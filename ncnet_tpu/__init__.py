@@ -1,0 +1,23 @@
+"""ncnet_tpu — a TPU-native (JAX/XLA/Pallas) neighbourhood-consensus correspondence framework.
+
+A ground-up re-design of the capabilities of the NCNet reference codebase
+(Rocco et al., NeurIPS 2018; reference tree surveyed in SURVEY.md) for TPU
+hardware: the compute path is pure-functional JAX compiled by XLA, the hot 4-D
+correlation ops have Pallas TPU kernels, and scaling is expressed through
+`jax.sharding` meshes (data parallelism for training, spatial sharding of the
+4-D correlation tensor for high-resolution matching).
+
+Layer map (mirrors SURVEY.md §1, re-architected):
+
+    cli/        entry points (train, eval_pf_pascal, eval_pf_willow, eval_tss, eval_inloc)
+    evals/      metrics and match-file writers (PCK, flow, InLoc .mat)
+    models/     backbones (ResNet-101 / VGG-16 in flax) + the NCNet model
+    ops/        correlation / mutual matching / Conv4d / maxpool4d / match extraction
+                (XLA einsum formulations + Pallas TPU kernels)
+    geometry/   affine & TPS grid generation, bilinear sampling, point transforms, .flo I/O
+    data/       CSV pair datasets, normalization, host-side prefetching loader
+    parallel/   mesh construction, data-parallel training step, corr-tensor sharding
+    training/   weak-supervision loss, optax train state, orbax checkpointing
+"""
+
+__version__ = "0.1.0"

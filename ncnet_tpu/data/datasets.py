@@ -1,0 +1,258 @@
+"""CSV-driven pair datasets: training pairs, PF-Pascal, PF-Willow, TSS.
+
+Host-side numpy datasets with `__len__` / `__getitem__` returning dicts of
+numpy arrays, consumed by `ncnet_tpu.data.loader`.
+
+Reference parity:
+  * ImagePairDataset  — lib/im_pair_dataset.py:11-93 (train/val pairs with
+    class + flip columns; both images resized to a square output).
+  * PFPascalDataset   — lib/pf_dataset.py:11-112 incl. the 'pf' and 'scnet'
+    L_pck procedures; keypoints padded to 20 with -1.
+  * PFWillowDataset   — lib/pf_willow_dataset.py:12-89 (10 points, L_pck from
+    the target keypoints' bbox max side).
+  * TSSDataset        — lib/tss_dataset.py:12-110 (pairs with flow direction
+    and flip; returns the GT-flow relative path for output naming).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from .image_io import load_and_resize_chw
+from .normalization import normalize_image_dict
+
+MAX_KEYPOINTS = 20
+
+
+class ImagePairDataset:
+    """Weak-supervision training pairs (CSV: source, target, class, flip)."""
+
+    def __init__(
+        self,
+        csv_path: str,
+        image_path: str,
+        output_size=(400, 400),
+        normalize: bool = True,
+        dataset_size: int = 0,
+        random_crop: bool = False,
+        rng: Optional[np.random.RandomState] = None,
+    ):
+        data = pd.read_csv(csv_path)
+        if dataset_size:
+            data = data.iloc[: min(dataset_size, len(data))]
+        self.img_a = data.iloc[:, 0].tolist()
+        self.img_b = data.iloc[:, 1].tolist()
+        self.category = data.iloc[:, 2].to_numpy()
+        self.flip = data.iloc[:, 3].to_numpy().astype(int)
+        self.image_path = image_path
+        self.out_h, self.out_w = output_size
+        self.normalize = normalize
+        self.random_crop = random_crop
+        self.rng = rng or np.random.RandomState(0)
+
+    def __len__(self):
+        return len(self.img_a)
+
+    def _load(self, rel, flip):
+        path = os.path.join(self.image_path, rel)
+        if self.random_crop:
+            from .image_io import read_image, resize_bilinear_np
+
+            img = read_image(path)
+            h, w = img.shape[:2]
+            top = self.rng.randint(h // 4 or 1)
+            bottom = int(3 * h / 4 + self.rng.randint(h // 4 or 1))
+            left = self.rng.randint(w // 4 or 1)
+            right = int(3 * w / 4 + self.rng.randint(w // 4 or 1))
+            img = img[top:bottom, left:right]
+            im_size = np.asarray(img.shape, np.float32)
+            if flip:
+                img = img[:, ::-1]
+            img = resize_bilinear_np(img, self.out_h, self.out_w)
+            return img.transpose(2, 0, 1).copy(), im_size
+        return load_and_resize_chw(path, self.out_h, self.out_w, flip=bool(flip))
+
+    def __getitem__(self, idx):
+        flip = self.flip[idx]
+        image_a, size_a = self._load(self.img_a[idx], flip)
+        image_b, size_b = self._load(self.img_b[idx], flip)
+        sample = {
+            "source_image": image_a,
+            "target_image": image_b,
+            "source_im_size": size_a,
+            "target_im_size": size_b,
+            "set": np.asarray(self.category[idx], np.float32),
+        }
+        if self.normalize:
+            sample = normalize_image_dict(sample, ["source_image", "target_image"])
+        return sample
+
+
+def _parse_points(xs: str, ys: str, pad_to: int = MAX_KEYPOINTS) -> np.ndarray:
+    """Parse ';'-separated coord lists, pad to fixed length with -1."""
+    x = np.fromstring(xs, sep=";") if ";" in xs or xs else np.array([])
+    y = np.fromstring(ys, sep=";") if ";" in ys or ys else np.array([])
+    xp = -np.ones(pad_to)
+    yp = -np.ones(pad_to)
+    xp[: len(x)] = x
+    yp[: len(x)] = y
+    return np.stack([xp, yp]).astype(np.float32)
+
+
+class PFPascalDataset:
+    """PF-Pascal keypoint-transfer eval pairs."""
+
+    def __init__(
+        self,
+        csv_path: str,
+        dataset_path: str,
+        output_size=(400, 400),
+        category: Optional[int] = None,
+        pck_procedure: str = "pf",
+        normalize: bool = True,
+    ):
+        pairs = pd.read_csv(csv_path)
+        self.category = pairs.iloc[:, 2].to_numpy().astype(float)
+        if category is not None:
+            keep = np.nonzero(self.category == category)[0]
+            pairs = pairs.iloc[keep]
+            self.category = self.category[keep]
+        self.img_a = pairs.iloc[:, 0].tolist()
+        self.img_b = pairs.iloc[:, 1].tolist()
+        self.points_a = pairs.iloc[:, 3:5]
+        self.points_b = pairs.iloc[:, 5:7]
+        self.dataset_path = dataset_path
+        self.out_h, self.out_w = output_size
+        self.pck_procedure = pck_procedure
+        self.normalize = normalize
+
+    def __len__(self):
+        return len(self.img_a)
+
+    def __getitem__(self, idx):
+        image_a, size_a = load_and_resize_chw(
+            os.path.join(self.dataset_path, self.img_a[idx]), self.out_h, self.out_w
+        )
+        image_b, size_b = load_and_resize_chw(
+            os.path.join(self.dataset_path, self.img_b[idx]), self.out_h, self.out_w
+        )
+        pts_a = _parse_points(self.points_a.iloc[idx, 0], self.points_a.iloc[idx, 1])
+        pts_b = _parse_points(self.points_b.iloc[idx, 0], self.points_b.iloc[idx, 1])
+        n_pts = int(np.sum(pts_a[0] != -1))
+
+        if self.pck_procedure == "pf":
+            l_pck = np.array(
+                [np.max(pts_a[:, :n_pts].max(1) - pts_a[:, :n_pts].min(1))], np.float32
+            )
+        elif self.pck_procedure == "scnet":
+            # SCNet procedure: rescale points (and nominal im size) to 224^2
+            # (parity: lib/pf_dataset.py:64-75).
+            pts_a[0, :n_pts] = pts_a[0, :n_pts] * 224 / size_a[1]
+            pts_a[1, :n_pts] = pts_a[1, :n_pts] * 224 / size_a[0]
+            pts_b[0, :n_pts] = pts_b[0, :n_pts] * 224 / size_b[1]
+            pts_b[1, :n_pts] = pts_b[1, :n_pts] * 224 / size_b[0]
+            size_a = size_a.copy()
+            size_b = size_b.copy()
+            size_a[0:2] = 224
+            size_b[0:2] = 224
+            l_pck = np.array([224.0], np.float32)
+        else:
+            raise ValueError(f"unknown pck procedure {self.pck_procedure!r}")
+
+        sample = {
+            "source_image": image_a,
+            "target_image": image_b,
+            "source_im_size": size_a,
+            "target_im_size": size_b,
+            "source_points": pts_a,
+            "target_points": pts_b,
+            "L_pck": l_pck,
+        }
+        if self.normalize:
+            sample = normalize_image_dict(sample, ["source_image", "target_image"])
+        return sample
+
+
+class PFWillowDataset:
+    """PF-Willow eval pairs (10 keypoints; L_pck = target-bbox max side)."""
+
+    def __init__(self, csv_path, dataset_path, output_size=(400, 400), normalize=True):
+        pairs = pd.read_csv(csv_path)
+        self.img_a = pairs.iloc[:, 0].tolist()
+        self.img_b = pairs.iloc[:, 1].tolist()
+        self.points_a = pairs.iloc[:, 2:4]
+        self.points_b = pairs.iloc[:, 4:6]
+        self.dataset_path = dataset_path
+        self.out_h, self.out_w = output_size
+        self.normalize = normalize
+
+    def __len__(self):
+        return len(self.img_a)
+
+    def __getitem__(self, idx):
+        image_a, size_a = load_and_resize_chw(
+            os.path.join(self.dataset_path, self.img_a[idx]), self.out_h, self.out_w
+        )
+        image_b, size_b = load_and_resize_chw(
+            os.path.join(self.dataset_path, self.img_b[idx]), self.out_h, self.out_w
+        )
+        pts_a = _parse_points(self.points_a.iloc[idx, 0], self.points_a.iloc[idx, 1], 10)
+        pts_b = _parse_points(self.points_b.iloc[idx, 0], self.points_b.iloc[idx, 1], 10)
+        # L_pck from the SOURCE points bbox (parity: lib/pf_willow_dataset.py
+        # uses point_A_coords max-min).
+        l_pck = np.array([np.max(pts_a.max(1) - pts_a.min(1))], np.float32)
+        sample = {
+            "source_image": image_a,
+            "target_image": image_b,
+            "source_im_size": size_a,
+            "target_im_size": size_b,
+            "source_points": pts_a,
+            "target_points": pts_b,
+            "L_pck": l_pck,
+        }
+        if self.normalize:
+            sample = normalize_image_dict(sample, ["source_image", "target_image"])
+        return sample
+
+
+class TSSDataset:
+    """TSS dense-flow eval pairs (CSV: source, target, flow_direction, flip, category)."""
+
+    def __init__(self, csv_path, dataset_path, output_size=(400, 400), normalize=True):
+        data = pd.read_csv(csv_path)
+        self.img_a = data.iloc[:, 0].tolist()
+        self.img_b = data.iloc[:, 1].tolist()
+        self.flow_direction = data.iloc[:, 2].to_numpy().astype(int)
+        self.flip = data.iloc[:, 3].to_numpy().astype(int)
+        self.dataset_path = dataset_path
+        self.out_h, self.out_w = output_size
+        self.normalize = normalize
+
+    def __len__(self):
+        return len(self.img_a)
+
+    def __getitem__(self, idx):
+        flip = bool(self.flip[idx])
+        image_a, size_a = load_and_resize_chw(
+            os.path.join(self.dataset_path, self.img_a[idx]), self.out_h, self.out_w, flip
+        )
+        image_b, size_b = load_and_resize_chw(
+            os.path.join(self.dataset_path, self.img_b[idx]), self.out_h, self.out_w, flip
+        )
+        # GT flow lives next to the image pair; direction picks flow1/flow2.
+        pair_dir = os.path.dirname(self.img_a[idx])
+        flow_file = f"flow{self.flow_direction[idx]}.flo"
+        sample = {
+            "source_image": image_a,
+            "target_image": image_b,
+            "source_im_size": size_a,
+            "target_im_size": size_b,
+            "flow_path": os.path.join(pair_dir, flow_file),
+        }
+        if self.normalize:
+            sample = normalize_image_dict(sample, ["source_image", "target_image"])
+        return sample

@@ -1,0 +1,55 @@
+"""Host-side image loading and resizing for the input pipeline.
+
+The reference reads with skimage and resizes through an identity affine
+grid-sample on the CPU torch path (lib/im_pair_dataset.py:59-93). Here images
+are read with PIL and resized with a numpy corner-aligned bilinear resize that
+matches `ncnet_tpu.geometry.grid.resize_bilinear` (same align_corners=True
+semantics), so host preprocessing and on-device code agree.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from PIL import Image
+
+
+def read_image(path: str) -> np.ndarray:
+    """Read an image as [h, w, 3] uint8 (grayscale broadcast to 3 channels)."""
+    img = Image.open(path)
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = np.repeat(arr[:, :, None], 3, axis=2)
+    if arr.shape[2] == 4:
+        arr = arr[:, :, :3]
+    return arr
+
+
+def resize_bilinear_np(image: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Corner-aligned bilinear resize of [h, w, c] float/uint8 -> float32."""
+    h, w = image.shape[:2]
+    img = image.astype(np.float32)
+    ys = np.linspace(0, h - 1, out_h)
+    xs = np.linspace(0, w - 1, out_w)
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    out = (
+        img[np.ix_(y0, x0)] * (1 - wy) * (1 - wx)
+        + img[np.ix_(y0, x1)] * (1 - wy) * wx
+        + img[np.ix_(y1, x0)] * wy * (1 - wx)
+        + img[np.ix_(y1, x1)] * wy * wx
+    )
+    return out
+
+
+def load_and_resize_chw(path: str, out_h: int, out_w: int, flip: bool = False) -> tuple:
+    """Read, optionally h-flip, resize; return ([3,h,w] float32, orig (h,w,c))."""
+    img = read_image(path)
+    im_size = np.asarray(img.shape, np.float32)
+    if flip:
+        img = img[:, ::-1]
+    img = resize_bilinear_np(img, out_h, out_w)
+    return img.transpose(2, 0, 1).copy(), im_size

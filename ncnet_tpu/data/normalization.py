@@ -1,0 +1,32 @@
+"""Image normalization (host-side numpy; parity: lib/normalization.py:5-50)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize_image(image, forward: bool = True, mean=IMAGENET_MEAN, std=IMAGENET_STD):
+    """Normalize (or de-normalize) a [..., 3, h, w] float image array.
+
+    `forward=True`: (x - mean) / std. `forward=False` inverts. The /255 range
+    normalization is the caller's responsibility (see `normalize_image_dict`).
+    """
+    mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+    if forward:
+        return (image - mean) / std
+    return image * std + mean
+
+
+def normalize_image_dict(sample: dict, image_keys, normalize_range: bool = True) -> dict:
+    """Normalize the named image entries of a sample dict in place-free style."""
+    out = dict(sample)
+    for key in image_keys:
+        img = np.asarray(out[key], np.float32)
+        if normalize_range:
+            img = img / 255.0
+        out[key] = normalize_image(img)
+    return out

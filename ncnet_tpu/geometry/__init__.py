@@ -1,0 +1,43 @@
+"""Geometry engine: grids, sampling, TPS, point transforms, flow I/O."""
+
+from .coords import (
+    normalize_axis,
+    unnormalize_axis,
+    points_to_unit_coords,
+    points_to_pixel_coords,
+)
+from .grid import (
+    affine_grid,
+    identity_grid,
+    grid_sample,
+    affine_transform,
+    resize_bilinear,
+)
+from .tps import TpsGrid, tps_point_transform, affine_point_transform
+from .flow_io import (
+    read_flo_file,
+    write_flo_file,
+    flow_to_sampling_grid,
+    sampling_grid_to_flow,
+    warp_image_by_flow,
+)
+
+__all__ = [
+    "normalize_axis",
+    "unnormalize_axis",
+    "points_to_unit_coords",
+    "points_to_pixel_coords",
+    "affine_grid",
+    "identity_grid",
+    "grid_sample",
+    "affine_transform",
+    "resize_bilinear",
+    "TpsGrid",
+    "tps_point_transform",
+    "affine_point_transform",
+    "read_flo_file",
+    "write_flo_file",
+    "flow_to_sampling_grid",
+    "sampling_grid_to_flow",
+    "warp_image_by_flow",
+]

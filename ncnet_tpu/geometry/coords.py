@@ -1,0 +1,54 @@
+"""Coordinate normalization between pixel and normalized [-1, 1] spaces.
+
+Semantics match the reference implementation (see /root/reference
+geotnf/point_tnf.py:6-10 and lib/point_tnf.py:6-10): pixel coordinates follow
+the 1-indexed convention used by the PF-Pascal/PF-Willow Matlab annotations,
+so pixel 1 maps to -1 and pixel L maps to +1.
+
+All functions are pure jnp and jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def normalize_axis(x, length):
+    """Map 1-indexed pixel coords [1, L] to normalized coords [-1, 1]."""
+    length = jnp.asarray(length, dtype=jnp.result_type(x, jnp.float32))
+    return (x - 1 - (length - 1) / 2) * 2 / (length - 1)
+
+
+def unnormalize_axis(x, length):
+    """Map normalized coords [-1, 1] back to 1-indexed pixel coords [1, L]."""
+    length = jnp.asarray(length, dtype=jnp.result_type(x, jnp.float32))
+    return x * (length - 1) / 2 + 1 + (length - 1) / 2
+
+
+def points_to_unit_coords(points, im_size):
+    """Normalize point sets from pixel to [-1, 1] coords.
+
+    Args:
+      points: [b, 2, n] array; row 0 is X, row 1 is Y (pixel coords).
+      im_size: [b, 2] array of (height, width) per batch element.
+
+    Returns:
+      [b, 2, n] normalized points.
+
+    Reference parity: lib/point_tnf.py:152-159 (X normalized by width,
+    Y by height).
+    """
+    h = im_size[:, 0:1]
+    w = im_size[:, 1:2]
+    x = normalize_axis(points[:, 0, :], w)
+    y = normalize_axis(points[:, 1, :], h)
+    return jnp.stack([x, y], axis=1)
+
+
+def points_to_pixel_coords(points, im_size):
+    """Inverse of :func:`points_to_unit_coords` (lib/point_tnf.py:161-168)."""
+    h = im_size[:, 0:1]
+    w = im_size[:, 1:2]
+    x = unnormalize_axis(points[:, 0, :], w)
+    y = unnormalize_axis(points[:, 1, :], h)
+    return jnp.stack([x, y], axis=1)

@@ -1,0 +1,89 @@
+"""Middlebury .flo flow I/O and flow <-> sampling-grid conversion.
+
+Host-side (numpy) utilities; parity target is geotnf/flow.py:7-124 of the
+reference tree, including the 1e10 out-of-bounds sentinel convention consumed
+by the external TSS evaluation kit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FLO_MAGIC = 202021.25
+
+
+def read_flo_file(filename: str) -> np.ndarray:
+    """Read a Middlebury .flo file into an [h, w, 2] float32 array."""
+    with open(filename, "rb") as f:
+        magic = np.fromfile(f, np.float32, count=1)
+        if magic.size == 0 or magic[0] != np.float32(_FLO_MAGIC):
+            raise TypeError(f"{filename}: bad .flo magic number")
+        w = int(np.fromfile(f, np.int32, count=1)[0])
+        h = int(np.fromfile(f, np.int32, count=1)[0])
+        data = np.fromfile(f, np.float32, count=2 * w * h)
+    return data.reshape(h, w, 2)
+
+
+def write_flo_file(flow: np.ndarray, filename: str) -> None:
+    """Write an [h, w, 2] flow field in Middlebury .flo format."""
+    flow = np.asarray(flow, dtype=np.float32)
+    h, w = flow.shape[:2]
+    with open(filename, "wb") as f:
+        np.array([_FLO_MAGIC], dtype=np.float32).tofile(f)
+        np.array([w], dtype=np.int32).tofile(f)
+        np.array([h], dtype=np.int32).tofile(f)
+        flow.tofile(f)
+
+
+def _normalize_axis_np(x, length):
+    return (x - 1 - (length - 1) / 2) * 2 / (length - 1)
+
+
+def _unnormalize_axis_np(x, length):
+    return x * (length - 1) / 2 + 1 + (length - 1) / 2
+
+
+def flow_to_sampling_grid(flow: np.ndarray, h_src: int, w_src: int) -> np.ndarray:
+    """Convert a target->source flow field to a normalized sampling grid.
+
+    Parity: geotnf/flow.py:70-84. Pixel indices are 1-based (Matlab heritage
+    of the TSS ground truth).
+    """
+    h_tgt, w_tgt = flow.shape[:2]
+    gx, gy = np.meshgrid(np.arange(1, w_tgt + 1), np.arange(1, h_tgt + 1))
+    sx = _normalize_axis_np(gx + flow[:, :, 0], w_src)
+    sy = _normalize_axis_np(gy + flow[:, :, 1], h_src)
+    return np.stack([sx, sy], axis=2).astype(np.float32)
+
+
+def sampling_grid_to_flow(source_grid: np.ndarray, h_src: int, w_src: int) -> np.ndarray:
+    """Convert a normalized [h_tgt, w_tgt, 2] sampling grid to a flow field.
+
+    Out-of-bounds grid locations get the 1e10 sentinel expected by the TSS
+    evaluation kit (parity: geotnf/flow.py:103-124).
+    """
+    source_grid = np.asarray(source_grid)
+    if source_grid.ndim == 4:
+        source_grid = source_grid[0]
+    h_tgt, w_tgt = source_grid.shape[:2]
+    sxn, syn = source_grid[:, :, 0], source_grid[:, :, 1]
+    in_bounds = (sxn > -1) & (sxn < 1) & (syn > -1) & (syn < 1)
+    sx = _unnormalize_axis_np(sxn, w_src)
+    sy = _unnormalize_axis_np(syn, h_src)
+    gx, gy = np.meshgrid(np.arange(1, w_tgt + 1), np.arange(1, h_tgt + 1))
+    dx = (sx - gx) * in_bounds + 1e10 * (1 - in_bounds)
+    dy = (sy - gy) * in_bounds + 1e10 * (1 - in_bounds)
+    return np.stack([dx, dy], axis=2)
+
+
+def warp_image_by_flow(image: np.ndarray, flow: np.ndarray) -> np.ndarray:
+    """Warp an [h, w, c] uint8/float image by a target->source flow field."""
+    # Local import: geometry.grid is jax; keep flow_io importable host-only.
+    import jax.numpy as jnp
+
+    from .grid import grid_sample
+
+    grid = flow_to_sampling_grid(flow, image.shape[0], image.shape[1])
+    img = jnp.asarray(image.astype(np.float32).transpose(2, 0, 1)[None])
+    out = grid_sample(img, jnp.asarray(grid)[None])
+    return np.asarray(out[0]).transpose(1, 2, 0).astype(np.uint8)

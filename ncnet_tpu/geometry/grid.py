@@ -1,0 +1,116 @@
+"""Sampling-grid generation and bilinear grid sampling.
+
+Reproduces the sampling semantics the reference model was trained with
+(PyTorch 0.3 `F.affine_grid` / `F.grid_sample`, reached through
+geotnf/transformation.py:371-423 and :122-135 of the reference tree):
+
+* corner alignment ("align_corners=True"): normalized coord -1 maps to the
+  center of the first pixel and +1 to the center of the last pixel;
+* zero padding outside the image: out-of-range bilinear taps contribute 0.
+
+Getting this wrong silently shifts every downstream PCK number (SURVEY.md §7
+"hard parts" item 2), so the unit tests pin these functions against
+`torch.nn.functional.grid_sample(..., align_corners=True)` on CPU.
+
+Layout convention throughout the framework is NCHW for images (matching the
+correlation-tensor layout [b, 1, iA, jA, iB, jB]) and [b, H, W, 2] for grids
+with (x, y) channel order.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def affine_grid(theta, out_h, out_w):
+    """Generate a sampling grid from batched 2x3 affine matrices.
+
+    Args:
+      theta: [b, 2, 3] affine parameters (row 0 produces x', row 1 y').
+      out_h, out_w: static output grid size.
+
+    Returns:
+      [b, out_h, out_w, 2] grid of (x, y) normalized sampling locations.
+    """
+    theta = jnp.reshape(theta, (-1, 2, 3))
+    xs = jnp.linspace(-1.0, 1.0, out_w)
+    ys = jnp.linspace(-1.0, 1.0, out_h)
+    gx, gy = jnp.meshgrid(xs, ys)  # each [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+    # [b, H, W, 2] = base [H, W, 3] . theta^T [b, 3, 2]
+    grid = jnp.einsum("hwk,bjk->bhwj", base, theta)
+    return grid
+
+
+def identity_grid(batch, out_h, out_w):
+    """Identity sampling grid (pure bilinear resize when sampled)."""
+    theta = jnp.broadcast_to(
+        jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]], dtype=jnp.float32),
+        (batch, 2, 3),
+    )
+    return affine_grid(theta, out_h, out_w)
+
+
+def grid_sample(image, grid):
+    """Bilinear sampling with corner-aligned coords and zero padding.
+
+    Args:
+      image: [b, c, h, w].
+      grid: [b, H, W, 2] normalized (x, y) sampling locations.
+
+    Returns:
+      [b, c, H, W] sampled output.
+    """
+    b, c, h, w = image.shape
+    x = grid[..., 0]
+    y = grid[..., 1]
+    # Corner-aligned unnormalization to 0-indexed continuous pixel coords.
+    ix = (x + 1.0) * (w - 1) / 2.0
+    iy = (y + 1.0) * (h - 1) / 2.0
+
+    ix0 = jnp.floor(ix)
+    iy0 = jnp.floor(iy)
+    ix1 = ix0 + 1
+    iy1 = iy0 + 1
+
+    wx1 = ix - ix0
+    wy1 = iy - iy0
+    wx0 = 1.0 - wx1
+    wy0 = 1.0 - wy1
+
+    def gather(iy_t, ix_t):
+        """Gather image values at integer (iy_t, ix_t), zero outside."""
+        valid = (iy_t >= 0) & (iy_t <= h - 1) & (ix_t >= 0) & (ix_t <= w - 1)
+        iy_c = jnp.clip(iy_t, 0, h - 1).astype(jnp.int32)
+        ix_c = jnp.clip(ix_t, 0, w - 1).astype(jnp.int32)
+        flat = image.reshape(b, c, h * w)
+        idx = (iy_c * w + ix_c).reshape(b, -1)  # [b, H*W]
+        vals = jnp.take_along_axis(flat, idx[:, None, :], axis=2)
+        vals = vals.reshape(b, c, *iy_t.shape[1:])
+        return vals * valid[:, None].astype(image.dtype)
+
+    out = (
+        gather(iy0, ix0) * (wy0 * wx0)[:, None]
+        + gather(iy0, ix1) * (wy0 * wx1)[:, None]
+        + gather(iy1, ix0) * (wy1 * wx0)[:, None]
+        + gather(iy1, ix1) * (wy1 * wx1)[:, None]
+    )
+    return out
+
+
+def affine_transform(image, theta, out_h, out_w):
+    """Warp `image` by affine `theta` into an (out_h, out_w) output.
+
+    With the identity theta this is a plain corner-aligned bilinear resize —
+    the same trick the reference uses for all dataset-side resizing
+    (lib/transformation.py:15-45 "AffineTnf" with theta=None).
+    """
+    grid = affine_grid(theta, out_h, out_w)
+    return grid_sample(image, grid.astype(image.dtype))
+
+
+def resize_bilinear(image, out_h, out_w):
+    """Corner-aligned bilinear resize of an NCHW batch."""
+    b = image.shape[0]
+    return grid_sample(image, identity_grid(b, out_h, out_w).astype(image.dtype))

@@ -1,0 +1,131 @@
+"""Thin-plate-spline (TPS) warps.
+
+Math parity target: the reference TpsGridGen (geotnf/transformation.py:425-561):
+a regular grid_size x grid_size lattice of control points on [-1, 1]^2, the
+L^-1 system matrix of Bookstein's TPS, and the U(r) = r^2 log(r^2) radial
+basis (with U(0) = 0 via the r^2 -> 1 substitution at
+geotnf/transformation.py:475,541).
+
+Design differences from the reference (TPU-first):
+* the L^-1 matrix is precomputed once in numpy at construction and closed
+  over as a constant — XLA constant-folds it into the compiled program;
+* `tps_apply` is a pure function over arbitrarily-shaped point sets, used both
+  for grid generation (vectorized over H*W pixels) and point warping
+  (geotnf/point_tnf.py:24-32), so there is a single TPS code path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _control_points(grid_size: int) -> np.ndarray:
+    """Regular lattice of control points on [-1,1]^2, shape [N, 2] (x, y).
+
+    Ordering parity: the reference builds P via
+    `P_Y, P_X = np.meshgrid(axis_coords, axis_coords)` then flattens
+    (geotnf/transformation.py:447-451), i.e. X varies slowest.
+    """
+    axis = np.linspace(-1, 1, grid_size)
+    py, px = np.meshgrid(axis, axis)
+    return np.stack([px.reshape(-1), py.reshape(-1)], axis=1)
+
+
+def _l_inverse(points: np.ndarray, reg_factor: float = 0.0) -> np.ndarray:
+    """Inverse of the TPS system matrix L for control points [N, 2]."""
+    n = points.shape[0]
+    x, y = points[:, 0:1], points[:, 1:2]
+    d2 = (x - x.T) ** 2 + (y - y.T) ** 2
+    d2 = np.where(d2 == 0, 1.0, d2)  # diagonal: U(0) = 0 via log(1)
+    k = d2 * np.log(d2)
+    if reg_factor != 0:
+        k = k + np.eye(n) * reg_factor
+    p = np.concatenate([np.ones((n, 1)), x, y], axis=1)
+    top = np.concatenate([k, p], axis=1)
+    bot = np.concatenate([p.T, np.zeros((3, 3))], axis=1)
+    l_mat = np.concatenate([top, bot], axis=0)
+    return np.linalg.inv(l_mat).astype(np.float32)
+
+
+class TpsGrid:
+    """TPS warp parameterized by control-point displacements.
+
+    theta layout parity with the reference (geotnf/transformation.py:499-500):
+    [b, 2N] with the first N entries the X coords of the warped control
+    points, the last N the Y coords.
+    """
+
+    def __init__(self, grid_size: int = 3, reg_factor: float = 0.0):
+        self.grid_size = grid_size
+        self.n = grid_size * grid_size
+        cp = _control_points(grid_size)
+        self.control_points = jnp.asarray(cp)  # [N, 2]
+        li = _l_inverse(cp, reg_factor)
+        self.li_w = jnp.asarray(li[: self.n, : self.n])  # [N, N]
+        self.li_a = jnp.asarray(li[self.n :, : self.n])  # [3, N]
+
+    def apply(self, theta, points):
+        """Warp `points` ([..., 2] normalized (x, y)) by TPS params `theta`.
+
+        Args:
+          theta: [b, 2N] (or [b, N, 2]-reshapable) target control coords.
+          points: [b, ..., 2] or [...,2] points to transform (broadcast over b).
+
+        Returns:
+          [b, ..., 2] warped points.
+        """
+        b = theta.shape[0]
+        theta = theta.reshape(b, 2, self.n)  # [b, (x|y), N]
+        q = jnp.swapaxes(theta, 1, 2)  # [b, N, 2]
+        w = jnp.einsum("mn,bnk->bmk", self.li_w, q)  # [b, N, 2] nonlinear wts
+        a = jnp.einsum("mn,bnk->bmk", self.li_a, q)  # [b, 3, 2] affine wts
+
+        if points.shape[-1] != 2:
+            raise ValueError("points must have trailing dim 2")
+        if points.ndim >= 3 and points.shape[0] == b:
+            pts = points  # already batched [b, ..., 2]
+        else:
+            pts = jnp.broadcast_to(points, (b,) + points.shape)
+        flat = pts.reshape(b, -1, 2)  # [b, M, 2]
+
+        d2 = jnp.sum(
+            (flat[:, :, None, :] - self.control_points[None, None, :, :]) ** 2,
+            axis=-1,
+        )  # [b, M, N]
+        d2 = jnp.where(d2 == 0, 1.0, d2)
+        u = d2 * jnp.log(d2)
+
+        affine = (
+            a[:, 0:1, :]
+            + flat[:, :, 0:1] * a[:, 1:2, :]
+            + flat[:, :, 1:2] * a[:, 2:3, :]
+        )  # [b, M, 2]
+        nonlin = jnp.einsum("bmn,bnk->bmk", u, w)  # [b, M, 2]
+        out = affine + nonlin
+        return out.reshape(pts.shape)
+
+    def grid(self, theta, out_h: int, out_w: int):
+        """Dense [b, out_h, out_w, 2] TPS sampling grid."""
+        xs = jnp.linspace(-1.0, 1.0, out_w)
+        ys = jnp.linspace(-1.0, 1.0, out_h)
+        gx, gy = jnp.meshgrid(xs, ys)
+        pts = jnp.stack([gx, gy], axis=-1)  # [H, W, 2]
+        return self.apply(theta, pts)
+
+
+def tps_point_transform(theta, points, grid_size: int = 3, reg_factor: float = 0.0):
+    """Warp [b, 2, n] point sets with TPS (parity: geotnf/point_tnf.py:24-32)."""
+    tps = TpsGrid(grid_size=grid_size, reg_factor=reg_factor)
+    pts = jnp.swapaxes(points, 1, 2)  # [b, n, 2]
+    warped = tps.apply(theta, pts)
+    return jnp.swapaxes(warped, 1, 2)
+
+
+def affine_point_transform(theta, points):
+    """Warp [b, 2, n] points by [b, 2, 3] (or [b,6]) affine params.
+
+    Parity: geotnf/point_tnf.py:34-38.
+    """
+    theta = theta.reshape(-1, 2, 3)
+    return jnp.einsum("bij,bjn->bin", theta[:, :, :2], points) + theta[:, :, 2:3]

@@ -1,0 +1,25 @@
+"""Models: backbones, the NCNet model, and checkpoint conversion."""
+
+from .backbone import BackboneConfig, backbone_init, backbone_apply
+from .ncnet import (
+    NCNetConfig,
+    PF_PASCAL_CONFIG,
+    INLOC_CONFIG,
+    ncnet_init,
+    ncnet_forward,
+    extract_features,
+    match_pipeline,
+)
+
+__all__ = [
+    "BackboneConfig",
+    "backbone_init",
+    "backbone_apply",
+    "NCNetConfig",
+    "PF_PASCAL_CONFIG",
+    "INLOC_CONFIG",
+    "ncnet_init",
+    "ncnet_forward",
+    "extract_features",
+    "match_pipeline",
+]

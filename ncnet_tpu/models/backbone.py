@@ -1,0 +1,232 @@
+"""Feature-extraction backbones (ResNet-101, VGG-16) as functional JAX.
+
+Parity target: the reference FeatureExtraction module (lib/model.py:19-87):
+a torchvision backbone truncated at a named layer (`layer3` for ResNet-101 ->
+1024 channels at stride 16; `pool4` for VGG-16 -> 512 channels at stride 16),
+run in inference mode with batch-norm frozen to its running statistics
+(lib/model.py:251 calls .eval() unconditionally, and parameters are frozen
+unless fine-tuning, lib/model.py:75-78).
+
+Design choices (TPU-first):
+* static architecture config (hashable dataclass) + pure-array parameter
+  pytrees + pure apply functions — no mutable modules; the frozen running
+  statistics live in the pytree and are constant-folded by XLA when the
+  backbone is not being fine-tuned;
+* batch norm is applied in inference form (scale/shift from running stats),
+  so the whole backbone is convs + elementwise — ideal fusion food for XLA;
+* convolution padding is explicit and symmetric to match PyTorch semantics
+  (XLA 'SAME' pads asymmetrically under stride 2, which would shift features).
+
+Weight conversion from torchvision / reference `.pth.tar` checkpoints lives in
+models/convert.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+# Block counts for the torchvision ResNet family.
+RESNET_SPECS = {
+    "resnet101": (3, 4, 23, 3),
+    "resnet50": (3, 4, 6, 3),
+    "resnet152": (3, 8, 36, 3),
+}
+
+# torchvision vgg16.features layer sequence with the reference's layer names
+# (lib/model.py:27-31); ("pool*", 0, 0) entries are 2x2/2 max pools.
+VGG_CFG = (
+    ("conv1_1", 3, 64), ("conv1_2", 64, 64), ("pool1", 0, 0),
+    ("conv2_1", 64, 128), ("conv2_2", 128, 128), ("pool2", 0, 0),
+    ("conv3_1", 128, 256), ("conv3_2", 256, 256), ("conv3_3", 256, 256), ("pool3", 0, 0),
+    ("conv4_1", 256, 512), ("conv4_2", 512, 512), ("conv4_3", 512, 512), ("pool4", 0, 0),
+    ("conv5_1", 512, 512), ("conv5_2", 512, 512), ("conv5_3", 512, 512), ("pool5", 0, 0),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BackboneConfig:
+    """Static backbone architecture description (safe to close over in jit)."""
+
+    cnn: str = "resnet101"  # 'resnet101' | 'resnet50' | 'resnet152' | 'vgg'
+    last_layer: str = ""  # '' -> 'layer3' (resnet) / 'pool4' (vgg)
+
+    @property
+    def resolved_last_layer(self) -> str:
+        if self.last_layer:
+            return self.last_layer
+        return "pool4" if self.cnn == "vgg" else "layer3"
+
+    @property
+    def num_stages(self) -> int:
+        return ["layer1", "layer2", "layer3", "layer4"].index(self.resolved_last_layer) + 1
+
+    @property
+    def vgg_layers(self):
+        out = []
+        for name, cin, cout in VGG_CFG:
+            out.append((name, cin, cout))
+            if name == self.resolved_last_layer:
+                break
+        return out
+
+    @property
+    def out_channels(self) -> int:
+        if self.cnn == "vgg":
+            c = 0
+            for name, cin, cout in self.vgg_layers:
+                if cout:
+                    c = cout
+            return c
+        return 64 * (2 ** (self.num_stages - 1)) * 4
+
+
+def conv2d(x, w, stride: int = 1, padding: int = 0):
+    """NCHW conv with torch-style symmetric padding. w is [kh, kw, cin, cout]."""
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=("NCHW", "HWIO", "NCHW"),
+    )
+
+
+def frozen_bn(x, bn: Params, eps: float = 1e-5):
+    """Inference-mode batch norm using stored running statistics."""
+    scale = bn["scale"] * lax.rsqrt(bn["var"] + eps)
+    shift = bn["bias"] - bn["mean"] * scale
+    return x * scale.reshape(1, -1, 1, 1) + shift.reshape(1, -1, 1, 1)
+
+
+def max_pool(x, window: int, stride: int, padding: int):
+    """Torch-style max pool (pads with -inf)."""
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, 1, window, window),
+        window_strides=(1, 1, stride, stride),
+        padding=((0, 0), (0, 0), (padding, padding), (padding, padding)),
+    )
+
+
+def _bn_init(c):
+    return {
+        "scale": jnp.ones((c,), jnp.float32),
+        "bias": jnp.zeros((c,), jnp.float32),
+        "mean": jnp.zeros((c,), jnp.float32),
+        "var": jnp.ones((c,), jnp.float32),
+    }
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    std = (2.0 / fan_in) ** 0.5  # He init, mirroring torchvision
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * std
+
+
+def _bottleneck_init(key, cin, planes, stride):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    cout = planes * 4
+    p: Params = {
+        "conv1": _conv_init(k1, 1, 1, cin, planes),
+        "bn1": _bn_init(planes),
+        "conv2": _conv_init(k2, 3, 3, planes, planes),
+        "bn2": _bn_init(planes),
+        "conv3": _conv_init(k3, 1, 1, planes, cout),
+        "bn3": _bn_init(cout),
+    }
+    if stride != 1 or cin != cout:
+        p["downsample"] = {
+            "conv": _conv_init(k4, 1, 1, cin, cout),
+            "bn": _bn_init(cout),
+        }
+    return p
+
+
+def _stage_strides(config: BackboneConfig):
+    """(stage_name, block_idx) -> stride, derived statically from the arch."""
+    blocks = RESNET_SPECS[config.cnn]
+    plan = []
+    for stage in range(config.num_stages):
+        n = blocks[stage]
+        plan.append([2 if (b == 0 and stage > 0) else 1 for b in range(n)])
+    return plan
+
+
+def resnet_init(key, config: BackboneConfig) -> Params:
+    """Random-init truncated-ResNet params (array-only pytree)."""
+    key, k0 = jax.random.split(key)
+    params: Params = {"conv1": _conv_init(k0, 7, 7, 3, 64), "bn1": _bn_init(64)}
+    cin = 64
+    for stage, strides in enumerate(_stage_strides(config)):
+        planes = 64 * (2**stage)
+        stage_blocks: List[Params] = []
+        for stride in strides:
+            key, kb = jax.random.split(key)
+            stage_blocks.append(_bottleneck_init(kb, cin, planes, stride))
+            cin = planes * 4
+        params[f"layer{stage + 1}"] = stage_blocks
+    return params
+
+
+def _bottleneck_apply(p: Params, x, stride: int):
+    out = jax.nn.relu(frozen_bn(conv2d(x, p["conv1"]), p["bn1"]))
+    out = jax.nn.relu(frozen_bn(conv2d(out, p["conv2"], stride=stride, padding=1), p["bn2"]))
+    out = frozen_bn(conv2d(out, p["conv3"]), p["bn3"])
+    if "downsample" in p:
+        x = frozen_bn(conv2d(x, p["downsample"]["conv"], stride=stride), p["downsample"]["bn"])
+    return jax.nn.relu(out + x)
+
+
+def resnet_apply(config: BackboneConfig, params: Params, x):
+    """Run the truncated ResNet on an NCHW float batch."""
+    x = jax.nn.relu(frozen_bn(conv2d(x, params["conv1"], stride=2, padding=3), params["bn1"]))
+    x = max_pool(x, 3, 2, 1)
+    for stage, strides in enumerate(_stage_strides(config)):
+        for block, stride in zip(params[f"layer{stage + 1}"], strides):
+            x = _bottleneck_apply(block, x, stride)
+    return x
+
+
+def vgg_init(key, config: BackboneConfig) -> Params:
+    layers: List[Params] = []
+    for name, cin, cout in config.vgg_layers:
+        if cout == 0:
+            layers.append({})  # pool layer: no params
+        else:
+            key, kw = jax.random.split(key)
+            layers.append(
+                {"w": _conv_init(kw, 3, 3, cin, cout), "b": jnp.zeros((cout,), jnp.float32)}
+            )
+    return {"layers": layers}
+
+
+def vgg_apply(config: BackboneConfig, params: Params, x):
+    for (name, cin, cout), layer in zip(config.vgg_layers, params["layers"]):
+        if cout == 0:
+            x = max_pool(x, 2, 2, 0)
+        else:
+            x = jax.nn.relu(conv2d(x, layer["w"], padding=1) + layer["b"].reshape(1, -1, 1, 1))
+    return x
+
+
+def backbone_init(key, config: BackboneConfig) -> Params:
+    if config.cnn in RESNET_SPECS:
+        return resnet_init(key, config)
+    if config.cnn == "vgg":
+        return vgg_init(key, config)
+    raise ValueError(f"unknown backbone {config.cnn!r}")
+
+
+def backbone_apply(config: BackboneConfig, params: Params, x):
+    if config.cnn in RESNET_SPECS:
+        return resnet_apply(config, params, x)
+    return vgg_apply(config, params, x)

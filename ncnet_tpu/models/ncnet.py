@@ -1,0 +1,122 @@
+"""The NCNet model: backbone -> correlation -> (pool) -> mutual -> consensus -> mutual.
+
+Parity target: ImMatchNet (lib/model.py:193-282 of the reference), re-expressed
+as a static config + pure-array params + pure apply function. The forward
+composition matches lib/model.py:261-282 exactly:
+
+    fA = l2norm(backbone(src));  fB = l2norm(backbone(tgt))
+    corr = correlation(fA, fB)                   # no normalization (lib/model.py:235)
+    (corr, delta) = maxpool4d(corr, k)           # only when relocalization_k_size > 1
+    corr = mutual_matching(corr)
+    corr = neigh_consensus(corr)                 # symmetric mode
+    corr = mutual_matching(corr)
+
+Dtype policy: the backbone runs in float32; features are cast to
+`corr_dtype` (bf16 by default) for the correlation contraction and the 4-D
+pipeline runs in float32 accumulation — this supersedes the reference's
+`half_precision` fp16 mode (eval_inloc.py:50, lib/conv4d.py:21-28).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.correlation import feature_correlation, feature_l2norm
+from ..ops.conv4d import neigh_consensus_apply, neigh_consensus_init
+from ..ops.mutual import mutual_matching
+from ..ops.pool4d import maxpool4d
+from .backbone import BackboneConfig, backbone_apply, backbone_init
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class NCNetConfig:
+    """Static model configuration (hashable; safe as a jit static arg).
+
+    Defaults mirror the reference model defaults (lib/model.py:193-207);
+    the published PF-Pascal run uses kernel_sizes (5,5,5) / channels
+    (16,16,1) (train.py:42-43) and the IVD/InLoc run (3,3) / (16,1).
+    """
+
+    backbone: BackboneConfig = BackboneConfig()
+    ncons_kernel_sizes: Tuple[int, ...] = (3, 3, 3)
+    ncons_channels: Tuple[int, ...] = (10, 10, 1)
+    normalize_features: bool = True
+    symmetric_mode: bool = True
+    relocalization_k_size: int = 0
+    half_precision: bool = False  # bf16 correlation + 4-D pipeline
+
+    @property
+    def corr_dtype(self):
+        return jnp.bfloat16 if self.half_precision else jnp.float32
+
+
+PF_PASCAL_CONFIG = NCNetConfig(
+    ncons_kernel_sizes=(5, 5, 5), ncons_channels=(16, 16, 1)
+)
+INLOC_CONFIG = NCNetConfig(
+    ncons_kernel_sizes=(3, 3), ncons_channels=(16, 1),
+    relocalization_k_size=2, half_precision=True,
+)
+
+
+def ncnet_init(key, config: NCNetConfig) -> Params:
+    kb, kn = jax.random.split(key)
+    return {
+        "backbone": backbone_init(kb, config.backbone),
+        "neigh_consensus": neigh_consensus_init(
+            kn, config.ncons_kernel_sizes, config.ncons_channels
+        ),
+    }
+
+
+def extract_features(config: NCNetConfig, params: Params, image):
+    """Backbone features with optional L2 normalization (lib/model.py:83-87)."""
+    feats = backbone_apply(config.backbone, params["backbone"], image)
+    if config.normalize_features:
+        feats = feature_l2norm(feats)
+    return feats
+
+
+def match_pipeline(config: NCNetConfig, params: Params, corr4d):
+    """The 4-D filtering pipeline applied after (and excluding) correlation."""
+    corr4d = mutual_matching(corr4d)
+    corr4d = neigh_consensus_apply(
+        params["neigh_consensus"], corr4d, symmetric=config.symmetric_mode
+    )
+    corr4d = mutual_matching(corr4d)
+    return corr4d
+
+
+def ncnet_forward(
+    config: NCNetConfig,
+    params: Params,
+    source_image,
+    target_image,
+):
+    """Full forward pass.
+
+    Args:
+      source_image, target_image: [b, 3, H, W] normalized image batches.
+
+    Returns:
+      corr4d [b, 1, iA, jA, iB, jB], and — when relocalization is on —
+      the delta4d offset tuple, else None.
+    """
+    feat_a = extract_features(config, params, source_image)
+    feat_b = extract_features(config, params, target_image)
+    corr4d = feature_correlation(
+        feat_a, feat_b, compute_dtype=jnp.bfloat16
+    ).astype(config.corr_dtype)
+
+    delta4d = None
+    if config.relocalization_k_size > 1:
+        corr4d, delta4d = maxpool4d(corr4d, config.relocalization_k_size)
+
+    corr4d = match_pipeline(config, params, corr4d.astype(jnp.float32))
+    return corr4d, delta4d

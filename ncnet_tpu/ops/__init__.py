@@ -1,0 +1,31 @@
+"""TPU-native ops for the 4-D correlation pipeline."""
+
+from .correlation import feature_correlation, feature_correlation_3d, feature_l2norm
+from .conv4d import (
+    conv4d,
+    conv4d_reference,
+    neigh_consensus_apply,
+    neigh_consensus_init,
+)
+from .mutual import mutual_matching
+from .pool4d import maxpool4d
+from .matches import (
+    corr_to_matches,
+    nearest_neighbour_point_transfer,
+    bilinear_point_transfer,
+)
+
+__all__ = [
+    "feature_correlation",
+    "feature_correlation_3d",
+    "feature_l2norm",
+    "conv4d",
+    "conv4d_reference",
+    "neigh_consensus_apply",
+    "neigh_consensus_init",
+    "mutual_matching",
+    "maxpool4d",
+    "corr_to_matches",
+    "nearest_neighbour_point_transfer",
+    "bilinear_point_transfer",
+]

@@ -1,0 +1,149 @@
+"""4-D convolution over the correlation tensor.
+
+The reference implements Conv4d as a *Python loop over the first spatial
+dimension*, calling `F.conv3d` once per slice per kernel offset
+(lib/conv4d.py:39-48) — O(iA * k) dispatches. The TPU-native formulation
+decomposes the 4-D convolution into exactly `k` batched 3-D convolutions
+(one per first-kernel-dim offset, with the iA axis folded into the XLA batch
+dimension), which is mathematically identical, fully vectorized, and lets XLA
+tile the inner contraction onto the MXU:
+
+    out[b, co, i, j, k, l] =
+      sum_{di} conv3d(x_padded[b, :, i + di], w[di])[co, j, k, l]
+
+Weight layout is [kI, kJ, kK, kL, cin, cout] (TPU-friendly trailing
+channels); bias is [cout].
+
+All shapes are static under jit; `same` zero padding preserves the spatial
+size exactly as the reference does (lib/conv4d.py:26-36).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv4d(x, weight, bias=None):
+    """Apply a 4-D convolution.
+
+    Args:
+      x: [b, cin, I, J, K, L] correlation-tensor activations.
+      weight: [kI, kJ, kK, kL, cin, cout] filters (odd kernel dims).
+      bias: optional [cout].
+
+    Returns:
+      [b, cout, I, J, K, L].
+    """
+    b, cin, si, sj, sk, sl = x.shape
+    ki, kj, kk, kl, wcin, cout = weight.shape
+    if wcin != cin:
+        raise ValueError(f"cin mismatch: x has {cin}, weight has {wcin}")
+    pad_i = ki // 2
+
+    # Zero-pad the first spatial dim once; remaining dims are padded by the
+    # inner 3-D convolution ('SAME').
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pad_i, pad_i), (0, 0), (0, 0), (0, 0)))
+
+    # Fold (b, I) into the conv batch: [b*I, cin, J, K, L] slices shifted by di.
+    def shifted(di):
+        return lax.dynamic_slice_in_dim(xp, di, si, axis=2)
+
+    out = None
+    for di in range(ki):
+        xs = shifted(di)  # [b, cin, I, J, K, L]
+        xs = jnp.moveaxis(xs, 2, 1).reshape(b * si, cin, sj, sk, sl)
+        w3 = jnp.transpose(weight[di], (4, 3, 0, 1, 2))  # [cout, cin, kj, kk, kl]
+        y = lax.conv_general_dilated(
+            xs,
+            w3,
+            window_strides=(1, 1, 1),
+            padding="SAME",
+            dimension_numbers=("NCHWD", "OIHWD", "NCHWD"),
+        )
+        out = y if out is None else out + y
+
+    out = out.reshape(b, si, cout, sj, sk, sl)
+    out = jnp.moveaxis(out, 2, 1)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1, 1)
+    return out
+
+
+def conv4d_reference(x, weight, bias=None):
+    """Naive einsum 4-D convolution — oracle for tests, O(k^4) memory reads.
+
+    Used only by the test suite to pin `conv4d` (and the Pallas kernels)
+    against a direct implementation of the defining sum.
+    """
+    b, cin, si, sj, sk, sl = x.shape
+    ki, kj, kk, kl, _, cout = weight.shape
+    pads = [(k // 2, k // 2) for k in (ki, kj, kk, kl)]
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple(pads))
+    out = jnp.zeros((b, cout, si, sj, sk, sl), dtype=jnp.float32)
+    for di in range(ki):
+        for dj in range(kj):
+            for dk in range(kk):
+                for dl in range(kl):
+                    patch = xp[:, :, di : di + si, dj : dj + sj, dk : dk + sk, dl : dl + sl]
+                    out = out + jnp.einsum(
+                        "bcijkl,cn->bnijkl", patch, weight[di, dj, dk, dl]
+                    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1, 1)
+    return out
+
+
+def neigh_consensus_apply(params, corr, *, symmetric: bool = True):
+    """Apply the neighbourhood-consensus Conv4d+ReLU stack.
+
+    Args:
+      params: list of {'weight': [k,k,k,k,cin,cout], 'bias': [cout]} dicts.
+      corr: [b, 1, iA, jA, iB, jB].
+      symmetric: if True, also run the stack on the A<->B transposed tensor
+        and sum the results transposed back (parity: lib/model.py:143-153) —
+        this enforces symmetry w.r.t. the matching direction and is *not*
+        equivalent to symmetrizing the filters because of the interleaved
+        ReLUs.
+
+    Returns:
+      [b, c_last, iA, jA, iB, jB].
+    """
+
+    def stack(x):
+        for layer in params:
+            x = conv4d(x, layer["weight"], layer["bias"])
+            x = jax.nn.relu(x)
+        return x
+
+    if symmetric:
+        swapped = jnp.transpose(corr, (0, 1, 4, 5, 2, 3))
+        return stack(corr) + jnp.transpose(stack(swapped), (0, 1, 4, 5, 2, 3))
+    return stack(corr)
+
+
+def neigh_consensus_init(key, kernel_sizes, channels, dtype=jnp.float32):
+    """Initialize NeighConsensus params.
+
+    Matches the reference architecture hyperparameters (lib/model.py:122-141):
+    `kernel_sizes` and `channels` are equal-length lists; input channel count
+    is 1. Initialization follows PyTorch's _ConvNd default: U(-s, s) with
+    s = 1/sqrt(cin * prod(kernel)) for both weights and biases.
+    """
+    params = []
+    cin = 1
+    for ks, cout in zip(kernel_sizes, channels):
+        key, k1, k2 = jax.random.split(key, 3)
+        fan_in = cin * ks**4
+        s = 1.0 / (fan_in**0.5)
+        params.append(
+            {
+                "weight": jax.random.uniform(
+                    k1, (ks, ks, ks, ks, cin, cout), dtype, -s, s
+                ),
+                "bias": jax.random.uniform(k2, (cout,), dtype, -s, s),
+            }
+        )
+        cin = cout
+    return params

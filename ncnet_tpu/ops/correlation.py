@@ -1,0 +1,59 @@
+"""Dense all-pairs feature correlation.
+
+The reference computes this with a batched matmul plus reshapes
+(lib/model.py:106-115). On TPU this is a single einsum, which XLA lowers
+straight onto the MXU; features are cast to bfloat16 for the contraction with
+float32 accumulation (`preferred_element_type`), mirroring — and improving on
+— the reference's fp16 memory-saving mode (eval_inloc.py:50).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def feature_l2norm(feature, axis: int = 1, eps: float = 1e-6):
+    """Channelwise L2 normalization (parity: lib/model.py:14-17)."""
+    norm = jnp.sqrt(jnp.sum(feature * feature, axis=axis, keepdims=True) + eps)
+    return feature / norm
+
+
+def feature_correlation(feature_a, feature_b, *, compute_dtype=jnp.bfloat16):
+    """All-pairs correlation of two NCHW feature maps.
+
+    Args:
+      feature_a: [b, c, hA, wA].
+      feature_b: [b, c, hB, wB].
+      compute_dtype: dtype for the MXU contraction (bf16 by default).
+
+    Returns:
+      [b, 1, hA, wA, hB, wB] float32 correlation tensor, indexed
+      [batch, 1, row_A, col_A, row_B, col_B] (parity: lib/model.py:106-115).
+    """
+    a = feature_a.astype(compute_dtype)
+    b_ = feature_b.astype(compute_dtype)
+    corr = jnp.einsum(
+        "bcij,bckl->bijkl", a, b_, preferred_element_type=jnp.float32
+    )
+    return corr[:, None]
+
+
+def feature_correlation_3d(feature_a, feature_b, *, normalize: bool = True):
+    """Legacy '3D' correlation mode (parity: lib/model.py:97-105,117-118).
+
+    Returns [b, hA*wA, hB, wB] with the A index flattened column-major
+    (idx_A = row_A + hA * col_A), exactly as the reference's transpose
+    sequence produces. Kept for API compatibility; the 4D mode is the one
+    used by the NCNet model.
+    """
+    b, c, h, w = feature_a.shape
+    # Column-major flatten of A positions: transpose (h, w) -> (w, h) first.
+    a = jnp.swapaxes(feature_a, 2, 3).reshape(b, c, w * h)
+    bb = feature_b.reshape(b, c, h * w)
+    mul = jnp.einsum("bcm,bcn->bnm", a, bb, preferred_element_type=jnp.float32)
+    corr = mul.reshape(b, h, w, w * h)
+    corr = jnp.moveaxis(corr, 3, 1)  # [b, hA*wA(cm), hB, wB]
+    if normalize:
+        corr = feature_l2norm(jnp.maximum(corr, 0.0), axis=1)
+    return corr

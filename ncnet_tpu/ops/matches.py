@@ -1,0 +1,205 @@
+"""Match extraction from the filtered 4-D correlation tensor.
+
+Parity targets in the reference tree:
+  * corr_to_matches           — lib/point_tnf.py:12-80
+  * nearest_neighbour transfer — lib/point_tnf.py:82-94
+  * bilinear transfer          — lib/point_tnf.py:96-149
+
+All functions are pure jnp and jit-safe (static shapes); everything stays on
+device — the reference round-trips through numpy for the coordinate grids,
+which would be a host sync on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _coord_grids(fs1, fs2, fs3, fs4, k_size, scale):
+    lo = -1.0 if scale == "centered" else 0.0
+    xa = jnp.linspace(lo, 1.0, fs2 * k_size)
+    ya = jnp.linspace(lo, 1.0, fs1 * k_size)
+    xb = jnp.linspace(lo, 1.0, fs4 * k_size)
+    yb = jnp.linspace(lo, 1.0, fs3 * k_size)
+    return xa, ya, xb, yb
+
+
+def corr_to_matches(
+    corr4d,
+    delta4d=None,
+    k_size: int = 1,
+    do_softmax: bool = False,
+    scale: str = "centered",
+    invert_matching_direction: bool = False,
+):
+    """Extract one match per position of one image from the 4-D tensor.
+
+    Default direction: for every position (iB, jB) of image B, find the best
+    (iA, jA) in image A (optionally after a softmax over A positions).
+    `invert_matching_direction` swaps the roles. With `delta4d` (the argmax
+    offsets from maxpool4d), coordinates are relocalized onto the k_size-times
+    finer pre-pool grid.
+
+    Args:
+      corr4d: [b, 1, fs1, fs2, fs3, fs4].
+      delta4d: optional (di_a, dj_a, di_b, dj_b) int32 offset tensors from
+        :func:`ncnet_tpu.ops.pool4d.maxpool4d`.
+      scale: 'centered' -> coords in [-1, 1]; 'positive' -> [0, 1].
+
+    Returns:
+      (xA, yA, xB, yB, score), each [b, n] float32 where n is the number of
+      positions in the probed image.
+    """
+    b, _, fs1, fs2, fs3, fs4 = corr4d.shape
+    xa_ax, ya_ax, xb_ax, yb_ax = _coord_grids(fs1, fs2, fs3, fs4, k_size, scale)
+
+    if invert_matching_direction:
+        # One match per A position: reduce over B positions.
+        nc = corr4d.reshape(b, fs1, fs2, fs3 * fs4)
+        if do_softmax:
+            nc = jax.nn.softmax(nc, axis=3)
+        score = jnp.max(nc, axis=3).reshape(b, -1)
+        idx = jnp.argmax(nc, axis=3).reshape(b, -1)  # flat B index
+        i_b = idx // fs4
+        j_b = idx % fs4
+        grid_ia, grid_ja = jnp.meshgrid(
+            jnp.arange(fs1), jnp.arange(fs2), indexing="ij"
+        )
+        i_a = jnp.broadcast_to(grid_ia.reshape(1, -1), (b, fs1 * fs2))
+        j_a = jnp.broadcast_to(grid_ja.reshape(1, -1), (b, fs1 * fs2))
+    else:
+        # One match per B position: reduce over A positions.
+        nc = corr4d.reshape(b, fs1 * fs2, fs3, fs4)
+        if do_softmax:
+            nc = jax.nn.softmax(nc, axis=1)
+        score = jnp.max(nc, axis=1).reshape(b, -1)
+        idx = jnp.argmax(nc, axis=1).reshape(b, -1)  # flat A index (row-major)
+        i_a = idx // fs2
+        j_a = idx % fs2
+        grid_ib, grid_jb = jnp.meshgrid(
+            jnp.arange(fs3), jnp.arange(fs4), indexing="ij"
+        )
+        i_b = jnp.broadcast_to(grid_ib.reshape(1, -1), (b, fs3 * fs4))
+        j_b = jnp.broadcast_to(grid_jb.reshape(1, -1), (b, fs3 * fs4))
+
+    if delta4d is not None:
+        # Relocalization: index the per-cell offsets at the matched 4-D cell
+        # and refine onto the fine grid (parity: lib/point_tnf.py:59-70).
+        di_a, dj_a, di_b, dj_b = delta4d
+
+        def gather_delta(d):
+            d = d.reshape(b, fs1, fs2, fs3, fs4)
+            flat = d.reshape(b, -1)
+            lin = ((i_a * fs2 + j_a) * fs3 + i_b) * fs4 + j_b
+            return jnp.take_along_axis(flat, lin, axis=1)
+
+        # Gather all four offsets at the coarse cell before refining any index.
+        g_ia, g_ja, g_ib, g_jb = (
+            gather_delta(di_a),
+            gather_delta(dj_a),
+            gather_delta(di_b),
+            gather_delta(dj_b),
+        )
+        i_a = i_a * k_size + g_ia
+        j_a = j_a * k_size + g_ja
+        i_b = i_b * k_size + g_ib
+        j_b = j_b * k_size + g_jb
+
+    x_a = jnp.take(xa_ax, j_a)
+    y_a = jnp.take(ya_ax, i_a)
+    x_b = jnp.take(xb_ax, j_b)
+    y_b = jnp.take(yb_ax, i_b)
+    return x_a, y_a, x_b, y_b, score
+
+
+def nearest_neighbour_point_transfer(matches, target_points_norm):
+    """Warp target points through the match set by nearest-neighbour lookup.
+
+    Args:
+      matches: (xA, yA, xB, yB) each [b, n].
+      target_points_norm: [b, 2, m] normalized target points.
+
+    Returns:
+      [b, 2, m] warped (source-image) points.
+    """
+    x_a, y_a, x_b, y_b = matches
+    dx = target_points_norm[:, 0, :][:, None, :] - x_b[:, :, None]
+    dy = target_points_norm[:, 1, :][:, None, :] - y_b[:, :, None]
+    dist = jnp.sqrt(dx * dx + dy * dy)  # [b, n, m]
+    idx = jnp.argmin(dist, axis=1)  # [b, m]
+    wx = jnp.take_along_axis(x_a, idx, axis=1)
+    wy = jnp.take_along_axis(y_a, idx, axis=1)
+    return jnp.stack([wx, wy], axis=1)
+
+
+def bilinear_point_transfer(matches, target_points_norm):
+    """Warp target points by bilinear interpolation over the match grid.
+
+    The matches are assumed to lie on a square fs x fs grid over image B
+    (the PF-Pascal eval configuration); for each target point, its four
+    enclosing grid cells' source coordinates are blended with bilinear
+    weights. Parity: lib/point_tnf.py:96-149 including the clamp-at-zero
+    edge-case handling for points left of the first grid line.
+    """
+    x_a, y_a, x_b, y_b = matches
+    b, n = x_b.shape
+    fs = int(round(n**0.5))
+    m = target_points_norm.shape[2]
+
+    grid = jnp.linspace(-1.0, 1.0, fs)  # match-grid axis coords
+
+    def cell_floor(coord):  # [b, m] -> [b, m] index of grid line at/below
+        cnt = jnp.sum(
+            (coord[:, None, :] - grid[None, :, None]) > 0, axis=1
+        ) - 1
+        return jnp.clip(cnt, 0, fs - 2)
+
+    x_minus = cell_floor(target_points_norm[:, 0, :])
+    y_minus = cell_floor(target_points_norm[:, 1, :])
+    x_plus = x_minus + 1
+    y_plus = y_minus + 1
+
+    def flat_idx(x_i, y_i):
+        return y_i * fs + x_i
+
+    def at(vals, idx):  # vals [b, n], idx [b, m]
+        return jnp.take_along_axis(vals, idx, axis=1)
+
+    def point(xs, ys, idx):  # -> [b, 2, m]
+        return jnp.stack([at(xs, idx), at(ys, idx)], axis=1)
+
+    idx_mm = flat_idx(x_minus, y_minus)
+    idx_pp = flat_idx(x_plus, y_plus)
+    idx_pm = flat_idx(x_plus, y_minus)
+    idx_mp = flat_idx(x_minus, y_plus)
+
+    p_mm = point(x_b, y_b, idx_mm)
+    p_pp = point(x_b, y_b, idx_pp)
+    p_pm = point(x_b, y_b, idx_pm)
+    p_mp = point(x_b, y_b, idx_mp)
+
+    def area(p):  # |dx * dy| per point, [b, m]
+        d = jnp.abs(target_points_norm - p)
+        return d[:, 0, :] * d[:, 1, :]
+
+    f_pp = area(p_mm)
+    f_mm = area(p_pp)
+    f_mp = area(p_pm)
+    f_pm = area(p_mp)
+
+    q_mm = point(x_a, y_a, idx_mm)
+    q_pp = point(x_a, y_a, idx_pp)
+    q_pm = point(x_a, y_a, idx_pm)
+    q_mp = point(x_a, y_a, idx_mp)
+
+    num = (
+        q_mm * f_mm[:, None]
+        + q_pp * f_pp[:, None]
+        + q_mp * f_mp[:, None]
+        + q_pm * f_pm[:, None]
+    )
+    den = (f_pp + f_mm + f_mp + f_pm)[:, None]
+    return num / den

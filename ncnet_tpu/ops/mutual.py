@@ -1,0 +1,35 @@
+"""Soft mutual-nearest-neighbour filtering of the 4-D correlation tensor.
+
+Parity target: lib/model.py:155-175 of the reference. Each correlation value
+is rescaled by its ratio to the max over all A positions (for its B position)
+and the max over all B positions (for its A position):
+
+    out = corr * (corr / (max_B + eps)) * (corr / (max_A + eps))
+
+This is a pair of reductions plus elementwise math — XLA fuses it into the
+surrounding computation, so no custom kernel is needed on TPU. The function
+is also provided in a mesh-aware variant (see parallel/corr_sharding.py) where
+the reductions run as `lax.pmax` collectives over the sharded axes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+EPS = 1e-5
+
+
+def mutual_matching(corr4d, eps: float = EPS):
+    """Apply soft mutual-NN filtering.
+
+    Args:
+      corr4d: [b, 1, iA, jA, iB, jB].
+
+    Returns:
+      Same shape, filtered.
+    """
+    max_over_a = jnp.max(corr4d, axis=(2, 3), keepdims=True)  # per-B max
+    max_over_b = jnp.max(corr4d, axis=(4, 5), keepdims=True)  # per-A max
+    ratio_b = corr4d / (max_over_a + eps)  # reference corr4d_B
+    ratio_a = corr4d / (max_over_b + eps)  # reference corr4d_A
+    return corr4d * (ratio_a * ratio_b)

@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip sharding tests run on a simulated mesh
+(`--xla_force_host_platform_device_count=8`), the TPU-world substitute for
+multi-node fixtures (SURVEY.md §4).
+
+Platform handling: this environment's sitecustomize registers the axon TPU
+PJRT plugin in every python process and overrides the `jax_platforms` config
+to "axon,cpu", which would dial the (single-session) TPU tunnel from the test
+runner. Tests must run CPU-only, so the config is forced back to "cpu" before
+any backend initialization.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

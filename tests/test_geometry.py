@@ -1,0 +1,140 @@
+"""Geometry golden tests: grid generation and sampling vs torch CPU oracle.
+
+The torch oracle uses align_corners=True + zero padding, which is the
+PyTorch-0.3 behavior the reference model was trained with (SURVEY.md §7
+hard-part 2).
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from ncnet_tpu.geometry import (
+    affine_grid,
+    grid_sample,
+    resize_bilinear,
+    normalize_axis,
+    unnormalize_axis,
+    points_to_unit_coords,
+    points_to_pixel_coords,
+    TpsGrid,
+    affine_point_transform,
+    read_flo_file,
+    write_flo_file,
+    sampling_grid_to_flow,
+    flow_to_sampling_grid,
+)
+
+
+def test_affine_grid_matches_torch(rng):
+    theta = rng.randn(2, 2, 3).astype(np.float32)
+    ours = np.asarray(affine_grid(jnp.asarray(theta), 7, 9))
+    ref = F.affine_grid(torch.tensor(theta), (2, 3, 7, 9), align_corners=True).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_grid_sample_matches_torch(rng):
+    img = rng.randn(2, 3, 8, 10).astype(np.float32)
+    # grid with both in-bounds and out-of-bounds coords
+    grid = (rng.rand(2, 6, 5, 2).astype(np.float32) * 2.6) - 1.3
+    ours = np.asarray(grid_sample(jnp.asarray(img), jnp.asarray(grid)))
+    ref = F.grid_sample(
+        torch.tensor(img), torch.tensor(grid),
+        mode="bilinear", padding_mode="zeros", align_corners=True,
+    ).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_resize_bilinear_matches_torch(rng):
+    img = rng.rand(1, 3, 13, 17).astype(np.float32)
+    ours = np.asarray(resize_bilinear(jnp.asarray(img), 7, 9))
+    theta = torch.tensor([[[1.0, 0, 0], [0, 1.0, 0]]])
+    ref_grid = F.affine_grid(theta, (1, 3, 7, 9), align_corners=True)
+    ref = F.grid_sample(torch.tensor(img), ref_grid, align_corners=True).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_normalize_axis_roundtrip():
+    x = jnp.array([1.0, 5.0, 10.0])
+    n = normalize_axis(x, 10)
+    # endpoints: pixel 1 -> -1, pixel L -> +1
+    np.testing.assert_allclose(np.asarray(n)[[0, 2]], [-1.0, 1.0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(unnormalize_axis(n, 10)), np.asarray(x), atol=1e-5)
+
+
+def test_points_unit_pixel_roundtrip(rng):
+    pts = rng.rand(2, 2, 5).astype(np.float32) * 100 + 1
+    size = np.array([[200.0, 300.0], [120.0, 90.0]], np.float32)
+    unit = points_to_unit_coords(jnp.asarray(pts), jnp.asarray(size))
+    back = points_to_pixel_coords(unit, jnp.asarray(size))
+    np.testing.assert_allclose(np.asarray(back), pts, atol=1e-4)
+
+
+def _torch_tps_oracle(theta, points_xy, grid_size=3):
+    """Direct numpy reimplementation of Bookstein TPS for cross-checking."""
+    n = grid_size * grid_size
+    axis = np.linspace(-1, 1, grid_size)
+    py, px = np.meshgrid(axis, axis)
+    cp = np.stack([px.reshape(-1), py.reshape(-1)], 1)  # [N,2]
+    d2 = ((cp[:, None, :] - cp[None, :, :]) ** 2).sum(-1)
+    d2[d2 == 0] = 1
+    K = d2 * np.log(d2)
+    P = np.concatenate([np.ones((n, 1)), cp], 1)
+    L = np.block([[K, P], [P.T, np.zeros((3, 3))]])
+    Li = np.linalg.inv(L)
+    q = theta.reshape(2, n).T  # [N, 2]
+    w = Li[:n, :n] @ q
+    a = Li[n:, :n] @ q
+    out = []
+    for p in points_xy:
+        r2 = ((p[None, :] - cp) ** 2).sum(-1)
+        r2 = np.where(r2 == 0, 1.0, r2)
+        u = r2 * np.log(r2)
+        val = a[0] + p[0] * a[1] + p[1] * a[2] + u @ w
+        out.append(val)
+    return np.array(out)
+
+
+def test_tps_matches_oracle(rng):
+    theta = rng.randn(1, 18).astype(np.float32) * 0.3
+    pts = (rng.rand(20, 2).astype(np.float32) * 2) - 1
+    tps = TpsGrid(grid_size=3)
+    ours = np.asarray(tps.apply(jnp.asarray(theta), jnp.asarray(pts)))[0]
+    ref = _torch_tps_oracle(theta[0], pts)
+    np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+
+def test_tps_identity_on_control_points():
+    # theta equal to the control points themselves -> identity warp
+    tps = TpsGrid(grid_size=3)
+    cp = np.asarray(tps.control_points)
+    theta = np.concatenate([cp[:, 0], cp[:, 1]])[None].astype(np.float32)
+    warped = np.asarray(tps.apply(jnp.asarray(theta), jnp.asarray(cp)))[0]
+    np.testing.assert_allclose(warped, cp, atol=1e-4)
+
+
+def test_affine_point_transform(rng):
+    theta = rng.randn(2, 2, 3).astype(np.float32)
+    pts = rng.randn(2, 2, 7).astype(np.float32)
+    ours = np.asarray(affine_point_transform(jnp.asarray(theta), jnp.asarray(pts)))
+    ref = np.einsum("bij,bjn->bin", theta[:, :, :2], pts) + theta[:, :, 2:3]
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_flo_roundtrip(tmp_path, rng):
+    flow = rng.randn(5, 7, 2).astype(np.float32)
+    path = str(tmp_path / "x.flo")
+    write_flo_file(flow, path)
+    back = read_flo_file(path)
+    np.testing.assert_array_equal(flow, back)
+
+
+def test_flow_grid_roundtrip(rng):
+    flow = rng.randn(6, 8, 2).astype(np.float32) * 2
+    grid = flow_to_sampling_grid(flow, 20, 30)
+    back = sampling_grid_to_flow(grid, 20, 30)
+    in_bounds = np.abs(back) < 1e9
+    np.testing.assert_allclose(back[in_bounds], flow[in_bounds], atol=1e-4)
