@@ -1,0 +1,91 @@
+"""Benchmark: InLoc-config dense-matching throughput on the flagship model.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The headline workload is the reference's InLoc dense-matching forward
+(eval_inloc.py: long side 3200 px -> ~200x150 features, relocalization
+maxpool k=2, NeighConsensus 3-3/16-1, both-direction match extraction).
+The reference runs this at roughly 1 pair/s on a V100 (fp16); the
+north-star target is >=4x that per chip (BASELINE.md). vs_baseline is
+reported against the 1.0 pair/s V100 estimate.
+"""
+
+import json
+import os
+import sys
+import time
+
+V100_BASELINE_PAIRS_PER_S = 1.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import ncnet_forward
+    from ncnet_tpu.ops import corr_to_matches
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    # InLoc configuration (SURVEY.md §3.3); on CPU smoke runs, shrink.
+    if on_tpu:
+        h_a, w_a = 3200, 2400  # query  -> 200x150 features
+        h_b, w_b = 3200, 2400  # pano
+    else:
+        h_a = w_a = h_b = w_b = 512
+
+    config = NCNetConfig(
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=2,
+        half_precision=True,
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+
+    @jax.jit
+    def step(params, src, tgt):
+        corr, delta = ncnet_forward(config, params, src, tgt)
+        m1 = corr_to_matches(
+            corr, delta4d=delta, k_size=2, do_softmax=True, scale="positive"
+        )
+        m2 = corr_to_matches(
+            corr, delta4d=delta, k_size=2, do_softmax=True, scale="positive",
+            invert_matching_direction=True,
+        )
+        return m1, m2
+
+    key = jax.random.PRNGKey(1)
+    k1, k2 = jax.random.split(key)
+    src = jax.random.normal(k1, (1, 3, h_a, w_a), jnp.float32)
+    tgt = jax.random.normal(k2, (1, 3, h_b, w_b), jnp.float32)
+
+    # warmup/compile
+    out = step(params, src, tgt)
+    jax.block_until_ready(out)
+
+    n_iters = 10 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = step(params, src, tgt)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n_iters
+
+    pairs_per_s = 1.0 / dt
+    print(
+        json.dumps(
+            {
+                "metric": "inloc_dense_match_pairs_per_s_per_chip"
+                + ("" if on_tpu else "_cpu_smoke"),
+                "value": round(pairs_per_s, 4),
+                "unit": "pairs/s/chip",
+                "vs_baseline": round(pairs_per_s / V100_BASELINE_PAIRS_PER_S, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

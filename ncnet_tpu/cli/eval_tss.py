@@ -1,0 +1,69 @@
+"""TSS dense-flow evaluation CLI (parity: eval_tss.py).
+
+Writes per-pair `.flo` files for the external TSS evaluation kit under
+`<flow_output_dir>/nc/<pair>/<flowN>.flo` (lib/eval_util.py:94-97).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import TSSDataset, DataLoader
+from ..evals import write_flow_output
+from ..models.ncnet import ncnet_forward
+from ..ops import corr_to_matches
+from .common import build_model
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="NCNet-TPU TSS flow eval")
+    parser.add_argument("--checkpoint", type=str, default="")
+    parser.add_argument("--image_size", type=int, default=400)
+    parser.add_argument("--eval_dataset_path", type=str, default="datasets/tss/")
+    parser.add_argument("--csv_file", type=str, default="test_pairs.csv")
+    parser.add_argument("--flow_output_dir", type=str, default="datasets/tss/results/")
+    parser.add_argument("--batch_size", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    config, params = build_model(checkpoint=args.checkpoint)
+    dataset = TSSDataset(
+        os.path.join(args.eval_dataset_path, args.csv_file),
+        args.eval_dataset_path,
+        output_size=(args.image_size, args.image_size),
+    )
+    loader = DataLoader(dataset, args.batch_size, shuffle=False, num_workers=8)
+
+    @jax.jit
+    def step(params, source, target):
+        corr, _ = ncnet_forward(config, params, source, target)
+        return corr_to_matches(corr, do_softmax=True)
+
+    done = 0
+    for batch in loader:
+        xa, ya, xb, yb, _ = step(
+            params,
+            jnp.asarray(batch["source_image"]),
+            jnp.asarray(batch["target_image"]),
+        )
+        bsz = batch["source_image"].shape[0]
+        for b in range(bsz):
+            matches_b = (xa[b : b + 1], ya[b : b + 1], xb[b : b + 1], yb[b : b + 1])
+            write_flow_output(
+                matches_b,
+                batch["source_im_size"][b],
+                batch["target_im_size"][b],
+                batch["flow_path"][b],
+                args.flow_output_dir,
+            )
+            done += 1
+        print(f"[{done}/{len(dataset)}]", flush=True)
+    print("Done!")
+
+
+if __name__ == "__main__":
+    main()

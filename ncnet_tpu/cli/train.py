@@ -1,0 +1,179 @@
+"""Weak-supervision training CLI.
+
+Usage (defaults reproduce the reference's published PF-Pascal run,
+train.py:34-49 of the reference tree):
+
+    python -m ncnet_tpu.cli.train --dataset_image_path datasets/pf-pascal \
+        --dataset_csv_path datasets/pf-pascal/image_pairs
+
+Data parallelism: the batch is sharded over all available devices on a 'dp'
+mesh; the jitted step contains both forward passes and the Adam update, and
+XLA inserts the gradient allreduce over ICI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import jax
+import numpy as np
+
+from ..data import ImagePairDataset, DataLoader
+from ..parallel import make_mesh
+from ..training import (
+    create_train_state,
+    make_train_step,
+    save_checkpoint,
+    shard_batch,
+    replicate_state,
+)
+from .common import build_model
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="NCNet-TPU weak-supervision training")
+    parser.add_argument("--checkpoint", type=str, default="")
+    parser.add_argument("--image_size", type=int, default=400)
+    parser.add_argument("--dataset_image_path", type=str, default="datasets/pf-pascal/")
+    parser.add_argument(
+        "--dataset_csv_path", type=str, default="datasets/pf-pascal/image_pairs/"
+    )
+    parser.add_argument("--num_epochs", type=int, default=5)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--ncons_kernel_sizes", nargs="+", type=int, default=[5, 5, 5])
+    parser.add_argument("--ncons_channels", nargs="+", type=int, default=[16, 16, 1])
+    parser.add_argument("--backbone", type=str, default="resnet101")
+    parser.add_argument("--result_model_dir", type=str, default="trained_models")
+    parser.add_argument("--result_model_fn", type=str, default="checkpoint_adam")
+    parser.add_argument("--fe_finetune_params", type=int, default=0)
+    parser.add_argument("--num_workers", type=int, default=8)
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--log_interval", type=int, default=1)
+    args = parser.parse_args(argv)
+
+    print("NCNet-TPU training")
+    print(args)
+
+    config, params = build_model(
+        checkpoint=args.checkpoint,
+        ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
+        ncons_channels=tuple(args.ncons_channels),
+        backbone_cnn=args.backbone,
+        seed=args.seed,
+    )
+
+    state, tx = create_train_state(
+        params, learning_rate=args.lr, train_fe=args.fe_finetune_params > 0
+    )
+    train_step, eval_step = make_train_step(config, tx)
+
+    # Use the largest device count that divides the batch.
+    n_dev = len(jax.devices())
+    while n_dev > 1 and args.batch_size % n_dev:
+        n_dev -= 1
+    mesh = make_mesh((n_dev,), ("dp",)) if n_dev > 1 else None
+    if mesh is not None:
+        state = replicate_state(state, mesh)
+    print(f"devices: {len(jax.devices())} (dp axis: {n_dev})")
+
+    size = (args.image_size, args.image_size)
+    dataset = ImagePairDataset(
+        os.path.join(args.dataset_csv_path, "train_pairs.csv"),
+        args.dataset_image_path,
+        output_size=size,
+        rng=np.random.RandomState(args.seed),
+    )
+    dataset_val = ImagePairDataset(
+        os.path.join(args.dataset_csv_path, "val_pairs.csv"),
+        args.dataset_image_path,
+        output_size=size,
+    )
+    if args.batch_size > len(dataset):
+        raise SystemExit(
+            f"batch_size {args.batch_size} exceeds dataset size {len(dataset)}; "
+            "with drop_last this would train on zero batches"
+        )
+    loader = DataLoader(
+        dataset, args.batch_size, shuffle=True, num_workers=args.num_workers,
+        seed=args.seed, drop_last=True,
+    )
+    loader_val = DataLoader(
+        dataset_val, args.batch_size, shuffle=False,
+        num_workers=args.num_workers, drop_last=True,
+    )
+
+    ckpt_dir = os.path.join(
+        args.result_model_dir,
+        time.strftime("%Y-%m-%d_%H%M") + "_" + args.result_model_fn,
+    )
+    best_val = float("inf")
+    train_losses, val_losses = [], []
+    trainable, opt_state = state.trainable, state.opt_state
+
+    for epoch in range(1, args.num_epochs + 1):
+        t0 = time.time()
+        epoch_loss, n_batches = 0.0, 0
+        for i, batch in enumerate(loader):
+            batch = shard_batch(
+                {k: batch[k] for k in ("source_image", "target_image")}, mesh
+            )
+            trainable, opt_state, loss = train_step(
+                trainable, state.frozen, opt_state,
+                batch["source_image"], batch["target_image"],
+            )
+            loss = float(loss)
+            epoch_loss += loss
+            n_batches += 1
+            if i % args.log_interval == 0:
+                print(
+                    f"Train epoch {epoch} [{i}/{len(loader)}]\tloss: {loss:.6f}",
+                    flush=True,
+                )
+        train_loss = epoch_loss / max(n_batches, 1)
+
+        val_loss, n_val = 0.0, 0
+        for batch in loader_val:
+            batch = shard_batch(
+                {k: batch[k] for k in ("source_image", "target_image")}, mesh
+            )
+            val_loss += float(
+                eval_step(
+                    trainable, state.frozen,
+                    batch["source_image"], batch["target_image"],
+                )
+            )
+            n_val += 1
+        val_loss /= max(n_val, 1)
+        dt = time.time() - t0
+        print(
+            f"Epoch {epoch}: train {train_loss:.4f}  val {val_loss:.4f}  ({dt:.1f}s)",
+            flush=True,
+        )
+        train_losses.append(train_loss)
+        val_losses.append(val_loss)
+
+        is_best = val_loss < best_val
+        best_val = min(val_loss, best_val)
+        full_params = {
+            "backbone": trainable.get("backbone", state.frozen["backbone"]),
+            "neigh_consensus": trainable["neigh_consensus"],
+        }
+        save_checkpoint(
+            ckpt_dir, full_params, config, epoch,
+            opt_state=opt_state,
+            extra={
+                "train_loss": train_losses,
+                "val_loss": val_losses,
+                "best_val_loss": best_val,
+                "args": vars(args),
+            },
+            is_best=is_best,
+        )
+    print("Done!")
+
+
+if __name__ == "__main__":
+    main()

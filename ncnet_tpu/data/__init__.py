@@ -1,0 +1,25 @@
+"""Data layer: datasets, normalization, image I/O, prefetching loader."""
+
+from .datasets import (
+    ImagePairDataset,
+    PFPascalDataset,
+    PFWillowDataset,
+    TSSDataset,
+)
+from .loader import DataLoader, default_collate
+from .normalization import normalize_image, normalize_image_dict
+from .image_io import read_image, resize_bilinear_np, load_and_resize_chw
+
+__all__ = [
+    "ImagePairDataset",
+    "PFPascalDataset",
+    "PFWillowDataset",
+    "TSSDataset",
+    "DataLoader",
+    "default_collate",
+    "normalize_image",
+    "normalize_image_dict",
+    "read_image",
+    "resize_bilinear_np",
+    "load_and_resize_chw",
+]

@@ -1,0 +1,128 @@
+"""Host-side batching data loader with background prefetch.
+
+Replaces the reference's vendored PyTorch-0.3 DataLoader
+(lib/dataloader.py:39-316, a multiprocessing fork-pool with an out-of-order
+reordering dict). TPU input pipelines are host-bound but simpler: a
+thread-pool maps `dataset[i]` (PIL decode + numpy resize release the GIL),
+batches are collated into stacked numpy arrays, and a bounded prefetch queue
+overlaps host decode with device steps.
+
+The reference's one local modification — deterministic per-worker RNG seeding
+(lib/dataloader.py:43,165) — becomes explicit: shuffling is driven by a
+caller-provided seed, and any per-sample randomness lives in the dataset's
+own RandomState.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def default_collate(samples):
+    """Stack a list of sample dicts into a batch dict.
+
+    numpy arrays stack; scalars become [b] arrays; strings (e.g. flow paths)
+    collect into lists — covering what lib/torch_util.py:9-24's
+    collate_custom handled for ragged annotations.
+    """
+    out = {}
+    for key in samples[0]:
+        vals = [s[key] for s in samples]
+        if isinstance(vals[0], np.ndarray):
+            out[key] = np.stack(vals)
+        elif isinstance(vals[0], (int, float, np.floating, np.integer)):
+            out[key] = np.asarray(vals)
+        else:
+            out[key] = vals
+    return out
+
+
+class DataLoader:
+    """Iterate a dataset in shuffled batches with threaded prefetch."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 16,
+        shuffle: bool = False,
+        num_workers: int = 4,
+        seed: int = 1,
+        drop_last: bool = False,
+        prefetch: int = 2,
+        collate_fn=default_collate,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.num_workers = max(num_workers, 1)
+        self.seed = seed
+        self.drop_last = drop_last
+        self.prefetch = prefetch
+        self.collate_fn = collate_fn
+        self._epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _batch_indices(self):
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self._epoch)
+            rng.shuffle(idx)
+        batches = [
+            idx[i : i + self.batch_size]
+            for i in range(0, len(idx), self.batch_size)
+        ]
+        if self.drop_last and batches and len(batches[-1]) < self.batch_size:
+            batches.pop()
+        return batches
+
+    def __iter__(self) -> Iterator[dict]:
+        batches = self._batch_indices()
+        self._epoch += 1
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def put(item):
+            """Bounded put that aborts when the consumer goes away."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        def produce():
+            try:
+                with ThreadPoolExecutor(self.num_workers) as pool:
+                    for batch_idx in batches:
+                        if stop.is_set():
+                            return
+                        samples = list(
+                            pool.map(self.dataset.__getitem__, batch_idx)
+                        )
+                        put(self.collate_fn(samples))
+                put(None)
+            except BaseException as exc:  # propagate to the consumer
+                put(exc)
+
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()

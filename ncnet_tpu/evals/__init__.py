@@ -1,0 +1,21 @@
+"""Evaluation: PCK keypoint transfer, TSS flow output, InLoc match export."""
+
+from .pck import pck, pck_metric
+from .flow_eval import dense_warp_grid, write_flow_output
+from .inloc import (
+    extract_inloc_matches,
+    write_matches_mat,
+    matches_buffer,
+    fill_matches,
+)
+
+__all__ = [
+    "pck",
+    "pck_metric",
+    "dense_warp_grid",
+    "write_flow_output",
+    "extract_inloc_matches",
+    "write_matches_mat",
+    "matches_buffer",
+    "fill_matches",
+]

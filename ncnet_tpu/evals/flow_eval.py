@@ -1,0 +1,51 @@
+"""Dense-flow evaluation output for TSS.
+
+Parity target: lib/eval_util.py:58-100 — for every pixel of the target image,
+warp its normalized coords through the match grid and write the resulting
+target->source displacement field as a Middlebury .flo file consumed by the
+external TSS evaluation kit (out-of-bounds pixels get the 1e10 sentinel).
+
+The per-pixel warp runs on device as one batched bilinear interpolation over
+the match grid (the reference loops in python per batch element).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..geometry.flow_io import sampling_grid_to_flow, write_flo_file
+from ..ops.matches import bilinear_point_transfer
+
+
+def dense_warp_grid(matches, h_tgt: int, w_tgt: int):
+    """Warp every target pixel through the match grid.
+
+    Returns [1, h_tgt, w_tgt, 2] normalized source coords.
+    """
+    xs = jnp.linspace(-1.0, 1.0, w_tgt)
+    ys = jnp.linspace(-1.0, 1.0, h_tgt)
+    gx, gy = jnp.meshgrid(xs, ys)
+    pts = jnp.stack([gx.reshape(-1), gy.reshape(-1)], axis=0)[None]  # [1,2,HW]
+    warped = bilinear_point_transfer(matches, pts)  # [1, 2, HW]
+    return jnp.transpose(warped, (0, 2, 1)).reshape(1, h_tgt, w_tgt, 2)
+
+
+def write_flow_output(
+    matches,
+    source_im_size,
+    target_im_size,
+    flow_rel_path: str,
+    output_dir: str,
+):
+    """Compute the dense flow for one pair and write `<output_dir>/nc/<rel>`."""
+    h_src, w_src = int(source_im_size[0]), int(source_im_size[1])
+    h_tgt, w_tgt = int(target_im_size[0]), int(target_im_size[1])
+    grid = np.asarray(dense_warp_grid(matches, h_tgt, w_tgt))
+    flow = sampling_grid_to_flow(grid, h_src, w_src)
+    out_path = os.path.join(output_dir, "nc", flow_rel_path)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    write_flo_file(flow, out_path)
+    return out_path

@@ -1,0 +1,120 @@
+"""InLoc dense-matching outputs for the Matlab localization pipeline.
+
+Parity target: eval_inloc.py:124-221 of the reference — per query x pano:
+both-direction match extraction with relocalization, descending score sort,
+coordinate-row dedup, recentring onto pixel-cell centers, and a
+`matches/<experiment>/<q>.mat` file with the layout the Matlab P3P-RANSAC
+stage consumes (lib_matlab/parfor_NC4D_PE_pnponly.m:17-61).
+
+Device/host split: match extraction + sort stay on device; the dedup
+(np.unique over coordinate rows) and the .mat write are host-side, matching
+where the reference's process boundary to Matlab is (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+from scipy.io import savemat
+
+from ..ops.matches import corr_to_matches
+
+
+def extract_inloc_matches(
+    corr4d,
+    delta4d=None,
+    k_size: int = 1,
+    do_softmax: bool = True,
+    both_directions: bool = True,
+    invert_direction: bool = False,
+):
+    """Extract, merge and dedup matches for one image pair.
+
+    Returns (xA, yA, xB, yB, score) 1-D float arrays in 'positive' [0, 1]
+    scale, recentered to pixel-cell centers, sorted by descending score with
+    duplicate coordinate rows removed (keeping the best-scoring instance).
+    """
+    fs1, fs2, fs3, fs4 = corr4d.shape[2:]
+
+    def one_direction(invert):
+        return corr_to_matches(
+            corr4d,
+            delta4d=delta4d,
+            k_size=k_size,
+            do_softmax=do_softmax,
+            scale="positive",
+            invert_matching_direction=invert,
+        )
+
+    if both_directions:
+        a = one_direction(False)
+        b = one_direction(True)
+        xa, ya, xb, yb, score = (
+            jnp.concatenate([u, v], axis=1) for u, v in zip(a, b)
+        )
+    else:
+        xa, ya, xb, yb, score = one_direction(invert_direction)
+
+    # Descending score sort on device (keeps the max-score duplicate first).
+    order = jnp.argsort(-score[0])
+    xa, ya, xb, yb, score = (
+        jnp.take(v[0], order) for v in (xa, ya, xb, yb, score)
+    )
+
+    # Recenter normalized [0,1] coords onto pixel-cell centers
+    # (parity: eval_inloc.py:179-189).
+    k = max(k_size, 1)
+    ya = ya * (fs1 * k - 1) / (fs1 * k) + 0.5 / (fs1 * k)
+    xa = xa * (fs2 * k - 1) / (fs2 * k) + 0.5 / (fs2 * k)
+    yb = yb * (fs3 * k - 1) / (fs3 * k) + 0.5 / (fs3 * k)
+    xb = xb * (fs4 * k - 1) / (fs4 * k) + 0.5 / (fs4 * k)
+
+    # Host-side dedup of coordinate rows (np.unique keeps the first = best
+    # occurrence index per unique row after the stable sort above).
+    coords = np.stack(
+        [np.asarray(xa), np.asarray(ya), np.asarray(xb), np.asarray(yb)], axis=0
+    )
+    _, unique_idx = np.unique(coords, axis=1, return_index=True)
+    unique_idx = np.sort(unique_idx)
+    return (
+        coords[0, unique_idx],
+        coords[1, unique_idx],
+        coords[2, unique_idx],
+        coords[3, unique_idx],
+        np.asarray(score)[unique_idx],
+    )
+
+
+def write_matches_mat(
+    path: str,
+    all_matches: np.ndarray,
+    query_fn: str,
+    pano_fn_all,
+):
+    """Write the per-query .mat file (layout parity: eval_inloc.py:221)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    savemat(
+        path,
+        {"matches": all_matches, "query_fn": query_fn, "pano_fn": pano_fn_all},
+        do_compression=True,
+    )
+
+
+def matches_buffer(n_panos: int, n_matches: int) -> np.ndarray:
+    """Allocate the [1, n_panos, N, 5] buffer (parity: eval_inloc.py:126)."""
+    return np.zeros((1, n_panos, n_matches, 5))
+
+
+def fill_matches(buffer: np.ndarray, pano_idx: int, match_tuple):
+    """Store one pano's matches into the buffer rows (xA,yA,xB,yB,score)."""
+    xa, ya, xb, yb, score = match_tuple
+    n = min(len(xa), buffer.shape[2])
+    buffer[0, pano_idx, :n, 0] = xa[:n]
+    buffer[0, pano_idx, :n, 1] = ya[:n]
+    buffer[0, pano_idx, :n, 2] = xb[:n]
+    buffer[0, pano_idx, :n, 3] = yb[:n]
+    buffer[0, pano_idx, :n, 4] = score[:n]
+    return buffer

@@ -1,0 +1,191 @@
+"""Weight conversion: torchvision / reference `.pth.tar` -> ncnet_tpu pytrees.
+
+The published NCNet checkpoints (trained_models/download.sh of the reference)
+are PyTorch state dicts with keys `FeatureExtraction.model.*` (a truncated
+torchvision backbone) and `NeighConsensus.conv.*` (the Conv4d stack), plus an
+argparse Namespace under 'args' whose `ncons_kernel_sizes`/`ncons_channels`
+override the caller's (lib/model.py:214-248: 'vgg'->'model' key rewrite,
+`num_batches_tracked` skip). This module maps those state dicts — or plain
+torchvision backbone state dicts — onto this framework's parameter pytrees.
+
+Layout changes performed:
+  * conv weights  OIHW       -> HWIO          (torch -> lax HWIO)
+  * Conv4d weights: the reference stores them pre-permuted for its slicing
+    loop as [kI, O, I, kJ, kK, kL] (lib/conv4d.py:76-77);
+    torch's native layout is [O, I, kI, kJ, kK, kL]. Both convert to this
+    framework's [kI, kJ, kK, kL, I, O].
+  * batch-norm running stats keep their role (frozen inference-mode BN).
+
+torch is only needed to unpickle `.pth.tar` files; state dicts may also be
+supplied as plain numpy mappings (used by the tests).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .backbone import BackboneConfig, RESNET_SPECS
+
+
+def _np(x) -> np.ndarray:
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+def _conv2d_w(x) -> np.ndarray:
+    return _np(x).transpose(2, 3, 1, 0)  # OIHW -> HWIO
+
+
+def _bn(sd: Mapping[str, Any], prefix: str) -> Dict[str, np.ndarray]:
+    return {
+        "scale": _np(sd[f"{prefix}.weight"]),
+        "bias": _np(sd[f"{prefix}.bias"]),
+        "mean": _np(sd[f"{prefix}.running_mean"]),
+        "var": _np(sd[f"{prefix}.running_var"]),
+    }
+
+
+def convert_resnet_state_dict(
+    sd: Mapping[str, Any], config: BackboneConfig, prefix: str = ""
+) -> Dict[str, Any]:
+    """Map a torchvision ResNet state dict onto the backbone pytree.
+
+    `prefix` strips e.g. 'FeatureExtraction.model.' for reference
+    checkpoints; torchvision resnet101 state dicts use no prefix but index
+    sequential children ('0.', '1.', ...) after the truncation in
+    lib/model.py:42-44, which is also handled ('conv1' == child 0 etc.).
+    """
+    blocks = RESNET_SPECS[config.cnn]
+
+    def get(name):
+        return sd[prefix + name]
+
+    # torchvision names; the reference's nn.Sequential truncation renames
+    # children to indices — detect which scheme is present.
+    seq = prefix + "0.weight" in sd
+    conv1_key = "0" if seq else "conv1"
+    bn1_key = "1" if seq else "bn1"
+
+    def stage_key(stage):  # layer1..layer4 -> sequential index 4..7
+        return str(stage + 3) if seq else f"layer{stage}"
+
+    params: Dict[str, Any] = {
+        "conv1": _conv2d_w(get(f"{conv1_key}.weight")),
+        "bn1": _bn(sd, prefix + bn1_key),
+    }
+    for stage in range(1, config.num_stages + 1):
+        sk = stage_key(stage)
+        stage_params = []
+        for b in range(blocks[stage - 1]):
+            bp = f"{prefix}{sk}.{b}"
+            block = {
+                "conv1": _conv2d_w(sd[f"{bp}.conv1.weight"]),
+                "bn1": _bn(sd, f"{bp}.bn1"),
+                "conv2": _conv2d_w(sd[f"{bp}.conv2.weight"]),
+                "bn2": _bn(sd, f"{bp}.bn2"),
+                "conv3": _conv2d_w(sd[f"{bp}.conv3.weight"]),
+                "bn3": _bn(sd, f"{bp}.bn3"),
+            }
+            if f"{bp}.downsample.0.weight" in sd:
+                block["downsample"] = {
+                    "conv": _conv2d_w(sd[f"{bp}.downsample.0.weight"]),
+                    "bn": _bn(sd, f"{bp}.downsample.1"),
+                }
+            stage_params.append(block)
+        params[f"layer{stage}"] = stage_params
+    return params
+
+
+def convert_vgg_state_dict(
+    sd: Mapping[str, Any], config: BackboneConfig, prefix: str = ""
+) -> Dict[str, Any]:
+    """Map a torchvision VGG-16 features state dict onto the backbone pytree.
+
+    torchvision vgg16.features indexes conv layers 0,2,5,7,10,12,14,17,19,21,
+    24,26,28 with pools between; the truncated reference model keeps the same
+    indices (lib/model.py:35).
+    """
+    conv_indices = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]
+    layers = []
+    ci = 0
+    for name, cin, cout in config.vgg_layers:
+        if cout == 0:
+            layers.append({})
+        else:
+            idx = conv_indices[ci]
+            layers.append(
+                {
+                    "w": _conv2d_w(sd[f"{prefix}{idx}.weight"]),
+                    "b": _np(sd[f"{prefix}{idx}.bias"]),
+                }
+            )
+            ci += 1
+    return {"layers": layers}
+
+
+def convert_conv4d_weight(w, pre_permuted: bool = True) -> np.ndarray:
+    """Convert a reference Conv4d weight to [kI, kJ, kK, kL, cin, cout].
+
+    pre_permuted=True: stored layout [kI, O, I, kJ, kK, kL] (the reference
+    permutes at construction, lib/conv4d.py:76-77 — this is what its
+    published checkpoints contain). Otherwise native [O, I, kI, kJ, kK, kL].
+    """
+    w = _np(w)
+    if pre_permuted:
+        return w.transpose(0, 3, 4, 5, 2, 1)
+    return w.transpose(2, 3, 4, 5, 1, 0)
+
+
+def convert_neigh_consensus_state_dict(
+    sd: Mapping[str, Any],
+    kernel_sizes: Sequence[int],
+    prefix: str = "NeighConsensus.conv.",
+    pre_permuted: bool = True,
+):
+    """Map the reference Conv4d stack (conv.0, conv.2, ... with ReLUs between)."""
+    params = []
+    for i, _ in enumerate(kernel_sizes):
+        idx = 2 * i  # ReLU modules interleave (lib/model.py:137-139)
+        params.append(
+            {
+                "weight": convert_conv4d_weight(
+                    sd[f"{prefix}{idx}.weight"], pre_permuted
+                ),
+                "bias": _np(sd[f"{prefix}{idx}.bias"]),
+            }
+        )
+    return params
+
+
+def load_reference_checkpoint(path: str):
+    """Load a reference `.pth.tar` checkpoint into (params, arch kwargs).
+
+    Applies the same normalizations as lib/model.py:211-220: the 'vgg'->
+    'model' key rewrite and the arch-param override from the stored args.
+    """
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    sd = {k.replace("vgg", "model"): v for k, v in ckpt["state_dict"].items()}
+    args = ckpt.get("args")
+    kernel_sizes = tuple(getattr(args, "ncons_kernel_sizes", (3, 3, 3)))
+    channels = tuple(getattr(args, "ncons_channels", (10, 10, 1)))
+    fe_prefix = "FeatureExtraction.model."
+    is_vgg = any(k.startswith(fe_prefix + "0.weight") for k in sd) and not any(
+        ".layer3." in k or k.startswith(fe_prefix + "4.") for k in sd
+    )
+    config = BackboneConfig(cnn="vgg" if is_vgg else "resnet101")
+    if config.cnn == "vgg":
+        backbone = convert_vgg_state_dict(sd, config, fe_prefix)
+    else:
+        backbone = convert_resnet_state_dict(sd, config, fe_prefix)
+    ncons = convert_neigh_consensus_state_dict(sd, kernel_sizes)
+    params = {"backbone": backbone, "neigh_consensus": ncons}
+    return params, {
+        "ncons_kernel_sizes": kernel_sizes,
+        "ncons_channels": channels,
+        "backbone": config,
+    }

@@ -25,34 +25,31 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def conv4d(x, weight, bias=None):
-    """Apply a 4-D convolution.
+def conv4d_prepadded(x, weight, bias=None):
+    """4-D convolution over input whose dim 2 is already padded by kI//2.
+
+    The shared core of both the single-device conv4d (zero padding) and the
+    sharded halo-exchange variant (parallel/corr_sharding.py): fold (b, I)
+    into the XLA conv batch and sum kI batched 3-D convolutions. Emits only
+    the center I rows.
 
     Args:
-      x: [b, cin, I, J, K, L] correlation-tensor activations.
+      x: [b, cin, I + 2*(kI//2), J, K, L].
       weight: [kI, kJ, kK, kL, cin, cout] filters (odd kernel dims).
       bias: optional [cout].
 
     Returns:
       [b, cout, I, J, K, L].
     """
-    b, cin, si, sj, sk, sl = x.shape
+    b, cin, si_pad, sj, sk, sl = x.shape
     ki, kj, kk, kl, wcin, cout = weight.shape
     if wcin != cin:
         raise ValueError(f"cin mismatch: x has {cin}, weight has {wcin}")
-    pad_i = ki // 2
-
-    # Zero-pad the first spatial dim once; remaining dims are padded by the
-    # inner 3-D convolution ('SAME').
-    xp = jnp.pad(x, ((0, 0), (0, 0), (pad_i, pad_i), (0, 0), (0, 0), (0, 0)))
-
-    # Fold (b, I) into the conv batch: [b*I, cin, J, K, L] slices shifted by di.
-    def shifted(di):
-        return lax.dynamic_slice_in_dim(xp, di, si, axis=2)
+    si = si_pad - 2 * (ki // 2)
 
     out = None
     for di in range(ki):
-        xs = shifted(di)  # [b, cin, I, J, K, L]
+        xs = lax.dynamic_slice_in_dim(x, di, si, axis=2)
         xs = jnp.moveaxis(xs, 2, 1).reshape(b * si, cin, sj, sk, sl)
         w3 = jnp.transpose(weight[di], (4, 3, 0, 1, 2))  # [cout, cin, kj, kk, kl]
         y = lax.conv_general_dilated(
@@ -64,11 +61,26 @@ def conv4d(x, weight, bias=None):
         )
         out = y if out is None else out + y
 
-    out = out.reshape(b, si, cout, sj, sk, sl)
-    out = jnp.moveaxis(out, 2, 1)
+    out = jnp.moveaxis(out.reshape(b, si, cout, sj, sk, sl), 1, 2)
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1, 1, 1)
     return out
+
+
+def conv4d(x, weight, bias=None):
+    """Apply a 4-D convolution with size-preserving zero padding.
+
+    Args:
+      x: [b, cin, I, J, K, L] correlation-tensor activations.
+      weight: [kI, kJ, kK, kL, cin, cout] filters (odd kernel dims).
+      bias: optional [cout].
+
+    Returns:
+      [b, cout, I, J, K, L].
+    """
+    pad_i = weight.shape[0] // 2
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pad_i, pad_i), (0, 0), (0, 0), (0, 0)))
+    return conv4d_prepadded(xp, weight, bias)
 
 
 def conv4d_reference(x, weight, bias=None):

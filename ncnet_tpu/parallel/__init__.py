@@ -1,0 +1,23 @@
+"""Parallelism: mesh construction, DP sharding, corr-tensor spatial sharding."""
+
+from .mesh import make_mesh, batch_sharding, replicated
+from .corr_sharding import (
+    make_sharded_match_pipeline,
+    sharded_correlation,
+    match_pipeline_sharded,
+    mutual_matching_sharded,
+    neigh_consensus_sharded,
+    conv4d_haloed,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "make_sharded_match_pipeline",
+    "sharded_correlation",
+    "match_pipeline_sharded",
+    "mutual_matching_sharded",
+    "neigh_consensus_sharded",
+    "conv4d_haloed",
+]

@@ -1,0 +1,187 @@
+"""Spatial sharding of the 4-D correlation tensor across a device mesh.
+
+This is the long-context / sequence-parallel analogue for the NCNet workload
+(SURVEY.md §2.8 item 2, §5): the InLoc configuration materializes correlation
+tensors of ~1.6G elements pre-pool; here the tensor is sharded along its iA
+axis across the mesh's 'sp' axis, and:
+
+* mutual matching's max-over-A-positions runs as a `lax.pmax` collective
+  (max-over-B stays shard-local);
+* the Conv4d stencil gets its iA neighbourhood via halo exchange with
+  `lax.ppermute` over ICI — ring-transfer of the 2-cell-deep boundary slabs,
+  exactly the ring-attention communication pattern;
+* symmetric-mode NeighConsensus re-lays the tensor out with `lax.all_to_all`
+  so the A<->B-transposed pass is sharded along *its* leading spatial dim,
+  then transfers back — the Ulysses-style all-to-all alternative, used here
+  because the transposed pass needs a different axis sharded.
+
+Everything is expressed inside one `shard_map`, so XLA schedules the
+collectives and overlaps them with compute.
+
+The reference has no counterpart (single CUDA device, fp16 + maxpool as the
+only memory workaround — eval_inloc.py:50, lib/model.py:269-272).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.conv4d import conv4d_prepadded
+from ..ops.mutual import EPS
+from ..ops.pool4d import maxpool4d
+
+
+def _halo_exchange(x, pad: int, axis_name: str):
+    """Pad dim 2 of the local block with `pad` rows from ring neighbours.
+
+    Boundary shards receive zeros (matching the zero padding of the global
+    convolution). x: [b, c, I_loc, ...] -> [b, c, I_loc + 2*pad, ...].
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return jnp.pad(x, ((0, 0), (0, 0), (pad, pad)) + ((0, 0),) * (x.ndim - 3))
+    # Send my last `pad` rows to my right neighbour (their left halo) and my
+    # first `pad` rows to my left neighbour (their right halo). ppermute
+    # leaves unaddressed destinations zero, which realizes the boundary
+    # zero padding for shards 0 and n-1.
+    right_slab = lax.slice_in_dim(x, x.shape[2] - pad, x.shape[2], axis=2)
+    left_slab = lax.slice_in_dim(x, 0, pad, axis=2)
+    from_left = lax.ppermute(
+        right_slab, axis_name, [(i, i + 1) for i in range(n - 1)]
+    )
+    from_right = lax.ppermute(
+        left_slab, axis_name, [(i + 1, i) for i in range(n - 1)]
+    )
+    return jnp.concatenate([from_left, x, from_right], axis=2)
+
+
+# Conv4d over a halo-padded block is exactly the shared prepadded core:
+# the halo plays the role of the zero padding.
+conv4d_haloed = conv4d_prepadded
+
+
+def mutual_matching_sharded(corr4d, axis_name: str, eps: float = EPS):
+    """Soft mutual-NN filtering on an iA-sharded block.
+
+    max over B positions (dims 4,5) is shard-local; max over A positions
+    (dims 2,3) needs the cross-shard `pmax` collective.
+    """
+    max_over_a = lax.pmax(
+        jnp.max(corr4d, axis=(2, 3), keepdims=True), axis_name
+    )
+    max_over_b = jnp.max(corr4d, axis=(4, 5), keepdims=True)
+    return corr4d * (
+        (corr4d / (max_over_b + eps)) * (corr4d / (max_over_a + eps))
+    )
+
+
+def _conv_stack_sharded(params: Sequence[Dict[str, Any]], x, axis_name: str):
+    """Conv4d+ReLU stack with per-layer halo exchange on dim 2."""
+    for layer in params:
+        pad = layer["weight"].shape[0] // 2
+        xp = _halo_exchange(x, pad, axis_name) if pad else x
+        x = jax.nn.relu(conv4d_haloed(xp, layer["weight"], layer["bias"]))
+    return x
+
+
+def neigh_consensus_sharded(
+    params: Sequence[Dict[str, Any]], corr4d, axis_name: str, symmetric: bool = True
+):
+    """Symmetric NeighConsensus on an iA-sharded correlation block.
+
+    The direct pass convolves with halo exchange along the sharded iA.
+    For the transposed pass the tensor is re-laid-out with all_to_all so the
+    B-side leading spatial dim (iB) becomes the sharded one, the same stack
+    runs, and the result is transferred back and summed.
+    """
+    direct = _conv_stack_sharded(params, corr4d, axis_name)
+    if not symmetric:
+        return direct
+
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        swapped = jnp.transpose(corr4d, (0, 1, 4, 5, 2, 3))
+        back = jnp.transpose(
+            _conv_stack_sharded(params, swapped, axis_name), (0, 1, 4, 5, 2, 3)
+        )
+        return direct + back
+
+    # Re-layout: [b,c,I_loc,J,K,L] --all_to_all--> [b,c,I,J,K_loc,L]
+    regathered = lax.all_to_all(
+        corr4d, axis_name, split_axis=4, concat_axis=2, tiled=True
+    )
+    swapped = jnp.transpose(regathered, (0, 1, 4, 5, 2, 3))  # [b,c,K_loc,L,I,J]
+    conv_t = _conv_stack_sharded(params, swapped, axis_name)
+    conv_t = jnp.transpose(conv_t, (0, 1, 4, 5, 2, 3))  # [b,c,I,J,K_loc,L]
+    back = lax.all_to_all(conv_t, axis_name, split_axis=2, concat_axis=4, tiled=True)
+    return direct + back
+
+
+def match_pipeline_sharded(params, corr_local, axis_name: str, symmetric: bool = True):
+    """mutual -> neigh-consensus -> mutual on an iA-sharded block."""
+    x = mutual_matching_sharded(corr_local, axis_name)
+    x = neigh_consensus_sharded(params, x, axis_name, symmetric)
+    x = mutual_matching_sharded(x, axis_name)
+    return x
+
+
+def make_sharded_match_pipeline(
+    mesh: Mesh, axis_name: str = "sp", symmetric: bool = True
+):
+    """Build a jit-able sharded pipeline over a mesh.
+
+    Returns a function (neigh_consensus_params, corr4d) -> corr4d where
+    corr4d is globally shaped [b, 1, I, J, K, L]; I must be divisible by the
+    mesh 'sp' axis size (it carries the sharding), and in symmetric mode K
+    must be too (the transposed pass re-shards onto K via all_to_all). The
+    InLoc input bucketing (cli/eval_inloc.py) guarantees this. Input/output
+    shardings: corr split on dim 2, params replicated.
+    """
+    spec_corr = P(None, None, axis_name, None, None, None)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), spec_corr),
+        out_specs=spec_corr,
+        check_vma=False,
+    )
+    def pipeline(params, corr_local):
+        return match_pipeline_sharded(params, corr_local, axis_name, symmetric)
+
+    return jax.jit(pipeline)
+
+
+def sharded_correlation(feature_a, feature_b, mesh: Mesh, axis_name: str = "sp"):
+    """All-pairs correlation with the output sharded along iA.
+
+    feature_a is sharded along its height axis; feature_b is replicated; each
+    shard computes its slab of the correlation tensor locally — no
+    communication at all (the einsum is embarrassingly parallel over iA).
+    """
+    spec_fa = P(None, None, axis_name, None)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec_fa, P()),
+        out_specs=P(None, None, axis_name, None, None, None),
+        check_vma=False,
+    )
+    def corr(fa_local, fb):
+        c = jnp.einsum(
+            "bcij,bckl->bijkl",
+            fa_local.astype(jnp.bfloat16),
+            fb.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return c[:, None]
+
+    return corr(feature_a, feature_b)

@@ -1,0 +1,55 @@
+"""Device-mesh construction for data- and spatial-parallel execution.
+
+The reference is single-GPU (SURVEY.md §2.8); scaling here is green-field:
+* axis 'dp' — data parallelism over image pairs (the training axis; gradient
+  allreduce rides ICI via `jax.sharding` + jit);
+* axis 'sp' — spatial sharding of the 4-D correlation tensor's iA axis for
+  the high-resolution InLoc configuration (the long-context analogue; see
+  parallel/corr_sharding.py).
+
+On a TPU pod slice, `make_mesh((dp, sp))` lays the axes over the physical
+ICI topology via jax.experimental.mesh_utils; on CPU test runs it uses the
+virtual host devices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = ("dp",),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    Args:
+      shape: mesh shape; defaults to all devices on one 'dp' axis.
+      axis_names: one name per mesh dim.
+    """
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices[:n])
+    except Exception:
+        dev_array = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Sharding for a batch-leading array: batch split over `axis`."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

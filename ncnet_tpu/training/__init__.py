@@ -1,0 +1,24 @@
+"""Training: weak-supervision loss, train state/steps, checkpointing."""
+
+from .loss import weak_loss, pair_match_score
+from .trainer import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    shard_batch,
+    replicate_state,
+)
+from .checkpoint import save_checkpoint, load_checkpoint, config_from_dict
+
+__all__ = [
+    "weak_loss",
+    "pair_match_score",
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "shard_batch",
+    "replicate_state",
+    "save_checkpoint",
+    "load_checkpoint",
+    "config_from_dict",
+]

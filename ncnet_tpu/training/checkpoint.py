@@ -1,0 +1,134 @@
+"""Checkpointing: self-describing save/restore with config-in-checkpoint.
+
+Reference parity (lib/torch_util.py:48-61, train.py:198-206, and the restore
+path lib/model.py:211-248): every epoch is saved, the best validation loss
+copies to `best/`, and the architecture hyper-parameters travel *with* the
+checkpoint and override caller args on restore (lib/model.py:217-220 — kept,
+because it is what makes published checkpoints self-describing). Unlike the
+reference, optimizer state is actually restored (the reference saves it but
+never loads it — SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..models.backbone import BackboneConfig
+from ..models.ncnet import NCNetConfig
+
+
+def _config_to_dict(config: NCNetConfig) -> dict:
+    d = dataclasses.asdict(config)
+    return d
+
+
+def config_from_dict(d: dict) -> NCNetConfig:
+    bb = d.pop("backbone", {})
+    d = dict(d)
+    for key in ("ncons_kernel_sizes", "ncons_channels"):
+        if key in d:
+            d[key] = tuple(d[key])
+    return NCNetConfig(backbone=BackboneConfig(**bb), **d)
+
+
+def _save_tree(tree, path: str):
+    """Flatten a pytree to an npz with path-encoded keys."""
+    flat = {}
+
+    def visit(prefix, node):
+        if isinstance(node, dict):
+            if not node:  # parameterless entries (e.g. pool layers)
+                flat[f"{prefix}/__empty__"] = np.zeros(())
+            for k, v in node.items():
+                visit(f"{prefix}/{k}", v)
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                visit(f"{prefix}/#{i}", v)
+        else:
+            flat[prefix] = np.asarray(node)
+
+    visit("", tree)
+    np.savez(path, **flat)
+
+
+def _load_tree(path: str):
+    """Inverse of _save_tree."""
+    data = np.load(path)
+    root: Dict[str, Any] = {}
+    for key in data.files:
+        parts = [p for p in key.split("/") if p]
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = data[key]
+
+    def listify(node):
+        if isinstance(node, dict):
+            if "__empty__" in node and len(node) == 1:
+                return {}
+            if node and all(k.startswith("#") for k in node):
+                return [listify(node[f"#{i}"]) for i in range(len(node))]
+            return {k: listify(v) for k, v in node.items()}
+        return node
+
+    return listify(root)
+
+
+def save_checkpoint(
+    directory: str,
+    params: Dict[str, Any],
+    config: NCNetConfig,
+    epoch: int,
+    opt_state=None,
+    extra: Optional[dict] = None,
+    is_best: bool = False,
+):
+    """Write params + config (+ opt state, metrics) under `directory/epoch_N`."""
+    os.makedirs(directory, exist_ok=True)
+    tag = os.path.join(directory, f"epoch_{epoch}")
+    os.makedirs(tag, exist_ok=True)
+    _save_tree(jax.tree.map(np.asarray, params), os.path.join(tag, "params.npz"))
+    if opt_state is not None:
+        flat, treedef = jax.tree.flatten(opt_state)
+        np.savez(
+            os.path.join(tag, "opt_state.npz"),
+            **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(flat)},
+        )
+        with open(os.path.join(tag, "opt_treedef.txt"), "w") as f:
+            f.write(str(treedef))
+    meta = {"config": _config_to_dict(config), "epoch": epoch, **(extra or {})}
+    with open(os.path.join(tag, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=float)
+    if is_best:
+        best = os.path.join(directory, "best")
+        if os.path.exists(best):
+            shutil.rmtree(best)
+        shutil.copytree(tag, best)
+    return tag
+
+
+def load_checkpoint(path: str, opt_state_template=None):
+    """Load (params, config, meta[, opt_state]) from a checkpoint dir.
+
+    The stored config wins over caller-supplied architecture args, matching
+    the reference restore behavior (lib/model.py:217-220).
+    """
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    config = config_from_dict(meta["config"])
+    params = _load_tree(os.path.join(path, "params.npz"))
+    result = {"params": params, "config": config, "meta": meta}
+    opt_path = os.path.join(path, "opt_state.npz")
+    if opt_state_template is not None and os.path.exists(opt_path):
+        data = np.load(opt_path)
+        leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+        _, treedef = jax.tree.flatten(opt_state_template)
+        result["opt_state"] = jax.tree.unflatten(treedef, leaves)
+    return result

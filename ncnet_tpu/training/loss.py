@@ -1,0 +1,64 @@
+"""Weak-supervision loss.
+
+Parity target: train.py:110-156 of the reference. For a batch of positive
+(matching) pairs, the per-direction softmax max-scores are averaged; negatives
+are formed *in-batch* by rolling the source images by one (train.py:137), and
+the loss is `mean_neg_score - mean_pos_score`.
+
+TPU-first notes: the roll is a jnp.roll on device (no host round-trip) and
+both forward passes run under one jit so XLA can share the backbone compute
+graph. The mean-of-max reductions fuse into the correlation pipeline.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pair_match_score(corr4d, normalization: str = "softmax"):
+    """Mean mutual match score of a filtered correlation tensor.
+
+    Implements the score of train.py:123-134: normalize the corr tensor as a
+    distribution over A positions (for each B position) and vice versa, take
+    the per-position max, and average the two directions.
+    """
+    b = corr4d.shape[0]
+    fs1, fs2, fs3, fs4 = corr4d.shape[2:]
+    nc_b_avec = corr4d.reshape(b, fs1 * fs2, fs3, fs4)
+    nc_a_bvec = corr4d.reshape(b, fs1, fs2, fs3 * fs4)
+
+    if normalization == "softmax":
+        nc_b_avec = jax.nn.softmax(nc_b_avec, axis=1)
+        nc_a_bvec = jax.nn.softmax(nc_a_bvec, axis=3)
+    elif normalization == "l1":
+        nc_b_avec = nc_b_avec / (jnp.sum(nc_b_avec, axis=1, keepdims=True) + 1e-4)
+        nc_a_bvec = nc_a_bvec / (jnp.sum(nc_a_bvec, axis=3, keepdims=True) + 1e-4)
+    elif normalization is not None:
+        raise ValueError(f"unknown normalization {normalization!r}")
+
+    scores_b = jnp.max(nc_b_avec, axis=1)  # [b, fs3, fs4]
+    scores_a = jnp.max(nc_a_bvec, axis=3)  # [b, fs1, fs2]
+    return (jnp.mean(scores_a) + jnp.mean(scores_b)) / 2
+
+
+def weak_loss(forward_fn, source_image, target_image, normalization: str = "softmax"):
+    """Positive-vs-rolled-negative weak loss.
+
+    Args:
+      forward_fn: (src, tgt) -> corr4d (the model forward closed over params).
+      source_image, target_image: [b, 3, h, w].
+
+    Returns:
+      scalar loss = score(negatives) - score(positives).
+    """
+    corr_pos = forward_fn(source_image, target_image)
+    score_pos = pair_match_score(corr_pos, normalization)
+
+    # In-batch negatives: source rolled by one pairs each target with a
+    # different image (parity: np.roll(np.arange(b), -1) at train.py:137).
+    rolled = jnp.roll(source_image, -1, axis=0)
+    corr_neg = forward_fn(rolled, target_image)
+    score_neg = pair_match_score(corr_neg, normalization)
+
+    return score_neg - score_pos

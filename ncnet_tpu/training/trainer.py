@@ -1,0 +1,182 @@
+"""Training: optax state, jitted data-parallel train/eval steps.
+
+Reference parity (train.py of the reference tree):
+  * Adam, lr 5e-4 (train.py:41,71), batch 16, 5 epochs;
+  * only the NeighConsensus stack is trainable — the backbone is frozen
+    (lib/model.py:75-78) and stays in inference mode (lib/model.py:251);
+  * per-epoch validation on val_pairs.csv with best-checkpoint tracking
+    (train.py:191-206).
+
+TPU-first design: the step is one jit containing both forward passes
+(positive + rolled negative) and the update; data parallelism is expressed
+by sharding the batch over the mesh 'dp' axis with NamedShardings — XLA
+inserts the gradient allreduce over ICI. The frozen backbone params are
+donated/replicated constants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.ncnet import NCNetConfig, ncnet_forward
+from .loss import weak_loss
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Pure-pytree train state (params split by trainability)."""
+
+    trainable: Params  # neigh_consensus (+ optionally fine-tuned backbone)
+    frozen: Params  # backbone
+    opt_state: Any
+    step: int = 0
+
+    def full_params(self) -> Params:
+        return {"backbone": self.frozen["backbone"], **self.trainable}
+
+
+def _finetune_mask(backbone: Params, n_blocks: int) -> Params:
+    """Update-mask over the backbone: True only for the last `n_blocks`
+    blocks' weights, excluding batch-norm running statistics.
+
+    Mirrors the reference's fine-tune selection (train.py:60-63: the last N
+    children of the last stage get requires_grad=True — their conv weights
+    and BN affine params, but never the running mean/var, which are buffers).
+    """
+
+    def false_like(t):
+        return jax.tree.map(lambda _: False, t)
+
+    mask = false_like(backbone)
+    if n_blocks <= 0:
+        return mask
+
+    def block_mask(block):
+        m = false_like(block)
+        for k, v in block.items():
+            if k.startswith("conv"):
+                m[k] = True
+            elif k.startswith("bn"):
+                m[k] = {"scale": True, "bias": True, "mean": False, "var": False}
+            elif k == "downsample":
+                m[k] = {
+                    "conv": True,
+                    "bn": {"scale": True, "bias": True, "mean": False, "var": False},
+                }
+        return m
+
+    if "layers" in backbone:  # vgg: last n conv layers
+        conv_idx = [i for i, l in enumerate(backbone["layers"]) if l]
+        for i in conv_idx[-n_blocks:]:
+            mask["layers"][i] = {"w": True, "b": True}
+    else:  # resnet: last n bottleneck blocks of the last stage
+        last_stage = max(k for k in backbone if k.startswith("layer"))
+        blocks = backbone[last_stage]
+        for i in range(max(len(blocks) - n_blocks, 0), len(blocks)):
+            mask[last_stage][i] = block_mask(blocks[i])
+    return mask
+
+
+def create_train_state(
+    params: Params,
+    learning_rate: float = 5e-4,
+    train_fe: bool = False,
+    fe_finetune_blocks: int = 1,
+) -> Tuple[TrainState, optax.GradientTransformation]:
+    """Split params into trainable/frozen and init Adam.
+
+    With train_fe=False only the NeighConsensus stack receives gradients,
+    mirroring the reference's requires_grad freeze (lib/model.py:75-78).
+    With train_fe=True the backbone joins the trainable set but the Adam
+    update is masked to the last `fe_finetune_blocks` blocks' weights —
+    batch-norm running statistics are never updated (they are buffers, not
+    parameters).
+    """
+    if train_fe:
+        trainable = {
+            "neigh_consensus": params["neigh_consensus"],
+            "backbone": params["backbone"],
+        }
+        frozen = {"backbone": params["backbone"]}  # forward uses trainable's
+        mask = {
+            "neigh_consensus": jax.tree.map(
+                lambda _: True, params["neigh_consensus"]
+            ),
+            "backbone": _finetune_mask(params["backbone"], fe_finetune_blocks),
+        }
+        labels = jax.tree.map(lambda m: "train" if m else "freeze", mask)
+        tx = optax.multi_transform(
+            {"train": optax.adam(learning_rate), "freeze": optax.set_to_zero()},
+            labels,
+        )
+    else:
+        trainable = {"neigh_consensus": params["neigh_consensus"]}
+        frozen = {"backbone": params["backbone"]}
+        tx = optax.adam(learning_rate)
+    opt_state = tx.init(trainable)
+    return TrainState(trainable, frozen, opt_state, 0), tx
+
+
+def make_train_step(
+    config: NCNetConfig,
+    tx: optax.GradientTransformation,
+    normalization: str = "softmax",
+):
+    """Build the jitted train step (loss + grads + Adam update)."""
+
+    def loss_fn(trainable: Params, frozen: Params, source, target):
+        params = {
+            "backbone": trainable.get("backbone", frozen["backbone"]),
+            "neigh_consensus": trainable["neigh_consensus"],
+        }
+
+        def forward(src, tgt):
+            corr, _ = ncnet_forward(config, params, src, tgt)
+            return corr
+
+        return weak_loss(forward, source, target, normalization)
+
+    @jax.jit
+    def train_step(state_trainable, state_frozen, opt_state, source, target):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state_trainable, state_frozen, source, target
+        )
+        updates, new_opt_state = tx.update(grads, opt_state, state_trainable)
+        new_trainable = optax.apply_updates(state_trainable, updates)
+        return new_trainable, new_opt_state, loss
+
+    @jax.jit
+    def eval_step(state_trainable, state_frozen, source, target):
+        return loss_fn(state_trainable, state_frozen, source, target)
+
+    return train_step, eval_step
+
+
+def shard_batch(batch: Dict[str, Any], mesh: Optional[Mesh]):
+    """Device-put a host batch with its leading dim split over mesh 'dp'."""
+    if mesh is None:
+        return {k: jnp.asarray(v) for k, v in batch.items()}
+    sharding = NamedSharding(mesh, P("dp"))
+    out = {}
+    for k, v in batch.items():
+        arr = jnp.asarray(v)
+        out[k] = jax.device_put(arr, sharding) if arr.ndim > 0 else arr
+    return out
+
+
+def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Replicate train state across the mesh (params are small: ~0.2M)."""
+    rep = NamedSharding(mesh, P())
+    put = lambda t: jax.tree.map(lambda x: jax.device_put(x, rep), t)
+    return TrainState(
+        put(state.trainable), put(state.frozen), put(state.opt_state), state.step
+    )

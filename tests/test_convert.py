@@ -1,0 +1,195 @@
+"""Weight-conversion tests: torch state dicts -> ncnet_tpu pytrees.
+
+The numeric oracle is a functional torch re-implementation of the
+torchvision ResNet/VGG forward driven directly by the state dict, so
+conversion AND our backbone forward are pinned end-to-end without needing
+torchvision itself.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models.backbone import (
+    BackboneConfig,
+    RESNET_SPECS,
+    backbone_apply,
+    backbone_init,
+)
+from ncnet_tpu.models.convert import (
+    convert_resnet_state_dict,
+    convert_vgg_state_dict,
+    convert_conv4d_weight,
+    convert_neigh_consensus_state_dict,
+)
+from ncnet_tpu.ops import conv4d
+
+
+def make_resnet_state_dict(arch="resnet50", stages=3, seed=0):
+    """Random torchvision-style ResNet state dict (truncated at `stages`)."""
+    g = torch.Generator().manual_seed(seed)
+    sd = {}
+
+    def add_bn(prefix, c):
+        sd[f"{prefix}.weight"] = torch.randn(c, generator=g) * 0.1 + 1
+        sd[f"{prefix}.bias"] = torch.randn(c, generator=g) * 0.1
+        sd[f"{prefix}.running_mean"] = torch.randn(c, generator=g) * 0.1
+        sd[f"{prefix}.running_var"] = torch.rand(c, generator=g) + 0.5
+        sd[f"{prefix}.num_batches_tracked"] = torch.tensor(1)
+
+    sd["conv1.weight"] = torch.randn(64, 3, 7, 7, generator=g) * 0.05
+    add_bn("bn1", 64)
+    cin = 64
+    for s in range(1, stages + 1):
+        planes = 64 * 2 ** (s - 1)
+        cout = planes * 4
+        for b in range(RESNET_SPECS[arch][s - 1]):
+            p = f"layer{s}.{b}"
+            sd[f"{p}.conv1.weight"] = torch.randn(planes, cin, 1, 1, generator=g) * 0.05
+            add_bn(f"{p}.bn1", planes)
+            sd[f"{p}.conv2.weight"] = torch.randn(planes, planes, 3, 3, generator=g) * 0.05
+            add_bn(f"{p}.bn2", planes)
+            sd[f"{p}.conv3.weight"] = torch.randn(cout, planes, 1, 1, generator=g) * 0.05
+            add_bn(f"{p}.bn3", cout)
+            if b == 0:
+                sd[f"{p}.downsample.0.weight"] = (
+                    torch.randn(cout, cin, 1, 1, generator=g) * 0.05
+                )
+                add_bn(f"{p}.downsample.1", cout)
+            cin = cout
+    return sd
+
+
+def torch_resnet_forward(sd, x, arch="resnet50", stages=3):
+    """Functional torchvision-ResNet forward from a raw state dict."""
+
+    def bn(t, p):
+        return F.batch_norm(
+            t,
+            sd[f"{p}.running_mean"],
+            sd[f"{p}.running_var"],
+            sd[f"{p}.weight"],
+            sd[f"{p}.bias"],
+            training=False,
+        )
+
+    x = F.conv2d(x, sd["conv1.weight"], stride=2, padding=3)
+    x = F.relu(bn(x, "bn1"))
+    x = F.max_pool2d(x, 3, 2, 1)
+    for s in range(1, stages + 1):
+        for b in range(RESNET_SPECS[arch][s - 1]):
+            p = f"layer{s}.{b}"
+            stride = 2 if (b == 0 and s > 1) else 1
+            identity = x
+            out = F.relu(bn(F.conv2d(x, sd[f"{p}.conv1.weight"]), f"{p}.bn1"))
+            out = F.relu(
+                bn(
+                    F.conv2d(out, sd[f"{p}.conv2.weight"], stride=stride, padding=1),
+                    f"{p}.bn2",
+                )
+            )
+            out = bn(F.conv2d(out, sd[f"{p}.conv3.weight"]), f"{p}.bn3")
+            if f"{p}.downsample.0.weight" in sd:
+                identity = bn(
+                    F.conv2d(x, sd[f"{p}.downsample.0.weight"], stride=stride),
+                    f"{p}.downsample.1",
+                )
+            x = F.relu(out + identity)
+    return x
+
+
+def test_resnet_conversion_numerical_parity(rng):
+    config = BackboneConfig(cnn="resnet50", last_layer="layer2")
+    sd = make_resnet_state_dict("resnet50", stages=2)
+    params = convert_resnet_state_dict(sd, config)
+    x = rng.randn(1, 3, 64, 64).astype(np.float32)
+    ref = torch_resnet_forward(sd, torch.tensor(x), "resnet50", stages=2).numpy()
+    ours = np.asarray(backbone_apply(config, params, jnp.asarray(x)))
+    assert ours.shape == ref.shape
+    np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+
+def test_resnet_conversion_shapes_match_init():
+    config = BackboneConfig(cnn="resnet50", last_layer="layer3")
+    sd = make_resnet_state_dict("resnet50", stages=3)
+    converted = convert_resnet_state_dict(sd, config)
+    inited = backbone_init(jax.random.PRNGKey(0), config)
+    c_shapes = [x.shape for x in jax.tree.leaves(jax.tree.map(np.asarray, converted))]
+    i_shapes = [x.shape for x in jax.tree.leaves(jax.tree.map(np.asarray, inited))]
+    assert c_shapes == i_shapes
+
+
+def make_vgg_state_dict(seed=0):
+    g = torch.Generator().manual_seed(seed)
+    cfg = [
+        (0, 3, 64), (2, 64, 64), (5, 64, 128), (7, 128, 128),
+        (10, 128, 256), (12, 256, 256), (14, 256, 256),
+        (17, 256, 512), (19, 512, 512), (21, 512, 512),
+    ]
+    sd = {}
+    for idx, cin, cout in cfg:
+        sd[f"{idx}.weight"] = torch.randn(cout, cin, 3, 3, generator=g) * 0.05
+        sd[f"{idx}.bias"] = torch.randn(cout, generator=g) * 0.1
+    return sd
+
+
+def torch_vgg_forward(sd, x):
+    order = [0, 2, "M", 5, 7, "M", 10, 12, 14, "M", 17, 19, 21, "M"]
+    for o in order:
+        if o == "M":
+            x = F.max_pool2d(x, 2, 2)
+        else:
+            x = F.relu(F.conv2d(x, sd[f"{o}.weight"], sd[f"{o}.bias"], padding=1))
+    return x
+
+
+def test_vgg_conversion_numerical_parity(rng):
+    config = BackboneConfig(cnn="vgg", last_layer="pool4")
+    sd = make_vgg_state_dict()
+    params = convert_vgg_state_dict(sd, config)
+    x = rng.randn(1, 3, 64, 64).astype(np.float32)
+    ref = torch_vgg_forward(sd, torch.tensor(x)).numpy()
+    ours = np.asarray(backbone_apply(config, params, jnp.asarray(x)))
+    np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+
+def test_conv4d_weight_conversion(rng):
+    """Native torch Conv4d layout converts to a weight our conv4d agrees on."""
+    from tests.test_ops import torch_conv4d
+
+    cin, cout, k = 2, 3, 3
+    w_native = rng.randn(cout, cin, k, k, k, k).astype(np.float32) * 0.1
+    bias = rng.randn(cout).astype(np.float32)
+    x = rng.randn(1, cin, 4, 4, 4, 4).astype(np.float32)
+
+    ours_w = convert_conv4d_weight(w_native, pre_permuted=False)
+    ours = np.asarray(conv4d(jnp.asarray(x), jnp.asarray(ours_w), jnp.asarray(bias)))
+    ref = torch_conv4d(
+        torch.tensor(x), torch.tensor(ours_w), torch.tensor(bias)
+    ).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    # pre-permuted layout (what reference checkpoints store: [kI,O,I,kJ,kK,kL])
+    w_pre = w_native.transpose(2, 0, 1, 3, 4, 5)
+    ours_w2 = convert_conv4d_weight(w_pre, pre_permuted=True)
+    np.testing.assert_array_equal(ours_w, ours_w2)
+
+
+def test_neigh_consensus_state_dict_conversion(rng):
+    sd = {
+        "NeighConsensus.conv.0.weight": torch.tensor(
+            rng.randn(3, 4, 1, 3, 3, 3).astype(np.float32)
+        ),
+        "NeighConsensus.conv.0.bias": torch.tensor(rng.randn(4).astype(np.float32)),
+        "NeighConsensus.conv.2.weight": torch.tensor(
+            rng.randn(3, 1, 4, 3, 3, 3).astype(np.float32)
+        ),
+        "NeighConsensus.conv.2.bias": torch.tensor(rng.randn(1).astype(np.float32)),
+    }
+    params = convert_neigh_consensus_state_dict(sd, (3, 3))
+    assert params[0]["weight"].shape == (3, 3, 3, 3, 1, 4)
+    assert params[1]["weight"].shape == (3, 3, 3, 3, 4, 1)

@@ -1,0 +1,218 @@
+"""Tests for the evaluation metrics, InLoc export, datasets and loader."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.evals import (
+    pck,
+    pck_metric,
+    dense_warp_grid,
+    write_flow_output,
+    extract_inloc_matches,
+    write_matches_mat,
+    matches_buffer,
+    fill_matches,
+)
+from ncnet_tpu.data import (
+    ImagePairDataset,
+    PFPascalDataset,
+    DataLoader,
+    default_collate,
+)
+from ncnet_tpu.geometry import read_flo_file
+from ncnet_tpu.ops import maxpool4d
+
+
+def test_pck_counts_valid_points_only():
+    src = np.full((1, 2, 5), -1, np.float32)
+    src[:, :, :3] = [[[10, 20, 30], [10, 20, 30]]]
+    warped = src.copy()
+    warped[0, 0, 0] += 100.0  # one valid point far off
+    l_pck = np.array([100.0], np.float32)
+    val = np.asarray(pck(jnp.asarray(src), jnp.asarray(warped), jnp.asarray(l_pck)))
+    np.testing.assert_allclose(val, [2 / 3], atol=1e-6)
+
+
+def test_pck_metric_identity_matches():
+    """With an identity match grid, PCK must be 1 for in-image points."""
+    fs = 8
+    xs = np.linspace(-1, 1, fs)
+    gx, gy = np.meshgrid(xs, xs)
+    ident = (
+        jnp.asarray(gx.reshape(1, -1).astype(np.float32)),
+        jnp.asarray(gy.reshape(1, -1).astype(np.float32)),
+        jnp.asarray(gx.reshape(1, -1).astype(np.float32)),
+        jnp.asarray(gy.reshape(1, -1).astype(np.float32)),
+    )
+    pts = np.full((1, 2, 20), -1, np.float32)
+    pts[0, :, :4] = [[50, 100, 150, 180], [40, 90, 120, 160]]
+    batch = {
+        "source_points": jnp.asarray(pts),
+        "target_points": jnp.asarray(pts),
+        "source_im_size": jnp.asarray([[200.0, 200.0]]),
+        "target_im_size": jnp.asarray([[200.0, 200.0]]),
+        "L_pck": jnp.asarray([[200.0]]),
+    }
+    val = np.asarray(pck_metric(batch, ident, alpha=0.1))
+    np.testing.assert_allclose(val, [1.0], atol=1e-6)
+
+
+def test_dense_warp_grid_identity():
+    fs = 6
+    xs = np.linspace(-1, 1, fs)
+    gx, gy = np.meshgrid(xs, xs)
+    ident = tuple(
+        jnp.asarray(a.reshape(1, -1).astype(np.float32)) for a in (gx, gy, gx, gy)
+    )
+    grid = np.asarray(dense_warp_grid(ident, 10, 12))
+    ex, ey = np.meshgrid(np.linspace(-1, 1, 12), np.linspace(-1, 1, 10))
+    np.testing.assert_allclose(grid[0, :, :, 0], ex, atol=1e-5)
+    np.testing.assert_allclose(grid[0, :, :, 1], ey, atol=1e-5)
+
+
+def test_write_flow_output_identity(tmp_path):
+    fs = 6
+    xs = np.linspace(-1, 1, fs)
+    gx, gy = np.meshgrid(xs, xs)
+    ident = tuple(
+        jnp.asarray(a.reshape(1, -1).astype(np.float32)) for a in (gx, gy, gx, gy)
+    )
+    out = write_flow_output(
+        ident, (20, 24), (20, 24), "pair1/flow1.flo", str(tmp_path)
+    )
+    flow = read_flo_file(out)
+    assert flow.shape == (20, 24, 2)
+    in_b = np.abs(flow) < 1e9
+    assert np.abs(flow[in_b]).max() < 1e-3  # identity warp -> ~zero flow
+
+
+def test_extract_inloc_matches(rng):
+    corr = jnp.asarray(rng.randn(1, 1, 8, 8, 8, 8).astype(np.float32))
+    pooled, delta = maxpool4d(corr, 2)
+    xa, ya, xb, yb, score = extract_inloc_matches(
+        pooled, delta4d=delta, k_size=2, both_directions=True
+    )
+    # scores descending, coords in (0, 1) after recentring
+    assert np.all(np.diff(score) <= 1e-6)
+    for v in (xa, ya, xb, yb):
+        assert v.min() > 0 and v.max() < 1
+    # dedup: coordinate rows unique
+    coords = np.stack([xa, ya, xb, yb])
+    assert np.unique(coords, axis=1).shape[1] == coords.shape[1]
+
+
+def test_write_matches_mat_roundtrip(tmp_path, rng):
+    from scipy.io import loadmat
+
+    buf = matches_buffer(3, 10)
+    m = (
+        rng.rand(5), rng.rand(5), rng.rand(5), rng.rand(5), rng.rand(5),
+    )
+    fill_matches(buf, 1, m)
+    path = str(tmp_path / "out" / "1.mat")
+    write_matches_mat(path, buf, "q1.jpg", np.array(["p1.jpg", "p2.jpg", "p3.jpg"]))
+    back = loadmat(path)
+    assert back["matches"].shape == (1, 3, 10, 5)
+    np.testing.assert_allclose(back["matches"][0, 1, :5, 0], m[0], atol=1e-6)
+    assert back["matches"][0, 0].max() == 0  # untouched pano row stays zero
+
+
+def _write_synthetic_dataset(root, n_pairs=6, size=48):
+    """Create images + train CSV + PF-Pascal-style eval CSV under root."""
+    img_dir = os.path.join(root, "images")
+    os.makedirs(img_dir, exist_ok=True)
+    rng = np.random.RandomState(0)
+    rows_train = ["source_image,target_image,class,flip"]
+    rows_eval = ["source_image,target_image,class,XA,YA,XB,YB"]
+    for i in range(n_pairs):
+        for suffix in ("a", "b"):
+            arr = rng.randint(0, 255, (size, size, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(img_dir, f"{i}{suffix}.jpg"))
+        rows_train.append(f"images/{i}a.jpg,images/{i}b.jpg,1,{i % 2}")
+        xa = ";".join(str(v) for v in rng.randint(5, size - 5, 4))
+        ya = ";".join(str(v) for v in rng.randint(5, size - 5, 4))
+        rows_eval.append(
+            f"images/{i}a.jpg,images/{i}b.jpg,1,{xa},{ya},{xa},{ya}"
+        )
+    with open(os.path.join(root, "train.csv"), "w") as f:
+        f.write("\n".join(rows_train))
+    with open(os.path.join(root, "eval.csv"), "w") as f:
+        f.write("\n".join(rows_eval))
+    return root
+
+
+def test_image_pair_dataset_and_loader(tmp_path):
+    root = _write_synthetic_dataset(str(tmp_path))
+    ds = ImagePairDataset(
+        os.path.join(root, "train.csv"), root, output_size=(32, 32)
+    )
+    assert len(ds) == 6
+    s = ds[0]
+    assert s["source_image"].shape == (3, 32, 32)
+    assert s["source_image"].dtype == np.float32
+    # normalized: roughly zero-mean
+    assert abs(float(s["source_image"].mean())) < 3.0
+
+    loader = DataLoader(ds, batch_size=4, shuffle=True, num_workers=2, seed=7)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert batches[0]["source_image"].shape == (4, 3, 32, 32)
+    assert batches[1]["source_image"].shape == (2, 3, 32, 32)
+    # deterministic reshuffle per epoch, different across epochs
+    order1 = [b["set"] for b in batches]
+    loader2 = DataLoader(ds, batch_size=4, shuffle=True, num_workers=2, seed=7)
+    b1 = list(loader2)
+    np.testing.assert_array_equal(batches[0]["source_image"], b1[0]["source_image"])
+
+
+def test_pf_pascal_dataset_scnet(tmp_path):
+    root = _write_synthetic_dataset(str(tmp_path))
+    ds = PFPascalDataset(
+        os.path.join(root, "eval.csv"), root, output_size=(32, 32),
+        pck_procedure="scnet",
+    )
+    s = ds[0]
+    assert s["source_points"].shape == (2, 20)
+    np.testing.assert_allclose(s["L_pck"], [224.0])
+    np.testing.assert_allclose(s["source_im_size"][:2], [224.0, 224.0])
+    # valid points rescaled into the 224 frame, padding stays -1
+    assert s["source_points"][0, :4].max() <= 224
+    assert np.all(s["source_points"][:, 4:] == -1)
+
+
+def test_pf_pascal_dataset_pf_procedure(tmp_path):
+    root = _write_synthetic_dataset(str(tmp_path))
+    ds = PFPascalDataset(
+        os.path.join(root, "eval.csv"), root, output_size=(32, 32),
+        pck_procedure="pf",
+    )
+    s = ds[0]
+    pts = s["source_points"]
+    n = int((pts[0] != -1).sum())
+    expect = max(
+        pts[0, :n].max() - pts[0, :n].min(), pts[1, :n].max() - pts[1, :n].min()
+    )
+    np.testing.assert_allclose(s["L_pck"], [expect])
+
+
+def test_loader_propagates_worker_errors():
+    """A dataset exception must surface in the consumer, not hang."""
+
+    class Broken:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i == 2:
+                raise ValueError("corrupt sample")
+            return {"x": np.zeros(3, np.float32)}
+
+    loader = DataLoader(Broken(), batch_size=2, num_workers=2)
+    with pytest.raises(ValueError, match="corrupt sample"):
+        list(loader)

@@ -1,0 +1,167 @@
+"""Model-level tests: backbone shapes, NCNet forward, training step, checkpoint."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models import (
+    BackboneConfig,
+    NCNetConfig,
+    backbone_init,
+    backbone_apply,
+    ncnet_init,
+    ncnet_forward,
+)
+from ncnet_tpu.training import (
+    create_train_state,
+    make_train_step,
+    save_checkpoint,
+    load_checkpoint,
+    pair_match_score,
+)
+
+TINY = NCNetConfig(
+    backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+    ncons_kernel_sizes=(3, 3),
+    ncons_channels=(4, 1),
+)
+
+
+def test_vgg_backbone_shape():
+    config = BackboneConfig(cnn="vgg", last_layer="pool4")
+    params = backbone_init(jax.random.PRNGKey(0), config)
+    x = jnp.zeros((1, 3, 64, 64))
+    out = backbone_apply(config, params, x)
+    assert out.shape == (1, 512, 4, 4)  # stride 16
+    assert config.out_channels == 512
+
+
+@pytest.mark.slow
+def test_resnet101_backbone_shape():
+    config = BackboneConfig(cnn="resnet101", last_layer="layer3")
+    params = backbone_init(jax.random.PRNGKey(0), config)
+    x = jnp.zeros((1, 3, 64, 64))
+    out = backbone_apply(config, params, x)
+    assert out.shape == (1, 1024, 4, 4)  # stride 16, 1024 ch
+    assert config.out_channels == 1024
+
+
+def test_ncnet_forward_shapes(rng):
+    params = ncnet_init(jax.random.PRNGKey(0), TINY)
+    src = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    corr, delta = ncnet_forward(TINY, params, src, tgt)
+    assert corr.shape == (2, 1, 4, 4, 4, 4)
+    assert delta is None
+
+
+def test_ncnet_forward_relocalization(rng):
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+        relocalization_k_size=2,
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    src = jnp.asarray(rng.randn(1, 3, 64, 64).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(1, 3, 64, 64).astype(np.float32))
+    corr, delta = ncnet_forward(config, params, src, tgt)
+    assert corr.shape == (1, 1, 4, 4, 4, 4)  # 8 -> pooled by 2
+    assert delta is not None and len(delta) == 4
+
+
+def test_train_step_decreases_loss(rng):
+    """A few steps on a fixed batch must reduce the weak loss."""
+    params = ncnet_init(jax.random.PRNGKey(0), TINY)
+    src = jnp.asarray(rng.randn(4, 3, 32, 32).astype(np.float32))
+    tgt = src + 0.05 * jnp.asarray(rng.randn(4, 3, 32, 32).astype(np.float32))
+
+    state, tx = create_train_state(params, learning_rate=2e-3)
+    train_step, eval_step = make_train_step(TINY, tx)
+
+    trainable, opt_state = state.trainable, state.opt_state
+    losses = []
+    for _ in range(8):
+        trainable, opt_state, loss = train_step(
+            trainable, state.frozen, opt_state, src, tgt
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_train_step_only_updates_ncons(rng):
+    params = ncnet_init(jax.random.PRNGKey(0), TINY)
+    state, tx = create_train_state(params)
+    assert set(state.trainable.keys()) == {"neigh_consensus"}
+    n_params = sum(x.size for x in jax.tree.leaves(state.trainable))
+    # tiny trainable head, as in the reference (~0.2M for the 5-5-5/16-16-1)
+    assert n_params < 1_000_000
+
+
+def test_checkpoint_roundtrip(tmp_path, rng):
+    params = ncnet_init(jax.random.PRNGKey(0), TINY)
+    state, tx = create_train_state(params)
+    path = save_checkpoint(
+        str(tmp_path), params, TINY, epoch=3,
+        opt_state=state.opt_state,
+        extra={"train_loss": [0.5, 0.4, 0.3]}, is_best=True,
+    )
+    restored = load_checkpoint(path, opt_state_template=state.opt_state)
+    assert restored["config"] == TINY
+    assert restored["meta"]["epoch"] == 3
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # best copy exists and loads
+    best = load_checkpoint(str(tmp_path / "best"))
+    assert best["meta"]["epoch"] == 3
+    # optimizer state restored
+    for a, b in zip(
+        jax.tree.leaves(state.opt_state), jax.tree.leaves(restored["opt_state"])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pair_match_score_prefers_diagonal(rng):
+    """A diagonal-dominant corr tensor must out-score a uniform one."""
+    fs = 4
+    eye = np.zeros((1, 1, fs, fs, fs, fs), np.float32)
+    for i in range(fs):
+        for j in range(fs):
+            eye[0, 0, i, j, i, j] = 1.0
+    uniform = np.ones_like(eye) * 0.1
+    s_eye = float(pair_match_score(jnp.asarray(eye)))
+    s_uni = float(pair_match_score(jnp.asarray(uniform)))
+    assert s_eye > s_uni
+
+
+def test_finetune_mask_excludes_bn_stats(rng):
+    """train_fe: BN running stats must never receive Adam updates."""
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.training import create_train_state, make_train_step
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="resnet50", last_layer="layer1"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    state, tx = create_train_state(params, train_fe=True, fe_finetune_blocks=1)
+    train_step, _ = make_train_step(config, tx)
+    src = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    new_t, _, _ = train_step(state.trainable, state.frozen, state.opt_state, src, tgt)
+
+    old_bb, new_bb = state.trainable["backbone"], new_t["backbone"]
+    last_block_old = old_bb["layer1"][-1]
+    last_block_new = new_bb["layer1"][-1]
+    # finetuned block: conv weights move, bn stats do not
+    assert not np.allclose(last_block_old["conv2"], last_block_new["conv2"])
+    np.testing.assert_array_equal(last_block_old["bn2"]["mean"], last_block_new["bn2"]["mean"])
+    np.testing.assert_array_equal(last_block_old["bn2"]["var"], last_block_new["bn2"]["var"])
+    # non-finetuned earlier block: fully frozen
+    np.testing.assert_array_equal(old_bb["conv1"], new_bb["conv1"])
+    np.testing.assert_array_equal(
+        np.asarray(old_bb["layer1"][0]["conv2"]), np.asarray(new_bb["layer1"][0]["conv2"])
+    )
