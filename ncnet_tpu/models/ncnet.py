@@ -50,6 +50,10 @@ class NCNetConfig:
     symmetric_mode: bool = True
     relocalization_k_size: int = 0
     half_precision: bool = False  # bf16 correlation + 4-D pipeline
+    # Fuse correlation+maxpool4d into one blockwise kernel so the pre-pool
+    # tensor never materializes (Pallas on TPU, slab-scan on CPU). Only
+    # takes effect when relocalization_k_size > 1 and batch == 1.
+    use_fused_corr_pool: bool = False
 
     @property
     def corr_dtype(self):
@@ -110,13 +114,26 @@ def ncnet_forward(
     """
     feat_a = extract_features(config, params, source_image)
     feat_b = extract_features(config, params, target_image)
-    corr4d = feature_correlation(
-        feat_a, feat_b, compute_dtype=jnp.bfloat16
-    ).astype(config.corr_dtype)
 
     delta4d = None
-    if config.relocalization_k_size > 1:
-        corr4d, delta4d = maxpool4d(corr4d, config.relocalization_k_size)
+    if (
+        config.relocalization_k_size > 1
+        and config.use_fused_corr_pool
+        and source_image.shape[0] == 1
+    ):
+        # Local import keeps jax.experimental.pallas off the import path of
+        # consumers that never take the fused branch.
+        from ..ops.pallas_kernels import fused_correlation_maxpool
+
+        corr4d, delta4d = fused_correlation_maxpool(
+            feat_a, feat_b, config.relocalization_k_size
+        )
+    else:
+        corr4d = feature_correlation(
+            feat_a, feat_b, compute_dtype=jnp.bfloat16
+        ).astype(config.corr_dtype)
+        if config.relocalization_k_size > 1:
+            corr4d, delta4d = maxpool4d(corr4d, config.relocalization_k_size)
 
     corr4d = match_pipeline(config, params, corr4d.astype(jnp.float32))
     return corr4d, delta4d

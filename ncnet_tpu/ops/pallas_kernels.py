@@ -1,0 +1,224 @@
+"""Pallas TPU kernels for the high-resolution correlation pipeline.
+
+The north-star op (SURVEY.md §7 item 5): **fused correlation + maxpool4d**.
+At InLoc resolution the pre-pool correlation tensor is ~9e8 elements
+(3.6 GB f32): the reference materializes it in fp16 and then pools
+(lib/model.py:269-272). Here each grid step computes one (A-cell-row x
+B-cell-tile) slab of the correlation on the MXU and immediately max-pools it
+in VMEM, writing only the pooled tensor + packed argmax offsets — the
+pre-pool tensor never exists in HBM. This removes ~2x full-tensor HBM
+round-trips and lifts the resolution ceiling from HBM size to compute.
+
+Layout strategy (Mosaic-friendly — no in-kernel transposes):
+the k^2 within-cell offsets are made *block-major* by a one-time host-side
+re-arrangement of the feature tensors:
+
+    A positions ordered (UA, m, VA):  row   = (u*k^2 + m) * VA + v
+    B positions ordered (n, cells):   col   =  n * TBc + t
+
+so pooling over the 16 (m, n) offset pairs is a max over k^2 x k^2 *contiguous
+sub-blocks* of the correlation tile — static slices + elementwise max,
+exactly what the VPU wants.
+
+A pure-XLA slab-wise fallback (`fused_correlation_maxpool_xla`) provides the
+same memory behavior on CPU and is the oracle for the kernel tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _arrange_a(fa, k):
+    """[c, IA, JA] -> [UA * k^2 * VA, c] with rows ordered (UA, m=(a,b), VA)."""
+    c, ia, ja = fa.shape
+    ua, va = ia // k, ja // k
+    x = fa.reshape(c, ua, k, va, k)  # c, u, a, v, b
+    x = jnp.transpose(x, (1, 2, 4, 3, 0))  # u, a, b, v, c
+    return x.reshape(ua * k * k * va, c)
+
+
+def _arrange_b(fb, k):
+    """[c, IB, JB] -> [k^2, WB*ZB, c] with dim0 the within-cell offset n=(c,d)."""
+    c, ib, jb = fb.shape
+    wb, zb = ib // k, jb // k
+    x = fb.reshape(c, wb, k, zb, k)  # c, w, coff, z, d
+    x = jnp.transpose(x, (2, 4, 1, 3, 0))  # coff, d, w, z, c
+    return x.reshape(k * k, wb * zb, c)
+
+
+def _decode_idx(idx, k):
+    """Packed offset (m*k^2 + n) -> (di_a, dj_a, di_b, dj_b), reference order."""
+    d = idx % k
+    c_ = (idx // k) % k
+    b = (idx // (k * k)) % k
+    a = idx // (k * k * k)
+    return a, b, c_, d
+
+
+def _corr_pool_kernel(kk: int, va: int, tbc: int, fa_ref, fb_ref, pooled_ref, idx_ref):
+    """One grid step: correlation slab on the MXU, pooled in VMEM.
+
+    fa_ref: [kk*va, c] — one A cell-row, offset-major rows.
+    fb_ref: [kk, tbc, c] — one B cell tile, offset-major leading dim.
+    pooled_ref/idx_ref: [va, tbc].
+    """
+    fa = fa_ref[:]
+    fb = fb_ref[:].reshape(kk * tbc, fa.shape[1])
+    corr = jax.lax.dot_general(
+        fa,
+        fb,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [kk*va, kk*tbc]
+
+    best = jnp.full((va, tbc), -jnp.inf, jnp.float32)
+    best_idx = jnp.zeros((va, tbc), jnp.int32)
+    for m in range(kk):
+        rows = corr[m * va : (m + 1) * va, :]
+        for n in range(kk):
+            sub = rows[:, n * tbc : (n + 1) * tbc]
+            off = m * kk + n
+            better = sub > best
+            best = jnp.where(better, sub, best)
+            best_idx = jnp.where(better, off, best_idx)
+    pooled_ref[:] = best
+    idx_ref[:] = best_idx
+
+
+def fused_correlation_maxpool_pallas(
+    feature_a,
+    feature_b,
+    k_size: int = 2,
+    tile_b_cells: int = 0,
+    interpret: bool = False,
+):
+    """Fused all-pairs correlation + 4-D max pool, Pallas TPU kernel.
+
+    Args:
+      feature_a: [1, c, IA, JA] (IA, JA divisible by k_size).
+      feature_b: [1, c, IB, JB].
+      k_size: pool factor (InLoc uses 2).
+      tile_b_cells: B-cell tile width (0 = auto: whole B cell rows,
+        targeting ~8 MB of VMEM).
+
+    Returns:
+      (pooled [1, 1, UA, VA, WB, ZB] float32,
+       (di_a, dj_a, di_b, dj_b) int32, same trailing shape) — identical
+      contract to feature_correlation -> ops.pool4d.maxpool4d.
+    """
+    if feature_a.shape[0] != 1:
+        raise ValueError("batch must be 1 (vmap/loop outside)")
+    k = k_size
+    kk = k * k
+    c = feature_a.shape[1]
+    ia, ja = feature_a.shape[2:]
+    ib, jb = feature_b.shape[2:]
+    ua, va = ia // k, ja // k
+    wb, zb = ib // k, jb // k
+    n_cells_b = wb * zb
+
+    if tile_b_cells == 0:
+        # Size the B tile from an explicit VMEM byte budget. Per B cell the
+        # step holds: fb block kk*c bf16, corr column kk*(kk*va) f32, and
+        # pooled+idx va*(4+4); the fa block is tile-independent.
+        budget = 10 * 1024 * 1024
+        fa_bytes = kk * va * c * 2
+        per_cell = kk * c * 2 + kk * kk * va * 4 + va * 8
+        max_cells = max((budget - fa_bytes) // per_cell, 1)
+        tile_b_cells = min(max_cells, n_cells_b)
+        while n_cells_b % tile_b_cells:
+            tile_b_cells -= 1
+    if n_cells_b % tile_b_cells:
+        raise ValueError(f"tile_b_cells {tile_b_cells} must divide {n_cells_b}")
+
+    fa_arr = _arrange_a(feature_a[0].astype(jnp.bfloat16), k)  # [ua*kk*va, c]
+    fb_arr = _arrange_b(feature_b[0].astype(jnp.bfloat16), k)  # [kk, cells, c]
+
+    grid = (ua, n_cells_b // tile_b_cells)
+    kernel = partial(_corr_pool_kernel, kk, va, tile_b_cells)
+    pooled, idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((kk * va, c), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (kk, tile_b_cells, c), lambda i, j: (0, j, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((va, tile_b_cells), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((va, tile_b_cells), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((ua * va, n_cells_b), jnp.float32),
+            jax.ShapeDtypeStruct((ua * va, n_cells_b), jnp.int32),
+        ],
+        interpret=interpret,
+    )(fa_arr, fb_arr)
+
+    pooled = pooled.reshape(1, 1, ua, va, wb, zb)
+    idx = idx.reshape(1, 1, ua, va, wb, zb)
+    deltas = _decode_idx(idx, k)
+    return pooled, deltas
+
+
+def fused_correlation_maxpool_xla(feature_a, feature_b, k_size: int = 2):
+    """Slab-wise XLA fallback with the same never-materialize property.
+
+    Scans over A cell-rows: each step computes a [k*JA, IB*JB] correlation
+    slab and pools it, so peak memory is one slab instead of the full 4-D
+    tensor. Same outputs as the Pallas kernel; used on CPU and as the test
+    oracle.
+    """
+    if feature_a.shape[0] != 1:
+        raise ValueError("batch must be 1")
+    k = k_size
+    kk = k * k
+    c = feature_a.shape[1]
+    ia, ja = feature_a.shape[2:]
+    ib, jb = feature_b.shape[2:]
+    ua, va = ia // k, ja // k
+    wb, zb = ib // k, jb // k
+
+    fa_rows = _arrange_a(feature_a[0], k).reshape(ua, kk * va, c)
+    fb_arr = _arrange_b(feature_b[0], k)  # [kk, cells, c]
+    n_cells_b = wb * zb
+
+    def row_step(_, fa_row):  # fa_row: [kk*va, c]
+        corr = jnp.einsum(
+            "mc,knc->mkn",
+            fa_row.astype(jnp.bfloat16),
+            fb_arr.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )  # [kk*va, kk, cells]
+        corr = corr.reshape(kk, va, kk, n_cells_b)
+        best = jnp.max(jnp.max(corr, axis=2), axis=0)
+        flat_off = (
+            jnp.arange(kk)[:, None, None, None] * kk + jnp.arange(kk)[None, None, :, None]
+        )
+        is_max = corr == jnp.max(corr, axis=(0, 2), keepdims=True)
+        idx = jnp.min(
+            jnp.where(is_max, flat_off, kk * kk), axis=(0, 2)
+        ).astype(jnp.int32)
+        return None, (best, idx)
+
+    _, (pooled, idx) = lax.scan(row_step, None, fa_rows)
+    pooled = pooled.reshape(1, 1, ua, va, wb, zb)
+    idx = idx.reshape(1, 1, ua, va, wb, zb)
+    return pooled, _decode_idx(idx, k)
+
+
+def fused_correlation_maxpool(feature_a, feature_b, k_size: int = 2):
+    """Dispatch: Pallas kernel on TPU, slab-wise XLA elsewhere."""
+    platform = jax.devices()[0].platform
+    if platform == "tpu":
+        return fused_correlation_maxpool_pallas(feature_a, feature_b, k_size)
+    return fused_correlation_maxpool_xla(feature_a, feature_b, k_size)
