@@ -14,6 +14,17 @@ from .grid import (
     resize_bilinear,
 )
 from .tps import TpsGrid, tps_point_transform, affine_point_transform
+from .transform import (
+    make_sampling_grid,
+    geometric_transform,
+    compose_aff_tps_grid,
+    composed_transform,
+    symmetric_image_pad,
+    synth_pair,
+    synth_two_pair,
+    synth_two_stage,
+    synth_two_stage_two_pair,
+)
 from .flow_io import (
     read_flo_file,
     write_flo_file,
@@ -35,6 +46,15 @@ __all__ = [
     "TpsGrid",
     "tps_point_transform",
     "affine_point_transform",
+    "make_sampling_grid",
+    "geometric_transform",
+    "compose_aff_tps_grid",
+    "composed_transform",
+    "symmetric_image_pad",
+    "synth_pair",
+    "synth_two_pair",
+    "synth_two_stage",
+    "synth_two_stage_two_pair",
     "read_flo_file",
     "write_flo_file",
     "flow_to_sampling_grid",

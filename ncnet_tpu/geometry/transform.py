@@ -1,0 +1,384 @@
+"""Unified geometric warper + synthetic training-pair generators.
+
+TPU-native re-design of the reference's transformation stack
+(geotnf/transformation.py:14-368):
+
+* `GeometricTnf` (geotnf/transformation.py:74-140) becomes the pure function
+  `geometric_transform` plus the grid factory `make_sampling_grid` — no
+  mutable Module state, no `use_cuda` flags; everything jits and shards.
+* `ComposedGeometricTnf` (geotnf/transformation.py:14-72) becomes
+  `compose_aff_tps_grid` / `composed_transform`: the affine and TPS grids are
+  composed by bilinearly sampling the affine grid (as a 2-channel image) at
+  the TPS grid positions, with 1e10 out-of-bounds sentinels exactly like the
+  reference so downstream `grid_sample` zero-pads composed OOB regions.
+* The `SynthPairTnf` family (geotnf/transformation.py:144-368) becomes the
+  functional generators `synth_pair` / `synth_two_pair` / `synth_two_stage` /
+  `synth_two_stage_two_pair`: image batch + theta batch in, training-pair
+  dict out. Randomness lives with the caller (jax.random / dataset RNG), not
+  hidden module state.
+
+Semantics parity notes (pinned by tests/test_transform.py):
+* `offset_factor` divides the base grid before the transform and multiplies
+  the resulting grid after it (geotnf/transformation.py:95-97,128-129) — for
+  an affine map this scales only the translation column.
+* `padding_factor`/`crop_factor` scale the final sampling grid
+  (geotnf/transformation.py:124-126), matching the symmetric-padding +
+  center-crop training recipe.
+* `symmetric_image_pad` reflect-pads by `int(dim * padding_factor)` on each
+  side, mirroring edge-inclusive ("symmetric" mode) like the index-select
+  construction at geotnf/transformation.py:207-223.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .grid import affine_grid, grid_sample, identity_grid
+from .tps import TpsGrid
+
+OOB_SENTINEL = 1e10
+
+
+def make_sampling_grid(
+    theta,
+    out_h: int,
+    out_w: int,
+    geometric_model: str = "affine",
+    tps_grid_size: int = 3,
+    tps_reg_factor: float = 0.0,
+    offset_factor: Optional[float] = None,
+):
+    """Sampling grid [b, out_h, out_w, 2] for affine or TPS parameters.
+
+    theta: [b, 2, 3] / [b, 6] for affine; [b, 2*grid_size^2] for TPS.
+    """
+    if geometric_model == "affine":
+        theta = jnp.reshape(theta, (-1, 2, 3))
+        if offset_factor is None:
+            return affine_grid(theta, out_h, out_w)
+        # Base grid divided by offset_factor, result multiplied back
+        # (geotnf/transformation.py:95-97,128-129): net effect is the
+        # translation column scaled by offset_factor.
+        scaled = theta.at[:, :, 2].multiply(offset_factor)
+        return affine_grid(scaled, out_h, out_w)
+    if geometric_model == "tps":
+        tps = TpsGrid(grid_size=tps_grid_size, reg_factor=tps_reg_factor)
+        grid = tps.grid(theta, out_h, out_w)
+        if offset_factor is not None:
+            # grid points pre-divided then output post-multiplied: for the
+            # (nonlinear) TPS map these do not cancel, so apply literally.
+            xs = jnp.linspace(-1.0, 1.0, out_w) / offset_factor
+            ys = jnp.linspace(-1.0, 1.0, out_h) / offset_factor
+            gx, gy = jnp.meshgrid(xs, ys)
+            pts = jnp.stack([gx, gy], axis=-1)
+            grid = tps.apply(theta, pts) * offset_factor
+        return grid
+    raise ValueError(f"unknown geometric_model {geometric_model!r}")
+
+
+def geometric_transform(
+    image,
+    theta=None,
+    geometric_model: str = "affine",
+    out_h: int = 240,
+    out_w: int = 240,
+    padding_factor: float = 1.0,
+    crop_factor: float = 1.0,
+    tps_grid_size: int = 3,
+    tps_reg_factor: float = 0.0,
+    offset_factor: Optional[float] = None,
+    return_sampling_grid: bool = False,
+):
+    """Warp an NCHW batch by affine/TPS params (ref GeometricTnf.__call__).
+
+    With `theta=None` this is a corner-aligned bilinear resize scaled by
+    `padding_factor * crop_factor` — the identity path the reference uses
+    both for dataset resizing and for the synth-pair center crop.
+    """
+    b = 1 if image is None else image.shape[0]
+    if theta is None:
+        grid = identity_grid(b, out_h, out_w)
+    else:
+        grid = make_sampling_grid(
+            theta,
+            out_h,
+            out_w,
+            geometric_model=geometric_model,
+            tps_grid_size=tps_grid_size,
+            tps_reg_factor=tps_reg_factor,
+            offset_factor=offset_factor,
+        )
+    if padding_factor != 1.0 or crop_factor != 1.0:
+        grid = grid * (padding_factor * crop_factor)
+    if image is None:
+        return grid
+    warped = grid_sample(image, grid.astype(image.dtype))
+    if return_sampling_grid:
+        return warped, grid
+    return warped
+
+
+def _mask_oob(grid):
+    """Replace grid rows whose (x, y) fall outside (-1, 1) with -1e10.
+
+    Matches the sentinel construction at geotnf/transformation.py:54-58: the
+    composed grid then samples far outside the image and zero-pads.
+    """
+    inb = (
+        (grid[..., 0] > -1.0)
+        & (grid[..., 0] < 1.0)
+        & (grid[..., 1] > -1.0)
+        & (grid[..., 1] < 1.0)
+    )[..., None]
+    return jnp.where(inb, grid, -OOB_SENTINEL)
+
+
+def compose_aff_tps_grid(
+    theta_aff,
+    theta_tps,
+    out_h: int = 240,
+    out_w: int = 240,
+    tps_grid_size: int = 3,
+    tps_reg_factor: float = 0.0,
+    padding_crop_factor: Optional[float] = None,
+):
+    """Composed affine∘TPS sampling grid (ref ComposedGeometricTnf).
+
+    The affine grid (as a 2-channel image) is bilinearly sampled at the TPS
+    grid positions; out-of-bounds regions of either stage are pushed to the
+    1e10 sentinel so the final image sample zero-pads them.
+    """
+    aff_offset = padding_crop_factor if padding_crop_factor is not None else 1.0
+    grid_aff = make_sampling_grid(
+        theta_aff, out_h, out_w, "affine", offset_factor=aff_offset
+    )
+    grid_tps = make_sampling_grid(
+        theta_tps,
+        out_h,
+        out_w,
+        "tps",
+        tps_grid_size=tps_grid_size,
+        tps_reg_factor=tps_reg_factor,
+    )
+    if padding_crop_factor is not None:
+        grid_tps = grid_tps * padding_crop_factor
+
+    grid_aff_m = _mask_oob(grid_aff)
+    # Sample the affine grid (channels-first [b, 2, H, W]) at TPS positions.
+    as_image = jnp.moveaxis(grid_aff_m, -1, 1)
+    composed = jnp.moveaxis(grid_sample(as_image, grid_tps), 1, -1)
+    return _mask_oob_like(grid_tps, composed)
+
+
+def _mask_oob_like(reference_grid, grid):
+    """Sentinel-mask `grid` where `reference_grid` is out of bounds."""
+    inb = (
+        (reference_grid[..., 0] > -1.0)
+        & (reference_grid[..., 0] < 1.0)
+        & (reference_grid[..., 1] > -1.0)
+        & (reference_grid[..., 1] < 1.0)
+    )[..., None]
+    return jnp.where(inb, grid, -OOB_SENTINEL)
+
+
+def composed_transform(
+    image,
+    theta_aff,
+    theta_tps,
+    out_h: int = 240,
+    out_w: int = 240,
+    tps_grid_size: int = 3,
+    tps_reg_factor: float = 0.0,
+    padding_crop_factor: Optional[float] = None,
+):
+    """Warp an NCHW batch by the composed affine+TPS transform."""
+    grid = compose_aff_tps_grid(
+        theta_aff,
+        theta_tps,
+        out_h,
+        out_w,
+        tps_grid_size=tps_grid_size,
+        tps_reg_factor=tps_reg_factor,
+        padding_crop_factor=padding_crop_factor,
+    )
+    return grid_sample(image, grid.astype(image.dtype))
+
+
+def symmetric_image_pad(image, padding_factor: float):
+    """Mirror-pad an NCHW batch by int(dim*padding_factor) per side."""
+    h, w = image.shape[2], image.shape[3]
+    pad_h, pad_w = int(h * padding_factor), int(w * padding_factor)
+    left = image[:, :, :, :pad_w][:, :, :, ::-1]
+    right = image[:, :, :, w - pad_w :][:, :, :, ::-1]
+    image = jnp.concatenate([left, image, right], axis=3)
+    top = image[:, :, :pad_h, :][:, :, ::-1, :]
+    bottom = image[:, :, h - pad_h :, :][:, :, ::-1, :]
+    return jnp.concatenate([top, image, bottom], axis=2)
+
+
+def _crop_and_warp(image, padding_factor, crop_factor, out_h, out_w):
+    """Shared preamble of every synth generator: pad + identity center crop."""
+    padded = symmetric_image_pad(image, padding_factor)
+    cropped = geometric_transform(
+        padded,
+        None,
+        out_h=out_h,
+        out_w=out_w,
+        padding_factor=padding_factor,
+        crop_factor=crop_factor,
+    )
+    return padded, cropped
+
+
+def synth_pair(
+    image,
+    theta,
+    geometric_model: str = "affine",
+    supervision: str = "strong",
+    crop_factor: float = 9 / 16,
+    output_size=(240, 240),
+    padding_factor: float = 0.5,
+    tps_grid_size: int = 3,
+):
+    """Synthetic training pair from one image batch (ref SynthPairTnf).
+
+    strong: {source, target=warp(source-region), theta_GT}.
+    weak: first half of the batch are positive pairs (source, warped source),
+    second half negatives (source_i, crop_j from the other half) — the
+    index-shuffle construction of geotnf/transformation.py:195-205.
+    """
+    out_h, out_w = output_size
+    padded, cropped = _crop_and_warp(
+        image, padding_factor, crop_factor, out_h, out_w
+    )
+    warped = geometric_transform(
+        padded,
+        theta,
+        geometric_model=geometric_model,
+        out_h=out_h,
+        out_w=out_w,
+        padding_factor=padding_factor,
+        crop_factor=crop_factor,
+        tps_grid_size=tps_grid_size,
+    )
+    if supervision == "strong":
+        return {"source_image": cropped, "target_image": warped, "theta_GT": theta}
+    if supervision == "weak":
+        b = image.shape[0]
+        half = b // 2
+        source = jnp.concatenate([cropped[:half], cropped[:half]], axis=0)
+        target = jnp.concatenate([warped[:half], cropped[half:]], axis=0)
+        return {"source_image": source, "target_image": target, "theta_GT": theta}
+    raise ValueError(f"unknown supervision {supervision!r}")
+
+
+def synth_two_pair(
+    image,
+    theta,
+    crop_factor: float = 9 / 16,
+    output_size=(240, 240),
+    padding_factor: float = 0.5,
+    tps_grid_size: int = 3,
+):
+    """One source, two targets (affine and TPS) — ref SynthTwoPairTnf.
+
+    theta: [b, 6 + 2*grid_size^2], affine params first.
+    """
+    out_h, out_w = output_size
+    theta_aff, theta_tps = theta[:, :6], theta[:, 6:]
+    padded, cropped = _crop_and_warp(
+        image, padding_factor, crop_factor, out_h, out_w
+    )
+    kwargs = dict(
+        out_h=out_h,
+        out_w=out_w,
+        padding_factor=padding_factor,
+        crop_factor=crop_factor,
+    )
+    warped_aff = geometric_transform(padded, theta_aff, "affine", **kwargs)
+    warped_tps = geometric_transform(
+        padded, theta_tps, "tps", tps_grid_size=tps_grid_size, **kwargs
+    )
+    return {
+        "source_image": cropped,
+        "target_image_aff": warped_aff,
+        "target_image_tps": warped_tps,
+        "theta_GT_aff": theta_aff,
+        "theta_GT_tps": theta_tps,
+    }
+
+
+def synth_two_stage(
+    image,
+    theta,
+    crop_factor: float = 9 / 16,
+    output_size=(240, 240),
+    padding_factor: float = 0.5,
+    tps_grid_size: int = 3,
+):
+    """Source + composed affine∘TPS target — ref SynthTwoStageTnf."""
+    out_h, out_w = output_size
+    theta_aff, theta_tps = theta[:, :6], theta[:, 6:]
+    padded, cropped = _crop_and_warp(
+        image, padding_factor, crop_factor, out_h, out_w
+    )
+    warped = composed_transform(
+        padded,
+        theta_aff,
+        theta_tps,
+        out_h=out_h,
+        out_w=out_w,
+        tps_grid_size=tps_grid_size,
+        padding_crop_factor=padding_factor * crop_factor,
+    )
+    return {
+        "source_image": cropped,
+        "target_image": warped,
+        "theta_GT_aff": theta_aff,
+        "theta_GT_tps": theta_tps,
+    }
+
+
+def synth_two_stage_two_pair(
+    image,
+    theta,
+    crop_factor: float = 9 / 16,
+    output_size=(240, 240),
+    padding_factor: float = 0.5,
+    tps_grid_size: int = 3,
+):
+    """Affine pair + TPS pair sharing one composed target — ref
+    SynthTwoStageTwoPairTnf (geotnf/transformation.py:264-320)."""
+    out_h, out_w = output_size
+    theta_aff, theta_tps = theta[:, :6], theta[:, 6:]
+    padded, cropped = _crop_and_warp(
+        image, padding_factor, crop_factor, out_h, out_w
+    )
+    kwargs = dict(out_h=out_h, out_w=out_w)
+    target_tps = composed_transform(
+        padded,
+        theta_aff,
+        theta_tps,
+        tps_grid_size=tps_grid_size,
+        padding_crop_factor=padding_factor * crop_factor,
+        **kwargs,
+    )
+    target_aff = geometric_transform(
+        padded,
+        theta_aff,
+        "affine",
+        padding_factor=padding_factor,
+        crop_factor=crop_factor,
+        **kwargs,
+    )
+    source_tps = geometric_transform(cropped, theta_aff, "affine", **kwargs)
+    return {
+        "source_image_aff": cropped,
+        "target_image_aff": target_aff,
+        "source_image_tps": source_tps,
+        "target_image_tps": target_tps,
+        "theta_GT_aff": theta_aff,
+        "theta_GT_tps": theta_tps,
+    }
