@@ -65,12 +65,16 @@ class TpsGrid:
         self.li_w = jnp.asarray(li[: self.n, : self.n])  # [N, N]
         self.li_a = jnp.asarray(li[self.n :, : self.n])  # [3, N]
 
-    def apply(self, theta, points):
+    def apply(self, theta, points, batched=None):
         """Warp `points` ([..., 2] normalized (x, y)) by TPS params `theta`.
 
         Args:
           theta: [b, 2N] (or [b, N, 2]-reshapable) target control coords.
           points: [b, ..., 2] or [...,2] points to transform (broadcast over b).
+          batched: whether `points` carries a leading batch dim. None infers
+            it from the shape — ambiguous exactly when points.shape[0] == b
+            for an unbatched rank>=3 point grid, so internal callers that
+            know pass it explicitly.
 
         Returns:
           [b, ..., 2] warped points.
@@ -83,7 +87,9 @@ class TpsGrid:
 
         if points.shape[-1] != 2:
             raise ValueError("points must have trailing dim 2")
-        if points.ndim >= 3 and points.shape[0] == b:
+        if batched is None:
+            batched = points.ndim >= 3 and points.shape[0] == b
+        if batched:
             pts = points  # already batched [b, ..., 2]
         else:
             pts = jnp.broadcast_to(points, (b,) + points.shape)
@@ -111,14 +117,14 @@ class TpsGrid:
         ys = jnp.linspace(-1.0, 1.0, out_h)
         gx, gy = jnp.meshgrid(xs, ys)
         pts = jnp.stack([gx, gy], axis=-1)  # [H, W, 2]
-        return self.apply(theta, pts)
+        return self.apply(theta, pts, batched=False)
 
 
 def tps_point_transform(theta, points, grid_size: int = 3, reg_factor: float = 0.0):
     """Warp [b, 2, n] point sets with TPS (parity: geotnf/point_tnf.py:24-32)."""
     tps = TpsGrid(grid_size=grid_size, reg_factor=reg_factor)
     pts = jnp.swapaxes(points, 1, 2)  # [b, n, 2]
-    warped = tps.apply(theta, pts)
+    warped = tps.apply(theta, pts, batched=True)
     return jnp.swapaxes(warped, 1, 2)
 
 

@@ -73,7 +73,7 @@ def make_sampling_grid(
             ys = jnp.linspace(-1.0, 1.0, out_h) / offset_factor
             gx, gy = jnp.meshgrid(xs, ys)
             pts = jnp.stack([gx, gy], axis=-1)
-            grid = tps.apply(theta, pts) * offset_factor
+            grid = tps.apply(theta, pts, batched=False) * offset_factor
         return grid
     raise ValueError(f"unknown geometric_model {geometric_model!r}")
 
@@ -126,13 +126,7 @@ def _mask_oob(grid):
     Matches the sentinel construction at geotnf/transformation.py:54-58: the
     composed grid then samples far outside the image and zero-pads.
     """
-    inb = (
-        (grid[..., 0] > -1.0)
-        & (grid[..., 0] < 1.0)
-        & (grid[..., 1] > -1.0)
-        & (grid[..., 1] < 1.0)
-    )[..., None]
-    return jnp.where(inb, grid, -OOB_SENTINEL)
+    return _mask_oob_like(grid, grid)
 
 
 def compose_aff_tps_grid(
@@ -267,6 +261,11 @@ def synth_pair(
         return {"source_image": cropped, "target_image": warped, "theta_GT": theta}
     if supervision == "weak":
         b = image.shape[0]
+        if b % 2:
+            raise ValueError(
+                "weak supervision pairs the batch halves; batch size must "
+                f"be even, got {b}"
+            )
         half = b // 2
         source = jnp.concatenate([cropped[:half], cropped[:half]], axis=0)
         target = jnp.concatenate([warped[:half], cropped[half:]], axis=0)

@@ -126,7 +126,10 @@ def ncnet_forward(
         from ..ops.pallas_kernels import fused_correlation_maxpool
 
         corr4d, delta4d = fused_correlation_maxpool(
-            feat_a, feat_b, config.relocalization_k_size
+            feat_a,
+            feat_b,
+            config.relocalization_k_size,
+            corr_dtype=config.corr_dtype,
         )
     else:
         corr4d = feature_correlation(

@@ -63,34 +63,50 @@ def _decode_idx(idx, k):
     return a, b, c_, d
 
 
-def _corr_pool_kernel(kk: int, va: int, tbc: int, fa_ref, fb_ref, pooled_ref, idx_ref):
+def _corr_pool_kernel(
+    kk: int, va: int, tbc: int, out_dtype, fa_ref, fb_ref, pooled_ref, idx_ref
+):
     """One grid step: correlation slab on the MXU, pooled in VMEM.
 
-    fa_ref: [kk*va, c] — one A cell-row, offset-major rows.
-    fb_ref: [kk, tbc, c] — one B cell tile, offset-major leading dim.
-    pooled_ref/idx_ref: [va, tbc].
-    """
-    fa = fa_ref[:]
-    fb = fb_ref[:].reshape(kk * tbc, fa.shape[1])
-    corr = jax.lax.dot_general(
-        fa,
-        fb,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [kk*va, kk*tbc]
+    fa_ref: [1, kk, va, c] — one A cell-row, within-cell offset m leading.
+    fb_ref: [kk, tbc, c] — one B cell tile, within-cell offset n leading.
+    pooled_ref/idx_ref: [1, va, tbc].
 
-    best = jnp.full((va, tbc), -jnp.inf, jnp.float32)
+    One dot per (m, n) offset pair: every [va, tbc] sub-slab then starts at
+    vector offset 0, so the compare/select chain never needs a Mosaic
+    relayout (strided sub-slices of one big [kk*va, kk*tbc] product are
+    sublane-misaligned whenever va % 8 != 0 and fail to compile).
+    """
+
+    def slab(m, n):
+        prod = jax.lax.dot_general(
+            fa_ref[0, m],
+            fb_ref[n],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [va, tbc]
+        # Round through the storage dtype for bit-parity with the unfused
+        # corr.astype(corr_dtype) -> maxpool4d formulation, but carry f32:
+        # the VPU has no sub-f32 vector compare, and comparing the rounded
+        # values in f32 yields the identical order.
+        return prod.astype(out_dtype).astype(jnp.float32)
+
+    best = slab(0, 0)
     best_idx = jnp.zeros((va, tbc), jnp.int32)
     for m in range(kk):
-        rows = corr[m * va : (m + 1) * va, :]
         for n in range(kk):
-            sub = rows[:, n * tbc : (n + 1) * tbc]
-            off = m * kk + n
-            better = sub > best
-            best = jnp.where(better, sub, best)
-            best_idx = jnp.where(better, off, best_idx)
-    pooled_ref[:] = best
-    idx_ref[:] = best_idx
+            if m == 0 and n == 0:
+                continue
+            sub = slab(m, n)
+            # Arithmetic select: jnp.where with a splat-constant branch asks
+            # Mosaic to relayout the i1 mask to a replicated layout, which
+            # is unsupported. Strict '>' keeps first-wins tie-breaking
+            # (parity with maxpool4d's min-over-argmax decode).
+            sel = (sub > best).astype(jnp.int32)
+            best_idx = sel * (m * kk + n) + (1 - sel) * best_idx
+            best = jnp.maximum(sub, best)
+    pooled_ref[0] = best.astype(out_dtype)
+    idx_ref[0] = best_idx
 
 
 def fused_correlation_maxpool_pallas(
@@ -99,6 +115,7 @@ def fused_correlation_maxpool_pallas(
     k_size: int = 2,
     tile_b_cells: int = 0,
     interpret: bool = False,
+    corr_dtype=jnp.float32,
 ):
     """Fused all-pairs correlation + 4-D max pool, Pallas TPU kernel.
 
@@ -106,11 +123,16 @@ def fused_correlation_maxpool_pallas(
       feature_a: [1, c, IA, JA] (IA, JA divisible by k_size).
       feature_b: [1, c, IB, JB].
       k_size: pool factor (InLoc uses 2).
-      tile_b_cells: B-cell tile width (0 = auto: whole B cell rows,
-        targeting ~8 MB of VMEM).
+      tile_b_cells: B-cell tile width (0 = auto: a multiple of 128 — the
+        Mosaic lane-divisibility requirement — sized against a 6 MB VMEM
+        budget). The last tile may be padded — each pooled cell depends only
+        on its own columns, so padding never contaminates real outputs.
+      corr_dtype: storage dtype the pooling runs in (bf16 for the
+        half-precision InLoc config — parity with the unfused
+        corr.astype -> maxpool4d path).
 
     Returns:
-      (pooled [1, 1, UA, VA, WB, ZB] float32,
+      (pooled [1, 1, UA, VA, WB, ZB] corr_dtype,
        (di_a, dj_a, di_b, dj_b) int32, same trailing shape) — identical
       contract to feature_correlation -> ops.pool4d.maxpool4d.
     """
@@ -127,39 +149,57 @@ def fused_correlation_maxpool_pallas(
 
     if tile_b_cells == 0:
         # Size the B tile from an explicit VMEM byte budget. Per B cell the
-        # step holds: fb block kk*c bf16, corr column kk*(kk*va) f32, and
-        # pooled+idx va*(4+4); the fa block is tile-independent.
-        budget = 10 * 1024 * 1024
+        # step holds the fb block (kk*c bf16, double-buffered across grid
+        # steps), one [va, .] f32 slab + best/best_idx accumulators, and the
+        # double-buffered pooled+idx output blocks; the fa block is
+        # tile-independent. 6 MB empirically clears the 16 MB scoped-VMEM
+        # limit with Mosaic's buffering overheads included.
+        budget = 6 * 1024 * 1024
         fa_bytes = kk * va * c * 2
         per_cell = kk * c * 2 + kk * kk * va * 4 + va * 8
-        max_cells = max((budget - fa_bytes) // per_cell, 1)
-        tile_b_cells = min(max_cells, n_cells_b)
-        while n_cells_b % tile_b_cells:
-            tile_b_cells -= 1
-    if n_cells_b % tile_b_cells:
-        raise ValueError(f"tile_b_cells {tile_b_cells} must divide {n_cells_b}")
+        max_cells = max((budget - fa_bytes) // per_cell, 128)
+        # Mosaic needs the lane (last output) dim divisible by 128 unless it
+        # spans the whole array; grid uses cdiv so a ragged tail is padded.
+        tile_b_cells = min(max_cells - max_cells % 128, n_cells_b)
+    if not interpret and tile_b_cells < n_cells_b and tile_b_cells % 128:
+        # Mosaic-only constraint; the interpreter (CPU tests) has no tiling.
+        raise ValueError(
+            f"tile_b_cells {tile_b_cells} must be a multiple of 128 (or span "
+            f"all {n_cells_b} B cells)"
+        )
 
-    fa_arr = _arrange_a(feature_a[0].astype(jnp.bfloat16), k)  # [ua*kk*va, c]
-    fb_arr = _arrange_b(feature_b[0].astype(jnp.bfloat16), k)  # [kk, cells, c]
+    # [ua, kk, va, c] / [kk, cells, c]: offset-major leading dims so every
+    # block's trailing two dims either match the array dims or meet the
+    # (8, 128) tiling rule, and the kernel indexes offsets without slicing.
+    fa_arr = _arrange_a(feature_a[0].astype(jnp.bfloat16), k).reshape(
+        ua, kk, va, c
+    )
+    fb_arr = _arrange_b(feature_b[0].astype(jnp.bfloat16), k)
 
-    grid = (ua, n_cells_b // tile_b_cells)
-    kernel = partial(_corr_pool_kernel, kk, va, tile_b_cells)
+    grid = (ua, pl.cdiv(n_cells_b, tile_b_cells))
+    kernel = partial(_corr_pool_kernel, kk, va, tile_b_cells, corr_dtype)
     pooled, idx = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((kk * va, c), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, kk, va, c), lambda i, j: (i, 0, 0, 0), memory_space=pltpu.VMEM
+            ),
             pl.BlockSpec(
                 (kk, tile_b_cells, c), lambda i, j: (0, j, 0), memory_space=pltpu.VMEM
             ),
         ],
         out_specs=[
-            pl.BlockSpec((va, tile_b_cells), lambda i, j: (i, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((va, tile_b_cells), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, va, tile_b_cells), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, va, tile_b_cells), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
+            ),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((ua * va, n_cells_b), jnp.float32),
-            jax.ShapeDtypeStruct((ua * va, n_cells_b), jnp.int32),
+            jax.ShapeDtypeStruct((ua, va, n_cells_b), corr_dtype),
+            jax.ShapeDtypeStruct((ua, va, n_cells_b), jnp.int32),
         ],
         interpret=interpret,
     )(fa_arr, fb_arr)
@@ -170,7 +210,9 @@ def fused_correlation_maxpool_pallas(
     return pooled, deltas
 
 
-def fused_correlation_maxpool_xla(feature_a, feature_b, k_size: int = 2):
+def fused_correlation_maxpool_xla(
+    feature_a, feature_b, k_size: int = 2, corr_dtype=jnp.float32
+):
     """Slab-wise XLA fallback with the same never-materialize property.
 
     Scans over A cell-rows: each step computes a [k*JA, IB*JB] correlation
@@ -188,22 +230,28 @@ def fused_correlation_maxpool_xla(feature_a, feature_b, k_size: int = 2):
     ua, va = ia // k, ja // k
     wb, zb = ib // k, jb // k
 
-    fa_rows = _arrange_a(feature_a[0], k).reshape(ua, kk * va, c)
-    fb_arr = _arrange_b(feature_b[0], k)  # [kk, cells, c]
+    # Loop invariants live outside the scan body: XLA does not hoist
+    # computation out of the while-loop, so the bf16 casts and the offset
+    # table are built exactly once.
+    fa_rows = _arrange_a(feature_a[0].astype(jnp.bfloat16), k).reshape(
+        ua, kk * va, c
+    )
+    fb_arr = _arrange_b(feature_b[0].astype(jnp.bfloat16), k)  # [kk, cells, c]
     n_cells_b = wb * zb
+    flat_off = (
+        jnp.arange(kk)[:, None, None, None] * kk
+        + jnp.arange(kk)[None, None, :, None]
+    )
 
     def row_step(_, fa_row):  # fa_row: [kk*va, c]
         corr = jnp.einsum(
             "mc,knc->mkn",
-            fa_row.astype(jnp.bfloat16),
-            fb_arr.astype(jnp.bfloat16),
+            fa_row,
+            fb_arr,
             preferred_element_type=jnp.float32,
         )  # [kk*va, kk, cells]
-        corr = corr.reshape(kk, va, kk, n_cells_b)
+        corr = corr.astype(corr_dtype).reshape(kk, va, kk, n_cells_b)
         best = jnp.max(jnp.max(corr, axis=2), axis=0)
-        flat_off = (
-            jnp.arange(kk)[:, None, None, None] * kk + jnp.arange(kk)[None, None, :, None]
-        )
         is_max = corr == jnp.max(corr, axis=(0, 2), keepdims=True)
         idx = jnp.min(
             jnp.where(is_max, flat_off, kk * kk), axis=(0, 2)
@@ -216,9 +264,24 @@ def fused_correlation_maxpool_xla(feature_a, feature_b, k_size: int = 2):
     return pooled, _decode_idx(idx, k)
 
 
-def fused_correlation_maxpool(feature_a, feature_b, k_size: int = 2):
-    """Dispatch: Pallas kernel on TPU, slab-wise XLA elsewhere."""
-    platform = jax.devices()[0].platform
-    if platform == "tpu":
-        return fused_correlation_maxpool_pallas(feature_a, feature_b, k_size)
-    return fused_correlation_maxpool_xla(feature_a, feature_b, k_size)
+def fused_correlation_maxpool(
+    feature_a, feature_b, k_size: int = 2, corr_dtype=jnp.float32
+):
+    """Dispatch on the *lowering* platform: Pallas on TPU, slab-scan XLA
+    elsewhere.
+
+    `lax.platform_dependent` resolves when the surrounding jit is lowered, so
+    a computation explicitly placed on CPU of a TPU host still gets the XLA
+    path (device-list sniffing would pick the Pallas kernel and fail to
+    lower).
+    """
+    return jax.lax.platform_dependent(
+        feature_a,
+        feature_b,
+        tpu=partial(
+            fused_correlation_maxpool_pallas, k_size=k_size, corr_dtype=corr_dtype
+        ),
+        default=partial(
+            fused_correlation_maxpool_xla, k_size=k_size, corr_dtype=corr_dtype
+        ),
+    )

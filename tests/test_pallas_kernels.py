@@ -65,6 +65,23 @@ def test_fused_pallas_tiling(rng):
         np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
 
 
+def test_fused_pallas_ragged_tail_tile(rng):
+    """A tile width that does not divide the B cell count: the padded tail
+    block must not contaminate real outputs."""
+    k = 2
+    fa = jnp.asarray(rng.randn(1, 8, 4, 4).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 8, 8, 8).astype(np.float32))  # 16 B cells
+    ref_pooled, ref_deltas = _oracle(fa, fb, k)
+    pooled, deltas = fused_correlation_maxpool_pallas(
+        fa, fb, k, tile_b_cells=6, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled), np.asarray(ref_pooled), atol=1e-5
+    )
+    for d, rd in zip(deltas, ref_deltas):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
+
+
 def test_fused_feeds_corr_to_matches(rng):
     """The fused outputs plug into corr_to_matches relocalization."""
     from ncnet_tpu.ops import corr_to_matches

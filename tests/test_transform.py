@@ -255,3 +255,21 @@ def test_synth_two_stage_two_pair_keys(rng):
     for k in ("source_image_aff", "target_image_aff", "source_image_tps",
               "target_image_tps"):
         assert out[k].shape == (2, 3, 12, 12)
+
+
+def test_tps_grid_batch_equals_out_h(rng):
+    """Regression: b == out_h must not trip TpsGrid.apply's batch inference."""
+    b = 12
+    theta = small_theta_tps(rng, b)
+    grid = make_sampling_grid(jnp.asarray(theta), b, 7, "tps")
+    assert grid.shape == (b, 12, 7, 2)
+    # every batch element is transformed by its own theta
+    grid1 = make_sampling_grid(jnp.asarray(theta[:1]), b, 7, "tps")
+    np.testing.assert_allclose(np.asarray(grid[:1]), np.asarray(grid1), atol=1e-6)
+
+
+def test_synth_pair_weak_odd_batch_raises(rng):
+    img = rng.rand(3, 3, 16, 16).astype(np.float32)
+    theta = small_theta_aff(rng, 3)
+    with pytest.raises(ValueError):
+        synth_pair(jnp.asarray(img), jnp.asarray(theta), supervision="weak")
