@@ -18,6 +18,10 @@ Layer map (mirrors SURVEY.md §1, re-architected):
     data/       CSV pair datasets, normalization, host-side prefetching loader
     parallel/   mesh construction, data-parallel training step, corr-tensor sharding
     training/   weak-supervision loss, optax train state, orbax checkpointing
+    localization/  InLoc-style PnP localization (batched P3P LO-RANSAC, point-cloud
+                rendering, dense-rootSIFT pose verification, rate curves) — the
+                Python/JAX-native replacement for the reference's Matlab L5 layer
+    utils/      file/plot/batching helpers + profiling & tracing (PhaseTimer, jax.profiler)
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,179 @@
+"""Localization CLI: match files -> poses -> localization-rate curve.
+
+Python-native equivalent of the reference's Matlab driver
+(compute_densePE_NCNet.m): consumes the per-query match `.mat` files
+written by `cli/eval_inloc.py`, runs P3P LO-RANSAC (and optional dense
+pose verification) against the InLoc RGBD cutouts, and writes poses +
+the localization-rate curve.
+
+Dataset layout expectations (InLoc): a shortlist `.mat` with an ImgList
+struct (queryname / topNname), cutout `.mat` files containing `XYZcut`
+(+ optional `RGBcut`), and optionally a ground-truth pose `.mat` for the
+final curve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from ..localization import (
+    LocalizationParams,
+    localization_rate,
+    localize_queries,
+    plot_localization_curves,
+)
+from ..localization.curves import DEFAULT_THRESHOLDS
+from ..localization.driver import evaluate_poses
+from ..utils.py_util import create_file_path
+
+
+def _load_shortlist(path: str):
+    """Parse the InLoc shortlist: {query: [pano, ...]} preserving order."""
+    from scipy.io import loadmat
+
+    raw = loadmat(path, squeeze_me=True, struct_as_record=False)
+    img_list = raw["ImgList"]
+    table = {}
+    order = []
+    for rec in np.atleast_1d(img_list):
+        q = str(rec.queryname)
+        table[q] = [str(n) for n in np.atleast_1d(rec.topNname)]
+        order.append(q)
+    return order, table
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="NCNet-TPU InLoc localization (PnP + curves)")
+    p.add_argument("--matches_dir", required=True, help="dir of per-query match .mat files")
+    p.add_argument("--shortlist", required=True, help="densePE shortlist .mat")
+    p.add_argument("--cutout_dir", required=True, help="InLoc cutout .mat directory")
+    p.add_argument("--query_dir", required=True, help="query image directory")
+    p.add_argument("--transform_dir", default="", help="scan alignment transformations dir")
+    p.add_argument("--output_dir", default="localization_out")
+    p.add_argument("--focal_length", type=float, default=4032 * 28.0 / 36.0, help="query focal (px)")
+    p.add_argument("--score_thr", type=float, default=0.75)
+    p.add_argument("--pnp_thr_deg", type=float, default=0.2)
+    p.add_argument("--ransac_iters", type=int, default=10000)
+    p.add_argument("--top_n", type=int, default=10)
+    p.add_argument("--pose_verification", action="store_true")
+    p.add_argument("--gt_poses", default="", help=".mat/.npz of ground-truth poses for curves")
+    args = p.parse_args(argv)
+
+    from scipy.io import loadmat
+    from ..data.image_io import read_image
+
+    order, table = _load_shortlist(args.shortlist)
+
+    import functools
+
+    query_index = {q: i for i, q in enumerate(order)}
+
+    @functools.lru_cache(maxsize=2)
+    def load_query_matches(q):
+        qi = query_index[q] + 1  # match files are written 1-indexed per query
+        return np.asarray(loadmat(os.path.join(args.matches_dir, f"{qi}.mat"))["matches"])
+
+    def load_matches(q, j):
+        return load_query_matches(q)[0, j, :, :5]
+
+    def load_cutout(pano):
+        raw = loadmat(os.path.join(args.cutout_dir, pano + ".mat"))
+        xyz = np.asarray(raw["XYZcut"], dtype=np.float64)
+        rgb = np.asarray(raw["RGBcut"], dtype=np.float64) if "RGBcut" in raw else None
+        transform = None
+        if args.transform_dir:
+            # InLoc naming: <building>/transformations/<scene>_trans_<scan>.txt
+            # where cutouts look like '<bldg>/cutout_<scan>_<pan>_<tilt>.jpg':
+            # scene id = token before 'cutout', scan id = first numeric token
+            # after it.
+            floor = pano.split("/")[0]
+            base = os.path.basename(pano)
+            while os.path.splitext(base)[1]:
+                base = os.path.splitext(base)[0]
+            tokens = base.split("_")
+            scene_id = tokens[0] if tokens[0] != "cutout" else floor
+            numeric = [t for t in tokens if t.isdigit()]
+            scan_id = numeric[0] if numeric else ""
+            tpath = os.path.join(
+                args.transform_dir, floor, "transformations",
+                f"{scene_id}_trans_{scan_id}.txt",
+            )
+            if os.path.exists(tpath):
+                rows = [
+                    [float(v) for v in line.split()]
+                    for line in open(tpath)
+                    if line.strip() and not line[0].isalpha()
+                ]
+                transform = np.asarray(rows[-4:], dtype=np.float64)
+            else:
+                print(f"WARNING: no scan transform at {tpath}; using local frame", flush=True)
+        if rgb is not None:
+            return xyz, transform, rgb
+        return xyz, transform
+
+    def query_size(q):
+        img = read_image(os.path.join(args.query_dir, q))
+        return img.shape[0], img.shape[1]
+
+    def load_query_image(q):
+        return read_image(os.path.join(args.query_dir, q))
+
+    params = LocalizationParams(
+        score_thr=args.score_thr,
+        pnp_thr_deg=args.pnp_thr_deg,
+        ransac_iters=args.ransac_iters,
+        top_n=args.top_n,
+        use_pose_verification=args.pose_verification,
+    )
+    results = localize_queries(
+        order,
+        shortlist=lambda q: table[q],
+        load_matches=load_matches,
+        load_cutout=load_cutout,
+        query_size=query_size,
+        focal_length=args.focal_length,
+        params=params,
+        cache_dir=os.path.join(args.output_dir, "pnp_cache"),
+        load_query_image=load_query_image if args.pose_verification else None,
+        progress=lambda q: print(f"localized: {q}", flush=True),
+    )
+
+    poses_path = os.path.join(args.output_dir, "poses.npz")
+    create_file_path(poses_path)
+    np.savez(
+        poses_path,
+        queries=np.array([r.query for r in results]),
+        poses=np.stack([r.best_pose for r in results]),
+        num_inliers=np.array(
+            [r.num_inliers[r.best_index] if r.best_index >= 0 else 0 for r in results]
+        ),
+    )
+    print(f"wrote {poses_path}")
+
+    if args.gt_poses:
+        if args.gt_poses.endswith(".npz"):
+            with np.load(args.gt_poses, allow_pickle=True) as z:
+                gt = {str(q): P for q, P in zip(z["queries"], z["poses"])}
+        else:
+            raw = loadmat(args.gt_poses, squeeze_me=True, struct_as_record=False)
+            key = [k for k in raw if not k.startswith("__")][0]
+            gt = {str(r.queryname): np.asarray(r.P) for r in np.atleast_1d(raw[key])}
+        pos_e, ori_e = evaluate_poses(results, gt)
+        rates = localization_rate(pos_e, ori_e)
+        curve_png = os.path.join(args.output_dir, "localization_curve.png")
+        plot_localization_curves({"NCNet-TPU densePE": rates}, curve_png)
+        summary = {
+            "rate@0.25m": float(rates[np.searchsorted(DEFAULT_THRESHOLDS, 0.25)]),
+            "rate@0.5m": float(rates[np.searchsorted(DEFAULT_THRESHOLDS, 0.5)]),
+            "rate@1.0m": float(rates[np.searchsorted(DEFAULT_THRESHOLDS, 1.0)]),
+        }
+        print(json.dumps(summary))
+        print(f"wrote {curve_png}")
+
+
+if __name__ == "__main__":
+    main()

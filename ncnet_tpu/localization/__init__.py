@@ -1,0 +1,43 @@
+"""Visual localization pipeline (InLoc-style PnP + pose verification).
+
+Python/JAX-native replacement for the reference's Matlab L5 layer
+(compute_densePE_NCNet.m + lib_matlab/*): consumes the per-query match
+files written by the InLoc eval (ncnet_tpu.evals.inloc /
+ncnet_tpu.cli.eval_inloc), backprojects database matches to 3-D via the
+RGBD cutouts, solves camera pose with P3P LO-RANSAC, optionally
+re-ranks candidate poses with dense-descriptor pose verification, and
+reports localization-rate-vs-distance-threshold curves.
+
+Design note (TPU-first): where the Matlab pipeline loops over RANSAC
+hypotheses one at a time inside `parfor`, this implementation solves
+ALL minimal P3P samples in one batched eigendecomposition and scores
+all hypotheses against all correspondences with one einsum — the same
+work expressed as large dense linear algebra.
+"""
+
+from .pnp import p3p_solve, lo_ransac_p3p, RansacResult
+from .backproject import matches_to_2d3d, Correspondences2d3d
+from .pose import camera_center, pose_distance, make_intrinsics
+from .render import points_to_persp
+from .dsift import dense_root_sift
+from .pose_verification import pose_verification_score
+from .curves import localization_rate, plot_localization_curves
+from .driver import localize_queries, LocalizationParams
+
+__all__ = [
+    "p3p_solve",
+    "lo_ransac_p3p",
+    "RansacResult",
+    "matches_to_2d3d",
+    "Correspondences2d3d",
+    "camera_center",
+    "pose_distance",
+    "make_intrinsics",
+    "points_to_persp",
+    "dense_root_sift",
+    "pose_verification_score",
+    "localization_rate",
+    "plot_localization_curves",
+    "localize_queries",
+    "LocalizationParams",
+]

@@ -1,0 +1,87 @@
+"""Match backprojection: normalized 2-D matches -> (ray, 3-D point) pairs.
+
+Parity: the preprocessing block of lib_matlab/parfor_NC4D_PE_pnponly.m:
+threshold by match score, upsample normalized coordinates to pixels,
+look up database-pixel 3-D positions in the RGBD cutout's XYZ map, move
+them to the global frame with the scan's alignment transform, and drop
+correspondences whose depth is missing (NaN).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .pose import make_intrinsics
+
+
+@dataclass
+class Correspondences2d3d:
+    query_px: np.ndarray  # [n, 2] query pixels (x, y)
+    db_px: np.ndarray  # [n, 2] database pixels (x, y), integer grid
+    rays: np.ndarray  # [n, 3] query bearing vectors K^-1 [u, v, 1]
+    points: np.ndarray  # [n, 3] global-frame 3-D points
+
+    def __len__(self) -> int:
+        return self.query_px.shape[0]
+
+
+def matches_to_2d3d(
+    matches: np.ndarray,
+    xyz_cutout: np.ndarray,
+    query_size: tuple,
+    focal_length: float,
+    scan_transform: np.ndarray | None = None,
+    score_thr: float = 0.75,
+    max_matches: int | None = None,
+    seed: int = 0,
+) -> Correspondences2d3d:
+    """Build PnP correspondences from one query x pano match list.
+
+    matches:        [n, 5] rows (xq, yq, xdb, ydb, score) with coordinates
+                    in [0, 1] ('positive' scale), as written by the InLoc
+                    eval (ncnet_tpu/evals/inloc.py; reference
+                    eval_inloc.py:199-203).
+    xyz_cutout:     [H, W, 3] per-pixel 3-D positions of the database
+                    cutout (NaN where depth is missing) — the `XYZcut`
+                    array of the InLoc dataset.
+    query_size:     (height, width) of the query image in pixels.
+    focal_length:   query focal length in pixels.
+    scan_transform: optional [4, 4] (or [3, 4]) local->global transform
+                    `P_after` applied to the cutout points.
+    score_thr:      keep matches with score > thr (reference thr 0.75,
+                    compute_densePE_NCNet.m:33).
+    max_matches:    optional random subsample (params.ncnet.N_subsample).
+    """
+    matches = np.asarray(matches, dtype=np.float64).reshape(-1, 5)
+    keep = matches[:, 4] > score_thr
+    matches = matches[keep]
+    if max_matches is not None and matches.shape[0] > max_matches:
+        rng = np.random.default_rng(seed)
+        matches = matches[rng.choice(matches.shape[0], size=max_matches, replace=False)]
+
+    hq, wq = query_size
+    hdb, wdb = xyz_cutout.shape[:2]
+
+    # Query pixels stay continuous (they parameterize the ray); database
+    # pixels index the XYZ grid so they are floored and clamped in-bounds
+    # (the Matlab code floors then bumps zeros to 1; with 0-based indexing
+    # that is a clamp to [0, dim-1]).
+    q_px = matches[:, 0:2] * np.array([wq, hq])
+    db_px = np.floor(matches[:, 2:4] * np.array([wdb, hdb])).astype(np.int64)
+    db_px = np.clip(db_px, 0, [wdb - 1, hdb - 1])
+
+    K = make_intrinsics(focal_length, hq, wq)
+    ones = np.ones((q_px.shape[0], 1))
+    rays = np.linalg.solve(K, np.concatenate([q_px, ones], axis=1).T).T
+
+    points = np.asarray(xyz_cutout, dtype=np.float64)[db_px[:, 1], db_px[:, 0]]
+    if scan_transform is not None:
+        T = np.asarray(scan_transform, dtype=np.float64)
+        points = points @ T[:3, :3].T + T[:3, 3]
+
+    ok = np.all(np.isfinite(points), axis=1)
+    return Correspondences2d3d(
+        query_px=q_px[ok], db_px=db_px[ok], rays=rays[ok], points=points[ok]
+    )

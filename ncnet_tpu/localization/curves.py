@@ -1,0 +1,59 @@
+"""Localization-rate curves (parity: lib_matlab/ht_plotcurve_WUSTL.m:75-99).
+
+A query counts as localized at distance threshold d if its position
+error is below d AND its orientation error is within max_orierr_deg.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# The reference's threshold grid: 0:0.0625:1 then 1.125:0.125:2 meters.
+DEFAULT_THRESHOLDS = np.concatenate(
+    [np.arange(0.0, 1.0 + 1e-9, 0.0625), np.arange(1.125, 2.0 + 1e-9, 0.125)]
+)
+
+
+def localization_rate(
+    pos_errors: np.ndarray,
+    ori_errors_deg: np.ndarray,
+    thresholds: np.ndarray = DEFAULT_THRESHOLDS,
+    max_orierr_deg: float = 10.0,
+) -> np.ndarray:
+    """Fraction of queries localized at each distance threshold.
+
+    pos_errors:     [n] position errors (meters); NaN/inf = not localized.
+    ori_errors_deg: [n] orientation errors (degrees).
+    """
+    pos = np.asarray(pos_errors, dtype=np.float64).copy()
+    ori = np.asarray(ori_errors_deg, dtype=np.float64)
+    pos[~np.isfinite(pos)] = np.inf
+    pos[ori > max_orierr_deg] = np.inf
+    thr = np.asarray(thresholds, dtype=np.float64)
+    return (pos[:, None] < thr[None, :]).mean(axis=0)
+
+
+def plot_localization_curves(
+    curves: dict,
+    out_path: str,
+    thresholds: np.ndarray = DEFAULT_THRESHOLDS,
+) -> None:
+    """Write the rate-vs-threshold figure. curves: {label: rates [t]}."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for label, rates in curves.items():
+        ax.plot(thresholds, np.asarray(rates) * 100.0, marker="o", linewidth=2.0, label=label)
+    ax.set_xlim(0, 2)
+    ax.set_ylim(0, 80)
+    ax.grid(True)
+    ax.set_xlabel("Distance threshold [meters]")
+    ax.set_ylabel("Correctly localized queries [%]")
+    ax.set_xticks(np.arange(0, 2.01, 0.25))
+    ax.legend(loc="lower right", fontsize=10)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)

@@ -1,0 +1,170 @@
+"""End-to-end localization driver (parity: compute_densePE_NCNet.m flow).
+
+Per query: load the match file written by the InLoc eval, backproject
+each top-ranked pano's matches to 2-D/3-D correspondences, solve P3P
+LO-RANSAC per pano, optionally re-rank candidate poses with dense pose
+verification, and report the best pose. Per-(query, pano) results are
+cached to disk and skipped when present, mirroring the reference's
+file-existence idempotency (parfor_NC4D_PE_pnponly.m:6).
+
+The dataset specifics (where cutouts live, scan transforms) are supplied
+by caller callbacks so the driver stays dataset-agnostic.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..utils.py_util import create_file_path
+from .backproject import matches_to_2d3d
+from .pnp import lo_ransac_p3p
+from .pose import pose_distance
+from .pose_verification import pose_verification_score
+
+
+@dataclass
+class LocalizationParams:
+    score_thr: float = 0.75  # match-score threshold (compute_densePE_NCNet.m:33)
+    pnp_thr_deg: float = 0.2  # angular inlier threshold (compute_densePE_NCNet.m:34)
+    ransac_iters: int = 10000
+    max_matches: Optional[int] = None
+    top_n: int = 10
+    use_pose_verification: bool = False
+    pv_downsample: int = 8
+    seed: int = 0
+
+
+@dataclass
+class QueryResult:
+    query: str
+    poses: list  # [top_n] np.ndarray [3, 4] (NaN where unsolved)
+    num_inliers: list  # [top_n] int
+    pv_scores: list  # [top_n] float (empty if PV disabled)
+    best_index: int = -1
+
+    @property
+    def best_pose(self) -> np.ndarray:
+        if self.best_index < 0:
+            return np.full((3, 4), np.nan)
+        return self.poses[self.best_index]
+
+
+def _cache_path(cache_dir: str, query: str, pano: str) -> str:
+    safe_q = query.replace("/", "__")
+    safe_p = os.path.splitext(pano.replace("/", "__"))[0]
+    return os.path.join(cache_dir, safe_q, safe_p + ".npz")
+
+
+def localize_queries(
+    queries: Sequence[str],
+    shortlist: Callable[[str], Sequence[str]],
+    load_matches: Callable[[str, int], np.ndarray],
+    load_cutout: Callable[[str], tuple],
+    query_size: Callable[[str], tuple],
+    focal_length: float,
+    params: LocalizationParams = LocalizationParams(),
+    cache_dir: Optional[str] = None,
+    load_query_image: Optional[Callable[[str], np.ndarray]] = None,
+    progress: Optional[Callable[[str], None]] = None,
+) -> list:
+    """Localize every query; returns a list of QueryResult.
+
+    shortlist(q)        -> ranked pano names for query q.
+    load_matches(q, j)  -> [n, 5] match rows for q's j-th pano.
+    load_cutout(pano)   -> (xyz [H, W, 3], scan_transform [4, 4] | None)
+                           — plus optionally a third element rgb [H, W, 3]
+                           when pose verification is enabled.
+    query_size(q)       -> (height, width) of the query image.
+    """
+    do_pv = params.use_pose_verification and load_query_image is not None
+    results = []
+    for q in queries:
+        panos = list(shortlist(q))[: params.top_n]
+        q_img = load_query_image(q) if do_pv else None
+        poses, ninl, pv_scores = [], [], []
+        for j, pano in enumerate(panos):
+            # Each pano's cutout is loaded at most once and shared between
+            # the PnP solve and the pose-verification rescoring.
+            cut = None
+
+            def get_cutout():
+                nonlocal cut
+                if cut is None:
+                    cut = load_cutout(pano)
+                return cut
+
+            cached = None
+            cpath = _cache_path(cache_dir, q, pano) if cache_dir else None
+            if cpath and os.path.exists(cpath):
+                with np.load(cpath) as z:
+                    cached = (z["P"], int(z["num_inliers"]))
+            if cached is None:
+                xyz, transform = get_cutout()[:2]
+                corr = matches_to_2d3d(
+                    load_matches(q, j),
+                    xyz,
+                    query_size(q),
+                    focal_length,
+                    scan_transform=transform,
+                    score_thr=params.score_thr,
+                    max_matches=params.max_matches,
+                    seed=params.seed,
+                )
+                res = lo_ransac_p3p(
+                    corr.rays,
+                    corr.points,
+                    inlier_thr=np.deg2rad(params.pnp_thr_deg),
+                    max_iters=params.ransac_iters,
+                    seed=params.seed,
+                )
+                cached = (res.P, res.num_inliers)
+                if cpath:
+                    create_file_path(cpath)
+                    np.savez(cpath, P=res.P, num_inliers=res.num_inliers, inliers=res.inliers)
+            poses.append(cached[0])
+            ninl.append(cached[1])
+
+            if do_pv:
+                full = get_cutout()
+                if len(full) < 3:
+                    raise ValueError("load_cutout must return (xyz, transform, rgb) for PV")
+                score, _ = pose_verification_score(
+                    q_img, full[2], full[0], poses[j], focal_length,
+                    downsample=params.pv_downsample,
+                )
+                pv_scores.append(score)
+
+        ranking = pv_scores if do_pv else ninl
+
+        solved = [j for j in range(len(panos)) if np.all(np.isfinite(poses[j]))]
+        best = max(solved, key=lambda j: ranking[j]) if solved else -1
+        results.append(
+            QueryResult(query=q, poses=poses, num_inliers=ninl, pv_scores=pv_scores, best_index=best)
+        )
+        if progress is not None:
+            progress(q)
+    return results
+
+
+def evaluate_poses(results: Sequence[QueryResult], gt_poses: dict) -> tuple:
+    """(pos_errors [n], ori_errors_deg [n]) vs ground-truth poses.
+
+    gt_poses: {query_name: [3, 4] pose}. Queries with no solved pose get
+    inf errors (counted as not localized by localization_rate).
+    """
+    pos_errs, ori_errs = [], []
+    for r in results:
+        P = r.best_pose
+        gt = gt_poses.get(r.query)
+        if gt is None or not np.all(np.isfinite(P)):
+            pos_errs.append(np.inf)
+            ori_errs.append(np.inf)
+            continue
+        dpos, dori = pose_distance(np.asarray(gt), P)
+        pos_errs.append(dpos)
+        ori_errs.append(np.rad2deg(dori))
+    return np.asarray(pos_errs), np.asarray(ori_errs)

@@ -1,0 +1,295 @@
+"""P3P absolute-pose solver + LO-RANSAC.
+
+Functional replacement for the `ht_lo_ransac_p3p` call in the reference
+Matlab pipeline (lib_matlab/parfor_NC4D_PE_pnponly.m:77: P3P LO-RANSAC,
+angular inlier threshold in radians, 10000 iterations). The solver itself
+lives in the external InLoc_demo repo, so this is a from-scratch
+implementation:
+
+  * Minimal solver: Grunert's classic three-point resection (the quartic
+    in the distance ratio), solved for ALL RANSAC samples at once as a
+    batch of 4x4 companion-matrix eigendecompositions.
+  * Pose from distances: batched absolute orientation (Kabsch/SVD)
+    between the camera-frame points s_i * f_i and the world points.
+  * Scoring: angular error between observed unit rays and predicted rays
+    for all hypotheses x all correspondences in one einsum.
+  * LO step: iterative object-space refinement on the inlier set
+    (alternate depth estimation and absolute orientation).
+
+Everything is vectorized numpy — the hypothesis sweep is a handful of
+large dense ops rather than a Matlab `for` over samples.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class RansacResult:
+    P: np.ndarray  # [3, 4] world->camera pose, or NaN if unsolved
+    inliers: np.ndarray  # [n] bool
+    num_inliers: int = 0
+    # Mean angular error (radians) of the inliers under the final pose.
+    inlier_error: float = float("inf")
+
+    @property
+    def ok(self) -> bool:
+        return bool(np.all(np.isfinite(self.P)))
+
+
+def _normalize_rows(v: np.ndarray, eps: float = 1e-12) -> np.ndarray:
+    return v / np.maximum(np.linalg.norm(v, axis=-1, keepdims=True), eps)
+
+
+def _quartic_roots_batched(coeffs: np.ndarray) -> np.ndarray:
+    """Real roots of a batch of quartics via companion-matrix eigenvalues.
+
+    coeffs: [m, 5] with coeffs[:, 0] the x^4 coefficient. Returns [m, 4]
+    real parts, with NaN where the root has a significant imaginary part
+    or the quartic degenerates (leading coefficient ~ 0).
+    """
+    coeffs = np.asarray(coeffs, dtype=np.float64)
+    m = coeffs.shape[0]
+    lead = coeffs[:, :1]
+    bad_lead = np.abs(lead[:, 0]) < 1e-12
+    safe_lead = np.where(bad_lead[:, None], 1.0, lead)
+    monic = coeffs[:, 1:] / safe_lead  # [m, 4]
+
+    comp = np.zeros((m, 4, 4), dtype=np.float64)
+    comp[:, 0, :] = -monic
+    comp[:, 1, 0] = 1.0
+    comp[:, 2, 1] = 1.0
+    comp[:, 3, 2] = 1.0
+    roots = np.linalg.eigvals(comp)  # [m, 4] complex
+    real = np.real(roots)
+    imag_ok = np.abs(np.imag(roots)) < 1e-6 * np.maximum(1.0, np.abs(real))
+    real = np.where(imag_ok, real, np.nan)
+    real[bad_lead] = np.nan
+    return real
+
+
+def p3p_solve(rays: np.ndarray, points: np.ndarray) -> np.ndarray:
+    """Grunert P3P for a batch of minimal samples.
+
+    rays:   [m, 3, 3] unit bearing vectors in the camera frame.
+    points: [m, 3, 3] corresponding world points.
+    Returns [m, 4, 3, 4] candidate poses (world->camera), NaN-padded
+    where fewer than 4 real solutions exist.
+    """
+    f = _normalize_rows(np.asarray(rays, dtype=np.float64))
+    X = np.asarray(points, dtype=np.float64)
+    m = f.shape[0]
+
+    # Side lengths: a opposite vertex 1, b opposite vertex 2, c opposite 3.
+    a = np.linalg.norm(X[:, 1] - X[:, 2], axis=-1)
+    b = np.linalg.norm(X[:, 0] - X[:, 2], axis=-1)
+    c = np.linalg.norm(X[:, 0] - X[:, 1], axis=-1)
+    cos_a = np.einsum("mi,mi->m", f[:, 1], f[:, 2])
+    cos_b = np.einsum("mi,mi->m", f[:, 0], f[:, 2])
+    cos_g = np.einsum("mi,mi->m", f[:, 0], f[:, 1])
+
+    with np.errstate(divide="ignore", invalid="ignore"):
+        b2 = np.maximum(b * b, 1e-18)
+        acb = (a * a - c * c) / b2  # (a^2 - c^2) / b^2
+        apb = (a * a + c * c) / b2  # (a^2 + c^2) / b^2
+        bc = (b * b - c * c) / b2
+        ba = (b * b - a * a) / b2
+        a2b = (a * a) / b2
+        c2b = (c * c) / b2
+
+        A4 = (acb - 1.0) ** 2 - 4.0 * c2b * cos_a**2
+        A3 = 4.0 * (
+            acb * (1.0 - acb) * cos_b
+            - (1.0 - apb) * cos_a * cos_g
+            + 2.0 * c2b * cos_a**2 * cos_b
+        )
+        A2 = 2.0 * (
+            acb**2
+            - 1.0
+            + 2.0 * acb**2 * cos_b**2
+            + 2.0 * bc * cos_a**2
+            - 4.0 * apb * cos_a * cos_b * cos_g
+            + 2.0 * ba * cos_g**2
+        )
+        A1 = 4.0 * (
+            -acb * (1.0 + acb) * cos_b
+            + 2.0 * a2b * cos_g**2 * cos_b
+            - (1.0 - apb) * cos_a * cos_g
+        )
+        A0 = (1.0 + acb) ** 2 - 4.0 * a2b * cos_g**2
+
+    coeffs = np.stack([A4, A3, A2, A1, A0], axis=-1)  # [m, 5]
+    v = _quartic_roots_batched(coeffs)  # [m, 4]  v = s3 / s1
+
+    with np.errstate(divide="ignore", invalid="ignore"):
+        # Back-substitution (Haralick et al., review of P3P solutions):
+        # u = s2/s1 from the linear relation between the two remaining
+        # constraints once v is fixed.
+        num = (-1.0 + acb[:, None]) * v**2 - 2.0 * acb[:, None] * cos_b[:, None] * v + 1.0 + acb[:, None]
+        den = 2.0 * (cos_g[:, None] - v * cos_a[:, None])
+        u = num / den
+        s1 = b[:, None] / np.sqrt(np.maximum(1.0 + v**2 - 2.0 * v * cos_b[:, None], 1e-18))
+        s2 = u * s1
+        s3 = v * s1
+
+    valid = np.isfinite(v) & np.isfinite(u) & (s1 > 0) & (s2 > 0) & (s3 > 0)
+    s = np.stack([s1, s2, s3], axis=-1)  # [m, 4, 3]
+    s = np.where(valid[..., None], s, np.nan)
+
+    # Camera-frame points for every candidate: [m, 4, 3(points), 3(xyz)]
+    cam_pts = s[..., None] * f[:, None, :, :]
+    world_pts = np.broadcast_to(X[:, None, :, :], cam_pts.shape)
+    poses = _absolute_orientation(world_pts.reshape(-1, 3, 3), cam_pts.reshape(-1, 3, 3))
+    return poses.reshape(m, 4, 3, 4)
+
+
+def _absolute_orientation(world: np.ndarray, cam: np.ndarray) -> np.ndarray:
+    """Batched rigid alignment: find [R|t] with cam_i ~= R @ world_i + t.
+
+    world, cam: [n, k, 3]. Returns [n, 3, 4] (NaN rows propagate to NaN
+    poses). Kabsch via SVD of the centered covariance.
+    """
+    world = np.asarray(world, dtype=np.float64)
+    cam = np.asarray(cam, dtype=np.float64)
+    bad = ~np.all(np.isfinite(cam), axis=(1, 2)) | ~np.all(np.isfinite(world), axis=(1, 2))
+    cam_safe = np.where(bad[:, None, None], 0.0, cam)
+    world_safe = np.where(bad[:, None, None], 0.0, world)
+
+    wc = world_safe.mean(axis=1, keepdims=True)
+    cc = cam_safe.mean(axis=1, keepdims=True)
+    H = np.einsum("nki,nkj->nij", world_safe - wc, cam_safe - cc)  # [n, 3, 3]
+    # Guard rank-deficient H from degenerate samples.
+    H = H + 1e-12 * np.eye(3)
+    U, _, Vt = np.linalg.svd(H)
+    d = np.sign(np.linalg.det(np.einsum("nij,njk->nik", np.transpose(Vt, (0, 2, 1)), np.transpose(U, (0, 2, 1)))))
+    D = np.zeros((world.shape[0], 3, 3))
+    D[:, 0, 0] = 1.0
+    D[:, 1, 1] = 1.0
+    D[:, 2, 2] = d
+    R = np.einsum("nij,njk,nkl->nil", np.transpose(Vt, (0, 2, 1)), D, np.transpose(U, (0, 2, 1)))
+    t = cc[:, 0, :] - np.einsum("nij,nj->ni", R, wc[:, 0, :])
+    P = np.concatenate([R, t[:, :, None]], axis=-1)
+    P = np.where(bad[:, None, None], np.nan, P)
+    return P
+
+
+def _angular_errors(poses: np.ndarray, rays: np.ndarray, points: np.ndarray) -> np.ndarray:
+    """Angle between observed rays and predicted rays for every pose.
+
+    poses: [h, 3, 4]; rays: [n, 3] (unit); points: [n, 3]. Returns [h, n]
+    radians (NaN-poses and behind-camera points give pi).
+    """
+    R = poses[:, :, :3]
+    t = poses[:, :, 3]
+    pred = np.einsum("hij,nj->hni", R, points) + t[:, None, :]  # [h, n, 3]
+    pred_n = _normalize_rows(pred)
+    cosang = np.einsum("hni,ni->hn", pred_n, rays)
+    cosang = np.where(np.isfinite(cosang), cosang, -1.0)
+    return np.arccos(np.clip(cosang, -1.0, 1.0))
+
+
+def _refine_pose(P: np.ndarray, rays: np.ndarray, points: np.ndarray, iters: int = 10) -> np.ndarray:
+    """Local optimization: object-space alternation on the inlier set.
+
+    Alternates (1) per-point depth = projection of the transformed point
+    onto its observed ray and (2) absolute orientation against the
+    re-scaled rays. Monotonically decreases object-space error.
+    """
+    P = P.copy()
+    for _ in range(iters):
+        trans = points @ P[:, :3].T + P[:, 3]
+        depths = np.maximum(np.einsum("ni,ni->n", trans, rays), 1e-9)
+        cam_pts = depths[:, None] * rays
+        P = _absolute_orientation(points[None], cam_pts[None])[0]
+        if not np.all(np.isfinite(P)):
+            return np.full((3, 4), np.nan)
+    return P
+
+
+def lo_ransac_p3p(
+    rays: np.ndarray,
+    points: np.ndarray,
+    inlier_thr: float,
+    max_iters: int = 10000,
+    seed: int = 0,
+    lo_iters: int = 10,
+) -> RansacResult:
+    """LO-RANSAC over batched Grunert P3P.
+
+    rays:       [n, 3] bearing vectors in the camera frame (normalized
+                internally); e.g. K^-1 @ [u, v, 1].
+    points:     [n, 3] world points.
+    inlier_thr: angular threshold in RADIANS (the reference passes
+                pnp_thr * pi / 180 with pnp_thr = 0.2 degrees,
+                compute_densePE_NCNet.m:34).
+    max_iters:  number of minimal samples (all solved in one batch).
+
+    Returns RansacResult with P = [R|t] (world->camera) and the inlier
+    mask under the final locally-optimized pose.
+    """
+    rays = _normalize_rows(np.asarray(rays, dtype=np.float64))
+    points = np.asarray(points, dtype=np.float64)
+    n = rays.shape[0]
+    if n < 3:
+        return RansacResult(P=np.full((3, 4), np.nan), inliers=np.zeros(n, dtype=bool))
+
+    rng = np.random.default_rng(seed)
+    # All minimal samples drawn up front; duplicates within a sample are
+    # discarded by the degenerate-quartic guard in p3p_solve.
+    idx = rng.integers(0, n, size=(max_iters, 3))
+    # Ensure distinct indices per sample (vectorized rejection resampling).
+    if n == 3:
+        idx = rng.permuted(np.tile(np.arange(3), (max_iters, 1)), axis=1)
+    else:
+        def collisions(ix):
+            return (ix[:, 0] == ix[:, 1]) | (ix[:, 0] == ix[:, 2]) | (ix[:, 1] == ix[:, 2])
+
+        collide = collisions(idx)
+        while collide.any():
+            idx[collide] = rng.integers(0, n, size=(int(collide.sum()), 3))
+            collide = collisions(idx)
+
+    cand = p3p_solve(rays[idx], points[idx]).reshape(-1, 3, 4)  # [m*4, 3, 4]
+    finite = np.all(np.isfinite(cand), axis=(1, 2))
+    cand = cand[finite]
+    if cand.shape[0] == 0:
+        return RansacResult(P=np.full((3, 4), np.nan), inliers=np.zeros(n, dtype=bool))
+
+    # Score every hypothesis against every correspondence in one sweep,
+    # chunked to bound memory for very large hypothesis counts.
+    best_count = -1
+    best_pose = None
+    chunk = max(1, int(4e7) // max(n, 1))
+    for start in range(0, cand.shape[0], chunk):
+        errs = _angular_errors(cand[start : start + chunk], rays, points)
+        counts = (errs < inlier_thr).sum(axis=1)
+        j = int(np.argmax(counts))
+        if counts[j] > best_count:
+            best_count = int(counts[j])
+            best_pose = cand[start + j]
+
+    if best_pose is None or best_count < 3:
+        return RansacResult(P=np.full((3, 4), np.nan), inliers=np.zeros(n, dtype=bool))
+
+    # Local optimization: refine on the inlier set, keep if it improves.
+    P = best_pose
+    for _ in range(2):
+        inl = _angular_errors(P[None], rays, points)[0] < inlier_thr
+        if inl.sum() < 3:
+            break
+        P_ref = _refine_pose(P, rays[inl], points[inl], iters=lo_iters)
+        if not np.all(np.isfinite(P_ref)):
+            break
+        new_inl = _angular_errors(P_ref[None], rays, points)[0] < inlier_thr
+        if new_inl.sum() >= inl.sum():
+            P = P_ref
+        else:
+            break
+
+    errs = _angular_errors(P[None], rays, points)[0]
+    inliers = errs < inlier_thr
+    mean_err = float(errs[inliers].mean()) if inliers.any() else float("inf")
+    return RansacResult(P=P, inliers=inliers, num_inliers=int(inliers.sum()), inlier_error=mean_err)

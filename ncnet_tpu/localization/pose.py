@@ -1,0 +1,46 @@
+"""Camera-pose helpers (parity: lib_matlab/p2c.m, lib_matlab/p2dist.m).
+
+A pose is a [3, 4] matrix P = [R | t] mapping world points to camera
+coordinates: x_cam = R @ X + t (no intrinsics folded in).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_intrinsics(focal_length: float, height: int, width: int) -> np.ndarray:
+    """Pinhole K with principal point at the image center.
+
+    Parity: the Kq construction in lib_matlab/parfor_NC4D_PE_pnponly.m:52-54
+    (fl on the diagonal, principal point (w/2, h/2)).
+    """
+    return np.array(
+        [
+            [focal_length, 0.0, width / 2.0],
+            [0.0, focal_length, height / 2.0],
+            [0.0, 0.0, 1.0],
+        ],
+        dtype=np.float64,
+    )
+
+
+def camera_center(P: np.ndarray) -> np.ndarray:
+    """Camera center C = -R^T t (parity: lib_matlab/p2c.m)."""
+    P = np.asarray(P, dtype=np.float64)
+    return -P[:3, :3].T @ P[:3, 3]
+
+
+def pose_distance(P1: np.ndarray, P2: np.ndarray) -> tuple:
+    """(position error [same units as t], orientation error [radians]).
+
+    Parity: lib_matlab/p2dist.m — position error is the distance between
+    camera centers; orientation error is the rotation angle of R1^-1 R2.
+    """
+    c1 = camera_center(P1)
+    c2 = camera_center(P2)
+    dpos = float(np.linalg.norm(c1 - c2))
+    R = np.linalg.solve(np.asarray(P1, dtype=np.float64)[:3, :3], np.asarray(P2, dtype=np.float64)[:3, :3])
+    cos_ang = (np.trace(R) - 1.0) / 2.0
+    dori = float(np.arccos(np.clip(cos_ang, -1.0, 1.0)))
+    return dpos, dori

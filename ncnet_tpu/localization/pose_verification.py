@@ -1,0 +1,94 @@
+"""Dense pose verification: re-score candidate poses by rendered appearance.
+
+Parity: lib_matlab/parfor_nc4d_PV.m — render the scan's RGBD cloud at
+the candidate pose (downsampled 8x), normalize both images over the
+valid-coverage mask, compare dense rootSIFT descriptors, and score the
+pose as 1 / median descriptor error. Poses whose render covers nothing
+(or that are NaN) score 0.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .dsift import dense_root_sift
+from .pose import make_intrinsics
+from .render import points_to_persp
+
+
+def _to_gray(img: np.ndarray) -> np.ndarray:
+    img = np.asarray(img, dtype=np.float64)
+    if img.ndim == 3:
+        img = img @ np.array([0.299, 0.587, 0.114])
+    return img
+
+
+def _normalize_over_mask(img: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """Zero-mean / unit-std normalization computed over the masked pixels
+    (parity: the image_normalization call in parfor_nc4d_PV.m:21-24)."""
+    vals = img[mask]
+    if vals.size == 0:
+        return img
+    std = vals.std()
+    return (img - vals.mean()) / (std if std > 1e-9 else 1.0)
+
+
+def pose_verification_score(
+    query_image: np.ndarray,
+    rgb_cloud: np.ndarray,
+    xyz_cloud: np.ndarray,
+    P: np.ndarray,
+    focal_length: float,
+    downsample: int = 8,
+    step: int = 4,
+    bin_size: int = 8,
+) -> tuple:
+    """(score, error_map) for one candidate pose.
+
+    query_image:  [H, W, 3] (or grayscale) query at full resolution.
+    rgb/xyz_cloud: the scan's colored point cloud (any shape, matched).
+    P:            [3, 4] candidate pose (world -> camera).
+    focal_length: query focal in pixels at FULL resolution; scaled by
+                  1/downsample like the reference's `fl * dslevel`.
+    """
+    P = np.asarray(P, dtype=np.float64)
+    if not np.all(np.isfinite(P)):
+        return 0.0, None
+
+    q = _to_gray(query_image)
+    h = max(1, q.shape[0] // downsample)
+    w = max(1, q.shape[1] // downsample)
+    # Box-ish downsample by striding (appearance statistics only).
+    q_small = np.asarray(
+        np.add.reduceat(
+            np.add.reduceat(q[: h * downsample, : w * downsample], np.arange(0, h * downsample, downsample), axis=0),
+            np.arange(0, w * downsample, downsample),
+            axis=1,
+        )
+    ) / float(downsample * downsample)
+
+    K = make_intrinsics(focal_length / downsample, h, w)
+    rgb_persp, xyz_persp = points_to_persp(rgb_cloud, xyz_cloud, K @ P, h, w)
+    valid = np.all(np.isfinite(xyz_persp), axis=-1)
+    if not valid.any():
+        return 0.0, None
+
+    synth = _to_gray(rgb_persp)
+    synth = np.where(valid, synth, 0.0)
+    q_norm = _normalize_over_mask(q_small, valid)
+    s_norm = _normalize_over_mask(synth, valid)
+
+    f_q, d_q = dense_root_sift(q_norm, step=step, bin_size=bin_size)
+    f_s, d_s = dense_root_sift(s_norm, step=step, bin_size=bin_size)
+    # Identical grids by construction; evaluate only frames on valid pixels.
+    on_valid = valid[f_s[:, 1], f_s[:, 0]]
+    if not on_valid.any():
+        return 0.0, None
+
+    err = np.linalg.norm(d_q[on_valid] - d_s[on_valid], axis=1)
+    med = float(np.median(err))
+    score = 1.0 / med if med > 1e-12 else float("inf")
+
+    err_map = np.full(valid.shape, np.nan)
+    err_map[f_s[on_valid, 1], f_s[on_valid, 0]] = err
+    return score, err_map

@@ -1,0 +1,53 @@
+"""Point-cloud perspective rendering with z-buffering.
+
+Functional replacement for the `ht_Points2Persp` call used by the
+reference's dense pose verification (lib_matlab/parfor_nc4d_PV.m:15):
+splat an RGBD point cloud through K @ P into a target view, keeping the
+nearest point per pixel. Pixels no point reaches are NaN.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def points_to_persp(
+    rgb: np.ndarray,
+    xyz: np.ndarray,
+    KP: np.ndarray,
+    out_h: int,
+    out_w: int,
+) -> tuple:
+    """Render (rgb_persp [h,w,3], xyz_persp [h,w,3]) of the cloud at KP.
+
+    rgb: [..., 3] colors (any shape; flattened), values passed through.
+    xyz: [..., 3] matching global-frame positions (NaN entries skipped).
+    KP:  [3, 4] projection K @ [R | t] mapping world -> pixel homogeneous.
+    """
+    rgb_flat = np.asarray(rgb, dtype=np.float64).reshape(-1, 3)
+    xyz_flat = np.asarray(xyz, dtype=np.float64).reshape(-1, 3)
+    ok = np.all(np.isfinite(xyz_flat), axis=1)
+    rgb_flat, xyz_flat = rgb_flat[ok], xyz_flat[ok]
+
+    proj = xyz_flat @ np.asarray(KP, dtype=np.float64)[:, :3].T + np.asarray(KP)[:, 3]
+    z = proj[:, 2]
+    front = z > 1e-9
+    proj, z, rgb_flat, xyz_flat = proj[front], z[front], rgb_flat[front], xyz_flat[front]
+
+    u = np.round(proj[:, 0] / z).astype(np.int64)
+    v = np.round(proj[:, 1] / z).astype(np.int64)
+    in_view = (u >= 0) & (u < out_w) & (v >= 0) & (v < out_h)
+    u, v, z = u[in_view], v[in_view], z[in_view]
+    rgb_flat, xyz_flat = rgb_flat[in_view], xyz_flat[in_view]
+
+    rgb_out = np.full((out_h, out_w, 3), np.nan)
+    xyz_out = np.full((out_h, out_w, 3), np.nan)
+    if z.size == 0:
+        return rgb_out, xyz_out
+
+    # Z-buffer: sort by depth descending, then write — nearest lands last.
+    order = np.argsort(-z, kind="stable")
+    u, v = u[order], v[order]
+    rgb_out[v, u] = rgb_flat[order]
+    xyz_out[v, u] = xyz_flat[order]
+    return rgb_out, xyz_out

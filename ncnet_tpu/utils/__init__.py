@@ -1,0 +1,21 @@
+"""Shared utilities: file helpers, profiling/tracing, plotting, batching.
+
+Covers the reference's L0 layer (lib/py_util.py, lib/plot.py, the
+torch_util helpers) plus the observability subsystem SURVEY.md §5 calls
+for (the reference has none — progress is bare prints).
+"""
+
+from .py_util import create_file_path
+from .profiling import PhaseTimer, trace_context, phase
+from .batching import collate_ragged, softmax_1d, expand_dim, str_to_bool
+
+__all__ = [
+    "create_file_path",
+    "PhaseTimer",
+    "trace_context",
+    "phase",
+    "collate_ragged",
+    "softmax_1d",
+    "expand_dim",
+    "str_to_bool",
+]

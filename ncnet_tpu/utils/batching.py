@@ -1,0 +1,64 @@
+"""Batching & misc helpers (parity: lib/torch_util.py:9-75).
+
+The reference's `BatchTensorToVars` (dict -> GPU Variables) has no
+TPU-side counterpart — device placement happens via jit/sharding — so
+only the genuinely reusable pieces carry over.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def collate_ragged(samples: list) -> dict:
+    """Collate dict samples whose values may be ragged (parity:
+    `collate_custom`, lib/torch_util.py:9-29): stackable arrays are
+    stacked; everything else is kept as a list."""
+    if not samples:
+        return {}
+    out = {}
+    for key in samples[0]:
+        vals = [s[key] for s in samples]
+        first = vals[0]
+        if isinstance(first, np.ndarray) and all(
+            isinstance(v, np.ndarray) and v.shape == first.shape for v in vals
+        ):
+            out[key] = np.stack(vals)
+        elif isinstance(first, (int, float, np.integer, np.floating)):
+            out[key] = np.asarray(vals)
+        else:
+            out[key] = vals
+    return out
+
+
+def softmax_1d(x, axis: int = -1):
+    """Numerically-stable softmax (parity: `Softmax1D`, lib/torch_util.py).
+
+    Thin alias over jax.nn.softmax — the project convention
+    (ncnet_tpu/ops/matches.py) — kept for API parity with the reference.
+    """
+    import jax
+
+    return jax.nn.softmax(jax.numpy.asarray(x), axis=axis)
+
+
+def expand_dim(x, axis: int, reps: int):
+    """Insert an axis and tile it `reps` times (parity: `expand_dim`,
+    lib/torch_util.py:63-66)."""
+    import jax.numpy as jnp
+
+    x = jnp.expand_dims(jnp.asarray(x), axis)
+    tiles = [1] * x.ndim
+    tiles[axis] = reps
+    return jnp.tile(x, tiles)
+
+
+def str_to_bool(v) -> bool:
+    """argparse-friendly bool (parity: `str_to_bool`, lib/torch_util.py)."""
+    if isinstance(v, bool):
+        return v
+    if str(v).lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if str(v).lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise ValueError(f"boolean value expected, got {v!r}")

@@ -1,0 +1,86 @@
+"""Plotting helpers (parity: lib/plot.py:6-29 + show_matches2_horizontal.m).
+
+Headless-safe: forces the Agg backend on import of the plotting calls.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.normalization import IMAGENET_MEAN, IMAGENET_STD
+
+
+def denormalize_for_display(image: np.ndarray) -> np.ndarray:
+    """Invert ImageNet normalization to [0, 1] HWC for imshow
+    (parity: lib/plot.py:6-17)."""
+    img = np.asarray(image)
+    if img.ndim == 4:
+        img = img[0]
+    if img.shape[0] in (1, 3):  # CHW -> HWC
+        img = np.transpose(img, (1, 2, 0))
+    mean = np.asarray(IMAGENET_MEAN).reshape(1, 1, -1)
+    std = np.asarray(IMAGENET_STD).reshape(1, 1, -1)
+    return np.clip(img * std + mean, 0.0, 1.0)
+
+
+def save_image(image: np.ndarray, path: str, denormalize: bool = True) -> None:
+    """Borderless image save (parity: lib/plot.py:20-29)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    img = denormalize_for_display(image) if denormalize else np.asarray(image)
+    fig = plt.figure(frameon=False)
+    fig.set_size_inches(img.shape[1] / 100.0, img.shape[0] / 100.0)
+    ax = plt.Axes(fig, [0.0, 0.0, 1.0, 1.0])
+    ax.set_axis_off()
+    fig.add_axes(ax)
+    ax.imshow(img, aspect="auto")
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+
+
+def plot_matches_horizontal(
+    image_a: np.ndarray,
+    image_b: np.ndarray,
+    points_a: np.ndarray,
+    points_b: np.ndarray,
+    path: str,
+    inliers: np.ndarray | None = None,
+    denormalize: bool = False,
+) -> None:
+    """Side-by-side pair with match lines (parity:
+    lib_matlab/show_matches2_horizontal.m). points_*: [n, 2] pixels."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    a = denormalize_for_display(image_a) if denormalize else np.asarray(image_a)
+    b = denormalize_for_display(image_b) if denormalize else np.asarray(image_b)
+    h = max(a.shape[0], b.shape[0])
+
+    def pad_to(img, h):
+        if img.shape[0] == h:
+            return img
+        pad = np.zeros((h - img.shape[0],) + img.shape[1:], img.dtype)
+        return np.concatenate([img, pad], axis=0)
+
+    canvas = np.concatenate([pad_to(a, h), pad_to(b, h)], axis=1)
+    off = a.shape[1]
+
+    fig, ax = plt.subplots(figsize=(canvas.shape[1] / 100.0, canvas.shape[0] / 100.0))
+    ax.imshow(canvas)
+    ax.set_axis_off()
+    pa = np.asarray(points_a, dtype=np.float64)
+    pb = np.asarray(points_b, dtype=np.float64)
+    inl = np.ones(pa.shape[0], dtype=bool) if inliers is None else np.asarray(inliers, dtype=bool)
+    for i in range(pa.shape[0]):
+        color = "g" if inl[i] else "r"
+        ax.plot([pa[i, 0], pb[i, 0] + off], [pa[i, 1], pb[i, 1]], color=color, linewidth=0.5)
+    ax.scatter(pa[:, 0], pa[:, 1], s=6, c="y")
+    ax.scatter(pb[:, 0] + off, pb[:, 1], s=6, c="y")
+    fig.tight_layout(pad=0)
+    fig.savefig(path, dpi=100)
+    plt.close(fig)

@@ -1,0 +1,87 @@
+"""Profiling & tracing (SURVEY.md §5: the reference has none — prints only).
+
+Two layers:
+  * `trace_context(logdir)` — wraps `jax.profiler.trace` so a whole
+    phase can be captured for TensorBoard/Perfetto inspection.
+  * `PhaseTimer` / `phase(...)` — lightweight wall-clock phase timing
+    with device synchronization (block_until_ready on a probe value),
+    for per-phase breakdowns in benches and evals without a trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Optional
+
+
+@contextlib.contextmanager
+def trace_context(logdir: Optional[str]):
+    """jax.profiler.trace if logdir is set; no-op otherwise."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+class PhaseTimer:
+    """Accumulates wall-clock time per named phase.
+
+    Usage:
+        timer = PhaseTimer()
+        with timer.phase("forward", sync=lambda: corr):
+            corr = step(...)
+        print(timer.report())
+
+    `sync=` takes a zero-arg callable evaluated when the phase CLOSES
+    (so it can reference values produced inside the block); the timer
+    blocks on the returned jax value before stopping the clock, so
+    TPU-async dispatch is not misattributed to later phases. A plain
+    jax array is also accepted for values that already exist at entry.
+    """
+
+    def __init__(self):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, sync=None):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                try:
+                    import jax
+
+                    jax.block_until_ready(sync() if callable(sync) else sync)
+                except Exception:
+                    pass
+            self.totals[name] += time.perf_counter() - start
+            self.counts[name] += 1
+
+    def report(self) -> str:
+        lines = []
+        for name in sorted(self.totals, key=lambda n: -self.totals[n]):
+            t, c = self.totals[name], self.counts[name]
+            lines.append(f"{name:30s} {t:9.3f}s  ({c} calls, {t / max(c, 1):8.4f}s avg)")
+        return "\n".join(lines)
+
+    def as_dict(self) -> dict:
+        return {k: {"total_s": self.totals[k], "calls": self.counts[k]} for k in self.totals}
+
+
+_GLOBAL_TIMER = PhaseTimer()
+
+
+def phase(name: str, sync=None):
+    """Module-level convenience: time a phase on the global timer."""
+    return _GLOBAL_TIMER.phase(name, sync=sync)
+
+
+def global_timer() -> PhaseTimer:
+    return _GLOBAL_TIMER

@@ -1,0 +1,12 @@
+"""Small filesystem helpers (parity: lib/py_util.py:4-10)."""
+
+from __future__ import annotations
+
+import os
+
+
+def create_file_path(filename: str) -> None:
+    """mkdir -p for the directory containing `filename`."""
+    d = os.path.dirname(filename)
+    if d:
+        os.makedirs(d, exist_ok=True)

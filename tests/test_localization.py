@@ -1,0 +1,240 @@
+"""Localization pipeline tests: synthetic-scene oracles for P3P RANSAC,
+backprojection, rendering, pose verification, and curves."""
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.localization import (
+    LocalizationParams,
+    lo_ransac_p3p,
+    localization_rate,
+    localize_queries,
+    matches_to_2d3d,
+    p3p_solve,
+    points_to_persp,
+    pose_distance,
+    pose_verification_score,
+)
+from ncnet_tpu.localization.driver import evaluate_poses
+from ncnet_tpu.localization.pose import camera_center, make_intrinsics
+
+
+def random_pose(rng):
+    """Random world->camera pose with points visible in front."""
+    axis = rng.normal(size=3)
+    axis /= np.linalg.norm(axis)
+    ang = rng.uniform(0.1, 1.0)
+    K = np.array(
+        [[0, -axis[2], axis[1]], [axis[2], 0, -axis[0]], [-axis[1], axis[0], 0]]
+    )
+    R = np.eye(3) + np.sin(ang) * K + (1 - np.cos(ang)) * (K @ K)
+    t = rng.normal(size=3) * 0.5 + np.array([0, 0, 4.0])
+    return np.concatenate([R, t[:, None]], axis=1)
+
+
+def make_scene(rng, n, P):
+    """World points in front of camera P, and their unit observation rays."""
+    cam_pts = rng.uniform([-2, -2, 2], [2, 2, 8], size=(n, 3))
+    R, t = P[:, :3], P[:, 3]
+    world = (cam_pts - t) @ R  # R^T (x - t)
+    rays = cam_pts / np.linalg.norm(cam_pts, axis=1, keepdims=True)
+    return world, rays
+
+
+class TestP3P:
+    def test_minimal_exact(self):
+        rng = np.random.default_rng(0)
+        for trial in range(20):
+            P = random_pose(rng)
+            world, rays = make_scene(rng, 3, P)
+            cands = p3p_solve(rays[None], world[None])[0]
+            ok = [c for c in cands if np.all(np.isfinite(c))]
+            assert ok, "no real P3P solution for a generic configuration"
+            errs = [pose_distance(P, c) for c in ok]
+            best = min(errs, key=lambda e: e[0])
+            assert best[0] < 1e-6 and best[1] < 1e-6
+
+    def test_ransac_with_outliers(self):
+        rng = np.random.default_rng(1)
+        P = random_pose(rng)
+        world, rays = make_scene(rng, 200, P)
+        # 40% outliers: random rays.
+        n_out = 80
+        bad = rng.normal(size=(n_out, 3))
+        rays[:n_out] = bad / np.linalg.norm(bad, axis=1, keepdims=True)
+        res = lo_ransac_p3p(rays, world, inlier_thr=np.deg2rad(0.2), max_iters=500, seed=0)
+        assert res.ok
+        dpos, dori = pose_distance(P, res.P)
+        assert dpos < 1e-3 and np.rad2deg(dori) < 0.1
+        assert res.num_inliers >= 115  # recovers (almost) all 120 inliers
+        assert res.inliers[n_out:].mean() > 0.95
+
+    def test_ransac_too_few(self):
+        res = lo_ransac_p3p(np.zeros((2, 3)), np.zeros((2, 3)), 0.01)
+        assert not res.ok and res.num_inliers == 0
+
+    def test_camera_center_roundtrip(self):
+        rng = np.random.default_rng(2)
+        P = random_pose(rng)
+        C = camera_center(P)
+        # x_cam of the center is 0.
+        assert np.allclose(P[:, :3] @ C + P[:, 3], 0.0, atol=1e-12)
+
+
+class TestBackproject:
+    def test_synthetic_lookup(self):
+        h, w = 40, 60
+        xx, yy = np.meshgrid(np.arange(w, dtype=float), np.arange(h, dtype=float), indexing="xy")
+        xyz = np.stack([xx, yy, np.full((h, w), 5.0)], axis=-1)
+        xyz[0, 0] = np.nan  # a hole
+        matches = np.array(
+            [
+                [0.5, 0.5, 0.5, 0.5, 0.9],  # valid, center
+                [0.25, 0.25, 0.005, 0.01, 0.9],  # hits the NaN hole -> dropped
+                [0.1, 0.1, 0.9, 0.9, 0.1],  # below score thr -> dropped
+            ]
+        )
+        corr = matches_to_2d3d(matches, xyz, (100, 200), focal_length=100.0, score_thr=0.75)
+        assert len(corr) == 1
+        assert np.allclose(corr.points[0], [w // 2, h // 2, 5.0])
+        assert np.allclose(corr.query_px[0], [100.0, 50.0])
+        # Ray direction reproduces the pixel through K.
+        K = make_intrinsics(100.0, 100, 200)
+        uv = K @ corr.rays[0]
+        assert np.allclose(uv[:2] / uv[2], [100.0, 50.0])
+
+    def test_scan_transform_applied(self):
+        xyz = np.ones((4, 4, 3))
+        T = np.eye(4)
+        T[:3, 3] = [10.0, 0.0, 0.0]
+        m = np.array([[0.5, 0.5, 0.5, 0.5, 0.9]])
+        corr = matches_to_2d3d(m, xyz, (8, 8), 4.0, scan_transform=T)
+        assert np.allclose(corr.points[0], [11.0, 1.0, 1.0])
+
+
+class TestRender:
+    def test_zbuffer_keeps_nearest(self):
+        # Two points projecting to the same pixel; nearer one must win.
+        K = make_intrinsics(10.0, 8, 8)
+        P = np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
+        xyz = np.array([[0.0, 0.0, 2.0], [0.0, 0.0, 1.0]])
+        rgb = np.array([[1.0, 0, 0], [0, 1.0, 0]])
+        rgb_p, xyz_p = points_to_persp(rgb, xyz, K @ P, 8, 8)
+        assert np.allclose(rgb_p[4, 4], [0, 1.0, 0])
+        assert np.allclose(xyz_p[4, 4], [0, 0, 1.0])
+        # Everything else NaN.
+        assert np.isnan(rgb_p).sum() == 8 * 8 * 3 - 3
+
+    def test_behind_camera_skipped(self):
+        K = make_intrinsics(10.0, 8, 8)
+        P = np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
+        _, xyz_p = points_to_persp(np.ones((1, 3)), np.array([[0.0, 0.0, -1.0]]), K @ P, 8, 8)
+        assert np.all(np.isnan(xyz_p))
+
+
+class TestPoseVerification:
+    def _scene(self):
+        rng = np.random.default_rng(3)
+        h, w = 96, 128
+        fl = 120.0
+        # A textured fronto-parallel plane at z=4 covering the image.
+        K = make_intrinsics(fl, h, w)
+        ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        z = 4.0
+        X = (xs - w / 2.0) * z / fl
+        Y = (ys - h / 2.0) * z / fl
+        xyz = np.stack([X, Y, np.full_like(X, z)], axis=-1)
+        tex = rng.uniform(0, 1, size=(h, w))
+        rgb = np.repeat(tex[:, :, None], 3, axis=2)
+        return rgb, xyz, fl
+
+    def test_true_pose_beats_wrong_pose(self):
+        rgb, xyz, fl = self._scene()
+        P_true = np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
+        P_wrong = P_true.copy()
+        P_wrong[:, 3] = [1.5, 0.8, 0.5]
+        query = (rgb * 255).astype(np.uint8)
+        s_true, _ = pose_verification_score(query, rgb, xyz, P_true, fl, downsample=2)
+        s_wrong, _ = pose_verification_score(query, rgb, xyz, P_wrong, fl, downsample=2)
+        assert s_true > s_wrong
+
+    def test_nan_pose_scores_zero(self):
+        rgb, xyz, fl = self._scene()
+        s, m = pose_verification_score(rgb, rgb, xyz, np.full((3, 4), np.nan), fl)
+        assert s == 0.0 and m is None
+
+
+class TestCurves:
+    def test_rates(self):
+        pos = np.array([0.1, 0.5, 3.0, np.inf, 0.2])
+        ori = np.array([1.0, 2.0, 1.0, 1.0, 45.0])  # last: ori too large
+        thr = np.array([0.25, 1.0, 5.0])
+        rates = localization_rate(pos, ori, thr)
+        # thr 0.25: only 0.1 qualifies; thr 1.0: 0.1+0.5; thr 5.0: +3.0.
+        assert np.allclose(rates, [1 / 5, 2 / 5, 3 / 5])
+
+
+class TestDriver:
+    def test_end_to_end_synthetic(self, tmp_path):
+        rng = np.random.default_rng(7)
+        fl = 100.0
+        hq, wq = 80, 100
+        hdb, wdb = 50, 50
+        P_gt = random_pose(rng)
+
+        # Database cutout: plane of 3-D points observed by an identity-pose
+        # db camera; query observes the same points from P_gt.
+        ys, xs = np.meshgrid(np.arange(hdb), np.arange(wdb), indexing="ij")
+        z = 6.0
+        world = np.stack(
+            [(xs - wdb / 2.0) * z / 60.0, (ys - hdb / 2.0) * z / 60.0, np.full(xs.shape, z, float)],
+            axis=-1,
+        )
+        # World -> query pixels, keep in-bounds points as matches.
+        R, t = P_gt[:, :3], P_gt[:, 3]
+        Kq = make_intrinsics(fl, hq, wq)
+        cam = world.reshape(-1, 3) @ R.T + t
+        uvw = cam @ Kq.T
+        uv = uvw[:, :2] / uvw[:, 2:3]
+        vis = (
+            (uv[:, 0] > 1) & (uv[:, 0] < wq - 1) & (uv[:, 1] > 1) & (uv[:, 1] < hq - 1) & (cam[:, 2] > 0)
+        )
+        idx = np.where(vis)[0]
+        assert idx.size >= 50
+        idx = rng.choice(idx, size=min(200, idx.size), replace=False)
+        db_xy = np.stack([(idx % wdb) + 0.5, (idx // wdb) + 0.5], axis=1)
+        m = np.concatenate(
+            [uv[idx] / [wq, hq], db_xy / [wdb, hdb], np.full((idx.size, 1), 0.9)], axis=1
+        )
+
+        results = localize_queries(
+            queries=["q1"],
+            shortlist=lambda q: ["pano_a"],
+            load_matches=lambda q, j: m,
+            load_cutout=lambda p: (world, None),
+            query_size=lambda q: (hq, wq),
+            focal_length=fl,
+            params=LocalizationParams(ransac_iters=300, top_n=1),
+            cache_dir=str(tmp_path / "cache"),
+        )
+        assert results[0].best_index == 0
+        dpos, dori = pose_distance(P_gt, results[0].best_pose)
+        assert dpos < 1e-2 and np.rad2deg(dori) < 0.5
+
+        # Idempotency: second run hits the cache and gives the same pose.
+        results2 = localize_queries(
+            queries=["q1"],
+            shortlist=lambda q: ["pano_a"],
+            load_matches=lambda q, j: (_ for _ in ()).throw(AssertionError("cache not used")),
+            load_cutout=lambda p: (world, None),
+            query_size=lambda q: (hq, wq),
+            focal_length=fl,
+            params=LocalizationParams(ransac_iters=300, top_n=1),
+            cache_dir=str(tmp_path / "cache"),
+        )
+        assert np.allclose(results2[0].best_pose, results[0].best_pose)
+
+        # evaluate_poses + curve plumbing.
+        pos_e, ori_e = evaluate_poses(results, {"q1": P_gt})
+        rates = localization_rate(pos_e, ori_e, np.array([0.25]))
+        assert rates[0] == 1.0

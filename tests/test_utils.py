@@ -1,0 +1,97 @@
+"""Utils tests: batching helpers, profiling, plotting, file helpers."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.utils import (
+    PhaseTimer,
+    collate_ragged,
+    create_file_path,
+    expand_dim,
+    phase,
+    softmax_1d,
+    str_to_bool,
+    trace_context,
+)
+from ncnet_tpu.utils.plot import denormalize_for_display, plot_matches_horizontal, save_image
+
+
+def test_create_file_path(tmp_path):
+    target = tmp_path / "a" / "b" / "c.txt"
+    create_file_path(str(target))
+    assert target.parent.is_dir()
+    create_file_path("no_dir_component.txt")  # no-op, no crash
+
+
+def test_collate_ragged():
+    samples = [
+        {"img": np.zeros((3, 4)), "pts": np.zeros((2, 5)), "name": "a", "n": 1},
+        {"img": np.ones((3, 4)), "pts": np.zeros((2, 7)), "name": "b", "n": 2},
+    ]
+    out = collate_ragged(samples)
+    assert out["img"].shape == (2, 3, 4)
+    assert isinstance(out["pts"], list) and len(out["pts"]) == 2  # ragged -> list
+    assert out["name"] == ["a", "b"]
+    assert np.array_equal(out["n"], [1, 2])
+    assert collate_ragged([]) == {}
+
+
+def test_softmax_and_expand():
+    x = np.array([[1.0, 2.0, 3.0]])
+    s = np.asarray(softmax_1d(x))
+    assert np.allclose(s.sum(axis=-1), 1.0)
+    assert np.all(np.diff(s[0]) > 0)
+    e = np.asarray(expand_dim(np.zeros((2, 3)), 0, 4))
+    assert e.shape == (4, 2, 3)
+
+
+def test_str_to_bool():
+    assert str_to_bool("yes") and str_to_bool("True") and str_to_bool(True)
+    assert not str_to_bool("0") and not str_to_bool("no")
+    with pytest.raises(ValueError):
+        str_to_bool("maybe")
+
+
+def test_phase_timer():
+    t = PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"), t.phase("b"):
+        pass
+    assert t.counts["a"] == 2 and t.counts["b"] == 1
+    assert "a" in t.report()
+    d = t.as_dict()
+    assert d["a"]["calls"] == 2
+    with phase("global_phase"):
+        pass
+    with trace_context(None):  # no-op path
+        pass
+
+
+def test_phase_timer_sync():
+    import jax.numpy as jnp
+
+    t = PhaseTimer()
+    with t.phase("matmul", sync=jnp.ones((8, 8)) @ jnp.ones((8, 8))):
+        pass
+    assert t.totals["matmul"] > 0
+
+
+def test_plot_helpers(tmp_path):
+    img = np.random.default_rng(0).normal(size=(3, 32, 48)).astype(np.float32)
+    disp = denormalize_for_display(img)
+    assert disp.shape == (32, 48, 3) and disp.min() >= 0 and disp.max() <= 1
+
+    out = tmp_path / "img.png"
+    save_image(img, str(out))
+    assert out.stat().st_size > 0
+
+    out2 = tmp_path / "matches.png"
+    a = np.random.default_rng(1).uniform(size=(32, 48, 3))
+    b = np.random.default_rng(2).uniform(size=(40, 48, 3))
+    pa = np.array([[5.0, 6.0], [10.0, 12.0]])
+    pb = np.array([[7.0, 8.0], [11.0, 13.0]])
+    plot_matches_horizontal(a, b, pa, pb, str(out2), inliers=np.array([True, False]))
+    assert out2.stat().st_size > 0
