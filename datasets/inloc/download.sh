@@ -1,0 +1,4 @@
+#!/bin/sh
+# InLoc cutouts (RGBD panorama crops) + iPhone7 query images.
+wget http://www.ok.sc.e.titech.ac.jp/INLOC/materials/cutouts.tar.gz
+wget http://www.ok.sc.e.titech.ac.jp/INLOC/materials/iphone7.tar.gz

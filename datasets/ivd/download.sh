@@ -1,0 +1,4 @@
+#!/bin/sh
+# Indoor Venues Dataset: 3.7k street-level images fetched from urls.txt.
+sh make_dirs.sh
+<urls.txt xargs -n2 -P8 wget -O

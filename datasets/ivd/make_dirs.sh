@@ -1,0 +1,4 @@
+#!/bin/sh
+# Create the target directory tree for the IVD image fetch: urls.txt rows
+# are "<output-path> <url>", so the needed dirs are the unique dirnames.
+awk '{print $1}' urls.txt | xargs -n1 dirname | sort -u | xargs mkdir -p

@@ -1,0 +1,4 @@
+#!/bin/sh
+# PF-Pascal images + pair/keypoint annotations (see README of the dataset).
+wget https://www.di.ens.fr/willow/research/proposalflow/dataset/PF-dataset-PASCAL.zip
+unzip PF-dataset-PASCAL.zip 'PF-dataset-PASCAL/JPEGImages/*'

@@ -1,0 +1,4 @@
+#!/bin/sh
+# PF-Willow image pairs + keypoint annotations.
+wget https://www.di.ens.fr/willow/research/proposalflow/dataset/PF-dataset.zip
+unzip PF-dataset.zip

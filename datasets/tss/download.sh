@@ -1,0 +1,4 @@
+#!/bin/sh
+# TSS dense-flow benchmark (Taniai et al.): images + ground-truth .flo.
+wget http://www.hci.iis.u-tokyo.ac.jp/datasets/data/JointCosegFlow/dataset/TSS_CVPR2016.zip
+unzip TSS_CVPR2016.zip

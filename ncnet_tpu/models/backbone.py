@@ -39,6 +39,27 @@ RESNET_SPECS = {
     "resnet152": (3, 8, 36, 3),
 }
 
+# torchvision DenseNet family: (block_config, growth_rate, init_features).
+# The reference truncates densenet201 after transition2 (lib/model.py:69-73:
+# `features.children()[:-4]`), so only the first two dense blocks run.
+DENSENET_SPECS = {
+    "densenet201": ((6, 12, 48, 32), 32, 64),
+    "densenet121": ((6, 12, 24, 16), 32, 64),
+}
+DENSENET_BN_SIZE = 4  # bottleneck width multiplier (conv1 outputs bn_size*growth)
+
+# FPN pyramid width for the 'resnet101fpn' backbone. NOTE: the reference's
+# resnet101fpn option is dead code — `fpn_body` (lib/model.py:61) is never
+# imported or defined anywhere in its tree, so instantiating it raises
+# NameError. This implementation is therefore a working standard FPN
+# (Lin et al. 2017) over resnet101 layer1-3 with hypercolumn output at
+# stride 16: lateral 1x1 -> top-down nearest-upsample + add -> 3x3 smooth,
+# each level L2-normalized and pooled back to the stride-16 grid, then
+# concatenated (3 * 256 = 768 channels). Keeping the output at stride 16
+# preserves the downstream 4-D correlation shapes of the default backbone.
+FPN_CHANNELS = 256
+FPN_STAGES = 3  # layer1..layer3
+
 # torchvision vgg16.features layer sequence with the reference's layer names
 # (lib/model.py:27-31); ("pool*", 0, 0) entries are 2x2/2 max pools.
 VGG_CFG = (
@@ -54,8 +75,13 @@ VGG_CFG = (
 class BackboneConfig:
     """Static backbone architecture description (safe to close over in jit)."""
 
-    cnn: str = "resnet101"  # 'resnet101' | 'resnet50' | 'resnet152' | 'vgg'
+    # 'resnet101' | 'resnet50' | 'resnet152' | 'vgg' | 'densenet201' |
+    # 'densenet121' | 'resnet101fpn'
+    cnn: str = "resnet101"
     last_layer: str = ""  # '' -> 'layer3' (resnet) / 'pool4' (vgg)
+    # DenseNet truncation: number of (dense block, transition) pairs to run;
+    # 2 reproduces the reference's children()[:-4] cut at transition2.
+    densenet_blocks: int = 2
 
     @property
     def resolved_last_layer(self) -> str:
@@ -77,6 +103,16 @@ class BackboneConfig:
         return out
 
     @property
+    def densenet_channels(self):
+        """Per-point channel counts after each (block, transition) pair."""
+        block_config, growth, c = DENSENET_SPECS[self.cnn]
+        out = []
+        for n in block_config[: self.densenet_blocks]:
+            c = (c + n * growth) // 2  # dense block then halving transition
+            out.append(c)
+        return out
+
+    @property
     def out_channels(self) -> int:
         if self.cnn == "vgg":
             c = 0
@@ -84,6 +120,10 @@ class BackboneConfig:
                 if cout:
                     c = cout
             return c
+        if self.cnn in DENSENET_SPECS:
+            return self.densenet_channels[-1]
+        if self.cnn == "resnet101fpn":
+            return FPN_CHANNELS * FPN_STAGES
         return 64 * (2 ** (self.num_stages - 1)) * 4
 
 
@@ -186,14 +226,21 @@ def _bottleneck_apply(p: Params, x, stride: int):
     return jax.nn.relu(out + x)
 
 
-def resnet_apply(config: BackboneConfig, params: Params, x):
-    """Run the truncated ResNet on an NCHW float batch."""
+def resnet_stages(config: BackboneConfig, params: Params, x):
+    """Truncated-ResNet forward returning every stage output (layer1..N)."""
     x = jax.nn.relu(frozen_bn(conv2d(x, params["conv1"], stride=2, padding=3), params["bn1"]))
     x = max_pool(x, 3, 2, 1)
+    outs = []
     for stage, strides in enumerate(_stage_strides(config)):
         for block, stride in zip(params[f"layer{stage + 1}"], strides):
             x = _bottleneck_apply(block, x, stride)
-    return x
+        outs.append(x)
+    return outs
+
+
+def resnet_apply(config: BackboneConfig, params: Params, x):
+    """Run the truncated ResNet on an NCHW float batch."""
+    return resnet_stages(config, params, x)[-1]
 
 
 def vgg_init(key, config: BackboneConfig) -> Params:
@@ -218,15 +265,150 @@ def vgg_apply(config: BackboneConfig, params: Params, x):
     return x
 
 
+def avg_pool(x, window: int, stride: int):
+    """Torch-style average pool (no padding)."""
+    summed = lax.reduce_window(
+        x,
+        0.0,
+        lax.add,
+        window_dimensions=(1, 1, window, window),
+        window_strides=(1, 1, stride, stride),
+        padding="VALID",
+    )
+    return summed / float(window * window)
+
+
+def _dense_layer_init(key, cin, growth):
+    k1, k2 = jax.random.split(key)
+    bottleneck = DENSENET_BN_SIZE * growth
+    return {
+        "norm1": _bn_init(cin),
+        "conv1": _conv_init(k1, 1, 1, cin, bottleneck),
+        "norm2": _bn_init(bottleneck),
+        "conv2": _conv_init(k2, 3, 3, bottleneck, growth),
+    }
+
+
+def densenet_init(key, config: BackboneConfig) -> Params:
+    """Truncated torchvision-DenseNet params (conv0 .. transition<k>)."""
+    block_config, growth, c = DENSENET_SPECS[config.cnn]
+    key, k0 = jax.random.split(key)
+    params: Params = {"conv0": _conv_init(k0, 7, 7, 3, c), "norm0": _bn_init(c)}
+    for b, n_layers in enumerate(block_config[: config.densenet_blocks]):
+        layers = []
+        for _ in range(n_layers):
+            key, kl = jax.random.split(key)
+            layers.append(_dense_layer_init(kl, c, growth))
+            c += growth
+        params[f"block{b + 1}"] = layers
+        key, kt = jax.random.split(key)
+        params[f"trans{b + 1}"] = {"norm": _bn_init(c), "conv": _conv_init(kt, 1, 1, c, c // 2)}
+        c //= 2
+    return params
+
+
+def densenet_apply(config: BackboneConfig, params: Params, x):
+    """Truncated DenseNet forward (parity: torchvision densenet.features up
+    to transition2, the reference's cut at lib/model.py:69-73)."""
+    x = conv2d(x, params["conv0"], stride=2, padding=3)
+    x = jax.nn.relu(frozen_bn(x, params["norm0"]))
+    x = max_pool(x, 3, 2, 1)
+    for b in range(config.densenet_blocks):
+        for layer in params[f"block{b + 1}"]:
+            y = jax.nn.relu(frozen_bn(x, layer["norm1"]))
+            y = conv2d(y, layer["conv1"])
+            y = jax.nn.relu(frozen_bn(y, layer["norm2"]))
+            y = conv2d(y, layer["conv2"], padding=1)
+            x = jnp.concatenate([x, y], axis=1)
+        trans = params[f"trans{b + 1}"]
+        x = conv2d(jax.nn.relu(frozen_bn(x, trans["norm"])), trans["conv"])
+        x = avg_pool(x, 2, 2)
+    return x
+
+
+def _upsample2x_to(x, like):
+    """Nearest-neighbour 2x upsample, cropped to `like`'s spatial dims."""
+    up = jnp.repeat(jnp.repeat(x, 2, axis=2), 2, axis=3)
+    return up[:, :, : like.shape[2], : like.shape[3]]
+
+
+def fpn_init(key, config: BackboneConfig) -> Params:
+    """FPN over a resnet101 trunk (see the dead-code note by FPN_CHANNELS)."""
+    trunk_cfg = dataclasses.replace(config, cnn="resnet101", last_layer="layer3")
+    key, kt = jax.random.split(key)
+    params: Params = {"trunk": resnet_init(kt, trunk_cfg)}
+    laterals, smooths = [], []
+    for stage in range(FPN_STAGES):
+        cin = 64 * (2**stage) * 4  # 256 / 512 / 1024
+        key, kl, ks = jax.random.split(key, 3)
+        laterals.append(
+            {"w": _conv_init(kl, 1, 1, cin, FPN_CHANNELS), "b": jnp.zeros((FPN_CHANNELS,), jnp.float32)}
+        )
+        smooths.append(
+            {"w": _conv_init(ks, 3, 3, FPN_CHANNELS, FPN_CHANNELS), "b": jnp.zeros((FPN_CHANNELS,), jnp.float32)}
+        )
+    params["lateral"] = laterals
+    params["smooth"] = smooths
+    return params
+
+
+def fpn_apply(config: BackboneConfig, params: Params, x):
+    """FPN hypercolumn features at stride 16 (768 channels).
+
+    Lateral 1x1 projections of layer1..layer3, top-down pathway with
+    nearest upsampling, 3x3 smoothing, per-level L2 normalization, and
+    average-pooling of the finer levels back onto the stride-16 grid
+    before channel concatenation (so downstream 4-D correlation shapes
+    match the plain resnet101/layer3 backbone).
+    """
+    trunk_cfg = dataclasses.replace(config, cnn="resnet101", last_layer="layer3")
+    stage_outs = resnet_stages(trunk_cfg, params["trunk"], x)
+
+    def proj(layer, v):
+        return conv2d(v, layer["w"]) + layer["b"].reshape(1, -1, 1, 1)
+
+    def smooth(layer, v):
+        return conv2d(v, layer["w"], padding=1) + layer["b"].reshape(1, -1, 1, 1)
+
+    # Top-down: p[2] (stride 16) -> p[0] (stride 4).
+    p = [None] * FPN_STAGES
+    p[2] = proj(params["lateral"][2], stage_outs[2])
+    p[1] = proj(params["lateral"][1], stage_outs[1]) + _upsample2x_to(p[2], stage_outs[1])
+    p[0] = proj(params["lateral"][0], stage_outs[0]) + _upsample2x_to(p[1], stage_outs[0])
+    p = [smooth(s, v) for s, v in zip(params["smooth"], p)]
+
+    # Hypercolumns on the stride-16 grid, each level L2-normalized. The
+    # finer levels are resized (not floor-pooled) onto p[2]'s exact grid so
+    # the output spatial shape always equals the plain layer3 backbone's,
+    # including sizes not divisible by 16.
+    eps = 1e-6
+    tgt = p[2].shape
+    levels = [
+        jax.image.resize(p[0], (tgt[0], FPN_CHANNELS, tgt[2], tgt[3]), "linear"),
+        jax.image.resize(p[1], (tgt[0], FPN_CHANNELS, tgt[2], tgt[3]), "linear"),
+        p[2],
+    ]
+    levels = [v / jnp.sqrt(jnp.sum(v * v, axis=1, keepdims=True) + eps) for v in levels]
+    return jnp.concatenate(levels, axis=1)
+
+
 def backbone_init(key, config: BackboneConfig) -> Params:
     if config.cnn in RESNET_SPECS:
         return resnet_init(key, config)
     if config.cnn == "vgg":
         return vgg_init(key, config)
+    if config.cnn in DENSENET_SPECS:
+        return densenet_init(key, config)
+    if config.cnn == "resnet101fpn":
+        return fpn_init(key, config)
     raise ValueError(f"unknown backbone {config.cnn!r}")
 
 
 def backbone_apply(config: BackboneConfig, params: Params, x):
     if config.cnn in RESNET_SPECS:
         return resnet_apply(config, params, x)
+    if config.cnn in DENSENET_SPECS:
+        return densenet_apply(config, params, x)
+    if config.cnn == "resnet101fpn":
+        return fpn_apply(config, params, x)
     return vgg_apply(config, params, x)

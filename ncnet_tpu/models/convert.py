@@ -26,7 +26,7 @@ from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .backbone import BackboneConfig, RESNET_SPECS
+from .backbone import BackboneConfig, DENSENET_SPECS, RESNET_SPECS
 
 
 def _np(x) -> np.ndarray:
@@ -126,6 +126,44 @@ def convert_vgg_state_dict(
     return {"layers": layers}
 
 
+def convert_densenet_state_dict(
+    sd: Mapping[str, Any], config: BackboneConfig, prefix: str = ""
+) -> Dict[str, Any]:
+    """Map a torchvision DenseNet features state dict onto the backbone pytree.
+
+    torchvision names: features.conv0 / norm0, features.denseblock<b>.
+    denselayer<l>.{norm1,conv1,norm2,conv2}, features.transition<b>.
+    {norm,conv}. A 'features.' component inside `prefix` (or none, for
+    state dicts saved from the truncated nn.Sequential) is handled by the
+    caller's prefix argument.
+    """
+    block_config, _, _ = DENSENET_SPECS[config.cnn]
+
+    params: Dict[str, Any] = {
+        "conv0": _conv2d_w(sd[f"{prefix}conv0.weight"]),
+        "norm0": _bn(sd, f"{prefix}norm0"),
+    }
+    for b in range(1, config.densenet_blocks + 1):
+        layers = []
+        for l in range(1, block_config[b - 1] + 1):
+            lp = f"{prefix}denseblock{b}.denselayer{l}"
+            layers.append(
+                {
+                    "norm1": _bn(sd, f"{lp}.norm1"),
+                    "conv1": _conv2d_w(sd[f"{lp}.conv1.weight"]),
+                    "norm2": _bn(sd, f"{lp}.norm2"),
+                    "conv2": _conv2d_w(sd[f"{lp}.conv2.weight"]),
+                }
+            )
+        params[f"block{b}"] = layers
+        tp = f"{prefix}transition{b}"
+        params[f"trans{b}"] = {
+            "norm": _bn(sd, f"{tp}.norm"),
+            "conv": _conv2d_w(sd[f"{tp}.conv.weight"]),
+        }
+    return params
+
+
 def convert_conv4d_weight(w, pre_permuted: bool = True) -> np.ndarray:
     """Convert a reference Conv4d weight to [kI, kJ, kK, kL, cin, cout].
 
@@ -174,13 +212,34 @@ def load_reference_checkpoint(path: str):
     kernel_sizes = tuple(getattr(args, "ncons_kernel_sizes", (3, 3, 3)))
     channels = tuple(getattr(args, "ncons_channels", (10, 10, 1)))
     fe_prefix = "FeatureExtraction.model."
-    is_vgg = any(k.startswith(fe_prefix + "0.weight") for k in sd) and not any(
-        ".layer3." in k or k.startswith(fe_prefix + "4.") for k in sd
+    is_densenet = any(".denselayer" in k for k in sd)
+    is_vgg = (
+        not is_densenet
+        and any(k.startswith(fe_prefix + "0.weight") for k in sd)
+        and not any(".layer3." in k or k.startswith(fe_prefix + "4.") for k in sd)
     )
-    config = BackboneConfig(cnn="vgg" if is_vgg else "resnet101")
-    if config.cnn == "vgg":
+    if is_densenet:
+        config = BackboneConfig(cnn="densenet201")
+        # The truncated nn.Sequential (lib/model.py:69-73) renames the
+        # features children to indices: 0=conv0, 1=norm0, 4=denseblock1,
+        # 5=transition1, 6=denseblock2, 7=transition2.
+        index_map = {
+            "0": "conv0", "1": "norm0", "4": "denseblock1",
+            "5": "transition1", "6": "denseblock2", "7": "transition2",
+        }
+        remapped = dict(sd)
+        for k in list(sd):
+            if k.startswith(fe_prefix):
+                rest = k[len(fe_prefix):]
+                head, _, tail = rest.partition(".")
+                if head in index_map:
+                    remapped[fe_prefix + index_map[head] + "." + tail] = sd[k]
+        backbone = convert_densenet_state_dict(remapped, config, fe_prefix)
+    elif is_vgg:
+        config = BackboneConfig(cnn="vgg")
         backbone = convert_vgg_state_dict(sd, config, fe_prefix)
     else:
+        config = BackboneConfig(cnn="resnet101")
         backbone = convert_resnet_state_dict(sd, config, fe_prefix)
     ncons = convert_neigh_consensus_state_dict(sd, kernel_sizes)
     params = {"backbone": backbone, "neigh_consensus": ncons}

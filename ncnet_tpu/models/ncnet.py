@@ -80,9 +80,13 @@ def ncnet_init(key, config: NCNetConfig) -> Params:
 
 
 def extract_features(config: NCNetConfig, params: Params, image):
-    """Backbone features with optional L2 normalization (lib/model.py:83-87)."""
+    """Backbone features with optional L2 normalization (lib/model.py:83-87).
+
+    The FPN backbone normalizes per pyramid level internally, so the
+    outer normalization is skipped for it (parity: lib/model.py:85).
+    """
     feats = backbone_apply(config.backbone, params["backbone"], image)
-    if config.normalize_features:
+    if config.normalize_features and config.backbone.cnn != "resnet101fpn":
         feats = feature_l2norm(feats)
     return feats
 

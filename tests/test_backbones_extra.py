@@ -1,0 +1,159 @@
+"""DenseNet + FPN backbone tests: torch functional oracle for the DenseNet
+forward/conversion; structural + pipeline tests for the FPN hypercolumns."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models.backbone import (
+    BackboneConfig,
+    DENSENET_SPECS,
+    FPN_CHANNELS,
+    FPN_STAGES,
+    backbone_apply,
+    backbone_init,
+)
+from ncnet_tpu.models.convert import convert_densenet_state_dict
+from ncnet_tpu.models.ncnet import NCNetConfig, ncnet_forward, ncnet_init
+
+
+def make_densenet_state_dict(arch="densenet201", n_pairs=2, seed=0):
+    """Random torchvision-style DenseNet features state dict (truncated)."""
+    g = torch.Generator().manual_seed(seed)
+    block_config, growth, c0 = DENSENET_SPECS[arch]
+    bn_size = 4
+    sd = {}
+
+    def add_bn(prefix, c):
+        sd[f"{prefix}.weight"] = torch.randn(c, generator=g) * 0.1 + 1
+        sd[f"{prefix}.bias"] = torch.randn(c, generator=g) * 0.1
+        sd[f"{prefix}.running_mean"] = torch.randn(c, generator=g) * 0.1
+        sd[f"{prefix}.running_var"] = torch.rand(c, generator=g) + 0.5
+        sd[f"{prefix}.num_batches_tracked"] = torch.tensor(1)
+
+    sd["conv0.weight"] = torch.randn(c0, 3, 7, 7, generator=g) * 0.05
+    add_bn("norm0", c0)
+    c = c0
+    for b in range(1, n_pairs + 1):
+        for l in range(1, block_config[b - 1] + 1):
+            p = f"denseblock{b}.denselayer{l}"
+            add_bn(f"{p}.norm1", c)
+            sd[f"{p}.conv1.weight"] = torch.randn(bn_size * growth, c, 1, 1, generator=g) * 0.05
+            add_bn(f"{p}.norm2", bn_size * growth)
+            sd[f"{p}.conv2.weight"] = torch.randn(growth, bn_size * growth, 3, 3, generator=g) * 0.05
+            c += growth
+        add_bn(f"transition{b}.norm", c)
+        sd[f"transition{b}.conv.weight"] = torch.randn(c // 2, c, 1, 1, generator=g) * 0.05
+        c //= 2
+    return sd
+
+
+def torch_densenet_forward(sd, x, arch="densenet201", n_pairs=2):
+    """Functional torchvision-DenseNet forward from a raw state dict."""
+    block_config, _, _ = DENSENET_SPECS[arch]
+
+    def bn(v, p):
+        return F.batch_norm(
+            v, sd[f"{p}.running_mean"], sd[f"{p}.running_var"],
+            sd[f"{p}.weight"], sd[f"{p}.bias"], training=False,
+        )
+
+    v = F.conv2d(x, sd["conv0.weight"], stride=2, padding=3)
+    v = F.max_pool2d(F.relu(bn(v, "norm0")), 3, 2, 1)
+    for b in range(1, n_pairs + 1):
+        for l in range(1, block_config[b - 1] + 1):
+            p = f"denseblock{b}.denselayer{l}"
+            y = F.conv2d(F.relu(bn(v, f"{p}.norm1")), sd[f"{p}.conv1.weight"])
+            y = F.conv2d(F.relu(bn(y, f"{p}.norm2")), sd[f"{p}.conv2.weight"], padding=1)
+            v = torch.cat([v, y], dim=1)
+        v = F.conv2d(F.relu(bn(v, f"transition{b}.norm")), sd[f"transition{b}.conv.weight"])
+        v = F.avg_pool2d(v, 2, 2)
+    return v
+
+
+class TestDenseNet:
+    def test_forward_matches_torch_oracle(self):
+        config = BackboneConfig(cnn="densenet201", densenet_blocks=2)
+        sd = make_densenet_state_dict()
+        params = convert_densenet_state_dict(sd, config)
+
+        x = torch.randn(2, 3, 64, 64, generator=torch.Generator().manual_seed(1))
+        want = torch_densenet_forward(sd, x).numpy()
+        got = np.asarray(backbone_apply(config, params, jnp.asarray(x.numpy())))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_out_channels_and_stride(self):
+        config = BackboneConfig(cnn="densenet201")
+        params = backbone_init(jax.random.PRNGKey(0), config)
+        out = backbone_apply(config, params, jnp.zeros((1, 3, 64, 64)))
+        # conv0/2 + pool/2 + trans1/2 + trans2/2 = stride 16; 256 channels.
+        assert out.shape == (1, 256, 4, 4)
+        assert config.out_channels == 256
+
+    def test_converter_prefix(self):
+        config = BackboneConfig(cnn="densenet201", densenet_blocks=1)
+        sd = make_densenet_state_dict(n_pairs=1)
+        prefixed = {f"features.{k}": v for k, v in sd.items()}
+        a = convert_densenet_state_dict(sd, config)
+        b = convert_densenet_state_dict(prefixed, config, prefix="features.")
+        np.testing.assert_array_equal(a["conv0"], b["conv0"])
+
+
+class TestFPN:
+    def test_shapes_and_normalization(self):
+        config = BackboneConfig(cnn="resnet101fpn")
+        assert config.out_channels == FPN_CHANNELS * FPN_STAGES
+        # Small trunk for test speed: patch spec via resnet50-sized trunk is
+        # not exposed, so run the real structure on a tiny image.
+        params = backbone_init(jax.random.PRNGKey(0), config)
+        out = backbone_apply(config, params, jnp.zeros((1, 3, 64, 64)) + 0.1)
+        assert out.shape == (1, 768, 4, 4)  # stride 16 hypercolumns
+        # Each 256-channel level is L2-normalized per position.
+        out = np.asarray(out)
+        for lvl in range(FPN_STAGES):
+            norms = np.linalg.norm(out[:, lvl * 256 : (lvl + 1) * 256], axis=1)
+            np.testing.assert_allclose(norms, 1.0, atol=1e-3)
+
+    def test_shape_parity_with_layer3_at_awkward_sizes(self):
+        # 100x100 -> layer3 grid is 7x7 (not divisible by 16); the FPN
+        # hypercolumns must land on the same grid, not a floor-pooled 6x6.
+        fpn_cfg = BackboneConfig(cnn="resnet101fpn")
+        plain_cfg = BackboneConfig(cnn="resnet101")
+        fpn_params = backbone_init(jax.random.PRNGKey(0), fpn_cfg)
+        plain_params = backbone_init(jax.random.PRNGKey(0), plain_cfg)
+        x = jnp.zeros((1, 3, 100, 100)) + 0.1
+        fpn_out = backbone_apply(fpn_cfg, fpn_params, x)
+        plain_out = backbone_apply(plain_cfg, plain_params, x)
+        assert fpn_out.shape[2:] == plain_out.shape[2:]
+
+    def test_ncnet_forward_with_fpn(self):
+        config = NCNetConfig(
+            backbone=BackboneConfig(cnn="resnet101fpn"),
+            ncons_kernel_sizes=(3,),
+            ncons_channels=(1,),
+        )
+        params = ncnet_init(jax.random.PRNGKey(0), config)
+        src = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 48, 48))
+        corr, delta = ncnet_forward(config, params, src, src)
+        assert corr.shape == (1, 1, 3, 3, 3, 3)
+        assert delta is None
+        assert np.all(np.isfinite(np.asarray(corr)))
+
+
+class TestDenseNetInNCNet:
+    def test_ncnet_forward_with_densenet(self):
+        config = NCNetConfig(
+            backbone=BackboneConfig(cnn="densenet201"),
+            ncons_kernel_sizes=(3,),
+            ncons_channels=(1,),
+        )
+        params = ncnet_init(jax.random.PRNGKey(0), config)
+        src = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 48, 48))
+        corr, _ = ncnet_forward(config, params, src, src)
+        assert corr.shape == (1, 1, 3, 3, 3, 3)
+        assert np.all(np.isfinite(np.asarray(corr)))

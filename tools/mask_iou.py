@@ -1,0 +1,45 @@
+"""Mask IoU scoring (parity target: tools/simpleMatch.py).
+
+Note on the reference: its `matchScore` computes "union" as
+`(realMask + synMask) > 1`, which is the INTERSECTION again, so the
+returned "IoU" is identically 1.0 wherever the masks overlap at all
+(tools/simpleMatch.py:13-15). That is a defect, not a behavior to
+replicate; this implementation computes the actual intersection over
+union.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def match_score(real_mask: np.ndarray, syn_mask: np.ndarray, threshold: float = 1.0) -> float:
+    """IoU of the two masks binarized at `value > threshold`.
+
+    Returns 0.0 when the union is empty (both masks blank).
+    """
+    a = np.asarray(real_mask) > threshold
+    b = np.asarray(syn_mask) > threshold
+    union = np.logical_or(a, b).sum()
+    if union == 0:
+        return 0.0
+    return float(np.logical_and(a, b).sum() / union)
+
+
+def main(argv=None):
+    import argparse
+
+    from PIL import Image
+
+    p = argparse.ArgumentParser(description="IoU of two binarized mask images")
+    p.add_argument("real_mask")
+    p.add_argument("syn_mask")
+    p.add_argument("--threshold", type=float, default=1.0)
+    args = p.parse_args(argv)
+    a = np.asarray(Image.open(args.real_mask).convert("L"))
+    b = np.asarray(Image.open(args.syn_mask).convert("L"))
+    print(match_score(a, b, args.threshold))
+
+
+if __name__ == "__main__":
+    main()

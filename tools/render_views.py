@@ -1,0 +1,186 @@
+"""Orbit-view renderer for .obj meshes (parity target: tools/render_blender.py).
+
+The reference drives Blender to render N orbit views of an object plus
+depth / normal / albedo passes, as a synthetic-data side tool. This is a
+dependency-free numpy software rasterizer producing the same outputs
+(RGB shaded view, depth map, normal map, albedo) without Blender:
+triangle z-buffer rasterization with barycentric interpolation and
+Lambertian shading.
+
+Usage:
+    python tools/render_views.py model.obj --views 8 --size 256 --output_folder out/
+Writes view_###.png, depth_###.png, normal_###.png, albedo_###.png.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def load_obj(path: str):
+    """Minimal .obj reader: v / f records (faces triangulated by fanning)."""
+    verts, faces = [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            if parts[0] == "v":
+                verts.append([float(x) for x in parts[1:4]])
+            elif parts[0] == "f":
+                idx = [int(tok.split("/")[0]) - 1 for tok in parts[1:]]
+                for k in range(1, len(idx) - 1):
+                    faces.append([idx[0], idx[k], idx[k + 1]])
+    return np.asarray(verts, dtype=np.float64), np.asarray(faces, dtype=np.int64)
+
+
+def normalize_mesh(verts: np.ndarray, scale: float = 1.0) -> np.ndarray:
+    """Center at origin and fit in the unit sphere (times `scale`)."""
+    c = (verts.max(axis=0) + verts.min(axis=0)) / 2.0
+    v = verts - c
+    r = np.linalg.norm(v, axis=1).max()
+    return v / (r if r > 0 else 1.0) * scale
+
+
+def look_at(eye: np.ndarray, target: np.ndarray, up=(0.0, 0.0, 1.0)):
+    """World->camera [R|t] with -z... +z forward (camera looks along +z)."""
+    fwd = target - eye
+    fwd = fwd / np.linalg.norm(fwd)
+    right = np.cross(fwd, np.asarray(up, dtype=np.float64))
+    if np.linalg.norm(right) < 1e-9:
+        right = np.cross(fwd, np.array([0.0, 1.0, 0.0]))
+    right /= np.linalg.norm(right)
+    down = np.cross(fwd, right)
+    R = np.stack([right, down, fwd])
+    t = -R @ eye
+    return R, t
+
+
+def render_mesh(
+    verts: np.ndarray,
+    faces: np.ndarray,
+    R: np.ndarray,
+    t: np.ndarray,
+    size: int = 256,
+    focal: float | None = None,
+    light_dir=(0.3, -0.5, -0.8),
+):
+    """Rasterize one view. Returns dict with rgb/depth/normal/albedo arrays."""
+    focal = focal if focal is not None else size * 1.2
+    K = np.array([[focal, 0, size / 2.0], [0, focal, size / 2.0], [0, 0, 1.0]])
+
+    cam = verts @ R.T + t  # [n, 3]
+    tri = cam[faces]  # [f, 3, 3]
+
+    # Face normals in camera space; backface culling.
+    n = np.cross(tri[:, 1] - tri[:, 0], tri[:, 2] - tri[:, 0])
+    norm_len = np.linalg.norm(n, axis=1, keepdims=True)
+    ok = (norm_len[:, 0] > 1e-12) & (tri[:, :, 2].min(axis=1) > 1e-6)
+    n = np.where(norm_len > 1e-12, n / np.maximum(norm_len, 1e-12), 0.0)
+    facing = n[:, 2] < 0  # normal towards the camera (camera looks +z)
+    keep = ok & facing
+    tri, n = tri[keep], n[keep]
+
+    light = np.asarray(light_dir, dtype=np.float64)
+    light /= np.linalg.norm(light)
+    albedo_face = np.full((tri.shape[0], 3), 0.7)
+    shade = np.clip(-(n @ light), 0.1, 1.0)
+
+    proj = tri @ K.T
+    uv = proj[:, :, :2] / proj[:, :, 2:3]  # [f, 3, 2]
+
+    depth = np.full((size, size), np.inf)
+    rgb = np.zeros((size, size, 3))
+    normal_map = np.zeros((size, size, 3))
+    albedo_map = np.zeros((size, size, 3))
+
+    for f in range(tri.shape[0]):
+        p = uv[f]
+        zs = tri[f, :, 2]
+        xmin = max(int(np.floor(p[:, 0].min())), 0)
+        xmax = min(int(np.ceil(p[:, 0].max())) + 1, size)
+        ymin = max(int(np.floor(p[:, 1].min())), 0)
+        ymax = min(int(np.ceil(p[:, 1].max())) + 1, size)
+        if xmin >= xmax or ymin >= ymax:
+            continue
+        xs, ys = np.meshgrid(np.arange(xmin, xmax) + 0.5, np.arange(ymin, ymax) + 0.5)
+        # Barycentric coordinates via the edge-function determinants.
+        d = (p[1, 1] - p[2, 1]) * (p[0, 0] - p[2, 0]) + (p[2, 0] - p[1, 0]) * (p[0, 1] - p[2, 1])
+        if abs(d) < 1e-12:
+            continue
+        w0 = ((p[1, 1] - p[2, 1]) * (xs - p[2, 0]) + (p[2, 0] - p[1, 0]) * (ys - p[2, 1])) / d
+        w1 = ((p[2, 1] - p[0, 1]) * (xs - p[2, 0]) + (p[0, 0] - p[2, 0]) * (ys - p[2, 1])) / d
+        w2 = 1.0 - w0 - w1
+        inside = (w0 >= 0) & (w1 >= 0) & (w2 >= 0)
+        if not inside.any():
+            continue
+        # Perspective-correct depth: interpolate 1/z.
+        zinv = w0 / zs[0] + w1 / zs[1] + w2 / zs[2]
+        z = 1.0 / np.maximum(zinv, 1e-12)
+        yy, xx = np.nonzero(inside)
+        gy, gx = yy + ymin, xx + xmin
+        zf = z[inside]
+        closer = zf < depth[gy, gx]
+        gy, gx, zf = gy[closer], gx[closer], zf[closer]
+        depth[gy, gx] = zf
+        rgb[gy, gx] = albedo_face[f] * shade[f]
+        normal_map[gy, gx] = (-n[f] + 1.0) / 2.0  # [-1,1] -> [0,1], camera-facing
+        albedo_map[gy, gx] = albedo_face[f]
+
+    mask = np.isfinite(depth)
+    return {"rgb": rgb, "depth": depth, "normal": normal_map, "albedo": albedo_map, "mask": mask}
+
+
+def orbit_views(n_views: int, radius: float = 2.5, elevation_deg: float = 20.0):
+    """Camera (R, t) for N equally-spaced azimuths at fixed elevation."""
+    out = []
+    el = np.deg2rad(elevation_deg)
+    for i in range(n_views):
+        az = 2.0 * np.pi * i / n_views
+        eye = radius * np.array([np.cos(az) * np.cos(el), np.sin(az) * np.cos(el), np.sin(el)])
+        out.append(look_at(eye, np.zeros(3)))
+    return out
+
+
+def _save_png(path: str, arr: np.ndarray) -> None:
+    from PIL import Image
+
+    Image.fromarray((np.clip(arr, 0, 1) * 255).astype(np.uint8)).save(path)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Render orbit views of an .obj (no Blender)")
+    p.add_argument("obj")
+    p.add_argument("--views", type=int, default=30)
+    p.add_argument("--output_folder", default="")
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--size", type=int, default=256)
+    p.add_argument("--depth_scale", type=float, default=1.4)
+    args = p.parse_args(argv)
+
+    out_dir = args.output_folder or os.path.splitext(args.obj)[0] + "_views"
+    os.makedirs(out_dir, exist_ok=True)
+
+    verts, faces = load_obj(args.obj)
+    verts = normalize_mesh(verts, args.scale)
+    for i, (R, t) in enumerate(orbit_views(args.views)):
+        view = render_mesh(verts, faces, R, t, size=args.size)
+        _save_png(os.path.join(out_dir, f"view_{i:03d}.png"), view["rgb"])
+        d = view["depth"].copy()
+        finite = np.isfinite(d)
+        dn = np.zeros_like(d)
+        if finite.any():
+            dmin, dmax = d[finite].min(), d[finite].max()
+            dn[finite] = 1.0 - (d[finite] - dmin) / max((dmax - dmin) * args.depth_scale / 1.4, 1e-9)
+        _save_png(os.path.join(out_dir, f"depth_{i:03d}.png"), np.repeat(dn[:, :, None], 3, 2))
+        _save_png(os.path.join(out_dir, f"normal_{i:03d}.png"), view["normal"])
+        _save_png(os.path.join(out_dir, f"albedo_{i:03d}.png"), view["albedo"])
+        print(f"rendered view {i + 1}/{args.views}", flush=True)
+    print(f"wrote {args.views} views to {out_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+#!/bin/sh
+# Published reference checkpoints; load with --checkpoint <file>.pth.tar
+# (ncnet_tpu converts them on the fly, models/convert.py).
+wget https://www.di.ens.fr/willow/research/ncnet/models/ncnet_pfpascal.pth.tar
+wget https://www.di.ens.fr/willow/research/ncnet/models/ncnet_ivd.pth.tar
