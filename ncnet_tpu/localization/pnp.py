@@ -216,6 +216,7 @@ def lo_ransac_p3p(
     max_iters: int = 10000,
     seed: int = 0,
     lo_iters: int = 10,
+    backend: str = "auto",
 ) -> RansacResult:
     """LO-RANSAC over batched Grunert P3P.
 
@@ -226,10 +227,26 @@ def lo_ransac_p3p(
                 pnp_thr * pi / 180 with pnp_thr = 0.2 degrees,
                 compute_densePE_NCNet.m:34).
     max_iters:  number of minimal samples (all solved in one batch).
+    backend:    'auto' (native C++ solver when built, else numpy),
+                'native', or 'numpy'. The two backends draw different
+                random samples but implement the same solver and accept
+                rules.
 
     Returns RansacResult with P = [R|t] (world->camera) and the inlier
     mask under the final locally-optimized pose.
     """
+    if backend not in ("auto", "native", "numpy"):
+        raise ValueError(f"unknown backend {backend!r}; use 'auto', 'native', or 'numpy'")
+    if backend != "numpy":
+        from ncnet_tpu import native
+
+        if native.available():
+            return native.lo_ransac_p3p_native(
+                rays, points, inlier_thr,
+                max_iters=max_iters, seed=seed, lo_iters=lo_iters,
+            )
+        if backend == "native":
+            raise RuntimeError("native P3P backend requested but unavailable")
     rays = _normalize_rows(np.asarray(rays, dtype=np.float64))
     points = np.asarray(points, dtype=np.float64)
     n = rays.shape[0]

@@ -1,0 +1,170 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The shared library is built on demand from the checked-in C++ sources
+with g++ (no pybind11 / external build deps); the build is cached next
+to the sources and rebuilt when they change. Everything here is
+optional: callers fall back to the pure-numpy implementations when the
+toolchain is unavailable.
+
+Contents:
+  * p3p_ransac.cpp — LO-RANSAC P3P absolute-pose solver (OpenMP), the
+    native equivalent of the reference's Matlab parfor + ht_lo_ransac_p3p
+    stage (lib_matlab/parfor_NC4D_PE_pnponly.m:25,77).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "p3p_ransac.cpp")
+_LIB = os.path.join(_DIR, "libncnet_p3p.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def build(force: bool = False) -> str:
+    """Compile the shared library if missing or stale. Returns its path."""
+    stale = (
+        force
+        or not os.path.exists(_LIB)
+        or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    )
+    if stale:
+        # Per-process tmp name + atomic rename: concurrent builders (e.g.
+        # pytest-xdist workers) each write their own file and the last
+        # os.replace wins with a complete library either way.
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-fopenmp",
+            _SRC, "-o", tmp,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as exc:
+            detail = getattr(exc, "stderr", "") or str(exc)
+            raise RuntimeError(f"native build failed: {detail}") from exc
+        os.replace(tmp, _LIB)
+    return _LIB
+
+
+def load():
+    """Load (building if needed) the native library, or None on failure."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            lib = ctypes.CDLL(build())
+        except (RuntimeError, OSError):
+            _load_failed = True
+            return None
+        lib.ncnet_lo_ransac_p3p.restype = ctypes.c_int
+        lib.ncnet_lo_ransac_p3p.argtypes = [
+            ctypes.POINTER(ctypes.c_double),  # rays
+            ctypes.POINTER(ctypes.c_double),  # points
+            ctypes.c_int,                     # n
+            ctypes.c_double,                  # inlier_thr
+            ctypes.c_int,                     # max_iters
+            ctypes.c_uint64,                  # seed
+            ctypes.c_int,                     # lo_iters
+            ctypes.POINTER(ctypes.c_double),  # P_out [12]
+            ctypes.POINTER(ctypes.c_uint8),   # inliers_out [n]
+            ctypes.POINTER(ctypes.c_double),  # mean_err_out
+        ]
+        lib.ncnet_p3p_solve.restype = ctypes.c_int
+        lib.ncnet_p3p_solve.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.ncnet_p3p_num_threads.restype = ctypes.c_int
+        lib.ncnet_p3p_num_threads.argtypes = []
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def num_threads() -> int:
+    lib = load()
+    return int(lib.ncnet_p3p_num_threads()) if lib else 0
+
+
+def _as_c(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def p3p_solve_native(rays: np.ndarray, points: np.ndarray) -> np.ndarray:
+    """Candidate poses for ONE minimal sample. rays/points: [3, 3].
+
+    Returns [k, 3, 4] with k in 0..4.
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    rays = np.ascontiguousarray(rays, dtype=np.float64)
+    points = np.ascontiguousarray(points, dtype=np.float64)
+    if rays.shape != (3, 3) or points.shape != (3, 3):
+        raise ValueError(
+            f"expected rays/points of shape (3, 3), got {rays.shape}/{points.shape}"
+        )
+    out = np.empty(48, dtype=np.float64)
+    k = lib.ncnet_p3p_solve(_as_c(rays), _as_c(points), _as_c(out))
+    return out[: 12 * k].reshape(k, 3, 4)
+
+
+def lo_ransac_p3p_native(
+    rays: np.ndarray,
+    points: np.ndarray,
+    inlier_thr: float,
+    max_iters: int = 10000,
+    seed: int = 0,
+    lo_iters: int = 10,
+):
+    """Native LO-RANSAC P3P; same contract as localization.pnp.lo_ransac_p3p.
+
+    The ctypes call releases the GIL, so per-query problems can also be
+    fanned out over a Python thread pool on top of the solver's own
+    OpenMP hypothesis parallelism.
+    """
+    from ncnet_tpu.localization.pnp import RansacResult
+
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    rays = np.ascontiguousarray(rays, dtype=np.float64)
+    points = np.ascontiguousarray(points, dtype=np.float64)
+    if rays.ndim != 2 or rays.shape[1] != 3 or points.shape != rays.shape:
+        raise ValueError(
+            f"expected matching [n, 3] rays/points, got {rays.shape}/{points.shape}"
+        )
+    n = int(rays.shape[0])
+    if n < 3:
+        return RansacResult(P=np.full((3, 4), np.nan), inliers=np.zeros(n, dtype=bool))
+    P = np.empty(12, dtype=np.float64)
+    inl = np.zeros(n, dtype=np.uint8)
+    err = ctypes.c_double(float("inf"))
+    cnt = lib.ncnet_lo_ransac_p3p(
+        _as_c(rays), _as_c(points), n,
+        float(inlier_thr), int(max_iters), int(seed) & (2**64 - 1), int(lo_iters),
+        _as_c(P), inl.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.byref(err),
+    )
+    if cnt < 0:
+        return RansacResult(P=np.full((3, 4), np.nan), inliers=np.zeros(n, dtype=bool))
+    return RansacResult(
+        P=P.reshape(3, 4),
+        inliers=inl.astype(bool),
+        num_inliers=int(cnt),
+        inlier_error=float(err.value),
+    )

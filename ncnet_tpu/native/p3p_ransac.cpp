@@ -1,0 +1,514 @@
+// Native LO-RANSAC P3P absolute-pose solver.
+//
+// C++ runtime component backing ncnet_tpu.localization.pnp — the
+// equivalent of the reference's Matlab `ht_lo_ransac_p3p` stage
+// (lib_matlab/parfor_NC4D_PE_pnponly.m:77: P3P LO-RANSAC, angular
+// inlier threshold, 10000 iterations), which in the reference runs
+// inside a Matlab parfor worker pool. Here the hypothesis sweep is an
+// OpenMP parallel loop over minimal samples; the minimal solver is
+// Grunert's three-point resection with an analytic (Ferrari) quartic,
+// Newton-polished; pose-from-distances is Horn's quaternion absolute
+// orientation (Jacobi 4x4 eigensolver). Sampling is drawn from a single
+// seeded stream before the parallel region, and ties are broken by
+// sample index, so results are deterministic and independent of the
+// thread count.
+//
+// Exposed C ABI (consumed via ctypes from ncnet_tpu/native/__init__.py):
+//   ncnet_lo_ransac_p3p(...)  -> num_inliers (or -1 if unsolved)
+//   ncnet_p3p_solve(...)      -> candidate poses for one minimal sample
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr double kPi = 3.14159265358979323846;
+
+// ----------------------------------------------------------------------
+// Small linear algebra
+// ----------------------------------------------------------------------
+
+struct Vec3 {
+  double x, y, z;
+};
+
+inline Vec3 operator-(const Vec3& a, const Vec3& b) { return {a.x - b.x, a.y - b.y, a.z - b.z}; }
+inline Vec3 operator+(const Vec3& a, const Vec3& b) { return {a.x + b.x, a.y + b.y, a.z + b.z}; }
+inline Vec3 operator*(double s, const Vec3& a) { return {s * a.x, s * a.y, s * a.z}; }
+inline double dot(const Vec3& a, const Vec3& b) { return a.x * b.x + a.y * b.y + a.z * b.z; }
+inline double norm(const Vec3& a) { return std::sqrt(dot(a, a)); }
+inline Vec3 normalized(const Vec3& a) {
+  double n = norm(a);
+  return n > 1e-300 ? Vec3{a.x / n, a.y / n, a.z / n} : Vec3{0.0, 0.0, 0.0};
+}
+
+// Row-major 3x4 pose [R|t], world -> camera: c = R w + t.
+struct Pose {
+  double m[12];
+  Vec3 apply(const Vec3& w) const {
+    return {m[0] * w.x + m[1] * w.y + m[2] * w.z + m[3],
+            m[4] * w.x + m[5] * w.y + m[6] * w.z + m[7],
+            m[8] * w.x + m[9] * w.y + m[10] * w.z + m[11]};
+  }
+};
+
+// Jacobi eigensolver for a symmetric 4x4; returns the eigenvector of the
+// largest eigenvalue in evec (used for Horn's quaternion method).
+void max_eigvec_sym4(const double A_in[16], double evec[4]) {
+  double A[16];
+  std::memcpy(A, A_in, sizeof(A));
+  double V[16] = {1, 0, 0, 0, 0, 1, 0, 0, 0, 0, 1, 0, 0, 0, 0, 1};
+  for (int sweep = 0; sweep < 32; ++sweep) {
+    double off = 0.0;
+    for (int p = 0; p < 4; ++p)
+      for (int q = p + 1; q < 4; ++q) off += A[4 * p + q] * A[4 * p + q];
+    if (off < 1e-24) break;
+    for (int p = 0; p < 4; ++p) {
+      for (int q = p + 1; q < 4; ++q) {
+        double apq = A[4 * p + q];
+        if (std::fabs(apq) < 1e-300) continue;
+        double app = A[4 * p + p], aqq = A[4 * q + q];
+        double theta = 0.5 * (aqq - app) / apq;
+        double t = (theta >= 0 ? 1.0 : -1.0) /
+                   (std::fabs(theta) + std::sqrt(theta * theta + 1.0));
+        double c = 1.0 / std::sqrt(t * t + 1.0);
+        double s = t * c;
+        for (int k = 0; k < 4; ++k) {
+          double akp = A[4 * k + p], akq = A[4 * k + q];
+          A[4 * k + p] = c * akp - s * akq;
+          A[4 * k + q] = s * akp + c * akq;
+        }
+        for (int k = 0; k < 4; ++k) {
+          double apk = A[4 * p + k], aqk = A[4 * q + k];
+          A[4 * p + k] = c * apk - s * aqk;
+          A[4 * q + k] = s * apk + c * aqk;
+        }
+        for (int k = 0; k < 4; ++k) {
+          double vkp = V[4 * k + p], vkq = V[4 * k + q];
+          V[4 * k + p] = c * vkp - s * vkq;
+          V[4 * k + q] = s * vkp + c * vkq;
+        }
+      }
+    }
+  }
+  int best = 0;
+  for (int i = 1; i < 4; ++i)
+    if (A[4 * i + i] > A[4 * best + best]) best = i;
+  for (int k = 0; k < 4; ++k) evec[k] = V[4 * k + best];
+}
+
+// Horn's closed-form absolute orientation: find [R|t] minimizing
+// sum_i |R w_i + t - c_i|^2. Proper rotation guaranteed (quaternion).
+bool absolute_orientation(const Vec3* world, const Vec3* cam, int k, Pose* out) {
+  Vec3 wc{0, 0, 0}, cc{0, 0, 0};
+  for (int i = 0; i < k; ++i) {
+    wc = wc + world[i];
+    cc = cc + cam[i];
+  }
+  wc = (1.0 / k) * wc;
+  cc = (1.0 / k) * cc;
+
+  double S[9] = {0};  // S[a*3+b] = sum w_a * c_b (centered)
+  for (int i = 0; i < k; ++i) {
+    Vec3 w = world[i] - wc, c = cam[i] - cc;
+    const double wv[3] = {w.x, w.y, w.z}, cv[3] = {c.x, c.y, c.z};
+    for (int a = 0; a < 3; ++a)
+      for (int b = 0; b < 3; ++b) S[3 * a + b] += wv[a] * cv[b];
+  }
+  const double Sxx = S[0], Sxy = S[1], Sxz = S[2];
+  const double Syx = S[3], Syy = S[4], Syz = S[5];
+  const double Szx = S[6], Szy = S[7], Szz = S[8];
+  const double N[16] = {
+      Sxx + Syy + Szz, Syz - Szy,       Szx - Sxz,        Sxy - Syx,
+      Syz - Szy,       Sxx - Syy - Szz, Sxy + Syx,        Szx + Sxz,
+      Szx - Sxz,       Sxy + Syx,       -Sxx + Syy - Szz, Syz + Szy,
+      Sxy - Syx,       Szx + Sxz,       Syz + Szy,        -Sxx - Syy + Szz};
+  double q[4];
+  max_eigvec_sym4(N, q);
+  double qn = std::sqrt(q[0] * q[0] + q[1] * q[1] + q[2] * q[2] + q[3] * q[3]);
+  if (!(qn > 1e-300) || !std::isfinite(qn)) return false;
+  const double w = q[0] / qn, x = q[1] / qn, y = q[2] / qn, z = q[3] / qn;
+  double R[9] = {1 - 2 * (y * y + z * z), 2 * (x * y - w * z),     2 * (x * z + w * y),
+                 2 * (x * y + w * z),     1 - 2 * (x * x + z * z), 2 * (y * z - w * x),
+                 2 * (x * z - w * y),     2 * (y * z + w * x),     1 - 2 * (x * x + y * y)};
+  Pose P;
+  for (int a = 0; a < 3; ++a) {
+    for (int b = 0; b < 3; ++b) P.m[4 * a + b] = R[3 * a + b];
+    P.m[4 * a + 3] = 0.0;
+  }
+  Vec3 Rw = P.apply(wc);
+  P.m[3] = cc.x - Rw.x;
+  P.m[7] = cc.y - Rw.y;
+  P.m[11] = cc.z - Rw.z;
+  for (int i = 0; i < 12; ++i)
+    if (!std::isfinite(P.m[i])) return false;
+  *out = P;
+  return true;
+}
+
+// ----------------------------------------------------------------------
+// Quartic (Ferrari + Newton polish)
+// ----------------------------------------------------------------------
+
+// One real root of the monic cubic x^3 + a x^2 + b x + c (Cardano).
+double cubic_real_root(double a, double b, double c) {
+  const double p = b - a * a / 3.0;
+  const double q = 2.0 * a * a * a / 27.0 - a * b / 3.0 + c;
+  const double disc = q * q / 4.0 + p * p * p / 27.0;
+  double t;
+  if (disc >= 0) {
+    const double s = std::sqrt(disc);
+    t = std::cbrt(-q / 2.0 + s) + std::cbrt(-q / 2.0 - s);
+  } else {
+    const double r = std::sqrt(-p * p * p / 27.0);
+    const double phi = std::acos(std::max(-1.0, std::min(1.0, -q / (2.0 * r))));
+    t = 2.0 * std::cbrt(r) * std::cos(phi / 3.0);
+  }
+  return t - a / 3.0;
+}
+
+// Real roots of A4 x^4 + A3 x^3 + A2 x^2 + A1 x + A0; returns count (<=4).
+int quartic_real_roots(double A4, double A3, double A2, double A1, double A0,
+                       double roots[4]) {
+  if (std::fabs(A4) < 1e-14) {
+    // Degenerate sample; the batched-numpy path rejects these too.
+    return 0;
+  }
+  const double a = A3 / A4, b = A2 / A4, c = A1 / A4, d = A0 / A4;
+  // Resolvent cubic: y^3 - b y^2 + (ac - 4d) y - (a^2 d - 4 b d + c^2) = 0.
+  const double y = cubic_real_root(-b, a * c - 4.0 * d,
+                                   -(a * a * d - 4.0 * b * d + c * c));
+  double R2 = a * a / 4.0 - b + y;
+  if (R2 < 0 && R2 > -1e-10) R2 = 0.0;
+  int cnt = 0;
+  auto emit = [&](double x) {
+    // Newton polish on the monic quartic (2-3 steps kills Ferrari slop).
+    for (int it = 0; it < 3; ++it) {
+      const double f = ((x + a) * x + b) * x * x + c * x + d;
+      const double fp = ((4.0 * x + 3.0 * a) * x + 2.0 * b) * x + c;
+      if (std::fabs(fp) < 1e-300) break;
+      x -= f / fp;
+    }
+    if (std::isfinite(x)) roots[cnt++] = x;
+  };
+  if (R2 >= 0) {
+    const double R = std::sqrt(R2);
+    double D2, E2;
+    if (R > 1e-12) {
+      const double t1 = 3.0 * a * a / 4.0 - R2 - 2.0 * b;
+      const double t2 = (4.0 * a * b - 8.0 * c - a * a * a) / (4.0 * R);
+      D2 = t1 + t2;
+      E2 = t1 - t2;
+    } else {
+      const double s = y * y - 4.0 * d;
+      const double sq = s >= 0 ? std::sqrt(s) : 0.0;
+      D2 = 3.0 * a * a / 4.0 - 2.0 * b + 2.0 * sq;
+      E2 = 3.0 * a * a / 4.0 - 2.0 * b - 2.0 * sq;
+      if (s < -1e-10) {
+        D2 = -1.0;
+        E2 = -1.0;
+      }
+    }
+    if (D2 >= -1e-12) {
+      const double D = std::sqrt(std::max(0.0, D2));
+      emit(-a / 4.0 + R / 2.0 + D / 2.0);
+      emit(-a / 4.0 + R / 2.0 - D / 2.0);
+    }
+    if (E2 >= -1e-12) {
+      const double E = std::sqrt(std::max(0.0, E2));
+      emit(-a / 4.0 - R / 2.0 + E / 2.0);
+      emit(-a / 4.0 - R / 2.0 - E / 2.0);
+    }
+  }
+  // Drop polished roots that are not actually roots (complex pairs that
+  // slipped through the discriminant tolerance).
+  int keep = 0;
+  for (int i = 0; i < cnt; ++i) {
+    const double x = roots[i];
+    const double f = ((x + a) * x + b) * x * x + c * x + d;
+    const double scale = 1.0 + std::fabs(x);
+    if (std::fabs(f) < 1e-6 * scale * scale * scale * scale) roots[keep++] = x;
+  }
+  return keep;
+}
+
+// ----------------------------------------------------------------------
+// Grunert P3P (same algebra as ncnet_tpu/localization/pnp.py:p3p_solve)
+// ----------------------------------------------------------------------
+
+// rays: 3 unit bearing vectors; X: 3 world points. Writes up to 4 poses.
+int p3p_grunert(const Vec3 f[3], const Vec3 X[3], Pose poses[4]) {
+  const double a = norm(X[1] - X[2]);
+  const double b = norm(X[0] - X[2]);
+  const double c = norm(X[0] - X[1]);
+  if (b * b < 1e-18) return 0;
+  const double cos_a = dot(f[1], f[2]);
+  const double cos_b = dot(f[0], f[2]);
+  const double cos_g = dot(f[0], f[1]);
+
+  const double b2 = b * b;
+  const double acb = (a * a - c * c) / b2;
+  const double apb = (a * a + c * c) / b2;
+  const double bc = (b * b - c * c) / b2;
+  const double ba = (b * b - a * a) / b2;
+  const double a2b = (a * a) / b2;
+  const double c2b = (c * c) / b2;
+
+  const double A4 = (acb - 1.0) * (acb - 1.0) - 4.0 * c2b * cos_a * cos_a;
+  const double A3 = 4.0 * (acb * (1.0 - acb) * cos_b -
+                           (1.0 - apb) * cos_a * cos_g +
+                           2.0 * c2b * cos_a * cos_a * cos_b);
+  const double A2 = 2.0 * (acb * acb - 1.0 + 2.0 * acb * acb * cos_b * cos_b +
+                           2.0 * bc * cos_a * cos_a -
+                           4.0 * apb * cos_a * cos_b * cos_g +
+                           2.0 * ba * cos_g * cos_g);
+  const double A1 = 4.0 * (-acb * (1.0 + acb) * cos_b +
+                           2.0 * a2b * cos_g * cos_g * cos_b -
+                           (1.0 - apb) * cos_a * cos_g);
+  const double A0 = (1.0 + acb) * (1.0 + acb) - 4.0 * a2b * cos_g * cos_g;
+
+  double v[4];
+  const int nv = quartic_real_roots(A4, A3, A2, A1, A0, v);
+  int np = 0;
+  for (int i = 0; i < nv; ++i) {
+    const double num =
+        (-1.0 + acb) * v[i] * v[i] - 2.0 * acb * cos_b * v[i] + 1.0 + acb;
+    const double den = 2.0 * (cos_g - v[i] * cos_a);
+    if (std::fabs(den) < 1e-300) continue;
+    const double u = num / den;
+    const double s1d = 1.0 + v[i] * v[i] - 2.0 * v[i] * cos_b;
+    if (s1d < 1e-18) continue;
+    const double s1 = b / std::sqrt(s1d);
+    const double s2 = u * s1;
+    const double s3 = v[i] * s1;
+    if (!(s1 > 0 && s2 > 0 && s3 > 0)) continue;
+    Vec3 cam[3] = {s1 * f[0], s2 * f[1], s3 * f[2]};
+    Pose P;
+    if (absolute_orientation(X, cam, 3, &P)) poses[np++] = P;
+  }
+  return np;
+}
+
+// ----------------------------------------------------------------------
+// Scoring / local optimization
+// ----------------------------------------------------------------------
+
+int count_inliers(const Pose& P, const Vec3* rays, const Vec3* pts, int n,
+                  double cos_thr) {
+  int cnt = 0;
+  for (int i = 0; i < n; ++i) {
+    Vec3 pred = P.apply(pts[i]);
+    const double pn = norm(pred);
+    if (pn < 1e-300) continue;
+    if (dot(pred, rays[i]) / pn > cos_thr) ++cnt;
+  }
+  return cnt;
+}
+
+double angular_error(const Pose& P, const Vec3& ray, const Vec3& pt) {
+  Vec3 pred = P.apply(pt);
+  const double pn = norm(pred);
+  if (pn < 1e-300) return kPi;
+  const double cang = std::max(-1.0, std::min(1.0, dot(pred, ray) / pn));
+  return std::acos(cang);
+}
+
+// Object-space alternation on a fixed point set (matches _refine_pose in
+// ncnet_tpu/localization/pnp.py): depth projection then Horn alignment.
+bool refine_pose(Pose* P, const Vec3* rays, const Vec3* pts, int k, int iters,
+                 Vec3* cam_buf) {
+  for (int it = 0; it < iters; ++it) {
+    for (int i = 0; i < k; ++i) {
+      Vec3 trans = P->apply(pts[i]);
+      const double depth = std::max(dot(trans, rays[i]), 1e-9);
+      cam_buf[i] = depth * rays[i];
+    }
+    if (!absolute_orientation(pts, cam_buf, k, P)) return false;
+  }
+  return true;
+}
+
+// xorshift64* — deterministic, seedable, cheap.
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ull) {}
+  uint64_t next() {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return s * 0x2545F4914F6CDD1Dull;
+  }
+  uint32_t below(uint32_t n) { return static_cast<uint32_t>(next() % n); }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Candidate poses for one minimal sample. rays/points: [3*3] row-major.
+// poses_out: [4*12]. Returns the number of poses written (0..4).
+int ncnet_p3p_solve(const double* rays, const double* points,
+                    double* poses_out) {
+  Vec3 f[3], X[3];
+  for (int i = 0; i < 3; ++i) {
+    f[i] = normalized({rays[3 * i], rays[3 * i + 1], rays[3 * i + 2]});
+    X[i] = {points[3 * i], points[3 * i + 1], points[3 * i + 2]};
+  }
+  Pose poses[4];
+  const int np = p3p_grunert(f, X, poses);
+  for (int i = 0; i < np; ++i)
+    std::memcpy(poses_out + 12 * i, poses[i].m, sizeof(poses[i].m));
+  return np;
+}
+
+// LO-RANSAC over Grunert P3P.
+//   rays:        [n*3] bearing vectors in the camera frame (normalized
+//                internally).
+//   points:      [n*3] world points.
+//   inlier_thr:  angular threshold, radians.
+//   max_iters:   number of minimal samples.
+//   P_out:       [12] row-major [R|t] world->camera.
+//   inliers_out: [n] 0/1 mask under the final pose (may be null).
+//   mean_err_out: mean angular inlier error, radians (may be null).
+// Returns the inlier count, or -1 if no pose was found.
+int ncnet_lo_ransac_p3p(const double* rays, const double* points, int n,
+                        double inlier_thr, int max_iters, uint64_t seed,
+                        int lo_iters, double* P_out, uint8_t* inliers_out,
+                        double* mean_err_out) {
+  if (n < 3 || max_iters < 1) return -1;
+  Vec3* f = new Vec3[n];
+  Vec3* X = new Vec3[n];
+  for (int i = 0; i < n; ++i) {
+    f[i] = normalized({rays[3 * i], rays[3 * i + 1], rays[3 * i + 2]});
+    X[i] = {points[3 * i], points[3 * i + 1], points[3 * i + 2]};
+  }
+  const double cos_thr = std::cos(inlier_thr);
+
+  // Draw all samples from one stream up front: results do not depend on
+  // the number of OpenMP threads.
+  int32_t* samples = new int32_t[3 * static_cast<int64_t>(max_iters)];
+  {
+    Rng rng(seed * 0x9e3779b97f4a7c15ull + 1);
+    for (int64_t t = 0; t < max_iters; ++t) {
+      int32_t i0 = rng.below(n), i1, i2;
+      do {
+        i1 = rng.below(n);
+      } while (i1 == i0);
+      do {
+        i2 = rng.below(n);
+      } while (i2 == i0 || i2 == i1);
+      samples[3 * t] = i0;
+      samples[3 * t + 1] = i1;
+      samples[3 * t + 2] = i2;
+    }
+  }
+
+  int best_count = -1;
+  int64_t best_iter = -1;
+  Pose best_pose{};
+#pragma omp parallel
+  {
+    int loc_count = -1;
+    int64_t loc_iter = -1;
+    Pose loc_pose{};
+#pragma omp for schedule(static)
+    for (int64_t t = 0; t < max_iters; ++t) {
+      Vec3 fs[3], Xs[3];
+      for (int j = 0; j < 3; ++j) {
+        fs[j] = f[samples[3 * t + j]];
+        Xs[j] = X[samples[3 * t + j]];
+      }
+      Pose cand[4];
+      const int np = p3p_grunert(fs, Xs, cand);
+      for (int p = 0; p < np; ++p) {
+        const int cnt = count_inliers(cand[p], f, X, n, cos_thr);
+        if (cnt > loc_count || (cnt == loc_count && t < loc_iter)) {
+          loc_count = cnt;
+          loc_iter = t;
+          loc_pose = cand[p];
+        }
+      }
+    }
+#pragma omp critical
+    {
+      if (loc_count > best_count ||
+          (loc_count == best_count && loc_iter != -1 &&
+           (best_iter == -1 || loc_iter < best_iter))) {
+        best_count = loc_count;
+        best_iter = loc_iter;
+        best_pose = loc_pose;
+      }
+    }
+  }
+  delete[] samples;
+
+  if (best_count < 3) {
+    delete[] f;
+    delete[] X;
+    return -1;
+  }
+
+  // Local optimization: refine on the inlier set, keep while it improves
+  // (same accept rule as the numpy path).
+  Pose P = best_pose;
+  Vec3* in_rays = new Vec3[n];
+  Vec3* in_pts = new Vec3[n];
+  Vec3* cam_buf = new Vec3[n];
+  for (int round = 0; round < 2; ++round) {
+    int k = 0;
+    for (int i = 0; i < n; ++i) {
+      if (angular_error(P, f[i], X[i]) < inlier_thr) {
+        in_rays[k] = f[i];
+        in_pts[k] = X[i];
+        ++k;
+      }
+    }
+    if (k < 3) break;
+    Pose P_ref = P;
+    if (!refine_pose(&P_ref, in_rays, in_pts, k, lo_iters, cam_buf)) break;
+    const int new_cnt = count_inliers(P_ref, f, X, n, cos_thr);
+    if (new_cnt >= k)
+      P = P_ref;
+    else
+      break;
+  }
+
+  int num_inl = 0;
+  double err_sum = 0.0;
+  for (int i = 0; i < n; ++i) {
+    const double e = angular_error(P, f[i], X[i]);
+    const bool inl = e < inlier_thr;
+    if (inliers_out) inliers_out[i] = inl ? 1 : 0;
+    if (inl) {
+      ++num_inl;
+      err_sum += e;
+    }
+  }
+  std::memcpy(P_out, P.m, sizeof(P.m));
+  if (mean_err_out)
+    *mean_err_out = num_inl ? err_sum / num_inl : kPi;
+
+  delete[] f;
+  delete[] X;
+  delete[] in_rays;
+  delete[] in_pts;
+  delete[] cam_buf;
+  return num_inl;
+}
+
+// Number of OpenMP threads the solver will use (1 if built without OpenMP).
+int ncnet_p3p_num_threads(void) {
+#ifdef _OPENMP
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,132 @@
+"""Native C++ LO-RANSAC P3P vs the numpy implementation.
+
+Both backends implement the same Grunert minimal solver + Horn/Kabsch
+pose-from-distances + object-space LO (reference stage:
+lib_matlab/parfor_NC4D_PE_pnponly.m:77), so on synthetic problems they
+must agree on the recovered pose and inlier set even though their RANSAC
+sampling streams differ.
+"""
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import native
+from ncnet_tpu.localization.pnp import lo_ransac_p3p, p3p_solve
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def _random_problem(seed, n=80, n_outliers=0, noise=0.0):
+    rng = np.random.default_rng(seed)
+    # Random proper rotation via QR.
+    A = rng.normal(size=(3, 3))
+    Q, R_ = np.linalg.qr(A)
+    Q *= np.sign(np.diag(R_))
+    if np.linalg.det(Q) < 0:
+        Q[:, 0] *= -1
+    t = rng.normal(size=3)
+    X = rng.normal(size=(n, 3)) * 2.0
+    cam = X @ Q.T + t
+    # Push the cloud in front of the camera.
+    shift = np.array([0.0, 0.0, 5.0 - cam[:, 2].min()])
+    cam = cam + shift
+    t = t + shift
+    rays = cam / np.linalg.norm(cam, axis=1, keepdims=True)
+    if noise:
+        rays = rays + rng.normal(size=rays.shape) * noise
+        rays /= np.linalg.norm(rays, axis=1, keepdims=True)
+    if n_outliers:
+        idx = rng.choice(n, size=n_outliers, replace=False)
+        bad = rng.normal(size=(n_outliers, 3))
+        rays[idx] = bad / np.linalg.norm(bad, axis=1, keepdims=True)
+        inlier_mask = np.ones(n, dtype=bool)
+        inlier_mask[idx] = False
+    else:
+        inlier_mask = np.ones(n, dtype=bool)
+    return rays, X, Q, t, inlier_mask
+
+
+def test_exact_recovery():
+    rays, X, R, t, _ = _random_problem(0)
+    res = native.lo_ransac_p3p_native(
+        rays, X, inlier_thr=np.deg2rad(0.2), max_iters=1000, seed=1
+    )
+    assert res.ok
+    assert res.num_inliers == X.shape[0]
+    np.testing.assert_allclose(res.P[:, :3], R, atol=1e-9)
+    np.testing.assert_allclose(res.P[:, 3], t, atol=1e-8)
+
+
+def test_outlier_rejection_matches_numpy():
+    rays, X, R, t, mask = _random_problem(3, n=120, n_outliers=40)
+    thr = np.deg2rad(0.2)
+    res_nat = native.lo_ransac_p3p_native(rays, X, thr, max_iters=2000, seed=5)
+    res_np = lo_ransac_p3p(rays, X, thr, max_iters=2000, seed=5, backend="numpy")
+    assert res_nat.ok and res_np.ok
+    # Same inlier set (the true one) and same pose up to solver precision.
+    np.testing.assert_array_equal(res_nat.inliers, mask)
+    np.testing.assert_array_equal(res_np.inliers, mask)
+    np.testing.assert_allclose(res_nat.P, res_np.P, atol=1e-6)
+    np.testing.assert_allclose(res_nat.P[:, :3], R, atol=1e-8)
+
+
+def test_noisy_problem_pose_close():
+    rays, X, R, t, _ = _random_problem(7, n=200, noise=1e-4)
+    thr = np.deg2rad(0.2)
+    res = native.lo_ransac_p3p_native(rays, X, thr, max_iters=2000, seed=2)
+    assert res.ok
+    assert res.num_inliers > 150
+    assert np.abs(res.P[:, :3] - R).max() < 5e-3
+    assert res.inlier_error < thr
+
+
+def test_minimal_solver_parity_with_numpy():
+    rng = np.random.default_rng(11)
+    for trial in range(20):
+        rays, X, _, _, _ = _random_problem(100 + trial, n=3)
+        nat = native.p3p_solve_native(rays, X)  # [k, 3, 4]
+        ref = p3p_solve(rays[None], X[None])[0]  # [4, 3, 4] NaN-padded
+        ref = ref[np.all(np.isfinite(ref), axis=(1, 2))]
+        assert nat.shape[0] >= 1
+        # Every numpy solution has a native counterpart (order-free match).
+        for P in ref:
+            dists = np.abs(nat - P).reshape(nat.shape[0], -1).max(axis=1)
+            assert dists.min() < 1e-6, f"trial {trial}: unmatched pose"
+
+
+def test_determinism_across_calls():
+    rays, X, _, _, _ = _random_problem(13, n=60, n_outliers=10)
+    thr = np.deg2rad(0.2)
+    a = native.lo_ransac_p3p_native(rays, X, thr, max_iters=500, seed=9)
+    b = native.lo_ransac_p3p_native(rays, X, thr, max_iters=500, seed=9)
+    np.testing.assert_array_equal(a.P, b.P)
+    np.testing.assert_array_equal(a.inliers, b.inliers)
+
+
+def test_degenerate_inputs():
+    res = native.lo_ransac_p3p_native(
+        np.zeros((2, 3)), np.zeros((2, 3)), 0.01, max_iters=10
+    )
+    assert not res.ok
+    # Collinear world points: solver must not crash.
+    X = np.stack([np.arange(10.0)] * 3, axis=1)  # points on a line
+    rays = np.tile(np.array([0.0, 0.0, 1.0]), (10, 1))
+    native.lo_ransac_p3p_native(rays, X, 0.01, max_iters=50)
+
+
+def test_auto_backend_dispatches_native():
+    rays, X, R, t, _ = _random_problem(21)
+    res = lo_ransac_p3p(rays, X, np.deg2rad(0.2), max_iters=500, seed=0)
+    assert res.ok
+    np.testing.assert_allclose(res.P[:, :3], R, atol=1e-8)
+
+
+def test_input_validation():
+    with pytest.raises(ValueError):
+        native.lo_ransac_p3p_native(np.zeros((80, 3)), np.zeros((50, 3)), 0.01)
+    with pytest.raises(ValueError):
+        native.p3p_solve_native(np.zeros((4, 3)), np.zeros((4, 3)))
+    with pytest.raises(ValueError):
+        lo_ransac_p3p(np.zeros((5, 3)), np.zeros((5, 3)), 0.01, backend="numppy")
