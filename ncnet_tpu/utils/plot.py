@@ -46,12 +46,14 @@ def plot_matches_horizontal(
     image_b: np.ndarray,
     points_a: np.ndarray,
     points_b: np.ndarray,
-    path: str,
+    path: str | None,
     inliers: np.ndarray | None = None,
     denormalize: bool = False,
-) -> None:
+):
     """Side-by-side pair with match lines (parity:
-    lib_matlab/show_matches2_horizontal.m). points_*: [n, 2] pixels."""
+    lib_matlab/show_matches2_horizontal.m). points_*: [n, 2] pixels.
+
+    Saves to `path`; with path=None returns the figure (notebook use)."""
     import matplotlib
 
     matplotlib.use("Agg")
@@ -82,5 +84,7 @@ def plot_matches_horizontal(
     ax.scatter(pa[:, 0], pa[:, 1], s=6, c="y")
     ax.scatter(pb[:, 0] + off, pb[:, 1], s=6, c="y")
     fig.tight_layout(pad=0)
+    if path is None:
+        return fig
     fig.savefig(path, dpi=100)
     plt.close(fig)
