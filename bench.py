@@ -18,17 +18,36 @@ import time
 
 V100_BASELINE_PAIRS_PER_S = 1.0
 
+_T0 = time.time()
+
+
+def note(msg):
+    """Stage timestamps on stderr: a silent hang is then attributable to a
+    specific stage (device dial, compile, execute) instead of opaque."""
+    print(f"# [{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
 
 def main():
     import jax
+
+    # Persistent compilation cache: the InLoc-shape compile is minutes-long
+    # on a tunneled backend; cache it across bench invocations.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("NCNET_TPU_COMPILE_CACHE", "/tmp/ncnet_tpu_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     import jax.numpy as jnp
 
     from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
     from ncnet_tpu.models.ncnet import ncnet_forward
     from ncnet_tpu.ops import corr_to_matches
 
+    note("dialing backend (jax.devices())...")
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
+    note(f"backend up: {dev}")
 
     # InLoc configuration (SURVEY.md §3.3); on CPU smoke runs, shrink.
     if on_tpu:
@@ -45,6 +64,7 @@ def main():
             half_precision=True,
             use_fused_corr_pool=fused,
         )
+        note("building params...")
         params = ncnet_init(jax.random.PRNGKey(0), config)
 
         @jax.jit
@@ -72,14 +92,18 @@ def main():
     fused_ran = True
     try:
         params, step = build(fused=True)
+        note(f"compiling+first-run fused step at {h_a}x{w_a} (first compile "
+             "of this shape can take many minutes on a tunneled backend)...")
         out = step(params, src, tgt)  # warmup/compile
         jax.block_until_ready(out)
+        note("fused step compiled and ran")
     except Exception as exc:  # noqa: BLE001
-        print(f"# fused path unavailable ({type(exc).__name__}); unfused", file=sys.stderr)
+        note(f"fused path unavailable ({type(exc).__name__}: {exc}); unfused")
         fused_ran = False
         params, step = build(fused=False)
         out = step(params, src, tgt)
         jax.block_until_ready(out)
+        note("unfused step compiled and ran")
 
     # Timing through a scalar fetch: on tunneled backends (axon)
     # block_until_ready can return before execution completes, so each
@@ -90,6 +114,7 @@ def main():
         return float(jnp.sum(m1[4]) + jnp.sum(m2[4]))
 
     run_once()  # settle caches/queues
+    note("timing...")
     n_iters = 5 if on_tpu else 2
     t0 = time.perf_counter()
     for _ in range(n_iters):

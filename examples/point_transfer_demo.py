@@ -52,6 +52,7 @@ def main(argv=None):
     if args.source_image and args.target_image:
         src_raw, _ = load_and_resize_chw(args.source_image, size, size)
         tgt_raw, _ = load_and_resize_chw(args.target_image, size, size)
+        src_raw, tgt_raw = src_raw / 255.0, tgt_raw / 255.0  # to [0, 1]
     else:
         # Synthetic pair: smooth random texture and an affine-warped copy.
         print("no images given - generating a synthetic warped pair")

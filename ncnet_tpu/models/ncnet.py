@@ -118,12 +118,23 @@ def ncnet_forward(
     """
     feat_a = extract_features(config, params, source_image)
     feat_b = extract_features(config, params, target_image)
+    return ncnet_forward_from_features(config, params, feat_a, feat_b)
 
+
+def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a, feat_b):
+    """Correlation → (pool) → mutual → consensus → mutual, from backbone features.
+
+    Split out of `ncnet_forward` so callers that reuse features (e.g. the
+    weak-supervision loss, which forms in-batch negatives by rolling the
+    *features* — mathematically identical to rolling the images through the
+    per-image backbone, at half the backbone FLOPs) can enter the pipeline
+    after extraction.
+    """
     delta4d = None
     if (
         config.relocalization_k_size > 1
         and config.use_fused_corr_pool
-        and source_image.shape[0] == 1
+        and feat_a.shape[0] == 1
     ):
         # Local import keeps jax.experimental.pallas off the import path of
         # consumers that never take the fused branch.

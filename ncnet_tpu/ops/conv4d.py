@@ -25,13 +25,20 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def conv4d_prepadded(x, weight, bias=None):
+def conv4d_prepadded(x, weight, bias=None, *, strategy: str = "conv2d"):
     """4-D convolution over input whose dim 2 is already padded by kI//2.
 
     The shared core of both the single-device conv4d (zero padding) and the
-    sharded halo-exchange variant (parallel/corr_sharding.py): fold (b, I)
-    into the XLA conv batch and sum kI batched 3-D convolutions. Emits only
+    sharded halo-exchange variant (parallel/corr_sharding.py). Emits only
     the center I rows.
+
+    Two mathematically identical decompositions:
+      * 'conv2d' (default): kI*kJ shifted batched **2-D** convolutions over
+        (K, L) with (b, I, J) folded into the conv batch. TPU convolutions
+        are natively 2-D — this lowers straight onto the hardware conv path,
+        whereas 3-D convs go through a generic lowering.
+      * 'conv3d': kI batched 3-D convolutions with (b, I) folded into the
+        batch (kept for comparison/testing).
 
     Args:
       x: [b, cin, I + 2*(kI//2), J, K, L].
@@ -47,21 +54,47 @@ def conv4d_prepadded(x, weight, bias=None):
         raise ValueError(f"cin mismatch: x has {cin}, weight has {wcin}")
     si = si_pad - 2 * (ki // 2)
 
-    out = None
-    for di in range(ki):
-        xs = lax.dynamic_slice_in_dim(x, di, si, axis=2)
-        xs = jnp.moveaxis(xs, 2, 1).reshape(b * si, cin, sj, sk, sl)
-        w3 = jnp.transpose(weight[di], (4, 3, 0, 1, 2))  # [cout, cin, kj, kk, kl]
-        y = lax.conv_general_dilated(
-            xs,
-            w3,
-            window_strides=(1, 1, 1),
-            padding="SAME",
-            dimension_numbers=("NCHWD", "OIHWD", "NCHWD"),
-        )
-        out = y if out is None else out + y
+    if strategy == "conv2d":
+        # Zero-pad J on both sides (I is already halo/zero padded by the
+        # caller); every (di, dj) kernel offset is then a contiguous slice.
+        pad_j = kj // 2
+        xp = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0)))
+        out = None
+        for di in range(ki):
+            for dj in range(kj):
+                xs = lax.slice_in_dim(xp, di, di + si, axis=2)
+                xs = lax.slice_in_dim(xs, dj, dj + sj, axis=3)
+                xs = jnp.moveaxis(xs, 1, 5).reshape(b * si * sj, sk, sl, cin)
+                # [kk, kl, cin, cout] filter, NHWC in/out: the TPU-native
+                # layout (channels minor).
+                y = lax.conv_general_dilated(
+                    xs,
+                    weight[di, dj],
+                    window_strides=(1, 1),
+                    padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+                out = y if out is None else out + y
+        out = out.reshape(b, si, sj, sk, sl, cout)
+        out = jnp.moveaxis(out, 5, 1)
+    elif strategy == "conv3d":
+        out = None
+        for di in range(ki):
+            xs = lax.dynamic_slice_in_dim(x, di, si, axis=2)
+            xs = jnp.moveaxis(xs, 2, 1).reshape(b * si, cin, sj, sk, sl)
+            w3 = jnp.transpose(weight[di], (4, 3, 0, 1, 2))  # [cout, cin, kj, kk, kl]
+            y = lax.conv_general_dilated(
+                xs,
+                w3,
+                window_strides=(1, 1, 1),
+                padding="SAME",
+                dimension_numbers=("NCHWD", "OIHWD", "NCHWD"),
+            )
+            out = y if out is None else out + y
+        out = jnp.moveaxis(out.reshape(b, si, cout, sj, sk, sl), 1, 2)
+    else:
+        raise ValueError(f"unknown strategy {strategy!r}")
 
-    out = jnp.moveaxis(out.reshape(b, si, cout, sj, sk, sl), 1, 2)
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1, 1, 1)
     return out

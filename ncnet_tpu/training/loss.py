@@ -43,7 +43,7 @@ def pair_match_score(corr4d, normalization: str = "softmax"):
 
 
 def weak_loss(forward_fn, source_image, target_image, normalization: str = "softmax"):
-    """Positive-vs-rolled-negative weak loss.
+    """Positive-vs-rolled-negative weak loss (image-level entry).
 
     Args:
       forward_fn: (src, tgt) -> corr4d (the model forward closed over params).
@@ -61,4 +61,27 @@ def weak_loss(forward_fn, source_image, target_image, normalization: str = "soft
     corr_neg = forward_fn(rolled, target_image)
     score_neg = pair_match_score(corr_neg, normalization)
 
+    return score_neg - score_pos
+
+
+def weak_loss_from_features(match_fn, feat_a, feat_b, normalization: str = "softmax"):
+    """Weak loss entered after feature extraction — half the backbone FLOPs.
+
+    The backbone is per-image (and its BN runs in inference mode,
+    lib/model.py:251), so features of the rolled batch are exactly the
+    rolled features: the negative pass can skip the backbone entirely.
+    The reference runs two full forwards per step (train.py:121,138); here
+    the backbone runs once and only the correlation pipeline runs twice.
+
+    Args:
+      match_fn: (feat_a, feat_b) -> corr4d (correlation pipeline closed over
+        params, e.g. ncnet_forward_from_features).
+      feat_a, feat_b: [b, c, h, w] backbone features.
+    """
+    score_pos = pair_match_score(match_fn(feat_a, feat_b), normalization)
+    # Under a dp-sharded batch the roll lowers to a collective permute of
+    # the (small) feature tensors over ICI.
+    score_neg = pair_match_score(
+        match_fn(jnp.roll(feat_a, -1, axis=0), feat_b), normalization
+    )
     return score_neg - score_pos

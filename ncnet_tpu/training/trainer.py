@@ -25,8 +25,12 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.ncnet import NCNetConfig, ncnet_forward
-from .loss import weak_loss
+from ..models.ncnet import (
+    NCNetConfig,
+    extract_features,
+    ncnet_forward_from_features,
+)
+from .loss import weak_loss_from_features
 
 Params = Dict[str, Any]
 
@@ -139,11 +143,14 @@ def make_train_step(
             "neigh_consensus": trainable["neigh_consensus"],
         }
 
-        def forward(src, tgt):
-            corr, _ = ncnet_forward(config, params, src, tgt)
+        feat_a = extract_features(config, params, source)
+        feat_b = extract_features(config, params, target)
+
+        def match(fa, fb):
+            corr, _ = ncnet_forward_from_features(config, params, fa, fb)
             return corr
 
-        return weak_loss(forward, source, target, normalization)
+        return weak_loss_from_features(match, feat_a, feat_b, normalization)
 
     @jax.jit
     def train_step(state_trainable, state_frozen, opt_state, source, target):

@@ -54,9 +54,10 @@ def plot_matches_horizontal(
     lib_matlab/show_matches2_horizontal.m). points_*: [n, 2] pixels.
 
     Saves to `path`; with path=None returns the figure (notebook use)."""
-    import matplotlib
+    if path is not None:
+        import matplotlib
 
-    matplotlib.use("Agg")
+        matplotlib.use("Agg")  # headless save; never hijack a notebook backend
     import matplotlib.pyplot as plt
 
     a = denormalize_for_display(image_a) if denormalize else np.asarray(image_a)

@@ -165,3 +165,48 @@ def test_finetune_mask_excludes_bn_stats(rng):
     np.testing.assert_array_equal(
         np.asarray(old_bb["layer1"][0]["conv2"]), np.asarray(new_bb["layer1"][0]["conv2"])
     )
+
+
+def test_weak_loss_feature_roll_equals_image_roll(rng):
+    """Rolling features == rolling images through the per-image backbone.
+
+    The trainer's half-backbone-FLOPs loss (weak_loss_from_features) must be
+    numerically identical to the reference formulation that re-runs the
+    backbone on the rolled batch (train.py:137-138).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import (
+        extract_features,
+        ncnet_forward,
+        ncnet_forward_from_features,
+    )
+    from ncnet_tpu.training.loss import weak_loss, weak_loss_from_features
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    src = jax.random.normal(k1, (3, 3, 32, 32))
+    tgt = jax.random.normal(k2, (3, 3, 32, 32))
+
+    def forward(s, t):
+        corr, _ = ncnet_forward(config, params, s, t)
+        return corr
+
+    def match(fa, fb):
+        corr, _ = ncnet_forward_from_features(config, params, fa, fb)
+        return corr
+
+    loss_img = weak_loss(forward, src, tgt)
+    loss_feat = weak_loss_from_features(
+        match,
+        extract_features(config, params, src),
+        extract_features(config, params, tgt),
+    )
+    assert jnp.allclose(loss_img, loss_feat, atol=1e-5), (loss_img, loss_feat)

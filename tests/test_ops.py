@@ -263,3 +263,21 @@ def test_nearest_neighbour_point_transfer():
     np.testing.assert_allclose(
         np.asarray(warped), np.array([[[0.5, -0.5], [0.1, -0.1]]]), atol=1e-6
     )
+
+
+def test_conv4d_strategies_agree():
+    """The conv2d (TPU-native 2-D lowering) and conv3d decompositions and the
+    dense-einsum oracle all compute the same 4-D convolution."""
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.ops.conv4d import conv4d_prepadded, conv4d_reference
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 6, 5, 7, 4))
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 5, 3, 3, 3, 2))
+    b = jax.random.normal(jax.random.PRNGKey(2), (2,))
+    ref = conv4d_reference(x, w, b)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+    for strategy in ("conv2d", "conv3d"):
+        out = conv4d_prepadded(xp, w, b, strategy=strategy)
+        assert jnp.allclose(out, ref, atol=1e-4), strategy
