@@ -58,6 +58,7 @@ def main():
 
     def build(fused: bool):
         config = NCNetConfig(
+            backbone=BackboneConfig(compute_dtype="bfloat16"),
             ncons_kernel_sizes=(3, 3),
             ncons_channels=(16, 1),
             relocalization_k_size=2,

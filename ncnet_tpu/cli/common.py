@@ -13,6 +13,16 @@ from ..models.convert import load_reference_checkpoint
 from ..training.checkpoint import load_checkpoint
 
 
+def _with_backbone_dtype(config: NCNetConfig, backbone_bf16: bool) -> NCNetConfig:
+    """Opt the backbone into bf16 conv compute (TPU fast path)."""
+    if not backbone_bf16:
+        return config
+    return dataclass_replace(
+        config,
+        backbone=dataclass_replace(config.backbone, compute_dtype="bfloat16"),
+    )
+
+
 def build_model(
     checkpoint: str = "",
     ncons_kernel_sizes=(5, 5, 5),
@@ -20,6 +30,7 @@ def build_model(
     backbone_cnn: str = "resnet101",
     relocalization_k_size: int = 0,
     half_precision: bool = False,
+    backbone_bf16: bool = False,
     seed: int = 1,
 ) -> Tuple[NCNetConfig, dict]:
     """Build (config, params), restoring from a checkpoint when given.
@@ -37,7 +48,7 @@ def build_model(
             relocalization_k_size=relocalization_k_size,
             half_precision=half_precision,
         )
-        return config, restored["params"]
+        return _with_backbone_dtype(config, backbone_bf16), restored["params"]
     if checkpoint:  # .pth.tar
         params, arch = load_reference_checkpoint(checkpoint)
         config = NCNetConfig(
@@ -47,7 +58,7 @@ def build_model(
             relocalization_k_size=relocalization_k_size,
             half_precision=half_precision,
         )
-        return config, params
+        return _with_backbone_dtype(config, backbone_bf16), params
     config = NCNetConfig(
         backbone=BackboneConfig(cnn=backbone_cnn),
         ncons_kernel_sizes=tuple(ncons_kernel_sizes),
@@ -55,6 +66,7 @@ def build_model(
         relocalization_k_size=relocalization_k_size,
         half_precision=half_precision,
     )
+    config = _with_backbone_dtype(config, backbone_bf16)
     params = ncnet_init(jax.random.PRNGKey(seed), config)
     return config, params
 

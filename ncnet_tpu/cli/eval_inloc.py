@@ -87,6 +87,13 @@ def main(argv=None):
     )
     parser.add_argument("--output_dir", type=str, default="matches")
     parser.add_argument("--resume", action="store_true", default=True)
+    # TPU fast path: bf16 conv compute in the backbone (2x MXU, half the
+    # activation HBM). The workload is half-precision end-to-end anyway
+    # (parity: eval_inloc.py:50 runs the reference in fp16).
+    parser.add_argument("--backbone_bf16", action="store_true", default=True)
+    parser.add_argument(
+        "--no-backbone_bf16", dest="backbone_bf16", action="store_false"
+    )
     args = parser.parse_args(argv)
 
     from scipy.io import loadmat
@@ -97,6 +104,7 @@ def main(argv=None):
         ncons_channels=(16, 1),
         relocalization_k_size=args.k_size,
         half_precision=True,
+        backbone_bf16=args.backbone_bf16,
     )
 
     experiment = (

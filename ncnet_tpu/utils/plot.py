@@ -10,6 +10,17 @@ import numpy as np
 from ..data.normalization import IMAGENET_MEAN, IMAGENET_STD
 
 
+def _headless_matplotlib():
+    """Select Agg for headless saving — but never retroactively: if pyplot
+    is already imported (e.g. a notebook's inline backend) leave it alone."""
+    import sys
+
+    if "matplotlib.pyplot" not in sys.modules:
+        import matplotlib
+
+        matplotlib.use("Agg")
+
+
 def denormalize_for_display(image: np.ndarray) -> np.ndarray:
     """Invert ImageNet normalization to [0, 1] HWC for imshow
     (parity: lib/plot.py:6-17)."""
@@ -25,9 +36,7 @@ def denormalize_for_display(image: np.ndarray) -> np.ndarray:
 
 def save_image(image: np.ndarray, path: str, denormalize: bool = True) -> None:
     """Borderless image save (parity: lib/plot.py:20-29)."""
-    import matplotlib
-
-    matplotlib.use("Agg")
+    _headless_matplotlib()
     import matplotlib.pyplot as plt
 
     img = denormalize_for_display(image) if denormalize else np.asarray(image)
@@ -55,9 +64,7 @@ def plot_matches_horizontal(
 
     Saves to `path`; with path=None returns the figure (notebook use)."""
     if path is not None:
-        import matplotlib
-
-        matplotlib.use("Agg")  # headless save; never hijack a notebook backend
+        _headless_matplotlib()
     import matplotlib.pyplot as plt
 
     a = denormalize_for_display(image_a) if denormalize else np.asarray(image_a)

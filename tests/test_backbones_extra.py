@@ -157,3 +157,31 @@ class TestDenseNetInNCNet:
         corr, _ = ncnet_forward(config, params, src, src)
         assert corr.shape == (1, 1, 3, 3, 3, 3)
         assert np.all(np.isfinite(np.asarray(corr)))
+
+
+def test_backbone_bf16_compute_close_to_f32():
+    """bf16 conv compute (TPU fast path) must track f32 features closely;
+    BN coefficients are f32-derived so no systematic drift."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.models.backbone import (
+        BackboneConfig,
+        backbone_apply,
+        backbone_init,
+    )
+
+    for cnn, layer in [("vgg", "pool3"), ("resnet101", "layer1")]:
+        cfg32 = BackboneConfig(cnn=cnn, last_layer=layer)
+        cfg16 = dataclasses.replace(cfg32, compute_dtype="bfloat16")
+        params = backbone_init(jax.random.PRNGKey(0), cfg32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 64, 64))
+        f32 = backbone_apply(cfg32, params, x)
+        f16 = backbone_apply(cfg16, params, x)
+        assert f16.dtype == jnp.float32  # cast back at the boundary
+        cos = jnp.sum(f32 * f16) / (
+            jnp.linalg.norm(f32.reshape(-1)) * jnp.linalg.norm(f16.reshape(-1))
+        )
+        assert cos > 0.995, (cnn, float(cos))
