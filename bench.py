@@ -44,8 +44,21 @@ def main():
     from ncnet_tpu.models.ncnet import ncnet_forward
     from ncnet_tpu.ops import corr_to_matches
 
-    note("dialing backend (jax.devices())...")
-    dev = jax.devices()[0]
+    # Backend dial under a watchdog: a wedged TPU tunnel blocks
+    # jax.devices() forever (observed on axon when a prior client's lease
+    # lingers). Failing loudly beats hanging until the harness timeout.
+    dial_timeout = float(os.environ.get("NCNET_BENCH_DIAL_TIMEOUT", "900"))
+    note(f"dialing backend (jax.devices(), watchdog {dial_timeout:.0f}s)...")
+    import threading
+
+    dialed = []
+    th = threading.Thread(target=lambda: dialed.append(jax.devices()), daemon=True)
+    th.start()
+    th.join(dial_timeout)
+    if not dialed:
+        note("backend dial timed out — accelerator unreachable; aborting")
+        os._exit(2)
+    dev = dialed[0][0]
     on_tpu = dev.platform != "cpu"
     note(f"backend up: {dev}")
 

@@ -1,0 +1,140 @@
+"""Staged profiler for the InLoc dense-matching pipeline.
+
+Times each stage of the headline workload (SURVEY.md §3.3) separately —
+backbone, fused correlation+pool, consensus, match extraction — so a
+regression or a wedged backend is attributable to a stage instead of one
+opaque end-to-end number. Timestamps print immediately (never pipe this
+through a buffering grep on a long TPU run).
+
+Usage:
+    python tools/profile_inloc.py                 # full InLoc shapes
+    python tools/profile_inloc.py --scale 0.5     # half-size features
+    JAX_PLATFORMS=cpu python tools/profile_inloc.py --scale 0.2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="scale on the InLoc image size (1.0 = 3200x2400)")
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--dial_timeout", type=float, default=900.0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("NCNET_TPU_COMPILE_CACHE", "/tmp/ncnet_tpu_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import threading
+
+    dialed = []
+    th = threading.Thread(target=lambda: dialed.append(jax.devices()), daemon=True)
+    th.start()
+    th.join(args.dial_timeout)
+    if not dialed:
+        log("backend dial timed out; aborting")
+        os._exit(2)
+    log(f"devices: {dialed[0]}")
+
+    import jax.numpy as jnp
+
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.backbone import backbone_apply
+    from ncnet_tpu.ops import (
+        corr_to_matches,
+        mutual_matching,
+        neigh_consensus_apply,
+        neigh_consensus_init,
+    )
+    from ncnet_tpu.ops.pallas_kernels import fused_correlation_maxpool
+
+    # InLoc config: long side 3200 -> stride-16 features 200x150, k=2.
+    h = int(3200 * args.scale) // 32 * 32
+    w = int(2400 * args.scale) // 32 * 32
+    fh, fw = h // 16, w // 16
+    log(f"image {h}x{w} -> features {fh}x{fw}")
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(compute_dtype="bfloat16"),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=2,
+        half_precision=True,
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    log("params built")
+
+    def timed(name, fn, *xs):
+        t0 = time.perf_counter()
+        out = fn(*xs)
+        jax.block_until_ready(out)
+        t_first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            jax.block_until_ready(fn(*xs))
+        dt = (time.perf_counter() - t0) / args.iters
+        log(f"{name}: compile+first={t_first:.2f}s steady={dt * 1000:.1f}ms")
+        return out
+
+    bb = jax.jit(lambda p, x: backbone_apply(config.backbone, p, x))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 3, h, w), jnp.float32)
+    feat = timed(f"backbone {h}x{w}", bb, params["backbone"], x)
+    log(f"  features: {feat.shape} {feat.dtype}")
+
+    fused = jax.jit(
+        lambda a, b: fused_correlation_maxpool(
+            a, b, k_size=2, corr_dtype=config.corr_dtype
+        )
+    )
+    fa = jax.random.normal(jax.random.PRNGKey(2), (1, 1024, fh, fw), jnp.float32)
+    fb = jax.random.normal(jax.random.PRNGKey(3), (1, 1024, fh, fw), jnp.float32)
+    pooled, deltas = timed(f"fused corr+pool {fh}x{fw}", fused, fa, fb)
+    log(f"  pooled: {pooled.shape} {pooled.dtype}")
+
+    nc = params["neigh_consensus"]
+
+    def consensus(p, corr):
+        corr = mutual_matching(corr)
+        corr = neigh_consensus_apply(p, corr, symmetric=True)
+        return mutual_matching(corr)
+
+    corr4d = timed(
+        "mutual+consensus+mutual", jax.jit(consensus), nc,
+        pooled.astype(jnp.float32),
+    )
+
+    def extract(corr, d):
+        m1 = corr_to_matches(
+            corr, delta4d=d, k_size=2, do_softmax=True, scale="positive"
+        )
+        m2 = corr_to_matches(
+            corr, delta4d=d, k_size=2, do_softmax=True, scale="positive",
+            invert_matching_direction=True,
+        )
+        return m1, m2
+
+    timed("corr_to_matches both dirs", jax.jit(extract), corr4d, deltas)
+    log("ALL DONE")
+
+
+if __name__ == "__main__":
+    main()
