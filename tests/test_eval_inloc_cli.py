@@ -1,0 +1,78 @@
+"""End-to-end test of the InLoc matching CLI (cli/eval_inloc.py).
+
+Synthetic fixture: a shortlist .mat (ImgList rows of query name + pano
+names), query/pano JPEGs. Checks the written per-query match .mat
+(layout parity with the reference writer, eval_inloc.py:199-221) and the
+--resume skip behavior.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+from scipy.io import loadmat, savemat
+
+from ncnet_tpu.cli import eval_inloc
+
+
+@pytest.fixture()
+def fixture_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    qdir = tmp_path / "query"
+    pdir = tmp_path / "pano"
+    qdir.mkdir()
+    pdir.mkdir()
+    for name, d in [("q0.jpg", qdir), ("q1.jpg", qdir)]:
+        Image.fromarray((rng.random((96, 128, 3)) * 255).astype("uint8")).save(d / name)
+    pano_names = [f"p{i}.jpg" for i in range(2)]
+    for name in pano_names:
+        Image.fromarray((rng.random((96, 128, 3)) * 255).astype("uint8")).save(
+            pdir / name
+        )
+    # ImgList struct array: each row (queryname, topNname cell array).
+    img_list = np.zeros((1, 2), dtype=[("queryname", "O"), ("topNname", "O")])
+    for q, qn in enumerate(["q0.jpg", "q1.jpg"]):
+        img_list[0, q]["queryname"] = qn
+        img_list[0, q]["topNname"] = np.array(pano_names, dtype=object).reshape(1, -1)
+    savemat(tmp_path / "shortlist.mat", {"ImgList": img_list})
+    return tmp_path
+
+
+def _run(fixture_dir, size=64):
+    out_dir = fixture_dir / "matches"
+    eval_inloc.main(
+        [
+            "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
+            "--query_path", str(fixture_dir / "query"),
+            "--pano_path", str(fixture_dir / "pano"),
+            "--output_dir", str(out_dir),
+            "--image_size", str(size),
+            "--n_queries", "2",
+            "--n_panos", "2",
+            "--k_size", "2",
+        ]
+    )
+    exp = [d for d in os.listdir(out_dir)]
+    assert len(exp) == 1
+    return out_dir / exp[0]
+
+
+def test_writes_match_files(fixture_dir):
+    exp_dir = _run(fixture_dir)
+    files = sorted(os.listdir(exp_dir))
+    assert files == ["1.mat", "2.mat"]
+    m = loadmat(exp_dir / "1.mat")["matches"]
+    # [1, n_panos, N, 5] with normalized coords + score rows filled.
+    assert m.shape[0] == 1 and m.shape[1] == 2 and m.shape[3] == 5
+    filled = m[0, 0]
+    assert np.isfinite(filled).all()
+    assert (filled[:, :4] >= 0).all() and (filled[:, :4] <= 1).all()
+
+
+def test_resume_skips_existing(fixture_dir):
+    exp_dir = _run(fixture_dir)
+    mtimes = {f: os.path.getmtime(exp_dir / f) for f in os.listdir(exp_dir)}
+    _run(fixture_dir)  # --resume is default-on; nothing rewritten
+    for f, t in mtimes.items():
+        assert os.path.getmtime(exp_dir / f) == t
