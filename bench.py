@@ -30,13 +30,9 @@ def note(msg):
 def main():
     import jax
 
-    # Persistent compilation cache: the InLoc-shape compile is minutes-long
-    # on a tunneled backend; cache it across bench invocations.
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("NCNET_TPU_COMPILE_CACHE", "/tmp/ncnet_tpu_jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from ncnet_tpu.utils.profiling import dial_devices, setup_compile_cache
+
+    setup_compile_cache()
 
     import jax.numpy as jnp
 
@@ -49,16 +45,11 @@ def main():
     # lingers). Failing loudly beats hanging until the harness timeout.
     dial_timeout = float(os.environ.get("NCNET_BENCH_DIAL_TIMEOUT", "900"))
     note(f"dialing backend (jax.devices(), watchdog {dial_timeout:.0f}s)...")
-    import threading
-
-    dialed = []
-    th = threading.Thread(target=lambda: dialed.append(jax.devices()), daemon=True)
-    th.start()
-    th.join(dial_timeout)
-    if not dialed:
+    devices = dial_devices(dial_timeout)
+    if devices is None:
         note("backend dial timed out — accelerator unreachable; aborting")
         os._exit(2)
-    dev = dialed[0][0]
+    dev = devices[0]
     on_tpu = dev.platform != "cpu"
     note(f"backend up: {dev}")
 

@@ -144,8 +144,6 @@ def main(argv=None):
     # 3200 px) overlaps the device forward of the previous pano.
     from concurrent.futures import ThreadPoolExecutor
 
-    pool = ThreadPoolExecutor(max_workers=1)
-
     def load_pano(pano_fn):
         return jnp.asarray(
             load_inloc_image(
@@ -153,6 +151,16 @@ def main(argv=None):
             )
         )
 
+    pool = ThreadPoolExecutor(max_workers=1)
+    try:
+        _query_loop(args, db, out_dir, params, forward, n_matches, pano_fn_all,
+                    pool, load_pano)
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _query_loop(args, db, out_dir, params, forward, n_matches, pano_fn_all,
+                pool, load_pano):
     for q in range(min(args.n_queries, len(db))):
         out_path = os.path.join(out_dir, f"{q + 1}.mat")
         if args.resume and os.path.exists(out_path):
@@ -165,7 +173,7 @@ def main(argv=None):
         )
         buf = matches_buffer(args.n_panos, n_matches)
         pano_fns = [db[q][1].ravel()[i].item() for i in range(args.n_panos)]
-        fut = pool.submit(load_pano, pano_fns[0])
+        fut = pool.submit(load_pano, pano_fns[0]) if pano_fns else None
         for idx in range(args.n_panos):
             tgt = fut.result()
             if idx + 1 < args.n_panos:

@@ -85,3 +85,36 @@ def phase(name: str, sync=None):
 
 def global_timer() -> PhaseTimer:
     return _GLOBAL_TIMER
+
+
+def dial_devices(timeout: float):
+    """jax.devices() under a watchdog thread.
+
+    A wedged accelerator tunnel blocks jax.devices() indefinitely (observed
+    on the axon TPU backend when a dead client's lease lingers); returns the
+    device list, or None if the dial did not complete within `timeout`
+    seconds. Shared by bench.py and tools/profile_inloc.py.
+    """
+    import threading
+
+    import jax
+
+    out = []
+    th = threading.Thread(target=lambda: out.append(jax.devices()), daemon=True)
+    th.start()
+    th.join(timeout)
+    return out[0] if out else None
+
+
+def setup_compile_cache(path: str = ""):
+    """Enable the persistent XLA compilation cache (minutes-long InLoc-shape
+    compiles amortize across processes)."""
+    import os
+
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        path or os.environ.get("NCNET_TPU_COMPILE_CACHE", "/tmp/ncnet_tpu_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -56,11 +56,16 @@ def main(argv=None):
 
     if args.verify:
         restored = load_checkpoint(tag)
-        ok = True
-        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored["params"])):
-            if not np.array_equal(np.asarray(a), np.asarray(b)):
-                ok = False
-                break
+        try:
+            # tree.map raises on structure mismatch (dropped/extra tensors).
+            equal = jax.tree.map(
+                lambda a, b: bool(np.array_equal(np.asarray(a), np.asarray(b))),
+                params,
+                restored["params"],
+            )
+            ok = all(jax.tree.leaves(equal))
+        except ValueError:
+            ok = False
         if not ok or restored["config"] != config:
             print("VERIFY FAILED: round-trip mismatch", file=sys.stderr)
             sys.exit(1)

@@ -38,22 +38,14 @@ def main(argv=None):
 
     import jax
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("NCNET_TPU_COMPILE_CACHE", "/tmp/ncnet_tpu_jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from ncnet_tpu.utils.profiling import dial_devices, setup_compile_cache
 
-    import threading
-
-    dialed = []
-    th = threading.Thread(target=lambda: dialed.append(jax.devices()), daemon=True)
-    th.start()
-    th.join(args.dial_timeout)
-    if not dialed:
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
         log("backend dial timed out; aborting")
         os._exit(2)
-    log(f"devices: {dialed[0]}")
+    log(f"devices: {devices}")
 
     import jax.numpy as jnp
 
