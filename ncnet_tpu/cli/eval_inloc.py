@@ -11,7 +11,7 @@ TPU-first differences from the reference:
     XLA compilation, so bucketing bounds recompiles (SURVEY.md §7 item 7);
   * the 4-D pipeline runs in bf16-correlation + f32 accumulation instead of
     fp16 storage;
-  * with --sp_shards > 1 the correlation tensor is spatially sharded across
+  * with --spatial_shards > 1 the correlation tensor is spatially sharded across
     the device mesh (parallel/corr_sharding.py) — the memory that forces the
     reference to fp16 + pool is instead split over chips;
   * finished queries are skipped by output-file existence, keeping the
@@ -40,22 +40,30 @@ from ..models.ncnet import ncnet_forward
 from .common import build_model
 
 
-def inloc_resize_shape(h, w, image_size, k_size, scale_factor=0.0625):
-    """Target (h, w): long side ~image_size, feature dims divisible by k_size.
+def inloc_resize_shape(h, w, image_size, k_size, scale_factor=0.0625, h_unit=0):
+    """Target (h, w): long side ~image_size, feature dims divisible by k_size
+    (height: by `h_unit` when given — the sharded forward needs iA and iB
+    divisible by shards*k_size; widths only ever need k_size).
 
     Mirrors the reference's alignment arithmetic (eval_inloc.py:84-89):
     floor(dim / (long/image_size) * scale/k) / scale * k.
     """
+    h_unit = h_unit or k_size
     ratio = max(h, w) / image_size
-    out_h = int(np.floor(h / ratio * scale_factor / k_size) / scale_factor * k_size)
+    out_h = int(np.floor(h / ratio * scale_factor / h_unit) / scale_factor * h_unit)
     out_w = int(np.floor(w / ratio * scale_factor / k_size) / scale_factor * k_size)
     return out_h, out_w
 
 
-def load_inloc_image(path, image_size, k_size):
+def load_inloc_image(path, image_size, k_size, extra_align: int = 1):
+    """extra_align multiplies the HEIGHT divisibility unit — the spatially-
+    sharded forward needs iA (and, via the transposed pass, iB) divisible by
+    (shards * k_size); width alignment stays at k_size."""
     img = read_image(path)
     h, w = img.shape[:2]
-    oh, ow = inloc_resize_shape(h, w, image_size, k_size)
+    oh, ow = inloc_resize_shape(
+        h, w, image_size, k_size, h_unit=k_size * extra_align
+    )
     img = resize_bilinear_np(img, oh, ow) / 255.0
     img = normalize_image(img.transpose(2, 0, 1))
     return img[None].astype(np.float32)
@@ -94,7 +102,12 @@ def main(argv=None):
     parser.add_argument(
         "--no-backbone_bf16", dest="backbone_bf16", action="store_false"
     )
+    # Multi-chip: shard the correlation tensor along iA over N devices
+    # (parallel/inloc_sharded.py). 1 = single-device.
+    parser.add_argument("--spatial_shards", type=int, default=1)
     args = parser.parse_args(argv)
+    if args.spatial_shards < 1:
+        parser.error("--spatial_shards must be >= 1")
 
     from scipy.io import loadmat
 
@@ -128,10 +141,17 @@ def main(argv=None):
 
     # One jit per distinct (src, tgt) shape pair; the bucketed resize keeps
     # this cache small.
-    @partial(jax.jit, static_argnums=())
-    def forward(params, src, tgt):
-        corr, delta = ncnet_forward(config, params, src, tgt)
-        return corr, delta
+    if args.spatial_shards > 1:
+        from ..parallel import make_mesh, make_sharded_inloc_forward
+
+        mesh = make_mesh((args.spatial_shards,), ("sp",))
+        forward = make_sharded_inloc_forward(config, mesh)
+    else:
+
+        @partial(jax.jit, static_argnums=())
+        def forward(params, src, tgt):
+            corr, delta = ncnet_forward(config, params, src, tgt)
+            return corr, delta
 
     n_matches = int(
         (args.image_size * 0.0625 / args.k_size)
@@ -147,7 +167,8 @@ def main(argv=None):
     def load_pano(pano_fn):
         return jnp.asarray(
             load_inloc_image(
-                os.path.join(args.pano_path, pano_fn), args.image_size, args.k_size
+                os.path.join(args.pano_path, pano_fn), args.image_size, args.k_size,
+                extra_align=args.spatial_shards,
             )
         )
 
@@ -168,7 +189,8 @@ def _query_loop(args, db, out_dir, params, forward, n_matches, pano_fn_all,
         query_fn = db[q][0].item()
         src = jnp.asarray(
             load_inloc_image(
-                os.path.join(args.query_path, query_fn), args.image_size, args.k_size
+                os.path.join(args.query_path, query_fn), args.image_size, args.k_size,
+                extra_align=args.spatial_shards,
             )
         )
         buf = matches_buffer(args.n_panos, n_matches)

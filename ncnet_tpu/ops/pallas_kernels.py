@@ -270,6 +270,10 @@ def fused_correlation_maxpool(
     """Dispatch on the *lowering* platform: Pallas on TPU, slab-scan XLA
     elsewhere.
 
+    Both branches are traced by lax.platform_dependent, so degenerate shapes
+    must be rejected up front (a 0-sized dim crashes Pallas grid math with an
+    opaque ZeroDivisionError).
+
     `lax.platform_dependent` resolves when the surrounding jit is lowered, so
     a computation explicitly placed on CPU of a TPU host still gets the XLA
     path (device-list sniffing would pick the Pallas kernel and fail to

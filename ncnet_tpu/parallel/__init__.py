@@ -11,6 +11,7 @@ from .corr_sharding import (
 )
 
 __all__ = [
+    "make_sharded_inloc_forward",
     "make_mesh",
     "batch_sharding",
     "replicated",
@@ -21,3 +22,11 @@ __all__ = [
     "neigh_consensus_sharded",
     "conv4d_haloed",
 ]
+
+
+def make_sharded_inloc_forward(*args, **kwargs):
+    """Lazy re-export: importing it eagerly would pull jax.experimental.pallas
+    onto the import path of every parallel-package consumer."""
+    from .inloc_sharded import make_sharded_inloc_forward as fn
+
+    return fn(*args, **kwargs)

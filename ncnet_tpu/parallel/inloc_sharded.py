@@ -1,0 +1,85 @@
+"""Spatially-sharded InLoc forward: multi-chip dense matching.
+
+Composes the pieces of corr_sharding.py into the full high-resolution
+matching step (SURVEY.md §3.3) with the correlation tensor sharded along
+iA across the mesh — the multi-chip path for resolutions whose (even
+pooled) correlation tensor plus workspace exceeds one chip's HBM:
+
+    backbone (replicated)
+      -> per-shard fused correlation + maxpool4d  (no communication:
+         each shard owns a slab of A rows; pooling is local to a slab)
+      -> mutual matching (pmax over shards)
+      -> symmetric NeighConsensus (halo-exchange Conv4d + all_to_all)
+      -> mutual matching
+    -> globally-shaped corr4d + relocalization deltas for corr_to_matches.
+
+The reference has no distributed counterpart (single GPU, fp16+maxpool
+as the only memory lever — eval_inloc.py:50, lib/model.py:269-272).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..models.ncnet import NCNetConfig, extract_features
+from .corr_sharding import make_sharded_match_pipeline
+
+
+def make_sharded_inloc_forward(config: NCNetConfig, mesh: Mesh, axis_name: str = "sp"):
+    """Build a jitted (params, src, tgt) -> (corr4d, delta4d) forward.
+
+    Requirements: batch 1; feature height iA divisible by
+    (mesh size * relocalization_k_size) — the input bucketing in
+    cli/eval_inloc.py pads images so this holds. In symmetric mode iB must
+    also be divisible by the mesh size (all_to_all re-shard).
+    """
+    # Local import keeps jax.experimental.pallas off the import path of
+    # consumers that never build the sharded InLoc forward (same policy as
+    # models/ncnet.py's fused branch).
+    from ..ops.pallas_kernels import fused_correlation_maxpool
+
+    k = config.relocalization_k_size
+    if k <= 1:
+        raise ValueError("sharded InLoc forward requires relocalization_k_size > 1")
+    spec_fa = P(None, None, axis_name, None)
+    spec_corr = P(None, None, axis_name, None, None, None)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec_fa, P()),
+        out_specs=(spec_corr, (spec_corr,) * 4),
+        check_vma=False,
+    )
+    def corr_pool_local(fa_local, fb):
+        # Each shard computes corr rows for its A slab and pools them —
+        # embarrassingly parallel (pool cells never straddle shards since
+        # I_loc is a multiple of k). delta_ia is slab-relative and needs no
+        # offset: maxpool4d deltas encode *within-cell* offsets.
+        pooled, deltas = fused_correlation_maxpool(
+            fa_local, fb, k_size=k, corr_dtype=config.corr_dtype
+        )
+        return pooled, tuple(deltas)
+
+    pipeline = make_sharded_match_pipeline(
+        mesh, axis_name, symmetric=config.symmetric_mode
+    )
+
+    @jax.jit
+    def forward(params, source_image, target_image):
+        feat_a = extract_features(config, params, source_image)
+        feat_b = extract_features(config, params, target_image)
+        feat_a = lax.with_sharding_constraint(
+            feat_a, NamedSharding(mesh, spec_fa)
+        )
+        pooled, deltas = corr_pool_local(feat_a, feat_b)
+        corr4d = pipeline(params["neigh_consensus"], pooled.astype(jnp.float32))
+        return corr4d, deltas
+
+    return forward

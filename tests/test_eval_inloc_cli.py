@@ -76,3 +76,26 @@ def test_resume_skips_existing(fixture_dir):
     _run(fixture_dir)  # --resume is default-on; nothing rewritten
     for f, t in mtimes.items():
         assert os.path.getmtime(exp_dir / f) == t
+
+
+def test_spatial_shards_cli(fixture_dir):
+    """--spatial_shards 2 runs the sharded forward on the CPU mesh and writes
+    the same .mat layout."""
+    out_dir = fixture_dir / "matches_sharded"
+    eval_inloc.main(
+        [
+            "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
+            "--query_path", str(fixture_dir / "query"),
+            "--pano_path", str(fixture_dir / "pano"),
+            "--output_dir", str(out_dir),
+            "--image_size", "128",
+            "--n_queries", "1",
+            "--n_panos", "2",
+            "--k_size", "2",
+            "--spatial_shards", "2",
+        ]
+    )
+    exp = os.listdir(out_dir)
+    m = loadmat(out_dir / exp[0] / "1.mat")["matches"]
+    assert m.shape[0] == 1 and m.shape[3] == 5
+    assert np.isfinite(m[0, 0]).all()

@@ -110,3 +110,41 @@ def test_dp_train_step_matches_single_device(rng):
     np.testing.assert_allclose(float(loss_single), float(loss_dp), atol=1e-5)
     for a, b in zip(jax.tree.leaves(t1), jax.tree.leaves(t2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_sharded_inloc_forward_matches_single_device():
+    """Full sharded InLoc forward (sharded fused corr+pool -> sharded
+    consensus) vs the single-device ncnet_forward on an 8-way CPU mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import ncnet_forward
+    from ncnet_tpu.parallel import make_mesh, make_sharded_inloc_forward
+
+    n = min(len(jax.devices()), 4)
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(4, 1),
+        relocalization_k_size=2,
+        use_fused_corr_pool=True,
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    # pool3 => stride 8; image 128 -> features 16 = divisible by n*k for n<=4.
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    src = jax.random.normal(k1, (1, 3, 128, 128))
+    tgt = jax.random.normal(k2, (1, 3, 128, 128))
+
+    ref_corr, ref_deltas = ncnet_forward(config, params, src, tgt)
+
+    mesh = make_mesh((n,), ("sp",))
+    fwd = make_sharded_inloc_forward(config, mesh)
+    corr, deltas = fwd(params, src, tgt)
+
+    np.testing.assert_allclose(
+        np.asarray(corr), np.asarray(ref_corr), atol=2e-5, rtol=1e-4
+    )
+    for d, rd in zip(deltas, ref_deltas):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
