@@ -152,7 +152,10 @@ def make_train_step(
 
         return weak_loss_from_features(match, feat_a, feat_b, normalization)
 
-    @jax.jit
+    # Donate the updated-in-place buffers (params + opt state): XLA reuses
+    # their device memory for the outputs instead of allocating fresh copies
+    # each step.
+    @partial(jax.jit, donate_argnums=(0, 2))
     def train_step(state_trainable, state_frozen, opt_state, source, target):
         loss, grads = jax.value_and_grad(loss_fn)(
             state_trainable, state_frozen, source, target

@@ -151,9 +151,12 @@ def test_finetune_mask_excludes_bn_stats(rng):
     train_step, _ = make_train_step(config, tx)
     src = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
     tgt = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    # Snapshot before stepping: train_step donates its params/opt-state
+    # buffers, so the originals are invalidated on TPU after the call.
+    old_bb = jax.tree.map(np.asarray, state.trainable["backbone"])
     new_t, _, _ = train_step(state.trainable, state.frozen, state.opt_state, src, tgt)
 
-    old_bb, new_bb = state.trainable["backbone"], new_t["backbone"]
+    new_bb = new_t["backbone"]
     last_block_old = old_bb["layer1"][-1]
     last_block_new = new_bb["layer1"][-1]
     # finetuned block: conv weights move, bn stats do not

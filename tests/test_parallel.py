@@ -91,9 +91,11 @@ def test_dp_train_step_matches_single_device(rng):
     state, tx = create_train_state(params, learning_rate=1e-3)
     train_step, _ = make_train_step(config, tx)
 
-    # single device
+    # single device. train_step donates params/opt-state buffers, so pass
+    # fresh copies and keep `state` intact for the data-parallel run below.
+    copy = lambda t: jax.tree.map(lambda x: jnp.array(x, copy=True), t)
     t1, _, loss_single = train_step(
-        state.trainable, state.frozen, state.opt_state, src, tgt
+        copy(state.trainable), state.frozen, copy(state.opt_state), src, tgt
     )
 
     # data-parallel over 4 devices
