@@ -28,8 +28,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..data.image_io import read_image, resize_bilinear_np
-from ..data.normalization import normalize_image
 from ..evals import (
     extract_inloc_matches,
     fill_matches,
@@ -59,14 +57,17 @@ def load_inloc_image(path, image_size, k_size, extra_align: int = 1):
     """extra_align multiplies the HEIGHT divisibility unit — the spatially-
     sharded forward needs iA (and, via the transposed pass, iB) divisible by
     (shards * k_size); width alignment stays at k_size."""
-    img = read_image(path)
-    h, w = img.shape[:2]
+    from PIL import Image
+
+    from ..data.image_io import load_and_resize_chw
+
+    with Image.open(path) as im:  # header-only: dims without a full decode
+        w, h = im.size
     oh, ow = inloc_resize_shape(
         h, w, image_size, k_size, h_unit=k_size * extra_align
     )
-    img = resize_bilinear_np(img, oh, ow) / 255.0
-    img = normalize_image(img.transpose(2, 0, 1))
-    return img[None].astype(np.float32)
+    chw, _ = load_and_resize_chw(path, oh, ow, normalize=True)
+    return chw[None]
 
 
 def main(argv=None):

@@ -14,9 +14,16 @@ from PIL import Image
 
 
 def read_image(path: str) -> np.ndarray:
-    """Read an image as [h, w, 3] uint8 (grayscale broadcast to 3 channels)."""
+    """Read an image as [h, w, 3] uint8 (grayscale broadcast to 3 channels).
+
+    Non-8-bit inputs (e.g. 16-bit PNGs) are converted through PIL to 8-bit,
+    matching the native loader's png_set_strip_16 behavior — both paths must
+    produce the same value scale.
+    """
     img = Image.open(path)
     arr = np.asarray(img)
+    if arr.dtype != np.uint8:
+        arr = np.asarray(img.convert("RGB"))
     if arr.ndim == 2:
         arr = np.repeat(arr[:, :, None], 3, axis=2)
     if arr.shape[2] == 4:
@@ -45,11 +52,34 @@ def resize_bilinear_np(image: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     return out
 
 
-def load_and_resize_chw(path: str, out_h: int, out_w: int, flip: bool = False) -> tuple:
-    """Read, optionally h-flip, resize; return ([3,h,w] float32, orig (h,w,c))."""
+def load_and_resize_chw(
+    path: str, out_h: int, out_w: int, flip: bool = False, normalize: bool = False
+) -> tuple:
+    """Read, optionally h-flip, resize; return ([3,h,w] float32, orig (h,w,c)).
+
+    With normalize=True the output is ImageNet-normalized ((x/255-mean)/std)
+    instead of raw 0..255. Uses the native C++ decode+resize
+    (ncnet_tpu/native/image_loader.cpp — identical corner-aligned arithmetic,
+    GIL-free) when built; falls back to the PIL + numpy path for unsupported
+    formats or a missing toolchain.
+    """
+    try:
+        from ncnet_tpu import native
+
+        if native.image_available():
+            chw, (h, w) = native.load_image_chw_native(
+                path, out_h, out_w, flip=flip, normalize=normalize
+            )
+            return chw, np.asarray((h, w, 3), np.float32)
+    except (OSError, RuntimeError):
+        pass
     img = read_image(path)
     im_size = np.asarray(img.shape, np.float32)
     if flip:
         img = img[:, ::-1]
-    img = resize_bilinear_np(img, out_h, out_w)
-    return img.transpose(2, 0, 1).copy(), im_size
+    img = resize_bilinear_np(img, out_h, out_w).transpose(2, 0, 1)
+    if normalize:
+        from .normalization import normalize_image
+
+        img = normalize_image(img / 255.0)
+    return np.ascontiguousarray(img, dtype=np.float32), im_size

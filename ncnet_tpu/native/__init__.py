@@ -10,6 +10,9 @@ Contents:
   * p3p_ransac.cpp — LO-RANSAC P3P absolute-pose solver (OpenMP), the
     native equivalent of the reference's Matlab parfor + ht_lo_ransac_p3p
     stage (lib_matlab/parfor_NC4D_PE_pnponly.m:25,77).
+  * image_loader.cpp — JPEG/PNG decode + corner-aligned resize + normalize
+    to CHW float32 in one pass (the job of the reference DataLoader's PIL
+    workers, lib/dataloader.py:39-56), GIL-free under the threaded loaders.
 """
 
 from __future__ import annotations
@@ -22,77 +25,129 @@ import threading
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "p3p_ransac.cpp")
-_LIB = os.path.join(_DIR, "libncnet_p3p.so")
+
+# Two independent libraries: the P3P solver needs only g++, the image
+# loader additionally links libjpeg/libpng — its absence must not take
+# the solver down with it.
+_P3P_SRC = [os.path.join(_DIR, "p3p_ransac.cpp")]
+_P3P_LIB = os.path.join(_DIR, "libncnet_p3p.so")
+_IMG_SRC = [os.path.join(_DIR, "image_loader.cpp")]
+_IMG_LIB = os.path.join(_DIR, "libncnet_image.so")
 
 _lock = threading.Lock()
-_lib = None
-_load_failed = False
+_libs = {}  # name -> ctypes.CDLL | None (None = build/load failed)
 
 
-def build(force: bool = False) -> str:
-    """Compile the shared library if missing or stale. Returns its path."""
+def _build(srcs, lib_path, extra_flags=(), force=False) -> str:
+    """Compile one shared library if missing or stale. Returns its path."""
     stale = (
         force
-        or not os.path.exists(_LIB)
-        or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        or not os.path.exists(lib_path)
+        or os.path.getmtime(lib_path) < max(os.path.getmtime(s) for s in srcs)
     )
     if stale:
         # Per-process tmp name + atomic rename: concurrent builders (e.g.
         # pytest-xdist workers) each write their own file and the last
         # os.replace wins with a complete library either way.
-        tmp = f"{_LIB}.{os.getpid()}.tmp"
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
         cmd = [
             "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-fopenmp",
-            _SRC, "-o", tmp,
+            *srcs, "-o", tmp, *extra_flags,
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
         except (subprocess.CalledProcessError, FileNotFoundError) as exc:
             detail = getattr(exc, "stderr", "") or str(exc)
             raise RuntimeError(f"native build failed: {detail}") from exc
-        os.replace(tmp, _LIB)
-    return _LIB
+        os.replace(tmp, lib_path)
+    return lib_path
+
+
+def build(force: bool = False) -> str:
+    """Compile both libraries (image loader failure is non-fatal)."""
+    path = _build(_P3P_SRC, _P3P_LIB, force=force)
+    try:
+        _build(_IMG_SRC, _IMG_LIB, ("-ljpeg", "-lpng"), force=force)
+    except RuntimeError:
+        pass
+    return path
+
+
+def _load_named(name):
+    if name in _libs:
+        return _libs[name]
+    srcs, lib_path, flags = {
+        "p3p": (_P3P_SRC, _P3P_LIB, ()),
+        "image": (_IMG_SRC, _IMG_LIB, ("-ljpeg", "-lpng")),
+    }[name]
+    try:
+        lib = ctypes.CDLL(_build(srcs, lib_path, flags))
+    except (RuntimeError, OSError):
+        _libs[name] = None
+        return None
+    if name == "p3p":
+        _declare_p3p(lib)
+    else:
+        _declare_image(lib)
+    _libs[name] = lib
+    return lib
 
 
 def load():
-    """Load (building if needed) the native library, or None on failure."""
-    global _lib, _load_failed
+    """Load (building if needed) the P3P library, or None on failure."""
     with _lock:
-        if _lib is not None or _load_failed:
-            return _lib
-        try:
-            lib = ctypes.CDLL(build())
-        except (RuntimeError, OSError):
-            _load_failed = True
-            return None
-        lib.ncnet_lo_ransac_p3p.restype = ctypes.c_int
-        lib.ncnet_lo_ransac_p3p.argtypes = [
-            ctypes.POINTER(ctypes.c_double),  # rays
-            ctypes.POINTER(ctypes.c_double),  # points
-            ctypes.c_int,                     # n
-            ctypes.c_double,                  # inlier_thr
-            ctypes.c_int,                     # max_iters
-            ctypes.c_uint64,                  # seed
-            ctypes.c_int,                     # lo_iters
-            ctypes.POINTER(ctypes.c_double),  # P_out [12]
-            ctypes.POINTER(ctypes.c_uint8),   # inliers_out [n]
-            ctypes.POINTER(ctypes.c_double),  # mean_err_out
-        ]
-        lib.ncnet_p3p_solve.restype = ctypes.c_int
-        lib.ncnet_p3p_solve.argtypes = [
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double),
-        ]
-        lib.ncnet_p3p_num_threads.restype = ctypes.c_int
-        lib.ncnet_p3p_num_threads.argtypes = []
-        _lib = lib
-        return _lib
+        return _load_named("p3p")
+
+
+def load_image_lib():
+    """Load (building if needed) the image loader, or None on failure."""
+    with _lock:
+        return _load_named("image")
+
+
+def _declare_p3p(lib):
+    lib.ncnet_lo_ransac_p3p.restype = ctypes.c_int
+    lib.ncnet_lo_ransac_p3p.argtypes = [
+        ctypes.POINTER(ctypes.c_double),  # rays
+        ctypes.POINTER(ctypes.c_double),  # points
+        ctypes.c_int,                     # n
+        ctypes.c_double,                  # inlier_thr
+        ctypes.c_int,                     # max_iters
+        ctypes.c_uint64,                  # seed
+        ctypes.c_int,                     # lo_iters
+        ctypes.POINTER(ctypes.c_double),  # P_out [12]
+        ctypes.POINTER(ctypes.c_uint8),   # inliers_out [n]
+        ctypes.POINTER(ctypes.c_double),  # mean_err_out
+    ]
+    lib.ncnet_p3p_solve.restype = ctypes.c_int
+    lib.ncnet_p3p_solve.argtypes = [
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.ncnet_p3p_num_threads.restype = ctypes.c_int
+    lib.ncnet_p3p_num_threads.argtypes = []
+
+
+def _declare_image(lib):
+    lib.ncnet_load_image_chw.restype = ctypes.c_int
+    lib.ncnet_load_image_chw.argtypes = [
+        ctypes.c_char_p,                  # path
+        ctypes.c_int, ctypes.c_int,       # out_h, out_w
+        ctypes.c_int, ctypes.c_int,       # flip, normalize
+        ctypes.POINTER(ctypes.c_int32),   # orig_hw[2] (nullable)
+        ctypes.POINTER(ctypes.c_float),   # out [3*out_h*out_w]
+    ]
 
 
 def available() -> bool:
+    """True when the P3P solver library is usable."""
     return load() is not None
+
+
+def image_available() -> bool:
+    """True when the image loader library (libjpeg/libpng) is usable."""
+    return load_image_lib() is not None
 
 
 def num_threads() -> int:
@@ -168,3 +223,27 @@ def lo_ransac_p3p_native(
         num_inliers=int(cnt),
         inlier_error=float(err.value),
     )
+
+
+def load_image_chw_native(
+    path: str, out_h: int, out_w: int, flip: bool = False, normalize: bool = False
+):
+    """Decode+resize(+normalize) via the native loader.
+
+    Returns ([3, out_h, out_w] float32, (orig_h, orig_w)). Raises
+    RuntimeError when the library is unavailable and IOError when the file
+    cannot be decoded (caller falls back to the PIL path).
+    """
+    lib = load_image_lib()
+    if lib is None:
+        raise RuntimeError("native image library unavailable")
+    out = np.empty((3, out_h, out_w), dtype=np.float32)
+    orig = np.zeros(2, dtype=np.int32)
+    rc = lib.ncnet_load_image_chw(
+        os.fsencode(path), int(out_h), int(out_w), int(flip), int(normalize),
+        orig.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if rc != 0:
+        raise IOError(f"native image load failed (rc={rc}): {path}")
+    return out, (int(orig[0]), int(orig[1]))

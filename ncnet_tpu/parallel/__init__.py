@@ -1,5 +1,6 @@
 """Parallelism: mesh construction, DP sharding, corr-tensor spatial sharding."""
 
+from . import multihost
 from .mesh import make_mesh, batch_sharding, replicated
 from .corr_sharding import (
     make_sharded_match_pipeline,
@@ -11,6 +12,7 @@ from .corr_sharding import (
 )
 
 __all__ = [
+    "multihost",
     "make_sharded_inloc_forward",
     "make_mesh",
     "batch_sharding",

@@ -1,0 +1,95 @@
+"""Multi-host bootstrap: one mesh spanning all hosts' devices.
+
+The reference is strictly single-process/single-GPU (SURVEY.md §2.8); here
+multi-host scaling is the same mesh abstraction as single-host — the mesh
+simply spans every host's devices, collectives ride ICI within a slice and
+DCN across slices, and XLA handles the transport. This module owns the only
+process-level coordination the framework needs: `jax.distributed.initialize`
+plus helpers for host-local batch handling.
+
+Typical use (same program on every host, e.g. under a TPU pod launcher):
+
+    from ncnet_tpu.parallel import multihost
+    multihost.initialize()                       # no-op single-host
+    mesh = multihost.global_mesh(("dp",))        # all devices, all hosts
+    # feed each host its local shard of the global batch:
+    batch = multihost.host_local_batch(global_batch_size, mesh)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the JAX distributed runtime (idempotent; single-host no-op).
+
+    With no arguments, relies on the environment (TPU pod runtimes and the
+    standard JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID
+    variables); explicit arguments win. Safe to call unconditionally: when
+    neither arguments nor environment indicate a multi-process run, it does
+    nothing.
+    """
+    global _initialized
+    if _initialized:
+        return
+    explicit = coordinator_address is not None
+    env = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if not (explicit or env):
+        return  # single-host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def global_mesh(axis_names: Sequence[str] = ("dp",), shape: Tuple[int, ...] = ()) -> Mesh:
+    """Mesh over ALL devices of ALL hosts (jax.devices() is global).
+
+    Default: 1-D mesh over every device. Pass `shape` for multi-axis meshes
+    (must multiply to the global device count).
+    """
+    import numpy as np
+
+    devices = np.asarray(jax.devices())
+    if not shape:
+        shape = (devices.size,)
+    return Mesh(devices.reshape(shape), axis_names)
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def host_local_slice(global_batch_size: int) -> Tuple[int, int]:
+    """[start, stop) of this host's rows of a globally-sharded batch.
+
+    The data loader on each host reads only its slice; jax.device_put with a
+    NamedSharding then places local rows on local devices without cross-host
+    transfer (the standard multi-host input pattern).
+    """
+    n, i = jax.process_count(), jax.process_index()
+    if global_batch_size % n:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by {n} hosts"
+        )
+    per = global_batch_size // n
+    return i * per, (i + 1) * per

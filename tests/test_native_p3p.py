@@ -130,3 +130,58 @@ def test_input_validation():
         native.p3p_solve_native(np.zeros((4, 3)), np.zeros((4, 3)))
     with pytest.raises(ValueError):
         lo_ransac_p3p(np.zeros((5, 3)), np.zeros((5, 3)), 0.01, backend="numppy")
+
+
+class TestNativeImageLoader:
+    def _roundtrip(self, tmp_path, fmt, shape=(37, 53)):
+        from ncnet_tpu.data.image_io import read_image, resize_bilinear_np
+
+        rng = np.random.default_rng(3)
+        arr = (rng.random(shape + (3,)) * 255).astype("uint8")
+        from PIL import Image
+
+        p = str(tmp_path / f"t.{fmt}")
+        Image.fromarray(arr).save(p, **({"quality": 95} if fmt == "jpg" else {}))
+        ref = resize_bilinear_np(read_image(p), 24, 40).transpose(2, 0, 1)
+        out, orig = native.load_image_chw_native(p, 24, 40)
+        assert orig == shape
+        # PNG decode is bit-exact; JPEG decoders may legally differ by
+        # +/-1 LSB between PIL's bundled turbo and the system libjpeg.
+        np.testing.assert_allclose(out, ref, atol=2.0 if fmt == "jpg" else 1e-3)
+
+    def test_jpeg_parity(self, tmp_path):
+        self._roundtrip(tmp_path, "jpg")
+
+    def test_png_parity(self, tmp_path):
+        self._roundtrip(tmp_path, "png")
+
+    def test_grayscale_png(self, tmp_path):
+        from PIL import Image
+
+        arr = (np.arange(40 * 30).reshape(40, 30) % 255).astype("uint8")
+        p = str(tmp_path / "g.png")
+        Image.fromarray(arr, mode="L").save(p)
+        out, orig = native.load_image_chw_native(p, 20, 15)
+        assert orig == (40, 30)
+        # gray broadcast: all three channels identical
+        np.testing.assert_array_equal(out[0], out[1])
+        np.testing.assert_array_equal(out[1], out[2])
+
+    def test_flip_and_normalize(self, tmp_path):
+        from PIL import Image
+
+        from ncnet_tpu.data.normalization import normalize_image
+
+        rng = np.random.default_rng(5)
+        arr = (rng.random((16, 20, 3)) * 255).astype("uint8")
+        p = str(tmp_path / "f.png")
+        Image.fromarray(arr).save(p)
+        out, _ = native.load_image_chw_native(p, 16, 20, flip=True, normalize=True)
+        ref = normalize_image(
+            arr[:, ::-1].astype(np.float32).transpose(2, 0, 1) / 255.0
+        )
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_missing_file(self):
+        with pytest.raises(IOError):
+            native.load_image_chw_native("/nonexistent.jpg", 8, 8)

@@ -150,3 +150,18 @@ def test_sharded_inloc_forward_matches_single_device():
     )
     for d, rd in zip(deltas, ref_deltas):
         np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
+
+
+def test_multihost_helpers_single_host():
+    """Single-host semantics: initialize() no-ops, mesh spans all devices,
+    the host-local slice is the full batch."""
+    import jax
+
+    from ncnet_tpu.parallel import multihost
+
+    multihost.initialize()  # no coordinator configured -> no-op
+    mesh = multihost.global_mesh(("dp",))
+    assert mesh.devices.size == len(jax.devices())
+    assert multihost.process_count() == 1
+    start, stop = multihost.host_local_slice(16)
+    assert (start, stop) == (0, 16)
