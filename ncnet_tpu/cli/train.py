@@ -49,6 +49,9 @@ def main(argv=None):
     parser.add_argument("--result_model_dir", type=str, default="trained_models")
     parser.add_argument("--result_model_fn", type=str, default="checkpoint_adam")
     parser.add_argument("--fe_finetune_params", type=int, default=0)
+    # Recompute backbone activations in the backward pass (HBM lever for
+    # fine-tuning at high resolution / large batch).
+    parser.add_argument("--remat_backbone", action="store_true", default=False)
     parser.add_argument("--num_workers", type=int, default=8)
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument("--log_interval", type=int, default=1)
@@ -68,7 +71,7 @@ def main(argv=None):
     state, tx = create_train_state(
         params, learning_rate=args.lr, train_fe=args.fe_finetune_params > 0
     )
-    train_step, eval_step = make_train_step(config, tx)
+    train_step, eval_step = make_train_step(config, tx, remat_backbone=args.remat_backbone)
 
     # Use the largest device count that divides the batch.
     n_dev = len(jax.devices())

@@ -134,8 +134,16 @@ def make_train_step(
     config: NCNetConfig,
     tx: optax.GradientTransformation,
     normalization: str = "softmax",
+    remat_backbone: bool = False,
 ):
-    """Build the jitted train step (loss + grads + Adam update)."""
+    """Build the jitted train step (loss + grads + Adam update).
+
+    remat_backbone=True wraps feature extraction in jax.checkpoint so its
+    activations are recomputed in the backward pass instead of stored —
+    the HBM lever for fine-tuning the backbone (train_fe) at high
+    resolution / large batch; with the default frozen backbone there is no
+    backbone backward pass and remat only costs compute.
+    """
 
     def loss_fn(trainable: Params, frozen: Params, source, target):
         params = {
@@ -143,8 +151,13 @@ def make_train_step(
             "neigh_consensus": trainable["neigh_consensus"],
         }
 
-        feat_a = extract_features(config, params, source)
-        feat_b = extract_features(config, params, target)
+        features = extract_features
+        if remat_backbone:
+            features = jax.checkpoint(
+                extract_features, static_argnums=(0,), policy=None
+            )
+        feat_a = features(config, params, source)
+        feat_b = features(config, params, target)
 
         def match(fa, fb):
             corr, _ = ncnet_forward_from_features(config, params, fa, fb)

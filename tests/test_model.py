@@ -213,3 +213,33 @@ def test_weak_loss_feature_roll_equals_image_roll(rng):
         extract_features(config, params, tgt),
     )
     assert jnp.allclose(loss_img, loss_feat, atol=1e-5), (loss_img, loss_feat)
+
+
+def test_train_step_remat_backbone_matches(rng):
+    """remat_backbone recomputes activations but must not change results."""
+    import jax
+
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.training import create_train_state, make_train_step
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    src = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    state, tx = create_train_state(params, train_fe=True, fe_finetune_blocks=1)
+
+    copy = lambda t: jax.tree.map(lambda x: jnp.array(x, copy=True), t)
+    outs = []
+    for remat in (False, True):
+        step, _ = make_train_step(config, tx, remat_backbone=remat)
+        t, _, loss = step(
+            copy(state.trainable), state.frozen, copy(state.opt_state), src, tgt
+        )
+        outs.append((t, float(loss)))
+    assert abs(outs[0][1] - outs[1][1]) < 1e-6
+    for a, b in zip(jax.tree.leaves(outs[0][0]), jax.tree.leaves(outs[1][0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
