@@ -116,13 +116,18 @@ def main(argv=None):
     train_losses, val_losses = [], []
     trainable, opt_state = state.trainable, state.opt_state
 
+    from ..data.loader import device_prefetch
+
+    def put(batch):
+        return shard_batch(
+            {k: batch[k] for k in ("source_image", "target_image")}, mesh
+        )
+
     for epoch in range(1, args.num_epochs + 1):
         t0 = time.time()
         epoch_loss, n_batches = 0.0, 0
-        for i, batch in enumerate(loader):
-            batch = shard_batch(
-                {k: batch[k] for k in ("source_image", "target_image")}, mesh
-            )
+        # One batch in flight: H2D transfer of batch i+1 overlaps step i.
+        for i, batch in enumerate(device_prefetch(loader, put)):
             trainable, opt_state, loss = train_step(
                 trainable, state.frozen, opt_state,
                 batch["source_image"], batch["target_image"],

@@ -126,3 +126,24 @@ class DataLoader:
                 yield item
         finally:
             stop.set()
+
+
+def device_prefetch(iterator, put_fn, depth: int = 2):
+    """Overlap host->device transfer with device compute.
+
+    jax.device_put is asynchronous: enqueueing the NEXT batch's transfer
+    before yielding the current one lets H2D copy ride under the train
+    step. `put_fn` maps a host batch to device arrays (e.g.
+    training.shard_batch); depth=2 keeps one batch in flight.
+    """
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    from collections import deque
+
+    pending = deque()
+    for item in iterator:
+        pending.append(put_fn(item))
+        if len(pending) >= depth:
+            yield pending.popleft()
+    while pending:
+        yield pending.popleft()

@@ -216,3 +216,20 @@ def test_loader_propagates_worker_errors():
     loader = DataLoader(Broken(), batch_size=2, num_workers=2)
     with pytest.raises(ValueError, match="corrupt sample"):
         list(loader)
+
+
+def test_device_prefetch_order_and_count():
+    from ncnet_tpu.data.loader import device_prefetch
+
+    items = list(range(7))
+    seen_puts = []
+
+    def put(x):
+        seen_puts.append(x)
+        return x * 10
+
+    out = list(device_prefetch(iter(items), put, depth=2))
+    assert out == [x * 10 for x in items]
+    assert seen_puts == items
+    with pytest.raises(ValueError):
+        list(device_prefetch(iter(items), put, depth=0))
