@@ -27,6 +27,22 @@ def _coord_grids(fs1, fs2, fs3, fs4, k_size, scale):
     return xa, ya, xb, yb
 
 
+def _reduced_max(nc, axis: int, softmax: bool):
+    """max over `axis`, optionally of the softmax along that axis.
+
+    Exact rewrite of max(softmax(x)) as exp(max(x) - logsumexp(x)):
+    softmax is monotonic, so the argmax is unchanged and the full
+    [*, n, *] softmax tensor (225 MB at InLoc resolution) never
+    materializes — two reduction passes instead of an elementwise exp
+    over the whole tensor plus two more passes.
+    """
+    m = jnp.max(nc, axis=axis)
+    if not softmax:
+        return m
+    lse = jax.scipy.special.logsumexp(nc, axis=axis)
+    return jnp.exp(m - lse)
+
+
 def corr_to_matches(
     corr4d,
     delta4d=None,
@@ -59,9 +75,7 @@ def corr_to_matches(
     if invert_matching_direction:
         # One match per A position: reduce over B positions.
         nc = corr4d.reshape(b, fs1, fs2, fs3 * fs4)
-        if do_softmax:
-            nc = jax.nn.softmax(nc, axis=3)
-        score = jnp.max(nc, axis=3).reshape(b, -1)
+        score = _reduced_max(nc, axis=3, softmax=do_softmax).reshape(b, -1)
         idx = jnp.argmax(nc, axis=3).reshape(b, -1)  # flat B index
         i_b = idx // fs4
         j_b = idx % fs4
@@ -73,9 +87,7 @@ def corr_to_matches(
     else:
         # One match per B position: reduce over A positions.
         nc = corr4d.reshape(b, fs1 * fs2, fs3, fs4)
-        if do_softmax:
-            nc = jax.nn.softmax(nc, axis=1)
-        score = jnp.max(nc, axis=1).reshape(b, -1)
+        score = _reduced_max(nc, axis=1, softmax=do_softmax).reshape(b, -1)
         idx = jnp.argmax(nc, axis=1).reshape(b, -1)  # flat A index (row-major)
         i_a = idx // fs2
         j_a = idx % fs2
