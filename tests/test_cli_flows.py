@@ -1,0 +1,145 @@
+"""End-to-end CLI flows: train -> eval_pf_pascal, and localize.
+
+Complements the per-module suites with the user-visible entry points on
+synthetic data (the reference validates exclusively through these flows,
+SURVEY.md §4).
+"""
+
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+from scipy.io import savemat
+
+from ncnet_tpu.cli import eval_pf_pascal, localize
+from ncnet_tpu.cli import train as train_cli
+
+
+@pytest.fixture()
+def pf_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    (tmp_path / "images").mkdir()
+    (tmp_path / "image_pairs").mkdir()
+    names = []
+    for i in range(8):
+        n = f"images/im{i}.jpg"
+        Image.fromarray((rng.random((64, 64, 3)) * 255).astype("uint8")).save(
+            tmp_path / n
+        )
+        names.append(n)
+    with open(tmp_path / "image_pairs/train_pairs.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["source_image", "target_image", "class", "flip"])
+        for i in range(0, 6, 2):
+            w.writerow([names[i], names[i + 1], 1, 0])
+    with open(tmp_path / "image_pairs/val_pairs.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["source_image", "target_image", "class", "flip"])
+        w.writerow([names[6], names[7], 1, 0])
+    pts = ";".join(str(v) for v in np.linspace(5, 60, 4))
+    with open(tmp_path / "image_pairs/test_pairs.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["source_image", "target_image", "class", "XA", "YA", "XB", "YB"])
+        for i in range(0, 6, 2):
+            w.writerow([names[i], names[i + 1], 1, pts, pts, pts, pts])
+    return tmp_path
+
+
+def test_train_then_eval_pck(pf_dir, capsys):
+    train_cli.main(
+        [
+            "--dataset_image_path", str(pf_dir),
+            "--dataset_csv_path", str(pf_dir / "image_pairs"),
+            "--num_epochs", "1", "--batch_size", "2", "--image_size", "64",
+            "--backbone", "vgg", "--ncons_kernel_sizes", "3",
+            "--ncons_channels", "1",
+            "--result_model_dir", str(pf_dir / "models"),
+            "--num_workers", "2",
+        ]
+    )
+    runs = os.listdir(pf_dir / "models")
+    assert len(runs) == 1
+    ckpt = pf_dir / "models" / runs[0] / "best"
+    assert ckpt.is_dir()
+
+    eval_pf_pascal.main(
+        [
+            "--checkpoint", str(ckpt),
+            "--eval_dataset_path", str(pf_dir),
+            "--image_size", "64", "--batch_size", "2",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "PCK" in out
+
+
+def test_localize_cli(tmp_path, capsys):
+    """Matches -> PnP poses -> rate curve, through the CLI with .mat fixtures."""
+    rng = np.random.default_rng(7)
+    fl = 100.0
+    hq, wq, hdb, wdb = 80, 100, 50, 50
+    for d in ["matches", "cutouts", "queries"]:
+        (tmp_path / d).mkdir()
+    # Small rotation + translation ground-truth pose.
+    axis = rng.normal(size=3)
+    axis /= np.linalg.norm(axis)
+    ang = np.deg2rad(2.0)
+    K_ = np.array([[0, -axis[2], axis[1]], [axis[2], 0, -axis[0]], [-axis[1], axis[0], 0]])
+    R = np.eye(3) + np.sin(ang) * K_ + (1 - np.cos(ang)) * (K_ @ K_)
+    t = rng.normal(size=3) * 0.1
+    ys, xs = np.meshgrid(np.arange(hdb), np.arange(wdb), indexing="ij")
+    z = 6.0
+    world = np.stack(
+        [(xs - wdb / 2) * z / 60.0, (ys - hdb / 2) * z / 60.0, np.full(xs.shape, z)],
+        axis=-1,
+    )
+    Kq = np.array([[fl, 0, wq / 2], [0, fl, hq / 2], [0, 0, 1]])
+    cam = world.reshape(-1, 3) @ R.T + t
+    uv = (cam @ Kq.T)[:, :2] / (cam @ Kq.T)[:, 2:3]
+    vis = (
+        (uv[:, 0] > 1) & (uv[:, 0] < wq - 1) & (uv[:, 1] > 1) & (uv[:, 1] < hq - 1)
+        & (cam[:, 2] > 0)
+    )
+    idx = rng.choice(np.where(vis)[0], size=min(200, int(vis.sum())), replace=False)
+    db_xy = np.stack([(idx % wdb) + 0.5, (idx // wdb) + 0.5], axis=1)
+    m = np.concatenate(
+        [uv[idx] / [wq, hq], db_xy / [wdb, hdb], np.full((idx.size, 1), 0.9)], axis=1
+    )
+    matches = np.zeros((1, 1, idx.size, 5))
+    matches[0, 0] = m
+    savemat(tmp_path / "matches/1.mat", {"matches": matches})
+    savemat(
+        tmp_path / "shortlist.mat",
+        {"ImgList": {"queryname": "q1.jpg", "topNname": ["pano_a"]}},
+    )
+    savemat(tmp_path / "cutouts/pano_a.mat", {"XYZcut": world})
+    Image.fromarray((rng.random((hq, wq, 3)) * 255).astype("uint8")).save(
+        tmp_path / "queries/q1.jpg"
+    )
+    np.savez(
+        tmp_path / "gt.npz",
+        queries=np.array(["q1.jpg"]),
+        poses=np.stack([np.concatenate([R, t[:, None]], axis=1)]),
+    )
+
+    localize.main(
+        [
+            "--matches_dir", str(tmp_path / "matches"),
+            "--shortlist", str(tmp_path / "shortlist.mat"),
+            "--cutout_dir", str(tmp_path / "cutouts"),
+            "--query_dir", str(tmp_path / "queries"),
+            "--output_dir", str(tmp_path / "out"),
+            "--focal_length", "100",
+            "--ransac_iters", "500",
+            "--top_n", "1",
+            "--gt_poses", str(tmp_path / "gt.npz"),
+        ]
+    )
+    out = capsys.readouterr().out
+    rates = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rates["rate@0.25m"] == 1.0
+    assert (tmp_path / "out/poses.npz").exists()
+    assert (tmp_path / "out/localization_curve.png").exists()
