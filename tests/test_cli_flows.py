@@ -35,10 +35,13 @@ def pf_dir(tmp_path):
         w.writerow(["source_image", "target_image", "class", "flip"])
         for i in range(0, 6, 2):
             w.writerow([names[i], names[i + 1], 1, 0])
+    # Two val rows: with batch_size 2 and drop_last, a single row would
+    # yield zero val batches and silently skip the eval/best-ckpt path.
     with open(tmp_path / "image_pairs/val_pairs.csv", "w", newline="") as f:
         w = csv.writer(f)
         w.writerow(["source_image", "target_image", "class", "flip"])
         w.writerow([names[6], names[7], 1, 0])
+        w.writerow([names[7], names[6], 1, 0])
     pts = ";".join(str(v) for v in np.linspace(5, 60, 4))
     with open(tmp_path / "image_pairs/test_pairs.csv", "w", newline="") as f:
         w = csv.writer(f)
