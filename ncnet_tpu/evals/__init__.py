@@ -3,7 +3,9 @@
 from .pck import pck, pck_metric
 from .flow_eval import dense_warp_grid, write_flow_output
 from .inloc import (
+    dedup_matches,
     extract_inloc_matches,
+    inloc_device_matches,
     write_matches_mat,
     matches_buffer,
     fill_matches,
@@ -14,7 +16,9 @@ __all__ = [
     "pck_metric",
     "dense_warp_grid",
     "write_flow_output",
+    "dedup_matches",
     "extract_inloc_matches",
+    "inloc_device_matches",
     "write_matches_mat",
     "matches_buffer",
     "fill_matches",

@@ -23,7 +23,7 @@ from scipy.io import savemat
 from ..ops.matches import corr_to_matches
 
 
-def extract_inloc_matches(
+def inloc_device_matches(
     corr4d,
     delta4d=None,
     k_size: int = 1,
@@ -31,11 +31,13 @@ def extract_inloc_matches(
     both_directions: bool = True,
     invert_direction: bool = False,
 ):
-    """Extract, merge and dedup matches for one image pair.
+    """Device-side match extraction for one pair: jit-safe, no host sync.
 
-    Returns (xA, yA, xB, yB, score) 1-D float arrays in 'positive' [0, 1]
-    scale, recentered to pixel-cell centers, sorted by descending score with
-    duplicate coordinate rows removed (keeping the best-scoring instance).
+    Returns (xA, yA, xB, yB, score) 1-D jnp arrays in 'positive' [0, 1]
+    scale, sorted by descending score and recentered to pixel-cell centers.
+    Callers jit this together with the model forward so the whole per-pano
+    device program is one XLA executable (op-by-op dispatch over a tunneled
+    backend costs milliseconds per op).
     """
     fs1, fs2, fs3, fs4 = corr4d.shape[2:]
 
@@ -71,9 +73,15 @@ def extract_inloc_matches(
     xa = xa * (fs2 * k - 1) / (fs2 * k) + 0.5 / (fs2 * k)
     yb = yb * (fs3 * k - 1) / (fs3 * k) + 0.5 / (fs3 * k)
     xb = xb * (fs4 * k - 1) / (fs4 * k) + 0.5 / (fs4 * k)
+    return xa, ya, xb, yb, score
 
-    # Host-side dedup of coordinate rows (np.unique keeps the first = best
-    # occurrence index per unique row after the stable sort above).
+
+def dedup_matches(xa, ya, xb, yb, score):
+    """Host-side dedup of coordinate rows (parity: eval_inloc.py:160-173).
+
+    Expects descending-score-sorted inputs; np.unique keeps the first = best
+    occurrence index per unique coordinate row.
+    """
     coords = np.stack(
         [np.asarray(xa), np.asarray(ya), np.asarray(xb), np.asarray(yb)], axis=0
     )
@@ -85,6 +93,32 @@ def extract_inloc_matches(
         coords[2, unique_idx],
         coords[3, unique_idx],
         np.asarray(score)[unique_idx],
+    )
+
+
+def extract_inloc_matches(
+    corr4d,
+    delta4d=None,
+    k_size: int = 1,
+    do_softmax: bool = True,
+    both_directions: bool = True,
+    invert_direction: bool = False,
+):
+    """Extract, merge and dedup matches for one image pair.
+
+    Convenience composition of `inloc_device_matches` (device) and
+    `dedup_matches` (host): (xA, yA, xB, yB, score) 1-D float arrays,
+    recentered, descending-score-sorted, duplicate coordinate rows removed.
+    """
+    return dedup_matches(
+        *inloc_device_matches(
+            corr4d,
+            delta4d=delta4d,
+            k_size=k_size,
+            do_softmax=do_softmax,
+            both_directions=both_directions,
+            invert_direction=invert_direction,
+        )
     )
 
 

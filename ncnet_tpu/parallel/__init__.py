@@ -14,6 +14,7 @@ from .corr_sharding import (
 __all__ = [
     "multihost",
     "make_sharded_inloc_forward",
+    "make_sharded_inloc_parts",
     "make_mesh",
     "batch_sharding",
     "replicated",
@@ -30,5 +31,12 @@ def make_sharded_inloc_forward(*args, **kwargs):
     """Lazy re-export: importing it eagerly would pull jax.experimental.pallas
     onto the import path of every parallel-package consumer."""
     from .inloc_sharded import make_sharded_inloc_forward as fn
+
+    return fn(*args, **kwargs)
+
+
+def make_sharded_inloc_parts(*args, **kwargs):
+    """Lazy re-export (see make_sharded_inloc_forward)."""
+    from .inloc_sharded import make_sharded_inloc_parts as fn
 
     return fn(*args, **kwargs)

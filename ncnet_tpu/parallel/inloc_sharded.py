@@ -31,8 +31,14 @@ from ..models.ncnet import NCNetConfig, extract_features
 from .corr_sharding import make_sharded_match_pipeline
 
 
-def make_sharded_inloc_forward(config: NCNetConfig, mesh: Mesh, axis_name: str = "sp"):
-    """Build a jitted (params, src, tgt) -> (corr4d, delta4d) forward.
+def make_sharded_inloc_parts(config: NCNetConfig, mesh: Mesh, axis_name: str = "sp"):
+    """Build the sharded InLoc forward, split for query-feature reuse.
+
+    Returns (query_features, forward_from_features):
+      query_features(params, image) -> feat: jitted replicated backbone —
+        run once per query, its result feeds every shortlisted pano.
+      forward_from_features(params, feat_a, tgt) -> (corr4d, delta4d):
+        pano backbone + per-shard fused corr+pool + sharded consensus.
 
     Requirements: batch 1; feature height iA divisible by
     (mesh size * relocalization_k_size) — the input bucketing in
@@ -72,8 +78,11 @@ def make_sharded_inloc_forward(config: NCNetConfig, mesh: Mesh, axis_name: str =
     )
 
     @jax.jit
-    def forward(params, source_image, target_image):
-        feat_a = extract_features(config, params, source_image)
+    def query_features(params, image):
+        return extract_features(config, params, image)
+
+    @jax.jit
+    def forward_from_features(params, feat_a, target_image):
         feat_b = extract_features(config, params, target_image)
         feat_a = lax.with_sharding_constraint(
             feat_a, NamedSharding(mesh, spec_fa)
@@ -81,5 +90,25 @@ def make_sharded_inloc_forward(config: NCNetConfig, mesh: Mesh, axis_name: str =
         pooled, deltas = corr_pool_local(feat_a, feat_b)
         corr4d = pipeline(params["neigh_consensus"], pooled.astype(jnp.float32))
         return corr4d, deltas
+
+    return query_features, forward_from_features
+
+
+def make_sharded_inloc_forward(config: NCNetConfig, mesh: Mesh, axis_name: str = "sp"):
+    """Build a jitted (params, src, tgt) -> (corr4d, delta4d) forward.
+
+    One-shot composition of `make_sharded_inloc_parts` (no feature reuse
+    across calls); callers looping one query against many panos should use
+    the parts directly.
+    """
+    query_features, forward_from_features = make_sharded_inloc_parts(
+        config, mesh, axis_name
+    )
+
+    @jax.jit
+    def forward(params, source_image, target_image):
+        return forward_from_features(
+            params, query_features(params, source_image), target_image
+        )
 
     return forward
