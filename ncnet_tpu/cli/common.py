@@ -40,6 +40,12 @@ def build_model(
     stored architecture hyper-parameters override the CLI args, matching the
     reference restore rule (lib/model.py:217-220).
     """
+    if checkpoint and not os.path.exists(checkpoint):
+        raise SystemExit(
+            f"checkpoint not found: {checkpoint!r} (expected a directory "
+            "written by ncnet_tpu.training.checkpoint or a reference "
+            ".pth.tar file)"
+        )
     if checkpoint and os.path.isdir(checkpoint):
         restored = load_checkpoint(checkpoint)
         config = restored["config"]

@@ -103,6 +103,13 @@ def main(argv=None):
         dataset, args.batch_size, shuffle=True, num_workers=args.num_workers,
         seed=args.seed, drop_last=True,
     )
+    if args.batch_size > len(dataset_val):
+        print(
+            f"WARNING: batch_size {args.batch_size} exceeds val-set size "
+            f"{len(dataset_val)}; validation will see zero batches, so the "
+            "best checkpoint is selected by train loss instead",
+            flush=True,
+        )
     loader_val = DataLoader(
         dataset_val, args.batch_size, shuffle=False,
         num_workers=args.num_workers, drop_last=True,
@@ -163,8 +170,12 @@ def main(argv=None):
         train_losses.append(train_loss)
         val_losses.append(val_loss)
 
-        is_best = val_loss < best_val
-        best_val = min(val_loss, best_val)
+        # With an empty val loader the 0.0 fallback must not drive best-
+        # checkpoint selection (it would pin "best" to epoch 1 forever);
+        # fall back to tracking the train loss instead.
+        select_loss = val_loss if n_val else train_loss
+        is_best = select_loss < best_val
+        best_val = min(select_loss, best_val)
         full_params = {
             "backbone": trainable.get("backbone", state.frozen["backbone"]),
             "neigh_consensus": trainable["neigh_consensus"],

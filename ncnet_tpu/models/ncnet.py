@@ -11,10 +11,13 @@ composition matches lib/model.py:261-282 exactly:
     corr = neigh_consensus(corr)                 # symmetric mode
     corr = mutual_matching(corr)
 
-Dtype policy: the backbone runs in float32; features are cast to
-`corr_dtype` (bf16 by default) for the correlation contraction and the 4-D
-pipeline runs in float32 accumulation — this supersedes the reference's
-`half_precision` fp16 mode (eval_inloc.py:50, lib/conv4d.py:21-28).
+Dtype policy: the backbone runs in float32 (bf16 conv compute opt-in via
+BackboneConfig); the correlation contracts in bf16 with f32 accumulation;
+and the 4-D pipeline stores activations in `corr_dtype` — float32 by
+default, bfloat16 when `half_precision=True` (the TPU analogue of the
+reference's fp16 mode, eval_inloc.py:50, lib/conv4d.py:21-28) — with f32
+accumulation inside each conv and f32 elementwise math in the mutual
+filters. The pipeline output is always f32 for softmax/argmax extraction.
 """
 
 from __future__ import annotations
@@ -92,13 +95,22 @@ def extract_features(config: NCNetConfig, params: Params, image):
 
 
 def match_pipeline(config: NCNetConfig, params: Params, corr4d):
-    """The 4-D filtering pipeline applied after (and excluding) correlation."""
+    """The 4-D filtering pipeline applied after (and excluding) correlation.
+
+    Runs in `config.corr_dtype` (bf16 for the half-precision InLoc config —
+    the inter-layer consensus activations are the largest tensors in the
+    model, and the reference likewise runs this stage in fp16,
+    lib/model.py:253-258) with f32 accumulation inside each conv and f32
+    elementwise math in the mutual-matching filters. Returns f32 for the
+    downstream softmax/argmax extraction.
+    """
+    corr4d = corr4d.astype(config.corr_dtype)
     corr4d = mutual_matching(corr4d)
     corr4d = neigh_consensus_apply(
         params["neigh_consensus"], corr4d, symmetric=config.symmetric_mode
     )
     corr4d = mutual_matching(corr4d)
-    return corr4d
+    return corr4d.astype(jnp.float32)
 
 
 def ncnet_forward(
@@ -153,5 +165,5 @@ def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a, fea
         if config.relocalization_k_size > 1:
             corr4d, delta4d = maxpool4d(corr4d, config.relocalization_k_size)
 
-    corr4d = match_pipeline(config, params, corr4d.astype(jnp.float32))
+    corr4d = match_pipeline(config, params, corr4d)
     return corr4d, delta4d

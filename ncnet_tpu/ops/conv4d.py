@@ -54,6 +54,12 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str = "conv2d"):
         raise ValueError(f"cin mismatch: x has {cin}, weight has {wcin}")
     si = si_pad - 2 * (ki // 2)
 
+    # Dtype policy: compute in the input dtype (bf16 for the half-precision
+    # InLoc pipeline — the activations between consensus layers are the
+    # largest HBM tensors in the model, parity: fp16 consensus in
+    # lib/model.py:253-258) but ACCUMULATE in f32 on the MXU, summing the
+    # kernel-offset partials in f32 and casting back once at the end.
+    w = weight.astype(x.dtype)
     if strategy == "conv2d":
         # Zero-pad J on both sides (I is already halo/zero padded by the
         # caller); every (di, dj) kernel offset is then a contiguous slice.
@@ -69,10 +75,11 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str = "conv2d"):
                 # layout (channels minor).
                 y = lax.conv_general_dilated(
                     xs,
-                    weight[di, dj],
+                    w[di, dj],
                     window_strides=(1, 1),
                     padding="SAME",
                     dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    preferred_element_type=jnp.float32,
                 )
                 out = y if out is None else out + y
         out = out.reshape(b, si, sj, sk, sl, cout)
@@ -82,13 +89,14 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str = "conv2d"):
         for di in range(ki):
             xs = lax.dynamic_slice_in_dim(x, di, si, axis=2)
             xs = jnp.moveaxis(xs, 2, 1).reshape(b * si, cin, sj, sk, sl)
-            w3 = jnp.transpose(weight[di], (4, 3, 0, 1, 2))  # [cout, cin, kj, kk, kl]
+            w3 = jnp.transpose(w[di], (4, 3, 0, 1, 2))  # [cout, cin, kj, kk, kl]
             y = lax.conv_general_dilated(
                 xs,
                 w3,
                 window_strides=(1, 1, 1),
                 padding="SAME",
                 dimension_numbers=("NCHWD", "OIHWD", "NCHWD"),
+                preferred_element_type=jnp.float32,
             )
             out = y if out is None else out + y
         out = jnp.moveaxis(out.reshape(b, si, cout, sj, sk, sl), 1, 2)
@@ -97,7 +105,7 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str = "conv2d"):
 
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1, 1, 1)
-    return out
+    return out.astype(x.dtype)
 
 
 def conv4d(x, weight, bias=None):

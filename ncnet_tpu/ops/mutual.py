@@ -22,14 +22,20 @@ EPS = 1e-5
 def mutual_matching(corr4d, eps: float = EPS):
     """Apply soft mutual-NN filtering.
 
+    The elementwise math runs in f32 regardless of the storage dtype (the
+    casts fuse into the surrounding ops, so a bf16 tensor still only moves
+    bf16 bytes through HBM while the eps-guarded divisions keep f32
+    resolution).
+
     Args:
       corr4d: [b, 1, iA, jA, iB, jB].
 
     Returns:
-      Same shape, filtered.
+      Same shape and dtype, filtered.
     """
-    max_over_a = jnp.max(corr4d, axis=(2, 3), keepdims=True)  # per-B max
-    max_over_b = jnp.max(corr4d, axis=(4, 5), keepdims=True)  # per-A max
-    ratio_b = corr4d / (max_over_a + eps)  # reference corr4d_B
-    ratio_a = corr4d / (max_over_b + eps)  # reference corr4d_A
-    return corr4d * (ratio_a * ratio_b)
+    c = corr4d.astype(jnp.float32)
+    max_over_a = jnp.max(c, axis=(2, 3), keepdims=True)  # per-B max
+    max_over_b = jnp.max(c, axis=(4, 5), keepdims=True)  # per-A max
+    ratio_b = c / (max_over_a + eps)  # reference corr4d_B
+    ratio_a = c / (max_over_b + eps)  # reference corr4d_A
+    return (c * (ratio_a * ratio_b)).astype(corr4d.dtype)

@@ -71,15 +71,16 @@ def mutual_matching_sharded(corr4d, axis_name: str, eps: float = EPS):
     """Soft mutual-NN filtering on an iA-sharded block.
 
     max over B positions (dims 4,5) is shard-local; max over A positions
-    (dims 2,3) needs the cross-shard `pmax` collective.
+    (dims 2,3) needs the cross-shard `pmax` collective. Elementwise math in
+    f32 with the result cast back to the storage dtype (same policy as
+    ops.mutual.mutual_matching).
     """
-    max_over_a = lax.pmax(
-        jnp.max(corr4d, axis=(2, 3), keepdims=True), axis_name
-    )
-    max_over_b = jnp.max(corr4d, axis=(4, 5), keepdims=True)
-    return corr4d * (
-        (corr4d / (max_over_b + eps)) * (corr4d / (max_over_a + eps))
-    )
+    c = corr4d.astype(jnp.float32)
+    max_over_a = lax.pmax(jnp.max(c, axis=(2, 3), keepdims=True), axis_name)
+    max_over_b = jnp.max(c, axis=(4, 5), keepdims=True)
+    return (
+        c * ((c / (max_over_b + eps)) * (c / (max_over_a + eps)))
+    ).astype(corr4d.dtype)
 
 
 def _conv_stack_sharded(params: Sequence[Dict[str, Any]], x, axis_name: str):

@@ -87,9 +87,13 @@ def make_sharded_inloc_parts(config: NCNetConfig, mesh: Mesh, axis_name: str = "
         feat_a = lax.with_sharding_constraint(
             feat_a, NamedSharding(mesh, spec_fa)
         )
+        # Same dtype policy as models.ncnet.match_pipeline: corr_pool_local
+        # already emits corr_dtype (bf16 under half_precision), the sharded
+        # consensus keeps that storage dtype with f32 conv accumulation, and
+        # the output is cast to f32 for extraction.
         pooled, deltas = corr_pool_local(feat_a, feat_b)
-        corr4d = pipeline(params["neigh_consensus"], pooled.astype(jnp.float32))
-        return corr4d, deltas
+        corr4d = pipeline(params["neigh_consensus"], pooled)
+        return corr4d.astype(jnp.float32), deltas
 
     return query_features, forward_from_features
 

@@ -72,6 +72,25 @@ def test_ncnet_forward_relocalization(rng):
     assert delta is not None and len(delta) == 4
 
 
+def test_half_precision_pipeline_tracks_f32(rng):
+    """The bf16 consensus path (half_precision=True) must track the f32
+    pipeline within bf16 resolution — the dtype change is a storage
+    optimization, not a model change (reference analogue: fp16 consensus,
+    lib/model.py:253-258)."""
+    import dataclasses
+
+    params = ncnet_init(jax.random.PRNGKey(0), TINY)
+    cfg_bf16 = dataclasses.replace(TINY, half_precision=True)
+    src = jnp.asarray(rng.randn(1, 3, 32, 32).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(1, 3, 32, 32).astype(np.float32))
+    corr_f32, _ = ncnet_forward(TINY, params, src, tgt)
+    corr_bf16, _ = ncnet_forward(cfg_bf16, params, src, tgt)
+    assert corr_bf16.dtype == jnp.float32  # extraction-facing output is f32
+    scale = float(jnp.max(jnp.abs(corr_f32))) + 1e-12
+    rel = float(jnp.max(jnp.abs(corr_bf16 - corr_f32))) / scale
+    assert rel < 0.05, f"bf16 pipeline diverged: rel err {rel}"
+
+
 def test_train_step_decreases_loss(rng):
     """A few steps on a fixed batch must reduce the weak loss."""
     params = ncnet_init(jax.random.PRNGKey(0), TINY)
