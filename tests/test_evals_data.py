@@ -201,6 +201,37 @@ def test_pf_pascal_dataset_pf_procedure(tmp_path):
     np.testing.assert_allclose(s["L_pck"], [expect])
 
 
+def test_loader_worker_count_invariance():
+    """Batch order and content must be independent of the worker count —
+    the concurrency-correctness guarantee the reference's reorder dict
+    provided (lib/dataloader.py:197-213), here via ordered pool.map."""
+
+    class Indexed:
+        def __len__(self):
+            return 37
+
+        def __getitem__(self, i):
+            return {"x": np.full((2, 2), i, dtype=np.float32), "i": int(i)}
+
+    from ncnet_tpu.data import DataLoader
+
+    def collect(workers):
+        loader = DataLoader(
+            Indexed(), batch_size=5, shuffle=True, num_workers=workers, seed=3
+        )
+        return list(loader)
+
+    ref_batches = collect(1)
+    seen = np.concatenate([b["i"] for b in ref_batches])
+    assert sorted(seen.tolist()) == list(range(37))  # exactly-once cover
+    for workers in (4, 8):
+        got = collect(workers)
+        assert len(got) == len(ref_batches)
+        for a, b in zip(ref_batches, got):
+            np.testing.assert_array_equal(a["i"], b["i"])
+            np.testing.assert_array_equal(a["x"], b["x"])
+
+
 def test_loader_propagates_worker_errors():
     """A dataset exception must surface in the consumer, not hang."""
 

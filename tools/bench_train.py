@@ -1,0 +1,108 @@
+"""Training-throughput benchmark: PF-Pascal weak-supervision step, pairs/s.
+
+Secondary perf evidence next to the headline bench.py (InLoc dense
+matching). Times the full jitted train step — two correlation passes
+(positive + rolled negative), gradient, Adam update — on synthetic batches
+at the reference's training configuration (400 px, ResNet-101 layer3,
+NeighConsensus 5-5-5/16-16-1, batch 16: reference train.py:36-43), sharded
+over all local devices.
+
+Prints one JSON line: {"metric", "value", "unit", "devices", "batch"}.
+
+Usage:
+    python tools/bench_train.py [--batch 16] [--image-size 400] [--iters 10]
+    # CPU smoke: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    #   python tools/bench_train.py --backbone vgg --image-size 64 --iters 2
+"""
+
+import argparse
+import json
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--image-size", type=int, default=400)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--backbone", type=str, default="resnet101")
+    p.add_argument("--remat", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.parallel import make_mesh
+    from ncnet_tpu.training import (
+        create_train_state,
+        make_train_step,
+        replicate_state,
+        shard_batch,
+    )
+    from ncnet_tpu.utils.profiling import setup_compile_cache
+
+    setup_compile_cache()
+    n_dev = len(jax.devices())
+    # Largest device count dividing the batch (same rule as cli/train.py).
+    dp = max(d for d in range(1, n_dev + 1) if args.batch % d == 0)
+    mesh = make_mesh((dp,), ("dp",))
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(
+            cnn=args.backbone,
+            last_layer={"resnet101": "layer3", "vgg": "pool4"}.get(
+                args.backbone, "layer3"
+            ),
+        ),
+        ncons_kernel_sizes=(5, 5, 5),
+        ncons_channels=(16, 16, 1),
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    state, tx = create_train_state(params)
+    state = replicate_state(state, mesh)
+    train_step, _ = make_train_step(config, tx, remat_backbone=args.remat)
+
+    key = jax.random.PRNGKey(1)
+    k1, k2 = jax.random.split(key)
+    shape = (args.batch, 3, args.image_size, args.image_size)
+    batch = shard_batch(
+        {
+            "source_image": jax.random.normal(k1, shape, jnp.float32),
+            "target_image": jax.random.normal(k2, shape, jnp.float32),
+        },
+        mesh,
+    )
+
+    trainable, opt_state = state.trainable, state.opt_state
+    trainable, opt_state, loss = train_step(  # compile + warmup
+        trainable, state.frozen, opt_state,
+        batch["source_image"], batch["target_image"],
+    )
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        trainable, opt_state, loss = train_step(
+            trainable, state.frozen, opt_state,
+            batch["source_image"], batch["target_image"],
+        )
+        float(loss)  # per-step sync: the loss fetch closes the iteration
+    dt = (time.perf_counter() - t0) / args.iters
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_step_pairs_per_s",
+                "value": round(args.batch / dt, 3),
+                "unit": "pairs/s",
+                "devices": dp,
+                "batch": args.batch,
+                "step_ms": round(dt * 1e3, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
