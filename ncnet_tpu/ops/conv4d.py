@@ -20,25 +20,34 @@ size exactly as the reference does (lib/conv4d.py:26-36).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+# Default decomposition; override per-process with NCNET_CONV4D_STRATEGY
+# ('conv2d' | 'conv3d' | 'convnd') to A/B formulations on a given backend.
+_DEFAULT_STRATEGY = os.environ.get("NCNET_CONV4D_STRATEGY", "conv2d")
 
-def conv4d_prepadded(x, weight, bias=None, *, strategy: str = "conv2d"):
+
+def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     """4-D convolution over input whose dim 2 is already padded by kI//2.
 
     The shared core of both the single-device conv4d (zero padding) and the
     sharded halo-exchange variant (parallel/corr_sharding.py). Emits only
     the center I rows.
 
-    Two mathematically identical decompositions:
+    Three mathematically identical formulations:
       * 'conv2d' (default): kI*kJ shifted batched **2-D** convolutions over
         (K, L) with (b, I, J) folded into the conv batch. TPU convolutions
         are natively 2-D — this lowers straight onto the hardware conv path,
         whereas 3-D convs go through a generic lowering.
       * 'conv3d': kI batched 3-D convolutions with (b, I) folded into the
         batch (kept for comparison/testing).
+      * 'convnd': one rank-4-spatial ConvGeneral op — the compiler owns the
+        whole stencil (for per-backend A/B; select via the
+        NCNET_CONV4D_STRATEGY env var).
 
     Args:
       x: [b, cin, I + 2*(kI//2), J, K, L].
@@ -48,6 +57,8 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str = "conv2d"):
     Returns:
       [b, cout, I, J, K, L].
     """
+    if strategy is None:
+        strategy = _DEFAULT_STRATEGY
     b, cin, si_pad, sj, sk, sl = x.shape
     ki, kj, kk, kl, wcin, cout = weight.shape
     if wcin != cin:
@@ -100,6 +111,21 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str = "conv2d"):
             )
             out = y if out is None else out + y
         out = jnp.moveaxis(out.reshape(b, si, cout, sj, sk, sl), 1, 2)
+    elif strategy == "convnd":
+        # One rank-4-spatial convolution: XLA's ConvGeneral HLO is rank-
+        # agnostic, so the whole 4-D stencil is a single op and the compiler
+        # owns the partial-sum scheduling (vs. k_i*k_j sequential conv+add
+        # passes over HBM in 'conv2d'). Backend support for >3 spatial dims
+        # varies — callers A/B this against 'conv2d' per platform.
+        w4 = jnp.transpose(w, (5, 4, 0, 1, 2, 3))  # [cout, cin, ki..kl]
+        out = lax.conv_general_dilated(
+            x,
+            w4,
+            window_strides=(1, 1, 1, 1),
+            padding=[(0, 0)] + [(kd // 2, kd // 2) for kd in (kj, kk, kl)],
+            dimension_numbers=("NCHWDE", "OIHWDE", "NCHWDE"),
+            preferred_element_type=jnp.float32,
+        )
     else:
         raise ValueError(f"unknown strategy {strategy!r}")
 

@@ -278,6 +278,16 @@ def test_conv4d_strategies_agree():
     b = jax.random.normal(jax.random.PRNGKey(2), (2,))
     ref = conv4d_reference(x, w, b)
     xp = jnp.pad(x, ((0, 0), (0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
-    for strategy in ("conv2d", "conv3d"):
-        out = conv4d_prepadded(xp, w, b, strategy=strategy)
+    for strategy in ("conv2d", "conv3d", "convnd"):
+        try:
+            out = conv4d_prepadded(xp, w, b, strategy=strategy)
+        except Exception as exc:  # noqa: BLE001
+            if strategy == "convnd":
+                # Rank-4-spatial ConvGeneral support varies by backend —
+                # that's the reason the strategy knob exists; the default
+                # paths must still be pinned.
+                import pytest
+
+                pytest.skip(f"convnd unsupported on this backend: {exc}")
+            raise
         assert jnp.allclose(out, ref, atol=1e-4), strategy

@@ -17,7 +17,11 @@ Usage:
 
 import argparse
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():
@@ -49,12 +53,9 @@ def main():
     mesh = make_mesh((dp,), ("dp",))
 
     config = NCNetConfig(
-        backbone=BackboneConfig(
-            cnn=args.backbone,
-            last_layer={"resnet101": "layer3", "vgg": "pool4"}.get(
-                args.backbone, "layer3"
-            ),
-        ),
+        # last_layer stays at its default: BackboneConfig resolves the
+        # per-backbone truncation point (layer3 / pool4 / ...).
+        backbone=BackboneConfig(cnn=args.backbone),
         ncons_kernel_sizes=(5, 5, 5),
         ncons_channels=(16, 16, 1),
     )

@@ -34,7 +34,14 @@ def main(argv=None):
                    help="scale on the InLoc image size (1.0 = 3200x2400)")
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--dial_timeout", type=float, default=900.0)
+    p.add_argument("--conv4d_strategy", type=str, default="",
+                   choices=("", "conv2d", "conv3d", "convnd"),
+                   help="A/B the Conv4d formulation (sets "
+                   "NCNET_CONV4D_STRATEGY before ncnet_tpu import)")
     args = p.parse_args(argv)
+
+    if args.conv4d_strategy:
+        os.environ["NCNET_CONV4D_STRATEGY"] = args.conv4d_strategy
 
     import jax
 
