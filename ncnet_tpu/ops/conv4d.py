@@ -2,14 +2,14 @@
 
 The reference implements Conv4d as a *Python loop over the first spatial
 dimension*, calling `F.conv3d` once per slice per kernel offset
-(lib/conv4d.py:39-48) — O(iA * k) dispatches. The TPU-native formulation
-decomposes the 4-D convolution into exactly `k` batched 3-D convolutions
-(one per first-kernel-dim offset, with the iA axis folded into the XLA batch
-dimension), which is mathematically identical, fully vectorized, and lets XLA
-tile the inner contraction onto the MXU:
-
-    out[b, co, i, j, k, l] =
-      sum_{di} conv3d(x_padded[b, :, i + di], w[di])[co, j, k, l]
+(lib/conv4d.py:39-48) — O(iA * k) dispatches. Here the 4-D convolution is a
+single traced expression with three selectable, mathematically identical
+decompositions (see `conv4d_prepadded`): the default folds (b, I, J) into
+the conv batch and runs kI*kJ shifted **2-D** convolutions over (K, L) —
+TPU convs are natively 2-D — with 'conv3d' (kI batched 3-D convs) and
+'convnd' (one rank-4-spatial ConvGeneral) kept for per-backend A/B via
+NCNET_CONV4D_STRATEGY. All variants are fully vectorized and let XLA tile
+the inner contraction onto the MXU.
 
 Weight layout is [kI, kJ, kK, kL, cin, cout] (TPU-friendly trailing
 channels); bias is [cout].
@@ -174,32 +174,50 @@ def conv4d_reference(x, weight, bias=None):
     return out
 
 
+def swap_ab_weight(weight):
+    """Swap the A-side and B-side kernel dims: w'[di,dj,dk,dl] = w[dk,dl,di,dj].
+
+    The identity behind the symmetric mode below: with T the A<->B spatial
+    transpose of the 4-D tensor,  T(conv4d(T(x), w)) == conv4d(x, w')  —
+    transposing in and back out of a convolution is the same convolution
+    with the kernel's (di,dj) and (dk,dl) axes exchanged (zero padding is
+    dimension-symmetric). ReLU is elementwise, so the identity extends
+    through the whole Conv4d+ReLU stack layer by layer.
+    """
+    return jnp.transpose(weight, (2, 3, 0, 1, 4, 5))
+
+
 def neigh_consensus_apply(params, corr, *, symmetric: bool = True):
     """Apply the neighbourhood-consensus Conv4d+ReLU stack.
 
     Args:
       params: list of {'weight': [k,k,k,k,cin,cout], 'bias': [cout]} dicts.
       corr: [b, 1, iA, jA, iB, jB].
-      symmetric: if True, also run the stack on the A<->B transposed tensor
-        and sum the results transposed back (parity: lib/model.py:143-153) —
-        this enforces symmetry w.r.t. the matching direction and is *not*
-        equivalent to symmetrizing the filters because of the interleaved
-        ReLUs.
+      symmetric: if True, enforce symmetry w.r.t. the matching direction by
+        summing the stack applied to the tensor AND to its A<->B transpose
+        (transposed back) — reference semantics lib/model.py:143-153, which
+        is *not* equivalent to symmetrizing the filters because of the
+        interleaved ReLUs. Realized here WITHOUT materializing transposes:
+        T(stack(T(x))) == stack of the same layers with A/B-swapped kernels
+        (see swap_ab_weight), so the second branch is the same convolution
+        chain over the same memory layout — two full-tensor HBM transposes
+        are saved, and the sharded variant avoids its all_to_all re-layouts
+        (parallel/corr_sharding.py).
 
     Returns:
       [b, c_last, iA, jA, iB, jB].
     """
 
-    def stack(x):
+    def stack(x, swap: bool):
         for layer in params:
-            x = conv4d(x, layer["weight"], layer["bias"])
+            w = swap_ab_weight(layer["weight"]) if swap else layer["weight"]
+            x = conv4d(x, w, layer["bias"])
             x = jax.nn.relu(x)
         return x
 
     if symmetric:
-        swapped = jnp.transpose(corr, (0, 1, 4, 5, 2, 3))
-        return stack(corr) + jnp.transpose(stack(swapped), (0, 1, 4, 5, 2, 3))
-    return stack(corr)
+        return stack(corr, False) + stack(corr, True)
+    return stack(corr, False)
 
 
 def neigh_consensus_init(key, kernel_sizes, channels, dtype=jnp.float32):

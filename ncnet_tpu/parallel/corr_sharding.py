@@ -8,12 +8,14 @@ axis across the mesh's 'sp' axis, and:
 * mutual matching's max-over-A-positions runs as a `lax.pmax` collective
   (max-over-B stays shard-local);
 * the Conv4d stencil gets its iA neighbourhood via halo exchange with
-  `lax.ppermute` over ICI — ring-transfer of the 2-cell-deep boundary slabs,
-  exactly the ring-attention communication pattern;
-* symmetric-mode NeighConsensus re-lays the tensor out with `lax.all_to_all`
-  so the A<->B-transposed pass is sharded along *its* leading spatial dim,
-  then transfers back — the Ulysses-style all-to-all alternative, used here
-  because the transposed pass needs a different axis sharded.
+  `lax.ppermute` over ICI — ring-transfer of the boundary slabs, exactly
+  the ring-attention communication pattern;
+* symmetric-mode NeighConsensus runs its A<->B-transposed branch as the
+  SAME convolution chain with A/B-swapped kernels
+  (ops.conv4d.swap_ab_weight): T(stack(T(x))) == stack(x, w_swapped), so
+  no re-layout of the tensor is needed — an earlier design used a
+  Ulysses-style `lax.all_to_all` re-shard for that branch; the swapped-
+  kernel identity makes the ring halo exchange the only communication.
 
 Everything is expressed inside one `shard_map`, so XLA schedules the
 collectives and overlaps them with compute.
@@ -33,7 +35,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..ops.conv4d import conv4d_prepadded
+from ..ops.conv4d import conv4d_prepadded, swap_ab_weight
 from ..ops.mutual import EPS
 from ..ops.pool4d import maxpool4d
 
@@ -83,12 +85,19 @@ def mutual_matching_sharded(corr4d, axis_name: str, eps: float = EPS):
     ).astype(corr4d.dtype)
 
 
-def _conv_stack_sharded(params: Sequence[Dict[str, Any]], x, axis_name: str):
-    """Conv4d+ReLU stack with per-layer halo exchange on dim 2."""
+def _conv_stack_sharded(
+    params: Sequence[Dict[str, Any]], x, axis_name: str, swap: bool = False
+):
+    """Conv4d+ReLU stack with per-layer halo exchange on dim 2.
+
+    swap=True runs the A/B-swapped-kernel chain (the transposed symmetric
+    branch, see ops.conv4d.swap_ab_weight) — same layout, same halos.
+    """
     for layer in params:
-        pad = layer["weight"].shape[0] // 2
+        w = swap_ab_weight(layer["weight"]) if swap else layer["weight"]
+        pad = w.shape[0] // 2
         xp = _halo_exchange(x, pad, axis_name) if pad else x
-        x = jax.nn.relu(conv4d_haloed(xp, layer["weight"], layer["bias"]))
+        x = jax.nn.relu(conv4d_haloed(xp, w, layer["bias"]))
     return x
 
 
@@ -97,32 +106,16 @@ def neigh_consensus_sharded(
 ):
     """Symmetric NeighConsensus on an iA-sharded correlation block.
 
-    The direct pass convolves with halo exchange along the sharded iA.
-    For the transposed pass the tensor is re-laid-out with all_to_all so the
-    B-side leading spatial dim (iB) becomes the sharded one, the same stack
-    runs, and the result is transferred back and summed.
+    Both branches convolve the SAME iA-sharded layout with per-layer halo
+    exchange: the transposed branch is realized as the swapped-kernel chain
+    (T(stack(T(x))) == stack(x, w_swapped), ops.conv4d.swap_ab_weight), so
+    no all_to_all re-layout of the correlation tensor is needed — the only
+    communication is the ring halo exchange either way.
     """
     direct = _conv_stack_sharded(params, corr4d, axis_name)
     if not symmetric:
         return direct
-
-    n = lax.axis_size(axis_name)
-    if n == 1:
-        swapped = jnp.transpose(corr4d, (0, 1, 4, 5, 2, 3))
-        back = jnp.transpose(
-            _conv_stack_sharded(params, swapped, axis_name), (0, 1, 4, 5, 2, 3)
-        )
-        return direct + back
-
-    # Re-layout: [b,c,I_loc,J,K,L] --all_to_all--> [b,c,I,J,K_loc,L]
-    regathered = lax.all_to_all(
-        corr4d, axis_name, split_axis=4, concat_axis=2, tiled=True
-    )
-    swapped = jnp.transpose(regathered, (0, 1, 4, 5, 2, 3))  # [b,c,K_loc,L,I,J]
-    conv_t = _conv_stack_sharded(params, swapped, axis_name)
-    conv_t = jnp.transpose(conv_t, (0, 1, 4, 5, 2, 3))  # [b,c,I,J,K_loc,L]
-    back = lax.all_to_all(conv_t, axis_name, split_axis=2, concat_axis=4, tiled=True)
-    return direct + back
+    return direct + _conv_stack_sharded(params, corr4d, axis_name, swap=True)
 
 
 def match_pipeline_sharded(params, corr_local, axis_name: str, symmetric: bool = True):
@@ -140,10 +133,9 @@ def make_sharded_match_pipeline(
 
     Returns a function (neigh_consensus_params, corr4d) -> corr4d where
     corr4d is globally shaped [b, 1, I, J, K, L]; I must be divisible by the
-    mesh 'sp' axis size (it carries the sharding), and in symmetric mode K
-    must be too (the transposed pass re-shards onto K via all_to_all). The
-    InLoc input bucketing (cli/eval_inloc.py) guarantees this. Input/output
-    shardings: corr split on dim 2, params replicated.
+    mesh 'sp' axis size (it carries the sharding) — the InLoc input
+    bucketing (cli/eval_inloc.py) guarantees this. Input/output shardings:
+    corr split on dim 2, params replicated.
     """
     spec_corr = P(None, None, axis_name, None, None, None)
 

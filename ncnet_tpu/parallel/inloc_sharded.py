@@ -9,7 +9,8 @@ pooled) correlation tensor plus workspace exceeds one chip's HBM:
       -> per-shard fused correlation + maxpool4d  (no communication:
          each shard owns a slab of A rows; pooling is local to a slab)
       -> mutual matching (pmax over shards)
-      -> symmetric NeighConsensus (halo-exchange Conv4d + all_to_all)
+      -> symmetric NeighConsensus (halo-exchange Conv4d; the transposed
+         branch is the swapped-kernel chain — no all_to_all re-layout)
       -> mutual matching
     -> globally-shaped corr4d + relocalization deltas for corr_to_matches.
 
@@ -42,8 +43,7 @@ def make_sharded_inloc_parts(config: NCNetConfig, mesh: Mesh, axis_name: str = "
 
     Requirements: batch 1; feature height iA divisible by
     (mesh size * relocalization_k_size) — the input bucketing in
-    cli/eval_inloc.py pads images so this holds. In symmetric mode iB must
-    also be divisible by the mesh size (all_to_all re-shard).
+    cli/eval_inloc.py pads images so this holds.
     """
     # Local import keeps jax.experimental.pallas off the import path of
     # consumers that never build the sharded InLoc forward (same policy as

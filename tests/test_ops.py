@@ -286,8 +286,6 @@ def test_conv4d_strategies_agree():
                 # Rank-4-spatial ConvGeneral support varies by backend —
                 # that's the reason the strategy knob exists; the default
                 # paths must still be pinned.
-                import pytest
-
                 pytest.skip(f"convnd unsupported on this backend: {exc}")
             raise
         assert jnp.allclose(out, ref, atol=1e-4), strategy
