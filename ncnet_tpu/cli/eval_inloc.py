@@ -109,6 +109,10 @@ def main(argv=None):
     # Multi-chip: shard the correlation tensor along iA over N devices
     # (parallel/inloc_sharded.py). 1 = single-device.
     parser.add_argument("--spatial_shards", type=int, default=1)
+    parser.add_argument(
+        "--profile_dir", type=str, default="",
+        help="capture a jax.profiler trace of the run for TensorBoard/Perfetto",
+    )
     args = parser.parse_args(argv)
     if args.spatial_shards < 1:
         parser.error("--spatial_shards must be >= 1")
@@ -199,10 +203,13 @@ def main(argv=None):
             )
         )
 
+    from ..utils.profiling import trace_context
+
     pool = ThreadPoolExecutor(max_workers=1)
     try:
-        _query_loop(args, db, out_dir, params, query_features, pano_matches,
-                    n_matches, pano_fn_all, pool, load_pano)
+        with trace_context(args.profile_dir):
+            _query_loop(args, db, out_dir, params, query_features, pano_matches,
+                        n_matches, pano_fn_all, pool, load_pano)
     finally:
         pool.shutdown(wait=False, cancel_futures=True)
 

@@ -24,6 +24,7 @@ from ..data import ImagePairDataset, DataLoader
 from ..parallel import make_mesh
 from ..training import (
     create_train_state,
+    load_opt_state,
     make_train_step,
     save_checkpoint,
     shard_batch,
@@ -55,6 +56,10 @@ def main(argv=None):
     parser.add_argument("--num_workers", type=int, default=8)
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument("--log_interval", type=int, default=1)
+    parser.add_argument(
+        "--profile_dir", type=str, default="",
+        help="capture a jax.profiler trace of the run for TensorBoard/Perfetto",
+    )
     args = parser.parse_args(argv)
 
     print("NCNet-TPU training")
@@ -71,6 +76,15 @@ def main(argv=None):
     state, tx = create_train_state(
         params, learning_rate=args.lr, train_fe=args.fe_finetune_params > 0
     )
+    # Resume the optimizer state alongside the params (the reference saves
+    # it but never restores it, train.py:203 — a defect not replicated).
+    # load_opt_state reads only opt_state.npz (params were already restored
+    # by build_model) and raises a clear error on an optimizer mismatch.
+    if args.checkpoint and os.path.isdir(args.checkpoint):
+        restored_opt = load_opt_state(args.checkpoint, state.opt_state)
+        if restored_opt is not None:
+            state.opt_state = restored_opt
+            print(f"restored optimizer state from {args.checkpoint}")
     train_step, eval_step = make_train_step(config, tx, remat_backbone=args.remat_backbone)
 
     # Use the largest device count that divides the batch.
@@ -119,11 +133,22 @@ def main(argv=None):
         args.result_model_dir,
         time.strftime("%Y-%m-%d_%H%M") + "_" + args.result_model_fn,
     )
+
+    from ..utils.profiling import trace_context
+
+    with trace_context(args.profile_dir):
+        _epoch_loop(args, config, state, train_step, eval_step, loader,
+                    loader_val, mesh, ckpt_dir)
+    print("Done!")
+
+
+def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
+                mesh, ckpt_dir):
+    from ..data.loader import device_prefetch
+
     best_val = float("inf")
     train_losses, val_losses = [], []
     trainable, opt_state = state.trainable, state.opt_state
-
-    from ..data.loader import device_prefetch
 
     def put(batch):
         return shard_batch(
@@ -191,7 +216,6 @@ def main(argv=None):
             },
             is_best=is_best,
         )
-    print("Done!")
 
 
 if __name__ == "__main__":

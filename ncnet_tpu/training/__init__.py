@@ -8,7 +8,12 @@ from .trainer import (
     shard_batch,
     replicate_state,
 )
-from .checkpoint import save_checkpoint, load_checkpoint, config_from_dict
+from .checkpoint import (
+    save_checkpoint,
+    load_checkpoint,
+    load_opt_state,
+    config_from_dict,
+)
 
 __all__ = [
     "weak_loss",
@@ -20,5 +25,6 @@ __all__ = [
     "replicate_state",
     "save_checkpoint",
     "load_checkpoint",
+    "load_opt_state",
     "config_from_dict",
 ]

@@ -125,10 +125,33 @@ def load_checkpoint(path: str, opt_state_template=None):
     config = config_from_dict(meta["config"])
     params = _load_tree(os.path.join(path, "params.npz"))
     result = {"params": params, "config": config, "meta": meta}
-    opt_path = os.path.join(path, "opt_state.npz")
-    if opt_state_template is not None and os.path.exists(opt_path):
-        data = np.load(opt_path)
-        leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
-        _, treedef = jax.tree.flatten(opt_state_template)
-        result["opt_state"] = jax.tree.unflatten(treedef, leaves)
+    if opt_state_template is not None:
+        opt_state = load_opt_state(path, opt_state_template)
+        if opt_state is not None:
+            result["opt_state"] = opt_state
     return result
+
+
+def load_opt_state(path: str, template):
+    """Restore just the optimizer state from a checkpoint dir, or None.
+
+    `template` supplies the pytree structure (opt states hold Python
+    containers npz cannot describe). A leaf-count mismatch means the saved
+    run used a different optimizer configuration — surfaced as a clear
+    error rather than a cryptic unflatten failure.
+    """
+    opt_path = os.path.join(path, "opt_state.npz")
+    if not os.path.exists(opt_path):
+        return None
+    data = np.load(opt_path)
+    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    flat, treedef = jax.tree.flatten(template)
+    if len(leaves) != len(flat):
+        raise ValueError(
+            f"optimizer state in {path!r} has {len(leaves)} leaves but the "
+            f"current optimizer expects {len(flat)} — the checkpoint was "
+            "saved with a different optimizer configuration (e.g. a "
+            "different --fe_finetune_params); drop the stale opt_state.npz "
+            "or match the original flags to resume it"
+        )
+    return jax.tree.unflatten(treedef, leaves)

@@ -79,6 +79,27 @@ def test_train_then_eval_pck(pf_dir, capsys):
     assert "PCK" in out
 
 
+def test_train_resume_restores_opt_state(pf_dir, capsys):
+    """Resuming from a native checkpoint restores the optimizer state (the
+    reference saves but never restores it, reference train.py:203)."""
+    common = [
+        "--dataset_image_path", str(pf_dir),
+        "--dataset_csv_path", str(pf_dir / "image_pairs"),
+        "--num_epochs", "1", "--batch_size", "2", "--image_size", "64",
+        "--backbone", "vgg", "--ncons_kernel_sizes", "3",
+        "--ncons_channels", "1", "--num_workers", "0",
+    ]
+    train_cli.main(common + ["--result_model_dir", str(pf_dir / "m1")])
+    run = os.listdir(pf_dir / "m1")[0]
+    ckpt = pf_dir / "m1" / run / "best"
+    train_cli.main(
+        common
+        + ["--result_model_dir", str(pf_dir / "m2"), "--checkpoint", str(ckpt)]
+    )
+    out = capsys.readouterr().out
+    assert f"restored optimizer state from {ckpt}" in out
+
+
 def test_localize_cli(tmp_path, capsys):
     """Matches -> PnP poses -> rate curve, through the CLI with .mat fixtures."""
     rng = np.random.default_rng(7)
