@@ -1,0 +1,24 @@
+#!/bin/sh
+# Fetch the training/eval pair lists that the reference distributes inside
+# its git tree (not inside the dataset archives): the PF-Pascal
+# train/val/test splits (~2.9k training rows with flip augmentation flags)
+# and the IVD pair lists. Keeping them upstream preserves split parity
+# without duplicating the files here.
+#
+# PF-Willow (test_pairs_pf.csv) and TSS (test_pairs_tss.csv) ship inside
+# their dataset zips — see their download.sh.
+set -e
+cd "$(dirname "$0")"  # paths below are relative to datasets/
+BASE="https://raw.githubusercontent.com/OliviaWang123456/ncnet/master"
+
+fetch() {
+  mkdir -p "$(dirname "$1")"
+  wget -nv -O "$1" "$BASE/datasets/$1"
+}
+
+fetch pf-pascal/image_pairs/train_pairs.csv
+fetch pf-pascal/image_pairs/val_pairs.csv
+fetch pf-pascal/image_pairs/test_pairs.csv
+fetch ivd/image_pairs/train_pairs.csv
+fetch ivd/image_pairs/val_pairs.csv
+echo "pair lists fetched"

@@ -1,4 +1,6 @@
 #!/bin/sh
 # PF-Pascal images + pair/keypoint annotations (see README of the dataset).
+# The train/val/test pair-list CSVs come from the upstream repo:
+#   sh ../fetch_pair_lists.sh
 wget https://www.di.ens.fr/willow/research/proposalflow/dataset/PF-dataset-PASCAL.zip
 unzip PF-dataset-PASCAL.zip 'PF-dataset-PASCAL/JPEGImages/*'

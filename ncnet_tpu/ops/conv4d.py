@@ -3,10 +3,11 @@
 The reference implements Conv4d as a *Python loop over the first spatial
 dimension*, calling `F.conv3d` once per slice per kernel offset
 (lib/conv4d.py:39-48) — O(iA * k) dispatches. Here the 4-D convolution is a
-single traced expression with three selectable, mathematically identical
+single traced expression with four selectable, mathematically identical
 decompositions (see `conv4d_prepadded`): the default folds (b, I, J) into
 the conv batch and runs kI*kJ shifted **2-D** convolutions over (K, L) —
-TPU convs are natively 2-D — with 'conv3d' (kI batched 3-D convs) and
+TPU convs are natively 2-D — with 'conv3d' (kI batched 3-D convs),
+'conv2d_stacked' (offsets folded into input channels, one conv) and
 'convnd' (one rank-4-spatial ConvGeneral) kept for per-backend A/B via
 NCNET_CONV4D_STRATEGY. All variants are fully vectorized and let XLA tile
 the inner contraction onto the MXU.
@@ -27,7 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 
 # Default decomposition; override per-process with NCNET_CONV4D_STRATEGY
-# ('conv2d' | 'conv3d' | 'convnd') to A/B formulations on a given backend.
+# ('conv2d' | 'conv3d' | 'conv2d_stacked' | 'convnd') to A/B formulations
+# on a given backend.
 _DEFAULT_STRATEGY = os.environ.get("NCNET_CONV4D_STRATEGY", "conv2d")
 
 
@@ -38,16 +40,19 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     sharded halo-exchange variant (parallel/corr_sharding.py). Emits only
     the center I rows.
 
-    Three mathematically identical formulations:
+    Four mathematically identical formulations:
       * 'conv2d' (default): kI*kJ shifted batched **2-D** convolutions over
         (K, L) with (b, I, J) folded into the conv batch. TPU convolutions
         are natively 2-D — this lowers straight onto the hardware conv path,
         whereas 3-D convs go through a generic lowering.
       * 'conv3d': kI batched 3-D convolutions with (b, I) folded into the
         batch (kept for comparison/testing).
+      * 'conv2d_stacked': ONE 2-D conv with the kI*kJ offsets folded into
+        the input channels — single output write, kI*kJ-times-larger input
+        (wins for small cin).
       * 'convnd': one rank-4-spatial ConvGeneral op — the compiler owns the
-        whole stencil (for per-backend A/B; select via the
-        NCNET_CONV4D_STRATEGY env var).
+        whole stencil.
+    Select per-backend via the NCNET_CONV4D_STRATEGY env var.
 
     Args:
       x: [b, cin, I + 2*(kI//2), J, K, L].
@@ -111,6 +116,38 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
             )
             out = y if out is None else out + y
         out = jnp.moveaxis(out.reshape(b, si, cout, sj, sk, sl), 1, 2)
+    elif strategy == "conv2d_stacked":
+        # Fold the kI*kJ kernel offsets into the conv INPUT channels: one
+        # conv2d over (K, L) with cin' = kI*kJ*cin sums all offsets inside
+        # its contraction — a single output write instead of kI*kJ
+        # partial-sum round trips through HBM, at the cost of materializing
+        # the kI*kJ-times-larger stacked input. Wins when cin is small
+        # (consensus layer 1 has cin=1); for large cin the stacked tensor
+        # dominates and 'conv2d' is the right shape.
+        pad_j = kj // 2
+        xp = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0)))
+        slabs = []
+        for di in range(ki):
+            for dj in range(kj):
+                xs = lax.slice_in_dim(xp, di, di + si, axis=2)
+                xs = lax.slice_in_dim(xs, dj, dj + sj, axis=3)
+                slabs.append(jnp.moveaxis(xs, 1, 5))  # [b, I, J, K, L, cin]
+        stacked = jnp.concatenate(slabs, axis=5).reshape(
+            b * si * sj, sk, sl, ki * kj * cin
+        )
+        w_stacked = w.reshape(ki * kj, kk, kl, cin, cout)
+        w_stacked = jnp.moveaxis(w_stacked, 0, 2).reshape(
+            kk, kl, ki * kj * cin, cout
+        )
+        out = lax.conv_general_dilated(
+            stacked,
+            w_stacked,
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        out = jnp.moveaxis(out.reshape(b, si, sj, sk, sl, cout), 5, 1)
     elif strategy == "convnd":
         # One rank-4-spatial convolution: XLA's ConvGeneral HLO is rank-
         # agnostic, so the whole 4-D stencil is a single op and the compiler

@@ -278,7 +278,7 @@ def test_conv4d_strategies_agree():
     b = jax.random.normal(jax.random.PRNGKey(2), (2,))
     ref = conv4d_reference(x, w, b)
     xp = jnp.pad(x, ((0, 0), (0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
-    for strategy in ("conv2d", "conv3d", "convnd"):
+    for strategy in ("conv2d", "conv3d", "conv2d_stacked", "convnd"):
         try:
             out = conv4d_prepadded(xp, w, b, strategy=strategy)
         except Exception as exc:  # noqa: BLE001
