@@ -9,7 +9,10 @@
 # their dataset zips — see their download.sh.
 set -e
 cd "$(dirname "$0")"  # paths below are relative to datasets/
-BASE="https://raw.githubusercontent.com/OliviaWang123456/ncnet/master"
+# Override NCNET_REF_BASE to pin a specific commit of the upstream repo
+# (recommended for reproducible splits), e.g.
+#   NCNET_REF_BASE=https://raw.githubusercontent.com/OliviaWang123456/ncnet/<sha>
+BASE="${NCNET_REF_BASE:-https://raw.githubusercontent.com/OliviaWang123456/ncnet/master}"
 
 fetch() {
   mkdir -p "$(dirname "$1")"

@@ -21,7 +21,7 @@ import jax
 import numpy as np
 
 from ..data import ImagePairDataset, DataLoader
-from ..parallel import make_mesh
+from ..parallel import make_mesh, multihost
 from ..training import (
     create_train_state,
     load_opt_state,
@@ -62,6 +62,12 @@ def main(argv=None):
     )
     args = parser.parse_args(argv)
 
+    # Multi-host bootstrap: a no-op unless a coordinator is configured in
+    # the environment (JAX_COORDINATOR_ADDRESS etc., see parallel.multihost).
+    # After it, jax.devices() is the GLOBAL device list and the same program
+    # runs unchanged on every host.
+    multihost.initialize()
+
     print("NCNet-TPU training")
     print(args)
 
@@ -87,14 +93,34 @@ def main(argv=None):
             print(f"restored optimizer state from {args.checkpoint}")
     train_step, eval_step = make_train_step(config, tx, remat_backbone=args.remat_backbone)
 
-    # Use the largest device count that divides the batch.
+    # Use the largest device count that divides the batch (single-host);
+    # multi-host requires the full global device count to divide the batch.
+    n_proc = multihost.process_count()
     n_dev = len(jax.devices())
-    while n_dev > 1 and args.batch_size % n_dev:
-        n_dev -= 1
+    if n_proc > 1:
+        if args.batch_size % n_dev:
+            raise SystemExit(
+                f"multi-host run: batch_size {args.batch_size} must be "
+                f"divisible by the global device count {n_dev}"
+            )
+    else:
+        while n_dev > 1 and args.batch_size % n_dev:
+            n_dev -= 1
     mesh = make_mesh((n_dev,), ("dp",)) if n_dev > 1 else None
     if mesh is not None:
         state = replicate_state(state, mesh)
-    print(f"devices: {len(jax.devices())} (dp axis: {n_dev})")
+    print(
+        f"devices: {len(jax.devices())} (dp axis: {n_dev}, hosts: {n_proc})"
+    )
+
+    # Each host decodes only its slice of every (deterministically
+    # scheduled) global batch and contributes it to the global array.
+    if n_proc > 1:
+        batch_slice = multihost.host_local_slice(args.batch_size)
+        put = lambda b: multihost.host_local_batch(b, mesh)  # noqa: E731
+    else:
+        batch_slice = None
+        put = lambda b: shard_batch(b, mesh)  # noqa: E731
 
     size = (args.image_size, args.image_size)
     dataset = ImagePairDataset(
@@ -115,7 +141,7 @@ def main(argv=None):
         )
     loader = DataLoader(
         dataset, args.batch_size, shuffle=True, num_workers=args.num_workers,
-        seed=args.seed, drop_last=True,
+        seed=args.seed, drop_last=True, batch_slice=batch_slice,
     )
     if args.batch_size > len(dataset_val):
         print(
@@ -126,7 +152,7 @@ def main(argv=None):
         )
     loader_val = DataLoader(
         dataset_val, args.batch_size, shuffle=False,
-        num_workers=args.num_workers, drop_last=True,
+        num_workers=args.num_workers, drop_last=True, batch_slice=batch_slice,
     )
 
     ckpt_dir = os.path.join(
@@ -138,12 +164,12 @@ def main(argv=None):
 
     with trace_context(args.profile_dir):
         _epoch_loop(args, config, state, train_step, eval_step, loader,
-                    loader_val, mesh, ckpt_dir)
+                    loader_val, put, ckpt_dir)
     print("Done!")
 
 
 def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
-                mesh, ckpt_dir):
+                put_batch, ckpt_dir):
     from ..data.loader import device_prefetch
 
     best_val = float("inf")
@@ -151,8 +177,8 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
     trainable, opt_state = state.trainable, state.opt_state
 
     def put(batch):
-        return shard_batch(
-            {k: batch[k] for k in ("source_image", "target_image")}, mesh
+        return put_batch(
+            {k: batch[k] for k in ("source_image", "target_image")}
         )
 
     for epoch in range(1, args.num_epochs + 1):
@@ -176,9 +202,7 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
 
         val_loss, n_val = 0.0, 0
         for batch in loader_val:
-            batch = shard_batch(
-                {k: batch[k] for k in ("source_image", "target_image")}, mesh
-            )
+            batch = put(batch)
             val_loss += float(
                 eval_step(
                     trainable, state.frozen,
@@ -201,21 +225,25 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
         select_loss = val_loss if n_val else train_loss
         is_best = select_loss < best_val
         best_val = min(select_loss, best_val)
-        full_params = {
-            "backbone": trainable.get("backbone", state.frozen["backbone"]),
-            "neigh_consensus": trainable["neigh_consensus"],
-        }
-        save_checkpoint(
-            ckpt_dir, full_params, config, epoch,
-            opt_state=opt_state,
-            extra={
-                "train_loss": train_losses,
-                "val_loss": val_losses,
-                "best_val_loss": best_val,
-                "args": vars(args),
-            },
-            is_best=is_best,
-        )
+        # Checkpoints are written by host 0 only: params/opt state are
+        # replicated, so other hosts would race identical writes on shared
+        # storage (and per-host strftime run dirs can straddle a minute).
+        if multihost.process_index() == 0:
+            full_params = {
+                "backbone": trainable.get("backbone", state.frozen["backbone"]),
+                "neigh_consensus": trainable["neigh_consensus"],
+            }
+            save_checkpoint(
+                ckpt_dir, full_params, config, epoch,
+                opt_state=opt_state,
+                extra={
+                    "train_loss": train_losses,
+                    "val_loss": val_losses,
+                    "best_val_loss": best_val,
+                    "args": vars(args),
+                },
+                is_best=is_best,
+            )
 
 
 if __name__ == "__main__":

@@ -55,7 +55,12 @@ class DataLoader:
         drop_last: bool = False,
         prefetch: int = 2,
         collate_fn=default_collate,
+        batch_slice: Optional[tuple] = None,
     ):
+        """batch_slice=(start, stop): decode only those rows of every batch —
+        the multi-host input pattern (each host runs the same deterministic
+        index schedule, seeds being equal, and reads just its
+        parallel.multihost.host_local_slice of each global batch)."""
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -64,6 +69,11 @@ class DataLoader:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.collate_fn = collate_fn
+        if batch_slice is not None and not drop_last:
+            # A ragged final batch would slice to unequal per-host row
+            # counts and wedge the cross-host array assembly downstream.
+            raise ValueError("batch_slice requires drop_last=True")
+        self.batch_slice = batch_slice
         self._epoch = 0
 
     def __len__(self):
@@ -83,6 +93,9 @@ class DataLoader:
         ]
         if self.drop_last and batches and len(batches[-1]) < self.batch_size:
             batches.pop()
+        if self.batch_slice is not None:
+            start, stop = self.batch_slice
+            batches = [b[start:stop] for b in batches]
         return batches
 
     def __iter__(self) -> Iterator[dict]:

@@ -12,8 +12,9 @@ Typical use (same program on every host, e.g. under a TPU pod launcher):
     from ncnet_tpu.parallel import multihost
     multihost.initialize()                       # no-op single-host
     mesh = multihost.global_mesh(("dp",))        # all devices, all hosts
-    # feed each host its local shard of the global batch:
-    batch = multihost.host_local_batch(global_batch_size, mesh)
+    start, stop = multihost.host_local_slice(global_batch_size)
+    local_rows = {k: v[start:stop] for k, v in host_batch.items()}
+    batch = multihost.host_local_batch(local_rows, mesh)  # global arrays
 """
 
 from __future__ import annotations
@@ -43,16 +44,32 @@ def initialize(
     global _initialized
     if _initialized:
         return
-    explicit = coordinator_address is not None
     env = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
     )
-    if not (explicit or env):
+    if coordinator_address is None and not env:
         return  # single-host
+    # JAX itself only auto-detects managed clusters (Slurm, OpenMPI, TPU
+    # pods); the generic JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    # JAX_PROCESS_ID variables are this framework's convention and must be
+    # passed through explicitly.
+    def _env_int(*names):
+        for name in names:
+            v = os.environ.get(name)
+            if v is not None:
+                return int(v)
+        return None
+
     jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
+        coordinator_address=coordinator_address or env,
+        num_processes=(
+            num_processes if num_processes is not None
+            else _env_int("JAX_NUM_PROCESSES", "NUM_PROCESSES")
+        ),
+        process_id=(
+            process_id if process_id is not None
+            else _env_int("JAX_PROCESS_ID", "PROCESS_ID")
+        ),
     )
     _initialized = True
 
@@ -82,9 +99,9 @@ def process_index() -> int:
 def host_local_slice(global_batch_size: int) -> Tuple[int, int]:
     """[start, stop) of this host's rows of a globally-sharded batch.
 
-    The data loader on each host reads only its slice; jax.device_put with a
-    NamedSharding then places local rows on local devices without cross-host
-    transfer (the standard multi-host input pattern).
+    The data loader on each host reads only its slice; `host_local_batch`
+    then assembles the global arrays without cross-host transfer (the
+    standard multi-host input pattern).
     """
     n, i = jax.process_count(), jax.process_index()
     if global_batch_size % n:
@@ -93,3 +110,21 @@ def host_local_slice(global_batch_size: int) -> Tuple[int, int]:
         )
     per = global_batch_size // n
     return i * per, (i + 1) * per
+
+
+def host_local_batch(batch: dict, mesh: Mesh, axis: str = "dp") -> dict:
+    """Assemble global batch-sharded arrays from each host's local rows.
+
+    `batch` maps names to THIS host's rows (its `host_local_slice` of the
+    global batch). jax.make_array_from_process_local_data places local rows
+    on local devices — no data crosses DCN. Works unchanged single-host,
+    where it is equivalent to a sharded device_put.
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis))
+    return {
+        k: jax.make_array_from_process_local_data(sharding, np.asarray(v))
+        for k, v in batch.items()
+    }
