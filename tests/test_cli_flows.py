@@ -100,6 +100,55 @@ def test_train_resume_restores_opt_state(pf_dir, capsys):
     assert f"restored optimizer state from {ckpt}" in out
 
 
+def test_eval_pf_willow_cli(tmp_path, capsys):
+    """PF-Willow CLI end to end on a synthetic Willow-layout dataset
+    (CSV: imA, imB, XA;-list, YA;-list, XB;-list, YB;-list — 10 points)."""
+    from ncnet_tpu.cli import eval_pf_willow
+
+    rng = np.random.default_rng(1)
+    (tmp_path / "images").mkdir()
+    names = []
+    for i in range(4):
+        n = f"images/w{i}.png"
+        Image.fromarray((rng.random((60, 80, 3)) * 255).astype("uint8")).save(
+            tmp_path / n
+        )
+        names.append(n)
+    pts_x = ";".join(str(v) for v in np.linspace(8, 70, 10))
+    pts_y = ";".join(str(v) for v in np.linspace(6, 52, 10))
+    with open(tmp_path / "test_pairs.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["imageA", "imageB", "XA", "YA", "XB", "YB"])
+        for i in range(0, 4, 2):
+            w.writerow([names[i], names[i + 1], pts_x, pts_y, pts_x, pts_y])
+
+    # Tiny checkpoint (vgg/pool3, one 3^4 conv) instead of the default
+    # resnet101 — exercises the same restore + eval path at a fraction of
+    # the compile time.
+    import jax
+
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.training import save_checkpoint
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    save_checkpoint(str(tmp_path / "ckpt"), params, config, 1, is_best=True)
+
+    eval_pf_willow.main(
+        [
+            "--checkpoint", str(tmp_path / "ckpt" / "best"),
+            "--eval_dataset_path", str(tmp_path),
+            "--image_size", "64", "--batch_size", "2",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "PCK" in out and "Total: 2" in out
+
+
 def test_localize_cli(tmp_path, capsys):
     """Matches -> PnP poses -> rate curve, through the CLI with .mat fixtures."""
     rng = np.random.default_rng(7)
