@@ -87,6 +87,37 @@ def global_timer() -> PhaseTimer:
     return _GLOBAL_TIMER
 
 
+def timed_steady(fn, *xs, iters: int = 3):
+    """Time fn(*xs): returns (first_s, steady_s, out).
+
+    first_s covers compile + first run; steady_s is the mean of `iters`
+    further runs. Each run is closed by materializing one element of every
+    output leaf on the host: on tunneled backends (axon) block_until_ready
+    can return before execution completes, and only a host fetch reliably
+    closes the iteration (the technique bench.py uses). Shared by
+    tools/profile_inloc.py and tools/bench_conv4d.py so their numbers stay
+    comparable.
+    """
+    import time as _time
+
+    import jax
+
+    def close(out):
+        for leaf in jax.tree.leaves(out):
+            if hasattr(leaf, "ravel"):
+                float(leaf.ravel()[0])
+
+    t0 = _time.perf_counter()
+    out = fn(*xs)
+    close(out)
+    first = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        close(fn(*xs))
+    steady = (_time.perf_counter() - t0) / max(iters, 1)
+    return first, steady, out
+
+
 def dial_devices(timeout: float):
     """jax.devices() under a watchdog thread.
 

@@ -1,0 +1,101 @@
+"""Sweep the Conv4d strategies at consensus-stack shapes on this backend.
+
+One invocation times every formulation of ncnet_tpu.ops.conv4d (conv2d /
+conv3d / conv2d_stacked / convnd, skipping any the backend rejects) on the
+InLoc consensus layers (post-pool [1,1,100,75,100,75], 3^4 kernels,
+1->16->1 channels) and on the PF-Pascal shape (25^4, 5^4 kernels), plus
+the full symmetric neigh_consensus_apply. Prints one line per (shape,
+strategy) so picking NCNET_CONV4D_STRATEGY for a backend is one run.
+
+Usage:
+    python tools/bench_conv4d.py [--scale 1.0] [--iters 5]
+    # CPU smoke: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    #   python tools/bench_conv4d.py --scale 0.2 --iters 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+STRATEGIES = ("conv2d", "conv3d", "conv2d_stacked", "convnd")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="scale on the InLoc consensus shape (1.0 = 100x75)")
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--dial_timeout", type=float, default=900.0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.ops.conv4d import (
+        conv4d_prepadded,
+        neigh_consensus_apply,
+        neigh_consensus_init,
+    )
+    from ncnet_tpu.utils.profiling import (
+        dial_devices,
+        setup_compile_cache,
+        timed_steady,
+    )
+
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        print("backend dial timed out; aborting", file=sys.stderr)
+        os._exit(2)
+    print(f"# backend: {devices[0]}")
+
+    ii = max(int(100 * args.scale) // 4 * 4, 8)
+    jj = max(int(75 * args.scale) // 4 * 4, 8)
+    cases = [
+        # (name, shape [b,cin,I,J,K,L], kernel, cout, dtype)
+        ("inloc-l1", (1, 1, ii, jj, ii, jj), 3, 16, jnp.bfloat16),
+        ("inloc-l2", (1, 16, ii, jj, ii, jj), 3, 1, jnp.bfloat16),
+        ("pfpascal-l2", (1, 16, 25, 25, 25, 25), 5, 16, jnp.float32),
+    ]
+
+    def timed(fn, *xs):
+        _, steady, _ = timed_steady(fn, *xs, iters=args.iters)
+        return steady
+
+    for name, shape, k, cout, dtype in cases:
+        b, cin = shape[:2]
+        x = jax.random.normal(jax.random.PRNGKey(0), shape, dtype)
+        w = jax.random.normal(
+            jax.random.PRNGKey(1), (k, k, k, k, cin, cout), jnp.float32
+        ) * (1.0 / (cin * k**4) ** 0.5)
+        bias = jnp.zeros((cout,), jnp.float32)
+        xp = jnp.pad(
+            x, ((0, 0), (0, 0), (k // 2, k // 2)) + ((0, 0),) * 3
+        )
+        for strategy in STRATEGIES:
+            try:
+                fn = jax.jit(
+                    lambda a, ww, bb, s=strategy: conv4d_prepadded(
+                        a, ww, bb, strategy=s
+                    )
+                )
+                dt = timed(fn, xp, w, bias)
+                print(f"{name:14s} {strategy:15s} {dt * 1e3:9.2f} ms")
+            except Exception as exc:  # noqa: BLE001
+                print(f"{name:14s} {strategy:15s} unsupported "
+                      f"({type(exc).__name__})")
+
+    # Full symmetric consensus stack at the InLoc config.
+    params = neigh_consensus_init(jax.random.PRNGKey(2), (3, 3), (16, 1))
+    corr = jax.random.normal(
+        jax.random.PRNGKey(3), (1, 1, ii, jj, ii, jj), jnp.bfloat16
+    )
+    stack = jax.jit(lambda p, c: neigh_consensus_apply(p, c, symmetric=True))
+    dt = timed(stack, params, corr)
+    print(f"{'consensus-stack':14s} {'(default)':15s} {dt * 1e3:9.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

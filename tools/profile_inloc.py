@@ -82,15 +82,10 @@ def main(argv=None):
     params = ncnet_init(jax.random.PRNGKey(0), config)
     log("params built")
 
+    from ncnet_tpu.utils.profiling import timed_steady
+
     def timed(name, fn, *xs):
-        t0 = time.perf_counter()
-        out = fn(*xs)
-        jax.block_until_ready(out)
-        t_first = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            jax.block_until_ready(fn(*xs))
-        dt = (time.perf_counter() - t0) / args.iters
+        t_first, dt, out = timed_steady(fn, *xs, iters=args.iters)
         log(f"{name}: compile+first={t_first:.2f}s steady={dt * 1000:.1f}ms")
         return out
 
