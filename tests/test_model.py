@@ -72,6 +72,65 @@ def test_ncnet_forward_relocalization(rng):
     assert delta is not None and len(delta) == 4
 
 
+def test_full_match_pipeline_matches_torch_composition(rng):
+    """Composed golden test (SURVEY.md §4 seed b): l2norm -> correlation ->
+    mutual -> symmetric consensus -> mutual against an independent torch
+    formulation. The torch side uses EXPLICIT transposes for the symmetric
+    branch, cross-checking the swapped-kernel identity used in
+    ops.conv4d.neigh_consensus_apply; stage boundaries (eps constants,
+    layout conventions) are pinned end to end, not just per op."""
+    import torch
+
+    from ncnet_tpu.ops import (
+        feature_correlation,
+        feature_l2norm,
+        mutual_matching,
+        neigh_consensus_apply,
+        neigh_consensus_init,
+    )
+
+    b, c, ha, wa, hb, wb = 2, 6, 5, 4, 5, 4
+    fa = rng.randn(b, c, ha, wa).astype(np.float32)
+    fb = rng.randn(b, c, hb, wb).astype(np.float32)
+    params = neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (4, 1))
+
+    # --- ours -----------------------------------------------------------
+    fa_j = feature_l2norm(jnp.asarray(fa))
+    fb_j = feature_l2norm(jnp.asarray(fb))
+    corr = feature_correlation(fa_j, fb_j, compute_dtype=jnp.float32)
+    ours = mutual_matching(
+        neigh_consensus_apply(params, mutual_matching(corr), symmetric=True)
+    )
+
+    # --- independent torch formulation (shared oracles from test_ops) ----
+    from tests.test_ops import torch_conv4d, torch_mutual_matching
+
+    t_params = [
+        {
+            "weight": torch.from_numpy(np.asarray(l["weight"], np.float32)),
+            "bias": torch.from_numpy(np.asarray(l["bias"], np.float32)),
+        }
+        for l in params
+    ]
+    ta = torch.from_numpy(fa)
+    tb = torch.from_numpy(fb)
+    ta = ta / torch.sqrt((ta * ta).sum(1, keepdim=True) + 1e-6)
+    tb = tb / torch.sqrt((tb * tb).sum(1, keepdim=True) + 1e-6)
+    tc = torch.einsum("bcij,bckl->bijkl", ta, tb)[:, None]
+
+    def t_stack(x):
+        for layer in t_params:
+            x = torch.relu(torch_conv4d(x, layer["weight"], layer["bias"]))
+        return x
+
+    tm = torch_mutual_matching(tc)
+    swapped = tm.permute(0, 1, 4, 5, 2, 3)
+    t_cons = t_stack(tm) + t_stack(swapped).permute(0, 1, 4, 5, 2, 3)
+    theirs = torch_mutual_matching(t_cons).numpy()
+
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-5, rtol=1e-4)
+
+
 def test_half_precision_pipeline_tracks_f32(rng):
     """The bf16 consensus path (half_precision=True) must track the f32
     pipeline within bf16 resolution — the dtype change is a storage
