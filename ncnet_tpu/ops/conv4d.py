@@ -28,8 +28,9 @@ import jax.numpy as jnp
 from jax import lax
 
 # Default decomposition; override per-process with NCNET_CONV4D_STRATEGY
-# ('conv2d' | 'conv3d' | 'conv2d_stacked' | 'convnd') to A/B formulations
-# on a given backend.
+# ('conv2d' | 'conv3d' | 'conv2d_stacked' | 'convnd' | 'auto' — 'auto'
+# picks conv2d_stacked for small fan-in layers, conv2d otherwise) to A/B
+# formulations on a given backend.
 _DEFAULT_STRATEGY = os.environ.get("NCNET_CONV4D_STRATEGY", "conv2d")
 
 
@@ -52,6 +53,8 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
         (wins for small cin).
       * 'convnd': one rank-4-spatial ConvGeneral op — the compiler owns the
         whole stencil.
+      * 'auto': per-layer pick — 'conv2d_stacked' when cin <= 2, else
+        'conv2d'.
     Select per-backend via the NCNET_CONV4D_STRATEGY env var.
 
     Args:
@@ -64,6 +67,13 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     """
     if strategy is None:
         strategy = _DEFAULT_STRATEGY
+    if strategy == "auto":
+        # Per-layer heuristic: fold the kI*kJ offsets into input channels
+        # when cin is small — the stacked input then stays a small multiple
+        # of the tensor while replacing kI*kJ partial-sum round trips with
+        # one output write (consensus layer 1 has cin=1). Larger cin makes
+        # the stacked input dominate; use the batched-2-D default there.
+        strategy = "conv2d_stacked" if weight.shape[4] <= 2 else "conv2d"
     b, cin, si_pad, sj, sk, sl = x.shape
     ki, kj, kk, kl, wcin, cout = weight.shape
     if wcin != cin:

@@ -278,7 +278,7 @@ def test_conv4d_strategies_agree():
     b = jax.random.normal(jax.random.PRNGKey(2), (2,))
     ref = conv4d_reference(x, w, b)
     xp = jnp.pad(x, ((0, 0), (0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
-    for strategy in ("conv2d", "conv3d", "conv2d_stacked", "convnd"):
+    for strategy in ("conv2d", "conv3d", "conv2d_stacked", "convnd", "auto"):
         try:
             out = conv4d_prepadded(xp, w, b, strategy=strategy)
         except Exception as exc:  # noqa: BLE001
@@ -289,3 +289,13 @@ def test_conv4d_strategies_agree():
                 pytest.skip(f"convnd unsupported on this backend: {exc}")
             raise
         assert jnp.allclose(out, ref, atol=1e-4), strategy
+
+    # 'auto' with small cin must route through (and agree via) the stacked
+    # branch — the case above has fan-in > 2 and only covers its conv2d arm.
+    x1 = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 5, 4, 6, 5))
+    w1 = jax.random.normal(jax.random.PRNGKey(4), (3, 3, 3, 3, 1, 2))
+    b1 = jax.random.normal(jax.random.PRNGKey(5), (2,))
+    ref1 = conv4d_reference(x1, w1, b1)
+    xp1 = jnp.pad(x1, ((0, 0), (0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+    out1 = conv4d_prepadded(xp1, w1, b1, strategy="auto")
+    assert jnp.allclose(out1, ref1, atol=1e-4)

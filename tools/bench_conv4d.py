@@ -19,7 +19,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-STRATEGIES = ("conv2d", "conv3d", "conv2d_stacked", "convnd")
+STRATEGIES = ("conv2d", "conv3d", "conv2d_stacked", "convnd", "auto")
 
 
 def main():

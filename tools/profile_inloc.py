@@ -35,7 +35,8 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--dial_timeout", type=float, default=900.0)
     p.add_argument("--conv4d_strategy", type=str, default="",
-                   choices=("", "conv2d", "conv3d", "conv2d_stacked", "convnd"),
+                   choices=("", "conv2d", "conv3d", "conv2d_stacked",
+                            "convnd", "auto"),
                    help="A/B the Conv4d formulation (sets "
                    "NCNET_CONV4D_STRATEGY before ncnet_tpu import)")
     args = p.parse_args(argv)
