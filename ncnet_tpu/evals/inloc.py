@@ -14,8 +14,6 @@ where the reference's process boundary to Matlab is (SURVEY.md §3.3).
 from __future__ import annotations
 
 import os
-from typing import Optional
-
 import numpy as np
 import jax.numpy as jnp
 from scipy.io import savemat

@@ -14,7 +14,7 @@ by caller callbacks so the driver stays dataset-agnostic.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
 import numpy as np

@@ -22,7 +22,7 @@ large dense ops rather than a Matlab `for` over samples.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

@@ -23,7 +23,7 @@ filters. The pipeline output is always f32 for softmax/argmax extraction.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

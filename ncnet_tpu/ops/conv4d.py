@@ -41,7 +41,7 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     sharded halo-exchange variant (parallel/corr_sharding.py). Emits only
     the center I rows.
 
-    Four mathematically identical formulations:
+    Four mathematically identical formulations, plus an 'auto' picker:
       * 'conv2d' (default): kI*kJ shifted batched **2-D** convolutions over
         (K, L) with (b, I, J) folded into the conv batch. TPU convolutions
         are natively 2-D — this lowers straight onto the hardware conv path,

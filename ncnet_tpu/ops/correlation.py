@@ -10,7 +10,6 @@ float32 accumulation (`preferred_element_type`), mirroring — and improving on
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax
 
 
 def feature_l2norm(feature, axis: int = 1, eps: float = 1e-6):

@@ -12,7 +12,6 @@ which would be a host sync on TPU.
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -27,7 +27,6 @@ same memory behavior on CPU and is the oracle for the kernel tests.
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -27,17 +27,16 @@ only memory workaround — eval_inloc.py:50, lib/model.py:269-272).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, List, Sequence
+from typing import Any, Dict, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from ..ops.conv4d import conv4d_prepadded, swap_ab_weight
 from ..ops.mutual import EPS
-from ..ops.pool4d import maxpool4d
 
 
 def _halo_exchange(x, pad: int, axis_name: str):

@@ -58,6 +58,30 @@ def _run(fixture_dir, size=64):
     return out_dir / exp[0]
 
 
+def test_inloc_resize_shape_alignment():
+    """Pin the reference's resize-alignment arithmetic (eval_inloc.py:84-89):
+    long side scaled to ~image_size with feature dims (stride 16) divisible
+    by k_size, and the height unit additionally by shards*k for the sharded
+    forward."""
+    from ncnet_tpu.cli.eval_inloc import inloc_resize_shape
+
+    # Canonical InLoc sizes: iPhone7 query 4032x3024 -> 3200x2400.
+    assert inloc_resize_shape(4032, 3024, 3200, 2) == (3200, 2400)
+    assert inloc_resize_shape(3024, 4032, 3200, 2) == (2400, 3200)
+    # Non-standard aspect: alignment trims, never exceeds the long side.
+    assert inloc_resize_shape(3000, 2000, 3200, 2) == (3200, 2112)
+    for h, w in [(4032, 3024), (999, 1501), (3000, 2000), (480, 640)]:
+        for k in (1, 2):
+            for shards in (1, 4):
+                oh, ow = inloc_resize_shape(
+                    h, w, 3200, k, h_unit=k * shards
+                )
+                assert oh <= 3200 and ow <= 3200
+                assert (oh // 16) % (k * shards) == 0, (h, w, k, shards)
+                assert (ow // 16) % k == 0
+                assert oh % 16 == 0 and ow % 16 == 0
+
+
 def test_writes_match_files(fixture_dir):
     exp_dir = _run(fixture_dir)
     files = sorted(os.listdir(exp_dir))

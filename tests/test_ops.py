@@ -278,15 +278,16 @@ def test_conv4d_strategies_agree():
     b = jax.random.normal(jax.random.PRNGKey(2), (2,))
     ref = conv4d_reference(x, w, b)
     xp = jnp.pad(x, ((0, 0), (0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
-    for strategy in ("conv2d", "conv3d", "conv2d_stacked", "convnd", "auto"):
+    for strategy in ("conv2d", "conv3d", "conv2d_stacked", "auto", "convnd"):
         try:
             out = conv4d_prepadded(xp, w, b, strategy=strategy)
-        except Exception as exc:  # noqa: BLE001
+        except Exception:  # noqa: BLE001
             if strategy == "convnd":
                 # Rank-4-spatial ConvGeneral support varies by backend —
-                # that's the reason the strategy knob exists; the default
-                # paths must still be pinned.
-                pytest.skip(f"convnd unsupported on this backend: {exc}")
+                # that's the reason the strategy knob exists; the other
+                # formulations must still be pinned, so continue rather
+                # than skip the whole test.
+                continue
             raise
         assert jnp.allclose(out, ref, atol=1e-4), strategy
 
