@@ -23,6 +23,22 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache (per-user path to avoid shared-machine
+# permission collisions): repeat suite runs reuse compiled programs.
+# Threshold 0 caches everything — the suite is made of many small programs
+# that individually compile fast but add up.
+import tempfile
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "NCNET_TEST_COMPILE_CACHE",
+        os.path.join(
+            tempfile.gettempdir(), f"ncnet_tpu_test_cache_{os.getuid()}"
+        ),
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import numpy as np
 import pytest

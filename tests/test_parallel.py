@@ -135,10 +135,13 @@ def test_sharded_inloc_forward_matches_single_device():
         use_fused_corr_pool=True,
     )
     params = ncnet_init(jax.random.PRNGKey(0), config)
-    # pool3 => stride 8; image 128 -> features 16 = divisible by n*k for n<=4.
+    # pool3 => stride 8; src 128 -> features 16 = divisible by n*k for n<=4.
+    # tgt is deliberately RECTANGULAR with iB=14 not divisible by the mesh
+    # (the swapped-kernel symmetric branch imposes no constraint on the
+    # B side — the real InLoc situation of query/pano aspect mismatch).
     k1, k2 = jax.random.split(jax.random.PRNGKey(1))
     src = jax.random.normal(k1, (1, 3, 128, 128))
-    tgt = jax.random.normal(k2, (1, 3, 128, 128))
+    tgt = jax.random.normal(k2, (1, 3, 112, 96))
 
     ref_corr, ref_deltas = ncnet_forward(config, params, src, tgt)
 
