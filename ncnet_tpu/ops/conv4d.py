@@ -4,13 +4,14 @@ The reference implements Conv4d as a *Python loop over the first spatial
 dimension*, calling `F.conv3d` once per slice per kernel offset
 (lib/conv4d.py:39-48) — O(iA * k) dispatches. Here the 4-D convolution is a
 single traced expression with four selectable, mathematically identical
-decompositions (see `conv4d_prepadded`): the default folds (b, I, J) into
-the conv batch and runs kI*kJ shifted **2-D** convolutions over (K, L) —
-TPU convs are natively 2-D — with 'conv3d' (kI batched 3-D convs),
-'conv2d_stacked' (offsets folded into input channels, one conv) and
-'convnd' (one rank-4-spatial ConvGeneral) kept for per-backend A/B via
-NCNET_CONV4D_STRATEGY. All variants are fully vectorized and let XLA tile
-the inner contraction onto the MXU.
+decompositions (see `conv4d_prepadded`). The default ('auto') picks per
+layer: 'conv2d_stacked' (kI*kJ offsets folded into the conv input channels
+— one output write) for small-cin layers, and otherwise 'conv2d' (kI*kJ
+shifted **2-D** convolutions over (K, L) with (b, I, J) folded into the
+conv batch — TPU convs are natively 2-D). 'conv3d' (kI batched 3-D convs)
+and 'convnd' (one rank-4-spatial ConvGeneral) are kept for per-backend A/B
+via NCNET_CONV4D_STRATEGY. All variants are fully vectorized and let XLA
+tile the inner contraction onto the MXU.
 
 Weight layout is [kI, kJ, kK, kL, cin, cout] (TPU-friendly trailing
 channels); bias is [cout].
@@ -28,10 +29,12 @@ import jax.numpy as jnp
 from jax import lax
 
 # Default decomposition; override per-process with NCNET_CONV4D_STRATEGY
-# ('conv2d' | 'conv3d' | 'conv2d_stacked' | 'convnd' | 'auto' — 'auto'
-# picks conv2d_stacked for small fan-in layers, conv2d otherwise) to A/B
-# formulations on a given backend.
-_DEFAULT_STRATEGY = os.environ.get("NCNET_CONV4D_STRATEGY", "conv2d")
+# ('conv2d' | 'conv3d' | 'conv2d_stacked' | 'convnd' | 'auto').
+# 'auto' (default) picks conv2d_stacked for small-cin layers — a cin=1
+# layer otherwise pays kI*kJ partial-sum round trips of a cout-times-larger
+# f32 output through HBM, vs one kI*kJ-times-larger bf16 input
+# materialization — and the batched-2-D formulation otherwise.
+_DEFAULT_STRATEGY = os.environ.get("NCNET_CONV4D_STRATEGY", "auto")
 
 
 def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
@@ -41,8 +44,9 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     sharded halo-exchange variant (parallel/corr_sharding.py). Emits only
     the center I rows.
 
-    Four mathematically identical formulations, plus an 'auto' picker:
-      * 'conv2d' (default): kI*kJ shifted batched **2-D** convolutions over
+    Four mathematically identical formulations, plus an 'auto' picker
+    (the default):
+      * 'conv2d': kI*kJ shifted batched **2-D** convolutions over
         (K, L) with (b, I, J) folded into the conv batch. TPU convolutions
         are natively 2-D — this lowers straight onto the hardware conv path,
         whereas 3-D convs go through a generic lowering.
@@ -53,9 +57,9 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
         (wins for small cin).
       * 'convnd': one rank-4-spatial ConvGeneral op — the compiler owns the
         whole stencil.
-      * 'auto': per-layer pick — 'conv2d_stacked' when cin <= 2, else
-        'conv2d'.
-    Select per-backend via the NCNET_CONV4D_STRATEGY env var.
+      * 'auto' (default): per-layer pick — 'conv2d_stacked' when cin <= 2,
+        else 'conv2d'.
+    Override per-backend via the NCNET_CONV4D_STRATEGY env var.
 
     Args:
       x: [b, cin, I + 2*(kI//2), J, K, L].
