@@ -155,10 +155,23 @@ def main(argv=None):
         num_workers=args.num_workers, drop_last=True, batch_slice=batch_slice,
     )
 
-    ckpt_dir = os.path.join(
-        args.result_model_dir,
-        time.strftime("%Y-%m-%d_%H%M") + "_" + args.result_model_fn,
-    )
+    # Claim the run directory ATOMICALLY at launch (exist_ok=False):
+    # checkpoints are otherwise written lazily at end of epoch, so two runs
+    # started the same minute would silently interleave into one dir.
+    # Host 0 claims; other hosts never write (see _epoch_loop).
+    suffix = 0
+    while True:
+        name = time.strftime("%Y-%m-%d_%H%M") + "_" + args.result_model_fn
+        if suffix:
+            name += f"_{suffix + 1}"
+        ckpt_dir = os.path.join(args.result_model_dir, name)
+        if multihost.process_index() != 0:
+            break
+        try:
+            os.makedirs(ckpt_dir, exist_ok=False)
+            break
+        except FileExistsError:
+            suffix += 1
 
     from ..utils.profiling import trace_context
 
@@ -199,6 +212,7 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                     flush=True,
                 )
         train_loss = epoch_loss / max(n_batches, 1)
+        train_dt = time.time() - t0
 
         val_loss, n_val = 0.0, 0
         for batch in loader_val:
@@ -212,8 +226,10 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
             n_val += 1
         val_loss /= max(n_val, 1)
         dt = time.time() - t0
+        pairs_per_s = n_batches * args.batch_size / max(train_dt, 1e-9)
         print(
-            f"Epoch {epoch}: train {train_loss:.4f}  val {val_loss:.4f}  ({dt:.1f}s)",
+            f"Epoch {epoch}: train {train_loss:.4f}  val {val_loss:.4f}  "
+            f"({dt:.1f}s, train {pairs_per_s:.1f} pairs/s)",
             flush=True,
         )
         train_losses.append(train_loss)
