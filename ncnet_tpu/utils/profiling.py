@@ -137,6 +137,29 @@ def dial_devices(timeout: float):
     return out[0] if out else None
 
 
+def machine_tag() -> str:
+    """Short fingerprint of the host CPU feature set.
+
+    XLA:CPU AOT cache entries embed the compile machine's features; loading
+    them on a different machine warns and risks SIGILL. /tmp persists across
+    heterogeneous hosts in some setups, so the cache path must be
+    machine-specific.
+    """
+    import hashlib
+    import platform
+
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    tag += hashlib.sha1(line.encode()).hexdigest()[:8]
+                    break
+    except OSError:
+        pass
+    return tag
+
+
 def setup_compile_cache(path: str = ""):
     """Enable the persistent XLA compilation cache (minutes-long InLoc-shape
     compiles amortize across processes)."""
@@ -146,6 +169,10 @@ def setup_compile_cache(path: str = ""):
 
     jax.config.update(
         "jax_compilation_cache_dir",
-        path or os.environ.get("NCNET_TPU_COMPILE_CACHE", "/tmp/ncnet_tpu_jax_cache"),
+        path
+        or os.environ.get(
+            "NCNET_TPU_COMPILE_CACHE",
+            f"/tmp/ncnet_tpu_jax_cache_{os.getuid()}_{machine_tag()}",
+        ),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -23,18 +23,21 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-# Persistent compile cache (per-user path to avoid shared-machine
-# permission collisions): repeat suite runs reuse compiled programs.
-# Threshold 0 caches everything — the suite is made of many small programs
-# that individually compile fast but add up.
+# Persistent compile cache (per-user AND per-machine path: /tmp may persist
+# across heterogeneous hosts, and XLA:CPU AOT entries from another CPU type
+# warn and risk SIGILL). Threshold 0 caches everything — the suite is made
+# of many small programs that individually compile fast but add up.
 import tempfile
+
+from ncnet_tpu.utils.profiling import machine_tag
 
 jax.config.update(
     "jax_compilation_cache_dir",
     os.environ.get(
         "NCNET_TEST_COMPILE_CACHE",
         os.path.join(
-            tempfile.gettempdir(), f"ncnet_tpu_test_cache_{os.getuid()}"
+            tempfile.gettempdir(),
+            f"ncnet_tpu_test_cache_{os.getuid()}_{machine_tag()}",
         ),
     ),
 )
