@@ -48,23 +48,69 @@ def _write_dataset(root):
                 w.writerow([names[i], names[i + 1], 1, 0])
 
 
+def _run_pair(cmds_env, timeout):
+    """Launch the per-process commands, reap BOTH even when one fails —
+    a surviving peer otherwise blocks forever in the coordinator handshake
+    or a cross-process collective and leaks across retried runs."""
+    procs = [
+        subprocess.Popen(
+            cmd, cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for cmd, env in cmds_env
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return procs, outs
+
+
+def _proc_env(extra=None):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        **(extra or {}),
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+@pytest.mark.slow
+def test_two_process_sharded_consensus():
+    """The sharded match pipeline over a mesh spanning two PROCESSES: the
+    Conv4d halo exchange (ppermute) crosses the host boundary — the
+    DCN-analogue path. Each process pins its addressable shards against the
+    unsharded reference (tests/_mh_sharded_probe.py)."""
+    port = _free_port()
+    probe = os.path.join(REPO, "tests", "_mh_sharded_probe.py")
+    procs, outs = _run_pair(
+        [
+            ([sys.executable, probe, f"localhost:{port}", str(pid)],
+             _proc_env())
+            for pid in range(2)
+        ],
+        timeout=300,
+    )
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"probe failed:\n{out}"
+        assert "cross-host sharded consensus OK" in out
+
+
 @pytest.mark.slow
 def test_two_process_train(tmp_path):
     _write_dataset(tmp_path)
     port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=2",
-            JAX_COORDINATOR_ADDRESS=f"localhost:{port}",
-            JAX_NUM_PROCESSES="2",
-            JAX_PROCESS_ID=str(pid),
-        )
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        procs.append(
-            subprocess.Popen(
+    procs, outs = _run_pair(
+        [
+            (
                 [
                     sys.executable, "-m", "ncnet_tpu.cli.train",
                     "--dataset_image_path", str(tmp_path),
@@ -75,17 +121,17 @@ def test_two_process_train(tmp_path):
                     "--result_model_dir", str(tmp_path / f"models_h{pid}"),
                     "--num_workers", "0",
                 ],
-                cwd=REPO,
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
+                _proc_env({
+                    "JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
+                    "JAX_NUM_PROCESSES": "2",
+                    "JAX_PROCESS_ID": str(pid),
+                }),
             )
-        )
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        outs.append(out)
+            for pid in range(2)
+        ],
+        timeout=600,
+    )
+    for p, out in zip(procs, outs):
         assert p.returncode == 0, f"host process failed:\n{out}"
 
     # Both hosts saw the global mesh and agreed on every epoch loss.
