@@ -18,6 +18,7 @@ def main(argv=None):
         "--eval_dataset_path", type=str, default="datasets/pf-pascal/"
     )
     parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_workers", type=int, default=8)
     parser.add_argument("--alpha", type=float, default=0.1,
                         help="PCK threshold (paper reports @0.1; the reference "
                         "code's default was 0.15)")
@@ -31,7 +32,8 @@ def main(argv=None):
         output_size=(args.image_size, args.image_size),
         pck_procedure=args.pck_procedure,
     )
-    evaluate_pck(config, params, dataset, args.batch_size, args.alpha)
+    evaluate_pck(config, params, dataset, args.batch_size, args.alpha,
+                 num_workers=args.num_workers)
 
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ def main(argv=None):
     )
     parser.add_argument("--csv_file", type=str, default="test_pairs.csv")
     parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_workers", type=int, default=8)
     parser.add_argument("--alpha", type=float, default=0.1)
     args = parser.parse_args(argv)
 
@@ -28,7 +29,8 @@ def main(argv=None):
         args.eval_dataset_path,
         output_size=(args.image_size, args.image_size),
     )
-    evaluate_pck(config, params, dataset, args.batch_size, args.alpha)
+    evaluate_pck(config, params, dataset, args.batch_size, args.alpha,
+                 num_workers=args.num_workers)
 
 
 if __name__ == "__main__":

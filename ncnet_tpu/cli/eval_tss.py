@@ -28,6 +28,7 @@ def main(argv=None):
     parser.add_argument("--csv_file", type=str, default="test_pairs.csv")
     parser.add_argument("--flow_output_dir", type=str, default="datasets/tss/results/")
     parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_workers", type=int, default=8)
     args = parser.parse_args(argv)
 
     config, params = build_model(checkpoint=args.checkpoint)
@@ -36,7 +37,10 @@ def main(argv=None):
         args.eval_dataset_path,
         output_size=(args.image_size, args.image_size),
     )
-    loader = DataLoader(dataset, args.batch_size, shuffle=False, num_workers=8)
+    loader = DataLoader(
+        dataset, args.batch_size, shuffle=False,
+        num_workers=args.num_workers,
+    )
 
     @jax.jit
     def step(params, source, target):
