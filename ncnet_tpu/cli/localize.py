@@ -60,6 +60,10 @@ def main(argv=None):
     p.add_argument("--ransac_iters", type=int, default=10000)
     p.add_argument("--top_n", type=int, default=10)
     p.add_argument("--pose_verification", action="store_true")
+    p.add_argument(
+        "--num_workers", type=int, default=1,
+        help="localize queries concurrently (the reference's Matlab parfor)",
+    )
     p.add_argument("--gt_poses", default="", help=".mat/.npz of ground-truth poses for curves")
     args = p.parse_args(argv)
 
@@ -72,7 +76,9 @@ def main(argv=None):
 
     query_index = {q: i for i, q in enumerate(order)}
 
-    @functools.lru_cache(maxsize=2)
+    # Sized to the worker count: each in-flight query re-reads its match
+    # file once per pano if evicted mid-query.
+    @functools.lru_cache(maxsize=max(2, 2 * args.num_workers))
     def load_query_matches(q):
         qi = query_index[q] + 1  # match files are written 1-indexed per query
         return np.asarray(loadmat(os.path.join(args.matches_dir, f"{qi}.mat"))["matches"])
@@ -140,6 +146,7 @@ def main(argv=None):
         cache_dir=os.path.join(args.output_dir, "pnp_cache"),
         load_query_image=load_query_image if args.pose_verification else None,
         progress=lambda q: print(f"localized: {q}", flush=True),
+        num_workers=args.num_workers,
     )
 
     poses_path = os.path.join(args.output_dir, "poses.npz")

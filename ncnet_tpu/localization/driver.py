@@ -70,8 +70,9 @@ def localize_queries(
     cache_dir: Optional[str] = None,
     load_query_image: Optional[Callable[[str], np.ndarray]] = None,
     progress: Optional[Callable[[str], None]] = None,
+    num_workers: int = 1,
 ) -> list:
-    """Localize every query; returns a list of QueryResult.
+    """Localize every query; returns a list of QueryResult (query order kept).
 
     shortlist(q)        -> ranked pano names for query q.
     load_matches(q, j)  -> [n, 5] match rows for q's j-th pano.
@@ -79,12 +80,25 @@ def localize_queries(
                            — plus optionally a third element rgb [H, W, 3]
                            when pose verification is enabled.
     query_size(q)       -> (height, width) of the query image.
+    num_workers > 1 localizes queries concurrently (the reference's Matlab
+    `parfor` over queries, ir_top100_NC4D_localization_pnponly.m:25): the
+    numpy/native stages release the GIL, callbacks must be thread-safe, and
+    per-(query, pano) cache paths are disjoint so the resume cache is safe.
     """
     do_pv = params.use_pose_verification and load_query_image is not None
-    results = []
-    for q in queries:
+
+    def localize_one(q: str) -> QueryResult:
         panos = list(shortlist(q))[: params.top_n]
         q_img = load_query_image(q) if do_pv else None
+        # One size lookup per query (the CLI's query_size decodes the image).
+        q_size = q_img.shape[:2] if q_img is not None else None
+
+        def get_query_size():
+            nonlocal q_size
+            if q_size is None:
+                q_size = query_size(q)
+            return q_size
+
         poses, ninl, pv_scores = [], [], []
         for j, pano in enumerate(panos):
             # Each pano's cutout is loaded at most once and shared between
@@ -107,7 +121,7 @@ def localize_queries(
                 corr = matches_to_2d3d(
                     load_matches(q, j),
                     xyz,
-                    query_size(q),
+                    get_query_size(),
                     focal_length,
                     scan_transform=transform,
                     score_thr=params.score_thr,
@@ -142,12 +156,20 @@ def localize_queries(
 
         solved = [j for j in range(len(panos)) if np.all(np.isfinite(poses[j]))]
         best = max(solved, key=lambda j: ranking[j]) if solved else -1
-        results.append(
-            QueryResult(query=q, poses=poses, num_inliers=ninl, pv_scores=pv_scores, best_index=best)
+        result = QueryResult(
+            query=q, poses=poses, num_inliers=ninl,
+            pv_scores=pv_scores, best_index=best,
         )
         if progress is not None:
             progress(q)
-    return results
+        return result
+
+    if num_workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(num_workers) as pool:
+            return list(pool.map(localize_one, queries))
+    return [localize_one(q) for q in queries]
 
 
 def evaluate_poses(results: Sequence[QueryResult], gt_poses: dict) -> tuple:

@@ -238,3 +238,20 @@ class TestDriver:
         pos_e, ori_e = evaluate_poses(results, {"q1": P_gt})
         rates = localization_rate(pos_e, ori_e, np.array([0.25]))
         assert rates[0] == 1.0
+
+        # Parallel (num_workers > 1, the reference's parfor-over-queries)
+        # must give identical results in query order.
+        many = [f"q{i}" for i in range(5)]
+        par = localize_queries(
+            queries=many,
+            shortlist=lambda q: ["pano_a"],
+            load_matches=lambda q, j: m,
+            load_cutout=lambda p: (world, None),
+            query_size=lambda q: (hq, wq),
+            focal_length=fl,
+            params=LocalizationParams(ransac_iters=300, top_n=1),
+            num_workers=3,
+        )
+        assert [r.query for r in par] == many
+        for r in par:
+            assert np.allclose(r.best_pose, results[0].best_pose)
