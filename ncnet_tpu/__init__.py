@@ -9,7 +9,8 @@ correlation ops have Pallas TPU kernels, and scaling is expressed through
 
 Layer map (mirrors SURVEY.md §1, re-architected):
 
-    cli/        entry points (train, eval_pf_pascal, eval_pf_willow, eval_tss, eval_inloc)
+    cli/        entry points (train, eval_pf_pascal, eval_pf_willow, eval_tss,
+                eval_inloc, localize)
     evals/      metrics and match-file writers (PCK, flow, InLoc .mat)
     models/     backbones (ResNet-101 / VGG-16 in flax) + the NCNet model
     ops/        correlation / mutual matching / Conv4d / maxpool4d / match extraction
@@ -17,7 +18,8 @@ Layer map (mirrors SURVEY.md §1, re-architected):
     geometry/   affine & TPS grid generation, bilinear sampling, point transforms, .flo I/O
     data/       CSV pair datasets, normalization, host-side prefetching loader
     parallel/   mesh construction, data-parallel training step, corr-tensor sharding
-    training/   weak-supervision loss, optax train state, orbax checkpointing
+    training/   weak-supervision loss, optax train state, self-describing
+                checkpoints (config + params + optimizer state)
     localization/  InLoc-style PnP localization (batched P3P LO-RANSAC, point-cloud
                 rendering, dense-rootSIFT pose verification, rate curves) — the
                 Python/JAX-native replacement for the reference's Matlab L5 layer
