@@ -1,0 +1,43 @@
+"""Execute the point-transfer demo notebook end to end.
+
+Notebooks rot silently; the .py twin is tested elsewhere, but the .ipynb
+has its own cell code. nbconvert executes it against a fresh kernel in a
+temp cwd (the notebook synthesizes its own warped pair, no datasets).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NOTEBOOK = os.path.join(REPO, "examples", "point_transfer_demo.ipynb")
+
+
+@pytest.mark.slow
+def test_demo_notebook_executes(tmp_path):
+    out_path = tmp_path / "executed.ipynb"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "nbconvert", "--to", "notebook",
+            "--execute", "--output", str(out_path), NOTEBOOK,
+        ],
+        cwd=tmp_path,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    nb = json.loads(out_path.read_text())
+    errors = [
+        o
+        for c in nb["cells"]
+        for o in c.get("outputs", [])
+        if o.get("output_type") == "error"
+    ]
+    assert not errors, errors[0]
