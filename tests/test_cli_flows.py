@@ -100,6 +100,31 @@ def test_train_resume_restores_opt_state(pf_dir, capsys):
     assert f"restored optimizer state from {ckpt}" in out
 
 
+def test_train_finetune_cli_and_resume(pf_dir, capsys):
+    """--fe_finetune_params > 0 end to end: the backbone joins the trainable
+    set (multi_transform optimizer), checkpoints carry the larger opt state,
+    and resuming with the same flag restores it (the mismatch case is
+    covered at the unit level)."""
+    common = [
+        "--dataset_image_path", str(pf_dir),
+        "--dataset_csv_path", str(pf_dir / "image_pairs"),
+        "--num_epochs", "1", "--batch_size", "2", "--image_size", "64",
+        "--backbone", "vgg", "--ncons_kernel_sizes", "3",
+        "--ncons_channels", "1", "--num_workers", "0",
+        "--fe_finetune_params", "1",
+    ]
+    train_cli.main(common + ["--result_model_dir", str(pf_dir / "ft1")])
+    run = os.listdir(pf_dir / "ft1")[0]
+    ckpt = pf_dir / "ft1" / run / "best"
+    assert (ckpt / "opt_state.npz").exists()
+    train_cli.main(
+        common
+        + ["--result_model_dir", str(pf_dir / "ft2"), "--checkpoint", str(ckpt)]
+    )
+    out = capsys.readouterr().out
+    assert f"restored optimizer state from {ckpt}" in out
+
+
 def test_eval_pf_willow_cli(tmp_path, capsys):
     """PF-Willow CLI end to end on a synthetic Willow-layout dataset
     (CSV: imA, imB, XA;-list, YA;-list, XB;-list, YB;-list — 10 points)."""
