@@ -57,6 +57,7 @@ def main():
         # (name, shape [b,cin,I,J,K,L], kernel, cout, dtype)
         ("inloc-l1", (1, 1, ii, jj, ii, jj), 3, 16, jnp.bfloat16),
         ("inloc-l2", (1, 16, ii, jj, ii, jj), 3, 1, jnp.bfloat16),
+        ("pfpascal-l1", (1, 1, 25, 25, 25, 25), 5, 16, jnp.float32),
         ("pfpascal-l2", (1, 16, 25, 25, 25, 25), 5, 16, jnp.float32),
     ]
 
