@@ -67,12 +67,16 @@ def main():
     on_tpu = dev.platform != "cpu"
     note(f"backend up: {dev}")
 
-    # InLoc configuration (SURVEY.md §3.3); on CPU smoke runs, shrink.
+    # InLoc configuration (SURVEY.md §3.3); on CPU smoke runs, shrink
+    # (NCNET_BENCH_SMOKE_SIZE overrides the smoke size — used by the
+    # bench-contract test to keep the whole path fast).
     if on_tpu:
         h_a, w_a = 3200, 2400  # query  -> 200x150 features
         h_b, w_b = 3200, 2400  # pano
     else:
-        h_a = w_a = h_b = w_b = 512
+        h_a = w_a = h_b = w_b = int(
+            os.environ.get("NCNET_BENCH_SMOKE_SIZE", "512")
+        )
 
     def build(fused: bool):
         config = NCNetConfig(

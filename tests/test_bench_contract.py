@@ -1,0 +1,42 @@
+"""bench.py output contract: exactly one JSON line with the driver's keys.
+
+The round driver records bench.py stdout as the benchmark result; a stray
+print or a changed key silently breaks the recording. Runs the real bench
+end to end on CPU at a tiny smoke size.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_emits_one_json_line():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        NCNET_BENCH_SMOKE_SIZE="96",
+        NCNET_BENCH_DIAL_TIMEOUT="60",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = [l for l in res.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    assert rec["metric"].startswith("inloc_dense_match_pairs_per_s_per_chip")
+    assert rec["value"] > 0
