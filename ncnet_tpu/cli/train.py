@@ -18,6 +18,7 @@ import os
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..data import ImagePairDataset, DataLoader
@@ -79,18 +80,53 @@ def main(argv=None):
         seed=args.seed,
     )
 
+    # --fe_finetune_params N fine-tunes the backbone's last N blocks, as in
+    # the reference (lib/model.py:75-78 unfreezes the last N parameter
+    # groups); N=0 keeps the backbone frozen.
     state, tx = create_train_state(
-        params, learning_rate=args.lr, train_fe=args.fe_finetune_params > 0
+        params,
+        learning_rate=args.lr,
+        train_fe=args.fe_finetune_params > 0,
+        fe_finetune_blocks=max(args.fe_finetune_params, 1),
     )
     # Resume the optimizer state alongside the params (the reference saves
     # it but never restores it, train.py:203 — a defect not replicated).
     # load_opt_state reads only opt_state.npz (params were already restored
     # by build_model) and raises a clear error on an optimizer mismatch.
+    restored_opt = None
+    restore_err = None
     if args.checkpoint and os.path.isdir(args.checkpoint):
-        restored_opt = load_opt_state(args.checkpoint, state.opt_state)
+        try:
+            restored_opt = load_opt_state(args.checkpoint, state.opt_state)
+        except Exception as exc:  # noqa: BLE001 — re-raised below, after the
+            # collective: a host raising here BEFORE the allgather would
+            # leave its peers blocked in the collective forever.
+            restore_err = exc
         if restored_opt is not None:
             state.opt_state = restored_opt
             print(f"restored optimizer state from {args.checkpoint}")
+    # Multi-host: without a shared filesystem, the checkpoint dir (or just
+    # opt_state.npz) may exist on only some hosts — host 0 would resume Adam
+    # moments while others start fresh, silently diverging the replicated
+    # state. Fail loudly on partial restoration instead. The allgather is a
+    # collective, so it must run on EVERY host — unconditionally of whether
+    # this host found the directory (args.checkpoint itself is identical
+    # across hosts: same command line everywhere).
+    if args.checkpoint and multihost.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # -1 = restore raised, 0 = no opt state found, 1 = restored.
+        status = -1 if restore_err is not None else int(restored_opt is not None)
+        flags = multihost_utils.process_allgather(jnp.int32(status))
+        if int(flags.min()) != int(flags.max()):
+            raise SystemExit(
+                "optimizer-state restore disagrees across hosts "
+                f"(per-host status, -1=error 0=absent 1=restored: "
+                f"{list(map(int, flags))}); make the checkpoint directory "
+                "visible to every host or remove opt_state.npz everywhere"
+            ) from restore_err
+    if restore_err is not None:
+        raise restore_err
     train_step, eval_step = make_train_step(config, tx, remat_backbone=args.remat_backbone)
 
     # Use the largest device count that divides the batch (single-host);

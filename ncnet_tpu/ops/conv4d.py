@@ -28,13 +28,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-# Default decomposition; override per-process with NCNET_CONV4D_STRATEGY
+# Default decomposition; override with NCNET_CONV4D_STRATEGY
 # ('conv2d' | 'conv3d' | 'conv2d_stacked' | 'convnd' | 'auto').
 # 'auto' (default) picks conv2d_stacked for small-cin layers — a cin=1
 # layer otherwise pays kI*kJ partial-sum round trips of a cout-times-larger
 # f32 output through HBM, vs one kI*kJ-times-larger bf16 input
 # materialization — and the batched-2-D formulation otherwise.
-_DEFAULT_STRATEGY = os.environ.get("NCNET_CONV4D_STRATEGY", "auto")
+# The env var is read at CALL (trace) time, so setting it after import
+# works; already-compiled jits keep the strategy they were traced with.
+_DEFAULT_STRATEGY = "auto"
 
 
 def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
@@ -70,7 +72,7 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
       [b, cout, I, J, K, L].
     """
     if strategy is None:
-        strategy = _DEFAULT_STRATEGY
+        strategy = os.environ.get("NCNET_CONV4D_STRATEGY", _DEFAULT_STRATEGY)
     if strategy == "auto":
         # Per-layer heuristic: fold the kI*kJ offsets into input channels
         # when cin is small — the stacked input then stays a small multiple

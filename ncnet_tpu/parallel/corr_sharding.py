@@ -126,7 +126,8 @@ def match_pipeline_sharded(params, corr_local, axis_name: str, symmetric: bool =
 
 
 def make_sharded_match_pipeline(
-    mesh: Mesh, axis_name: str = "sp", symmetric: bool = True
+    mesh: Mesh, axis_name: str = "sp", symmetric: bool = True,
+    batch_axis: str | None = None,
 ):
     """Build a jit-able sharded pipeline over a mesh.
 
@@ -135,8 +136,13 @@ def make_sharded_match_pipeline(
     mesh 'sp' axis size (it carries the sharding) — the InLoc input
     bucketing (cli/eval_inloc.py) guarantees this. Input/output shardings:
     corr split on dim 2, params replicated.
+
+    batch_axis: optional second mesh axis carrying the batch dim (dp x sp on
+    one 2-D mesh: pairs across 'dp', each pair's iA rows across 'sp'). Batch
+    entries are independent, so every collective (pmax, halo ppermute) still
+    runs over axis_name only.
     """
-    spec_corr = P(None, None, axis_name, None, None, None)
+    spec_corr = P(batch_axis, None, axis_name, None, None, None)
 
     @partial(
         shard_map,

@@ -81,9 +81,37 @@ def make_sharded_inloc_parts(config: NCNetConfig, mesh: Mesh, axis_name: str = "
     def query_features(params, image):
         return extract_features(config, params, image)
 
+    n_shards = mesh.shape[axis_name]
+
+    def _check_shapes(feat_a, feat_b):
+        # Trace-time (shapes are static): a non-conforming iA would otherwise
+        # surface as an opaque shard_map divisibility error — or worse,
+        # silently truncate rows in the kernel's `ia // k` cell math.
+        b, _, ia, ja = feat_a.shape
+        ib, jb = feat_b.shape[2:]
+        if b != 1:
+            raise ValueError(f"sharded InLoc forward requires batch 1, got {b}")
+        if ia % (n_shards * k):
+            raise ValueError(
+                f"feature height iA={ia} must be divisible by mesh size x "
+                f"relocalization_k_size = {n_shards}*{k}={n_shards * k}; pad "
+                "the input image so the feature height conforms "
+                "(cli/eval_inloc.py's load_inloc_image(extra_align=mesh_size)"
+                " buckets inputs this way)"
+            )
+        bad = {
+            name: v for name, v in (("jA", ja), ("iB", ib), ("jB", jb)) if v % k
+        }
+        if bad:
+            raise ValueError(
+                f"feature dims {bad} must be divisible by "
+                f"relocalization_k_size={k}"
+            )
+
     @jax.jit
     def forward_from_features(params, feat_a, target_image):
         feat_b = extract_features(config, params, target_image)
+        _check_shapes(feat_a, feat_b)
         feat_a = lax.with_sharding_constraint(
             feat_a, NamedSharding(mesh, spec_fa)
         )

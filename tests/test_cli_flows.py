@@ -125,6 +125,35 @@ def test_train_finetune_cli_and_resume(pf_dir, capsys):
     assert f"restored optimizer state from {ckpt}" in out
 
 
+def test_train_cli_passes_finetune_blocks(pf_dir, monkeypatch):
+    """--fe_finetune_params N must reach create_train_state as
+    fe_finetune_blocks=N (N>1 silently collapsed to 1 in round 1)."""
+    captured = {}
+
+    class _Stop(Exception):
+        pass
+
+    def spy(params, **kwargs):
+        captured.update(kwargs)
+        raise _Stop
+
+    monkeypatch.setattr(train_cli, "create_train_state", spy)
+    with pytest.raises(_Stop):
+        train_cli.main(
+            [
+                "--dataset_image_path", str(pf_dir),
+                "--dataset_csv_path", str(pf_dir / "image_pairs"),
+                "--num_epochs", "1", "--batch_size", "2", "--image_size", "64",
+                "--backbone", "vgg", "--ncons_kernel_sizes", "3",
+                "--ncons_channels", "1", "--num_workers", "0",
+                "--result_model_dir", str(pf_dir / "m"),
+                "--fe_finetune_params", "3",
+            ]
+        )
+    assert captured["train_fe"] is True
+    assert captured["fe_finetune_blocks"] == 3
+
+
 def test_eval_pf_willow_cli(tmp_path, capsys):
     """PF-Willow CLI end to end on a synthetic Willow-layout dataset
     (CSV: imA, imB, XA;-list, YA;-list, XB;-list, YB;-list — 10 points)."""

@@ -58,6 +58,76 @@ def _run(fixture_dir, size=64):
     return out_dir / exp[0]
 
 
+def test_write_matches_mat_reference_contract(tmp_path):
+    """Key-by-key contract with the reference writer (eval_inloc.py:126,
+    199-221): the unchanged Matlab pipeline must see identical field names,
+    dtypes, shapes, and values from both writers.
+
+    The reference side is generated here with scipy from the reference
+    code's documented layout: float64 `matches` [1, Npanos, N, 5] filled
+    rows-first with (xA, yA, xB, yB, score), `query_fn` str,
+    `pano_fn` object array, do_compression=True.
+    """
+    from scipy.io import savemat as scipy_savemat
+
+    from ncnet_tpu.evals.inloc import (
+        fill_matches,
+        matches_buffer,
+        write_matches_mat,
+    )
+
+    rng = np.random.default_rng(3)
+    n_panos, n_cap = 3, 7
+    pano_fn_all = np.vstack(
+        [
+            np.array([f"pano_{q}_{i}.jpg" for i in range(n_panos)], dtype=object
+                     ).reshape(1, -1)
+            for q in range(2)
+        ]
+    )
+
+    # Reference writer emulation (eval_inloc.py:126,199-203,221).
+    matches_ref = np.zeros((1, n_panos, n_cap, 5))
+    per_pano = []
+    for idx in range(n_panos):
+        npts = [5, 7, 0][idx]  # fewer-than-N, exactly-N, and empty panos
+        tup = tuple(rng.random(npts) for _ in range(5))
+        per_pano.append(tup)
+        xa, ya, xb, yb, score = tup
+        if npts > 0:
+            matches_ref[0, idx, :npts, 0] = xa
+            matches_ref[0, idx, :npts, 1] = ya
+            matches_ref[0, idx, :npts, 2] = xb
+            matches_ref[0, idx, :npts, 3] = yb
+            matches_ref[0, idx, :npts, 4] = score
+    ref_path = tmp_path / "ref" / "1.mat"
+    os.makedirs(ref_path.parent)
+    scipy_savemat(
+        ref_path,
+        {"matches": matches_ref, "query_fn": "q0.jpg", "pano_fn": pano_fn_all},
+        do_compression=True,
+    )
+
+    # Our writer on the same data.
+    buf = matches_buffer(n_panos, n_cap)
+    for idx, tup in enumerate(per_pano):
+        fill_matches(buf, idx, tup)
+    ours_path = tmp_path / "ours" / "1.mat"
+    write_matches_mat(str(ours_path), buf, "q0.jpg", pano_fn_all)
+
+    ref = loadmat(ref_path)
+    ours = loadmat(ours_path)
+    ref_keys = {k for k in ref if not k.startswith("__")}
+    assert {k for k in ours if not k.startswith("__")} == ref_keys
+    for k in sorted(ref_keys):
+        assert ours[k].dtype == ref[k].dtype, k
+        assert ours[k].shape == ref[k].shape, k
+        if ref[k].dtype == object:
+            np.testing.assert_array_equal(ours[k], ref[k])
+        else:
+            np.testing.assert_array_equal(ours[k], ref[k], err_msg=k)
+
+
 def test_inloc_resize_shape_alignment():
     """Pin the reference's resize-alignment arithmetic (eval_inloc.py:84-89):
     long side scaled to ~image_size with feature dims (stride 16) divisible

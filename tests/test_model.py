@@ -248,6 +248,34 @@ def test_finetune_mask_excludes_bn_stats(rng):
     )
 
 
+def test_finetune_blocks_n2_unfreezes_two_blocks(rng):
+    """fe_finetune_blocks=2 must fine-tune the last TWO blocks (reference
+    --fe_finetune_params N semantics), not just the last one."""
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.training import create_train_state, make_train_step
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="resnet50", last_layer="layer1"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    state, tx = create_train_state(params, train_fe=True, fe_finetune_blocks=2)
+    train_step, _ = make_train_step(config, tx)
+    src = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    old_bb = jax.tree.map(np.asarray, state.trainable["backbone"])
+    new_t, _, _ = train_step(state.trainable, state.frozen, state.opt_state, src, tgt)
+
+    new_bb = new_t["backbone"]
+    assert not np.allclose(old_bb["layer1"][-1]["conv2"], new_bb["layer1"][-1]["conv2"])
+    assert not np.allclose(old_bb["layer1"][-2]["conv2"], new_bb["layer1"][-2]["conv2"])
+    # resnet50 layer1 has 3 blocks; the first stays frozen
+    np.testing.assert_array_equal(
+        np.asarray(old_bb["layer1"][0]["conv2"]), np.asarray(new_bb["layer1"][0]["conv2"])
+    )
+
+
 def test_weak_loss_feature_roll_equals_image_roll(rng):
     """Rolling features == rolling images through the per-image backbone.
 

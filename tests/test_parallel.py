@@ -156,6 +156,97 @@ def test_sharded_inloc_forward_matches_single_device():
         np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
 
 
+@requires_multi
+def test_sharded_inloc_forward_bad_shape_raises():
+    """Feature height not divisible by mesh*k must fail with a clear error
+    at trace time, never an opaque shard_map message or silent truncation."""
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.parallel import make_mesh, make_sharded_inloc_forward
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+        relocalization_k_size=2,
+        use_fused_corr_pool=True,
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    mesh = make_mesh((4,), ("sp",))
+    fwd = make_sharded_inloc_forward(config, mesh)
+    # pool3 stride 8: 72 -> features 9, not divisible by n*k = 8.
+    src = jnp.zeros((1, 3, 72, 128))
+    tgt = jnp.zeros((1, 3, 128, 128))
+    with pytest.raises(ValueError, match="divisible by mesh size"):
+        fwd(params, src, tgt)
+    # B-side dims only need divisibility by k.
+    tgt_bad = jnp.zeros((1, 3, 128, 72))  # jB = 9
+    src_ok = jnp.zeros((1, 3, 128, 128))
+    with pytest.raises(ValueError, match="relocalization_k_size"):
+        fwd(params, src_ok, tgt_bad)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_dp_sp_combined_mesh_pipeline(rng):
+    """dp x sp on ONE 2x4 mesh: pairs sharded across 'dp', each pair's iA
+    rows across 'sp' — the combined layout of SURVEY §2.8 items 1+2."""
+    mesh = make_mesh((2, 4), ("dp", "sp"))
+    params = neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (6, 1))
+    corr = jnp.asarray(rng.randn(2, 1, 8, 5, 6, 7).astype(np.float32))
+
+    ref = mutual_matching(
+        neigh_consensus_apply(params, mutual_matching(corr), symmetric=True)
+    )
+
+    pipeline = make_sharded_match_pipeline(
+        mesh, "sp", symmetric=True, batch_axis="dp"
+    )
+    corr_sharded = jax.device_put(
+        corr, NamedSharding(mesh, P("dp", None, "sp", None, None, None))
+    )
+    out = pipeline(params, corr_sharded)
+    assert out.sharding.is_equivalent_to(
+        NamedSharding(mesh, P("dp", None, "sp", None, None, None)), out.ndim
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_train_step_on_2d_mesh(rng):
+    """The dp train step runs unchanged on a 2-D (2x4) mesh with the batch
+    sharded over BOTH axes, matching single-device numerics."""
+    from ncnet_tpu.models import NCNetConfig, BackboneConfig, ncnet_init
+    from ncnet_tpu.training import create_train_state, make_train_step
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    src = jnp.asarray(rng.randn(8, 3, 32, 32).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(8, 3, 32, 32).astype(np.float32))
+
+    state, tx = create_train_state(params, learning_rate=1e-3)
+    train_step, _ = make_train_step(config, tx)
+
+    copy = lambda t: jax.tree.map(lambda x: jnp.array(x, copy=True), t)
+    t1, _, loss_single = train_step(
+        copy(state.trainable), state.frozen, copy(state.opt_state), src, tgt
+    )
+
+    mesh = make_mesh((2, 4), ("dp", "sp"))
+    sharding = NamedSharding(mesh, P(("dp", "sp")))
+    rep = NamedSharding(mesh, P())
+    put_rep = lambda t: jax.tree.map(lambda x: jax.device_put(x, rep), t)
+    t2, _, loss_2d = train_step(
+        put_rep(state.trainable), put_rep(state.frozen), put_rep(state.opt_state),
+        jax.device_put(src, sharding), jax.device_put(tgt, sharding),
+    )
+    np.testing.assert_allclose(float(loss_single), float(loss_2d), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(t1), jax.tree.leaves(t2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_multihost_helpers_single_host():
     """Single-host semantics: initialize() no-ops, mesh spans all devices,
     the host-local slice is the full batch."""

@@ -31,6 +31,7 @@ def main():
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--backbone", type=str, default="resnet101")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--dial_timeout", type=float, default=600.0)
     args = p.parse_args()
 
     import jax
@@ -47,7 +48,15 @@ def main():
     from ncnet_tpu.utils.profiling import setup_compile_cache
 
     setup_compile_cache()
-    n_dev = len(jax.devices())
+    # Dial under a watchdog: a wedged axon tunnel blocks jax.devices()
+    # forever (same policy as bench.py / the other tools).
+    from ncnet_tpu.utils.profiling import dial_devices
+
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        print("backend dial timed out; aborting", file=sys.stderr)
+        return 2
+    n_dev = len(devices)
     # Largest device count dividing the batch (same rule as cli/train.py).
     dp = max(d for d in range(1, n_dev + 1) if args.batch % d == 0)
     mesh = make_mesh((dp,), ("dp",))
@@ -106,4 +115,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

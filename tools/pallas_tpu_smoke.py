@@ -1,0 +1,117 @@
+"""On-TPU smoke test for the fused correlation+maxpool Pallas kernel.
+
+Compiles `fused_correlation_maxpool_pallas` under the REAL Mosaic compiler
+(the CPU test suite can only exercise interpret mode) and checks it against
+the slab-scan XLA oracle at a small shape first (fast compile-failure
+signal), then at the full InLoc shape (200x150 features, c=1024, k=2,
+bf16 storage — the workload of the reference's eval_inloc.py:124-137).
+
+Prints PASS/FAIL per shape; exit code 0 only if all pass.
+
+Usage (TPU must be reachable):
+    python tools/pallas_tpu_smoke.py [--dial_timeout 600]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dial_timeout", type=float, default=600.0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ncnet_tpu.ops.pallas_kernels import (
+        fused_correlation_maxpool_pallas,
+        fused_correlation_maxpool_xla,
+    )
+    from ncnet_tpu.utils.profiling import dial_devices, setup_compile_cache
+
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        log("backend dial timed out; aborting")
+        return 2
+    dev = devices[0]
+    log(f"backend up: {dev}")
+    if dev.platform == "cpu":
+        log("CPU backend: Mosaic not exercised, nothing to smoke-test here")
+        return 2
+
+    # (name, c, IA, JA, IB, JB) — small first so a Mosaic lowering failure
+    # surfaces in seconds, then the full InLoc query x pano shape.
+    cases = [
+        ("small 40x30", 64, 40, 30, 40, 30),
+        ("inloc 200x150", 1024, 200, 150, 200, 150),
+    ]
+    failures = 0
+    for name, c, ia, ja, ib, jb in cases:
+        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        fa = jax.random.normal(k1, (1, c, ia, ja), jnp.float32)
+        fb = jax.random.normal(k2, (1, c, ib, jb), jnp.float32)
+        try:
+            log(f"{name}: compiling Pallas kernel (Mosaic)...")
+            run = jax.jit(
+                lambda a, b: fused_correlation_maxpool_pallas(
+                    a, b, k_size=2, corr_dtype=jnp.bfloat16
+                )
+            )
+            pooled_p, deltas_p = jax.tree.map(np.asarray, run(fa, fb))
+            log(f"{name}: Pallas compiled+ran; running XLA oracle...")
+            oracle = jax.jit(
+                lambda a, b: fused_correlation_maxpool_xla(
+                    a, b, k_size=2, corr_dtype=jnp.bfloat16
+                )
+            )
+            pooled_x, deltas_x = jax.tree.map(np.asarray, oracle(fa, fb))
+        except Exception as exc:  # noqa: BLE001
+            log(f"{name}: FAIL ({type(exc).__name__}: {exc})")
+            failures += 1
+            continue
+
+        perr = float(
+            np.max(np.abs(pooled_p.astype(np.float32) - pooled_x.astype(np.float32)))
+        )
+        # Argmax deltas: exact except where bf16 rounding creates ties
+        # (first-wins order then differs between the two pooling orders).
+        dmis = max(
+            float(np.mean(dp != dx)) for dp, dx in zip(deltas_p, deltas_x)
+        )
+        ok = perr <= 0.05 and dmis <= 1e-3
+        log(
+            f"{name}: {'PASS' if ok else 'FAIL'} "
+            f"pooled_max_abs_err={perr:.4g} delta_mismatch_frac={dmis:.2e}"
+        )
+        failures += 0 if ok else 1
+
+        # Timing at the InLoc shape: Pallas vs the slab-scan oracle.
+        if "inloc" in name and failures == 0:
+            for label, fn in (("pallas", run), ("xla_slab", oracle)):
+                fn(fa, fb)  # warm
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    out = fn(fa, fb)
+                    jax.block_until_ready(out)
+                    float(jnp.sum(out[0][0]))  # force through the tunnel
+                log(f"{name}: {label} {(time.perf_counter() - t0) / 5 * 1e3:.1f} ms/call")
+
+    log(f"{'ALL PASS' if failures == 0 else f'{failures} FAILURES'}")
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
