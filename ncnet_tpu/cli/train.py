@@ -232,22 +232,29 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
 
     for epoch in range(1, args.num_epochs + 1):
         t0 = time.time()
-        epoch_loss, n_batches = 0.0, 0
+        losses = []
         # One batch in flight: H2D transfer of batch i+1 overlaps step i.
+        # Losses stay DEVICE scalars inside the loop — float() would force a
+        # full sync every step, serializing dispatch; on a tunneled backend
+        # that costs a round trip per batch. The sync happens only at log
+        # points (per batch at the default --log_interval 1, matching the
+        # reference's per-batch print; raise it to unlock async dispatch).
         for i, batch in enumerate(device_prefetch(loader, put)):
             trainable, opt_state, loss = train_step(
                 trainable, state.frozen, opt_state,
                 batch["source_image"], batch["target_image"],
             )
-            loss = float(loss)
-            epoch_loss += loss
-            n_batches += 1
             if i % args.log_interval == 0:
+                loss = float(loss)  # the only fetch of this scalar
                 print(
-                    f"Train epoch {epoch} [{i}/{len(loader)}]\tloss: {loss:.6f}",
+                    f"Train epoch {epoch} [{i}/{len(loader)}]\tloss: "
+                    f"{loss:.6f}",
                     flush=True,
                 )
-        train_loss = epoch_loss / max(n_batches, 1)
+            losses.append(loss)
+        train_loss = (
+            float(np.mean([float(l) for l in losses])) if losses else 0.0
+        )
         train_dt = time.time() - t0
 
         val_loss, n_val = 0.0, 0
@@ -262,7 +269,7 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
             n_val += 1
         val_loss /= max(n_val, 1)
         dt = time.time() - t0
-        pairs_per_s = n_batches * args.batch_size / max(train_dt, 1e-9)
+        pairs_per_s = len(losses) * args.batch_size / max(train_dt, 1e-9)
         print(
             f"Epoch {epoch}: train {train_loss:.4f}  val {val_loss:.4f}  "
             f"({dt:.1f}s, train {pairs_per_s:.1f} pairs/s)",

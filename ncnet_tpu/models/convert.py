@@ -28,6 +28,10 @@ import numpy as np
 
 from .backbone import BackboneConfig, DENSENET_SPECS, RESNET_SPECS
 
+# torchvision vgg16.features conv-layer indices (pools between); the
+# truncated reference model keeps the same indices (lib/model.py:35).
+VGG_TORCH_CONV_INDICES = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+
 
 def _np(x) -> np.ndarray:
     if hasattr(x, "detach"):
@@ -108,7 +112,7 @@ def convert_vgg_state_dict(
     24,26,28 with pools between; the truncated reference model keeps the same
     indices (lib/model.py:35).
     """
-    conv_indices = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]
+    conv_indices = VGG_TORCH_CONV_INDICES
     layers = []
     ci = 0
     for name, cin, cout in config.vgg_layers:
@@ -218,7 +222,22 @@ def load_reference_checkpoint(path: str):
         and any(k.startswith(fe_prefix + "0.weight") for k in sd)
         and not any(".layer3." in k or k.startswith(fe_prefix + "4.") for k in sd)
     )
-    if is_densenet:
+    # Files written by export_reference_checkpoint carry the backbone arch
+    # in the Namespace (feature_extraction_cnn / fe_last_layer — extra
+    # fields the reference's restore ignores; the name matches ImMatchNet's
+    # constructor kwarg, lib/model.py:195). Without them the published-
+    # checkpoint heuristics below apply (the reference only ever shipped
+    # resnet101 / vgg / densenet201 at their default truncations).
+    fe_arch = getattr(args, "feature_extraction_cnn", "")
+    if fe_arch in RESNET_SPECS or fe_arch == "vgg":
+        config = BackboneConfig(
+            cnn=fe_arch, last_layer=getattr(args, "fe_last_layer", "")
+        )
+        converter = (
+            convert_vgg_state_dict if fe_arch == "vgg" else convert_resnet_state_dict
+        )
+        backbone = converter(sd, config, fe_prefix)
+    elif is_densenet:
         config = BackboneConfig(cnn="densenet201")
         # The truncated nn.Sequential (lib/model.py:69-73) renames the
         # features children to indices: 0=conv0, 1=norm0, 4=denseblock1,
@@ -248,3 +267,123 @@ def load_reference_checkpoint(path: str):
         "ncons_channels": channels,
         "backbone": config,
     }
+
+
+# --------------------------------------------------------------------------
+# Reverse direction: ncnet_tpu pytrees -> reference `.pth.tar`.
+#
+# Lets a user take weights trained here back to the reference implementation
+# (its restore path: lib/model.py:211-248). Exact inverses of the importers
+# above, so export -> load_reference_checkpoint round-trips bit-exactly.
+
+
+def _inv_conv2d_w(w) -> np.ndarray:
+    return np.asarray(w, np.float32).transpose(3, 2, 0, 1)  # HWIO -> OIHW
+
+
+def _inv_bn(bn: Mapping[str, Any], prefix: str, out: Dict[str, Any]) -> None:
+    out[f"{prefix}.weight"] = np.asarray(bn["scale"], np.float32)
+    out[f"{prefix}.bias"] = np.asarray(bn["bias"], np.float32)
+    out[f"{prefix}.running_mean"] = np.asarray(bn["mean"], np.float32)
+    out[f"{prefix}.running_var"] = np.asarray(bn["var"], np.float32)
+    out[f"{prefix}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+
+def export_resnet_state_dict(
+    params: Mapping[str, Any], config: BackboneConfig, prefix: str = ""
+) -> Dict[str, Any]:
+    """Backbone pytree -> the truncated nn.Sequential's state dict (the
+    sequential-index key scheme of the reference's published checkpoints:
+    conv1 -> '0', bn1 -> '1', layer<s> -> '<s+3>', lib/model.py:42-44)."""
+    sd: Dict[str, Any] = {}
+    sd[prefix + "0.weight"] = _inv_conv2d_w(params["conv1"])
+    _inv_bn(params["bn1"], prefix + "1", sd)
+    for stage in range(1, config.num_stages + 1):
+        for b, block in enumerate(params[f"layer{stage}"]):
+            p = f"{prefix}{stage + 3}.{b}"
+            for c in ("conv1", "conv2", "conv3"):
+                sd[f"{p}.{c}.weight"] = _inv_conv2d_w(block[c])
+                _inv_bn(block[c.replace("conv", "bn")], f"{p}.{c.replace('conv', 'bn')}", sd)
+            if "downsample" in block:
+                sd[f"{p}.downsample.0.weight"] = _inv_conv2d_w(
+                    block["downsample"]["conv"]
+                )
+                _inv_bn(block["downsample"]["bn"], f"{p}.downsample.1", sd)
+    return sd
+
+
+def export_vgg_state_dict(
+    params: Mapping[str, Any], config: BackboneConfig, prefix: str = ""
+) -> Dict[str, Any]:
+    """Backbone pytree -> truncated torchvision vgg16.features state dict
+    (conv indices preserved by the reference's truncation, lib/model.py:35)."""
+    conv_indices = VGG_TORCH_CONV_INDICES
+    sd: Dict[str, Any] = {}
+    ci = 0
+    for (name, cin, cout), layer in zip(config.vgg_layers, params["layers"]):
+        if cout == 0:
+            continue
+        idx = conv_indices[ci]
+        sd[f"{prefix}{idx}.weight"] = _inv_conv2d_w(layer["w"])
+        sd[f"{prefix}{idx}.bias"] = np.asarray(layer["b"], np.float32)
+        ci += 1
+    return sd
+
+
+def export_reference_checkpoint(
+    path: str,
+    params: Mapping[str, Any],
+    backbone: BackboneConfig,
+    kernel_sizes: Sequence[int],
+    channels: Sequence[int],
+    epoch: int = 0,
+    best_test_loss: float = 0.0,
+):
+    """Write a reference-loadable `.pth.tar` (lib/model.py:211-248 format).
+
+    Conv4d weights go out PRE-PERMUTED ([kI, O, I, kJ, kK, kL]) exactly as
+    the reference's Conv4d stores them (lib/conv4d.py:76-77); arch params
+    travel in the argparse Namespace under 'args' so the reference's
+    checkpoint-wins restore rule reconstructs the right stack.
+    """
+    import argparse as _argparse
+
+    import torch
+
+    fe_prefix = "FeatureExtraction.model."
+    if backbone.cnn == "vgg":
+        sd = export_vgg_state_dict(params["backbone"], backbone, fe_prefix)
+    elif backbone.cnn.startswith("resnet") and backbone.cnn in RESNET_SPECS:
+        sd = export_resnet_state_dict(params["backbone"], backbone, fe_prefix)
+    else:
+        raise ValueError(
+            f"export supports the reference's loadable backbones (resnet*/"
+            f"vgg), not {backbone.cnn!r}"
+        )
+    for i, layer in enumerate(params["neigh_consensus"]):
+        w = np.asarray(layer["weight"], np.float32)  # [kI,kJ,kK,kL,I,O]
+        sd[f"NeighConsensus.conv.{2 * i}.weight"] = w.transpose(0, 5, 4, 1, 2, 3)
+        sd[f"NeighConsensus.conv.{2 * i}.bias"] = np.asarray(
+            layer["bias"], np.float32
+        )
+    ckpt = {
+        "epoch": epoch,
+        "args": _argparse.Namespace(
+            ncons_kernel_sizes=list(kernel_sizes),
+            ncons_channels=list(channels),
+            # Extra fields (ignored by the reference's restore) so our own
+            # importer can round-trip non-default backbones exactly.
+            feature_extraction_cnn=backbone.cnn,
+            fe_last_layer=backbone.last_layer,
+        ),
+        "state_dict": {
+            # np.ascontiguousarray can return a read-only view (e.g. of a
+            # jax-backed buffer); copy so torch gets a writable tensor.
+            k: torch.from_numpy(np.array(v, copy=True)) for k, v in sd.items()
+        },
+        "best_test_loss": best_test_loss,
+        "optimizer": {},
+        "train_loss": np.zeros(max(epoch, 1)),
+        "test_loss": np.zeros(max(epoch, 1)),
+    }
+    torch.save(ckpt, path)

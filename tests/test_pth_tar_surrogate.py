@@ -94,10 +94,11 @@ def make_reference_pth_tar(path, backbone_sd, kernel_sizes, channels,
         sd[f"NeighConsensus.conv.{2 * i}.bias"] = layer["bias"]
     ckpt = {
         "epoch": 5,
+        # Faithful to the reference train.py's argparse surface (no backbone
+        # field exists there — arch detection must work from the keys).
         "args": argparse.Namespace(
             ncons_kernel_sizes=list(kernel_sizes),
             ncons_channels=list(channels),
-            fe_arch="resnet101",
             lr=5e-4,
             batch_size=16,
         ),
@@ -183,6 +184,52 @@ def test_flagship_pth_tar_surrogate_end_to_end(tmp_path, rng):
         ref = _torch_pipeline(fa, fb, ncons_native).numpy()
 
     np.testing.assert_allclose(np.asarray(corr), ref, atol=5e-4, rtol=1e-3)
+
+
+def test_export_round_trips_bit_exact(tmp_path):
+    """Native params -> export_reference_checkpoint -> .pth.tar ->
+    load_reference_checkpoint must round-trip bit-exactly (resnet101 and
+    vgg, the reference's loadable backbones), including through the
+    export_checkpoint CLI from a native checkpoint directory."""
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.convert import (
+        export_reference_checkpoint,
+        load_reference_checkpoint,
+    )
+    from ncnet_tpu.training.checkpoint import save_checkpoint
+    from tools import export_checkpoint
+
+    # Includes a non-default backbone (resnet50 truncated at layer2): the
+    # exported Namespace's feature_extraction_cnn / fe_last_layer fields
+    # must carry the arch back through the importer.
+    for cnn, last, ks, ch in (
+        ("resnet101", "", (5, 5, 5), (16, 16, 1)),
+        ("vgg", "", (3, 3), (16, 1)),
+        ("resnet50", "layer2", (3,), (1,)),
+    ):
+        config = NCNetConfig(
+            backbone=BackboneConfig(cnn=cnn, last_layer=last),
+            ncons_kernel_sizes=ks,
+            ncons_channels=ch,
+        )
+        params = jax.tree.map(np.asarray, ncnet_init(jax.random.PRNGKey(0), config))
+        out = tmp_path / f"{cnn}.pth.tar"
+        export_reference_checkpoint(str(out), params, config.backbone, ks, ch)
+        re_params, arch = load_reference_checkpoint(str(out))
+        assert arch["backbone"].cnn == cnn
+        assert arch["backbone"].last_layer == last
+        assert tuple(arch["ncons_kernel_sizes"]) == ks
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            params, re_params,
+        )
+
+        # CLI path from a native checkpoint dir (includes its own verify).
+        ckpt_dir = tmp_path / f"native_{cnn}"
+        tag = save_checkpoint(str(ckpt_dir), params, config, epoch=1)
+        assert export_checkpoint.main(
+            [tag, str(tmp_path / f"{cnn}_cli.pth.tar")]
+        ) == 0
 
 
 def test_legacy_vgg_key_era_pth_tar(tmp_path, rng):
