@@ -1,0 +1,23 @@
+"""End-to-end pipeline demo test: eval_inloc -> localize -> rate curve.
+
+The composed user-facing flow the reference splits across Python AND
+Matlab (eval_inloc.py + compute_densePE_NCNet.m), here one in-process run
+on a synthetic scene with identity ground truth (see
+examples/inloc_pipeline_demo.py for the construction).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+
+
+def test_pipeline_demo_localizes_identity(tmp_path):
+    import inloc_pipeline_demo
+
+    rc = inloc_pipeline_demo.main(
+        ["--out", str(tmp_path), "--size", "128", "--ransac_iters", "500"]
+    )
+    assert rc == 0  # recovered translation error < 0.25 m
+    assert (tmp_path / "out" / "localization_curve.png").exists()
