@@ -108,6 +108,31 @@ def _corr_pool_kernel(
     idx_ref[0] = best_idx
 
 
+def auto_tile_b_cells(
+    k: int, va: int, c: int, n_cells_b: int, budget: int = 6 * 1024 * 1024
+) -> int:
+    """Size the B-cell tile from an explicit VMEM byte budget.
+
+    Per B cell one grid step holds the fb block (kk*c bf16, double-buffered
+    across grid steps), one [va, .] f32 correlation slab + best/best_idx
+    accumulators, and the double-buffered pooled+idx output blocks; the fa
+    block is tile-independent. The default 6 MB budget empirically clears
+    the 16 MB scoped-VMEM limit with Mosaic's buffering overheads included
+    (re-tune on hardware via tools/pallas_tpu_smoke.py, docs/NEXT.md).
+
+    The result is always valid for Mosaic: a multiple of 128 (the lane-
+    divisibility requirement for a tiled last dim) or the whole array.
+    Unit-locked at the real workload shapes in tests/test_pallas_kernels.py.
+    """
+    kk = k * k
+    fa_bytes = kk * va * c * 2
+    per_cell = kk * c * 2 + kk * kk * va * 4 + va * 8
+    max_cells = max((budget - fa_bytes) // per_cell, 128)
+    # Mosaic needs the lane (last output) dim divisible by 128 unless it
+    # spans the whole array; grid uses cdiv so a ragged tail is padded.
+    return min(max_cells - max_cells % 128, n_cells_b)
+
+
 def fused_correlation_maxpool_pallas(
     feature_a,
     feature_b,
@@ -147,19 +172,7 @@ def fused_correlation_maxpool_pallas(
     n_cells_b = wb * zb
 
     if tile_b_cells == 0:
-        # Size the B tile from an explicit VMEM byte budget. Per B cell the
-        # step holds the fb block (kk*c bf16, double-buffered across grid
-        # steps), one [va, .] f32 slab + best/best_idx accumulators, and the
-        # double-buffered pooled+idx output blocks; the fa block is
-        # tile-independent. 6 MB empirically clears the 16 MB scoped-VMEM
-        # limit with Mosaic's buffering overheads included.
-        budget = 6 * 1024 * 1024
-        fa_bytes = kk * va * c * 2
-        per_cell = kk * c * 2 + kk * kk * va * 4 + va * 8
-        max_cells = max((budget - fa_bytes) // per_cell, 128)
-        # Mosaic needs the lane (last output) dim divisible by 128 unless it
-        # spans the whole array; grid uses cdiv so a ragged tail is padded.
-        tile_b_cells = min(max_cells - max_cells % 128, n_cells_b)
+        tile_b_cells = auto_tile_b_cells(k, va, c, n_cells_b)
     if not interpret and tile_b_cells < n_cells_b and tile_b_cells % 128:
         # Mosaic-only constraint; the interpreter (CPU tests) has no tiling.
         raise ValueError(

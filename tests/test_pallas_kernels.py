@@ -99,3 +99,36 @@ def test_fused_feeds_corr_to_matches(rng):
     )
     np.testing.assert_allclose(np.asarray(xa), np.asarray(rxa), atol=1e-6)
     np.testing.assert_allclose(np.asarray(score), np.asarray(rscore), atol=1e-5)
+
+
+def test_auto_tile_b_cells_valid_at_workload_shapes():
+    """The VMEM auto-sizing must yield a Mosaic-valid tile (multiple of 128
+    or the whole B-cell array) with a positive grid at every shape the
+    framework actually runs — a wrong size here silently demotes bench.py
+    to the unfused fallback on first hardware contact."""
+    from ncnet_tpu.ops.pallas_kernels import auto_tile_b_cells
+
+    cases = [
+        # (k, va, c, n_cells_b): InLoc 3200x2400 (200x150 feats, k=2),
+        # InLoc portrait, PF-Pascal-ish small, square 512-bench smoke,
+        # deep-channel + tall va stress.
+        (2, 75, 1024, 100 * 75),
+        (2, 100, 1024, 75 * 100),
+        (2, 12, 512, 12 * 12),
+        (2, 16, 1024, 16 * 16),
+        (2, 256, 2048, 128 * 96),
+        (3, 50, 1024, 66 * 50),
+    ]
+    for k, va, c, n_cells in cases:
+        tile = auto_tile_b_cells(k, va, c, n_cells)
+        assert tile > 0, (k, va, c, n_cells)
+        assert tile == n_cells or tile % 128 == 0, (tile, n_cells)
+        # The per-step VMEM the formula models stays under the 16 MB scoped
+        # limit: fa block + double-buffered fb/output blocks + f32 slab.
+        kk = k * k
+        step_bytes = (
+            kk * va * c * 2
+            + 2 * (kk * tile * c * 2 + 2 * tile * va * 8)
+            + kk * kk * va * tile * 4
+        )
+        assert step_bytes < 16 * 1024 * 1024, (k, va, c, n_cells, step_bytes)
