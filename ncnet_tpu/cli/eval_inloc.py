@@ -53,6 +53,11 @@ def inloc_resize_shape(h, w, image_size, k_size, scale_factor=0.0625, h_unit=0):
     ratio = max(h, w) / image_size
     out_h = int(np.floor(h / ratio * scale_factor / h_unit) / scale_factor * h_unit)
     out_w = int(np.floor(w / ratio * scale_factor / k_size) / scale_factor * k_size)
+    # Small inputs (or large h_unit) can floor a dim to ZERO feature cells —
+    # downstream that is a 0-sized correlation axis (opaque Pallas grid
+    # crash). Clamp to one alignment unit: slight upscale beats a crash.
+    out_h = max(out_h, int(h_unit / scale_factor))
+    out_w = max(out_w, int(k_size / scale_factor))
     return out_h, out_w
 
 

@@ -291,6 +291,15 @@ def fused_correlation_maxpool(
     path (device-list sniffing would pick the Pallas kernel and fail to
     lower).
     """
+    for name, feat in (("feature_a", feature_a), ("feature_b", feature_b)):
+        h, w = feat.shape[2:]
+        if h < k_size or w < k_size:
+            raise ValueError(
+                f"{name} spatial dims {h}x{w} too small for pool k_size="
+                f"{k_size}: at least one pooled cell is required (undersized "
+                "inputs usually mean the resize floored a dim to zero — see "
+                "cli/eval_inloc.py inloc_resize_shape)"
+            )
     return jax.lax.platform_dependent(
         feature_a,
         feature_b,

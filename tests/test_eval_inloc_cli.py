@@ -172,10 +172,11 @@ def test_resume_skips_existing(fixture_dir):
         assert os.path.getmtime(exp_dir / f) == t
 
 
-def test_spatial_shards_cli(fixture_dir):
-    """--spatial_shards 2 runs the sharded forward on the CPU mesh and writes
-    the same .mat layout."""
-    out_dir = fixture_dir / "matches_sharded"
+@pytest.mark.parametrize("shards", [2, 4])
+def test_spatial_shards_cli(fixture_dir, shards):
+    """--spatial_shards N runs the sharded forward on the CPU mesh and writes
+    the same .mat layout (N=4 exercises the h_unit=N*k input bucketing)."""
+    out_dir = fixture_dir / f"matches_sharded_{shards}"
     eval_inloc.main(
         [
             "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
@@ -186,7 +187,7 @@ def test_spatial_shards_cli(fixture_dir):
             "--n_queries", "1",
             "--n_panos", "2",
             "--k_size", "2",
-            "--spatial_shards", "2",
+            "--spatial_shards", str(shards),
         ]
     )
     exp = os.listdir(out_dir)

@@ -1,0 +1,211 @@
+"""Learning-signal experiment: does weak-supervision training lift PCK?
+
+Builds a fully synthetic PF-Pascal-layout dataset (random smooth textures;
+pairs are known warps, so ground-truth keypoint correspondences are exact),
+measures keypoint-transfer PCK with the UNTRAINED model, trains with
+`cli.train` (the weak loss of reference train.py:110-156), and measures
+again. Report-only (exit 0 either way) — see the finding below.
+
+FINDING (2026-07-30, CPU, no pretrained weights available offline): with a
+RANDOMLY-INITIALIZED backbone the weak loss decreases (pos-vs-rolled-neg
+discrimination improves: -1e-6 -> -2e-4 over 300 steps) while PCK drops
+(e.g. 9.4% -> 0% on translation-only pairs; per-keypoint transfer errors
+grow 2-3x). The loss can be satisfied by a texture-identity shortcut —
+sharpening SOME peak for same-texture pairs — which only aligns with
+geometrically correct peaks when the backbone features are themselves
+meaningful (ImageNet-pretrained, as the reference assumes:
+lib/model.py:25-44 downloads torchvision weights). The loss/gradient math
+itself is golden-tested against the reference formulation
+(tests/test_model.py::test_weak_loss_feature_roll_equals_image_roll), so
+re-run this experiment for a positive signal once pretrained weights are
+fetchable (docs/NEXT.md).
+
+Runs on CPU in a few minutes:
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python tools/sanity_train_improves_pck.py --out /tmp/sanity_pck
+"""
+
+import argparse
+import csv
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _texture(rng, size, cells=12):
+    t = rng.random((cells, cells, 3))
+    t = np.kron(t, np.ones((size // cells, size // cells, 1)))
+    return (t[:size, :size] * 255).astype("uint8")
+
+
+def _affine(rng, size, max_rot=0.0, max_scale=0.0, max_shift=0.15):
+    """Random affine M mapping TARGET pixel coords -> SOURCE pixel coords.
+
+    Defaults are TRANSLATION-only: without downloadable ImageNet weights
+    the backbone is randomly initialized, and random conv features are
+    translation-equivariant but have no rotation/scale invariance — rotated
+    pairs would be noise-level matchable regardless of the consensus stack,
+    telling us nothing about the training signal."""
+    a = rng.uniform(-max_rot, max_rot)
+    s = 1.0 + rng.uniform(-max_scale, max_scale)
+    c, r = np.cos(a) * s, np.sin(a) * s
+    t = rng.uniform(-max_shift, max_shift, 2) * size
+    center = size / 2.0
+    M = np.array([[c, -r, 0.0], [r, c, 0.0]])
+    M[:, 2] = center - M[:, :2] @ [center, center] + t
+    return M
+
+
+def _warp(img, M):
+    from scipy.ndimage import map_coordinates
+
+    h, w = img.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    src = np.einsum("ij,jhw->ihw", M, np.stack(
+        [xs, ys, np.ones_like(xs)]).astype(np.float64))
+    out = np.stack(
+        [
+            map_coordinates(img[..., ch].astype(np.float64), [src[1], src[0]],
+                            order=1, mode="reflect")
+            for ch in range(img.shape[2])
+        ],
+        axis=-1,
+    )
+    return out.astype("uint8")
+
+
+def build_dataset(root, rng, size=96, n_train=24, n_val=4, n_test=8, n_kp=8):
+    os.makedirs(os.path.join(root, "images"), exist_ok=True)
+    os.makedirs(os.path.join(root, "image_pairs"), exist_ok=True)
+    from PIL import Image
+
+    def make_pair(i):
+        src = _texture(rng, size, cells=int(rng.integers(8, 16)))
+        M = _affine(rng, size)
+        tgt = _warp(src, M)
+        sn, tn = f"images/s{i}.png", f"images/t{i}.png"
+        Image.fromarray(src).save(os.path.join(root, sn))
+        Image.fromarray(tgt).save(os.path.join(root, tn))
+        return sn, tn, M
+
+    for split, n in (("train_pairs", n_train), ("val_pairs", n_val)):
+        with open(os.path.join(root, "image_pairs", f"{split}.csv"), "w",
+                  newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["source_image", "target_image", "class", "flip"])
+            for i in range(n):
+                sn, tn, _ = make_pair(f"{split}_{i}")
+                w.writerow([sn, tn, 1, 0])
+
+    with open(os.path.join(root, "image_pairs", "test_pairs.csv"), "w",
+              newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["source_image", "target_image", "class",
+                    "XA", "YA", "XB", "YB"])
+        for i in range(n_test):
+            sn, tn, M = make_pair(f"test_{i}")
+            # Target keypoints on an interior grid; source = M @ target.
+            m = size * 0.25
+            kp = rng.uniform(m, size - m, (n_kp, 2))
+            src_kp = kp @ M[:, :2].T + M[:, 2]
+            w.writerow([
+                sn, tn, 1,
+                ";".join(f"{v:.2f}" for v in src_kp[:, 0]),
+                ";".join(f"{v:.2f}" for v in src_kp[:, 1]),
+                ";".join(f"{v:.2f}" for v in kp[:, 0]),
+                ";".join(f"{v:.2f}" for v in kp[:, 1]),
+            ])
+
+
+def run_pck(root, ckpt, image_size):
+    import contextlib
+    import io
+
+    from ncnet_tpu.cli import eval_pf_pascal
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        eval_pf_pascal.main([
+            "--checkpoint", ckpt,
+            "--eval_dataset_path", root,
+            "--image_size", str(image_size),
+            "--batch_size", "4",
+            "--pck_procedure", "pf",
+        ])
+    out = buf.getvalue()
+    m = re.search(r"PCK[^0-9]*([0-9.]+)%", out)
+    assert m, out
+    return float(m.group(1))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="/tmp/sanity_pck")
+    p.add_argument("--size", type=int, default=96)
+    p.add_argument("--image_size", type=int, default=96)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    rng = np.random.default_rng(args.seed)
+    root = args.out
+    build_dataset(root, rng, size=args.size)
+    print(f"synthetic affine-pair dataset under {root}")
+
+    import jax
+
+    from ncnet_tpu.cli import train as train_cli
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.training.checkpoint import save_checkpoint
+
+    # Untrained reference point: the same architecture at init.
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg"),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+    )
+    params = jax.tree.map(
+        np.asarray, ncnet_init(jax.random.PRNGKey(args.seed), config)
+    )
+    init_ckpt = save_checkpoint(os.path.join(root, "init"), params, config, 0)
+    pck_before = run_pck(root, init_ckpt, args.image_size)
+    print(f"PCK untrained: {pck_before:.2f}%")
+
+    train_cli.main([
+        "--dataset_image_path", root,
+        "--dataset_csv_path", os.path.join(root, "image_pairs"),
+        "--num_epochs", str(args.epochs),
+        "--batch_size", "4",
+        "--image_size", str(args.image_size),
+        "--backbone", "vgg",
+        "--ncons_kernel_sizes", "3", "3",
+        "--ncons_channels", "16", "1",
+        "--checkpoint", init_ckpt,
+        "--result_model_dir", os.path.join(root, "models"),
+        "--num_workers", "2",
+        "--seed", str(args.seed),
+        "--log_interval", "10",
+    ])
+    # Newest run dir: re-runs into the same --out leave older runs behind.
+    runs = os.path.join(root, "models")
+    run = max(os.listdir(runs), key=lambda d: os.path.getmtime(os.path.join(runs, d)))
+    best = os.path.join(runs, run, "best")
+    pck_after = run_pck(root, best, args.image_size)
+    print(f"PCK trained:   {pck_after:.2f}%")
+    print(json.dumps({
+        "pck_untrained_pct": pck_before,
+        "pck_trained_pct": pck_after,
+        "delta_pct": round(pck_after - pck_before, 2),
+        "note": "random backbone: see module docstring before reading "
+                "a negative delta as a training-stack bug",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
