@@ -57,6 +57,17 @@ class NCNetConfig:
     # tensor never materializes (Pallas on TPU, slab-scan on CPU). Only
     # takes effect when relocalization_k_size > 1 and batch == 1.
     use_fused_corr_pool: bool = False
+    # 'auto': platform dispatch (Pallas on TPU, XLA slab-scan elsewhere);
+    # 'xla': force the slab-scan everywhere — the middle tier of bench.py's
+    # fallback ladder (same never-materialize memory behavior, no Mosaic
+    # dependency) if the Pallas kernel fails on a new backend/shape.
+    fused_impl: str = "auto"
+
+    def __post_init__(self):
+        if self.fused_impl not in ("auto", "xla"):
+            raise ValueError(
+                f"fused_impl must be 'auto' or 'xla', got {self.fused_impl!r}"
+            )
 
     @property
     def corr_dtype(self):
@@ -150,9 +161,17 @@ def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a, fea
     ):
         # Local import keeps jax.experimental.pallas off the import path of
         # consumers that never take the fused branch.
-        from ..ops.pallas_kernels import fused_correlation_maxpool
+        from ..ops.pallas_kernels import (
+            fused_correlation_maxpool,
+            fused_correlation_maxpool_xla,
+        )
 
-        corr4d, delta4d = fused_correlation_maxpool(
+        fused = (
+            fused_correlation_maxpool_xla
+            if config.fused_impl == "xla"
+            else fused_correlation_maxpool
+        )
+        corr4d, delta4d = fused(
             feat_a,
             feat_b,
             config.relocalization_k_size,

@@ -108,6 +108,24 @@ def _corr_pool_kernel(
     idx_ref[0] = best_idx
 
 
+def _check_pool_shapes(feature_a, feature_b, k_size: int) -> None:
+    """Reject inputs with fewer than one pooled cell in any spatial dim.
+
+    Shared by every fused entry point: a 0-sized pooled axis otherwise
+    crashes Pallas grid math with an opaque ZeroDivisionError, or scans
+    over zero rows in the XLA slab path and silently emits an empty
+    correlation tensor."""
+    for name, feat in (("feature_a", feature_a), ("feature_b", feature_b)):
+        h, w = feat.shape[2:]
+        if h < k_size or w < k_size:
+            raise ValueError(
+                f"{name} spatial dims {h}x{w} too small for pool k_size="
+                f"{k_size}: at least one pooled cell is required (undersized "
+                "inputs usually mean the resize floored a dim to zero — see "
+                "cli/eval_inloc.py inloc_resize_shape)"
+            )
+
+
 def auto_tile_b_cells(
     k: int, va: int, c: int, n_cells_b: int, budget: int = 6 * 1024 * 1024
 ) -> int:
@@ -162,6 +180,7 @@ def fused_correlation_maxpool_pallas(
     """
     if feature_a.shape[0] != 1:
         raise ValueError("batch must be 1 (vmap/loop outside)")
+    _check_pool_shapes(feature_a, feature_b, k_size)
     k = k_size
     kk = k * k
     c = feature_a.shape[1]
@@ -234,6 +253,7 @@ def fused_correlation_maxpool_xla(
     """
     if feature_a.shape[0] != 1:
         raise ValueError("batch must be 1")
+    _check_pool_shapes(feature_a, feature_b, k_size)
     k = k_size
     kk = k * k
     c = feature_a.shape[1]
@@ -291,15 +311,6 @@ def fused_correlation_maxpool(
     path (device-list sniffing would pick the Pallas kernel and fail to
     lower).
     """
-    for name, feat in (("feature_a", feature_a), ("feature_b", feature_b)):
-        h, w = feat.shape[2:]
-        if h < k_size or w < k_size:
-            raise ValueError(
-                f"{name} spatial dims {h}x{w} too small for pool k_size="
-                f"{k_size}: at least one pooled cell is required (undersized "
-                "inputs usually mean the resize floored a dim to zero — see "
-                "cli/eval_inloc.py inloc_resize_shape)"
-            )
     return jax.lax.platform_dependent(
         feature_a,
         feature_b,

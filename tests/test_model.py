@@ -349,3 +349,37 @@ def test_train_step_remat_backbone_matches(rng):
     assert abs(outs[0][1] - outs[1][1]) < 1e-6
     for a, b in zip(jax.tree.leaves(outs[0][0]), jax.tree.leaves(outs[1][0])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fused_impl_xla_matches_unfused(rng):
+    """fused_impl='xla' (bench.py's middle fallback tier) must produce the
+    same corr + relocalization deltas as the unfused materialize+pool path."""
+    import dataclasses
+
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import ncnet_forward
+
+    base = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+        relocalization_k_size=2,
+        use_fused_corr_pool=True,
+        fused_impl="xla",
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), base)
+    src = jnp.asarray(rng.randn(1, 3, 64, 64).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(1, 3, 64, 48).astype(np.float32))
+
+    corr_x, deltas_x = ncnet_forward(base, params, src, tgt)
+    unfused = dataclasses.replace(base, use_fused_corr_pool=False)
+    corr_u, deltas_u = ncnet_forward(unfused, params, src, tgt)
+
+    np.testing.assert_allclose(
+        np.asarray(corr_x), np.asarray(corr_u), atol=2e-5, rtol=1e-4
+    )
+    for dx, du in zip(deltas_x, deltas_u):
+        np.testing.assert_array_equal(np.asarray(dx), np.asarray(du))
+
+    with pytest.raises(ValueError, match="fused_impl"):
+        dataclasses.replace(base, fused_impl="mosaic")
