@@ -1,0 +1,67 @@
+"""Export a native checkpoint to a reference-loadable `.pth.tar`.
+
+The inverse of tools/convert_checkpoint.py: lets weights trained in this
+framework travel BACK to the reference implementation (whose restore path,
+lib/model.py:211-248, reads the arch params from the stored argparse
+Namespace and the pre-permuted Conv4d weights from the state dict).
+
+Usage:
+    ncnet-export-checkpoint <native_ckpt_dir> <out.pth.tar>
+"""
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("src", help="native checkpoint directory (training.checkpoint)")
+    p.add_argument("dst", help="output .pth.tar path")
+    p.add_argument(
+        "--verify", action="store_true", default=True,
+        help="re-import the exported file and compare pytrees (default on)",
+    )
+    p.add_argument("--no-verify", dest="verify", action="store_false")
+    args = p.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from ncnet_tpu.models.convert import (
+        export_reference_checkpoint,
+        load_reference_checkpoint,
+    )
+    from ncnet_tpu.training.checkpoint import load_checkpoint
+
+    restored = load_checkpoint(args.src)
+    config, params = restored["config"], restored["params"]
+    export_reference_checkpoint(
+        args.dst,
+        params,
+        config.backbone,
+        config.ncons_kernel_sizes,
+        config.ncons_channels,
+        epoch=restored["meta"].get("epoch", 0),
+    )
+    n = sum(x.size for x in jax.tree.leaves(params))
+    print(f"wrote {args.dst}: {config.backbone.cnn}, "
+          f"ncons {tuple(config.ncons_kernel_sizes)}/"
+          f"{tuple(config.ncons_channels)}, {n / 1e6:.1f}M params")
+
+    if args.verify:
+        re_params, arch = load_reference_checkpoint(args.dst)
+        assert tuple(arch["ncons_kernel_sizes"]) == tuple(config.ncons_kernel_sizes)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            params,
+            re_params,
+        )
+        print("round-trip verify OK (bit-exact)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,76 +1,13 @@
-"""Convert a reference PyTorch checkpoint (.pth.tar) to a native checkpoint.
+"""Delegate: the implementation lives in ncnet_tpu.cli.convert_checkpoint
+(installable as the `ncnet-convert-checkpoint` console script); this
+path is kept so `python tools/convert_checkpoint.py` keeps working from a checkout."""
 
-The published NCNet checkpoints (trained_models/download.sh: ncnet_pfpascal,
-ncnet_ivd) restore directly into every CLI via --checkpoint <file>.pth.tar;
-this tool materializes the conversion once into the native self-describing
-format (training/checkpoint.py) so later runs skip the torch dependency and
-the on-the-fly key remapping.
-
-Usage:
-    python tools/convert_checkpoint.py trained_models/ncnet_pfpascal.pth.tar \
-        trained_models/ncnet_pfpascal_native
-"""
-
-from __future__ import annotations
-
-import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-
-def main(argv=None):
-    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("src", help="reference .pth.tar checkpoint")
-    p.add_argument("dst", help="output native checkpoint directory")
-    p.add_argument(
-        "--verify", action="store_true", default=True,
-        help="round-trip restore and compare a forward pass (default on)",
-    )
-    p.add_argument("--no-verify", dest="verify", action="store_false")
-    args = p.parse_args(argv)
-
-    import jax
-    import numpy as np
-
-    from ncnet_tpu.models import NCNetConfig
-    from ncnet_tpu.models.convert import load_reference_checkpoint
-    from ncnet_tpu.training.checkpoint import load_checkpoint, save_checkpoint
-
-    params, arch = load_reference_checkpoint(args.src)
-    config = NCNetConfig(
-        backbone=arch["backbone"],
-        ncons_kernel_sizes=arch["ncons_kernel_sizes"],
-        ncons_channels=arch["ncons_channels"],
-    )
-    n_leaves = len(jax.tree.leaves(params))
-    n_params = sum(x.size for x in jax.tree.leaves(params))
-    print(f"loaded {args.src}: {arch['backbone'].cnn}, "
-          f"ncons {arch['ncons_kernel_sizes']}/{arch['ncons_channels']}, "
-          f"{n_leaves} tensors / {n_params / 1e6:.1f}M params")
-
-    save_checkpoint(args.dst, params, config, epoch=0, is_best=True)
-    tag = os.path.join(args.dst, "best")
-    print(f"wrote {tag}")
-
-    if args.verify:
-        restored = load_checkpoint(tag)
-        try:
-            # tree.map raises on structure mismatch (dropped/extra tensors).
-            equal = jax.tree.map(
-                lambda a, b: bool(np.array_equal(np.asarray(a), np.asarray(b))),
-                params,
-                restored["params"],
-            )
-            ok = all(jax.tree.leaves(equal))
-        except ValueError:
-            ok = False
-        if not ok or restored["config"] != config:
-            print("VERIFY FAILED: round-trip mismatch", file=sys.stderr)
-            sys.exit(1)
-        print("verify: round-trip exact")
-
+from ncnet_tpu.cli.convert_checkpoint import main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
