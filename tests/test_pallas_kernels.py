@@ -132,3 +132,31 @@ def test_auto_tile_b_cells_valid_at_workload_shapes():
             + kk * kk * va * tile * 4
         )
         assert step_bytes < 16 * 1024 * 1024, (k, va, c, n_cells, step_bytes)
+
+
+def test_fused_kernel_ragged_tile_tail():
+    """A tile that does NOT divide the B-cell count exercises the grid's
+    cdiv padding path exactly as at InLoc scale (auto tile 384 vs 7500
+    cells -> tail 204; here 512 vs 750 -> tail 238, same code path, test-
+    sized): the padded tail must never contaminate real outputs. The full
+    c=1024 auto-sizing itself is locked in
+    test_auto_tile_b_cells_valid_at_workload_shapes."""
+    from ncnet_tpu.ops.pallas_kernels import (
+        fused_correlation_maxpool_pallas,
+        fused_correlation_maxpool_xla,
+    )
+
+    tile = 512
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    fa = jax.random.normal(k1, (1, 32, 48, 20), jnp.float32)
+    fb = jax.random.normal(k2, (1, 32, 100, 30), jnp.float32)  # 750 cells
+    assert 750 % tile != 0  # genuinely ragged
+    p, d = fused_correlation_maxpool_pallas(
+        fa, fb, 2, tile_b_cells=tile, interpret=True, corr_dtype=jnp.bfloat16
+    )
+    px, dx = fused_correlation_maxpool_xla(fa, fb, 2, corr_dtype=jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(p, np.float32), np.asarray(px, np.float32)
+    )
+    for a, b in zip(d, dx):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
