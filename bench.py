@@ -143,13 +143,20 @@ def main():
     panos_per_query = 10  # eval_inloc.py:124-132: top-10 shortlist per query
 
     def run_block():
-        """One query block: query features once + 10 pano steps."""
+        """One query block: query features once + 10 pano steps.
+
+        The per-pano scalar reductions stay on device and the block closes
+        with ONE host fetch: a per-pano float() would serialize a tunnel
+        round trip (~40 ms on axon) into every step, and the real eval
+        pipeline likewise overlaps host reads with the next pano's device
+        work (cli/eval_inloc.py)."""
         fa = query_feats(params, src)
-        acc = 0.0
+        acc = None
         for _ in range(panos_per_query):
             m = step(params, fa, tgt)
-            acc += float(jnp.sum(m[4]))
-        return acc
+            s = jnp.sum(m[4])
+            acc = s if acc is None else acc + s
+        return float(acc)
 
     run_block()  # settle caches/queues
     note("timing...")

@@ -236,14 +236,23 @@ def _query_loop(args, db, out_dir, params, query_features, pano_matches,
         buf = matches_buffer(args.n_panos, n_matches)
         pano_fns = [db[q][1].ravel()[i].item() for i in range(args.n_panos)]
         fut = pool.submit(load_pano, pano_fns[0]) if pano_fns else None
+        # One-behind host processing: pano idx's forward is dispatched (async)
+        # BEFORE pano idx-1's matches are fetched and deduped, so the
+        # device-side forward overlaps both the host dedup and the fetch's
+        # tunnel round trip instead of idling through them.
+        pending = None  # (pano_idx, device match tuple)
         for idx in range(args.n_panos):
             tgt = fut.result()
             if idx + 1 < args.n_panos:
                 fut = pool.submit(load_pano, pano_fns[idx + 1])
-            match_tuple = dedup_matches(*pano_matches(params, feat_a, tgt))
-            fill_matches(buf, idx, match_tuple)
+            dev_matches = pano_matches(params, feat_a, tgt)
+            if pending is not None:
+                fill_matches(buf, pending[0], dedup_matches(*pending[1]))
+            pending = (idx, dev_matches)
             if idx % 10 == 0:
                 print(f">>> query {q} pano {idx}", flush=True)
+        if pending is not None:
+            fill_matches(buf, pending[0], dedup_matches(*pending[1]))
         write_matches_mat(out_path, buf, query_fn, pano_fn_all)
         print(f"wrote {out_path}", flush=True)
 

@@ -111,9 +111,12 @@ def match_pipeline(config: NCNetConfig, params: Params, corr4d):
     Runs in `config.corr_dtype` (bf16 for the half-precision InLoc config —
     the inter-layer consensus activations are the largest tensors in the
     model, and the reference likewise runs this stage in fp16,
-    lib/model.py:253-258) with f32 accumulation inside each conv and f32
-    elementwise math in the mutual-matching filters. Returns f32 for the
-    downstream softmax/argmax extraction.
+    lib/model.py:253-258). Conv numerics: multi-conv Conv4d strategies sum
+    their kernel-offset partials in f32; single-conv strategies emit the
+    storage dtype directly (each MXU tile contraction is f32; inter-tile
+    adds may be storage-dtype — see the dtype-policy note in
+    ops/conv4d.py). Mutual-matching elementwise math is f32. Returns f32
+    for the downstream softmax/argmax extraction.
     """
     corr4d = corr4d.astype(config.corr_dtype)
     corr4d = mutual_matching(corr4d)

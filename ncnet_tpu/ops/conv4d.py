@@ -91,6 +91,20 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     # largest HBM tensors in the model, parity: fp16 consensus in
     # lib/model.py:253-258) but ACCUMULATE in f32 on the MXU, summing the
     # kernel-offset partials in f32 and casting back once at the end.
+    # Single-conv strategies ('conv2d_stacked', 'convnd') have no
+    # cross-conv partial sums, so they emit the input dtype directly. At
+    # InLoc shapes that removes a 3.4 GB f32 output buffer plus its
+    # separate 1.7 GB bf16 cast copy from the HBM peak (the round-2 OOM on
+    # a 16 GB v5e was dominated by exactly these temps). Precision caveat:
+    # with a low-precision preferred_element_type the backend is *allowed*
+    # to add inter-tile partials in that dtype (the TPU MXU still
+    # accumulates each tile's contraction in f32); the consensus
+    # contractions are <=625 terms and the bf16 storage already bounds the
+    # pipeline at ~2-3 decimal digits, covered by the bf16 tolerance test
+    # in tests/test_ops.py. Multi-conv strategies keep explicit f32
+    # partial sums — their cross-conv adds are in this function's hands.
+    single_conv = strategy in ("conv2d_stacked", "convnd")
+    acc_dtype = x.dtype if single_conv else jnp.float32
     w = weight.astype(x.dtype)
     if strategy == "conv2d":
         # Zero-pad J on both sides (I is already halo/zero padded by the
@@ -161,7 +175,7 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
             window_strides=(1, 1),
             padding="SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc_dtype,
         )
         out = jnp.moveaxis(out.reshape(b, si, sj, sk, sl, cout), 5, 1)
     elif strategy == "convnd":
@@ -177,13 +191,13 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
             window_strides=(1, 1, 1, 1),
             padding=[(0, 0)] + [(kd // 2, kd // 2) for kd in (kj, kk, kl)],
             dimension_numbers=("NCHWDE", "OIHWDE", "NCHWDE"),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc_dtype,
         )
     else:
         raise ValueError(f"unknown strategy {strategy!r}")
 
     if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1, 1, 1)
+        out = out + bias.astype(out.dtype).reshape(1, -1, 1, 1, 1, 1)
     return out.astype(x.dtype)
 
 
@@ -240,7 +254,46 @@ def swap_ab_weight(weight):
     return jnp.transpose(weight, (2, 3, 0, 1, 4, 5))
 
 
-def neigh_consensus_apply(params, corr, *, symmetric: bool = True):
+# Chunked-consensus auto-trigger: chunk when the largest interlayer
+# activation would exceed this many elements (2**28 elems = 512 MB bf16 /
+# 1 GB f32), and size slabs so the per-slab activation stays near
+# _CHUNK_TARGET_ELEMS. Both only consulted when chunk_i is None ('auto');
+# NCNET_CONSENSUS_CHUNK_I overrides the row count (0 disables).
+_CHUNK_THRESHOLD_ELEMS = 2**28
+_CHUNK_TARGET_ELEMS = 2**26
+
+
+def _consensus_stack_prepadded(params, x, swap, i0, total_i, halo):
+    """Run the Conv4d+ReLU stack on an I-slab carrying `halo` extra rows.
+
+    x holds rows [i0 - halo, i0 + s + halo) of the (zero-padded) global
+    tensor. Each layer consumes ki//2 of the halo per side. Between layers,
+    rows whose global position falls outside [0, total_i) are re-zeroed:
+    the reference applies per-layer 'same' zero padding (lib/conv4d.py:26-36
+    via lib/model.py:146-152), so a deeper layer must see *zeros* beyond the
+    image edge — not activations computed from the zero-padded input — and
+    without the mask the chunked and unchunked paths would disagree at the
+    I boundaries.
+    """
+    h = halo
+    for li, layer in enumerate(params):
+        w = swap_ab_weight(layer["weight"]) if swap else layer["weight"]
+        x = conv4d_prepadded(x, w, layer["bias"])
+        x = jax.nn.relu(x)
+        h -= w.shape[0] // 2
+        if li < len(params) - 1:
+            pos = i0 - h + jnp.arange(x.shape[2])
+            valid = (pos >= 0) & (pos < total_i)
+            x = jnp.where(valid[None, None, :, None, None, None], x, 0)
+    if h:
+        # Non-cubic kernels can leave this branch consuming less I-halo than
+        # the other symmetric branch (halo is the max over branches): emit
+        # the center rows so both branches return the same slab.
+        x = lax.slice_in_dim(x, h, x.shape[2] - h, axis=2)
+    return x
+
+
+def neigh_consensus_apply(params, corr, *, symmetric: bool = True, chunk_i=None):
     """Apply the neighbourhood-consensus Conv4d+ReLU stack.
 
     Args:
@@ -256,10 +309,42 @@ def neigh_consensus_apply(params, corr, *, symmetric: bool = True):
         chain over the same memory layout — two full-tensor HBM transposes
         are saved, and the sharded variant avoids its all_to_all re-layouts
         (parallel/corr_sharding.py).
+      chunk_i: memory plan for the iA dimension. None (default) decides at
+        trace time from the static shapes: when the largest interlayer
+        activation exceeds ~2**28 elements (InLoc's 16-channel
+        100x75x100x75 tensor is 9e8), the stack runs as a `lax.map` over
+        I-slabs with a halo of sum(ki//2) rows, bounding every large temp
+        to slab size — the intra-chip analogue of the halo-exchange
+        sharding in parallel/corr_sharding.py. An int forces that many
+        rows per slab; 0 forces the one-shot path. The
+        NCNET_CONSENSUS_CHUNK_I env var (read at trace time) overrides.
 
     Returns:
       [b, c_last, iA, jA, iB, jB].
     """
+    b, cin, si, sj, sk, sl = corr.shape
+    # The swapped symmetric branch convolves I with each kernel's K-extent
+    # (swap_ab_weight), so the carried halo must cover both branch's
+    # consumption; a branch consuming less emits extra rows that
+    # _consensus_stack_prepadded trims back to the slab.
+    halo = max(
+        sum(l["weight"].shape[0] // 2 for l in params),
+        sum(l["weight"].shape[2] // 2 for l in params),
+    )
+    if chunk_i is None:
+        env = os.environ.get("NCNET_CONSENSUS_CHUNK_I")
+        if env is not None:
+            chunk_i = int(env)
+    if chunk_i is None:
+        max_c = max(
+            max(l["weight"].shape[4], l["weight"].shape[5]) for l in params
+        )
+        peak = b * max_c * si * sj * sk * sl
+        if peak > _CHUNK_THRESHOLD_ELEMS:
+            per_row = max(1, peak // si)
+            # A slab's widest activation spans chunk_i + 2*halo rows; budget
+            # for the halo rows too so the target is honored.
+            chunk_i = max(1, _CHUNK_TARGET_ELEMS // per_row - 2 * halo)
 
     def stack(x, swap: bool):
         for layer in params:
@@ -268,9 +353,30 @@ def neigh_consensus_apply(params, corr, *, symmetric: bool = True):
             x = jax.nn.relu(x)
         return x
 
-    if symmetric:
-        return stack(corr, False) + stack(corr, True)
-    return stack(corr, False)
+    if not chunk_i or chunk_i >= si:
+        if symmetric:
+            return stack(corr, False) + stack(corr, True)
+        return stack(corr, False)
+
+    n = -(-si // chunk_i)
+    tail = n * chunk_i - si
+    xp = jnp.pad(
+        corr, ((0, 0), (0, 0), (halo, halo + tail), (0, 0), (0, 0), (0, 0))
+    )
+
+    def do_slab(i0):
+        # xp row (i0) is global row (i0 - halo); slicing at i0 yields
+        # global rows [i0 - halo, i0 + chunk_i + halo).
+        xs = lax.dynamic_slice_in_dim(xp, i0, chunk_i + 2 * halo, axis=2)
+        y = _consensus_stack_prepadded(params, xs, False, i0, si, halo)
+        if symmetric:
+            y = y + _consensus_stack_prepadded(params, xs, True, i0, si, halo)
+        return y
+
+    outs = lax.map(do_slab, jnp.arange(n) * chunk_i)
+    cout = outs.shape[2]
+    out = jnp.moveaxis(outs, 0, 2).reshape(b, cout, n * chunk_i, sj, sk, sl)
+    return out[:, :, :si]
 
 
 def neigh_consensus_init(key, kernel_sizes, channels, dtype=jnp.float32):

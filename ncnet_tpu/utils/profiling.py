@@ -91,21 +91,31 @@ def timed_steady(fn, *xs, iters: int = 3):
     """Time fn(*xs): returns (first_s, steady_s, out).
 
     first_s covers compile + first run; steady_s is the mean of `iters`
-    further runs. Each run is closed by materializing one element of every
-    output leaf on the host: on tunneled backends (axon) block_until_ready
-    can return before execution completes, and only a host fetch reliably
-    closes the iteration (the technique bench.py uses). Shared by
-    tools/profile_inloc.py and tools/bench_conv4d.py so their numbers stay
-    comparable.
+    further runs. Each run is closed by materializing a host-side probe of
+    the outputs: on tunneled backends (axon) block_until_ready can return
+    before execution completes, and only a host fetch reliably closes the
+    iteration (the technique bench.py uses). The probe packs one element of
+    EVERY leaf into a single scalar fetch — per-leaf fetches serialize one
+    tunnel round trip each (~40 ms on axon), which inflated multi-output
+    stages by up to 10 round trips per iteration before round 2's
+    re-measurement. Shared by tools/profile_inloc.py and
+    tools/bench_conv4d.py so their numbers stay comparable.
     """
     import time as _time
 
     import jax
+    import jax.numpy as jnp
 
     def close(out):
-        for leaf in jax.tree.leaves(out):
-            if hasattr(leaf, "ravel"):
-                float(leaf.ravel()[0])
+        leaves = [l for l in jax.tree.leaves(out) if hasattr(l, "ravel")]
+        if not leaves:
+            return
+        # Async dispatches chain on device; only the final float() blocks,
+        # so the host pays one round trip per iteration, not one per leaf.
+        probe = leaves[0].ravel()[0].astype(jnp.float32)
+        for leaf in leaves[1:]:
+            probe = probe + leaf.ravel()[0].astype(jnp.float32)
+        float(probe)
 
     t0 = _time.perf_counter()
     out = fn(*xs)

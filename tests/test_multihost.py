@@ -77,6 +77,10 @@ def _proc_env(extra=None):
         os.environ,
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        # The probe scripts import ncnet_tpu; python puts the *script's*
+        # directory (tests/) on sys.path, not the cwd, so the repo root must
+        # travel explicitly — the suite must not depend on a venv install.
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
         **(extra or {}),
     )
     env.pop("PALLAS_AXON_POOL_IPS", None)

@@ -160,6 +160,71 @@ def test_neigh_consensus_symmetric(rng):
     )
 
 
+@pytest.mark.parametrize("symmetric", [True, False])
+@pytest.mark.parametrize(
+    "ksizes,channels,chunk", [((3, 3), (4, 1), 2), ((3, 3), (4, 1), 3), ((5, 3), (2, 1), 4)]
+)
+def test_neigh_consensus_chunked_matches_oneshot(rng, symmetric, ksizes, channels, chunk):
+    """The I-slab memory plan is numerically exact, including the global-edge
+    rows where the reference's per-layer zero padding (not carried halo
+    activations) must be reproduced, and a ragged final slab."""
+    key = jax.random.PRNGKey(3)
+    params = neigh_consensus_init(key, ksizes, channels)
+    corr = jnp.asarray(rng.randn(1, 1, 7, 5, 6, 5).astype(np.float32))
+    ref = neigh_consensus_apply(params, corr, symmetric=symmetric, chunk_i=0)
+    out = neigh_consensus_apply(params, corr, symmetric=symmetric, chunk_i=chunk)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_conv4d_bf16_single_conv_accumulation(rng):
+    """bf16 storage through the single-conv (stacked) strategy stays within
+    bf16 tolerance of the f32 oracle: guards the preferred_element_type
+    change — a backend accumulating inter-tile partials too coarsely would
+    blow past this bound on the 625-term 5^4 contraction."""
+    from ncnet_tpu.ops.conv4d import conv4d_prepadded
+
+    x = rng.randn(1, 1, 7, 6, 6, 6).astype(np.float32)
+    w = (rng.randn(5, 5, 5, 5, 1, 4).astype(np.float32) / 25.0)
+    bias = rng.randn(4).astype(np.float32) * 0.1
+    ref = conv4d_reference(jnp.asarray(x), jnp.asarray(w), jnp.asarray(bias))
+    xp = jnp.pad(
+        jnp.asarray(x, jnp.bfloat16), ((0, 0), (0, 0), (2, 2), (0, 0), (0, 0), (0, 0))
+    )
+    out = conv4d_prepadded(
+        xp, jnp.asarray(w), jnp.asarray(bias), strategy="conv2d_stacked"
+    )
+    assert out.dtype == jnp.bfloat16
+    scale = float(jnp.max(jnp.abs(ref)))
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=0.03 * scale
+    )
+
+
+def test_neigh_consensus_chunked_asymmetric_kernel(rng):
+    """Chunking with a kernel whose A-side and B-side extents differ: the
+    symmetric branches consume different I-halo and the smaller one is
+    trimmed back to the slab."""
+    w = rng.randn(5, 5, 3, 3, 1, 1).astype(np.float32) * 0.1
+    b = rng.randn(1).astype(np.float32) * 0.1
+    params = [{"weight": jnp.asarray(w), "bias": jnp.asarray(b)}]
+    corr = jnp.asarray(rng.randn(1, 1, 8, 5, 6, 5).astype(np.float32))
+    for symmetric in (True, False):
+        ref = neigh_consensus_apply(params, corr, symmetric=symmetric, chunk_i=0)
+        out = neigh_consensus_apply(params, corr, symmetric=symmetric, chunk_i=3)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_neigh_consensus_chunk_env_override(rng, monkeypatch):
+    """NCNET_CONSENSUS_CHUNK_I is read at trace time and matches one-shot."""
+    key = jax.random.PRNGKey(4)
+    params = neigh_consensus_init(key, (3,), (1,))
+    corr = jnp.asarray(rng.randn(1, 1, 5, 4, 4, 4).astype(np.float32))
+    ref = neigh_consensus_apply(params, corr, chunk_i=0)
+    monkeypatch.setenv("NCNET_CONSENSUS_CHUNK_I", "2")
+    out = neigh_consensus_apply(params, corr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
 @pytest.mark.parametrize("k", [2, 4])
 def test_maxpool4d_matches_oracle(rng, k):
     corr = rng.randn(1, 1, 2 * k, 2 * k, k, 2 * k).astype(np.float32)
