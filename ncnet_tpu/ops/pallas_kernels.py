@@ -26,6 +26,7 @@ same memory behavior on CPU and is the oracle for the kernel tests.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -62,6 +63,35 @@ def _decode_idx(idx, k):
     return a, b, c_, d
 
 
+def _pool_select(slab, kk: int, rows: int, tbc: int, out_dtype, pooled_ref, idx_ref):
+    """Shared max+argmax chain over the kk x kk offset slabs.
+
+    `slab(m, n)` returns the [rows, tbc] f32 correlation sub-slab for
+    within-cell offsets (m, n), already rounded through the storage dtype
+    for bit-parity with the unfused corr.astype(corr_dtype) -> maxpool4d
+    formulation (carry f32: the VPU has no sub-f32 vector compare, and
+    comparing the rounded values in f32 yields the identical order).
+
+    Arithmetic select: jnp.where with a splat-constant branch asks Mosaic
+    to relayout the i1 mask to a replicated layout, which is unsupported.
+    Strict '>' keeps first-wins tie-breaking (parity with maxpool4d's
+    min-over-argmax decode). One copy of these semantics serves both
+    kernels so the A/B impls cannot silently diverge.
+    """
+    best = slab(0, 0)
+    best_idx = jnp.zeros((rows, tbc), jnp.int32)
+    for m in range(kk):
+        for n in range(kk):
+            if m == 0 and n == 0:
+                continue
+            sub = slab(m, n)
+            sel = (sub > best).astype(jnp.int32)
+            best_idx = sel * (m * kk + n) + (1 - sel) * best_idx
+            best = jnp.maximum(sub, best)
+    pooled_ref[0] = best.astype(out_dtype)
+    idx_ref[0] = best_idx
+
+
 def _corr_pool_kernel(
     kk: int, va: int, tbc: int, out_dtype, fa_ref, fb_ref, pooled_ref, idx_ref
 ):
@@ -84,28 +114,42 @@ def _corr_pool_kernel(
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [va, tbc]
-        # Round through the storage dtype for bit-parity with the unfused
-        # corr.astype(corr_dtype) -> maxpool4d formulation, but carry f32:
-        # the VPU has no sub-f32 vector compare, and comparing the rounded
-        # values in f32 yields the identical order.
         return prod.astype(out_dtype).astype(jnp.float32)
 
-    best = slab(0, 0)
-    best_idx = jnp.zeros((va, tbc), jnp.int32)
-    for m in range(kk):
-        for n in range(kk):
-            if m == 0 and n == 0:
-                continue
-            sub = slab(m, n)
-            # Arithmetic select: jnp.where with a splat-constant branch asks
-            # Mosaic to relayout the i1 mask to a replicated layout, which
-            # is unsupported. Strict '>' keeps first-wins tie-breaking
-            # (parity with maxpool4d's min-over-argmax decode).
-            sel = (sub > best).astype(jnp.int32)
-            best_idx = sel * (m * kk + n) + (1 - sel) * best_idx
-            best = jnp.maximum(sub, best)
-    pooled_ref[0] = best.astype(out_dtype)
-    idx_ref[0] = best_idx
+    _pool_select(slab, kk, va, tbc, out_dtype, pooled_ref, idx_ref)
+
+
+def _corr_pool_kernel_bigdot(
+    kk: int, va_pad: int, tbc: int, out_dtype, fa_ref, fb_ref, pooled_ref, idx_ref
+):
+    """One grid step as ONE MXU dot: [kk*va_pad, c] x [c, kk*tbc].
+
+    The 16-small-dots kernel (_corr_pool_kernel) keeps every dot's M at
+    va=75 — sublane-misaligned and well under the 128-wide systolic
+    dimension. Padding va to a multiple of 8 host-side makes the fused
+    [kk*va_pad, kk*tbc] product legal to sub-slice with STATIC offsets
+    (sublane offsets m*va_pad, lane offsets n*tbc — tbc is a multiple of
+    128), so the whole correlation slab is one well-shaped MXU op and the
+    pooling compare/select chain runs over aligned views.
+
+    fa_ref: [1, kk, va_pad, c]; fb_ref: [kk, tbc, c];
+    pooled_ref/idx_ref: [1, va_pad, tbc]. Padded A rows carry zero
+    features -> zero scores; the caller slices them off.
+    """
+    fa = fa_ref[0].reshape(kk * va_pad, fa_ref.shape[3])
+    fb = fb_ref[...].reshape(kk * tbc, fb_ref.shape[2])
+    prod = jax.lax.dot_general(
+        fa,
+        fb,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [kk*va_pad, kk*tbc]
+
+    def slab(m, n):
+        s = prod[m * va_pad : (m + 1) * va_pad, n * tbc : (n + 1) * tbc]
+        return s.astype(out_dtype).astype(jnp.float32)
+
+    _pool_select(slab, kk, va_pad, tbc, out_dtype, pooled_ref, idx_ref)
 
 
 def _check_pool_shapes(feature_a, feature_b, k_size: int) -> None:
@@ -158,6 +202,7 @@ def fused_correlation_maxpool_pallas(
     tile_b_cells: int = 0,
     interpret: bool = False,
     corr_dtype=jnp.float32,
+    kernel_impl: str | None = None,
 ):
     """Fused all-pairs correlation + 4-D max pool, Pallas TPU kernel.
 
@@ -172,6 +217,10 @@ def fused_correlation_maxpool_pallas(
       corr_dtype: storage dtype the pooling runs in (bf16 for the
         half-precision InLoc config — parity with the unfused
         corr.astype -> maxpool4d path).
+      kernel_impl: 'bigdot' (default; one [kk*va_pad, c] x [c, kk*tbc] MXU
+        dot per grid step over sublane-padded A rows) or 'dots' (k^2 x k^2
+        separate [va, c] x [c, tbc] dots — the round-1 kernel, kept for
+        A/B). NCNET_PALLAS_CORR_IMPL overrides at trace time.
 
     Returns:
       (pooled [1, 1, UA, VA, WB, ZB] corr_dtype,
@@ -181,6 +230,10 @@ def fused_correlation_maxpool_pallas(
     if feature_a.shape[0] != 1:
         raise ValueError("batch must be 1 (vmap/loop outside)")
     _check_pool_shapes(feature_a, feature_b, k_size)
+    if kernel_impl is None:
+        kernel_impl = os.environ.get("NCNET_PALLAS_CORR_IMPL", "bigdot")
+    if kernel_impl not in ("bigdot", "dots"):
+        raise ValueError(f"unknown kernel_impl {kernel_impl!r}")
     k = k_size
     kk = k * k
     c = feature_a.shape[1]
@@ -189,32 +242,56 @@ def fused_correlation_maxpool_pallas(
     ua, va = ia // k, ja // k
     wb, zb = ib // k, jb // k
     n_cells_b = wb * zb
+    # Sublane-align the A rows for the bigdot kernel so the pooled
+    # sub-slices of the one fused product start at static multiples of 8.
+    va_pad = -(-va // 8) * 8 if kernel_impl == "bigdot" else va
 
     if tile_b_cells == 0:
-        tile_b_cells = auto_tile_b_cells(k, va, c, n_cells_b)
-    if not interpret and tile_b_cells < n_cells_b and tile_b_cells % 128:
-        # Mosaic-only constraint; the interpreter (CPU tests) has no tiling.
+        tile_b_cells = auto_tile_b_cells(k, va_pad, c, n_cells_b)
+        if kernel_impl == "bigdot" and tile_b_cells % 128:
+            # The bigdot kernel sub-slices its fused product at lane
+            # offsets n*tbc, which must be 128-aligned even when one tile
+            # spans every B cell (auto_tile_b_cells returns n_cells_b
+            # whole in that case). Round UP: the Pallas grid's cdiv
+            # tolerates a block wider than the array — the padded columns
+            # are the already-tested ragged-tail path.
+            tile_b_cells = -(-tile_b_cells // 128) * 128
+    if not interpret and tile_b_cells % 128 and not (
+        kernel_impl == "dots" and tile_b_cells >= n_cells_b
+    ):
+        # Mosaic-only constraint; the interpreter (CPU tests) has no
+        # tiling. The dots kernel indexes each [va, tbc] slab from vector
+        # offset 0, so a whole-array tile of any width is legal there.
         raise ValueError(
-            f"tile_b_cells {tile_b_cells} must be a multiple of 128 (or span "
-            f"all {n_cells_b} B cells)"
+            f"tile_b_cells {tile_b_cells} must be a multiple of 128 for "
+            f"kernel_impl={kernel_impl!r} (dots may instead span all "
+            f"{n_cells_b} B cells)"
         )
 
-    # [ua, kk, va, c] / [kk, cells, c]: offset-major leading dims so every
-    # block's trailing two dims either match the array dims or meet the
-    # (8, 128) tiling rule, and the kernel indexes offsets without slicing.
+    # [ua, kk, va(_pad), c] / [kk, cells, c]: offset-major leading dims so
+    # every block's trailing two dims either match the array dims or meet
+    # the (8, 128) tiling rule, and the kernel indexes offsets without
+    # slicing.
     fa_arr = _arrange_a(feature_a[0].astype(jnp.bfloat16), k).reshape(
         ua, kk, va, c
     )
+    if va_pad != va:
+        fa_arr = jnp.pad(fa_arr, ((0, 0), (0, 0), (0, va_pad - va), (0, 0)))
     fb_arr = _arrange_b(feature_b[0].astype(jnp.bfloat16), k)
 
     grid = (ua, pl.cdiv(n_cells_b, tile_b_cells))
-    kernel = partial(_corr_pool_kernel, kk, va, tile_b_cells, corr_dtype)
+    if kernel_impl == "bigdot":
+        kernel = partial(
+            _corr_pool_kernel_bigdot, kk, va_pad, tile_b_cells, corr_dtype
+        )
+    else:
+        kernel = partial(_corr_pool_kernel, kk, va, tile_b_cells, corr_dtype)
     pooled, idx = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec(
-                (1, kk, va, c), lambda i, j: (i, 0, 0, 0), memory_space=pltpu.VMEM
+                (1, kk, va_pad, c), lambda i, j: (i, 0, 0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
                 (kk, tile_b_cells, c), lambda i, j: (0, j, 0), memory_space=pltpu.VMEM
@@ -222,21 +299,21 @@ def fused_correlation_maxpool_pallas(
         ],
         out_specs=[
             pl.BlockSpec(
-                (1, va, tile_b_cells), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
+                (1, va_pad, tile_b_cells), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (1, va, tile_b_cells), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
+                (1, va_pad, tile_b_cells), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
             ),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((ua, va, n_cells_b), corr_dtype),
-            jax.ShapeDtypeStruct((ua, va, n_cells_b), jnp.int32),
+            jax.ShapeDtypeStruct((ua, va_pad, n_cells_b), corr_dtype),
+            jax.ShapeDtypeStruct((ua, va_pad, n_cells_b), jnp.int32),
         ],
         interpret=interpret,
     )(fa_arr, fb_arr)
 
-    pooled = pooled.reshape(1, 1, ua, va, wb, zb)
-    idx = idx.reshape(1, 1, ua, va, wb, zb)
+    pooled = pooled[:, :va].reshape(1, 1, ua, va, wb, zb)
+    idx = idx[:, :va].reshape(1, 1, ua, va, wb, zb)
     deltas = _decode_idx(idx, k)
     return pooled, deltas
 

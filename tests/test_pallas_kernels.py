@@ -36,12 +36,15 @@ def test_fused_xla_matches_oracle(rng, k):
         np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
 
 
-def test_fused_pallas_interpret_matches_oracle(rng):
+@pytest.mark.parametrize("impl", ["bigdot", "dots"])
+def test_fused_pallas_interpret_matches_oracle(rng, impl):
     k = 2
     fa = jnp.asarray(rng.randn(1, 16, 8, 6).astype(np.float32))
     fb = jnp.asarray(rng.randn(1, 16, 4, 10).astype(np.float32))
     ref_pooled, ref_deltas = _oracle(fa, fb, k)
-    pooled, deltas = fused_correlation_maxpool_pallas(fa, fb, k, interpret=True)
+    pooled, deltas = fused_correlation_maxpool_pallas(
+        fa, fb, k, interpret=True, kernel_impl=impl
+    )
     np.testing.assert_allclose(
         np.asarray(pooled), np.asarray(ref_pooled), atol=1e-5
     )
@@ -49,14 +52,15 @@ def test_fused_pallas_interpret_matches_oracle(rng):
         np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
 
 
-def test_fused_pallas_tiling(rng):
+@pytest.mark.parametrize("impl", ["bigdot", "dots"])
+def test_fused_pallas_tiling(rng, impl):
     """Multiple B tiles per row exercise the second grid dimension."""
     k = 2
     fa = jnp.asarray(rng.randn(1, 8, 4, 4).astype(np.float32))
     fb = jnp.asarray(rng.randn(1, 8, 8, 8).astype(np.float32))
     ref_pooled, ref_deltas = _oracle(fa, fb, k)
     pooled, deltas = fused_correlation_maxpool_pallas(
-        fa, fb, k, tile_b_cells=4, interpret=True
+        fa, fb, k, tile_b_cells=4, interpret=True, kernel_impl=impl
     )
     np.testing.assert_allclose(
         np.asarray(pooled), np.asarray(ref_pooled), atol=1e-5
@@ -65,7 +69,8 @@ def test_fused_pallas_tiling(rng):
         np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
 
 
-def test_fused_pallas_ragged_tail_tile(rng):
+@pytest.mark.parametrize("impl", ["bigdot", "dots"])
+def test_fused_pallas_ragged_tail_tile(rng, impl):
     """A tile width that does not divide the B cell count: the padded tail
     block must not contaminate real outputs."""
     k = 2
@@ -73,7 +78,7 @@ def test_fused_pallas_ragged_tail_tile(rng):
     fb = jnp.asarray(rng.randn(1, 8, 8, 8).astype(np.float32))  # 16 B cells
     ref_pooled, ref_deltas = _oracle(fa, fb, k)
     pooled, deltas = fused_correlation_maxpool_pallas(
-        fa, fb, k, tile_b_cells=6, interpret=True
+        fa, fb, k, tile_b_cells=6, interpret=True, kernel_impl=impl
     )
     np.testing.assert_allclose(
         np.asarray(pooled), np.asarray(ref_pooled), atol=1e-5
@@ -132,6 +137,32 @@ def test_auto_tile_b_cells_valid_at_workload_shapes():
             + kk * kk * va * tile * 4
         )
         assert step_bytes < 16 * 1024 * 1024, (k, va, c, n_cells, step_bytes)
+
+
+def test_fused_bigdot_auto_tile_small_input_lane_alignment(rng):
+    """Small inputs where auto_tile_b_cells spans all B cells (n_cells_b
+    not a multiple of 128): the bigdot path must round its tile UP to a
+    128 multiple — its fused-product lane slices at n*tbc are only legal
+    when 128-aligned — and the resulting whole-array padded block must not
+    contaminate outputs (numerics checked here; alignment enforced by the
+    guard it shares with hardware lowering)."""
+    from ncnet_tpu.ops.pallas_kernels import (
+        fused_correlation_maxpool_pallas,
+        fused_correlation_maxpool_xla,
+    )
+
+    fa = jnp.asarray(rng.randn(1, 512, 4, 24).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 512, 24, 24).astype(np.float32))  # 144 cells
+    p, d = fused_correlation_maxpool_pallas(
+        fa, fb, 2, interpret=True, corr_dtype=jnp.bfloat16,
+        kernel_impl="bigdot",
+    )
+    px, dx = fused_correlation_maxpool_xla(fa, fb, 2, corr_dtype=jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(p, np.float32), np.asarray(px, np.float32)
+    )
+    for a, b in zip(d, dx):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_fused_kernel_ragged_tile_tail():

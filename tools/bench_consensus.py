@@ -1,0 +1,116 @@
+"""A/B the consensus-stage memory plans and Conv4d strategies on device.
+
+Times mutual->symmetric-consensus->mutual at the InLoc post-pool shape
+([1,1,100,75,100,75] bf16, 3^4 kernels, 1->16->1 channels) across
+chunk_i values and NCNET_CONV4D_STRATEGY choices, with R applications
+chained inside one jit (lax.scan) so the ~40 ms tunnel round trip does
+not floor the measurement (see tools/bench_corr_pool.py).
+
+Usage:
+    python tools/bench_consensus.py [--scale 1.0] [--reps 4] [--iters 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--dial_timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ncnet_tpu.utils.profiling import (
+        dial_devices,
+        setup_compile_cache,
+        timed_steady,
+    )
+
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        log("backend dial timed out; aborting")
+        os._exit(2)
+    log(f"devices: {devices}")
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ncnet_tpu.ops.conv4d import neigh_consensus_apply, neigh_consensus_init
+    from ncnet_tpu.ops.mutual import mutual_matching
+
+    ii = max(int(100 * args.scale) // 4 * 4, 8)
+    jj = max(int(75 * args.scale) // 4 * 4, 8)
+    log(f"consensus stage at [1,1,{ii},{jj},{ii},{jj}] bf16, reps={args.reps}")
+
+    params = neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (16, 1))
+    corr = jax.random.normal(
+        jax.random.PRNGKey(1), (1, 1, ii, jj, ii, jj), jnp.float32
+    ).astype(jnp.bfloat16)
+
+    # (label, chunk_i, strategy env or None)
+    cases = [
+        ("chunk3-auto   (round-2 default)", 3, None),
+        ("chunk7-auto", 7, None),
+        ("chunk13-auto", 13, None),
+        ("chunk25-auto", 25, None),
+        ("chunk13-conv3d", 13, "conv3d"),
+        ("oneshot-conv3d", 0, "conv3d"),
+        ("oneshot-stacked+conv3d", 0, None),  # env set below per case
+    ]
+
+    for label, chunk_i, strat in cases:
+        prev = os.environ.pop("NCNET_CONV4D_STRATEGY", None)
+        if strat:
+            os.environ["NCNET_CONV4D_STRATEGY"] = strat
+        elif label.startswith("oneshot-stacked"):
+            # layer-wise auto at full tensor OOMs for conv2d layer 2; this
+            # case asks whether stacked-l1 + conv3d-l2 fits and wins.
+            os.environ["NCNET_CONV4D_STRATEGY"] = "conv3d"
+
+        def stage(c, chunk_i=chunk_i):
+            c = mutual_matching(c)
+            c = neigh_consensus_apply(
+                params, c, symmetric=True, chunk_i=chunk_i
+            )
+            return mutual_matching(c)
+
+        def reps_fn(c):
+            def body(carry, _):
+                out = stage(c * (1.0 + carry * 0.0))
+                return out.ravel()[0].astype(jnp.float32), ()
+
+            out, _ = lax.scan(body, jnp.float32(0), None, length=args.reps)
+            return out
+
+        try:
+            first, dt, _ = timed_steady(jax.jit(reps_fn), corr, iters=args.iters)
+            log(f"{label:32s} first={first:6.2f}s "
+                f"-> {dt * 1000 / args.reps:7.1f}ms/app (+~RTT/iter amortized)")
+        except Exception as exc:  # noqa: BLE001
+            log(f"{label:32s} FAILED: {type(exc).__name__}: "
+                f"{str(exc).splitlines()[0][:120]}")
+        finally:
+            os.environ.pop("NCNET_CONV4D_STRATEGY", None)
+            if prev is not None:
+                os.environ["NCNET_CONV4D_STRATEGY"] = prev
+
+
+if __name__ == "__main__":
+    main()

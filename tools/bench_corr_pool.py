@@ -1,0 +1,127 @@
+"""A/B the fused correlation+maxpool formulations on the live backend.
+
+Times each candidate at the InLoc feature shape (200x150, k=2, bf16
+storage) with R repetitions chained inside ONE jit via lax.scan — a
+tunneled backend costs ~40 ms per host round trip, so per-call timing
+has an ~85 ms floor that would swamp a sub-100 ms kernel. Each scan
+iteration perturbs the input with the previous iteration's probe scalar
+(x * (1 + eps*0) pattern) so XLA cannot hoist the loop body.
+
+Candidates:
+  * pallas   — ops.pallas_kernels.fused_correlation_maxpool_pallas
+  * xla      — the slab-scan fallback (same never-materialize property)
+  * unfused  — plain einsum correlation + ops.pool4d.maxpool4d; the
+               pre-pool tensor (1.8 GB bf16 at InLoc shapes) DOES
+               materialize — affordable since the consensus stage's
+               round-2 memory plan freed the HBM headroom.
+
+Usage:
+    python tools/bench_corr_pool.py [--scale 1.0] [--reps 4] [--iters 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--reps", type=int, default=4,
+                   help="kernel applications chained inside one jit")
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--dial_timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ncnet_tpu.utils.profiling import (
+        dial_devices,
+        setup_compile_cache,
+        timed_steady,
+    )
+
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        log("backend dial timed out; aborting")
+        os._exit(2)
+    log(f"devices: {devices}")
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ncnet_tpu.ops.correlation import feature_correlation
+    from ncnet_tpu.ops.pool4d import maxpool4d
+    from ncnet_tpu.ops.pallas_kernels import (
+        fused_correlation_maxpool_pallas,
+        fused_correlation_maxpool_xla,
+    )
+
+    fh = int(200 * args.scale)
+    fw = int(150 * args.scale)
+    c = 1024
+    log(f"features {fh}x{fw} c={c} k=2 bf16 storage, reps={args.reps}")
+
+    fa = jax.random.normal(jax.random.PRNGKey(0), (1, c, fh, fw), jnp.float32)
+    fb = jax.random.normal(jax.random.PRNGKey(1), (1, c, fh, fw), jnp.float32)
+
+    def unfused(a, b):
+        corr = feature_correlation(a, b, compute_dtype=jnp.bfloat16).astype(
+            jnp.bfloat16
+        )
+        return maxpool4d(corr, 2)
+
+    candidates = {
+        "pallas_bigdot": lambda a, b: fused_correlation_maxpool_pallas(
+            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot"
+        ),
+        "pallas_dots": lambda a, b: fused_correlation_maxpool_pallas(
+            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="dots"
+        ),
+        "pallas_bigdot_t768": lambda a, b: fused_correlation_maxpool_pallas(
+            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot",
+            tile_b_cells=768,
+        ),
+        "xla_slab": lambda a, b: fused_correlation_maxpool_xla(
+            a, b, k_size=2, corr_dtype=jnp.bfloat16
+        ),
+        "unfused": unfused,
+    }
+
+    for name, fn in candidates.items():
+        def reps_fn(a, b, fn=fn):
+            def body(carry, _):
+                # Data dependence on the previous iteration defeats CSE;
+                # the multiply is one elementwise pass, ~0.15 ms at this
+                # size — negligible against the kernels under test.
+                pooled, deltas = fn(a * (1.0 + carry * 0.0), b)
+                probe = pooled.ravel()[0].astype(jnp.float32)
+                return probe, ()
+
+            out, _ = lax.scan(body, jnp.float32(0), None, length=args.reps)
+            return out
+
+        try:
+            first, dt, _ = timed_steady(
+                jax.jit(reps_fn), fa, fb, iters=args.iters
+            )
+            log(f"{name:10s} first={first:6.2f}s total={dt * 1000:8.1f}ms "
+                f"-> {dt * 1000 / args.reps:7.1f}ms/app (incl ~one RTT/iter)")
+        except Exception as exc:  # noqa: BLE001
+            log(f"{name:10s} FAILED: {type(exc).__name__}: {exc}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Strictly-serial TPU experiment queue (round 2).
+#
+# The axon tunnel is single-session: TWO concurrent JAX clients wedge it
+# for ~10-25 min of lease expiry (observed 2026-07-30 when a smoke test
+# and a bench dialed together). This queue is the only sanctioned way to
+# run TPU jobs: one process at a time, dial-probe before each batch,
+# retry with sleeps while the tunnel recovers.
+cd /root/repo || exit 1
+OUT=docs/tpu_r02
+mkdir -p "$OUT"
+for n in $(seq 1 60); do
+  echo "=== queue attempt $n $(date -u +%FT%TZ) ===" | tee -a "$OUT/queue.log"
+  if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "=== tunnel up; running serial queue ===" | tee -a "$OUT/queue.log"
+    python tools/bench_corr_pool.py --dial_timeout 300 \
+      > "$OUT/bench_corr_pool.txt" 2>&1
+    echo "--- corr_pool rc=$? ---" >> "$OUT/queue.log"
+    python tools/bench_consensus.py --dial_timeout 300 \
+      > "$OUT/bench_consensus.txt" 2>&1
+    echo "--- consensus rc=$? ---" >> "$OUT/queue.log"
+    python tools/pallas_tpu_smoke.py --dial_timeout 300 \
+      > "$OUT/pallas_smoke.txt" 2>&1
+    echo "--- smoke rc=$? ---" >> "$OUT/queue.log"
+    NCNET_BENCH_DIAL_TIMEOUT=300 python bench.py \
+      > "$OUT/bench_last.json" 2>> "$OUT/queue.log"
+    echo "--- bench rc=$? ---" >> "$OUT/queue.log"
+    echo "=== queue DONE $(date -u +%FT%TZ) ===" | tee -a "$OUT/queue.log"
+    exit 0
+  fi
+  echo "tunnel down; sleeping 240s" >> "$OUT/queue.log"
+  sleep 240
+done
+exit 3
