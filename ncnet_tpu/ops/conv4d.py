@@ -201,20 +201,21 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     return out.astype(x.dtype)
 
 
-def conv4d(x, weight, bias=None):
+def conv4d(x, weight, bias=None, *, strategy: str | None = None):
     """Apply a 4-D convolution with size-preserving zero padding.
 
     Args:
       x: [b, cin, I, J, K, L] correlation-tensor activations.
       weight: [kI, kJ, kK, kL, cin, cout] filters (odd kernel dims).
       bias: optional [cout].
+      strategy: optional decomposition override (see conv4d_prepadded).
 
     Returns:
       [b, cout, I, J, K, L].
     """
     pad_i = weight.shape[0] // 2
     xp = jnp.pad(x, ((0, 0), (0, 0), (pad_i, pad_i), (0, 0), (0, 0), (0, 0)))
-    return conv4d_prepadded(xp, weight, bias)
+    return conv4d_prepadded(xp, weight, bias, strategy=strategy)
 
 
 def conv4d_reference(x, weight, bias=None):
@@ -263,7 +264,8 @@ _CHUNK_THRESHOLD_ELEMS = 2**28
 _CHUNK_TARGET_ELEMS = 2**26
 
 
-def _consensus_stack_prepadded(params, x, swap, i0, total_i, halo):
+def _consensus_stack_prepadded(params, x, swap, i0, total_i, halo,
+                               strategies=None):
     """Run the Conv4d+ReLU stack on an I-slab carrying `halo` extra rows.
 
     x holds rows [i0 - halo, i0 + s + halo) of the (zero-padded) global
@@ -278,7 +280,10 @@ def _consensus_stack_prepadded(params, x, swap, i0, total_i, halo):
     h = halo
     for li, layer in enumerate(params):
         w = swap_ab_weight(layer["weight"]) if swap else layer["weight"]
-        x = conv4d_prepadded(x, w, layer["bias"])
+        x = conv4d_prepadded(
+            x, w, layer["bias"],
+            strategy=strategies[li] if strategies else None,
+        )
         x = jax.nn.relu(x)
         h -= w.shape[0] // 2
         if li < len(params) - 1:
@@ -293,7 +298,9 @@ def _consensus_stack_prepadded(params, x, swap, i0, total_i, halo):
     return x
 
 
-def neigh_consensus_apply(params, corr, *, symmetric: bool = True, chunk_i=None):
+def neigh_consensus_apply(
+    params, corr, *, symmetric: bool = True, chunk_i=None, strategies=None
+):
     """Apply the neighbourhood-consensus Conv4d+ReLU stack.
 
     Args:
@@ -318,10 +325,25 @@ def neigh_consensus_apply(params, corr, *, symmetric: bool = True, chunk_i=None)
         sharding in parallel/corr_sharding.py. An int forces that many
         rows per slab; 0 forces the one-shot path. The
         NCNET_CONSENSUS_CHUNK_I env var (read at trace time) overrides.
+      strategies: optional per-layer Conv4d decomposition overrides (one
+        entry per layer, each a conv4d_prepadded strategy name or None).
+        The TPU sweep found different winners — and different *legal*
+        formulations — per layer (docs/NEXT.md), which a single global
+        NCNET_CONV4D_STRATEGY cannot express.
 
     Returns:
       [b, c_last, iA, jA, iB, jB].
     """
+    if strategies is not None:
+        if isinstance(strategies, str) or len(strategies) != len(params):
+            # Guard the migration from the single global strategy string: a
+            # bare "conv3d" would be indexed per character and fail deep in
+            # conv4d_prepadded as "unknown strategy 'c'".
+            raise ValueError(
+                "strategies must be a sequence with one entry per layer "
+                f"({len(params)}), e.g. ('conv2d_stacked', 'conv3d'); got "
+                f"{strategies!r}"
+            )
     b, cin, si, sj, sk, sl = corr.shape
     # The swapped symmetric branch convolves I with each kernel's K-extent
     # (swap_ab_weight), so the carried halo must cover both branch's
@@ -347,9 +369,12 @@ def neigh_consensus_apply(params, corr, *, symmetric: bool = True, chunk_i=None)
             chunk_i = max(1, _CHUNK_TARGET_ELEMS // per_row - 2 * halo)
 
     def stack(x, swap: bool):
-        for layer in params:
+        for li, layer in enumerate(params):
             w = swap_ab_weight(layer["weight"]) if swap else layer["weight"]
-            x = conv4d(x, w, layer["bias"])
+            x = conv4d(
+                x, w, layer["bias"],
+                strategy=strategies[li] if strategies else None,
+            )
             x = jax.nn.relu(x)
         return x
 
@@ -368,9 +393,13 @@ def neigh_consensus_apply(params, corr, *, symmetric: bool = True, chunk_i=None)
         # xp row (i0) is global row (i0 - halo); slicing at i0 yields
         # global rows [i0 - halo, i0 + chunk_i + halo).
         xs = lax.dynamic_slice_in_dim(xp, i0, chunk_i + 2 * halo, axis=2)
-        y = _consensus_stack_prepadded(params, xs, False, i0, si, halo)
+        y = _consensus_stack_prepadded(
+            params, xs, False, i0, si, halo, strategies
+        )
         if symmetric:
-            y = y + _consensus_stack_prepadded(params, xs, True, i0, si, halo)
+            y = y + _consensus_stack_prepadded(
+                params, xs, True, i0, si, halo, strategies
+            )
         return y
 
     outs = lax.map(do_slab, jnp.arange(n) * chunk_i)

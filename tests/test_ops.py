@@ -365,3 +365,18 @@ def test_conv4d_strategies_agree():
     xp1 = jnp.pad(x1, ((0, 0), (0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
     out1 = conv4d_prepadded(xp1, w1, b1, strategy="auto")
     assert jnp.allclose(out1, ref1, atol=1e-4)
+
+
+@pytest.mark.parametrize("chunk", [0, 3])
+def test_neigh_consensus_per_layer_strategies(rng, chunk):
+    """Per-layer strategy overrides agree with the layer-wise auto default in
+    both the one-shot and chunked memory plans (the knob exists because the
+    TPU sweep found different legal/winning formulations per layer)."""
+    key = jax.random.PRNGKey(9)
+    params = neigh_consensus_init(key, (3, 3), (4, 1))
+    corr = jnp.asarray(rng.randn(1, 1, 7, 5, 6, 5).astype(np.float32))
+    ref = neigh_consensus_apply(params, corr, chunk_i=chunk)
+    out = neigh_consensus_apply(
+        params, corr, chunk_i=chunk, strategies=("conv2d_stacked", "conv3d")
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

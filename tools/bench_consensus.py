@@ -2,9 +2,11 @@
 
 Times mutual->symmetric-consensus->mutual at the InLoc post-pool shape
 ([1,1,100,75,100,75] bf16, 3^4 kernels, 1->16->1 channels) across
-chunk_i values and NCNET_CONV4D_STRATEGY choices, with R applications
+chunk_i values and per-layer Conv4d strategy mixes, with R applications
 chained inside one jit (lax.scan) so the ~40 ms tunnel round trip does
-not floor the measurement (see tools/bench_corr_pool.py).
+not floor the measurement (see tools/bench_corr_pool.py). The
+NCNET_CONV4D_STRATEGY env var is cleared for the whole run so the
+'auto'-labeled cases really measure layer-wise auto.
 
 Usage:
     python tools/bench_consensus.py [--scale 1.0] [--reps 4] [--iters 3]
@@ -64,36 +66,39 @@ def main(argv=None):
         jax.random.PRNGKey(1), (1, 1, ii, jj, ii, jj), jnp.float32
     ).astype(jnp.bfloat16)
 
-    # (label, chunk_i, strategy env or None)
+    # Isolation: the per-backend env override must not leak into the
+    # 'auto'-labeled cases (conv4d_prepadded falls back to os.environ when
+    # a layer's strategy is None).
+    os.environ.pop("NCNET_CONV4D_STRATEGY", None)
+
+    # (label, chunk_i, per-layer strategies or None for layer-wise 'auto')
     cases = [
         ("chunk3-auto   (round-2 default)", 3, None),
         ("chunk7-auto", 7, None),
         ("chunk13-auto", 13, None),
         ("chunk25-auto", 25, None),
-        ("chunk13-conv3d", 13, "conv3d"),
-        ("oneshot-conv3d", 0, "conv3d"),
-        ("oneshot-stacked+conv3d", 0, None),  # env set below per case
+        ("chunk13-conv3d", 13, ("conv3d", "conv3d")),
+        ("oneshot-conv3d", 0, ("conv3d", "conv3d")),
+        # conv2d OOMs the one-shot layer 2 at full scale; does the
+        # stacked-l1 + conv3d-l2 mix fit and win?
+        ("oneshot-stacked+conv3d", 0, ("conv2d_stacked", "conv3d")),
     ]
 
-    for label, chunk_i, strat in cases:
-        prev = os.environ.pop("NCNET_CONV4D_STRATEGY", None)
-        if strat:
-            os.environ["NCNET_CONV4D_STRATEGY"] = strat
-        elif label.startswith("oneshot-stacked"):
-            # layer-wise auto at full tensor OOMs for conv2d layer 2; this
-            # case asks whether stacked-l1 + conv3d-l2 fits and wins.
-            os.environ["NCNET_CONV4D_STRATEGY"] = "conv3d"
+    for label, chunk_i, strats in cases:
 
-        def stage(c, chunk_i=chunk_i):
+        def stage(c, chunk_i=chunk_i, strats=strats):
             c = mutual_matching(c)
             c = neigh_consensus_apply(
-                params, c, symmetric=True, chunk_i=chunk_i
+                params, c, symmetric=True, chunk_i=chunk_i, strategies=strats
             )
             return mutual_matching(c)
 
-        def reps_fn(c):
+        def reps_fn(c, stage=stage):
             def body(carry, _):
-                out = stage(c * (1.0 + carry * 0.0))
+                # The CSE-defeating perturbation must not promote: a f32
+                # carry times the bf16 tensor would silently benchmark the
+                # whole stage at f32 (2x the production HBM traffic).
+                out = stage(c * (1.0 + carry * 0.0).astype(c.dtype))
                 return out.ravel()[0].astype(jnp.float32), ()
 
             out, _ = lax.scan(body, jnp.float32(0), None, length=args.reps)
@@ -106,10 +111,6 @@ def main(argv=None):
         except Exception as exc:  # noqa: BLE001
             log(f"{label:32s} FAILED: {type(exc).__name__}: "
                 f"{str(exc).splitlines()[0][:120]}")
-        finally:
-            os.environ.pop("NCNET_CONV4D_STRATEGY", None)
-            if prev is not None:
-                os.environ["NCNET_CONV4D_STRATEGY"] = prev
 
 
 if __name__ == "__main__":

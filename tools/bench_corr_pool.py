@@ -107,7 +107,13 @@ def main(argv=None):
                 # the multiply is one elementwise pass, ~0.15 ms at this
                 # size — negligible against the kernels under test.
                 pooled, deltas = fn(a * (1.0 + carry * 0.0), b)
+                # Probe EVERY output: an unprobed deltas would let XLA
+                # DCE the argmax chain out of the non-Pallas candidates
+                # (a pallas_call is opaque and always pays it) — a skewed
+                # A/B. Matches timed_steady's every-leaf probe rule.
                 probe = pooled.ravel()[0].astype(jnp.float32)
+                for d in jax.tree.leaves(deltas):
+                    probe = probe + d.ravel()[0].astype(jnp.float32)
                 return probe, ()
 
             out, _ = lax.scan(body, jnp.float32(0), None, length=args.reps)

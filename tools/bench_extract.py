@@ -1,0 +1,132 @@
+"""A/B match-extraction formulations at the InLoc post-consensus shape.
+
+corr_to_matches was the slowest stage of the first real-TPU profile
+(754 ms — reductions over a non-minor axis of the 56 M-element tensor);
+the minor-axis rewrite landed blind between tunnel windows. This tool
+times the current formulation and its pieces so the next regression is
+attributable: per-direction cost, the transpose, the softmax logsumexp
+pass, and the delta4d relocalization gathers.
+
+Reps are chained inside one jit via lax.scan (see bench_corr_pool.py:
+per-call timing through the tunnel has an ~85 ms floor).
+
+Usage:
+    python tools/bench_extract.py [--scale 1.0] [--reps 4] [--iters 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--dial_timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ncnet_tpu.utils.profiling import (
+        dial_devices,
+        setup_compile_cache,
+        timed_steady,
+    )
+
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        log("backend dial timed out; aborting")
+        os._exit(2)
+    log(f"devices: {devices}")
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ncnet_tpu.evals.inloc import inloc_device_matches
+    from ncnet_tpu.ops.matches import corr_to_matches
+
+    ii = max(int(100 * args.scale) // 4 * 4, 8)
+    jj = max(int(75 * args.scale) // 4 * 4, 8)
+    log(f"corr [1,1,{ii},{jj},{ii},{jj}] bf16, k=2, reps={args.reps}")
+
+    key = jax.random.PRNGKey(0)
+    corr = jax.random.normal(
+        key, (1, 1, ii, jj, ii, jj), jnp.float32
+    ).astype(jnp.bfloat16)
+    deltas = tuple(
+        jax.random.randint(jax.random.PRNGKey(7 + i), corr.shape, 0, 2)
+        for i in range(4)
+    )
+
+    def full(c):
+        return inloc_device_matches(c, delta4d=deltas, k_size=2)
+
+    def dir_b2a(c):  # native minor-axis reduction, no transpose
+        return corr_to_matches(
+            c, delta4d=deltas, k_size=2, do_softmax=True, scale="positive",
+            invert_matching_direction=True,
+        )
+
+    def dir_a2b(c):  # transposed direction
+        return corr_to_matches(
+            c, delta4d=deltas, k_size=2, do_softmax=True, scale="positive",
+        )
+
+    def dir_a2b_nosoftmax(c):
+        return corr_to_matches(
+            c, delta4d=deltas, k_size=2, do_softmax=False, scale="positive",
+        )
+
+    def dir_b2a_nodelta(c):
+        return corr_to_matches(
+            c, k_size=2, do_softmax=True, scale="positive",
+            invert_matching_direction=True,
+        )
+
+    candidates = {
+        "full both dirs+sort": full,
+        "dir B->A (minor)": dir_b2a,
+        "dir A->B (transpose)": dir_a2b,
+        "dir A->B no-softmax": dir_a2b_nosoftmax,
+        "dir B->A no-delta": dir_b2a_nodelta,
+    }
+
+    for name, fn in candidates.items():
+        def reps_fn(c, fn=fn):
+            def body(carry, _):
+                # astype: a f32 carry would promote the bf16 tensor and
+                # benchmark extraction at double the production HBM traffic.
+                out = fn(c * (1.0 + carry * 0.0).astype(c.dtype))
+                probe = sum(
+                    l.ravel()[0].astype(jnp.float32) for l in jax.tree.leaves(out)
+                )
+                return probe, ()
+
+            out, _ = lax.scan(body, jnp.float32(0), None, length=args.reps)
+            return out
+
+        try:
+            first, dt, _ = timed_steady(jax.jit(reps_fn), corr, iters=args.iters)
+            log(f"{name:22s} first={first:6.2f}s "
+                f"-> {dt * 1000 / args.reps:7.1f}ms/app")
+        except Exception as exc:  # noqa: BLE001
+            log(f"{name:22s} FAILED: {type(exc).__name__}: "
+                f"{str(exc).splitlines()[0][:120]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,16 +13,19 @@ for n in $(seq 1 60); do
   echo "=== queue attempt $n $(date -u +%FT%TZ) ===" | tee -a "$OUT/queue.log"
   if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "=== tunnel up; running serial queue ===" | tee -a "$OUT/queue.log"
-    python tools/bench_corr_pool.py --dial_timeout 300 \
+    # Every job under `timeout`: a tunnel wedge AFTER a successful dial
+    # otherwise hangs the job in a device fetch forever and starves the
+    # rest of the queue (the dial watchdog only bounds the dial).
+    timeout 1800 python tools/bench_corr_pool.py --dial_timeout 300 \
       > "$OUT/bench_corr_pool.txt" 2>&1
     echo "--- corr_pool rc=$? ---" >> "$OUT/queue.log"
-    python tools/bench_consensus.py --dial_timeout 300 \
+    timeout 1800 python tools/bench_consensus.py --dial_timeout 300 \
       > "$OUT/bench_consensus.txt" 2>&1
     echo "--- consensus rc=$? ---" >> "$OUT/queue.log"
-    python tools/pallas_tpu_smoke.py --dial_timeout 300 \
+    timeout 1800 python tools/pallas_tpu_smoke.py --dial_timeout 300 \
       > "$OUT/pallas_smoke.txt" 2>&1
     echo "--- smoke rc=$? ---" >> "$OUT/queue.log"
-    NCNET_BENCH_DIAL_TIMEOUT=300 python bench.py \
+    NCNET_BENCH_DIAL_TIMEOUT=300 timeout 1800 python bench.py \
       > "$OUT/bench_last.json" 2>> "$OUT/queue.log"
     echo "--- bench rc=$? ---" >> "$OUT/queue.log"
     echo "=== queue DONE $(date -u +%FT%TZ) ===" | tee -a "$OUT/queue.log"

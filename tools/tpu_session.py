@@ -1,0 +1,102 @@
+"""One-dial TPU experiment session: every queued experiment in ONE process.
+
+The axon tunnel is single-session and wedges for 10-25 min when a client
+disconnects uncleanly — including the lease linger after a *clean* exit
+(observed 2026-07-31 01:03: a bench exited rc=0 and the very next process's
+dial hung for its full watchdog). Running each tool as its own process costs
+one dial per tool and one wedge risk per handoff; this driver dials once and
+then calls each tool's main() in-process — jax caches the initialized
+backend, so the tools' own dial_devices() calls return instantly.
+
+Phases run in value order and are individually fenced: a failure in one
+records the traceback and moves on, so a mid-session tunnel death still
+leaves the highest-value numbers on disk.
+
+Usage:
+    python tools/tpu_session.py [--dial_timeout 600] [--skip phase,phase]
+Phases: corr_pool, consensus, extract, profile, bench.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[session {time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def _load(name):
+    path = os.path.join(os.path.dirname(__file__), name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dial_timeout", type=float, default=600.0)
+    p.add_argument("--skip", type=str, default="",
+                   help="comma-separated phase names to skip")
+    p.add_argument("--iters", type=int, default=3)
+    args = p.parse_args(argv)
+    skip = set(filter(None, args.skip.split(",")))
+
+    from ncnet_tpu.utils.profiling import dial_devices, setup_compile_cache
+
+    setup_compile_cache()
+    log(f"dialing (watchdog {args.dial_timeout:.0f}s)...")
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        log("dial timed out; aborting session")
+        return 2
+    log(f"devices: {devices}")
+
+    # Tools re-dial internally; the backend is already up, so give them a
+    # short watchdog — if the tunnel died between phases we want to move on,
+    # not burn 10 minutes per remaining phase.
+    phases = [
+        ("corr_pool", "bench_corr_pool",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("consensus", "bench_consensus",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("extract", "bench_extract",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("profile", "profile_inloc",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+    ]
+    for label, modname, phase_argv in phases:
+        if label in skip:
+            log(f"=== {label}: SKIPPED ===")
+            continue
+        log(f"=== {label} ===")
+        try:
+            _load(modname).main(phase_argv)
+        except SystemExit as exc:  # tools os._exit on dial fail only
+            log(f"{label} exited: {exc}")
+        except Exception:  # noqa: BLE001
+            log(f"{label} FAILED:\n{traceback.format_exc()}")
+
+    if "bench" not in skip:
+        log("=== bench (headline JSON on stdout) ===")
+        try:
+            os.environ["NCNET_BENCH_DIAL_TIMEOUT"] = "120"
+            _load("../bench").main()
+        except Exception:  # noqa: BLE001
+            log(f"bench FAILED:\n{traceback.format_exc()}")
+    log("session DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Probe-then-session loop: dial-probe the tunnel with a short subprocess,
+# and the moment it answers, run the full one-dial experiment session
+# (tools/tpu_session.py). Exactly one JAX client at a time; 300 s between
+# probe attempts (a wedged tunnel needs 10-25 min to clear, and hammering
+# it with probes extends the wedge).
+cd /root/repo || exit 1
+OUT=docs/tpu_r02
+mkdir -p "$OUT"
+for n in $(seq 1 80); do
+  echo "=== session-loop attempt $n $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "=== tunnel up; starting session $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
+    # timeout: a tunnel wedge after a successful dial otherwise hangs the
+    # session in a device fetch forever (the dial watchdog only bounds the
+    # dial); 2 h bounds a full session incl. first-compiles.
+    timeout 7200 python tools/tpu_session.py --dial_timeout 300 "$@" \
+      > "$OUT/session_$(date -u +%H%M).log" 2>&1
+    rc=$?
+    echo "=== session rc=$rc $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
+    [ "$rc" -eq 0 ] && exit 0
+  fi
+  sleep 300
+done
+echo "=== gave up ===" >> "$OUT/session_loop.log"
+exit 3
