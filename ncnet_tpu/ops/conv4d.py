@@ -57,6 +57,9 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
       * 'conv2d_stacked': ONE 2-D conv with the kI*kJ offsets folded into
         the input channels — single output write, kI*kJ-times-larger input
         (wins for small cin).
+      * 'conv2d_outstacked': the dual — kI*kJ offsets folded into the conv
+        OUTPUT channels, summed by shifted slice-adds; single input read
+        and an MXU N dim of kI*kJ*cout (wins for small cout, large cin).
       * 'convnd': one rank-4-spatial ConvGeneral op — the compiler owns the
         whole stencil.
       * 'auto' (default): per-layer pick — 'conv2d_stacked' when cin <= 2,
@@ -178,6 +181,41 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
             preferred_element_type=acc_dtype,
         )
         out = jnp.moveaxis(out.reshape(b, si, sj, sk, sl, cout), 5, 1)
+    elif strategy == "conv2d_outstacked":
+        # Dual of 'conv2d_stacked': fold the kI*kJ offsets into the conv
+        # OUTPUT channels — one conv2d over (K, L) with cout' = kI*kJ*cout
+        # producing every offset's partial at every (I, J) position, then
+        # kI*kJ shifted slice-adds. The input is read ONCE (vs kI*kJ times
+        # in 'conv2d'), and the MXU N dim is kI*kJ*cout instead of cout —
+        # the winning shape when cout is small but cin is not (consensus
+        # layer 2: cin=16, cout=1, where input-stacking would blow the
+        # input up 9x and 'conv2d' starves the MXU at N=1).
+        pad_j = kj // 2
+        xp = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0)))
+        sip, sjp = si_pad, sj + 2 * pad_j
+        xs = jnp.moveaxis(xp, 1, 5).reshape(b * sip * sjp, sk, sl, cin)
+        # [kk, kl, cin, ki*kj*cout]: offset-major output channels.
+        w_out = jnp.transpose(w, (2, 3, 4, 0, 1, 5)).reshape(
+            kk, kl, cin, ki * kj * cout
+        )
+        y = lax.conv_general_dilated(
+            xs,
+            w_out,
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        ).reshape(b, sip, sjp, sk, sl, ki * kj, cout)
+        # out[i, j] = sum_{di,dj} y[i+di, j+dj, (di,dj)]: padded rows hold
+        # conv-of-zeros = 0, reproducing 'same' zero padding exactly.
+        out = None
+        for di in range(ki):
+            for dj in range(kj):
+                ys = lax.slice_in_dim(y, di, di + si, axis=1)
+                ys = lax.slice_in_dim(ys, dj, dj + sj, axis=2)
+                ys = ys[:, :, :, :, :, di * kj + dj]
+                out = ys if out is None else out + ys
+        out = jnp.moveaxis(out, 5, 1)
     elif strategy == "convnd":
         # One rank-4-spatial convolution: XLA's ConvGeneral HLO is rank-
         # agnostic, so the whole 4-D stencil is a single op and the compiler

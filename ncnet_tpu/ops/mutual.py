@@ -14,12 +14,14 @@ the reductions run as `lax.pmax` collectives over the sharded axes.
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 EPS = 1e-5
 
 
-def mutual_matching(corr4d, eps: float = EPS):
+def mutual_matching(corr4d, eps: float = EPS, *, transpose_major=None):
     """Apply soft mutual-NN filtering.
 
     The elementwise math runs in f32 regardless of the storage dtype (the
@@ -29,12 +31,27 @@ def mutual_matching(corr4d, eps: float = EPS):
 
     Args:
       corr4d: [b, 1, iA, jA, iB, jB].
+      transpose_major: the per-B max reduces over the MAJOR (iA, jA) axes —
+        the axis class whose reduction measured ~100x slower than a
+        minor-axis pass in this tensor's match-extraction stage on a v5e
+        (ops/matches.py). True routes that reduction through one explicit
+        [A, B] -> [B, A] transpose + minor-axis max; False reduces in the
+        native layout; None (default) reads the NCNET_MUTUAL_TRANSPOSE env
+        var at trace time (unset = False until the device A/B says
+        otherwise — tools/bench_consensus.py).
 
     Returns:
       Same shape and dtype, filtered.
     """
+    if transpose_major is None:
+        transpose_major = os.environ.get("NCNET_MUTUAL_TRANSPOSE", "") == "1"
     c = corr4d.astype(jnp.float32)
-    max_over_a = jnp.max(c, axis=(2, 3), keepdims=True)  # per-B max
+    if transpose_major:
+        b, ch, i1, j1, i2, j2 = c.shape
+        ct = jnp.transpose(c.reshape(b, ch, i1 * j1, i2 * j2), (0, 1, 3, 2))
+        max_over_a = jnp.max(ct, axis=3).reshape(b, ch, 1, 1, i2, j2)
+    else:
+        max_over_a = jnp.max(c, axis=(2, 3), keepdims=True)  # per-B max
     max_over_b = jnp.max(c, axis=(4, 5), keepdims=True)  # per-A max
     ratio_b = c / (max_over_a + eps)  # reference corr4d_B
     ratio_a = c / (max_over_b + eps)  # reference corr4d_A

@@ -160,11 +160,21 @@ def machine_tag() -> str:
 
     tag = platform.machine()
     try:
+        picked = {}
         with open("/proc/cpuinfo") as f:
             for line in f:
-                if line.startswith(("flags", "Features")):
-                    tag += hashlib.sha1(line.encode()).hexdigest()[:8]
-                    break
+                # Hash the model name too: two hosts can report identical
+                # kernel flag lines while LLVM's direct cpuid detection
+                # differs (observed 2026-07-31: stale AOT entries carrying
+                # +amx-fp16 loaded on an amx-fp16-less host with SIGILL
+                # warnings — the flags-only hash collided).
+                for key in ("flags", "Features", "model name"):
+                    if line.startswith(key) and key not in picked:
+                        picked[key] = line
+            if picked:
+                tag += hashlib.sha1(
+                    "".join(sorted(picked.values())).encode()
+                ).hexdigest()[:8]
     except OSError:
         pass
     return tag

@@ -343,7 +343,8 @@ def test_conv4d_strategies_agree():
     b = jax.random.normal(jax.random.PRNGKey(2), (2,))
     ref = conv4d_reference(x, w, b)
     xp = jnp.pad(x, ((0, 0), (0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
-    for strategy in ("conv2d", "conv3d", "conv2d_stacked", "auto", "convnd"):
+    for strategy in ("conv2d", "conv3d", "conv2d_stacked",
+                     "conv2d_outstacked", "auto", "convnd"):
         try:
             out = conv4d_prepadded(xp, w, b, strategy=strategy)
         except Exception:  # noqa: BLE001
@@ -376,7 +377,22 @@ def test_neigh_consensus_per_layer_strategies(rng, chunk):
     params = neigh_consensus_init(key, (3, 3), (4, 1))
     corr = jnp.asarray(rng.randn(1, 1, 7, 5, 6, 5).astype(np.float32))
     ref = neigh_consensus_apply(params, corr, chunk_i=chunk)
-    out = neigh_consensus_apply(
-        params, corr, chunk_i=chunk, strategies=("conv2d_stacked", "conv3d")
-    )
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    for strats in (("conv2d_stacked", "conv3d"),
+                   ("conv2d_outstacked", "conv2d_outstacked")):
+        out = neigh_consensus_apply(
+            params, corr, chunk_i=chunk, strategies=strats
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5, err_msg=str(strats)
+        )
+
+
+def test_mutual_matching_transpose_major_equivalent(rng):
+    """The transposed-major formulation (device A/B candidate for the slow
+    major-axis per-B max) is numerically identical to the native layout."""
+    from ncnet_tpu.ops.mutual import mutual_matching
+
+    x = jnp.asarray(rng.randn(2, 1, 5, 4, 6, 3).astype(np.float32))
+    a = mutual_matching(x, transpose_major=False)
+    b = mutual_matching(x, transpose_major=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

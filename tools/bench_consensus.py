@@ -82,16 +82,28 @@ def main(argv=None):
         # conv2d OOMs the one-shot layer 2 at full scale; does the
         # stacked-l1 + conv3d-l2 mix fit and win?
         ("oneshot-stacked+conv3d", 0, ("conv2d_stacked", "conv3d")),
+        # Output-stacked layer 2: single input read + MXU N=9 (vs 1) —
+        # the traffic/shape argument says this should be the l2 winner.
+        ("oneshot-stacked+outstacked", 0,
+         ("conv2d_stacked", "conv2d_outstacked")),
+        ("chunk13-stacked+outstacked", 13,
+         ("conv2d_stacked", "conv2d_outstacked")),
     ]
+    # Best-chunk case re-run with the transposed-major mutual_matching:
+    # its per-B max reduces over the major axes, the same axis class that
+    # cost extraction ~100x pre-rewrite.
+    cases.append(("chunk13-auto+mutualT", 13, None, True))
 
-    for label, chunk_i, strats in cases:
+    for case in cases:
+        label, chunk_i, strats = case[0], case[1], case[2]
+        mutual_t = case[3] if len(case) > 3 else False
 
-        def stage(c, chunk_i=chunk_i, strats=strats):
-            c = mutual_matching(c)
+        def stage(c, chunk_i=chunk_i, strats=strats, mutual_t=mutual_t):
+            c = mutual_matching(c, transpose_major=mutual_t)
             c = neigh_consensus_apply(
                 params, c, symmetric=True, chunk_i=chunk_i, strategies=strats
             )
-            return mutual_matching(c)
+            return mutual_matching(c, transpose_major=mutual_t)
 
         def reps_fn(c, stage=stage):
             def body(carry, _):
