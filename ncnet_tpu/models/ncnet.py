@@ -140,7 +140,13 @@ def ncnet_forward(
 
     Returns:
       corr4d [b, 1, iA, jA, iB, jB], and — when relocalization is on —
-      the delta4d offset tuple, else None.
+      relocalization offsets `delta4d`, else None. delta4d is the
+      (di_a, dj_a, di_b, dj_b) int32 tuple on the unfused path, but the
+      fused batch-1 path emits the kernel's PACKED single int32 tensor
+      (offset = ((di_a*k + dj_a)*k + di_b)*k + dj_b). Pass either form
+      straight to corr_to_matches — it dispatches on the type; decode a
+      packed tensor with ops.pallas_kernels._decode_idx if the tuple is
+      needed.
     """
     feat_a = extract_features(config, params, source_image)
     feat_b = extract_features(config, params, target_image)
@@ -155,6 +161,11 @@ def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a, fea
     *features* — mathematically identical to rolling the images through the
     per-image backbone, at half the backbone FLOPs) can enter the pipeline
     after extraction.
+
+    Returns (corr4d, delta4d) with the same delta4d contract as
+    `ncnet_forward`: decoded 4-tuple on the unfused path, the kernel's
+    packed int32 tensor on the fused batch-1 path, None without
+    relocalization; corr_to_matches accepts every form.
     """
     delta4d = None
     if (
@@ -174,11 +185,16 @@ def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a, fea
             if config.fused_impl == "xla"
             else fused_correlation_maxpool
         )
+        # Packed deltas: the kernel's native single-tensor offset encoding
+        # flows to corr_to_matches, which gathers the matched cells and
+        # decodes only those — four full-resolution decoded offset planes
+        # (~900 MB HBM at InLoc shapes) never materialize.
         corr4d, delta4d = fused(
             feat_a,
             feat_b,
             config.relocalization_k_size,
             corr_dtype=config.corr_dtype,
+            decode_deltas=False,
         )
     else:
         corr4d = feature_correlation(

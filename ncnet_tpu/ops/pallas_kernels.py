@@ -203,6 +203,7 @@ def fused_correlation_maxpool_pallas(
     interpret: bool = False,
     corr_dtype=jnp.float32,
     kernel_impl: str | None = None,
+    decode_deltas: bool = True,
 ):
     """Fused all-pairs correlation + 4-D max pool, Pallas TPU kernel.
 
@@ -221,11 +222,17 @@ def fused_correlation_maxpool_pallas(
         dot per grid step over sublane-padded A rows) or 'dots' (k^2 x k^2
         separate [va, c] x [c, tbc] dots — the round-1 kernel, kept for
         A/B). NCNET_PALLAS_CORR_IMPL overrides at trace time.
+      decode_deltas: True returns the (di_a, dj_a, di_b, dj_b) tuple —
+        the maxpool4d-parity contract. False returns the kernel's packed
+        int32 offset tensor as-is; corr_to_matches consumes it directly,
+        skipping four full-tensor decoded offset planes (~900 MB of HBM
+        temps at InLoc resolution) that extraction gathers only ~0.03 %
+        of.
 
     Returns:
       (pooled [1, 1, UA, VA, WB, ZB] corr_dtype,
-       (di_a, dj_a, di_b, dj_b) int32, same trailing shape) — identical
-      contract to feature_correlation -> ops.pool4d.maxpool4d.
+       (di_a, dj_a, di_b, dj_b) int32 tuple of the same trailing shape —
+       or the packed int32 tensor when decode_deltas=False).
     """
     if feature_a.shape[0] != 1:
         raise ValueError("batch must be 1 (vmap/loop outside)")
@@ -314,12 +321,14 @@ def fused_correlation_maxpool_pallas(
 
     pooled = pooled[:, :va].reshape(1, 1, ua, va, wb, zb)
     idx = idx[:, :va].reshape(1, 1, ua, va, wb, zb)
-    deltas = _decode_idx(idx, k)
-    return pooled, deltas
+    if not decode_deltas:
+        return pooled, idx
+    return pooled, _decode_idx(idx, k)
 
 
 def fused_correlation_maxpool_xla(
-    feature_a, feature_b, k_size: int = 2, corr_dtype=jnp.float32
+    feature_a, feature_b, k_size: int = 2, corr_dtype=jnp.float32,
+    decode_deltas: bool = True,
 ):
     """Slab-wise XLA fallback with the same never-materialize property.
 
@@ -370,11 +379,14 @@ def fused_correlation_maxpool_xla(
     _, (pooled, idx) = lax.scan(row_step, None, fa_rows)
     pooled = pooled.reshape(1, 1, ua, va, wb, zb)
     idx = idx.reshape(1, 1, ua, va, wb, zb)
+    if not decode_deltas:
+        return pooled, idx
     return pooled, _decode_idx(idx, k)
 
 
 def fused_correlation_maxpool(
-    feature_a, feature_b, k_size: int = 2, corr_dtype=jnp.float32
+    feature_a, feature_b, k_size: int = 2, corr_dtype=jnp.float32,
+    decode_deltas: bool = True,
 ):
     """Dispatch on the *lowering* platform: Pallas on TPU, slab-scan XLA
     elsewhere.
@@ -392,9 +404,11 @@ def fused_correlation_maxpool(
         feature_a,
         feature_b,
         tpu=partial(
-            fused_correlation_maxpool_pallas, k_size=k_size, corr_dtype=corr_dtype
+            fused_correlation_maxpool_pallas, k_size=k_size,
+            corr_dtype=corr_dtype, decode_deltas=decode_deltas,
         ),
         default=partial(
-            fused_correlation_maxpool_xla, k_size=k_size, corr_dtype=corr_dtype
+            fused_correlation_maxpool_xla, k_size=k_size,
+            corr_dtype=corr_dtype, decode_deltas=decode_deltas,
         ),
     )

@@ -180,6 +180,42 @@ def machine_tag() -> str:
     return tag
 
 
+def chain_reps(fn, reps: int):
+    """Wrap fn(*xs) so `reps` applications run inside ONE jit via lax.scan.
+
+    Per-call timing through a tunneled backend has an ~85 ms host-RTT
+    floor that swamps sub-100 ms kernels; chaining reps inside one
+    executable amortizes it. Two measurement-critical properties, shared
+    here so every bench tool keeps them in sync:
+      * the carry multiplies into the first argument ((1 + carry*0),
+        cast to its dtype so it cannot promote the workload) — a data
+        dependence XLA cannot hoist or CSE away;
+      * the carry probes one element of EVERY output leaf, so no
+        candidate's partial computation is dead-code-eliminated while an
+        opaque competitor (pallas_call) still pays it.
+
+    Time the result with timed_steady and divide by `reps`.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def reps_fn(*xs):
+        def body(carry, _):
+            first = xs[0] * (1.0 + carry * 0.0).astype(xs[0].dtype)
+            out = fn(first, *xs[1:])
+            leaves = [l for l in jax.tree.leaves(out) if hasattr(l, "ravel")]
+            probe = leaves[0].ravel()[0].astype(jnp.float32)
+            for leaf in leaves[1:]:
+                probe = probe + leaf.ravel()[0].astype(jnp.float32)
+            return probe, ()
+
+        out, _ = lax.scan(body, jnp.float32(0), None, length=reps)
+        return out
+
+    return jax.jit(reps_fn)
+
+
 def setup_compile_cache(path: str = ""):
     """Enable the persistent XLA compilation cache (minutes-long InLoc-shape
     compiles amortize across processes)."""

@@ -378,7 +378,13 @@ def test_fused_impl_xla_matches_unfused(rng):
     np.testing.assert_allclose(
         np.asarray(corr_x), np.asarray(corr_u), atol=2e-5, rtol=1e-4
     )
-    for dx, du in zip(deltas_x, deltas_u):
+    # The fused path emits the kernel's packed single-tensor offsets
+    # (ncnet_forward_from_features passes decode_deltas=False); decode
+    # to compare with the unfused maxpool4d tuple.
+    from ncnet_tpu.ops.pallas_kernels import _decode_idx
+
+    assert hasattr(deltas_x, "reshape") and deltas_x.dtype == jnp.int32
+    for dx, du in zip(_decode_idx(deltas_x, 2), deltas_u):
         np.testing.assert_array_equal(np.asarray(dx), np.asarray(du))
 
     with pytest.raises(ValueError, match="fused_impl"):

@@ -191,3 +191,32 @@ def test_fused_kernel_ragged_tile_tail():
     )
     for a, b in zip(d, dx):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_packed_deltas_match_decoded(rng):
+    """decode_deltas=False returns the packed offset tensor whose
+    corr_to_matches consumption is identical to the decoded-tuple path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ncnet_tpu.ops.matches import corr_to_matches
+    from ncnet_tpu.ops.pallas_kernels import fused_correlation_maxpool_xla
+
+    fa = jnp.asarray(rng.randn(1, 8, 8, 6).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 8, 6, 8).astype(np.float32))
+    pooled, deltas = fused_correlation_maxpool_xla(fa, fb, k_size=2)
+    pooled_p, packed = fused_correlation_maxpool_xla(
+        fa, fb, k_size=2, decode_deltas=False
+    )
+    np.testing.assert_array_equal(np.asarray(pooled), np.asarray(pooled_p))
+    assert packed.shape == pooled.shape and packed.dtype == jnp.int32
+    for invert in (False, True):
+        ref = corr_to_matches(
+            pooled, delta4d=deltas, k_size=2, do_softmax=True, invert_matching_direction=invert
+        )
+        out = corr_to_matches(
+            pooled, delta4d=packed, k_size=2, do_softmax=True, invert_matching_direction=invert
+        )
+        for r, o in zip(ref, out):
+            np.testing.assert_allclose(np.asarray(r), np.asarray(o), atol=1e-6)

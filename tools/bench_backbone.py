@@ -1,0 +1,90 @@
+"""Stage-cumulative backbone timing at the InLoc image size.
+
+The first real-TPU profile put the ResNet-101 backbone at ~108 ms for a
+3200x2400 bf16 forward — ~9 % MXU efficiency against the ~1.8 TFLOP of
+conv work, so the backbone is a real optimization target once the corr
+pipeline stops dominating. This tool times cumulative truncations at
+layer1/layer2/layer3 (the `last_layer` knob) so the slow stage is
+identifiable without a profiler trace (stage cost = difference between
+consecutive rows; the stem conv+pool is inside the layer1 row).
+
+Usage:
+    python tools/bench_backbone.py [--scale 1.0] [--reps 3] [--iters 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--dial_timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ncnet_tpu.utils.profiling import (
+        chain_reps,
+        dial_devices,
+        setup_compile_cache,
+        timed_steady,
+    )
+
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        log("backend dial timed out; aborting")
+        os._exit(2)
+    log(f"devices: {devices}")
+
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from ncnet_tpu.models.backbone import (
+        BackboneConfig,
+        backbone_apply,
+        backbone_init,
+    )
+
+    h = int(3200 * args.scale) // 32 * 32
+    w = int(2400 * args.scale) // 32 * 32
+    log(f"image {h}x{w} bf16, reps={args.reps}")
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, h, w), jnp.float32)
+
+    base = BackboneConfig(compute_dtype="bfloat16")
+    params = backbone_init(jax.random.PRNGKey(1), base)
+
+    for cut in ("layer1", "layer2", "layer3"):
+        cfg = dataclasses.replace(base, last_layer=cut)
+        try:
+            first, dt, _ = timed_steady(
+                chain_reps(
+                    lambda a, p, cfg=cfg: backbone_apply(cfg, p, a), args.reps
+                ),
+                x, params, iters=args.iters,
+            )
+            log(f"-> {cut:8s} cumulative first={first:6.2f}s "
+                f"{dt * 1000 / args.reps:7.1f}ms/app")
+        except Exception as exc:  # noqa: BLE001
+            log(f"-> {cut:8s} FAILED: {type(exc).__name__}: "
+                f"{str(exc).splitlines()[0][:120]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,7 @@ def main(argv=None):
     import jax
 
     from ncnet_tpu.utils.profiling import (
+        chain_reps,
         dial_devices,
         setup_compile_cache,
         timed_steady,
@@ -52,7 +53,6 @@ def main(argv=None):
     log(f"devices: {devices}")
 
     import jax.numpy as jnp
-    from jax import lax
 
     from ncnet_tpu.ops.conv4d import neigh_consensus_apply, neigh_consensus_init
     from ncnet_tpu.ops.mutual import mutual_matching
@@ -105,19 +105,10 @@ def main(argv=None):
             )
             return mutual_matching(c, transpose_major=mutual_t)
 
-        def reps_fn(c, stage=stage):
-            def body(carry, _):
-                # The CSE-defeating perturbation must not promote: a f32
-                # carry times the bf16 tensor would silently benchmark the
-                # whole stage at f32 (2x the production HBM traffic).
-                out = stage(c * (1.0 + carry * 0.0).astype(c.dtype))
-                return out.ravel()[0].astype(jnp.float32), ()
-
-            out, _ = lax.scan(body, jnp.float32(0), None, length=args.reps)
-            return out
-
         try:
-            first, dt, _ = timed_steady(jax.jit(reps_fn), corr, iters=args.iters)
+            first, dt, _ = timed_steady(
+                chain_reps(stage, args.reps), corr, iters=args.iters
+            )
             log(f"{label:32s} first={first:6.2f}s "
                 f"-> {dt * 1000 / args.reps:7.1f}ms/app (+~RTT/iter amortized)")
         except Exception as exc:  # noqa: BLE001

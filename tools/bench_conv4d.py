@@ -19,7 +19,8 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-STRATEGIES = ("conv2d", "conv3d", "conv2d_stacked", "convnd", "auto")
+STRATEGIES = ("conv2d", "conv3d", "conv2d_stacked", "conv2d_outstacked",
+              "convnd", "auto")
 
 
 def main():
@@ -27,6 +28,8 @@ def main():
     p.add_argument("--scale", type=float, default=1.0,
                    help="scale on the InLoc consensus shape (1.0 = 100x75)")
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--reps", type=int, default=4,
+                   help="applications chained inside one jit per timing")
     p.add_argument("--dial_timeout", type=float, default=900.0)
     args = p.parse_args()
 
@@ -39,6 +42,7 @@ def main():
         neigh_consensus_init,
     )
     from ncnet_tpu.utils.profiling import (
+        chain_reps,
         dial_devices,
         setup_compile_cache,
         timed_steady,
@@ -62,8 +66,10 @@ def main():
     ]
 
     def timed(fn, *xs):
-        _, steady, _ = timed_steady(fn, *xs, iters=args.iters)
-        return steady
+        _, steady, _ = timed_steady(
+            chain_reps(fn, args.reps), *xs, iters=args.iters
+        )
+        return steady / args.reps
 
     for name, shape, k, cout, dtype in cases:
         b, cin = shape[:2]
@@ -77,12 +83,12 @@ def main():
         )
         for strategy in STRATEGIES:
             try:
-                fn = jax.jit(
+                dt = timed(
                     lambda a, ww, bb, s=strategy: conv4d_prepadded(
                         a, ww, bb, strategy=s
-                    )
+                    ),
+                    xp, w, bias,
                 )
-                dt = timed(fn, xp, w, bias)
                 print(f"{name:14s} {strategy:15s} {dt * 1e3:9.2f} ms")
             except Exception as exc:  # noqa: BLE001
                 print(f"{name:14s} {strategy:15s} unsupported "
@@ -93,8 +99,9 @@ def main():
     corr = jax.random.normal(
         jax.random.PRNGKey(3), (1, 1, ii, jj, ii, jj), jnp.bfloat16
     )
-    stack = jax.jit(lambda p, c: neigh_consensus_apply(p, c, symmetric=True))
-    dt = timed(stack, params, corr)
+    dt = timed(
+        lambda c, p: neigh_consensus_apply(p, c, symmetric=True), corr, params
+    )
     print(f"{'consensus-stack':14s} {'(default)':15s} {dt * 1e3:9.2f} ms")
 
 

@@ -47,6 +47,7 @@ def main(argv=None):
     import jax
 
     from ncnet_tpu.utils.profiling import (
+        chain_reps,
         dial_devices,
         setup_compile_cache,
         timed_steady,
@@ -60,7 +61,6 @@ def main(argv=None):
     log(f"devices: {devices}")
 
     import jax.numpy as jnp
-    from jax import lax
 
     from ncnet_tpu.ops.correlation import feature_correlation
     from ncnet_tpu.ops.pool4d import maxpool4d
@@ -101,27 +101,9 @@ def main(argv=None):
     }
 
     for name, fn in candidates.items():
-        def reps_fn(a, b, fn=fn):
-            def body(carry, _):
-                # Data dependence on the previous iteration defeats CSE;
-                # the multiply is one elementwise pass, ~0.15 ms at this
-                # size — negligible against the kernels under test.
-                pooled, deltas = fn(a * (1.0 + carry * 0.0), b)
-                # Probe EVERY output: an unprobed deltas would let XLA
-                # DCE the argmax chain out of the non-Pallas candidates
-                # (a pallas_call is opaque and always pays it) — a skewed
-                # A/B. Matches timed_steady's every-leaf probe rule.
-                probe = pooled.ravel()[0].astype(jnp.float32)
-                for d in jax.tree.leaves(deltas):
-                    probe = probe + d.ravel()[0].astype(jnp.float32)
-                return probe, ()
-
-            out, _ = lax.scan(body, jnp.float32(0), None, length=args.reps)
-            return out
-
         try:
             first, dt, _ = timed_steady(
-                jax.jit(reps_fn), fa, fb, iters=args.iters
+                chain_reps(fn, args.reps), fa, fb, iters=args.iters
             )
             log(f"{name:10s} first={first:6.2f}s total={dt * 1000:8.1f}ms "
                 f"-> {dt * 1000 / args.reps:7.1f}ms/app (incl ~one RTT/iter)")

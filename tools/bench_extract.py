@@ -41,6 +41,7 @@ def main(argv=None):
     import jax
 
     from ncnet_tpu.utils.profiling import (
+        chain_reps,
         dial_devices,
         setup_compile_cache,
         timed_steady,
@@ -54,7 +55,6 @@ def main(argv=None):
     log(f"devices: {devices}")
 
     import jax.numpy as jnp
-    from jax import lax
 
     from ncnet_tpu.evals.inloc import inloc_device_matches
     from ncnet_tpu.ops.matches import corr_to_matches
@@ -72,8 +72,16 @@ def main(argv=None):
         for i in range(4)
     )
 
+    # The fused kernel's packed encoding: ((a*k + b)*k + c)*k + d.
+    packed = (
+        ((deltas[0] * 2 + deltas[1]) * 2 + deltas[2]) * 2 + deltas[3]
+    ).astype(jnp.int32)
+
     def full(c):
         return inloc_device_matches(c, delta4d=deltas, k_size=2)
+
+    def full_packed(c):
+        return inloc_device_matches(c, delta4d=packed, k_size=2)
 
     def dir_b2a(c):  # native minor-axis reduction, no transpose
         return corr_to_matches(
@@ -99,6 +107,7 @@ def main(argv=None):
 
     candidates = {
         "full both dirs+sort": full,
+        "full packed-deltas": full_packed,
         "dir B->A (minor)": dir_b2a,
         "dir A->B (transpose)": dir_a2b,
         "dir A->B no-softmax": dir_a2b_nosoftmax,
@@ -106,21 +115,10 @@ def main(argv=None):
     }
 
     for name, fn in candidates.items():
-        def reps_fn(c, fn=fn):
-            def body(carry, _):
-                # astype: a f32 carry would promote the bf16 tensor and
-                # benchmark extraction at double the production HBM traffic.
-                out = fn(c * (1.0 + carry * 0.0).astype(c.dtype))
-                probe = sum(
-                    l.ravel()[0].astype(jnp.float32) for l in jax.tree.leaves(out)
-                )
-                return probe, ()
-
-            out, _ = lax.scan(body, jnp.float32(0), None, length=args.reps)
-            return out
-
         try:
-            first, dt, _ = timed_steady(jax.jit(reps_fn), corr, iters=args.iters)
+            first, dt, _ = timed_steady(
+                chain_reps(fn, args.reps), corr, iters=args.iters
+            )
             log(f"{name:22s} first={first:6.2f}s "
                 f"-> {dt * 1000 / args.reps:7.1f}ms/app")
         except Exception as exc:  # noqa: BLE001

@@ -14,7 +14,7 @@ leaves the highest-value numbers on disk.
 
 Usage:
     python tools/tpu_session.py [--dial_timeout 600] [--skip phase,phase]
-Phases: corr_pool, consensus, extract, profile, bench.
+Phases: corr_pool, consensus, extract, backbone, profile, bench.
 """
 
 from __future__ import annotations
@@ -71,6 +71,8 @@ def main(argv=None):
         ("consensus", "bench_consensus",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("extract", "bench_extract",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("backbone", "bench_backbone",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("profile", "profile_inloc",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
