@@ -145,8 +145,8 @@ def ncnet_forward(
       fused batch-1 path emits the kernel's PACKED single int32 tensor
       (offset = ((di_a*k + dj_a)*k + di_b)*k + dj_b). Pass either form
       straight to corr_to_matches — it dispatches on the type; decode a
-      packed tensor with ops.pallas_kernels._decode_idx if the tuple is
-      needed.
+      packed tensor with ops.matches.decode_packed_offsets if the tuple
+      is needed.
     """
     feat_a = extract_features(config, params, source_image)
     feat_b = extract_features(config, params, target_image)

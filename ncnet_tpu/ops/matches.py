@@ -26,6 +26,26 @@ def _coord_grids(fs1, fs2, fs3, fs4, k_size, scale):
     return xa, ya, xb, yb
 
 
+def decode_packed_offsets(packed, k: int):
+    """Packed within-cell offset -> (di_a, dj_a, di_b, dj_b).
+
+    THE definition of the fused kernel's packed encoding
+    (offset = ((di_a*k + dj_a)*k + di_b)*k + dj_b) — the kernel's
+    decoder and the benches' encoder both defer here so the bit layout
+    lives in exactly one pallas-free module.
+    """
+    dj_b = packed % k
+    di_b = (packed // k) % k
+    dj_a = (packed // (k * k)) % k
+    di_a = packed // (k * k * k)
+    return di_a, dj_a, di_b, dj_b
+
+
+def encode_packed_offsets(di_a, dj_a, di_b, dj_b, k: int):
+    """Inverse of :func:`decode_packed_offsets`."""
+    return ((di_a * k + dj_a) * k + di_b) * k + dj_b
+
+
 def _minor_score_argmax(nc, softmax: bool):
     """(score, argmax) over the MINOR axis of [b, M, N].
 
@@ -117,12 +137,9 @@ def corr_to_matches(
             return jnp.take_along_axis(d.reshape(b, -1), lin, axis=1)
 
         if hasattr(delta4d, "reshape"):  # packed single tensor
-            packed = gather_delta(delta4d)
-            k = k_size
-            g_jb = packed % k
-            g_ib = (packed // k) % k
-            g_ja = (packed // (k * k)) % k
-            g_ia = packed // (k * k * k)
+            g_ia, g_ja, g_ib, g_jb = decode_packed_offsets(
+                gather_delta(delta4d), k_size
+            )
         else:
             di_a, dj_a, di_b, dj_b = delta4d
             # Gather all four offsets at the coarse cell before refining

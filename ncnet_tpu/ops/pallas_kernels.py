@@ -55,12 +55,14 @@ def _arrange_b(fb, k):
 
 
 def _decode_idx(idx, k):
-    """Packed offset (m*k^2 + n) -> (di_a, dj_a, di_b, dj_b), reference order."""
-    d = idx % k
-    c_ = (idx // k) % k
-    b = (idx // (k * k)) % k
-    a = idx // (k * k * k)
-    return a, b, c_, d
+    """Packed offset (m*k^2 + n) -> (di_a, dj_a, di_b, dj_b), reference order.
+
+    Delegates to the canonical bit-layout definition in ops.matches so the
+    encoding cannot desync between the kernel and its pallas-free consumers.
+    """
+    from .matches import decode_packed_offsets
+
+    return decode_packed_offsets(idx, k)
 
 
 def _pool_select(slab, kk: int, rows: int, tbc: int, out_dtype, pooled_ref, idx_ref):

@@ -40,6 +40,9 @@ def make_sharded_inloc_parts(config: NCNetConfig, mesh: Mesh, axis_name: str = "
         run once per query, its result feeds every shortlisted pano.
       forward_from_features(params, feat_a, tgt) -> (corr4d, delta4d):
         pano backbone + per-shard fused corr+pool + sharded consensus.
+        delta4d is the kernel's packed int32 offset tensor (the
+        models/ncnet.py fused-path contract); corr_to_matches consumes
+        it directly.
 
     Requirements: batch 1; feature height iA divisible by
     (mesh size * relocalization_k_size) — the input bucketing in
@@ -60,18 +63,21 @@ def make_sharded_inloc_parts(config: NCNetConfig, mesh: Mesh, axis_name: str = "
         shard_map,
         mesh=mesh,
         in_specs=(spec_fa, P()),
-        out_specs=(spec_corr, (spec_corr,) * 4),
+        out_specs=(spec_corr, spec_corr),
         check_vma=False,
     )
     def corr_pool_local(fa_local, fb):
         # Each shard computes corr rows for its A slab and pools them —
         # embarrassingly parallel (pool cells never straddle shards since
-        # I_loc is a multiple of k). delta_ia is slab-relative and needs no
-        # offset: maxpool4d deltas encode *within-cell* offsets.
-        pooled, deltas = fused_correlation_maxpool(
-            fa_local, fb, k_size=k, corr_dtype=config.corr_dtype
+        # I_loc is a multiple of k). The PACKED offsets are shard-position-
+        # independent (they encode *within-cell* offsets), so per-shard
+        # packed tensors concatenate into the global one directly — same
+        # contract as the single-device fused path (models/ncnet.py).
+        pooled, packed = fused_correlation_maxpool(
+            fa_local, fb, k_size=k, corr_dtype=config.corr_dtype,
+            decode_deltas=False,
         )
-        return pooled, tuple(deltas)
+        return pooled, packed
 
     pipeline = make_sharded_match_pipeline(
         mesh, axis_name, symmetric=config.symmetric_mode

@@ -1,7 +1,8 @@
 """Sweep the Conv4d strategies at consensus-stack shapes on this backend.
 
 One invocation times every formulation of ncnet_tpu.ops.conv4d (conv2d /
-conv3d / conv2d_stacked / convnd, skipping any the backend rejects) on the
+conv3d / conv2d_stacked / conv2d_outstacked / convnd, skipping any the
+backend rejects) on the
 InLoc consensus layers (post-pool [1,1,100,75,100,75], 3^4 kernels,
 1->16->1 channels) and on the PF-Pascal shape (25^4, 5^4 kernels), plus
 the full symmetric neigh_consensus_apply. Prints one line per (shape,
@@ -23,7 +24,7 @@ STRATEGIES = ("conv2d", "conv3d", "conv2d_stacked", "conv2d_outstacked",
               "convnd", "auto")
 
 
-def main():
+def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--scale", type=float, default=1.0,
                    help="scale on the InLoc consensus shape (1.0 = 100x75)")
@@ -31,7 +32,7 @@ def main():
     p.add_argument("--reps", type=int, default=4,
                    help="applications chained inside one jit per timing")
     p.add_argument("--dial_timeout", type=float, default=900.0)
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
     import jax
     import jax.numpy as jnp

@@ -72,10 +72,9 @@ def main(argv=None):
         for i in range(4)
     )
 
-    # The fused kernel's packed encoding: ((a*k + b)*k + c)*k + d.
-    packed = (
-        ((deltas[0] * 2 + deltas[1]) * 2 + deltas[2]) * 2 + deltas[3]
-    ).astype(jnp.int32)
+    from ncnet_tpu.ops.matches import encode_packed_offsets
+
+    packed = encode_packed_offsets(*deltas, 2).astype(jnp.int32)
 
     def full(c):
         return inloc_device_matches(c, delta4d=deltas, k_size=2)

@@ -24,7 +24,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def main():
+def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--image-size", type=int, default=400)
@@ -32,7 +32,7 @@ def main():
     p.add_argument("--backbone", type=str, default="resnet101")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--dial_timeout", type=float, default=600.0)
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
     import jax
     import jax.numpy as jnp

@@ -14,7 +14,8 @@ leaves the highest-value numbers on disk.
 
 Usage:
     python tools/tpu_session.py [--dial_timeout 600] [--skip phase,phase]
-Phases: corr_pool, consensus, extract, backbone, profile, bench.
+Phases: corr_pool, consensus, extract, backbone, profile, conv4d, train,
+bench.
 """
 
 from __future__ import annotations
@@ -76,6 +77,9 @@ def main(argv=None):
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("profile", "profile_inloc",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("conv4d", "bench_conv4d",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("train", "bench_train", ["--dial_timeout", "120", "--iters", "4"]),
     ]
     for label, modname, phase_argv in phases:
         if label in skip:
