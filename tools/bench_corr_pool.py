@@ -84,8 +84,13 @@ def main(argv=None):
         return maxpool4d(corr, 2)
 
     candidates = {
-        "pallas_bigdot": lambda a, b: fused_correlation_maxpool_pallas(
-            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot"
+        "pallas_bigdot_ba": lambda a, b: fused_correlation_maxpool_pallas(
+            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot",
+            grid_order="ba",
+        ),
+        "pallas_bigdot_ab": lambda a, b: fused_correlation_maxpool_pallas(
+            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot",
+            grid_order="ab",
         ),
         "pallas_dots": lambda a, b: fused_correlation_maxpool_pallas(
             a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="dots"
