@@ -367,11 +367,18 @@ def neigh_consensus_apply(
         entry per layer, each a conv4d_prepadded strategy name or None).
         The TPU sweep found different winners — and different *legal*
         formulations — per layer (docs/NEXT.md), which a single global
-        NCNET_CONV4D_STRATEGY cannot express.
+        NCNET_CONV4D_STRATEGY cannot express. None falls back to the
+        NCNET_CONSENSUS_STRATEGIES env var (comma-separated, read at
+        trace time, e.g. "conv2d_stacked,conv2d_outstacked") so a
+        hardware session can A/B full-pipeline mixes without code edits.
 
     Returns:
       [b, c_last, iA, jA, iB, jB].
     """
+    if strategies is None:
+        env = os.environ.get("NCNET_CONSENSUS_STRATEGIES")
+        if env:
+            strategies = tuple(s.strip() or None for s in env.split(","))
     if strategies is not None:
         if isinstance(strategies, str) or len(strategies) != len(params):
             # Guard the migration from the single global strategy string: a

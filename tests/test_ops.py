@@ -396,3 +396,21 @@ def test_mutual_matching_transpose_major_equivalent(rng):
     a = mutual_matching(x, transpose_major=False)
     b = mutual_matching(x, transpose_major=True)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_neigh_consensus_strategies_env(rng, monkeypatch):
+    """NCNET_CONSENSUS_STRATEGIES (trace-time, comma-separated) selects
+    per-layer strategies when the caller passes none — the knob hardware
+    sessions use to A/B full-pipeline mixes without code edits."""
+    key = jax.random.PRNGKey(11)
+    params = neigh_consensus_init(key, (3, 3), (4, 1))
+    corr = jnp.asarray(rng.randn(1, 1, 6, 5, 6, 5).astype(np.float32))
+    ref = neigh_consensus_apply(params, corr)
+    monkeypatch.setenv(
+        "NCNET_CONSENSUS_STRATEGIES", "conv2d_stacked,conv2d_outstacked"
+    )
+    out = neigh_consensus_apply(params, corr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    monkeypatch.setenv("NCNET_CONSENSUS_STRATEGIES", "conv3d")  # wrong arity
+    with pytest.raises(ValueError, match="one entry per layer"):
+        neigh_consensus_apply(params, corr)

@@ -92,12 +92,15 @@ def main(argv=None):
             a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot",
             grid_order="ab",
         ),
+        # grid_order pinned on EVERY candidate: an inherited env override
+        # would otherwise make lines incomparable across runs.
         "pallas_dots": lambda a, b: fused_correlation_maxpool_pallas(
-            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="dots"
+            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="dots",
+            grid_order="ba",
         ),
         "pallas_bigdot_t768": lambda a, b: fused_correlation_maxpool_pallas(
             a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot",
-            tile_b_cells=768,
+            tile_b_cells=768, grid_order="ba",
         ),
         "xla_slab": lambda a, b: fused_correlation_maxpool_xla(
             a, b, k_size=2, corr_dtype=jnp.bfloat16

@@ -94,12 +94,30 @@ def main(argv=None):
             log(f"{label} FAILED:\n{traceback.format_exc()}")
 
     if "bench" not in skip:
+        os.environ["NCNET_BENCH_DIAL_TIMEOUT"] = "120"
+        # The baseline run must not inherit a mix left over from a prior
+        # manual experiment — the A/B below would then compare a config
+        # with itself.
+        os.environ.pop("NCNET_CONSENSUS_STRATEGIES", None)
         log("=== bench (headline JSON on stdout) ===")
         try:
-            os.environ["NCNET_BENCH_DIAL_TIMEOUT"] = "120"
             _load("../bench").main()
         except Exception:  # noqa: BLE001
             log(f"bench FAILED:\n{traceback.format_exc()}")
+        # Candidate-mix re-run: the CPU A/B's best consensus strategy mix,
+        # via the trace-time env knob — if this line beats the default's,
+        # flip the 'auto' heuristic in ops/conv4d.py.
+        log("=== bench with NCNET_CONSENSUS_STRATEGIES="
+            "conv2d_stacked,conv2d_outstacked ===")
+        try:
+            os.environ["NCNET_CONSENSUS_STRATEGIES"] = (
+                "conv2d_stacked,conv2d_outstacked"
+            )
+            _load("../bench").main()
+        except Exception:  # noqa: BLE001
+            log(f"bench(mix) FAILED:\n{traceback.format_exc()}")
+        finally:
+            os.environ.pop("NCNET_CONSENSUS_STRATEGIES", None)
     log("session DONE")
     return 0
 
