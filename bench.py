@@ -40,7 +40,10 @@ def main():
 
     import jax.numpy as jnp
 
-    from ncnet_tpu.evals import inloc_device_matches
+    from ncnet_tpu.evals import (
+        inloc_device_matches,
+        inloc_matches_from_consensus,
+    )
     from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
     from ncnet_tpu.models.ncnet import (
         extract_features,
@@ -78,10 +81,17 @@ def main():
             os.environ.get("NCNET_BENCH_SMOKE_SIZE", "512")
         )
 
-    def build(mode: str):
+    def build(mode: str, extract_impl: str = "auto"):
         """mode: 'auto' (platform dispatch -> Pallas on TPU), 'xla'
         (forced slab-scan fusion — same memory behavior, no Mosaic), or
-        'unfused' (materialize + pool)."""
+        'unfused' (materialize + pool). extract_impl: 'auto' = the
+        one-read Pallas statistics kernel on TPU, 'xla' = the
+        corr_to_matches formulation (the no-Mosaic fallback).
+
+        NCNET_FUSE_MUTUAL_EXTRACT=1 additionally folds the final
+        mutual-NN filter into the extraction kernel (pipeline stops after
+        consensus; evals.inloc.inloc_matches_from_consensus) — the
+        session driver A/Bs this against the default composition."""
         config = NCNetConfig(
             backbone=BackboneConfig(compute_dtype="bfloat16"),
             ncons_kernel_sizes=(3, 3),
@@ -101,11 +111,21 @@ def main():
         # One pano step: pano backbone + (fused) correlation+pool +
         # consensus + both-direction match extraction — the per-pano device
         # program of cli/eval_inloc.py.
+        fuse_mutual = os.environ.get("NCNET_FUSE_MUTUAL_EXTRACT") == "1"
+
         @jax.jit
         def step(params, feat_a, tgt):
             feat_b = extract_features(config, params, tgt)
-            corr, delta = ncnet_forward_from_features(config, params, feat_a, feat_b)
-            return inloc_device_matches(corr, delta4d=delta, k_size=2)
+            corr, delta = ncnet_forward_from_features(
+                config, params, feat_a, feat_b, final_mutual=not fuse_mutual
+            )
+            if fuse_mutual:
+                return inloc_matches_from_consensus(
+                    corr, delta4d=delta, k_size=2, impl=extract_impl
+                )
+            return inloc_device_matches(
+                corr, delta4d=delta, k_size=2, impl=extract_impl
+            )
 
         return params, query_feats, step
 
@@ -114,27 +134,35 @@ def main():
     src = jax.random.normal(k1, (1, 3, h_a, w_a), jnp.float32)
     tgt = jax.random.normal(k2, (1, 3, h_b, w_b), jnp.float32)
 
-    # Fallback ladder: Pallas kernel -> forced XLA slab-scan (same
-    # never-materialize memory behavior, no Mosaic dependency) -> fully
-    # unfused materialize+pool. The JSON line records which tier ran.
-    tiers = ("auto", "xla", "unfused")
+    # Fallback ladder: both Pallas kernels -> Pallas corr+pool with XLA
+    # extraction -> forced XLA slab-scan (same never-materialize memory
+    # behavior, no Mosaic dependency) -> fully unfused materialize+pool.
+    # The JSON line records which tier ran.
+    tiers = (
+        ("auto", "auto"),
+        ("auto", "xla"),
+        ("xla", "xla"),
+        ("unfused", "xla"),
+    )
     for tier in tiers:
+        mode, extract_impl = tier
+        name = f"{mode}+extract-{extract_impl}"
         try:
-            params, query_feats, step = build(tier)
-            note(f"compiling+first-run '{tier}' step at {h_a}x{w_a} (first "
+            params, query_feats, step = build(mode, extract_impl)
+            note(f"compiling+first-run '{name}' step at {h_a}x{w_a} (first "
                  "compile of this shape can take many minutes on a tunneled "
                  "backend)...")
             feat_a = query_feats(params, src)
             out = step(params, feat_a, tgt)  # warmup/compile
             jax.block_until_ready(out)
-            note(f"'{tier}' step compiled and ran")
+            note(f"'{name}' step compiled and ran")
             break
         except Exception as exc:  # noqa: BLE001
             if tier == tiers[-1]:
                 raise
-            note(f"'{tier}' tier unavailable ({type(exc).__name__}: {exc}); "
+            note(f"'{name}' tier unavailable ({type(exc).__name__}: {exc}); "
                  "falling back")
-    fused_ran = tier != "unfused"
+    fused_ran = tier[0] != "unfused"
 
     # Timing through a scalar fetch: on tunneled backends (axon)
     # block_until_ready can return before execution completes, so each
@@ -176,7 +204,7 @@ def main():
                 "unit": "pairs/s/chip",
                 "vs_baseline": round(pairs_per_s / V100_BASELINE_PAIRS_PER_S, 4),
                 "fused": fused_ran,
-                "path": tier,
+                "path": name,
             }
         )
     )

@@ -176,7 +176,13 @@ def main(argv=None):
         @jax.jit
         def pano_matches(params, feat_a, tgt):
             corr, delta = sharded_from_features(params, feat_a, tgt)
-            return inloc_device_matches(corr, delta4d=delta, **match_kwargs)
+            # Pin the XLA extraction: its reductions partition along the
+            # sharded corr axes under GSPMD, whereas the Pallas statistics
+            # kernel has no partitioning rule and would force a full
+            # per-device replication of the corr tensor.
+            return inloc_device_matches(
+                corr, delta4d=delta, impl="xla", **match_kwargs
+            )
     else:
 
         @jax.jit

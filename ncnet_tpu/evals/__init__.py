@@ -6,6 +6,7 @@ from .inloc import (
     dedup_matches,
     extract_inloc_matches,
     inloc_device_matches,
+    inloc_matches_from_consensus,
     write_matches_mat,
     matches_buffer,
     fill_matches,
@@ -19,6 +20,7 @@ __all__ = [
     "dedup_matches",
     "extract_inloc_matches",
     "inloc_device_matches",
+    "inloc_matches_from_consensus",
     "write_matches_mat",
     "matches_buffer",
     "fill_matches",

@@ -15,10 +15,103 @@ from __future__ import annotations
 
 import os
 import numpy as np
+import jax
 import jax.numpy as jnp
 from scipy.io import savemat
 
-from ..ops.matches import corr_to_matches
+from ..ops.matches import corr_to_matches, relocalize_and_coords
+from ..ops.mutual import mutual_matching
+
+
+def _resolve_extract_impl(impl):
+    """'auto' | 'pallas' | 'xla'; None reads NCNET_EXTRACT_IMPL at trace
+    time (default 'auto': the Pallas statistics kernel when lowering to
+    TPU, the corr_to_matches formulation elsewhere)."""
+    if impl is None:
+        impl = os.environ.get("NCNET_EXTRACT_IMPL", "auto")
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown extraction impl {impl!r}")
+    return impl
+
+
+def _raw_matches_xla(corr4d, delta4d, k_size, do_softmax):
+    """Both directions via corr_to_matches, concatenated [B-dir, A-dir]."""
+    a = corr_to_matches(
+        corr4d, delta4d=delta4d, k_size=k_size, do_softmax=do_softmax,
+        scale="positive", invert_matching_direction=False,
+    )
+    b = corr_to_matches(
+        corr4d, delta4d=delta4d, k_size=k_size, do_softmax=do_softmax,
+        scale="positive", invert_matching_direction=True,
+    )
+    return tuple(jnp.concatenate([u, v], axis=1) for u, v in zip(a, b))
+
+
+def _raw_matches_stats(
+    corr4d, delta4d, k_size, do_softmax, fused_mutual=False, interpret=False
+):
+    """Both directions from ONE Pallas sweep over the [M, N] matrix.
+
+    The bidirectional statistics kernel (ops.extract_kernel) reads the
+    tensor once and yields per-row (per-A) and per-column (per-B)
+    max/argmax/sumexp; the softmax score of the max element is exactly
+    1 / sumexp (max(softmax(x)) = exp(max - logsumexp)). With
+    `fused_mutual`, the final soft mutual-NN filter is applied tile-wise
+    inside the kernel (pass 1: bidirectional maxes; pass 2: statistics of
+    the filtered values) — the filtered tensor never reaches HBM.
+    """
+    from ..ops.extract_kernel import (
+        bidir_extract_stats_pallas,
+        bidir_maxes_pallas,
+    )
+
+    shape4d = corr4d.shape[2:]
+    fs1, fs2, fs3, fs4 = shape4d
+    x2d = corr4d.reshape(fs1 * fs2, fs3 * fs4)
+    row_col_max = None
+    if fused_mutual:
+        row_col_max = bidir_maxes_pallas(x2d, interpret=interpret)
+    row, col = bidir_extract_stats_pallas(
+        x2d, do_softmax=do_softmax, row_col_max=row_col_max,
+        interpret=interpret,
+    )
+
+    def direction(stats, probe_n, probe_div, arg_div):
+        mx, arg, sumexp = stats
+        score = (1.0 / sumexp if do_softmax else mx)[None, :]
+        m_i, m_j = (arg // arg_div)[None, :], (arg % arg_div)[None, :]
+        pos = jnp.arange(probe_n, dtype=jnp.int32)
+        p_i, p_j = (pos // probe_div)[None, :], (pos % probe_div)[None, :]
+        return score, m_i, m_j, p_i, p_j
+
+    # Direction False (one match per B position): column statistics.
+    s, i_a, j_a, i_b, j_b = direction(col, fs3 * fs4, fs4, fs2)
+    d0 = relocalize_and_coords(
+        i_a, j_a, i_b, j_b, s, delta4d, k_size, shape4d, "positive"
+    )
+    # Direction True (one match per A position): row statistics.
+    s, i_b, j_b, i_a, j_a = direction(row, fs1 * fs2, fs2, fs4)
+    d1 = relocalize_and_coords(
+        i_a, j_a, i_b, j_b, s, delta4d, k_size, shape4d, "positive"
+    )
+    return tuple(jnp.concatenate([u, v], axis=1) for u, v in zip(d0, d1))
+
+
+def _sort_and_recenter(raw, shape4d, k_size):
+    """Shared tail: descending-score device sort + recentring onto
+    pixel-cell centers (parity: eval_inloc.py:160-189)."""
+    fs1, fs2, fs3, fs4 = shape4d
+    xa, ya, xb, yb, score = raw
+    order = jnp.argsort(-score[0])
+    xa, ya, xb, yb, score = (
+        jnp.take(v[0], order) for v in (xa, ya, xb, yb, score)
+    )
+    k = max(k_size, 1)
+    ya = ya * (fs1 * k - 1) / (fs1 * k) + 0.5 / (fs1 * k)
+    xa = xa * (fs2 * k - 1) / (fs2 * k) + 0.5 / (fs2 * k)
+    yb = yb * (fs3 * k - 1) / (fs3 * k) + 0.5 / (fs3 * k)
+    xb = xb * (fs4 * k - 1) / (fs4 * k) + 0.5 / (fs4 * k)
+    return xa, ya, xb, yb, score
 
 
 def inloc_device_matches(
@@ -28,6 +121,7 @@ def inloc_device_matches(
     do_softmax: bool = True,
     both_directions: bool = True,
     invert_direction: bool = False,
+    impl=None,
 ):
     """Device-side match extraction for one pair: jit-safe, no host sync.
 
@@ -36,42 +130,95 @@ def inloc_device_matches(
     Callers jit this together with the model forward so the whole per-pano
     device program is one XLA executable (op-by-op dispatch over a tunneled
     backend costs milliseconds per op).
-    """
-    fs1, fs2, fs3, fs4 = corr4d.shape[2:]
 
-    def one_direction(invert):
-        return corr_to_matches(
+    `impl` (default: NCNET_EXTRACT_IMPL env, 'auto') picks the extraction
+    formulation for the batch-1 both-directions case: 'pallas' = the
+    one-read bidirectional statistics kernel, 'xla' = corr_to_matches per
+    direction, 'auto' = Pallas when lowering to TPU.
+    """
+    shape4d = corr4d.shape[2:]
+    impl = _resolve_extract_impl(impl)
+    fused_ok = both_directions and corr4d.shape[0] == 1 and corr4d.shape[1] == 1
+
+    if impl == "pallas" and not fused_ok:
+        raise ValueError(
+            "impl='pallas' requires batch 1, a single channel and "
+            "both_directions=True (the bidirectional statistics kernel); "
+            f"got shape {corr4d.shape}, both_directions={both_directions}"
+        )
+    if both_directions:
+        if impl == "pallas" and fused_ok:
+            raw = _raw_matches_stats(corr4d, delta4d, k_size, do_softmax)
+        elif impl == "auto" and fused_ok:
+            raw = jax.lax.platform_dependent(
+                corr4d,
+                tpu=lambda c: _raw_matches_stats(
+                    c, delta4d, k_size, do_softmax
+                ),
+                default=lambda c: _raw_matches_xla(
+                    c, delta4d, k_size, do_softmax
+                ),
+            )
+        else:
+            raw = _raw_matches_xla(corr4d, delta4d, k_size, do_softmax)
+    else:
+        raw = corr_to_matches(
             corr4d,
             delta4d=delta4d,
             k_size=k_size,
             do_softmax=do_softmax,
             scale="positive",
-            invert_matching_direction=invert,
+            invert_matching_direction=invert_direction,
+        )
+    return _sort_and_recenter(raw, shape4d, k_size)
+
+
+def inloc_matches_from_consensus(
+    consensus4d,
+    delta4d=None,
+    k_size: int = 1,
+    do_softmax: bool = True,
+    impl=None,
+    interpret: bool = False,
+):
+    """Fused final-MutualMatching + both-direction extraction.
+
+    Takes the CONSENSUS output (match_pipeline(..., final_mutual=False),
+    still in the storage dtype) and evaluates the last soft mutual-NN
+    filter inside the extraction kernel: pass 1 reads the tensor once for
+    its bidirectional maxes, pass 2 filters each tile in VMEM and takes
+    match statistics — the filtered tensor never materializes in HBM, and
+    the tensor moves through HBM twice (bf16) instead of the unfused
+    four+ full-tensor round trips (mutual write + extraction reads).
+
+    Same return contract as `inloc_device_matches`.
+    """
+    if consensus4d.shape[0] != 1 or consensus4d.shape[1] != 1:
+        raise ValueError("fused mutual+extraction requires batch 1")
+    shape4d = consensus4d.shape[2:]
+    impl = _resolve_extract_impl(impl)
+
+    def fused(c):
+        return _raw_matches_stats(
+            c, delta4d, k_size, do_softmax, fused_mutual=True,
+            interpret=interpret,
         )
 
-    if both_directions:
-        a = one_direction(False)
-        b = one_direction(True)
-        xa, ya, xb, yb, score = (
-            jnp.concatenate([u, v], axis=1) for u, v in zip(a, b)
-        )
+    def unfused(c):
+        # Bit-parity with the default pipeline tail: mutual filter in the
+        # storage dtype, then f32 extraction.
+        filtered = mutual_matching(c).astype(jnp.float32)
+        return _raw_matches_xla(filtered, delta4d, k_size, do_softmax)
+
+    if impl == "pallas":
+        raw = fused(consensus4d)
+    elif impl == "xla":
+        raw = unfused(consensus4d)
     else:
-        xa, ya, xb, yb, score = one_direction(invert_direction)
-
-    # Descending score sort on device (keeps the max-score duplicate first).
-    order = jnp.argsort(-score[0])
-    xa, ya, xb, yb, score = (
-        jnp.take(v[0], order) for v in (xa, ya, xb, yb, score)
-    )
-
-    # Recenter normalized [0,1] coords onto pixel-cell centers
-    # (parity: eval_inloc.py:179-189).
-    k = max(k_size, 1)
-    ya = ya * (fs1 * k - 1) / (fs1 * k) + 0.5 / (fs1 * k)
-    xa = xa * (fs2 * k - 1) / (fs2 * k) + 0.5 / (fs2 * k)
-    yb = yb * (fs3 * k - 1) / (fs3 * k) + 0.5 / (fs3 * k)
-    xb = xb * (fs4 * k - 1) / (fs4 * k) + 0.5 / (fs4 * k)
-    return xa, ya, xb, yb, score
+        raw = jax.lax.platform_dependent(
+            consensus4d, tpu=fused, default=unfused
+        )
+    return _sort_and_recenter(raw, shape4d, k_size)
 
 
 def dedup_matches(xa, ya, xb, yb, score):

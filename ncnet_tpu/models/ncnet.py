@@ -23,6 +23,7 @@ filters. The pipeline output is always f32 for softmax/argmax extraction.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, Tuple
 
 import jax
@@ -105,7 +106,8 @@ def extract_features(config: NCNetConfig, params: Params, image):
     return feats
 
 
-def match_pipeline(config: NCNetConfig, params: Params, corr4d):
+def match_pipeline(config: NCNetConfig, params: Params, corr4d,
+                   final_mutual: bool = True, mutual1_maxes=None):
     """The 4-D filtering pipeline applied after (and excluding) correlation.
 
     Runs in `config.corr_dtype` (bf16 for the half-precision InLoc config —
@@ -117,12 +119,23 @@ def match_pipeline(config: NCNetConfig, params: Params, corr4d):
     adds may be storage-dtype — see the dtype-policy note in
     ops/conv4d.py). Mutual-matching elementwise math is f32. Returns f32
     for the downstream softmax/argmax extraction.
+
+    `final_mutual=False` stops after the consensus stack and returns the
+    STORAGE dtype: the caller evaluates the last mutual filter fused into
+    match extraction (evals.inloc.inloc_matches_from_consensus), which
+    rounds through the same storage dtype for bit-parity with this path.
+
+    `mutual1_maxes` are precomputed (per-A, per-B) maxes of corr4d (e.g.
+    from the fused correlation+pool kernel's emit_maxes) — the first
+    mutual filter then runs without its own reduction passes.
     """
     corr4d = corr4d.astype(config.corr_dtype)
-    corr4d = mutual_matching(corr4d)
+    corr4d = mutual_matching(corr4d, maxes=mutual1_maxes)
     corr4d = neigh_consensus_apply(
         params["neigh_consensus"], corr4d, symmetric=config.symmetric_mode
     )
+    if not final_mutual:
+        return corr4d
     corr4d = mutual_matching(corr4d)
     return corr4d.astype(jnp.float32)
 
@@ -153,7 +166,8 @@ def ncnet_forward(
     return ncnet_forward_from_features(config, params, feat_a, feat_b)
 
 
-def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a, feat_b):
+def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a,
+                                feat_b, final_mutual: bool = True):
     """Correlation → (pool) → mutual → consensus → mutual, from backbone features.
 
     Split out of `ncnet_forward` so callers that reuse features (e.g. the
@@ -161,6 +175,9 @@ def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a, fea
     *features* — mathematically identical to rolling the images through the
     per-image backbone, at half the backbone FLOPs) can enter the pipeline
     after extraction.
+
+    `final_mutual=False` defers the last mutual filter to a fused
+    extraction (see match_pipeline / evals.inloc.inloc_matches_from_consensus).
 
     Returns (corr4d, delta4d) with the same delta4d contract as
     `ncnet_forward`: decoded 4-tuple on the unfused path, the kernel's
@@ -189,19 +206,34 @@ def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a, fea
         # flows to corr_to_matches, which gathers the matched cells and
         # decodes only those — four full-resolution decoded offset planes
         # (~900 MB HBM at InLoc shapes) never materialize.
-        corr4d, delta4d = fused(
+        # NCNET_FUSE_CORR_MAXES=1 (trace time) additionally has the kernel
+        # accumulate the first mutual filter's max operands while each
+        # pooled tile is in VMEM, removing that filter's reduction passes
+        # (default off until the hardware session A/B confirms).
+        emit_maxes = os.environ.get("NCNET_FUSE_CORR_MAXES", "0") == "1"
+        out = fused(
             feat_a,
             feat_b,
             config.relocalization_k_size,
             corr_dtype=config.corr_dtype,
             decode_deltas=False,
+            emit_maxes=emit_maxes,
         )
+        mutual1_maxes = None
+        if emit_maxes:
+            corr4d, delta4d, mutual1_maxes = out
+        else:
+            corr4d, delta4d = out
     else:
+        mutual1_maxes = None
         corr4d = feature_correlation(
             feat_a, feat_b, compute_dtype=jnp.bfloat16
         ).astype(config.corr_dtype)
         if config.relocalization_k_size > 1:
             corr4d, delta4d = maxpool4d(corr4d, config.relocalization_k_size)
 
-    corr4d = match_pipeline(config, params, corr4d)
+    corr4d = match_pipeline(
+        config, params, corr4d, final_mutual=final_mutual,
+        mutual1_maxes=mutual1_maxes,
+    )
     return corr4d, delta4d

@@ -80,9 +80,22 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
         # Per-layer heuristic: fold the kI*kJ offsets into input channels
         # when cin is small — the stacked input then stays a small multiple
         # of the tensor while replacing kI*kJ partial-sum round trips with
-        # one output write (consensus layer 1 has cin=1). Larger cin makes
-        # the stacked input dominate; use the batched-2-D default there.
-        strategy = "conv2d_stacked" if weight.shape[4] <= 2 else "conv2d"
+        # one output write (consensus layer 1 has cin=1). Small cout takes
+        # the dual ('conv2d_outstacked': offsets folded into OUTPUT
+        # channels): the 2026-07-31 v5e sweep measured stacked+outstacked
+        # as the fastest full-consensus mix (131.8 ms vs 353.7 ms for the
+        # previous chunked default), and the plain 'conv2d' loop does not
+        # even lower at the one-shot InLoc layer-2 shape
+        # ([1,16,100,75,100,75]: JaxRuntimeError, docs/tpu_r02/
+        # bench_conv4d.txt). Larger cin AND cout (PF-Pascal's 16->16
+        # middle layer, where conv2d won its sweep row) keep the batched
+        # 2-D formulation.
+        if weight.shape[4] <= 2:
+            strategy = "conv2d_stacked"
+        elif weight.shape[5] <= 2:
+            strategy = "conv2d_outstacked"
+        else:
+            strategy = "conv2d"
     b, cin, si_pad, sj, sk, sl = x.shape
     ki, kj, kk, kl, wcin, cout = weight.shape
     if wcin != cin:
@@ -294,11 +307,16 @@ def swap_ab_weight(weight):
 
 
 # Chunked-consensus auto-trigger: chunk when the largest interlayer
-# activation would exceed this many elements (2**28 elems = 512 MB bf16 /
-# 1 GB f32), and size slabs so the per-slab activation stays near
-# _CHUNK_TARGET_ELEMS. Both only consulted when chunk_i is None ('auto');
+# activation would exceed this many BYTES, and size slabs so the per-slab
+# activation stays near _CHUNK_TARGET_ELEMS. The 2 GB threshold is set
+# from the 2026-07-31 v5e session: the one-shot stack at the bf16 InLoc
+# peak (16ch x 100x75x100x75 = 1.66 GB) fits a 16 GB chip comfortably and
+# runs 2.7x faster than any chunked plan (131.8 ms vs 353.7 ms,
+# docs/tpu_r02/session_0316.log), while an f32 pipeline at the same shape
+# (3.3 GB peak + conv workspaces) keeps the chunked safety net. Both
+# knobs only consulted when chunk_i is None ('auto');
 # NCNET_CONSENSUS_CHUNK_I overrides the row count (0 disables).
-_CHUNK_THRESHOLD_ELEMS = 2**28
+_CHUNK_THRESHOLD_BYTES = 2**31
 _CHUNK_TARGET_ELEMS = 2**26
 
 
@@ -356,8 +374,9 @@ def neigh_consensus_apply(
         (parallel/corr_sharding.py).
       chunk_i: memory plan for the iA dimension. None (default) decides at
         trace time from the static shapes: when the largest interlayer
-        activation exceeds ~2**28 elements (InLoc's 16-channel
-        100x75x100x75 tensor is 9e8), the stack runs as a `lax.map` over
+        activation exceeds _CHUNK_THRESHOLD_BYTES (the bf16 InLoc
+        16-channel 100x75x100x75 tensor at 1.66 GB stays one-shot — the
+        measured-faster plan on a v5e), the stack runs as a `lax.map` over
         I-slabs with a halo of sum(ki//2) rows, bounding every large temp
         to slab size — the intra-chip analogue of the halo-exchange
         sharding in parallel/corr_sharding.py. An int forces that many
@@ -407,7 +426,7 @@ def neigh_consensus_apply(
             max(l["weight"].shape[4], l["weight"].shape[5]) for l in params
         )
         peak = b * max_c * si * sj * sk * sl
-        if peak > _CHUNK_THRESHOLD_ELEMS:
+        if peak * corr.dtype.itemsize > _CHUNK_THRESHOLD_BYTES:
             per_row = max(1, peak // si)
             # A slab's widest activation spans chunk_i + 2*halo rows; budget
             # for the halo rows too so the target is honored.

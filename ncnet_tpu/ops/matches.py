@@ -68,6 +68,54 @@ def _minor_score_argmax(nc, softmax: bool):
     return jnp.exp(m - lse), idx
 
 
+def relocalize_and_coords(
+    i_a, j_a, i_b, j_b, score, delta4d, k_size, shape4d, scale
+):
+    """Shared tail of match extraction: delta4d relocalization + index->
+    normalized-coordinate mapping (parity: lib/point_tnf.py:59-80).
+
+    Single home for the semantics so corr_to_matches and the fused Pallas
+    statistics path (evals.inloc) cannot diverge. All index arrays are
+    [b, n] int32; returns (xA, yA, xB, yB, score).
+    """
+    fs1, fs2, fs3, fs4 = shape4d
+    b = i_a.shape[0]
+    xa_ax, ya_ax, xb_ax, yb_ax = _coord_grids(fs1, fs2, fs3, fs4, k_size, scale)
+
+    if delta4d is not None:
+        # Relocalization: index the per-cell offsets at the matched 4-D cell
+        # and refine onto the fine grid.
+        lin = ((i_a * fs2 + j_a) * fs3 + i_b) * fs4 + j_b
+
+        def gather_delta(d):
+            return jnp.take_along_axis(d.reshape(b, -1), lin, axis=1)
+
+        if hasattr(delta4d, "reshape"):  # packed single tensor
+            g_ia, g_ja, g_ib, g_jb = decode_packed_offsets(
+                gather_delta(delta4d), k_size
+            )
+        else:
+            di_a, dj_a, di_b, dj_b = delta4d
+            # Gather all four offsets at the coarse cell before refining
+            # any index.
+            g_ia, g_ja, g_ib, g_jb = (
+                gather_delta(di_a),
+                gather_delta(dj_a),
+                gather_delta(di_b),
+                gather_delta(dj_b),
+            )
+        i_a = i_a * k_size + g_ia
+        j_a = j_a * k_size + g_ja
+        i_b = i_b * k_size + g_ib
+        j_b = j_b * k_size + g_jb
+
+    x_a = jnp.take(xa_ax, j_a)
+    y_a = jnp.take(ya_ax, i_a)
+    x_b = jnp.take(xb_ax, j_b)
+    y_b = jnp.take(yb_ax, i_b)
+    return x_a, y_a, x_b, y_b, score
+
+
 def corr_to_matches(
     corr4d,
     delta4d=None,
@@ -101,7 +149,6 @@ def corr_to_matches(
       positions in the probed image.
     """
     b, _, fs1, fs2, fs3, fs4 = corr4d.shape
-    xa_ax, ya_ax, xb_ax, yb_ax = _coord_grids(fs1, fs2, fs3, fs4, k_size, scale)
 
     if invert_matching_direction:
         # One match per A position: reduce over B positions — already the
@@ -128,38 +175,10 @@ def corr_to_matches(
         i_b = jnp.broadcast_to(grid_ib.reshape(1, -1), (b, fs3 * fs4))
         j_b = jnp.broadcast_to(grid_jb.reshape(1, -1), (b, fs3 * fs4))
 
-    if delta4d is not None:
-        # Relocalization: index the per-cell offsets at the matched 4-D cell
-        # and refine onto the fine grid (parity: lib/point_tnf.py:59-70).
-        lin = ((i_a * fs2 + j_a) * fs3 + i_b) * fs4 + j_b
-
-        def gather_delta(d):
-            return jnp.take_along_axis(d.reshape(b, -1), lin, axis=1)
-
-        if hasattr(delta4d, "reshape"):  # packed single tensor
-            g_ia, g_ja, g_ib, g_jb = decode_packed_offsets(
-                gather_delta(delta4d), k_size
-            )
-        else:
-            di_a, dj_a, di_b, dj_b = delta4d
-            # Gather all four offsets at the coarse cell before refining
-            # any index.
-            g_ia, g_ja, g_ib, g_jb = (
-                gather_delta(di_a),
-                gather_delta(dj_a),
-                gather_delta(di_b),
-                gather_delta(dj_b),
-            )
-        i_a = i_a * k_size + g_ia
-        j_a = j_a * k_size + g_ja
-        i_b = i_b * k_size + g_ib
-        j_b = j_b * k_size + g_jb
-
-    x_a = jnp.take(xa_ax, j_a)
-    y_a = jnp.take(ya_ax, i_a)
-    x_b = jnp.take(xb_ax, j_b)
-    y_b = jnp.take(yb_ax, i_b)
-    return x_a, y_a, x_b, y_b, score
+    return relocalize_and_coords(
+        i_a, j_a, i_b, j_b, score, delta4d, k_size, (fs1, fs2, fs3, fs4),
+        scale,
+    )
 
 
 def nearest_neighbour_point_transfer(matches, target_points_norm):

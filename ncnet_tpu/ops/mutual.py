@@ -21,7 +21,22 @@ import jax.numpy as jnp
 EPS = 1e-5
 
 
-def mutual_matching(corr4d, eps: float = EPS, *, transpose_major=None):
+def mutual_filter_values(c, max_over_b, max_over_a, eps: float = EPS):
+    """THE mutual-filter expression: c * ((c/(max_b+eps)) * (c/(max_a+eps))).
+
+    Single home for the arithmetic INCLUDING its grouping — f32
+    multiplication is not associative, a 1-ulp regrouping can cross a bf16
+    rounding boundary and flip a near-tied downstream argmax, and three
+    call sites (both branches here and the fused extraction kernel's
+    tile prologue, ops/extract_kernel._mutual_tile) must stay
+    bit-identical. All operands f32; broadcasting shapes are the callers'
+    business.
+    """
+    return c * ((c / (max_over_b + eps)) * (c / (max_over_a + eps)))
+
+
+def mutual_matching(corr4d, eps: float = EPS, *, transpose_major=None,
+                    maxes=None):
     """Apply soft mutual-NN filtering.
 
     The elementwise math runs in f32 regardless of the storage dtype (the
@@ -39,13 +54,26 @@ def mutual_matching(corr4d, eps: float = EPS, *, transpose_major=None):
         native layout; None (default) reads the NCNET_MUTUAL_TRANSPOSE env
         var at trace time (unset = False until the device A/B says
         otherwise — tools/bench_consensus.py).
+      maxes: optional precomputed (per_a_max [iA*jA], per_b_max [iB*jB])
+        f32 maxes of corr4d — e.g. accumulated for free by the fused
+        correlation+pool kernel (ops/pallas_kernels.py, emit_maxes). The
+        filter is then pure elementwise math that XLA fuses into the
+        consumer; no reduction passes over the tensor.
 
     Returns:
       Same shape and dtype, filtered.
     """
+    c = corr4d.astype(jnp.float32)
+    if maxes is not None:
+        b, ch, i1, j1, i2, j2 = c.shape
+        per_a, per_b = maxes
+        max_over_b = per_a.reshape(b, ch, i1, j1, 1, 1)
+        max_over_a = per_b.reshape(b, ch, 1, 1, i2, j2)
+        return mutual_filter_values(c, max_over_b, max_over_a, eps).astype(
+            corr4d.dtype
+        )
     if transpose_major is None:
         transpose_major = os.environ.get("NCNET_MUTUAL_TRANSPOSE", "") == "1"
-    c = corr4d.astype(jnp.float32)
     if transpose_major:
         b, ch, i1, j1, i2, j2 = c.shape
         ct = jnp.transpose(c.reshape(b, ch, i1 * j1, i2 * j2), (0, 1, 3, 2))
@@ -53,6 +81,7 @@ def mutual_matching(corr4d, eps: float = EPS, *, transpose_major=None):
     else:
         max_over_a = jnp.max(c, axis=(2, 3), keepdims=True)  # per-B max
     max_over_b = jnp.max(c, axis=(4, 5), keepdims=True)  # per-A max
-    ratio_b = c / (max_over_a + eps)  # reference corr4d_B
-    ratio_a = c / (max_over_b + eps)  # reference corr4d_A
-    return (c * (ratio_a * ratio_b)).astype(corr4d.dtype)
+    # ratio to max_over_a = reference corr4d_B; to max_over_b = corr4d_A.
+    return mutual_filter_values(c, max_over_b, max_over_a, eps).astype(
+        corr4d.dtype
+    )

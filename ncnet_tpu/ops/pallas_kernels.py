@@ -92,22 +92,69 @@ def _pool_select(slab, kk: int, rows: int, tbc: int, out_dtype, pooled_ref, idx_
             best = jnp.maximum(sub, best)
     pooled_ref[0] = best.astype(out_dtype)
     idx_ref[0] = best_idx
+    return best
+
+
+# Finite -inf for the pooled-stat masking (same rationale as
+# ops/extract_kernel._NEG: a real -inf would NaN on -inf minus -inf).
+_NEG = -3.0e38
+
+
+def _pool_stats_update(
+    best, va: int, tbc: int, n_cells_b: int, rmax_ref, cmax_ref, cmax_s
+):
+    """Accumulate the pooled tensor's per-A-row and per-B-cell maxes.
+
+    These are the exact reduction operands of the first soft mutual-NN
+    filter (lib/model.py:155-175) over the pooled correlation — emitting
+    them from the kernel turns that filter into pure elementwise math
+    downstream (no separate full-tensor reduction passes).
+
+    Requires grid order 'ab' (A rows slow, B tiles fast — the measured
+    default): the per-A-row max accumulates in its RESIDENT output block
+    across the B sweep, while the per-B max lives in a scratch spanning
+    every B tile (the sequential grid carries it across A rows) and is
+    written through to its output block each step.
+
+    `best` is the f32 rounded-through-storage pooled slab [rows, tbc];
+    padded rows (va_pad sublane alignment) and the ragged B tail are
+    masked to a finite -inf so zero-feature padding cannot win a max
+    (correlation values can be negative).
+    """
+    u = pl.program_id(0)
+    t = pl.program_id(1)
+    rows = best.shape[0]
+    r_in = lax.broadcasted_iota(jnp.int32, (rows, tbc), 0) < va
+    c_in = t * tbc + lax.broadcasted_iota(jnp.int32, (rows, tbc), 1) < n_cells_b
+    masked = jnp.where(r_in & c_in, best, _NEG)
+
+    tmax = jnp.max(masked, axis=1, keepdims=True)[None]  # (1, rows, 1)
+    prev = jnp.where(t == 0, jnp.full((1, rows, 1), _NEG), rmax_ref[...])
+    rmax_ref[...] = jnp.maximum(prev, tmax)
+
+    tcol = jnp.max(masked, axis=0, keepdims=True)  # (1, tbc)
+    prevc = jnp.where(u == 0, jnp.full((1, tbc), _NEG), cmax_s[t])
+    newc = jnp.maximum(prevc, tcol)
+    cmax_s[t] = newc
+    cmax_ref[...] = newc[None]
 
 
 def _corr_pool_kernel(
-    kk: int, va: int, tbc: int, out_dtype, fa_ref, fb_ref, pooled_ref, idx_ref
+    kk: int, va: int, tbc: int, n_cells_b: int, emit: bool, out_dtype, *refs
 ):
     """One grid step: correlation slab on the MXU, pooled in VMEM.
 
     fa_ref: [1, kk, va, c] — one A cell-row, within-cell offset m leading.
     fb_ref: [kk, tbc, c] — one B cell tile, within-cell offset n leading.
-    pooled_ref/idx_ref: [1, va, tbc].
+    pooled_ref/idx_ref: [1, va, tbc]. With `emit`, three more refs carry
+    the mutual-filter max statistics (see _pool_stats_update).
 
     One dot per (m, n) offset pair: every [va, tbc] sub-slab then starts at
     vector offset 0, so the compare/select chain never needs a Mosaic
     relayout (strided sub-slices of one big [kk*va, kk*tbc] product are
     sublane-misaligned whenever va % 8 != 0 and fail to compile).
     """
+    fa_ref, fb_ref, pooled_ref, idx_ref = refs[:4]
 
     def slab(m, n):
         prod = jax.lax.dot_general(
@@ -118,11 +165,14 @@ def _corr_pool_kernel(
         )  # [va, tbc]
         return prod.astype(out_dtype).astype(jnp.float32)
 
-    _pool_select(slab, kk, va, tbc, out_dtype, pooled_ref, idx_ref)
+    best = _pool_select(slab, kk, va, tbc, out_dtype, pooled_ref, idx_ref)
+    if emit:
+        _pool_stats_update(best, va, tbc, n_cells_b, *refs[4:])
 
 
 def _corr_pool_kernel_bigdot(
-    kk: int, va_pad: int, tbc: int, out_dtype, fa_ref, fb_ref, pooled_ref, idx_ref
+    kk: int, va: int, va_pad: int, tbc: int, n_cells_b: int, emit: bool,
+    out_dtype, *refs
 ):
     """One grid step as ONE MXU dot: [kk*va_pad, c] x [c, kk*tbc].
 
@@ -136,8 +186,10 @@ def _corr_pool_kernel_bigdot(
 
     fa_ref: [1, kk, va_pad, c]; fb_ref: [kk, tbc, c];
     pooled_ref/idx_ref: [1, va_pad, tbc]. Padded A rows carry zero
-    features -> zero scores; the caller slices them off.
+    features -> zero scores; the caller slices them off (and the `emit`
+    statistics mask them, since correlation values can be negative).
     """
+    fa_ref, fb_ref, pooled_ref, idx_ref = refs[:4]
     fa = fa_ref[0].reshape(kk * va_pad, fa_ref.shape[3])
     fb = fb_ref[...].reshape(kk * tbc, fb_ref.shape[2])
     prod = jax.lax.dot_general(
@@ -151,7 +203,9 @@ def _corr_pool_kernel_bigdot(
         s = prod[m * va_pad : (m + 1) * va_pad, n * tbc : (n + 1) * tbc]
         return s.astype(out_dtype).astype(jnp.float32)
 
-    _pool_select(slab, kk, va_pad, tbc, out_dtype, pooled_ref, idx_ref)
+    best = _pool_select(slab, kk, va_pad, tbc, out_dtype, pooled_ref, idx_ref)
+    if emit:
+        _pool_stats_update(best, va, tbc, n_cells_b, *refs[4:])
 
 
 def _check_pool_shapes(feature_a, feature_b, k_size: int) -> None:
@@ -207,6 +261,7 @@ def fused_correlation_maxpool_pallas(
     kernel_impl: str | None = None,
     decode_deltas: bool = True,
     grid_order: str | None = None,
+    emit_maxes: bool = False,
 ):
     """Fused all-pairs correlation + 4-D max pool, Pallas TPU kernel.
 
@@ -229,10 +284,11 @@ def fused_correlation_maxpool_pallas(
         B tiles fast) re-fetches every B block for each of the UA A-rows
         — ~6.3 GB/pano of fb reads at InLoc shapes. 'ba' (B tiles slow,
         A rows fast) keeps one fb block resident while all A rows stream
-        past it: fb is read once (~63 MB) and the re-read burden moves to
-        the 10x-smaller fa blocks (~0.7 GB total) — ~9x less HBM traffic
-        for identical output. Default reads NCNET_PALLAS_GRID_ORDER at
-        trace time ('ba' unset; flipped after the device A/B).
+        past it (~9x less HBM traffic on paper). The 2026-07-31 v5e A/B
+        measured 'ab' FASTER anyway (31.4 vs 34.7 ms/app,
+        docs/tpu_r02/session_0316.log — the re-reads pipeline behind the
+        MXU while 'ba' stalls on its block handoffs), so 'ab' is the
+        default; NCNET_PALLAS_GRID_ORDER (read at trace time) overrides.
       decode_deltas: True returns the (di_a, dj_a, di_b, dj_b) tuple —
         the maxpool4d-parity contract. False returns the kernel's packed
         int32 offset tensor as-is; corr_to_matches consumes it directly,
@@ -240,10 +296,17 @@ def fused_correlation_maxpool_pallas(
         temps at InLoc resolution) that extraction gathers only ~0.03 %
         of.
 
+      emit_maxes: additionally return the pooled tensor's per-A-position
+        and per-B-position maxes (f32, computed over the rounded stored
+        values) — the reduction operands of the first mutual-NN filter,
+        accumulated for free while each pooled tile is still in VMEM.
+        Requires grid_order 'ab' (the default).
+
     Returns:
       (pooled [1, 1, UA, VA, WB, ZB] corr_dtype,
        (di_a, dj_a, di_b, dj_b) int32 tuple of the same trailing shape —
        or the packed int32 tensor when decode_deltas=False).
+      With emit_maxes, a third element (row_max [UA*VA], col_max [WB*ZB]).
     """
     if feature_a.shape[0] != 1:
         raise ValueError("batch must be 1 (vmap/loop outside)")
@@ -253,9 +316,14 @@ def fused_correlation_maxpool_pallas(
     if kernel_impl not in ("bigdot", "dots"):
         raise ValueError(f"unknown kernel_impl {kernel_impl!r}")
     if grid_order is None:
-        grid_order = os.environ.get("NCNET_PALLAS_GRID_ORDER", "ba")
+        grid_order = os.environ.get("NCNET_PALLAS_GRID_ORDER", "ab")
     if grid_order not in ("ab", "ba"):
         raise ValueError(f"unknown grid_order {grid_order!r}")
+    if emit_maxes and grid_order != "ab":
+        raise ValueError(
+            "emit_maxes requires grid_order 'ab': the per-A-row max "
+            "accumulates in its resident output block across the B sweep"
+        )
     k = k_size
     kk = k * k
     c = feature_a.shape[1]
@@ -310,11 +378,46 @@ def fused_correlation_maxpool_pallas(
         a_of, b_of = (lambda j, i: i), (lambda j, i: j)
     if kernel_impl == "bigdot":
         kernel = partial(
-            _corr_pool_kernel_bigdot, kk, va_pad, tile_b_cells, corr_dtype
+            _corr_pool_kernel_bigdot, kk, va, va_pad, tile_b_cells,
+            n_cells_b, emit_maxes, corr_dtype,
         )
     else:
-        kernel = partial(_corr_pool_kernel, kk, va, tile_b_cells, corr_dtype)
-    pooled, idx = pl.pallas_call(
+        kernel = partial(
+            _corr_pool_kernel, kk, va, tile_b_cells, n_cells_b, emit_maxes,
+            corr_dtype,
+        )
+    slab_spec = pl.BlockSpec(
+        (1, va_pad, tile_b_cells),
+        lambda *g: (a_of(*g), 0, b_of(*g)),
+        memory_space=pltpu.VMEM,
+    )
+    out_specs = [slab_spec, slab_spec]
+    out_shape = [
+        jax.ShapeDtypeStruct((ua, va_pad, n_cells_b), corr_dtype),
+        jax.ShapeDtypeStruct((ua, va_pad, n_cells_b), jnp.int32),
+    ]
+    scratch_shapes = []
+    if emit_maxes:
+        out_specs += [
+            pl.BlockSpec(
+                (1, va_pad, 1),
+                lambda *g: (a_of(*g), 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, tile_b_cells),
+                lambda *g: (0, 0, b_of(*g)),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((ua, va_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1, n_cells_b), jnp.float32),
+        ]
+        scratch_shapes = [
+            pltpu.VMEM((n_b_tiles, 1, tile_b_cells), jnp.float32)
+        ]
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -329,35 +432,26 @@ def fused_correlation_maxpool_pallas(
                 memory_space=pltpu.VMEM,
             ),
         ],
-        out_specs=[
-            pl.BlockSpec(
-                (1, va_pad, tile_b_cells),
-                lambda *g: (a_of(*g), 0, b_of(*g)),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, va_pad, tile_b_cells),
-                lambda *g: (a_of(*g), 0, b_of(*g)),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((ua, va_pad, n_cells_b), corr_dtype),
-            jax.ShapeDtypeStruct((ua, va_pad, n_cells_b), jnp.int32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
     )(fa_arr, fb_arr)
+    pooled, idx = out[0], out[1]
 
     pooled = pooled[:, :va].reshape(1, 1, ua, va, wb, zb)
     idx = idx[:, :va].reshape(1, 1, ua, va, wb, zb)
-    if not decode_deltas:
-        return pooled, idx
-    return pooled, _decode_idx(idx, k)
+    deltas = idx if not decode_deltas else _decode_idx(idx, k)
+    if not emit_maxes:
+        return pooled, deltas
+    row_max = out[2][:, :va, 0].reshape(ua * va)
+    col_max = out[3][0, 0]
+    return pooled, deltas, (row_max, col_max)
 
 
 def fused_correlation_maxpool_xla(
     feature_a, feature_b, k_size: int = 2, corr_dtype=jnp.float32,
-    decode_deltas: bool = True,
+    decode_deltas: bool = True, emit_maxes: bool = False,
 ):
     """Slab-wise XLA fallback with the same never-materialize property.
 
@@ -408,14 +502,20 @@ def fused_correlation_maxpool_xla(
     _, (pooled, idx) = lax.scan(row_step, None, fa_rows)
     pooled = pooled.reshape(1, 1, ua, va, wb, zb)
     idx = idx.reshape(1, 1, ua, va, wb, zb)
-    if not decode_deltas:
-        return pooled, idx
-    return pooled, _decode_idx(idx, k)
+    deltas = idx if not decode_deltas else _decode_idx(idx, k)
+    if not emit_maxes:
+        return pooled, deltas
+    # Fallback statistics as plain reductions over the stored values —
+    # same contract as the kernel's accumulated maxes.
+    p32 = pooled.astype(jnp.float32)
+    row_max = jnp.max(p32, axis=(4, 5)).reshape(ua * va)
+    col_max = jnp.max(p32, axis=(2, 3)).reshape(wb * zb)
+    return pooled, deltas, (row_max, col_max)
 
 
 def fused_correlation_maxpool(
     feature_a, feature_b, k_size: int = 2, corr_dtype=jnp.float32,
-    decode_deltas: bool = True,
+    decode_deltas: bool = True, emit_maxes: bool = False,
 ):
     """Dispatch on the *lowering* platform: Pallas on TPU, slab-scan XLA
     elsewhere.
@@ -435,9 +535,11 @@ def fused_correlation_maxpool(
         tpu=partial(
             fused_correlation_maxpool_pallas, k_size=k_size,
             corr_dtype=corr_dtype, decode_deltas=decode_deltas,
+            emit_maxes=emit_maxes,
         ),
         default=partial(
             fused_correlation_maxpool_xla, k_size=k_size,
             corr_dtype=corr_dtype, decode_deltas=decode_deltas,
+            emit_maxes=emit_maxes,
         ),
     )

@@ -128,6 +128,61 @@ def timed_steady(fn, *xs, iters: int = 3):
     return first, steady, out
 
 
+class AlarmTimeout(BaseException):
+    """Raised by run_with_alarm when the wall-clock bound expires.
+
+    Deliberately a BaseException: the bench tools fence individual
+    candidates with broad `except Exception` handlers, and a phase-level
+    timeout must fly past those to the session driver instead of being
+    logged as one more failed candidate (which would consume the one-shot
+    alarm and leave the rest of the phase unfenced).
+    """
+
+
+def run_with_alarm(seconds: int, fn, *args, **kwargs):
+    """Run fn bounded by SIGALRM; raises AlarmTimeout on expiry.
+
+    The per-experiment fence for hardware sessions: a single pathological
+    compile otherwise hangs the whole one-dial experiment queue (observed
+    2026-07-31: the pre-kernel XLA extraction formulation sat >20 min in
+    the tunnel's remote-compile helper and starved every later phase).
+    SIGALRM interrupts the blocking HTTP wait in the main thread; the jax
+    client survives to run the next experiment. Main-thread only — call
+    sites are the sequential tool drivers (tools/tpu_session.py,
+    tools/bench_extract.py).
+
+    Nesting-safe both ways: an inner fence arms min(its bound, the outer
+    fence's remaining time) — it can never extend the outer deadline —
+    and re-arms the outer's remaining time (minus the elapsed inner run,
+    floor 1 s) on exit, so a per-candidate fence can neither cancel nor
+    suspend the session's phase fence. Once the outer budget is spent,
+    every subsequent inner call is clamped to ~1 s, so a phase whose
+    per-candidate handlers swallow AlarmTimeout still drains in seconds
+    per remaining candidate instead of minutes.
+    """
+    import signal
+    import time as _time
+
+    def _handler(signum, frame):
+        raise AlarmTimeout(f"timed out after {seconds}s")
+
+    start = _time.monotonic()
+    old_handler = signal.signal(signal.SIGALRM, _handler)
+    prev_remaining = signal.alarm(0)  # read + cancel any outer fence
+    arm = int(seconds)
+    if prev_remaining:
+        arm = min(arm, prev_remaining)
+    signal.alarm(max(1, arm))
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_handler)
+        if prev_remaining:
+            elapsed = int(_time.monotonic() - start)
+            signal.alarm(max(1, prev_remaining - elapsed))
+
+
 def dial_devices(timeout: float):
     """jax.devices() under a watchdog thread.
 

@@ -1,0 +1,150 @@
+"""Bidirectional extraction-statistics kernel vs its XLA oracle.
+
+The kernel (ops/extract_kernel.py) computes both matching directions'
+max / first-wins argmax / online sumexp in one sweep; these tests pin it —
+in interpret mode, which exercises the exact grid/accumulator logic —
+against the straightforward XLA formulation, including ragged tile tails,
+duplicate-max tie-breaking, bf16 storage rounding, and the fused
+mutual-filter prologue. End-to-end: the fused inloc extraction paths must
+reproduce the corr_to_matches-based formulation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ncnet_tpu.evals.inloc import (
+    _raw_matches_stats,
+    _raw_matches_xla,
+    inloc_matches_from_consensus,
+)
+from ncnet_tpu.ops.extract_kernel import (
+    bidir_extract_stats_pallas,
+    bidir_extract_stats_xla,
+    bidir_maxes_pallas,
+)
+from ncnet_tpu.ops.mutual import mutual_matching
+
+
+def _assert_stats_equal(got, want, softmax, rtol=1e-6):
+    for (gm, ga, gs), (wm, wa, ws), name in zip(got, want, ("row", "col")):
+        np.testing.assert_allclose(gm, wm, rtol=rtol, err_msg=f"{name} max")
+        np.testing.assert_array_equal(ga, wa, err_msg=f"{name} argmax")
+        if softmax:
+            np.testing.assert_allclose(
+                gs, ws, rtol=1e-5, err_msg=f"{name} sumexp"
+            )
+
+
+@pytest.mark.parametrize("softmax", [True, False])
+@pytest.mark.parametrize(
+    "shape,tiles",
+    [
+        ((16, 128), (8, 128)),  # exact tiling
+        ((50, 70), (16, 128)),  # ragged rows + block wider than the array
+        ((23, 300), (8, 128)),  # ragged both axes, multi-tile columns
+        ((40, 256), (16, 128)),  # multiple row and column tiles
+    ],
+)
+def test_stats_kernel_matches_oracle(softmax, shape, tiles):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+    got = bidir_extract_stats_pallas(
+        x, do_softmax=softmax, tile_m=tiles[0], tile_n=tiles[1],
+        interpret=True,
+    )
+    want = bidir_extract_stats_xla(x, do_softmax=softmax)
+    _assert_stats_equal(got, want, softmax)
+
+
+def test_stats_kernel_first_wins_ties():
+    # Small integer values -> exact representation; plant duplicate maxima
+    # within one tile and across tiles on both axes.
+    x = jnp.zeros((20, 260), jnp.float32)
+    x = x.at[3, 7].set(5.0).at[3, 200].set(5.0).at[3, 250].set(5.0)
+    x = x.at[11, 40].set(2.0).at[17, 40].set(2.0)
+    got = bidir_extract_stats_pallas(
+        x, do_softmax=False, tile_m=8, tile_n=128, interpret=True
+    )
+    want = bidir_extract_stats_xla(x, do_softmax=False)
+    _assert_stats_equal(got, want, False)
+    assert int(got[0][1][3]) == 7  # first of the three row maxima
+    assert int(got[1][1][40]) == 11  # first of the two column maxima
+
+
+def test_stats_kernel_bf16_input():
+    x = jax.random.normal(jax.random.PRNGKey(1), (30, 200), jnp.float32)
+    xb = x.astype(jnp.bfloat16)
+    got = bidir_extract_stats_pallas(
+        xb, do_softmax=True, tile_m=8, tile_n=128, interpret=True
+    )
+    want = bidir_extract_stats_xla(xb, do_softmax=True)
+    _assert_stats_equal(got, want, True)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_stats_kernel_fused_mutual(dtype):
+    # The mutual prologue must reproduce mutual_matching -> oracle stats,
+    # including the storage-dtype rounding of the filtered values.
+    key = jax.random.PRNGKey(2)
+    c = jax.random.uniform(key, (1, 1, 6, 5, 7, 4), jnp.float32).astype(dtype)
+    x2d = c.reshape(30, 28)
+    maxes = bidir_maxes_pallas(x2d, tile_m=8, tile_n=128, interpret=True)
+    got = bidir_extract_stats_pallas(
+        x2d, do_softmax=True, row_col_max=maxes, tile_m=8, tile_n=128,
+        interpret=True,
+    )
+    filtered = mutual_matching(c).astype(jnp.float32).reshape(30, 28)
+    want = bidir_extract_stats_xla(filtered, do_softmax=True)
+    _assert_stats_equal(got, want, True, rtol=1e-5)
+
+
+@pytest.mark.parametrize("softmax", [True, False])
+@pytest.mark.parametrize("with_delta", [True, False])
+def test_raw_matches_stats_path_equals_xla(softmax, with_delta):
+    key = jax.random.PRNGKey(3)
+    c = jax.random.uniform(key, (1, 1, 6, 5, 7, 4), jnp.float32)
+    k_size, delta = 1, None
+    if with_delta:
+        k_size = 2
+        delta = jax.random.randint(
+            jax.random.PRNGKey(4), c.shape, 0, 16
+        ).astype(jnp.int32)
+    got = _raw_matches_stats(c, delta, k_size, softmax, interpret=True)
+    want = _raw_matches_xla(c, delta, k_size, softmax)
+    # Coordinates are exact (same integer indices); scores agree to fp
+    # tolerance (1/sumexp vs exp(max - logsumexp) round differently).
+    for g, w, name in zip(got[:4], want[:4], "xa ya xb yb".split()):
+        np.testing.assert_array_equal(g, w, err_msg=name)
+    np.testing.assert_allclose(got[4], want[4], rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_inloc_matches_from_consensus_parity(dtype):
+    """Fused mutual+extraction == materialize-then-extract, end to end
+    (sorted + recentered outputs), on a tie-free random tensor."""
+    from ncnet_tpu.evals.inloc import inloc_device_matches
+
+    key = jax.random.PRNGKey(5)
+    consensus = jax.random.uniform(
+        key, (1, 1, 4, 6, 5, 3), jnp.float32
+    ).astype(dtype)
+    got = inloc_matches_from_consensus(
+        consensus, k_size=1, impl="pallas", interpret=True
+    )
+    filtered = mutual_matching(consensus).astype(jnp.float32)
+    want = inloc_device_matches(filtered, k_size=1, impl="xla")
+    # The sort key (score) differs in ulps between the formulations; with
+    # distinct random scores the permutation is identical.
+    for g, w, name in zip(got, want, "xa ya xb yb score".split()):
+        np.testing.assert_allclose(
+            g, w, rtol=1e-4, atol=1e-6, err_msg=name
+        )
+
+
+def test_inloc_device_matches_impl_knob_unknown():
+    c = jnp.zeros((1, 1, 2, 2, 2, 2))
+    from ncnet_tpu.evals.inloc import inloc_device_matches
+
+    with pytest.raises(ValueError, match="unknown extraction impl"):
+        inloc_device_matches(c, impl="nope")

@@ -249,3 +249,78 @@ def test_fused_pallas_grid_orders_agree(rng, impl):
     )
     for da, db in zip(outs["ab"][1], outs["ba"][1]):
         np.testing.assert_array_equal(np.asarray(da), np.asarray(db))
+
+
+@pytest.mark.parametrize("impl", ["bigdot", "dots"])
+def test_emit_maxes_interpret_matches_reductions(rng, impl):
+    """Kernel-accumulated mutual-filter maxes == reductions over the
+    pooled output, including a ragged B tail and va_pad row masking
+    (negative correlations must not lose to zero-feature padding)."""
+    k = 2
+    fa = jnp.asarray(rng.randn(1, 8, 6, 6).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 8, 8, 10).astype(np.float32))
+    pooled, _, (row_max, col_max) = fused_correlation_maxpool_pallas(
+        fa, fb, k, interpret=True, kernel_impl=impl, tile_b_cells=128,
+        emit_maxes=True, grid_order="ab",
+    )
+    p32 = np.asarray(pooled, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(row_max), p32.max(axis=(4, 5)).reshape(-1), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(col_max), p32.max(axis=(2, 3)).reshape(-1), rtol=1e-6
+    )
+    # XLA fallback emits the same statistics.
+    _, _, (rx, cx) = fused_correlation_maxpool_xla(
+        fa, fb, k, emit_maxes=True
+    )
+    np.testing.assert_allclose(np.asarray(rx), np.asarray(row_max), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(cx), np.asarray(col_max), rtol=1e-6)
+
+
+def test_emit_maxes_requires_ab_order(rng):
+    fa = jnp.asarray(rng.randn(1, 4, 4, 4).astype(np.float32))
+    with pytest.raises(ValueError, match="emit_maxes requires grid_order"):
+        fused_correlation_maxpool_pallas(
+            fa, fa, 2, interpret=True, emit_maxes=True, grid_order="ba"
+        )
+
+
+def test_mutual_matching_precomputed_maxes(rng):
+    """mutual_matching(maxes=...) == the self-reducing formulation."""
+    from ncnet_tpu.ops.mutual import mutual_matching
+
+    c = jnp.asarray(
+        rng.randn(1, 1, 4, 5, 6, 3).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    c32 = c.astype(jnp.float32)
+    per_a = jnp.max(c32, axis=(4, 5)).reshape(-1)
+    per_b = jnp.max(c32, axis=(2, 3)).reshape(-1)
+    got = mutual_matching(c, maxes=(per_a, per_b))
+    want = mutual_matching(c)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_forward_fuse_corr_maxes_env_parity(rng, monkeypatch):
+    """NCNET_FUSE_CORR_MAXES=1 leaves the forward output unchanged."""
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import ncnet_forward_from_features
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(4, 1),
+        relocalization_k_size=2,
+        half_precision=True,
+        use_fused_corr_pool=True,
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    fa = jnp.asarray(rng.randn(1, 1024, 8, 6).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 1024, 6, 8).astype(np.float32))
+    base_corr, base_delta = ncnet_forward_from_features(config, params, fa, fb)
+    monkeypatch.setenv("NCNET_FUSE_CORR_MAXES", "1")
+    corr, delta = ncnet_forward_from_features(config, params, fa, fb)
+    np.testing.assert_allclose(
+        np.asarray(corr), np.asarray(base_corr), atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(delta), np.asarray(base_delta))

@@ -95,3 +95,82 @@ def test_plot_helpers(tmp_path):
     pb = np.array([[7.0, 8.0], [11.0, 13.0]])
     plot_matches_horizontal(a, b, pa, pb, str(out2), inliers=np.array([True, False]))
     assert out2.stat().st_size > 0
+
+
+def test_run_with_alarm_timeout_and_value():
+    import time
+
+    from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
+
+    assert run_with_alarm(5, lambda: 42) == 42
+    import pytest as _pytest
+
+    with _pytest.raises(AlarmTimeout):
+        run_with_alarm(1, time.sleep, 10)
+
+
+def test_run_with_alarm_flies_past_except_exception():
+    """AlarmTimeout must not be swallowed by the bench tools' broad
+    per-candidate `except Exception` handlers (it is a BaseException)."""
+    import time
+
+    import pytest as _pytest
+
+    from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
+
+    def swallowing():
+        try:
+            time.sleep(10)
+        except Exception:  # noqa: BLE001 — the pattern under test
+            return "swallowed"
+
+    with _pytest.raises(AlarmTimeout):
+        run_with_alarm(1, swallowing)
+
+
+def test_run_with_alarm_inner_fence_restores_outer():
+    """A nested (per-candidate) fence must re-arm the outer (phase) fence
+    on exit — the 2026-07-31 session-starvation regression guard."""
+    import time
+
+    import pytest as _pytest
+
+    from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
+
+    def body():
+        run_with_alarm(30, lambda: None)  # fast inner fence
+        time.sleep(10)  # outer 2 s fence must still fire here
+
+    with _pytest.raises(AlarmTimeout):
+        run_with_alarm(2, body)
+
+
+def test_run_with_alarm_inner_cannot_extend_outer():
+    """Inner fences longer than the outer's remaining budget are clamped:
+    a phase of candidates whose handlers swallow AlarmTimeout (the bench
+    tools' pattern) drains in ~1 s per candidate once the outer budget is
+    spent, instead of running each candidate to its own full bound."""
+    import time
+
+    from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
+
+    done = []
+
+    def body():
+        for i in range(4):
+            try:
+                run_with_alarm(30, time.sleep, 3)
+                done.append(i)
+            except AlarmTimeout:
+                pass
+
+    t0 = time.monotonic()
+    try:
+        run_with_alarm(2, body)
+    except AlarmTimeout:
+        pass
+    elapsed = time.monotonic() - t0
+    # Unclamped, body would sleep 4 x 3 s = 12 s; the 2 s outer fence must
+    # bound it to ~2 s + ~1 s per remaining clamped candidate.
+    assert elapsed < 9, f"outer fence failed to bound nested fences: {elapsed:.1f}s"
+    assert len(done) < 4

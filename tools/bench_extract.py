@@ -77,10 +77,27 @@ def main(argv=None):
     packed = encode_packed_offsets(*deltas, 2).astype(jnp.int32)
 
     def full(c):
-        return inloc_device_matches(c, delta4d=deltas, k_size=2)
+        return inloc_device_matches(c, delta4d=deltas, k_size=2, impl="xla")
 
     def full_packed(c):
-        return inloc_device_matches(c, delta4d=packed, k_size=2)
+        return inloc_device_matches(c, delta4d=packed, k_size=2, impl="xla")
+
+    def full_pallas_stats(c):
+        # One-read bidirectional statistics kernel (ops/extract_kernel.py).
+        return inloc_device_matches(c, delta4d=packed, k_size=2, impl="pallas")
+
+    def fused_mutual_pallas(c):
+        # Final mutual filter evaluated inside the kernel (two reads total).
+        return inloc_matches_from_consensus(
+            c, delta4d=packed, k_size=2, impl="pallas"
+        )
+
+    def mutual_then_extract_xla(c):
+        # The materializing equivalent of fused_mutual_pallas: what the
+        # default pipeline pays for mutual2 + extraction together.
+        return inloc_matches_from_consensus(
+            c, delta4d=packed, k_size=2, impl="xla"
+        )
 
     def dir_b2a(c):  # native minor-axis reduction, no transpose
         return corr_to_matches(
@@ -104,22 +121,36 @@ def main(argv=None):
             invert_matching_direction=True,
         )
 
+    # Pallas candidates first: the XLA formulations are the known compile
+    # hazard at this shape (a >20 min remote-compile hang on 2026-07-31
+    # starved the whole session queue), so they run last under a fence.
     candidates = {
-        "full both dirs+sort": full,
+        "full pallas-stats": full_pallas_stats,
+        "fused mutual+extract": fused_mutual_pallas,
         "full packed-deltas": full_packed,
+        "full both dirs+sort": full,
+        "mutual+extract (xla)": mutual_then_extract_xla,
         "dir B->A (minor)": dir_b2a,
         "dir A->B (transpose)": dir_a2b,
         "dir A->B no-softmax": dir_a2b_nosoftmax,
         "dir B->A no-delta": dir_b2a_nodelta,
     }
 
+    from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
+
     for name, fn in candidates.items():
         try:
-            first, dt, _ = timed_steady(
-                chain_reps(fn, args.reps), corr, iters=args.iters
+            first, dt, _ = run_with_alarm(
+                420,
+                timed_steady,
+                chain_reps(fn, args.reps),
+                corr,
+                iters=args.iters,
             )
             log(f"{name:22s} first={first:6.2f}s "
                 f"-> {dt * 1000 / args.reps:7.1f}ms/app")
+        except AlarmTimeout:
+            log(f"{name:22s} TIMED OUT (>420s compile/run)")
         except Exception as exc:  # noqa: BLE001
             log(f"{name:22s} FAILED: {type(exc).__name__}: "
                 f"{str(exc).splitlines()[0][:120]}")

@@ -26,10 +26,10 @@ def log(msg):
     print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
 
 
-def main():
+def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--dial_timeout", type=float, default=600.0)
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
     import jax
     import jax.numpy as jnp
@@ -39,7 +39,12 @@ def main():
         fused_correlation_maxpool_pallas,
         fused_correlation_maxpool_xla,
     )
-    from ncnet_tpu.utils.profiling import dial_devices, setup_compile_cache
+    from ncnet_tpu.utils.profiling import (
+        AlarmTimeout,
+        dial_devices,
+        run_with_alarm,
+        setup_compile_cache,
+    )
 
     setup_compile_cache()
     devices = dial_devices(args.dial_timeout)
@@ -108,6 +113,82 @@ def main():
                     jax.block_until_ready(out)
                     float(jnp.sum(out[0][0]))  # force through the tunnel
                 log(f"{name}: {label} {(time.perf_counter() - t0) / 5 * 1e3:.1f} ms/call")
+
+    # --- bidirectional extraction-statistics kernel (ops/extract_kernel) ---
+    from ncnet_tpu.ops.extract_kernel import (
+        bidir_extract_stats_pallas,
+        bidir_extract_stats_xla,
+        bidir_maxes_pallas,
+    )
+
+    # (name, M, N[, mutual]) — small first, then the InLoc post-pool matrix
+    # (100x75 cells per side -> 7500x7500).
+    ext_cases = [
+        ("extract small 1200x1200", 1200, 1200, False),
+        ("extract inloc 7500x7500", 7500, 7500, False),
+        ("extract inloc fused-mutual", 7500, 7500, True),
+    ]
+    for name, m, n, fused_mutual in ext_cases:
+        x = jax.random.normal(
+            jax.random.PRNGKey(1), (m, n), jnp.float32
+        ).astype(jnp.bfloat16)
+        try:
+            log(f"{name}: compiling (Mosaic)...")
+
+            def pallas_fn(v, _fused=fused_mutual):
+                maxes = bidir_maxes_pallas(v) if _fused else None
+                return bidir_extract_stats_pallas(v, row_col_max=maxes)
+
+            def xla_fn(v, _fused=fused_mutual):
+                maxes = None
+                if _fused:
+                    (rm, _, _), (cm, _, _) = bidir_extract_stats_xla(
+                        v, do_softmax=False
+                    )
+                    maxes = (rm, cm)
+                return bidir_extract_stats_xla(v, row_col_max=maxes)
+
+            run_e = jax.jit(pallas_fn)
+            got = jax.tree.map(np.asarray, run_e(x))
+            log(f"{name}: Pallas compiled+ran; running XLA oracle...")
+            # Fence the oracle: XLA argmax over the 56M-element matrix is
+            # the formulation class with a documented multi-minute
+            # remote-compile pathology; one hang must not consume the
+            # whole smoke phase (and its ALL PASS verdict).
+            want = run_with_alarm(
+                420, lambda: jax.tree.map(np.asarray, jax.jit(xla_fn)(x))
+            )
+        except AlarmTimeout:
+            log(f"{name}: FAIL (XLA oracle timed out >420s; Pallas ran)")
+            failures += 1
+            continue
+        except Exception as exc:  # noqa: BLE001
+            log(f"{name}: FAIL ({type(exc).__name__}: {exc})")
+            failures += 1
+            continue
+        worst = 0.0
+        argmis = 0.0
+        for (gm, ga, gs), (wm, wa, ws) in zip(got, want):
+            worst = max(
+                worst,
+                float(np.max(np.abs(gm - wm))),
+                float(np.max(np.abs(gs - ws) / np.maximum(np.abs(ws), 1e-6))),
+            )
+            argmis = max(argmis, float(np.mean(ga != wa)))
+        ok = worst <= 1e-2 and argmis <= 1e-3
+        log(
+            f"{name}: {'PASS' if ok else 'FAIL'} "
+            f"stat_err={worst:.4g} arg_mismatch_frac={argmis:.2e}"
+        )
+        failures += 0 if ok else 1
+        if ok and m == 7500:
+            run_e(x)  # warm
+            t0 = time.perf_counter()
+            for _ in range(5):
+                out = run_e(x)
+                jax.block_until_ready(out)
+                float(jnp.sum(out[0][0]))
+            log(f"{name}: pallas {(time.perf_counter() - t0) / 5 * 1e3:.1f} ms/call")
 
     log(f"{'ALL PASS' if failures == 0 else f'{failures} FAILURES'}")
     return 0 if failures == 0 else 1

@@ -67,6 +67,9 @@ def main(argv=None):
     # short watchdog — if the tunnel died between phases we want to move on,
     # not burn 10 minutes per remaining phase.
     phases = [
+        # Correctness first: both Pallas kernels vs their XLA oracles under
+        # real Mosaic (corr+pool AND the bidirectional extraction stats).
+        ("smoke", "pallas_tpu_smoke", ["--dial_timeout", "120"]),
         ("corr_pool", "bench_corr_pool",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("consensus", "bench_consensus",
@@ -81,13 +84,21 @@ def main(argv=None):
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("train", "bench_train", ["--dial_timeout", "120", "--iters", "4"]),
     ]
+    from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
+
     for label, modname, phase_argv in phases:
         if label in skip:
             log(f"=== {label}: SKIPPED ===")
             continue
         log(f"=== {label} ===")
         try:
-            _load(modname).main(phase_argv)
+            # 25 min per phase: one pathological compile must not starve
+            # the rest of the queue (observed 2026-07-31, see
+            # run_with_alarm). Individual tools add tighter per-candidate
+            # fences where hangs were actually seen.
+            run_with_alarm(1500, _load(modname).main, phase_argv)
+        except AlarmTimeout as exc:
+            log(f"{label} TIMED OUT: {exc}")
         except SystemExit as exc:  # tools os._exit on dial fail only
             log(f"{label} exited: {exc}")
         except Exception:  # noqa: BLE001
@@ -95,29 +106,41 @@ def main(argv=None):
 
     if "bench" not in skip:
         os.environ["NCNET_BENCH_DIAL_TIMEOUT"] = "120"
-        # The baseline run must not inherit a mix left over from a prior
-        # manual experiment — the A/B below would then compare a config
-        # with itself.
-        os.environ.pop("NCNET_CONSENSUS_STRATEGIES", None)
-        log("=== bench (headline JSON on stdout) ===")
-        try:
-            _load("../bench").main()
-        except Exception:  # noqa: BLE001
-            log(f"bench FAILED:\n{traceback.format_exc()}")
-        # Candidate-mix re-run: the CPU A/B's best consensus strategy mix,
-        # via the trace-time env knob — if this line beats the default's,
-        # flip the 'auto' heuristic in ops/conv4d.py.
-        log("=== bench with NCNET_CONSENSUS_STRATEGIES="
-            "conv2d_stacked,conv2d_outstacked ===")
-        try:
-            os.environ["NCNET_CONSENSUS_STRATEGIES"] = (
-                "conv2d_stacked,conv2d_outstacked"
-            )
-            _load("../bench").main()
-        except Exception:  # noqa: BLE001
-            log(f"bench(mix) FAILED:\n{traceback.format_exc()}")
-        finally:
-            os.environ.pop("NCNET_CONSENSUS_STRATEGIES", None)
+        # Headline A/B matrix via trace-time env knobs. The baseline run
+        # must not inherit knobs left over from a prior manual experiment
+        # — each run sets exactly its own dict and pops it afterwards.
+        # Winners get promoted to code defaults:
+        #   mix          -> the 'auto' heuristic in ops/conv4d.py
+        #   fused-mutual -> the step composition in bench.py /
+        #                   cli/eval_inloc.py
+        #   full-fusion  -> additionally NCNET_FUSE_CORR_MAXES default in
+        #                   models/ncnet.py
+        bench_runs = [
+            ("baseline", {}),
+            ("mix", {"NCNET_CONSENSUS_STRATEGIES":
+                     "conv2d_stacked,conv2d_outstacked"}),
+            ("fused-mutual", {"NCNET_FUSE_MUTUAL_EXTRACT": "1"}),
+            ("full-fusion", {"NCNET_FUSE_MUTUAL_EXTRACT": "1",
+                             "NCNET_FUSE_CORR_MAXES": "1"}),
+        ]
+        for run_label, env in bench_runs:
+            for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
+                      "NCNET_FUSE_CORR_MAXES"):
+                os.environ.pop(k, None)
+            os.environ.update(env)
+            log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
+            try:
+                # Same fence as the phases: bench.py's fallback ladder can
+                # reach the XLA extraction tier whose InLoc-shape compile
+                # is the documented >20 min remote-compile hang.
+                run_with_alarm(1500, _load("../bench").main)
+            except AlarmTimeout as exc:
+                log(f"bench[{run_label}] TIMED OUT: {exc}")
+            except Exception:  # noqa: BLE001
+                log(f"bench[{run_label}] FAILED:\n{traceback.format_exc()}")
+            finally:
+                for k in env:
+                    os.environ.pop(k, None)
     log("session DONE")
     return 0
 
