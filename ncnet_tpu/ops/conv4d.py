@@ -122,37 +122,50 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     single_conv = strategy in ("conv2d_stacked", "convnd")
     acc_dtype = x.dtype if single_conv else jnp.float32
     w = weight.astype(x.dtype)
+    # AD memory policy, shared by every multi-part strategy below: each
+    # part (a kernel-offset term, or a whole stacked formulation) is
+    # wrapped in jax.checkpoint so its backward residual is the SHARED
+    # padded input rather than the part's private reshaped copy. Without
+    # this, value_and_grad through e.g. the 5^4-kernel conv2d loop saves
+    # 25 x 400 MB reshaped input copies per 16->16 consensus layer at the
+    # PF-Pascal training shape — the 53 GB HBM OOM of the 2026-07-31
+    # bench_train run on a 16 GB v5e. Forward-only jits are untouched
+    # (checkpoint is the identity without AD), and the backward recompute
+    # is bandwidth-cheap slicing.
     if strategy == "conv2d":
         # Zero-pad J on both sides (I is already halo/zero padded by the
         # caller); every (di, dj) kernel offset is then a contiguous slice.
         pad_j = kj // 2
         xp = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0)))
+
+        def offset_term(xp_, w2d, di, dj):
+            xs = lax.slice_in_dim(xp_, di, di + si, axis=2)
+            xs = lax.slice_in_dim(xs, dj, dj + sj, axis=3)
+            xs = jnp.moveaxis(xs, 1, 5).reshape(b * si * sj, sk, sl, cin)
+            # [kk, kl, cin, cout] filter, NHWC in/out: the TPU-native
+            # layout (channels minor).
+            return lax.conv_general_dilated(
+                xs,
+                w2d,
+                window_strides=(1, 1),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32,
+            )
+
+        offset_term = jax.checkpoint(offset_term, static_argnums=(2, 3))
         out = None
         for di in range(ki):
             for dj in range(kj):
-                xs = lax.slice_in_dim(xp, di, di + si, axis=2)
-                xs = lax.slice_in_dim(xs, dj, dj + sj, axis=3)
-                xs = jnp.moveaxis(xs, 1, 5).reshape(b * si * sj, sk, sl, cin)
-                # [kk, kl, cin, cout] filter, NHWC in/out: the TPU-native
-                # layout (channels minor).
-                y = lax.conv_general_dilated(
-                    xs,
-                    w[di, dj],
-                    window_strides=(1, 1),
-                    padding="SAME",
-                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                    preferred_element_type=jnp.float32,
-                )
+                y = offset_term(xp, w[di, dj], di, dj)
                 out = y if out is None else out + y
         out = out.reshape(b, si, sj, sk, sl, cout)
         out = jnp.moveaxis(out, 5, 1)
     elif strategy == "conv3d":
-        out = None
-        for di in range(ki):
-            xs = lax.dynamic_slice_in_dim(x, di, si, axis=2)
+        def di_term(x_, w3, di):
+            xs = lax.dynamic_slice_in_dim(x_, di, si, axis=2)
             xs = jnp.moveaxis(xs, 2, 1).reshape(b * si, cin, sj, sk, sl)
-            w3 = jnp.transpose(w[di], (4, 3, 0, 1, 2))  # [cout, cin, kj, kk, kl]
-            y = lax.conv_general_dilated(
+            return lax.conv_general_dilated(
                 xs,
                 w3,
                 window_strides=(1, 1, 1),
@@ -160,6 +173,12 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
                 dimension_numbers=("NCHWD", "OIHWD", "NCHWD"),
                 preferred_element_type=jnp.float32,
             )
+
+        di_term = jax.checkpoint(di_term, static_argnums=(2,))
+        out = None
+        for di in range(ki):
+            w3 = jnp.transpose(w[di], (4, 3, 0, 1, 2))  # [cout, cin, kj, kk, kl]
+            y = di_term(x, w3, di)
             out = y if out is None else out + y
         out = jnp.moveaxis(out.reshape(b, si, cout, sj, sk, sl), 1, 2)
     elif strategy == "conv2d_stacked":
@@ -171,29 +190,35 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
         # (consensus layer 1 has cin=1); for large cin the stacked tensor
         # dominates and 'conv2d' is the right shape.
         pad_j = kj // 2
-        xp = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0)))
-        slabs = []
-        for di in range(ki):
-            for dj in range(kj):
-                xs = lax.slice_in_dim(xp, di, di + si, axis=2)
-                xs = lax.slice_in_dim(xs, dj, dj + sj, axis=3)
-                slabs.append(jnp.moveaxis(xs, 1, 5))  # [b, I, J, K, L, cin]
-        stacked = jnp.concatenate(slabs, axis=5).reshape(
-            b * si * sj, sk, sl, ki * kj * cin
-        )
-        w_stacked = w.reshape(ki * kj, kk, kl, cin, cout)
-        w_stacked = jnp.moveaxis(w_stacked, 0, 2).reshape(
-            kk, kl, ki * kj * cin, cout
-        )
-        out = lax.conv_general_dilated(
-            stacked,
-            w_stacked,
-            window_strides=(1, 1),
-            padding="SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=acc_dtype,
-        )
-        out = jnp.moveaxis(out.reshape(b, si, sj, sk, sl, cout), 5, 1)
+
+        def stacked_body(x_, w_):
+            xp = jnp.pad(
+                x_, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0))
+            )
+            slabs = []
+            for di in range(ki):
+                for dj in range(kj):
+                    xs = lax.slice_in_dim(xp, di, di + si, axis=2)
+                    xs = lax.slice_in_dim(xs, dj, dj + sj, axis=3)
+                    slabs.append(jnp.moveaxis(xs, 1, 5))  # [b, I, J, K, L, cin]
+            stacked = jnp.concatenate(slabs, axis=5).reshape(
+                b * si * sj, sk, sl, ki * kj * cin
+            )
+            w_stacked = w_.reshape(ki * kj, kk, kl, cin, cout)
+            w_stacked = jnp.moveaxis(w_stacked, 0, 2).reshape(
+                kk, kl, ki * kj * cin, cout
+            )
+            y = lax.conv_general_dilated(
+                stacked,
+                w_stacked,
+                window_strides=(1, 1),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=acc_dtype,
+            )
+            return jnp.moveaxis(y.reshape(b, si, sj, sk, sl, cout), 5, 1)
+
+        out = jax.checkpoint(stacked_body)(x, w)
     elif strategy == "conv2d_outstacked":
         # Dual of 'conv2d_stacked': fold the kI*kJ offsets into the conv
         # OUTPUT channels — one conv2d over (K, L) with cout' = kI*kJ*cout
@@ -204,31 +229,38 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
         # layer 2: cin=16, cout=1, where input-stacking would blow the
         # input up 9x and 'conv2d' starves the MXU at N=1).
         pad_j = kj // 2
-        xp = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0)))
         sip, sjp = si_pad, sj + 2 * pad_j
-        xs = jnp.moveaxis(xp, 1, 5).reshape(b * sip * sjp, sk, sl, cin)
-        # [kk, kl, cin, ki*kj*cout]: offset-major output channels.
-        w_out = jnp.transpose(w, (2, 3, 4, 0, 1, 5)).reshape(
-            kk, kl, cin, ki * kj * cout
-        )
-        y = lax.conv_general_dilated(
-            xs,
-            w_out,
-            window_strides=(1, 1),
-            padding="SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
-        ).reshape(b, sip, sjp, sk, sl, ki * kj, cout)
-        # out[i, j] = sum_{di,dj} y[i+di, j+dj, (di,dj)]: padded rows hold
-        # conv-of-zeros = 0, reproducing 'same' zero padding exactly.
-        out = None
-        for di in range(ki):
-            for dj in range(kj):
-                ys = lax.slice_in_dim(y, di, di + si, axis=1)
-                ys = lax.slice_in_dim(ys, dj, dj + sj, axis=2)
-                ys = ys[:, :, :, :, :, di * kj + dj]
-                out = ys if out is None else out + ys
-        out = jnp.moveaxis(out, 5, 1)
+
+        def outstacked_body(x_, w_):
+            xp = jnp.pad(
+                x_, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0))
+            )
+            xs = jnp.moveaxis(xp, 1, 5).reshape(b * sip * sjp, sk, sl, cin)
+            # [kk, kl, cin, ki*kj*cout]: offset-major output channels.
+            w_out = jnp.transpose(w_, (2, 3, 4, 0, 1, 5)).reshape(
+                kk, kl, cin, ki * kj * cout
+            )
+            y = lax.conv_general_dilated(
+                xs,
+                w_out,
+                window_strides=(1, 1),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32,
+            ).reshape(b, sip, sjp, sk, sl, ki * kj, cout)
+            # out[i, j] = sum_{di,dj} y[i+di, j+dj, (di,dj)]: padded rows
+            # hold conv-of-zeros = 0, reproducing 'same' zero padding
+            # exactly.
+            acc = None
+            for di in range(ki):
+                for dj in range(kj):
+                    ys = lax.slice_in_dim(y, di, di + si, axis=1)
+                    ys = lax.slice_in_dim(ys, dj, dj + sj, axis=2)
+                    ys = ys[:, :, :, :, :, di * kj + dj]
+                    acc = ys if acc is None else acc + ys
+            return jnp.moveaxis(acc, 5, 1)
+
+        out = jax.checkpoint(outstacked_body)(x, w)
     elif strategy == "convnd":
         # One rank-4-spatial convolution: XLA's ConvGeneral HLO is rank-
         # agnostic, so the whole 4-D stencil is a single op and the compiler

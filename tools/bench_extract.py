@@ -56,7 +56,10 @@ def main(argv=None):
 
     import jax.numpy as jnp
 
-    from ncnet_tpu.evals.inloc import inloc_device_matches
+    from ncnet_tpu.evals.inloc import (
+        inloc_device_matches,
+        inloc_matches_from_consensus,
+    )
     from ncnet_tpu.ops.matches import corr_to_matches
 
     ii = max(int(100 * args.scale) // 4 * 4, 8)
