@@ -113,7 +113,6 @@ def main():
         # program of cli/eval_inloc.py.
         fuse_mutual = os.environ.get("NCNET_FUSE_MUTUAL_EXTRACT") == "1"
 
-        @jax.jit
         def step(params, feat_a, tgt):
             feat_b = extract_features(config, params, tgt)
             corr, delta = ncnet_forward_from_features(
@@ -127,12 +126,40 @@ def main():
                 corr, delta4d=delta, k_size=2, impl=extract_impl
             )
 
-        return params, query_feats, step
+        # One query block = ONE device program: query features + a
+        # lax.scan over the pano stack. Per-program dispatch through a
+        # tunneled backend costs ~50 ms (measured 2026-07-31: four
+        # stage-level optimizations moved chained stage times but not the
+        # headline — the 10 per-pano dispatches were the bottleneck), and
+        # a local runtime pays a smaller but real per-dispatch cost too.
+        # The eval CLI exposes the same batching (--pano_batch).
+        @jax.jit
+        def block(params, src, tgt_stack):
+            feat_a = query_feats(params, src)
 
+            def body(acc, tgt):
+                m = step(params, feat_a, tgt[None])
+                # Probe one element of EVERY output array (the chain_reps
+                # rule, utils/profiling.py): summing only the scores would
+                # let XLA dead-code-eliminate the coordinate extraction
+                # (argmax/delta decode) from the compiled block.
+                probe = sum(v.ravel()[0].astype(jnp.float32) for v in m)
+                return acc + probe, None
+
+            acc, _ = jax.lax.scan(body, jnp.float32(0), tgt_stack)
+            return acc
+
+        return params, block
+
+    panos_per_query = 10  # eval_inloc.py:124-132: top-10 shortlist per query
     key = jax.random.PRNGKey(1)
     k1, k2 = jax.random.split(key)
     src = jax.random.normal(k1, (1, 3, h_a, w_a), jnp.float32)
-    tgt = jax.random.normal(k2, (1, 3, h_b, w_b), jnp.float32)
+    # Distinct pano contents: honest per-pano work inside the scan (and
+    # nothing for the compiler to share across iterations).
+    tgt_stack = jax.random.normal(
+        k2, (panos_per_query, 3, h_b, w_b), jnp.float32
+    )
 
     # Fallback ladder: both Pallas kernels -> Pallas corr+pool with XLA
     # extraction -> forced XLA slab-scan (same never-materialize memory
@@ -148,14 +175,13 @@ def main():
         mode, extract_impl = tier
         name = f"{mode}+extract-{extract_impl}"
         try:
-            params, query_feats, step = build(mode, extract_impl)
-            note(f"compiling+first-run '{name}' step at {h_a}x{w_a} (first "
+            params, block = build(mode, extract_impl)
+            note(f"compiling+first-run '{name}' block at {h_a}x{w_a} (first "
                  "compile of this shape can take many minutes on a tunneled "
                  "backend)...")
-            feat_a = query_feats(params, src)
-            out = step(params, feat_a, tgt)  # warmup/compile
+            out = block(params, src, tgt_stack)  # warmup/compile
             jax.block_until_ready(out)
-            note(f"'{name}' step compiled and ran")
+            note(f"'{name}' block compiled and ran")
             break
         except Exception as exc:  # noqa: BLE001
             if tier == tiers[-1]:
@@ -166,25 +192,14 @@ def main():
 
     # Timing through a scalar fetch: on tunneled backends (axon)
     # block_until_ready can return before execution completes, so each
-    # iteration is closed by materializing a tiny host-side reduction of the
-    # outputs — the fetch cannot complete before the step has run.
-    panos_per_query = 10  # eval_inloc.py:124-132: top-10 shortlist per query
+    # iteration is closed by materializing a tiny host-side scalar — the
+    # fetch cannot complete before the block has run. One fetch per block:
+    # per-pano float()s would serialize a tunnel round trip (~40 ms on
+    # axon) into every step.
 
     def run_block():
-        """One query block: query features once + 10 pano steps.
-
-        The per-pano scalar reductions stay on device and the block closes
-        with ONE host fetch: a per-pano float() would serialize a tunnel
-        round trip (~40 ms on axon) into every step, and the real eval
-        pipeline likewise overlaps host reads with the next pano's device
-        work (cli/eval_inloc.py)."""
-        fa = query_feats(params, src)
-        acc = None
-        for _ in range(panos_per_query):
-            m = step(params, fa, tgt)
-            s = jnp.sum(m[4])
-            acc = s if acc is None else acc + s
-        return float(acc)
+        """One query block: query features + 10 pano steps, one program."""
+        return float(block(params, src, tgt_stack))
 
     run_block()  # settle caches/queues
     note("timing...")

@@ -118,9 +118,21 @@ def main(argv=None):
         "--profile_dir", type=str, default="",
         help="capture a jax.profiler trace of the run for TensorBoard/Perfetto",
     )
+    parser.add_argument(
+        "--pano_batch", type=int, default=1,
+        help="panos per device program: same-bucket panos are stacked and "
+        "scanned inside ONE dispatch (ragged groups padded by repetition). "
+        "Per-dispatch latency dominates tunneled backends (~50 ms each, "
+        "2026-07-31 measurement); 1 = one dispatch per pano.",
+    )
     args = parser.parse_args(argv)
     if args.spatial_shards < 1:
         parser.error("--spatial_shards must be >= 1")
+    if args.pano_batch < 1:
+        parser.error("--pano_batch must be >= 1")
+    if args.pano_batch > 1 and args.spatial_shards > 1:
+        parser.error("--pano_batch requires --spatial_shards 1 (the sharded "
+                     "pipeline batches across the mesh instead)")
 
     from scipy.io import loadmat
 
@@ -189,11 +201,22 @@ def main(argv=None):
         def query_features(params, src):
             return extract_features(config, params, src)
 
-        @jax.jit
-        def pano_matches(params, feat_a, tgt):
+        def pano_matches_one(params, feat_a, tgt):
             feat_b = extract_features(config, params, tgt)
             corr, delta = ncnet_forward_from_features(config, params, feat_a, feat_b)
             return inloc_device_matches(corr, delta4d=delta, **match_kwargs)
+
+        pano_matches = jax.jit(pano_matches_one)
+
+        @jax.jit
+        def pano_matches_batch(params, feat_a, tgt_stack):
+            # lax.scan over a same-shape pano stack: the whole group is one
+            # dispatch; outputs stack to [P, n] per match array.
+            def body(_, tgt):
+                return None, pano_matches_one(params, feat_a, tgt[None])
+
+            _, ms = jax.lax.scan(body, None, tgt_stack)
+            return ms
 
     n_matches = int(
         (args.image_size * 0.0625 / args.k_size)
@@ -216,17 +239,67 @@ def main(argv=None):
 
     from ..utils.profiling import trace_context
 
-    pool = ThreadPoolExecutor(max_workers=1)
+    pool = ThreadPoolExecutor(max_workers=2 if args.pano_batch > 1 else 1)
+    batch_fn = pano_matches_batch if args.pano_batch > 1 else None
     try:
         with trace_context(args.profile_dir):
             _query_loop(args, db, out_dir, params, query_features, pano_matches,
-                        n_matches, pano_fn_all, pool, load_pano)
+                        n_matches, pano_fn_all, pool, load_pano, batch_fn)
     finally:
         pool.shutdown(wait=False, cancel_futures=True)
 
 
+def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
+                       load_pano):
+    """All of one query's panos in same-shape stacks of --pano_batch.
+
+    Ragged groups are padded by repeating their last pano (the padded
+    iterations' outputs are discarded), so each bucket shape compiles
+    exactly one program regardless of how the shortlist's shapes mix.
+    """
+    p = args.pano_batch
+    futures = [pool.submit(load_pano, fn) for fn in pano_fns]
+    groups = {}  # (H, W) -> list of (pano_idx, image) not yet dispatched
+
+    def flush(idxs, ms):
+        np_ms = jax.device_get(ms)
+        for k, idx in enumerate(idxs):
+            fill_matches(buf, idx, dedup_matches(*(a[k] for a in np_ms)))
+
+    pending = None  # one-behind: dispatch next stack before fetching prior
+
+    def dispatch(chunk):
+        nonlocal pending
+        padded = chunk + [chunk[-1]] * (p - len(chunk))
+        stack = jnp.concatenate([img for _, img in padded], axis=0)
+        ms = batch_fn(params, feat_a, stack)
+        if pending is not None:
+            flush(*pending)
+        # Keep only indices + device handles: the host image copies are
+        # dropped here, bounding host/device memory to ~p images per
+        # in-flight group instead of the whole shortlist.
+        pending = ([idx for idx, _ in chunk], ms)
+
+    # Incremental grouping: a stack dispatches the moment p same-shape
+    # panos have decoded, so decode (threaded, hundreds of ms at 3200 px)
+    # overlaps the device forward of the previous stack — same pipelining
+    # property as the unbatched one-behind loop.
+    for idx, fut in enumerate(futures):
+        img = fut.result()
+        g = groups.setdefault(img.shape[2:], [])
+        g.append((idx, img))
+        if len(g) == p:
+            dispatch(g[:])
+            g.clear()
+    for g in groups.values():
+        if g:
+            dispatch(g)
+    if pending is not None:
+        flush(*pending)
+
+
 def _query_loop(args, db, out_dir, params, query_features, pano_matches,
-                n_matches, pano_fn_all, pool, load_pano):
+                n_matches, pano_fn_all, pool, load_pano, batch_fn=None):
     for q in range(min(args.n_queries, len(db))):
         out_path = os.path.join(out_dir, f"{q + 1}.mat")
         if args.resume and os.path.exists(out_path):
@@ -241,6 +314,12 @@ def _query_loop(args, db, out_dir, params, query_features, pano_matches,
         feat_a = query_features(params, src)
         buf = matches_buffer(args.n_panos, n_matches)
         pano_fns = [db[q][1].ravel()[i].item() for i in range(args.n_panos)]
+        if batch_fn is not None:
+            _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns,
+                               pool, load_pano)
+            write_matches_mat(out_path, buf, query_fn, pano_fn_all)
+            print(f"wrote {out_path}", flush=True)
+            continue
         fut = pool.submit(load_pano, pano_fns[0]) if pano_fns else None
         # One-behind host processing: pano idx's forward is dispatched (async)
         # BEFORE pano idx-1's matches are fetched and deduped, so the

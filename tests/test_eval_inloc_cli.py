@@ -194,3 +194,49 @@ def test_spatial_shards_cli(fixture_dir, shards):
     m = loadmat(out_dir / exp[0] / "1.mat")["matches"]
     assert m.shape[0] == 1 and m.shape[3] == 5
     assert np.isfinite(m[0, 0]).all()
+
+
+def test_pano_batch_matches_unbatched(fixture_dir):
+    """--pano_batch (scanned same-shape stacks, incl. ragged padding) writes
+    the same .mat contents as the per-pano dispatch path."""
+    from scipy.io import loadmat
+
+    ref_dir = _run(fixture_dir)
+    out_b = fixture_dir / "matches_batched"
+    eval_inloc.main(
+        [
+            "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
+            "--query_path", str(fixture_dir / "query"),
+            "--pano_path", str(fixture_dir / "pano"),
+            "--output_dir", str(out_b),
+            "--image_size", "64",
+            "--n_queries", "2",
+            "--n_panos", "2",
+            "--k_size", "2",
+            # 3 > n_panos: exercises the ragged-group repeat padding.
+            "--pano_batch", "3",
+        ]
+    )
+    exp = os.listdir(out_b)
+    assert len(exp) == 1
+    got_dir = out_b / exp[0]
+    names = sorted(os.listdir(ref_dir))
+    assert sorted(os.listdir(got_dir)) == names and names
+    for fn in names:
+        want = loadmat(ref_dir / fn)["matches"]
+        got = loadmat(got_dir / fn)["matches"]
+        # The scanned program is a DIFFERENT compiled artifact: XLA fusion
+        # choices shift bf16 rounding by ~1e-4, which flips near-tied
+        # argmax winners on these noise-image fixtures — exact coordinate
+        # equality is not a property of the batching. Assert the stable
+        # contract instead: same layout, same filled rows, coordinates in
+        # range, and the descending score columns equal to rounding.
+        assert got.shape == want.shape
+        filled_w = np.any(want != 0, axis=-1)
+        filled_g = np.any(got != 0, axis=-1)
+        np.testing.assert_array_equal(filled_g, filled_w)
+        assert np.all((got[..., :4] >= 0) & (got[..., :4] <= 1))
+        np.testing.assert_allclose(
+            got[..., 4], want[..., 4], atol=2e-3,
+            err_msg="score column diverged beyond bf16 rounding",
+        )

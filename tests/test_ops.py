@@ -414,3 +414,33 @@ def test_neigh_consensus_strategies_env(rng, monkeypatch):
     monkeypatch.setenv("NCNET_CONSENSUS_STRATEGIES", "conv3d")  # wrong arity
     with pytest.raises(ValueError, match="one entry per layer"):
         neigh_consensus_apply(params, corr)
+
+
+@pytest.mark.parametrize(
+    "strategy", ["conv2d", "conv3d", "conv2d_stacked", "conv2d_outstacked"]
+)
+def test_conv4d_grad_parity_across_strategies(rng, strategy):
+    """Gradients through every checkpointed decomposition match the dense
+    einsum reference. Guards the jax.checkpoint AD-memory rework
+    (ops/conv4d.py): a wrapping mistake would silently change training
+    gradients (or re-introduce the 53 GB residual blow-up) and only
+    surface as wrong results on hardware."""
+    import jax
+
+    from ncnet_tpu.ops.conv4d import conv4d, conv4d_reference
+
+    x = jnp.asarray(rng.randn(1, 2, 6, 5, 6, 5).astype(np.float32))
+    w = jnp.asarray(0.1 * rng.randn(3, 3, 3, 3, 2, 3).astype(np.float32))
+    b = jnp.asarray(rng.randn(3).astype(np.float32))
+    cot = jnp.asarray(rng.randn(1, 3, 6, 5, 6, 5).astype(np.float32))
+
+    def loss(fn):
+        return lambda x_, w_, b_: jnp.sum(fn(x_, w_, b_) * cot)
+
+    gx, gw, gb = jax.grad(
+        loss(lambda *a: conv4d(*a, strategy=strategy)), argnums=(0, 1, 2)
+    )(x, w, b)
+    rx, rw, rb = jax.grad(loss(conv4d_reference), argnums=(0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(gx, rx, atol=2e-4)
+    np.testing.assert_allclose(gw, rw, atol=2e-4)
+    np.testing.assert_allclose(gb, rb, atol=2e-4)

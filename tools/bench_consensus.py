@@ -71,48 +71,77 @@ def main(argv=None):
     # a layer's strategy is None).
     os.environ.pop("NCNET_CONV4D_STRATEGY", None)
 
-    # (label, chunk_i, per-layer strategies or None for layer-wise 'auto')
+    # Post-2026-07-31 sweep: the chunk scan and conv3d rows are decided
+    # (one-shot stacked+outstacked won at 122-132 ms and is now the code
+    # default); the cases below keep the champion + chunked sanity as
+    # regression anchors and add the DIAGNOSTIC splits that decide whether
+    # a fused consensus Pallas kernel is worth building — where the stage
+    # time goes (mutual reductions vs per-layer convs vs the symmetric
+    # double-evaluation).
+    x16 = jax.random.normal(
+        jax.random.PRNGKey(2), (1, 16, ii, jj, ii, jj), jnp.float32
+    ).astype(jnp.bfloat16)
+    maxes = (
+        jnp.max(corr.astype(jnp.float32), axis=(4, 5)).reshape(-1),
+        jnp.max(corr.astype(jnp.float32), axis=(2, 3)).reshape(-1),
+    )
+
+    def full_stage(c):  # what the pipeline default runs
+        c = mutual_matching(c)
+        c = neigh_consensus_apply(params, c, symmetric=True, chunk_i=0)
+        return mutual_matching(c)
+
+    def chunked_stage(c):
+        c = mutual_matching(c)
+        c = neigh_consensus_apply(params, c, symmetric=True, chunk_i=25)
+        return mutual_matching(c)
+
+    def convs_only(c):
+        return neigh_consensus_apply(params, c, symmetric=True, chunk_i=0)
+
+    def convs_nonsym(c):
+        return neigh_consensus_apply(params, c, symmetric=False, chunk_i=0)
+
+    def l1_only(c):
+        return neigh_consensus_apply(
+            params[:1], c, symmetric=False, chunk_i=0,
+            strategies=("conv2d_stacked",),
+        )
+
+    def l2_only(c):
+        return neigh_consensus_apply(
+            params[1:], x16 * (1 + 0 * jnp.sum(c)), symmetric=False,
+            chunk_i=0, strategies=("conv2d_outstacked",),
+        )
+
+    def mutuals_only(c):
+        return mutual_matching(mutual_matching(c))
+
+    def mutual_elementwise(c):
+        # The emit_maxes downstream: filter with precomputed maxes — no
+        # reduction passes.
+        return mutual_matching(c, maxes=maxes)
+
     cases = [
-        ("chunk3-auto   (round-2 default)", 3, None),
-        ("chunk7-auto", 7, None),
-        ("chunk13-auto", 13, None),
-        ("chunk25-auto", 25, None),
-        ("chunk13-conv3d", 13, ("conv3d", "conv3d")),
-        ("oneshot-conv3d", 0, ("conv3d", "conv3d")),
-        # conv2d OOMs the one-shot layer 2 at full scale; does the
-        # stacked-l1 + conv3d-l2 mix fit and win?
-        ("oneshot-stacked+conv3d", 0, ("conv2d_stacked", "conv3d")),
-        # Output-stacked layer 2: single input read + MXU N=9 (vs 1) —
-        # the traffic/shape argument says this should be the l2 winner.
-        ("oneshot-stacked+outstacked", 0,
-         ("conv2d_stacked", "conv2d_outstacked")),
-        ("chunk13-stacked+outstacked", 13,
-         ("conv2d_stacked", "conv2d_outstacked")),
+        ("oneshot-auto (default, full stage)", full_stage),
+        ("chunk25-auto (chunked sanity)", chunked_stage),
+        ("convs-only symmetric", convs_only),
+        ("convs-only non-symmetric", convs_nonsym),
+        ("l1-only stacked (1->16)", l1_only),
+        ("l2-only outstacked (16->1)", l2_only),
+        ("mutual x2 (reductions)", mutuals_only),
+        ("mutual elementwise (maxes given)", mutual_elementwise),
     ]
-    # Best-chunk case re-run with the transposed-major mutual_matching:
-    # its per-B max reduces over the major axes, the same axis class that
-    # cost extraction ~100x pre-rewrite.
-    cases.append(("chunk13-auto+mutualT", 13, None, True))
 
-    for case in cases:
-        label, chunk_i, strats = case[0], case[1], case[2]
-        mutual_t = case[3] if len(case) > 3 else False
-
-        def stage(c, chunk_i=chunk_i, strats=strats, mutual_t=mutual_t):
-            c = mutual_matching(c, transpose_major=mutual_t)
-            c = neigh_consensus_apply(
-                params, c, symmetric=True, chunk_i=chunk_i, strategies=strats
-            )
-            return mutual_matching(c, transpose_major=mutual_t)
-
+    for label, stage in cases:
         try:
             first, dt, _ = timed_steady(
                 chain_reps(stage, args.reps), corr, iters=args.iters
             )
-            log(f"{label:32s} first={first:6.2f}s "
+            log(f"{label:34s} first={first:6.2f}s "
                 f"-> {dt * 1000 / args.reps:7.1f}ms/app (+~RTT/iter amortized)")
         except Exception as exc:  # noqa: BLE001
-            log(f"{label:32s} FAILED: {type(exc).__name__}: "
+            log(f"{label:34s} FAILED: {type(exc).__name__}: "
                 f"{str(exc).splitlines()[0][:120]}")
 
 
