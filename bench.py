@@ -139,11 +139,12 @@ def main():
 
             def body(acc, tgt):
                 m = step(params, feat_a, tgt[None])
-                # Probe one element of EVERY output array (the chain_reps
-                # rule, utils/profiling.py): summing only the scores would
-                # let XLA dead-code-eliminate the coordinate extraction
-                # (argmax/delta decode) from the compiled block.
-                probe = sum(v.ravel()[0].astype(jnp.float32) for v in m)
+                # Consume EVERY element of EVERY output array (the
+                # chain_reps rule, utils/profiling.py, strengthened to
+                # full sums): anything less lets XLA dead-code-eliminate
+                # part of the coordinate extraction (whole arrays, or the
+                # per-match delta decode behind a single-element probe).
+                probe = sum(jnp.sum(v.astype(jnp.float32)) for v in m)
                 return acc + probe, None
 
             acc, _ = jax.lax.scan(body, jnp.float32(0), tgt_stack)

@@ -258,7 +258,15 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
     exactly one program regardless of how the shortlist's shapes mix.
     """
     p = args.pano_batch
-    futures = [pool.submit(load_pano, fn) for fn in pano_fns]
+    n = len(pano_fns)
+    # Sliding decode window: at most p+1 loads in flight, so host memory
+    # stays bounded by the batch size (a long shortlist of 3200 px panos
+    # would otherwise pile up ~100 MB per decoded future) while decode
+    # still overlaps the device work of the previous stack.
+    window = p + 1
+    futures = {
+        i: pool.submit(load_pano, pano_fns[i]) for i in range(min(window, n))
+    }
     groups = {}  # (H, W) -> list of (pano_idx, image) not yet dispatched
 
     def flush(idxs, ms):
@@ -284,8 +292,11 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
     # panos have decoded, so decode (threaded, hundreds of ms at 3200 px)
     # overlaps the device forward of the previous stack — same pipelining
     # property as the unbatched one-behind loop.
-    for idx, fut in enumerate(futures):
-        img = fut.result()
+    for idx in range(n):
+        img = futures.pop(idx).result()
+        nxt = idx + window
+        if nxt < n:
+            futures[nxt] = pool.submit(load_pano, pano_fns[nxt])
         g = groups.setdefault(img.shape[2:], [])
         g.append((idx, img))
         if len(g) == p:

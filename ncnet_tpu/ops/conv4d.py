@@ -338,6 +338,114 @@ def swap_ab_weight(weight):
     return jnp.transpose(weight, (2, 3, 0, 1, 4, 5))
 
 
+def fold_kl(x, f: int):
+    """Space-to-depth on the (K, L) dims: fold f x f patches into channels.
+
+    The consensus convs' channel counts (1 / 9 / 16) are far below the
+    VPU/MXU lane width of 128, so the TPU conv path pads them ~14x —
+    measured 12x off the HBM roofline on a v5e (53 ms for the 1->16 layer
+    vs ~4.5 ms of traffic). Folding multiplies every channel count by f^2
+    at the cost of a (phase-mixing) folded kernel — see fold_weight_kl.
+
+    x: [b, c, I, J, K, L] -> ([b, f*f*c, I, J, ceil(K/f), ceil(L/f)],
+    (K, L)) with channel index (pk*f + pl)*c + c_orig. K/L are
+    right-padded with zeros to multiples of f; the pad columns are beyond
+    the 'same' zero boundary for every valid output and unfold_kl slices
+    them back off.
+    """
+    b, c, si, sj, sk, sl = x.shape
+    kp = -(-sk // f) * f
+    lp = -(-sl // f) * f
+    x = jnp.pad(
+        x, ((0, 0), (0, 0), (0, 0), (0, 0), (0, kp - sk), (0, lp - sl))
+    )
+    x = x.reshape(b, c, si, sj, kp // f, f, lp // f, f)
+    x = jnp.transpose(x, (0, 5, 7, 1, 2, 3, 4, 6))  # b, pk, pl, c, I, J, K', L'
+    return x.reshape(b, f * f * c, si, sj, kp // f, lp // f), (sk, sl)
+
+
+def zero_fold_pad_kl(x, f: int, orig_kl):
+    """Re-zero the folded channels/columns beyond the original K/L extent.
+
+    Between stacked folded layers the right-pad phases hold COMPUTED
+    values, but the reference semantics ('same' zero padding per layer,
+    lib/conv4d.py:26-36) require deeper layers to see zeros beyond the
+    image edge — the folded analogue of the chunked path's inter-layer
+    halo re-zeroing (_consensus_stack_prepadded). No-op when K and L
+    divide f.
+    """
+    sk, sl = orig_kl
+    b, cf, si, sj, skf, slf = x.shape
+    if skf * f == sk and slf * f == sl:
+        return x
+    c = cf // (f * f)
+    k_ok = (
+        jnp.arange(skf)[None, :] * f + jnp.arange(f)[:, None] < sk
+    )  # [pk, K']
+    l_ok = jnp.arange(slf)[None, :] * f + jnp.arange(f)[:, None] < sl
+    xr = x.reshape(b, f, f, c, si, sj, skf, slf)
+    mask = (
+        k_ok[None, :, None, None, None, None, :, None]
+        & l_ok[None, None, :, None, None, None, None, :]
+    )
+    return jnp.where(mask, xr, 0).reshape(x.shape)
+
+
+def unfold_kl(x, f: int, orig_kl):
+    """Inverse of fold_kl (slices off the right-pad phases)."""
+    sk, sl = orig_kl
+    b, cf, si, sj, skf, slf = x.shape
+    c = cf // (f * f)
+    x = x.reshape(b, f, f, c, si, sj, skf, slf)
+    x = jnp.transpose(x, (0, 3, 4, 5, 6, 1, 7, 2))  # b, c, I, J, K', pk, L', pl
+    return x.reshape(b, c, si, sj, skf * f, slf * f)[..., :sk, :sl]
+
+
+def fold_weight_kl(weight, f: int):
+    """Phase-mixing kernel for convolution in fold_kl's folded layout.
+
+    For output phase (pko, plo) and original tap (dk, dl), the input
+    position k_in = f*K' + pko + (dk - rk) lands in folded tap
+    tk = floor((pko + dk - rk)/f) at input phase (pko + dk - rk) mod f:
+
+        Wf[:, :, tk+off_k, tl+off_l, pin*cin + ci, pout*cout + co]
+            = w[:, :, dk, dl, ci, co]
+
+    [ki, kj, kk, kl, cin, cout] -> [ki, kj, tkk, tkl, f*f*cin, f*f*cout]
+    with tkk = 2*ceil(rk/f) + 1 (3 for every k <= 2f+1). The zero entries
+    (fraction 1 - 1/f^2) cost MXU FLOPs that the lane padding was wasting
+    anyway; HBM traffic is what the fold actually buys back. The placement
+    map is a CONSTANT one-hot tensor built with numpy at trace time, so
+    the whole fold is one einsum in the jaxpr (per-entry .at[].set
+    scatters would add f^2*k^2 dynamic-update-slices per layer per
+    branch to the remote-compiled program).
+    """
+    import numpy as _np
+
+    ki, kj, kk, kl, cin, cout = weight.shape
+    rk, rl = kk // 2, kl // 2
+    off_k, off_l = -(-rk // f), -(-rl // f)
+    tkk, tkl = 2 * off_k + 1, 2 * off_l + 1
+    ff = f * f
+    # place[dk, dl, pout, tk, tl, pin] = 1 where original tap (dk, dl)
+    # feeds output phase pout from folded tap (tk, tl) at input phase pin.
+    place = _np.zeros((kk, kl, ff, tkk, tkl, ff), weight.dtype)
+    for pko in range(f):
+        for plo in range(f):
+            pout = pko * f + plo
+            for dk in range(kk):
+                for dl in range(kl):
+                    ak = pko + dk - rk
+                    al = plo + dl - rl
+                    pin = (ak % f) * f + (al % f)
+                    place[dk, dl, pout, ak // f + off_k, al // f + off_l,
+                          pin] = 1
+    wf = jnp.einsum(
+        "ijklco,klptuq->ijtuqcpo", weight, jnp.asarray(place)
+    )
+    return wf.reshape(ki, kj, tkk, tkl, ff * cin, ff * cout)
+
+
 # Chunked-consensus auto-trigger: chunk when the largest interlayer
 # activation would exceed this many BYTES, and size slabs so the per-slab
 # activation stays near _CHUNK_TARGET_ELEMS. The 2 GB threshold is set
@@ -464,20 +572,50 @@ def neigh_consensus_apply(
             # for the halo rows too so the target is honored.
             chunk_i = max(1, _CHUNK_TARGET_ELEMS // per_row - 2 * halo)
 
+    # Space-to-depth experiment (NCNET_CONSENSUS_KL_FOLD=f, trace time):
+    # run the WHOLE one-shot stack in fold_kl's folded layout — channel
+    # counts f^2-fold larger (lane packing), kernels phase-mixed by
+    # fold_weight_kl, ReLU layout-independent, one fold/unfold pair total.
+    # Swap-then-fold: the symmetric identity is in the unfolded axes, so
+    # each layer folds its (possibly swapped) kernel individually.
+    kl_fold = int(os.environ.get("NCNET_CONSENSUS_KL_FOLD", "0") or 0)
+    one_shot = not chunk_i or chunk_i >= si
+    if kl_fold > 1 and not one_shot:
+        # Silently measuring the unfolded chunked path under a 'fold' A/B
+        # label would corrupt the experiment the knob exists for.
+        raise ValueError(
+            f"NCNET_CONSENSUS_KL_FOLD={kl_fold} requires the one-shot "
+            f"path, but chunking selected chunk_i={chunk_i} for shape "
+            f"{corr.shape} (force chunk_i=0 / NCNET_CONSENSUS_CHUNK_I=0)"
+        )
+
     def stack(x, swap: bool):
         for li, layer in enumerate(params):
             w = swap_ab_weight(layer["weight"]) if swap else layer["weight"]
+            bias = layer["bias"]
+            if one_shot and kl_fold > 1:
+                w = fold_weight_kl(w, kl_fold)
+                bias = jnp.tile(bias, kl_fold * kl_fold)
             x = conv4d(
-                x, w, layer["bias"],
+                x, w, bias,
                 strategy=strategies[li] if strategies else None,
             )
             x = jax.nn.relu(x)
+            if one_shot and kl_fold > 1 and li < len(params) - 1:
+                # Deeper layers must see zeros beyond the original K/L
+                # edge, not values computed in the fold's right-pad.
+                x = zero_fold_pad_kl(x, kl_fold, orig_kl)
         return x
 
-    if not chunk_i or chunk_i >= si:
+    if one_shot:
+        if kl_fold > 1:
+            corr, orig_kl = fold_kl(corr, kl_fold)
+        out = stack(corr, False)
         if symmetric:
-            return stack(corr, False) + stack(corr, True)
-        return stack(corr, False)
+            out = out + stack(corr, True)
+        if kl_fold > 1:
+            out = unfold_kl(out, kl_fold, orig_kl)
+        return out
 
     n = -(-si // chunk_i)
     tail = n * chunk_i - si

@@ -444,3 +444,46 @@ def test_conv4d_grad_parity_across_strategies(rng, strategy):
     np.testing.assert_allclose(gx, rx, atol=2e-4)
     np.testing.assert_allclose(gw, rw, atol=2e-4)
     np.testing.assert_allclose(gb, rb, atol=2e-4)
+
+
+@pytest.mark.parametrize("f", [2, 3])
+@pytest.mark.parametrize("ksz", [3, 5])
+def test_conv4d_kl_fold_parity(rng, f, ksz):
+    """Space-to-depth folded conv == plain conv4d: fold_kl + fold_weight_kl
+    + unfold_kl reproduce the unfolded result exactly (incl. ragged K/L
+    needing right-pad and the 'same' zero boundary)."""
+    from ncnet_tpu.ops.conv4d import (
+        conv4d,
+        fold_kl,
+        fold_weight_kl,
+        unfold_kl,
+    )
+
+    cin, cout = 2, 3
+    x = jnp.asarray(rng.randn(1, cin, 6, 5, 7, 5).astype(np.float32))
+    w = jnp.asarray(
+        0.1 * rng.randn(ksz, ksz, ksz, ksz, cin, cout).astype(np.float32)
+    )
+    b = jnp.asarray(rng.randn(cout).astype(np.float32))
+    want = conv4d(x, w, b)
+    xf, orig = fold_kl(x, f)
+    wf = fold_weight_kl(w, f)
+    bf = jnp.tile(b, f * f)
+    got = unfold_kl(conv4d(xf, wf, bf), f, orig)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+@pytest.mark.parametrize("symmetric", [True, False])
+def test_consensus_kl_fold_env_parity(rng, symmetric, monkeypatch):
+    """NCNET_CONSENSUS_KL_FOLD runs the whole stack folded with identical
+    output (the headline A/B knob must be a pure layout change)."""
+    import jax
+
+    from ncnet_tpu.ops.conv4d import neigh_consensus_apply, neigh_consensus_init
+
+    params = neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (4, 1))
+    x = jnp.asarray(rng.randn(1, 1, 6, 6, 7, 6).astype(np.float32))
+    want = neigh_consensus_apply(params, x, symmetric=symmetric, chunk_i=0)
+    monkeypatch.setenv("NCNET_CONSENSUS_KL_FOLD", "2")
+    got = neigh_consensus_apply(params, x, symmetric=symmetric, chunk_i=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)

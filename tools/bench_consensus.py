@@ -123,26 +123,51 @@ def main(argv=None):
         return mutual_matching(c, maxes=maxes)
 
     cases = [
-        ("oneshot-auto (default, full stage)", full_stage),
-        ("chunk25-auto (chunked sanity)", chunked_stage),
-        ("convs-only symmetric", convs_only),
-        ("convs-only non-symmetric", convs_nonsym),
-        ("l1-only stacked (1->16)", l1_only),
-        ("l2-only outstacked (16->1)", l2_only),
-        ("mutual x2 (reductions)", mutuals_only),
-        ("mutual elementwise (maxes given)", mutual_elementwise),
+        ("oneshot-auto (default, full stage)", full_stage, {}),
+        ("chunk25-auto (chunked sanity)", chunked_stage, {}),
+        ("convs-only symmetric", convs_only, {}),
+        ("convs-only non-symmetric", convs_nonsym, {}),
+        ("l1-only stacked (1->16)", l1_only, {}),
+        ("l2-only outstacked (16->1)", l2_only, {}),
+        ("mutual x2 (reductions)", mutuals_only, {}),
+        ("mutual elementwise (maxes given)", mutual_elementwise, {}),
+        # Space-to-depth (fold_kl): f^2-fold channel counts for lane
+        # packing; the winner (if any) flips the stack default.
+        ("fold2 stacked+outstacked", convs_only,
+         {"NCNET_CONSENSUS_KL_FOLD": "2",
+          "NCNET_CONSENSUS_STRATEGIES": "conv2d_stacked,conv2d_outstacked"}),
+        ("fold2 auto", convs_only, {"NCNET_CONSENSUS_KL_FOLD": "2"}),
+        ("fold4 stacked+outstacked", convs_only,
+         {"NCNET_CONSENSUS_KL_FOLD": "4",
+          "NCNET_CONSENSUS_STRATEGIES": "conv2d_stacked,conv2d_outstacked"}),
     ]
 
-    for label, stage in cases:
+    from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
+
+    for label, stage, env in cases:
+        for k in ("NCNET_CONSENSUS_KL_FOLD", "NCNET_CONSENSUS_STRATEGIES"):
+            os.environ.pop(k, None)
+        os.environ.update(env)
         try:
-            first, dt, _ = timed_steady(
-                chain_reps(stage, args.reps), corr, iters=args.iters
+            # Per-case fence: a single pathological remote compile must
+            # cost one case, not the phase (2026-07-31: the l2-only case
+            # sat >20 min in the compile helper).
+            first, dt, _ = run_with_alarm(
+                420,
+                timed_steady,
+                chain_reps(stage, args.reps),
+                corr,
+                iters=args.iters,
             )
             log(f"{label:34s} first={first:6.2f}s "
                 f"-> {dt * 1000 / args.reps:7.1f}ms/app (+~RTT/iter amortized)")
+        except AlarmTimeout:
+            log(f"{label:34s} TIMED OUT (>420s compile/run)")
         except Exception as exc:  # noqa: BLE001
             log(f"{label:34s} FAILED: {type(exc).__name__}: "
                 f"{str(exc).splitlines()[0][:120]}")
+    for k in ("NCNET_CONSENSUS_KL_FOLD", "NCNET_CONSENSUS_STRATEGIES"):
+        os.environ.pop(k, None)
 
 
 if __name__ == "__main__":

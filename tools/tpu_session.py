@@ -117,15 +117,16 @@ def main(argv=None):
         #                   models/ncnet.py
         bench_runs = [
             ("baseline", {}),
-            ("mix", {"NCNET_CONSENSUS_STRATEGIES":
-                     "conv2d_stacked,conv2d_outstacked"}),
+            ("fold2", {"NCNET_CONSENSUS_KL_FOLD": "2",
+                       "NCNET_CONSENSUS_STRATEGIES":
+                       "conv2d_stacked,conv2d_outstacked"}),
             ("fused-mutual", {"NCNET_FUSE_MUTUAL_EXTRACT": "1"}),
             ("full-fusion", {"NCNET_FUSE_MUTUAL_EXTRACT": "1",
                              "NCNET_FUSE_CORR_MAXES": "1"}),
         ]
         for run_label, env in bench_runs:
             for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
-                      "NCNET_FUSE_CORR_MAXES"):
+                      "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD"):
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
