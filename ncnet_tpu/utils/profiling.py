@@ -245,9 +245,14 @@ def chain_reps(fn, reps: int):
       * the carry multiplies into the first argument ((1 + carry*0),
         cast to its dtype so it cannot promote the workload) — a data
         dependence XLA cannot hoist or CSE away;
-      * the carry probes one element of EVERY output leaf, so no
-        candidate's partial computation is dead-code-eliminated while an
-        opaque competitor (pallas_call) still pays it.
+      * the carry consumes EVERY ELEMENT of EVERY output leaf (full
+        sums), so no candidate's partial computation is dead-code-
+        eliminated while an opaque competitor (pallas_call) still pays
+        it. A single-element probe is not enough: XLA can slice
+        backward through elementwise tails (e.g. the per-match delta
+        decode) and compute just the probed element, under-reporting
+        the candidate. The sums themselves are noise next to any stage
+        worth timing.
 
     Time the result with timed_steady and divide by `reps`.
     """
@@ -260,9 +265,9 @@ def chain_reps(fn, reps: int):
             first = xs[0] * (1.0 + carry * 0.0).astype(xs[0].dtype)
             out = fn(first, *xs[1:])
             leaves = [l for l in jax.tree.leaves(out) if hasattr(l, "ravel")]
-            probe = leaves[0].ravel()[0].astype(jnp.float32)
-            for leaf in leaves[1:]:
-                probe = probe + leaf.ravel()[0].astype(jnp.float32)
+            probe = jnp.float32(0)
+            for leaf in leaves:
+                probe = probe + jnp.sum(leaf.astype(jnp.float32))
             return probe, ()
 
         out, _ = lax.scan(body, jnp.float32(0), None, length=reps)

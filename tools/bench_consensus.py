@@ -57,8 +57,12 @@ def main(argv=None):
     from ncnet_tpu.ops.conv4d import neigh_consensus_apply, neigh_consensus_init
     from ncnet_tpu.ops.mutual import mutual_matching
 
-    ii = max(int(100 * args.scale) // 4 * 4, 8)
-    jj = max(int(75 * args.scale) // 4 * 4, 8)
+    # EXACT pipeline shape — no rounding: the earlier //4*4 alignment
+    # measured 100x72 for a stage whose real input is 100x75, and vector
+    # padding effects (75 -> 80 sublanes / 128 lanes) are part of what
+    # this tool exists to observe.
+    ii = max(int(100 * args.scale), 8)
+    jj = max(int(75 * args.scale), 8)
     log(f"consensus stage at [1,1,{ii},{jj},{ii},{jj}] bf16, reps={args.reps}")
 
     params = neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (16, 1))
@@ -144,8 +148,14 @@ def main(argv=None):
 
     from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
 
+    # Snapshot the shared process env: this tool runs in-process under
+    # tpu_session, and stripping the operator's own overrides would make
+    # every LATER phase silently measure the defaults.
+    _knobs = ("NCNET_CONSENSUS_KL_FOLD", "NCNET_CONSENSUS_STRATEGIES")
+    _saved = {k: os.environ.get(k) for k in _knobs}
+
     for label, stage, env in cases:
-        for k in ("NCNET_CONSENSUS_KL_FOLD", "NCNET_CONSENSUS_STRATEGIES"):
+        for k in _knobs:
             os.environ.pop(k, None)
         os.environ.update(env)
         try:
@@ -166,8 +176,11 @@ def main(argv=None):
         except Exception as exc:  # noqa: BLE001
             log(f"{label:34s} FAILED: {type(exc).__name__}: "
                 f"{str(exc).splitlines()[0][:120]}")
-    for k in ("NCNET_CONSENSUS_KL_FOLD", "NCNET_CONSENSUS_STRATEGIES"):
-        os.environ.pop(k, None)
+    for k, v in _saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
 
 
 if __name__ == "__main__":

@@ -1,0 +1,171 @@
+"""Bisect the InLoc per-pano device step: true IN-STEP stage costs.
+
+Stage-level chained benches (bench_consensus / bench_extract) and the
+per-call staged profile (profile_inloc) disagree by up to 3x about the
+consensus stage, and four stage-level optimizations moved none of the
+headline — so the only trustworthy attribution is differential: time the
+REAL step (the exact program bench.py scans over panos) with one stage
+knocked out at a time, all variants chained inside one jit. The
+difference between adjacent variants is that stage's true in-step cost,
+with all cross-stage fusion effects included.
+
+Variants (each includes everything above it):
+  feats-only      pano backbone + feature norm
+  +corr+pool      fused correlation + maxpool (packed deltas)
+  +mutual1        first soft mutual-NN filter
+  +consensus      symmetric Conv4d stack
+  +mutual2        second filter (full match_pipeline)
+  +extract (full) both-direction extraction + sort + recenter = the step
+
+Usage:
+    python tools/bench_step_bisect.py [--reps 3] [--iters 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--dial_timeout", type=float, default=600.0)
+    p.add_argument("--image", type=int, default=3200)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ncnet_tpu.utils.profiling import (
+        AlarmTimeout,
+        chain_reps,
+        dial_devices,
+        run_with_alarm,
+        setup_compile_cache,
+        timed_steady,
+    )
+
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        log("backend dial timed out; aborting")
+        os._exit(2)
+    log(f"devices: {devices}")
+
+    import jax.numpy as jnp
+
+    from ncnet_tpu.evals import inloc_device_matches
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import (
+        extract_features,
+        match_pipeline,
+        ncnet_forward_from_features,
+    )
+    from ncnet_tpu.ops.conv4d import neigh_consensus_apply
+    from ncnet_tpu.ops.mutual import mutual_matching
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(compute_dtype="bfloat16"),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=2,
+        half_precision=True,
+        use_fused_corr_pool=True,
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    h, w = args.image, args.image * 3 // 4
+    log(f"image {h}x{w}, reps={args.reps}")
+    key = jax.random.PRNGKey(1)
+    src = jax.random.normal(key, (1, 3, h, w), jnp.float32)
+    feat_a = jax.jit(lambda p, s: extract_features(config, p, s))(params, src)
+    jax.block_until_ready(feat_a)
+
+    from ncnet_tpu.ops.pallas_kernels import fused_correlation_maxpool
+
+    def probe(*leaves):
+        return sum(jnp.sum(v.astype(jnp.float32)) for v in leaves)
+
+    def feats_only(tgt):
+        return probe(extract_features(config, params, tgt))
+
+    def corr_pool(tgt):
+        fb = extract_features(config, params, tgt)
+        pooled, deltas = fused_correlation_maxpool(
+            feat_a, fb, 2, corr_dtype=config.corr_dtype, decode_deltas=False
+        )
+        return probe(pooled, deltas)
+
+    def plus_mutual1(tgt):
+        fb = extract_features(config, params, tgt)
+        pooled, deltas = fused_correlation_maxpool(
+            feat_a, fb, 2, corr_dtype=config.corr_dtype, decode_deltas=False
+        )
+        return probe(mutual_matching(pooled), deltas)
+
+    def plus_consensus(tgt):
+        fb = extract_features(config, params, tgt)
+        pooled, deltas = fused_correlation_maxpool(
+            feat_a, fb, 2, corr_dtype=config.corr_dtype, decode_deltas=False
+        )
+        c = neigh_consensus_apply(
+            params["neigh_consensus"], mutual_matching(pooled), symmetric=True
+        )
+        return probe(c, deltas)
+
+    def plus_mutual2(tgt):
+        fb = extract_features(config, params, tgt)
+        pooled, deltas = fused_correlation_maxpool(
+            feat_a, fb, 2, corr_dtype=config.corr_dtype, decode_deltas=False
+        )
+        return probe(match_pipeline(config, params, pooled), deltas)
+
+    def full_step(tgt):
+        fb = extract_features(config, params, tgt)
+        corr, deltas = ncnet_forward_from_features(config, params, feat_a, fb)
+        return probe(*inloc_device_matches(corr, delta4d=deltas, k_size=2))
+
+    variants = [
+        ("feats-only", feats_only),
+        ("+corr+pool", corr_pool),
+        ("+mutual1", plus_mutual1),
+        ("+consensus", plus_consensus),
+        ("+mutual2", plus_mutual2),
+        ("+extract (full step)", full_step),
+    ]
+    prev = None  # (label, ms) of the last SUCCESSFUL variant
+    for label, fn in variants:
+        try:
+            first, dt, _ = run_with_alarm(
+                420, timed_steady, chain_reps(fn, args.reps),
+                jax.random.normal(key, (1, 3, h, w), jnp.float32),
+                iters=args.iters,
+            )
+            ms = dt * 1000 / args.reps
+            delta = (
+                "" if prev is None
+                else f"  (+{ms - prev[1]:6.1f}ms vs {prev[0]})"
+            )
+            log(f"{label:22s} first={first:6.2f}s -> {ms:7.1f}ms/pano{delta}")
+            prev = (label, ms)
+        except AlarmTimeout:
+            log(f"{label:22s} TIMED OUT (>420s compile/run)")
+            prev = None  # a delta against a skipped stage would mislabel
+        except Exception as exc:  # noqa: BLE001
+            log(f"{label:22s} FAILED: {type(exc).__name__}: "
+                f"{str(exc).splitlines()[0][:120]}")
+            prev = None
+
+
+if __name__ == "__main__":
+    main()

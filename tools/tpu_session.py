@@ -76,6 +76,10 @@ def main(argv=None):
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("extract", "bench_extract",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        # Differential truth: the real step with stages knocked out one at
+        # a time — the only attribution that includes in-step fusion.
+        ("bisect", "bench_step_bisect",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("backbone", "bench_backbone",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("profile", "profile_inloc",
