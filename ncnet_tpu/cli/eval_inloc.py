@@ -41,38 +41,76 @@ from ..models.ncnet import (
 from .common import build_model
 
 
-def inloc_resize_shape(h, w, image_size, k_size, scale_factor=0.0625, h_unit=0):
-    """Target (h, w): long side ~image_size, feature dims divisible by k_size
-    (height: by `h_unit` when given — the sharded forward needs iA and iB
-    divisible by shards*k_size; widths only ever need k_size).
+def inloc_resize_shape(h, w, image_size, k_size, scale_factor=0.0625,
+                       h_unit=0, w_unit=0):
+    """Target (h, w): long side ~image_size, feature dims divisible by the
+    per-axis alignment units (default k_size; the sharded forward passes
+    h_unit=shards*k_size; the vector-padding bucketing passes 16 on both —
+    see resolve_feat_units).
 
     Mirrors the reference's alignment arithmetic (eval_inloc.py:84-89):
-    floor(dim / (long/image_size) * scale/k) / scale * k.
+    floor(dim / (long/image_size) * scale/unit) / scale * unit.
     """
     h_unit = h_unit or k_size
+    w_unit = w_unit or k_size
     ratio = max(h, w) / image_size
     out_h = int(np.floor(h / ratio * scale_factor / h_unit) / scale_factor * h_unit)
-    out_w = int(np.floor(w / ratio * scale_factor / k_size) / scale_factor * k_size)
-    # Small inputs (or large h_unit) can floor a dim to ZERO feature cells —
+    out_w = int(np.floor(w / ratio * scale_factor / w_unit) / scale_factor * w_unit)
+    # Small inputs (or large units) can floor a dim to ZERO feature cells —
     # downstream that is a 0-sized correlation axis (opaque Pallas grid
     # crash). Clamp to one alignment unit: slight upscale beats a crash.
     out_h = max(out_h, int(h_unit / scale_factor))
-    out_w = max(out_w, int(k_size / scale_factor))
+    out_w = max(out_w, int(w_unit / scale_factor))
     return out_h, out_w
 
 
-def load_inloc_image(path, image_size, k_size, extra_align: int = 1):
+def resolve_feat_units(feat_unit, image_size, k_size, extra_align: int = 1):
+    """(h_unit, w_unit) in feature cells for inloc_resize_shape.
+
+    feat_unit < 0 is 'auto': 16 at InLoc scale (image_size >= 1024), else
+    plain k_size alignment. 16 feature cells make the POOLED dims
+    multiples of 8 — the 2026-07-31 v5e session measured the consensus
+    stage 34% slower at the unaligned 100x75 pooled shape than at 100x72
+    (vector padding, docs/tpu_r02/session_0610.log), and the snap also
+    trims ~8% raw work (3200x2400 px -> 3072x2304, features 192x144).
+    The same class of resolution approximation as the reference's own
+    k-size alignment (eval_inloc.py:84-89); pass --feat_unit 2 (= k_size)
+    to reproduce the reference's exact dims.
+
+    Units are lcm'd with the mandatory divisors (k_size; height also
+    shards*k_size) so sharding constraints always win — but when the lcm
+    would blow past 2x the requested unit (non-power-of-two shard counts:
+    lcm(16, 10) = 80 cells is a silent 20%+ resolution loss), the vector
+    alignment is dropped for that axis and only the mandatory divisor
+    remains.
+    """
+    if feat_unit is None or feat_unit < 0:
+        feat_unit = 16 if image_size >= 1024 else k_size
+    feat_unit = max(int(feat_unit), 1)
+
+    def unit_for(mandatory):
+        u = int(np.lcm(feat_unit, mandatory))
+        return u if u <= 2 * feat_unit else mandatory
+
+    return unit_for(k_size * max(extra_align, 1)), unit_for(k_size)
+
+
+def load_inloc_image(path, image_size, k_size, extra_align: int = 1,
+                     feat_unit: int = -1):
     """extra_align multiplies the HEIGHT divisibility unit — the spatially-
     sharded forward needs iA (and, via the transposed pass, iB) divisible by
-    (shards * k_size); width alignment stays at k_size."""
+    (shards * k_size). feat_unit: see resolve_feat_units (-1 = auto)."""
     from PIL import Image
 
     from ..data.image_io import load_and_resize_chw
 
     with Image.open(path) as im:  # header-only: dims without a full decode
         w, h = im.size
+    h_unit, w_unit = resolve_feat_units(
+        feat_unit, image_size, k_size, extra_align
+    )
     oh, ow = inloc_resize_shape(
-        h, w, image_size, k_size, h_unit=k_size * extra_align
+        h, w, image_size, k_size, h_unit=h_unit, w_unit=w_unit
     )
     chw, _ = load_and_resize_chw(path, oh, ow, normalize=True)
     return chw[None]
@@ -124,6 +162,13 @@ def main(argv=None):
         "scanned inside ONE dispatch (ragged groups padded by repetition). "
         "Per-dispatch latency dominates tunneled backends (~50 ms each, "
         "2026-07-31 measurement); 1 = one dispatch per pano.",
+    )
+    parser.add_argument(
+        "--feat_unit", type=int, default=-1,
+        help="feature-dim alignment unit for the resize buckets (-1 auto: "
+        "16 at InLoc scale so pooled dims are vector-friendly multiples "
+        "of 8, else k_size; pass 2 for the reference's exact dims) — see "
+        "resolve_feat_units",
     )
     args = parser.parse_args(argv)
     if args.spatial_shards < 1:
@@ -233,7 +278,7 @@ def main(argv=None):
         return jnp.asarray(
             load_inloc_image(
                 os.path.join(args.pano_path, pano_fn), args.image_size, args.k_size,
-                extra_align=args.spatial_shards,
+                extra_align=args.spatial_shards, feat_unit=args.feat_unit,
             )
         )
 
@@ -319,7 +364,7 @@ def _query_loop(args, db, out_dir, params, query_features, pano_matches,
         src = jnp.asarray(
             load_inloc_image(
                 os.path.join(args.query_path, query_fn), args.image_size, args.k_size,
-                extra_align=args.spatial_shards,
+                extra_align=args.spatial_shards, feat_unit=args.feat_unit,
             )
         )
         feat_a = query_features(params, src)

@@ -129,9 +129,16 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     # this, value_and_grad through e.g. the 5^4-kernel conv2d loop saves
     # 25 x 400 MB reshaped input copies per 16->16 consensus layer at the
     # PF-Pascal training shape — the 53 GB HBM OOM of the 2026-07-31
-    # bench_train run on a 16 GB v5e. Forward-only jits are untouched
-    # (checkpoint is the identity without AD), and the backward recompute
-    # is bandwidth-cheap slicing.
+    # bench_train run on a 16 GB v5e. The multi-conv strategies
+    # additionally run their offset loops as lax.scan (sequential
+    # backward BY CONSTRUCTION — checkpoint alone still OOM'd because
+    # XLA schedules the independent offsets' backward recomputes
+    # concurrently). Known trade-off: the scan also sequences the
+    # FORWARD offsets through dynamic slices; 'conv2d'/'conv3d' are
+    # auto-picked only at the small PF-Pascal shapes (25^4 tensors,
+    # ~ms-scale convs) where that cost is noise, and the InLoc flagship
+    # path uses the single-conv stacked/outstacked strategies, which
+    # keep their one-shot forward.
     if strategy == "conv2d":
         # Zero-pad J on both sides (I is already halo/zero padded by the
         # caller); every (di, dj) kernel offset is then a contiguous slice.

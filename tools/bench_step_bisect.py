@@ -84,8 +84,21 @@ def main(argv=None):
         use_fused_corr_pool=True,
     )
     params = ncnet_init(jax.random.PRNGKey(0), config)
-    h, w = args.image, args.image * 3 // 4
-    log(f"image {h}x{w}, reps={args.reps}")
+    # Same bucketing as bench.py's headline (NCNET_INLOC_FEAT_UNIT, auto
+    # -> 16): the consensus stage is ~34% shape-sensitive between the
+    # bucketed and reference dims, so the bisect must attribute stages at
+    # the SAME shape the headline runs.
+    from ncnet_tpu.cli.eval_inloc import inloc_resize_shape, resolve_feat_units
+
+    units = resolve_feat_units(
+        int(os.environ.get("NCNET_INLOC_FEAT_UNIT", "-1")), args.image, 2
+    )
+    h, w = inloc_resize_shape(
+        args.image, args.image * 3 // 4, args.image, 2,
+        h_unit=units[0], w_unit=units[1],
+    )
+    log(f"image {h}x{w} (nominal {args.image}, units {units}), "
+        f"reps={args.reps}")
     key = jax.random.PRNGKey(1)
     src = jax.random.normal(key, (1, 3, h, w), jnp.float32)
     feat_a = jax.jit(lambda p, s: extract_features(config, p, s))(params, src)

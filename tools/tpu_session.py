@@ -90,11 +90,33 @@ def main(argv=None):
     ]
     from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
 
+    # Hard backstop for hangs SIGALRM cannot reach: a remote-compile wait
+    # stuck in native code defers signal delivery indefinitely (observed
+    # 2026-07-31 06:15-06:40: a case hung 25+ min THROUGH both its 420 s
+    # case fence and the 1500 s phase fence). A daemon thread hard-exits
+    # the session 180 s past any phase deadline; the probe loop treats the
+    # nonzero rc as a failed session and redials.
+    import threading
+    import time as _time
+
+    deadline = [None]
+
+    def _watchdog():
+        while True:
+            _time.sleep(30)
+            d = deadline[0]
+            if d is not None and _time.time() > d:
+                log("phase watchdog: alarm never landed; hard-exiting")
+                os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     for label, modname, phase_argv in phases:
         if label in skip:
             log(f"=== {label}: SKIPPED ===")
             continue
         log(f"=== {label} ===")
+        deadline[0] = _time.time() + 1500 + 180
         try:
             # 25 min per phase: one pathological compile must not starve
             # the rest of the queue (observed 2026-07-31, see
@@ -107,6 +129,8 @@ def main(argv=None):
             log(f"{label} exited: {exc}")
         except Exception:  # noqa: BLE001
             log(f"{label} FAILED:\n{traceback.format_exc()}")
+        finally:
+            deadline[0] = None
 
     if "bench" not in skip:
         os.environ["NCNET_BENCH_DIAL_TIMEOUT"] = "120"
@@ -120,7 +144,8 @@ def main(argv=None):
         #   full-fusion  -> additionally NCNET_FUSE_CORR_MAXES default in
         #                   models/ncnet.py
         bench_runs = [
-            ("baseline", {}),
+            ("baseline", {}),  # feat_unit auto -> 16: the new aligned shape
+            ("feat2 (reference dims)", {"NCNET_INLOC_FEAT_UNIT": "2"}),
             ("fold2", {"NCNET_CONSENSUS_KL_FOLD": "2",
                        "NCNET_CONSENSUS_STRATEGIES":
                        "conv2d_stacked,conv2d_outstacked"}),
@@ -130,10 +155,12 @@ def main(argv=None):
         ]
         for run_label, env in bench_runs:
             for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
-                      "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD"):
+                      "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD",
+                      "NCNET_INLOC_FEAT_UNIT"):
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
+            deadline[0] = _time.time() + 1500 + 180
             try:
                 # Same fence as the phases: bench.py's fallback ladder can
                 # reach the XLA extraction tier whose InLoc-shape compile
@@ -144,6 +171,7 @@ def main(argv=None):
             except Exception:  # noqa: BLE001
                 log(f"bench[{run_label}] FAILED:\n{traceback.format_exc()}")
             finally:
+                deadline[0] = None
                 for k in env:
                     os.environ.pop(k, None)
     log("session DONE")
