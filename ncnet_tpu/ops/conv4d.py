@@ -95,7 +95,15 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
         elif weight.shape[5] <= 2:
             strategy = "conv2d_outstacked"
         else:
-            strategy = "conv2d"
+            # Large cin AND cout (PF-Pascal's 16->16 middle layer): one
+            # rank-4 ConvGeneral. The v5e sweep has it within 4% of the
+            # conv2d loop (85.79 vs 82.97 ms, docs/tpu_r02/
+            # bench_conv4d.txt), and as a SINGLE conv its AD residual is
+            # just the input — the multi-offset loop strategies save (or
+            # scan-carry) a full accumulator per offset, which OOM'd
+            # jit(train_step) at 38-54 GB on a 16 GB chip. conv2d/conv3d
+            # remain selectable as inference formulations.
+            strategy = "convnd"
     b, cin, si_pad, sj, sk, sl = x.shape
     ki, kj, kk, kl, wcin, cout = weight.shape
     if wcin != cin:
@@ -129,34 +137,26 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     # this, value_and_grad through e.g. the 5^4-kernel conv2d loop saves
     # 25 x 400 MB reshaped input copies per 16->16 consensus layer at the
     # PF-Pascal training shape — the 53 GB HBM OOM of the 2026-07-31
-    # bench_train run on a 16 GB v5e. The multi-conv strategies
-    # additionally run their offset loops as lax.scan (sequential
-    # backward BY CONSTRUCTION — checkpoint alone still OOM'd because
-    # XLA schedules the independent offsets' backward recomputes
-    # concurrently). Known trade-off: the scan also sequences the
-    # FORWARD offsets through dynamic slices; 'conv2d'/'conv3d' are
-    # auto-picked only at the small PF-Pascal shapes (25^4 tensors,
-    # ~ms-scale convs) where that cost is noise, and the InLoc flagship
-    # path uses the single-conv stacked/outstacked strategies, which
-    # keep their one-shot forward.
+    # bench_train run on a 16 GB v5e. Checkpointing alone does NOT bound
+    # the multi-offset loops under AD (XLA schedules the independent
+    # offsets' backward recomputes concurrently; a lax.scan rewrite then
+    # scan-carried the 400 MB accumulator per offset instead — 38 GB), so
+    # 'auto' routes every differentiated case to SINGLE-conv strategies
+    # (stacked / outstacked / convnd) whose residual is just the input;
+    # conv2d/conv3d remain as inference formulations.
     if strategy == "conv2d":
         # Zero-pad J on both sides (I is already halo/zero padded by the
         # caller); every (di, dj) kernel offset is then a contiguous slice.
-        # lax.scan over the offsets, NOT a Python loop: the loop's k_i*k_j
-        # offset terms are mutually independent, so even with per-term
-        # jax.checkpoint XLA schedules their backward recomputes
-        # concurrently and the peak stays ~25 reshaped-input copies
-        # (53.97 G measured for jit(train_step) at the PF-Pascal shape on
-        # a 16 GB v5e, 2026-07-31 — with the checkpoints in place). A
-        # scan's backward is sequential BY CONSTRUCTION, and the
-        # checkpointed body keeps the per-iteration residual to the
-        # (loop-invariant, unstacked) padded input plus one tiny filter.
+        # INFERENCE formulation: its backward saves (static loop) or
+        # scan-carries (a tried lax.scan rewrite) a full accumulator per
+        # offset — 38-54 GB at the PF-Pascal train shape — so training
+        # 'auto' routes the large-cin/cout case to 'convnd' instead.
         pad_j = kj // 2
         xp = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0)))
 
         def offset_term(xp_, w2d, di, dj):
-            xs = lax.dynamic_slice_in_dim(xp_, di, si, axis=2)
-            xs = lax.dynamic_slice_in_dim(xs, dj, sj, axis=3)
+            xs = lax.slice_in_dim(xp_, di, di + si, axis=2)
+            xs = lax.slice_in_dim(xs, dj, dj + sj, axis=3)
             xs = jnp.moveaxis(xs, 1, 5).reshape(b * si * sj, sk, sl, cin)
             # [kk, kl, cin, cout] filter, NHWC in/out: the TPU-native
             # layout (channels minor).
@@ -169,25 +169,17 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
                 preferred_element_type=jnp.float32,
             )
 
-        starts = jnp.array(
-            [(di, dj) for di in range(ki) for dj in range(kj)], jnp.int32
-        )
-
-        def offset_body(acc, inp):
-            w2d, st = inp
-            y = jax.checkpoint(offset_term)(xp, w2d, st[0], st[1])
-            return acc + y, None
-
-        out, _ = lax.scan(
-            offset_body,
-            jnp.zeros((b * si * sj, sk, sl, cout), jnp.float32),
-            (w.reshape(ki * kj, kk, kl, cin, cout), starts),
-        )
+        offset_term = jax.checkpoint(offset_term, static_argnums=(2, 3))
+        out = None
+        for di in range(ki):
+            for dj in range(kj):
+                y = offset_term(xp, w[di, dj], di, dj)
+                out = y if out is None else out + y
         out = out.reshape(b, si, sj, sk, sl, cout)
         out = jnp.moveaxis(out, 5, 1)
     elif strategy == "conv3d":
         def di_term(x_, w3, di):
-            xs = lax.dynamic_slice_in_dim(x_, di, si, axis=2)
+            xs = lax.slice_in_dim(x_, di, di + si, axis=2)
             xs = jnp.moveaxis(xs, 2, 1).reshape(b * si, cin, sj, sk, sl)
             return lax.conv_general_dilated(
                 xs,
@@ -198,20 +190,12 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
                 preferred_element_type=jnp.float32,
             )
 
-        # Sequential scan + checkpointed body: same AD-memory rationale as
-        # the 'conv2d' branch above.
-        w3_all = jnp.transpose(w, (0, 5, 4, 1, 2, 3))  # [ki, cout, cin, kj, kk, kl]
-
-        def di_body(acc, inp):
-            w3, di = inp
-            y = jax.checkpoint(di_term)(x, w3, di)
-            return acc + y, None
-
-        out, _ = lax.scan(
-            di_body,
-            jnp.zeros((b * si, cout, sj, sk, sl), jnp.float32),
-            (w3_all, jnp.arange(ki, dtype=jnp.int32)),
-        )
+        di_term = jax.checkpoint(di_term, static_argnums=(2,))
+        out = None
+        for di in range(ki):
+            w3 = jnp.transpose(w[di], (4, 3, 0, 1, 2))  # [cout, cin, kj, kk, kl]
+            y = di_term(x, w3, di)
+            out = y if out is None else out + y
         out = jnp.moveaxis(out.reshape(b, si, cout, sj, sk, sl), 1, 2)
     elif strategy == "conv2d_stacked":
         # Fold the kI*kJ kernel offsets into the conv INPUT channels: one
