@@ -6,12 +6,14 @@ dimension*, calling `F.conv3d` once per slice per kernel offset
 single traced expression with four selectable, mathematically identical
 decompositions (see `conv4d_prepadded`). The default ('auto') picks per
 layer: 'conv2d_stacked' (kI*kJ offsets folded into the conv input channels
-— one output write) for small-cin layers, and otherwise 'conv2d' (kI*kJ
-shifted **2-D** convolutions over (K, L) with (b, I, J) folded into the
-conv batch — TPU convs are natively 2-D). 'conv3d' (kI batched 3-D convs)
-and 'convnd' (one rank-4-spatial ConvGeneral) are kept for per-backend A/B
-via NCNET_CONV4D_STRATEGY. All variants are fully vectorized and let XLA
-tile the inner contraction onto the MXU.
+— one output write) for small-cin layers, 'conv2d_outstacked' (offsets
+folded into the OUTPUT channels) for small-cout layers, and 'convnd' (one
+rank-4-spatial ConvGeneral, the only AD-memory-safe choice) when both are
+large. 'conv2d' (kI*kJ shifted **2-D** convolutions over (K, L) with
+(b, I, J) folded into the conv batch) and 'conv3d' (kI batched 3-D convs)
+remain as inference formulations selectable via NCNET_CONV4D_STRATEGY.
+All variants are fully vectorized and let XLA tile the inner contraction
+onto the MXU.
 
 Weight layout is [kI, kJ, kK, kL, cin, cout] (TPU-friendly trailing
 channels); bias is [cout].
@@ -29,11 +31,10 @@ import jax.numpy as jnp
 from jax import lax
 
 # Default decomposition; override with NCNET_CONV4D_STRATEGY
-# ('conv2d' | 'conv3d' | 'conv2d_stacked' | 'convnd' | 'auto').
-# 'auto' (default) picks conv2d_stacked for small-cin layers — a cin=1
-# layer otherwise pays kI*kJ partial-sum round trips of a cout-times-larger
-# f32 output through HBM, vs one kI*kJ-times-larger bf16 input
-# materialization — and the batched-2-D formulation otherwise.
+# ('conv2d' | 'conv3d' | 'conv2d_stacked' | 'conv2d_outstacked' | 'convnd'
+# | 'auto'). 'auto' (default) picks conv2d_stacked for small-cin layers,
+# conv2d_outstacked for small-cout layers, and convnd otherwise — see the
+# heuristic in conv4d_prepadded for the measurements behind each arm.
 # The env var is read at CALL (trace) time, so setting it after import
 # works; already-compiled jits keep the strategy they were traced with.
 _DEFAULT_STRATEGY = "auto"
@@ -63,7 +64,7 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
       * 'convnd': one rank-4-spatial ConvGeneral op — the compiler owns the
         whole stencil.
       * 'auto' (default): per-layer pick — 'conv2d_stacked' when cin <= 2,
-        else 'conv2d'.
+        'conv2d_outstacked' when cout <= 2, else 'convnd'.
     Override per-backend via the NCNET_CONV4D_STRATEGY env var.
 
     Args:

@@ -80,6 +80,9 @@ def main(argv=None):
         # a time — the only attribution that includes in-step fusion.
         ("bisect", "bench_step_bisect",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        # Op-level truth: device trace of the headline step, parsed
+        # in-process (top ops by self time into this log).
+        ("trace", "trace_step", ["--dial_timeout", "120"]),
         ("backbone", "bench_backbone",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("profile", "profile_inloc",

@@ -1,0 +1,190 @@
+"""Capture a device trace of the headline block and print the op table.
+
+The step bisect gives true per-STAGE costs, but two of them resist
+stage-level explanation (in-step corr+pool costs 2.5x its standalone
+chained time; consensus 115 ms vs a ~26 ms traffic roofline). A device
+trace answers at the op level. This tool runs the exact bench.py block
+under jax.profiler.trace and parses the xplane with
+tensorboard_plugin_profile (installed in this image), printing the
+top ops by self time into the session log — no TensorBoard needed.
+
+Usage:
+    python tools/trace_step.py [--dial_timeout 600] [--image 3200]
+Trace artifacts land in docs/tpu_r02/trace/ for later inspection.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def _print_op_table(logdir):
+    """Parse the captured xplane and print top ops by self time.
+
+    Runs in THIS process only when invoked with --parse_only (a fresh
+    process where no protobuf has been imported yet): the plugin's
+    generated protos need PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python,
+    which must be set before the first google.protobuf import.
+    """
+    xplanes = glob.glob(
+        os.path.join(logdir, "plugins", "profile", "*", "*.xplane.pb")
+    )
+    if not xplanes:
+        log("no xplane captured")
+        return
+    # The logdir accumulates one timestamped dir per run — parse the
+    # NEWEST capture, not directory order.
+    xplanes = [max(xplanes, key=os.path.getmtime)]
+    # Parse the XSpace proto directly (the tensorboard plugin's converter
+    # needs a TF pywrap symbol this build lacks): aggregate event
+    # durations by op name over the device plane's lines.
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    space = xplane_pb2.XSpace()
+    with open(xplanes[0], "rb") as f:
+        space.ParseFromString(f.read())
+    # Prefer the accelerator plane; '/host:CPU' is the CPU-smoke fallback.
+    planes = sorted(
+        space.planes,
+        key=lambda p: (("TPU" not in p.name) and ("device" not in p.name.lower()),
+                       p.name != "/host:CPU"),
+    )
+    for plane in planes:
+        if plane.name in ("/host:metadata", "Task Environment"):
+            continue
+        meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+        # Hierarchical lines (modules > ops > ...) overlap in time —
+        # summing across all of them double-counts and lets a whole-module
+        # event top the table. Aggregate ONE line: the op-granularity one
+        # ('XLA Ops' on TPU planes), falling back to the busiest line.
+        lines = list(plane.lines)
+        if not lines:
+            continue
+        op_lines = [l for l in lines if "op" in l.name.lower()]
+        line = (op_lines or sorted(lines, key=lambda l: -len(l.events)))[0]
+        totals = {}
+        for ev in line.events:
+            name = meta.get(ev.metadata_id, str(ev.metadata_id))
+            totals[name] = totals.get(name, 0) + ev.duration_ps
+        if not totals:
+            continue
+        top = sorted(totals.items(), key=lambda kv: -kv[1])[:30]
+        total_us = sum(totals.values()) / 1e6
+        log(f"plane {plane.name}, line '{line.name}': {len(totals)} "
+            f"distinct events, {total_us:.0f} us total (2 traced steps)")
+        for name, ps in top:
+            log(f"  {ps / 1e6:>10.0f} us  {name[:100]}")
+        return
+    log(f"no device plane found in {xplanes[0]} "
+        f"(planes: {[p.name for p in space.planes][:8]})")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dial_timeout", type=float, default=600.0)
+    p.add_argument("--image", type=int, default=3200)
+    p.add_argument("--iters", type=int, default=3)  # accepted for session API
+    p.add_argument("--logdir", type=str, default="docs/tpu_r02/trace")
+    p.add_argument("--parse_only", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.parse_only:
+        # Must precede the first google.protobuf import (fresh process).
+        os.environ.setdefault(
+            "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python"
+        )
+        _print_op_table(args.logdir)
+        return
+
+    import jax
+
+    from ncnet_tpu.utils.profiling import dial_devices, setup_compile_cache
+
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        log("backend dial timed out; aborting")
+        os._exit(2)
+    log(f"devices: {devices}")
+
+    import jax.numpy as jnp
+
+    from ncnet_tpu.cli.eval_inloc import inloc_resize_shape, resolve_feat_units
+    from ncnet_tpu.evals import inloc_device_matches
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import (
+        extract_features,
+        ncnet_forward_from_features,
+    )
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(compute_dtype="bfloat16"),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=2,
+        half_precision=True,
+        use_fused_corr_pool=True,
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    units = resolve_feat_units(
+        int(os.environ.get("NCNET_INLOC_FEAT_UNIT", "-1")), args.image, 2
+    )
+    h, w = inloc_resize_shape(
+        args.image, args.image * 3 // 4, args.image, 2,
+        h_unit=units[0], w_unit=units[1],
+    )
+    log(f"image {h}x{w}")
+    key = jax.random.PRNGKey(1)
+    src = jax.random.normal(key, (1, 3, h, w), jnp.float32)
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (1, 3, h, w), jnp.float32)
+
+    @jax.jit
+    def step(params, src, tgt):
+        feat_a = extract_features(config, params, src)
+        feat_b = extract_features(config, params, tgt)
+        corr, delta = ncnet_forward_from_features(config, params, feat_a, feat_b)
+        m = inloc_device_matches(corr, delta4d=delta, k_size=2)
+        return sum(jnp.sum(v.astype(jnp.float32)) for v in m)
+
+    log("compile+warm...")
+    float(step(params, src, tgt))
+    log("tracing 2 steps...")
+    os.makedirs(args.logdir, exist_ok=True)
+    with jax.profiler.trace(args.logdir):
+        for _ in range(2):
+            float(step(params, src, tgt))
+    log("parsing (subprocess: the proto impl env must precede any "
+        "protobuf import, and jax already imported one here)...")
+    import subprocess
+
+    env = dict(
+        os.environ,
+        PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION="python",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # parse must not dial the tunnel
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--parse_only",
+         "--logdir", args.logdir],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    print(out.stdout, flush=True)
+    if out.returncode:
+        log(f"parse subprocess rc={out.returncode}: {out.stderr[-800:]}")
+
+
+if __name__ == "__main__":
+    main()
