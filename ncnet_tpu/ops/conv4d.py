@@ -93,7 +93,12 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
         # 2-D formulation.
         if weight.shape[4] <= 2:
             strategy = "conv2d_stacked"
-        elif weight.shape[5] <= 2:
+        elif weight.shape[5] <= 2 and weight.shape[0] * weight.shape[1] <= 9:
+            # Small cout AND a small kernel: the outstacked conv's
+            # ki*kj-times-wider output stays modest (9x for the InLoc 3^4
+            # layer). At 5^4 kernels the 25x buffer is a ~2 GB backward
+            # transient per branch at the PF-Pascal training shape —
+            # convnd's input-only residual wins there.
             strategy = "conv2d_outstacked"
         else:
             # Large cin AND cout (PF-Pascal's 16->16 middle layer): one

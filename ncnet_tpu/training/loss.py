@@ -78,10 +78,20 @@ def weak_loss_from_features(match_fn, feat_a, feat_b, normalization: str = "soft
         params, e.g. ncnet_forward_from_features).
       feat_a, feat_b: [b, c, h, w] backbone features.
     """
-    score_pos = pair_match_score(match_fn(feat_a, feat_b), normalization)
+    import jax
+
+    # Checkpoint each direction's pipeline-to-score: without it the
+    # positive AND negative passes hold their full consensus activation
+    # chains simultaneously for the backward (two symmetric Conv4d stacks
+    # each) — several GB of the jit(train_step) HBM peak at the reference
+    # schedule on a 16 GB chip. With it, each direction's residual is its
+    # feature inputs and the backward recomputes one direction at a time.
+    def direction_score(fa, fb):
+        return pair_match_score(match_fn(fa, fb), normalization)
+
+    direction_score = jax.checkpoint(direction_score)
+    score_pos = direction_score(feat_a, feat_b)
     # Under a dp-sharded batch the roll lowers to a collective permute of
     # the (small) feature tensors over ICI.
-    score_neg = pair_match_score(
-        match_fn(jnp.roll(feat_a, -1, axis=0), feat_b), normalization
-    )
+    score_neg = direction_score(jnp.roll(feat_a, -1, axis=0), feat_b)
     return score_neg - score_pos
