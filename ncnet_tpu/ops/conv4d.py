@@ -251,13 +251,19 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
         # layer 2: cin=16, cout=1, where input-stacking would blow the
         # input up 9x and 'conv2d' starves the MXU at N=1).
         pad_j = kj // 2
-        sip, sjp = si_pad, sj + 2 * pad_j
+        sip = si_pad
 
         def outstacked_body(x_, w_):
-            xp = jnp.pad(
-                x_, ((0, 0), (0, 0), (0, 0), (pad_j, pad_j), (0, 0), (0, 0))
-            )
-            xs = jnp.moveaxis(xp, 1, 5).reshape(b * sip * sjp, sk, sl, cin)
+            # NO J pad: the 2026-07-31 device trace showed the padded
+            # formulation paying ~15 ms/branch in pure movement at InLoc
+            # shape — a 1.6 GB padded input copy plus a layout copy of the
+            # 1.8 GB f32 offset buffer. Instead the conv runs on the
+            # unpadded-J batch, emits STORAGE-dtype partials (each still
+            # f32-accumulated inside the conv; the 9 cross-offset adds
+            # below stay f32), and each (di, dj) offset accumulates via a
+            # clipped static slice-add — out-of-range taps contribute
+            # nothing, which IS 'same' zero padding.
+            xs = jnp.moveaxis(x_, 1, 5).reshape(b * sip * sj, sk, sl, cin)
             # [kk, kl, cin, ki*kj*cout]: offset-major output channels.
             w_out = jnp.transpose(w_, (2, 3, 4, 0, 1, 5)).reshape(
                 kk, kl, cin, ki * kj * cout
@@ -268,18 +274,20 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
                 window_strides=(1, 1),
                 padding="SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                preferred_element_type=jnp.float32,
-            ).reshape(b, sip, sjp, sk, sl, ki * kj, cout)
-            # out[i, j] = sum_{di,dj} y[i+di, j+dj, (di,dj)]: padded rows
-            # hold conv-of-zeros = 0, reproducing 'same' zero padding
-            # exactly.
-            acc = None
+                preferred_element_type=x_.dtype,
+            ).reshape(b, sip, sj, sk, sl, ki * kj, cout)
+            acc = jnp.zeros((b, si, sj, sk, sl, cout), jnp.float32)
             for di in range(ki):
                 for dj in range(kj):
+                    o = dj - pad_j  # J offset; I is caller-prepadded
+                    j_in = slice(max(0, o), sj + min(0, o))
+                    j_out = slice(max(0, -o), sj + min(0, -o))
                     ys = lax.slice_in_dim(y, di, di + si, axis=1)
-                    ys = lax.slice_in_dim(ys, dj, dj + sj, axis=2)
-                    ys = ys[:, :, :, :, :, di * kj + dj]
-                    acc = ys if acc is None else acc + ys
+                    ys = ys[:, :, j_in, :, :, di * kj + dj]
+                    acc = acc.at[:, :, j_out].add(
+                        ys.astype(jnp.float32)
+                    )
+            # f32 out: the shared tail adds the bias in f32 and casts once.
             return jnp.moveaxis(acc, 5, 1)
 
         out = jax.checkpoint(outstacked_body)(x, w)
