@@ -78,38 +78,22 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     if strategy is None:
         strategy = os.environ.get("NCNET_CONV4D_STRATEGY", _DEFAULT_STRATEGY)
     if strategy == "auto":
-        # Per-layer heuristic: fold the kI*kJ offsets into input channels
-        # when cin is small — the stacked input then stays a small multiple
-        # of the tensor while replacing kI*kJ partial-sum round trips with
-        # one output write (consensus layer 1 has cin=1). Small cout takes
-        # the dual ('conv2d_outstacked': offsets folded into OUTPUT
-        # channels): the 2026-07-31 v5e sweep measured stacked+outstacked
-        # as the fastest full-consensus mix (131.8 ms vs 353.7 ms for the
-        # previous chunked default), and the plain 'conv2d' loop does not
-        # even lower at the one-shot InLoc layer-2 shape
-        # ([1,16,100,75,100,75]: JaxRuntimeError, docs/tpu_r02/
-        # bench_conv4d.txt). Larger cin AND cout (PF-Pascal's 16->16
-        # middle layer, where conv2d won its sweep row) keep the batched
-        # 2-D formulation.
-        if weight.shape[4] <= 2:
-            strategy = "conv2d_stacked"
-        elif weight.shape[5] <= 2 and weight.shape[0] * weight.shape[1] <= 9:
-            # Small cout AND a small kernel: the outstacked conv's
-            # ki*kj-times-wider output stays modest (9x for the InLoc 3^4
-            # layer). At 5^4 kernels the 25x buffer is a ~2 GB backward
-            # transient per branch at the PF-Pascal training shape —
-            # convnd's input-only residual wins there.
-            strategy = "conv2d_outstacked"
-        else:
-            # Large cin AND cout (PF-Pascal's 16->16 middle layer): one
-            # rank-4 ConvGeneral. The v5e sweep has it within 4% of the
-            # conv2d loop (85.79 vs 82.97 ms, docs/tpu_r02/
-            # bench_conv4d.txt), and as a SINGLE conv its AD residual is
-            # just the input — the multi-offset loop strategies save (or
-            # scan-carry) a full accumulator per offset, which OOM'd
-            # jit(train_step) at 38-54 GB on a 16 GB chip. conv2d/conv3d
-            # remain selectable as inference formulations.
-            strategy = "convnd"
+        # Per-layer heuristic (single home: _auto_pick below, shared with
+        # the channels-last consensus gate). Measurements behind the arms:
+        # stacked for small cin — one output write replaces kI*kJ
+        # partial-sum round trips (2026-07-31 v5e: stacked+outstacked mix
+        # 131.8 ms vs 353.7 for the previous chunked default, and plain
+        # 'conv2d' does not even lower at the one-shot InLoc layer-2
+        # shape); outstacked for small cout with a SMALL kernel (the
+        # ki*kj-times-wider conv output is a ~2 GB backward transient per
+        # branch at 5^4 training shapes); convnd for large cin AND cout
+        # (within 4% of conv2d in the sweep, and the only AD-memory-safe
+        # choice — multi-offset loops save or scan-carry a full
+        # accumulator per offset: 38-54 GB OOMs of jit(train_step)).
+        strategy = _auto_pick(
+            weight.shape[0], weight.shape[1], weight.shape[4],
+            weight.shape[5],
+        )
     b, cin, si_pad, sj, sk, sl = x.shape
     ki, kj, kk, kl, wcin, cout = weight.shape
     if wcin != cin:
@@ -121,20 +105,20 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
     # largest HBM tensors in the model, parity: fp16 consensus in
     # lib/model.py:253-258) but ACCUMULATE in f32 on the MXU, summing the
     # kernel-offset partials in f32 and casting back once at the end.
-    # Single-conv strategies ('conv2d_stacked', 'convnd') have no
-    # cross-conv partial sums, so they emit the input dtype directly. At
-    # InLoc shapes that removes a 3.4 GB f32 output buffer plus its
-    # separate 1.7 GB bf16 cast copy from the HBM peak (the round-2 OOM on
-    # a 16 GB v5e was dominated by exactly these temps). Precision caveat:
-    # with a low-precision preferred_element_type the backend is *allowed*
-    # to add inter-tile partials in that dtype (the TPU MXU still
+    # Single-conv emission ('conv2d_stacked', 'convnd', and outstacked's
+    # per-offset partials) uses the input dtype directly. At InLoc shapes
+    # that removes a 3.4 GB f32 output buffer plus its separate 1.7 GB
+    # bf16 cast copy from the HBM peak (the round-2 OOM on a 16 GB v5e
+    # was dominated by exactly these temps). Precision caveat: with a
+    # low-precision preferred_element_type the backend is *allowed* to
+    # add inter-tile partials in that dtype (the TPU MXU still
     # accumulates each tile's contraction in f32); the consensus
     # contractions are <=625 terms and the bf16 storage already bounds the
     # pipeline at ~2-3 decimal digits, covered by the bf16 tolerance test
-    # in tests/test_ops.py. Multi-conv strategies keep explicit f32
-    # partial sums — their cross-conv adds are in this function's hands.
-    single_conv = strategy in ("conv2d_stacked", "convnd")
-    acc_dtype = x.dtype if single_conv else jnp.float32
+    # in tests/test_ops.py. The multi-conv loops (conv2d/conv3d) and
+    # outstacked's 9 cross-offset adds keep explicit f32 partial sums —
+    # those adds are in this function's hands.
+    acc_dtype = x.dtype
     w = weight.astype(x.dtype)
     # AD memory policy, shared by every multi-part strategy below: each
     # part (a kernel-offset term, or a whole stacked formulation) is
@@ -251,7 +235,6 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
         # layer 2: cin=16, cout=1, where input-stacking would blow the
         # input up 9x and 'conv2d' starves the MXU at N=1).
         pad_j = kj // 2
-        sip = si_pad
 
         def outstacked_body(x_, w_):
             # NO J pad: the 2026-07-31 device trace showed the padded
@@ -263,7 +246,7 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
             # below stay f32), and each (di, dj) offset accumulates via a
             # clipped static slice-add — out-of-range taps contribute
             # nothing, which IS 'same' zero padding.
-            xs = jnp.moveaxis(x_, 1, 5).reshape(b * sip * sj, sk, sl, cin)
+            xs = jnp.moveaxis(x_, 1, 5).reshape(b * si_pad * sj, sk, sl, cin)
             # [kk, kl, cin, ki*kj*cout]: offset-major output channels.
             w_out = jnp.transpose(w_, (2, 3, 4, 0, 1, 5)).reshape(
                 kk, kl, cin, ki * kj * cout
@@ -275,7 +258,7 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
                 padding="SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 preferred_element_type=x_.dtype,
-            ).reshape(b, sip, sj, sk, sl, ki * kj, cout)
+            ).reshape(b, si_pad, sj, sk, sl, ki * kj, cout)
             acc = jnp.zeros((b, si, sj, sk, sl, cout), jnp.float32)
             for di in range(ki):
                 for dj in range(kj):
@@ -524,6 +507,127 @@ def _consensus_stack_prepadded(params, x, swap, i0, total_i, halo,
     return x
 
 
+def _auto_pick(ki, kj, cin, cout):
+    """The 'auto' per-layer strategy heuristic (single home; see the
+    measurement citations at the conv4d_prepadded call site)."""
+    if cin <= 2:
+        return "conv2d_stacked"
+    if cout <= 2 and ki * kj <= 9:
+        return "conv2d_outstacked"
+    return "convnd"
+
+
+def _consensus_oneshot_cl(params, corr, symmetric, strategies):
+    """One-shot consensus stack in CHANNELS-LAST layout end to end.
+
+    The 2026-07-31 device trace showed ~25 ms/step of pure layout copies
+    between consensus layers: every conv4d call moves channels first<->
+    last around its NHWC conv, and XLA materializes the round-trips at
+    1.5 GB a piece. Here the whole stack works on [b, I, J, K, L, c]:
+    with cin = cout = 1 at the stack boundary (the consensus net maps
+    1 -> ... -> 1 channels, lib/model.py:122-141), entry and exit are
+    free rank-1-channel reshapes, and no layer ever transposes.
+
+    Only the stacked/outstacked strategies are expressed (the shapes the
+    'auto' heuristic picks for every shipped consensus config); callers
+    fall back to the generic path otherwise, and resolve strategies PER
+    BRANCH (swap_ab_weight exchanges the kernel's IJ/KL extents, so a
+    non-cubic kernel can legitimately pick different formulations for
+    the two symmetric branches). `strategies` is the pair
+    (forward_list, swapped_list) of fully resolved names. Numerics
+    identical to the channels-first strategies: same convs, same f32
+    accumulation policy (the conv bodies below are the channels-last
+    twins of conv4d_prepadded's — a dtype/policy change in either file
+    location must be mirrored, enforced by the CL parity test).
+    """
+    b, cin0, si, sj, sk, sl = corr.shape
+    x0 = jnp.transpose(corr, (0, 2, 3, 4, 5, 1))  # free at cin0 == 1
+
+    def layer_cl(x, w, bias, strat):
+        ki, kj, kk, kl, cin, cout = w.shape
+        pi, pj = ki // 2, kj // 2
+        wd = w.astype(x.dtype)
+        if strat == "conv2d_stacked":
+            def body(x_, w_):
+                xp = jnp.pad(
+                    x_,
+                    ((0, 0), (pi, pi), (pj, pj), (0, 0), (0, 0), (0, 0)),
+                )
+                slabs = [
+                    lax.slice_in_dim(
+                        lax.slice_in_dim(xp, di, di + si, axis=1),
+                        dj, dj + sj, axis=2,
+                    )
+                    for di in range(ki)
+                    for dj in range(kj)
+                ]
+                stacked = jnp.concatenate(slabs, axis=5).reshape(
+                    b * si * sj, sk, sl, ki * kj * cin
+                )
+                w_stacked = jnp.moveaxis(
+                    w_.reshape(ki * kj, kk, kl, cin, cout), 0, 2
+                ).reshape(kk, kl, ki * kj * cin, cout)
+                y = lax.conv_general_dilated(
+                    stacked,
+                    w_stacked,
+                    window_strides=(1, 1),
+                    padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    preferred_element_type=x_.dtype,
+                )
+                return y.reshape(b, si, sj, sk, sl, cout)
+
+            y = jax.checkpoint(body)(x, wd)
+        elif strat == "conv2d_outstacked":
+            def body(x_, w_):
+                xp = jnp.pad(
+                    x_, ((0, 0), (pi, pi), (0, 0), (0, 0), (0, 0), (0, 0))
+                )
+                xs = xp.reshape(b * (si + 2 * pi) * sj, sk, sl, cin)
+                w_out = jnp.transpose(w_, (2, 3, 4, 0, 1, 5)).reshape(
+                    kk, kl, cin, ki * kj * cout
+                )
+                yy = lax.conv_general_dilated(
+                    xs,
+                    w_out,
+                    window_strides=(1, 1),
+                    padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    preferred_element_type=x_.dtype,
+                ).reshape(b, si + 2 * pi, sj, sk, sl, ki * kj, cout)
+                acc = jnp.zeros((b, si, sj, sk, sl, cout), jnp.float32)
+                for di in range(ki):
+                    for dj in range(kj):
+                        o = dj - pj
+                        j_in = slice(max(0, o), sj + min(0, o))
+                        j_out = slice(max(0, -o), sj + min(0, -o))
+                        ys = lax.slice_in_dim(yy, di, di + si, axis=1)
+                        ys = ys[:, :, j_in, :, :, di * kj + dj]
+                        acc = acc.at[:, :, j_out].add(ys.astype(jnp.float32))
+                return acc
+
+            y = jax.checkpoint(body)(x, wd)
+        else:  # pragma: no cover — guarded by the caller
+            raise ValueError(f"channels-last path lacks {strat!r}")
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return jax.nn.relu(y).astype(x.dtype)
+
+    fwd_strategies, swap_strategies = strategies
+
+    def stack(x, swap):
+        strats = swap_strategies if swap else fwd_strategies
+        for li, layer in enumerate(params):
+            w = swap_ab_weight(layer["weight"]) if swap else layer["weight"]
+            x = layer_cl(x, w, layer["bias"], strats[li])
+        return x
+
+    out = stack(x0, False)
+    if symmetric:
+        out = out + stack(x0, True)
+    return jnp.transpose(out, (0, 5, 1, 2, 3, 4))  # free at cout == 1
+
+
 def neigh_consensus_apply(
     params, corr, *, symmetric: bool = True, chunk_i=None, strategies=None
 ):
@@ -638,6 +742,41 @@ def neigh_consensus_apply(
         return x
 
     if one_shot:
+        # Channels-last fast path (see _consensus_oneshot_cl): taken when
+        # every layer resolves to a strategy it expresses and the stack
+        # boundary channels are 1 (free entry/exit reshapes). Opt out for
+        # A/B with NCNET_CONSENSUS_CL=0.
+        if (
+            kl_fold <= 1
+            and corr.shape[1] == 1
+            and params[-1]["weight"].shape[5] == 1
+            and os.environ.get("NCNET_CONSENSUS_CL", "1") == "1"
+        ):
+            def resolve(swapped):
+                # 'auto' must be re-picked per symmetric branch: the
+                # swapped kernel exchanges IJ/KL extents, and a non-cubic
+                # kernel can land in a different arm (e.g. a 25-tap
+                # swapped IJ stencil belongs to convnd, not outstacked).
+                out_s = []
+                for li, layer in enumerate(params):
+                    s = strategies[li] if strategies else None
+                    if s is None:
+                        s = os.environ.get("NCNET_CONV4D_STRATEGY", "auto")
+                    if s == "auto":
+                        kiw, kjw, kkw, klw, ciw, cow = layer["weight"].shape
+                        if swapped:
+                            kiw, kjw = kkw, klw
+                        s = _auto_pick(kiw, kjw, ciw, cow)
+                    out_s.append(s)
+                return out_s
+
+            resolved = (resolve(False), resolve(True))
+            needed = resolved[0] + (resolved[1] if symmetric else [])
+            if all(s in ("conv2d_stacked", "conv2d_outstacked")
+                   for s in needed):
+                return _consensus_oneshot_cl(
+                    params, corr, symmetric, resolved
+                )
         if kl_fold > 1:
             corr, orig_kl = fold_kl(corr, kl_fold)
         out = stack(corr, False)

@@ -487,3 +487,30 @@ def test_consensus_kl_fold_env_parity(rng, symmetric, monkeypatch):
     monkeypatch.setenv("NCNET_CONSENSUS_KL_FOLD", "2")
     got = neigh_consensus_apply(params, x, symmetric=symmetric, chunk_i=0)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+@pytest.mark.parametrize("symmetric", [True, False])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_consensus_channels_last_path_parity(rng, symmetric, dtype, monkeypatch):
+    """The channels-last one-shot stack == the generic channels-first path
+    (NCNET_CONSENSUS_CL=0) for the InLoc-shaped 1 -> 16 -> 1 config."""
+    import jax
+
+    from ncnet_tpu.ops.conv4d import neigh_consensus_apply, neigh_consensus_init
+
+    params = neigh_consensus_init(jax.random.PRNGKey(3), (3, 3), (16, 1))
+    x = jnp.asarray(rng.randn(1, 1, 6, 5, 7, 6).astype(np.float32)).astype(dtype)
+    # Pin the env: an ambient CL=0 / strategy override would make this
+    # compare the generic path to itself.
+    monkeypatch.setenv("NCNET_CONSENSUS_CL", "1")
+    monkeypatch.delenv("NCNET_CONV4D_STRATEGY", raising=False)
+    monkeypatch.delenv("NCNET_CONSENSUS_STRATEGIES", raising=False)
+    got = neigh_consensus_apply(params, x, symmetric=symmetric, chunk_i=0)
+    monkeypatch.setenv("NCNET_CONSENSUS_CL", "0")
+    want = neigh_consensus_apply(params, x, symmetric=symmetric, chunk_i=0)
+    tol = 1e-6 if dtype == jnp.float32 else 5e-2
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32),
+        np.asarray(want, dtype=np.float32),
+        atol=tol, rtol=tol,
+    )
