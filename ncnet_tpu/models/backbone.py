@@ -24,6 +24,7 @@ models/convert.py.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, List
 
 import jax
@@ -132,14 +133,43 @@ class BackboneConfig:
         return 64 * (2 ** (self.num_stages - 1)) * 4
 
 
+# Channels-last mode (set only under resnet_apply's NHWC scope): the
+# 2026-07-31 device trace showed the NCHW residual-add+relu fusions of
+# ResNet layer3 running at ~8% of HBM bandwidth under XLA's channel-minor
+# T(2,128) tiling — ~46 ops x 1.46 ms, two thirds of the backbone's cost.
+# In NHWC the 1024-wide channel axis is the lane dimension and elementwise
+# ops tile natively. The flag is trace-time state scoped by a context
+# manager (single-threaded tracing), so the VGG/DenseNet paths and every
+# existing caller stay NCHW untouched.
+_CHANNELS_LAST = False
+
+
+class _channels_last:
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+
+    def __enter__(self):
+        global _CHANNELS_LAST
+        self.prev = _CHANNELS_LAST
+        _CHANNELS_LAST = self.enabled
+
+    def __exit__(self, *exc):
+        global _CHANNELS_LAST
+        _CHANNELS_LAST = self.prev
+
+
 def conv2d(x, w, stride: int = 1, padding: int = 0):
-    """NCHW conv with torch-style symmetric padding. w is [kh, kw, cin, cout]."""
+    """Conv with torch-style symmetric padding. w is [kh, kw, cin, cout].
+
+    Input/output layout is NCHW, or NHWC inside a _channels_last scope.
+    """
+    dims = ("NHWC", "HWIO", "NHWC") if _CHANNELS_LAST else ("NCHW", "HWIO", "NCHW")
     return lax.conv_general_dilated(
         x,
         w,
         window_strides=(stride, stride),
         padding=((padding, padding), (padding, padding)),
-        dimension_numbers=("NCHW", "HWIO", "NCHW"),
+        dimension_numbers=dims,
     )
 
 
@@ -157,18 +187,23 @@ def frozen_bn(x, bn: Params, eps: float = 1e-5):
     shift = bn["bias"].astype(jnp.float32) - bn["mean"].astype(jnp.float32) * scale
     scale = scale.astype(x.dtype)
     shift = shift.astype(x.dtype)
-    return x * scale.reshape(1, -1, 1, 1) + shift.reshape(1, -1, 1, 1)
+    shape = (1, 1, 1, -1) if _CHANNELS_LAST else (1, -1, 1, 1)
+    return x * scale.reshape(shape) + shift.reshape(shape)
 
 
 def max_pool(x, window: int, stride: int, padding: int):
     """Torch-style max pool (pads with -inf)."""
+    if _CHANNELS_LAST:
+        wd = (1, window, window, 1)
+        ws = (1, stride, stride, 1)
+        pd = ((0, 0), (padding, padding), (padding, padding), (0, 0))
+    else:
+        wd = (1, 1, window, window)
+        ws = (1, 1, stride, stride)
+        pd = ((0, 0), (0, 0), (padding, padding), (padding, padding))
     return lax.reduce_window(
-        x,
-        -jnp.inf,
-        lax.max,
-        window_dimensions=(1, 1, window, window),
-        window_strides=(1, 1, stride, stride),
-        padding=((0, 0), (0, 0), (padding, padding), (padding, padding)),
+        x, -jnp.inf, lax.max, window_dimensions=wd, window_strides=ws,
+        padding=pd,
     )
 
 
@@ -254,7 +289,19 @@ def resnet_stages(config: BackboneConfig, params: Params, x):
 
 
 def resnet_apply(config: BackboneConfig, params: Params, x):
-    """Run the truncated ResNet on an NCHW float batch."""
+    """Run the truncated ResNet on an NCHW float batch.
+
+    NCNET_BACKBONE_NHWC=1 (trace time) runs the stages internally in
+    channels-last layout — one entry transpose of the 3-channel input and
+    one exit transpose back to the NCHW contract; everything between
+    tiles the 64-1024-wide channel axis on lanes (see _channels_last).
+    """
+    if os.environ.get("NCNET_BACKBONE_NHWC", "0") == "1":
+        with _channels_last(True):
+            out = resnet_stages(
+                config, params, jnp.transpose(x, (0, 2, 3, 1))
+            )[-1]
+        return jnp.transpose(out, (0, 3, 1, 2))
     return resnet_stages(config, params, x)[-1]
 
 

@@ -185,3 +185,29 @@ def test_backbone_bf16_compute_close_to_f32():
             jnp.linalg.norm(f32.reshape(-1)) * jnp.linalg.norm(f16.reshape(-1))
         )
         assert cos > 0.995, (cnn, float(cos))
+
+
+def test_resnet_nhwc_internal_layout_parity(monkeypatch):
+    """NCNET_BACKBONE_NHWC=1 is a pure layout change: same values as the
+    NCHW path within conv-reassociation tolerance."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ncnet_tpu.models.backbone import (
+        BackboneConfig,
+        backbone_apply,
+        backbone_init,
+    )
+
+    config = BackboneConfig(cnn="resnet101")
+    params = backbone_init(jax.random.PRNGKey(0), config)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 64, 64), jnp.float32)
+    monkeypatch.delenv("NCNET_BACKBONE_NHWC", raising=False)
+    want = backbone_apply(config, params, x)
+    monkeypatch.setenv("NCNET_BACKBONE_NHWC", "1")
+    got = backbone_apply(config, params, x)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )

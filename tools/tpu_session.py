@@ -148,10 +148,10 @@ def main(argv=None):
         #                   models/ncnet.py
         bench_runs = [
             ("baseline", {}),  # feat_unit auto -> 16: the new aligned shape
+            ("nhwc-backbone", {"NCNET_BACKBONE_NHWC": "1"}),
+            ("nhwc+no-cl", {"NCNET_BACKBONE_NHWC": "1",
+                            "NCNET_CONSENSUS_CL": "0"}),
             ("feat2 (reference dims)", {"NCNET_INLOC_FEAT_UNIT": "2"}),
-            ("fold2", {"NCNET_CONSENSUS_KL_FOLD": "2",
-                       "NCNET_CONSENSUS_STRATEGIES":
-                       "conv2d_stacked,conv2d_outstacked"}),
             ("fused-mutual", {"NCNET_FUSE_MUTUAL_EXTRACT": "1"}),
             ("full-fusion", {"NCNET_FUSE_MUTUAL_EXTRACT": "1",
                              "NCNET_FUSE_CORR_MAXES": "1"}),
@@ -159,7 +159,8 @@ def main(argv=None):
         for run_label, env in bench_runs:
             for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
                       "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD",
-                      "NCNET_INLOC_FEAT_UNIT"):
+                      "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
+                      "NCNET_CONSENSUS_CL"):
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
