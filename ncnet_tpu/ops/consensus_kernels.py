@@ -1,0 +1,208 @@
+"""Pallas TPU kernel for consensus layer 1 (the cin=1 Conv4d).
+
+Why XLA tops out here: the consensus convs' channel dims (1 / 9 / 16)
+leave the 128x128 MXU almost idle however they are folded — the stage
+measured ~114 ms in-step at InLoc shape against a ~26 ms traffic
+roofline, insensitive to strategy mixes, space-to-depth folds, and
+layout rewrites (docs/tpu_r02 session logs). For LAYER 1 (cin=1) the
+arithmetic repacks into a genuinely MXU-shaped dot:
+
+    per (i, j) cell:  dot [K*LP, 81] x [81, 2*c_mid]
+
+— contraction over ALL 81 4-D taps at once, output channels stacking
+BOTH symmetric branches (they read the same input tensor), bias + ReLU
+fused. Layer 2 (cin=16 per branch) keeps its XLA formulation: its
+output width is <= 2*kk*kl = 18 columns whichever way it is folded, so
+no dot shape exists that beats the outstacked conv within VMEM.
+
+Layout: each cell's (K, L) plane is FLAT with L zero-padded to a
+multiple of 128 lanes (LP). A (dk, dl) plane shift is then ONE static
+slice of the margin-padded flat vector — the zero pad columns make flat
+shifting row-exact and implement 'same' zero padding for free. I/J
+boundary taps multiply by a 0/1 validity scalar derived from the grid
+ids (the input specs clamp their index maps at the edges). The output
+keeps the padded-flat layout with its pad columns force-zeroed (ReLU of
+a bias would otherwise leak there); `unflatten_planes` restores
+[..., K, L].
+
+Oracle / fallback: the XLA stacked formulation (ops.conv4d).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _lp(l: int) -> int:
+    return -(-l // 128) * 128
+
+
+def flatten_planes(x, k: int, l: int):
+    """[..., K, L] -> [..., K*LP] with L zero-padded to 128 lanes."""
+    lp = _lp(l)
+    pad = [(0, 0)] * (x.ndim - 2) + [(0, 0), (0, lp - l)]
+    return jnp.pad(x, pad).reshape(*x.shape[:-2], k * lp)
+
+
+def unflatten_planes(x, k: int, l: int):
+    """Inverse of flatten_planes on the trailing axis."""
+    lp = _lp(l)
+    return x.reshape(*x.shape[:-1], k, lp)[..., :l]
+
+
+def _l1_kernel(ki, kj, kk, kl, si, sj, sk, sl, cout2, compute_dtype, both,
+               *refs):
+    n_t = ki * kj
+    plane_refs = refs[:n_t]
+    w_ref, b_ref = refs[n_t], refs[n_t + 1]
+    outs = refs[n_t + 2:]
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    lp = _lp(sl)
+    flat = sk * lp
+    margin = (kk // 2) * lp + kl // 2
+    offsets = [dk * lp + dl for dk in range(kk) for dl in range(kl)]
+
+    cols = []
+    for t in range(n_t):
+        di, dj = t // kj, t % kj
+        ii = i + di - ki // 2
+        jj = j + dj - kj // 2
+        valid = ((ii >= 0) & (ii < si) & (jj >= 0) & (jj < sj)).astype(
+            jnp.float32
+        )
+        plane = plane_refs[t][0, 0].astype(jnp.float32) * valid
+        pp = jnp.pad(plane, (margin, margin)).astype(compute_dtype)
+        for off in offsets:
+            cols.append(
+                lax.dynamic_slice_in_dim(pp, off, flat, axis=0)
+            )
+    a = jnp.stack(cols, axis=-1)  # [flat, ki*kj*kk*kl]
+    acc = jax.lax.dot_general(
+        a,
+        w_ref[...],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [flat, cout2]
+    acc = jax.nn.relu(acc + b_ref[...])
+    # Zero the L-pad columns: downstream flat-shift consumers rely on
+    # them being exactly zero, and relu(bias) would leak there.
+    col = lax.broadcasted_iota(jnp.int32, (flat, 1), 0) % lp
+    acc = jnp.where(col < sl, acc, 0.0)
+    if both:
+        half = cout2 // 2
+        outs[0][0, 0] = acc[:, :half].astype(outs[0].dtype)
+        outs[1][0, 0] = acc[:, half:].astype(outs[1].dtype)
+    else:
+        outs[0][0, 0] = acc.astype(outs[0].dtype)
+
+
+def consensus_l1_pallas(w1, b1, corr4d, symmetric: bool = True,
+                        interpret: bool = False):
+    """Layer-1 Conv4d + bias + ReLU, optionally for BOTH symmetric branches.
+
+    Args:
+      w1: [ki, kj, kk, kl, 1, c_mid]; b1: [c_mid].
+      corr4d: [1, 1, I, J, K, L] (any float dtype; bf16 compute for the
+        bf16 pipeline).
+      symmetric: also evaluate the swap_ab_weight branch (stacked on the
+        dot's output columns — both branches read the same input).
+
+    Returns:
+      (z_fwd, z_swap) — z_swap None when symmetric=False — each
+      [I, J, K*LP, c_mid] in corr4d's dtype: flatten_planes layout with
+      pad columns zeroed.
+
+    Shape preconditions (ValueError otherwise; callers fall back to the
+    XLA stack): extent-symmetric kernels (ki==kk, kj==kl — the swapped
+    branch reuses the forward tap enumeration), and an L pad of at least
+    kl//2 columns (lp > sl required: with no zero pad columns the flat
+    L shifts would wrap into the adjacent K row).
+    """
+    from .conv4d import swap_ab_weight
+
+    b, c0, si, sj, sk, sl = corr4d.shape
+    ki, kj, kk, kl, cin, c_mid = w1.shape
+    if b != 1 or c0 != 1 or cin != 1:
+        raise ValueError("consensus_l1_pallas: batch-1 single-channel only")
+    if ki != kk or kj != kl:
+        raise ValueError(
+            "consensus_l1_pallas: extent-symmetric kernels only "
+            f"(got {(ki, kj, kk, kl)})"
+        )
+    if _lp(sl) - sl < kl // 2:
+        raise ValueError(
+            f"consensus_l1_pallas: L={sl} leaves fewer than kl//2="
+            f"{kl // 2} zero pad columns in the 128-lane flat layout — "
+            "flat shifts would wrap into the adjacent K row"
+        )
+    lp = _lp(sl)
+    flat = sk * lp
+    dtype = corr4d.dtype
+    # bf16 MXU compute for the bf16 pipeline; f32 inputs keep an f32 dot
+    # (exact parity with the XLA stack at f32, half MXU rate — the
+    # flagship half-precision config is the fast path).
+    compute_dtype = jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
+
+    def w_cols(w):
+        # Column order must match the kernel's im2col: (di, dj) major,
+        # (dk, dl) minor.
+        return w.reshape(ki * kj * kk * kl, c_mid)
+
+    if symmetric:
+        w_pair = jnp.concatenate(
+            [w_cols(w1), w_cols(swap_ab_weight(w1))], axis=1
+        ).astype(compute_dtype)  # [taps, 2*c_mid]
+        b_pair = jnp.concatenate([b1, b1]).astype(jnp.float32)[None, :]
+        cout2 = 2 * c_mid
+    else:
+        w_pair = w_cols(w1).astype(compute_dtype)
+        b_pair = b1.astype(jnp.float32)[None, :]
+        cout2 = c_mid
+
+    y = flatten_planes(corr4d[0, 0].astype(dtype), sk, sl)  # [I, J, flat]
+
+    specs = []
+    for di in range(ki):
+        for dj in range(kj):
+            def imap(i, j, _di=di, _dj=dj):
+                return (
+                    jnp.clip(i + _di - ki // 2, 0, si - 1),
+                    jnp.clip(j + _dj - kj // 2, 0, sj - 1),
+                    0,
+                )
+
+            specs.append(
+                pl.BlockSpec((1, 1, flat), imap, memory_space=pltpu.VMEM)
+            )
+
+    out_spec = pl.BlockSpec(
+        (1, 1, flat, c_mid), lambda i, j: (i, j, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    n_out = 2 if symmetric else 1
+    out = pl.pallas_call(
+        partial(_l1_kernel, ki, kj, kk, kl, si, sj, sk, sl, cout2,
+                compute_dtype, symmetric),
+        grid=(si, sj),
+        in_specs=specs + [
+            pl.BlockSpec((ki * kj * kk * kl, cout2),
+                         lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cout2), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[out_spec] * n_out,
+        out_shape=[
+            jax.ShapeDtypeStruct((si, sj, flat, c_mid), dtype)
+        ] * n_out,
+        interpret=interpret,
+    )(*([y] * (ki * kj)), w_pair, b_pair)
+    if symmetric:
+        return out[0], out[1]
+    return out[0], None

@@ -615,6 +615,45 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies):
 
     fwd_strategies, swap_strategies = strategies
 
+    # Layer-1 Pallas kernel (NCNET_CONSENSUS_L1_PALLAS=1, trace time):
+    # both symmetric branches' first layers evaluate in one MXU-shaped
+    # kernel (ops/consensus_kernels.py) and layer 2 continues in this
+    # channels-last path; only the 2-layer cin0=1 stacks qualify.
+    w1_shape = params[0]["weight"].shape
+    lp = -(-sl // 128) * 128  # keeps jax.experimental.pallas off the
+    # import path of callers that never take the kernel branch
+
+    if (
+        len(params) == 2
+        and b == 1
+        and w1_shape[4] == 1
+        and w1_shape[0] == w1_shape[2]  # extent-symmetric kernel: the
+        and w1_shape[1] == w1_shape[3]  # fused swapped branch reuses the
+        # forward tap enumeration (consensus_kernels preconditions)
+        and lp - sl >= w1_shape[3] // 2
+        and os.environ.get("NCNET_CONSENSUS_L1_PALLAS", "0") == "1"
+    ):
+        from .consensus_kernels import consensus_l1_pallas
+
+        za_f, zb_f = consensus_l1_pallas(
+            params[0]["weight"], params[0]["bias"], corr,
+            symmetric=symmetric,
+        )
+
+        def finish(z_f, swap):
+            z6 = z_f.reshape(si, sj, sk, lp, -1)[:, :, :, :sl][None]
+            w2 = params[1]["weight"]
+            strats = swap_strategies if swap else fwd_strategies
+            return layer_cl(
+                z6, swap_ab_weight(w2) if swap else w2,
+                params[1]["bias"], strats[1],
+            )
+
+        out = finish(za_f, False)
+        if symmetric:
+            out = out + finish(zb_f, True)
+        return jnp.transpose(out, (0, 5, 1, 2, 3, 4))
+
     def stack(x, swap):
         strats = swap_strategies if swap else fwd_strategies
         for li, layer in enumerate(params):

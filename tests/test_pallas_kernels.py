@@ -324,3 +324,43 @@ def test_forward_fuse_corr_maxes_env_parity(rng, monkeypatch):
         np.asarray(corr), np.asarray(base_corr), atol=1e-6
     )
     np.testing.assert_array_equal(np.asarray(delta), np.asarray(base_delta))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_consensus_l1_kernel_interpret_parity(rng, dtype):
+    """The layer-1 consensus kernel (both symmetric branches in one dot)
+    == relu(conv4d + bias) with the plain and swapped kernels, including
+    I/J edge taps, the flat-plane L padding, and pad-column zeroing."""
+    from ncnet_tpu.ops.conv4d import conv4d, swap_ab_weight
+    from ncnet_tpu.ops.consensus_kernels import (
+        consensus_l1_pallas,
+        unflatten_planes,
+        _lp,
+    )
+
+    si, sj, sk, sl, c = 5, 4, 6, 5, 7
+    corr = jnp.asarray(
+        rng.randn(1, 1, si, sj, sk, sl).astype(np.float32)
+    ).astype(dtype)
+    w1 = jnp.asarray(0.2 * rng.randn(3, 3, 3, 3, 1, c).astype(np.float32))
+    b1 = jnp.asarray(0.1 * rng.randn(c).astype(np.float32))
+
+    za_f, zb_f = consensus_l1_pallas(w1, b1, corr, interpret=True)
+    lp = _lp(sl)
+    tol = 1e-5 if dtype == jnp.float32 else 6e-2
+
+    for z_f, w in ((za_f, w1), (zb_f, swap_ab_weight(w1))):
+        want = jax.nn.relu(
+            conv4d(corr.astype(jnp.float32), w, b1)
+        )  # [1, c, I, J, K, L]
+        got = z_f.reshape(si, sj, sk, lp, c)[:, :, :, :sl]
+        got = jnp.transpose(got, (4, 0, 1, 2, 3))[None]
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            atol=tol, rtol=tol,
+        )
+        # Pad columns must be exactly zero (flat-shift consumers rely on
+        # it).
+        pads = np.asarray(z_f.reshape(si, sj, sk, lp, c)[:, :, :, sl:],
+                          np.float32)
+        assert (pads == 0).all()

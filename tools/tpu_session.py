@@ -149,8 +149,8 @@ def main(argv=None):
         bench_runs = [
             ("baseline", {}),  # feat_unit auto -> 16: the new aligned shape
             ("nhwc-backbone", {"NCNET_BACKBONE_NHWC": "1"}),
-            ("nhwc+no-cl", {"NCNET_BACKBONE_NHWC": "1",
-                            "NCNET_CONSENSUS_CL": "0"}),
+            ("nhwc+l1-pallas", {"NCNET_BACKBONE_NHWC": "1",
+                                "NCNET_CONSENSUS_L1_PALLAS": "1"}),
             ("feat2 (reference dims)", {"NCNET_INLOC_FEAT_UNIT": "2"}),
             ("fused-mutual", {"NCNET_FUSE_MUTUAL_EXTRACT": "1"}),
             ("full-fusion", {"NCNET_FUSE_MUTUAL_EXTRACT": "1",
@@ -160,7 +160,7 @@ def main(argv=None):
             for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
                       "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD",
                       "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
-                      "NCNET_CONSENSUS_CL"):
+                      "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_L1_PALLAS"):
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
