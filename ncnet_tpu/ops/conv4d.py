@@ -631,13 +631,19 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies):
         and w1_shape[1] == w1_shape[3]  # fused swapped branch reuses the
         # forward tap enumeration (consensus_kernels preconditions)
         and lp - sl >= w1_shape[3] // 2
-        and os.environ.get("NCNET_CONSENSUS_L1_PALLAS", "0") == "1"
+        and os.environ.get("NCNET_CONSENSUS_L1_PALLAS", "0")
+        in ("1", "interpret")
     ):
         from .consensus_kernels import consensus_l1_pallas
 
+        # "interpret" runs the kernel in the Pallas interpreter — the
+        # CPU hook that lets the END-TO-END integration branch (reshape /
+        # slice / swapped-layer-2 glue below) be parity-tested without
+        # hardware.
         za_f, zb_f = consensus_l1_pallas(
             params[0]["weight"], params[0]["bias"], corr,
             symmetric=symmetric,
+            interpret=os.environ["NCNET_CONSENSUS_L1_PALLAS"] == "interpret",
         )
 
         def finish(z_f, swap):

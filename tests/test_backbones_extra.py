@@ -203,7 +203,9 @@ def test_resnet_nhwc_internal_layout_parity(monkeypatch):
     config = BackboneConfig(cnn="resnet101")
     params = backbone_init(jax.random.PRNGKey(0), config)
     x = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 64, 64), jnp.float32)
-    monkeypatch.delenv("NCNET_BACKBONE_NHWC", raising=False)
+    # Explicit on BOTH legs: NHWC is the default now, so an unset env
+    # would make this compare the NHWC path with itself.
+    monkeypatch.setenv("NCNET_BACKBONE_NHWC", "0")
     want = backbone_apply(config, params, x)
     monkeypatch.setenv("NCNET_BACKBONE_NHWC", "1")
     got = backbone_apply(config, params, x)

@@ -152,10 +152,9 @@ def main(argv=None):
         # remote-compile budget (the 08:03 session lost two bench lines
         # to >25 min compiles).
         bench_runs = [
-            ("baseline", {}),
-            ("nhwc+l1-pallas", {"NCNET_BACKBONE_NHWC": "1",
-                                "NCNET_CONSENSUS_L1_PALLAS": "1"}),
-            ("nhwc-backbone", {"NCNET_BACKBONE_NHWC": "1"}),
+            ("default (nhwc)", {}),
+            ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}),
+            ("nchw-backbone", {"NCNET_BACKBONE_NHWC": "0"}),
         ]
         for run_label, env in bench_runs:
             for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
