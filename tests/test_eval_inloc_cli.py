@@ -240,3 +240,56 @@ def test_pano_batch_matches_unbatched(fixture_dir):
             got[..., 4], want[..., 4], atol=2e-3,
             err_msg="score column diverged beyond bf16 rounding",
         )
+
+
+def test_pano_batch_mixed_shapes(tmp_path):
+    """Batched pano mode with HETEROGENEOUS pano shapes: the incremental
+    grouper must split same-bucket stacks correctly (portrait + landscape
+    panos in one shortlist) and still fill every pano's slot."""
+    rng = np.random.default_rng(3)
+    qdir = tmp_path / "query"
+    pdir = tmp_path / "pano"
+    qdir.mkdir()
+    pdir.mkdir()
+    Image.fromarray(
+        (rng.random((96, 128, 3)) * 255).astype("uint8")
+    ).save(qdir / "q0.jpg")
+    # Two landscape + two portrait panos -> two shape buckets.
+    shapes = [(96, 128), (128, 96), (96, 128), (128, 96)]
+    pano_names = []
+    for i, (h, w) in enumerate(shapes):
+        n = f"p{i}.jpg"
+        Image.fromarray(
+            (rng.random((h, w, 3)) * 255).astype("uint8")
+        ).save(pdir / n)
+        pano_names.append(n)
+    img_list = np.zeros((1, 1), dtype=[("queryname", "O"), ("topNname", "O")])
+    img_list[0, 0]["queryname"] = "q0.jpg"
+    img_list[0, 0]["topNname"] = np.array(
+        pano_names, dtype=object
+    ).reshape(1, -1)
+    savemat(tmp_path / "shortlist.mat", {"ImgList": img_list})
+
+    out_dir = tmp_path / "matches"
+    eval_inloc.main(
+        [
+            "--inloc_shortlist", str(tmp_path / "shortlist.mat"),
+            "--query_path", str(qdir),
+            "--pano_path", str(pdir),
+            "--output_dir", str(out_dir),
+            "--image_size", "64",
+            "--n_queries", "1",
+            "--n_panos", "4",
+            "--k_size", "2",
+            "--pano_batch", "2",
+        ]
+    )
+    exp = os.listdir(out_dir)
+    assert len(exp) == 1
+    from scipy.io import loadmat
+
+    m = loadmat(out_dir / exp[0] / "1.mat")["matches"]
+    assert m.shape[1] == 4
+    # Every pano slot must carry real matches (nonzero scores).
+    for idx in range(4):
+        assert np.any(m[0, idx, :, 4] > 0), f"pano {idx} slot empty"
