@@ -95,9 +95,9 @@ def _pool_select(slab, kk: int, rows: int, tbc: int, out_dtype, pooled_ref, idx_
     return best
 
 
-# Finite -inf for the pooled-stat masking (same rationale as
-# ops/extract_kernel._NEG: a real -inf would NaN on -inf minus -inf).
-_NEG = -3.0e38
+# Finite -inf for the pooled-stat masking (a real -inf would NaN on
+# -inf minus -inf) — single home in the extraction kernel module.
+from .extract_kernel import _NEG  # noqa: E402
 
 
 def _pool_stats_update(
