@@ -6,7 +6,7 @@
 # a dial succeeds run the whole hardware queue while the tunnel lasts.
 # Breaks only on a non-cpu_smoke bench metric (or attempt cap).
 cd /root/repo || exit 1
-OUT=docs/tpu_r02
+OUT=docs/tpu_r03
 mkdir -p "$OUT"
 for n in $(seq 1 90); do
   echo "=== attempt $n $(date -u +%FT%TZ) ===" >> "$OUT/probe.log"
