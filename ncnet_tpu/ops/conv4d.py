@@ -259,17 +259,30 @@ def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 preferred_element_type=x_.dtype,
             ).reshape(b, si_pad, sj, sk, sl, ki * kj, cout)
-            acc = jnp.zeros((b, si, sj, sk, sl, cout), jnp.float32)
+            # Tree-reduce of zero-padded terms, NOT sequential at[].add:
+            # the round-2 device trace showed XLA emitting each at[].add
+            # as its own full-tensor f32 read-modify-write pass (~15 ms/
+            # step of pure HBM traffic at InLoc shape). Padding every
+            # term back to the output window and summing lets XLA fuse
+            # all kI*kJ shifted adds into ONE pass that reads each conv
+            # output element exactly once. Numerics unchanged: same f32
+            # accumulation, same (di, dj) addition order per element
+            # (adding a pad zero is exact).
+            acc = None
             for di in range(ki):
                 for dj in range(kj):
                     o = dj - pad_j  # J offset; I is caller-prepadded
                     j_in = slice(max(0, o), sj + min(0, o))
-                    j_out = slice(max(0, -o), sj + min(0, -o))
                     ys = lax.slice_in_dim(y, di, di + si, axis=1)
-                    ys = ys[:, :, j_in, :, :, di * kj + dj]
-                    acc = acc.at[:, :, j_out].add(
-                        ys.astype(jnp.float32)
+                    ys = ys[:, :, j_in, :, :, di * kj + dj].astype(
+                        jnp.float32
                     )
+                    term = jnp.pad(
+                        ys,
+                        ((0, 0), (0, 0), (max(0, -o), max(0, o)),
+                         (0, 0), (0, 0), (0, 0)),
+                    )
+                    acc = term if acc is None else acc + term
             # f32 out: the shared tail adds the bias in f32 and casts once.
             return jnp.moveaxis(acc, 5, 1)
 
@@ -580,10 +593,17 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies):
             y = jax.checkpoint(body)(x, wd)
         elif strat == "conv2d_outstacked":
             def body(x_, w_):
-                xp = jnp.pad(
-                    x_, ((0, 0), (pi, pi), (0, 0), (0, 0), (0, 0), (0, 0))
-                )
-                xs = xp.reshape(b * (si + 2 * pi) * sj, sk, sl, cin)
+                # NO explicit I pad (the round-2 trace showed the padded
+                # formulation materializing a 1.5 GB copy per branch,
+                # ~6 ms each): both I and J offsets accumulate via
+                # clipped slices — out-of-range taps contribute nothing,
+                # which IS 'same' zero padding. And a tree-reduce of
+                # zero-padded terms instead of sequential at[].add lets
+                # XLA fuse all kI*kJ shifted adds into one pass (the
+                # at[].add chain cost ~15 ms/step of f32 RMW traffic).
+                # Numerics unchanged: f32 accumulation, same per-element
+                # addition order (pad zeros add exactly).
+                xs = x_.reshape(b * si * sj, sk, sl, cin)
                 w_out = jnp.transpose(w_, (2, 3, 4, 0, 1, 5)).reshape(
                     kk, kl, cin, ki * kj * cout
                 )
@@ -594,16 +614,25 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies):
                     padding="SAME",
                     dimension_numbers=("NHWC", "HWIO", "NHWC"),
                     preferred_element_type=x_.dtype,
-                ).reshape(b, si + 2 * pi, sj, sk, sl, ki * kj, cout)
-                acc = jnp.zeros((b, si, sj, sk, sl, cout), jnp.float32)
+                ).reshape(b, si, sj, sk, sl, ki * kj, cout)
+                acc = None
                 for di in range(ki):
                     for dj in range(kj):
-                        o = dj - pj
-                        j_in = slice(max(0, o), sj + min(0, o))
-                        j_out = slice(max(0, -o), sj + min(0, -o))
-                        ys = lax.slice_in_dim(yy, di, di + si, axis=1)
-                        ys = ys[:, :, j_in, :, :, di * kj + dj]
-                        acc = acc.at[:, :, j_out].add(ys.astype(jnp.float32))
+                        oi = di - pi
+                        oj = dj - pj
+                        i_in = slice(max(0, oi), si + min(0, oi))
+                        j_in = slice(max(0, oj), sj + min(0, oj))
+                        ys = yy[:, i_in, j_in, :, :, di * kj + dj].astype(
+                            jnp.float32
+                        )
+                        term = jnp.pad(
+                            ys,
+                            ((0, 0),
+                             (max(0, -oi), max(0, oi)),
+                             (max(0, -oj), max(0, oj)),
+                             (0, 0), (0, 0), (0, 0)),
+                        )
+                        acc = term if acc is None else acc + term
                 return acc
 
             y = jax.checkpoint(body)(x, wd)
