@@ -147,19 +147,63 @@ def main():
         # headline — the 10 per-pano dispatches were the bottleneck), and
         # a local runtime pays a smaller but real per-dispatch cost too.
         # The eval CLI exposes the same batching (--pano_batch).
+        # Pano-backbone batching (NCNET_PANO_BACKBONE_BATCH=n, trace
+        # time): run the pano backbones for the whole stack in batches of
+        # n BEFORE the per-pano scan. The round-2 trace shows the batch-1
+        # backbone convs at 12-16% MXU utilization (89-130 GB/s — neither
+        # compute- nor HBM-bound); batching feeds the MXU while the
+        # per-pano scan keeps the HBM-bound corr/consensus tensors at
+        # batch-1 size. Features for 10 panos at InLoc shape are ~0.6 GB
+        # bf16 — cheap next to the 1.5 GB consensus activations.
+        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "1") or 1)
+
+        def match_from_feats(params, feat_a, feat_b):
+            corr, delta = ncnet_forward_from_features(
+                config, params, feat_a, feat_b, final_mutual=not fuse_mutual
+            )
+            if fuse_mutual:
+                return inloc_matches_from_consensus(
+                    corr, delta4d=delta, k_size=2, impl=extract_impl
+                )
+            return inloc_device_matches(
+                corr, delta4d=delta, k_size=2, impl=extract_impl
+            )
+
         @jax.jit
         def block(params, src, tgt_stack):
             feat_a = query_feats(params, src)
 
-            def body(acc, tgt):
-                m = step(params, feat_a, tgt[None])
+            def probe_of(m):
                 # Consume EVERY element of EVERY output array (the
                 # chain_reps rule, utils/profiling.py, strengthened to
                 # full sums): anything less lets XLA dead-code-eliminate
                 # part of the coordinate extraction (whole arrays, or the
                 # per-match delta decode behind a single-element probe).
-                probe = sum(jnp.sum(v.astype(jnp.float32)) for v in m)
-                return acc + probe, None
+                return sum(jnp.sum(v.astype(jnp.float32)) for v in m)
+
+            if bb > 1:
+                n = tgt_stack.shape[0]
+                nb = bb
+                while n % nb:  # largest divisor of the stack size <= bb
+                    nb -= 1
+                groups = tgt_stack.reshape(
+                    n // nb, nb, *tgt_stack.shape[1:]
+                )
+                feats_b = jax.lax.map(
+                    lambda g: extract_features(config, params, g), groups
+                )
+                feats_b = feats_b.reshape(n, 1, *feats_b.shape[2:])
+
+                def body_f(acc, feat_b):
+                    m = match_from_feats(params, feat_a, feat_b)
+                    return acc + probe_of(m), None
+
+                acc, _ = jax.lax.scan(body_f, jnp.float32(0), feats_b)
+                return acc
+
+            def body(acc, tgt):
+                m = step(params, feat_a, tgt[None])
+                return acc + probe_of(m), None
 
             acc, _ = jax.lax.scan(body, jnp.float32(0), tgt_stack)
             return acc

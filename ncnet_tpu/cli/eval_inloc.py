@@ -205,6 +205,26 @@ def main(argv=None):
     os.makedirs(out_dir, exist_ok=True)
     print(f"Output matches folder: {out_dir}")
 
+    # State the resolved geometry up front (ADVICE r2): the default
+    # feat_unit=16 buckets 3200x2400 px panos to 3072x2304 (features
+    # 192x144), which is NOT the reference's exact 200x150 feature grid —
+    # results are comparable to the reference pipeline only with
+    # --feat_unit 2. Printing the units here makes the choice auditable
+    # in every eval log.
+    units = resolve_feat_units(args.feat_unit, args.image_size, args.k_size,
+                               extra_align=args.spatial_shards)
+    example_h, example_w = inloc_resize_shape(
+        args.image_size, args.image_size * 3 // 4, args.image_size,
+        args.k_size, h_unit=units[0], w_unit=units[1],
+    )
+    print(
+        f"Resize buckets: feat units {units} (--feat_unit {args.feat_unit}; "
+        f"e.g. a {args.image_size}x{args.image_size * 3 // 4} pano -> "
+        f"{example_h}x{example_w} px, features ~{example_h // 16}x"
+        f"{example_w // 16}). Pass --feat_unit 2 to reproduce the "
+        "reference's exact feature dims."
+    )
+
     dbmat = loadmat(args.inloc_shortlist)
     db = dbmat["ImgList"][0, :]
     pano_fn_all = np.vstack([db[q][1] for q in range(len(db))])
@@ -253,10 +273,40 @@ def main(argv=None):
 
         pano_matches = jax.jit(pano_matches_one)
 
+        # Pano-backbone batching (NCNET_PANO_BACKBONE_BATCH=n, trace
+        # time): batch the group's backbones before the per-pano scan.
+        # Batch-1 backbone convs run at 12-16% MXU utilization (round-2
+        # trace); batching feeds the MXU while the scan keeps the
+        # HBM-bound corr/consensus tensors at batch-1 size. bench.py
+        # carries the same knob.
+        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "1") or 1)
+
         @jax.jit
         def pano_matches_batch(params, feat_a, tgt_stack):
             # lax.scan over a same-shape pano stack: the whole group is one
             # dispatch; outputs stack to [P, n] per match array.
+            if bb > 1:
+                n = tgt_stack.shape[0]
+                nb = bb
+                while n % nb:  # largest divisor of the group size <= bb
+                    nb -= 1
+                groups = tgt_stack.reshape(n // nb, nb, *tgt_stack.shape[1:])
+                feats_b = jax.lax.map(
+                    lambda g: extract_features(config, params, g), groups
+                )
+                feats_b = feats_b.reshape(n, 1, *feats_b.shape[2:])
+
+                def body_f(_, feat_b):
+                    corr, delta = ncnet_forward_from_features(
+                        config, params, feat_a, feat_b
+                    )
+                    return None, inloc_device_matches(
+                        corr, delta4d=delta, **match_kwargs
+                    )
+
+                _, ms = jax.lax.scan(body_f, None, feats_b)
+                return ms
+
             def body(_, tgt):
                 return None, pano_matches_one(params, feat_a, tgt[None])
 
@@ -304,10 +354,11 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
     """
     p = args.pano_batch
     n = len(pano_fns)
-    # Sliding decode window: at most p+1 loads in flight, so host memory
-    # stays bounded by the batch size (a long shortlist of 3200 px panos
-    # would otherwise pile up ~100 MB per decoded future) while decode
-    # still overlaps the device work of the previous stack.
+    # Sliding decode window: at most p+1 loads in flight. Decoded images
+    # ALSO accumulate in partially-filled shape buckets below, so the
+    # true host bound is the decode window plus the bucket cap (2p,
+    # enforced by the early flush in the loop): ~3p decoded panos total,
+    # regardless of how many distinct shapes interleave (ADVICE r2).
     window = p + 1
     futures = {
         i: pool.submit(load_pano, pano_fns[i]) for i in range(min(window, n))
@@ -347,6 +398,13 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
         if len(g) == p:
             dispatch(g[:])
             g.clear()
+        elif sum(len(gg) for gg in groups.values()) > 2 * p:
+            # Many interleaved shapes: flush the fullest partial bucket
+            # (a padded, smaller stack) rather than holding an unbounded
+            # number of decoded 3200 px panos across buckets.
+            big = max(groups.values(), key=len)
+            dispatch(big[:])
+            big.clear()
     for g in groups.values():
         if g:
             dispatch(g)

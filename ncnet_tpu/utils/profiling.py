@@ -163,16 +163,25 @@ def run_with_alarm(seconds: int, fn, *args, **kwargs):
     import signal
     import time as _time
 
-    def _handler(signum, frame):
-        raise AlarmTimeout(f"timed out after {seconds}s")
-
     start = _time.monotonic()
+
+    def _handler(signum, frame):
+        # Report the ACTUALLY-ARMED duration: an inner fence clamped to an
+        # outer fence's remaining time (or the 1 s floor) would otherwise
+        # claim its caller's full bound and mislead session-log analysis
+        # of which fence fired (ADVICE r2).
+        raise AlarmTimeout(
+            f"timed out after {armed}s"
+            + (f" (requested {seconds}s)" if armed != int(seconds) else "")
+        )
+
     old_handler = signal.signal(signal.SIGALRM, _handler)
     prev_remaining = signal.alarm(0)  # read + cancel any outer fence
     arm = int(seconds)
     if prev_remaining:
         arm = min(arm, prev_remaining)
-    signal.alarm(max(1, arm))
+    armed = max(1, arm)
+    signal.alarm(armed)
     try:
         return fn(*args, **kwargs)
     finally:

@@ -417,14 +417,23 @@ def test_neigh_consensus_strategies_env(rng, monkeypatch):
 
 
 @pytest.mark.parametrize(
-    "strategy", ["conv2d", "conv3d", "conv2d_stacked", "conv2d_outstacked"]
+    "strategy",
+    ["conv2d", "conv3d", "conv2d_stacked", "conv2d_outstacked",
+     pytest.param("convnd", marks=pytest.mark.slow)]
 )
 def test_conv4d_grad_parity_across_strategies(rng, strategy):
     """Gradients through every checkpointed decomposition match the dense
     einsum reference. Guards the jax.checkpoint AD-memory rework
     (ops/conv4d.py): a wrapping mistake would silently change training
     gradients (or re-introduce the 53 GB residual blow-up) and only
-    surface as wrong results on hardware."""
+    surface as wrong results on hardware.
+
+    'convnd' is best-effort like the forward test (ADVICE r2: it became
+    the training default for large-cin/cout layers with no AD coverage):
+    rank-4-spatial ConvGeneral gradients can fail to lower — or lower
+    pathologically slowly — on some backends (a tiny CPU grad probe ran
+    9+ min), so the case is fenced by a 90 s alarm and slow-marked; a
+    timeout or lowering error skips rather than failing the lane."""
     import jax
 
     from ncnet_tpu.ops.conv4d import conv4d, conv4d_reference
@@ -437,9 +446,21 @@ def test_conv4d_grad_parity_across_strategies(rng, strategy):
     def loss(fn):
         return lambda x_, w_, b_: jnp.sum(fn(x_, w_, b_) * cot)
 
-    gx, gw, gb = jax.grad(
+    grad_fn = jax.grad(
         loss(lambda *a: conv4d(*a, strategy=strategy)), argnums=(0, 1, 2)
-    )(x, w, b)
+    )
+    if strategy == "convnd":
+        from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
+
+        try:
+            gx, gw, gb = run_with_alarm(90, grad_fn, x, w, b)
+        except AlarmTimeout:
+            pytest.skip("convnd grad did not lower within 90s on this "
+                        "backend (known-variable ConvGeneral rank-4 support)")
+        except Exception as exc:  # noqa: BLE001
+            pytest.skip(f"convnd grad failed to lower here: {exc}")
+    else:
+        gx, gw, gb = grad_fn(x, w, b)
     rx, rw, rb = jax.grad(loss(conv4d_reference), argnums=(0, 1, 2))(x, w, b)
     np.testing.assert_allclose(gx, rx, atol=2e-4)
     np.testing.assert_allclose(gw, rw, atol=2e-4)

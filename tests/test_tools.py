@@ -93,3 +93,22 @@ def test_point_transfer_demo_cli(tmp_path):
     assert res.returncode == 0, res.stderr
     assert out.stat().st_size > 0
     assert "transferred 4 keypoints" in res.stdout
+
+
+@pytest.mark.slow
+def test_crosscheck_train_torch_agrees(tmp_path):
+    """The shipped JAX training stack (loss -> grads -> Adam) matches an
+    independent torch reimplementation step for step (VERDICT r2 item 5:
+    turns the loss-improves/PCK-degrades anomaly into a confirmed data
+    property). Runs the tool's own assertions at a tiny config; rc != 0
+    means a real gradient/optimizer divergence."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "crosscheck_train_torch.py"),
+         "--steps", "4", "--size", "32", "--n_pairs", "4", "--batch", "2",
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=600, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "FRAMEWORKS AGREE" in res.stderr

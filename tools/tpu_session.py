@@ -154,13 +154,20 @@ def main(argv=None):
         bench_runs = [
             ("default (nhwc)", {}),
             ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}),
-            ("nchw-backbone", {"NCNET_BACKBONE_NHWC": "0"}),
+            # Round-3: pano-backbone batching (trace shows batch-1
+            # backbone convs at 12-16% MXU util — NEXT.md round-3 note).
+            ("default+bb5", {"NCNET_PANO_BACKBONE_BATCH": "5"}),
+            ("default+bb10", {"NCNET_PANO_BACKBONE_BATCH": "10"}),
+            ("default+bb5+l1-pallas",
+             {"NCNET_PANO_BACKBONE_BATCH": "5",
+              "NCNET_CONSENSUS_L1_PALLAS": "1"}),
         ]
         for run_label, env in bench_runs:
             for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
                       "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD",
                       "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
-                      "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_L1_PALLAS"):
+                      "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_L1_PALLAS",
+                      "NCNET_PANO_BACKBONE_BATCH"):
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
