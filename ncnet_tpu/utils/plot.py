@@ -58,11 +58,17 @@ def plot_matches_horizontal(
     path: str | None,
     inliers: np.ndarray | None = None,
     denormalize: bool = False,
+    scores: np.ndarray | None = None,
 ):
     """Side-by-side pair with match lines (parity:
     lib_matlab/show_matches2_horizontal.m). points_*: [n, 2] pixels.
 
-    Saves to `path`; with path=None returns the figure (notebook use)."""
+    Line coloring: with `scores` ([n] floats), each line is colored by
+    its match score through the viridis colormap, min-max normalized
+    over the drawn set (the Matlab driver's plots likewise encode score
+    as line color); with `inliers` (and no scores), green/red; neither,
+    all green. Saves to `path`; with path=None returns the figure
+    (notebook use)."""
     if path is not None:
         _headless_matplotlib()
     import matplotlib.pyplot as plt
@@ -85,10 +91,19 @@ def plot_matches_horizontal(
     ax.set_axis_off()
     pa = np.asarray(points_a, dtype=np.float64)
     pb = np.asarray(points_b, dtype=np.float64)
-    inl = np.ones(pa.shape[0], dtype=bool) if inliers is None else np.asarray(inliers, dtype=bool)
+    if scores is not None:
+        s = np.asarray(scores, dtype=np.float64)
+        lo, hi = float(s.min()), float(s.max())
+        rel = (s - lo) / (hi - lo) if hi > lo else np.ones_like(s)
+        cmap = plt.get_cmap("viridis")
+        colors = [cmap(r) for r in rel]
+    else:
+        inl = (np.ones(pa.shape[0], dtype=bool) if inliers is None
+               else np.asarray(inliers, dtype=bool))
+        colors = ["g" if i else "r" for i in inl]
     for i in range(pa.shape[0]):
-        color = "g" if inl[i] else "r"
-        ax.plot([pa[i, 0], pb[i, 0] + off], [pa[i, 1], pb[i, 1]], color=color, linewidth=0.5)
+        ax.plot([pa[i, 0], pb[i, 0] + off], [pa[i, 1], pb[i, 1]],
+                color=colors[i], linewidth=0.5)
     ax.scatter(pa[:, 0], pa[:, 1], s=6, c="y")
     ax.scatter(pb[:, 0] + off, pb[:, 1], s=6, c="y")
     fig.tight_layout(pad=0)

@@ -112,3 +112,46 @@ def test_crosscheck_train_torch_agrees(tmp_path):
     )
     assert res.returncode == 0, res.stdout + res.stderr
     assert "FRAMEWORKS AGREE" in res.stderr
+
+
+def test_show_matches_renders_png(tmp_path):
+    """Match-pair visualization (parity: show_matches2_horizontal.m):
+    a driver-contract .mat renders to score-colored side-by-side PNGs."""
+    from PIL import Image
+    from ncnet_tpu.evals.inloc import (
+        fill_matches,
+        matches_buffer,
+        write_matches_mat,
+    )
+
+    rng = np.random.default_rng(0)
+    qdir = tmp_path / "q"; pdir = tmp_path / "p"
+    qdir.mkdir(); pdir.mkdir()
+    Image.fromarray(
+        rng.integers(0, 255, (60, 80, 3), dtype=np.uint8), "RGB"
+    ).save(qdir / "query.png")
+    for i in range(2):
+        Image.fromarray(
+            rng.integers(0, 255, (48, 64, 3), dtype=np.uint8), "RGB"
+        ).save(pdir / f"pano{i}.png")
+
+    buf = matches_buffer(2, 12)
+    for p in range(2):
+        n = 12
+        fill_matches(buf, p, (
+            rng.random(n), rng.random(n), rng.random(n), rng.random(n),
+            rng.random(n),
+        ))
+    mat = tmp_path / "query_1.mat"
+    write_matches_mat(str(mat), buf, "query.png",
+                      np.array([["pano0.png"], ["pano1.png"]], dtype=object))
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from show_matches import render_matches_mat
+
+    outs = render_matches_mat(str(mat), str(qdir), str(pdir),
+                              str(tmp_path / "viz"), top=8)
+    assert len(outs) == 2
+    for o in outs:
+        img = np.asarray(Image.open(o))
+        assert img.shape[0] > 0 and img.shape[1] > 0

@@ -1,0 +1,97 @@
+"""Render an InLoc driver .mat matches file as side-by-side match images.
+
+Parity: lib_matlab/show_matches2_horizontal.m + generate_ncnet_plot.m of
+the reference — the Matlab-side visualization of the dense matches the
+pipeline writes per query. Here it is a framework CLI over the same
+per-query `.mat` contract (`evals.inloc.write_matches_mat`:
+matches [1, n_panos, N, 5] with rows (xA, yA, xB, yB, score) in [0, 1]
+'positive' coordinates, query_fn, pano_fn): one PNG per pano, match
+lines colored by score (viridis), top-N by score.
+
+Usage:
+    python tools/show_matches.py matches/query_1.mat \
+        --query_root datasets/inloc/query/iphone7 \
+        --pano_root datasets/inloc/db_scans \
+        --out_dir viz --top 50 [--pano 0]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def load_image(path):
+    from PIL import Image
+
+    return np.asarray(Image.open(path).convert("RGB"))
+
+
+def render_matches_mat(mat_path, query_root, pano_root, out_dir, top=50,
+                       pano=None, min_score=0.0):
+    """Render PNGs for one per-query .mat; returns the written paths."""
+    from scipy.io import loadmat
+
+    from ncnet_tpu.utils.plot import plot_matches_horizontal
+
+    m = loadmat(mat_path)
+    matches = np.asarray(m["matches"])  # [1, n_panos, N, 5]
+    query_fn = str(np.ravel(m["query_fn"])[0])
+    pano_fns = [str(np.ravel(p)[0]) for p in np.ravel(m["pano_fn"])]
+
+    img_a = load_image(os.path.join(query_root, query_fn))
+    ha, wa = img_a.shape[:2]
+
+    os.makedirs(out_dir, exist_ok=True)
+    out_paths = []
+    panos = range(matches.shape[1]) if pano is None else [pano]
+    for p in panos:
+        rows = matches[0, p]
+        keep = rows[:, 4] > min_score
+        rows = rows[keep][:top]
+        if not len(rows):
+            continue
+        img_b = load_image(os.path.join(pano_root, pano_fns[p]))
+        hb, wb = img_b.shape[:2]
+        pa = np.stack([rows[:, 0] * wa, rows[:, 1] * ha], axis=1)
+        pb = np.stack([rows[:, 2] * wb, rows[:, 3] * hb], axis=1)
+        stem = os.path.splitext(os.path.basename(mat_path))[0]
+        out = os.path.join(out_dir, f"{stem}_pano{p:02d}.png")
+        plot_matches_horizontal(
+            img_a, img_b, pa, pb, out, scores=rows[:, 4]
+        )
+        out_paths.append(out)
+    return out_paths
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mat", help="per-query .mat from the InLoc driver")
+    ap.add_argument("--query_root", required=True)
+    ap.add_argument("--pano_root", required=True)
+    ap.add_argument("--out_dir", default="viz")
+    ap.add_argument("--top", type=int, default=50,
+                    help="draw at most this many highest-score matches")
+    ap.add_argument("--pano", type=int, default=None,
+                    help="render only this pano index (default: all)")
+    ap.add_argument("--min_score", type=float, default=0.0)
+    args = ap.parse_args(argv)
+
+    outs = render_matches_mat(
+        args.mat, args.query_root, args.pano_root, args.out_dir,
+        top=args.top, pano=args.pano, min_score=args.min_score,
+    )
+    for o in outs:
+        print(o)
+    if not outs:
+        print("no matches above --min_score; nothing rendered",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
