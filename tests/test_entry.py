@@ -10,6 +10,7 @@ import pytest
 import jax
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
     import __graft_entry__ as ge

@@ -172,7 +172,9 @@ def test_resume_skips_existing(fixture_dir):
         assert os.path.getmtime(exp_dir / f) == t
 
 
-@pytest.mark.parametrize("shards", [2, 4])
+@pytest.mark.parametrize(
+    "shards", [2, pytest.param(4, marks=pytest.mark.slow)]
+)
 def test_spatial_shards_cli(fixture_dir, shards):
     """--spatial_shards N runs the sharded forward on the CPU mesh and writes
     the same .mat layout (N=4 exercises the h_unit=N*k input bucketing)."""
@@ -196,6 +198,7 @@ def test_spatial_shards_cli(fixture_dir, shards):
     assert np.isfinite(m[0, 0]).all()
 
 
+@pytest.mark.slow
 def test_pano_batch_matches_unbatched(fixture_dir):
     """--pano_batch (scanned same-shape stacks, incl. ragged padding) writes
     the same .mat contents as the per-pano dispatch path."""
@@ -242,6 +245,7 @@ def test_pano_batch_matches_unbatched(fixture_dir):
         )
 
 
+@pytest.mark.slow
 def test_pano_batch_mixed_shapes(tmp_path):
     """Batched pano mode with HETEROGENEOUS pano shapes: the incremental
     grouper must split same-bucket stacks correctly (portrait + landscape
