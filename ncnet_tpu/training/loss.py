@@ -12,6 +12,8 @@ graph. The mean-of-max reductions fuse into the correlation pipeline.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -89,7 +91,24 @@ def weak_loss_from_features(match_fn, feat_a, feat_b, normalization: str = "soft
     def direction_score(fa, fb):
         return pair_match_score(match_fn(fa, fb), normalization)
 
-    direction_score = jax.checkpoint(direction_score)
+    # NCNET_TRAIN_REMAT_POLICY (trace time) tunes the memory/recompute
+    # trade of this checkpoint — the round-2 campaign made the train step
+    # FIT (20 GB) but left it recompute-heavy (7.8 s/step at batch 16;
+    # docs/NEXT.md round-3 item 4):
+    #   "full" (default) save nothing, recompute each direction's pipeline;
+    #   "dots"           save MXU contraction results inside the pipeline
+    #                    (jax.checkpoint_policies.checkpoint_dots);
+    #   "none"           no checkpoint — both directions' activations live
+    #                    through the backward (fastest when they fit).
+    policy = os.environ.get("NCNET_TRAIN_REMAT_POLICY", "full")
+    if policy == "none":
+        pass
+    elif policy == "dots":
+        direction_score = jax.checkpoint(
+            direction_score, policy=jax.checkpoint_policies.checkpoint_dots
+        )
+    else:
+        direction_score = jax.checkpoint(direction_score)
     score_pos = direction_score(feat_a, feat_b)
     # Under a dp-sharded batch the roll lowers to a collective permute of
     # the (small) feature tensors over ICI.

@@ -25,8 +25,17 @@ devs = np.asarray(jax.devices())
 assert devs.size == 4, devs
 mesh = Mesh(devs, ("sp",))
 
-params = neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (4, 1))
-corr = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 8, 5, 6, 7), jnp.float32)
+# Optional argv[3]: "iA,jA,iB,jB,c_mid" overrides the tiny default — the
+# real-pooled-extent variant (96-row sharded axis, 16-channel consensus)
+# runs the SAME probe at production geometry (VERDICT r2 item 6).
+if len(sys.argv) > 3:
+    ia, ja, ib, jb, c_mid = (int(v) for v in sys.argv[3].split(","))
+else:
+    ia, ja, ib, jb, c_mid = 8, 5, 6, 7, 4
+params = neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (c_mid, 1))
+corr = jax.random.normal(
+    jax.random.PRNGKey(1), (1, 1, ia, ja, ib, jb), jnp.float32
+)
 
 ref = mutual_matching(
     neigh_consensus_apply(params, mutual_matching(corr), symmetric=True)

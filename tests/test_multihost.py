@@ -154,3 +154,26 @@ def test_two_process_train(tmp_path):
     assert not os.path.exists(tmp_path / "models_h1") or not os.listdir(
         tmp_path / "models_h1"
     )
+
+
+@pytest.mark.slow
+def test_two_process_sharded_consensus_real_extent():
+    """The cross-process halo-exchange consensus at the production sharded
+    extent: iA=96 rows over a 4-way mesh spanning two processes, with the
+    real 16-channel consensus (VERDICT r2 item 6's multihost variant).
+    The B plane is halved (48x36) to keep two CPU processes feasible —
+    the sharded axis and channel geometry are the production values."""
+    port = _free_port()
+    probe = os.path.join(REPO, "tests", "_mh_sharded_probe.py")
+    shape = "96,72,48,36,16"
+    procs, outs = _run_pair(
+        [
+            ([sys.executable, probe, f"localhost:{port}", str(pid), shape],
+             _proc_env())
+            for pid in range(2)
+        ],
+        timeout=560,
+    )
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"probe failed:\n{out}"
+        assert "cross-host sharded consensus OK" in out

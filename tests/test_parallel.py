@@ -262,3 +262,53 @@ def test_multihost_helpers_single_host():
     assert multihost.process_count() == 1
     start, stop = multihost.host_local_slice(16)
     assert (start, stop) == (0, 16)
+
+
+@requires_multi
+@pytest.mark.slow
+def test_sharded_inloc_forward_real_pooled_shape_parity():
+    """Sharded InLoc forward at the REAL rectangular pooled class (96x72):
+    features 192x144 -> k=2 pooled corr [1,1,96,72,96,72] with the real
+    16-channel consensus, on the full 8-way CPU mesh (VERDICT r2 item 6 —
+    the round-2 coverage stopped at tiny square vgg-pool3 shapes).
+
+    The backbone is vgg-pool1 (stride 2) so a 384x288 input lands exactly
+    on the 192x144 feature grid the single-chip InLoc path uses at its
+    3072x2304 bucket with resnet stride 16 — the SHARDED code under test
+    (per-shard fused corr+pool, halo-exchange consensus, pmax mutual) sees
+    the production tensor geometry at a CPU-feasible backbone cost.
+    f32 end to end: bf16 is emulated (slow) on CPU and the parity
+    tolerance would hide nothing extra."""
+    import jax
+    import numpy as np
+
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import ncnet_forward
+    from ncnet_tpu.parallel import make_sharded_inloc_forward
+
+    n = len(jax.devices())
+    assert n == 8, "conftest forces 8 virtual CPU devices"
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool1"),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=2,
+        use_fused_corr_pool=True,
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    # pool1 => stride 2: 384x288 px -> features 192x144 (iA=192 divisible
+    # by n*k=16), pooled 96x72 — the production rectangular class.
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    src = jax.random.normal(k1, (1, 3, 384, 288))
+    tgt = jax.random.normal(k2, (1, 3, 384, 288))
+
+    ref_corr, ref_deltas = ncnet_forward(config, params, src, tgt)
+
+    mesh = make_mesh((n,), ("sp",))
+    fwd = make_sharded_inloc_forward(config, mesh)
+    corr, deltas = fwd(params, src, tgt)
+
+    np.testing.assert_allclose(
+        np.asarray(corr), np.asarray(ref_corr), atol=2e-5, rtol=1e-4
+    )
+    np.testing.assert_array_equal(np.asarray(deltas), np.asarray(ref_deltas))

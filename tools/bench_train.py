@@ -31,6 +31,14 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--backbone", type=str, default="resnet101")
     p.add_argument("--remat", action="store_true")
+    p.add_argument(
+        "--policies", type=str, default="",
+        help="comma-separated NCNET_TRAIN_REMAT_POLICY sweep (e.g. "
+        "'full,dots,none'); one JSON line per policy, each fenced so a "
+        "pathological compile can't starve the rest (round-3 item 4: "
+        "7.8 s/step is recompute-heavy, the policy trade is untried on "
+        "hardware). Empty = single run with the inherited env.",
+    )
     p.add_argument("--dial_timeout", type=float, default=600.0)
     args = p.parse_args(argv)
 
@@ -69,9 +77,6 @@ def main(argv=None):
         ncons_channels=(16, 16, 1),
     )
     params = ncnet_init(jax.random.PRNGKey(0), config)
-    state, tx = create_train_state(params)
-    state = replicate_state(state, mesh)
-    train_step, _ = make_train_step(config, tx, remat_backbone=args.remat)
 
     key = jax.random.PRNGKey(1)
     k1, k2 = jax.random.split(key)
@@ -84,34 +89,61 @@ def main(argv=None):
         mesh,
     )
 
-    trainable, opt_state = state.trainable, state.opt_state
-    trainable, opt_state, loss = train_step(  # compile + warmup
-        trainable, state.frozen, opt_state,
-        batch["source_image"], batch["target_image"],
-    )
-    jax.block_until_ready(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        trainable, opt_state, loss = train_step(
+    def measure(policy_label):
+        # Fresh param buffers per run: train_step donates trainable/opt
+        # state, so a shared init pytree would be deleted after the first
+        # policy's run.
+        state, tx = create_train_state(jax.tree.map(jnp.array, params))
+        state = replicate_state(state, mesh)
+        train_step, _ = make_train_step(config, tx, remat_backbone=args.remat)
+        trainable, opt_state = state.trainable, state.opt_state
+        trainable, opt_state, loss = train_step(  # compile + warmup
             trainable, state.frozen, opt_state,
             batch["source_image"], batch["target_image"],
         )
-        float(loss)  # per-step sync: the loss fetch closes the iteration
-    dt = (time.perf_counter() - t0) / args.iters
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            trainable, opt_state, loss = train_step(
+                trainable, state.frozen, opt_state,
+                batch["source_image"], batch["target_image"],
+            )
+            float(loss)  # per-step sync: the fetch closes the iteration
+        dt = (time.perf_counter() - t0) / args.iters
+        line = {
+            "metric": "train_step_pairs_per_s",
+            "value": round(args.batch / dt, 3),
+            "unit": "pairs/s",
+            "devices": dp,
+            "batch": args.batch,
+            "step_ms": round(dt * 1e3, 2),
+        }
+        if policy_label is not None:
+            line["remat_policy"] = policy_label
+        print(json.dumps(line), flush=True)
 
-    print(
-        json.dumps(
-            {
-                "metric": "train_step_pairs_per_s",
-                "value": round(args.batch / dt, 3),
-                "unit": "pairs/s",
-                "devices": dp,
-                "batch": args.batch,
-                "step_ms": round(dt * 1e3, 2),
-            }
-        )
-    )
+    if not args.policies:
+        measure(None)
+        return
+    from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
+
+    for policy in args.policies.split(","):
+        policy = policy.strip()
+        os.environ["NCNET_TRAIN_REMAT_POLICY"] = policy
+        try:
+            # 10 min per policy: an OOMing or pathologically-compiling
+            # variant must not starve the sweep.
+            run_with_alarm(600, measure, policy)
+        except AlarmTimeout:
+            print(json.dumps({"metric": "train_step_pairs_per_s",
+                              "remat_policy": policy, "timeout": True}),
+                  flush=True)
+        except Exception as exc:  # noqa: BLE001 — OOM is a data point
+            print(json.dumps({"metric": "train_step_pairs_per_s",
+                              "remat_policy": policy,
+                              "error": str(exc)[:200]}), flush=True)
+        finally:
+            os.environ.pop("NCNET_TRAIN_REMAT_POLICY", None)
 
 
 if __name__ == "__main__":

@@ -89,7 +89,9 @@ def main(argv=None):
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("conv4d", "bench_conv4d",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
-        ("train", "bench_train", ["--dial_timeout", "120", "--iters", "4"]),
+        ("train", "bench_train",
+         ["--dial_timeout", "120", "--iters", "4",
+          "--policies", "full,dots,none"]),
     ]
     from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
 
