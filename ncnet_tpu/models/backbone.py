@@ -276,9 +276,76 @@ def _bottleneck_apply(p: Params, x, stride: int):
     return jax.nn.relu(out + x)
 
 
+def _fold_conv1_weight(w):
+    """[7, 7, cin, cout] stride-2 kernel -> [4, 4, 4*cin, cout] stride-1.
+
+    Space-to-depth fold: kernel tap a maps to folded tap
+    sa = floor((a-3)/2) + 2 at input phase pa = (a-3) mod 2, with the
+    folded channel index c*4 + pa*2 + pb matching _space_to_depth_2x2's
+    channel packing. Unmapped (sa, phase) combinations stay zero.
+    """
+    kh, kw, cin, cout = w.shape
+    wf = jnp.zeros((4, 4, 4 * cin, cout), w.dtype)
+    for a in range(kh):
+        sa, pa = divmod(a + 1, 2)  # == (floor((a-3)/2)+2, (a-3) mod 2)
+        for b in range(kw):
+            sb, pb = divmod(b + 1, 2)
+            idx = jnp.arange(cin) * 4 + pa * 2 + pb
+            wf = wf.at[sa, sb, idx].set(w[a, b])
+    return wf
+
+
+def _space_to_depth_2x2(x):
+    """[B,C,H,W] (or NHWC in a _channels_last scope) -> 2x2-folded, 4C."""
+    if _CHANNELS_LAST:
+        b, h, w, c = x.shape
+        x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        return jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(
+            b, h // 2, w // 2, 4 * c
+        )
+    b, c, h, w = x.shape
+    x = x.reshape(b, c, h // 2, 2, w // 2, 2)
+    return jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(
+        b, 4 * c, h // 2, w // 2
+    )
+
+
+def _conv1_apply(params, x):
+    """ResNet stem conv (7x7 stride 2 pad 3), optionally input-folded.
+
+    NCNET_BACKBONE_CONV1_FOLD=1 (trace time) runs the space-to-depth
+    formulation: the round-2 device trace shows the unfolded stem at 2%
+    MXU utilization, 31 GB/s (8.9 ms/pano at InLoc shape) — a cin=3
+    convolution can't feed the 128-lane MXU. Folding quadruples cin and
+    turns the kernel into a dense 4x4 stride-1 stencil. Bit-parity is
+    not exact (different contraction order); tests pin 1e-5.
+    """
+    w = params["conv1"]
+    h, wd = (x.shape[1], x.shape[2]) if _CHANNELS_LAST else (
+        x.shape[2], x.shape[3]
+    )
+    fold = (
+        os.environ.get("NCNET_BACKBONE_CONV1_FOLD", "0") == "1"
+        and w.shape[0] == 7 and w.shape[1] == 7
+        and h % 2 == 0 and wd % 2 == 0
+    )
+    if not fold:
+        return conv2d(x, w, stride=2, padding=3)
+    xf = _space_to_depth_2x2(x)
+    dims = (("NHWC", "HWIO", "NHWC") if _CHANNELS_LAST
+            else ("NCHW", "HWIO", "NCHW"))
+    return lax.conv_general_dilated(
+        xf,
+        _fold_conv1_weight(w).astype(xf.dtype),
+        window_strides=(1, 1),
+        padding=((2, 1), (2, 1)),
+        dimension_numbers=dims,
+    )
+
+
 def resnet_stages(config: BackboneConfig, params: Params, x):
     """Truncated-ResNet forward returning every stage output (layer1..N)."""
-    x = jax.nn.relu(frozen_bn(conv2d(x, params["conv1"], stride=2, padding=3), params["bn1"]))
+    x = jax.nn.relu(frozen_bn(_conv1_apply(params, x), params["bn1"]))
     x = max_pool(x, 3, 2, 1)
     outs = []
     for stage, strides in enumerate(_stage_strides(config)):

@@ -213,3 +213,44 @@ def test_resnet_nhwc_internal_layout_parity(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
     )
+
+
+def test_conv1_fold_parity(monkeypatch):
+    """NCNET_BACKBONE_CONV1_FOLD's space-to-depth stem == the plain 7x7
+    stride-2 conv (both layouts): the fold quadruples cin for the MXU
+    (round-2 trace: unfolded stem at 2% utilization)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ncnet_tpu.models import backbone as bb
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((7, 7, 3, 8)).astype(np.float32))
+    params = {"conv1": w}
+    x = jnp.asarray(rng.standard_normal((2, 3, 20, 16)).astype(np.float32))
+
+    ref = bb.conv2d(x, w, stride=2, padding=3)
+    monkeypatch.setenv("NCNET_BACKBONE_CONV1_FOLD", "1")
+    out = bb._conv1_apply(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    # Channels-last scope (the NHWC-internal default path).
+    x_cl = jnp.transpose(x, (0, 2, 3, 1))
+    with bb._channels_last(True):
+        out_cl = bb._conv1_apply(params, x_cl)
+        ref_cl = bb.conv2d(x_cl, w, stride=2, padding=3)
+    np.testing.assert_allclose(np.asarray(out_cl), np.asarray(ref_cl),
+                               atol=1e-5, rtol=1e-5)
+
+    # Odd spatial dims fall back to the plain conv rather than mis-folding.
+    x_odd = jnp.asarray(
+        rng.standard_normal((1, 3, 19, 16)).astype(np.float32)
+    )
+    out_odd = bb._conv1_apply(params, x_odd)
+    np.testing.assert_allclose(
+        np.asarray(out_odd),
+        np.asarray(bb.conv2d(x_odd, w, stride=2, padding=3)),
+        atol=1e-6,
+    )

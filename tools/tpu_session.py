@@ -163,13 +163,17 @@ def main(argv=None):
             ("default+bb5+l1-pallas",
              {"NCNET_PANO_BACKBONE_BATCH": "5",
               "NCNET_CONSENSUS_L1_PALLAS": "1"}),
+            ("default+bb5+conv1fold",
+             {"NCNET_PANO_BACKBONE_BATCH": "5",
+              "NCNET_BACKBONE_CONV1_FOLD": "1"}),
         ]
         for run_label, env in bench_runs:
             for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
                       "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD",
                       "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
                       "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_L1_PALLAS",
-                      "NCNET_PANO_BACKBONE_BATCH"):
+                      "NCNET_PANO_BACKBONE_BATCH",
+                      "NCNET_BACKBONE_CONV1_FOLD"):
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
