@@ -14,6 +14,7 @@ XLA inserts the gradient allreduce over ICI.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import time
 
@@ -57,6 +58,17 @@ def main(argv=None):
     parser.add_argument("--num_workers", type=int, default=8)
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument("--log_interval", type=int, default=1)
+    # Preemption story (SURVEY §5): --save_interval N writes a rolling
+    # mid-epoch checkpoint (tag "step") every N steps; --resume continues
+    # a --checkpoint run from its recorded (epoch, step) instead of from
+    # epoch 1 — the loader's per-epoch shuffle is a pure function of
+    # (seed, epoch), so the exact batch schedule replays and the first
+    # `step` batches of the resumed epoch are skipped.
+    parser.add_argument("--save_interval", type=int, default=0,
+                        help="steps between rolling mid-epoch checkpoints "
+                        "(0 = per-epoch only)")
+    parser.add_argument("--resume", action="store_true", default=False,
+                        help="resume epoch/step position from --checkpoint")
     parser.add_argument(
         "--profile_dir", type=str, default="",
         help="capture a jax.profiler trace of the run for TensorBoard/Perfetto",
@@ -209,37 +221,69 @@ def main(argv=None):
         except FileExistsError:
             suffix += 1
 
+    # --resume: continue from the checkpoint's recorded position. A
+    # mid-epoch ("step") checkpoint carries step_in_epoch; a per-epoch one
+    # means that epoch COMPLETED, so resumption starts at the next.
+    start_epoch, skip_steps = 1, 0
+    if args.resume:
+        if not (args.checkpoint and os.path.isdir(args.checkpoint)):
+            raise SystemExit("--resume requires --checkpoint <dir>")
+        with open(os.path.join(args.checkpoint, "meta.json")) as f:
+            meta = json.load(f)
+        if "step_in_epoch" in meta:
+            start_epoch = int(meta["epoch"])
+            skip_steps = int(meta["step_in_epoch"])
+        else:
+            start_epoch = int(meta["epoch"]) + 1
+        print(f"resuming at epoch {start_epoch}, step {skip_steps}")
+
     from ..utils.profiling import trace_context
 
     with trace_context(args.profile_dir):
         _epoch_loop(args, config, state, train_step, eval_step, loader,
-                    loader_val, put, ckpt_dir)
+                    loader_val, put, ckpt_dir, start_epoch=start_epoch,
+                    skip_steps=skip_steps)
     print("Done!")
 
 
 def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
-                put_batch, ckpt_dir):
+                put_batch, ckpt_dir, start_epoch: int = 1,
+                skip_steps: int = 0):
     from ..data.loader import device_prefetch
 
     best_val = float("inf")
     train_losses, val_losses = [], []
     trainable, opt_state = state.trainable, state.opt_state
+    # Fast-forward the loader's epoch counter so epoch E shuffles with
+    # RandomState(seed + E - 1) exactly as the original run did.
+    loader.set_epoch(start_epoch - 1)
 
     def put(batch):
         return put_batch(
             {k: batch[k] for k in ("source_image", "target_image")}
         )
 
-    for epoch in range(1, args.num_epochs + 1):
+    for epoch in range(start_epoch, args.num_epochs + 1):
         t0 = time.time()
         losses = []
+        # Resumed epoch: replay the deterministic schedule; the
+        # generator drops already-trained batches before the device
+        # transfer (the loader still decodes them, backpressured by
+        # its prefetch queue — minutes at worst for a full epoch).
+        skip = skip_steps if epoch == start_epoch else 0
+
+        def resumed(it=loader, skip=skip):
+            for j, b in enumerate(it):
+                if j >= skip:
+                    yield b
+
         # One batch in flight: H2D transfer of batch i+1 overlaps step i.
         # Losses stay DEVICE scalars inside the loop — float() would force a
         # full sync every step, serializing dispatch; on a tunneled backend
         # that costs a round trip per batch. The sync happens only at log
         # points (per batch at the default --log_interval 1, matching the
         # reference's per-batch print; raise it to unlock async dispatch).
-        for i, batch in enumerate(device_prefetch(loader, put)):
+        for i, batch in enumerate(device_prefetch(resumed(), put), start=skip):
             trainable, opt_state, loss = train_step(
                 trainable, state.frozen, opt_state,
                 batch["source_image"], batch["target_image"],
@@ -252,6 +296,23 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                     flush=True,
                 )
             losses.append(loss)
+            if (
+                args.save_interval
+                and (i + 1) % args.save_interval == 0
+                and multihost.process_index() == 0
+            ):
+                full_params = {
+                    "backbone": trainable.get(
+                        "backbone", state.frozen["backbone"]
+                    ),
+                    "neigh_consensus": trainable["neigh_consensus"],
+                }
+                save_checkpoint(
+                    ckpt_dir, full_params, config, epoch,
+                    opt_state=opt_state,
+                    extra={"step_in_epoch": i + 1, "args": vars(args)},
+                    tag="step",
+                )
         train_loss = (
             float(np.mean([float(l) for l in losses])) if losses else 0.0
         )

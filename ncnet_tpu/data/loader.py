@@ -82,6 +82,12 @@ class DataLoader:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
+    def set_epoch(self, epoch: int) -> None:
+        """Position the shuffle schedule: the NEXT iteration shuffles
+        with RandomState(seed + epoch) — mid-epoch training resume
+        (cli/train.py --resume) replays an exact batch order."""
+        self._epoch = epoch
+
     def _batch_indices(self):
         idx = np.arange(len(self.dataset))
         if self.shuffle:

@@ -89,10 +89,21 @@ def save_checkpoint(
     opt_state=None,
     extra: Optional[dict] = None,
     is_best: bool = False,
+    tag: Optional[str] = None,
 ):
-    """Write params + config (+ opt state, metrics) under `directory/epoch_N`."""
+    """Write params + config (+ opt state, metrics) under `directory/epoch_N`.
+
+    `tag` overrides the directory name — the mid-epoch preemption
+    checkpoints use the rolling tag "step" (written fresh to "step.tmp"
+    and swapped in, so a kill mid-write leaves the previous complete
+    "step" dir or a complete "step.tmp"; cli/train.py's resume checks
+    both)."""
     os.makedirs(directory, exist_ok=True)
-    tag = os.path.join(directory, f"epoch_{epoch}")
+    rolling = tag is not None
+    final_tag = os.path.join(directory, tag if rolling else f"epoch_{epoch}")
+    tag = final_tag + ".tmp" if rolling else final_tag
+    if rolling and os.path.exists(tag):
+        shutil.rmtree(tag)
     os.makedirs(tag, exist_ok=True)
     _save_tree(jax.tree.map(np.asarray, params), os.path.join(tag, "params.npz"))
     if opt_state is not None:
@@ -106,6 +117,11 @@ def save_checkpoint(
     meta = {"config": _config_to_dict(config), "epoch": epoch, **(extra or {})}
     with open(os.path.join(tag, "meta.json"), "w") as f:
         json.dump(meta, f, indent=2, default=float)
+    if rolling:
+        if os.path.exists(final_tag):
+            shutil.rmtree(final_tag)
+        os.replace(tag, final_tag)
+        tag = final_tag
     if is_best:
         best = os.path.join(directory, "best")
         if os.path.exists(best):

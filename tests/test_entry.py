@@ -77,3 +77,71 @@ def test_train_cli_synthetic(tmp_path):
     )
     assert per_pair.shape == (4,)
     assert 0.0 <= mean_pck <= 1.0
+
+
+def test_train_cli_mid_epoch_resume(tmp_path):
+    """--save_interval writes a rolling mid-epoch 'step' checkpoint and
+    --resume continues from its recorded (epoch, step) — the preemption
+    story of SURVEY §5 (round-2 partial #49)."""
+    from tests.test_evals_data import _write_synthetic_dataset
+    from ncnet_tpu.cli import train as train_cli
+
+    root = str(tmp_path)
+    _write_synthetic_dataset(root, n_pairs=4, size=48)
+    csv_dir = os.path.join(root, "csv")
+    os.makedirs(csv_dir)
+    import shutil
+
+    shutil.copy(os.path.join(root, "train.csv"),
+                os.path.join(csv_dir, "train_pairs.csv"))
+    shutil.copy(os.path.join(root, "train.csv"),
+                os.path.join(csv_dir, "val_pairs.csv"))
+
+    common = [
+        "--dataset_image_path", root,
+        "--dataset_csv_path", csv_dir,
+        "--batch_size", "2",
+        "--image_size", "48",
+        "--backbone", "vgg",
+        "--ncons_kernel_sizes", "3",
+        "--ncons_channels", "1",
+        "--num_workers", "2",
+    ]
+    models_a = os.path.join(root, "models_a")
+    train_cli.main(common + [
+        "--num_epochs", "1", "--save_interval", "1",
+        "--result_model_dir", models_a,
+    ])
+    run_a = os.path.join(models_a, os.listdir(models_a)[0])
+    assert "step" in os.listdir(run_a)
+    import json as _json
+
+    with open(os.path.join(run_a, "step", "meta.json")) as f:
+        meta = _json.load(f)
+    # 4 pairs / batch 2 = 2 steps; the rolling tag holds the LAST save.
+    assert meta["epoch"] == 1 and meta["step_in_epoch"] == 2
+    assert os.path.exists(os.path.join(run_a, "step", "opt_state.npz"))
+
+    # Resume from the mid-epoch checkpoint: continues inside epoch 1
+    # (skipping its 2 trained steps) and trains epoch 2 normally.
+    models_b = os.path.join(root, "models_b")
+    train_cli.main(common + [
+        "--num_epochs", "2",
+        "--checkpoint", os.path.join(run_a, "step"),
+        "--resume",
+        "--result_model_dir", models_b,
+    ])
+    run_b = os.path.join(models_b, os.listdir(models_b)[0])
+    assert "epoch_2" in os.listdir(run_b)
+
+    # Resume from a completed-epoch checkpoint: starts at the NEXT epoch.
+    models_c = os.path.join(root, "models_c")
+    train_cli.main(common + [
+        "--num_epochs", "2",
+        "--checkpoint", os.path.join(run_a, "epoch_1"),
+        "--resume",
+        "--result_model_dir", models_c,
+    ])
+    run_c = os.path.join(models_c, os.listdir(models_c)[0])
+    listing = os.listdir(run_c)
+    assert "epoch_2" in listing and "epoch_1" not in listing
