@@ -18,7 +18,19 @@ for n in $(seq 1 80); do
       > "$OUT/session_$(date -u +%H%M).log" 2>&1
     rc=$?
     echo "=== session rc=$rc $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
-    [ "$rc" -eq 0 ] && exit 0
+    if [ "$rc" -eq 0 ]; then
+      # Trimmed session landed — spend the rest of the tunnel window on
+      # the FULL measurement session (smoke already done; bench ran in
+      # the trimmed pass, so re-running it last refreshes bench_last
+      # with any defaults the phases inform).
+      if [ "$#" -gt 0 ]; then
+        echo "=== chaining full session $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
+        timeout 7200 python tools/tpu_session.py --dial_timeout 300 --skip smoke \
+          > "$OUT/session_full_$(date -u +%H%M).log" 2>&1
+        echo "=== full session rc=$? $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
+      fi
+      exit 0
+    fi
   fi
   sleep 300
 done
