@@ -46,6 +46,28 @@ def build_model(
             "written by ncnet_tpu.training.checkpoint or a reference "
             ".pth.tar file)"
         )
+    def check_consensus_arch(config, source: str):
+        # Validate the RESOLVED architecture (a checkpoint's stored
+        # hyper-parameters override the CLI args, so raw-arg validation
+        # would both reject ignored args and miss a bad checkpoint). The
+        # consensus stack must map back to a single-channel corr tensor
+        # (lib/model.py:122-141 always ends at 1); anything else fails
+        # much later as an opaque reshape error inside the loss or
+        # extraction.
+        ks, ch = config.ncons_kernel_sizes, config.ncons_channels
+        if len(ks) != len(ch):
+            raise SystemExit(
+                f"{source}: ncons_kernel_sizes ({len(ks)} entries) and "
+                f"ncons_channels ({len(ch)}) must be equal length"
+            )
+        if ch and ch[-1] != 1:
+            raise SystemExit(
+                f"{source}: ncons_channels must end at 1 (got {tuple(ch)}):"
+                " the consensus output is consumed as a single-channel 4-D"
+                " correlation tensor"
+            )
+        return config
+
     if checkpoint and os.path.isdir(checkpoint):
         restored = load_checkpoint(checkpoint)
         config = restored["config"]
@@ -54,6 +76,7 @@ def build_model(
             relocalization_k_size=relocalization_k_size,
             half_precision=half_precision,
         )
+        config = check_consensus_arch(config, f"checkpoint {checkpoint!r}")
         return _with_backbone_dtype(config, backbone_bf16), restored["params"]
     if checkpoint:  # .pth.tar
         params, arch = load_reference_checkpoint(checkpoint)
@@ -64,6 +87,7 @@ def build_model(
             relocalization_k_size=relocalization_k_size,
             half_precision=half_precision,
         )
+        config = check_consensus_arch(config, f"checkpoint {checkpoint!r}")
         return _with_backbone_dtype(config, backbone_bf16), params
     config = NCNetConfig(
         backbone=BackboneConfig(cnn=backbone_cnn),
@@ -72,6 +96,7 @@ def build_model(
         relocalization_k_size=relocalization_k_size,
         half_precision=half_precision,
     )
+    config = check_consensus_arch(config, "CLI args")
     config = _with_backbone_dtype(config, backbone_bf16)
     params = ncnet_init(jax.random.PRNGKey(seed), config)
     return config, params

@@ -685,7 +685,8 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies):
         za_f, zb_f = consensus_l1_pallas(
             params[0]["weight"], params[0]["bias"], corr,
             symmetric=symmetric,
-            interpret=os.environ["NCNET_CONSENSUS_L1_PALLAS"] == "interpret",
+            interpret=os.environ.get("NCNET_CONSENSUS_L1_PALLAS")
+            == "interpret",
         )
 
         def finish(z_f, swap):
