@@ -153,12 +153,15 @@ def main(argv=None):
         # and recorded in docs/NEXT.md; re-running them burns flaky
         # remote-compile budget (the 08:03 session lost two bench lines
         # to >25 min compiles).
+        # Ordered by information value: if the tunnel dies mid-matrix we
+        # want baseline -> the round-3 backbone-batching hypothesis ->
+        # the l1-pallas verdict, in that order.
         bench_runs = [
             ("default (nhwc)", {}),
-            ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}),
             # Round-3: pano-backbone batching (trace shows batch-1
             # backbone convs at 12-16% MXU util — NEXT.md round-3 note).
             ("default+bb5", {"NCNET_PANO_BACKBONE_BATCH": "5"}),
+            ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}),
             ("default+bb10", {"NCNET_PANO_BACKBONE_BATCH": "10"}),
             ("default+bb5+l1-pallas",
              {"NCNET_PANO_BACKBONE_BATCH": "5",
