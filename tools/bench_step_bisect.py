@@ -9,6 +9,14 @@ knocked out at a time, all variants chained inside one jit. The
 difference between adjacent variants is that stage's true in-step cost,
 with all cross-stage fusion effects included.
 
+CAVEAT (round 3): differential attribution is DCE-skewed. Knocking out
+a stage lets XLA dead-code-eliminate upstream work feeding only that
+stage — the round-2 bisect charged ~68 ms to corr+pool that the device
+trace shows was mostly backbone convs disappearing with it (the kernel
+itself is ~10 ms in-step; see docs/NEXT.md round-3 trace attribution).
+Treat adjacent-variant deltas as UPPER bounds on a stage; use
+tools/trace_step.py + tools/trace_optable.py as ground truth.
+
 Variants (each includes everything above it):
   feats-only      pano backbone + feature norm
   +corr+pool      fused correlation + maxpool (packed deltas)
