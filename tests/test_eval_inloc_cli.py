@@ -199,13 +199,18 @@ def test_spatial_shards_cli(fixture_dir, shards):
 
 
 @pytest.mark.slow
-def test_pano_batch_matches_unbatched(fixture_dir):
+@pytest.mark.parametrize("backbone_batch", ["1", "2"])
+def test_pano_batch_matches_unbatched(fixture_dir, backbone_batch,
+                                      monkeypatch):
     """--pano_batch (scanned same-shape stacks, incl. ragged padding) writes
     the same .mat contents as the per-pano dispatch path."""
     from scipy.io import loadmat
 
     ref_dir = _run(fixture_dir)
-    out_b = fixture_dir / "matches_batched"
+    # backbone_batch="2" covers the NCNET_PANO_BACKBONE_BATCH path:
+    # group backbones run batched before the per-pano scan.
+    monkeypatch.setenv("NCNET_PANO_BACKBONE_BATCH", backbone_batch)
+    out_b = fixture_dir / ("matches_batched" + backbone_batch)
     eval_inloc.main(
         [
             "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
