@@ -28,6 +28,7 @@ from ..training import (
     create_train_state,
     load_opt_state,
     make_train_step,
+    resolve_resume_dir,
     save_checkpoint,
     shard_batch,
     replicate_state,
@@ -74,6 +75,21 @@ def main(argv=None):
         help="capture a jax.profiler trace of the run for TensorBoard/Perfetto",
     )
     args = parser.parse_args(argv)
+
+    # --resume must tolerate a preemption INSIDE save_checkpoint's
+    # rename-aside swap: the complete checkpoint may sit at the sibling
+    # step.tmp / step.old instead of the dir the user named. Resolve
+    # before ANY use of args.checkpoint (build_model reads it first).
+    if args.resume and args.checkpoint:
+        resolved = resolve_resume_dir(args.checkpoint)
+        if resolved is None:
+            raise SystemExit(
+                f"--resume: no complete checkpoint at {args.checkpoint} "
+                "(also tried .tmp/.old siblings)"
+            )
+        if resolved != os.path.normpath(args.checkpoint):
+            print(f"resume: swap was interrupted; using {resolved}")
+        args.checkpoint = resolved
 
     # Multi-host bootstrap: a no-op unless a coordinator is configured in
     # the environment (JAX_COORDINATOR_ADDRESS etc., see parallel.multihost).
@@ -224,35 +240,98 @@ def main(argv=None):
     # --resume: continue from the checkpoint's recorded position. A
     # mid-epoch ("step") checkpoint carries step_in_epoch; a per-epoch one
     # means that epoch COMPLETED, so resumption starts at the next.
-    start_epoch, skip_steps = 1, 0
+    start_epoch, skip_steps, resume_meta = 1, 0, None
     if args.resume:
         if not (args.checkpoint and os.path.isdir(args.checkpoint)):
             raise SystemExit("--resume requires --checkpoint <dir>")
         with open(os.path.join(args.checkpoint, "meta.json")) as f:
-            meta = json.load(f)
-        if "step_in_epoch" in meta:
-            start_epoch = int(meta["epoch"])
-            skip_steps = int(meta["step_in_epoch"])
+            resume_meta = json.load(f)
+        if "step_in_epoch" in resume_meta:
+            start_epoch = int(resume_meta["epoch"])
+            skip_steps = int(resume_meta["step_in_epoch"])
         else:
-            start_epoch = int(meta["epoch"]) + 1
+            start_epoch = int(resume_meta["epoch"]) + 1
         print(f"resuming at epoch {start_epoch}, step {skip_steps}")
+        # Carry the best/ checkpoint into the new run dir: best_val
+        # resumes from meta, so if no post-resume epoch beats it the new
+        # run would otherwise end with NO best/ at all (the true best
+        # stranded in the abandoned pre-preemption dir).
+        if multihost.process_index() == 0:
+            # resolve_resume_dir doubles as the completeness check here:
+            # best/ uses the same rename-aside swap, so a preemption
+            # mid-swap may have left the complete copy at a .tmp/.old
+            # sibling — and a partial dir must not be carried.
+            best_src = resolve_resume_dir(os.path.join(
+                os.path.dirname(os.path.normpath(args.checkpoint)), "best"
+            ))
+            best_dst = os.path.join(ckpt_dir, "best")
+            if best_src and not os.path.exists(best_dst):
+                from ..training.checkpoint import copy_checkpoint_dir
+
+                copy_checkpoint_dir(best_src, best_dst)
+                print(f"resume: carried best checkpoint from {best_src}")
+                # Old-format step metas lack best_val_loss; without a
+                # threshold the first post-resume epoch would overwrite
+                # the carried best/ unconditionally (inf comparison).
+                # Seed it from the carried best's own meta.
+                if "best_val_loss" not in resume_meta:
+                    try:
+                        with open(os.path.join(best_src, "meta.json")) as f:
+                            best_meta = json.load(f)
+                        resume_meta["best_val_loss"] = float(
+                            best_meta["best_val_loss"]
+                        )
+                    except (OSError, KeyError, ValueError):
+                        pass
 
     from ..utils.profiling import trace_context
 
     with trace_context(args.profile_dir):
         _epoch_loop(args, config, state, train_step, eval_step, loader,
                     loader_val, put, ckpt_dir, start_epoch=start_epoch,
-                    skip_steps=skip_steps)
+                    skip_steps=skip_steps, resume_meta=resume_meta)
     print("Done!")
 
 
 def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                 put_batch, ckpt_dir, start_epoch: int = 1,
-                skip_steps: int = 0):
+                skip_steps: int = 0, resume_meta=None):
     from ..data.loader import device_prefetch
 
+    # Restore the loss history and best-checkpoint threshold from the
+    # resumed checkpoint's meta so a resume does not silently reset them
+    # (a fresh best_val=inf would let the first post-resume epoch steal
+    # "best" regardless of the pre-preemption record).
     best_val = float("inf")
     train_losses, val_losses = [], []
+    resumed_epoch_losses = []
+    if resume_meta is not None:
+        train_losses = [float(x) for x in resume_meta.get("train_loss", [])]
+        val_losses = [float(x) for x in resume_meta.get("val_loss", [])]
+        best_val = float(resume_meta.get("best_val_loss", float("inf")))
+        # Per-step losses of the partially-trained epoch: the resumed
+        # epoch's train_loss must average ALL its batches, not just the
+        # post-resume ones, and an exactly-at-the-boundary checkpoint
+        # (step_in_epoch == len(loader)) must still run validation and
+        # the per-epoch save for that epoch instead of recording 0.0.
+        resumed_epoch_losses = [
+            float(x) for x in resume_meta.get("epoch_losses", [])
+        ]
+    if skip_steps >= len(loader) and not resumed_epoch_losses:
+        # Old-format step checkpoint (no epoch_losses) at the exact
+        # boundary: the epoch is complete but its per-step losses are
+        # gone — skip into the next epoch rather than recording a
+        # zero-batch epoch whose 0.0 train_loss would drive
+        # best-checkpoint selection.
+        start_epoch += 1
+        skip_steps = 0
+        if start_epoch > args.num_epochs:
+            print(
+                f"resume: checkpoint already covers all {args.num_epochs} "
+                "epochs (its per-step losses predate the epoch_losses "
+                "format, so the final epoch's validation cannot be "
+                "reconstructed); nothing to train"
+            )
     trainable, opt_state = state.trainable, state.opt_state
     # Fast-forward the loader's epoch counter so epoch E shuffles with
     # RandomState(seed + E - 1) exactly as the original run did.
@@ -265,7 +344,10 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
 
     for epoch in range(start_epoch, args.num_epochs + 1):
         t0 = time.time()
-        losses = []
+        # The resumed epoch starts with the losses of its already-trained
+        # batches so train_loss averages the WHOLE epoch.
+        losses = list(resumed_epoch_losses) if epoch == start_epoch else []
+        n_preloaded = len(losses)
         # Resumed epoch: replay the deterministic schedule; the
         # generator drops already-trained batches before the device
         # transfer (the loader still decodes them, backpressured by
@@ -273,6 +355,12 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
         skip = skip_steps if epoch == start_epoch else 0
 
         def resumed(it=loader, skip=skip):
+            if skip >= len(it):
+                # Exact-boundary resume: every batch is already trained.
+                # Don't decode the whole epoch just to drop it — advance
+                # the shuffle schedule and go straight to validation.
+                it.set_epoch(it._epoch + 1)
+                return
             for j, b in enumerate(it):
                 if j >= skip:
                     yield b
@@ -301,6 +389,13 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                 and (i + 1) % args.save_interval == 0
                 and multihost.process_index() == 0
             ):
+                # Fetch each device scalar at most once across all saves
+                # (with --log_interval > 1 most entries are still device
+                # scalars; re-converting the whole list every save would
+                # be O(steps^2 / save_interval) tunnel round trips).
+                losses[:] = [
+                    l if isinstance(l, float) else float(l) for l in losses
+                ]
                 full_params = {
                     "backbone": trainable.get(
                         "backbone", state.frozen["backbone"]
@@ -310,7 +405,20 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                 save_checkpoint(
                     ckpt_dir, full_params, config, epoch,
                     opt_state=opt_state,
-                    extra={"step_in_epoch": i + 1, "args": vars(args)},
+                    # Completed-epoch history + this epoch's per-step
+                    # losses ride along so a resume restores best_val,
+                    # the loss curves, AND can finish this epoch with a
+                    # correctly-averaged train_loss (ADVICE r3).
+                    # best_val is inf until a validation has run; omit
+                    # it then (json would emit non-RFC 'Infinity') —
+                    # the resume path already .get()s with an inf
+                    # default.
+                    extra={"step_in_epoch": i + 1, "args": vars(args),
+                           "train_loss": train_losses,
+                           "val_loss": val_losses,
+                           **({"best_val_loss": best_val}
+                              if best_val != float("inf") else {}),
+                           "epoch_losses": losses},
                     tag="step",
                 )
         train_loss = (
@@ -330,7 +438,10 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
             n_val += 1
         val_loss /= max(n_val, 1)
         dt = time.time() - t0
-        pairs_per_s = len(losses) * args.batch_size / max(train_dt, 1e-9)
+        pairs_per_s = (
+            (len(losses) - n_preloaded) * args.batch_size
+            / max(train_dt, 1e-9)
+        )
         print(
             f"Epoch {epoch}: train {train_loss:.4f}  val {val_loss:.4f}  "
             f"({dt:.1f}s, train {pairs_per_s:.1f} pairs/s)",

@@ -13,6 +13,7 @@ from .checkpoint import (
     load_checkpoint,
     load_opt_state,
     config_from_dict,
+    resolve_resume_dir,
 )
 
 __all__ = [
@@ -27,4 +28,5 @@ __all__ = [
     "load_checkpoint",
     "load_opt_state",
     "config_from_dict",
+    "resolve_resume_dir",
 ]

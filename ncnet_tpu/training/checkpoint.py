@@ -81,6 +81,69 @@ def _load_tree(path: str):
     return listify(root)
 
 
+def _rmtree_unmarked(path: str) -> None:
+    """Remove a checkpoint dir, un-marking it complete FIRST.
+
+    rmtree deletes entries in directory order, so a kill mid-rmtree
+    could leave a gutted dir whose surviving meta.json still marks it
+    complete to resolve_resume_dir; unlinking meta.json first makes the
+    deletion safe at every kill point."""
+    if not os.path.exists(path):
+        return
+    meta = os.path.join(path, "meta.json")
+    if os.path.exists(meta):
+        os.unlink(meta)
+    shutil.rmtree(path)
+
+
+def _swap_aside(tmp: str, final: str) -> None:
+    """Promote a complete `tmp` dir to `final` via rename-aside.
+
+    (final -> final.old; tmp -> final; rm final.old.) Every step is a
+    rename or an un-marked delete, so a kill at ANY point leaves a
+    complete dir at one of final / final.tmp / final.old — the triple
+    resolve_resume_dir searches."""
+    aside = final + ".old"
+    _rmtree_unmarked(aside)
+    if os.path.exists(final):
+        os.replace(final, aside)
+    os.replace(tmp, final)
+    _rmtree_unmarked(aside)
+
+
+def _copytree_meta_last(src: str, dst: str) -> None:
+    """Copy a checkpoint dir so meta.json lands LAST, atomically.
+
+    A plain copytree can copy the small meta.json before the bulky
+    params.npz finishes, leaving a kill-window where a partial copy
+    passes resolve_resume_dir's completeness check."""
+    os.makedirs(dst)
+    for entry in sorted(os.listdir(src)):
+        if entry == "meta.json":
+            continue
+        s, d = os.path.join(src, entry), os.path.join(dst, entry)
+        if os.path.isdir(s):
+            shutil.copytree(s, d)
+        else:
+            shutil.copy2(s, d)
+    meta_dst = os.path.join(dst, "meta.json")
+    shutil.copy2(os.path.join(src, "meta.json"), meta_dst + ".tmp")
+    os.replace(meta_dst + ".tmp", meta_dst)
+
+
+def copy_checkpoint_dir(src: str, dst: str) -> None:
+    """Kill-safe copy of a complete checkpoint dir to `dst`.
+
+    Stale-.tmp guard, meta-last copy, rename-aside swap: a preemption at
+    any point leaves either the previous complete `dst` (or a complete
+    sibling resolve_resume_dir can find) — never a partial dir that
+    passes the completeness check. Used for best/ promotion and the
+    --resume best-carry."""
+    _rmtree_unmarked(dst + ".tmp")
+    _copytree_meta_last(src, dst + ".tmp")
+    _swap_aside(dst + ".tmp", dst)
+
+
 def save_checkpoint(
     directory: str,
     params: Dict[str, Any],
@@ -94,16 +157,19 @@ def save_checkpoint(
     """Write params + config (+ opt state, metrics) under `directory/epoch_N`.
 
     `tag` overrides the directory name — the mid-epoch preemption
-    checkpoints use the rolling tag "step" (written fresh to "step.tmp"
-    and swapped in, so a kill mid-write leaves the previous complete
-    "step" dir or a complete "step.tmp"; cli/train.py's resume checks
-    both)."""
+    checkpoints use the rolling tag "step", written fresh to "step.tmp"
+    and swapped in rename-aside (step -> step.old; step.tmp -> step;
+    rm step.old), so a kill at ANY point leaves at least one complete
+    dir among step / step.tmp / step.old; `resolve_resume_dir` (used by
+    cli/train.py --resume) checks all three in that order."""
     os.makedirs(directory, exist_ok=True)
     rolling = tag is not None
     final_tag = os.path.join(directory, tag if rolling else f"epoch_{epoch}")
     tag = final_tag + ".tmp" if rolling else final_tag
-    if rolling and os.path.exists(tag):
-        shutil.rmtree(tag)
+    if rolling:
+        # A stale .tmp (earlier interrupted save) must not survive as a
+        # "complete" sibling that outranks the fresh save.
+        _rmtree_unmarked(tag)
     os.makedirs(tag, exist_ok=True)
     _save_tree(jax.tree.map(np.asarray, params), os.path.join(tag, "params.npz"))
     if opt_state is not None:
@@ -115,19 +181,51 @@ def save_checkpoint(
         with open(os.path.join(tag, "opt_treedef.txt"), "w") as f:
             f.write(str(treedef))
     meta = {"config": _config_to_dict(config), "epoch": epoch, **(extra or {})}
-    with open(os.path.join(tag, "meta.json"), "w") as f:
+    # meta.json's presence is the completeness marker resolve_resume_dir
+    # keys on, so it must APPEAR atomically: a kill mid-dump must not
+    # leave a truncated meta.json that marks a partial dir complete.
+    meta_path = os.path.join(tag, "meta.json")
+    with open(meta_path + ".tmp", "w") as f:
         json.dump(meta, f, indent=2, default=float)
+    os.replace(meta_path + ".tmp", meta_path)
     if rolling:
-        if os.path.exists(final_tag):
-            shutil.rmtree(final_tag)
-        os.replace(tag, final_tag)
+        # ADVICE r3: the old rmtree(final)-then-replace order had a
+        # window where only a partial dir existed.
+        _swap_aside(tag, final_tag)
         tag = final_tag
     if is_best:
-        best = os.path.join(directory, "best")
-        if os.path.exists(best):
-            shutil.rmtree(best)
-        shutil.copytree(tag, best)
+        # Same discipline for best/: copy with meta landing last, then
+        # rename-aside — a kill mid-copy leaves the previous complete
+        # best/ (or a complete sibling) resolvable, never a partial dir
+        # that passes the completeness check.
+        copy_checkpoint_dir(tag, os.path.join(directory, "best"))
     return tag
+
+
+def resolve_resume_dir(path: str) -> Optional[str]:
+    """Resolve a --resume checkpoint dir, tolerating a rolling-swap kill.
+
+    save_checkpoint's rename-aside swap guarantees a COMPLETE checkpoint
+    always exists at one of `path`, `path + ".tmp"`, or `path + ".old"`
+    no matter where a preemption lands; return the newest complete one
+    (meta.json is written last, so its presence marks completeness), or
+    None if none qualifies. `.tmp` is checked FIRST: a complete .tmp is
+    always newer than `path` (each save rmtree's any stale .tmp before
+    writing a fresh one), so preferring `path` would silently resume an
+    older checkpoint and replay already-trained steps.
+    """
+    # A trailing slash (shell tab-completion) would turn `path + ".tmp"`
+    # into a path INSIDE the dir instead of the sibling.
+    path = os.path.normpath(path)
+    for cand in (path + ".tmp", path, path + ".old"):
+        # Completeness = meta.json (written last, atomically) AND
+        # params.npz (belt-and-braces against a dir gutted by an
+        # interrupted rmtree of a stale .tmp).
+        if os.path.isfile(os.path.join(cand, "meta.json")) and os.path.isfile(
+            os.path.join(cand, "params.npz")
+        ):
+            return cand
+    return None
 
 
 def load_checkpoint(path: str, opt_state_template=None):

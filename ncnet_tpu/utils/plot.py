@@ -91,6 +91,8 @@ def plot_matches_horizontal(
     ax.set_axis_off()
     pa = np.asarray(points_a, dtype=np.float64)
     pb = np.asarray(points_b, dtype=np.float64)
+    if scores is not None and np.asarray(scores).size == 0:
+        scores = None  # zero matches: fall through to the inliers path
     if scores is not None:
         s = np.asarray(scores, dtype=np.float64)
         lo, hi = float(s.min()), float(s.max())

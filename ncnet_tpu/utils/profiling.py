@@ -164,6 +164,11 @@ def run_with_alarm(seconds: int, fn, *args, **kwargs):
     import time as _time
 
     start = _time.monotonic()
+    # Bound BEFORE installing the handler: an outer alarm firing in the
+    # window between signal.signal() and the clamped assignment below
+    # must raise AlarmTimeout, not NameError (ADVICE r3). Overwritten
+    # with the clamped value before signal.alarm() arms anything.
+    armed = int(seconds)
 
     def _handler(signum, frame):
         # Report the ACTUALLY-ARMED duration: an inner fence clamped to an
@@ -175,21 +180,32 @@ def run_with_alarm(seconds: int, fn, *args, **kwargs):
             + (f" (requested {seconds}s)" if armed != int(seconds) else "")
         )
 
-    old_handler = signal.signal(signal.SIGALRM, _handler)
-    prev_remaining = signal.alarm(0)  # read + cancel any outer fence
-    arm = int(seconds)
-    if prev_remaining:
-        arm = min(arm, prev_remaining)
-    armed = max(1, arm)
-    signal.alarm(armed)
+    # Handler install happens INSIDE the try: if an outer alarm fires in
+    # the window right after signal.signal(), the raise must still run
+    # the finally (restoring the outer handler) or the session-level
+    # fence would be silently dead afterwards.
+    old_handler = None
+    prev_remaining = None
     try:
+        old_handler = signal.signal(signal.SIGALRM, _handler)
+        prev_remaining = signal.alarm(0)  # read + cancel any outer fence
+        arm = int(seconds)
+        if prev_remaining:
+            arm = min(arm, prev_remaining)
+        armed = max(1, arm)
+        signal.alarm(armed)
         return fn(*args, **kwargs)
     finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old_handler)
-        if prev_remaining:
-            elapsed = int(_time.monotonic() - start)
-            signal.alarm(max(1, prev_remaining - elapsed))
+        # old_handler None means signal.signal itself raised (e.g. from
+        # a non-main thread) — nothing was installed or disarmed, so
+        # touching the alarm here would cancel an OUTER fence that was
+        # never read and can never be re-armed.
+        if old_handler is not None:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old_handler)
+            if prev_remaining:
+                elapsed = int(_time.monotonic() - start)
+                signal.alarm(max(1, prev_remaining - elapsed))
 
 
 def dial_devices(timeout: float):

@@ -132,7 +132,46 @@ def test_train_cli_mid_epoch_resume(tmp_path):
         "--result_model_dir", models_b,
     ])
     run_b = os.path.join(models_b, os.listdir(models_b)[0])
-    assert "epoch_2" in os.listdir(run_b)
+    listing_b = os.listdir(run_b)
+    # The step checkpoint above sits at the exact epoch boundary
+    # (step_in_epoch == len(loader) == 2) and carries the epoch's
+    # per-step losses: the resume FINISHES epoch 1 (validation + the
+    # per-epoch save, with train_loss averaged from the restored
+    # losses — not the 0.0 of a zero-batch replay; ADVICE r3), then
+    # trains epoch 2.
+    assert "epoch_1" in listing_b and "epoch_2" in listing_b
+    # best/ carried over from the pre-preemption run dir so the resumed
+    # run can never end without one.
+    assert "best" in listing_b
+    with open(os.path.join(run_b, "epoch_2", "meta.json")) as f:
+        meta_b = _json.load(f)
+    assert len(meta_b["train_loss"]) == 2
+    np.testing.assert_allclose(
+        meta_b["train_loss"][0], float(np.mean(meta["epoch_losses"])),
+        rtol=1e-6)
+
+    # An old-format step checkpoint (no epoch_losses) at the boundary:
+    # the losses are gone, so the resume skips into epoch 2 rather than
+    # recording a zero-batch epoch 1.
+    import shutil as _sh
+
+    old_fmt = os.path.join(root, "old_fmt_step")
+    _sh.copytree(os.path.join(run_a, "step"), old_fmt)
+    with open(os.path.join(old_fmt, "meta.json")) as f:
+        meta_old = _json.load(f)
+    del meta_old["epoch_losses"]
+    with open(os.path.join(old_fmt, "meta.json"), "w") as f:
+        _json.dump(meta_old, f)
+    models_d = os.path.join(root, "models_d")
+    train_cli.main(common + [
+        "--num_epochs", "2",
+        "--checkpoint", old_fmt,
+        "--resume",
+        "--result_model_dir", models_d,
+    ])
+    run_d = os.path.join(models_d, os.listdir(models_d)[0])
+    listing_d = os.listdir(run_d)
+    assert "epoch_2" in listing_d and "epoch_1" not in listing_d
 
     # Resume from a completed-epoch checkpoint: starts at the NEXT epoch.
     models_c = os.path.join(root, "models_c")

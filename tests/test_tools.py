@@ -155,3 +155,20 @@ def test_show_matches_renders_png(tmp_path):
     for o in outs:
         img = np.asarray(Image.open(o))
         assert img.shape[0] > 0 and img.shape[1] > 0
+
+
+def test_plot_matches_empty_scores(tmp_path):
+    """plot_matches_horizontal with zero matches must not raise on the
+    scores= path (ADVICE r3: s.min() on a zero-size array)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from ncnet_tpu.utils.plot import plot_matches_horizontal
+
+    a = np.zeros((20, 30, 3), np.uint8)
+    b = np.zeros((16, 24, 3), np.uint8)
+    empty = np.zeros((0, 2))
+    out = str(tmp_path / "empty.png")
+    plot_matches_horizontal(a, b, empty, empty, scores=np.zeros((0,)),
+                            path=out, denormalize=False)
+    assert os.path.exists(out)

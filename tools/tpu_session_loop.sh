@@ -5,7 +5,7 @@
 # probe attempts (a wedged tunnel needs 10-25 min to clear, and hammering
 # it with probes extends the wedge).
 cd /root/repo || exit 1
-OUT=docs/tpu_r03
+OUT=docs/tpu_r04
 mkdir -p "$OUT"
 for n in $(seq 1 80); do
   echo "=== session-loop attempt $n $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
