@@ -252,6 +252,24 @@ def main(argv=None):
         else:
             start_epoch = int(resume_meta["epoch"]) + 1
         print(f"resuming at epoch {start_epoch}, step {skip_steps}")
+        # Multi-host: resolve_resume_dir runs per host against per-host
+        # filesystems, so hosts caught at different points of the rolling
+        # swap could silently resume from DIFFERENT checkpoints (the
+        # opt-state guard above only compares restore status). Compare
+        # the resolved position itself and fail loudly on divergence.
+        if multihost.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            pos = multihost_utils.process_allgather(
+                jnp.array([start_epoch, skip_steps], jnp.int32)
+            )
+            if not bool((pos == pos[0]).all()):
+                raise SystemExit(
+                    "resume position disagrees across hosts (per-host "
+                    f"[epoch, step]: {pos.tolist()}); the rolling-swap "
+                    "siblings resolved differently — make the SAME "
+                    "checkpoint state visible to every host"
+                )
         # Carry the best/ checkpoint into the new run dir: best_val
         # resumes from meta, so if no post-resume epoch beats it the new
         # run would otherwise end with NO best/ at all (the true best
@@ -278,11 +296,25 @@ def main(argv=None):
                     try:
                         with open(os.path.join(best_src, "meta.json")) as f:
                             best_meta = json.load(f)
-                        resume_meta["best_val_loss"] = float(
-                            best_meta["best_val_loss"]
+                        seed_val = best_meta.get("best_val_loss")
+                        if seed_val is None:
+                            # e.g. best/ written by convert_checkpoint
+                            # (extra=None): fall back to its loss curve.
+                            curve = best_meta.get("val_loss") or []
+                            seed_val = min(curve) if curve else None
+                        if seed_val is not None:
+                            resume_meta["best_val_loss"] = float(seed_val)
+                        else:
+                            print(
+                                "resume: warning: carried best/ records no "
+                                "loss; the first post-resume epoch will "
+                                "replace it"
+                            )
+                    except (OSError, ValueError) as exc:
+                        print(
+                            "resume: warning: could not seed best_val "
+                            f"from carried best/ ({exc})"
                         )
-                    except (OSError, KeyError, ValueError):
-                        pass
 
     from ..utils.profiling import trace_context
 
@@ -354,12 +386,14 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
         # its prefetch queue — minutes at worst for a full epoch).
         skip = skip_steps if epoch == start_epoch else 0
 
-        def resumed(it=loader, skip=skip):
+        def resumed(it=loader, skip=skip, epoch=epoch):
             if skip >= len(it):
                 # Exact-boundary resume: every batch is already trained.
-                # Don't decode the whole epoch just to drop it — advance
-                # the shuffle schedule and go straight to validation.
-                it.set_epoch(it._epoch + 1)
+                # Don't decode the whole epoch just to drop it — position
+                # the shuffle schedule where a real iteration of epoch
+                # `epoch` would have left it (the NEXT iteration shuffles
+                # with seed + epoch) and go straight to validation.
+                it.set_epoch(epoch)
                 return
             for j, b in enumerate(it):
                 if j >= skip:
