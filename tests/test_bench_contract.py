@@ -40,3 +40,43 @@ def test_bench_emits_one_json_line():
         assert key in rec, rec
     assert rec["metric"].startswith("inloc_dense_match_pairs_per_s_per_chip")
     assert rec["value"] > 0
+
+
+def test_traceagg_on_committed_round2_trace():
+    """traceagg ground truth against the committed round-2 device trace:
+    whole-step totals and the stage rollup must reproduce the numbers in
+    docs/NEXT.md's round-3 attribution table (backbone ~174 ms/step for
+    the double pass, consensus ~110, corr+pool ~10-15)."""
+    from ncnet_tpu.utils.traceagg import aggregate, stage_rollup
+
+    agg = aggregate(os.path.join(REPO, "docs/tpu_r02/trace"), steps=2)
+    assert agg is not None
+    assert 250 < agg["total_ms"] < 350
+    assert 0.05 < agg["mfu"] < 0.12
+    assert 0.3 < agg["hbm_frac"] < 0.5
+    stages = stage_rollup(agg)
+    assert 150 < stages["backbone"]["ms"] < 200
+    assert 90 < stages["consensus"]["ms"] < 125
+    assert 5 < stages["corr_pool"]["ms"] < 20
+    for s in stages.values():
+        for k in ("ms", "tflops", "gbs", "mfu", "hbm_frac"):
+            assert k in s
+
+
+def test_traceagg_returns_none_for_cpu_trace(tmp_path):
+    """A CPU trace has no accelerator op metadata: aggregate must return
+    None (bench emits util=null), never fabricated zeros."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return jnp.tanh(x @ x.T).sum()
+
+    x = jnp.ones((64, 64))
+    f(x).block_until_ready()
+    with jax.profiler.trace(str(tmp_path)):
+        f(x).block_until_ready()
+    from ncnet_tpu.utils.traceagg import aggregate
+
+    assert aggregate(str(tmp_path), steps=1) is None
