@@ -169,17 +169,43 @@ def main():
                 corr, delta4d=delta, k_size=2, impl=extract_impl
             )
 
+        def probe_of(m):
+            # Consume EVERY element of EVERY output array (the
+            # chain_reps rule, utils/profiling.py, strengthened to
+            # full sums): anything less lets XLA dead-code-eliminate
+            # part of the coordinate extraction (whole arrays, or the
+            # per-match delta decode behind a single-element probe).
+            return sum(jnp.sum(v.astype(jnp.float32)) for v in m)
+
+        # NCNET_BENCH_HIT_PATH=1: every pano is a feature-cache hit (the
+        # cross-query cache of cli/eval_inloc.py at steady state) — the
+        # stack entries are precomputed FEATURES and the block runs only
+        # correlation/consensus/extraction. Upper bound for the cache's
+        # headline effect; the session matrix A/Bs it against default.
+        if os.environ.get("NCNET_BENCH_HIT_PATH") == "1":
+            @jax.jit
+            def block_hit(params, src, feats_stack):
+                feat_a = query_feats(params, src)
+
+                def body(acc, feat_b):
+                    m = match_from_feats(params, feat_a, feat_b)
+                    return acc + probe_of(m), None
+
+                acc, _ = jax.lax.scan(body, jnp.float32(0), feats_stack)
+                return acc
+
+            @jax.jit
+            def prep_feats(params, tgt_stack):
+                return jax.lax.map(
+                    lambda t: extract_features(config, params, t[None]),
+                    tgt_stack,
+                )
+
+            return params, block_hit, prep_feats
+
         @jax.jit
         def block(params, src, tgt_stack):
             feat_a = query_feats(params, src)
-
-            def probe_of(m):
-                # Consume EVERY element of EVERY output array (the
-                # chain_reps rule, utils/profiling.py, strengthened to
-                # full sums): anything less lets XLA dead-code-eliminate
-                # part of the coordinate extraction (whole arrays, or the
-                # per-match delta decode behind a single-element probe).
-                return sum(jnp.sum(v.astype(jnp.float32)) for v in m)
 
             if bb > 1:
                 n = tgt_stack.shape[0]
@@ -208,7 +234,7 @@ def main():
             acc, _ = jax.lax.scan(body, jnp.float32(0), tgt_stack)
             return acc
 
-        return params, block
+        return params, block, None
 
     panos_per_query = 10  # eval_inloc.py:124-132: top-10 shortlist per query
     key = jax.random.PRNGKey(1)
@@ -234,11 +260,24 @@ def main():
         mode, extract_impl = tier
         name = f"{mode}+extract-{extract_impl}"
         try:
-            params, block = build(mode, extract_impl)
+            params, block, prep_feats = build(mode, extract_impl)
+            # The image stack stays loop-invariant: a tier fallback must
+            # re-extract features from IMAGES, not from a prior tier's
+            # feature stack.
+            stack = tgt_stack
+            if prep_feats is not None:
+                # Precompute the pano features OUTSIDE the timed block:
+                # hit-path blocks model a steady-state cache (features on
+                # device; the eval CLI's H2D of a cached feature overlaps
+                # dispatch the same way its decode prefetch does).
+                note("hit-path: precomputing pano feature stack...")
+                stack = prep_feats(params, tgt_stack)
+                jax.block_until_ready(stack)
+                name += "+hit-path"
             note(f"compiling+first-run '{name}' block at {h_a}x{w_a} (first "
                  "compile of this shape can take many minutes on a tunneled "
                  "backend)...")
-            out = block(params, src, tgt_stack)  # warmup/compile
+            out = block(params, src, stack)  # warmup/compile
             jax.block_until_ready(out)
             note(f"'{name}' block compiled and ran")
             break
@@ -258,7 +297,7 @@ def main():
 
     def run_block():
         """One query block: query features + 10 pano steps, one program."""
-        return float(block(params, src, tgt_stack))
+        return float(block(params, src, stack))
 
     run_block()  # settle caches/queues
     note("timing...")

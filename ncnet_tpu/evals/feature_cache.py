@@ -1,0 +1,172 @@
+"""Cross-query pano feature cache for the InLoc matching CLI.
+
+The InLoc shortlists repeat panos heavily across the 356 queries, yet the
+reference recomputes every pano's backbone features for every query x pano
+pair (eval_inloc.py:124-137 — 3,560 forward passes). The backbone is the
+largest per-pano device cost (~87 ms of ~300 on v5e, round-2 trace), so a
+TPU-first redesign caches pano features ACROSS queries: a hit skips the
+pano backbone entirely and dispatches only the correlation/consensus/
+extraction half of the step.
+
+Keying and bounds:
+  * key = (model_key, pano path, resized (H, W) bucket) — model_key
+    identifies the weights (checkpoint path + file mtime, or the init
+    seed), so a cache can never serve features from different weights;
+    the resize bucket key keeps distinct compilation shapes distinct.
+  * bounded host-memory LRU by BYTES (features at the InLoc bucket are
+    ~113 MB per pano: 1024ch x 192x144 f32 — backbone_apply returns f32
+    even with a bf16 compute dtype; the CLI's default 4 GiB budget holds
+    ~36 panos, a 10-pano shortlist window plus reuse locality).
+  * optional disk tier (``disk_dir``): entries evicted from memory stay
+    on disk (npz keyed by a hash of the key) and promote back on hit —
+    sized for re-runs and multi-process sweeps, where the backbone cost
+    of the whole pano set is paid at most once per weights.
+
+This module is pure host-side bookkeeping (numpy + files); the caller
+owns device placement (jnp.asarray on hit) and extraction (device_get
+on store).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def model_cache_key(checkpoint: str, seed: int = 0) -> str:
+    """Stable identifier for the weights producing the cached features.
+
+    A checkpoint is identified by its resolved path + params.npz mtime
+    (content hashing 100+ MB of weights per CLI start is not worth it;
+    an mtime bump after a re-save correctly invalidates). Without a
+    checkpoint, features come from the deterministic init -> the seed
+    identifies them.
+    """
+    if checkpoint:
+        path = os.path.abspath(os.path.normpath(checkpoint))
+        params_file = os.path.join(path, "params.npz")
+        try:
+            mtime = os.stat(params_file).st_mtime_ns
+        except OSError:
+            try:
+                mtime = os.stat(path).st_mtime_ns
+            except OSError:
+                mtime = 0
+        return f"{path}@{mtime}"
+    return f"init-seed-{seed}"
+
+
+class PanoFeatureCache:
+    """Byte-bounded LRU of pano backbone features, optional disk tier."""
+
+    def __init__(self, max_bytes: int, disk_dir: Optional[str] = None,
+                 model_key: str = ""):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        self.max_bytes = int(max_bytes)
+        self.disk_dir = disk_dir
+        self.model_key = model_key
+        self._lru: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        # get() runs on the CLI's decode-prefetch thread while put() runs
+        # on the main thread; LRU reordering + eviction need the lock.
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.disk_hits = 0
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+
+    def _key(self, pano_path: str, shape: Tuple[int, int]) -> tuple:
+        return (self.model_key, pano_path, tuple(shape))
+
+    def _disk_path(self, key: tuple) -> str:
+        h = hashlib.sha1(repr(key).encode()).hexdigest()
+        return os.path.join(self.disk_dir, f"feat_{h}.npz")
+
+    def get(self, pano_path: str, shape: Tuple[int, int]):
+        """Cached features for (pano, resize bucket), or None.
+
+        Disk-tier hits promote back into the memory LRU.
+        """
+        key = self._key(pano_path, shape)
+        with self._lock:
+            feats = self._lru.get(key)
+            if feats is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+                return feats
+        if self.disk_dir:
+            path = self._disk_path(key)
+            if os.path.exists(path):
+                import zipfile
+
+                try:
+                    with np.load(path) as z:
+                        feats = z["feats"]
+                except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+                    # A partial write (killed run) is a miss, not a crash.
+                    feats = None
+                if feats is not None:
+                    self.hits += 1
+                    self.disk_hits += 1
+                    self._store_mem(key, feats)
+                    return feats
+        self.misses += 1
+        return None
+
+    def put(self, pano_path: str, shape: Tuple[int, int],
+            feats: np.ndarray) -> None:
+        key = self._key(pano_path, shape)
+        with self._lock:
+            if key in self._lru:
+                return
+        feats = np.asarray(feats)
+        if self.disk_dir:
+            path = self._disk_path(key)
+            if not os.path.exists(path):
+                # tmp + rename: a killed run must not leave a truncated
+                # npz that later loads as garbage features.
+                tmp = path + ".tmp"
+                try:
+                    # Through a handle: np.savez(str) would append .npz
+                    # to the tmp name and the rename would miss it.
+                    with open(tmp, "wb") as fh:
+                        np.savez(fh, feats=feats)
+                    os.replace(tmp, path)
+                except OSError:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+        self._store_mem(key, feats)
+
+    def _store_mem(self, key: tuple, feats: np.ndarray) -> None:
+        if feats.nbytes > self.max_bytes:
+            return  # larger than the whole budget: disk-only (if any)
+        with self._lock:
+            if key in self._lru:
+                return
+            self._lru[key] = feats
+            self._bytes += feats.nbytes
+            while self._bytes > self.max_bytes and len(self._lru) > 1:
+                _, old = self._lru.popitem(last=False)
+                self._bytes -= old.nbytes
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def stats(self) -> str:
+        total = self.hits + self.misses
+        pct = 100.0 * self.hits / total if total else 0.0
+        return (
+            f"pano-feature cache: {self.hits}/{total} hits ({pct:.0f}%, "
+            f"{self.disk_hits} from disk), {len(self._lru)} entries / "
+            f"{self._bytes / 1e6:.0f} MB in memory"
+        )

@@ -1,0 +1,86 @@
+"""Unit tests for the cross-query pano feature cache (evals/feature_cache)."""
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.evals.feature_cache import PanoFeatureCache, model_cache_key
+
+
+def _feat(seed, mb=1):
+    rng = np.random.default_rng(seed)
+    n = mb * 1024 * 1024 // 4
+    return rng.random(n).astype(np.float32)
+
+
+def test_lru_byte_bound_eviction():
+    c = PanoFeatureCache(max_bytes=3 * 1024 * 1024)
+    for i in range(4):  # 4 x 1 MB into a 3 MB budget
+        c.put(f"p{i}", (8, 8), _feat(i))
+    assert c.nbytes <= 3 * 1024 * 1024
+    assert c.get("p0", (8, 8)) is None  # oldest evicted
+    assert c.get("p3", (8, 8)) is not None
+
+    # get() refreshes recency: p1 survives the next insertion, p2 goes.
+    assert c.get("p1", (8, 8)) is not None
+    c.put("p4", (8, 8), _feat(4))
+    assert c.get("p1", (8, 8)) is not None
+    assert c.get("p2", (8, 8)) is None
+
+
+def test_keying_separates_shape_and_model():
+    c = PanoFeatureCache(max_bytes=64 * 1024 * 1024, model_key="m1")
+    f = _feat(0)
+    c.put("p", (8, 8), f)
+    assert c.get("p", (16, 8)) is None  # different resize bucket
+    c2 = PanoFeatureCache(max_bytes=64 * 1024 * 1024, model_key="m2")
+    assert c2.get("p", (8, 8)) is None  # different weights
+
+    got = c.get("p", (8, 8))
+    np.testing.assert_array_equal(got, f)
+
+
+def test_oversized_entry_not_cached_in_memory():
+    c = PanoFeatureCache(max_bytes=1024)
+    c.put("p", (8, 8), _feat(0))  # 1 MB > 1 KB budget
+    assert c.nbytes == 0
+
+
+def test_disk_tier_promote_and_truncation_tolerance(tmp_path):
+    d = str(tmp_path / "cache")
+    c = PanoFeatureCache(max_bytes=64 * 1024 * 1024, disk_dir=d,
+                         model_key="m")
+    f = _feat(1)
+    c.put("p", (8, 8), f)
+
+    # Fresh instance (new process): memory empty, disk serves + promotes.
+    c2 = PanoFeatureCache(max_bytes=64 * 1024 * 1024, disk_dir=d,
+                          model_key="m")
+    got = c2.get("p", (8, 8))
+    np.testing.assert_array_equal(got, f)
+    assert c2.disk_hits == 1
+    assert c2.nbytes == f.nbytes
+
+    # Truncated disk entry (killed run) is a miss, not a crash.
+    import glob
+    import os
+
+    path = glob.glob(os.path.join(d, "feat_*.npz"))[0]
+    with open(path, "r+b") as fh:
+        fh.truncate(100)
+    c3 = PanoFeatureCache(max_bytes=64 * 1024 * 1024, disk_dir=d,
+                          model_key="m")
+    assert c3.get("p", (8, 8)) is None
+
+
+def test_model_cache_key_checkpoint_vs_seed(tmp_path):
+    assert model_cache_key("", seed=3) == "init-seed-3"
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    (ck / "params.npz").write_bytes(b"x")
+    k1 = model_cache_key(str(ck))
+    assert str(ck) in k1 and "@" in k1
+    import os
+    import time
+
+    os.utime(ck / "params.npz", (time.time() + 5, time.time() + 5))
+    assert model_cache_key(str(ck)) != k1  # re-save invalidates

@@ -169,6 +169,10 @@ def main(argv=None):
             ("default+bb5+conv1fold",
              {"NCNET_PANO_BACKBONE_BATCH": "5",
               "NCNET_BACKBONE_CONV1_FOLD": "1"}),
+            # Round-4: cache-hit steady state of the cross-query pano
+            # feature cache (cli/eval_inloc.py --pano_feature_cache_mb);
+            # the block skips the pano backbone. CPU pre-read: 5.7x.
+            ("default+featcache-hit", {"NCNET_BENCH_HIT_PATH": "1"}),
         ]
         for run_label, env in bench_runs:
             for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
@@ -176,7 +180,8 @@ def main(argv=None):
                       "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
                       "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_L1_PALLAS",
                       "NCNET_PANO_BACKBONE_BATCH",
-                      "NCNET_BACKBONE_CONV1_FOLD"):
+                      "NCNET_BACKBONE_CONV1_FOLD",
+                      "NCNET_BENCH_HIT_PATH"):
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
