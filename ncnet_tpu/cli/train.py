@@ -56,6 +56,11 @@ def main(argv=None):
     # Recompute backbone activations in the backward pass (HBM lever for
     # fine-tuning at high resolution / large batch).
     parser.add_argument("--remat_backbone", action="store_true", default=False)
+    # Gradient accumulation over N sequential micro-batches: only one
+    # micro-batch of AD activations is live at a time (lax.scan), the HBM
+    # lever for the reference's batch-16 schedule. Negatives roll within
+    # each micro-batch (see make_train_step). batch_size must divide by N.
+    parser.add_argument("--grad_accum", type=int, default=1)
     parser.add_argument("--num_workers", type=int, default=8)
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument("--log_interval", type=int, default=1)
@@ -75,6 +80,18 @@ def main(argv=None):
         help="capture a jax.profiler trace of the run for TensorBoard/Perfetto",
     )
     args = parser.parse_args(argv)
+
+    if args.grad_accum < 1:
+        raise SystemExit("--grad_accum must be >= 1")
+    if args.grad_accum > 1 and (
+        args.batch_size % args.grad_accum
+        or args.batch_size // args.grad_accum < 2
+    ):
+        raise SystemExit(
+            f"--grad_accum {args.grad_accum} needs batch_size "
+            f"{args.batch_size} divisible by it with a micro-batch >= 2 "
+            "(the weak loss rolls negatives within a micro-batch)"
+        )
 
     # --resume must tolerate a preemption INSIDE save_checkpoint's
     # rename-aside swap: the complete checkpoint may sit at the sibling
@@ -155,20 +172,28 @@ def main(argv=None):
             ) from restore_err
     if restore_err is not None:
         raise restore_err
-    train_step, eval_step = make_train_step(config, tx, remat_backbone=args.remat_backbone)
+    train_step, eval_step = make_train_step(
+        config, tx, remat_backbone=args.remat_backbone,
+        accum_steps=args.grad_accum,
+    )
 
-    # Use the largest device count that divides the batch (single-host);
-    # multi-host requires the full global device count to divide the batch.
+    # Use the largest device count that divides the MICRO-batch (the unit
+    # each scan step of a grad-accumulated run actually shards; requiring
+    # only full-batch divisibility would make GSPMD reshard/pad inside
+    # every accumulation step). Multi-host requires the full global device
+    # count to divide it.
     n_proc = multihost.process_count()
     n_dev = len(jax.devices())
+    micro = args.batch_size // max(args.grad_accum, 1)
     if n_proc > 1:
-        if args.batch_size % n_dev:
+        if micro % n_dev:
             raise SystemExit(
-                f"multi-host run: batch_size {args.batch_size} must be "
-                f"divisible by the global device count {n_dev}"
+                f"multi-host run: micro-batch {micro} (batch_size "
+                f"{args.batch_size} / grad_accum {args.grad_accum}) must "
+                f"be divisible by the global device count {n_dev}"
             )
     else:
-        while n_dev > 1 and args.batch_size % n_dev:
+        while n_dev > 1 and micro % n_dev:
             n_dev -= 1
     mesh = make_mesh((n_dev,), ("dp",)) if n_dev > 1 else None
     if mesh is not None:

@@ -135,6 +135,7 @@ def make_train_step(
     tx: optax.GradientTransformation,
     normalization: str = "softmax",
     remat_backbone: bool = False,
+    accum_steps: int = 1,
 ):
     """Build the jitted train step (loss + grads + Adam update).
 
@@ -143,6 +144,16 @@ def make_train_step(
     the HBM lever for fine-tuning the backbone (train_fe) at high
     resolution / large batch; with the default frozen backbone there is no
     backbone backward pass and remat only costs compute.
+
+    accum_steps=k > 1 gradient-accumulates over k sequential micro-batches
+    of batch/k pairs (lax.scan, so XLA keeps ONE micro-batch of AD
+    activations live — the direct HBM lever for the reference's batch-16
+    schedule, complementary to the remat policies). Loss and grads are
+    the MEAN over micro-batches. Note the weak loss forms its negatives
+    by rolling WITHIN a batch (loss.py): with accumulation the roll pairs
+    within each micro-batch, so the negative set differs from the
+    unaccumulated batch — same loss family, not bit-identical training.
+    The batch size must divide by k.
     """
 
     def loss_fn(trainable: Params, frozen: Params, source, target):
@@ -170,9 +181,43 @@ def make_train_step(
     # each step.
     @partial(jax.jit, donate_argnums=(0, 2))
     def train_step(state_trainable, state_frozen, opt_state, source, target):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state_trainable, state_frozen, source, target
-        )
+        if accum_steps > 1:
+            b = source.shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch size {b} not divisible by accum_steps "
+                    f"{accum_steps}"
+                )
+            micro = b // accum_steps
+            if micro < 2:
+                raise ValueError(
+                    "micro-batch of 1: the weak loss forms negatives by "
+                    "rolling WITHIN a micro-batch (loss.py), so batch/"
+                    f"accum_steps must be >= 2 (got batch {b}, accum "
+                    f"{accum_steps}) — training would be silently dead"
+                )
+            msrc = source.reshape(accum_steps, micro, *source.shape[1:])
+            mtgt = target.reshape(accum_steps, micro, *target.shape[1:])
+
+            def body(carry, xs):
+                g_acc, l_acc = carry
+                s, t = xs
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    state_trainable, state_frozen, s, t
+                )
+                g_acc = jax.tree.map(jnp.add, g_acc, grads)
+                return (g_acc, l_acc + loss), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state_trainable)
+            (g_sum, l_sum), _ = jax.lax.scan(
+                body, (zeros, jnp.float32(0.0)), (msrc, mtgt)
+            )
+            grads = jax.tree.map(lambda g: g / accum_steps, g_sum)
+            loss = l_sum / accum_steps
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state_trainable, state_frozen, source, target
+            )
         updates, new_opt_state = tx.update(grads, opt_state, state_trainable)
         new_trainable = optax.apply_updates(state_trainable, updates)
         return new_trainable, new_opt_state, loss

@@ -445,3 +445,73 @@ def test_fused_impl_xla_matches_unfused(rng):
 
     with pytest.raises(ValueError, match="fused_impl"):
         dataclasses.replace(base, fused_impl="mosaic")
+
+
+def test_grad_accum_matches_mean_of_microbatches(rng):
+    """accum_steps=2 must produce EXACTLY the update from the mean of the
+    two micro-batches' losses/grads (the documented contract — negatives
+    roll within each micro-batch)."""
+    import optax
+
+    from ncnet_tpu.training.trainer import make_train_step
+    from ncnet_tpu.training.loss import weak_loss_from_features
+    from ncnet_tpu.models.ncnet import (
+        extract_features,
+        ncnet_forward_from_features,
+    )
+
+    params = ncnet_init(jax.random.PRNGKey(0), TINY)
+    src = jnp.asarray(rng.randn(4, 3, 48, 48).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(4, 3, 48, 48).astype(np.float32))
+
+    # Reference: mean of per-micro-batch (loss, grads), one tx.update.
+    def loss_fn(trainable, frozen, s, t):
+        p = {"backbone": frozen["backbone"],
+             "neigh_consensus": trainable["neigh_consensus"]}
+        fa = extract_features(TINY, p, s)
+        fb = extract_features(TINY, p, t)
+
+        def match(a, b):
+            corr, _ = ncnet_forward_from_features(TINY, p, a, b)
+            return corr
+
+        return weak_loss_from_features(match, fa, fb, "softmax")
+
+    # SGD keeps the update LINEAR in the grads, so the comparison is
+    # well-conditioned (Adam at an init whose grads are ~0 amplifies f32
+    # summation-order noise to O(lr) sign flips).
+    tx = optax.sgd(0.1)
+    trainable = {"neigh_consensus": params["neigh_consensus"]}
+    frozen = {"backbone": params["backbone"]}
+
+    losses, grads = [], []
+    for sl in (slice(0, 2), slice(2, 4)):
+        l, g = jax.value_and_grad(loss_fn)(trainable, frozen, src[sl], tgt[sl])
+        losses.append(l)
+        grads.append(g)
+    mean_grads = jax.tree.map(lambda a, b: (a + b) / 2.0, *grads)
+    updates, _ = tx.update(mean_grads, tx.init(trainable), trainable)
+    want = optax.apply_updates(trainable, updates)
+
+    step2, _ = make_train_step(TINY, tx, accum_steps=2)
+    got, _, loss = step2(trainable, frozen, tx.init(trainable), src, tgt)
+    # The weak loss at init is ~1e-5 (pos ≈ neg): compare with an absolute
+    # tolerance — f32 summation-order differences are ~1e-7.
+    np.testing.assert_allclose(
+        float(loss), float((losses[0] + losses[1]) / 2.0), atol=5e-7
+    )
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5
+        )
+
+
+def test_grad_accum_rejects_indivisible_batch(rng):
+    from ncnet_tpu.training.trainer import make_train_step
+
+    params = ncnet_init(jax.random.PRNGKey(0), TINY)
+    state, tx = create_train_state(params)
+    step3, _ = make_train_step(TINY, tx, accum_steps=3)
+    src = jnp.zeros((4, 3, 48, 48))
+    with pytest.raises(ValueError, match="not divisible"):
+        step3(state.trainable, state.frozen, state.opt_state, src, src)

@@ -31,6 +31,10 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--backbone", type=str, default="resnet101")
     p.add_argument("--remat", action="store_true")
+    # Gradient accumulation (trainer.make_train_step accum_steps): the
+    # round-4 HBM lever to sweep against the remat policies — micro-batch
+    # AD memory may allow a cheaper policy at the same global batch.
+    p.add_argument("--accum", type=int, default=1)
     p.add_argument(
         "--policies", type=str, default="",
         help="comma-separated NCNET_TRAIN_REMAT_POLICY sweep (e.g. "
@@ -65,8 +69,10 @@ def main(argv=None):
         print("backend dial timed out; aborting", file=sys.stderr)
         return 2
     n_dev = len(devices)
-    # Largest device count dividing the batch (same rule as cli/train.py).
-    dp = max(d for d in range(1, n_dev + 1) if args.batch % d == 0)
+    # Largest device count dividing the MICRO-batch (same rule as
+    # cli/train.py — the accumulated scan shards per micro-batch).
+    micro = args.batch // max(args.accum, 1)
+    dp = max(d for d in range(1, n_dev + 1) if micro % d == 0)
     mesh = make_mesh((dp,), ("dp",))
 
     config = NCNetConfig(
@@ -95,7 +101,8 @@ def main(argv=None):
         # policy's run.
         state, tx = create_train_state(jax.tree.map(jnp.array, params))
         state = replicate_state(state, mesh)
-        train_step, _ = make_train_step(config, tx, remat_backbone=args.remat)
+        train_step, _ = make_train_step(config, tx, remat_backbone=args.remat,
+                                        accum_steps=args.accum)
         trainable, opt_state = state.trainable, state.opt_state
         trainable, opt_state, loss = train_step(  # compile + warmup
             trainable, state.frozen, opt_state,
@@ -120,6 +127,8 @@ def main(argv=None):
         }
         if policy_label is not None:
             line["remat_policy"] = policy_label
+        if args.accum > 1:
+            line["accum"] = args.accum
         print(json.dumps(line), flush=True)
 
     if not args.policies:

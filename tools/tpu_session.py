@@ -92,6 +92,13 @@ def main(argv=None):
         ("train", "bench_train",
          ["--dial_timeout", "120", "--iters", "4",
           "--policies", "full,dots,none"]),
+        # Round-4: gradient accumulation (4 micro-batches of 4) — the AD
+        # memory drops ~4x, so the cheaper remat policies may fit where
+        # they OOM'd at batch 16; sweep the two fastest CPU-pre-read
+        # policies under accumulation.
+        ("train_accum", "bench_train",
+         ["--dial_timeout", "120", "--iters", "4", "--accum", "4",
+          "--policies", "dots,none"]),
     ]
     from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
 
