@@ -163,6 +163,18 @@ def main(argv=None):
         "Per-dispatch latency dominates tunneled backends (~50 ms each, "
         "2026-07-31 measurement); 1 = one dispatch per pano.",
     )
+    # Multi-chip pano fan-out: each device of a dp mesh runs the COMPLETE
+    # batch-1 per-pano program (forward + Pallas extraction) on a
+    # different shortlist pano via shard_map — no halo exchange, no
+    # sharded-op constraints, near-linear scaling for the headline
+    # workload. Complementary to --spatial_shards (which splits ONE pair
+    # when a single chip's HBM can't hold it).
+    parser.add_argument(
+        "--pano_dp", type=int, default=0,
+        help="fan panos over an N-device data-parallel mesh, one pano per "
+        "chip per dispatch (0 = off, -1 = all visible devices); uses the "
+        "--pano_batch stacking machinery with group size N",
+    )
     # Cross-query pano-feature cache (VERDICT r3 item 2): the shortlists
     # repeat panos across the 356 queries but the reference recomputes
     # every pano's backbone per pair (eval_inloc.py:124-137); a hit skips
@@ -196,6 +208,20 @@ def main(argv=None):
     if args.pano_batch > 1 and args.spatial_shards > 1:
         parser.error("--pano_batch requires --spatial_shards 1 (the sharded "
                      "pipeline batches across the mesh instead)")
+    if args.pano_dp and (args.spatial_shards > 1 or args.pano_batch > 1):
+        parser.error("--pano_dp replaces --pano_batch grouping and requires "
+                     "--spatial_shards 1")
+    if args.pano_dp:
+        # Any negative value means "all visible devices". Ride the
+        # --pano_batch grouping machinery: same-bucket stacks of exactly
+        # one pano per device.
+        n_vis = len(jax.devices())
+        args.pano_batch = n_vis if args.pano_dp < 0 else args.pano_dp
+        if args.pano_batch > n_vis:
+            parser.error(
+                f"--pano_dp {args.pano_dp} exceeds the {n_vis} visible "
+                "devices"
+            )
 
     from scipy.io import loadmat
 
@@ -310,6 +336,50 @@ def main(argv=None):
 
         match_from_cached_feats = jax.jit(_match_from_feats)
 
+        if args.pano_dp:
+            # One COMPLETE batch-1 per-pano program per device: shard_map
+            # hands each device its [1, 3, H, W] shard, so the unmodified
+            # single-pano math (incl. the batch-1 Pallas extraction) runs
+            # per chip with zero cross-device traffic; outputs restack to
+            # [n_dp, n_matches] exactly like the scan path's.
+            from jax import shard_map
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel import make_mesh
+
+            dp_mesh = make_mesh((args.pano_batch,), ("dp",))
+            stack_sharding = NamedSharding(dp_mesh, P("dp"))
+
+            def _one_shard(params, feat_a, tgt):
+                m = pano_matches_one(params, feat_a, tgt)
+                return tuple(v[None] for v in m)
+
+            _pano_dp_jit = jax.jit(shard_map(
+                _one_shard,
+                mesh=dp_mesh,
+                in_specs=(P(), P(), P("dp")),
+                out_specs=P("dp"),
+                check_vma=False,
+            ))
+
+            # Replicate the weights over the mesh ONCE — otherwise every
+            # dispatch re-broadcasts the backbone from device 0.
+            rep = NamedSharding(dp_mesh, P())
+            params_rep = jax.device_put(params, rep)
+
+            def pano_matches_dp(_params, feat_a, stack):
+                return _pano_dp_jit(
+                    params_rep, jax.device_put(feat_a, rep), stack
+                )
+
+            def dp_stack(imgs):
+                # Host stack -> per-device H2D placement directly (no
+                # chip-0 staging of the full [n_dp, 3, H, W] stack;
+                # load_pano keeps dp panos on the host).
+                return jax.device_put(
+                    np.concatenate(imgs, axis=0), stack_sharding
+                )
+
         # Pano-backbone batching (NCNET_PANO_BACKBONE_BATCH=n, trace
         # time): batch the group's backbones before the per-pano scan.
         # Batch-1 backbone convs run at 12-16% MXU utilization (round-2
@@ -359,9 +429,9 @@ def main(argv=None):
 
     cache = None
     if args.pano_feature_cache_mb > 0:
-        if args.spatial_shards > 1 or args.pano_batch > 1:
-            print("pano-feature cache: disabled (--spatial_shards > 1 or "
-                  "--pano_batch > 1 run their own feature plumbing)")
+        if args.spatial_shards > 1 or args.pano_batch > 1 or args.pano_dp:
+            print("pano-feature cache: disabled (--spatial_shards/"
+                  "--pano_batch/--pano_dp run their own feature plumbing)")
         else:
             from ..evals.feature_cache import (
                 PanoFeatureCache,
@@ -382,12 +452,14 @@ def main(argv=None):
     from concurrent.futures import ThreadPoolExecutor
 
     def load_pano(pano_fn):
-        return jnp.asarray(
-            load_inloc_image(
-                os.path.join(args.pano_path, pano_fn), args.image_size, args.k_size,
-                extra_align=args.spatial_shards, feat_unit=args.feat_unit,
-            )
+        arr = load_inloc_image(
+            os.path.join(args.pano_path, pano_fn), args.image_size, args.k_size,
+            extra_align=args.spatial_shards, feat_unit=args.feat_unit,
         )
+        # --pano_dp stacks on the HOST and device_puts the stack sharded
+        # (per-device H2D); everything else moves each pano to the device
+        # as soon as it decodes so H2D overlaps compute.
+        return arr if args.pano_dp else jnp.asarray(arr)
 
     def pano_target_shape(pano_fn):
         """Resized (H, W) bucket from the image HEADER alone — a cache
@@ -418,7 +490,11 @@ def main(argv=None):
     pool = ThreadPoolExecutor(
         max_workers=2 if (args.pano_batch > 1 or cache is not None) else 1
     )
-    batch_fn = pano_matches_batch if args.pano_batch > 1 else None
+    if args.pano_dp:
+        batch_fn, stack_fn = pano_matches_dp, dp_stack
+    else:
+        batch_fn = pano_matches_batch if args.pano_batch > 1 else None
+        stack_fn = None
     cache_fns = (
         (prepare_pano, match_from_cached_feats, pano_matches_with_feats)
         if cache is not None else None
@@ -427,7 +503,7 @@ def main(argv=None):
         with trace_context(args.profile_dir):
             _query_loop(args, db, out_dir, params, query_features, pano_matches,
                         n_matches, pano_fn_all, pool, load_pano, batch_fn,
-                        cache=cache, cache_fns=cache_fns)
+                        cache=cache, cache_fns=cache_fns, stack_fn=stack_fn)
     finally:
         pool.shutdown(wait=False, cancel_futures=True)
     if cache is not None:
@@ -435,7 +511,7 @@ def main(argv=None):
 
 
 def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
-                       load_pano):
+                       load_pano, stack_fn=None):
     """All of one query's panos in same-shape stacks of --pano_batch.
 
     Ragged groups are padded by repeating their last pano (the padded
@@ -465,7 +541,11 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
     def dispatch(chunk):
         nonlocal pending
         padded = chunk + [chunk[-1]] * (p - len(chunk))
-        stack = jnp.concatenate([img for _, img in padded], axis=0)
+        imgs = [img for _, img in padded]
+        stack = (
+            stack_fn(imgs) if stack_fn is not None
+            else jnp.concatenate(imgs, axis=0)
+        )
         ms = batch_fn(params, feat_a, stack)
         if pending is not None:
             flush(*pending)
@@ -547,7 +627,7 @@ def _run_panos_cached(args, params, feat_a, buf, pano_fns, pool, cache,
 
 def _query_loop(args, db, out_dir, params, query_features, pano_matches,
                 n_matches, pano_fn_all, pool, load_pano, batch_fn=None,
-                cache=None, cache_fns=None):
+                cache=None, cache_fns=None, stack_fn=None):
     for q in range(min(args.n_queries, len(db))):
         out_path = os.path.join(out_dir, f"{q + 1}.mat")
         if args.resume and os.path.exists(out_path):
@@ -564,7 +644,7 @@ def _query_loop(args, db, out_dir, params, query_features, pano_matches,
         pano_fns = [db[q][1].ravel()[i].item() for i in range(args.n_panos)]
         if batch_fn is not None:
             _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns,
-                               pool, load_pano)
+                               pool, load_pano, stack_fn=stack_fn)
             write_matches_mat(out_path, buf, query_fn, pano_fn_all)
             print(f"wrote {out_path}", flush=True)
             continue

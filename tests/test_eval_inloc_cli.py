@@ -365,3 +365,30 @@ def test_pano_feature_cache_disk_tier(fixture_dir, capsys):
         a = loadmat(fixture_dir / "m_d1" / exp1 / q)
         b = loadmat(fixture_dir / "m_d2" / exp2 / q)
         np.testing.assert_array_equal(a["matches"], b["matches"])
+
+
+def test_pano_dp_fanout_parity(fixture_dir):
+    """--pano_dp 8: each virtual device runs the complete batch-1 per-pano
+    program on a different pano (shard_map fan-out) — written matches must
+    be identical to the sequential path's."""
+    base = [
+        "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
+        "--query_path", str(fixture_dir / "query"),
+        "--pano_path", str(fixture_dir / "pano"),
+        "--image_size", "64",
+        "--n_queries", "2",
+        "--n_panos", "2",
+        "--k_size", "2",
+        "--pano_feature_cache_mb", "0",
+    ]
+    eval_inloc.main(base + ["--output_dir", str(fixture_dir / "m_seq")])
+    eval_inloc.main(base + [
+        "--output_dir", str(fixture_dir / "m_dp"),
+        "--pano_dp", "8",
+    ])
+    exp_a = os.listdir(fixture_dir / "m_seq")[0]
+    exp_b = os.listdir(fixture_dir / "m_dp")[0]
+    for q in ("1.mat", "2.mat"):
+        a = loadmat(fixture_dir / "m_seq" / exp_a / q)
+        b = loadmat(fixture_dir / "m_dp" / exp_b / q)
+        np.testing.assert_array_equal(a["matches"], b["matches"])

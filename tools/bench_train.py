@@ -69,6 +69,13 @@ def main(argv=None):
         print("backend dial timed out; aborting", file=sys.stderr)
         return 2
     n_dev = len(devices)
+    # Same validation as cli/train.py: fail fast, not inside the jit trace.
+    if args.accum > 1 and (
+        args.batch % args.accum or args.batch // args.accum < 2
+    ):
+        print(f"--accum {args.accum} needs --batch {args.batch} divisible "
+              "by it with a micro-batch >= 2", file=sys.stderr)
+        return 2
     # Largest device count dividing the MICRO-batch (same rule as
     # cli/train.py — the accumulated scan shards per micro-batch).
     micro = args.batch // max(args.accum, 1)
