@@ -165,6 +165,12 @@ def main(argv=None):
         # the l1-pallas verdict, in that order.
         bench_runs = [
             ("default (nhwc)", {}),
+            # Round-4: cache-hit steady state of the cross-query pano
+            # feature cache (default ON in cli/eval_inloc.py) — the most
+            # important new evidence, so it rides right after baseline;
+            # its block also compiles fastest (no pano backbone). CPU
+            # pre-read: 5.7x.
+            ("default+featcache-hit", {"NCNET_BENCH_HIT_PATH": "1"}),
             # Round-3: pano-backbone batching (trace shows batch-1
             # backbone convs at 12-16% MXU util — NEXT.md round-3 note).
             ("default+bb5", {"NCNET_PANO_BACKBONE_BATCH": "5"}),
@@ -176,10 +182,6 @@ def main(argv=None):
             ("default+bb5+conv1fold",
              {"NCNET_PANO_BACKBONE_BATCH": "5",
               "NCNET_BACKBONE_CONV1_FOLD": "1"}),
-            # Round-4: cache-hit steady state of the cross-query pano
-            # feature cache (cli/eval_inloc.py --pano_feature_cache_mb);
-            # the block skips the pano backbone. CPU pre-read: 5.7x.
-            ("default+featcache-hit", {"NCNET_BENCH_HIT_PATH": "1"}),
         ]
         for run_label, env in bench_runs:
             for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
