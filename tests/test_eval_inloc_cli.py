@@ -339,6 +339,7 @@ def test_pano_feature_cache_parity_and_hits(fixture_dir, capsys):
         assert a["query_fn"] == b["query_fn"]
 
 
+@pytest.mark.slow
 def test_pano_feature_cache_disk_tier(fixture_dir, capsys):
     """Disk tier: a SECOND process-run with an empty memory cache serves
     every pano from disk (no backbone recompute), still bit-identical."""
@@ -367,6 +368,7 @@ def test_pano_feature_cache_disk_tier(fixture_dir, capsys):
         np.testing.assert_array_equal(a["matches"], b["matches"])
 
 
+@pytest.mark.slow
 def test_pano_dp_fanout_parity(fixture_dir):
     """--pano_dp 8: each virtual device runs the complete batch-1 per-pano
     program on a different pano (shard_map fan-out) — written matches must
