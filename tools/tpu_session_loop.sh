@@ -7,7 +7,9 @@
 cd /root/repo || exit 1
 OUT=docs/tpu_r04
 mkdir -p "$OUT"
-for n in $(seq 1 80); do
+# NCNET_LOOP_ATTEMPTS: ~5-7 min per attempt; 80 spans ~8 h. Round 4
+# observed the round window outlasting the default — size to the window.
+for n in $(seq 1 "${NCNET_LOOP_ATTEMPTS:-80}"); do
   echo "=== session-loop attempt $n $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "=== tunnel up; starting session $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
