@@ -184,3 +184,116 @@ def test_train_cli_mid_epoch_resume(tmp_path):
     run_c = os.path.join(models_c, os.listdir(models_c)[0])
     listing = os.listdir(run_c)
     assert "epoch_2" in listing and "epoch_1" not in listing
+
+
+@pytest.mark.slow
+def test_train_survives_repeated_sigkill(tmp_path):
+    """Chaos test for the preemption story: SIGKILL a real training
+    subprocess at random moments (including inside checkpoint writes and
+    swaps), resume from whatever state is left, and the run must always
+    make progress and finish — with best/ and epoch checkpoints intact.
+    The unit tests pin each swap kill-window; this drives the WHOLE
+    stack (process death, resolve_resume_dir, history restore) the way a
+    real preemption does."""
+    import signal
+    import subprocess
+    import time as _time
+
+    from tests.test_evals_data import _write_synthetic_dataset
+
+    root = str(tmp_path)
+    _write_synthetic_dataset(root, n_pairs=6, size=48)
+    csv_dir = os.path.join(root, "csv")
+    os.makedirs(csv_dir)
+    import shutil
+
+    shutil.copy(os.path.join(root, "train.csv"),
+                os.path.join(csv_dir, "train_pairs.csv"))
+    shutil.copy(os.path.join(root, "train.csv"),
+                os.path.join(csv_dir, "val_pairs.csv"))
+
+    models = os.path.join(root, "models")
+    run_dir = None
+
+    def cmd(resume_from=None):
+        c = [
+            sys.executable, "-m", "ncnet_tpu.cli.train",
+            "--dataset_image_path", root,
+            "--dataset_csv_path", csv_dir,
+            "--num_epochs", "2",
+            "--batch_size", "2",
+            "--image_size", "48",
+            "--backbone", "vgg",
+            "--ncons_kernel_sizes", "3",
+            "--ncons_channels", "1",
+            "--result_model_dir", models,
+            "--num_workers", "2",
+            "--save_interval", "1",
+            "--log_interval", "1",
+        ]
+        if resume_from:
+            c += ["--checkpoint", resume_from, "--resume"]
+        return c
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    from ncnet_tpu.training.checkpoint import resolve_resume_dir
+
+    rng = np.random.default_rng(0)
+    resume_from = None
+    completed = False
+    # Exactly 3 kills, then one run that must complete.
+    for attempt in range(4):
+        proc = subprocess.Popen(
+            cmd(resume_from), env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        if attempt < 3:
+            # Kill at a random point of the run (the 8-20 s window spans
+            # startup, first steps, and checkpoint writes on this box).
+            _time.sleep(float(rng.uniform(8.0, 20.0)))
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+                proc.wait()
+            proc.stdout.close()
+            # Resume from the NEWEST run dir holding a complete rolling
+            # checkpoint (the run dir created by a resumed attempt may
+            # die before its first step save — fall back to the previous
+            # run's checkpoint rather than restarting from scratch).
+            # Completeness via the production resolver, which tolerates
+            # a kill mid-swap (step/.tmp/.old siblings).
+            resume_from = None
+            runs = sorted(
+                os.listdir(models),
+                key=lambda d: os.path.getmtime(os.path.join(models, d)),
+                reverse=True,
+            ) if os.path.isdir(models) else []
+            for r in runs:
+                resolved = resolve_resume_dir(os.path.join(models, r, "step"))
+                if resolved is not None:
+                    resume_from = os.path.join(models, r, "step")
+                    break
+        else:
+            try:
+                out, _ = proc.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+                raise AssertionError(f"final run hung; tail: {out[-2000:]}")
+            assert proc.returncode == 0, out[-2000:]
+            completed = True
+    assert completed
+    final_runs = sorted(
+        os.listdir(models),
+        key=lambda d: os.path.getmtime(os.path.join(models, d)),
+    )
+    final = os.path.join(models, final_runs[-1])
+    listing = os.listdir(final)
+    assert "best" in listing
+    assert "epoch_2" in listing
+    # best/ is loadable (complete) — the carry/copy discipline held.
+    from ncnet_tpu.training.checkpoint import load_checkpoint
+
+    ck = load_checkpoint(os.path.join(final, "best"))
+    assert ck["params"]
