@@ -213,7 +213,6 @@ def test_train_survives_repeated_sigkill(tmp_path):
                 os.path.join(csv_dir, "val_pairs.csv"))
 
     models = os.path.join(root, "models")
-    run_dir = None
 
     def cmd(resume_from=None):
         c = [
@@ -245,18 +244,22 @@ def test_train_survives_repeated_sigkill(tmp_path):
     completed = False
     # Exactly 3 kills, then one run that must complete.
     for attempt in range(4):
-        proc = subprocess.Popen(
-            cmd(resume_from), env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
         if attempt < 3:
-            # Kill at a random point of the run (the 8-20 s window spans
-            # startup, first steps, and checkpoint writes on this box).
-            _time.sleep(float(rng.uniform(8.0, 20.0)))
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGKILL)
-                proc.wait()
-            proc.stdout.close()
+            # Killed attempts write to a FILE: an undrained PIPE would
+            # fill at ~64 KB and freeze the child mid-print, so the kill
+            # would never land on in-flight training/checkpoint work.
+            with open(os.path.join(root, f"kill_{attempt}.log"), "w") as lf:
+                proc = subprocess.Popen(
+                    cmd(resume_from), env=env,
+                    stdout=lf, stderr=subprocess.STDOUT,
+                )
+                # Kill at a random point of the run (the 8-20 s window
+                # spans startup, first steps, and checkpoint writes on
+                # this box).
+                _time.sleep(float(rng.uniform(8.0, 20.0)))
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait()
             # Resume from the NEWEST run dir holding a complete rolling
             # checkpoint (the run dir created by a resumed attempt may
             # die before its first step save — fall back to the previous
@@ -275,6 +278,10 @@ def test_train_survives_repeated_sigkill(tmp_path):
                     resume_from = os.path.join(models, r, "step")
                     break
         else:
+            proc = subprocess.Popen(
+                cmd(resume_from), env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
             try:
                 out, _ = proc.communicate(timeout=600)
             except subprocess.TimeoutExpired:
