@@ -77,7 +77,7 @@ def _l1_kernel(ki, kj, kk, kl, si, sj, sk, sl, cout2, compute_dtype, both,
         valid = ((ii >= 0) & (ii < si) & (jj >= 0) & (jj < sj)).astype(
             jnp.float32
         )
-        plane = plane_refs[t][0, 0].astype(jnp.float32) * valid
+        plane = plane_refs[t][0, 0, 0].astype(jnp.float32) * valid
         pp = jnp.pad(plane, (margin, margin)).astype(compute_dtype)
         for off in offsets:
             cols.append(
@@ -166,7 +166,12 @@ def consensus_l1_pallas(w1, b1, corr4d, symmetric: bool = True,
         b_pair = b1.astype(jnp.float32)[None, :]
         cout2 = c_mid
 
-    y = flatten_planes(corr4d[0, 0].astype(dtype), sk, sl)  # [I, J, flat]
+    # [I, J, 1, flat]: the dummy axis makes each input block's LAST TWO
+    # dims (1, flat) EQUAL to the array dims — Mosaic rejects block
+    # shapes whose trailing two dims are neither (8, 128)-divisible nor
+    # full-extent, and the halo blocks here are one (i, j) cell each
+    # (observed on hardware 2026-08-01, docs/tpu_r04/session_0835.log).
+    y = flatten_planes(corr4d[0, 0].astype(dtype), sk, sl)[:, :, None, :]
 
     specs = []
     for di in range(ki):
@@ -176,10 +181,11 @@ def consensus_l1_pallas(w1, b1, corr4d, symmetric: bool = True,
                     jnp.clip(i + _di - ki // 2, 0, si - 1),
                     jnp.clip(j + _dj - kj // 2, 0, sj - 1),
                     0,
+                    0,
                 )
 
             specs.append(
-                pl.BlockSpec((1, 1, flat), imap, memory_space=pltpu.VMEM)
+                pl.BlockSpec((1, 1, 1, flat), imap, memory_space=pltpu.VMEM)
             )
 
     out_spec = pl.BlockSpec(
