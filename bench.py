@@ -62,6 +62,11 @@ def main():
         if os.environ.get("JAX_PLATFORMS") == "cpu":
             note("CPU backend also unreachable; aborting")
             os._exit(2)
+        if os.environ.get("NCNET_BENCH_NO_REEXEC"):
+            # In-process callers (tools/tpu_session.py): an execve here
+            # would silently replace the whole session with a CPU smoke.
+            note("backend dial failed — NCNET_BENCH_NO_REEXEC set, failing")
+            raise RuntimeError("bench dial failed (re-exec disabled)")
         note("backend dial failed — re-exec as CPU smoke run")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         env.pop("PALLAS_AXON_POOL_IPS", None)  # axon plugin hooks every proc

@@ -83,14 +83,18 @@ def main(argv=None):
         )
         return maxpool4d(corr, 2)
 
+    # Decision-value order: the production default (bigdot_ab) and the
+    # XLA reference land first so a mid-phase death (2026-08-01: the
+    # then-first candidate's cold reps-compile hung >20 min through every
+    # fence) still records the pair the kernel-vs-XLA default decision
+    # needs. t768 last: its compile vmem-OOMs (session 0646).
     candidates = {
-        "pallas_bigdot_ba": lambda a, b: fused_correlation_maxpool_pallas(
-            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot",
-            grid_order="ba",
-        ),
         "pallas_bigdot_ab": lambda a, b: fused_correlation_maxpool_pallas(
             a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot",
             grid_order="ab",
+        ),
+        "xla_slab": lambda a, b: fused_correlation_maxpool_xla(
+            a, b, k_size=2, corr_dtype=jnp.bfloat16
         ),
         # grid_order pinned on EVERY candidate: an inherited env override
         # would otherwise make lines incomparable across runs.
@@ -98,14 +102,15 @@ def main(argv=None):
             a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="dots",
             grid_order="ba",
         ),
+        "pallas_bigdot_ba": lambda a, b: fused_correlation_maxpool_pallas(
+            a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot",
+            grid_order="ba",
+        ),
+        "unfused": unfused,
         "pallas_bigdot_t768": lambda a, b: fused_correlation_maxpool_pallas(
             a, b, k_size=2, corr_dtype=jnp.bfloat16, kernel_impl="bigdot",
             tile_b_cells=768, grid_order="ba",
         ),
-        "xla_slab": lambda a, b: fused_correlation_maxpool_xla(
-            a, b, k_size=2, corr_dtype=jnp.bfloat16
-        ),
-        "unfused": unfused,
     }
 
     for name, fn in candidates.items():

@@ -123,29 +123,20 @@ def main(argv=None):
 
     threading.Thread(target=_watchdog, daemon=True).start()
 
-    for label, modname, phase_argv in phases:
-        if label in skip:
-            log(f"=== {label}: SKIPPED ===")
-            continue
-        log(f"=== {label} ===")
-        deadline[0] = _time.time() + 1500 + 180
-        try:
-            # 25 min per phase: one pathological compile must not starve
-            # the rest of the queue (observed 2026-07-31, see
-            # run_with_alarm). Individual tools add tighter per-candidate
-            # fences where hangs were actually seen.
-            run_with_alarm(1500, _load(modname).main, phase_argv)
-        except AlarmTimeout as exc:
-            log(f"{label} TIMED OUT: {exc}")
-        except SystemExit as exc:  # tools os._exit on dial fail only
-            log(f"{label} exited: {exc}")
-        except Exception:  # noqa: BLE001
-            log(f"{label} FAILED:\n{traceback.format_exc()}")
-        finally:
-            deadline[0] = None
-
+    # Bench matrix runs BEFORE the per-stage phases (flipped 2026-08-01):
+    # tunnel windows have measured ~30 min (08:31-09:03 this round), the
+    # matrix carries most of the knob verdicts (bb5/bb10, conv1fold,
+    # l1-pallas) in headline units, and its baseline run compiles the
+    # exact program the driver's round-end bench.py must find warm in the
+    # disk cache. The phases refine attribution afterwards if the window
+    # holds.
     if "bench" not in skip:
         os.environ["NCNET_BENCH_DIAL_TIMEOUT"] = "120"
+        # In-process bench must fail loudly, not fall back: standalone
+        # bench.py re-execs itself as a CPU smoke when the dial fails,
+        # which inside this session would silently replace the whole
+        # process (phases never run, rc=0, the loop logs success).
+        os.environ["NCNET_BENCH_NO_REEXEC"] = "1"
         # Headline A/B matrix via trace-time env knobs. The baseline run
         # must not inherit knobs left over from a prior manual experiment
         # — each run sets exactly its own dict and pops it afterwards.
@@ -174,23 +165,36 @@ def main(argv=None):
             # Round-3: pano-backbone batching (trace shows batch-1
             # backbone convs at 12-16% MXU util — NEXT.md round-3 note).
             ("default+bb5", {"NCNET_PANO_BACKBONE_BATCH": "5"}),
-            ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}),
             ("default+bb10", {"NCNET_PANO_BACKBONE_BATCH": "10"}),
-            ("default+bb5+l1-pallas",
-             {"NCNET_PANO_BACKBONE_BATCH": "5",
-              "NCNET_CONSENSUS_L1_PALLAS": "1"}),
             ("default+bb5+conv1fold",
              {"NCNET_PANO_BACKBONE_BATCH": "5",
               "NCNET_BACKBONE_CONV1_FOLD": "1"}),
+            # l1-pallas LAST: a fresh Mosaic kernel compile is the one
+            # class of program that has hung the remote-compile helper
+            # through every fence (l2-only, sessions 0522/0610; corr_pool
+            # 08:35 this round) — if it wedges, only these slots are lost.
+            ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}),
+            ("default+bb5+l1-pallas",
+             {"NCNET_PANO_BACKBONE_BATCH": "5",
+              "NCNET_CONSENSUS_L1_PALLAS": "1"}),
         ]
+        # Snapshot inherited knob overrides: the matrix must strip them so
+        # each run measures exactly its own dict, but the phases that now
+        # run AFTER the matrix must still see the operator's env (an
+        # inherited override silently cleared here would make every phase
+        # measure plain defaults while its log reads as the override's).
+        _matrix_knobs = (
+            "NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
+            "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD",
+            "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
+            "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_L1_PALLAS",
+            "NCNET_PANO_BACKBONE_BATCH", "NCNET_BACKBONE_CONV1_FOLD",
+            "NCNET_BENCH_HIT_PATH",
+        )
+        _inherited = {k: os.environ[k] for k in _matrix_knobs
+                      if k in os.environ}
         for run_label, env in bench_runs:
-            for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
-                      "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD",
-                      "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
-                      "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_L1_PALLAS",
-                      "NCNET_PANO_BACKBONE_BATCH",
-                      "NCNET_BACKBONE_CONV1_FOLD",
-                      "NCNET_BENCH_HIT_PATH"):
+            for k in _matrix_knobs:
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
@@ -208,6 +212,29 @@ def main(argv=None):
                 deadline[0] = None
                 for k in env:
                     os.environ.pop(k, None)
+        os.environ.update(_inherited)
+
+    for label, modname, phase_argv in phases:
+        if label in skip:
+            log(f"=== {label}: SKIPPED ===")
+            continue
+        log(f"=== {label} ===")
+        deadline[0] = _time.time() + 1500 + 180
+        try:
+            # 25 min per phase: one pathological compile must not starve
+            # the rest of the queue (observed 2026-07-31, see
+            # run_with_alarm). Individual tools add tighter per-candidate
+            # fences where hangs were actually seen.
+            run_with_alarm(1500, _load(modname).main, phase_argv)
+        except AlarmTimeout as exc:
+            log(f"{label} TIMED OUT: {exc}")
+        except SystemExit as exc:  # tools os._exit on dial fail only
+            log(f"{label} exited: {exc}")
+        except Exception:  # noqa: BLE001
+            log(f"{label} FAILED:\n{traceback.format_exc()}")
+        finally:
+            deadline[0] = None
+
     log("session DONE")
     return 0
 

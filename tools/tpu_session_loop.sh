@@ -22,9 +22,9 @@ for n in $(seq 1 "${NCNET_LOOP_ATTEMPTS:-80}"); do
     echo "=== session rc=$rc $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
     if [ "$rc" -eq 0 ]; then
       # Trimmed session landed — spend the rest of the tunnel window on
-      # the FULL measurement session (smoke already done; bench ran in
-      # the trimmed pass, so re-running it last refreshes bench_last
-      # with any defaults the phases inform).
+      # the FULL measurement session: the bench matrix re-runs first
+      # (warm cache, fast) and then the phases the trimmed pass skipped
+      # (corr_pool etc.) get their shot.
       if [ "$#" -gt 0 ]; then
         echo "=== chaining full session $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
         timeout 7200 python tools/tpu_session.py --dial_timeout 300 --skip smoke \
