@@ -160,7 +160,11 @@ def main():
         # per-pano scan keeps the HBM-bound corr/consensus tensors at
         # batch-1 size. Features for 10 panos at InLoc shape are ~0.6 GB
         # bf16 — cheap next to the 1.5 GB consensus activations.
-        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "1") or 1)
+        # Default 5 (promoted 2026-08-01, session_1128 bench matrix):
+        # bb5 9.69 pairs/s vs default-1 6.09 (+59%; backbone 84 -> 24
+        # ms/pair at 46% MFU). bb10 8.14 and bb5+conv1fold 9.24 LOSE —
+        # knobs kept, defaults stay off.
+        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "5") or 1)
 
         def match_from_feats(params, feat_a, feat_b):
             corr, delta = ncnet_forward_from_features(

@@ -386,7 +386,9 @@ def main(argv=None):
         # trace); batching feeds the MXU while the scan keeps the
         # HBM-bound corr/consensus tensors at batch-1 size. bench.py
         # carries the same knob.
-        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "1") or 1)
+        # Default 5 (promoted 2026-08-01, session_1128 bench matrix:
+        # 9.69 vs 6.09 pairs/s; bb10 and bb5+conv1fold both lose).
+        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "5") or 1)
 
         @jax.jit
         def pano_matches_batch(params, feat_a, tgt_stack):

@@ -78,11 +78,14 @@ def _l1_kernel(ki, kj, kk, kl, si, sj, sk, sl, cout2, compute_dtype, both,
             jnp.float32
         )
         plane = plane_refs[t][0, 0, 0].astype(jnp.float32) * valid
-        pp = jnp.pad(plane, (margin, margin)).astype(compute_dtype)
+        # Margin pad via concatenate + STATIC python slices: both
+        # lax.dynamic_slice_in_dim (even at a constant index) and lax.pad
+        # emit primitives Mosaic's TC lowering rejects (dynamic_slice
+        # observed on hardware 2026-08-01, session_1128 smoke).
+        zero = jnp.zeros((margin,), compute_dtype)
+        pp = jnp.concatenate([zero, plane.astype(compute_dtype), zero])
         for off in offsets:
-            cols.append(
-                lax.dynamic_slice_in_dim(pp, off, flat, axis=0)
-            )
+            cols.append(pp[off:off + flat])
     a = jnp.stack(cols, axis=-1)  # [flat, ki*kj*kk*kl]
     acc = jax.lax.dot_general(
         a,

@@ -308,12 +308,20 @@ def setup_compile_cache(path: str = ""):
 
     import jax
 
+    # Repo-local by default (NOT /tmp): the round-4 container restart wiped
+    # /tmp and cost every warm compile of the round — cold InLoc-shape
+    # compiles through the remote-compile helper are the single biggest
+    # tunnel-window tax (20-40 s each, pathological >20 min). The repo dir
+    # survives restarts; machine_tag keeps caches from different backends
+    # apart.
+    _repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     jax.config.update(
         "jax_compilation_cache_dir",
         path
         or os.environ.get(
             "NCNET_TPU_COMPILE_CACHE",
-            f"/tmp/ncnet_tpu_jax_cache_{os.getuid()}_{machine_tag()}",
+            os.path.join(_repo, ".jax_cache", machine_tag()),
         ),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

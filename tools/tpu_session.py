@@ -154,29 +154,26 @@ def main(argv=None):
         # Ordered by information value: if the tunnel dies mid-matrix we
         # want baseline -> the round-3 backbone-batching hypothesis ->
         # the l1-pallas verdict, in that order.
+        # Matrix updated 2026-08-01 after session_1128 decided the round-3
+        # knobs (bb5 PROMOTED to code default 9.69 vs 6.09; bb10 8.14 and
+        # bb5+conv1fold 9.24 LOSE — dropped from the matrix, knobs kept
+        # in code; numbers in docs/NEXT.md).
         bench_runs = [
-            ("default (nhwc)", {}),
-            # Round-4: cache-hit steady state of the cross-query pano
-            # feature cache (default ON in cli/eval_inloc.py) — the most
-            # important new evidence, so it rides right after baseline;
-            # its block also compiles fastest (no pano backbone). CPU
-            # pre-read: 5.7x.
+            # 'default' now means bb5 (the promoted code default).
+            ("default (bb5)", {}),
+            # Cache-hit steady state of the cross-query pano feature
+            # cache (default ON in cli/eval_inloc.py); its block also
+            # compiles fastest (no pano backbone).
             ("default+featcache-hit", {"NCNET_BENCH_HIT_PATH": "1"}),
-            # Round-3: pano-backbone batching (trace shows batch-1
-            # backbone convs at 12-16% MXU util — NEXT.md round-3 note).
-            ("default+bb5", {"NCNET_PANO_BACKBONE_BATCH": "5"}),
-            ("default+bb10", {"NCNET_PANO_BACKBONE_BATCH": "10"}),
-            ("default+bb5+conv1fold",
-             {"NCNET_PANO_BACKBONE_BATCH": "5",
-              "NCNET_BACKBONE_CONV1_FOLD": "1"}),
+            # Pre-promotion reference so a bb5 regression vs bb1 stays
+            # detectable session-over-session.
+            ("bb1 reference", {"NCNET_PANO_BACKBONE_BATCH": "1"}),
             # l1-pallas LAST: a fresh Mosaic kernel compile is the one
             # class of program that has hung the remote-compile helper
             # through every fence (l2-only, sessions 0522/0610; corr_pool
-            # 08:35 this round) — if it wedges, only these slots are lost.
+            # 08:35 this round) — if it wedges, only this slot is lost.
+            # (With bb5 the default, this line IS the bb5+l1 combo.)
             ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}),
-            ("default+bb5+l1-pallas",
-             {"NCNET_PANO_BACKBONE_BATCH": "5",
-              "NCNET_CONSENSUS_L1_PALLAS": "1"}),
         ]
         # Snapshot inherited knob overrides: the matrix must strip them so
         # each run measures exactly its own dict, but the phases that now
