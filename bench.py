@@ -164,7 +164,7 @@ def main():
         # bb5 9.69 pairs/s vs default-1 6.09 (+59%; backbone 84 -> 24
         # ms/pair at 46% MFU). bb10 8.14 and bb5+conv1fold 9.24 LOSE —
         # knobs kept, defaults stay off.
-        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "5") or 1)
+        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "5") or 5)
 
         def match_from_feats(params, feat_a, feat_b):
             corr, delta = ncnet_forward_from_features(
@@ -337,6 +337,7 @@ def main():
         )
 
         tdir = None
+        trace_ok = False
         try:
             tdir = tempfile.mkdtemp(prefix="ncnet_bench_trace_")
             note("capturing one traced block for the utilization table...")
@@ -346,6 +347,7 @@ def main():
                     run_block()
 
             run_with_alarm(300, _traced)
+            trace_ok = True
             agg = aggregate(tdir, steps=1)
             if agg is None:
                 note("trace has no accelerator op metadata (CPU smoke); "
@@ -371,10 +373,28 @@ def main():
         finally:
             # A full profiler capture is tens-to-hundreds of MB; the
             # round loop re-runs bench many times — don't leak them.
+            # NCNET_BENCH_KEEP_TRACE=<dir> preserves the capture there
+            # instead (ONE capture per dest: the bench block's scan-
+            # batched 'other' stage only exists in THIS trace, so the
+            # session keeps the baseline run's copy for
+            # tools/trace_optable.py).
             if tdir is not None:
                 import shutil
 
-                shutil.rmtree(tdir, ignore_errors=True)
+                keep = os.environ.get("NCNET_BENCH_KEEP_TRACE")
+                if keep and trace_ok:
+                    # Only replace a previously kept capture once THIS
+                    # capture completed — a timed-out/failed capture must
+                    # not clobber the last good one with partial garbage.
+                    shutil.rmtree(keep, ignore_errors=True)
+                    try:
+                        shutil.move(tdir, keep)
+                        note(f"trace kept at {keep}")
+                    except OSError as exc:
+                        note(f"trace keep failed ({exc}); dropping")
+                        shutil.rmtree(tdir, ignore_errors=True)
+                else:
+                    shutil.rmtree(tdir, ignore_errors=True)
 
     print(
         json.dumps(

@@ -388,7 +388,7 @@ def main(argv=None):
         # carries the same knob.
         # Default 5 (promoted 2026-08-01, session_1128 bench matrix:
         # 9.69 vs 6.09 pairs/s; bb10 and bb5+conv1fold both lose).
-        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "5") or 1)
+        bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "5") or 5)
 
         @jax.jit
         def pano_matches_batch(params, feat_a, tgt_stack):

@@ -66,29 +66,21 @@ def main(argv=None):
     # Tools re-dial internally; the backend is already up, so give them a
     # short watchdog — if the tunnel died between phases we want to move on,
     # not burn 10 minutes per remaining phase.
+    # Ordered by information value, with the two observed wedge classes
+    # LAST (reordered 2026-08-01 12:06): a fresh-shape reps-wrapped
+    # compile can hang the remote-compile helper through every fence
+    # (corr_pool at 08:35, consensus at 11:37 — both wedged their
+    # session at its FIRST standalone-stage compile and cost a hard
+    # exit + a 10-25 min tunnel wedge). The matrix + trace + train
+    # phases carry the round's open decisions; the standalone stage
+    # benches are refinement.
     phases = [
-        # Correctness first: both Pallas kernels vs their XLA oracles under
-        # real Mosaic (corr+pool AND the bidirectional extraction stats).
+        # Correctness: kernels vs oracles under real Mosaic (PASSED twice
+        # this round already — skip via loop args when windows are short).
         ("smoke", "pallas_tpu_smoke", ["--dial_timeout", "120"]),
-        ("corr_pool", "bench_corr_pool",
-         ["--dial_timeout", "120", "--iters", str(args.iters)]),
-        ("consensus", "bench_consensus",
-         ["--dial_timeout", "120", "--iters", str(args.iters)]),
-        ("extract", "bench_extract",
-         ["--dial_timeout", "120", "--iters", str(args.iters)]),
-        # Differential truth: the real step with stages knocked out one at
-        # a time — the only attribution that includes in-step fusion.
-        ("bisect", "bench_step_bisect",
-         ["--dial_timeout", "120", "--iters", str(args.iters)]),
         # Op-level truth: device trace of the headline step, parsed
         # in-process (top ops by self time into this log).
         ("trace", "trace_step", ["--dial_timeout", "120"]),
-        ("backbone", "bench_backbone",
-         ["--dial_timeout", "120", "--iters", str(args.iters)]),
-        ("profile", "profile_inloc",
-         ["--dial_timeout", "120", "--iters", str(args.iters)]),
-        ("conv4d", "bench_conv4d",
-         ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("train", "bench_train",
          ["--dial_timeout", "120", "--iters", "4",
           "--policies", "full,dots,none"]),
@@ -99,6 +91,24 @@ def main(argv=None):
         ("train_accum", "bench_train",
          ["--dial_timeout", "120", "--iters", "4", "--accum", "4",
           "--policies", "dots,none"]),
+        # Differential truth: the real step with stages knocked out one at
+        # a time — the only attribution that includes in-step fusion.
+        ("bisect", "bench_step_bisect",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("backbone", "bench_backbone",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("profile", "profile_inloc",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("conv4d", "bench_conv4d",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("extract", "bench_extract",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        # The two wedge-prone standalone stage benches, dead last: if one
+        # hangs, only refinement numbers are lost.
+        ("consensus", "bench_consensus",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        ("corr_pool", "bench_corr_pool",
+         ["--dial_timeout", "120", "--iters", str(args.iters)]),
     ]
     from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
 
@@ -159,8 +169,13 @@ def main(argv=None):
         # bb5+conv1fold 9.24 LOSE — dropped from the matrix, knobs kept
         # in code; numbers in docs/NEXT.md).
         bench_runs = [
-            # 'default' now means bb5 (the promoted code default).
-            ("default (bb5)", {}),
+            # 'default' now means bb5 (the promoted code default). Keep
+            # this run's trace: the scan-batched block's 'other' stage
+            # (77-99 ms/pair in session_1128, now the #1 cost) exists
+            # only in the bench block's own capture — read it with
+            # tools/trace_optable.py docs/tpu_r04/bench_trace.
+            ("default (bb5)",
+             {"NCNET_BENCH_KEEP_TRACE": "docs/tpu_r04/bench_trace"}),
             # Cache-hit steady state of the cross-query pano feature
             # cache (default ON in cli/eval_inloc.py); its block also
             # compiles fastest (no pano backbone).
@@ -186,7 +201,7 @@ def main(argv=None):
             "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
             "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_L1_PALLAS",
             "NCNET_PANO_BACKBONE_BATCH", "NCNET_BACKBONE_CONV1_FOLD",
-            "NCNET_BENCH_HIT_PATH",
+            "NCNET_BENCH_HIT_PATH", "NCNET_BENCH_KEEP_TRACE",
         )
         _inherited = {k: os.environ[k] for k in _matrix_knobs
                       if k in os.environ}
