@@ -1,0 +1,187 @@
+"""Static data-movement inventory of the headline block (no device needed).
+
+The session_1128 utilization tables put the scan-batched bench block's
+"other" stage at 77-99 ms/pair moving ~5.5 GB/pair at <10% HBM
+efficiency — but the capture that attributes it op-by-op only exists on
+hardware, and the tunnel wedges. This tool gets the STRUCTURAL half
+offline: it builds the exact bench block at TPU shapes, lowers it with
+jax.jit(...).lower() (abstract shapes only — works on CPU), and sums
+operand bytes of the data-movement StableHLO ops (transpose / gather /
+concatenate / pad / convert / dynamic-slice/update) grouped by the
+source file:line in their location metadata. Bytes-weighted, not
+time-weighted: XLA will fuse much of this away, so treat the table as a
+candidate list for the hardware trace to confirm, not a cost model.
+
+Usage: JAX_PLATFORMS=cpu python tools/hlo_inventory.py [--panos 10] [--bb 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+MOVE_OPS = (
+    "transpose", "gather", "scatter", "concatenate", "pad",
+    "dynamic_slice", "dynamic_update_slice", "convert", "reverse",
+    "broadcast_in_dim", "iota", "reshape",
+)
+
+_TY = re.compile(r"tensor<([0-9x]+)x(f32|bf16|f16|i32|s32|i8|u8|i64|s64|i1)>")
+_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "i32": 4, "s32": 4, "i8": 1,
+          "u8": 1, "i1": 1, "i64": 8, "s64": 8}
+_LOC = re.compile(r'"([^"]+\.py)":(\d+)')
+_LOC_NAME = re.compile(r'loc\("([^"]*)"')
+
+
+def tensor_bytes(ty: str) -> int:
+    m = _TY.search(ty)
+    if not m:
+        return 0
+    dims, dt = m.groups()
+    n = 1
+    for d in dims.split("x"):
+        if d:
+            n *= int(d)
+    return n * _BYTES[dt]
+
+
+def source_of(line: str, locs: dict) -> str:
+    """Resolve a (possibly nested: named-loc / callsite / alias) location
+    to file:line, preferring project frames over jax-internal ones."""
+    m = re.search(r"loc\(#loc(\d+)\)", line)
+    if m:
+        line = locs.get(m.group(1), line)
+    # Expand #locN refs transitively (the table nests named locs around
+    # callsites around file locs).
+    for _ in range(8):
+        refs = re.findall(r"#loc(\d+)", line)
+        if not refs:
+            break
+        for r in set(refs):
+            line = line.replace(f"#loc{r}", locs.get(r, ""))
+    files = _LOC.findall(line)
+    if files:
+        for f, n in files:
+            if "/ncnet_tpu/" in f or "/tools/" in f:
+                return f"{f}:{n}"
+        return f"{files[0][0]}:{files[0][1]}"
+    m = _LOC_NAME.search(line)
+    if m:
+        return m.group(1)
+    return "?"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--panos", type=int, default=10)
+    p.add_argument("--bb", type=int, default=0,
+                   help="pano-backbone batch (0 = current default)")
+    p.add_argument("--image", type=int, default=3200)
+    p.add_argument("--top", type=int, default=28)
+    args = p.parse_args(argv)
+    if args.bb:
+        os.environ["NCNET_PANO_BACKBONE_BATCH"] = str(args.bb)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.cli.eval_inloc import inloc_resize_shape, resolve_feat_units
+    from ncnet_tpu.evals import inloc_device_matches
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import (
+        extract_features,
+        ncnet_forward_from_features,
+    )
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(compute_dtype="bfloat16"),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=2,
+        half_precision=True,
+        use_fused_corr_pool=True,
+        fused_impl="xla",  # lowerable without Mosaic; same surrounding glue
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    units = resolve_feat_units(-1, args.image, 2)
+    h, w = inloc_resize_shape(args.image, args.image * 3 // 4, args.image, 2,
+                              h_unit=units[0], w_unit=units[1])
+    print(f"block: {args.panos} panos at {h}x{w}", flush=True)
+
+    bb = args.bb or int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "5") or 5)
+
+    def step(params, feat_a, tgt_feat):
+        corr, delta = ncnet_forward_from_features(
+            config, params, feat_a, tgt_feat, final_mutual=True
+        )
+        return inloc_device_matches(corr, delta4d=delta, k_size=2)
+
+    def block(params, src, tgts):
+        feat_a = extract_features(config, params, src)
+        n = tgts.shape[0]
+        nb = bb
+        while n % nb:
+            nb -= 1
+        groups = tgts.reshape(n // nb, nb, *tgts.shape[1:])
+        feats = jax.lax.map(
+            lambda g: jax.vmap(
+                lambda t: extract_features(config, params, t[None])[0]
+            )(g),
+            groups,
+        )
+        feats = feats.reshape(n, *feats.shape[2:])
+
+        def body(_, tf):
+            return None, step(params, feat_a, tf[None])
+
+        _, ms = jax.lax.scan(body, None, feats)
+        return ms
+
+    src = jax.ShapeDtypeStruct((1, 3, h, w), jnp.float32)
+    tgts = jax.ShapeDtypeStruct((args.panos, 3, h, w), jnp.float32)
+    lowered = jax.jit(block).lower(params, src, tgts)
+    try:
+        text = lowered.as_text(debug_info=True)
+    except TypeError:  # older jax: debug info always present
+        text = lowered.as_text()
+    print(f"stablehlo: {len(text) / 1e6:.1f} MB", flush=True)
+
+    # Trailing location table (#locN = "file":line:col)
+    locs = {}
+    for m in re.finditer(r"#loc(\d+) = loc\((.*)\)$", text, re.M):
+        locs[m.group(1)] = m.group(2)
+    # alias chains: #loc5 = loc(#loc3)
+    for k, v in list(locs.items()):
+        m = re.fullmatch(r"#loc(\d+)", v)
+        if m:
+            locs[k] = locs.get(m.group(1), v)
+
+    by_srcop = collections.Counter()
+    for line in text.splitlines():
+        ls = line.lstrip()
+        if not ls.startswith("%"):
+            continue
+        m = re.search(r"stablehlo\.(\w+)", ls)
+        if not m or m.group(1) not in MOVE_OPS:
+            continue
+        op = m.group(1)
+        nbytes = tensor_bytes(ls.rsplit("->", 1)[-1] if "->" in ls else ls)
+        src_file = source_of(ls, locs)
+        # strip to repo-relative tail
+        sf = re.sub(r"^.*/(ncnet_tpu|tools)/", r"\1/", src_file)
+        sf = re.sub(r'".*', "", sf).split(";")[0]
+        by_srcop[(op, sf)] += nbytes
+
+    print("\n-- data-movement output bytes by (op, source), top "
+          f"{args.top} (UNOPTIMIZED: XLA fuses much of this) --")
+    for (op, sf), b in by_srcop.most_common(args.top):
+        print(f"  {b / 1e9:8.2f} GB  {op:<22} {sf}")
+
+
+if __name__ == "__main__":
+    main()
