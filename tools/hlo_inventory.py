@@ -6,11 +6,13 @@ efficiency — but the capture that attributes it op-by-op only exists on
 hardware, and the tunnel wedges. This tool gets the STRUCTURAL half
 offline: it builds the exact bench block at TPU shapes, lowers it with
 jax.jit(...).lower() (abstract shapes only — works on CPU), and sums
-operand bytes of the data-movement StableHLO ops (transpose / gather /
+RESULT bytes of the data-movement StableHLO ops (transpose / gather /
 concatenate / pad / convert / dynamic-slice/update) grouped by the
-source file:line in their location metadata. Bytes-weighted, not
-time-weighted: XLA will fuse much of this away, so treat the table as a
-candidate list for the hardware trace to confirm, not a cost model.
+source file:line in their location metadata. Result bytes overstate
+broadcast/iota/pad (they read less than they write) and understate
+gather-style ops (huge operand, tiny result); and XLA will fuse much of
+this away — treat the table as "tensor volume flowing through movement
+ops", a candidate list for the hardware trace to confirm, not traffic.
 
 Usage: JAX_PLATFORMS=cpu python tools/hlo_inventory.py [--panos 10] [--bb 5]
 """
@@ -59,11 +61,12 @@ def source_of(line: str, locs: dict) -> str:
     # Expand #locN refs transitively (the table nests named locs around
     # callsites around file locs).
     for _ in range(8):
-        refs = re.findall(r"#loc(\d+)", line)
-        if not refs:
+        if "#loc" not in line:
             break
-        for r in set(refs):
-            line = line.replace(f"#loc{r}", locs.get(r, ""))
+        # re.sub (not str.replace): replacing "#loc1" textually would
+        # corrupt longer refs like "#loc12" on the same line.
+        line = re.sub(r"#loc(\d+)",
+                      lambda m: locs.get(m.group(1), ""), line)
     files = _LOC.findall(line)
     if files:
         for f, n in files:
@@ -123,23 +126,36 @@ def main(argv=None):
 
     def block(params, src, tgts):
         feat_a = extract_features(config, params, src)
-        n = tgts.shape[0]
-        nb = bb
-        while n % nb:
-            nb -= 1
-        groups = tgts.reshape(n // nb, nb, *tgts.shape[1:])
-        feats = jax.lax.map(
-            lambda g: jax.vmap(
-                lambda t: extract_features(config, params, t[None])[0]
-            )(g),
-            groups,
-        )
-        feats = feats.reshape(n, *feats.shape[2:])
 
-        def body(_, tf):
+        # Mirror bench.py's structure: bb>1 hoists batched pano backbones
+        # out of the scan; bb<=1 keeps the backbone INSIDE the scan body.
+        # (A structurally different program here would make the inventory
+        # incomparable to the traced bench block.)
+        if bb > 1:
+            n = tgts.shape[0]
+            nb = max(1, bb)
+            while n % nb:
+                nb -= 1
+            groups = tgts.reshape(n // nb, nb, *tgts.shape[1:])
+            feats = jax.lax.map(
+                lambda g: jax.vmap(
+                    lambda t: extract_features(config, params, t[None])[0]
+                )(g),
+                groups,
+            )
+            feats = feats.reshape(n, *feats.shape[2:])
+
+            def body(_, tf):
+                return None, step(params, feat_a, tf[None])
+
+            _, ms = jax.lax.scan(body, None, feats)
+            return ms
+
+        def body_full(_, t):
+            tf = extract_features(config, params, t[None])[0]
             return None, step(params, feat_a, tf[None])
 
-        _, ms = jax.lax.scan(body, None, feats)
+        _, ms = jax.lax.scan(body_full, None, tgts)
         return ms
 
     src = jax.ShapeDtypeStruct((1, 3, h, w), jnp.float32)

@@ -11,6 +11,31 @@ mkdir -p "$OUT"
 # observed the round window outlasting the default — size to the window.
 for n in $(seq 1 "${NCNET_LOOP_ATTEMPTS:-80}"); do
   echo "=== session-loop attempt $n $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
+  # Transport-layer forensics BEFORE the jax dial: "refused" = the remote
+  # tunnel service is down (nothing local helps; observed 12:05-? after
+  # the 11:28 session's hard exit), "timeout" = network/lease wedge,
+  # "open" + a failed dial = client-visible lease wedge.
+  python - >> "$OUT/session_loop.log" 2>&1 <<'PYEOF'
+import os, socket
+hp = os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
+if hp:
+    host, _, port = hp.rpartition(":")
+    if not host:
+        host, port = port, ""
+    try:
+        port_n = int(port or 8471)
+    except ValueError:
+        host, port_n = hp, 8471
+    s = socket.socket(); s.settimeout(5)
+    try:
+        s.connect((host, port_n)); print("  tcp: open")
+    except socket.timeout:
+        print("  tcp: timeout")
+    except OSError as e:
+        print(f"  tcp: {e.strerror or e}")
+    finally:
+        s.close()
+PYEOF
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "=== tunnel up; starting session $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
     # timeout: a tunnel wedge after a successful dial otherwise hangs the
