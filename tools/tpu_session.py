@@ -168,6 +168,9 @@ def main(argv=None):
         # knobs (bb5 PROMOTED to code default 9.69 vs 6.09; bb10 8.14 and
         # bb5+conv1fold 9.24 LOSE — dropped from the matrix, knobs kept
         # in code; numbers in docs/NEXT.md).
+        # (label, env, fence_s). Default fence matches the phases; 1500 s
+        # covers the documented >20 min XLA-extraction-tier compile hang
+        # class without starving the rest of the queue.
         bench_runs = [
             # 'default' now means bb5 (the promoted code default). Keep
             # this run's trace: the scan-batched block's 'other' stage
@@ -175,20 +178,24 @@ def main(argv=None):
             # only in the bench block's own capture — read it with
             # tools/trace_optable.py docs/tpu_r04/bench_trace.
             ("default (bb5)",
-             {"NCNET_BENCH_KEEP_TRACE": "docs/tpu_r04/bench_trace"}),
+             {"NCNET_BENCH_KEEP_TRACE": "docs/tpu_r04/bench_trace"}, 1500),
             # Cache-hit steady state of the cross-query pano feature
             # cache (default ON in cli/eval_inloc.py); its block also
             # compiles fastest (no pano backbone).
-            ("default+featcache-hit", {"NCNET_BENCH_HIT_PATH": "1"}),
+            ("default+featcache-hit", {"NCNET_BENCH_HIT_PATH": "1"}, 1500),
             # Pre-promotion reference so a bb5 regression vs bb1 stays
             # detectable session-over-session.
-            ("bb1 reference", {"NCNET_PANO_BACKBONE_BATCH": "1"}),
-            # l1-pallas LAST: a fresh Mosaic kernel compile is the one
-            # class of program that has hung the remote-compile helper
-            # through every fence (l2-only, sessions 0522/0610; corr_pool
-            # 08:35 this round) — if it wedges, only this slot is lost.
+            ("bb1 reference", {"NCNET_PANO_BACKBONE_BATCH": "1"}, 1500),
+            # l1-pallas LAST with a tight 420 s fence: a fresh Mosaic
+            # kernel compile is the one class of program that has hung
+            # the remote-compile helper through every fence (l2-only,
+            # sessions 0522/0610; corr_pool 08:35 this round). A healthy
+            # compile of this small kernel is well under 2 min; since a
+            # native-code wedge defeats SIGALRM and the deadline watchdog
+            # hard-exits the WHOLE session at fence+180 (phases and all),
+            # the tight fence caps that blast radius at ~10 min.
             # (With bb5 the default, this line IS the bb5+l1 combo.)
-            ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}),
+            ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}, 420),
         ]
         # Snapshot inherited knob overrides: the matrix must strip them so
         # each run measures exactly its own dict, but the phases that now
@@ -205,17 +212,19 @@ def main(argv=None):
         )
         _inherited = {k: os.environ[k] for k in _matrix_knobs
                       if k in os.environ}
-        for run_label, env in bench_runs:
+        for run_label, env, fence in bench_runs:
             for k in _matrix_knobs:
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
-            deadline[0] = _time.time() + 1500 + 180
+            deadline[0] = _time.time() + fence + 180
             try:
-                # Same fence as the phases: bench.py's fallback ladder can
-                # reach the XLA extraction tier whose InLoc-shape compile
-                # is the documented >20 min remote-compile hang.
-                run_with_alarm(1500, _load("../bench").main)
+                # Default fence matches the phases: bench.py's fallback
+                # ladder can reach the XLA extraction tier whose
+                # InLoc-shape compile is the documented >20 min
+                # remote-compile hang. Individual runs may carry a
+                # tighter fence (3rd tuple element).
+                run_with_alarm(fence, _load("../bench").main)
             except AlarmTimeout as exc:
                 log(f"bench[{run_label}] TIMED OUT: {exc}")
             except Exception:  # noqa: BLE001
