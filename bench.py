@@ -395,16 +395,34 @@ def main():
 
                 keep = os.environ.get("NCNET_BENCH_KEEP_TRACE")
                 if keep and trace_ok:
+                    # A cwd-relative keep path escapes the .gitignore'd
+                    # docs/ tree when bench runs from elsewhere — anchor
+                    # it to the repo root like the compile cache.
+                    if not os.path.isabs(keep):
+                        keep = os.path.join(os.path.dirname(
+                            os.path.abspath(__file__)), keep)
                     # Only replace a previously kept capture once THIS
-                    # capture completed — a timed-out/failed capture must
-                    # not clobber the last good one with partial garbage.
-                    shutil.rmtree(keep, ignore_errors=True)
+                    # capture is safely in place: stage the new one at a
+                    # temp sibling first so a failed move can't lose BOTH
+                    # the old and the new capture.
+                    staged = keep + ".tmp"
+                    shutil.rmtree(staged, ignore_errors=True)
                     try:
-                        shutil.move(tdir, keep)
-                        note(f"trace kept at {keep}")
+                        shutil.move(tdir, staged)
                     except OSError as exc:
                         note(f"trace keep failed ({exc}); dropping")
                         shutil.rmtree(tdir, ignore_errors=True)
+                        shutil.rmtree(staged, ignore_errors=True)
+                    else:
+                        try:
+                            shutil.rmtree(keep, ignore_errors=True)
+                            os.rename(staged, keep)
+                            note(f"trace kept at {keep}")
+                        except OSError as exc:
+                            # The staged dir is now the only complete
+                            # capture — leave it for manual recovery.
+                            note(f"trace keep rename failed ({exc}); "
+                                 f"capture left at {staged}")
                 else:
                     shutil.rmtree(tdir, ignore_errors=True)
 

@@ -137,16 +137,17 @@ def main(argv=None):
             while n % nb:
                 nb -= 1
             groups = tgts.reshape(n // nb, nb, *tgts.shape[1:])
+            # Direct batched extract over each group — the exact call
+            # bench.py makes. (vmap-of-batch-1 inserts extra broadcast/
+            # reshape ops into the unoptimized StableHLO and skews the
+            # movement-byte inventory this tool exists to mirror.)
             feats = jax.lax.map(
-                lambda g: jax.vmap(
-                    lambda t: extract_features(config, params, t[None])[0]
-                )(g),
-                groups,
+                lambda g: extract_features(config, params, g), groups
             )
-            feats = feats.reshape(n, *feats.shape[2:])
+            feats = feats.reshape(n, 1, *feats.shape[2:])
 
             def body(_, tf):
-                return None, step(params, feat_a, tf[None])
+                return None, step(params, feat_a, tf)
 
             _, ms = jax.lax.scan(body, None, feats)
             return ms

@@ -176,9 +176,9 @@ def main(argv=None):
             # this run's trace: the scan-batched block's 'other' stage
             # (77-99 ms/pair in session_1128, now the #1 cost) exists
             # only in the bench block's own capture — read it with
-            # tools/trace_optable.py docs/tpu_r04/bench_trace.
+            # tools/trace_optable.py docs/tpu_r05/bench_trace.
             ("default (bb5)",
-             {"NCNET_BENCH_KEEP_TRACE": "docs/tpu_r04/bench_trace"}, 1500),
+             {"NCNET_BENCH_KEEP_TRACE": "docs/tpu_r05/bench_trace"}, 1500),
             # Cache-hit steady state of the cross-query pano feature
             # cache (default ON in cli/eval_inloc.py); its block also
             # compiles fastest (no pano backbone).

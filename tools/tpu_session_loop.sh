@@ -5,7 +5,7 @@
 # probe attempts (a wedged tunnel needs 10-25 min to clear, and hammering
 # it with probes extends the wedge).
 cd /root/repo || exit 1
-OUT=docs/tpu_r04
+OUT=docs/tpu_r05
 mkdir -p "$OUT"
 # NCNET_LOOP_ATTEMPTS: ~5-7 min per attempt; 80 spans ~8 h. Round 4
 # observed the round window outlasting the default — size to the window.
@@ -19,22 +19,32 @@ for n in $(seq 1 "${NCNET_LOOP_ATTEMPTS:-80}"); do
 import os, socket
 hp = os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
 if hp:
-    host, _, port = hp.rpartition(":")
-    if not host:
-        host, port = port, ""
+    # Split host:port only for the two unambiguous forms — bracketed
+    # IPv6 '[::1]:8471', or a single-colon host with a numeric tail.
+    # A bare IPv6 literal ('::1', 'fe80::1') has >1 colon and is NOT a
+    # port split even when its last group is numeric; probing a mangled
+    # host would log a misleading DNS error instead of the transport
+    # state, defeating the forensic purpose of this line.
+    host, port_n = hp, 8471
+    if hp.startswith("["):
+        br, sep, port = hp.partition("]:")
+        if sep and port.isdigit():
+            host, port_n = br[1:], int(port)
+        elif hp.endswith("]"):
+            host = hp[1:-1]
+    elif hp.count(":") == 1:
+        h, _, port = hp.partition(":")
+        if h and port.isdigit():
+            host, port_n = h, int(port)
+    # create_connection auto-selects the address family (an AF_INET
+    # socket would turn every IPv6 literal into a resolver error).
     try:
-        port_n = int(port or 8471)
-    except ValueError:
-        host, port_n = hp, 8471
-    s = socket.socket(); s.settimeout(5)
-    try:
-        s.connect((host, port_n)); print("  tcp: open")
+        socket.create_connection((host, port_n), timeout=5).close()
+        print("  tcp: open")
     except socket.timeout:
         print("  tcp: timeout")
     except OSError as e:
         print(f"  tcp: {e.strerror or e}")
-    finally:
-        s.close()
 PYEOF
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "=== tunnel up; starting session $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"

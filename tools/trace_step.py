@@ -96,7 +96,7 @@ def main(argv=None):
     p.add_argument("--dial_timeout", type=float, default=600.0)
     p.add_argument("--image", type=int, default=3200)
     p.add_argument("--iters", type=int, default=3)  # accepted for session API
-    p.add_argument("--logdir", type=str, default="docs/tpu_r04/trace")
+    p.add_argument("--logdir", type=str, default="docs/tpu_r05/trace")
     p.add_argument("--parse_only", action="store_true")
     args = p.parse_args(argv)
 
