@@ -357,7 +357,22 @@ def main():
                 note("trace has no accelerator op metadata (CPU smoke); "
                      "utilization omitted")
             else:
+                # Capture-scaling invariant: attributed device time
+                # summed over ONE op line can never exceed the wall of
+                # the traced (synced) run. A violation means the
+                # aggregation double-counted (the session_1128
+                # umbrella-row artifact, fixed in traceagg.op_tids), the
+                # capture spanned extra work, or the plane carried
+                # several concurrent op lines (op_lines below tells
+                # which) — in every case the absolute ms are not wall-
+                # comparable and the block says so instead of publishing
+                # them silently. Relative stage shares stay meaningful.
+                scale_ok = (
+                    agg["total_ms"] <= traced_wall[0] * 1e3 * 1.05
+                )
                 util = {
+                    "scale_ok": scale_ok,
+                    "op_lines": agg.get("op_lines"),
                     "device_ms_per_pair": round(
                         agg["total_ms"] / panos_per_query, 2
                     ),

@@ -77,6 +77,42 @@ def device_pid(events) -> Optional[int]:
     return None
 
 
+def op_tids(events, pid) -> Optional[set]:
+    """tids of the device plane's per-op line(s), or None to accept all.
+
+    A capture's device plane carries several lines (tids): "XLA Ops"
+    (one X event per op execution) plus umbrella lines — "XLA Modules",
+    step markers, name-scope rollups. Summing across ALL lines double
+    counts: an umbrella event spans the very ops it contains, and newer
+    trace converters attach the same ``long_name``/cost args to it.
+    That is the 2026-08-01 session_1128 artifact (docs/NEXT.md): the
+    attributed device total came out ~1.9x the traced wall, and the
+    umbrella's sourceless share masqueraded as a dominant "other" stage
+    equal to the whole wall.
+
+    Prefer the line(s) literally named "XLA Ops"; when the converter
+    names differ, fall back to the single tid with the most op-level
+    events (umbrella lines have one event per module execution, the op
+    line has thousands); None only when no thread metadata exists.
+    """
+    names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name" \
+                and e.get("pid") == pid and "tid" in e:
+            names[e["tid"]] = e.get("args", {}).get("name", "")
+    ops_named = {t for t, n in names.items() if "XLA Ops" in n}
+    if ops_named:
+        return ops_named
+    counts = collections.Counter()
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") == pid and "tid" in e \
+                and "long_name" in (e.get("args") or {}):
+            counts[e["tid"]] += 1
+    if len(counts) > 1:
+        return {counts.most_common(1)[0][0]}
+    return None
+
+
 def stage_of(src: str) -> str:
     for sub, stage in STAGE_OF_SOURCE:
         if sub in src:
@@ -95,6 +131,7 @@ def aggregate(trace_dir: str, steps: int = 1) -> Optional[dict]:
     pid = device_pid(ev)
     if pid is None:
         return None
+    tids = op_tids(ev, pid)
 
     by_cat = collections.Counter()
     by_src = {}
@@ -105,6 +142,8 @@ def aggregate(trace_dir: str, steps: int = 1) -> Optional[dict]:
     for e in ev:
         if e.get("ph") != "X" or e.get("pid") != pid:
             continue
+        if tids is not None and e.get("tid") not in tids:
+            continue  # umbrella lines (modules/steps/name scopes)
         a = e.get("args") or {}
         if "long_name" not in a:  # umbrella program / host rows
             continue
@@ -139,6 +178,7 @@ def aggregate(trace_dir: str, steps: int = 1) -> Optional[dict]:
     return dict(
         path=path,
         steps=n,
+        op_lines=len(tids) if tids is not None else None,
         total_ms=tot_us / n / 1e3,
         total_gflops=tot_flops / n / 1e9,
         total_gb=tot_bytes / n / 1e9,

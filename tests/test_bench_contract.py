@@ -80,3 +80,49 @@ def test_traceagg_returns_none_for_cpu_trace(tmp_path):
     from ncnet_tpu.utils.traceagg import aggregate
 
     assert aggregate(str(tmp_path), steps=1) is None
+
+
+def test_traceagg_excludes_umbrella_rows(tmp_path):
+    """The session_1128 capture artifact (docs/NEXT.md): a converter that
+    attaches long_name/cost args to the "XLA Modules" umbrella line must
+    not double the attributed total — the umbrella spans the very ops it
+    contains and its sourceless share masquerades as an "other" stage
+    equal to the whole wall. op_tids pins aggregation to the op line."""
+    import gzip
+    import json
+
+    from ncnet_tpu.utils.traceagg import aggregate, stage_rollup
+
+    d = tmp_path / "plugins" / "profile" / "2026_08_02_00_00_00"
+    d.mkdir(parents=True)
+    meta = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+    ]
+    op = {"ph": "X", "pid": 3, "tid": 3, "ts": 0, "dur": 100.0,
+          "name": "fusion.1",
+          "args": {"long_name": "fusion.1", "model_flops": 1000,
+                   "bytes_accessed": 2000, "hlo_category": "fusion",
+                   "source": "ncnet_tpu/ops/conv4d.py"}}
+    op2 = dict(op, ts=100, dur=60.0, name="conv.2",
+               args=dict(op["args"], long_name="conv.2",
+                         source="ncnet_tpu/models/backbone.py"))
+    # The umbrella: ONE event spanning both ops, same metadata shape,
+    # no ncnet source file.
+    umbrella = {"ph": "X", "pid": 3, "tid": 2, "ts": 0, "dur": 160.0,
+                "name": "jit_block", "args": {"long_name": "jit_block",
+                "model_flops": 2000, "bytes_accessed": 4000,
+                "hlo_category": "module"}}
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": meta + [op, op2, umbrella]}, f)
+
+    agg = aggregate(str(tmp_path), steps=1)
+    assert agg is not None
+    assert abs(agg["total_ms"] - 0.160) < 1e-9  # ops only, not 0.320
+    stages = stage_rollup(agg)
+    assert "other" not in stages
+    assert set(stages) == {"consensus", "backbone"}
