@@ -310,7 +310,10 @@ def main():
 
     run_block()  # settle caches/queues
     note("timing...")
-    n_blocks = 3 if on_tpu else 1
+    # CPU smoke times 2 blocks: single-block timing showed +/-4% run-to-
+    # run scatter (2026-08-02 A/B), which is the size of the r03->r04
+    # smoke "regression" — outage-round numbers must be comparable.
+    n_blocks = 3 if on_tpu else 2
     t0 = time.perf_counter()
     for _ in range(n_blocks):
         run_block()

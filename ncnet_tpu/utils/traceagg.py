@@ -91,9 +91,12 @@ def op_tids(events, pid) -> Optional[set]:
     equal to the whole wall.
 
     Prefer the line(s) literally named "XLA Ops"; when the converter
-    names differ, fall back to the single tid with the most op-level
-    events (umbrella lines have one event per module execution, the op
-    line has thousands); None only when no thread metadata exists.
+    names differ, fall back to dropping umbrella-shaped lines by event
+    count — an umbrella line has one event per module execution, an op
+    line has orders of magnitude more, and a genuine concurrent per-core
+    op line has the same order as its siblings, so keeping every tid
+    within 10x of the busiest excludes umbrellas without halving a
+    multi-core capture. None (accept all) when nothing distinguishes.
     """
     names = {}
     for e in events:
@@ -109,7 +112,8 @@ def op_tids(events, pid) -> Optional[set]:
                 and "long_name" in (e.get("args") or {}):
             counts[e["tid"]] += 1
     if len(counts) > 1:
-        return {counts.most_common(1)[0][0]}
+        top = counts.most_common(1)[0][1]
+        return {t for t, c in counts.items() if c * 10 >= top}
     return None
 
 

@@ -15,7 +15,7 @@ for n in $(seq 1 "${NCNET_LOOP_ATTEMPTS:-80}"); do
   # tunnel service is down (nothing local helps; observed 12:05-? after
   # the 11:28 session's hard exit), "timeout" = network/lease wedge,
   # "open" + a failed dial = client-visible lease wedge.
-  python - >> "$OUT/session_loop.log" 2>&1 <<'PYEOF'
+  probe_out=$(python - 2>&1 <<'PYEOF'
 import os, socket
 hp = os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
 if hp:
@@ -46,6 +46,16 @@ if hp:
     except OSError as e:
         print(f"  tcp: {e.strerror or e}")
 PYEOF
+  )
+  echo "$probe_out" >> "$OUT/session_loop.log"
+  # A refused TCP probe means the remote service is down — the 120 s jax
+  # dial cannot succeed and only burns CPU against whatever else runs on
+  # this box (the round-end driver bench measured a -7% smoke regression
+  # under this loop's contention in round 4). Dial only when the probe
+  # says open/timeout or could not say (empty endpoint/unknown error).
+  case "$probe_out" in
+    *"refused"*) sleep 300; continue ;;
+  esac
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "=== tunnel up; starting session $(date -u +%FT%TZ) ===" >> "$OUT/session_loop.log"
     # timeout: a tunnel wedge after a successful dial otherwise hangs the
