@@ -1,0 +1,258 @@
+"""Honest steady-state throughput of the cross-query pano feature cache.
+
+VERDICT r4 weak #5: the bench's `featcache-hit` mode measures the
+ALL-HITS bound (12.35 pairs/s/chip on v5e, session_1128); the honest
+steady state depends on the real pano hit-rate over the InLoc eval's
+356-query x top-10 shortlist (`densePE_top100_shortlist_cvpr18.mat`,
+reference eval_inloc.py:34-35,103-104), which this sandbox cannot
+download. This tool measures the hit-rate on a POSE-GROUNDED replay of
+that shortlist structure instead:
+
+- Query stream: the 329 GT-registered InLoc queries from the reference's
+  committed `lib_matlab/DUC_refposes_all.mat` (DUC1 198 + DUC2 131), in
+  list order (capture order — the locality the LRU actually sees). Each
+  entry carries the query's camera pose P and the scan it registered to.
+- Database model: InLoc's retrieval database is perspective cutouts,
+  12 yaw x 3 pitch = 36 per scan (InLoc dataset convention). Scan
+  positions are approximated by the centroid of the camera centers of
+  the queries registered to each scan.
+- Retrieval surrogate: per query, cutouts score by scan distance plus
+  yaw mismatch against the query's viewing direction, top-10 kept —
+  a NetVLAD-shaped stand-in with the right spatial locality.
+- Cache: the REAL `PanoFeatureCache` (byte-bounded LRU), default budget
+  (eval_inloc `--pano_feature_cache_mb` 4096), real per-entry bytes for
+  the production feature shape (1024 x 192 x 144 f32 at the 3072x2304
+  resize bucket = 113.2 MB/pano). Entries are `np.broadcast_to` views:
+  `nbytes` reports the full virtual size, so accounting is honest while
+  the replay allocates nothing.
+
+Blended throughput folds the measured miss/hit rates (9.69 / 12.35
+pairs/s/chip, session_1128) over the simulated miss/hit counts. The
+retrieval surrogate is the one modeled component — the sweep over its
+locality knobs (and a no-locality worst case) brackets the answer.
+
+Run: python tools/cache_steady_state.py [--refposes PATH] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ncnet_tpu.evals.feature_cache import PanoFeatureCache  # noqa: E402
+
+REFPOSES_DEFAULT = "/root/reference/lib_matlab/DUC_refposes_all.mat"
+
+# Production feature-cache entry: resnet101 conv4 features of one pano at
+# the 3072x2304 resize bucket (feat stride 16 -> 192x144, 1024 ch, f32).
+ENTRY_SHAPE = (1024, 192, 144)
+ENTRY_DTYPE = np.float32
+
+# session_1128 measured rates, pairs/s/chip (docs/NEXT.md round-4 ledger).
+MISS_RATE = 9.6923
+HIT_RATE = 12.3481
+
+YAWS = 12          # cutouts per scan: 12 yaw x 3 pitch (InLoc convention)
+PITCHES = 3
+TOP_K = 10
+
+
+def load_queries(refposes_path: str):
+    """[(building, name, C(3,), yaw, scan_id)] in capture order."""
+    from scipy.io import loadmat
+
+    m = loadmat(refposes_path)
+    out = []
+    for bld in ("DUC1_RefList", "DUC2_RefList"):
+        for e in m[bld][0]:
+            P = np.asarray(e["P"], np.float64)
+            R, t = P[:, :3], P[:, 3]
+            C = -R.T @ t
+            # Camera forward axis in world frame; yaw on the floor plane.
+            fwd = R.T @ np.array([0.0, 0.0, 1.0])
+            yaw = math.atan2(fwd[1], fwd[0])
+            out.append((bld[:4], str(e["queryname"][0]), C, yaw,
+                        str(e["reldbname"][0])))
+    return out
+
+
+def synthetic_queries(n_per_bld=(198, 131), seed=0):
+    """Fallback stream when the refposes .mat is unavailable: a random
+    walk along corridors with a scan every few steps — same shape of
+    locality, none of the real geometry."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for b, n in enumerate(n_per_bld):
+        pos = np.zeros(2)
+        heading = 0.0
+        for i in range(n):
+            heading += float(rng.normal(0, 0.4))
+            pos = pos + 1.5 * np.array([math.cos(heading),
+                                        math.sin(heading)])
+            scan = f"B{b}_scan_{int(i // 3):03d}"
+            out.append((f"B{b}", f"q{i:04d}",
+                        np.array([pos[0], pos[1], 1.5]), heading, scan))
+    return out
+
+
+def build_scans(queries):
+    """scan_id -> centroid position of its registered queries."""
+    acc = {}
+    for _, _, C, _, scan in queries:
+        acc.setdefault(scan, []).append(C)
+    return {s: np.mean(cs, axis=0) for s, cs in acc.items()}
+
+
+def shortlist(q, scans, dist_scale=5.0, yaw_weight=1.0):
+    """Top-10 cutout paths for one query under the retrieval surrogate.
+
+    Score = distance(query, scan)/dist_scale + yaw_weight * yaw mismatch,
+    where the mismatch is the smaller of the cutout-facing's wrapped
+    difference to (a) the query's own viewing direction (both look at
+    the same scene) and (b) the scan->query bearing (the cutout shows
+    the area the query stands in) — either makes a retrieval-plausible
+    cutout. dist_scale=inf, yaw_weight=0 degrades to nearest-scan-only.
+    """
+    _, _, C, q_yaw, _ = q
+
+    def angdiff(a, b):
+        return abs((a - b + math.pi) % (2 * math.pi) - math.pi)
+
+    cands = []
+    for scan, pos in scans.items():
+        d = float(np.linalg.norm((C - pos)[:2]))
+        bearing = math.atan2(C[1] - pos[1], C[0] - pos[0])
+        for yi in range(YAWS):
+            cut_yaw = 2 * math.pi * yi / YAWS - math.pi
+            dy = min(angdiff(cut_yaw, q_yaw), angdiff(cut_yaw, bearing))
+            for pi in range(PITCHES):
+                score = d / dist_scale + yaw_weight * dy \
+                    + 0.1 * abs(pi - 1)
+                cands.append((score, f"{scan}/cutout_{yi:02d}_{pi}.jpg"))
+    cands.sort()
+    return [p for _, p in cands[:TOP_K]]
+
+
+def build_shortlists(queries, scans, dist_scale=5.0, yaw_weight=1.0):
+    """One top-10 cutout list per query (computed once per param set)."""
+    lists = []
+    for q in queries:
+        # DUC1 and DUC2 use independent coordinate frames — retrieval
+        # must only see the query's own building.
+        bld_scans = {s: p for s, p in scans.items() if s.startswith(q[0])}
+        lists.append(shortlist(q, bld_scans, dist_scale, yaw_weight))
+    return lists
+
+
+def replay(shortlists, cache_mb, disk_tier=False):
+    """Drive the real cache over precomputed shortlists; return stats.
+
+    disk_tier models eval_inloc --pano_feature_cache_dir WITHOUT the
+    113 MB-per-pano npz writes: an unbounded disk tier makes every
+    revisit a hit (get() promotes disk hits back into the memory LRU),
+    so feeding the real cache an effectively-infinite memory budget
+    reproduces the same hit/miss accounting the disk tier would see.
+    """
+    entry = np.broadcast_to(np.zeros((), ENTRY_DTYPE), ENTRY_SHAPE)
+    shape = (3072, 2304)
+    budget = (1 << 62) if disk_tier else cache_mb * 1024 * 1024
+    cache = PanoFeatureCache(budget)
+    uniq = set()
+    for cuts in shortlists:
+        for cut in cuts:
+            uniq.add(cut)
+            if cache.get(cut, shape) is None:
+                cache.put(cut, shape, entry)
+    total = cache.hits + cache.misses
+    hit_frac = cache.hits / total
+    blended = total / (cache.misses / MISS_RATE + cache.hits / HIT_RATE)
+    return dict(
+        pairs=total, unique_panos=len(uniq), hits=cache.hits,
+        misses=cache.misses,
+        hit_rate=round(hit_frac, 4),
+        blended_pairs_per_s=round(blended, 4),
+        resident_capacity=(None if disk_tier
+                           else cache.max_bytes // entry.nbytes),
+    )
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--refposes", default=REFPOSES_DEFAULT)
+    p.add_argument("--cache_mb", type=int, nargs="*",
+                   default=[4096, 8192, 16384])
+    p.add_argument("--json", action="store_true",
+                   help="one JSON line instead of the table")
+    p.add_argument("--synthetic", action="store_true",
+                   help="force the no-refposes fallback stream")
+    args = p.parse_args(argv)
+
+    if not args.synthetic and os.path.exists(args.refposes):
+        queries = load_queries(args.refposes)
+        source = args.refposes
+    else:
+        queries = synthetic_queries()
+        source = "synthetic-walk"
+    scans = build_scans(queries)
+
+    results = {}
+    default_lists = build_shortlists(queries, scans)
+    for mb in args.cache_mb:
+        results[f"mem_{mb}mb"] = replay(default_lists, mb)
+    # Disk tier: every revisit hits (promotes back to mem LRU).
+    results["disk_tier"] = replay(default_lists, 0, disk_tier=True)
+    # Locality sensitivity at the default budget: tighter / looser
+    # retrieval neighborhoods bracket the surrogate's one free knob.
+    for ds, yw, label in ((2.0, 2.0, "tight"), (10.0, 0.5, "loose")):
+        results[f"mem_4096mb_{label}"] = replay(
+            build_shortlists(queries, scans, ds, yw), 4096)
+    # Pessimistic pool: the refposes file only names scans with >=1
+    # registered query (58), but the DUC database has ~277 scans —
+    # unobserved scans still appear in real shortlists and dilute the
+    # overlap. Interpolate distractor scans between each scan and its
+    # two nearest same-building neighbors (corridor geometry) to triple
+    # the pool.
+    aug = dict(scans)
+    for s, p in scans.items():
+        bld = s[:4]
+        near = sorted(
+            (float(np.linalg.norm((p - p2)[:2])), s2)
+            for s2, p2 in scans.items() if s2 != s and s2[:4] == bld
+        )[:2]
+        for i, (_, s2) in enumerate(near):
+            aug[f"{bld}/distractor_{s.split('/')[-1]}_{i}"] = (
+                (p + scans[s2]) / 2.0)
+    results["mem_4096mb_distractors"] = replay(
+        build_shortlists(queries, aug), 4096)
+
+    out = dict(
+        source=source, n_queries=len(queries), n_scans=len(scans),
+        top_k=TOP_K, entry_mb=round(
+            np.prod(ENTRY_SHAPE) * 4 / 1e6, 1),
+        miss_rate=MISS_RATE, hit_rate_bound=HIT_RATE, results=results,
+    )
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"stream: {out['n_queries']} queries ({source}), "
+              f"{out['n_scans']} scans, top-{TOP_K} cutout shortlist")
+        print(f"entry: {out['entry_mb']} MB "
+              f"({ENTRY_SHAPE} {np.dtype(ENTRY_DTYPE).name})")
+        for label, r in results.items():
+            res = r["resident_capacity"]
+            print(f"  {label:22} unique={r['unique_panos']:4d} "
+                  f"hit={r['hit_rate']:.1%} "
+                  f"resident={'inf' if res is None else res:>4} "
+                  f"blended={r['blended_pairs_per_s']:.2f} pairs/s/chip")
+    return out
+
+
+if __name__ == "__main__":
+    main()
