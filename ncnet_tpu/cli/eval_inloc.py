@@ -242,8 +242,15 @@ def main(argv=None):
     )
     if args.checkpoint:
         # Key outputs by checkpoint so --resume never reuses another
-        # checkpoint's matches (parity: eval_inloc.py:69-71).
-        ckpt_name = os.path.basename(os.path.normpath(args.checkpoint)).split(".")[0]
+        # checkpoint's matches (parity: eval_inloc.py:69-71). Generic
+        # leaf names (every converted reference checkpoint ends in
+        # .../best) take the parent dir into the key, else two different
+        # conversions collide on CHECKPOINT_best and --resume silently
+        # scores the other model's matches.
+        parts = os.path.normpath(args.checkpoint).split(os.sep)
+        ckpt_name = parts[-1].split(".")[0]
+        if ckpt_name in ("best", "latest", "step") and len(parts) > 1:
+            ckpt_name = f"{parts[-2].split('.')[0]}_{ckpt_name}"
         experiment += f"_CHECKPOINT_{ckpt_name}"
     out_dir = os.path.join(args.output_dir, experiment)
     os.makedirs(out_dir, exist_ok=True)
@@ -510,6 +517,7 @@ def main(argv=None):
         pool.shutdown(wait=False, cancel_futures=True)
     if cache is not None:
         print(cache.stats(), flush=True)
+    return out_dir
 
 
 def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,

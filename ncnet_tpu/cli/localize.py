@@ -161,14 +161,23 @@ def main(argv=None):
     )
     print(f"wrote {poses_path}")
 
+    summary = None
     if args.gt_poses:
         if args.gt_poses.endswith(".npz"):
             with np.load(args.gt_poses, allow_pickle=True) as z:
                 gt = {str(q): P for q, P in zip(z["queries"], z["poses"])}
         else:
             raw = loadmat(args.gt_poses, squeeze_me=True, struct_as_record=False)
-            key = [k for k in raw if not k.startswith("__")][0]
-            gt = {str(r.queryname): np.asarray(r.P) for r in np.atleast_1d(raw[key])}
+            # Merge EVERY RefList variable: the reference's GT file
+            # (lib_matlab/DUC_refposes_all.mat) splits the 329 poses over
+            # DUC1_RefList + DUC2_RefList — reading one key would
+            # silently score only one building.
+            gt = {}
+            for key in raw:
+                if key.startswith("__"):
+                    continue
+                for r in np.atleast_1d(raw[key]):
+                    gt[str(r.queryname)] = np.asarray(r.P)
         pos_e, ori_e = evaluate_poses(results, gt)
         rates = localization_rate(pos_e, ori_e)
         curve_png = os.path.join(args.output_dir, "localization_curve.png")
@@ -177,9 +186,11 @@ def main(argv=None):
             "rate@0.25m": float(rates[np.searchsorted(DEFAULT_THRESHOLDS, 0.25)]),
             "rate@0.5m": float(rates[np.searchsorted(DEFAULT_THRESHOLDS, 0.5)]),
             "rate@1.0m": float(rates[np.searchsorted(DEFAULT_THRESHOLDS, 1.0)]),
+            "n_queries": len(results),
         }
         print(json.dumps(summary))
         print(f"wrote {curve_png}")
+    return summary
 
 
 if __name__ == "__main__":

@@ -236,12 +236,14 @@ class TSSDataset:
         return len(self.img_a)
 
     def __getitem__(self, idx):
+        # Column 3 is flip_img_A: ONLY the source is mirrored
+        # (tss_dataset.py:48-50 — image_B loads unflipped).
         flip = bool(self.flip[idx])
         image_a, size_a = load_and_resize_chw(
             os.path.join(self.dataset_path, self.img_a[idx]), self.out_h, self.out_w, flip
         )
         image_b, size_b = load_and_resize_chw(
-            os.path.join(self.dataset_path, self.img_b[idx]), self.out_h, self.out_w, flip
+            os.path.join(self.dataset_path, self.img_b[idx]), self.out_h, self.out_w, False
         )
         # GT flow lives next to the image pair; direction picks flow1/flow2.
         pair_dir = os.path.dirname(self.img_a[idx])

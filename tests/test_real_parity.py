@@ -42,6 +42,7 @@ def test_real_parity_runner_on_surrogate(tmp_path, capsys):
                 os.path.join(csv_dir, "test_pairs.csv"))
 
     rc = real_parity.main([
+        "--suite", "pfpascal",
         "--pth", str(pth),
         "--dataset_path", root,
         "--expected_pck", "-1",  # surrogate: no published number to match
@@ -60,6 +61,7 @@ def test_real_parity_runner_on_surrogate(tmp_path, capsys):
 
     # Second run reuses the existing conversion (idempotent).
     rc = real_parity.main([
+        "--suite", "pfpascal",
         "--pth", str(pth),
         "--dataset_path", root,
         "--expected_pck", "-1",
@@ -95,3 +97,208 @@ def test_real_parity_records_failed_fetch(tmp_path, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert "unable to resolve host" in out
     assert "FETCH FAILED" in out
+
+
+def _surrogate_pth(tmp_path, seed=3):
+    from tests.test_pth_tar_surrogate import (
+        _sequential_resnet_keys,
+        make_reference_pth_tar,
+        make_resnet_state_dict,
+    )
+
+    named_sd = make_resnet_state_dict("resnet101", stages=3, seed=seed)
+    pth = tmp_path / "ncnet_surrogate.pth.tar"
+    make_reference_pth_tar(
+        pth, _sequential_resnet_keys(named_sd), (3,), (1,)
+    )
+    return pth
+
+
+@pytest.mark.slow
+def test_real_parity_willow_suite(tmp_path, capsys):
+    """pfwillow suite on a staged Willow-layout dataset: report-only (no
+    gate), bbox PCK in [0, 1]."""
+    import csv as csvmod
+
+    from PIL import Image
+
+    import real_parity
+
+    pth = _surrogate_pth(tmp_path)
+    rng = np.random.default_rng(1)
+    root = tmp_path / "willow"
+    (root / "images").mkdir(parents=True)
+    names = []
+    for i in range(4):
+        n = f"images/w{i}.png"
+        Image.fromarray(
+            (rng.random((60, 80, 3)) * 255).astype("uint8")
+        ).save(root / n)
+        names.append(n)
+    px = ";".join(str(v) for v in np.linspace(8, 70, 10))
+    py = ";".join(str(v) for v in np.linspace(6, 52, 10))
+    with open(root / "test_pairs.csv", "w", newline="") as f:
+        w = csvmod.writer(f)
+        w.writerow(["imageA", "imageB", "XA", "YA", "XB", "YB"])
+        for i in range(0, 4, 2):
+            w.writerow([names[i], names[i + 1], px, py, px, py])
+
+    rc = real_parity.main([
+        "--suite", "pfwillow",
+        "--pth", str(pth),
+        "--willow_dataset_path", str(root),
+        "--image_size", "64", "--batch_size", "2", "--num_workers", "2",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["metric"] == "pf_willow_pck_at_0.1"
+    assert rec["suite"] == "pfwillow"
+    assert 0.0 <= rec["value"] <= 1.0
+    assert "parity" not in rec  # report-only
+
+
+@pytest.mark.slow
+def test_real_parity_tss_suite(tmp_path, capsys):
+    """tss suite: flows written AND scored against staged GT .flo (mean
+    EPE + flow-PCK fields present)."""
+    import csv as csvmod
+
+    from PIL import Image
+
+    import real_parity
+    from ncnet_tpu.geometry.flow_io import write_flo_file
+
+    pth = _surrogate_pth(tmp_path)
+    rng = np.random.default_rng(0)
+    root = tmp_path / "tss"
+    rows = []
+    # pair3 exercises the flip_img_A=1 scoring path (prediction
+    # re-indexed from the mirrored source grid before GT comparison).
+    for pair, flip in [("pair1", 0), ("pair2", 0), ("pair3", 1)]:
+        d = root / pair
+        d.mkdir(parents=True)
+        for name in ["image1.png", "image2.png"]:
+            Image.fromarray(
+                (rng.random((48, 64, 3)) * 255).astype("uint8")
+            ).save(d / name)
+        # GT flow at the source resolution: zero flow (self-consistent
+        # fixture; the surrogate net scores whatever it scores).
+        write_flo_file(np.zeros((48, 64, 2), np.float32),
+                       str(d / "flow1.flo"))
+        rows.append([f"{pair}/image1.png", f"{pair}/image2.png", 1, flip,
+                     "car"])
+    with open(root / "test_pairs.csv", "w", newline="") as f:
+        w = csvmod.writer(f)
+        w.writerow(["source", "target", "flow_direction", "flip",
+                    "category"])
+        w.writerows(rows)
+
+    rc = real_parity.main([
+        "--suite", "tss",
+        "--pth", str(pth),
+        "--tss_dataset_path", str(root),
+        "--flow_output_dir", str(tmp_path / "flows"),
+        "--image_size", "64", "--batch_size", "2", "--num_workers", "2",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["metric"] == "tss_flow"
+    assert rec["n_pairs"] == 3
+    assert rec["n_scored_vs_gt"] == 3
+    assert rec["mean_epe_px"] >= 0.0
+    assert 0.0 <= rec["flow_pck_at_0.05"] <= 1.0
+
+
+def test_real_parity_blocked_suites_record_and_continue(
+        tmp_path, capsys, monkeypatch):
+    """With no egress and nothing staged, every suite records a verbatim
+    'blocked' entry, the runner visits ALL suites, and exits 3."""
+    import real_parity
+
+    # Hermetic: REPO points at tmp (no trained_models/download.sh there),
+    # so every fetch fails fast without touching the network.
+    monkeypatch.setattr(real_parity, "REPO", str(tmp_path))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(SystemExit) as exc:
+        real_parity.main([
+            "--pth", str(tmp_path / "absent.pth.tar"),
+            "--ivd_pth", str(tmp_path / "absent_ivd.pth.tar"),
+            "--dataset_path", str(empty),
+            "--willow_dataset_path", str(empty),
+            "--tss_dataset_path", str(empty),
+            "--inloc_dataset_path", str(empty),
+        ])
+    assert exc.value.code == 3
+    out = capsys.readouterr().out
+    recs = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    summary = recs[-1]
+    assert summary["summary"] is True
+    assert set(summary["suites_blocked"]) == {"pfpascal", "pfwillow",
+                                              "tss", "inloc"}
+    blocked = [r for r in recs if "blocked" in r]
+    assert len(blocked) == 4
+
+
+@pytest.mark.slow
+def test_real_parity_inloc_suite(tmp_path, capsys):
+    """inloc suite full chain offline: staged shortlist + query/pano
+    images + cutout .mats + GT poses -> match stage -> localization ->
+    rate@ fields in the record."""
+    from PIL import Image
+    from scipy.io import savemat
+
+    import real_parity
+
+    pth = _surrogate_pth(tmp_path)
+    rng = np.random.default_rng(0)
+    root = tmp_path / "inloc"
+    for d in ("query", "pano", "cutouts"):
+        (root / d).mkdir(parents=True)
+    qnames = ["q0.jpg", "q1.jpg"]
+    pnames = ["p0.jpg", "p1.jpg"]
+    for n in qnames:
+        Image.fromarray((rng.random((96, 128, 3)) * 255).astype("uint8")
+                        ).save(root / "query" / n)
+    for n in pnames:
+        Image.fromarray((rng.random((96, 128, 3)) * 255).astype("uint8")
+                        ).save(root / "pano" / n)
+    img_list = np.zeros((1, 2), dtype=[("queryname", "O"),
+                                       ("topNname", "O")])
+    for q, qn in enumerate(qnames):
+        img_list[0, q]["queryname"] = qn
+        img_list[0, q]["topNname"] = np.array(
+            pnames, dtype=object).reshape(1, -1)
+    savemat(root / "shortlist.mat", {"ImgList": img_list})
+    # Cutout XYZ planes (named <pano>.mat as cli.localize expects).
+    ys, xs = np.meshgrid(np.arange(50), np.arange(50), indexing="ij")
+    world = np.stack([(xs - 25) * 0.1, (ys - 25) * 0.1,
+                      np.full(xs.shape, 6.0)], axis=-1)
+    for n in pnames:
+        savemat(root / "cutouts" / f"{n}.mat", {"XYZcut": world})
+    np.savez(tmp_path / "gt.npz",
+             queries=np.array(qnames),
+             poses=np.stack([np.eye(3, 4), np.eye(3, 4)]))
+
+    rc = real_parity.main([
+        "--suite", "inloc",
+        "--ivd_pth", str(pth),
+        "--inloc_shortlist", str(root / "shortlist.mat"),
+        "--inloc_query_path", str(root / "query"),
+        "--inloc_pano_path", str(root / "pano"),
+        "--inloc_cutout_path", str(root / "cutouts"),
+        "--inloc_transform_path", "none",
+        "--inloc_matches_dir", str(tmp_path / "matches"),
+        "--inloc_gt_poses", str(tmp_path / "gt.npz"),
+        "--inloc_image_size", "64",
+        "--inloc_n_queries", "2",
+        "--inloc_n_panos", "2",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["metric"] == "inloc_localization"
+    assert rec["n_queries"] == 2
+    assert "rate@0.25m" in rec and "rate@1.0m" in rec
