@@ -1,0 +1,35 @@
+"""tools/train_eval_pipeline.py: train -> checkpoint -> eval -> export
+-> reconvert as one run (VERDICT r4 missing #2 offline half)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_pipeline_end_to_end(tmp_path, capsys):
+    path = os.path.join(REPO, "tools", "train_eval_pipeline.py")
+    spec = importlib.util.spec_from_file_location("train_eval_pipeline",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    rc = mod.main([
+        "--out", str(tmp_path / "run"),
+        "--size", "48", "--image_size", "48",
+        "--epochs", "1", "--n_train", "8", "--batch_size", "4",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec = json.loads(
+        [l for l in out.splitlines() if l.startswith('{"pipeline"')][-1]
+    )
+    assert rec["roundtrip_exact"] is True
+    # The reconverted checkpoint must score IDENTICALLY — any resize/BN/
+    # layout divergence in export->convert would break this.
+    assert rec["pck"] == rec["pck_reconverted"]
+    assert rec["train_s"] > 0

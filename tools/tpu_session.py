@@ -14,8 +14,9 @@ leaves the highest-value numbers on disk.
 
 Usage:
     python tools/tpu_session.py [--dial_timeout 600] [--skip phase,phase]
-Phases: corr_pool, consensus, extract, backbone, profile, conv4d, train,
-bench.
+Phases (in run order): bench (the headline A/B matrix, always first),
+smoke, trace, train, train_accum, bisect, backbone, profile, conv4d,
+extract, train_e2e, consensus, corr_pool.
 """
 
 from __future__ import annotations
@@ -103,6 +104,14 @@ def main(argv=None):
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
         ("extract", "bench_extract",
          ["--dial_timeout", "120", "--iters", str(args.iters)]),
+        # VERDICT r4 #5b: the full train -> checkpoint -> eval -> export
+        # round trip ON HARDWARE (small corpus; proves the pipeline, not
+        # the model). One JSON line lands in this log. Runs LATE: its
+        # 96 px vgg programs are entirely fresh shapes, and a fresh-shape
+        # first compile is the documented wedge class — after this point
+        # only the two refinement stage benches are at risk.
+        ("train_e2e", "train_eval_pipeline",
+         ["--out", "/tmp/train_e2e_tpu", "--epochs", "2"]),
         # The two wedge-prone standalone stage benches, dead last: if one
         # hangs, only refinement numbers are lost.
         ("consensus", "bench_consensus",

@@ -1,0 +1,165 @@
+"""Train -> checkpoint -> eval -> export -> reconvert, as ONE pipeline.
+
+VERDICT r4 missing #2 / next-round #5b: the training loop and the eval
+harness are each tested, but no run had produced a checkpoint whose eval
+was then recorded, and no trained checkpoint had made the round trip
+through the reference .pth.tar format. This tool proves the whole chain
+on whatever backend is up (the TPU session runs it as its `train_e2e`
+phase; CPU covers the offline test):
+
+  1. build the synthetic affine-warp corpus (known GT correspondences —
+     tools/sanity_train_improves_pck.build_dataset);
+  2. train the reference recipe shape end-to-end (``cli/train.py``,
+     parity: train.py:39-41/191-206) to a best/ checkpoint;
+  3. eval PCK@0.1 from that checkpoint (``cli/eval_pf_pascal.py``);
+  4. export it to the reference's .pth.tar layout
+     (``cli/export_checkpoint.py``), reconvert it back
+     (``cli/convert_checkpoint.py``), verify bit-exactness, and re-eval
+     from the reconverted copy — the PCK must be identical.
+
+Emits ONE JSON line:
+  {"pipeline": "train_eval_export", "backend": ..., "pck": ...,
+   "pck_reconverted": ..., "roundtrip_exact": true, ...}
+
+Usage: python tools/train_eval_pipeline.py [--out DIR] [--size 96]
+           [--epochs 2] [--image_size 96] [--batch_size 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_sanity():
+    path = os.path.join(os.path.dirname(__file__),
+                        "sanity_train_improves_pck.py")
+    spec = importlib.util.spec_from_file_location("sanity_pck", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _params_equal(a, b):
+    import jax
+
+    leaves_a = jax.tree.leaves(a)
+    leaves_b = jax.tree.leaves(b)
+    if len(leaves_a) != len(leaves_b):
+        return False
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(leaves_a, leaves_b)
+    )
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="/tmp/train_eval_pipeline")
+    p.add_argument("--size", type=int, default=96,
+                   help="synthetic corpus image size")
+    p.add_argument("--image_size", type=int, default=96)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--n_train", type=int, default=24)
+    p.add_argument("--backbone", type=str, default="vgg",
+                   help="vgg keeps the CPU/offline path fast; the TPU "
+                   "session can pass resnet101 (the reference default)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    backend = jax.default_backend()
+    sanity = _load_sanity()
+    rng = np.random.default_rng(args.seed)
+    root = args.out
+    t0 = time.time()
+    sanity.build_dataset(root, rng, size=args.size,
+                         n_train=args.n_train)
+    print(f"[pipeline] corpus under {root}", flush=True)
+
+    # 2. Train end-to-end via the real CLI (weak inlier-count loss,
+    # checkpoints with config + optimizer state travelling along).
+    from ncnet_tpu.cli import train as train_cli
+
+    t_train = time.time()
+    train_cli.main([
+        "--dataset_image_path", root,
+        "--dataset_csv_path", os.path.join(root, "image_pairs"),
+        "--num_epochs", str(args.epochs),
+        "--batch_size", str(args.batch_size),
+        "--image_size", str(args.image_size),
+        "--backbone", args.backbone,
+        "--ncons_kernel_sizes", "3", "3",
+        "--ncons_channels", "16", "1",
+        "--result_model_dir", os.path.join(root, "models"),
+        "--num_workers", "2",
+        "--seed", str(args.seed),
+        "--log_interval", "10",
+    ])
+    train_s = time.time() - t_train
+    runs = os.path.join(root, "models")
+    run = max(os.listdir(runs),
+              key=lambda d: os.path.getmtime(os.path.join(runs, d)))
+    best = os.path.join(runs, run, "best")
+    print(f"[pipeline] trained checkpoint: {best}", flush=True)
+
+    # 3. Eval PCK from the trained checkpoint.
+    pck = sanity.run_pck(root, best, args.image_size)
+    print(f"[pipeline] PCK@0.1 from trained checkpoint: {pck:.2f}%",
+          flush=True)
+
+    # 4. Export to the reference layout, reconvert, verify, re-eval.
+    from ncnet_tpu.cli.convert_checkpoint import main as convert_main
+    from ncnet_tpu.cli.export_checkpoint import main as export_main
+    from ncnet_tpu.training.checkpoint import load_checkpoint
+
+    pth = os.path.join(root, "exported.pth.tar")
+    rc = export_main([best, pth])
+    if rc not in (0, None):
+        print(json.dumps({"pipeline": "train_eval_export",
+                          "error": f"export rc={rc}"}))
+        return 1
+    reconv = os.path.join(root, "reconverted")
+    rc = convert_main([pth, reconv])
+    if rc not in (0, None):
+        print(json.dumps({"pipeline": "train_eval_export",
+                          "error": f"reconvert rc={rc}"}))
+        return 1
+
+    params_a = load_checkpoint(best)["params"]
+    params_b = load_checkpoint(os.path.join(reconv, "best"))["params"]
+    exact = _params_equal(params_a, params_b)
+    pck_b = sanity.run_pck(root, os.path.join(reconv, "best"),
+                           args.image_size)
+    print(f"[pipeline] PCK@0.1 from reconverted checkpoint: {pck_b:.2f}%",
+          flush=True)
+
+    rec = {
+        "pipeline": "train_eval_export",
+        "backend": backend,
+        "backbone": args.backbone,
+        "epochs": args.epochs,
+        "n_train_pairs": args.n_train,
+        "image_size": args.image_size,
+        "train_s": round(train_s, 1),
+        "pck": pck,
+        "pck_reconverted": pck_b,
+        "roundtrip_exact": bool(exact),
+        "total_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(rec), flush=True)
+    return 0 if (exact and pck == pck_b) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
