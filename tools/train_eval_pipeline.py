@@ -123,18 +123,26 @@ def main(argv=None):
     from ncnet_tpu.cli.export_checkpoint import main as export_main
     from ncnet_tpu.training.checkpoint import load_checkpoint
 
+    # The converters signal verify failure by raising (export: assertion;
+    # convert: sys.exit(1)) — catch both so the structured JSON error
+    # record is what lands in the TPU session log.
     pth = os.path.join(root, "exported.pth.tar")
-    rc = export_main([best, pth])
-    if rc not in (0, None):
-        print(json.dumps({"pipeline": "train_eval_export",
-                          "error": f"export rc={rc}"}))
-        return 1
     reconv = os.path.join(root, "reconverted")
-    rc = convert_main([pth, reconv])
-    if rc not in (0, None):
-        print(json.dumps({"pipeline": "train_eval_export",
-                          "error": f"reconvert rc={rc}"}))
-        return 1
+    for step_name, fn, argv_ in (
+        ("export", export_main, [best, pth]),
+        ("reconvert", convert_main, [pth, reconv]),
+    ):
+        try:
+            rc = fn(argv_)
+        except (SystemExit, Exception) as exc:  # noqa: BLE001
+            print(json.dumps({"pipeline": "train_eval_export",
+                              "error": f"{step_name}: "
+                              f"{type(exc).__name__}: {exc}"}))
+            return 1
+        if rc not in (0, None):
+            print(json.dumps({"pipeline": "train_eval_export",
+                              "error": f"{step_name} rc={rc}"}))
+            return 1
 
     params_a = load_checkpoint(best)["params"]
     params_b = load_checkpoint(os.path.join(reconv, "best"))["params"]
