@@ -47,3 +47,37 @@ def test_refposes_replay_when_reference_present():
     # A query's shortlist must stay inside its own building.
     for q, cuts in zip(qs[:20], lists):
         assert all(c.startswith(q[0]) for c in cuts)
+
+
+def test_parts_corpus_generator(tmp_path):
+    """build_parts_dataset (sanity tool): inter-instance pairs with the
+    dataset-layout contract and in-bounds GT keypoints."""
+    import importlib.util
+
+    path = os.path.join(REPO, "tools", "sanity_train_improves_pck.py")
+    spec = importlib.util.spec_from_file_location("sanity_pck", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    root = str(tmp_path)
+    mod.build_parts_dataset(root, rng, size=64, n_train=3, n_val=1,
+                            n_test=2, n_kp=4)
+    import csv as csvmod
+
+    with open(os.path.join(root, "image_pairs", "test_pairs.csv")) as f:
+        rows = list(csvmod.reader(f))
+    assert rows[0] == ["source_image", "target_image", "class",
+                       "XA", "YA", "XB", "YB"]
+    assert len(rows) == 3
+    for r in rows[1:]:
+        xa = [float(v) for v in r[3].split(";")]
+        xb = [float(v) for v in r[5].split(";")]
+        assert len(xa) == 4 and len(xb) == 4
+        # Source and target keypoints differ (independent instances) yet
+        # both stay in the canonical interior band of the image.
+        assert xa != xb
+    with open(os.path.join(root, "image_pairs", "train_pairs.csv")) as f:
+        assert len(list(csvmod.reader(f))) == 4

@@ -85,6 +85,74 @@ def _warp(img, M):
     return out.astype("uint8")
 
 
+def build_parts_dataset(root, rng, size=96, n_train=24, n_val=4,
+                        n_test=8, n_kp=6):
+    """INTER-INSTANCE pairs: a fixed category layout of n_kp colored
+    parts, each pair = two independently-drawn instances (own affine
+    placement, own appearance jitter, own background). Matching requires
+    part-identity features, not pixel identity — the regime PF-Pascal's
+    intra-class pairs live in, and the one where the weak inlier-count
+    loss has signal TOWARD geometry (unlike same-image warp pairs, where
+    its optimum rewards score concentration; docs/NEXT.md item 7c)."""
+    os.makedirs(os.path.join(root, "images"), exist_ok=True)
+    os.makedirs(os.path.join(root, "image_pairs"), exist_ok=True)
+    from PIL import Image
+
+    # Category definition, fixed for the whole corpus: canonical part
+    # positions + identity colors (part i is findable across instances).
+    layout = rng.uniform(0.30, 0.70, (n_kp, 2)) * size
+    colors = rng.uniform(80, 255, (n_kp, 3))
+    radius = size * 0.055
+
+    def instance():
+        M = _affine(rng, size)
+        # centers = M applied to canonical layout (target->source form:
+        # here we just use M as a placement transform).
+        centers = layout @ M[:, :2].T + M[:, 2]
+        img = _texture(rng, size, cells=int(rng.integers(6, 12))) * 0.25
+        ys, xs = np.meshgrid(np.arange(size), np.arange(size),
+                             indexing="ij")
+        for k in range(n_kp):
+            col = np.clip(colors[k] + rng.normal(0, 18, 3), 0, 255)
+            r_k = radius * float(rng.uniform(0.85, 1.15))
+            d2 = (xs - centers[k, 0]) ** 2 + (ys - centers[k, 1]) ** 2
+            w = np.exp(-d2 / (2.0 * r_k * r_k))[..., None]
+            img = img * (1 - w) + col * w
+        return img.astype("uint8"), centers
+
+    def make_pair(i):
+        src, kp_src = instance()
+        tgt, kp_tgt = instance()
+        sn, tn = f"images/s{i}.png", f"images/t{i}.png"
+        Image.fromarray(src).save(os.path.join(root, sn))
+        Image.fromarray(tgt).save(os.path.join(root, tn))
+        return sn, tn, kp_src, kp_tgt
+
+    for split, n in (("train_pairs", n_train), ("val_pairs", n_val)):
+        with open(os.path.join(root, "image_pairs", f"{split}.csv"), "w",
+                  newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["source_image", "target_image", "class", "flip"])
+            for i in range(n):
+                sn, tn, _, _ = make_pair(f"{split}_{i}")
+                w.writerow([sn, tn, 1, 0])
+
+    with open(os.path.join(root, "image_pairs", "test_pairs.csv"), "w",
+              newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["source_image", "target_image", "class",
+                    "XA", "YA", "XB", "YB"])
+        for i in range(n_test):
+            sn, tn, kp_src, kp_tgt = make_pair(f"test_{i}")
+            w.writerow([
+                sn, tn, 1,
+                ";".join(f"{v:.2f}" for v in kp_src[:, 0]),
+                ";".join(f"{v:.2f}" for v in kp_src[:, 1]),
+                ";".join(f"{v:.2f}" for v in kp_tgt[:, 0]),
+                ";".join(f"{v:.2f}" for v in kp_tgt[:, 1]),
+            ])
+
+
 def build_dataset(root, rng, size=96, n_train=24, n_val=4, n_test=8, n_kp=8):
     os.makedirs(os.path.join(root, "images"), exist_ok=True)
     os.makedirs(os.path.join(root, "image_pairs"), exist_ok=True)
@@ -274,12 +342,19 @@ def main(argv=None):
     # correspondence InfoNCE before the weak-loss training, testing the
     # "meaningful features flip the PCK direction" prediction offline.
     p.add_argument("--pretrain_steps", type=int, default=0)
+    # 'warp' = same-image affine pairs (the item-7c fixed-point corpus);
+    # 'parts' = inter-instance pairs of one part-layout category —
+    # appearance differs, geometry correlates, the PF-Pascal regime.
+    p.add_argument("--corpus", choices=("warp", "parts"), default="warp")
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
     root = args.out
-    build_dataset(root, rng, size=args.size)
-    print(f"synthetic affine-pair dataset under {root}")
+    if args.corpus == "parts":
+        build_parts_dataset(root, rng, size=args.size)
+    else:
+        build_dataset(root, rng, size=args.size)
+    print(f"synthetic {args.corpus}-pair dataset under {root}")
 
     import jax
 
