@@ -1,0 +1,62 @@
+"""Dataset-acquisition scripts (SURVEY §2.4 item 26, the one partial):
+egress is dead in this sandbox, but the CODE half is testable — every
+fetch script must be valid shell, and the IVD make_dirs.sh must build
+the directory tree its urls.txt implies (the reference splits fetch into
+make_dirs + download; datasets/ivd/make_dirs.sh:1-4 here derives dirs
+from urls.txt instead of shipping a dirs.txt)."""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATASETS = os.path.join(REPO, "datasets")
+
+SCRIPTS = [
+    "fetch_pair_lists.sh",
+    "pf-pascal/download.sh",
+    "pf-willow/download.sh",
+    "tss/download.sh",
+    "inloc/download.sh",
+    "ivd/download.sh",
+    "ivd/make_dirs.sh",
+]
+
+
+@pytest.mark.parametrize("rel", SCRIPTS)
+def test_script_is_valid_shell(rel):
+    path = os.path.join(DATASETS, rel)
+    assert os.path.exists(path), rel
+    proc = subprocess.run(["bash", "-n", path], capture_output=True,
+                          text=True)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_ivd_make_dirs_builds_tree_from_urls(tmp_path):
+    """make_dirs.sh: unique dirnames of urls.txt's first column."""
+    with open(os.path.join(DATASETS, "ivd", "make_dirs.sh")) as f:
+        script = f.read()
+    (tmp_path / "urls.txt").write_text(
+        "be/Brussels/scene1/img1.jpg http://x/1.jpg\n"
+        "be/Brussels/scene1/img2.jpg http://x/2.jpg\n"
+        "fr/Paris/scene2/img3.jpg http://x/3.jpg\n"
+    )
+    proc = subprocess.run(["bash", "-c", script], cwd=str(tmp_path),
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert (tmp_path / "be/Brussels/scene1").is_dir()
+    assert (tmp_path / "fr/Paris/scene2").is_dir()
+
+
+def test_ivd_urls_file_schema():
+    """urls.txt rows are '<relative-output-path> <url>' — the contract
+    make_dirs.sh and download.sh both parse."""
+    path = os.path.join(DATASETS, "ivd", "urls.txt")
+    with open(path) as f:
+        rows = [l.split() for l in f if l.strip()]
+    assert rows, "urls.txt empty"
+    for r in rows[:50]:
+        assert len(r) == 2, r
+        assert not os.path.isabs(r[0])
+        assert r[1].startswith(("http://", "https://")), r
