@@ -86,43 +86,51 @@ def _warp(img, M):
 
 
 def build_parts_dataset(root, rng, size=96, n_train=24, n_val=4,
-                        n_test=8, n_kp=6):
-    """INTER-INSTANCE pairs: a fixed category layout of n_kp colored
-    parts, each pair = two independently-drawn instances (own affine
+                        n_test=8, n_kp=6, n_categories=4):
+    """INTER-INSTANCE pairs: n_categories part-layout categories, each
+    pair = two independently-drawn instances of ONE category (own affine
     placement, own appearance jitter, own background). Matching requires
     part-identity features, not pixel identity — the regime PF-Pascal's
-    intra-class pairs live in, and the one where the weak inlier-count
-    loss has signal TOWARD geometry (unlike same-image warp pairs, where
-    its optimum rewards score concentration; docs/NEXT.md item 7c)."""
+    intra-class pairs live in.
+
+    Multiple categories are ESSENTIAL for the weak loss: it forms
+    negatives by rolling within the batch (training/loss.py), and with a
+    single category a rolled "negative" is indistinguishable from a
+    positive — the loss then correctly suppresses all scores and the
+    model collapses (measured 2026-08-02: pretrained 14.58% -> 0.00%
+    after 50 epochs on a 1-category corpus). Categories are written
+    round-robin so a batch of n_categories holds one of each and every
+    roll-by-1 negative is cross-category — the PF-Pascal batch
+    statistics in miniature."""
     os.makedirs(os.path.join(root, "images"), exist_ok=True)
     os.makedirs(os.path.join(root, "image_pairs"), exist_ok=True)
     from PIL import Image
 
-    # Category definition, fixed for the whole corpus: canonical part
-    # positions + identity colors (part i is findable across instances).
-    layout = rng.uniform(0.30, 0.70, (n_kp, 2)) * size
-    colors = rng.uniform(80, 255, (n_kp, 3))
+    # Per-category definition, fixed for the corpus: canonical part
+    # positions + identity colors (part k of category c is findable
+    # across that category's instances, and looks unlike category c').
+    layouts = [rng.uniform(0.30, 0.70, (n_kp, 2)) * size
+               for _ in range(n_categories)]
+    colors = [rng.uniform(80, 255, (n_kp, 3)) for _ in range(n_categories)]
     radius = size * 0.055
 
-    def instance():
+    def instance(cat):
         M = _affine(rng, size)
-        # centers = M applied to canonical layout (target->source form:
-        # here we just use M as a placement transform).
-        centers = layout @ M[:, :2].T + M[:, 2]
+        centers = layouts[cat] @ M[:, :2].T + M[:, 2]
         img = _texture(rng, size, cells=int(rng.integers(6, 12))) * 0.25
         ys, xs = np.meshgrid(np.arange(size), np.arange(size),
                              indexing="ij")
         for k in range(n_kp):
-            col = np.clip(colors[k] + rng.normal(0, 18, 3), 0, 255)
+            col = np.clip(colors[cat][k] + rng.normal(0, 18, 3), 0, 255)
             r_k = radius * float(rng.uniform(0.85, 1.15))
             d2 = (xs - centers[k, 0]) ** 2 + (ys - centers[k, 1]) ** 2
             w = np.exp(-d2 / (2.0 * r_k * r_k))[..., None]
             img = img * (1 - w) + col * w
         return img.astype("uint8"), centers
 
-    def make_pair(i):
-        src, kp_src = instance()
-        tgt, kp_tgt = instance()
+    def make_pair(i, cat):
+        src, kp_src = instance(cat)
+        tgt, kp_tgt = instance(cat)
         sn, tn = f"images/s{i}.png", f"images/t{i}.png"
         Image.fromarray(src).save(os.path.join(root, sn))
         Image.fromarray(tgt).save(os.path.join(root, tn))
@@ -134,8 +142,9 @@ def build_parts_dataset(root, rng, size=96, n_train=24, n_val=4,
             w = csv.writer(f)
             w.writerow(["source_image", "target_image", "class", "flip"])
             for i in range(n):
-                sn, tn, _, _ = make_pair(f"{split}_{i}")
-                w.writerow([sn, tn, 1, 0])
+                cat = i % n_categories  # round-robin: see docstring
+                sn, tn, _, _ = make_pair(f"{split}_{i}", cat)
+                w.writerow([sn, tn, cat + 1, 0])
 
     with open(os.path.join(root, "image_pairs", "test_pairs.csv"), "w",
               newline="") as f:
@@ -143,9 +152,10 @@ def build_parts_dataset(root, rng, size=96, n_train=24, n_val=4,
         w.writerow(["source_image", "target_image", "class",
                     "XA", "YA", "XB", "YB"])
         for i in range(n_test):
-            sn, tn, kp_src, kp_tgt = make_pair(f"test_{i}")
+            cat = i % n_categories
+            sn, tn, kp_src, kp_tgt = make_pair(f"test_{i}", cat)
             w.writerow([
-                sn, tn, 1,
+                sn, tn, cat + 1,
                 ";".join(f"{v:.2f}" for v in kp_src[:, 0]),
                 ";".join(f"{v:.2f}" for v in kp_src[:, 1]),
                 ";".join(f"{v:.2f}" for v in kp_tgt[:, 0]),
@@ -351,7 +361,9 @@ def main(argv=None):
     rng = np.random.default_rng(args.seed)
     root = args.out
     if args.corpus == "parts":
-        build_parts_dataset(root, rng, size=args.size)
+        # 16 test pairs x 6 kp = 96 keypoints: ~1% PCK resolution (the
+        # 48-step warp-corpus table was noise-limited at 64 kp).
+        build_parts_dataset(root, rng, size=args.size, n_test=16)
     else:
         build_dataset(root, rng, size=args.size)
     print(f"synthetic {args.corpus}-pair dataset under {root}")
