@@ -363,13 +363,16 @@ def main():
                 # Capture-scaling invariant: attributed device time
                 # summed over ONE op line can never exceed the wall of
                 # the traced (synced) run. A violation means the
-                # aggregation double-counted (the session_1128
-                # umbrella-row artifact, fixed in traceagg.op_tids), the
-                # capture spanned extra work, or the plane carried
-                # several concurrent op lines (op_lines below tells
-                # which) — in every case the absolute ms are not wall-
-                # comparable and the block says so instead of publishing
-                # them silently. Relative stage shares stay meaningful.
+                # aggregation double-counted — session_1128's umbrella
+                # row (fixed in traceagg.op_tids) and round-5's nested
+                # `while` containers, whose span covers the very body
+                # ops emitted on the same line (fixed by self-time
+                # aggregation in traceagg.aggregate) — the capture
+                # spanned extra work, or the plane carried several
+                # concurrent op lines (op_lines below tells which) — in
+                # every case the absolute ms are not wall-comparable and
+                # the block says so instead of publishing them silently.
+                # Relative stage shares stay meaningful.
                 scale_ok = (
                     agg["total_ms"] <= traced_wall[0] * 1e3 * 1.05
                 )

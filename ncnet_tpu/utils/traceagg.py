@@ -90,20 +90,25 @@ def op_tids(events, pid) -> Optional[set]:
     umbrella's sourceless share masqueraded as a dominant "other" stage
     equal to the whole wall.
 
-    Prefer the line(s) literally named "XLA Ops"; when the converter
-    names differ, fall back to dropping umbrella-shaped lines by event
-    count — an umbrella line has one event per module execution, an op
-    line has orders of magnitude more, and a genuine concurrent per-core
-    op line has the same order as its siblings, so keeping every tid
-    within 10x of the busiest excludes umbrellas without halving a
-    multi-core capture. None (accept all) when nothing distinguishes.
+    Prefer the line(s) named exactly "XLA Ops" — a substring match also
+    catches "Async XLA Ops", an empty-or-DMA line whose presence made
+    the round-5 capture report op_lines=2 for a single-core trace. When
+    the converter names differ, fall back to dropping umbrella-shaped
+    lines by event count — an umbrella line has one event per module
+    execution, an op line has orders of magnitude more, and a genuine
+    concurrent per-core op line has the same order as its siblings, so
+    keeping every tid within 10x of the busiest excludes umbrellas
+    without halving a multi-core capture. None (accept all) when
+    nothing distinguishes.
     """
     names = {}
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "thread_name" \
                 and e.get("pid") == pid and "tid" in e:
             names[e["tid"]] = e.get("args", {}).get("name", "")
-    ops_named = {t for t, n in names.items() if "XLA Ops" in n}
+    ops_named = {t for t, n in names.items() if n == "XLA Ops"}
+    if not ops_named:
+        ops_named = {t for t, n in names.items() if "XLA Ops" in n}
     if ops_named:
         return ops_named
     counts = collections.Counter()
@@ -137,12 +142,19 @@ def aggregate(trace_dir: str, steps: int = 1) -> Optional[dict]:
         return None
     tids = op_tids(ev, pid)
 
-    by_cat = collections.Counter()
-    by_src = {}
-    ops = {}
-    tot_us = 0.0
-    tot_flops = 0.0
-    tot_bytes = 0.0
+    # The op line NESTS events flame-graph style: a control-flow
+    # container (`while`, `conditional`) is emitted as one X event whose
+    # span covers the per-iteration body ops, ALSO emitted on the same
+    # tid. The bb5 scan block's `while.5` (source bench.py, i.e. "other")
+    # carries device_duration/model_flops for its whole body — summing
+    # events flat double-counts every looped op (round-5 capture:
+    # Σdur 1.89 s over a 0.96 s line span) and books the body's share a
+    # second time under the container's sourceless "other" stage. The
+    # honest rule is SELF time/flops/bytes: each event minus what its
+    # same-line children already account for (clamped at 0 — a `while`
+    # condition adds real overhead beyond its children; a container
+    # whose metadata undercounts its body must not go negative).
+    per_tid = collections.defaultdict(list)
     for e in ev:
         if e.get("ph") != "X" or e.get("pid") != pid:
             continue
@@ -151,9 +163,18 @@ def aggregate(trace_dir: str, steps: int = 1) -> Optional[dict]:
         a = e.get("args") or {}
         if "long_name" not in a:  # umbrella program / host rows
             continue
-        d = float(e["dur"])  # microseconds
-        flops = float(a.get("model_flops", 0) or 0)
-        nbytes = float(a.get("bytes_accessed", 0) or 0)
+        per_tid[e["tid"]].append(e)
+
+    by_cat = collections.Counter()
+    by_src = {}
+    ops = {}
+    tot_us = 0.0
+    tot_flops = 0.0
+    tot_bytes = 0.0
+
+    def emit(e, d, flops, nbytes):
+        nonlocal tot_us, tot_flops, tot_bytes
+        a = e.get("args") or {}
         src = a.get("source", "<none>").split("/ncnet_tpu/")[-1]
         by_cat[a.get("hlo_category", "?")] += d
         s = by_src.setdefault(src, dict(us=0.0, flops=0.0, bytes=0.0))
@@ -174,6 +195,29 @@ def aggregate(trace_dir: str, steps: int = 1) -> Optional[dict]:
         op["us"] += d
         op["flops"] += flops
         op["bytes"] += nbytes
+
+    for evs in per_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -float(e.get("dur", 0))))
+        stack = []  # [end_ts, event, self_us, self_flops, self_bytes]
+        for e in evs:
+            a = e.get("args") or {}
+            ts = float(e["ts"])
+            d = float(e["dur"])
+            flops = float(a.get("model_flops", 0) or 0)
+            nbytes = float(a.get("bytes_accessed", 0) or 0)
+            while stack and stack[-1][0] <= ts:
+                fin = stack.pop()
+                emit(fin[1], max(fin[2], 0.0), max(fin[3], 0.0),
+                     max(fin[4], 0.0))
+            if stack:  # nested: charge only self share to the parent
+                stack[-1][2] -= d
+                stack[-1][3] -= flops
+                stack[-1][4] -= nbytes
+            stack.append([ts + d, e, d, flops, nbytes])
+        while stack:
+            fin = stack.pop()
+            emit(fin[1], max(fin[2], 0.0), max(fin[3], 0.0),
+                 max(fin[4], 0.0))
 
     if tot_us == 0.0:
         return None

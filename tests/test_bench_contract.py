@@ -126,3 +126,69 @@ def test_traceagg_excludes_umbrella_rows(tmp_path):
     stages = stage_rollup(agg)
     assert "other" not in stages
     assert set(stages) == {"consensus", "backbone"}
+
+
+def test_traceagg_self_time_for_nested_containers(tmp_path):
+    """The round-5 capture artifact: the op line nests flame-graph
+    style — a `while` container (the bb5 scan block, source bench.py)
+    spans the per-iteration body ops emitted on the SAME tid and carries
+    device_duration/model_flops for its whole body. Summing events flat
+    double-counts every looped op (observed: Σdur 1.89 s over a 0.96 s
+    span) and books the body's cost a second time under the container's
+    sourceless "other" stage. aggregate must charge each event only its
+    SELF share (duration/flops/bytes minus same-line children)."""
+    import gzip
+    import json
+
+    from ncnet_tpu.utils.traceagg import aggregate, stage_rollup
+
+    d = tmp_path / "plugins" / "profile" / "2026_08_02_00_00_00"
+    d.mkdir(parents=True)
+    meta = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        # An "Async XLA Ops" line must NOT count as a second op line
+        # (substring match made op_lines=2 on a single-core capture).
+        {"ph": "M", "pid": 3, "tid": 4, "name": "thread_name",
+         "args": {"name": "Async XLA Ops"}},
+    ]
+    body = {"ph": "X", "pid": 3, "tid": 3, "ts": 10, "dur": 80.0,
+            "name": "fusion.7",
+            "args": {"long_name": "fusion.7", "model_flops": 800,
+                     "bytes_accessed": 1600, "hlo_category": "fusion",
+                     "source": "ncnet_tpu/models/backbone.py"}}
+    body2 = dict(body, ts=95, dur=40.0, name="fusion.8",
+                 args=dict(body["args"], long_name="fusion.8",
+                           model_flops=400, bytes_accessed=800))
+    # The container: spans both body ops on the same line, metadata
+    # totals its body, source is the scan wrapper (stage "other").
+    outer = {"ph": "X", "pid": 3, "tid": 3, "ts": 0, "dur": 160.0,
+             "name": "while.5",
+             "args": {"long_name": "while.5", "model_flops": 1200,
+                      "bytes_accessed": 2400, "hlo_category": "while",
+                      "source": "bench.py"}}
+    tail = dict(body, ts=170, dur=40.0, name="conv.9",
+                args=dict(body["args"], long_name="conv.9",
+                          model_flops=100, bytes_accessed=200,
+                          source="ncnet_tpu/ops/conv4d.py"))
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": meta + [outer, body, body2, tail]}, f)
+
+    agg = aggregate(str(tmp_path), steps=1)
+    assert agg is not None
+    assert agg["op_lines"] == 1  # Async line excluded
+    # Top-level coverage: container 160 + tail 40, NOT 160+80+40+40.
+    assert abs(agg["total_ms"] - 0.200) < 1e-9
+    # FLOPs de-duplicated the same way: the bodies keep their 800+400
+    # under their OWN stages, the container's self share is
+    # 1200-800-400 = 0, and the tail adds 100 — total 1300, not
+    # 1200+800+400+100.
+    assert abs(agg["total_gflops"] * 1e9 - 1300.0) < 1e-6
+    stages = stage_rollup(agg)
+    # Container self time = 160 - 120 = 40 -> "other"; body ops keep
+    # their own stages at full duration.
+    assert abs(stages["backbone"]["ms"] - 0.120) < 1e-9
+    assert abs(stages["other"]["ms"] - 0.040) < 1e-9
+    assert abs(stages["consensus"]["ms"] - 0.040) < 1e-9

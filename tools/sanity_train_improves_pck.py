@@ -99,9 +99,11 @@ def build_parts_dataset(root, rng, size=96, n_train=24, n_val=4,
     positive — the loss then correctly suppresses all scores and the
     model collapses (measured 2026-08-02: pretrained 14.58% -> 0.00%
     after 50 epochs on a 1-category corpus). Categories are written
-    round-robin so a batch of n_categories holds one of each and every
-    roll-by-1 negative is cross-category — the PF-Pascal batch
-    statistics in miniature."""
+    round-robin, but cli/train.py shuffles each epoch, so a roll-by-1
+    negative is merely cross-category with HIGH PROBABILITY
+    (~1 - (n_per_cat-1)/(N-1)); occasional same-category "negatives"
+    remain — which IS the PF-Pascal regime (the reference train.py:88
+    also shuffles, and its 20-class batches collide the same way)."""
     os.makedirs(os.path.join(root, "images"), exist_ok=True)
     os.makedirs(os.path.join(root, "image_pairs"), exist_ok=True)
     from PIL import Image
