@@ -657,51 +657,18 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies):
 
     fwd_strategies, swap_strategies = strategies
 
-    # Layer-1 Pallas kernel (NCNET_CONSENSUS_L1_PALLAS=1, trace time):
-    # both symmetric branches' first layers evaluate in one MXU-shaped
-    # kernel (ops/consensus_kernels.py) and layer 2 continues in this
-    # channels-last path; only the 2-layer cin0=1 stacks qualify.
-    w1_shape = params[0]["weight"].shape
-    lp = -(-sl // 128) * 128  # keeps jax.experimental.pallas off the
-    # import path of callers that never take the kernel branch
-
-    if (
-        len(params) == 2
-        and b == 1
-        and w1_shape[4] == 1
-        and w1_shape[0] == w1_shape[2]  # extent-symmetric kernel: the
-        and w1_shape[1] == w1_shape[3]  # fused swapped branch reuses the
-        # forward tap enumeration (consensus_kernels preconditions)
-        and lp - sl >= w1_shape[3] // 2
-        and os.environ.get("NCNET_CONSENSUS_L1_PALLAS", "0")
-        in ("1", "interpret")
-    ):
-        from .consensus_kernels import consensus_l1_pallas
-
-        # "interpret" runs the kernel in the Pallas interpreter — the
-        # CPU hook that lets the END-TO-END integration branch (reshape /
-        # slice / swapped-layer-2 glue below) be parity-tested without
-        # hardware.
-        za_f, zb_f = consensus_l1_pallas(
-            params[0]["weight"], params[0]["bias"], corr,
-            symmetric=symmetric,
-            interpret=os.environ.get("NCNET_CONSENSUS_L1_PALLAS")
-            == "interpret",
-        )
-
-        def finish(z_f, swap):
-            z6 = z_f.reshape(si, sj, sk, lp, -1)[:, :, :, :sl][None]
-            w2 = params[1]["weight"]
-            strats = swap_strategies if swap else fwd_strategies
-            return layer_cl(
-                z6, swap_ab_weight(w2) if swap else w2,
-                params[1]["bias"], strats[1],
-            )
-
-        out = finish(za_f, False)
-        if symmetric:
-            out = out + finish(zb_f, True)
-        return jnp.transpose(out, (0, 5, 1, 2, 3, 4))
+    # A layer-1 Pallas kernel (one MXU dot over all 81 4-D taps per
+    # (i, j) cell, both symmetric branches stacked on output columns)
+    # lived here behind NCNET_CONSENSUS_L1_PALLAS through rounds 3-5.
+    # DELETED 2026-08-02 after the third distinct Mosaic lowering
+    # rejection on real hardware (round-3 BlockSpec shape rule, round-4
+    # `dynamic_slice`, round-5 "Input offsets outside of the first tile"
+    # at the margin-pad concatenate, docs/tpu_r05/session_0257.log): its
+    # flat-plane shift design needs lane-UNALIGNED (+-1 column) offsets,
+    # which Mosaic's TC lowering structurally rejects — a working rewrite
+    # would be a different kernel (shift matrices on the MXU), and the
+    # prize is bounded by the ~6 ms XLA layer-1, far below the layout-
+    # copy cost targeted by the strategy mixes above.
 
     def stack(x, swap):
         strats = swap_strategies if swap else fwd_strategies

@@ -337,6 +337,15 @@ def fused_correlation_maxpool_pallas(
     va_pad = -(-va // 8) * 8 if kernel_impl == "bigdot" else va
 
     if tile_b_cells == 0:
+        # NCNET_PALLAS_TILE_B_CELLS (trace time) overrides the VMEM-budget
+        # auto sizing for hardware sweeps (docs/NEXT.md: the 6 MB budget
+        # constant has never been tuned against measured per-shape
+        # timings); it passes through the same Mosaic validity checks
+        # below as an explicit argument would.
+        env_tile = os.environ.get("NCNET_PALLAS_TILE_B_CELLS")
+        if env_tile:
+            tile_b_cells = int(env_tile)
+    if tile_b_cells == 0:
         tile_b_cells = auto_tile_b_cells(k, va_pad, c, n_cells_b)
         if kernel_impl == "bigdot" and tile_b_cells % 128:
             # The bigdot kernel sub-slices its fused product at lane

@@ -66,7 +66,9 @@ def weak_loss(forward_fn, source_image, target_image, normalization: str = "soft
     return score_neg - score_pos
 
 
-def weak_loss_from_features(match_fn, feat_a, feat_b, normalization: str = "softmax"):
+def weak_loss_from_features(match_fn, feat_a, feat_b,
+                            normalization: str = "softmax",
+                            remat_policy=None):
     """Weak loss entered after feature extraction — half the backbone FLOPs.
 
     The backbone is per-image (and its BN runs in inference mode,
@@ -79,6 +81,9 @@ def weak_loss_from_features(match_fn, feat_a, feat_b, normalization: str = "soft
       match_fn: (feat_a, feat_b) -> corr4d (correlation pipeline closed over
         params, e.g. ncnet_forward_from_features).
       feat_a, feat_b: [b, c, h, w] backbone features.
+      remat_policy: caller default for the checkpoint policy below; the
+        NCNET_TRAIN_REMAT_POLICY env var still overrides (sweep knob).
+        None falls back to "dots" — the v5e-measured winner.
     """
     import jax
 
@@ -93,14 +98,19 @@ def weak_loss_from_features(match_fn, feat_a, feat_b, normalization: str = "soft
 
     # NCNET_TRAIN_REMAT_POLICY (trace time) tunes the memory/recompute
     # trade of this checkpoint — the round-2 campaign made the train step
-    # FIT (20 GB) but left it recompute-heavy (7.8 s/step at batch 16;
-    # docs/NEXT.md round-3 item 4):
-    #   "full" (default) save nothing, recompute each direction's pipeline;
-    #   "dots"           save MXU contraction results inside the pipeline
-    #                    (jax.checkpoint_policies.checkpoint_dots);
-    #   "none"           no checkpoint — both directions' activations live
-    #                    through the backward (fastest when they fit).
-    policy = os.environ.get("NCNET_TRAIN_REMAT_POLICY", "full")
+    # FIT (20 GB) but left it recompute-heavy. Hardware sweep (v5e,
+    # 2026-08-02 session_0257, reference schedule batch 16, 400 px):
+    #   "full"  45.9 s/step — save nothing, recompute each direction;
+    #   "dots"   5.4 s/step — save MXU contraction results
+    #            (jax.checkpoint_policies.checkpoint_dots); the batch-16
+    #            winner, promoted to the default;
+    #   "none"  fails to compile at batch 16 (no-remat AD exceeds HBM)
+    #           but WINS under --grad_accum 4 (4.5 vs 5.4 s/step: one
+    #           micro-batch of activations fits) — make_train_step
+    #           passes it as the caller default on the accum path.
+    policy = os.environ.get(
+        "NCNET_TRAIN_REMAT_POLICY", remat_policy or "dots"
+    )
     if policy == "none":
         pass
     elif policy == "dots":

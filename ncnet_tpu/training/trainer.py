@@ -174,7 +174,21 @@ def make_train_step(
             corr, _ = ncnet_forward_from_features(config, params, fa, fb)
             return corr
 
-        return weak_loss_from_features(match, feat_a, feat_b, normalization)
+        # Remat default per path (hardware-measured, see loss.py): a
+        # micro-batch of <= 4 pairs fits un-rematerialized ("none",
+        # 4.5 s/step at batch 16 x accum 4 on v5e) where the batch-16
+        # AD fails to compile and must save dots ("dots", 5.4 s/step).
+        # Larger micro-batches are unmeasured between those endpoints,
+        # so only the measured size gets the aggressive default;
+        # NCNET_TRAIN_REMAT_POLICY overrides. feat_a's leading dim IS
+        # the micro-batch at trace time (the accum path scans over
+        # micro-slices before calling loss_fn).
+        micro = feat_a.shape[0]
+        return weak_loss_from_features(
+            match, feat_a, feat_b, normalization,
+            remat_policy="none" if accum_steps > 1 and micro <= 4
+            else "dots",
+        )
 
     # Donate the updated-in-place buffers (params + opt state): XLA reuses
     # their device memory for the outputs instead of allocating fresh copies

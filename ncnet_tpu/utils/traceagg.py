@@ -43,7 +43,6 @@ STAGE_OF_SOURCE = (
     ("ops/pallas_kernels", "corr_pool"),
     ("ops/pool4d", "corr_pool"),
     ("ops/conv4d", "consensus"),
-    ("ops/consensus_kernels", "consensus"),
     ("ops/matches", "extract"),
     ("ops/extract_kernel", "extract"),
     ("ops/mutual", "extract"),

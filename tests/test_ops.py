@@ -537,22 +537,3 @@ def test_consensus_channels_last_path_parity(rng, symmetric, dtype, monkeypatch)
     )
 
 
-@pytest.mark.parametrize("symmetric", [True, False])
-def test_consensus_l1_pallas_integration_parity(rng, symmetric, monkeypatch):
-    """The NCNET_CONSENSUS_L1_PALLAS branch (kernel + the reshape/slice/
-    swapped-layer-2 glue in _consensus_oneshot_cl) == the plain stack,
-    end to end, via the interpret hook."""
-    import jax
-
-    from ncnet_tpu.ops.conv4d import neigh_consensus_apply, neigh_consensus_init
-
-    params = neigh_consensus_init(jax.random.PRNGKey(7), (3, 3), (16, 1))
-    x = jnp.asarray(rng.randn(1, 1, 5, 4, 6, 5).astype(np.float32))
-    monkeypatch.delenv("NCNET_CONSENSUS_L1_PALLAS", raising=False)
-    monkeypatch.setenv("NCNET_CONSENSUS_CL", "1")
-    want = neigh_consensus_apply(params, x, symmetric=symmetric, chunk_i=0)
-    monkeypatch.setenv("NCNET_CONSENSUS_L1_PALLAS", "interpret")
-    got = neigh_consensus_apply(params, x, symmetric=symmetric, chunk_i=0)
-    np.testing.assert_allclose(
-        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
-    )

@@ -69,6 +69,34 @@ def test_fused_pallas_tiling(rng, impl):
         np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
 
 
+def test_fused_pallas_tile_env_override(rng, monkeypatch):
+    """NCNET_PALLAS_TILE_B_CELLS (the hardware tile-sweep knob) takes the
+    same path as an explicit tile_b_cells and keeps output parity."""
+    from ncnet_tpu.ops import pallas_kernels
+
+    k = 2
+    fa = jnp.asarray(rng.randn(1, 8, 4, 4).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 8, 8, 8).astype(np.float32))
+    ref_pooled, ref_deltas = _oracle(fa, fb, k)
+    monkeypatch.setenv("NCNET_PALLAS_TILE_B_CELLS", "4")
+    # The override must actually short-circuit the auto sizing — a dead
+    # knob would still pass an output-parity check.
+    monkeypatch.setattr(
+        pallas_kernels, "auto_tile_b_cells",
+        lambda *a, **kw: (_ for _ in ()).throw(
+            AssertionError("auto sizing ran despite the env override")
+        ),
+    )
+    pooled, deltas = fused_correlation_maxpool_pallas(
+        fa, fb, k, interpret=True, kernel_impl="dots"
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled), np.asarray(ref_pooled), atol=1e-5
+    )
+    for d, rd in zip(deltas, ref_deltas):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(rd))
+
+
 @pytest.mark.parametrize("grid_order", ["ab", "ba"])
 @pytest.mark.parametrize("impl", ["bigdot", "dots"])
 def test_fused_pallas_ragged_tail_tile(rng, impl, grid_order):
@@ -326,41 +354,3 @@ def test_forward_fuse_corr_maxes_env_parity(rng, monkeypatch):
     np.testing.assert_array_equal(np.asarray(delta), np.asarray(base_delta))
 
 
-@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
-def test_consensus_l1_kernel_interpret_parity(rng, dtype):
-    """The layer-1 consensus kernel (both symmetric branches in one dot)
-    == relu(conv4d + bias) with the plain and swapped kernels, including
-    I/J edge taps, the flat-plane L padding, and pad-column zeroing."""
-    from ncnet_tpu.ops.conv4d import conv4d, swap_ab_weight
-    from ncnet_tpu.ops.consensus_kernels import (
-        consensus_l1_pallas,
-        unflatten_planes,
-        _lp,
-    )
-
-    si, sj, sk, sl, c = 5, 4, 6, 5, 7
-    corr = jnp.asarray(
-        rng.randn(1, 1, si, sj, sk, sl).astype(np.float32)
-    ).astype(dtype)
-    w1 = jnp.asarray(0.2 * rng.randn(3, 3, 3, 3, 1, c).astype(np.float32))
-    b1 = jnp.asarray(0.1 * rng.randn(c).astype(np.float32))
-
-    za_f, zb_f = consensus_l1_pallas(w1, b1, corr, interpret=True)
-    lp = _lp(sl)
-    tol = 1e-5 if dtype == jnp.float32 else 6e-2
-
-    for z_f, w in ((za_f, w1), (zb_f, swap_ab_weight(w1))):
-        want = jax.nn.relu(
-            conv4d(corr.astype(jnp.float32), w, b1)
-        )  # [1, c, I, J, K, L]
-        got = z_f.reshape(si, sj, sk, lp, c)[:, :, :, :sl]
-        got = jnp.transpose(got, (4, 0, 1, 2, 3))[None]
-        np.testing.assert_allclose(
-            np.asarray(got, np.float32), np.asarray(want, np.float32),
-            atol=tol, rtol=tol,
-        )
-        # Pad columns must be exactly zero (flat-shift consumers rely on
-        # it).
-        pads = np.asarray(z_f.reshape(si, sj, sk, lp, c)[:, :, :, sl:],
-                          np.float32)
-        assert (pads == 0).all()

@@ -195,16 +195,8 @@ def main(argv=None):
             # Pre-promotion reference so a bb5 regression vs bb1 stays
             # detectable session-over-session.
             ("bb1 reference", {"NCNET_PANO_BACKBONE_BATCH": "1"}, 1500),
-            # l1-pallas LAST with a tight 420 s fence: a fresh Mosaic
-            # kernel compile is the one class of program that has hung
-            # the remote-compile helper through every fence (l2-only,
-            # sessions 0522/0610; corr_pool 08:35 this round). A healthy
-            # compile of this small kernel is well under 2 min; since a
-            # native-code wedge defeats SIGALRM and the deadline watchdog
-            # hard-exits the WHOLE session at fence+180 (phases and all),
-            # the tight fence caps that blast radius at ~10 min.
-            # (With bb5 the default, this line IS the bb5+l1 combo.)
-            ("default+l1-pallas", {"NCNET_CONSENSUS_L1_PALLAS": "1"}, 420),
+            # (The default+l1-pallas line died 2026-08-02: third distinct
+            # Mosaic lowering rejection, kernel deleted — ops/conv4d.py.)
         ]
         # Snapshot inherited knob overrides: the matrix must strip them so
         # each run measures exactly its own dict, but the phases that now
@@ -215,7 +207,7 @@ def main(argv=None):
             "NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
             "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD",
             "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
-            "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_L1_PALLAS",
+            "NCNET_CONSENSUS_CL",
             "NCNET_PANO_BACKBONE_BATCH", "NCNET_BACKBONE_CONV1_FOLD",
             "NCNET_BENCH_HIT_PATH", "NCNET_BENCH_KEEP_TRACE",
         )
