@@ -1,0 +1,134 @@
+"""Mosaic lowering probe: can pltpu.roll express the consensus plane shifts?
+
+The deleted l1 kernel (see ops/conv4d.py) died on lane-UNALIGNED offsets:
+its flat [K*LP] layout made a (dk, dl) plane shift a concatenate/slice at
++-1 column, which Mosaic's TC lowering rejects three different ways. The
+fused-consensus plan keeps each (k, l) plane 2-D in VMEM and shifts with
+`pltpu.roll` (the documented lane/sublane rotate) + iota edge masks —
+zero-fill rotation == 'same' zero padding.
+
+This probe compiles and checks ONE grid step of that pattern on real
+Mosaic in seconds: a [sk, lp] block, all 9 (dk, dl) shifted copies via
+roll+mask, a [sk*lp/? , 9] x [9, c] dot. PASS/FAIL decides whether the
+fused consensus kernel is buildable before any real investment (the l1
+lesson: interpret-mode green says nothing about TC lowering).
+
+    python tools/probe_roll_kernel.py            # dials the tunnel
+    JAX_PLATFORMS=cpu ... --interpret            # CPU sanity of the probe
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dial_timeout", type=float, default=120.0)
+    p.add_argument("--interpret", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not args.interpret:
+        from ncnet_tpu.utils.profiling import dial_devices
+
+        if dial_devices(args.dial_timeout) is None:
+            print("dial timed out")
+            return 2
+
+    sk, sl, c = 16, 72, 8  # one (k, l) plane; lp pads 72 -> 128 lanes
+    lp = 128
+
+    def kernel(x_ref, w_ref, o_ref):
+        x = x_ref[...]  # [sk, lp], L zero-padded
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sk, lp), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sk, lp), 1)
+        taps = []
+        for dk in (-1, 0, 1):
+            for dl in (-1, 0, 1):
+                # roll + mask the wrap: rotation by (dk, dl) brings
+                # row/col (r - dk, c - dl) here; rows/cols whose source
+                # fell outside [0, sk) x [0, sl) contribute zero ('same'
+                # zero padding).
+                y = pltpu.roll(x, dk % sk, 0)
+                y = pltpu.roll(y, dl % lp, 1)
+                src_r = rows - dk
+                src_c = cols - dl
+                # Source in-bounds AND destination a real (non-pad)
+                # column: source masking alone keeps garbage out of
+                # VALID outputs, but a layered kernel wants pad columns
+                # exactly zero so no mask subtlety compounds per layer.
+                ok = (
+                    (src_r >= 0) & (src_r < sk)
+                    & (src_c >= 0) & (src_c < sl) & (cols < sl)
+                )
+                taps.append(jnp.where(ok, y, 0.0))
+        a = jnp.stack(taps, axis=-1)  # [sk, lp, 9]
+        acc = jax.lax.dot_general(
+            a.reshape(sk * lp, 9),
+            w_ref[...],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            # f32 probe oracle needs true-f32 MXU passes; the default
+            # single-bf16-pass precision shows ~4e-2 error at these
+            # magnitudes, which would masquerade as a roll/mask bug.
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        o_ref[...] = acc.reshape(sk, lp, c)
+
+    x = jnp.zeros((sk, lp), jnp.float32).at[:, :sl].set(
+        jnp.asarray(np.random.RandomState(0).randn(sk, sl), jnp.float32)
+    )
+    w = jnp.asarray(np.random.RandomState(1).randn(9, c), jnp.float32)
+
+    run = jax.jit(
+        lambda x, w: pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((sk, lp, c), jnp.float32),
+            interpret=args.interpret,
+        )(x, w)
+    )
+    t0 = time.perf_counter()
+    try:
+        got = np.asarray(run(x, w))
+    except Exception as exc:  # noqa: BLE001
+        print(f"FAIL compile/run ({type(exc).__name__}): {exc}")
+        return 1
+    dt = time.perf_counter() - t0
+
+    # Oracle: same-padded 3x3 conv over the [sk, sl] plane per channel.
+    xf = np.asarray(x)[:, :sl]
+    wf = np.asarray(w)
+    want = np.zeros((sk, sl, c), np.float32)
+    for t, (dk, dl) in enumerate(
+        (dk, dl) for dk in (-1, 0, 1) for dl in (-1, 0, 1)
+    ):
+        shifted = np.zeros_like(xf)
+        rs = slice(max(0, -dk), sk - max(0, dk))
+        rd = slice(max(0, dk), sk - max(0, -dk))
+        cs = slice(max(0, -dl), sl - max(0, dl))
+        cd = slice(max(0, dl), sl - max(0, -dl))
+        shifted[rd, cd] = xf[rs, cs]
+        want += shifted[..., None] * wf[t]
+    err = float(np.abs(got[:, :sl] - want).max())
+    pads = float(np.abs(got[:, sl:]).max())
+    ok = err < 1e-4 and pads == 0.0
+    print(
+        f"{'PASS' if ok else 'FAIL'} compile+run {dt:.1f}s "
+        f"max_abs_err={err:.3g} pad_cols_abs={pads:.3g}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

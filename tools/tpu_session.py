@@ -144,8 +144,9 @@ def main(argv=None):
 
     # Bench matrix runs BEFORE the per-stage phases (flipped 2026-08-01):
     # tunnel windows have measured ~30 min (08:31-09:03 this round), the
-    # matrix carries most of the knob verdicts (bb5/bb10, conv1fold,
-    # l1-pallas) in headline units, and its baseline run compiles the
+    # matrix carries the round's open knob verdicts in headline units
+    # (bb5/bb10, conv1fold, and l1-pallas were decided this way before
+    # their lines retired), and its baseline run compiles the
     # exact program the driver's round-end bench.py must find warm in the
     # disk cache. The phases refine attribution afterwards if the window
     # holds.
@@ -170,9 +171,8 @@ def main(argv=None):
         # and recorded in docs/NEXT.md; re-running them burns flaky
         # remote-compile budget (the 08:03 session lost two bench lines
         # to >25 min compiles).
-        # Ordered by information value: if the tunnel dies mid-matrix we
-        # want baseline -> the round-3 backbone-batching hypothesis ->
-        # the l1-pallas verdict, in that order.
+        # Ordered by information value: baseline (with kept trace)
+        # first, then the cache-hit and bb1 references.
         # Matrix updated 2026-08-01 after session_1128 decided the round-3
         # knobs (bb5 PROMOTED to code default 9.69 vs 6.09; bb10 8.14 and
         # bb5+conv1fold 9.24 LOSE — dropped from the matrix, knobs kept
