@@ -325,3 +325,75 @@ def setup_compile_cache(path: str = ""):
         ),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def run_bench_matrix(runs, *, dial_timeout=300.0, fence=1500.0,
+                     knobs=(), log=print):
+    """Shared driver for headline A/B matrices over trace-time env knobs.
+
+    One dial, then bench.py's main() in-process per (label, env) run,
+    each under a SIGALRM fence plus the hard-exit watchdog (a remote-
+    compile wait stuck in native code defers signal delivery forever —
+    the documented wedge class). Every knob in `knobs` is stripped
+    before each run so combos never leak between lines. Used by
+    tools/bench_strategies_ab.py and tools/bench_knob_ab.py; the fuller
+    tools/tpu_session.py keeps its own loop (it additionally snapshots
+    and restores operator-inherited overrides around the matrix).
+
+    Returns 0, or 2 when the dial timed out.
+    """
+    import importlib.util
+    import os
+    import threading
+    import time as _time
+    import traceback
+
+    setup_compile_cache()
+    log(f"dialing (watchdog {dial_timeout:.0f}s)...")
+    if dial_devices(dial_timeout) is None:
+        log("dial timed out; aborting")
+        return 2
+
+    deadline = [None]
+
+    def _watchdog():
+        while True:
+            _time.sleep(30)
+            d = deadline[0]
+            if d is not None and _time.time() > d:
+                log("watchdog: alarm never landed; hard-exiting")
+                os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    os.environ["NCNET_BENCH_DIAL_TIMEOUT"] = "120"
+    os.environ["NCNET_BENCH_NO_REEXEC"] = "1"
+
+    def _load_bench():
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "..",
+            "bench.py",
+        )
+        spec = importlib.util.spec_from_file_location("bench", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    for label, env in runs:
+        for k in knobs:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        log(f"=== bench[{label}] env={env} ===")
+        deadline[0] = _time.time() + fence + 180
+        try:
+            run_with_alarm(int(fence), _load_bench().main)
+        except AlarmTimeout as exc:
+            log(f"bench[{label}] TIMED OUT: {exc}")
+        except Exception:  # noqa: BLE001
+            log(f"bench[{label}] FAILED:\n{traceback.format_exc()}")
+        finally:
+            deadline[0] = None
+            for k in env:
+                os.environ.pop(k, None)
+    log("A/B DONE")
+    return 0

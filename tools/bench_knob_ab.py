@@ -1,0 +1,75 @@
+"""Generic headline A/B over trace-time env knobs (one dial, fenced runs).
+
+Sibling of bench_strategies_ab.py with the runs supplied on the command
+line — for quick hardware windows where editing a matrix in code wastes
+tunnel minutes:
+
+    python tools/bench_knob_ab.py \
+        "chunk25=NCNET_CONSENSUS_CHUNK_I:25" \
+        "ss=NCNET_CONSENSUS_STRATEGIES:conv2d_stacked,conv2d_stacked" \
+        "combo=NCNET_PANO_BACKBONE_BATCH:6;NCNET_BENCH_HIT_PATH:1" \
+        "anchor="
+
+Each arg is label=VAR:value[;VAR:value...] — ';' separates pairs so
+comma-valued knobs (the strategy lists) pass through. Empty env = an
+all-defaults anchor. Every run emits bench.py's one-line JSON to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+# Knobs any run may set; stripped before each run so combos never leak
+# between lines (mirrors tpu_session.py's matrix hygiene).
+KNOBS = (
+    "NCNET_CONSENSUS_STRATEGIES", "NCNET_FUSE_MUTUAL_EXTRACT",
+    "NCNET_FUSE_CORR_MAXES", "NCNET_CONSENSUS_KL_FOLD",
+    "NCNET_INLOC_FEAT_UNIT", "NCNET_BACKBONE_NHWC",
+    "NCNET_CONSENSUS_CL", "NCNET_CONSENSUS_CHUNK_I",
+    "NCNET_PANO_BACKBONE_BATCH", "NCNET_BACKBONE_CONV1_FOLD",
+    "NCNET_BENCH_HIT_PATH", "NCNET_BENCH_KEEP_TRACE",
+    "NCNET_PALLAS_TILE_B_CELLS", "NCNET_PALLAS_CORR_IMPL",
+    "NCNET_PALLAS_GRID_ORDER", "NCNET_EXTRACT_IMPL",
+)
+
+
+def log(msg):
+    print(f"[ab {time.time() - _T0:7.1f}s] {msg}", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("runs", nargs="+",
+                   help="label=VAR:value[;VAR:value...] per run")
+    p.add_argument("--dial_timeout", type=float, default=300.0)
+    p.add_argument("--fence", type=float, default=1500.0)
+    args = p.parse_args(argv)
+
+    runs = []
+    for spec in args.runs:
+        label, _, envspec = spec.partition("=")
+        env = {}
+        for pair in filter(None, envspec.split(";")):
+            var, _, val = pair.partition(":")
+            if var not in KNOBS:
+                raise SystemExit(f"unknown knob {var!r} in {spec!r}")
+            env[var] = val
+        runs.append((label, env))
+
+    from ncnet_tpu.utils.profiling import run_bench_matrix
+
+    return run_bench_matrix(
+        runs, dial_timeout=args.dial_timeout, fence=args.fence,
+        knobs=KNOBS, log=log,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,25 +6,27 @@ XLA layout copies around the two channels-last convs (conv4d.py:608/653
 — the MXU conv wants the 6912 A-cells on lanes `{0,3,2,1}` while the
 surrounding concat/slice/pad fusions emit `{1,2,3,0}`). The copies are a
 property of the per-layer decomposition mix, so A/B the mixes end to end
-in headline units: layer-1 'conv2d_stacked' pays an input-side concat
-copy pair, layer-2 'conv2d_outstacked' pays an output-side copy per
-symmetric branch. The default 'auto' is (stacked, outstacked) at the
+in headline units. The default 'auto' is (stacked, outstacked) at the
 InLoc (3,3)/(16,1) config (conv4d._auto_pick).
+
+MEASURED VERDICT (2026-08-02, docs/tpu_r05/ab_0401.log): all three
+non-auto mixes are HBM-INFEASIBLE at one-shot InLoc scale — layer-1
+outstacked and layer-2 stacked each materialize a bf16[6912,96,72,144]
+(18.3 GB) intermediate, every bench tier fails to allocate, and 'auto'
+remains the only mix that fits. The copies are the price of the only
+feasible formulation; see docs/NEXT.md "Consensus roofline verdict".
+Kept runnable for regression on future shapes/backends.
 
 Run AFTER tools/tpu_session.py finishes (one jax client at a time):
     python tools/bench_strategies_ab.py [--dial_timeout 300]
-Winner promotion: flip conv4d._auto_pick (and note the measurement in
-docs/NEXT.md) if a fixed mix beats 'auto' at the headline.
 """
 
 from __future__ import annotations
 
 import argparse
-import importlib.util
 import os
 import sys
 import time
-import traceback
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -35,72 +37,20 @@ def log(msg):
     print(f"[ab {time.time() - _T0:7.1f}s] {msg}", flush=True)
 
 
-def _load_bench():
-    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
-    spec = importlib.util.spec_from_file_location("bench", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--dial_timeout", type=float, default=300.0)
     p.add_argument("--keep_trace_dir", default="docs/tpu_r05/ab_trace",
-                   help="trace of the winning run (set per run below)")
+                   help="per-variant trace keep prefix")
     args = p.parse_args(argv)
 
-    from ncnet_tpu.utils.profiling import (
-        AlarmTimeout,
-        dial_devices,
-        run_with_alarm,
-        setup_compile_cache,
-    )
-
-    setup_compile_cache()
-    log(f"dialing (watchdog {args.dial_timeout:.0f}s)...")
-    devices = dial_devices(args.dial_timeout)
-    if devices is None:
-        log("dial timed out; aborting")
-        return 2
-    log(f"devices: {devices}")
-
-    # Hard backstop mirroring tpu_session.py: a remote-compile wait stuck
-    # in native code defers SIGALRM indefinitely; hard-exit past fence.
-    import threading
-
-    deadline = [None]
-
-    def _watchdog():
-        while True:
-            time.sleep(30)
-            d = deadline[0]
-            if d is not None and time.time() > d:
-                log("watchdog: alarm never landed; hard-exiting")
-                os._exit(3)
-
-    threading.Thread(target=_watchdog, daemon=True).start()
-
-    os.environ["NCNET_BENCH_DIAL_TIMEOUT"] = "120"
-    os.environ["NCNET_BENCH_NO_REEXEC"] = "1"
-
-    # Ordered by information value. Every non-default mix is a fresh XLA
-    # program at InLoc shape (disk cache cold) — the documented
-    # >20 min compile hang class gets the 1500 s fence + hard exit.
-    runs = [
-        # Hypothesis 1: outstacked layer-1 removes the input-side concat
-        # copy pair (conv4d.py:608, 99 ms/block) without touching the
-        # measured-good layer-2.
+    base_runs = [
         ("outstacked,outstacked",
          {"NCNET_CONSENSUS_STRATEGIES":
           "conv2d_outstacked,conv2d_outstacked"}),
-        # Hypothesis 2: stacked layer-2 removes the output-side copies
-        # (conv4d.py:653, 132 ms/block) at the price of a 144-feature
-        # input concat.
         ("stacked,stacked",
          {"NCNET_CONSENSUS_STRATEGIES":
           "conv2d_stacked,conv2d_stacked"}),
-        # The remaining mix (auto's mirror image).
         ("outstacked,stacked",
          {"NCNET_CONSENSUS_STRATEGIES":
           "conv2d_outstacked,conv2d_stacked"}),
@@ -108,31 +58,24 @@ def main(argv=None):
         # comparable run-over-run.
         ("auto anchor", {}),
     ]
-    for label, env in runs:
-        os.environ.pop("NCNET_CONSENSUS_STRATEGIES", None)
-        os.environ.pop("NCNET_BENCH_KEEP_TRACE", None)
-        os.environ.update(env)
+    runs = []
+    for label, env in base_runs:
         if env:
             # Keep each variant's capture so the copy table is checkable
             # without a re-run (small: one block's device plane).
-            os.environ["NCNET_BENCH_KEEP_TRACE"] = (
+            env = dict(env, NCNET_BENCH_KEEP_TRACE=(
                 args.keep_trace_dir + "_"
                 + label.replace(",", "_").replace(" ", "_")
-            )
-        log(f"=== bench[{label}] env={env} ===")
-        deadline[0] = time.time() + 1500 + 180
-        try:
-            run_with_alarm(1500, _load_bench().main)
-        except AlarmTimeout as exc:
-            log(f"bench[{label}] TIMED OUT: {exc}")
-        except Exception:  # noqa: BLE001
-            log(f"bench[{label}] FAILED:\n{traceback.format_exc()}")
-        finally:
-            deadline[0] = None
-            for k in env:
-                os.environ.pop(k, None)
-    log("A/B DONE")
-    return 0
+            ))
+        runs.append((label, env))
+
+    from ncnet_tpu.utils.profiling import run_bench_matrix
+
+    return run_bench_matrix(
+        runs, dial_timeout=args.dial_timeout,
+        knobs=("NCNET_CONSENSUS_STRATEGIES", "NCNET_BENCH_KEEP_TRACE"),
+        log=log,
+    )
 
 
 if __name__ == "__main__":

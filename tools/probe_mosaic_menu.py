@@ -1,0 +1,208 @@
+"""Mosaic primitive menu probe: which ops can the fused consensus kernel use?
+
+probe_roll_kernel.py proved the basic [sk, 128] roll+mask+dot pattern
+lowers. The full fused-consensus kernel has several candidate layouts
+whose feasibility turns on specific Mosaic lowerings; this probe compiles
+each in isolation on real hardware and prints a PASS/FAIL menu. The
+design doc in docs/NEXT.md picks the layout from this table:
+
+  lane_roll_xtile   roll the lane axis of [8, 1024] by 129 (crosses the
+                    128-lane tile boundary) — needed by the C-major flat
+                    layout ([c, K*LP]) where a (dk, dl) shift is one
+                    lane roll by dk*LP + dl.
+  sub_roll_big      roll the sublane axis of [1024, 32] by 129 — needed
+                    by the flat-M layout ([K*LP, c]) where the shift is
+                    a sublane roll.
+  sub_concat_odd    concatenate [1, N] rows at sublane offset 1 (build
+                    an [81, N] im2col by stacking tap rows).
+  reshape_lanes     [M, K*128] -> [M, K, 128] lane retiling (unflatten
+                    planes without a copy through HBM).
+  roll_rank3        pltpu.roll on axis 1 of [8, 64, 128] (roll a
+                    middle/sublane axis of a rank-3 block).
+  dyn_scratch       lax.fori_loop with dynamic leading-index load from
+                    an input block and accumulating store to a VMEM
+                    scratch buffer (the per-j inner loop + out_acc
+                    scatter pattern).
+
+Each case checks numerics against numpy, not just compilation.
+
+    python tools/probe_mosaic_menu.py              # dial + run all
+    JAX_PLATFORMS=cpu ... --interpret              # CPU sanity
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dial_timeout", type=float, default=120.0)
+    p.add_argument("--interpret", action="store_true")
+    p.add_argument("--only", default="", help="comma-separated case names")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not args.interpret:
+        from ncnet_tpu.utils.profiling import dial_devices
+
+        if dial_devices(args.dial_timeout) is None:
+            print("dial timed out")
+            return 2
+
+    rng = np.random.RandomState(0)
+    results = {}
+
+    def case(name, fn):
+        if args.only and name not in args.only.split(","):
+            return
+        t0 = time.perf_counter()
+        try:
+            err = float(fn())
+            ok = err < 1e-4
+            results[name] = (
+                f"{'PASS' if ok else 'NUMERIC-FAIL'} "
+                f"err={err:.3g} {time.perf_counter() - t0:.1f}s"
+            )
+        except Exception as exc:  # noqa: BLE001
+            msg = str(exc).split("\n")[0][:140]
+            results[name] = (
+                f"LOWER-FAIL ({type(exc).__name__}) {msg} "
+                f"{time.perf_counter() - t0:.1f}s"
+            )
+        print(f"  {name:16s} {results[name]}", flush=True)
+
+    def run1(kernel, out_sds, *xs):
+        return jax.jit(
+            lambda *a: pl.pallas_call(
+                kernel, out_shape=out_sds, interpret=args.interpret
+            )(*a)
+        )(*xs)
+
+    # -- lane_roll_xtile: [8, 1024] lanes rolled by 129 --------------------
+    def lane_roll_xtile():
+        x = jnp.asarray(rng.randn(8, 1024), jnp.float32)
+
+        def k(x_ref, o_ref):
+            o_ref[...] = pltpu.roll(x_ref[...], 129, 1)
+
+        got = np.asarray(
+            run1(k, jax.ShapeDtypeStruct((8, 1024), jnp.float32), x)
+        )
+        want = np.roll(np.asarray(x), 129, 1)
+        return np.abs(got - want).max()
+
+    case("lane_roll_xtile", lane_roll_xtile)
+
+    # -- sub_roll_big: [1024, 32] sublanes rolled by 129 -------------------
+    def sub_roll_big():
+        x = jnp.asarray(rng.randn(1024, 32), jnp.float32)
+
+        def k(x_ref, o_ref):
+            o_ref[...] = pltpu.roll(x_ref[...], 129, 0)
+
+        got = np.asarray(
+            run1(k, jax.ShapeDtypeStruct((1024, 32), jnp.float32), x)
+        )
+        want = np.roll(np.asarray(x), 129, 0)
+        return np.abs(got - want).max()
+
+    case("sub_roll_big", sub_roll_big)
+
+    # -- sub_concat_odd: stack 81 [1, N] rows ------------------------------
+    def sub_concat_odd():
+        x = jnp.asarray(rng.randn(1, 512), jnp.float32)
+
+        def k(x_ref, o_ref):
+            rows = [x_ref[...] * float(i) for i in range(81)]
+            o_ref[...] = jnp.concatenate(rows, axis=0)
+
+        got = np.asarray(
+            run1(k, jax.ShapeDtypeStruct((81, 512), jnp.float32), x)
+        )
+        want = np.concatenate(
+            [np.asarray(x) * float(i) for i in range(81)], 0
+        )
+        return np.abs(got - want).max()
+
+    case("sub_concat_odd", sub_concat_odd)
+
+    # -- reshape_lanes: [16, 8*128] -> [16, 8, 128] ------------------------
+    def reshape_lanes():
+        x = jnp.asarray(rng.randn(16, 1024), jnp.float32)
+
+        def k(x_ref, o_ref):
+            o_ref[...] = x_ref[...].reshape(16, 8, 128)
+
+        got = np.asarray(
+            run1(k, jax.ShapeDtypeStruct((16, 8, 128), jnp.float32), x)
+        )
+        want = np.asarray(x).reshape(16, 8, 128)
+        return np.abs(got - want).max()
+
+    case("reshape_lanes", reshape_lanes)
+
+    # -- roll_rank3: roll axis 1 of [8, 64, 128] ---------------------------
+    def roll_rank3():
+        x = jnp.asarray(rng.randn(8, 64, 128), jnp.float32)
+
+        def k(x_ref, o_ref):
+            o_ref[...] = pltpu.roll(x_ref[...], 3, 1)
+
+        got = np.asarray(
+            run1(k, jax.ShapeDtypeStruct((8, 64, 128), jnp.float32), x)
+        )
+        want = np.roll(np.asarray(x), 3, 1)
+        return np.abs(got - want).max()
+
+    case("roll_rank3", roll_rank3)
+
+    # -- dyn_scratch: fori_loop dynamic load + scratch accumulate ----------
+    def dyn_scratch():
+        sj, m, n = 12, 64, 128
+        x = jnp.asarray(rng.randn(sj, m, n), jnp.float32)
+
+        def k(x_ref, o_ref, acc):
+            acc[...] = jnp.zeros_like(acc)
+
+            def body(j, _):
+                v = x_ref[j]  # dynamic leading index
+                # accumulate into a rolling slot (j mod 3) then fold
+                acc[jax.lax.rem(j, 3)] += v
+                return 0
+
+            jax.lax.fori_loop(0, sj, body, 0)
+            o_ref[...] = acc[0] + acc[1] + acc[2]
+
+        got = np.asarray(
+            jax.jit(
+                lambda a: pl.pallas_call(
+                    k,
+                    out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+                    scratch_shapes=[pltpu.VMEM((3, m, n), jnp.float32)],
+                    interpret=args.interpret,
+                )(a)
+            )(x)
+        )
+        want = np.asarray(x).sum(0)
+        return np.abs(got - want).max()
+
+    case("dyn_scratch", dyn_scratch)
+
+    print("menu:", {k: v.split()[0] for k, v in results.items()})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
