@@ -20,6 +20,17 @@ itself is golden-tested against the reference formulation
 re-run this experiment for a positive signal once pretrained weights are
 fetchable (docs/NEXT.md).
 
+SEED TABLE (2026-08-02, --corpus parts --epochs 50 --pretrain_steps 300,
+delta_pct = trained - untrained PCK): s0 +15.63, s1 -2.08, s2 +9.38,
+s3 -1.04, s4 0.00, s5 -2.09 (mean +3.3). Bimodal: two of six seeds
+learn genuine correspondence (9-17% PCK from ~1%), the rest sit at the
+±2-keypoint noise floor; the paired random-backbone arms (-1.04 both
+seeds run) still collapse. So the weak loss demonstrably CAN improve a
+model whose features are meaningful — the round-2..4 "fixed point"
+was a random-features property — while seed-robustness on this tiny
+synthetic corpus is limited; the definitive check (ImageNet weights +
+real PF-Pascal) remains egress-gated.
+
 Runs on CPU in a few minutes:
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
       python tools/sanity_train_improves_pck.py --out /tmp/sanity_pck
