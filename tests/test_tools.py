@@ -202,3 +202,33 @@ def test_pretrain_backbone_contrastive_step(tmp_path):
         for a, b in zip(before, after)
     )
     assert changed
+
+
+def test_bench_knob_ab_parse_runs():
+    """The hardware A/B's CLI spec parser: ';' separates env pairs so
+    comma-valued knobs (strategy lists) pass through whole; an unknown
+    knob must SystemExit before any dial (a typo would otherwise bench
+    plain defaults under the typo'd label)."""
+    from bench_knob_ab import parse_runs
+
+    runs = parse_runs([
+        "anchor=",
+        "ss=NCNET_CONSENSUS_STRATEGIES:conv2d_stacked,conv2d_stacked",
+        "combo=NCNET_PANO_BACKBONE_BATCH:6;NCNET_BENCH_HIT_PATH:1",
+    ])
+    assert runs[0] == ("anchor", {})
+    assert runs[1] == ("ss", {
+        "NCNET_CONSENSUS_STRATEGIES": "conv2d_stacked,conv2d_stacked"
+    })
+    assert runs[2] == ("combo", {
+        "NCNET_PANO_BACKBONE_BATCH": "6", "NCNET_BENCH_HIT_PATH": "1"
+    })
+    with pytest.raises(SystemExit):
+        parse_runs(["bad=NCNET_NOT_A_KNOB:1"])
+    # A forgotten '=' must not silently bench defaults under the label.
+    with pytest.raises(SystemExit):
+        parse_runs(["chunk25NCNET_CONSENSUS_CHUNK_I:25"])
+    # ',' between pairs folds the next VAR:value into this value;
+    # the stray ':' inside the value is the tell.
+    with pytest.raises(SystemExit):
+        parse_runs(["c=NCNET_PANO_BACKBONE_BATCH:6,NCNET_BENCH_HIT_PATH:1"])

@@ -44,6 +44,39 @@ def log(msg):
     print(f"[ab {time.time() - _T0:7.1f}s] {msg}", flush=True)
 
 
+def parse_runs(specs):
+    """label=VAR:value[;VAR:value...] specs -> [(label, env_dict)].
+
+    ';' separates pairs (not ',': strategy-list knobs are comma-valued).
+    Unknown knobs SystemExit before any dial — a typo'd variable must
+    not silently bench the default configuration under its label.
+    """
+    runs = []
+    for spec in specs:
+        label, sep, envspec = spec.partition("=")
+        if not sep:
+            # A forgotten '=' would otherwise bench plain defaults
+            # under the typo'd label; an anchor run must say so with an
+            # explicit trailing '='.
+            raise SystemExit(f"missing '=' in run spec {spec!r}")
+        env = {}
+        for pair in filter(None, envspec.split(";")):
+            var, _, val = pair.partition(":")
+            if var not in KNOBS:
+                raise SystemExit(f"unknown knob {var!r} in {spec!r}")
+            if ":" in val:
+                # ',' used between pairs folds the next VAR:value into
+                # this value (split is on ';'), silently leaving later
+                # knobs unset; no legal knob value contains ':'.
+                raise SystemExit(
+                    f"':' inside value {val!r} in {spec!r} — separate "
+                    "pairs with ';'"
+                )
+            env[var] = val
+        runs.append((label, env))
+    return runs
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("runs", nargs="+",
@@ -52,16 +85,7 @@ def main(argv=None):
     p.add_argument("--fence", type=float, default=1500.0)
     args = p.parse_args(argv)
 
-    runs = []
-    for spec in args.runs:
-        label, _, envspec = spec.partition("=")
-        env = {}
-        for pair in filter(None, envspec.split(";")):
-            var, _, val = pair.partition(":")
-            if var not in KNOBS:
-                raise SystemExit(f"unknown knob {var!r} in {spec!r}")
-            env[var] = val
-        runs.append((label, env))
+    runs = parse_runs(args.runs)
 
     from ncnet_tpu.utils.profiling import run_bench_matrix
 
