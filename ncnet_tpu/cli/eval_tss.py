@@ -11,7 +11,6 @@ import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..data import TSSDataset, DataLoader
 from ..evals import write_flow_output

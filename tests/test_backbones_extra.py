@@ -2,7 +2,6 @@
 forward/conversion; structural + pipeline tests for the FPN hypercolumns."""
 
 import numpy as np
-import pytest
 import torch
 import torch.nn.functional as F
 

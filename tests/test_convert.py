@@ -7,7 +7,6 @@ torchvision itself.
 """
 
 import numpy as np
-import pytest
 import torch
 import torch.nn.functional as F
 

@@ -7,8 +7,6 @@ import sys
 import numpy as np
 import pytest
 
-import jax
-
 
 @pytest.mark.slow
 def test_dryrun_multichip_8():

@@ -8,7 +8,6 @@ source-image shape.
 """
 
 import csv
-import os
 
 import numpy as np
 import pytest

@@ -23,7 +23,6 @@ from ncnet_tpu.data import (
     ImagePairDataset,
     PFPascalDataset,
     DataLoader,
-    default_collate,
 )
 from ncnet_tpu.geometry import read_flo_file
 from ncnet_tpu.ops import maxpool4d

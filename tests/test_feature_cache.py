@@ -1,7 +1,6 @@
 """Unit tests for the cross-query pano feature cache (evals/feature_cache)."""
 
 import numpy as np
-import pytest
 
 from ncnet_tpu.evals.feature_cache import PanoFeatureCache, model_cache_key
 

@@ -6,7 +6,6 @@ hard-part 2).
 """
 
 import numpy as np
-import pytest
 import torch
 import torch.nn.functional as F
 

@@ -2,7 +2,6 @@
 backprojection, rendering, pose verification, and curves."""
 
 import numpy as np
-import pytest
 
 from ncnet_tpu.localization import (
     LocalizationParams,

@@ -18,7 +18,7 @@ from ncnet_tpu.ops import (
     neigh_consensus_init,
     feature_correlation,
 )
-from ncnet_tpu.models.ncnet import match_pipeline, NCNetConfig
+from ncnet_tpu.models.ncnet import NCNetConfig
 from ncnet_tpu.parallel import (
     make_mesh,
     make_sharded_match_pipeline,

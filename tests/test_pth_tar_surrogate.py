@@ -24,7 +24,6 @@ import argparse
 import os
 
 import numpy as np
-import pytest
 import torch
 
 import jax

@@ -1,6 +1,5 @@
 """Utils tests: batching helpers, profiling, plotting, file helpers."""
 
-import os
 
 import numpy as np
 import pytest
