@@ -205,8 +205,12 @@ def main():
 
             @jax.jit
             def prep_feats(params, tgt_stack):
+                # bf16, mirroring what the production cache stores (the
+                # correlation casts features to bf16 first anyway).
                 return jax.lax.map(
-                    lambda t: extract_features(config, params, t[None]),
+                    lambda t: extract_features(
+                        config, params, t[None]
+                    ).astype(jnp.bfloat16),
                     tgt_stack,
                 )
 

@@ -336,10 +336,17 @@ def main(argv=None):
         # features (same math — extract_features output is what the fused
         # program consumes internally, so hit and miss produce identical
         # matches); the hit program consumes host-cached features.
+        # Features are cached in bf16: the correlation kernels cast
+        # features to bf16 as their first op (ops/pallas_kernels.py:374,
+        # ops/correlation.py:33), so the hit path stays bit-identical
+        # while the entry — and its D2H on store / H2D on hit — is half
+        # the bytes (~57 MB/pano instead of 113), doubling the panos a
+        # given --pano_feature_cache_mb budget holds.
         @jax.jit
         def pano_matches_with_feats(params, feat_a, tgt):
             feat_b = extract_features(config, params, tgt)
-            return _match_from_feats(params, feat_a, feat_b), feat_b
+            return (_match_from_feats(params, feat_a, feat_b),
+                    feat_b.astype(jnp.bfloat16))
 
         match_from_cached_feats = jax.jit(_match_from_feats)
 
@@ -454,6 +461,10 @@ def main(argv=None):
                 # — the disk-tier key must name the weights that actually
                 # produced the features.
                 model_key=model_cache_key(args.checkpoint, seed=1),
+                # Normalizes legacy f32 disk entries to the bf16 the miss
+                # program now stores (one entry size, one hit-program
+                # dtype specialization).
+                store_dtype=jnp.bfloat16,
             )
 
     # One-ahead prefetch: pano decode+resize (hundreds of ms of host work at

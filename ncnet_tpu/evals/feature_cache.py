@@ -14,9 +14,11 @@ Keying and bounds:
     seed), so a cache can never serve features from different weights;
     the resize bucket key keeps distinct compilation shapes distinct.
   * bounded host-memory LRU by BYTES (features at the InLoc bucket are
-    ~113 MB per pano: 1024ch x 192x144 f32 — backbone_apply returns f32
-    even with a bf16 compute dtype; the CLI's default 4 GiB budget holds
-    ~36 panos, a 10-pano shortlist window plus reuse locality).
+    ~57 MB per pano: 1024ch x 192x144 bf16 — the miss program rounds its
+    f32 features through bf16 before the D2H store, which is lossless
+    downstream because every correlation path casts features to bf16 as
+    its first op; the CLI's default 4 GiB budget holds ~75 panos, several
+    10-pano shortlist windows plus reuse locality).
   * optional disk tier (``disk_dir``): entries evicted from memory stay
     on disk (npz keyed by a hash of the key) and promote back on hit —
     sized for re-runs and multi-process sweeps, where the backbone cost
@@ -35,6 +37,7 @@ import threading
 from collections import OrderedDict
 from typing import Optional, Tuple
 
+import ml_dtypes  # ships with jax
 import numpy as np
 
 
@@ -65,12 +68,19 @@ class PanoFeatureCache:
     """Byte-bounded LRU of pano backbone features, optional disk tier."""
 
     def __init__(self, max_bytes: int, disk_dir: Optional[str] = None,
-                 model_key: str = ""):
+                 model_key: str = "", store_dtype=None):
+        """store_dtype: when set (eval_inloc passes bf16), every entry —
+        including pre-existing disk entries written before the bf16
+        change — is normalized to that dtype on load/store, keeping the
+        LRU at one entry size and the hit program at one dtype
+        specialization. None (default) keeps the container
+        dtype-faithful."""
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive")
         self.max_bytes = int(max_bytes)
         self.disk_dir = disk_dir
         self.model_key = model_key
+        self.store_dtype = np.dtype(store_dtype) if store_dtype else None
         self._lru: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
         # get() runs on the CLI's decode-prefetch thread while put() runs
         # on the main thread; LRU reordering + eviction need the lock.
@@ -85,9 +95,19 @@ class PanoFeatureCache:
     def _key(self, pano_path: str, shape: Tuple[int, int]) -> tuple:
         return (self.model_key, pano_path, tuple(shape))
 
+    @staticmethod
+    def _hash(key: tuple) -> str:
+        return hashlib.sha1(repr(key).encode()).hexdigest()
+
     def _disk_path(self, key: tuple) -> str:
-        h = hashlib.sha1(repr(key).encode()).hexdigest()
-        return os.path.join(self.disk_dir, f"feat_{h}.npz")
+        # feat2_: the uint16-view+tag format. Versioned name so a reader
+        # from a pre-bf16 build sharing this dir misses (recomputes)
+        # instead of consuming the uint16 view as f32 features.
+        return os.path.join(self.disk_dir, f"feat2_{self._hash(key)}.npz")
+
+    def _legacy_disk_path(self, key: tuple) -> str:
+        # feat_: pre-bf16 builds' raw-npz entries (untagged f32).
+        return os.path.join(self.disk_dir, f"feat_{self._hash(key)}.npz")
 
     def get(self, pano_path: str, shape: Tuple[int, int]):
         """Cached features for (pano, resize bucket), or None.
@@ -102,21 +122,50 @@ class PanoFeatureCache:
                 self.hits += 1
                 return feats
         if self.disk_dir:
-            path = self._disk_path(key)
-            if os.path.exists(path):
-                import zipfile
+            import zipfile
 
+            path = self._disk_path(key)
+            legacy_path = self._legacy_disk_path(key)
+            feats = read_path = None
+            # Probe the versioned format first, then the pre-bf16 one; a
+            # partial/corrupt file (killed run, racing migration) falls
+            # through to the next candidate instead of shadowing it.
+            for cand in (path, legacy_path):
+                if not os.path.exists(cand):
+                    continue
                 try:
-                    with np.load(path) as z:
-                        feats = z["feats"]
+                    with np.load(cand) as z:
+                        f = z["feats"]
+                        # npz cannot round-trip the ml_dtypes bf16 dtype
+                        # (it loads back as opaque V2); entries are saved
+                        # as a uint16 view plus this tag.
+                        if "dtype" in z and str(z["dtype"][()]) == "bfloat16":
+                            f = f.view(ml_dtypes.bfloat16)
                 except (OSError, ValueError, KeyError, zipfile.BadZipFile):
-                    # A partial write (killed run) is a miss, not a crash.
-                    feats = None
-                if feats is not None:
-                    self.hits += 1
-                    self.disk_hits += 1
-                    self._store_mem(key, feats)
-                    return feats
+                    continue  # a miss for this candidate, not a crash
+                feats, read_path = f, cand
+                break
+            if (feats is not None and self.store_dtype is not None
+                    and feats.dtype != self.store_dtype):
+                # Legacy disk entry in another dtype (pre-bf16 f32):
+                # round it the same way a fresh store would (identical
+                # values downstream — the correlation casts to bf16
+                # first regardless) and write the half-size entry under
+                # the versioned name. Only once that write has landed is
+                # the old file dropped (a pre-bf16 reader sharing the
+                # dir then misses and recomputes — safe; a failed write
+                # must not orphan the only disk copy).
+                feats = feats.astype(self.store_dtype)
+                if self._disk_write(path, feats) and read_path == legacy_path:
+                    try:
+                        os.unlink(legacy_path)
+                    except OSError:
+                        pass
+            if feats is not None:
+                self.hits += 1
+                self.disk_hits += 1
+                self._store_mem(key, feats)
+                return feats
         self.misses += 1
         return None
 
@@ -127,24 +176,38 @@ class PanoFeatureCache:
             if key in self._lru:
                 return
         feats = np.asarray(feats)
+        if self.store_dtype is not None and feats.dtype != self.store_dtype:
+            feats = feats.astype(self.store_dtype)
         if self.disk_dir:
             path = self._disk_path(key)
             if not os.path.exists(path):
-                # tmp + rename: a killed run must not leave a truncated
-                # npz that later loads as garbage features.
-                tmp = path + ".tmp"
-                try:
-                    # Through a handle: np.savez(str) would append .npz
-                    # to the tmp name and the rename would miss it.
-                    with open(tmp, "wb") as fh:
-                        np.savez(fh, feats=feats)
-                    os.replace(tmp, path)
-                except OSError:
-                    try:
-                        os.unlink(tmp)
-                    except OSError:
-                        pass
+                self._disk_write(path, feats)
         self._store_mem(key, feats)
+
+    def _disk_write(self, path: str, feats: np.ndarray) -> bool:
+        # tmp + rename: a killed run must not leave a truncated npz that
+        # later loads as garbage features. The tmp name is per-process:
+        # concurrent sweeps sharing disk_dir migrate the same popular
+        # panos at startup, and two writers on ONE shared tmp inode can
+        # publish a half-written file through the other's os.replace.
+        tmp = f"{path}.{os.getpid()}.tmp"
+        if feats.dtype == ml_dtypes.bfloat16:
+            storable, tag = feats.view(np.uint16), "bfloat16"
+        else:
+            storable, tag = feats, str(feats.dtype)
+        try:
+            # Through a handle: np.savez(str) would append .npz to the
+            # tmp name and the rename would miss it.
+            with open(tmp, "wb") as fh:
+                np.savez(fh, feats=storable, dtype=tag)
+            os.replace(tmp, path)
+            return True
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
 
     def _store_mem(self, key: tuple, feats: np.ndarray) -> None:
         if feats.nbytes > self.max_bytes:

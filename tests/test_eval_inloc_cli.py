@@ -325,10 +325,22 @@ def test_pano_feature_cache_parity_and_hits(fixture_dir, capsys):
     ])
     eval_inloc.main(base + [
         "--output_dir", str(fixture_dir / "m_on"),
+        "--pano_feature_cache_dir", str(fixture_dir / "fc_parity"),
     ])
     out = capsys.readouterr().out
     # q0: 2 misses; q1: the same panos -> 2 hits.
     assert "2/4 hits (50%" in out
+
+    # Entries are stored bf16 (half the bytes of the f32 features; the
+    # parity assertions below prove the rounding is output-lossless).
+    # On disk that's a uint16 view + dtype tag — npz can't round-trip
+    # the ml_dtypes bf16 dtype itself.
+    npzs = [f for f in os.listdir(fixture_dir / "fc_parity")
+            if f.endswith(".npz")]
+    assert npzs
+    with np.load(fixture_dir / "fc_parity" / npzs[0]) as z:
+        assert str(z["dtype"][()]) == "bfloat16"
+        assert z["feats"].dtype == np.uint16
 
     exp_off = os.listdir(fixture_dir / "m_off")[0]
     exp_on = os.listdir(fixture_dir / "m_on")[0]

@@ -63,12 +63,71 @@ def test_disk_tier_promote_and_truncation_tolerance(tmp_path):
     import glob
     import os
 
-    path = glob.glob(os.path.join(d, "feat_*.npz"))[0]
+    path = glob.glob(os.path.join(d, "feat2_*.npz"))[0]
     with open(path, "r+b") as fh:
         fh.truncate(100)
     c3 = PanoFeatureCache(max_bytes=64 * 1024 * 1024, disk_dir=d,
                           model_key="m")
     assert c3.get("p", (8, 8)) is None
+
+
+def test_store_dtype_roundtrip_and_legacy_migration(tmp_path):
+    """store_dtype=bf16 (what eval_inloc passes): fresh entries store and
+    round-trip bf16 through disk; a pre-bf16 untagged f32 disk entry is
+    rounded to bf16 on load instead of occupying a double-size slot and
+    forcing a second hit-program dtype specialization."""
+    import ml_dtypes
+
+    d = str(tmp_path / "cache")
+    c = PanoFeatureCache(max_bytes=64 * 1024 * 1024, disk_dir=d,
+                         model_key="m", store_dtype=ml_dtypes.bfloat16)
+    f = _feat(1)
+    c.put("p", (8, 8), f)
+    got = c.get("p", (8, 8))
+    assert got.dtype == ml_dtypes.bfloat16
+    assert got.nbytes == f.nbytes // 2
+    np.testing.assert_array_equal(got, f.astype(ml_dtypes.bfloat16))
+
+    # Disk round-trip preserves bf16 (uint16 view + tag inside the npz).
+    c2 = PanoFeatureCache(max_bytes=64 * 1024 * 1024, disk_dir=d,
+                          model_key="m", store_dtype=ml_dtypes.bfloat16)
+    got2 = c2.get("p", (8, 8))
+    assert got2.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(got2, got)
+
+    # Legacy entry written by a pre-bf16 build: raw f32 npz under the
+    # unversioned feat_ name, the way the old np.savez(fh, feats=feats)
+    # did.
+    import os
+
+    f_old = _feat(2)
+    legacy_path = c2._legacy_disk_path(c2._key("q", (8, 8)))
+    with open(legacy_path, "wb") as fh:
+        np.savez(fh, feats=f_old)
+    c3 = PanoFeatureCache(max_bytes=64 * 1024 * 1024, disk_dir=d,
+                          model_key="m", store_dtype=ml_dtypes.bfloat16)
+    got3 = c3.get("q", (8, 8))
+    assert got3.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(got3, f_old.astype(ml_dtypes.bfloat16))
+    # The migration moves the entry to the versioned half-size format
+    # (feat2_) and drops the legacy file, so a pre-bf16 reader sharing
+    # this dir misses instead of misreading the uint16 view as features.
+    assert not os.path.exists(legacy_path)
+    feat2_path = c3._disk_path(c3._key("q", (8, 8)))
+    with np.load(feat2_path) as z:
+        assert str(z["dtype"][()]) == "bfloat16"
+        assert z["feats"].dtype == np.uint16
+
+    # A corrupt versioned file must not shadow an intact legacy entry:
+    # the probe falls through to the legacy format and serves it.
+    with open(feat2_path, "r+b") as fh:
+        fh.truncate(10)
+    with open(legacy_path, "wb") as fh:
+        np.savez(fh, feats=f_old)
+    c4 = PanoFeatureCache(max_bytes=64 * 1024 * 1024, disk_dir=d,
+                          model_key="m", store_dtype=ml_dtypes.bfloat16)
+    got4 = c4.get("q", (8, 8))
+    np.testing.assert_array_equal(got4, f_old.astype(ml_dtypes.bfloat16))
 
 
 def test_model_cache_key_checkpoint_vs_seed(tmp_path):
