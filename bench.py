@@ -221,10 +221,12 @@ def main():
             feat_a = query_feats(params, src)
 
             if bb > 1:
+                from ncnet_tpu.cli.eval_inloc import _bb_group_size
+
                 n = tgt_stack.shape[0]
-                nb = bb
-                while n % nb:  # largest divisor of the stack size <= bb
-                    nb -= 1
+                # The CLI's one definition of the grouping: the bench
+                # must measure exactly the program eval_inloc runs.
+                nb = _bb_group_size(n, bb)
                 groups = tgt_stack.reshape(
                     n // nb, nb, *tgt_stack.shape[1:]
                 )

@@ -41,6 +41,21 @@ from ..models.ncnet import (
 from .common import build_model
 
 
+def _bb_group_size(n: int, bb: int) -> int:
+    """Largest divisor of stack size ``n`` that is <= ``bb`` (min 1).
+
+    The ONE definition of the pano-backbone grouping: the batch programs
+    use it to shape their ``lax.map`` groups and the feature cache's
+    producer key uses it to name the program that computed an entry —
+    these must agree or a disk entry produced by one grouping would hit
+    under another's key.
+    """
+    nb = max(1, min(bb, n))
+    while n % nb:
+        nb -= 1
+    return nb
+
+
 def inloc_resize_shape(h, w, image_size, k_size, scale_factor=0.0625,
                        h_unit=0, w_unit=0):
     """Target (h, w): long side ~image_size, feature dims divisible by the
@@ -180,13 +195,14 @@ def main(argv=None):
     # every pano's backbone per pair (eval_inloc.py:124-137); a hit skips
     # the pano backbone (~87 ms of ~300 per pano on v5e) AND the 3200 px
     # host decode entirely. Host-memory LRU bounded in MB (features are
-    # ~113 MB f32 per pano at the default bucket -> 4 GiB holds ~36);
+    # ~57 MB bf16 per pano at the default bucket -> 4 GiB holds ~75);
     # optional disk tier for re-runs. Bit-parity: a hit replays the
     # identical feature tensor through the identical match program.
     parser.add_argument(
         "--pano_feature_cache_mb", type=int, default=4096,
         help="host-memory budget for the cross-query pano feature cache "
-        "(0 disables; single-device --pano_batch 1 path only)",
+        "(0 disables; composes with --pano_batch, disabled under "
+        "--spatial_shards/--pano_dp)",
     )
     parser.add_argument(
         "--pano_feature_cache_dir", type=str, default="",
@@ -404,20 +420,24 @@ def main(argv=None):
         # 9.69 vs 6.09 pairs/s; bb10 and bb5+conv1fold both lose).
         bb = int(os.environ.get("NCNET_PANO_BACKBONE_BATCH", "5") or 5)
 
+        def _batched_feats(params, tgt_stack):
+            # The bb-grouped backbone both batch programs share — ONE
+            # definition, because the cache's producer key promises the
+            # miss program uses exactly _bb_group_size's grouping.
+            n = tgt_stack.shape[0]
+            nb = _bb_group_size(n, bb)
+            groups = tgt_stack.reshape(n // nb, nb, *tgt_stack.shape[1:])
+            feats_b = jax.lax.map(
+                lambda g: extract_features(config, params, g), groups
+            )
+            return feats_b.reshape(n, 1, *feats_b.shape[2:])
+
         @jax.jit
         def pano_matches_batch(params, feat_a, tgt_stack):
             # lax.scan over a same-shape pano stack: the whole group is one
             # dispatch; outputs stack to [P, n] per match array.
             if bb > 1:
-                n = tgt_stack.shape[0]
-                nb = bb
-                while n % nb:  # largest divisor of the group size <= bb
-                    nb -= 1
-                groups = tgt_stack.reshape(n // nb, nb, *tgt_stack.shape[1:])
-                feats_b = jax.lax.map(
-                    lambda g: extract_features(config, params, g), groups
-                )
-                feats_b = feats_b.reshape(n, 1, *feats_b.shape[2:])
+                feats_b = _batched_feats(params, tgt_stack)
 
                 def body_f(_, feat_b):
                     corr, delta = ncnet_forward_from_features(
@@ -436,6 +456,26 @@ def main(argv=None):
             _, ms = jax.lax.scan(body, None, tgt_stack)
             return ms
 
+        # Cached-batched miss program: same-shape stack of cache MISSES
+        # -> batched backbone (the promoted bb grouping) + per-pano match
+        # scan, additionally returning the stack's features (bf16, what
+        # the cache stores) so a cached run keeps the batched-backbone
+        # miss cost instead of falling back to per-pano backbones.
+        @jax.jit
+        def pano_matches_batch_with_feats(params, feat_a, tgt_stack):
+            # _batched_feats unconditionally (nb=1 when bb<=1): the
+            # producer key "bb<nb>" must name ONE program structure.
+            feats_b = _batched_feats(params, tgt_stack)
+
+            def body_wf(_, feat_b):
+                # Through _match_from_feats: the hit program
+                # (match_from_cached_feats) is the same composition, so
+                # an edit to it cannot desynchronize hits from misses.
+                return None, _match_from_feats(params, feat_a, feat_b)
+
+            _, ms = jax.lax.scan(body_wf, None, feats_b)
+            return ms, feats_b.astype(jnp.bfloat16)
+
     n_matches = int(
         (args.image_size * 0.0625 / args.k_size)
         * np.floor((args.image_size * 0.0625 / args.k_size) * 0.75)
@@ -445,22 +485,48 @@ def main(argv=None):
 
     cache = None
     if args.pano_feature_cache_mb > 0:
-        if args.spatial_shards > 1 or args.pano_batch > 1 or args.pano_dp:
+        if args.spatial_shards > 1 or args.pano_dp:
             print("pano-feature cache: disabled (--spatial_shards/"
-                  "--pano_batch/--pano_dp run their own feature plumbing)")
+                  "--pano_dp run their own feature plumbing)")
         else:
             from ..evals.feature_cache import (
                 PanoFeatureCache,
                 model_cache_key,
             )
 
+            # The key also names the PROGRAM that produced the features:
+            # the batched miss program's nb-grouped backbone is a
+            # different XLA artifact from the sequential one (bf16
+            # rounding differs within ~2e-3 scores), so a disk tier
+            # populated by a --pano_batch run must MISS in a sequential
+            # run (recompute) rather than silently break the sequential
+            # mode's strict hit/miss bit-parity.
+            if args.pano_batch > 1:
+                # Miss stacks are always padded to exactly --pano_batch,
+                # so the traced program is named by BOTH the stack size
+                # and its _bb_group_size grouping — two sweep members
+                # with the same bb but different --pano_batch compile
+                # different XLA artifacts and must not share entries.
+                producer = "|p%d-bb%d" % (
+                    args.pano_batch,
+                    _bb_group_size(args.pano_batch, bb),
+                )
+            else:
+                # Sequential producer = EMPTY suffix: every disk entry
+                # written before producer keying existed was
+                # sequential-produced, and the suffix must not
+                # invalidate those tiers (or the legacy-f32 migration
+                # in feature_cache.get would never fire).
+                producer = ""
             cache = PanoFeatureCache(
                 args.pano_feature_cache_mb * 1024 * 1024,
                 disk_dir=args.pano_feature_cache_dir or None,
                 # seed=1: build_model's default init seed (cli/common.py)
                 # — the disk-tier key must name the weights that actually
                 # produced the features.
-                model_key=model_cache_key(args.checkpoint, seed=1),
+                model_key=(
+                    model_cache_key(args.checkpoint, seed=1) + producer
+                ),
                 # Normalizes legacy f32 disk entries to the bf16 the miss
                 # program now stores (one entry size, one hit-program
                 # dtype specialization).
@@ -516,7 +582,8 @@ def main(argv=None):
         batch_fn = pano_matches_batch if args.pano_batch > 1 else None
         stack_fn = None
     cache_fns = (
-        (prepare_pano, match_from_cached_feats, pano_matches_with_feats)
+        (prepare_pano, match_from_cached_feats, pano_matches_with_feats,
+         pano_matches_batch_with_feats)
         if cache is not None else None
     )
     try:
@@ -531,6 +598,44 @@ def main(argv=None):
     return out_dir
 
 
+class _MissGroups:
+    """Same-shape bucket accumulator shared by the two batched drivers.
+
+    Encodes the grouping heuristics ONCE so cached and uncached
+    `--pano_batch` runs cannot drift apart: a bucket dispatches the
+    moment `p` same-shape items have decoded; ragged groups are padded
+    by repeating their last item (via :meth:`pad`; the padded
+    iterations' outputs are discarded by the caller); and the decoded
+    backlog across buckets is capped at 2p by early-flushing the
+    fullest partial bucket rather than holding an unbounded number of
+    decoded 3200 px panos (ADVICE r2).
+    """
+
+    def __init__(self, p, dispatch):
+        self.p = p
+        self.dispatch = dispatch  # receives a chunk of 1..p items
+        self.groups = {}  # shape key -> list of items not yet dispatched
+
+    def pad(self, chunk):
+        return chunk + [chunk[-1]] * (self.p - len(chunk))
+
+    def add(self, shape_key, item):
+        g = self.groups.setdefault(shape_key, [])
+        g.append(item)
+        if len(g) == self.p:
+            self.dispatch(g[:])
+            g.clear()
+        elif sum(len(gg) for gg in self.groups.values()) > 2 * self.p:
+            big = max(self.groups.values(), key=len)
+            self.dispatch(big[:])
+            big.clear()
+
+    def drain(self):
+        for g in self.groups.values():
+            if g:
+                self.dispatch(g)
+
+
 def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
                        load_pano, stack_fn=None):
     """All of one query's panos in same-shape stacks of --pano_batch.
@@ -542,15 +647,14 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
     p = args.pano_batch
     n = len(pano_fns)
     # Sliding decode window: at most p+1 loads in flight. Decoded images
-    # ALSO accumulate in partially-filled shape buckets below, so the
-    # true host bound is the decode window plus the bucket cap (2p,
-    # enforced by the early flush in the loop): ~3p decoded panos total,
-    # regardless of how many distinct shapes interleave (ADVICE r2).
+    # ALSO accumulate in partially-filled shape buckets (_MissGroups),
+    # so the true host bound is the decode window plus the bucket cap
+    # (2p): ~3p decoded panos total, regardless of how many distinct
+    # shapes interleave.
     window = p + 1
     futures = {
         i: pool.submit(load_pano, pano_fns[i]) for i in range(min(window, n))
     }
-    groups = {}  # (H, W) -> list of (pano_idx, image) not yet dispatched
 
     def flush(idxs, ms):
         np_ms = jax.device_get(ms)
@@ -561,8 +665,7 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
 
     def dispatch(chunk):
         nonlocal pending
-        padded = chunk + [chunk[-1]] * (p - len(chunk))
-        imgs = [img for _, img in padded]
+        imgs = [img for _, img in groups.pad(chunk)]
         stack = (
             stack_fn(imgs) if stack_fn is not None
             else jnp.concatenate(imgs, axis=0)
@@ -575,6 +678,7 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
         # in-flight group instead of the whole shortlist.
         pending = ([idx for idx, _ in chunk], ms)
 
+    groups = _MissGroups(p, dispatch)
     # Incremental grouping: a stack dispatches the moment p same-shape
     # panos have decoded, so decode (threaded, hundreds of ms at 3200 px)
     # overlaps the device forward of the previous stack — same pipelining
@@ -584,23 +688,84 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
         nxt = idx + window
         if nxt < n:
             futures[nxt] = pool.submit(load_pano, pano_fns[nxt])
-        g = groups.setdefault(img.shape[2:], [])
-        g.append((idx, img))
-        if len(g) == p:
-            dispatch(g[:])
-            g.clear()
-        elif sum(len(gg) for gg in groups.values()) > 2 * p:
-            # Many interleaved shapes: flush the fullest partial bucket
-            # (a padded, smaller stack) rather than holding an unbounded
-            # number of decoded 3200 px panos across buckets.
-            big = max(groups.values(), key=len)
-            dispatch(big[:])
-            big.clear()
-    for g in groups.values():
-        if g:
-            dispatch(g)
+        groups.add(img.shape[2:], (idx, img))
+    groups.drain()
     if pending is not None:
         flush(*pending)
+
+
+def _run_panos_cached_batched(args, params, feat_a, buf, pano_fns, pool,
+                              cache, cache_fns):
+    """--pano_batch composed with the cross-query feature cache.
+
+    The grouping/padding/backlog heuristics are `_MissGroups` — the
+    same object `_run_panos_batched` drives, so the two modes cannot
+    drift. Hits dispatch immediately per pano (a hit has no backbone to
+    batch, and the consensus stack runs batch-1 in every mode); misses
+    accumulate into same-shape stacks of --pano_batch and run the
+    batched-backbone miss program, which also returns the stack's bf16
+    features for the store. This keeps the promoted batched-backbone
+    miss cost (bb5: 9.69 vs 6.09 pairs/s on v5e) in cached runs;
+    without it, every cached miss would pay a per-pano backbone and the
+    cache would LOSE to plain --pano_batch below ~70% hit-rate.
+    """
+    prepare_pano, match_cached, _, batch_with_feats = cache_fns
+    p = args.pano_batch
+    n = len(pano_fns)
+    window = p + 1
+    futures = {
+        i: pool.submit(prepare_pano, pano_fns[i])
+        for i in range(min(window, n))
+    }
+    pending = None  # ("hit", idx, ms) | ("miss", idxs, ms)
+    put_futs = []
+
+    def flush(entry):
+        if entry[0] == "hit":
+            fill_matches(buf, entry[1], dedup_matches(*entry[2]))
+            return
+        _, idxs, ms = entry
+        np_ms = jax.device_get(ms)
+        for k, idx in enumerate(idxs):
+            fill_matches(buf, idx, dedup_matches(*(a[k] for a in np_ms)))
+
+    def dispatch_miss(chunk):
+        nonlocal pending
+        stack = jnp.concatenate(
+            [img for _, _, img in groups.pad(chunk)], axis=0
+        )
+        ms, feats = batch_with_feats(params, feat_a, stack)
+        if pending is not None:
+            flush(pending)
+        pending = ("miss", [idx for idx, _, _ in chunk], ms)
+        for k, (idx, shape, _) in enumerate(chunk):
+            # feats[k] is a device slice; put()'s np.asarray is the D2H
+            # fetch, on the pool thread so the device keeps working.
+            put_futs.append(pool.submit(
+                cache.put, os.path.join(args.pano_path, pano_fns[idx]),
+                shape, feats[k],
+            ))
+
+    groups = _MissGroups(p, dispatch_miss)
+    for idx in range(n):
+        shape, feats_np, img = futures.pop(idx).result()
+        nxt = idx + window
+        if nxt < n:
+            futures[nxt] = pool.submit(prepare_pano, pano_fns[nxt])
+        if feats_np is not None:
+            ms = match_cached(params, feat_a, jnp.asarray(feats_np))
+            if pending is not None:
+                flush(pending)
+            pending = ("hit", idx, ms)
+            continue
+        groups.add(tuple(img.shape[2:]), (idx, shape, img))
+    groups.drain()
+    if pending is not None:
+        flush(pending)
+    # Drain this query's stores before the next query probes (same
+    # contract as the sequential cached loop).
+    for f in put_futs:
+        f.result()
 
 
 def _run_panos_cached(args, params, feat_a, buf, pano_fns, pool, cache,
@@ -613,7 +778,7 @@ def _run_panos_cached(args, params, feat_a, buf, pano_fns, pool, cache,
     a program that also returns the pano features; the D2H fetch + store
     happen on the pool thread so the device keeps working.
     """
-    prepare_pano, match_cached, matches_with_feats = cache_fns
+    prepare_pano, match_cached, matches_with_feats, _ = cache_fns
     n = len(pano_fns)
     fut = pool.submit(prepare_pano, pano_fns[0]) if pano_fns else None
     pending = None  # (pano_idx, device match tuple)
@@ -663,6 +828,14 @@ def _query_loop(args, db, out_dir, params, query_features, pano_matches,
         feat_a = query_features(params, src)
         buf = matches_buffer(args.n_panos, n_matches)
         pano_fns = [db[q][1].ravel()[i].item() for i in range(args.n_panos)]
+        if cache is not None and batch_fn is not None:
+            # --pano_batch + cache: hits per-pano, misses in batched
+            # stacks through the batched-with-feats program.
+            _run_panos_cached_batched(args, params, feat_a, buf, pano_fns,
+                                      pool, cache, cache_fns)
+            write_matches_mat(out_path, buf, query_fn, pano_fn_all)
+            print(f"wrote {out_path}", flush=True)
+            continue
         if batch_fn is not None:
             _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns,
                                pool, load_pano, stack_fn=stack_fn)

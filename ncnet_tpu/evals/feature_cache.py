@@ -34,6 +34,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import uuid
 from collections import OrderedDict
 from typing import Optional, Tuple
 
@@ -186,11 +187,13 @@ class PanoFeatureCache:
 
     def _disk_write(self, path: str, feats: np.ndarray) -> bool:
         # tmp + rename: a killed run must not leave a truncated npz that
-        # later loads as garbage features. The tmp name is per-process:
-        # concurrent sweeps sharing disk_dir migrate the same popular
-        # panos at startup, and two writers on ONE shared tmp inode can
-        # publish a half-written file through the other's os.replace.
-        tmp = f"{path}.{os.getpid()}.tmp"
+        # later loads as garbage features. The tmp name is unique per
+        # WRITE (pid + uuid): concurrent sweeps sharing disk_dir migrate
+        # the same popular panos at startup, same-process pool threads
+        # can store a shortlist-duplicated pano twice, and two writers
+        # on ONE shared tmp inode could publish a half-written file
+        # through the other's os.replace.
+        tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
         if feats.dtype == ml_dtypes.bfloat16:
             storable, tag = feats.view(np.uint16), "bfloat16"
         else:

@@ -351,6 +351,86 @@ def test_pano_feature_cache_parity_and_hits(fixture_dir, capsys):
         assert a["query_fn"] == b["query_fn"]
 
 
+def test_pano_feature_cache_with_pano_batch(fixture_dir, capsys):
+    """--pano_batch composed with the cache: query 1's misses run the
+    batched-backbone miss program (stacks of --pano_batch, features
+    returned for the store), query 2's panos are pure hits. Contract
+    mirrors test_pano_batch_matches_unbatched: batching already trades
+    bit-exactness for throughput (different compiled artifacts shift
+    bf16 rounding), so the cached-batched run must match the uncached
+    batched run at the same layout/filled-rows/score-rounding level."""
+    base = [
+        "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
+        "--query_path", str(fixture_dir / "query"),
+        "--pano_path", str(fixture_dir / "pano"),
+        "--image_size", "64",
+        "--n_queries", "2",
+        "--n_panos", "2",
+        "--k_size", "2",
+        "--pano_batch", "2",
+    ]
+    eval_inloc.main(base + [
+        "--output_dir", str(fixture_dir / "mb_off"),
+        "--pano_feature_cache_mb", "0",
+    ])
+    eval_inloc.main(base + [
+        "--output_dir", str(fixture_dir / "mb_on"),
+    ])
+    out = capsys.readouterr().out
+    # q0: 2 misses (one batched stack); q1: the same panos -> 2 hits.
+    assert "2/4 hits (50%" in out
+
+    exp_off = os.listdir(fixture_dir / "mb_off")[0]
+    exp_on = os.listdir(fixture_dir / "mb_on")[0]
+    for q in ("1.mat", "2.mat"):
+        want = loadmat(fixture_dir / "mb_off" / exp_off / q)["matches"]
+        got = loadmat(fixture_dir / "mb_on" / exp_on / q)["matches"]
+        assert got.shape == want.shape
+        filled_w = np.any(want != 0, axis=-1)
+        filled_g = np.any(got != 0, axis=-1)
+        np.testing.assert_array_equal(filled_g, filled_w)
+        assert np.all((got[..., :4] >= 0) & (got[..., :4] <= 1))
+        np.testing.assert_allclose(
+            got[..., 4], want[..., 4], atol=2e-3,
+            err_msg="score column diverged beyond bf16 rounding",
+        )
+
+
+@pytest.mark.slow
+def test_pano_feature_cache_producer_key_isolation(fixture_dir, capsys):
+    """Disk entries are keyed by the PROGRAM that produced them: a tier
+    populated by a sequential run must MISS in a --pano_batch run (and
+    vice versa), because the batched backbone is a different XLA
+    artifact (bf16 rounding differs) and a cross-producer hit would
+    silently break each mode's hit/miss parity contract."""
+    base = [
+        "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
+        "--query_path", str(fixture_dir / "query"),
+        "--pano_path", str(fixture_dir / "pano"),
+        "--image_size", "64",
+        "--n_queries", "1",
+        "--n_panos", "2",
+        "--k_size", "2",
+        "--pano_feature_cache_dir", str(fixture_dir / "fc_prod"),
+    ]
+    eval_inloc.main(base + ["--output_dir", str(fixture_dir / "mp_seq")])
+    capsys.readouterr()
+    # Batched run, same disk dir: the seq-produced entries must not hit.
+    eval_inloc.main(base + [
+        "--output_dir", str(fixture_dir / "mp_bat"),
+        "--pano_batch", "2",
+    ])
+    out = capsys.readouterr().out
+    assert "0/2 hits" in out
+    # Same batched config again: now ITS OWN disk entries hit.
+    eval_inloc.main(base + [
+        "--output_dir", str(fixture_dir / "mp_bat2"),
+        "--pano_batch", "2",
+    ])
+    out = capsys.readouterr().out
+    assert "2/2 hits (100%" in out
+
+
 @pytest.mark.slow
 def test_pano_feature_cache_disk_tier(fixture_dir, capsys):
     """Disk tier: a SECOND process-run with an empty memory cache serves

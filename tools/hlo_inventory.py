@@ -132,10 +132,10 @@ def main(argv=None):
         # (A structurally different program here would make the inventory
         # incomparable to the traced bench block.)
         if bb > 1:
+            from ncnet_tpu.cli.eval_inloc import _bb_group_size
+
             n = tgts.shape[0]
-            nb = max(1, bb)
-            while n % nb:
-                nb -= 1
+            nb = _bb_group_size(n, bb)
             groups = tgts.reshape(n // nb, nb, *tgts.shape[1:])
             # Direct batched extract over each group — the exact call
             # bench.py makes. (vmap-of-batch-1 inserts extra broadcast/
