@@ -41,6 +41,29 @@ from ..models.ncnet import (
 from .common import build_model
 
 
+def _ragged_miss_stacks() -> bool:
+    """NCNET_RAGGED_MISS_STACKS (trace time, default 1): dispatch
+    partial miss stacks at their TRUE size instead of padding to
+    --pano_batch.
+
+    Padding repeats the last pano, so a drain-time group of 1 pays the
+    full p-stack program — p backbones AND p consensus/extract scans —
+    for one useful pano (`_MissGroups.pad`). At the replayed InLoc
+    steady state (tools/cache_steady_state.py, 53% hit-rate) 38% of
+    queries drain a partial group, so the waste is first-order: the
+    measured cached steady state under padding was 9.59 pairs/s/chip —
+    BELOW the 9.74 cold path, because mixed queries paid their hits
+    plus fully-padded miss stacks. Ragged dispatch lets the jitted
+    batch program retrace at each distinct stack size m < p: one extra
+    compile per size, ONE-TIME (persistent compile cache), after which
+    every partial group costs only its true size. PROMOTED to default
+    2026-08-02 on the v5e measurement: steady state 10.75 vs 9.59
+    pairs/s/chip (+12%; tools/bench_steady_state_hw.py, both logs in
+    docs/tpu_r05/). Padding stays available (=0) for environments
+    where per-shape compiles are expensive and uncached (cold CI)."""
+    return os.environ.get("NCNET_RAGGED_MISS_STACKS", "1") == "1"
+
+
 def _bb_group_size(n: int, bb: int) -> int:
     """Largest divisor of stack size ``n`` that is <= ``bb`` (min 1).
 
@@ -511,6 +534,13 @@ def main(argv=None):
                     args.pano_batch,
                     _bb_group_size(args.pano_batch, bb),
                 )
+                if _ragged_miss_stacks():
+                    # Ragged runs mix entries from m-sized programs
+                    # (m <= p) — rounding-equivalent under the batched
+                    # contract, but a different artifact set from the
+                    # always-padded mode, so the two must not share a
+                    # disk tier.
+                    producer += "-r"
             else:
                 # Sequential producer = EMPTY suffix: every disk entry
                 # written before producer keying existed was
@@ -605,7 +635,9 @@ class _MissGroups:
     `--pano_batch` runs cannot drift apart: a bucket dispatches the
     moment `p` same-shape items have decoded; ragged groups are padded
     by repeating their last item (via :meth:`pad`; the padded
-    iterations' outputs are discarded by the caller); and the decoded
+    iterations' outputs are discarded by the caller — unless
+    `NCNET_RAGGED_MISS_STACKS=1`, where the dispatcher sends the true
+    size and the jitted program retraces per size); and the decoded
     backlog across buckets is capped at 2p by early-flushing the
     fullest partial bucket rather than holding an unbounded number of
     decoded 3200 px panos (ADVICE r2).
@@ -663,9 +695,11 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
 
     pending = None  # one-behind: dispatch next stack before fetching prior
 
+    ragged = _ragged_miss_stacks()
+
     def dispatch(chunk):
         nonlocal pending
-        imgs = [img for _, img in groups.pad(chunk)]
+        imgs = [img for _, img in (chunk if ragged else groups.pad(chunk))]
         stack = (
             stack_fn(imgs) if stack_fn is not None
             else jnp.concatenate(imgs, axis=0)
@@ -729,10 +763,13 @@ def _run_panos_cached_batched(args, params, feat_a, buf, pano_fns, pool,
         for k, idx in enumerate(idxs):
             fill_matches(buf, idx, dedup_matches(*(a[k] for a in np_ms)))
 
+    ragged = _ragged_miss_stacks()
+
     def dispatch_miss(chunk):
         nonlocal pending
         stack = jnp.concatenate(
-            [img for _, _, img in groups.pad(chunk)], axis=0
+            [img for _, _, img in (chunk if ragged else groups.pad(chunk))],
+            axis=0,
         )
         ms, feats = batch_with_feats(params, feat_a, stack)
         if pending is not None:

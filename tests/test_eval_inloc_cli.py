@@ -396,6 +396,62 @@ def test_pano_feature_cache_with_pano_batch(fixture_dir, capsys):
         )
 
 
+def test_ragged_miss_stacks(fixture_dir, capsys, monkeypatch):
+    """NCNET_RAGGED_MISS_STACKS=1: a drain-time partial miss group
+    dispatches at its TRUE size — here 2 misses under --pano_batch 3
+    run one 2-stack program instead of a repeat-padded 3-stack — both
+    plain and composed with the feature cache (q1's panos are hits, and
+    the ragged producer key's "-r" suffix keeps its entries out of
+    padded-mode tiers). Contract mirrors the batched tests: padding was
+    never bit-exact across program shapes, so the ragged run must match
+    the padded run at the layout/filled-rows/score-rounding level.
+
+    Ragged is the promoted default (v5e steady state 10.75 vs 9.59
+    pairs/s/chip); the padded baseline is forced explicitly."""
+    base = [
+        "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
+        "--query_path", str(fixture_dir / "query"),
+        "--pano_path", str(fixture_dir / "pano"),
+        "--image_size", "64",
+        "--n_queries", "2",
+        "--n_panos", "2",
+        "--k_size", "2",
+        "--pano_batch", "3",
+    ]
+    monkeypatch.setenv("NCNET_RAGGED_MISS_STACKS", "0")
+    eval_inloc.main(base + [
+        "--output_dir", str(fixture_dir / "rg_pad"),
+        "--pano_feature_cache_mb", "0",
+    ])
+    monkeypatch.setenv("NCNET_RAGGED_MISS_STACKS", "1")
+    eval_inloc.main(base + [
+        "--output_dir", str(fixture_dir / "rg_off"),
+        "--pano_feature_cache_mb", "0",
+    ])
+    eval_inloc.main(base + [
+        "--output_dir", str(fixture_dir / "rg_on"),
+    ])
+    out = capsys.readouterr().out
+    # Cached run: q0 misses both panos (one ragged 2-stack), q1 hits.
+    assert "2/4 hits (50%" in out
+
+    exp_pad = os.listdir(fixture_dir / "rg_pad")[0]
+    for mode_dir in ("rg_off", "rg_on"):
+        exp = os.listdir(fixture_dir / mode_dir)[0]
+        for q in ("1.mat", "2.mat"):
+            want = loadmat(fixture_dir / "rg_pad" / exp_pad / q)["matches"]
+            got = loadmat(fixture_dir / mode_dir / exp / q)["matches"]
+            assert got.shape == want.shape
+            np.testing.assert_array_equal(
+                np.any(got != 0, axis=-1), np.any(want != 0, axis=-1)
+            )
+            np.testing.assert_allclose(
+                got[..., 4], want[..., 4], atol=2e-3,
+                err_msg=f"{mode_dir}/{q} scores diverged beyond bf16 "
+                        "rounding vs the padded run",
+            )
+
+
 @pytest.mark.slow
 def test_pano_feature_cache_producer_key_isolation(fixture_dir, capsys):
     """Disk entries are keyed by the PROGRAM that produced them: a tier

@@ -1,0 +1,354 @@
+"""MEASURED steady-state throughput of the cached+batched InLoc path.
+
+BASELINE.md's "blended 10.96 pairs/s/chip" folds the two measured
+endpoint rates (cold 9.69 / all-hits 12.39, bench.py) linearly over the
+replayed 53% pano hit-rate (tools/cache_steady_state.py). That linear
+blend ignores real-path structure that only costs on MIXED queries:
+
+- miss stacks pad to full --pano_batch groups (`_MissGroups.pad`,
+  cli/eval_inloc.py): a query with 6 cache hits still pays the full
+  5-pano miss program (5 backbones AND 5 consensus/extract scans) for
+  its 4 misses — at the replayed schedule, 38% of queries drain at
+  least one partial group;
+- a mixed block interleaves the hit scan with the batched miss program
+  inside one query, a program composition neither endpoint runs.
+
+This tool measures those compositions directly on hardware. The replay
+(pose-grounded shortlist structure over the real byte-bounded LRU —
+same machinery as cache_steady_state) yields each query's composition
+class `(h hits, miss-stack sizes)`; the most frequent classes are built
+as bench-convention query blocks (ONE jitted program per class: query
+backbone + length-h hit scan + the class's miss stacks with the bf16
+feature output the cache store consumes) and timed like bench.py
+(scalar-fetch closed, device-resident inputs — transfers are excluded
+exactly as in the endpoint numbers, where the CLI overlaps them with
+dispatch/decode). Unmeasured rare classes are filled by a least-squares
+fit t = t_query + h*t_hit + n_stacks*t_stack + n_slots*t_slot; its
+residuals on the measured classes are reported so the linearity
+assumption is checked, not assumed.
+
+--ragged additionally evaluates NCNET_RAGGED_MISS_STACKS=1 (partial
+groups dispatch at their true size instead of padding to 5), the
+candidate default this tool exists to decide.
+
+Output: one JSON line with the measured steady-state pairs/s/chip, the
+per-class table, and the fit diagnostics.
+
+Reference workload: eval_inloc.py:124-132 (356 queries x top-10
+shortlist); cache path: cli/eval_inloc.py `_run_panos_cached_batched`.
+
+Run (one JAX client at a time — never concurrently with a session):
+    python tools/bench_steady_state_hw.py [--ragged] [--classes 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import Counter
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+PANOS_PER_QUERY = 10
+P = 5  # --pano_batch / NCNET_PANO_BACKBONE_BATCH promoted default
+
+
+def miss_sizes(m: int, ragged: bool) -> tuple:
+    """Stack sizes the CLI dispatches for m misses in one query: full
+    groups of P as misses decode, the remainder padded (default) or at
+    its true size (NCNET_RAGGED_MISS_STACKS=1)."""
+    sizes = (P,) * (m // P)
+    if m % P:
+        sizes += (m % P if ragged else P,)
+    return sizes
+
+
+def schedule_histogram(cache_mb: int, ragged: bool):
+    """{(hits, miss_sizes): n_queries} over the pose-grounded replay.
+
+    Same replay as tools/cache_steady_state.py (its documented
+    surrogate caveats apply here unchanged); re-derived per run so the
+    histogram always matches the current cache/bucketing defaults.
+    """
+    from cache_steady_state import (
+        ENTRY_DTYPE,
+        ENTRY_SHAPE,
+        REFPOSES_DEFAULT,
+        build_scans,
+        build_shortlists,
+        load_queries,
+        synthetic_queries,
+    )
+
+    from ncnet_tpu.evals.feature_cache import PanoFeatureCache
+
+    if os.path.exists(REFPOSES_DEFAULT):
+        queries = load_queries(REFPOSES_DEFAULT)
+    else:  # sandbox without the reference tree: keep the tool runnable
+        queries = synthetic_queries()
+    lists = build_shortlists(queries, build_scans(queries))
+    entry = np.broadcast_to(np.zeros((), ENTRY_DTYPE), ENTRY_SHAPE)
+    cache = PanoFeatureCache(cache_mb * 1024 * 1024)
+    hist: Counter = Counter()
+    for cuts in lists:
+        h = 0
+        for cut in cuts:
+            if cache.get(cut, (3072, 2304)) is not None:
+                h += 1
+            else:
+                cache.put(cut, (3072, 2304), entry)
+        hist[(h, miss_sizes(len(cuts) - h, ragged))] += 1
+    hit_rate = cache.hits / (cache.hits + cache.misses)
+    return hist, hit_rate
+
+
+def pick_classes(hist: Counter, n: int):
+    """The n most frequent classes, extended (within n+2) until every
+    distinct stack size in the histogram is covered by some measured
+    class — the fit cannot otherwise pin a size's cost."""
+    by_freq = sorted(hist.items(), key=lambda kv: -kv[1])
+    chosen = [c for c, _ in by_freq[:n]]
+    need = {s for (_, sizes) in hist for s in sizes}
+    have = {s for (_, sizes) in chosen for s in sizes}
+    for c, _ in by_freq[n:]:
+        if len(chosen) >= n + 2 or need <= have:
+            break
+        if set(c[1]) - have:
+            chosen.append(c)
+            have |= set(c[1])
+    return chosen
+
+
+def fit_features(h: int, sizes: tuple):
+    return [1.0, float(h), float(len(sizes)), float(sum(sizes))]
+
+
+def class_label(h: int, sizes: tuple) -> str:
+    return f"h{h}m" + ("-".join(str(s) for s in sizes) or "0")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ragged", action="store_true",
+                    help="evaluate NCNET_RAGGED_MISS_STACKS=1 dispatch "
+                         "(partial miss groups at true size)")
+    ap.add_argument("--classes", type=int, default=6,
+                    help="measure the N most frequent composition classes")
+    ap.add_argument("--blocks", type=int, default=3,
+                    help="timed blocks per class (after warmup)")
+    ap.add_argument("--cache_mb", type=int, default=4096)
+    ap.add_argument("--dial_timeout", type=float, default=120.0)
+    args = ap.parse_args(argv)
+
+    hist, hit_rate = schedule_histogram(args.cache_mb, args.ragged)
+    n_queries = sum(hist.values())
+    measured_classes = pick_classes(hist, args.classes)
+    print(f"# schedule: {n_queries} queries, hit-rate {hit_rate:.3f}, "
+          f"{len(hist)} classes; measuring "
+          f"{[class_label(*c) for c in measured_classes]}", flush=True)
+
+    import jax
+
+    from ncnet_tpu.utils.profiling import dial_devices, setup_compile_cache
+
+    setup_compile_cache()
+    devices = dial_devices(args.dial_timeout)
+    if devices is None:
+        print("dial failed; aborting (this tool needs the accelerator)")
+        return 2
+    on_tpu = devices[0].platform != "cpu"
+    print(f"# backend: {devices[0]}", flush=True)
+
+    import jax.numpy as jnp
+
+    from ncnet_tpu.cli.eval_inloc import (
+        _bb_group_size,
+        inloc_resize_shape,
+        resolve_feat_units,
+    )
+    from ncnet_tpu.evals import inloc_device_matches
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+    from ncnet_tpu.models.ncnet import (
+        extract_features,
+        ncnet_forward_from_features,
+    )
+
+    # Same configuration/bucketing as bench.py's headline block.
+    if on_tpu:
+        nominal, nom_h, nom_w = 3200, 3200, 2400
+    else:
+        nominal = nom_h = nom_w = int(
+            os.environ.get("NCNET_BENCH_SMOKE_SIZE", "512")
+        )
+    units = resolve_feat_units(-1, nominal, 2)
+    h_a, w_a = inloc_resize_shape(
+        nom_h, nom_w, nominal, 2, h_unit=units[0], w_unit=units[1]
+    )
+    config = NCNetConfig(
+        backbone=BackboneConfig(compute_dtype="bfloat16"),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=2,
+        half_precision=True,
+        use_fused_corr_pool=True,
+        fused_impl="auto",
+    )
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+
+    def match_from_feats(prm, feat_a, feat_b):
+        corr, delta = ncnet_forward_from_features(
+            config, prm, feat_a, feat_b, final_mutual=True
+        )
+        return inloc_device_matches(corr, delta4d=delta, k_size=2,
+                                    impl="auto")
+
+    def probe_of(m):
+        # Full-sum probe (bench.py convention): consume every output
+        # element so XLA cannot DCE part of the extraction.
+        return sum(jnp.sum(v.astype(jnp.float32)) for v in m)
+
+    def build_block(h, sizes):
+        """One query block of composition (h hits, miss stacks of
+        `sizes`): the device work `_run_panos_cached_batched` dispatches
+        for such a query, as ONE program (the endpoints' convention)."""
+
+        def miss_group(prm, feat_a, acc, stack):
+            m = stack.shape[0]
+            nb = _bb_group_size(m, P)  # the CLI's one grouping rule
+            groups = stack.reshape(m // nb, nb, *stack.shape[1:])
+            feats_b = jax.lax.map(
+                lambda grp: extract_features(config, prm, grp), groups
+            )
+            # The store's bf16 rounding is part of the real miss program
+            # (pano_matches_batch_with_feats); its sum keeps the cast
+            # un-DCE'd (one extra HBM read, ~0.3 ms — negligible next to
+            # the backbones).
+            f16 = feats_b.astype(jnp.bfloat16)
+            fb = feats_b.reshape(m, 1, *feats_b.shape[2:])
+
+            def body_miss(aa, feat_b):
+                return aa + probe_of(
+                    match_from_feats(prm, feat_a, feat_b)
+                ), None
+
+            acc, _ = jax.lax.scan(body_miss, acc, fb)
+            return acc + jnp.sum(f16.astype(jnp.float32))
+
+        @jax.jit
+        def block(prm, src, feats_stack, tgt_stacks):
+            feat_a = extract_features(config, prm, src)
+            acc = jnp.float32(0)
+            if h:
+                def body_hit(a, feat_b):
+                    return a + probe_of(
+                        match_from_feats(prm, feat_a, feat_b)
+                    ), None
+
+                acc, _ = jax.lax.scan(body_hit, acc, feats_stack)
+            for stack in tgt_stacks:
+                acc = miss_group(prm, feat_a, acc, stack)
+            return acc
+
+        return block
+
+    key = jax.random.PRNGKey(1)
+    k1, k2, k3 = jax.random.split(key, 3)
+    src = jax.random.normal(k1, (1, 3, h_a, w_a), jnp.float32)
+    fh, fw = h_a // 16, w_a // 16  # backbone stride (SURVEY §2.1)
+    # Hit entries: bf16 features, the dtype the cache stores. Distinct
+    # per-slot contents (honest per-pano work inside the scan).
+    h_max = max(h for h, _ in measured_classes)
+    feats_all = jax.random.normal(
+        k2, (max(h_max, 1), 1, 1024, fh, fw), jnp.float32
+    ).astype(jnp.bfloat16)
+    imgs_all = jax.random.normal(k3, (PANOS_PER_QUERY, 3, h_a, w_a),
+                                 jnp.float32)
+
+    results = {}
+    for h, sizes in measured_classes:
+        feats = (feats_all[:h] if h else
+                 jnp.zeros((0, 1, 1024, fh, fw), jnp.bfloat16))
+        tgts, off = [], 0
+        for s in sizes:
+            tgts.append(imgs_all[off:off + s])
+            off += s
+        label = class_label(h, sizes)
+        print(f"# compiling block {label}...", flush=True)
+        block = build_block(h, sizes)
+        t0 = time.perf_counter()
+        float(block(params, src, feats, tgts))  # compile + warmup
+        print(f"#   compiled+ran in {time.perf_counter() - t0:.1f}s; "
+              "timing...", flush=True)
+        float(block(params, src, feats, tgts))  # settle queues
+        t0 = time.perf_counter()
+        for _ in range(args.blocks):
+            # Scalar fetch closes each block (tunneled block_until_ready
+            # can return early — bench.py convention).
+            float(block(params, src, feats, tgts))
+        dt = (time.perf_counter() - t0) / args.blocks
+        results[(h, sizes)] = dt
+        print(f"#   {label}: {dt * 1e3:.1f} ms/block "
+              f"({PANOS_PER_QUERY / dt:.3f} pairs/s)", flush=True)
+
+    # Least-squares fill for unmeasured classes + linearity check on the
+    # measured ones. Padded-only data has n_slots = 5*n_stacks
+    # (collinear): lstsq's minimum-norm solution still predicts
+    # correctly inside that subspace, which is exactly where the
+    # unmeasured padded classes live.
+    A = np.array([fit_features(h, s) for (h, s) in results])
+    y = np.array(list(results.values()))
+    coef, *_ = np.linalg.lstsq(A, y, rcond=None)
+
+    def predict(h, sizes):
+        return float(np.dot(fit_features(h, sizes), coef))
+
+    fit_err = {
+        class_label(h, s): round(predict(h, s) / t - 1.0, 4)
+        for (h, s), t in results.items()
+    }
+
+    total_time = 0.0
+    table = {}
+    for (h, sizes), n in sorted(hist.items()):
+        t = results.get((h, sizes))
+        src_kind = "measured"
+        if t is None:
+            t = predict(h, sizes)
+            src_kind = "fit"
+        total_time += n * t
+        table[class_label(h, sizes)] = {
+            "queries": n,
+            "ms_per_block": round(t * 1e3, 1),
+            "pairs_per_s": round(PANOS_PER_QUERY / t, 3),
+            "source": src_kind,
+        }
+    measured = PANOS_PER_QUERY * n_queries / total_time
+
+    print(json.dumps({
+        "metric": "inloc_steady_state_pairs_per_s_per_chip"
+        + ("_ragged" if args.ragged else "")
+        + ("" if on_tpu else "_cpu_smoke"),
+        "value": round(measured, 4),
+        "unit": "pairs/s/chip",
+        "hit_rate": round(hit_rate, 4),
+        "queries": n_queries,
+        "classes": table,
+        "fit_coef_ms": {
+            "t_query": round(float(coef[0]) * 1e3, 1),
+            "t_hit": round(float(coef[1]) * 1e3, 1),
+            "t_stack": round(float(coef[2]) * 1e3, 1),
+            "t_slot": round(float(coef[3]) * 1e3, 1),
+        },
+        "fit_residuals": fit_err,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
