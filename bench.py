@@ -29,14 +29,38 @@ def note(msg):
     """Stage timestamps on stderr: a silent hang is then attributable to a
     specific stage (device dial, compile, execute) instead of opaque."""
     print(f"# [{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+    # Mirror into the run log when one is active (NCNET_RUN_LOG): each
+    # note is a progress marker, so the heartbeat's idle clock measures
+    # time since the last *stage*, not since run start.
+    try:
+        from ncnet_tpu import obs
+
+        obs.event("note", msg=msg)
+    except Exception:
+        pass
 
 
 def main():
     import jax
 
+    from ncnet_tpu import obs
     from ncnet_tpu.utils.profiling import dial_devices, setup_compile_cache
 
     setup_compile_cache()
+
+    # Run log is OPT-IN here (NCNET_RUN_LOG=<path or dir>): bench's stdout
+    # contract is exactly one JSON line, and the default invocation inside
+    # tools/tpu_session.py runs main() many times in one process — an
+    # unconditional log would stack open runs. The headline JSON doubles
+    # as a `bench.headline` event when enabled.
+    run_log = None
+    log_dest = os.environ.get("NCNET_RUN_LOG", "")
+    if log_dest:
+        run_log = obs.init_run(
+            "bench",
+            obs.default_log_path(log_dest, "bench")
+            if os.path.isdir(log_dest) else log_dest,
+        )
 
     import jax.numpy as jnp
 
@@ -465,20 +489,23 @@ def main():
                 else:
                     shutil.rmtree(tdir, ignore_errors=True)
 
-    print(
-        json.dumps(
-            {
-                "metric": "inloc_dense_match_pairs_per_s_per_chip"
-                + ("" if on_tpu else "_cpu_smoke"),
-                "value": round(pairs_per_s, 4),
-                "unit": "pairs/s/chip",
-                "vs_baseline": round(pairs_per_s / V100_BASELINE_PAIRS_PER_S, 4),
-                "fused": fused_ran,
-                "path": name,
-                "util": util,
-            }
-        )
-    )
+    headline = {
+        "metric": "inloc_dense_match_pairs_per_s_per_chip"
+        + ("" if on_tpu else "_cpu_smoke"),
+        "value": round(pairs_per_s, 4),
+        "unit": "pairs/s/chip",
+        "vs_baseline": round(pairs_per_s / V100_BASELINE_PAIRS_PER_S, 4),
+        "fused": fused_ran,
+        "path": name,
+        "util": util,
+    }
+    if run_log is not None:
+        # The same dict BENCH_r*.json archives, queryable from the run
+        # log; the gauge makes it diffable by tools/obs_report.py.
+        obs.gauge("bench.pairs_per_s").set(pairs_per_s)
+        run_log.event("bench.headline", **headline)
+        run_log.close("ok")
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

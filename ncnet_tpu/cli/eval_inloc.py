@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import argparse
 import os
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..evals import (
     dedup_matches,
     fill_matches,
@@ -233,6 +235,12 @@ def main(argv=None):
         "persist across runs, keyed by checkpoint + resize bucket)",
     )
     parser.add_argument(
+        "--run_log", type=str, default="auto",
+        help="structured JSONL run log (docs/OBSERVABILITY.md): 'auto' "
+        "writes runlog-eval_inloc-<stamp>.jsonl into the experiment "
+        "output dir, a path writes there, empty disables",
+    )
+    parser.add_argument(
         "--feat_unit", type=int, default=-1,
         help="feature-dim alignment unit for the resize buckets (-1 auto: "
         "16 at InLoc scale so pooled dims are vector-friendly multiples "
@@ -295,6 +303,26 @@ def main(argv=None):
     os.makedirs(out_dir, exist_ok=True)
     print(f"Output matches folder: {out_dir}")
 
+    run_log = None
+    if args.run_log:
+        # Default inside the experiment dir: one experiment, one place
+        # for its artifacts. The Matlab stage reads <q>.mat paths, so a
+        # runlog-*.jsonl alongside them is inert.
+        run_log = obs.init_run(
+            "eval_inloc",
+            args.run_log if args.run_log != "auto"
+            else obs.default_log_path(out_dir, "eval_inloc"),
+            args=args,
+        )
+        # Backend already dialed (build_model jitted above), so the
+        # device list is free to record here — run_start deliberately
+        # doesn't (obs.events._device_metadata).
+        run_log.event(
+            "devices",
+            n_devices=len(jax.devices()),
+            platform=jax.devices()[0].platform,
+        )
+
     # State the resolved geometry up front (ADVICE r2): the default
     # feat_unit=16 buckets 3200x2400 px panos to 3072x2304 (features
     # 192x144), which is NOT the reference's exact 200x150 feature grid —
@@ -314,6 +342,8 @@ def main(argv=None):
         f"{example_w // 16}). Pass --feat_unit 2 to reproduce the "
         "reference's exact feature dims."
     )
+    obs.event("config", experiment=experiment, out_dir=out_dir,
+              feat_units=list(units))
 
     dbmat = loadmat(args.inloc_shortlist)
     db = dbmat["ImgList"][0, :]
@@ -395,10 +425,10 @@ def main(argv=None):
             # single-pano math (incl. the batch-1 Pallas extraction) runs
             # per chip with zero cross-device traffic; outputs restack to
             # [n_dp, n_matches] exactly like the scan path's.
-            from jax import shard_map
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from ..parallel import make_mesh
+            from ..parallel.mesh import shard_map_compat
 
             dp_mesh = make_mesh((args.pano_batch,), ("dp",))
             stack_sharding = NamedSharding(dp_mesh, P("dp"))
@@ -407,12 +437,11 @@ def main(argv=None):
                 m = pano_matches_one(params, feat_a, tgt)
                 return tuple(v[None] for v in m)
 
-            _pano_dp_jit = jax.jit(shard_map(
+            _pano_dp_jit = jax.jit(shard_map_compat(
                 _one_shard,
                 mesh=dp_mesh,
                 in_specs=(P(), P(), P("dp")),
                 out_specs=P("dp"),
-                check_vma=False,
             ))
 
             # Replicate the weights over the mesh ONCE — otherwise every
@@ -616,15 +645,33 @@ def main(argv=None):
          pano_matches_batch_with_feats)
         if cache is not None else None
     )
+    t_loop = time.perf_counter()
     try:
         with trace_context(args.profile_dir):
             _query_loop(args, db, out_dir, params, query_features, pano_matches,
                         n_matches, pano_fn_all, pool, load_pano, batch_fn,
                         cache=cache, cache_fns=cache_fns, stack_fn=stack_fn)
+    except BaseException as exc:
+        if run_log is not None:
+            run_log.close(f"error:{type(exc).__name__}")
+            run_log = None
+        raise
     finally:
         pool.shutdown(wait=False, cancel_futures=True)
+    elapsed = time.perf_counter() - t_loop
+    pairs = obs.counter("eval_inloc.pairs").value
+    if elapsed > 0:
+        obs.gauge("eval_inloc.pairs_per_s").set(pairs / elapsed)
     if cache is not None:
         print(cache.stats(), flush=True)
+        obs.gauge("eval_inloc.cache.hits").set(cache.hits)
+        obs.gauge("eval_inloc.cache.misses").set(cache.misses)
+        obs.gauge("eval_inloc.cache.disk_hits").set(cache.disk_hits)
+        obs.event("cache_stats", stats=cache.stats(), hits=cache.hits,
+                  misses=cache.misses, disk_hits=cache.disk_hits)
+    if run_log is not None:
+        run_log.flush_metrics(phase="matching")
+        run_log.close("ok", pairs=pairs, elapsed_s=elapsed)
     return out_dir
 
 
@@ -672,9 +719,15 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
                        load_pano, stack_fn=None):
     """All of one query's panos in same-shape stacks of --pano_batch.
 
-    Ragged groups are padded by repeating their last pano (the padded
-    iterations' outputs are discarded), so each bucket shape compiles
-    exactly one program regardless of how the shortlist's shapes mix.
+    Ragged dispatch is the default (`NCNET_RAGGED_MISS_STACKS=1`, see
+    `_ragged_miss_stacks` / `_MissGroups`): partial groups run at their
+    TRUE size, one extra jit retrace per distinct size. With
+    `NCNET_RAGGED_MISS_STACKS=0` — and ALWAYS under `--pano_dp`
+    (`stack_fn` set), whose sharded device_put needs stacks divisible
+    by the mesh — ragged groups fall back to padding by repeating their
+    last pano (the padded iterations' outputs are discarded), so each
+    bucket shape compiles exactly one program regardless of how the
+    shortlist's shapes mix.
     """
     p = args.pano_batch
     n = len(pano_fns)
@@ -695,10 +748,18 @@ def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,
 
     pending = None  # one-behind: dispatch next stack before fetching prior
 
-    ragged = _ragged_miss_stacks()
+    # --pano_dp (stack_fn set) MUST pad: its device_put shards the stack
+    # over the dp mesh, and a ragged partial group's leading dim is not
+    # divisible by the mesh size (ADVICE r5 high).
+    ragged = _ragged_miss_stacks() and stack_fn is None
 
     def dispatch(chunk):
         nonlocal pending
+        obs.counter("eval_inloc.dispatch.ragged" if len(chunk) < p and ragged
+                    else "eval_inloc.dispatch.padded" if len(chunk) < p
+                    else "eval_inloc.dispatch.full").inc()
+        if len(chunk) < p and not ragged:
+            obs.counter("eval_inloc.pad_slots").inc(p - len(chunk))
         imgs = [img for _, img in (chunk if ragged else groups.pad(chunk))]
         stack = (
             stack_fn(imgs) if stack_fn is not None
@@ -767,6 +828,11 @@ def _run_panos_cached_batched(args, params, feat_a, buf, pano_fns, pool,
 
     def dispatch_miss(chunk):
         nonlocal pending
+        obs.counter("eval_inloc.dispatch.ragged" if len(chunk) < p and ragged
+                    else "eval_inloc.dispatch.padded" if len(chunk) < p
+                    else "eval_inloc.dispatch.full").inc()
+        if len(chunk) < p and not ragged:
+            obs.counter("eval_inloc.pad_slots").inc(p - len(chunk))
         stack = jnp.concatenate(
             [img for _, _, img in (chunk if ragged else groups.pad(chunk))],
             axis=0,
@@ -854,8 +920,17 @@ def _query_loop(args, db, out_dir, params, query_features, pano_matches,
     for q in range(min(args.n_queries, len(db))):
         out_path = os.path.join(out_dir, f"{q + 1}.mat")
         if args.resume and os.path.exists(out_path):
+            obs.counter("eval_inloc.queries_skipped").inc()
             continue
         query_fn = db[q][0].item()
+        t_q = time.perf_counter()
+
+        def _query_done():
+            obs.counter("eval_inloc.queries").inc()
+            obs.counter("eval_inloc.pairs").inc(args.n_panos)
+            obs.event("query", q=q, query_fn=query_fn, n_panos=args.n_panos,
+                      dur_s=time.perf_counter() - t_q)
+
         src = jnp.asarray(
             load_inloc_image(
                 os.path.join(args.query_path, query_fn), args.image_size, args.k_size,
@@ -872,18 +947,21 @@ def _query_loop(args, db, out_dir, params, query_features, pano_matches,
                                       pool, cache, cache_fns)
             write_matches_mat(out_path, buf, query_fn, pano_fn_all)
             print(f"wrote {out_path}", flush=True)
+            _query_done()
             continue
         if batch_fn is not None:
             _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns,
                                pool, load_pano, stack_fn=stack_fn)
             write_matches_mat(out_path, buf, query_fn, pano_fn_all)
             print(f"wrote {out_path}", flush=True)
+            _query_done()
             continue
         if cache is not None:
             _run_panos_cached(args, params, feat_a, buf, pano_fns, pool,
                               cache, cache_fns)
             write_matches_mat(out_path, buf, query_fn, pano_fn_all)
             print(f"wrote {out_path}", flush=True)
+            _query_done()
             continue
         fut = pool.submit(load_pano, pano_fns[0]) if pano_fns else None
         # One-behind host processing: pano idx's forward is dispatched (async)
@@ -905,6 +983,7 @@ def _query_loop(args, db, out_dir, params, query_features, pano_matches,
             fill_matches(buf, pending[0], dedup_matches(*pending[1]))
         write_matches_mat(out_path, buf, query_fn, pano_fn_all)
         print(f"wrote {out_path}", flush=True)
+        _query_done()
 
 
 if __name__ == "__main__":

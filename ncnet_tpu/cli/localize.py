@@ -20,6 +20,7 @@ import os
 
 import numpy as np
 
+from .. import obs
 from ..localization import (
     LocalizationParams,
     localization_rate,
@@ -65,7 +66,21 @@ def main(argv=None):
         help="localize queries concurrently (the reference's Matlab parfor)",
     )
     p.add_argument("--gt_poses", default="", help=".mat/.npz of ground-truth poses for curves")
+    p.add_argument(
+        "--run_log", default="auto",
+        help="run-log JSONL path; 'auto' = <output_dir>/runlog-*.jsonl, '' disables",
+    )
     args = p.parse_args(argv)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    run_log = None
+    if args.run_log:
+        run_log = obs.init_run(
+            "localize",
+            args.run_log if args.run_log != "auto"
+            else obs.default_log_path(args.output_dir, "localize"),
+            args=args,
+        )
 
     from scipy.io import loadmat
     from ..data.image_io import read_image
@@ -135,19 +150,25 @@ def main(argv=None):
         top_n=args.top_n,
         use_pose_verification=args.pose_verification,
     )
-    results = localize_queries(
-        order,
-        shortlist=lambda q: table[q],
-        load_matches=load_matches,
-        load_cutout=load_cutout,
-        query_size=query_size,
-        focal_length=args.focal_length,
-        params=params,
-        cache_dir=os.path.join(args.output_dir, "pnp_cache"),
-        load_query_image=load_query_image if args.pose_verification else None,
-        progress=lambda q: print(f"localized: {q}", flush=True),
-        num_workers=args.num_workers,
-    )
+    try:
+        results = localize_queries(
+            order,
+            shortlist=lambda q: table[q],
+            load_matches=load_matches,
+            load_cutout=load_cutout,
+            query_size=query_size,
+            focal_length=args.focal_length,
+            params=params,
+            cache_dir=os.path.join(args.output_dir, "pnp_cache"),
+            load_query_image=load_query_image if args.pose_verification else None,
+            progress=lambda q: print(f"localized: {q}", flush=True),
+            num_workers=args.num_workers,
+        )
+    except BaseException as exc:
+        if run_log is not None:
+            run_log.close(f"error:{type(exc).__name__}")
+            run_log = None
+        raise
 
     poses_path = os.path.join(args.output_dir, "poses.npz")
     create_file_path(poses_path)
@@ -190,6 +211,12 @@ def main(argv=None):
         }
         print(json.dumps(summary))
         print(f"wrote {curve_png}")
+    if run_log is not None:
+        n_unsolved = sum(1 for r in results if r.best_index < 0)
+        run_log.event("localization_summary", n_queries=len(results),
+                      n_unsolved=n_unsolved, summary=summary)
+        run_log.flush_metrics(phase="localization")
+        run_log.close("ok", n_queries=len(results))
     return summary
 
 

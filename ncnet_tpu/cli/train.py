@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..data import ImagePairDataset, DataLoader
 from ..parallel import make_mesh, multihost
 from ..training import (
@@ -64,6 +65,12 @@ def main(argv=None):
     parser.add_argument("--num_workers", type=int, default=8)
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument("--log_interval", type=int, default=1)
+    parser.add_argument(
+        "--run_log", type=str, default="auto",
+        help="structured JSONL run log (docs/OBSERVABILITY.md): 'auto' "
+        "writes runlog-train-<stamp>.jsonl into the run's checkpoint "
+        "dir (host 0 only), a path writes there, empty disables",
+    )
     # Preemption story (SURVEY §5): --save_interval N writes a rolling
     # mid-epoch checkpoint (tag "step") every N steps; --resume continues
     # a --checkpoint run from its recorded (epoch, step) instead of from
@@ -262,6 +269,23 @@ def main(argv=None):
         except FileExistsError:
             suffix += 1
 
+    # Telemetry on host 0 only: params/losses are replicated, so one
+    # run log per run (same ownership rule as checkpoint writes).
+    run_log = None
+    if args.run_log and multihost.process_index() == 0:
+        run_log = obs.init_run(
+            "train",
+            args.run_log if args.run_log != "auto"
+            else obs.default_log_path(ckpt_dir, "train"),
+            args=args,
+        )
+        run_log.event(
+            "devices",
+            n_devices=len(jax.devices()),
+            platform=jax.devices()[0].platform,
+            ckpt_dir=ckpt_dir,
+        )
+
     # --resume: continue from the checkpoint's recorded position. A
     # mid-epoch ("step") checkpoint carries step_in_epoch; a per-epoch one
     # means that epoch COMPLETED, so resumption starts at the next.
@@ -343,10 +367,17 @@ def main(argv=None):
 
     from ..utils.profiling import trace_context
 
-    with trace_context(args.profile_dir):
-        _epoch_loop(args, config, state, train_step, eval_step, loader,
-                    loader_val, put, ckpt_dir, start_epoch=start_epoch,
-                    skip_steps=skip_steps, resume_meta=resume_meta)
+    try:
+        with trace_context(args.profile_dir):
+            _epoch_loop(args, config, state, train_step, eval_step, loader,
+                        loader_val, put, ckpt_dir, start_epoch=start_epoch,
+                        skip_steps=skip_steps, resume_meta=resume_meta)
+    except BaseException as exc:
+        if run_log is not None:
+            run_log.close(f"error:{type(exc).__name__}")
+        raise
+    if run_log is not None:
+        run_log.close("ok")
     print("Done!")
 
 
@@ -430,13 +461,22 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
         # that costs a round trip per batch. The sync happens only at log
         # points (per batch at the default --log_interval 1, matching the
         # reference's per-batch print; raise it to unlock async dispatch).
+        t_step = time.perf_counter()
         for i, batch in enumerate(device_prefetch(resumed(), put), start=skip):
             trainable, opt_state, loss = train_step(
                 trainable, state.frozen, opt_state,
                 batch["source_image"], batch["target_image"],
             )
+            # Host wall time between dispatches — measures the steady-
+            # state step rate without adding a sync (under async dispatch
+            # individual values lag the device; the mean converges).
+            now = time.perf_counter()
+            obs.histogram("train.step_time_s").observe(now - t_step)
+            t_step = now
             if i % args.log_interval == 0:
                 loss = float(loss)  # the only fetch of this scalar
+                obs.gauge("train.loss").set(loss)
+                obs.event("train_step", epoch=epoch, step=i, loss=loss)
                 print(
                     f"Train epoch {epoch} [{i}/{len(loader)}]\tloss: "
                     f"{loss:.6f}",
@@ -506,6 +546,13 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
             f"({dt:.1f}s, train {pairs_per_s:.1f} pairs/s)",
             flush=True,
         )
+        obs.gauge("train.pairs_per_s").set(pairs_per_s)
+        obs.event("epoch", epoch=epoch, train_loss=train_loss,
+                  val_loss=val_loss, pairs_per_s=pairs_per_s, dur_s=dt,
+                  n_steps=len(losses) - n_preloaded, n_val=n_val)
+        # Metrics snapshots ride the epoch boundary — an existing host
+        # sync point (train_loss/val_loss were just fetched).
+        obs.get_run().flush_metrics(phase=f"epoch{epoch}")
         train_losses.append(train_loss)
         val_losses.append(val_loss)
 

@@ -22,6 +22,8 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from .. import obs
+
 
 def default_collate(samples):
     """Stack a list of sample dicts into a batch dict.
@@ -135,8 +137,16 @@ class DataLoader:
 
         producer = threading.Thread(target=produce, daemon=True)
         producer.start()
+        depth = obs.gauge("data.loader.queue_depth")
+        starved = obs.counter("data.loader.starved")
         try:
             while True:
+                # An empty queue at get() means the device side is about to
+                # wait on host decode — the input-bound signal the run log
+                # surfaces as data.loader.starved.
+                depth.set(q.qsize())
+                if q.empty():
+                    starved.inc()
                 item = q.get()
                 if item is None:
                     return

@@ -149,16 +149,12 @@ def inloc_device_matches(
     if both_directions:
         if impl == "pallas" and fused_ok:
             raw = _raw_matches_stats(corr4d, delta4d, k_size, do_softmax)
-        elif impl == "auto" and fused_ok:
-            raw = jax.lax.platform_dependent(
-                corr4d,
-                tpu=lambda c: _raw_matches_stats(
-                    c, delta4d, k_size, do_softmax
-                ),
-                default=lambda c: _raw_matches_xla(
-                    c, delta4d, k_size, do_softmax
-                ),
-            )
+        elif impl == "auto" and fused_ok and jax.default_backend() == "tpu":
+            # Trace-time backend choice, NOT lax.platform_dependent: the
+            # per-platform cond lowers every branch, and the Pallas
+            # kernel has no CPU lowering (interpret-only), so the cond
+            # itself fails to compile off-TPU.
+            raw = _raw_matches_stats(corr4d, delta4d, k_size, do_softmax)
         else:
             raw = _raw_matches_xla(corr4d, delta4d, k_size, do_softmax)
     else:
@@ -214,10 +210,13 @@ def inloc_matches_from_consensus(
         raw = fused(consensus4d)
     elif impl == "xla":
         raw = unfused(consensus4d)
+    elif jax.default_backend() == "tpu":
+        # Trace-time backend choice (see inloc_device_matches): the
+        # platform cond would lower the interpret-only Pallas branch on
+        # CPU and fail the whole compile.
+        raw = fused(consensus4d)
     else:
-        raw = jax.lax.platform_dependent(
-            consensus4d, tpu=fused, default=unfused
-        )
+        raw = unfused(consensus4d)
     return _sort_and_recenter(raw, shape4d, k_size)
 
 

@@ -19,6 +19,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from .. import obs
 from ..utils.py_util import create_file_path
 from .backproject import matches_to_2d3d
 from .pnp import lo_ransac_p3p
@@ -159,6 +160,17 @@ def localize_queries(
         result = QueryResult(
             query=q, poses=poses, num_inliers=ninl,
             pv_scores=pv_scores, best_index=best,
+        )
+        obs.counter("localization.queries").inc()
+        if best < 0:
+            obs.counter("localization.unsolved").inc()
+        else:
+            obs.histogram("localization.best_inliers").observe(ninl[best])
+        obs.event(
+            "query_localized", query=q, solved=best >= 0,
+            best_index=best,
+            num_inliers=int(ninl[best]) if best >= 0 else 0,
+            n_panos=len(panos),
         )
         if progress is not None:
             progress(q)

@@ -1,0 +1,65 @@
+"""Run telemetry: structured event log, metrics registry, heartbeat.
+
+See docs/OBSERVABILITY.md for the event schema and metric naming
+convention. Quick tour::
+
+    from ncnet_tpu import obs
+
+    run = obs.init_run("eval_inloc", obs.default_log_path(out_dir,
+                                                          "eval_inloc"),
+                       args=args)
+    obs.counter("eval_inloc.cache.hits").inc()
+    with obs.span("consensus", sync=lambda: corr):
+        ...
+    run.flush_metrics(phase="matching")
+    run.close("ok")
+
+Library code calls ``obs.event``/``obs.span``/``obs.counter``
+unconditionally — they no-op (or accumulate invisibly) unless an entry
+point opened a run log.
+"""
+
+from .events import (
+    NULL_RUN,
+    RunLog,
+    default_log_path,
+    event,
+    get_run,
+    init_run,
+    span,
+)
+from .heartbeat import Heartbeat, Watchdog
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    default_registry,
+    gauge,
+    histogram,
+    reset,
+    snapshot,
+)
+
+__all__ = [
+    "NULL_RUN",
+    "RunLog",
+    "default_log_path",
+    "event",
+    "get_run",
+    "init_run",
+    "span",
+    "Heartbeat",
+    "Watchdog",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "counter",
+    "default_registry",
+    "gauge",
+    "histogram",
+    "reset",
+    "snapshot",
+]

@@ -1,0 +1,352 @@
+"""Structured JSONL run log for every long-running entry point.
+
+One run = one append-only ``runlog-<run_id>.jsonl`` file. Every line is
+one JSON event with the shared envelope::
+
+    {"v": 1, "run_id": ..., "event": <name>,
+     "t_wall": <unix seconds>, "t_mono": <monotonic seconds>, ...fields}
+
+The first event is ``run_start`` (host/pid/git-rev/CLI-args metadata),
+the last is ``run_end`` with an exit status — written by an explicit
+``close()``, by atexit, or by the chained SIGTERM/SIGINT handler, so a
+crashed or preempted run still leaves a final flush on disk (the same
+posture as training/checkpoint.py: artifacts must survive a kill at any
+point). ``metrics`` events carry `obs.metrics` registry snapshots,
+flushed at phase boundaries and at close.
+
+The span form composes with utils/profiling.PhaseTimer's sync
+semantics: ``with run.span("consensus", sync=lambda: corr): ...``
+blocks on the jax value when the span CLOSES, so device-async dispatch
+is not misattributed — but nothing here EVER syncs unless the caller
+passes ``sync=`` (ISSUE 1: no new device sync points on the hot path).
+
+Library code logs through the module-level :func:`event` /
+:func:`span`, which no-op unless an entry point called
+:func:`init_run` — so data/loader.py or localization/driver.py can
+instrument unconditionally without coupling unit tests to log files.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import uuid
+from typing import Optional
+
+from . import metrics as _metrics
+
+SCHEMA_VERSION = 1
+
+#: Heartbeat/stall events must not count as run progress, or the
+#: heartbeat would keep resetting the idle clock it measures.
+_NON_PROGRESS_EVENTS = frozenset({"heartbeat", "stall"})
+
+
+def _git_rev() -> Optional[str]:
+    """Current git rev of the repo this module lives in, or None.
+
+    Fenced subprocess: telemetry must never take a run down, and the
+    deployment may not even be a git checkout.
+    """
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    rev = out.stdout.strip()
+    return rev if out.returncode == 0 and rev else None
+
+
+def _device_metadata() -> dict:
+    """Backend description WITHOUT dialing it.
+
+    jax.devices() can block for minutes on a wedged tunnel
+    (utils/profiling.dial_devices exists because of it), so the run log
+    only records what is knowable for free: the configured platform and,
+    if the caller's backend is already up, its device list is recorded
+    later by an explicit `event("devices", ...)` from the entry point.
+    """
+    return {
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+        "python": sys.version.split()[0],
+    }
+
+
+class RunLog:
+    """Append-only structured JSONL log of one run."""
+
+    def __init__(
+        self,
+        path: str,
+        component: str,
+        args=None,
+        registry: Optional[_metrics.MetricsRegistry] = None,
+        clock=time.monotonic,
+        run_id: Optional[str] = None,
+    ):
+        self.path = path
+        self.component = component
+        self.run_id = run_id or (
+            time.strftime("%Y%m%d-%H%M%S") + "-" + uuid.uuid4().hex[:8]
+        )
+        self.registry = registry if registry is not None else (
+            _metrics.default_registry()
+        )
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._closed = False
+        self.heartbeat = None  # attached by init_run / the caller
+        # Monotonic time of the last NON-heartbeat event: the stall
+        # detector's idle clock.
+        self.last_progress_mono = clock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+        self._t0_mono = clock()
+        if args is not None and not isinstance(args, dict):
+            args = vars(args)  # argparse.Namespace
+        self.event(
+            "run_start",
+            component=component,
+            schema=SCHEMA_VERSION,
+            git_rev=_git_rev(),
+            argv=list(sys.argv),
+            args=args,
+            **_device_metadata(),
+        )
+
+    # -- core API ---------------------------------------------------------
+
+    def event(self, name: str, **fields) -> None:
+        """Append one structured event; a closed log drops silently.
+
+        Every write is flushed: events sit at phase boundaries and
+        per-step/per-query granularity, so line-flushing is cheap and a
+        SIGKILL loses at most the line being written.
+        """
+        rec = {
+            "v": SCHEMA_VERSION,
+            "run_id": self.run_id,
+            "event": name,
+            "t_wall": time.time(),
+            "t_mono": self.clock(),
+        }
+        rec.update(fields)
+        # default=str: a numpy scalar or Path in a field must degrade to
+        # text, never take the run down mid-telemetry.
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            if self._closed:
+                return
+            if name not in _NON_PROGRESS_EVENTS:
+                self.last_progress_mono = rec["t_mono"]
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    @contextlib.contextmanager
+    def span(self, name: str, sync=None, **fields):
+        """Timed block: one ``<name>`` event with ``dur_s`` at close.
+
+        `sync=` follows PhaseTimer.phase: a zero-arg callable (or jax
+        value) blocked on when the span closes, so the duration covers
+        the device work launched inside the block. Exceptions inside the
+        block are re-raised after an event with ``error`` is written.
+        """
+        t0 = self.clock()
+        try:
+            yield
+        except BaseException as exc:
+            self.event(name, kind="span", dur_s=self.clock() - t0,
+                       error=f"{type(exc).__name__}: {exc}", **fields)
+            raise
+        else:
+            if sync is not None:
+                try:
+                    import jax
+
+                    jax.block_until_ready(sync() if callable(sync) else sync)
+                except Exception:
+                    pass
+            self.event(name, kind="span", dur_s=self.clock() - t0, **fields)
+
+    def flush_metrics(self, phase: Optional[str] = None) -> None:
+        """Write a ``metrics`` event with the registry's full snapshot."""
+        self.event("metrics", phase=phase, snapshot=self.registry.snapshot())
+
+    def close(self, status: str = "ok", **fields) -> None:
+        """Final metrics flush + ``run_end`` + file close. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+        if self.heartbeat is not None:
+            try:
+                self.heartbeat.stop()
+            except Exception:
+                pass
+        self.flush_metrics(phase="exit")
+        self.event("run_end", status=status,
+                   dur_s=self.clock() - self._t0_mono, **fields)
+        with self._lock:
+            self._closed = True
+            self._fh.close()
+        _deactivate(self)
+
+    def __enter__(self) -> "RunLog":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close("ok" if exc_type is None
+                   else f"error:{exc_type.__name__}")
+
+
+class _NullRunLog:
+    """No-op stand-in so library call sites never need a None check."""
+
+    run_id = None
+    path = None
+    heartbeat = None
+
+    def event(self, name: str, **fields) -> None:
+        pass
+
+    @contextlib.contextmanager
+    def span(self, name: str, sync=None, **fields):
+        yield
+
+    def flush_metrics(self, phase=None) -> None:
+        pass
+
+    def close(self, status: str = "ok", **fields) -> None:
+        pass
+
+
+NULL_RUN = _NullRunLog()
+
+_active_lock = threading.Lock()
+_active: list = []  # innermost-last stack of open RunLogs
+_exit_hooks_installed = False
+
+
+def _deactivate(run: RunLog) -> None:
+    with _active_lock:
+        if run in _active:
+            _active.remove(run)
+
+
+def _close_all(status: str) -> None:
+    with _active_lock:
+        runs = list(_active)
+    for run in runs:
+        try:
+            run.close(status)
+        except Exception:
+            pass
+
+
+def _install_exit_hooks() -> None:
+    """atexit + chained SIGTERM/SIGINT final flush, installed once.
+
+    The signal handlers CHAIN: after closing the run logs they re-invoke
+    whatever handler was installed before (or re-raise the default
+    behavior), so a preemption SIGTERM still terminates and an operator
+    ^C still interrupts. SIGALRM is deliberately untouched —
+    utils/profiling.run_with_alarm owns it.
+    """
+    global _exit_hooks_installed
+    if _exit_hooks_installed:
+        return
+    _exit_hooks_installed = True
+    atexit.register(_close_all, "atexit")
+
+    def _chain(signum, prev):
+        def handler(sig, frame):
+            _close_all(f"signal:{signal.Signals(sig).name}")
+            if callable(prev):
+                prev(sig, frame)
+            else:
+                signal.signal(sig, prev if prev is not None
+                              else signal.SIG_DFL)
+                signal.raise_signal(sig)
+        return handler
+
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev = signal.getsignal(signum)
+            signal.signal(signum, _chain(signum, prev))
+        except (ValueError, OSError):
+            # Non-main thread or embedded interpreter: atexit still
+            # covers the clean paths; don't fight the host process.
+            pass
+
+
+def init_run(
+    component: str,
+    path: str,
+    args=None,
+    heartbeat_s: Optional[float] = None,
+    registry: Optional[_metrics.MetricsRegistry] = None,
+) -> RunLog:
+    """Open a run log, make it the current run, start its heartbeat.
+
+    `heartbeat_s` <= 0 disables the heartbeat thread; None reads
+    ``NCNET_OBS_HEARTBEAT_S`` (default 30). The first beat is emitted
+    immediately, so even a seconds-long smoke run records >= 1
+    heartbeat event (the acceptance contract for CPU-smoke runs).
+    """
+    run = RunLog(path, component, args=args, registry=registry)
+    with _active_lock:
+        _active.append(run)
+    _install_exit_hooks()
+    if heartbeat_s is None:
+        try:
+            heartbeat_s = float(os.environ.get("NCNET_OBS_HEARTBEAT_S", "30"))
+        except ValueError:
+            heartbeat_s = 30.0
+    if heartbeat_s > 0:
+        from .heartbeat import Heartbeat
+
+        run.heartbeat = Heartbeat(run, interval_s=heartbeat_s)
+        run.heartbeat.start()
+    return run
+
+
+def get_run():
+    """The innermost active RunLog, or the shared no-op."""
+    with _active_lock:
+        return _active[-1] if _active else NULL_RUN
+
+
+def event(name: str, **fields) -> None:
+    """Log to the current run (no-op when no run is active)."""
+    get_run().event(name, **fields)
+
+
+def span(name: str, sync=None, **fields):
+    return get_run().span(name, sync=sync, **fields)
+
+
+def default_log_path(directory: str, component: str) -> str:
+    """Canonical run-log location: ``<dir>/runlog-<component>-<stamp>.jsonl``.
+
+    One file per run (never reused): --resume reruns of the eval CLI
+    append new FILES next to the old ones instead of interleaving run
+    records, and tools/obs_report.py consumes exactly one run per file.
+    """
+    stamp = time.strftime("%Y%m%d-%H%M%S") + "-" + uuid.uuid4().hex[:6]
+    return os.path.join(directory, f"runlog-{component}-{stamp}.jsonl")

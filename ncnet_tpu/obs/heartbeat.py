@@ -1,0 +1,197 @@
+"""Stall heartbeat + hard-exit watchdog for long-running entry points.
+
+Two failure modes show up on real TPU sessions and have, until now,
+been handled by ad-hoc copies of the same thread-and-deadline pattern
+in ``bench.py``/``utils/profiling.run_bench_matrix`` and
+``tools/tpu_session.py``:
+
+* a run goes QUIET — the process is alive but nothing has progressed
+  for minutes (wedged tunnel, hung compile, starved input pipeline).
+  :class:`Heartbeat` makes that visible: a daemon thread emits a
+  periodic ``heartbeat`` event carrying the idle time since the last
+  real (non-heartbeat) run-log event, and a one-shot ``stall`` event
+  when the idle time crosses a threshold. Downstream, the run log tells
+  you not just *that* the run died but *when it stopped progressing*.
+
+* a run goes ZOMBIE — SIGALRM fencing can't fire because the main
+  thread is stuck inside a C extension holding the GIL hostage, so the
+  only way out is ``os._exit``. :class:`Watchdog` is that pattern made
+  reusable: arm a deadline, a daemon thread hard-exits the process if
+  it passes. ``run_bench_matrix`` and ``tpu_session`` now use it
+  instead of their private ``deadline = [None]`` lists.
+
+Both take an injectable ``clock`` so tests drive stall detection with a
+fake clock instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Heartbeat:
+    """Background thread emitting periodic ``heartbeat`` events on a RunLog.
+
+    The first beat is emitted synchronously inside :meth:`start`, so
+    even a seconds-long smoke run records at least one heartbeat event.
+    A ``stall`` event is emitted once per stall episode: when
+    ``idle_s`` (time since the run's last non-heartbeat event) first
+    exceeds ``stall_after_s``, and again only after progress resumes
+    and a new stall begins.
+    """
+
+    def __init__(
+        self,
+        runlog,
+        interval_s: float = 30.0,
+        stall_after_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.runlog = runlog
+        self.interval_s = float(interval_s)
+        # Default: four missed beats without progress is a stall.
+        self.stall_after_s = (
+            float(stall_after_s) if stall_after_s is not None
+            else 4.0 * self.interval_s
+        )
+        self.clock = clock
+        self.beats = 0
+        self.stalls = 0
+        self._in_stall = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat_once(self) -> dict:
+        """Emit one heartbeat (and maybe a stall) event; returns the fields.
+
+        Public so tests can drive stall detection with a fake clock and
+        no thread.
+        """
+        now = self.clock()
+        idle_s = now - self.runlog.last_progress_mono
+        stalled = idle_s >= self.stall_after_s
+        if stalled and not self._in_stall:
+            self._in_stall = True
+            self.stalls += 1
+            self.runlog.event("stall", idle_s=idle_s,
+                              stall_after_s=self.stall_after_s)
+        elif not stalled:
+            self._in_stall = False
+        self.beats += 1
+        fields = {"idle_s": idle_s, "stalled": stalled, "beat": self.beats}
+        self.runlog.event("heartbeat", **fields)
+        return fields
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.beat_once()
+            except Exception:
+                # A telemetry thread must never propagate into stderr
+                # spam or take the interpreter down at shutdown.
+                return
+
+    def start(self) -> "Heartbeat":
+        if self._thread is not None:
+            return self
+        self.beat_once()
+        self._thread = threading.Thread(
+            target=self._loop, name="obs-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+
+
+class Watchdog:
+    """Hard-exit deadline for sections SIGALRM fencing cannot cover.
+
+    ``run_with_alarm`` (utils/profiling.py) handles the common case,
+    but a main thread stuck inside a blocking C call never services
+    the alarm. This watchdog runs a daemon thread that polls a shared
+    deadline and calls ``on_expire`` (default ``os._exit(exit_code)``)
+    once it is passed — the pattern previously duplicated as
+    ``deadline = [None]`` + local ``_watchdog`` closures in
+    ``run_bench_matrix`` and ``tools/tpu_session.py``.
+
+    Usage::
+
+        wd = Watchdog(label="phase").start()
+        wd.arm(timeout_s + 120)   # hard ceiling past the soft alarm
+        ...                        # fenced work
+        wd.disarm()
+    """
+
+    def __init__(
+        self,
+        label: str = "watchdog",
+        exit_code: int = 3,
+        poll_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_expire: Optional[Callable[[], None]] = None,
+        log: Callable[[str], None] = lambda msg: None,
+    ):
+        self.label = label
+        self.exit_code = exit_code
+        self.poll_s = float(poll_s)
+        self.clock = clock
+        self.on_expire = on_expire
+        self.log = log
+        self._deadline: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def arm(self, seconds: float) -> None:
+        with self._lock:
+            self._deadline = self.clock() + float(seconds)
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._deadline = None
+
+    def expired(self) -> bool:
+        with self._lock:
+            d = self._deadline
+        return d is not None and self.clock() > d
+
+    def check(self) -> bool:
+        """One poll step; fires ``on_expire`` when past the deadline.
+
+        Returns True when it fired. Public for fake-clock tests —
+        the thread loop is just this on a timer.
+        """
+        if not self.expired():
+            return False
+        self.log(f"[{self.label}] hard deadline exceeded; exiting "
+                 f"{self.exit_code}")
+        if self.on_expire is not None:
+            self.on_expire()
+        else:
+            os._exit(self.exit_code)
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            if self.check():
+                return
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"obs-watchdog-{self.label}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        # No join: the thread sleeps up to poll_s and is a daemon; a
+        # disarm + set is enough to make it inert.

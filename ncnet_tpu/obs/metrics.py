@@ -1,0 +1,194 @@
+"""Thread-safe run-metrics registry: Counter / Gauge / Histogram.
+
+The long-running entry points accumulate host-side counters (cache
+hits, padding waste, prefetch starvation, retry records) that used to
+live in scattered instance attributes and die with the process. This
+registry is the ONE place they accumulate; `snapshot()` serializes the
+whole registry into a plain dict that `obs.events.RunLog` flushes into
+the run log at phase boundaries and at exit.
+
+Design constraints (ISSUE 1 tentpole):
+  * host-side only — nothing here touches jax or forces a device sync;
+    callers record values they already hold on the host (a float() the
+    training loop was doing anyway, a queue depth, a stack size);
+  * thread-safe — the eval CLI records from its decode-prefetch pool
+    threads while the main thread dispatches, and the data loader
+    records from its producer thread;
+  * cheap — inc/set/observe are a lock acquire + a few float ops, so
+    they can sit on per-step/per-query paths without moving benchmarks.
+
+Metric naming convention (docs/OBSERVABILITY.md): dotted lowercase
+``component.subsystem.name`` with the unit as a suffix where ambiguous
+(``_s``, ``_bytes``, ``_frac``) — e.g. ``train.step_time_s``,
+``eval_inloc.cache.hits``, ``data.loader.starved``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class Counter:
+    """Monotonically increasing count (events, items, bytes)."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        return self.value
+
+
+class Gauge:
+    """Last-written value (queue depth, hit rate, pairs/s)."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> Optional[float]:
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram:
+    """Streaming summary of an observed distribution (step times, sizes).
+
+    Keeps count/sum/min/max/last — enough for the report tool's mean and
+    range without storing samples (a training run observes one value per
+    step; an unbounded sample list would grow with the run).
+    """
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.last: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.last = v
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            mean = self.sum / self.count if self.count else None
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "mean": mean,
+                "min": self.min,
+                "max": self.max,
+                "last": self.last,
+            }
+
+
+class MetricsRegistry:
+    """Name -> metric map with get-or-create accessors.
+
+    One process-wide default registry (module functions below) so
+    library code (data/loader.py, localization/driver.py) can record
+    without plumbing a registry handle through every call chain; tests
+    construct private registries or `reset()` the default.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                # Each metric gets its own lock: a hot counter on the
+                # loader's producer thread must not contend with the
+                # registry-structure lock held during snapshot().
+                m = cls(name, threading.Lock())
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get_or_create(name, Histogram)
+
+    def snapshot(self) -> dict:
+        """Serialize every metric into a plain-JSON dict, grouped by kind."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in sorted(items):
+            if isinstance(m, Counter):
+                out["counters"][name] = m.snapshot()
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.snapshot()
+            else:
+                out["histograms"][name] = m.snapshot()
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _DEFAULT
+
+
+def counter(name: str) -> Counter:
+    return _DEFAULT.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _DEFAULT.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _DEFAULT.histogram(name)
+
+
+def snapshot() -> dict:
+    return _DEFAULT.snapshot()
+
+
+def reset() -> None:
+    _DEFAULT.reset()

@@ -526,29 +526,22 @@ def fused_correlation_maxpool(
     feature_a, feature_b, k_size: int = 2, corr_dtype=jnp.float32,
     decode_deltas: bool = True, emit_maxes: bool = False,
 ):
-    """Dispatch on the *lowering* platform: Pallas on TPU, slab-scan XLA
+    """Dispatch on the default backend: Pallas on TPU, slab-scan XLA
     elsewhere.
 
-    Both branches are traced by lax.platform_dependent, so degenerate shapes
-    must be rejected up front (a 0-sized dim crashes Pallas grid math with an
-    opaque ZeroDivisionError).
-
-    `lax.platform_dependent` resolves when the surrounding jit is lowered, so
-    a computation explicitly placed on CPU of a TPU host still gets the XLA
-    path (device-list sniffing would pick the Pallas kernel and fail to
-    lower).
+    Trace-time choice, NOT lax.platform_dependent: the per-platform cond
+    lowers every branch on every platform, and the Pallas kernel has no
+    CPU lowering (interpret-only), so the cond itself fails to compile
+    off-TPU. The cost is that a computation explicitly placed on the CPU
+    of a TPU host traces the Pallas branch — acceptable; no path in this
+    repo does that.
     """
-    return jax.lax.platform_dependent(
-        feature_a,
-        feature_b,
-        tpu=partial(
-            fused_correlation_maxpool_pallas, k_size=k_size,
-            corr_dtype=corr_dtype, decode_deltas=decode_deltas,
-            emit_maxes=emit_maxes,
-        ),
-        default=partial(
-            fused_correlation_maxpool_xla, k_size=k_size,
-            corr_dtype=corr_dtype, decode_deltas=decode_deltas,
-            emit_maxes=emit_maxes,
-        ),
+    impl = (
+        fused_correlation_maxpool_pallas
+        if jax.default_backend() == "tpu"
+        else fused_correlation_maxpool_xla
+    )
+    return impl(
+        feature_a, feature_b, k_size=k_size, corr_dtype=corr_dtype,
+        decode_deltas=decode_deltas, emit_maxes=emit_maxes,
     )

@@ -33,10 +33,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
+from .mesh import shard_map_compat
 from ..ops.conv4d import conv4d_prepadded, swap_ab_weight
 from ..ops.mutual import EPS
+
+
+def _axis_size(axis_name: str) -> int:
+    """Static mesh-axis size inside shard_map, across jax versions:
+    lax.axis_size appeared in 0.5; older jax resolves psum(1, name) to a
+    static int at trace time."""
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:
+        return int(lax.psum(1, axis_name))
 
 
 def _halo_exchange(x, pad: int, axis_name: str):
@@ -45,7 +55,7 @@ def _halo_exchange(x, pad: int, axis_name: str):
     Boundary shards receive zeros (matching the zero padding of the global
     convolution). x: [b, c, I_loc, ...] -> [b, c, I_loc + 2*pad, ...].
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n == 1:
         return jnp.pad(x, ((0, 0), (0, 0), (pad, pad)) + ((0, 0),) * (x.ndim - 3))
     # Send my last `pad` rows to my right neighbour (their left halo) and my
@@ -145,11 +155,10 @@ def make_sharded_match_pipeline(
     spec_corr = P(batch_axis, None, axis_name, None, None, None)
 
     @partial(
-        shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P(), spec_corr),
         out_specs=spec_corr,
-        check_vma=False,
     )
     def pipeline(params, corr_local):
         return match_pipeline_sharded(params, corr_local, axis_name, symmetric)
@@ -167,11 +176,10 @@ def sharded_correlation(feature_a, feature_b, mesh: Mesh, axis_name: str = "sp")
     spec_fa = P(None, None, axis_name, None)
 
     @partial(
-        shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(spec_fa, P()),
         out_specs=P(None, None, axis_name, None, None, None),
-        check_vma=False,
     )
     def corr(fa_local, fb):
         c = jnp.einsum(

@@ -26,10 +26,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..models.ncnet import NCNetConfig, extract_features
 from .corr_sharding import make_sharded_match_pipeline
+from .mesh import shard_map_compat
 
 
 def make_sharded_inloc_parts(config: NCNetConfig, mesh: Mesh, axis_name: str = "sp"):
@@ -60,11 +60,10 @@ def make_sharded_inloc_parts(config: NCNetConfig, mesh: Mesh, axis_name: str = "
     spec_corr = P(None, None, axis_name, None, None, None)
 
     @partial(
-        shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(spec_fa, P()),
         out_specs=(spec_corr, spec_corr),
-        check_vma=False,
     )
     def corr_pool_local(fa_local, fb):
         # Each shard computes corr rows for its A slab and pools them —

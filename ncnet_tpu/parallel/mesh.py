@@ -46,6 +46,25 @@ def make_mesh(
     return Mesh(dev_array, axis_names)
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check=False):
+    """`shard_map` across jax versions: the export moved
+    (jax.experimental.shard_map -> jax.shard_map) and the replication
+    check kwarg was renamed (check_rep -> check_vma). Every shard_map in
+    this repo goes through here so a jax upgrade is a one-line change.
+    """
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    for kw in ("check_vma", "check_rep"):
+        try:
+            return _sm(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **{kw: check})
+        except TypeError:
+            continue
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
 def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
     """Sharding for a batch-leading array: batch split over `axis`."""
     return NamedSharding(mesh, P(axis))

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..models.ncnet import (
     NCNetConfig,
     extract_features,
@@ -155,6 +156,14 @@ def make_train_step(
     unaccumulated batch — same loss family, not bit-identical training.
     The batch size must divide by k.
     """
+    # Record how the step was built once, host-side: the grad-accum /
+    # remat choice decides both HBM shape and which remat default fires,
+    # so every run log carries it (obs no-ops without an active run; the
+    # gauges surface in the first metrics snapshot either way).
+    obs.event("train_step_build", accum_steps=accum_steps,
+              remat_backbone=remat_backbone, normalization=normalization)
+    obs.gauge("train.accum_steps").set(accum_steps)
+    obs.gauge("train.remat_backbone").set(1.0 if remat_backbone else 0.0)
 
     def loss_fn(trainable: Params, frozen: Params, source, target):
         params = {

@@ -344,9 +344,9 @@ def run_bench_matrix(runs, *, dial_timeout=300.0, fence=1500.0,
     """
     import importlib.util
     import os
-    import threading
-    import time as _time
     import traceback
+
+    from ..obs import Watchdog
 
     setup_compile_cache()
     log(f"dialing (watchdog {dial_timeout:.0f}s)...")
@@ -354,17 +354,10 @@ def run_bench_matrix(runs, *, dial_timeout=300.0, fence=1500.0,
         log("dial timed out; aborting")
         return 2
 
-    deadline = [None]
-
-    def _watchdog():
-        while True:
-            _time.sleep(30)
-            d = deadline[0]
-            if d is not None and _time.time() > d:
-                log("watchdog: alarm never landed; hard-exiting")
-                os._exit(3)
-
-    threading.Thread(target=_watchdog, daemon=True).start()
+    # Hard ceiling past the SIGALRM fence: a remote-compile wait stuck in
+    # native code defers signal delivery forever (the documented wedge
+    # class), so a daemon-thread deadline is the only way out.
+    watchdog = Watchdog(label="bench_matrix", log=log).start()
 
     os.environ["NCNET_BENCH_DIAL_TIMEOUT"] = "120"
     os.environ["NCNET_BENCH_NO_REEXEC"] = "1"
@@ -384,7 +377,7 @@ def run_bench_matrix(runs, *, dial_timeout=300.0, fence=1500.0,
             os.environ.pop(k, None)
         os.environ.update(env)
         log(f"=== bench[{label}] env={env} ===")
-        deadline[0] = _time.time() + fence + 180
+        watchdog.arm(fence + 180)
         try:
             run_with_alarm(int(fence), _load_bench().main)
         except AlarmTimeout as exc:
@@ -392,7 +385,7 @@ def run_bench_matrix(runs, *, dial_timeout=300.0, fence=1500.0,
         except Exception:  # noqa: BLE001
             log(f"bench[{label}] FAILED:\n{traceback.format_exc()}")
         finally:
-            deadline[0] = None
+            watchdog.disarm()
             for k in env:
                 os.environ.pop(k, None)
     log("A/B DONE")

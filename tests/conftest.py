@@ -43,6 +43,8 @@ jax.config.update(
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
+import json
+
 import numpy as np
 import pytest
 
@@ -50,3 +52,50 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs_metrics():
+    """The obs default registry is process-global (one CLI run per
+    process in production); zero it per test so metric assertions see
+    only their own run's increments."""
+    from ncnet_tpu import obs
+
+    obs.reset()
+    yield
+
+
+def assert_valid_runlog(path, component=None):
+    """Schema check for an obs run log (docs/OBSERVABILITY.md).
+
+    Shared by the CLI flow tests (train, eval_inloc) and test_obs.py:
+    every line carries the v1 envelope with one run_id; the run opens
+    with run_start (host/git/args metadata), records >= 1 heartbeat and
+    >= 1 metrics snapshot, and closes with run_end. Returns the parsed
+    records.
+    """
+    with open(path, encoding="utf-8") as fh:
+        records = [json.loads(line) for line in fh if line.strip()]
+    assert records, f"empty run log {path}"
+    names = [r["event"] for r in records]
+    for r in records:
+        assert r["v"] == 1
+        assert r["run_id"] == records[0]["run_id"]
+        assert isinstance(r["event"], str)
+        assert isinstance(r["t_wall"], float)
+        assert isinstance(r["t_mono"], float)
+    start = records[0]
+    assert start["event"] == "run_start"
+    assert start["schema"] == 1
+    if component is not None:
+        assert start["component"] == component
+    for key in ("argv", "hostname", "pid", "python"):
+        assert key in start
+    assert names[-1] == "run_end"
+    assert "status" in records[-1] and "dur_s" in records[-1]
+    assert "heartbeat" in names
+    snaps = [r for r in records if r["event"] == "metrics"]
+    assert snaps, "no metrics snapshot in run log"
+    for snap in snaps:
+        assert set(snap["snapshot"]) == {"counters", "gauges", "histograms"}
+    return records

@@ -68,6 +68,26 @@ def test_train_then_eval_pck(pf_dir, capsys):
     ckpt = pf_dir / "models" / runs[0] / "best"
     assert ckpt.is_dir()
 
+    # The run's telemetry log lands in the checkpoint dir and carries
+    # step timings, the epoch record, and a final metrics snapshot
+    # (docs/OBSERVABILITY.md).
+    from conftest import assert_valid_runlog
+
+    runlogs = [f for f in os.listdir(pf_dir / "models" / runs[0])
+               if f.startswith("runlog-train-")]
+    assert len(runlogs) == 1
+    records = assert_valid_runlog(
+        pf_dir / "models" / runs[0] / runlogs[0], component="train")
+    names = [r["event"] for r in records]
+    assert "epoch" in names and "train_step" in names
+    final = [r for r in records if r["event"] == "metrics"][-1]["snapshot"]
+    assert final["histograms"]["train.step_time_s"]["count"] >= 1
+    assert "train.loss" in final["gauges"]
+    # make_train_step runs before the run log opens; its build event
+    # no-ops but the build gauges persist into the first snapshot.
+    assert final["gauges"]["train.accum_steps"] == 1.0
+    assert records[-1]["status"] == "ok"
+
     eval_pf_pascal.main(
         [
             "--checkpoint", str(ckpt),

@@ -1,0 +1,237 @@
+"""Unit tests for the obs subsystem (events, metrics, heartbeat) and
+tools/obs_report.py.
+
+The end-to-end schema checks for real CLI runs live with their flows
+(test_cli_flows.test_train_then_eval_pck, the train run log;
+test_eval_inloc_cli.test_writes_match_files, the eval run log), both
+through conftest.assert_valid_runlog. Here: the RunLog envelope and
+lifecycle in isolation, registry thread safety, fake-clock stall
+detection and watchdog expiry, and the report/diff tool over the two
+committed fixture logs in tests/data/.
+"""
+
+import io
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from conftest import assert_valid_runlog
+from ncnet_tpu import obs
+from ncnet_tpu.obs import events as obs_events
+from ncnet_tpu.obs.metrics import MetricsRegistry
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import obs_report  # noqa: E402
+
+FIXTURE_A = os.path.join(os.path.dirname(__file__), "data", "obs_runlog_a.jsonl")
+FIXTURE_B = os.path.join(os.path.dirname(__file__), "data", "obs_runlog_b.jsonl")
+
+
+# -- RunLog ---------------------------------------------------------------
+
+
+def test_runlog_lifecycle_schema(tmp_path):
+    path = tmp_path / "runlog-unit-1.jsonl"
+    run = obs.init_run("unit", str(path), args={"alpha": 1})
+    try:
+        run.event("work", n=3)
+        with run.span("phase_one"):
+            pass
+        run.flush_metrics(phase="mid")
+    finally:
+        run.close("ok", extra="bye")
+    records = assert_valid_runlog(path, component="unit")
+    names = [r["event"] for r in records]
+    assert "work" in names and "phase_one" in names
+    span = next(r for r in records if r["event"] == "phase_one")
+    assert span["kind"] == "span" and span["dur_s"] >= 0.0
+    assert records[0]["args"] == {"alpha": 1}
+    assert records[-1]["extra"] == "bye"
+    # Closed log drops silently and a second close is a no-op.
+    run.event("after_close")
+    run.close("ok")
+    assert len(assert_valid_runlog(path)) == len(records)
+
+
+def test_runlog_span_records_error_and_reraises(tmp_path):
+    run = obs_events.RunLog(str(tmp_path / "r.jsonl"), "unit")
+    with pytest.raises(ValueError):
+        with run.span("boom"):
+            raise ValueError("nope")
+    run.close("error:ValueError")
+    with open(tmp_path / "r.jsonl") as fh:
+        records = [json.loads(l) for l in fh]
+    span = next(r for r in records if r["event"] == "boom")
+    assert span["error"].startswith("ValueError")
+    assert records[-1]["status"] == "error:ValueError"
+
+
+def test_module_level_event_noops_without_run():
+    assert obs.get_run() is obs.NULL_RUN
+    obs.event("nobody_home")  # must not raise
+    with obs.span("nothing"):
+        pass
+
+
+def test_init_run_nests_and_unwinds(tmp_path):
+    a = obs.init_run("outer", str(tmp_path / "a.jsonl"), heartbeat_s=0)
+    b = obs.init_run("inner", str(tmp_path / "b.jsonl"), heartbeat_s=0)
+    assert obs.get_run() is b
+    b.close()
+    assert obs.get_run() is a
+    a.close()
+    assert obs.get_run() is obs.NULL_RUN
+
+
+# -- metrics --------------------------------------------------------------
+
+
+def test_metrics_thread_safety():
+    reg = MetricsRegistry()
+    n_threads, n_iter = 8, 2000
+
+    def work(i):
+        for _ in range(n_iter):
+            reg.counter("c").inc()
+            reg.gauge(f"g{i}").set(float(i))
+            reg.histogram("h").observe(1.0)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["counters"]["c"] == float(n_threads * n_iter)
+    assert snap["histograms"]["h"]["count"] == n_threads * n_iter
+    assert snap["histograms"]["h"]["sum"] == pytest.approx(n_threads * n_iter)
+
+
+def test_metrics_kind_mismatch():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+# -- heartbeat / stall ----------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_heartbeat_stall_detection_fake_clock(tmp_path):
+    clock = FakeClock()
+    run = obs_events.RunLog(str(tmp_path / "hb.jsonl"), "unit", clock=clock)
+    hb = obs.Heartbeat(run, interval_s=10.0, stall_after_s=25.0, clock=clock)
+
+    assert hb.beat_once()["stalled"] is False
+    clock.t = 30.0  # no progress since t=0 -> stalled
+    assert hb.beat_once()["stalled"] is True
+    clock.t = 40.0  # still the same episode: no second stall event
+    assert hb.beat_once()["stalled"] is True
+    assert hb.stalls == 1
+    run.event("progress")  # resets the idle clock
+    clock.t = 45.0
+    assert hb.beat_once()["stalled"] is False
+    clock.t = 75.0  # a NEW stall episode
+    assert hb.beat_once()["stalled"] is True
+    assert hb.stalls == 2
+    run.close()
+    with open(tmp_path / "hb.jsonl") as fh:
+        records = [json.loads(l) for l in fh]
+    stalls = [r for r in records if r["event"] == "stall"]
+    assert len(stalls) == 2
+    assert stalls[0]["idle_s"] == pytest.approx(30.0)
+    # Heartbeats never reset the idle clock they measure.
+    beats = [r for r in records if r["event"] == "heartbeat"]
+    assert beats[2]["idle_s"] == pytest.approx(40.0)
+
+
+def test_heartbeat_thread_and_init_run(tmp_path):
+    path = tmp_path / "hb2.jsonl"
+    run = obs.init_run("unit", str(path), heartbeat_s=600.0)
+    assert run.heartbeat is not None and run.heartbeat.beats == 1
+    run.close()
+    records = assert_valid_runlog(path)  # requires >= 1 heartbeat event
+    assert records[-1]["status"] == "ok"
+
+
+def test_watchdog_fake_clock():
+    clock = FakeClock()
+    fired = []
+    wd = obs.Watchdog(label="t", clock=clock, on_expire=lambda: fired.append(1))
+    assert wd.check() is False  # never armed
+    wd.arm(100.0)
+    clock.t = 50.0
+    assert wd.check() is False
+    clock.t = 101.0
+    assert wd.check() is True and fired == [1]
+    wd.disarm()
+    assert wd.check() is False
+
+
+# -- obs_report -----------------------------------------------------------
+
+
+def test_obs_report_summary_renders():
+    out = io.StringIO()
+    obs_report.summarize(FIXTURE_A, obs_report.load_run(FIXTURE_A), out=out)
+    text = out.getvalue()
+    assert "eval_inloc" in text
+    assert "status    : ok" in text
+    assert "query" in text  # span rollup line
+    assert "eval_inloc.pairs_per_s" in text
+
+
+def test_obs_report_diff_flags_regressions():
+    a = obs_report.final_metrics(obs_report.load_run(FIXTURE_A))
+    b = obs_report.final_metrics(obs_report.load_run(FIXTURE_B))
+    rows = {r["name"]: r for r in obs_report.diff_metrics(a, b, 0.05)}
+    # +15% throughput: past the 5% threshold -> flagged.
+    assert rows["eval_inloc.pairs_per_s"]["flagged"]
+    assert rows["eval_inloc.pairs_per_s"]["rel"] == pytest.approx(0.15)
+    # Identical counters: zero delta, never flagged.
+    assert rows["eval_inloc.pairs"]["delta"] == 0.0
+    assert not rows["eval_inloc.pairs"]["flagged"]
+    # A metric present on only one side renders but cannot be flagged.
+    assert rows["eval_inloc.dispatch.ragged"]["a"] is None
+    assert not rows["eval_inloc.dispatch.ragged"]["flagged"]
+    # -10% inlier mean: direction-agnostic flagging catches it too.
+    assert rows["localization.best_inliers.mean"]["flagged"]
+
+
+def test_obs_report_cli_modes(capsys):
+    assert obs_report.main([FIXTURE_A]) == 0
+    assert "run 20260805-090000-fixturea" in capsys.readouterr().out
+    assert obs_report.main(
+        ["--diff", FIXTURE_A, FIXTURE_B, "--threshold", "0.05"]) == 0
+    out = capsys.readouterr().out
+    assert "FLAGGED" in out
+    assert obs_report.main(
+        ["--diff", FIXTURE_A, FIXTURE_B, "--strict"]) == 1
+    # A huge threshold flags nothing, strict or not.
+    assert obs_report.main(
+        ["--diff", FIXTURE_A, FIXTURE_B, "--threshold", "9", "--strict"]) == 0
+
+
+def test_obs_report_tolerates_truncated_line(tmp_path):
+    with open(FIXTURE_A) as fh:
+        content = fh.read()
+    # Simulate a SIGKILL mid-write: the final line is half a record.
+    trunc = tmp_path / "trunc.jsonl"
+    trunc.write_text(content + '{"v": 1, "run_id": "20260805-090000-fix')
+    records = obs_report.load_run(str(trunc))
+    assert len(records) == len(obs_report.load_run(FIXTURE_A))
+    out = io.StringIO()
+    obs_report.summarize(str(trunc), records, out=out)
+    assert "status    : ok" in out.getvalue()

@@ -155,7 +155,20 @@ def main(argv=None):
 
     import jax
 
+    from ncnet_tpu import obs
     from ncnet_tpu.utils.profiling import dial_devices, setup_compile_cache
+
+    # Opt-in run log (NCNET_RUN_LOG=<path or dir>), bench.py convention:
+    # the per-class timings and the headline land as structured events.
+    run_log = None
+    log_dest = os.environ.get("NCNET_RUN_LOG", "")
+    if log_dest:
+        run_log = obs.init_run(
+            "bench_steady_state",
+            obs.default_log_path(log_dest, "bench_steady_state")
+            if os.path.isdir(log_dest) else log_dest,
+            args=args,
+        )
 
     setup_compile_cache()
     devices = dial_devices(args.dial_timeout)
@@ -295,6 +308,8 @@ def main(argv=None):
         results[(h, sizes)] = dt
         print(f"#   {label}: {dt * 1e3:.1f} ms/block "
               f"({PANOS_PER_QUERY / dt:.3f} pairs/s)", flush=True)
+        obs.event("class_timed", label=label, ms_per_block=dt * 1e3,
+                  pairs_per_s=PANOS_PER_QUERY / dt)
 
     # Least-squares fill for unmeasured classes + linearity check on the
     # measured ones. Padded-only data has n_slots = 5*n_stacks
@@ -330,7 +345,7 @@ def main(argv=None):
         }
     measured = PANOS_PER_QUERY * n_queries / total_time
 
-    print(json.dumps({
+    headline = {
         "metric": "inloc_steady_state_pairs_per_s_per_chip"
         + ("_ragged" if args.ragged else "")
         + ("" if on_tpu else "_cpu_smoke"),
@@ -346,7 +361,12 @@ def main(argv=None):
             "t_slot": round(float(coef[3]) * 1e3, 1),
         },
         "fit_residuals": fit_err,
-    }), flush=True)
+    }
+    if run_log is not None:
+        obs.gauge("bench.steady_state_pairs_per_s").set(measured)
+        run_log.event("bench.headline", **headline)
+        run_log.close("ok")
+    print(json.dumps(headline), flush=True)
     return 0
 
 

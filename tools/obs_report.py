@@ -1,0 +1,214 @@
+"""Render and diff structured run logs (docs/OBSERVABILITY.md).
+
+Summary mode — one run's ``runlog-*.jsonl`` as a human-readable report:
+run metadata (component, git rev, host, status), duration, event counts,
+span time rollup, heartbeat/stall record, and the final metrics
+snapshot::
+
+    python tools/obs_report.py out/runlog-eval_inloc-20260805-1.jsonl
+
+Diff mode — two runs' final metrics side by side, relative deltas
+computed for every numeric metric present in either run, rows past
+``--threshold`` flagged (the regression gate for A/Bing two eval or
+bench runs)::
+
+    python tools/obs_report.py --diff a.jsonl b.jsonl --threshold 0.05
+
+``--strict`` makes flagged rows a nonzero exit, so the diff can gate a
+session script the way tier-1 tests gate a commit.
+
+Truncated final lines (a run killed mid-write) are tolerated: every
+complete line still parses, which is the crash-safety point of the
+line-flushed JSONL format.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_run(path: str) -> List[dict]:
+    """All complete JSON records of one run log, in file order."""
+    records = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                # A SIGKILL mid-write loses at most the final line; the
+                # rest of the run stays reportable.
+                continue
+    return records
+
+
+def _last_metrics(records: List[dict]) -> Optional[dict]:
+    snaps = [r for r in records if r.get("event") == "metrics"]
+    return snaps[-1]["snapshot"] if snaps else None
+
+
+def final_metrics(records: List[dict]) -> Dict[str, float]:
+    """Flatten the run's last metrics snapshot to {name: value}.
+
+    Counters and gauges map directly; histograms contribute their mean
+    as ``<name>.mean`` plus ``<name>.count`` (the two numbers a
+    regression diff can act on).
+    """
+    snap = _last_metrics(records)
+    if snap is None:
+        return {}
+    out: Dict[str, float] = {}
+    for name, v in snap.get("counters", {}).items():
+        out[name] = float(v)
+    for name, v in snap.get("gauges", {}).items():
+        out[name] = float(v)
+    for name, h in snap.get("histograms", {}).items():
+        if h.get("count"):
+            out[name + ".mean"] = float(h["mean"])
+            out[name + ".count"] = float(h["count"])
+    return out
+
+
+def span_rollup(records: List[dict]) -> Dict[str, dict]:
+    """{span name: {count, total_s, mean_s, max_s}} over the run."""
+    out: Dict[str, dict] = {}
+    for r in records:
+        if r.get("kind") != "span" or "dur_s" not in r:
+            continue
+        agg = out.setdefault(
+            r["event"], {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += r["dur_s"]
+        agg["max_s"] = max(agg["max_s"], r["dur_s"])
+    for agg in out.values():
+        agg["mean_s"] = agg["total_s"] / agg["count"]
+    return out
+
+
+def summarize(path: str, records: List[dict], out=None) -> None:
+    w = (out or sys.stdout).write
+    if not records:
+        w(f"{path}: empty run log\n")
+        return
+    start = next((r for r in records if r.get("event") == "run_start"), {})
+    end = next((r for r in reversed(records)
+                if r.get("event") == "run_end"), None)
+    w(f"run {start.get('run_id', records[0].get('run_id'))}\n")
+    w(f"  component : {start.get('component')}\n")
+    w(f"  file      : {path}\n")
+    w(f"  git_rev   : {start.get('git_rev')}\n")
+    w(f"  host/pid  : {start.get('hostname')}/{start.get('pid')}"
+      f" (platform {start.get('jax_platforms')})\n")
+    if end is not None:
+        w(f"  status    : {end.get('status')}"
+          f" after {end.get('dur_s', 0):.1f}s\n")
+    else:
+        w("  status    : NO run_end (crashed or still running)\n")
+
+    counts: Dict[str, int] = {}
+    for r in records:
+        counts[r.get("event", "?")] = counts.get(r.get("event", "?"), 0) + 1
+    w("  events    : " + ", ".join(
+        f"{k}={v}" for k, v in sorted(counts.items())) + "\n")
+
+    beats = [r for r in records if r.get("event") == "heartbeat"]
+    stalls = [r for r in records if r.get("event") == "stall"]
+    if beats:
+        max_idle = max(r.get("idle_s", 0.0) for r in beats)
+        w(f"  heartbeat : {len(beats)} beats, max idle {max_idle:.1f}s, "
+          f"{len(stalls)} stall(s)\n")
+    for r in stalls:
+        w(f"    stall after {r.get('idle_s', 0):.1f}s idle "
+          f"(threshold {r.get('stall_after_s', 0):.1f}s)\n")
+
+    spans = span_rollup(records)
+    if spans:
+        w("  spans:\n")
+        for name, agg in sorted(spans.items(),
+                                key=lambda kv: -kv[1]["total_s"]):
+            w(f"    {name:<28} x{agg['count']:<5} total "
+              f"{agg['total_s']:8.2f}s  mean {agg['mean_s']:.3f}s  "
+              f"max {agg['max_s']:.3f}s\n")
+
+    metrics = final_metrics(records)
+    if metrics:
+        w("  final metrics:\n")
+        for name, v in sorted(metrics.items()):
+            w(f"    {name:<40} {v:g}\n")
+
+
+def diff_metrics(
+    a: Dict[str, float], b: Dict[str, float], threshold: float,
+) -> List[dict]:
+    """Rows {name, a, b, delta, rel, flagged} over the union of metrics.
+
+    rel is (b - a) / |a| (None when a == 0 or the metric is one-sided);
+    flagged when |rel| >= threshold — direction-agnostic, because the
+    reader knows which direction is a regression for each metric and
+    the threshold's job is only to separate noise from signal.
+    """
+    rows = []
+    for name in sorted(set(a) | set(b)):
+        va, vb = a.get(name), b.get(name)
+        delta = rel = None
+        if va is not None and vb is not None:
+            delta = vb - va
+            if va != 0:
+                rel = delta / abs(va)
+        flagged = rel is not None and abs(rel) >= threshold and delta != 0
+        rows.append({"name": name, "a": va, "b": vb,
+                     "delta": delta, "rel": rel, "flagged": flagged})
+    return rows
+
+
+def render_diff(rows: List[dict], path_a: str, path_b: str,
+                out=None) -> int:
+    w = (out or sys.stdout).write
+    w(f"A: {path_a}\nB: {path_b}\n")
+    w(f"{'metric':<40} {'A':>12} {'B':>12} {'delta':>12} {'rel':>8}\n")
+    n_flagged = 0
+    for r in rows:
+        fa = f"{r['a']:g}" if r["a"] is not None else "-"
+        fb = f"{r['b']:g}" if r["b"] is not None else "-"
+        fd = f"{r['delta']:+g}" if r["delta"] is not None else "-"
+        fr = f"{r['rel']:+.1%}" if r["rel"] is not None else "-"
+        mark = "  <-- FLAGGED" if r["flagged"] else ""
+        if r["flagged"]:
+            n_flagged += 1
+        w(f"{r['name']:<40} {fa:>12} {fb:>12} {fd:>12} {fr:>8}{mark}\n")
+    w(f"{n_flagged} metric(s) past threshold\n")
+    return n_flagged
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logs", nargs="+", help="run-log JSONL file(s)")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff the final metrics of exactly two runs")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative delta at/above which a diff row is "
+                         "flagged (default 0.05)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when the diff flags any metric")
+    args = ap.parse_args(argv)
+
+    if args.diff:
+        if len(args.logs) != 2:
+            ap.error("--diff takes exactly two run logs")
+        a, b = (final_metrics(load_run(p)) for p in args.logs)
+        n_flagged = render_diff(
+            diff_metrics(a, b, args.threshold), args.logs[0], args.logs[1])
+        return 1 if (args.strict and n_flagged) else 0
+
+    for path in args.logs:
+        summarize(path, load_run(path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

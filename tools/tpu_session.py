@@ -124,23 +124,12 @@ def main(argv=None):
     # Hard backstop for hangs SIGALRM cannot reach: a remote-compile wait
     # stuck in native code defers signal delivery indefinitely (observed
     # 2026-07-31 06:15-06:40: a case hung 25+ min THROUGH both its 420 s
-    # case fence and the 1500 s phase fence). A daemon thread hard-exits
+    # case fence and the 1500 s phase fence). The obs Watchdog hard-exits
     # the session 180 s past any phase deadline; the probe loop treats the
     # nonzero rc as a failed session and redials.
-    import threading
-    import time as _time
+    from ncnet_tpu.obs import Watchdog
 
-    deadline = [None]
-
-    def _watchdog():
-        while True:
-            _time.sleep(30)
-            d = deadline[0]
-            if d is not None and _time.time() > d:
-                log("phase watchdog: alarm never landed; hard-exiting")
-                os._exit(3)
-
-    threading.Thread(target=_watchdog, daemon=True).start()
+    watchdog = Watchdog(label="tpu_session", log=log).start()
 
     # Bench matrix runs BEFORE the per-stage phases (flipped 2026-08-01):
     # tunnel windows have measured ~30 min (08:31-09:03 this round), the
@@ -218,7 +207,7 @@ def main(argv=None):
                 os.environ.pop(k, None)
             os.environ.update(env)
             log(f"=== bench[{run_label}] env={env} (JSON on stdout) ===")
-            deadline[0] = _time.time() + fence + 180
+            watchdog.arm(fence + 180)
             try:
                 # Default fence matches the phases: bench.py's fallback
                 # ladder can reach the XLA extraction tier whose
@@ -231,7 +220,7 @@ def main(argv=None):
             except Exception:  # noqa: BLE001
                 log(f"bench[{run_label}] FAILED:\n{traceback.format_exc()}")
             finally:
-                deadline[0] = None
+                watchdog.disarm()
                 for k in env:
                     os.environ.pop(k, None)
         os.environ.update(_inherited)
@@ -241,7 +230,7 @@ def main(argv=None):
             log(f"=== {label}: SKIPPED ===")
             continue
         log(f"=== {label} ===")
-        deadline[0] = _time.time() + 1500 + 180
+        watchdog.arm(1500 + 180)
         try:
             # 25 min per phase: one pathological compile must not starve
             # the rest of the queue (observed 2026-07-31, see
@@ -255,7 +244,7 @@ def main(argv=None):
         except Exception:  # noqa: BLE001
             log(f"{label} FAILED:\n{traceback.format_exc()}")
         finally:
-            deadline[0] = None
+            watchdog.disarm()
 
     log("session DONE")
     return 0
