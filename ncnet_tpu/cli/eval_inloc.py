@@ -40,6 +40,11 @@ from ..models.ncnet import (
     extract_features,
     ncnet_forward_from_features,
 )
+# The same-shape bucket accumulator both batched drivers ride lives in
+# utils/batching (promoted there so the online serving micro-batcher
+# shares the exact grouping heuristics); the historical `_MissGroups`
+# name keeps this module's driver code readable.
+from ..utils.batching import ShapeBuckets as _MissGroups
 from .common import build_model
 
 
@@ -675,44 +680,6 @@ def main(argv=None):
     return out_dir
 
 
-class _MissGroups:
-    """Same-shape bucket accumulator shared by the two batched drivers.
-
-    Encodes the grouping heuristics ONCE so cached and uncached
-    `--pano_batch` runs cannot drift apart: a bucket dispatches the
-    moment `p` same-shape items have decoded; ragged groups are padded
-    by repeating their last item (via :meth:`pad`; the padded
-    iterations' outputs are discarded by the caller — unless
-    `NCNET_RAGGED_MISS_STACKS=1`, where the dispatcher sends the true
-    size and the jitted program retraces per size); and the decoded
-    backlog across buckets is capped at 2p by early-flushing the
-    fullest partial bucket rather than holding an unbounded number of
-    decoded 3200 px panos (ADVICE r2).
-    """
-
-    def __init__(self, p, dispatch):
-        self.p = p
-        self.dispatch = dispatch  # receives a chunk of 1..p items
-        self.groups = {}  # shape key -> list of items not yet dispatched
-
-    def pad(self, chunk):
-        return chunk + [chunk[-1]] * (self.p - len(chunk))
-
-    def add(self, shape_key, item):
-        g = self.groups.setdefault(shape_key, [])
-        g.append(item)
-        if len(g) == self.p:
-            self.dispatch(g[:])
-            g.clear()
-        elif sum(len(gg) for gg in self.groups.values()) > 2 * self.p:
-            big = max(self.groups.values(), key=len)
-            self.dispatch(big[:])
-            big.clear()
-
-    def drain(self):
-        for g in self.groups.values():
-            if g:
-                self.dispatch(g)
 
 
 def _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns, pool,

@@ -38,6 +38,7 @@ from .metrics import (
     default_registry,
     gauge,
     histogram,
+    render_text,
     reset,
     snapshot,
 )
@@ -60,6 +61,7 @@ __all__ = [
     "default_registry",
     "gauge",
     "histogram",
+    "render_text",
     "reset",
     "snapshot",
 ]

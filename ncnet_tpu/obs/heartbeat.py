@@ -64,6 +64,14 @@ class Heartbeat:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    @property
+    def in_stall(self) -> bool:
+        """True while the run is inside a stall episode (idle time past
+        ``stall_after_s`` and no progress since) — the serving front
+        end's ``/healthz`` reports this so a load balancer can drain a
+        wedged replica instead of timing requests out against it."""
+        return self._in_stall
+
     def beat_once(self) -> dict:
         """Emit one heartbeat (and maybe a stall) event; returns the fields.
 

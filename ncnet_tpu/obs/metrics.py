@@ -25,6 +25,7 @@ Metric naming convention (docs/OBSERVABILITY.md): dotted lowercase
 
 from __future__ import annotations
 
+import re
 import threading
 from typing import Dict, Optional
 
@@ -162,9 +163,63 @@ class MetricsRegistry:
                 out["histograms"][name] = m.snapshot()
         return out
 
+    def render_text(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of the registry.
+
+        The serving front end's ``GET /metrics`` serves this; any
+        Prometheus-compatible scraper consumes it directly. Mapping:
+
+          * dotted metric names sanitize to underscores
+            (``serving.queue_wait_s`` -> ``serving_queue_wait_s``);
+          * Counter -> ``<name>_total`` counter;
+          * Gauge   -> gauge (unset gauges are omitted — Prometheus has
+            no null and 0.0 would be a lie);
+          * Histogram -> a ``<name>`` summary (``_count``/``_sum``, the
+            two fields our streaming summary can expose exactly) plus
+            ``<name>_min``/``<name>_max``/``<name>_last`` gauges — the
+            registry keeps no quantile sketch (metrics.Histogram
+            docstring), so no fabricated ``quantile`` labels.
+        """
+        with self._lock:
+            items = sorted(self._metrics.items())
+        lines = []
+
+        def emit(name, kind, value):
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {float(value):g}")
+
+        for name, m in items:
+            pname = _prom_name(name)
+            if isinstance(m, Counter):
+                emit(f"{pname}_total", "counter", m.snapshot())
+            elif isinstance(m, Gauge):
+                v = m.snapshot()
+                if v is not None:
+                    emit(pname, "gauge", v)
+            else:
+                s = m.snapshot()
+                lines.append(f"# TYPE {pname} summary")
+                lines.append(f"{pname}_count {float(s['count']):g}")
+                lines.append(f"{pname}_sum {float(s['sum']):g}")
+                for field in ("min", "max", "last"):
+                    if s[field] is not None:
+                        emit(f"{pname}_{field}", "gauge", s[field])
+        return "\n".join(lines) + ("\n" if lines else "")
+
     def reset(self) -> None:
         with self._lock:
             self._metrics.clear()
+
+
+_PROM_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize a dotted metric name into a valid Prometheus name."""
+    name = _PROM_INVALID.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
 
 
 _DEFAULT = MetricsRegistry()
@@ -188,6 +243,10 @@ def histogram(name: str) -> Histogram:
 
 def snapshot() -> dict:
     return _DEFAULT.snapshot()
+
+
+def render_text() -> str:
+    return _DEFAULT.render_text()
 
 
 def reset() -> None:

@@ -1,0 +1,43 @@
+"""Online matching service: deadline-aware dynamic batching over the
+NCNet match pipeline (docs/SERVING.md).
+
+Layering::
+
+    client.MatchClient ──HTTP──> server.MatchServer
+                                   │  admission + deadline batching
+                                   ▼
+                                 batcher.DeadlineBatcher
+                                   │  same-bucket batches
+                                   ▼
+                                 engine.MatchEngine (jit + FeatureCache)
+
+Lazy attribute access keeps the pure-stdlib pieces (client) importable
+without pulling jax into a load-generator process: ``from
+ncnet_tpu.serving.client import MatchClient`` stays lightweight, while
+``ncnet_tpu.serving.MatchEngine`` imports the model stack on demand.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "DeadlineBatcher": "batcher",
+    "RejectedError": "batcher",
+    "BatchResult": "batcher",
+    "MatchEngine": "engine",
+    "Prepared": "engine",
+    "MatchServer": "server",
+    "MatchClient": "client",
+    "ServingError": "client",
+    "OverCapacityError": "client",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,302 @@
+"""Bounded admission queue + deadline-aware dynamic micro-batcher.
+
+The online matching front end (serving/server.py) accepts single
+(query, pano) requests from independent clients; the TPU-side economics
+are the same as the offline eval's (`--pano_batch`): one dispatch per
+pair pays a fixed per-dispatch latency, so strangers' requests that
+land in the same resolution bucket should share one jitted batch
+program. This module is the traffic half of that story:
+
+* **Admission control**: :meth:`DeadlineBatcher.submit` is a BOUNDED
+  queue. Past ``max_queue`` pending requests it raises
+  :class:`RejectedError` (the server maps it to HTTP 503 +
+  ``Retry-After``) instead of growing an unbounded backlog whose tail
+  latency nobody can meet. Rejection is the cheapest work a saturated
+  service can do (FireCaffe's batching-discipline argument, PAPERS.md).
+
+* **Shape bucketing**: requests group by their resolution-bucket key —
+  the SAME accumulator heuristics as the batched eval drivers
+  (utils/batching.ShapeBuckets, promoted out of cli/eval_inloc so eval
+  and serving cannot drift): a bucket dispatches the moment it holds
+  ``max_batch`` requests, and the cross-bucket backlog cap early-flushes
+  the fullest partial bucket.
+
+* **Deadline-aware flush**: a partial bucket is flushed when its OLDEST
+  request has lingered ``max_delay_s`` (bounded added latency in
+  exchange for batching) or when that request's deadline minus
+  ``deadline_slack_s`` (the model-time estimate) is about to pass —
+  whichever comes first. Deadlines shape WHEN a batch runs; admitted
+  requests are never dropped (the drain contract below).
+
+* **Graceful drain**: :meth:`close` stops admission, flushes every
+  partial bucket, and completes every admitted request before
+  returning — a rolling restart loses nothing it accepted.
+
+The core is synchronous and clock-injected: tests drive `submit` +
+:meth:`poll` with a fake clock and no threads. :meth:`start` attaches
+the worker thread for real serving; the worker sleeps exactly until the
+earliest pending flush trigger.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+from .. import obs
+from ..utils.batching import ShapeBuckets
+
+
+class RejectedError(Exception):
+    """Admission queue full: back off and retry after ``retry_after_s``."""
+
+    def __init__(self, retry_after_s: float, depth: int):
+        super().__init__(
+            f"admission queue full ({depth} pending); "
+            f"retry after {retry_after_s:.3f}s"
+        )
+        self.retry_after_s = retry_after_s
+        self.depth = depth
+
+
+@dataclass
+class _Pending:
+    """One admitted request waiting for (or riding in) a batch."""
+
+    bucket_key: Any
+    payload: Any
+    future: Future
+    t_submit: float
+    deadline: float
+
+    def __repr__(self):  # payloads are image arrays; keep logs sane
+        return (f"_Pending(bucket={self.bucket_key!r}, "
+                f"t_submit={self.t_submit:.3f}, deadline={self.deadline:.3f})")
+
+
+@dataclass
+class BatchResult:
+    """Per-request completion: the runner's result plus batch telemetry."""
+
+    result: Any
+    batch_size: int
+    queue_wait_s: float
+    run_s: float = 0.0
+    extra: dict = field(default_factory=dict)
+
+
+class DeadlineBatcher:
+    """Deadline-aware dynamic batcher over same-shape resolution buckets.
+
+    ``runner(bucket_key, [payload, ...]) -> [result, ...]`` is the model
+    half (serving/engine.MatchEngine.run_batch); it executes on the
+    batcher's worker thread (or the :meth:`poll` caller's), one batch at
+    a time — the engine owns exactly one accelerator, so batch-level
+    serialization IS the device schedule.
+    """
+
+    def __init__(
+        self,
+        runner: Callable[[Any, List[Any]], List[Any]],
+        max_batch: int = 4,
+        max_queue: int = 32,
+        max_delay_s: float = 0.05,
+        deadline_slack_s: float = 0.0,
+        default_timeout_s: float = 30.0,
+        backlog_cap: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.runner = runner
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self.max_delay_s = float(max_delay_s)
+        self.deadline_slack_s = float(deadline_slack_s)
+        self.default_timeout_s = float(default_timeout_s)
+        self.clock = clock
+        self._cond = threading.Condition()
+        # dispatch target: full buckets (and backlog early-flushes) land
+        # here synchronously inside add()/flush_ready()/drain(), all
+        # under _cond; the worker (or poll()) runs them outside the lock.
+        self._ready: List[List[_Pending]] = []
+        # Late-bound append: poll() swaps _ready for a fresh list, so
+        # the dispatch target must resolve the attribute per call.
+        self._buckets = ShapeBuckets(
+            max_batch, lambda chunk: self._ready.append(chunk),
+            backlog_cap=backlog_cap,
+        )
+        self._closed = False
+        self._inflight = 0
+        self._thread: Optional[threading.Thread] = None
+
+    # -- admission --------------------------------------------------------
+
+    def submit(self, bucket_key, payload, timeout_s: Optional[float] = None
+               ) -> Future:
+        """Admit one request; returns a Future resolving to BatchResult.
+
+        Raises :class:`RejectedError` (queue full) or RuntimeError
+        (batcher closed). ``timeout_s`` sets the request's deadline
+        relative to now; the batcher flushes the request's bucket
+        before the deadline (minus ``deadline_slack_s``) passes.
+        """
+        now = self.clock()
+        timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
+        pending = _Pending(
+            bucket_key=bucket_key,
+            payload=payload,
+            future=Future(),
+            t_submit=now,
+            deadline=now + float(timeout_s),
+        )
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed to new requests")
+            depth = len(self._buckets) + sum(len(b) for b in self._ready)
+            if depth >= self.max_queue:
+                obs.counter("serving.rejected").inc()
+                # One max_delay is roughly one batch-formation window: by
+                # then at least one queued batch has flushed and a slot
+                # opened (saturated steady state drains max_batch per
+                # model step, so this is the optimistic bound — clients
+                # with backoff multiply it themselves).
+                raise RejectedError(
+                    retry_after_s=max(self.max_delay_s, 0.01), depth=depth
+                )
+            self._buckets.add(bucket_key, pending)
+            obs.counter("serving.admitted").inc()
+            obs.gauge("serving.queue_depth").set(len(self._buckets))
+            self._cond.notify_all()
+        return pending.future
+
+    # -- flush policy -----------------------------------------------------
+
+    def _flush_due(self, pendings: List[_Pending], now: float) -> bool:
+        oldest = pendings[0]
+        return (
+            now - oldest.t_submit >= self.max_delay_s
+            or oldest.deadline - self.deadline_slack_s <= now
+        )
+
+    def _next_wake(self, now: float) -> Optional[float]:
+        """Seconds until the earliest pending flush trigger, or None."""
+        t = None
+        for g in self._buckets.groups.values():
+            if not g:
+                continue
+            oldest = g[0]
+            due = min(
+                oldest.t_submit + self.max_delay_s,
+                oldest.deadline - self.deadline_slack_s,
+            )
+            t = due if t is None else min(t, due)
+        if t is None:
+            return None
+        return max(0.0, t - now)
+
+    # -- execution --------------------------------------------------------
+
+    def poll(self, now: Optional[float] = None) -> int:
+        """Flush due buckets and run every ready batch; returns the
+        number of batches run. The fake-clock test surface — production
+        uses the worker thread, which is this on a timer."""
+        now = self.clock() if now is None else now
+        with self._cond:
+            self._buckets.flush_ready(
+                lambda key, g: self._flush_due(g, now)
+            )
+            ready, self._ready = self._ready, []
+            self._inflight += len(ready)
+            obs.gauge("serving.queue_depth").set(len(self._buckets))
+        for chunk in ready:
+            self._run(chunk)
+        if ready:
+            with self._cond:
+                self._inflight -= len(ready)
+                self._cond.notify_all()
+        return len(ready)
+
+    def _run(self, chunk: List[_Pending]) -> None:
+        t_run = self.clock()
+        obs.counter("serving.batches").inc()
+        obs.histogram("serving.batch_size").observe(len(chunk))
+        for p in chunk:
+            obs.histogram("serving.queue_wait_s").observe(t_run - p.t_submit)
+        try:
+            results = self.runner(chunk[0].bucket_key,
+                                  [p.payload for p in chunk])
+        except BaseException as exc:  # noqa: BLE001 — forwarded per-request
+            obs.counter("serving.batch_errors").inc()
+            for p in chunk:
+                if not p.future.set_running_or_notify_cancel():
+                    continue
+                p.future.set_exception(exc)
+            return
+        run_s = self.clock() - t_run
+        obs.histogram("serving.run_batch_s").observe(run_s)
+        for p, r in zip(chunk, results):
+            if not p.future.set_running_or_notify_cancel():
+                continue
+            p.future.set_result(BatchResult(
+                result=r,
+                batch_size=len(chunk),
+                queue_wait_s=t_run - p.t_submit,
+                run_s=run_s,
+            ))
+
+    # -- worker thread ----------------------------------------------------
+
+    def start(self) -> "DeadlineBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._ready:
+                    now = self.clock()
+                    self._buckets.flush_ready(
+                        lambda key, g: self._flush_due(g, now)
+                    )
+                    if self._ready:
+                        break
+                    if self._closed and not len(self._buckets):
+                        return
+                    self._cond.wait(timeout=self._next_wake(now))
+            self.poll()
+
+    # -- shutdown ---------------------------------------------------------
+
+    def close(self, timeout_s: float = 60.0) -> None:
+        """Stop admission, flush every partial bucket, and complete every
+        admitted request (the no-drop drain contract). Idempotent."""
+        with self._cond:
+            already = self._closed
+            self._closed = True
+            self._buckets.drain()
+            self._cond.notify_all()
+        if already and self._thread is None:
+            return
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+            return
+        # Threadless (fake-clock / synchronous) mode: run the drained
+        # batches on the caller.
+        while self.poll():
+            pass
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._buckets) + sum(len(b) for b in self._ready)

@@ -1,0 +1,124 @@
+"""Stdlib HTTP client for the online matching service.
+
+Deliberately free of jax/numpy imports: the threaded load generator
+(tools/bench_serving.py) runs dozens of these concurrently and a
+client needs nothing but `urllib` + `json`. Mirrors the server's
+schema (docs/SERVING.md) and backoff contract: 503 responses carry
+``Retry-After``; :meth:`MatchClient.match` honors it up to
+``retries`` times before surfacing :class:`OverCapacityError`.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+class ServingError(Exception):
+    """Non-2xx response from the service."""
+
+    def __init__(self, status: int, payload):
+        super().__init__(f"HTTP {status}: {payload}")
+        self.status = status
+        self.payload = payload
+
+
+class OverCapacityError(ServingError):
+    """503 after exhausting Retry-After backoff retries."""
+
+
+class MatchClient:
+    def __init__(self, base_url: str, timeout_s: float = 60.0,
+                 retries: int = 2):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.retries = retries
+
+    # -- transport --------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                raw = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+                if ctype.startswith("application/json"):
+                    return resp.status, json.loads(raw), resp.headers
+                return resp.status, raw.decode(), resp.headers
+        except urllib.error.HTTPError as exc:
+            raw = exc.read()
+            try:
+                payload = json.loads(raw)
+            except ValueError:
+                payload = raw.decode(errors="replace")
+            return exc.code, payload, exc.headers
+
+    # -- endpoints --------------------------------------------------------
+
+    def match(
+        self,
+        query_path: Optional[str] = None,
+        pano_path: Optional[str] = None,
+        query_bytes: Optional[bytes] = None,
+        pano_bytes: Optional[bytes] = None,
+        deadline_ms: Optional[float] = None,
+        max_matches: Optional[int] = None,
+    ) -> dict:
+        """POST /v1/match; returns the response dict on 200.
+
+        503s are retried after the server's ``Retry-After`` hint (up to
+        ``retries`` times — the cooperative half of admission control);
+        any other non-200 raises :class:`ServingError`.
+        """
+        body = {}
+        if query_path:
+            body["query_path"] = query_path
+        if pano_path:
+            body["pano_path"] = pano_path
+        if query_bytes:
+            body["query_b64"] = base64.b64encode(query_bytes).decode()
+        if pano_bytes:
+            body["pano_b64"] = base64.b64encode(pano_bytes).decode()
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        if max_matches is not None:
+            body["max_matches"] = max_matches
+        attempt = 0
+        while True:
+            status, payload, headers = self._request(
+                "POST", "/v1/match", body
+            )
+            if status == 200:
+                return payload
+            if status == 503 and attempt < self.retries:
+                attempt += 1
+                try:
+                    delay = float(headers.get("Retry-After", "0.1"))
+                except (TypeError, ValueError):
+                    delay = 0.1
+                time.sleep(min(delay, 5.0))
+                continue
+            cls = OverCapacityError if status == 503 else ServingError
+            raise cls(status, payload)
+
+    def healthz(self) -> dict:
+        status, payload, _ = self._request("GET", "/healthz")
+        if status not in (200, 503):
+            raise ServingError(status, payload)
+        return payload
+
+    def metrics(self) -> str:
+        status, payload, _ = self._request("GET", "/metrics")
+        if status != 200:
+            raise ServingError(status, payload)
+        return payload

@@ -1,0 +1,323 @@
+"""Model runner for the online matching service.
+
+One engine owns one model (config + params) and the jitted batch
+programs the batcher dispatches into. The device-side shape story is
+identical to the offline eval's (cli/eval_inloc): every distinct
+resolution bucket is one XLA compilation, so requests are snapped to
+the same `inloc_resize_shape` buckets and batched per bucket; a batch
+of b same-bucket pairs runs as ONE dispatch (`lax.scan` over the pair
+stack — the `--pano_batch` machinery's shape, with per-pair query
+features since strangers' queries differ).
+
+Ragged batch sizes retrace per size m <= max_batch — the promoted
+ragged-dispatch posture (`eval_inloc._ragged_miss_stacks`): one extra
+compile per size, one-time, after which every batch costs its true
+size. :meth:`MatchEngine.warmup` precompiles declared buckets at
+startup so the first user request never pays a compile.
+
+Optional :class:`~ncnet_tpu.evals.feature_cache.PanoFeatureCache`
+integration: requests that reference a server-side pano/gallery image
+by path probe the cache during host-side prepare; hits skip the pano
+backbone and decode entirely and batch through a separate
+from-features program (hit and miss share `_match_from_feats`-style
+composition, so the bit-parity contract of the eval cache carries
+over unchanged).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import threading
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..cli.eval_inloc import inloc_resize_shape, resolve_feat_units
+from ..evals import dedup_matches, inloc_device_matches
+from ..models.ncnet import extract_features, ncnet_forward_from_features
+
+
+@dataclass
+class Prepared:
+    """Host-side prepared request: decoded/resized arrays + bucket key."""
+
+    bucket_key: tuple
+    query: np.ndarray                 # [1, 3, Hq, Wq] f32, normalized
+    pano: Optional[np.ndarray]        # [1, 3, Hp, Wp] f32 (miss path)
+    pano_feats: Optional[np.ndarray]  # cached features (hit path)
+    pano_path: Optional[str]          # cache store key (None = no store)
+    pano_shape: Optional[Tuple[int, int]]
+    max_matches: int = 0              # 0 = all
+
+
+class MatchEngine:
+    """Per-bucket jitted match dispatch + warmup + feature cache.
+
+    ``run_batch`` is thread-confined to the batcher's worker (one
+    accelerator, one stream of batch programs); ``prepare`` runs
+    concurrently on the HTTP handler threads (decode/resize is pure
+    host work, exactly like the eval CLI's prefetch pool).
+    """
+
+    def __init__(
+        self,
+        config,
+        params,
+        k_size: int = 2,
+        image_size: int = 1600,
+        feat_unit: int = -1,
+        do_softmax: bool = True,
+        both_directions: bool = True,
+        invert_direction: bool = False,
+        cache_mb: int = 0,
+        cache_dir: str = "",
+        cache_model_key: str = "",
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax, self._jnp = jax, jnp
+        self.config = config
+        self.params = params
+        self.k_size = k_size
+        self.image_size = image_size
+        self.feat_unit = feat_unit
+        match_kwargs = dict(
+            k_size=k_size,
+            do_softmax=do_softmax,
+            both_directions=both_directions,
+            invert_direction=invert_direction,
+        )
+
+        def _match_from_feats(params, feat_a, feat_b):
+            corr, delta = ncnet_forward_from_features(
+                config, params, feat_a, feat_b
+            )
+            return inloc_device_matches(corr, delta4d=delta, **match_kwargs)
+
+        # One scanned program per (bucket shapes, batch size): the whole
+        # batch is one dispatch, outputs stack to [b, n] per match array.
+        # Queries differ per request (unlike eval's one-query fan-out),
+        # so the scan body extracts BOTH sides' features.
+        @jax.jit
+        def _batch_pairs(params, q_stack, t_stack):
+            def body(_, qt):
+                q, t = qt
+                feat_a = extract_features(config, params, q[None])
+                feat_b = extract_features(config, params, t[None])
+                return None, _match_from_feats(params, feat_a, feat_b)
+
+            _, ms = jax.lax.scan(body, None, (q_stack, t_stack))
+            return ms
+
+        # Miss program under an active cache: additionally returns the
+        # pano feature stack (bf16 — the dtype the cache stores; every
+        # correlation path casts features to bf16 as its first op, so
+        # the hit replay is bit-identical, evals/feature_cache.py).
+        @jax.jit
+        def _batch_pairs_with_feats(params, q_stack, t_stack):
+            def body(_, qt):
+                q, t = qt
+                feat_a = extract_features(config, params, q[None])
+                feat_b = extract_features(config, params, t[None])
+                return None, (_match_from_feats(params, feat_a, feat_b),
+                              feat_b.astype(jnp.bfloat16))
+
+            _, (ms, feats) = jax.lax.scan(body, None, (q_stack, t_stack))
+            return ms, feats
+
+        # Hit program: pano features come from the host cache.
+        @jax.jit
+        def _batch_pairs_cached(params, q_stack, featb_stack):
+            def body(_, qf):
+                q, feat_b = qf
+                feat_a = extract_features(config, params, q[None])
+                return None, _match_from_feats(params, feat_a, feat_b)
+
+            _, ms = jax.lax.scan(body, None, (q_stack, featb_stack))
+            return ms
+
+        self._batch_pairs = _batch_pairs
+        self._batch_pairs_with_feats = _batch_pairs_with_feats
+        self._batch_pairs_cached = _batch_pairs_cached
+
+        self.cache = None
+        if cache_mb > 0:
+            from ..evals.feature_cache import PanoFeatureCache
+
+            # Producer key "serve": the serving miss program (per-pair
+            # backbone inside the pair scan) is a different XLA artifact
+            # from the eval CLI's bb-grouped one — a shared disk tier
+            # must not cross-hit between them (the eval producer-key
+            # rule, cli/eval_inloc.py).
+            self.cache = PanoFeatureCache(
+                cache_mb * 1024 * 1024,
+                disk_dir=cache_dir or None,
+                model_key=cache_model_key + "|serve",
+                store_dtype=jnp.bfloat16,
+            )
+        # put() fetches D2H; serialize stores so a burst of misses can't
+        # stack redundant fetches of one shortlist-popular pano.
+        self._store_lock = threading.Lock()
+
+    # -- host-side request preparation -----------------------------------
+
+    def _resize_shape(self, h: int, w: int) -> Tuple[int, int]:
+        h_unit, w_unit = resolve_feat_units(
+            self.feat_unit, self.image_size, self.k_size
+        )
+        return inloc_resize_shape(
+            h, w, self.image_size, self.k_size, h_unit=h_unit, w_unit=w_unit
+        )
+
+    def _load_image(self, path: Optional[str], b64: Optional[str]
+                    ) -> Tuple[np.ndarray, Tuple[int, int]]:
+        """Decode + bucket-resize + normalize one image (path or base64
+        payload) into the model's [1, 3, H, W] layout."""
+        from PIL import Image
+
+        from ..data.image_io import load_and_resize_chw, resize_bilinear_np
+        from ..data.normalization import normalize_image
+
+        if path:
+            with Image.open(path) as im:  # header-only dims read
+                w, h = im.size
+            oh, ow = self._resize_shape(h, w)
+            chw, _ = load_and_resize_chw(path, oh, ow, normalize=True)
+            return chw[None], (oh, ow)
+        raw = base64.b64decode(b64)
+        with Image.open(io.BytesIO(raw)) as im:
+            img = np.asarray(im.convert("RGB"), dtype=np.float32)
+        oh, ow = self._resize_shape(*img.shape[:2])
+        chw = resize_bilinear_np(img, oh, ow).transpose(2, 0, 1)
+        chw = normalize_image(chw / 255.0).astype(np.float32)
+        return np.ascontiguousarray(chw)[None], (oh, ow)
+
+    def prepare(self, request: dict) -> Prepared:
+        """Decode/resize a request's images, probe the feature cache.
+
+        Request schema (docs/SERVING.md): ``query_path`` | ``query_b64``
+        plus ``pano_path`` | ``pano_b64``; optional ``max_matches``.
+        Raises ValueError on malformed input (the server maps it to 400).
+        """
+        if not isinstance(request, dict):
+            raise ValueError("request body must be a JSON object")
+        q_path, q_b64 = request.get("query_path"), request.get("query_b64")
+        p_path, p_b64 = request.get("pano_path"), request.get("pano_b64")
+        if bool(q_path) == bool(q_b64):
+            raise ValueError("exactly one of query_path/query_b64 required")
+        if bool(p_path) == bool(p_b64):
+            raise ValueError("exactly one of pano_path/pano_b64 required")
+        max_matches = int(request.get("max_matches", 0) or 0)
+        try:
+            query, _ = self._load_image(q_path, q_b64)
+        except (OSError, ValueError) as exc:
+            raise ValueError(f"query image unreadable: {exc}") from exc
+
+        pano = pano_feats = pano_shape = None
+        if p_path and self.cache is not None:
+            # Header-only probe first: a hit skips the full-size decode
+            # (the eval prefetch thread's exact trick).
+            try:
+                from PIL import Image
+
+                with Image.open(p_path) as im:
+                    pw, ph = im.size
+            except (OSError, ValueError) as exc:
+                raise ValueError(f"pano image unreadable: {exc}") from exc
+            pano_shape = self._resize_shape(ph, pw)
+            pano_feats = self.cache.get(p_path, pano_shape)
+        if pano_feats is None:
+            try:
+                pano, pano_shape = self._load_image(p_path, p_b64)
+            except (OSError, ValueError) as exc:
+                raise ValueError(f"pano image unreadable: {exc}") from exc
+
+        # Bucket key = every shape the jitted program specializes on.
+        # Hit and miss requests compile DIFFERENT programs, so the cache
+        # state is part of the key (a hit riding a miss batch would need
+        # its features re-derived; keep the buckets disjoint instead).
+        if pano_feats is not None:
+            kind = ("feat", tuple(pano_feats.shape))
+        else:
+            kind = ("img", tuple(pano.shape[2:]))
+        return Prepared(
+            bucket_key=(tuple(query.shape[2:]), kind),
+            query=query,
+            pano=pano,
+            pano_feats=pano_feats,
+            pano_path=p_path if (p_path and self.cache is not None) else None,
+            pano_shape=pano_shape,
+            max_matches=max_matches,
+        )
+
+    # -- batched device dispatch ------------------------------------------
+
+    def run_batch(self, bucket_key, batch: List[Prepared]) -> List[dict]:
+        """Run one same-bucket batch as one device dispatch; returns one
+        result dict per request (matches [n, 5] float32 + counts)."""
+        jnp = self._jnp
+        q_stack = jnp.concatenate([p.query for p in batch], axis=0)
+        store = []
+        if batch[0].pano_feats is not None:
+            f_stack = jnp.stack(
+                [jnp.asarray(p.pano_feats) for p in batch], axis=0
+            )
+            ms = self._batch_pairs_cached(self.params, q_stack, f_stack)
+        else:
+            t_stack = jnp.concatenate([p.pano for p in batch], axis=0)
+            if self.cache is not None and any(p.pano_path for p in batch):
+                ms, feats = self._batch_pairs_with_feats(
+                    self.params, q_stack, t_stack
+                )
+                store = [(p, feats[k]) for k, p in enumerate(batch)
+                         if p.pano_path]
+            else:
+                ms = self._batch_pairs(self.params, q_stack, t_stack)
+        np_ms = self._jax.device_get(ms)
+        out = []
+        for k, p in enumerate(batch):
+            tup = dedup_matches(*(a[k] for a in np_ms))
+            rows = np.stack(tup, axis=1).astype(np.float32)  # [n, 5]
+            if p.max_matches > 0:
+                rows = rows[: p.max_matches]
+            out.append({"matches": rows, "n_matches": int(rows.shape[0])})
+        for p, f in store:
+            # D2H fetch inside put(); serialized so concurrent batches
+            # don't race duplicate stores of the same pano.
+            with self._store_lock:
+                self.cache.put(p.pano_path, p.pano_shape, f)
+        if self.cache is not None:
+            obs.gauge("serving.cache.hits").set(self.cache.hits)
+            obs.gauge("serving.cache.misses").set(self.cache.misses)
+        return out
+
+    # -- startup ----------------------------------------------------------
+
+    def warmup(self, raw_shapes, batch_sizes=(1,)) -> int:
+        """Precompile the match program for declared traffic buckets.
+
+        ``raw_shapes``: iterable of (query_h, query_w, pano_h, pano_w)
+        RAW pixel dims (deployment knows its camera/gallery resolutions;
+        the engine applies the same bucket snap requests get). Returns
+        the number of programs compiled. Compiles land in the persistent
+        compile cache, so a restarted replica warms from disk.
+        """
+        n = 0
+        for qh, qw, ph, pw in raw_shapes:
+            q_shape = self._resize_shape(qh, qw)
+            p_shape = self._resize_shape(ph, pw)
+            for b in batch_sizes:
+                q = self._jnp.zeros((b, 3) + q_shape, self._jnp.float32)
+                t = self._jnp.zeros((b, 3) + p_shape, self._jnp.float32)
+                with obs.span("serving.warmup", q_shape=list(q_shape),
+                              p_shape=list(p_shape), batch=b):
+                    self._jax.block_until_ready(
+                        self._batch_pairs(self.params, q, t)
+                    )
+                n += 1
+        obs.counter("serving.warmup_programs").inc(n)
+        return n

@@ -1,0 +1,331 @@
+"""Stdlib-only HTTP front end for the online matching service.
+
+No new dependencies: `http.server.ThreadingHTTPServer` accepts
+connections, handler threads do the host-side decode/resize
+(engine.prepare — the concurrency story of the eval CLI's prefetch
+pool), and the deadline batcher's single worker owns the device.
+
+Endpoints (schema: docs/SERVING.md):
+
+* ``POST /v1/match`` — one (query, pano) pair; JSON in, JSON out.
+  Responses carry the batch telemetry (batch size, queue wait) so
+  clients and the load-gen bench can see batching happen. Over-capacity
+  requests get 503 + ``Retry-After`` (admission control), malformed
+  ones 400, deadline overruns 504.
+* ``GET /healthz`` — liveness + the PR-1 heartbeat's stall flag: a
+  wedged replica (device hang, starved batcher) reports ``stalled`` and
+  503 so a balancer drains it.
+* ``GET /metrics`` — Prometheus text exposition of the whole
+  `obs.metrics` registry (obs.render_text).
+
+Every request is an `obs` event; queue-wait / batch-size / end-to-end
+latency land in `obs` histograms. The run log is the same JSONL
+contract as every other entry point (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .. import obs
+from .batcher import DeadlineBatcher, RejectedError
+from .engine import MatchEngine
+
+#: Grace added past a request's deadline before the handler gives up
+#: waiting (504). Admitted requests are still completed by the batcher —
+#: the drain contract — the client has just stopped listening.
+DEADLINE_GRACE_S = 30.0
+
+
+class MatchServer:
+    """Engine + batcher + ThreadingHTTPServer, one object to start/stop."""
+
+    def __init__(
+        self,
+        engine: MatchEngine,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_batch: int = 4,
+        max_queue: int = 32,
+        max_delay_s: float = 0.05,
+        deadline_slack_s: float = 0.1,
+        default_timeout_s: float = 30.0,
+        run_log=None,
+    ):
+        self.engine = engine
+        self.run_log = run_log
+        self.batcher = DeadlineBatcher(
+            engine.run_batch,
+            max_batch=max_batch,
+            max_queue=max_queue,
+            max_delay_s=max_delay_s,
+            deadline_slack_s=deadline_slack_s,
+            default_timeout_s=default_timeout_s,
+        )
+        self.t_start = time.monotonic()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: N802
+                # Default impl spams stderr per request; the structured
+                # run log is the record of truth here.
+                pass
+
+            def _send_json(self, code: int, payload: dict,
+                           headers: Optional[dict] = None) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client gave up; nothing to salvage
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    self._send_json(*server.healthz())
+                elif self.path == "/metrics":
+                    text = obs.render_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(text)))
+                    self.end_headers()
+                    self.wfile.write(text)
+                else:
+                    self._send_json(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/v1/match":
+                    self._send_json(404, {"error": "not found"})
+                    return
+                code, payload, headers = server.handle_match(self)
+                self._send_json(code, payload, headers)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # -- endpoint logic (handler-thread context) --------------------------
+
+    def healthz(self):
+        hb = self.run_log.heartbeat if self.run_log is not None else None
+        stalled = bool(hb.in_stall) if hb is not None else False
+        payload = {
+            "status": "stalled" if stalled else "ok",
+            "uptime_s": round(time.monotonic() - self.t_start, 3),
+            "queue_depth": self.batcher.depth,
+        }
+        return (503 if stalled else 200), payload
+
+    def handle_match(self, handler):
+        """Parse, admit, wait, respond. Returns (code, payload, headers)."""
+        t0 = time.monotonic()
+        obs.counter("serving.requests").inc()
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            request = json.loads(handler.rfile.read(length) or b"{}")
+        except (ValueError, OSError) as exc:
+            obs.counter("serving.bad_requests").inc()
+            return 400, {"error": f"malformed request: {exc}"}, None
+        timeout_s = None
+        if request.get("deadline_ms") is not None:
+            try:
+                timeout_s = max(float(request["deadline_ms"]) / 1000.0, 1e-3)
+            except (TypeError, ValueError):
+                obs.counter("serving.bad_requests").inc()
+                return 400, {"error": "deadline_ms must be a number"}, None
+        try:
+            prepared = self.engine.prepare(request)
+        except ValueError as exc:
+            obs.counter("serving.bad_requests").inc()
+            return 400, {"error": str(exc)}, None
+        try:
+            fut = self.batcher.submit(
+                prepared.bucket_key, prepared, timeout_s=timeout_s
+            )
+        except RejectedError as exc:
+            obs.event("reject", depth=exc.depth,
+                      retry_after_s=exc.retry_after_s)
+            return (
+                503,
+                {"error": "over capacity", "retry_after_s": exc.retry_after_s},
+                {"Retry-After": f"{exc.retry_after_s:.3f}"},
+            )
+        except RuntimeError as exc:  # draining for shutdown
+            return 503, {"error": str(exc)}, {"Retry-After": "1"}
+        wait_s = (timeout_s if timeout_s is not None
+                  else self.batcher.default_timeout_s) + DEADLINE_GRACE_S
+        try:
+            br = fut.result(timeout=wait_s)
+        except FutureTimeoutError:
+            obs.counter("serving.deadline_exceeded").inc()
+            return 504, {"error": "deadline exceeded"}, None
+        except Exception as exc:  # noqa: BLE001 — model failure -> 500
+            obs.counter("serving.errors").inc()
+            obs.event("request_error", error=f"{type(exc).__name__}: {exc}")
+            return 500, {"error": f"{type(exc).__name__}: {exc}"}, None
+        e2e_s = time.monotonic() - t0
+        obs.counter("serving.responses").inc()
+        obs.histogram("serving.e2e_latency_s").observe(e2e_s)
+        obs.event(
+            "request",
+            bucket=repr(prepared.bucket_key),
+            n_matches=br.result["n_matches"],
+            batch_size=br.batch_size,
+            queue_wait_s=round(br.queue_wait_s, 6),
+            e2e_s=round(e2e_s, 6),
+        )
+        return 200, {
+            "matches": br.result["matches"].tolist(),
+            "n_matches": br.result["n_matches"],
+            "batch_size": br.batch_size,
+            "queue_wait_ms": round(br.queue_wait_s * 1e3, 3),
+            "run_ms": round(br.run_s * 1e3, 3),
+            "latency_ms": round(e2e_s * 1e3, 3),
+        }, None
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MatchServer":
+        self.batcher.start()
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="serving-http", daemon=True
+        )
+        self._serve_thread.start()
+        obs.event("serving_start", host=self.host, port=self.port)
+        return self
+
+    def stop(self) -> None:
+        """Graceful drain: stop accepting, finish every admitted request,
+        then shut the listener down."""
+        self.batcher.close()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10)
+            self._serve_thread = None
+        obs.event("serving_stop", queue_depth=self.batcher.depth)
+
+
+def _parse_warmup(specs):
+    """--warmup qHxqW:pHxpW[:b1,b2] -> (shapes, batch_sizes) lists."""
+    shapes, batches = [], set()
+    for spec in specs:
+        parts = spec.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(f"bad --warmup spec {spec!r}")
+        qh, qw = (int(v) for v in parts[0].split("x"))
+        ph, pw = (int(v) for v in parts[1].split("x"))
+        shapes.append((qh, qw, ph, pw))
+        if len(parts) == 3:
+            batches.update(int(v) for v in parts[2].split(","))
+    return shapes, sorted(batches) or [1]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="NCNet-TPU online matching service"
+    )
+    parser.add_argument("--host", type=str, default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8080,
+                        help="0 = ephemeral (bound port printed on stderr)")
+    parser.add_argument("--checkpoint", type=str, default="")
+    parser.add_argument("--k_size", type=int, default=2)
+    parser.add_argument("--image_size", type=int, default=1600)
+    parser.add_argument("--feat_unit", type=int, default=-1)
+    parser.add_argument("--max_batch", type=int, default=4)
+    parser.add_argument("--max_queue", type=int, default=32)
+    parser.add_argument("--max_delay_ms", type=float, default=50.0)
+    parser.add_argument("--deadline_slack_ms", type=float, default=100.0)
+    parser.add_argument("--default_timeout_s", type=float, default=30.0)
+    parser.add_argument("--cache_mb", type=int, default=2048,
+                        help="pano feature cache budget (0 disables)")
+    parser.add_argument("--cache_dir", type=str, default="")
+    parser.add_argument(
+        "--warmup", action="append", default=[],
+        help="precompile a bucket at startup: qHxqW:pHxpW[:b1,b2] raw "
+        "pixel dims (repeatable)",
+    )
+    parser.add_argument(
+        "--run_log", type=str, default="",
+        help="structured JSONL run log path (empty disables)",
+    )
+    args = parser.parse_args(argv)
+
+    from ..cli.common import build_model
+    from ..evals.feature_cache import model_cache_key
+
+    run_log = None
+    if args.run_log:
+        run_log = obs.init_run("serving", args.run_log, args=args)
+
+    config, params = build_model(
+        checkpoint=args.checkpoint,
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=args.k_size,
+        half_precision=True,
+        backbone_bf16=True,
+    )
+    engine = MatchEngine(
+        config, params,
+        k_size=args.k_size,
+        image_size=args.image_size,
+        feat_unit=args.feat_unit,
+        cache_mb=args.cache_mb,
+        cache_dir=args.cache_dir,
+        cache_model_key=model_cache_key(args.checkpoint, seed=1),
+    )
+    if args.warmup:
+        shapes, batches = _parse_warmup(args.warmup)
+        n = engine.warmup(shapes, batch_sizes=batches)
+        print(f"warmup: {n} programs compiled", file=sys.stderr, flush=True)
+
+    server = MatchServer(
+        engine,
+        host=args.host,
+        port=args.port,
+        max_batch=args.max_batch,
+        max_queue=args.max_queue,
+        max_delay_s=args.max_delay_ms / 1e3,
+        deadline_slack_s=args.deadline_slack_ms / 1e3,
+        default_timeout_s=args.default_timeout_s,
+        run_log=run_log,
+    ).start()
+    print(f"serving on {server.url}", file=sys.stderr, flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("draining...", file=sys.stderr, flush=True)
+    finally:
+        server.stop()
+        if run_log is not None:
+            run_log.close("ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,23 @@ def _reset_obs_metrics():
     yield
 
 
+@pytest.fixture(scope="session")
+def tiny_serving_model():
+    """Session-shared tiny model for the serving tests (the eval CLI
+    smoke config: k_size 2, small consensus stack, bf16 backbone).
+    Session-scoped because params init is the expensive part; each test
+    builds its own engine/server around these."""
+    from ncnet_tpu.cli.common import build_model
+
+    return build_model(
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        relocalization_k_size=2,
+        half_precision=True,
+        backbone_bf16=True,
+    )
+
+
 def assert_valid_runlog(path, component=None):
     """Schema check for an obs run log (docs/OBSERVABILITY.md).
 

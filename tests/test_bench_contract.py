@@ -42,6 +42,46 @@ def test_bench_emits_one_json_line():
     assert rec["value"] > 0
 
 
+def test_bench_serving_emits_one_json_line(tiny_serving_model, capsys):
+    """tools/bench_serving.py stdout contract (ISSUE 2): the load
+    generator, run in-process against a real tiny server, prints ONE
+    JSON line with the throughput metric and latency percentiles."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import json as _json
+
+    import bench_serving
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    # Precompile the exact bucket the synthetic load hits so the bench
+    # measures serving, not XLA.
+    engine.warmup([(96, 128, 96, 128)], batch_sizes=(1, 2))
+    server = MatchServer(engine, port=0, max_batch=2, max_delay_s=0.05,
+                         default_timeout_s=120.0).start()
+    try:
+        rc = bench_serving.main([
+            "--url", server.url, "--synthetic", "96x128",
+            "--rate", "8", "--duration_s", "1", "--threads", "4",
+        ])
+    finally:
+        server.stop()
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = _json.loads(lines[0])
+    assert rec["metric"] == "serving_match_throughput_rps"
+    assert rec["unit"] == "req/s"
+    assert rec["value"] > 0
+    for q in ("p50", "p95", "p99"):
+        assert rec["latency_ms"][q] > 0
+    assert rec["sent"] == 8
+    assert rec["ok"] + rec["rejected"] == rec["sent"]
+    assert rec["errors"] == 0
+
+
 def test_traceagg_on_committed_round2_trace():
     """traceagg ground truth against the committed round-2 device trace:
     whole-step totals and the stage rollup must reproduce the numbers in

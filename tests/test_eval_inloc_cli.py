@@ -545,15 +545,13 @@ def test_pano_feature_cache_disk_tier(fixture_dir, capsys):
         np.testing.assert_array_equal(a["matches"], b["matches"])
 
 
+@pytest.mark.slow
 def test_pano_dp_fanout_parity(fixture_dir):
     """--pano_dp 8: each virtual device runs the complete batch-1 per-pano
     program on a different pano (shard_map fan-out) — written matches must
-    be identical to the sequential path's.
-
-    Tier-1 (not slow-marked) since the ragged-dispatch default broke this
-    mode once (a drain-time partial group is not divisible by the mesh, so
-    --pano_dp must force padded dispatch — ADVICE r5 high): the dp path
-    needs CI coverage under the DEFAULT env, not just in slow runs."""
+    be identical to the sequential path's. Full-mesh (8-way) variant;
+    the tier-1 lane covers the same property with a smaller mesh in
+    test_pano_dp_fanout_parity_fast below."""
     base = [
         "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
         "--query_path", str(fixture_dir / "query"),
@@ -575,3 +573,33 @@ def test_pano_dp_fanout_parity(fixture_dir):
         a = loadmat(fixture_dir / "m_seq" / exp_a / q)
         b = loadmat(fixture_dir / "m_dp" / exp_b / q)
         np.testing.assert_array_equal(a["matches"], b["matches"])
+
+
+def test_pano_dp_fanout_parity_fast(fixture_dir):
+    """Tier-1 shrunk --pano_dp parity: 4-way mesh, one query, two panos.
+
+    Kept in the default lane since the ragged-dispatch default broke this
+    mode once (a drain-time partial group is not divisible by the mesh, so
+    --pano_dp must force padded dispatch — ADVICE r5 high): the 2-pano
+    group here is NOT divisible by the 4-way mesh, so the drain path is
+    exactly the regression shape, at a fraction of the full-mesh cost."""
+    base = [
+        "--inloc_shortlist", str(fixture_dir / "shortlist.mat"),
+        "--query_path", str(fixture_dir / "query"),
+        "--pano_path", str(fixture_dir / "pano"),
+        "--image_size", "64",
+        "--n_queries", "1",
+        "--n_panos", "2",
+        "--k_size", "2",
+        "--pano_feature_cache_mb", "0",
+    ]
+    eval_inloc.main(base + ["--output_dir", str(fixture_dir / "f_seq")])
+    eval_inloc.main(base + [
+        "--output_dir", str(fixture_dir / "f_dp"),
+        "--pano_dp", "4",
+    ])
+    exp_a = os.listdir(fixture_dir / "f_seq")[0]
+    exp_b = os.listdir(fixture_dir / "f_dp")[0]
+    a = loadmat(fixture_dir / "f_seq" / exp_a / "1.mat")
+    b = loadmat(fixture_dir / "f_dp" / exp_b / "1.mat")
+    np.testing.assert_array_equal(a["matches"], b["matches"])

@@ -13,6 +13,7 @@ committed fixture logs in tests/data/.
 import io
 import json
 import os
+import re
 import sys
 import threading
 
@@ -28,6 +29,9 @@ import obs_report  # noqa: E402
 
 FIXTURE_A = os.path.join(os.path.dirname(__file__), "data", "obs_runlog_a.jsonl")
 FIXTURE_B = os.path.join(os.path.dirname(__file__), "data", "obs_runlog_b.jsonl")
+
+# Valid Prometheus metric name (exposition format 0.0.4).
+_PROM_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
 
 
 # -- RunLog ---------------------------------------------------------------
@@ -116,6 +120,52 @@ def test_metrics_kind_mismatch():
     reg.counter("x")
     with pytest.raises(TypeError):
         reg.gauge("x")
+
+
+def test_render_text_prometheus_exposition():
+    """render_text: counters -> _total, histograms -> summary with
+    _count/_sum + min/max/last gauges, dotted names sanitized, unset
+    gauges omitted (ISSUE 2 satellite 2 — /metrics serves this)."""
+    reg = MetricsRegistry()
+    reg.counter("serving.requests").inc(3)
+    reg.gauge("serving.queue_depth").set(2.5)
+    reg.gauge("never.set")  # registered but unset: must not render
+    h = reg.histogram("serving.e2e_latency_s")
+    h.observe(0.25)
+    h.observe(0.75)
+    text = reg.render_text()
+
+    assert "# TYPE serving_requests_total counter" in text
+    assert "serving_requests_total 3" in text
+    assert "# TYPE serving_queue_depth gauge" in text
+    assert "serving_queue_depth 2.5" in text
+    assert "never_set" not in text
+    assert "# TYPE serving_e2e_latency_s summary" in text
+    assert "serving_e2e_latency_s_count 2" in text
+    assert "serving_e2e_latency_s_sum 1" in text
+    assert "serving_e2e_latency_s_min 0.25" in text
+    assert "serving_e2e_latency_s_max 0.75" in text
+    assert "serving_e2e_latency_s_last 0.75" in text
+    assert text.endswith("\n")
+    # Every non-comment line is "name value" with a finite float value.
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.split(" ")
+        assert _PROM_NAME_RE.fullmatch(name), line
+        float(value)
+
+
+def test_render_text_sanitizes_hostile_names():
+    reg = MetricsRegistry()
+    reg.counter("9weird name/with:stuff").inc()
+    text = reg.render_text()
+    assert "_9weird_name_with:stuff_total 1" in text
+
+
+def test_render_text_module_level_uses_default_registry():
+    obs.counter("modlevel.c").inc()
+    assert "modlevel_c_total 1" in obs.render_text()
 
 
 # -- heartbeat / stall ----------------------------------------------------

@@ -1,0 +1,326 @@
+"""Online matching service (ncnet_tpu/serving, ISSUE 2).
+
+Two layers of coverage:
+
+* DeadlineBatcher unit tests — fake clock, no threads, no jax: the
+  flush policy (max-batch, max-delay, deadline), bucket isolation,
+  admission control, the drain contract, and error propagation are all
+  pure control flow and must be testable at microsecond cost.
+* CPU end-to-end — a real MatchServer on an ephemeral port with a tiny
+  model, driven over HTTP by MatchClient: concurrent requests share a
+  batch, the feature cache replays bit-identically, /healthz and
+  /metrics serve, the run log validates, and shutdown drains cleanly.
+"""
+
+import io
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from conftest import assert_valid_runlog
+from ncnet_tpu import obs
+from ncnet_tpu.serving.batcher import DeadlineBatcher, RejectedError
+from ncnet_tpu.serving.client import (
+    MatchClient,
+    OverCapacityError,
+    ServingError,
+)
+
+# -- batcher (fake clock, threadless) -------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def echo_runner(calls):
+    def runner(bucket_key, payloads):
+        calls.append((bucket_key, list(payloads)))
+        return [f"r:{p}" for p in payloads]
+
+    return runner
+
+
+def make_batcher(clock, calls, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_queue", 8)
+    kw.setdefault("max_delay_s", 0.05)
+    return DeadlineBatcher(echo_runner(calls), clock=clock, **kw)
+
+
+def test_batcher_max_batch_flush():
+    clock, calls = FakeClock(), []
+    b = make_batcher(clock, calls)
+    f1 = b.submit("a", "p1")
+    f2 = b.submit("a", "p2")
+    # Full bucket dispatches without any clock advance.
+    assert b.poll() == 1
+    assert f1.result(0).result == "r:p1"
+    assert f2.result(0).result == "r:p2"
+    assert f1.result(0).batch_size == 2
+    assert calls == [("a", ["p1", "p2"])]
+
+
+def test_batcher_max_delay_flush():
+    clock, calls = FakeClock(), []
+    b = make_batcher(clock, calls, max_delay_s=0.05)
+    f = b.submit("a", "p1")
+    assert b.poll() == 0, "partial bucket, not due yet"
+    clock.t += 0.04
+    assert b.poll() == 0, "still inside the linger window"
+    clock.t += 0.02
+    assert b.poll() == 1, "oldest lingered past max_delay_s"
+    assert f.result(0).batch_size == 1
+    assert f.result(0).queue_wait_s == pytest.approx(0.06)
+
+
+def test_batcher_deadline_flush_beats_max_delay():
+    clock, calls = FakeClock(), []
+    b = make_batcher(clock, calls, max_delay_s=10.0, deadline_slack_s=0.005)
+    f = b.submit("a", "p1", timeout_s=0.02)
+    clock.t += 0.01
+    assert b.poll() == 0, "deadline minus slack not reached"
+    clock.t += 0.006  # now 0.016 >= 0.02 - 0.005
+    assert b.poll() == 1, "deadline-near flush fires long before max_delay"
+    assert f.result(0).result == "r:p1"
+
+
+def test_batcher_bucket_isolation_by_shape():
+    clock, calls = FakeClock(), []
+    b = make_batcher(clock, calls, max_batch=2)
+    b.submit(("64x48", "img"), "p1")
+    b.submit(("96x64", "img"), "p2")
+    clock.t += 0.06
+    assert b.poll() == 2, "different shapes never share a batch"
+    assert sorted(len(ps) for _, ps in calls) == [1, 1]
+    b.submit(("64x48", "img"), "q1")
+    b.submit(("64x48", "img"), "q2")
+    assert b.poll() == 1, "same shape batches together"
+    assert calls[-1] == (("64x48", "img"), ["q1", "q2"])
+
+
+def test_batcher_backpressure_rejects_with_retry_after():
+    clock, calls = FakeClock(), []
+    b = make_batcher(clock, calls, max_batch=4, max_queue=3)
+    futs = [b.submit("a", f"p{i}") for i in range(3)]
+    with pytest.raises(RejectedError) as exc_info:
+        b.submit("a", "overflow")
+    assert exc_info.value.depth == 3
+    assert exc_info.value.retry_after_s > 0
+    snap = obs.snapshot()
+    assert snap["counters"]["serving.rejected"] == 1.0
+    assert snap["counters"]["serving.admitted"] == 3.0
+    # The rejected request is NOT in any bucket: a later poll runs only
+    # the three admitted ones.
+    clock.t += 0.06
+    assert b.poll() == 1
+    assert [f.result(0).result for f in futs] == ["r:p0", "r:p1", "r:p2"]
+
+
+def test_batcher_drain_on_close_completes_all_admitted():
+    clock, calls = FakeClock(), []
+    b = make_batcher(clock, calls, max_batch=4)
+    futs = [b.submit("a", f"p{i}") for i in range(3)]
+    futs.append(b.submit("b", "q0"))
+    b.close()  # threadless: drains synchronously on the caller
+    for f in futs:
+        assert f.done(), "drain contract: every admitted request completes"
+    assert {f.result(0).result for f in futs} == {"r:p0", "r:p1", "r:p2",
+                                                  "r:q0"}
+    with pytest.raises(RuntimeError):
+        b.submit("a", "late")
+
+
+def test_batcher_runner_exception_propagates_per_request():
+    clock = FakeClock()
+
+    def boom(bucket_key, payloads):
+        raise ValueError("device on fire")
+
+    b = DeadlineBatcher(boom, max_batch=2, clock=clock)
+    f1 = b.submit("a", "p1")
+    f2 = b.submit("a", "p2")
+    assert b.poll() == 1
+    for f in (f1, f2):
+        with pytest.raises(ValueError, match="device on fire"):
+            f.result(0)
+    assert obs.snapshot()["counters"]["serving.batch_errors"] == 1.0
+
+
+def test_batcher_worker_thread_real_clock():
+    """The threaded path: full-bucket and linger flushes both complete
+    without any explicit poll() from the test."""
+    calls = []
+    b = DeadlineBatcher(echo_runner(calls), max_batch=2,
+                        max_delay_s=0.02).start()
+    try:
+        f1 = b.submit("a", "p1")
+        f2 = b.submit("a", "p2")
+        assert f1.result(timeout=5).batch_size == 2
+        assert f2.result(timeout=5).result == "r:p2"
+        f3 = b.submit("a", "p3")  # partial: linger flush on the worker
+        assert f3.result(timeout=5).batch_size == 1
+    finally:
+        b.close()
+
+
+# -- client backoff (stub HTTP server, no jax) ----------------------------
+
+
+def _stub_server(handler_cls):
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_client_retries_503_then_succeeds():
+    state = {"hits": 0, "always_503": False}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: N802
+            pass
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            state["hits"] += 1
+            if state["always_503"] or state["hits"] < 2:
+                body = b'{"error": "over capacity"}'
+                self.send_response(503)
+                self.send_header("Retry-After", "0.01")
+            else:
+                body = b'{"n_matches": 0, "matches": [], "batch_size": 1}'
+                self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd, url = _stub_server(Handler)
+    try:
+        client = MatchClient(url, retries=2)
+        resp = client.match(query_path="q.jpg", pano_path="p.jpg")
+        assert resp["n_matches"] == 0
+        assert state["hits"] == 2, "one 503 then one retry"
+
+        state["always_503"] = True
+        with pytest.raises(OverCapacityError) as exc_info:
+            MatchClient(url, retries=0).match(
+                query_path="q.jpg", pano_path="p.jpg"
+            )
+        assert exc_info.value.status == 503
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# -- end to end (tiny model, real HTTP, CPU) ------------------------------
+
+
+def _jpeg_bytes(h, w, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    img = Image.fromarray((rng.random((h, w, 3)) * 255).astype("uint8"))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_serving_e2e_cpu(tiny_serving_model, tmp_path):
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    log_path = str(tmp_path / "serving_run.jsonl")
+    run_log = obs.init_run("serving", log_path)
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=64)
+    server = MatchServer(
+        engine, port=0, max_batch=2, max_queue=16,
+        max_delay_s=0.3, default_timeout_s=300.0, run_log=run_log,
+    ).start()
+    try:
+        client = MatchClient(server.url, timeout_s=600.0)
+        assert client.healthz()["status"] == "ok"
+
+        qb = _jpeg_bytes(96, 128, 0)
+        pb = _jpeg_bytes(96, 128, 1)
+
+        # Two concurrent same-shape requests share one batch (the
+        # acceptance criterion: a response served from a batch of > 1).
+        results = [None, None]
+
+        def call(i):
+            results[i] = client.match(query_bytes=qb, pano_bytes=pb,
+                                      max_matches=8)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r is not None for r in results)
+        assert any(r["batch_size"] == 2 for r in results), results
+        for r in results:
+            assert r["n_matches"] >= 1
+            assert len(r["matches"]) == r["n_matches"] <= 8
+            assert all(len(row) == 5 for row in r["matches"])
+            assert r["latency_ms"] >= r["queue_wait_ms"]
+
+        # Path-referenced pano: miss populates the feature cache, the
+        # repeat hits it and replays bit-identically.
+        pano_path = str(tmp_path / "pano.jpg")
+        with open(pano_path, "wb") as fh:
+            fh.write(pb)
+        r_miss = client.match(query_bytes=qb, pano_path=pano_path)
+        r_hit = client.match(query_bytes=qb, pano_path=pano_path)
+        assert engine.cache.hits >= 1
+        assert r_miss["matches"] == r_hit["matches"]
+
+        # Malformed requests map to 400, not 500.
+        for bad in ({}, {"query_b64": "!!", "pano_b64": "!!"},
+                    {"query_path": "/nonexistent.jpg",
+                     "pano_path": pano_path}):
+            status, payload, _ = client._request("POST", "/v1/match", bad)
+            assert status == 400, (bad, payload)
+            assert "error" in payload
+
+        # /metrics: Prometheus text of the default registry.
+        metrics = client.metrics()
+        assert "# TYPE serving_batches_total counter" in metrics
+        assert "serving_e2e_latency_s_count" in metrics
+        assert "serving_batch_size_max 2" in metrics
+
+        # Drain contract over the real engine: admit directly, then
+        # stop() — every admitted request still completes.
+        prepared = engine.prepare({"query_b64": _b64(qb),
+                                   "pano_b64": _b64(pb)})
+        futs = [server.batcher.submit(prepared.bucket_key, prepared)
+                for _ in range(3)]
+    finally:
+        server.stop()
+    for f in futs:
+        assert f.done(), "drain: admitted request dropped at shutdown"
+        assert f.result(0).result["n_matches"] >= 1
+    with pytest.raises(RuntimeError):
+        server.batcher.submit(prepared.bucket_key, prepared)
+
+    run_log.close("ok")
+    records = assert_valid_runlog(log_path, component="serving")
+    names = [r["event"] for r in records]
+    assert "serving_start" in names and "serving_stop" in names
+    assert "request" in names
+
+
+def _b64(data):
+    import base64
+
+    return base64.b64encode(data).decode()

@@ -1,0 +1,196 @@
+"""Threaded open-loop load generator for the online matching service.
+
+Drives ``POST /v1/match`` at a fixed arrival rate (open loop: arrivals
+are scheduled on the wall clock, independent of completions — the
+honest way to measure a service's latency under load; closed-loop
+clients hide queueing collapse by slowing down with the server) and
+prints ONE JSON line (the repo's bench stdout contract,
+tests/test_bench_contract.py):
+
+    {"metric": "serving_match_throughput_rps", "value": N,
+     "unit": "req/s", "latency_ms": {"p50": ..., "p95": ..., "p99": ...},
+     "sent": ..., "ok": ..., "rejected": ..., "errors": ...,
+     "batched_frac": ..., "duration_s": ...}
+
+Request payloads: ``--query/--pano`` point at server-readable files, or
+``--synthetic HxW`` generates random JPEGs once and ships them inline
+(base64) — self-contained against any server. Stage notes go to stderr.
+
+Example (CPU smoke)::
+
+    python -m ncnet_tpu.serving.server --port 8123 --image_size 64 &
+    python tools/bench_serving.py --url http://127.0.0.1:8123 \
+        --synthetic 96x128 --rate 4 --duration_s 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import threading
+import time
+
+
+def note(msg):
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile on a pre-sorted list (no numpy needed —
+    the load generator stays stdlib-only, like serving/client.py)."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def synth_jpegs(spec, seed=0):
+    """Two random JPEGs (query, pano) at HxW — encoded once, sent inline."""
+    import numpy as np
+    from PIL import Image
+
+    h, w = (int(v) for v in spec.split("x"))
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(2):
+        img = Image.fromarray(
+            (rng.random((h, w, 3)) * 255).astype("uint8")
+        )
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG")
+        out.append(buf.getvalue())
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="open-loop load generator for the matching service"
+    )
+    parser.add_argument("--url", type=str, required=True)
+    parser.add_argument("--rate", type=float, default=8.0,
+                        help="open-loop arrival rate, requests/s")
+    parser.add_argument("--duration_s", type=float, default=10.0)
+    parser.add_argument("--threads", type=int, default=16,
+                        help="worker pool size (bounds in-flight requests)")
+    parser.add_argument("--query", type=str, default="",
+                        help="server-readable query image path")
+    parser.add_argument("--pano", type=str, default="",
+                        help="server-readable pano image path")
+    parser.add_argument("--synthetic", type=str, default="",
+                        help="HxW: generate random images, send inline b64")
+    parser.add_argument("--deadline_ms", type=float, default=0.0,
+                        help="per-request deadline (0 = server default)")
+    parser.add_argument("--max_matches", type=int, default=16)
+    parser.add_argument("--no_retry", action="store_true",
+                        help="count 503s as rejected instead of retrying")
+    args = parser.parse_args(argv)
+    if bool(args.synthetic) == bool(args.query and args.pano):
+        parser.error("pass either --synthetic HxW or both --query/--pano")
+
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from ncnet_tpu.serving.client import (
+        MatchClient,
+        OverCapacityError,
+        ServingError,
+    )
+
+    kwargs = {"max_matches": args.max_matches}
+    if args.deadline_ms > 0:
+        kwargs["deadline_ms"] = args.deadline_ms
+    if args.synthetic:
+        q_bytes, p_bytes = synth_jpegs(args.synthetic)
+        kwargs.update(query_bytes=q_bytes, pano_bytes=p_bytes)
+    else:
+        kwargs.update(query_path=args.query, pano_path=args.pano)
+
+    client = MatchClient(args.url, retries=0 if args.no_retry else 2)
+    health = client.healthz()
+    note(f"healthz: {health}")
+
+    n_requests = max(1, int(args.rate * args.duration_s))
+    lock = threading.Lock()
+    lat_ms, batch_sizes = [], []
+    counts = {"sent": 0, "ok": 0, "rejected": 0, "errors": 0}
+    # Open loop: request i fires at t0 + i/rate regardless of completions.
+    # A schedule index handed out under the lock keeps workers from
+    # coordinating on anything but the wall clock.
+    sched = {"next": 0}
+    t0 = time.monotonic()
+
+    def worker():
+        while True:
+            with lock:
+                i = sched["next"]
+                if i >= n_requests:
+                    return
+                sched["next"] = i + 1
+            due = t0 + i / args.rate
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t_req = time.monotonic()
+            try:
+                resp = client.match(**kwargs)
+            except OverCapacityError:
+                with lock:
+                    counts["sent"] += 1
+                    counts["rejected"] += 1
+                continue
+            except (ServingError, OSError) as exc:
+                with lock:
+                    counts["sent"] += 1
+                    counts["errors"] += 1
+                note(f"error on req {i}: {exc}")
+                continue
+            dt_ms = (time.monotonic() - t_req) * 1e3
+            with lock:
+                counts["sent"] += 1
+                counts["ok"] += 1
+                lat_ms.append(dt_ms)
+                batch_sizes.append(resp.get("batch_size", 1))
+
+    threads = [
+        threading.Thread(target=worker, daemon=True)
+        for _ in range(min(args.threads, n_requests))
+    ]
+    note(f"load: {n_requests} requests at {args.rate}/s open-loop, "
+         f"{len(threads)} workers")
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+
+    lat_ms.sort()
+    batched = sum(1 for b in batch_sizes if b > 1)
+    rec = {
+        "metric": "serving_match_throughput_rps",
+        "value": round(counts["ok"] / elapsed, 4) if elapsed > 0 else 0.0,
+        "unit": "req/s",
+        "latency_ms": {
+            "p50": round(percentile(lat_ms, 50), 3) if lat_ms else None,
+            "p95": round(percentile(lat_ms, 95), 3) if lat_ms else None,
+            "p99": round(percentile(lat_ms, 99), 3) if lat_ms else None,
+        },
+        "sent": counts["sent"],
+        "ok": counts["ok"],
+        "rejected": counts["rejected"],
+        "errors": counts["errors"],
+        "batched_frac": round(batched / len(batch_sizes), 4)
+        if batch_sizes else 0.0,
+        "mean_batch_size": round(sum(batch_sizes) / len(batch_sizes), 3)
+        if batch_sizes else None,
+        "duration_s": round(elapsed, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    return 0 if counts["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
