@@ -489,6 +489,12 @@ def main():
                 else:
                     shutil.rmtree(tdir, ignore_errors=True)
 
+    # The consensus plan the measured program actually traced (recorded
+    # by neigh_consensus_apply at trace time): makes BENCH_r0*.json
+    # trajectories attributable to plan changes — fused? strategies?
+    # fold? autotune cache hit? — not just code drift.
+    from ncnet_tpu.ops import consensus_last_plan
+
     headline = {
         "metric": "inloc_dense_match_pairs_per_s_per_chip"
         + ("" if on_tpu else "_cpu_smoke"),
@@ -498,6 +504,7 @@ def main():
         "fused": fused_ran,
         "path": name,
         "util": util,
+        "consensus_plan": consensus_last_plan(),
     }
     if run_log is not None:
         # The same dict BENCH_r*.json archives, queryable from the run

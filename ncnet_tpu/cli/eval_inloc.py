@@ -350,6 +350,27 @@ def main(argv=None):
     obs.event("config", experiment=experiment, out_dir=out_dir,
               feat_units=list(units))
 
+    # Consult the persistent consensus strategy cache (ops/autotune.py)
+    # for the representative shape bucket, and say up front whether this
+    # eval runs a tuned plan or the static heuristic — the same consult
+    # neigh_consensus_apply makes at trace time, surfaced before the
+    # first multi-minute compile instead of buried inside it.
+    from ..ops import autotune as _autotune
+
+    k = max(args.k_size, 1)
+    fh, fw = example_h // 16 // k, example_w // 16 // k
+    example_corr = (1, 1, fh, fw, fh, fw)
+    tuned = _autotune.lookup_plan(
+        example_corr, config.corr_dtype, params["neigh_consensus"],
+        symmetric=config.symmetric_mode, full=True,
+    )
+    obs.event("autotune", action="consult", where="eval_inloc",
+              corr_shape=list(example_corr),
+              cache_hit=tuned is not None,
+              ms=tuned.get("ms") if tuned else None,
+              plan=tuned.get("plan") if tuned else None,
+              cache_path=_autotune.cache_path())
+
     dbmat = loadmat(args.inloc_shortlist)
     db = dbmat["ImgList"][0, :]
     pano_fn_all = np.vstack([db[q][1] for q in range(len(db))])

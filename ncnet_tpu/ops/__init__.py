@@ -2,6 +2,7 @@
 
 from .correlation import feature_correlation, feature_correlation_3d, feature_l2norm
 from .conv4d import (
+    consensus_last_plan,
     conv4d,
     conv4d_reference,
     neigh_consensus_apply,
@@ -19,6 +20,7 @@ __all__ = [
     "feature_correlation",
     "feature_correlation_3d",
     "feature_l2norm",
+    "consensus_last_plan",
     "conv4d",
     "conv4d_reference",
     "neigh_consensus_apply",

@@ -1,0 +1,414 @@
+"""Persistent on-device autotuner for the consensus Conv4d stack.
+
+The conv4d strategy zoo (per-layer conv2d_stacked/outstacked/convnd
+mixes, symmetric branch fusion, KL space-to-depth folding, I-chunking)
+got the consensus stage from 502 ms/10-pair block hand-tuned via env
+vars and offline A/B sessions (docs/NEXT.md, docs/tpu_r0*/). This module
+converts that session-log folklore into executable, cached decisions:
+
+  * `enumerate_plans` is the single home for the LEGAL candidate space —
+    the bench tools (tools/bench_consensus.py, tools/bench_strategies_ab
+    .py) and the tuner CLI (tools/autotune_consensus.py) all draw from
+    it, so a new knob propagates everywhere at once.
+  * `autotune` times each candidate with compiled-call medians on the
+    live backend (chain_reps to amortize the tunneled-backend RTT floor,
+    exactly like the bench tools) and persists the winner to a JSON
+    cache keyed by (backend kind, shape signature).
+  * `lookup_plan` is consulted by `neigh_consensus_apply` at TRACE time,
+    before its static heuristics: a populated cache changes the traced
+    plan with no env vars set. Explicit `strategies=`/env knobs still
+    win PER KNOB, and a missing/corrupt/stale cache degrades silently to
+    the heuristics (with a warning `autotune` obs event, never an
+    exception — a bad cache file must not take down serving).
+
+Cache file format (version 1)::
+
+    {"version": 1,
+     "entries": {
+       "<backend kind>": {
+         "<shape signature>": {
+            "plan": {"strategies": [...]|null, "branch_fuse": bool,
+                     "kl_fold": int, "chunk_i": int},
+            "ms": float,            # measured steady ms per apply
+            "tuned_at": str,        # ISO stamp, informational
+            "candidates": int}}}}
+
+Default location: `trained_models/consensus_autotune.json` (repo-root
+anchored so serving/CLI/bench agree regardless of cwd). Override with
+NCNET_STRATEGY_CACHE=<path>; set it to the empty string to disable all
+cache reads/writes (the tuner does exactly that around its own
+measurements so candidates don't consult the plan being tuned).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import zlib
+
+from .. import obs
+
+CACHE_VERSION = 1
+CACHE_BASENAME = "consensus_autotune.json"
+
+# Env keys a plan can materialize into (tools strip ALL of these between
+# A/B runs so combos never leak between lines).
+PLAN_ENV_KEYS = (
+    "NCNET_CONSENSUS_STRATEGIES",
+    "NCNET_CONSENSUS_BRANCH_FUSE",
+    "NCNET_CONSENSUS_KL_FOLD",
+    "NCNET_CONSENSUS_CHUNK_I",
+)
+
+# The channels-last strategies the one-shot fast path expresses; the
+# enumeration's per-layer mixes draw from these (convnd/conv3d mixes
+# lost every sweep they entered — docs/NEXT.md — and explicit mixes of
+# these two span the space the TPU sessions actually explored).
+CL_STRATEGIES = ("conv2d_stacked", "conv2d_outstacked")
+
+_KNOWN_STRATEGIES = (
+    "conv2d", "conv3d", "conv2d_stacked", "conv2d_outstacked", "convnd",
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# (path, mtime, size) -> parsed cache dict; lookup_plan runs at trace
+# time (serving warmup traces per shape bucket), so the JSON parse must
+# not repeat per trace.
+_CACHE_MEMO: dict = {}
+
+
+def cache_path():
+    """Resolved cache file path, or None when disabled.
+
+    NCNET_STRATEGY_CACHE: unset -> repo default; empty string ->
+    disabled; anything else -> that path.
+    """
+    env = os.environ.get("NCNET_STRATEGY_CACHE")
+    if env is not None:
+        return env or None
+    return os.path.join(_REPO, "trained_models", CACHE_BASENAME)
+
+
+def backend_kind() -> str:
+    """Cache key axis 1: platform + device kind (plans tuned on a v5e
+    must not steer a v4 or the CPU tests)."""
+    import jax
+
+    backend = jax.default_backend()
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:  # pragma: no cover — backend with no devices
+        kind = "unknown"
+    return f"{backend}:{kind}"
+
+
+def shape_signature(corr_shape, dtype, params, symmetric: bool) -> str:
+    """Cache key axis 2: everything the legal plan space depends on."""
+    kernels = "/".join(
+        "x".join(str(d) for d in l["weight"].shape[:4]) for l in params
+    )
+    chans = "/".join(str(l["weight"].shape[5]) for l in params)
+    shape = "x".join(str(d) for d in corr_shape)
+    import numpy as np
+
+    return (f"corr{shape}|{np.dtype(dtype).name}|k{kernels}|c{chans}"
+            f"|sym{int(bool(symmetric))}")
+
+
+def normalize_plan(plan: dict) -> dict:
+    """Fill knob defaults and canonicalize types (dedupe/cache key)."""
+    s = plan.get("strategies")
+    return {
+        "strategies": list(s) if s else None,
+        "branch_fuse": bool(plan.get("branch_fuse", True)),
+        "kl_fold": int(plan.get("kl_fold") or 0),
+        "chunk_i": int(plan.get("chunk_i") or 0),
+    }
+
+
+def plan_key(plan: dict) -> str:
+    return json.dumps(normalize_plan(plan), sort_keys=True)
+
+
+def plan_label(plan: dict) -> str:
+    """Short human label for bench lines / obs events."""
+    p = normalize_plan(plan)
+    s = ",".join(x or "auto" for x in p["strategies"]) \
+        if p["strategies"] else "auto"
+    bits = [s, "fused" if p["branch_fuse"] else "unfused"]
+    if p["kl_fold"] > 1:
+        bits.append(f"fold{p['kl_fold']}")
+    if p["chunk_i"]:
+        bits.append(f"chunk{p['chunk_i']}")
+    return "+".join(bits)
+
+
+def plan_env(plan: dict) -> dict:
+    """The env-var materialization of a plan (trace-time knobs).
+
+    The single home the bench tools share: strategies key present only
+    when the plan pins them (absent == heuristic 'auto'), the other
+    knobs always explicit so a previous line's setting can't bleed
+    through a driver that forgot to strip (they strip PLAN_ENV_KEYS
+    anyway).
+    """
+    p = normalize_plan(plan)
+    env = {
+        "NCNET_CONSENSUS_BRANCH_FUSE": "1" if p["branch_fuse"] else "0",
+        "NCNET_CONSENSUS_KL_FOLD": str(p["kl_fold"]),
+        "NCNET_CONSENSUS_CHUNK_I": str(p["chunk_i"]),
+    }
+    if p["strategies"]:
+        env["NCNET_CONSENSUS_STRATEGIES"] = ",".join(
+            x or "" for x in p["strategies"]
+        )
+    return env
+
+
+def enumerate_plans(params, *, symmetric: bool = True,
+                    kl_folds=(0, 2, 4), chunks=(0,)):
+    """The legal candidate space for (params, symmetric).
+
+    Pruning rules (each is a hard constraint of neigh_consensus_apply,
+    not a taste choice):
+      * kl_fold > 1 requires the one-shot path (chunking raises).
+      * kl_fold > 1 is paired only with explicit per-layer mixes: under
+        'auto' the folded f^2-times-wider channels resolve convnd, the
+        formulation folding exists to escape.
+      * branch fusion exists only for the symmetric one-shot path;
+        chunked candidates are emitted unfused only (the knob is inert
+        there — two labels for one program would skew a sweep's stats).
+    """
+    n = len(params)
+    mixes = [None] + [list(c) for c in
+                      itertools.product(CL_STRATEGIES, repeat=n)]
+    plans, seen = [], set()
+    for mix, fold, chunk in itertools.product(mixes, kl_folds, chunks):
+        if fold > 1 and (chunk or mix is None):
+            continue
+        fuses = (True, False) if (symmetric and not chunk) else (False,)
+        for fuse in fuses:
+            plan = normalize_plan({
+                "strategies": mix, "branch_fuse": fuse,
+                "kl_fold": fold, "chunk_i": chunk,
+            })
+            key = plan_key(plan)
+            if key not in seen:
+                seen.add(key)
+                plans.append(plan)
+    return plans
+
+
+def _valid_plan(plan, params) -> bool:
+    if not isinstance(plan, dict):
+        return False
+    s = plan.get("strategies")
+    if s is not None:
+        if (not isinstance(s, (list, tuple)) or len(s) != len(params)
+                or any(x is not None and x not in _KNOWN_STRATEGIES
+                       for x in s)):
+            return False
+    try:
+        int(plan.get("kl_fold") or 0)
+        int(plan.get("chunk_i") or 0)
+    except (TypeError, ValueError):
+        return False
+    return True
+
+
+def _read_cache(path):
+    """Parse the cache file; None when missing/corrupt (with a warning
+    event on corruption — a bad file must degrade to the heuristics,
+    never raise into a trace)."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    memo_key = (path, st.st_mtime_ns, st.st_size)
+    if memo_key in _CACHE_MEMO:
+        return _CACHE_MEMO[memo_key]
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if (not isinstance(data, dict)
+                or data.get("version") != CACHE_VERSION
+                or not isinstance(data.get("entries"), dict)):
+            raise ValueError(f"unrecognized cache structure/version "
+                             f"{data.get('version')!r}"
+                             if isinstance(data, dict) else
+                             "cache root is not an object")
+    except (OSError, ValueError) as exc:
+        obs.event("autotune", action="cache_corrupt", path=path,
+                  error=str(exc))
+        data = None
+    _CACHE_MEMO.clear()  # one live file; don't accrue stale mtimes
+    _CACHE_MEMO[memo_key] = data
+    return data
+
+
+def lookup_plan(corr_shape, dtype, params, *, symmetric: bool = True,
+                full: bool = False):
+    """Trace-time cache consult: the tuned plan for this (backend,
+    shape signature), or None.
+
+    Defensive by contract: returns None on ANY problem (missing file,
+    corrupt JSON, stale entry whose strategies no longer validate
+    against `params`) after a warning `autotune` event. `full=True`
+    returns the whole cache record (plan + measured ms) for callers
+    that report, e.g. serving warmup's obs event.
+    """
+    path = cache_path()
+    if not path:
+        return None
+    data = _read_cache(path)
+    if not data:
+        return None
+    try:
+        kind = backend_kind()
+        sig = shape_signature(corr_shape, dtype, params, symmetric)
+        rec = data["entries"].get(kind, {}).get(sig)
+    except Exception as exc:  # pragma: no cover — defensive only
+        obs.event("autotune", action="cache_error", path=path,
+                  error=str(exc))
+        return None
+    if not isinstance(rec, dict) or not _valid_plan(rec.get("plan"),
+                                                    params):
+        if rec is not None:
+            obs.event("autotune", action="cache_stale", path=path,
+                      sig=sig, entry=rec)
+        return None
+    return rec if full else normalize_plan(rec["plan"])
+
+
+def save_plan(corr_shape, dtype, params, plan, ms, *,
+              symmetric: bool = True, candidates: int = 0, path=None):
+    """Persist a tuned winner (read-modify-write, rename-aside so a
+    kill mid-write never leaves a truncated file). Returns the path, or
+    None when the cache is disabled."""
+    import datetime
+
+    path = path or cache_path()
+    if not path:
+        return None
+    data = _read_cache(path) or {"version": CACHE_VERSION, "entries": {}}
+    kind = backend_kind()
+    sig = shape_signature(corr_shape, dtype, params, symmetric)
+    data["entries"].setdefault(kind, {})[sig] = {
+        "plan": normalize_plan(plan),
+        "ms": float(ms),
+        "tuned_at": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "candidates": int(candidates),
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    _CACHE_MEMO.clear()
+    return path
+
+
+@contextlib.contextmanager
+def plan_overrides(plan: dict):
+    """Materialize a plan into the trace-time env, with the strategy
+    cache DISABLED (a candidate must not consult the very plan being
+    tuned), restoring everything on exit."""
+    keys = PLAN_ENV_KEYS + ("NCNET_STRATEGY_CACHE",)
+    saved = {k: os.environ.get(k) for k in keys}
+    try:
+        for k in PLAN_ENV_KEYS:
+            os.environ.pop(k, None)
+        os.environ.update(plan_env(plan))
+        os.environ["NCNET_STRATEGY_CACHE"] = ""
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def fake_timer(params, corr, symmetric, plan, *, reps=0, iters=0):
+    """Deterministic no-device stand-in timer (CRC of the plan label):
+    the CLI's NCNET_AUTOTUNE_FAKE_TIMER=1 mode and the unit tests use it
+    to exercise winner selection / cache round-trips without compiling
+    dozens of candidates."""
+    label = plan_label(plan)
+    ms = 1.0 + (zlib.crc32(label.encode()) % 10_000) / 100.0
+    return 0.0, ms
+
+
+def device_timer(params, corr, symmetric, plan, *, reps=4, iters=3):
+    """Measure one candidate on the live backend: `reps` applies chained
+    inside ONE jit (lax.scan — amortizes the tunneled-backend RTT floor,
+    defeats DCE; see utils.profiling.chain_reps), timed over `iters`
+    steady repetitions. Returns (compile_s, steady ms per apply)."""
+    from ..utils.profiling import chain_reps, timed_steady
+    from .conv4d import neigh_consensus_apply
+
+    with plan_overrides(plan):
+        fn = chain_reps(
+            lambda c: neigh_consensus_apply(params, c,
+                                            symmetric=symmetric),
+            reps,
+        )
+        first_s, steady_s, _ = timed_steady(fn, corr, iters=iters)
+    return first_s, steady_s / max(reps, 1) * 1000.0
+
+
+def autotune(params, corr, *, symmetric: bool = True, plans=None,
+             reps: int = 4, iters: int = 3, timer=None, save: bool = True,
+             log=None):
+    """Time every candidate plan and persist the winner.
+
+    Returns (best_plan, best_ms, results) where results is the full
+    [(plan, ms)] list (ms == None for candidates that failed to
+    compile/run — a candidate failure is logged and skipped, never
+    fatal). `timer` is injectable for tests: a callable with
+    device_timer's signature.
+    """
+    timer = timer or device_timer
+    if plans is None:
+        plans = enumerate_plans(params, symmetric=symmetric)
+    results = []
+    best = None
+    for plan in plans:
+        label = plan_label(plan)
+        try:
+            first_s, ms = timer(params, corr, symmetric, plan,
+                                reps=reps, iters=iters)
+        except Exception as exc:  # noqa: BLE001 — candidate fence
+            obs.event("autotune", action="candidate_failed", plan=plan,
+                      label=label, error=f"{type(exc).__name__}: {exc}")
+            if log:
+                log(f"autotune[{label}] FAILED: "
+                    f"{type(exc).__name__}: {exc}")
+            results.append((plan, None))
+            continue
+        obs.event("autotune", action="measured", plan=plan, label=label,
+                  ms=ms, compile_s=first_s)
+        if log:
+            log(f"autotune[{label}] {ms:.3f} ms "
+                f"(compile {first_s:.1f}s)")
+        results.append((plan, ms))
+        if best is None or ms < best[1]:
+            best = (plan, ms)
+    if best is None:
+        raise RuntimeError("autotune: every candidate failed")
+    plan, ms = best
+    saved_path = None
+    if save:
+        saved_path = save_plan(corr.shape, corr.dtype, params, plan, ms,
+                               symmetric=symmetric,
+                               candidates=len(plans))
+    obs.event("autotune", action="winner", plan=plan,
+              label=plan_label(plan), ms=ms, candidates=len(plans),
+              cache_path=saved_path)
+    return plan, ms, results

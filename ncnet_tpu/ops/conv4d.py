@@ -24,10 +24,12 @@ size exactly as the reference does (lib/conv4d.py:26-36).
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax import lax
 
 # Default decomposition; override with NCNET_CONV4D_STRATEGY
@@ -38,6 +40,21 @@ from jax import lax
 # The env var is read at CALL (trace) time, so setting it after import
 # works; already-compiled jits keep the strategy they were traced with.
 _DEFAULT_STRATEGY = "auto"
+
+# Trace-time record of the plan the LAST neigh_consensus_apply call
+# resolved (strategies, fusion, fold, chunk, and where each knob came
+# from: arg | env | cache | auto). Introspection only — bench.py reports
+# it in the headline payload and the autotuner tests assert on it; it
+# carries no numerics. None until the first call.
+LAST_PLAN: dict | None = None
+
+
+def consensus_last_plan():
+    """Accessor for LAST_PLAN: the ops package re-exports a conv4d
+    FUNCTION that shadows this module's attribute path, so callers
+    outside the package (bench.py, tests) read the global through this
+    instead of an importlib dance."""
+    return LAST_PLAN
 
 
 def conv4d_prepadded(x, weight, bias=None, *, strategy: str | None = None):
@@ -417,6 +434,31 @@ def zero_fold_pad_kl(x, f: int, orig_kl):
     return jnp.where(mask, xr, 0).reshape(x.shape)
 
 
+def _zero_fold_pad_cl(x, f: int, orig_kl, c: int):
+    """zero_fold_pad_kl's CHANNELS-LAST twin for the fused folded stack.
+
+    x: [b, I, J, K', L', C] with C = nb * f*f * c, channels branch-major
+    then phase-major ((pk*f + pl)*c + co per branch — fold_kl's order).
+    `c` is the per-phase channel count (the layer's original cout). No-op
+    when K and L divide f.
+    """
+    sk, sl = orig_kl
+    b_, si_, sj_, skf, slf, cf = x.shape
+    if skf * f == sk and slf * f == sl:
+        return x
+    nb = cf // (f * f * c)
+    k_ok = (
+        jnp.arange(skf)[:, None] * f + jnp.arange(f)[None, :] < sk
+    )  # [K', pk]
+    l_ok = jnp.arange(slf)[:, None] * f + jnp.arange(f)[None, :] < sl
+    xr = x.reshape(b_, si_, sj_, skf, slf, nb, f, f, c)
+    mask = (
+        k_ok[None, None, None, :, None, None, :, None, None]
+        & l_ok[None, None, None, None, :, None, None, :, None]
+    )
+    return jnp.where(mask, xr, 0).reshape(x.shape)
+
+
 def unfold_kl(x, f: int, orig_kl):
     """Inverse of fold_kl (slices off the right-pad phases)."""
     sk, sl = orig_kl
@@ -444,18 +486,35 @@ def fold_weight_kl(weight, f: int):
     map is a CONSTANT one-hot tensor built with numpy at trace time, so
     the whole fold is one einsum in the jaxpr (per-entry .at[].set
     scatters would add f^2*k^2 dynamic-update-slices per layer per
-    branch to the remote-compiled program).
+    branch to the remote-compiled program). Memoized per (kernel dims,
+    f, dtype): serving warmup re-traces the stack per shape bucket, and
+    the autotuner traces it per candidate plan — the nested Python loop
+    should run once per distinct kernel, not once per trace.
     """
-    import numpy as _np
-
     ki, kj, kk, kl, cin, cout = weight.shape
+    place = _fold_place_kl(kk, kl, f, _np.dtype(weight.dtype).name)
     rk, rl = kk // 2, kl // 2
     off_k, off_l = -(-rk // f), -(-rl // f)
     tkk, tkl = 2 * off_k + 1, 2 * off_l + 1
     ff = f * f
-    # place[dk, dl, pout, tk, tl, pin] = 1 where original tap (dk, dl)
-    # feeds output phase pout from folded tap (tk, tl) at input phase pin.
-    place = _np.zeros((kk, kl, ff, tkk, tkl, ff), weight.dtype)
+    wf = jnp.einsum(
+        "ijklco,klptuq->ijtuqcpo", weight, jnp.asarray(place)
+    )
+    return wf.reshape(ki, kj, tkk, tkl, ff * cin, ff * cout)
+
+
+@functools.lru_cache(maxsize=64)
+def _fold_place_kl(kk: int, kl: int, f: int, dtype_name: str):
+    """One-hot placement constant for fold_weight_kl (memoized).
+
+    place[dk, dl, pout, tk, tl, pin] = 1 where original tap (dk, dl)
+    feeds output phase pout from folded tap (tk, tl) at input phase pin.
+    """
+    rk, rl = kk // 2, kl // 2
+    off_k, off_l = -(-rk // f), -(-rl // f)
+    tkk, tkl = 2 * off_k + 1, 2 * off_l + 1
+    ff = f * f
+    place = _np.zeros((kk, kl, ff, tkk, tkl, ff), dtype_name)
     for pko in range(f):
         for plo in range(f):
             pout = pko * f + plo
@@ -466,10 +525,8 @@ def fold_weight_kl(weight, f: int):
                     pin = (ak % f) * f + (al % f)
                     place[dk, dl, pout, ak // f + off_k, al // f + off_l,
                           pin] = 1
-    wf = jnp.einsum(
-        "ijklco,klptuq->ijtuqcpo", weight, jnp.asarray(place)
-    )
-    return wf.reshape(ki, kj, tkk, tkl, ff * cin, ff * cout)
+    place.setflags(write=False)
+    return place
 
 
 # Chunked-consensus auto-trigger: chunk when the largest interlayer
@@ -530,7 +587,8 @@ def _auto_pick(ki, kj, cin, cout):
     return "convnd"
 
 
-def _consensus_oneshot_cl(params, corr, symmetric, strategies):
+def _consensus_oneshot_cl(params, corr, symmetric, strategies,
+                          kl_fold: int = 0, branch_fuse: bool = False):
     """One-shot consensus stack in CHANNELS-LAST layout end to end.
 
     The 2026-07-31 device trace showed ~25 ms/step of pure layout copies
@@ -552,27 +610,52 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies):
     accumulation policy (the conv bodies below are the channels-last
     twins of conv4d_prepadded's — a dtype/policy change in either file
     location must be mirrored, enforced by the CL parity test).
+
+    branch_fuse (callers set it only when `symmetric` and both branches
+    resolved to the SAME stacked/outstacked strategy list): fold the
+    forward and A<->B-swapped branches into ONE conv per layer instead
+    of two. Layer 1 shares its whole input, so the branches' weights
+    concatenate on OUTPUT channels (cout -> 2*cout); every later layer
+    is a grouped conv (feature_group_count=2) so each branch's channels
+    stay separate through the elementwise ReLUs; the final two halves
+    sum — the same convs with the same per-group contraction and the
+    same f32 accumulation policy, at half the conv dispatches, one
+    shared input read, and 2x the lane occupancy of the 1/9/16-channel
+    tensors. Channels stay BRANCH-major throughout (group g = branch g).
+
+    kl_fold > 1 (fused path only): run the whole stack in fold_kl's
+    space-to-depth layout. Per layer the (possibly swapped) kernel folds
+    FIRST via fold_weight_kl, then branch-stacks — the symmetric
+    identity lives in the unfolded axes. Entry/exit pay one fold/unfold
+    transpose pair (the folded cin0 = f^2 is no longer a free reshape),
+    same as the channels-first folded path they replace.
     """
     b, cin0, si, sj, sk, sl = corr.shape
+    orig_kl = None
+    if kl_fold > 1:
+        corr, orig_kl = fold_kl(corr, kl_fold)
+        b, cin0, si, sj, sk, sl = corr.shape
     x0 = jnp.transpose(corr, (0, 2, 3, 4, 5, 1))  # free at cin0 == 1
 
-    def layer_cl(x, w, bias, strat):
+    # Bias + ReLU live INSIDE the checkpointed bodies: the round-2
+    # trace showed the epilogue as its own fusion doing a full
+    # read+write round trip over the 16-channel tensor (~12 ms/step
+    # at InLoc shape) — inside the body it can fuse into the conv's
+    # (or the accumulation's) output epilogue. Dtype sequence is
+    # unchanged per strategy (stacked: storage-dtype add; outstacked:
+    # f32 add; one final cast), so numerics are bit-identical to the
+    # former shared tail.
+    def finish(y_, b_, in_dtype):
+        if b_ is not None:
+            y_ = y_ + b_.astype(y_.dtype)
+        return jax.nn.relu(y_).astype(in_dtype)
+
+    def layer_cl(x, w, bias, strat, groups: int = 1):
+        if groups == 2:
+            return layer_cl_grouped(x, w, bias, strat)
         ki, kj, kk, kl, cin, cout = w.shape
         pi, pj = ki // 2, kj // 2
         wd = w.astype(x.dtype)
-        # Bias + ReLU live INSIDE the checkpointed bodies: the round-2
-        # trace showed the epilogue as its own fusion doing a full
-        # read+write round trip over the 16-channel tensor (~12 ms/step
-        # at InLoc shape) — inside the body it can fuse into the conv's
-        # (or the accumulation's) output epilogue. Dtype sequence is
-        # unchanged per strategy (stacked: storage-dtype add; outstacked:
-        # f32 add; one final cast), so numerics are bit-identical to the
-        # former shared tail.
-        def finish(y_, b_, in_dtype):
-            if b_ is not None:
-                y_ = y_ + b_.astype(y_.dtype)
-            return jax.nn.relu(y_).astype(in_dtype)
-
         if strat == "conv2d_stacked":
             def body(x_, w_, b_):
                 xp = jnp.pad(
@@ -655,6 +738,107 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies):
             f"channels-last path lacks {strat!r}"
         )
 
+    def layer_cl_grouped(x, w_pair, bias, strat):
+        """Branch-fused interior layer: ONE grouped conv, group g =
+        symmetric branch g. `w_pair` is (forward, swapped) per-branch
+        kernels [ki,kj,kk,kl,cin_h,cout_h]; x carries 2*cin_h channels
+        BRANCH-major; bias is the fused [2*cout_h]. Each group's
+        contraction is exactly the unfused branch's conv (same taps,
+        same preferred_element_type), so numerics are unchanged."""
+        w0, w1 = w_pair
+        ki, kj, kk, kl, cin_h, cout_h = w0.shape
+        pi, pj = ki // 2, kj // 2
+        wd0, wd1 = w0.astype(x.dtype), w1.astype(x.dtype)
+        if strat == "conv2d_stacked":
+            def body(x_, w0_, w1_, b_):
+                xp = jnp.pad(
+                    x_,
+                    ((0, 0), (pi, pi), (pj, pj), (0, 0), (0, 0), (0, 0)),
+                )
+                slabs = [
+                    lax.slice_in_dim(
+                        lax.slice_in_dim(xp, di, di + si, axis=1),
+                        dj, dj + sj, axis=2,
+                    )
+                    for di in range(ki)
+                    for dj in range(kj)
+                ]
+                # Grouped conv needs group-contiguous input channels:
+                # branch-major over ALL offsets (each branch's ki*kj*
+                # cin_h block together), not fold-major per slab.
+                stacked = jnp.concatenate(
+                    [s[..., :cin_h] for s in slabs]
+                    + [s[..., cin_h:] for s in slabs],
+                    axis=5,
+                ).reshape(b * si * sj, sk, sl, 2 * ki * kj * cin_h)
+
+                def wstack(w_):
+                    return jnp.moveaxis(
+                        w_.reshape(ki * kj, kk, kl, cin_h, cout_h), 0, 2
+                    ).reshape(kk, kl, ki * kj * cin_h, cout_h)
+
+                wg = jnp.concatenate([wstack(w0_), wstack(w1_)], axis=3)
+                y = lax.conv_general_dilated(
+                    stacked,
+                    wg,
+                    window_strides=(1, 1),
+                    padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    feature_group_count=2,
+                    preferred_element_type=x_.dtype,
+                )
+                return finish(
+                    y.reshape(b, si, sj, sk, sl, 2 * cout_h), b_, x_.dtype
+                )
+
+            return jax.checkpoint(body)(x, wd0, wd1, bias)
+        elif strat == "conv2d_outstacked":
+            def body(x_, w0_, w1_, b_):
+                xs = x_.reshape(b * si * sj, sk, sl, 2 * cin_h)
+
+                def wout(w_):
+                    return jnp.transpose(w_, (2, 3, 4, 0, 1, 5)).reshape(
+                        kk, kl, cin_h, ki * kj * cout_h
+                    )
+
+                wg = jnp.concatenate([wout(w0_), wout(w1_)], axis=3)
+                yy = lax.conv_general_dilated(
+                    xs,
+                    wg,
+                    window_strides=(1, 1),
+                    padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    feature_group_count=2,
+                    preferred_element_type=x_.dtype,
+                ).reshape(b, si, sj, sk, sl, 2, ki * kj, cout_h)
+                acc = None
+                for di in range(ki):
+                    for dj in range(kj):
+                        oi = di - pi
+                        oj = dj - pj
+                        i_in = slice(max(0, oi), si + min(0, oi))
+                        j_in = slice(max(0, oj), sj + min(0, oj))
+                        ys = yy[
+                            :, i_in, j_in, :, :, :, di * kj + dj
+                        ].astype(jnp.float32)
+                        term = jnp.pad(
+                            ys,
+                            ((0, 0),
+                             (max(0, -oi), max(0, oi)),
+                             (max(0, -oj), max(0, oj)),
+                             (0, 0), (0, 0), (0, 0), (0, 0)),
+                        )
+                        acc = term if acc is None else acc + term
+                return finish(
+                    acc.reshape(b, si, sj, sk, sl, 2 * cout_h), b_,
+                    x_.dtype,
+                )
+
+            return jax.checkpoint(body)(x, wd0, wd1, bias)
+        raise ValueError(  # pragma: no cover — guarded by the caller
+            f"channels-last fused path lacks {strat!r}"
+        )
+
     fwd_strategies, swap_strategies = strategies
 
     # A layer-1 Pallas kernel (one MXU dot over all 81 4-D taps per
@@ -677,10 +861,54 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies):
             x = layer_cl(x, w, layer["bias"], strats[li])
         return x
 
-    out = stack(x0, False)
-    if symmetric:
-        out = out + stack(x0, True)
-    return jnp.transpose(out, (0, 5, 1, 2, 3, 4))  # free at cout == 1
+    def fused_stack(x):
+        # Caller guarantees fwd_strategies == swap_strategies here.
+        nl = len(params)
+        for li, layer in enumerate(params):
+            w = layer["weight"]
+            ws = swap_ab_weight(layer["weight"])
+            bias = layer["bias"]
+            if kl_fold > 1:
+                # Swap-then-fold: the symmetric identity lives in the
+                # unfolded axes, so each branch folds its own kernel;
+                # the branch-stack happens AFTER the fold.
+                w = fold_weight_kl(w, kl_fold)
+                ws = fold_weight_kl(ws, kl_fold)
+                bias = jnp.tile(bias, kl_fold * kl_fold)
+            b2 = jnp.concatenate([bias, bias])
+            if li == 0:
+                # The stack input is SHARED between branches (cin0 = 1,
+                # or f^2 folded phases of it): one conv with the
+                # branches' weights concatenated on output channels —
+                # per output channel the contraction is the unfused
+                # branch's, unchanged.
+                x = layer_cl(
+                    x, jnp.concatenate([w, ws], axis=5), b2, fwd_strategies[li]
+                )
+            else:
+                x = layer_cl(x, (w, ws), b2, fwd_strategies[li], groups=2)
+            if kl_fold > 1 and li < nl - 1:
+                # Deeper layers must see zeros beyond the original K/L
+                # edge, not values computed in the fold's right-pad.
+                x = _zero_fold_pad_cl(
+                    x, kl_fold, orig_kl, layer["weight"].shape[5]
+                )
+        # The symmetric sum: the two branches' final channel halves, in
+        # the storage dtype — the same add the unfused path does between
+        # its two stack() results.
+        ch = x.shape[-1] // 2
+        return x[..., :ch] + x[..., ch:]
+
+    if branch_fuse:
+        out = fused_stack(x0)
+    else:
+        out = stack(x0, False)
+        if symmetric:
+            out = out + stack(x0, True)
+    out = jnp.transpose(out, (0, 5, 1, 2, 3, 4))  # free at cout == 1
+    if kl_fold > 1:
+        out = unfold_kl(out, kl_fold, orig_kl)
+    return out
 
 
 def neigh_consensus_apply(
@@ -723,10 +951,18 @@ def neigh_consensus_apply(
     Returns:
       [b, c_last, iA, jA, iB, jB].
     """
+    global LAST_PLAN
+    src = {
+        "strategies": "arg" if strategies is not None else None,
+        "chunk_i": "arg" if chunk_i is not None else None,
+        "kl_fold": None,
+        "branch_fuse": None,
+    }
     if strategies is None:
         env = os.environ.get("NCNET_CONSENSUS_STRATEGIES")
         if env:
             strategies = tuple(s.strip() or None for s in env.split(","))
+            src["strategies"] = "env"
     if strategies is not None:
         if isinstance(strategies, str) or len(strategies) != len(params):
             # Guard the migration from the single global strategy string: a
@@ -737,6 +973,52 @@ def neigh_consensus_apply(
                 f"({len(params)}), e.g. ('conv2d_stacked', 'conv3d'); got "
                 f"{strategies!r}"
             )
+    if chunk_i is None:
+        env = os.environ.get("NCNET_CONSENSUS_CHUNK_I")
+        if env is not None:
+            chunk_i = int(env)
+            src["chunk_i"] = "env"
+    env_fold = os.environ.get("NCNET_CONSENSUS_KL_FOLD")
+    kl_fold = int(env_fold or 0)
+    if env_fold is not None:
+        src["kl_fold"] = "env"
+    # Symmetric-branch fusion opt-out (A/B knob; default ON — the fused
+    # grouped path is the one-shot default whenever both branches resolve
+    # to stacked/outstacked).
+    env_fuse = os.environ.get("NCNET_CONSENSUS_BRANCH_FUSE")
+    branch_fuse = (env_fuse or "1") != "0"
+    if env_fuse is not None:
+        src["branch_fuse"] = "env"
+
+    # Persistent strategy cache (ops/autotune.py, read at trace time): a
+    # tuned plan recorded for this (backend kind, shape signature) fills
+    # every knob the caller/env left unset. Explicit strategies=/env vars
+    # still win PER KNOB, and a missing/corrupt/disabled cache falls
+    # through to the static heuristics below.
+    cache_hit = False
+    cache_ms = None
+    if any(v is None for v in src.values()):
+        from .autotune import lookup_plan  # lazy: autotune times this fn
+
+        rec = lookup_plan(corr.shape, corr.dtype, params,
+                          symmetric=symmetric, full=True)
+        plan = rec["plan"] if rec else None
+        if plan:
+            cache_hit = True
+            cache_ms = rec.get("ms")
+            if src["strategies"] is None and plan.get("strategies"):
+                strategies = tuple(plan["strategies"])
+                src["strategies"] = "cache"
+            if src["chunk_i"] is None and plan.get("chunk_i") is not None:
+                chunk_i = int(plan["chunk_i"])
+                src["chunk_i"] = "cache"
+            if src["kl_fold"] is None and plan.get("kl_fold") is not None:
+                kl_fold = int(plan["kl_fold"])
+                src["kl_fold"] = "cache"
+            if (src["branch_fuse"] is None
+                    and plan.get("branch_fuse") is not None):
+                branch_fuse = bool(plan["branch_fuse"])
+                src["branch_fuse"] = "cache"
     b, cin, si, sj, sk, sl = corr.shape
     # The swapped symmetric branch convolves I with each kernel's K-extent
     # (swap_ab_weight), so the carried halo must cover both branch's
@@ -746,10 +1028,6 @@ def neigh_consensus_apply(
         sum(l["weight"].shape[0] // 2 for l in params),
         sum(l["weight"].shape[2] // 2 for l in params),
     )
-    if chunk_i is None:
-        env = os.environ.get("NCNET_CONSENSUS_CHUNK_I")
-        if env is not None:
-            chunk_i = int(env)
     if chunk_i is None:
         max_c = max(
             max(l["weight"].shape[4], l["weight"].shape[5]) for l in params
@@ -761,13 +1039,13 @@ def neigh_consensus_apply(
             # for the halo rows too so the target is honored.
             chunk_i = max(1, _CHUNK_TARGET_ELEMS // per_row - 2 * halo)
 
-    # Space-to-depth experiment (NCNET_CONSENSUS_KL_FOLD=f, trace time):
-    # run the WHOLE one-shot stack in fold_kl's folded layout — channel
-    # counts f^2-fold larger (lane packing), kernels phase-mixed by
-    # fold_weight_kl, ReLU layout-independent, one fold/unfold pair total.
-    # Swap-then-fold: the symmetric identity is in the unfolded axes, so
-    # each layer folds its (possibly swapped) kernel individually.
-    kl_fold = int(os.environ.get("NCNET_CONSENSUS_KL_FOLD", "0") or 0)
+    # Space-to-depth (NCNET_CONSENSUS_KL_FOLD=f / cached plan, trace
+    # time): run the WHOLE one-shot stack in fold_kl's folded layout —
+    # channel counts f^2-fold larger (lane packing), kernels phase-mixed
+    # by fold_weight_kl, ReLU layout-independent, one fold/unfold pair
+    # total. Swap-then-fold: the symmetric identity is in the unfolded
+    # axes, so each layer folds its (possibly swapped) kernel
+    # individually.
     one_shot = not chunk_i or chunk_i >= si
     if kl_fold > 1 and not one_shot:
         # Silently measuring the unfolded chunked path under a 'fold' A/B
@@ -796,14 +1074,16 @@ def neigh_consensus_apply(
                 x = zero_fold_pad_kl(x, kl_fold, orig_kl)
         return x
 
+    sources = {k: (v or "auto") for k, v in src.items()}
     if one_shot:
         # Channels-last fast path (see _consensus_oneshot_cl): taken when
         # every layer resolves to a strategy it expresses and the stack
         # boundary channels are 1 (free entry/exit reshapes). Opt out for
-        # A/B with NCNET_CONSENSUS_CL=0.
+        # A/B with NCNET_CONSENSUS_CL=0. With kl_fold the CL path is
+        # entered only branch-FUSED (the unfused folded stack stays on
+        # the generic channels-first path below, unchanged).
         if (
-            kl_fold <= 1
-            and corr.shape[1] == 1
+            corr.shape[1] == 1
             and params[-1]["weight"].shape[5] == 1
             and os.environ.get("NCNET_CONSENSUS_CL", "1") == "1"
         ):
@@ -812,6 +1092,10 @@ def neigh_consensus_apply(
                 # swapped kernel exchanges IJ/KL extents, and a non-cubic
                 # kernel can land in a different arm (e.g. a 25-tap
                 # swapped IJ stencil belongs to convnd, not outstacked).
+                # Under kl_fold the folded kernel multiplies both channel
+                # counts by f^2 — the same shapes conv4d_prepadded's own
+                # 'auto' would see on the generic folded path.
+                ff = kl_fold * kl_fold if kl_fold > 1 else 1
                 out_s = []
                 for li, layer in enumerate(params):
                     s = strategies[li] if strategies else None
@@ -821,17 +1105,54 @@ def neigh_consensus_apply(
                         kiw, kjw, kkw, klw, ciw, cow = layer["weight"].shape
                         if swapped:
                             kiw, kjw = kkw, klw
-                        s = _auto_pick(kiw, kjw, ciw, cow)
+                        s = _auto_pick(kiw, kjw, ciw * ff, cow * ff)
                     out_s.append(s)
                 return out_s
 
             resolved = (resolve(False), resolve(True))
             needed = resolved[0] + (resolved[1] if symmetric else [])
-            if all(s in ("conv2d_stacked", "conv2d_outstacked")
-                   for s in needed):
+            # Fuse the symmetric branches only when they resolved to the
+            # SAME per-layer strategies (a non-cubic kernel legitimately
+            # diverging falls back to the two-branch path), every kernel
+            # is IJ/KL-shape-symmetric (the branches' kernels must share
+            # a shape to concat/group — (5,5,3,3) resolves stacked on
+            # BOTH branches at cin=1 yet its transpose is (3,3,5,5)),
+            # and the knob didn't opt out.
+            fuse = (branch_fuse and symmetric
+                    and resolved[0] == resolved[1]
+                    and all(l["weight"].shape[0:2] == l["weight"].shape[2:4]
+                            for l in params))
+            cl_ok = all(s in ("conv2d_stacked", "conv2d_outstacked")
+                        for s in needed)
+            if cl_ok and (kl_fold <= 1 or fuse):
+                LAST_PLAN = {
+                    "path": "cl_fused" if fuse else "cl",
+                    "strategies": list(resolved[0]),
+                    "strategies_swapped": list(resolved[1]),
+                    "fused": fuse,
+                    "kl_fold": kl_fold if kl_fold > 1 else 0,
+                    "chunk_i": 0,
+                    "symmetric": symmetric,
+                    "cache_hit": cache_hit,
+                    "cache_ms": cache_ms,
+                    "source": sources,
+                }
                 return _consensus_oneshot_cl(
-                    params, corr, symmetric, resolved
+                    params, corr, symmetric, resolved,
+                    kl_fold=kl_fold if kl_fold > 1 else 0,
+                    branch_fuse=fuse,
                 )
+        LAST_PLAN = {
+            "path": "oneshot",
+            "strategies": list(strategies) if strategies else None,
+            "fused": False,
+            "kl_fold": kl_fold if kl_fold > 1 else 0,
+            "chunk_i": 0,
+            "symmetric": symmetric,
+            "cache_hit": cache_hit,
+            "cache_ms": cache_ms,
+            "source": sources,
+        }
         if kl_fold > 1:
             corr, orig_kl = fold_kl(corr, kl_fold)
         out = stack(corr, False)
@@ -841,6 +1162,17 @@ def neigh_consensus_apply(
             out = unfold_kl(out, kl_fold, orig_kl)
         return out
 
+    LAST_PLAN = {
+        "path": "chunked",
+        "strategies": list(strategies) if strategies else None,
+        "fused": False,
+        "kl_fold": 0,
+        "chunk_i": int(chunk_i),
+        "symmetric": symmetric,
+        "cache_hit": cache_hit,
+            "cache_ms": cache_ms,
+        "source": sources,
+    }
     n = -(-si // chunk_i)
     tail = n * chunk_i - si
     xp = jnp.pad(

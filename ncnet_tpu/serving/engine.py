@@ -306,6 +306,8 @@ class MatchEngine:
         the number of programs compiled. Compiles land in the persistent
         compile cache, so a restarted replica warms from disk.
         """
+        from ncnet_tpu.ops import consensus_last_plan
+
         n = 0
         for qh, qw, ph, pw in raw_shapes:
             q_shape = self._resize_shape(qh, qw)
@@ -318,6 +320,18 @@ class MatchEngine:
                     self._jax.block_until_ready(
                         self._batch_pairs(self.params, q, t)
                     )
+                # The trace above consulted the strategy cache
+                # (ops/autotune.py) for this bucket's consensus shape;
+                # surface what it resolved — tuned plan or heuristic —
+                # so a replica's run log shows which buckets are tuned.
+                plan = consensus_last_plan()
+                if plan is not None:
+                    obs.event("autotune", action="consult",
+                              where="serving.warmup",
+                              q_shape=list(q_shape),
+                              p_shape=list(p_shape), batch=b,
+                              cache_hit=plan.get("cache_hit"),
+                              ms=plan.get("cache_ms"), plan=plan)
                 n += 1
         obs.counter("serving.warmup_programs").inc(n)
         return n

@@ -1,0 +1,225 @@
+"""Consensus autotuner (ncnet_tpu/ops/autotune.py): enumeration
+legality, deterministic winner selection, cache round-trip into
+neigh_consensus_apply's trace-time plan, corrupt/stale-cache fallback,
+and env-var precedence over a populated cache."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.ops import autotune
+from ncnet_tpu.ops.conv4d import (
+    consensus_last_plan,
+    neigh_consensus_apply,
+    neigh_consensus_init,
+)
+
+SHAPE = (1, 1, 6, 5, 7, 6)
+
+
+@pytest.fixture
+def params():
+    return neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (16, 1))
+
+
+@pytest.fixture
+def corr():
+    r = np.random.RandomState(1)
+    return jnp.asarray(r.randn(*SHAPE).astype(np.float32))
+
+
+@pytest.fixture
+def clean_env(monkeypatch, tmp_path):
+    """Hermetic knobs: no ambient plan env vars, cache at a tmp path."""
+    for k in autotune.PLAN_ENV_KEYS + ("NCNET_CONV4D_STRATEGY",
+                                       "NCNET_CONSENSUS_CL"):
+        monkeypatch.delenv(k, raising=False)
+    cache = tmp_path / "consensus_autotune.json"
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", str(cache))
+    return cache
+
+
+def test_enumerate_plans_legality(params):
+    plans = autotune.enumerate_plans(params, symmetric=True,
+                                     chunks=(0, 25))
+    assert plans, "empty candidate space"
+    keys = {autotune.plan_key(p) for p in plans}
+    assert len(keys) == len(plans), "duplicate candidates"
+    for p in plans:
+        if p["kl_fold"] > 1:
+            # Fold requires the one-shot path (apply raises otherwise)
+            # and explicit strategies (auto resolves convnd folded).
+            assert p["chunk_i"] == 0
+            assert p["strategies"] is not None
+        if p["chunk_i"]:
+            assert p["branch_fuse"] is False
+    # Both fusion arms are present for the symmetric space...
+    assert any(p["branch_fuse"] for p in plans)
+    assert any(not p["branch_fuse"] for p in plans)
+    # ...and absent for the non-symmetric one (nothing to fuse).
+    assert not any(
+        p["branch_fuse"]
+        for p in autotune.enumerate_plans(params, symmetric=False)
+    )
+
+
+def test_fake_timer_winner_deterministic(params, corr, clean_env):
+    a = autotune.autotune(params, corr, timer=autotune.fake_timer,
+                          save=False)
+    b = autotune.autotune(params, corr, timer=autotune.fake_timer,
+                          save=False)
+    assert autotune.plan_key(a[0]) == autotune.plan_key(b[0])
+    assert a[1] == b[1]
+    measured = [ms for _, ms in a[2] if ms is not None]
+    assert a[1] == min(measured)
+
+
+def test_injected_timer_picks_planned_winner(params, corr, clean_env):
+    target = autotune.plan_key(autotune.normalize_plan(
+        {"strategies": ["conv2d_stacked", "conv2d_outstacked"],
+         "branch_fuse": True, "kl_fold": 0, "chunk_i": 0}))
+
+    def timer(params_, corr_, sym_, plan, *, reps, iters):
+        return 0.0, 1.0 if autotune.plan_key(plan) == target else 50.0
+
+    best, ms, _ = autotune.autotune(params, corr, timer=timer, save=False)
+    assert autotune.plan_key(best) == target and ms == 1.0
+
+
+def test_cache_round_trip_changes_traced_plan(params, corr, clean_env):
+    """Acceptance: a populated cache changes the traced plan WITHOUT any
+    env vars set (verifiable via the recorded plan), and every knob's
+    source says so."""
+    neigh_consensus_apply(params, corr, symmetric=True)
+    baseline = consensus_last_plan()
+    assert baseline["cache_hit"] is False
+    # A winner the heuristic would never pick: unfused + fold2.
+    plan = {"strategies": ["conv2d_stacked", "conv2d_outstacked"],
+            "branch_fuse": False, "kl_fold": 2, "chunk_i": 0}
+    path = autotune.save_plan(SHAPE, corr.dtype, params, plan, 3.25,
+                              symmetric=True, candidates=7)
+    assert path == str(clean_env) and os.path.exists(path)
+    out = neigh_consensus_apply(params, corr, symmetric=True)
+    tuned = consensus_last_plan()
+    assert tuned["cache_hit"] is True
+    assert tuned["cache_ms"] == 3.25
+    assert tuned["kl_fold"] == 2 and tuned["fused"] is False
+    assert tuned["source"] == {k: "cache" for k in tuned["source"]}
+    assert autotune.plan_key({
+        "strategies": tuned["strategies"], "branch_fuse": tuned["fused"],
+        "kl_fold": tuned["kl_fold"], "chunk_i": tuned["chunk_i"],
+    }) == autotune.plan_key(plan)
+    # The tuned plan is a pure formulation change: numerics hold.
+    ref = _apply_without_cache(params, corr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def _apply_without_cache(params, corr):
+    prev = os.environ.get("NCNET_STRATEGY_CACHE")
+    os.environ["NCNET_STRATEGY_CACHE"] = ""
+    try:
+        return neigh_consensus_apply(params, corr, symmetric=True)
+    finally:
+        os.environ["NCNET_STRATEGY_CACHE"] = prev
+
+
+def test_corrupt_cache_warns_and_falls_back(params, corr, clean_env,
+                                            tmp_path):
+    clean_env.write_text("{definitely not json")
+    log_path = tmp_path / "runlog-unit.jsonl"
+    run = obs.init_run("unit", str(log_path), heartbeat_s=0)
+    try:
+        neigh_consensus_apply(params, corr, symmetric=True)
+    finally:
+        run.close("ok")
+    plan = consensus_last_plan()
+    assert plan["cache_hit"] is False  # heuristic fallback, no raise
+    records = [json.loads(l) for l in log_path.read_text().splitlines()]
+    warn = [r for r in records if r.get("event") == "autotune"]
+    assert warn and warn[0]["action"] == "cache_corrupt"
+
+
+def test_stale_cache_entry_ignored(params, corr, clean_env):
+    """An entry whose strategies no longer validate against the params
+    (e.g. tuned for a different layer count) must be ignored."""
+    plan = {"strategies": ["conv2d_stacked"],  # arity 1, params have 2
+            "branch_fuse": True, "kl_fold": 0, "chunk_i": 0}
+    # save_plan validates nothing by design (the tuner only saves what
+    # it measured); write the stale entry the way a config drift would
+    # leave it — same signature, wrong-arity plan.
+    autotune.save_plan(SHAPE, corr.dtype, params, plan, 1.0,
+                       symmetric=True)
+    assert autotune.lookup_plan(SHAPE, corr.dtype, params,
+                                symmetric=True) is None
+    neigh_consensus_apply(params, corr, symmetric=True)
+    assert consensus_last_plan()["cache_hit"] is False
+
+
+def test_env_vars_win_over_cache_per_knob(params, corr, clean_env,
+                                          monkeypatch):
+    """Precedence: explicit env knobs beat the cached plan PER KNOB —
+    the cache only fills what the caller/env left unset."""
+    plan = {"strategies": ["conv2d_stacked", "conv2d_outstacked"],
+            "branch_fuse": False, "kl_fold": 2, "chunk_i": 0}
+    autotune.save_plan(SHAPE, corr.dtype, params, plan, 2.0,
+                       symmetric=True)
+    monkeypatch.setenv("NCNET_CONSENSUS_KL_FOLD", "0")
+    neigh_consensus_apply(params, corr, symmetric=True)
+    got = consensus_last_plan()
+    assert got["cache_hit"] is True
+    assert got["kl_fold"] == 0 and got["source"]["kl_fold"] == "env"
+    assert got["source"]["strategies"] == "cache"
+    assert got["fused"] is False  # branch_fuse still from cache
+    # An explicit strategies= arg beats everything.
+    neigh_consensus_apply(params, corr, symmetric=True,
+                          strategies=("conv2d_stacked", "conv3d"))
+    assert consensus_last_plan()["source"]["strategies"] == "arg"
+
+
+def test_plan_env_round_trip(params, corr, clean_env, monkeypatch):
+    """plan_env's materialization reaches the trace exactly (the bench
+    tools' single-home contract)."""
+    plan = autotune.normalize_plan(
+        {"strategies": ["conv2d_stacked", "conv2d_outstacked"],
+         "branch_fuse": True, "kl_fold": 2, "chunk_i": 0})
+    for k, v in autotune.plan_env(plan).items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", "")
+    neigh_consensus_apply(params, corr, symmetric=True)
+    got = consensus_last_plan()
+    assert got["kl_fold"] == 2 and got["fused"] is True
+    assert got["strategies"] == plan["strategies"]
+    assert got["cache_hit"] is False
+
+
+def test_disabled_cache_never_reads_or_writes(params, corr, monkeypatch,
+                                              tmp_path):
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", "")
+    assert autotune.cache_path() is None
+    assert autotune.lookup_plan(SHAPE, corr.dtype, params,
+                                symmetric=True) is None
+    assert autotune.save_plan(SHAPE, corr.dtype, params,
+                              {"strategies": None}, 1.0) is None
+
+
+def test_plan_overrides_restores_env(monkeypatch):
+    monkeypatch.setenv("NCNET_CONSENSUS_KL_FOLD", "4")
+    monkeypatch.delenv("NCNET_CONSENSUS_STRATEGIES", raising=False)
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", "/some/cache.json")
+    plan = {"strategies": ["conv2d_stacked", "conv2d_stacked"],
+            "branch_fuse": False, "kl_fold": 0, "chunk_i": 0}
+    with autotune.plan_overrides(plan):
+        assert os.environ["NCNET_CONSENSUS_KL_FOLD"] == "0"
+        assert (os.environ["NCNET_CONSENSUS_STRATEGIES"]
+                == "conv2d_stacked,conv2d_stacked")
+        # The candidate must not consult the plan being tuned.
+        assert os.environ["NCNET_STRATEGY_CACHE"] == ""
+    assert os.environ["NCNET_CONSENSUS_KL_FOLD"] == "4"
+    assert "NCNET_CONSENSUS_STRATEGIES" not in os.environ
+    assert os.environ["NCNET_STRATEGY_CACHE"] == "/some/cache.json"

@@ -82,6 +82,46 @@ def test_bench_serving_emits_one_json_line(tiny_serving_model, capsys):
     assert rec["errors"] == 0
 
 
+def test_autotune_cli_emits_one_json_line(tmp_path, capsys, monkeypatch):
+    """tools/autotune_consensus.py stdout contract (ISSUE 3): run
+    in-process with the fake timer (no device dial, no compiles) and a
+    tmp cache; ONE stdout JSON line with the best-plan metric, and the
+    winner persisted to the cache file."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import autotune_consensus
+    from ncnet_tpu.ops import autotune
+
+    cache = tmp_path / "cache.json"
+    monkeypatch.setenv("NCNET_AUTOTUNE_FAKE_TIMER", "1")
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", str(cache))
+    for k in autotune.PLAN_ENV_KEYS:
+        monkeypatch.delenv(k, raising=False)
+    rc = autotune_consensus.main([
+        "--shape", "1,1,6,5,7,6", "--dtype", "float32",
+        "--kernel_sizes", "3", "3", "--channels", "16", "1",
+    ])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "consensus_autotune_best_ms"
+    assert rec["unit"] == "ms"
+    assert rec["value"] > 0
+    assert rec["backend"] == "fake"
+    assert rec["measured"] == rec["candidates"] and rec["failed"] == 0
+    assert rec["cache_path"] == str(cache)
+    # The winner round-trips: the cache now resolves for this signature.
+    import jax
+
+    from ncnet_tpu.ops.conv4d import neigh_consensus_init
+
+    params = neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (16, 1))
+    looked = autotune.lookup_plan((1, 1, 6, 5, 7, 6), "float32", params,
+                                  symmetric=True)
+    assert looked is not None
+    assert autotune.plan_key(looked) == autotune.plan_key(rec["plan"])
+
+
 def test_traceagg_on_committed_round2_trace():
     """traceagg ground truth against the committed round-2 device trace:
     whole-step totals and the stage rollup must reproduce the numbers in

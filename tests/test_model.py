@@ -287,7 +287,11 @@ def test_finetune_mask_excludes_bn_stats(rng):
     tgt = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
     # Snapshot before stepping: train_step donates its params/opt-state
     # buffers, so the originals are invalidated on TPU after the call.
-    old_bb = jax.tree.map(np.asarray, state.trainable["backbone"])
+    # np.array, not np.asarray: on CPU the latter can be a zero-copy VIEW
+    # of the device buffer, and when the donated buffer is reused for the
+    # output (executable-dependent — flips with the persistent compile
+    # cache) the "old" snapshot silently shows the new values.
+    old_bb = jax.tree.map(np.array, state.trainable["backbone"])
     new_t, _, _ = train_step(state.trainable, state.frozen, state.opt_state, src, tgt)
 
     new_bb = new_t["backbone"]
@@ -320,7 +324,8 @@ def test_finetune_blocks_n2_unfreezes_two_blocks(rng):
     train_step, _ = make_train_step(config, tx)
     src = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
     tgt = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
-    old_bb = jax.tree.map(np.asarray, state.trainable["backbone"])
+    # np.array (copy), not np.asarray: see test_finetune_mask_excludes_bn_stats.
+    old_bb = jax.tree.map(np.array, state.trainable["backbone"])
     new_t, _, _ = train_step(state.trainable, state.frozen, state.opt_state, src, tgt)
 
     new_bb = new_t["backbone"]

@@ -537,3 +537,165 @@ def test_consensus_channels_last_path_parity(rng, symmetric, dtype, monkeypatch)
     )
 
 
+
+def _reference_symmetric_consensus(params, corr):
+    """Reference semantics built on conv4d_reference (dense einsum): the
+    stack applied to the tensor AND to its A<->B transpose, transposed
+    back and summed (lib/model.py:143-153)."""
+    from ncnet_tpu.ops.conv4d import conv4d_reference
+
+    def stack(x):
+        for layer in params:
+            x = jax.nn.relu(
+                conv4d_reference(x, layer["weight"], layer["bias"])
+            )
+        return x
+
+    xt = jnp.transpose(corr, (0, 1, 4, 5, 2, 3))
+    return stack(corr) + jnp.transpose(stack(xt), (0, 1, 4, 5, 2, 3))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_consensus_branch_fuse_parity_vs_reference(rng, dtype, monkeypatch):
+    """The branch-fused grouped path (ONE conv per layer, the symmetric
+    one-shot default) matches the conv4d_reference-built symmetric
+    output, and IS the default plan when both branches resolve to
+    stacked/outstacked."""
+    import jax as _jax
+
+    from ncnet_tpu.ops.conv4d import (
+        consensus_last_plan,
+        neigh_consensus_apply,
+        neigh_consensus_init,
+    )
+
+    for k in ("NCNET_CONSENSUS_BRANCH_FUSE", "NCNET_CONSENSUS_STRATEGIES",
+              "NCNET_CONSENSUS_KL_FOLD", "NCNET_CONV4D_STRATEGY",
+              "NCNET_CONSENSUS_CL"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", "")  # heuristic only
+    params = neigh_consensus_init(_jax.random.PRNGKey(3), (3, 3), (16, 1))
+    x32 = jnp.asarray(rng.randn(1, 1, 6, 5, 7, 6).astype(np.float32))
+    got = neigh_consensus_apply(
+        params, x32.astype(dtype), symmetric=True, chunk_i=0
+    )
+    plan = consensus_last_plan()
+    assert plan["path"] == "cl_fused" and plan["fused"] is True
+    assert all(s in ("conv2d_stacked", "conv2d_outstacked")
+               for s in plan["strategies"])
+    want = _reference_symmetric_consensus(params, x32)
+    tol = 1e-5 if dtype == jnp.float32 else 5e-2
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), np.asarray(want),
+        atol=tol, rtol=tol,
+    )
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_consensus_branch_fuse_vs_unfused(rng, dtype, monkeypatch):
+    """Fused vs NCNET_CONSENSUS_BRANCH_FUSE=0: the grouped formulation is
+    the SAME convs with the same accumulation policy — exact in f32,
+    within bf16 tolerance in bf16."""
+    import jax as _jax
+
+    from ncnet_tpu.ops.conv4d import (
+        consensus_last_plan,
+        neigh_consensus_apply,
+        neigh_consensus_init,
+    )
+
+    for k in ("NCNET_CONSENSUS_STRATEGIES", "NCNET_CONSENSUS_KL_FOLD",
+              "NCNET_CONV4D_STRATEGY", "NCNET_CONSENSUS_CL"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", "")
+    params = neigh_consensus_init(_jax.random.PRNGKey(5), (3, 3), (16, 1))
+    x = jnp.asarray(
+        rng.randn(1, 1, 6, 5, 7, 6).astype(np.float32)
+    ).astype(dtype)
+    monkeypatch.setenv("NCNET_CONSENSUS_BRANCH_FUSE", "1")
+    fused = neigh_consensus_apply(params, x, symmetric=True, chunk_i=0)
+    assert consensus_last_plan()["fused"] is True
+    monkeypatch.setenv("NCNET_CONSENSUS_BRANCH_FUSE", "0")
+    unfused = neigh_consensus_apply(params, x, symmetric=True, chunk_i=0)
+    assert consensus_last_plan()["fused"] is False
+    if dtype == jnp.float32:
+        np.testing.assert_array_equal(
+            np.asarray(fused), np.asarray(unfused)
+        )
+    else:
+        np.testing.assert_allclose(
+            np.asarray(fused, dtype=np.float32),
+            np.asarray(unfused, dtype=np.float32), atol=5e-2, rtol=5e-2,
+        )
+
+
+def test_consensus_branch_fuse_noncubic_falls_back_unfused(rng, monkeypatch):
+    """A non-cubic kernel whose swapped branch resolves a different
+    strategy arm (here: layer 2's 5x5 IJ stencil is convnd forward,
+    outstacked swapped) must NOT fuse — the gate falls back to the
+    generic unfused path, with reference parity intact."""
+    import jax as _jax
+
+    from ncnet_tpu.ops.conv4d import (
+        consensus_last_plan,
+        neigh_consensus_apply,
+    )
+
+    for k in ("NCNET_CONSENSUS_BRANCH_FUSE", "NCNET_CONSENSUS_STRATEGIES",
+              "NCNET_CONSENSUS_KL_FOLD", "NCNET_CONV4D_STRATEGY",
+              "NCNET_CONSENSUS_CL"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", "")
+    r = np.random.RandomState(7)
+    params = [
+        {"weight": jnp.asarray(
+            0.2 * r.randn(3, 3, 3, 3, 1, 4).astype(np.float32)),
+         "bias": jnp.asarray(r.randn(4).astype(np.float32))},
+        {"weight": jnp.asarray(
+            0.2 * r.randn(5, 5, 3, 3, 4, 1).astype(np.float32)),
+         "bias": jnp.asarray(r.randn(1).astype(np.float32))},
+    ]
+    x = jnp.asarray(rng.randn(1, 1, 6, 5, 7, 6).astype(np.float32))
+    got = neigh_consensus_apply(params, x, symmetric=True, chunk_i=0)
+    plan = consensus_last_plan()
+    assert plan["fused"] is False and plan["path"] != "cl_fused"
+    want = _reference_symmetric_consensus(params, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("f", [2, 4])
+def test_consensus_branch_fuse_kl_fold_parity(rng, f, monkeypatch):
+    """Fused x KL-fold with K/L NOT divisible by f (right-pad phases +
+    inter-layer re-zero): identical output to the unfolded unfused
+    stack. Explicit stacked/outstacked strategies, as on the generic
+    folded path ('auto' at f^2-times-wider channels resolves convnd)."""
+    import jax as _jax
+
+    from ncnet_tpu.ops.conv4d import (
+        consensus_last_plan,
+        neigh_consensus_apply,
+        neigh_consensus_init,
+    )
+
+    for k in ("NCNET_CONSENSUS_BRANCH_FUSE", "NCNET_CONSENSUS_STRATEGIES",
+              "NCNET_CONSENSUS_KL_FOLD", "NCNET_CONV4D_STRATEGY",
+              "NCNET_CONSENSUS_CL"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", "")
+    params = neigh_consensus_init(_jax.random.PRNGKey(0), (3, 3), (16, 1))
+    x = jnp.asarray(rng.randn(1, 1, 6, 5, 7, 6).astype(np.float32))
+    assert x.shape[4] % f or x.shape[5] % f  # the ragged case
+    monkeypatch.setenv("NCNET_CONSENSUS_BRANCH_FUSE", "0")
+    want = neigh_consensus_apply(params, x, symmetric=True, chunk_i=0)
+    monkeypatch.setenv("NCNET_CONSENSUS_BRANCH_FUSE", "1")
+    monkeypatch.setenv("NCNET_CONSENSUS_KL_FOLD", str(f))
+    monkeypatch.setenv("NCNET_CONSENSUS_STRATEGIES",
+                       "conv2d_stacked,conv2d_outstacked")
+    got = neigh_consensus_apply(params, x, symmetric=True, chunk_i=0)
+    plan = consensus_last_plan()
+    assert plan["path"] == "cl_fused" and plan["kl_fold"] == f
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )

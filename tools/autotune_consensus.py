@@ -1,0 +1,155 @@
+"""Tune the consensus Conv4d plan on the live backend and cache the winner.
+
+Enumerates the legal candidate plans for a consensus config at a given
+correlation shape (ncnet_tpu/ops/autotune.py — per-layer strategy mixes
+x branch-fused/unfused x KL-fold x chunking), times each with
+compiled-call medians (R applies chained in one jit), and persists the
+winner to the strategy cache (trained_models/consensus_autotune.json,
+override NCNET_STRATEGY_CACHE). After a session runs this once per
+(backend, shape bucket), `neigh_consensus_apply` picks the tuned plan at
+trace time with no env vars set.
+
+Stdout is EXACTLY ONE JSON line (the driver contract shared with
+bench.py / tools/bench_*.py); all diagnostics go to stderr.
+
+Usage:
+    python tools/autotune_consensus.py [--shape 1,1,100,75,100,75]
+        [--dtype bfloat16] [--kernel_sizes 3 3] [--channels 16 1]
+        [--reps 4] [--iters 3] [--max_candidates 0] [--no_save]
+
+NCNET_AUTOTUNE_FAKE_TIMER=1 swaps the device timer for a deterministic
+no-device stand-in (CI contract tests; never use for real tuning).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_T0 = time.time()
+
+
+def note(msg):
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--shape", type=str, default="1,1,100,75,100,75",
+                   help="correlation shape b,c,iA,jA,iB,jB (InLoc "
+                        "post-pool default)")
+    p.add_argument("--dtype", type=str, default="bfloat16")
+    p.add_argument("--kernel_sizes", type=int, nargs="+", default=[3, 3])
+    p.add_argument("--channels", type=int, nargs="+", default=[16, 1])
+    p.add_argument("--symmetric", type=int, default=1)
+    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--max_candidates", type=int, default=0,
+                   help="0 = all; otherwise time only the first N of "
+                        "the enumeration (session-budget guard)")
+    p.add_argument("--fence", type=int, default=420,
+                   help="per-candidate SIGALRM bound, seconds")
+    p.add_argument("--no_save", action="store_true",
+                   help="measure and report only; leave the cache alone")
+    p.add_argument("--dial_timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+
+    fake = os.environ.get("NCNET_AUTOTUNE_FAKE_TIMER") == "1"
+
+    from ncnet_tpu.utils.profiling import (
+        AlarmTimeout,
+        dial_devices,
+        run_with_alarm,
+        setup_compile_cache,
+    )
+
+    if not fake:
+        setup_compile_cache()
+        devices = dial_devices(args.dial_timeout)
+        if devices is None:
+            note("backend dial timed out; aborting")
+            return 2
+        note(f"devices: {devices}")
+
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.ops import autotune
+    from ncnet_tpu.ops.conv4d import neigh_consensus_init
+
+    shape = tuple(int(s) for s in args.shape.split(","))
+    if len(shape) != 6:
+        note(f"--shape must have 6 dims, got {shape}")
+        return 2
+    dtype = jnp.dtype(args.dtype)
+    params = neigh_consensus_init(
+        jax.random.PRNGKey(0), tuple(args.kernel_sizes),
+        tuple(args.channels),
+    )
+    # Timing does not depend on the values; normal data avoids any
+    # subnormal slow path.
+    corr = jax.random.normal(
+        jax.random.PRNGKey(1), shape, jnp.float32
+    ).astype(dtype)
+    symmetric = bool(args.symmetric)
+
+    plans = autotune.enumerate_plans(params, symmetric=symmetric)
+    total = len(plans)
+    if args.max_candidates and total > args.max_candidates:
+        note(f"capping {total} candidates to first {args.max_candidates}"
+             f" (--max_candidates)")
+        plans = plans[: args.max_candidates]
+    note(f"{len(plans)} candidate plans for shape={shape} "
+         f"dtype={dtype.name} sym={symmetric}"
+         + (" [FAKE TIMER]" if fake else ""))
+
+    if fake:
+        timer = autotune.fake_timer
+    else:
+        def timer(params_, corr_, sym_, plan, *, reps, iters):
+            # Per-candidate fence: one pathological remote compile must
+            # cost one candidate, not the session (the bench tools'
+            # standing rule). AlarmTimeout is a BaseException, so
+            # convert it here — autotune()'s candidate fence catches
+            # Exception only, by design.
+            try:
+                return run_with_alarm(
+                    args.fence, autotune.device_timer, params_, corr_,
+                    sym_, plan, reps=reps, iters=iters,
+                )
+            except AlarmTimeout as exc:
+                raise RuntimeError(f"candidate fence: {exc}") from None
+
+    best_plan, best_ms, results = autotune.autotune(
+        params, corr, symmetric=symmetric, plans=plans,
+        reps=args.reps, iters=args.iters, timer=timer,
+        save=not args.no_save, log=note,
+    )
+
+    measured = [(p_, m) for p_, m in results if m is not None]
+    record = {
+        "metric": "consensus_autotune_best_ms",
+        "value": best_ms,
+        "unit": "ms",
+        "plan": autotune.normalize_plan(best_plan),
+        "plan_label": autotune.plan_label(best_plan),
+        "backend": autotune.backend_kind() if not fake else "fake",
+        "sig": autotune.shape_signature(shape, dtype, params, symmetric),
+        "candidates": len(plans),
+        "measured": len(measured),
+        "failed": len(results) - len(measured),
+        "cache_path": (None if args.no_save else autotune.cache_path()),
+        "reps": args.reps,
+        "iters": args.iters,
+    }
+    print(json.dumps(record), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

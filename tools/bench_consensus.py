@@ -34,6 +34,9 @@ def main(argv=None):
     p.add_argument("--reps", type=int, default=4)
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--dial_timeout", type=float, default=600.0)
+    p.add_argument("--max_plans", type=int, default=0,
+                   help="cap the enumerated plan cases (0 = all); the "
+                        "diagnostic cases always run")
     args = p.parse_args(argv)
 
     import jax
@@ -117,6 +120,11 @@ def main(argv=None):
         # reduction passes.
         return mutual_matching(c, maxes=maxes)
 
+    def convs_plan(c):
+        # Knob-driven variant: every plan axis (strategies, fusion,
+        # fold, chunk) comes from the case env, none pinned by args.
+        return neigh_consensus_apply(params, c, symmetric=True)
+
     cases = [
         ("oneshot-auto (default, full stage)", full_stage, {}),
         ("chunk25-auto (chunked sanity)", chunked_stage, {}),
@@ -129,23 +137,31 @@ def main(argv=None):
         # is derivable: l2 = (convs-only non-symmetric) - (l1-only).
         ("mutual x2 (reductions)", mutuals_only, {}),
         ("mutual elementwise (maxes given)", mutual_elementwise, {}),
-        # Space-to-depth (fold_kl): f^2-fold channel counts for lane
-        # packing; the winner (if any) flips the stack default.
-        ("fold2 stacked+outstacked", convs_only,
-         {"NCNET_CONSENSUS_KL_FOLD": "2",
-          "NCNET_CONSENSUS_STRATEGIES": "conv2d_stacked,conv2d_outstacked"}),
-        ("fold2 auto", convs_only, {"NCNET_CONSENSUS_KL_FOLD": "2"}),
-        ("fold4 stacked+outstacked", convs_only,
-         {"NCNET_CONSENSUS_KL_FOLD": "4",
-          "NCNET_CONSENSUS_STRATEGIES": "conv2d_stacked,conv2d_outstacked"}),
     ]
+
+    # Plan cases come from the autotuner's enumeration (the single home
+    # shared with tools/autotune_consensus.py and bench_strategies_ab):
+    # per-layer strategy mixes x branch-fused/unfused x KL-fold. Each
+    # runs with the strategy cache disabled so a tuned plan can't fill
+    # the knobs a candidate leaves open and mislabel the line.
+    from ncnet_tpu.ops import autotune
+
+    plans = autotune.enumerate_plans(params, symmetric=True)
+    if args.max_plans and len(plans) > args.max_plans:
+        log(f"capping {len(plans)} enumerated plans to {args.max_plans}")
+        plans = plans[: args.max_plans]
+    for plan in plans:
+        cases.append((
+            f"plan {autotune.plan_label(plan)}", convs_plan,
+            dict(autotune.plan_env(plan), NCNET_STRATEGY_CACHE=""),
+        ))
 
     from ncnet_tpu.utils.profiling import AlarmTimeout, run_with_alarm
 
     # Snapshot the shared process env: this tool runs in-process under
     # tpu_session, and stripping the operator's own overrides would make
     # every LATER phase silently measure the defaults.
-    _knobs = ("NCNET_CONSENSUS_KL_FOLD", "NCNET_CONSENSUS_STRATEGIES")
+    _knobs = autotune.PLAN_ENV_KEYS + ("NCNET_STRATEGY_CACHE",)
     _saved = {k: os.environ.get(k) for k in _knobs}
 
     for label, stage, env in cases:

@@ -17,6 +17,12 @@ remains the only mix that fits. The copies are the price of the only
 feasible formulation; see docs/NEXT.md "Consensus roofline verdict".
 Kept runnable for regression on future shapes/backends.
 
+The candidate matrix is sourced from the autotuner's enumeration
+(ncnet_tpu/ops/autotune.py — the single home shared with
+tools/bench_consensus.py and tools/autotune_consensus.py), so it now
+includes the branch-fused/unfused axis; --include_folds extends it with
+the KL-fold candidates the enumeration carries.
+
 Run AFTER tools/tpu_session.py finishes (one jax client at a time):
     python tools/bench_strategies_ab.py [--dial_timeout 300]
 """
@@ -42,38 +48,57 @@ def main(argv=None):
     p.add_argument("--dial_timeout", type=float, default=300.0)
     p.add_argument("--keep_trace_dir", default="docs/tpu_r05/ab_trace",
                    help="per-variant trace keep prefix")
+    p.add_argument("--n_layers", type=int, default=2,
+                   help="consensus depth the headline model runs "
+                        "(InLoc: 2)")
+    p.add_argument("--include_folds", action="store_true",
+                   help="also run the KL-fold candidates (off by "
+                        "default: each A/B line is a full bench run)")
+    p.add_argument("--max_runs", type=int, default=0,
+                   help="0 = all; otherwise cap the matrix (session-"
+                        "budget guard)")
     args = p.parse_args(argv)
 
-    base_runs = [
-        ("outstacked,outstacked",
-         {"NCNET_CONSENSUS_STRATEGIES":
-          "conv2d_outstacked,conv2d_outstacked"}),
-        ("stacked,stacked",
-         {"NCNET_CONSENSUS_STRATEGIES":
-          "conv2d_stacked,conv2d_stacked"}),
-        ("outstacked,stacked",
-         {"NCNET_CONSENSUS_STRATEGIES":
-          "conv2d_outstacked,conv2d_stacked"}),
-        # Anchor: the promoted default, warm cache, keeps the session
-        # comparable run-over-run.
-        ("auto anchor", {}),
-    ]
+    # Import is device-free: enumerate_plans only needs the layer count,
+    # so the backend dial stays inside run_bench_matrix.
+    from ncnet_tpu.ops import autotune
+
+    plans = autotune.enumerate_plans(
+        [{}] * args.n_layers, symmetric=True,
+        kl_folds=(0, 2, 4) if args.include_folds else (0,),
+        chunks=(0,),
+    )
+    base_runs = [(autotune.plan_label(pl), autotune.plan_env(pl))
+                 for pl in plans]
+    # Anchor: the promoted default (no knobs at all — heuristic + any
+    # populated strategy cache), warm cache, keeps the session
+    # comparable run-over-run.
+    base_runs.append(("auto anchor", {}))
+    if args.max_runs and len(base_runs) > args.max_runs:
+        log(f"capping {len(base_runs)} runs to {args.max_runs}")
+        base_runs = base_runs[: args.max_runs]
+
     runs = []
     for label, env in base_runs:
         if env:
             # Keep each variant's capture so the copy table is checkable
-            # without a re-run (small: one block's device plane).
-            env = dict(env, NCNET_BENCH_KEEP_TRACE=(
-                args.keep_trace_dir + "_"
-                + label.replace(",", "_").replace(" ", "_")
-            ))
+            # without a re-run (small: one block's device plane), and
+            # disable the strategy cache: a tuned plan filling the
+            # knobs a candidate left open would mislabel that line.
+            env = dict(env, NCNET_STRATEGY_CACHE="",
+                       NCNET_BENCH_KEEP_TRACE=(
+                           args.keep_trace_dir + "_"
+                           + label.replace(",", "_").replace(" ", "_")
+                                  .replace("+", "_")
+                       ))
         runs.append((label, env))
 
     from ncnet_tpu.utils.profiling import run_bench_matrix
 
     return run_bench_matrix(
         runs, dial_timeout=args.dial_timeout,
-        knobs=("NCNET_CONSENSUS_STRATEGIES", "NCNET_BENCH_KEEP_TRACE"),
+        knobs=autotune.PLAN_ENV_KEYS
+        + ("NCNET_BENCH_KEEP_TRACE", "NCNET_STRATEGY_CACHE"),
         log=log,
     )
 
