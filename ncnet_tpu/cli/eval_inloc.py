@@ -911,67 +911,86 @@ def _query_loop(args, db, out_dir, params, query_features, pano_matches,
             obs.counter("eval_inloc.queries_skipped").inc()
             continue
         query_fn = db[q][0].item()
-        t_q = time.perf_counter()
 
         def _query_done():
             obs.counter("eval_inloc.queries").inc()
             obs.counter("eval_inloc.pairs").inc(args.n_panos)
-            obs.event("query", q=q, query_fn=query_fn, n_panos=args.n_panos,
-                      dur_s=time.perf_counter() - t_q)
 
-        src = jnp.asarray(
-            load_inloc_image(
-                os.path.join(args.query_path, query_fn), args.image_size, args.k_size,
-                extra_align=args.spatial_shards, feat_unit=args.feat_unit,
-            )
-        )
-        feat_a = query_features(params, src)
-        buf = matches_buffer(args.n_panos, n_matches)
-        pano_fns = [db[q][1].ravel()[i].item() for i in range(args.n_panos)]
-        if cache is not None and batch_fn is not None:
-            # --pano_batch + cache: hits per-pano, misses in batched
-            # stacks through the batched-with-feats program.
-            _run_panos_cached_batched(args, params, feat_a, buf, pano_fns,
+        # One trace per query (obs/trace.py): the per-query wall time
+        # decomposes into query_features + panos children the same way
+        # a serving request decomposes into admit/queue/device. The
+        # trace root IS the per-query `query` span event (same fields
+        # the flat v1 event carried, plus the trace ids).
+        with obs.trace.trace("query", q=q, query_fn=query_fn,
+                             n_panos=args.n_panos):
+            # No sync=: the query forward is intentionally async-dispatch
+            # (the one-behind pipeline overlaps it); this span measures
+            # host decode + dispatch, not device completion.
+            with obs.trace.span("query_features"):
+                src = jnp.asarray(
+                    load_inloc_image(
+                        os.path.join(args.query_path, query_fn),
+                        args.image_size, args.k_size,
+                        extra_align=args.spatial_shards,
+                        feat_unit=args.feat_unit,
+                    )
+                )
+                feat_a = query_features(params, src)
+            buf = matches_buffer(args.n_panos, n_matches)
+            pano_fns = [db[q][1].ravel()[i].item()
+                        for i in range(args.n_panos)]
+            if cache is not None and batch_fn is not None:
+                # --pano_batch + cache: hits per-pano, misses in batched
+                # stacks through the batched-with-feats program.
+                with obs.trace.span("panos", mode="cached_batched"):
+                    _run_panos_cached_batched(args, params, feat_a, buf,
+                                              pano_fns, pool, cache,
+                                              cache_fns)
+                write_matches_mat(out_path, buf, query_fn, pano_fn_all)
+                print(f"wrote {out_path}", flush=True)
+                _query_done()
+                continue
+            if batch_fn is not None:
+                with obs.trace.span("panos", mode="batched"):
+                    _run_panos_batched(args, params, feat_a, batch_fn, buf,
+                                       pano_fns, pool, load_pano,
+                                       stack_fn=stack_fn)
+                write_matches_mat(out_path, buf, query_fn, pano_fn_all)
+                print(f"wrote {out_path}", flush=True)
+                _query_done()
+                continue
+            if cache is not None:
+                with obs.trace.span("panos", mode="cached"):
+                    _run_panos_cached(args, params, feat_a, buf, pano_fns,
                                       pool, cache, cache_fns)
+                write_matches_mat(out_path, buf, query_fn, pano_fn_all)
+                print(f"wrote {out_path}", flush=True)
+                _query_done()
+                continue
+            with obs.trace.span("panos", mode="pipelined"):
+                fut = pool.submit(load_pano, pano_fns[0]) if pano_fns else None
+                # One-behind host processing: pano idx's forward is
+                # dispatched (async) BEFORE pano idx-1's matches are
+                # fetched and deduped, so the device-side forward overlaps
+                # both the host dedup and the fetch's tunnel round trip
+                # instead of idling through them.
+                pending = None  # (pano_idx, device match tuple)
+                for idx in range(args.n_panos):
+                    tgt = fut.result()
+                    if idx + 1 < args.n_panos:
+                        fut = pool.submit(load_pano, pano_fns[idx + 1])
+                    dev_matches = pano_matches(params, feat_a, tgt)
+                    if pending is not None:
+                        fill_matches(buf, pending[0],
+                                     dedup_matches(*pending[1]))
+                    pending = (idx, dev_matches)
+                    if idx % 10 == 0:
+                        print(f">>> query {q} pano {idx}", flush=True)
+                if pending is not None:
+                    fill_matches(buf, pending[0], dedup_matches(*pending[1]))
             write_matches_mat(out_path, buf, query_fn, pano_fn_all)
             print(f"wrote {out_path}", flush=True)
             _query_done()
-            continue
-        if batch_fn is not None:
-            _run_panos_batched(args, params, feat_a, batch_fn, buf, pano_fns,
-                               pool, load_pano, stack_fn=stack_fn)
-            write_matches_mat(out_path, buf, query_fn, pano_fn_all)
-            print(f"wrote {out_path}", flush=True)
-            _query_done()
-            continue
-        if cache is not None:
-            _run_panos_cached(args, params, feat_a, buf, pano_fns, pool,
-                              cache, cache_fns)
-            write_matches_mat(out_path, buf, query_fn, pano_fn_all)
-            print(f"wrote {out_path}", flush=True)
-            _query_done()
-            continue
-        fut = pool.submit(load_pano, pano_fns[0]) if pano_fns else None
-        # One-behind host processing: pano idx's forward is dispatched (async)
-        # BEFORE pano idx-1's matches are fetched and deduped, so the
-        # device-side forward overlaps both the host dedup and the fetch's
-        # tunnel round trip instead of idling through them.
-        pending = None  # (pano_idx, device match tuple)
-        for idx in range(args.n_panos):
-            tgt = fut.result()
-            if idx + 1 < args.n_panos:
-                fut = pool.submit(load_pano, pano_fns[idx + 1])
-            dev_matches = pano_matches(params, feat_a, tgt)
-            if pending is not None:
-                fill_matches(buf, pending[0], dedup_matches(*pending[1]))
-            pending = (idx, dev_matches)
-            if idx % 10 == 0:
-                print(f">>> query {q} pano {idx}", flush=True)
-        if pending is not None:
-            fill_matches(buf, pending[0], dedup_matches(*pending[1]))
-        write_matches_mat(out_path, buf, query_fn, pano_fn_all)
-        print(f"wrote {out_path}", flush=True)
-        _query_done()
 
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ unconditionally — they no-op (or accumulate invisibly) unless an entry
 point opened a run log.
 """
 
+from . import flight, trace
 from .events import (
     NULL_RUN,
     RunLog,
@@ -28,7 +29,9 @@ from .events import (
     init_run,
     span,
 )
+from .flight import FlightRecorder
 from .heartbeat import Heartbeat, Watchdog
+from .trace import SpanCtx, install_compile_telemetry
 from .metrics import (
     Counter,
     Gauge,
@@ -51,6 +54,11 @@ __all__ = [
     "get_run",
     "init_run",
     "span",
+    "flight",
+    "trace",
+    "FlightRecorder",
+    "SpanCtx",
+    "install_compile_telemetry",
     "Heartbeat",
     "Watchdog",
     "Counter",

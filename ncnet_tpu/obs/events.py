@@ -3,8 +3,11 @@
 One run = one append-only ``runlog-<run_id>.jsonl`` file. Every line is
 one JSON event with the shared envelope::
 
-    {"v": 1, "run_id": ..., "event": <name>,
+    {"v": 2, "run_id": ..., "event": <name>,
      "t_wall": <unix seconds>, "t_mono": <monotonic seconds>, ...fields}
+
+Span events may additionally carry ``trace_id``/``span_id``/
+``parent_id`` (request-scoped tracing, obs/trace.py — schema v2).
 
 The first event is ``run_start`` (host/pid/git-rev/CLI-args metadata),
 the last is ``run_end`` with an exit status — written by an explicit
@@ -40,9 +43,13 @@ import time
 import uuid
 from typing import Optional
 
+from . import flight as _flight
 from . import metrics as _metrics
 
-SCHEMA_VERSION = 1
+#: v2 adds the optional trace envelope fields (trace_id / span_id /
+#: parent_id on span events — obs/trace.py) and the `compile` event.
+#: v1 files remain readable: every v2 field is additive.
+SCHEMA_VERSION = 2
 
 #: Heartbeat/stall events must not count as run progress, or the
 #: heartbeat would keep resetting the idle clock it measures.
@@ -148,6 +155,10 @@ class RunLog:
             "t_mono": self.clock(),
         }
         rec.update(fields)
+        # Every event also lands in the bounded in-memory flight
+        # recorder (obs/flight.py) — even after close, so a crash
+        # during shutdown still has its last events in the ring.
+        _flight.record(rec)
         # default=str: a numpy scalar or Path in a field must degrade to
         # text, never take the run down mid-telemetry.
         line = json.dumps(rec, default=str)
@@ -216,14 +227,28 @@ class RunLog:
 
 
 class _NullRunLog:
-    """No-op stand-in so library call sites never need a None check."""
+    """No-run stand-in so library call sites never need a None check.
+
+    Events are dropped from the (nonexistent) log file but still
+    recorded into the flight recorder's in-memory ring — the crash
+    triage surface must be live even when no entry point opened a run
+    (obs/flight.py).
+    """
 
     run_id = None
     path = None
     heartbeat = None
 
     def event(self, name: str, **fields) -> None:
-        pass
+        rec = {
+            "v": SCHEMA_VERSION,
+            "run_id": None,
+            "event": name,
+            "t_wall": time.time(),
+            "t_mono": time.monotonic(),
+        }
+        rec.update(fields)
+        _flight.record(rec)
 
     @contextlib.contextmanager
     def span(self, name: str, sync=None, **fields):
@@ -273,6 +298,10 @@ def _install_exit_hooks() -> None:
         return
     _exit_hooks_installed = True
     atexit.register(_close_all, "atexit")
+    # Unhandled exceptions (main thread or any worker) dump the flight
+    # recorder's ring before the traceback prints — the last N events
+    # of a crash that never reached a clean close.
+    _flight.install_excepthooks()
 
     def _chain(signum, prev):
         def handler(sig, frame):
@@ -313,6 +342,12 @@ def init_run(
     with _active_lock:
         _active.append(run)
     _install_exit_hooks()
+    # Compile telemetry rides every run: recompile storms are a serving
+    # problem first, but an eval that silently retraces per query is
+    # the same disease (obs/trace.install_compile_telemetry).
+    from .trace import install_compile_telemetry
+
+    install_compile_telemetry()
     if heartbeat_s is None:
         try:
             heartbeat_s = float(os.environ.get("NCNET_OBS_HEARTBEAT_S", "30"))

@@ -1,0 +1,197 @@
+"""Always-on bounded flight recorder: the last N events, dumpable.
+
+The run log (obs/events.py) is opt-in — library code's events vanish
+unless an entry point called ``init_run``. That is the right posture
+for normal operation (unit tests must not grow log files), but it is
+exactly wrong at triage time: the hangs ``SIGALRM`` cannot reach
+(wedged C extension, stuck device dispatch) and the crashes that never
+opened a run are the ones where "what happened in the last few
+seconds" matters most.
+
+This module keeps a process-global in-memory ring of the most recent
+events — every ``obs.event``/span record lands here whether or not a
+run is open — and dumps it to a JSONL file when something goes wrong:
+
+* ``obs.Watchdog`` dumps just before its hard ``os._exit`` — the ring
+  is the only record of what the process was doing when it wedged;
+* ``obs.Heartbeat`` dumps at the start of each stall episode — the
+  events *leading into* the stall, captured while the process is still
+  alive to write them;
+* the chained ``sys.excepthook`` / ``threading.excepthook`` installed
+  by ``obs.events._install_exit_hooks`` dump on unhandled exceptions.
+
+The ring is bounded (``NCNET_FLIGHT_EVENTS``, default 512 records) and
+recording is a lock + deque append — cheap enough for per-request hot
+paths. Dumps are rate-limited per reason so a flapping stall cannot
+fill a disk.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+#: Dump files: ``flight-<reason>-<stamp>.jsonl`` in the first of
+#: ``NCNET_FLIGHT_DIR``, the active run log's directory, or cwd.
+_DUMP_PREFIX = "flight"
+
+#: Minimum seconds between dumps for one reason (flap guard).
+_DUMP_COOLDOWN_S = 30.0
+
+
+def _capacity() -> int:
+    try:
+        return max(int(os.environ.get("NCNET_FLIGHT_EVENTS", "512")), 16)
+    except ValueError:
+        return 512
+
+
+class FlightRecorder:
+    """Bounded ring of recent event records + JSONL dump."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = capacity or _capacity()
+        self._buf = collections.deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._last_dump = {}  # reason -> monotonic time of last dump
+        self.dumps = 0
+
+    def record(self, rec: dict) -> None:
+        """Append one event record (a plain dict; never raises)."""
+        try:
+            with self._lock:
+                self._buf.append(rec)
+        except Exception:
+            pass  # telemetry must never take the caller down
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._last_dump.clear()
+
+    def _dump_dir(self) -> str:
+        env = os.environ.get("NCNET_FLIGHT_DIR")
+        if env:
+            return env
+        # Next to the active run log, when one is open.
+        try:
+            from . import events
+
+            run = events.get_run()
+            if getattr(run, "path", None):
+                return os.path.dirname(os.path.abspath(run.path)) or "."
+        except Exception:
+            pass
+        return "."
+
+    def dump(self, reason: str, directory: Optional[str] = None,
+             force: bool = False) -> Optional[str]:
+        """Write the ring to ``flight-<reason>-<stamp>.jsonl``; returns
+        the path, or None (empty ring, cooldown, or unwritable dir —
+        a triage helper must never crash the process it is triaging).
+        """
+        now = time.monotonic()
+        with self._lock:
+            if not self._buf:
+                return None
+            last = self._last_dump.get(reason)
+            if not force and last is not None \
+                    and now - last < _DUMP_COOLDOWN_S:
+                return None
+            self._last_dump[reason] = now
+            records = list(self._buf)
+        safe_reason = "".join(
+            c if c.isalnum() or c in "-_" else "_" for c in reason
+        ) or "unknown"
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        d = directory or self._dump_dir()
+        path = os.path.join(
+            d, f"{_DUMP_PREFIX}-{safe_reason}-{stamp}-{os.getpid()}.jsonl"
+        )
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as fh:
+                header = {
+                    "event": "flight_dump",
+                    "reason": reason,
+                    "t_wall": time.time(),
+                    "pid": os.getpid(),
+                    "n_records": len(records),
+                    "capacity": self.capacity,
+                }
+                fh.write(json.dumps(header, default=str) + "\n")
+                for rec in records:
+                    fh.write(json.dumps(rec, default=str) + "\n")
+        except OSError:
+            return None
+        self.dumps += 1
+        return path
+
+
+_RECORDER = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    """The process-global flight recorder."""
+    return _RECORDER
+
+
+def record(rec: dict) -> None:
+    _RECORDER.record(rec)
+
+
+def dump(reason: str, directory: Optional[str] = None,
+         force: bool = False) -> Optional[str]:
+    return _RECORDER.dump(reason, directory=directory, force=force)
+
+
+_hooks_installed = False
+
+
+def install_excepthooks() -> None:
+    """Chain sys/threading excepthooks to dump the ring on unhandled
+    exceptions; installed once (idempotent), called from
+    ``obs.events._install_exit_hooks``."""
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+
+    import sys
+
+    prev_sys = sys.excepthook
+
+    def _sys_hook(exc_type, exc, tb):
+        try:
+            _RECORDER.dump(f"crash-{exc_type.__name__}")
+        except Exception:
+            pass
+        prev_sys(exc_type, exc, tb)
+
+    sys.excepthook = _sys_hook
+
+    prev_thread = threading.excepthook
+
+    def _thread_hook(args):
+        # SystemExit from a daemon thread is routine shutdown noise.
+        if args.exc_type is not SystemExit:
+            try:
+                name = getattr(args.thread, "name", "thread")
+                _RECORDER.dump(f"thread-{args.exc_type.__name__}-{name}")
+            except Exception:
+                pass
+        prev_thread(args)
+
+    threading.excepthook = _thread_hook

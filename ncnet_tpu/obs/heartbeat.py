@@ -86,6 +86,19 @@ class Heartbeat:
             self.stalls += 1
             self.runlog.event("stall", idle_s=idle_s,
                               stall_after_s=self.stall_after_s)
+            # Dump the flight ring at the START of the episode — the
+            # events leading into the stall, written while the process
+            # is still healthy enough to write them (obs/flight.py).
+            try:
+                from . import flight
+
+                d = None
+                path = getattr(self.runlog, "path", None)
+                if path:
+                    d = os.path.dirname(os.path.abspath(path)) or None
+                flight.dump("stall", directory=d)
+            except Exception:
+                pass
         elif not stalled:
             self._in_stall = False
         self.beats += 1
@@ -180,6 +193,14 @@ class Watchdog:
             return False
         self.log(f"[{self.label}] hard deadline exceeded; exiting "
                  f"{self.exit_code}")
+        # Last act before the hard exit: dump the flight ring — the
+        # only record of what the process was doing when it wedged.
+        try:
+            from . import flight
+
+            flight.dump(f"watchdog-{self.label}", force=True)
+        except Exception:
+            pass
         if self.on_expire is not None:
             self.on_expire()
         else:

@@ -25,9 +25,19 @@ Metric naming convention (docs/OBSERVABILITY.md): dotted lowercase
 
 from __future__ import annotations
 
+import bisect
 import re
 import threading
 from typing import Dict, Optional
+
+#: Fixed log-spaced histogram buckets: 4 per decade over 1e-4 .. 1e4
+#: (upper bounds, Prometheus ``le`` semantics; everything above the
+#: last bound lands in +Inf). One shared ladder for every histogram —
+#: seconds (queue wait 1e-3..1e1, compile times 1e-2..1e3) and small
+#: counts (batch sizes 1..16) all resolve to distinct buckets, and a
+#: fixed ladder keeps A/B diffs bucket-aligned across runs. 33 bounds
+#: = 34 ints per histogram: bounded state, unlike a sample list.
+DEFAULT_BUCKETS = tuple(10.0 ** (k / 4.0) for k in range(-16, 17))
 
 
 class Counter:
@@ -73,16 +83,22 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming summary of an observed distribution (step times, sizes).
+    """Bucketed summary of an observed distribution (step times, sizes).
 
-    Keeps count/sum/min/max/last — enough for the report tool's mean and
-    range without storing samples (a training run observes one value per
-    step; an unbounded sample list would grow with the run).
+    Keeps count/sum/min/max/last plus fixed log-spaced bucket counts
+    (:data:`DEFAULT_BUCKETS`), so p50/p95/p99 exist (bucket-edge
+    interpolation, clamped to the observed min/max) and ``/metrics``
+    can expose cumulative ``_bucket`` lines — all in bounded state (a
+    training run observes one value per step; an unbounded sample list
+    would grow with the run).
     """
 
-    def __init__(self, name: str, lock: threading.Lock):
+    def __init__(self, name: str, lock: threading.Lock,
+                 buckets=DEFAULT_BUCKETS):
         self.name = name
         self._lock = lock
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._bucket_counts = [0] * (len(self.buckets) + 1)  # last: +Inf
         self.count = 0
         self.sum = 0.0
         self.min: Optional[float] = None
@@ -91,12 +107,54 @@ class Histogram:
 
     def observe(self, v: float) -> None:
         v = float(v)
+        # Prometheus `le`: the first bucket whose upper bound is >= v.
+        idx = bisect.bisect_left(self.buckets, v)
         with self._lock:
             self.count += 1
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
             self.last = v
+            self._bucket_counts[idx] += 1
+
+    def _quantile_locked(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile; caller holds the lock."""
+        if not self.count:
+            return None
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self._bucket_counts):
+            if not c:
+                continue
+            cum += c
+            if cum >= target:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else (self.max if self.max is not None else lo))
+                frac = (target - (cum - c)) / c
+                est = lo + (hi - lo) * frac
+                # The ladder is coarser than the data near the edges:
+                # never report outside the observed range.
+                if self.min is not None:
+                    est = max(est, self.min)
+                if self.max is not None:
+                    est = min(est, self.max)
+                return est
+        return self.max
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def bucket_counts(self):
+        """(upper_bounds, cumulative_counts) aligned lists; the final
+        entry is the +Inf bucket (== count)."""
+        with self._lock:
+            cum, out = 0, []
+            for c in self._bucket_counts:
+                cum += c
+                out.append(cum)
+            return self.buckets, out
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -108,6 +166,9 @@ class Histogram:
                 "min": self.min,
                 "max": self.max,
                 "last": self.last,
+                "p50": self._quantile_locked(0.50),
+                "p95": self._quantile_locked(0.95),
+                "p99": self._quantile_locked(0.99),
             }
 
 
@@ -174,11 +235,12 @@ class MetricsRegistry:
           * Counter -> ``<name>_total`` counter;
           * Gauge   -> gauge (unset gauges are omitted — Prometheus has
             no null and 0.0 would be a lie);
-          * Histogram -> a ``<name>`` summary (``_count``/``_sum``, the
-            two fields our streaming summary can expose exactly) plus
-            ``<name>_min``/``<name>_max``/``<name>_last`` gauges — the
-            registry keeps no quantile sketch (metrics.Histogram
-            docstring), so no fabricated ``quantile`` labels.
+          * Histogram -> a Prometheus histogram: cumulative
+            ``<name>_bucket{le="..."}`` lines over the fixed log-spaced
+            ladder (DEFAULT_BUCKETS; empty leading/trailing buckets are
+            elided, the cumulative contract is preserved by always
+            emitting ``+Inf``), ``_sum``/``_count``, plus
+            ``<name>_min``/``<name>_max``/``<name>_last`` gauges.
         """
         with self._lock:
             items = sorted(self._metrics.items())
@@ -198,9 +260,24 @@ class MetricsRegistry:
                     emit(pname, "gauge", v)
             else:
                 s = m.snapshot()
-                lines.append(f"# TYPE {pname} summary")
-                lines.append(f"{pname}_count {float(s['count']):g}")
+                bounds, cum = m.bucket_counts()
+                lines.append(f"# TYPE {pname} histogram")
+                # Elide the empty head (cum 0) and the saturated tail
+                # (every bound past the max is a repeat of count) —
+                # the ladder spans 8 decades and most metrics live in
+                # 2; scrape size should track the data, not the ladder.
+                prev = 0
+                for b, c in zip(bounds, cum):
+                    if c == 0 or (c == prev and c == s["count"]):
+                        prev = c
+                        continue
+                    prev = c
+                    lines.append(f'{pname}_bucket{{le="{b:g}"}} {c:g}')
+                lines.append(
+                    f'{pname}_bucket{{le="+Inf"}} {float(s["count"]):g}'
+                )
                 lines.append(f"{pname}_sum {float(s['sum']):g}")
+                lines.append(f"{pname}_count {float(s['count']):g}")
                 for field in ("min", "max", "last"):
                     if s[field] is not None:
                         emit(f"{pname}_{field}", "gauge", s[field])

@@ -1,0 +1,254 @@
+"""Request-scoped tracing: trace/span IDs over the obs run log.
+
+PR 1's ``RunLog.span`` records flat timed blocks — fine for a
+single-threaded eval loop, blind once the serving path (PR 2) moves one
+request across thread boundaries: HTTP handler thread (admit) →
+batcher bookkeeping (queue wait) → worker thread (batch assembly,
+device dispatch) → handler thread again (respond). This module adds
+the structure those flat spans lack:
+
+* every span event carries ``trace_id`` / ``span_id`` / ``parent_id``
+  in the ordinary run-log envelope (schema v2, docs/OBSERVABILITY.md),
+  so one request's wall time decomposes into a tree that
+  ``tools/obs_report.py`` renders and ``tools/trace_export.py`` turns
+  into a Perfetto view;
+* propagation is ``contextvars``-based within a thread and **explicit**
+  across threads: :func:`current` captures the active context (e.g. at
+  ``DeadlineBatcher.submit``), :func:`attach` re-establishes it on the
+  worker thread, and :func:`emit_span` books externally-measured
+  durations (queue wait) into the right tree without a context switch;
+* one *batched* piece of work serves many requests: :func:`span` and
+  :func:`emit_span` fan out — under an :func:`attach` of several
+  requests' contexts they emit one span event **per requesting trace**,
+  so a batch's device time shows up in every rider's tree (with
+  ``batch_size`` telling the reader it was shared).
+
+Spans opened with no active trace degrade to the flat PR-1 form (a
+``kind: "span"`` event with no IDs) — library code instruments
+unconditionally, exactly like ``obs.event``.
+
+Also here: :func:`install_compile_telemetry` hooks ``jax.monitoring``
+duration listeners so every XLA backend compile lands in the run log as
+a ``compile`` event and in the ``jit.compile_time_s`` histogram — the
+recompile-storm signal for serving (an unwarmed bucket shape recompiles
+on the hot path; the histogram's count is the storm detector).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+import uuid
+from typing import Iterable, NamedTuple, Optional, Tuple
+
+
+class SpanCtx(NamedTuple):
+    """One active span: everything a child needs to parent onto it."""
+
+    trace_id: str
+    span_id: str
+
+
+#: Active span contexts for this thread/task. A tuple because one unit
+#: of work can serve several traces at once (a shared batch); () means
+#: no trace is active.
+_CTX: "contextvars.ContextVar[Tuple[SpanCtx, ...]]" = contextvars.ContextVar(
+    "ncnet_obs_trace_ctx", default=()
+)
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current() -> Tuple[SpanCtx, ...]:
+    """The active span context(s); capture at a thread boundary and
+    re-establish on the far side with :func:`attach`."""
+    return _CTX.get()
+
+
+@contextlib.contextmanager
+def attach(contexts: Iterable[SpanCtx]):
+    """Make ``contexts`` the active span context(s) for the block —
+    the cross-thread half of propagation (the batcher worker attaches
+    the union of its batch's request contexts before running the
+    engine, so engine spans land in every rider's tree)."""
+    token = _CTX.set(tuple(contexts))
+    try:
+        yield
+    finally:
+        _CTX.reset(token)
+
+
+def _emit(name: str, **fields) -> None:
+    # Late import: events imports metrics; trace must stay leaf-ish to
+    # avoid an import cycle with events' flight wiring.
+    from . import events
+
+    events.event(name, **fields)
+
+
+def emit_span(
+    name: str,
+    dur_s: float,
+    parents: Optional[Iterable[SpanCtx]] = None,
+    **fields,
+) -> None:
+    """Book one already-measured span into the tree(s).
+
+    For durations measured outside any single thread's control flow —
+    the batcher's queue wait is ``t_run - t_submit`` across two threads
+    and cannot be a ``with`` block anywhere. ``parents=None`` uses the
+    ambient context; an empty parent set degrades to a flat span event.
+    """
+    parents = current() if parents is None else tuple(parents)
+    if not parents:
+        _emit(name, kind="span", dur_s=dur_s, **fields)
+        return
+    for p in parents:
+        _emit(
+            name,
+            kind="span",
+            dur_s=dur_s,
+            trace_id=p.trace_id,
+            span_id=_new_id(),
+            parent_id=p.span_id,
+            **fields,
+        )
+
+
+@contextlib.contextmanager
+def span(name: str, sync=None, **fields):
+    """Timed block as a child of the active context(s).
+
+    Under a multi-context :func:`attach` (a shared batch) one event is
+    emitted per requesting trace — same duration, distinct
+    ``span_id``s. With no active trace this is exactly the flat
+    ``obs.span`` form. ``sync=`` follows PhaseTimer/RunLog.span: a
+    zero-arg callable (or jax value) blocked on at close, so device
+    work launched inside the block is attributed to it — never passed
+    on hot paths (ISSUE 1: no new device sync points).
+    """
+    parents = current()
+    if not parents:
+        from . import events
+
+        with events.span(name, sync=sync, **fields):
+            yield ()
+        return
+    children = tuple(SpanCtx(p.trace_id, _new_id()) for p in parents)
+    token = _CTX.set(children)
+    t0 = time.monotonic()
+    try:
+        yield children
+    except BaseException as exc:
+        dur = time.monotonic() - t0
+        _CTX.reset(token)
+        token = None
+        for p, c in zip(parents, children):
+            _emit(name, kind="span", dur_s=dur, trace_id=c.trace_id,
+                  span_id=c.span_id, parent_id=p.span_id,
+                  error=f"{type(exc).__name__}: {exc}", **fields)
+        raise
+    else:
+        if sync is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(sync() if callable(sync) else sync)
+            except Exception:
+                pass
+        dur = time.monotonic() - t0
+        for p, c in zip(parents, children):
+            _emit(name, kind="span", dur_s=dur, trace_id=c.trace_id,
+                  span_id=c.span_id, parent_id=p.span_id, **fields)
+    finally:
+        if token is not None:
+            _CTX.reset(token)
+
+
+@contextlib.contextmanager
+def trace(name: str, **fields):
+    """Root span of a NEW trace (one serving request, one eval query).
+
+    Yields the root :class:`SpanCtx`; everything opened inside — in
+    this thread, or on another thread via :func:`current`/
+    :func:`attach` — parents onto it. The root event is written at
+    close (after its children; readers build the tree from IDs, not
+    file order) with ``parent_id: None`` marking it a root.
+    """
+    root = SpanCtx(_new_id(), _new_id())
+    token = _CTX.set((root,))
+    t0 = time.monotonic()
+    try:
+        yield root
+    except BaseException as exc:
+        _emit(name, kind="span", dur_s=time.monotonic() - t0,
+              trace_id=root.trace_id, span_id=root.span_id, parent_id=None,
+              error=f"{type(exc).__name__}: {exc}", **fields)
+        raise
+    else:
+        _emit(name, kind="span", dur_s=time.monotonic() - t0,
+              trace_id=root.trace_id, span_id=root.span_id, parent_id=None,
+              **fields)
+    finally:
+        _CTX.reset(token)
+
+
+# -- jax.monitoring compile telemetry -------------------------------------
+
+_compile_telemetry_installed = False
+
+
+def install_compile_telemetry() -> bool:
+    """Register a ``jax.monitoring`` duration listener once (process
+    lifetime — jax keeps listeners global, so this is deliberately not
+    un-installable); returns whether the hook is live.
+
+    Every ``/jax/core/compile/backend_compile_duration`` event becomes
+    a run-log ``compile`` event plus an observation on the
+    ``jit.compile_time_s`` histogram (and a ``jit.compiles`` counter) —
+    with the PR's bucketed histograms, ``/metrics`` then exposes a
+    compile-time distribution a recompile storm visibly shifts. Other
+    ``/jax/core/compile/*`` stage durations (jaxpr trace, MLIR
+    lowering) are folded into ``jit.compile_time_s``-adjacent
+    histograms under their stage name but do not emit events — they
+    fire on cache hits too and would drown the signal.
+
+    Called from ``obs.init_run`` and the serving entry point; safe (and
+    a no-op) without jax installed, so the obs layer keeps working in
+    stubbed-out environments.
+    """
+    global _compile_telemetry_installed
+    if _compile_telemetry_installed:
+        return True
+    try:
+        from jax import monitoring as _monitoring
+    except Exception:
+        return False
+
+    def _listener(jax_event: str, duration: float, **kwargs) -> None:
+        try:
+            if "compile" not in jax_event:
+                return
+            from . import metrics
+
+            stage = jax_event.rstrip("/").rsplit("/", 1)[-1]
+            if stage == "backend_compile_duration":
+                metrics.counter("jit.compiles").inc()
+                metrics.histogram("jit.compile_time_s").observe(duration)
+                _emit("compile", jax_event=jax_event, dur_s=duration,
+                      **{k: str(v) for k, v in kwargs.items()})
+            else:
+                metrics.histogram(
+                    "jit." + stage.replace("_duration", "") + "_s"
+                ).observe(duration)
+        except Exception:
+            # A telemetry listener inside jit tracing must never take
+            # the compile down.
+            pass
+
+    _monitoring.register_event_duration_secs_listener(_listener)
+    _compile_telemetry_installed = True
+    return True

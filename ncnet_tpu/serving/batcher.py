@@ -44,9 +44,10 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 from .. import obs
+from ..obs import trace
 from ..utils.batching import ShapeBuckets
 
 
@@ -71,6 +72,11 @@ class _Pending:
     future: Future
     t_submit: float
     deadline: float
+    # Trace context captured on the SUBMITTING thread (obs/trace.py) —
+    # the batch runs on the worker thread, where contextvars would be
+    # empty; the worker re-attaches these so batch/device spans land in
+    # every rider's request tree.
+    trace_ctx: Tuple[trace.SpanCtx, ...] = ()
 
     def __repr__(self):  # payloads are image arrays; keep logs sane
         return (f"_Pending(bucket={self.bucket_key!r}, "
@@ -154,6 +160,7 @@ class DeadlineBatcher:
             future=Future(),
             t_submit=now,
             deadline=now + float(timeout_s),
+            trace_ctx=trace.current(),
         )
         with self._cond:
             if self._closed:
@@ -228,9 +235,19 @@ class DeadlineBatcher:
         obs.histogram("serving.batch_size").observe(len(chunk))
         for p in chunk:
             obs.histogram("serving.queue_wait_s").observe(t_run - p.t_submit)
+            # Queue wait spans two threads (submit → here); it can't be
+            # a `with` block anywhere, so book the measured duration
+            # into each request's tree explicitly.
+            trace.emit_span("queue_wait", dur_s=t_run - p.t_submit,
+                            parents=p.trace_ctx, batch_size=len(chunk))
+        # The runner executes ONE batch serving MANY traces: attach the
+        # union of the riders' contexts so engine spans (batch_assemble,
+        # device) fan out into every request's tree.
+        riders = tuple(c for p in chunk for c in p.trace_ctx)
         try:
-            results = self.runner(chunk[0].bucket_key,
-                                  [p.payload for p in chunk])
+            with trace.attach(riders):
+                results = self.runner(chunk[0].bucket_key,
+                                      [p.payload for p in chunk])
         except BaseException as exc:  # noqa: BLE001 — forwarded per-request
             obs.counter("serving.batch_errors").inc()
             for p in chunk:

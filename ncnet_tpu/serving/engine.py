@@ -29,12 +29,14 @@ from __future__ import annotations
 import base64
 import io
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
 from .. import obs
+from ..obs import trace
 from ..cli.eval_inloc import inloc_resize_shape, resolve_feat_units
 from ..evals import dedup_matches, inloc_device_matches
 from ..models.ncnet import extract_features, ncnet_forward_from_features
@@ -258,33 +260,62 @@ class MatchEngine:
 
     def run_batch(self, bucket_key, batch: List[Prepared]) -> List[dict]:
         """Run one same-bucket batch as one device dispatch; returns one
-        result dict per request (matches [n, 5] float32 + counts)."""
+        result dict per request (matches [n, 5] float32 + counts +
+        per-request ``timing``).
+
+        Runs under the batcher's trace attach (obs/trace.py), so the
+        ``batch_assemble``/``device`` spans land in every rider's
+        request tree. Timings are measured around work that ALREADY
+        syncs (``device_get`` is the existing D2H fetch) — no new
+        device sync points on the hot path.
+        """
         jnp = self._jnp
+        t_asm = time.monotonic()
         q_stack = jnp.concatenate([p.query for p in batch], axis=0)
         store = []
+        f_stack = t_stack = None
+        mode = "plain"
         if batch[0].pano_feats is not None:
             f_stack = jnp.stack(
                 [jnp.asarray(p.pano_feats) for p in batch], axis=0
             )
-            ms = self._batch_pairs_cached(self.params, q_stack, f_stack)
+            mode = "cached"
         else:
             t_stack = jnp.concatenate([p.pano for p in batch], axis=0)
             if self.cache is not None and any(p.pano_path for p in batch):
-                ms, feats = self._batch_pairs_with_feats(
-                    self.params, q_stack, t_stack
-                )
-                store = [(p, feats[k]) for k, p in enumerate(batch)
-                         if p.pano_path]
-            else:
-                ms = self._batch_pairs(self.params, q_stack, t_stack)
+                mode = "with_feats"
+        assemble_s = time.monotonic() - t_asm
+        trace.emit_span("batch_assemble", dur_s=assemble_s,
+                        batch_size=len(batch))
+
+        t_dev = time.monotonic()
+        if mode == "cached":
+            ms = self._batch_pairs_cached(self.params, q_stack, f_stack)
+        elif mode == "with_feats":
+            ms, feats = self._batch_pairs_with_feats(
+                self.params, q_stack, t_stack
+            )
+            store = [(p, feats[k]) for k, p in enumerate(batch)
+                     if p.pano_path]
+        else:
+            ms = self._batch_pairs(self.params, q_stack, t_stack)
         np_ms = self._jax.device_get(ms)
+        device_s = time.monotonic() - t_dev
+        trace.emit_span("device", dur_s=device_s, batch_size=len(batch))
+        obs.histogram("serving.device_time_s").observe(device_s)
+
+        timing = {
+            "batch_assemble_ms": assemble_s * 1e3,
+            "device_ms": device_s * 1e3,
+        }
         out = []
         for k, p in enumerate(batch):
             tup = dedup_matches(*(a[k] for a in np_ms))
             rows = np.stack(tup, axis=1).astype(np.float32)  # [n, 5]
             if p.max_matches > 0:
                 rows = rows[: p.max_matches]
-            out.append({"matches": rows, "n_matches": int(rows.shape[0])})
+            out.append({"matches": rows, "n_matches": int(rows.shape[0]),
+                        "timing": dict(timing)})
         for p, f in store:
             # D2H fetch inside put(); serialized so concurrent batches
             # don't race duplicate stores of the same pano.

@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import obs
+from ..obs import trace
 from .batcher import DeadlineBatcher, RejectedError
 from .engine import MatchEngine
 
@@ -136,27 +137,47 @@ class MatchServer:
         return (503 if stalled else 200), payload
 
     def handle_match(self, handler):
-        """Parse, admit, wait, respond. Returns (code, payload, headers)."""
+        """Parse, admit, wait, respond. Returns (code, payload, headers).
+
+        The whole lifecycle runs under one request-scoped trace
+        (obs/trace.py): ``admit`` (parse + host prepare) on this handler
+        thread, ``queue_wait``/``batch_assemble``/``device`` booked by
+        the batcher's worker into the same tree via the context captured
+        at submit, ``respond`` (payload build) back here.
+        """
+        with trace.trace("request") as root:
+            return self._handle_match_traced(handler, root)
+
+    def _handle_match_traced(self, handler, root):
         t0 = time.monotonic()
         obs.counter("serving.requests").inc()
-        try:
-            length = int(handler.headers.get("Content-Length", 0))
-            request = json.loads(handler.rfile.read(length) or b"{}")
-        except (ValueError, OSError) as exc:
-            obs.counter("serving.bad_requests").inc()
-            return 400, {"error": f"malformed request: {exc}"}, None
-        timeout_s = None
-        if request.get("deadline_ms") is not None:
+        # ``admit`` covers parse + host-side prepare only; submit happens
+        # AFTER the span closes so the worker's queue_wait span parents
+        # onto the request root, not onto admit.
+        t_admit = time.monotonic()
+        with trace.span("admit"):
             try:
-                timeout_s = max(float(request["deadline_ms"]) / 1000.0, 1e-3)
-            except (TypeError, ValueError):
+                length = int(handler.headers.get("Content-Length", 0))
+                request = json.loads(handler.rfile.read(length) or b"{}")
+            except (ValueError, OSError) as exc:
                 obs.counter("serving.bad_requests").inc()
-                return 400, {"error": "deadline_ms must be a number"}, None
-        try:
-            prepared = self.engine.prepare(request)
-        except ValueError as exc:
-            obs.counter("serving.bad_requests").inc()
-            return 400, {"error": str(exc)}, None
+                return 400, {"error": f"malformed request: {exc}"}, None
+            timeout_s = None
+            if request.get("deadline_ms") is not None:
+                try:
+                    timeout_s = max(
+                        float(request["deadline_ms"]) / 1000.0, 1e-3
+                    )
+                except (TypeError, ValueError):
+                    obs.counter("serving.bad_requests").inc()
+                    return (400, {"error": "deadline_ms must be a number"},
+                            None)
+            try:
+                prepared = self.engine.prepare(request)
+            except ValueError as exc:
+                obs.counter("serving.bad_requests").inc()
+                return 400, {"error": str(exc)}, None
+        admit_s = time.monotonic() - t_admit
         try:
             fut = self.batcher.submit(
                 prepared.bucket_key, prepared, timeout_s=timeout_s
@@ -182,7 +203,29 @@ class MatchServer:
             obs.counter("serving.errors").inc()
             obs.event("request_error", error=f"{type(exc).__name__}: {exc}")
             return 500, {"error": f"{type(exc).__name__}: {exc}"}, None
+        t_respond = time.monotonic()
+        with trace.span("respond"):
+            engine_timing = br.result.get("timing", {})
+            payload = {
+                "matches": br.result["matches"].tolist(),
+                "n_matches": br.result["n_matches"],
+                "batch_size": br.batch_size,
+                "queue_wait_ms": round(br.queue_wait_s * 1e3, 3),
+                "run_ms": round(br.run_s * 1e3, 3),
+                "trace_id": root.trace_id,
+            }
+        respond_s = time.monotonic() - t_respond
         e2e_s = time.monotonic() - t0
+        payload["latency_ms"] = round(e2e_s * 1e3, 3)
+        payload["timing"] = {
+            "admit_ms": round(admit_s * 1e3, 3),
+            "queue_wait_ms": round(br.queue_wait_s * 1e3, 3),
+            "batch_assemble_ms": round(
+                engine_timing.get("batch_assemble_ms", 0.0), 3),
+            "device_ms": round(engine_timing.get("device_ms", 0.0), 3),
+            "respond_ms": round(respond_s * 1e3, 3),
+            "total_ms": round(e2e_s * 1e3, 3),
+        }
         obs.counter("serving.responses").inc()
         obs.histogram("serving.e2e_latency_s").observe(e2e_s)
         obs.event(
@@ -192,15 +235,9 @@ class MatchServer:
             batch_size=br.batch_size,
             queue_wait_s=round(br.queue_wait_s, 6),
             e2e_s=round(e2e_s, 6),
+            trace_id=root.trace_id,
         )
-        return 200, {
-            "matches": br.result["matches"].tolist(),
-            "n_matches": br.result["n_matches"],
-            "batch_size": br.batch_size,
-            "queue_wait_ms": round(br.queue_wait_s * 1e3, 3),
-            "run_ms": round(br.run_s * 1e3, 3),
-            "latency_ms": round(e2e_s * 1e3, 3),
-        }, None
+        return 200, payload, None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -280,6 +317,10 @@ def main(argv=None):
     run_log = None
     if args.run_log:
         run_log = obs.init_run("serving", args.run_log, args=args)
+    # Even without a run log, compile telemetry feeds the jit.* metrics
+    # that /metrics exposes — the recompile-storm signal must not depend
+    # on --run_log being set.
+    obs.install_compile_telemetry()
 
     config, params = build_model(
         checkpoint=args.checkpoint,

@@ -18,14 +18,27 @@ from typing import Optional
 
 @contextlib.contextmanager
 def trace_context(logdir: Optional[str]):
-    """jax.profiler.trace if logdir is set; no-op otherwise."""
+    """jax.profiler.trace if logdir is set; no-op otherwise.
+
+    The ``profile_capture`` run-log events bracketing the capture carry
+    the wall-clock window tools/trace_export.py uses to align the
+    profiler's device timeline with the run log's spans.
+    """
     if not logdir:
         yield
         return
+    import time as _time
+
     import jax
 
+    from .. import obs
+
+    obs.event("profile_capture", phase="start", logdir=logdir,
+              t_capture_wall=_time.time())
     with jax.profiler.trace(logdir):
         yield
+    obs.event("profile_capture", phase="end", logdir=logdir,
+              t_capture_wall=_time.time())
 
 
 class PhaseTimer:

@@ -86,24 +86,37 @@ def assert_valid_runlog(path, component=None):
     """Schema check for an obs run log (docs/OBSERVABILITY.md).
 
     Shared by the CLI flow tests (train, eval_inloc) and test_obs.py:
-    every line carries the v1 envelope with one run_id; the run opens
-    with run_start (host/git/args metadata), records >= 1 heartbeat and
-    >= 1 metrics snapshot, and closes with run_end. Returns the parsed
-    records.
+    every line carries the envelope (schema v1 or v2 — v2 adds the
+    additive trace fields) with one run_id; the run opens with
+    run_start (host/git/args metadata), records >= 1 heartbeat and
+    >= 1 metrics snapshot, and closes with run_end. Traced span records
+    must form a valid tree: every non-null parent_id resolves to a
+    span_id in the same log. Returns the parsed records.
     """
     with open(path, encoding="utf-8") as fh:
         records = [json.loads(line) for line in fh if line.strip()]
     assert records, f"empty run log {path}"
     names = [r["event"] for r in records]
     for r in records:
-        assert r["v"] == 1
+        assert r["v"] in (1, 2)
         assert r["run_id"] == records[0]["run_id"]
         assert isinstance(r["event"], str)
         assert isinstance(r["t_wall"], float)
         assert isinstance(r["t_mono"], float)
+    # Traced spans must form a valid tree: every span has an id, and every
+    # non-root parent_id resolves. Non-span events may carry a bare trace_id
+    # for correlation (e.g. serving's `request` summary event).
+    span_ids = {r["span_id"] for r in records if r.get("span_id")}
+    for r in records:
+        if r.get("kind") == "span" and r.get("trace_id"):
+            assert r.get("span_id"), f"traced span missing span_id: {r}"
+            if r.get("parent_id") is not None:
+                assert r["parent_id"] in span_ids, (
+                    f"unresolved parent_id in {r}"
+                )
     start = records[0]
     assert start["event"] == "run_start"
-    assert start["schema"] == 1
+    assert start["schema"] in (1, 2)
     if component is not None:
         assert start["component"] == component
     for key in ("argv", "hostname", "pid", "python"):

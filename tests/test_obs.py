@@ -123,9 +123,10 @@ def test_metrics_kind_mismatch():
 
 
 def test_render_text_prometheus_exposition():
-    """render_text: counters -> _total, histograms -> summary with
-    _count/_sum + min/max/last gauges, dotted names sanitized, unset
-    gauges omitted (ISSUE 2 satellite 2 — /metrics serves this)."""
+    """render_text: counters -> _total, histograms -> Prometheus
+    histograms (cumulative _bucket lines + _sum/_count) with
+    min/max/last gauges kept, dotted names sanitized, unset gauges
+    omitted (ISSUE 2 satellite 2 — /metrics serves this)."""
     reg = MetricsRegistry()
     reg.counter("serving.requests").inc(3)
     reg.gauge("serving.queue_depth").set(2.5)
@@ -140,20 +141,56 @@ def test_render_text_prometheus_exposition():
     assert "# TYPE serving_queue_depth gauge" in text
     assert "serving_queue_depth 2.5" in text
     assert "never_set" not in text
-    assert "# TYPE serving_e2e_latency_s summary" in text
+    assert "# TYPE serving_e2e_latency_s histogram" in text
+    assert 'serving_e2e_latency_s_bucket{le="+Inf"} 2' in text
     assert "serving_e2e_latency_s_count 2" in text
     assert "serving_e2e_latency_s_sum 1" in text
     assert "serving_e2e_latency_s_min 0.25" in text
     assert "serving_e2e_latency_s_max 0.75" in text
     assert "serving_e2e_latency_s_last 0.75" in text
     assert text.endswith("\n")
-    # Every non-comment line is "name value" with a finite float value.
+    # Cumulative bucket counts: non-decreasing in le order, final
+    # bucket == count (the Prometheus histogram contract).
+    buckets = []
+    for line in text.splitlines():
+        m = re.match(
+            r'serving_e2e_latency_s_bucket\{le="([^"]+)"\} (\S+)', line)
+        if m:
+            le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+            buckets.append((le, float(m.group(2))))
+    assert buckets == sorted(buckets)
+    assert [c for _, c in buckets] == sorted(c for _, c in buckets)
+    assert buckets[-1] == (float("inf"), 2.0)
+    # 0.25 and 0.75 land in different log-spaced buckets.
+    assert any(c == 1.0 for _, c in buckets)
+    # Every non-comment line is "name[{labels}] value", finite value.
     for line in text.strip().splitlines():
         if line.startswith("#"):
             continue
-        name, value = line.split(" ")
-        assert _PROM_NAME_RE.fullmatch(name), line
-        float(value)
+        m = re.fullmatch(r'(\S+?)(\{[^}]*\})? ([^ ]+)', line)
+        assert m, line
+        assert _PROM_NAME_RE.fullmatch(m.group(1)), line
+        float(m.group(3))
+
+
+def test_histogram_quantiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("q.test_s")
+    for v in [0.01 * i for i in range(1, 101)]:  # 0.01 .. 1.00
+        h.observe(v)
+    snap = h.snapshot()
+    # Bucket-edge interpolation on a log ladder is coarse; the
+    # contract is ordering + clamping, not exact percentile values.
+    assert snap["min"] <= snap["p50"] <= snap["p95"] <= snap["p99"] \
+        <= snap["max"]
+    assert snap["p50"] == pytest.approx(0.5, rel=0.5)
+    assert snap["p99"] == pytest.approx(1.0, rel=0.35)
+    empty = reg.histogram("q.empty_s")
+    assert empty.snapshot()["p50"] is None
+    one = reg.histogram("q.one_s")
+    one.observe(3.0)
+    # A single observation: every quantile clamps to it exactly.
+    assert one.quantile(0.5) == 3.0 and one.quantile(0.99) == 3.0
 
 
 def test_render_text_sanitizes_hostile_names():
@@ -216,7 +253,9 @@ def test_heartbeat_thread_and_init_run(tmp_path):
     assert records[-1]["status"] == "ok"
 
 
-def test_watchdog_fake_clock():
+def test_watchdog_fake_clock(tmp_path, monkeypatch):
+    # Expiry dumps the flight ring (obs/flight.py); keep it out of cwd.
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", str(tmp_path))
     clock = FakeClock()
     fired = []
     wd = obs.Watchdog(label="t", clock=clock, on_expire=lambda: fired.append(1))

@@ -274,6 +274,17 @@ def test_serving_e2e_cpu(tiny_serving_model, tmp_path):
             assert len(r["matches"]) == r["n_matches"] <= 8
             assert all(len(row) == 5 for row in r["matches"])
             assert r["latency_ms"] >= r["queue_wait_ms"]
+            # Per-request lifecycle timing (schema v2): every stage
+            # present, totals consistent with the e2e latency.
+            timing = r["timing"]
+            assert set(timing) == {"admit_ms", "queue_wait_ms",
+                                   "batch_assemble_ms", "device_ms",
+                                   "respond_ms", "total_ms"}
+            assert all(v >= 0.0 for v in timing.values())
+            assert timing["device_ms"] > 0.0
+            assert timing["total_ms"] == r["latency_ms"]
+            assert r["trace_id"]
+        assert results[0]["trace_id"] != results[1]["trace_id"]
 
         # Path-referenced pano: miss populates the feature cache, the
         # repeat hits it and replays bit-identically.
@@ -293,11 +304,16 @@ def test_serving_e2e_cpu(tiny_serving_model, tmp_path):
             assert status == 400, (bad, payload)
             assert "error" in payload
 
-        # /metrics: Prometheus text of the default registry.
+        # /metrics: Prometheus text of the default registry, including
+        # cumulative histogram _bucket lines (schema v2 satellite).
         metrics = client.metrics()
         assert "# TYPE serving_batches_total counter" in metrics
         assert "serving_e2e_latency_s_count" in metrics
         assert "serving_batch_size_max 2" in metrics
+        assert "# TYPE serving_e2e_latency_s histogram" in metrics
+        assert 'serving_e2e_latency_s_bucket{le="+Inf"}' in metrics
+        assert 'serving_queue_wait_s_bucket{le="+Inf"}' in metrics
+        assert "serving_device_time_s_count" in metrics
 
         # Drain contract over the real engine: admit directly, then
         # stop() — every admitted request still completes.
@@ -318,6 +334,56 @@ def test_serving_e2e_cpu(tiny_serving_model, tmp_path):
     names = [r["event"] for r in records]
     assert "serving_start" in names and "serving_stop" in names
     assert "request" in names
+
+    # Request spans form a valid tree (the schema-v2 acceptance
+    # contract): every HTTP-served request root nests queue_wait +
+    # batch_assemble + device children booked from the worker thread.
+    spans = [r for r in records
+             if r.get("kind") == "span" and r.get("trace_id")]
+    roots = [r for r in spans
+             if r["event"] == "request" and r.get("parent_id") is None]
+    children = {}
+    for r in spans:
+        if r.get("parent_id") is not None:
+            children.setdefault(r["parent_id"], set()).add(r["event"])
+    # 400-path roots carry only an admit child; the served requests
+    # (2 concurrent + miss + hit) carry the full lifecycle.
+    full = [root for root in roots
+            if {"admit", "queue_wait", "respond"}
+            <= children.get(root["span_id"], set())]
+    assert len(full) >= 4, [children.get(r["span_id"]) for r in roots]
+    # Device-side spans fan out from the worker into request trees.
+    got = set().union(*children.values())
+    assert {"batch_assemble", "device"} <= got
+    # The batched pair of requests shares ONE device dispatch: their
+    # device spans carry batch_size 2 in two distinct trees.
+    dev2 = [r for r in spans
+            if r["event"] == "device" and r.get("batch_size") == 2]
+    assert len({r["trace_id"] for r in dev2}) >= 2
+
+    # The exporter turns this log into structurally valid Chrome-trace
+    # JSON (ph/ts/pid/tid; ts monotone within each tid).
+    import json as _json
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(__file__),
+                                      "..", "tools"))
+    import trace_export
+
+    out = str(tmp_path / "serving.trace.json")
+    data = trace_export.export(log_path, out)
+    with open(out, encoding="utf-8") as fh:
+        assert _json.load(fh) == data
+    by_tid = {}
+    for e in data["traceEvents"]:
+        assert e["ph"] in ("X", "i", "M")
+        if e["ph"] != "M":
+            by_tid.setdefault(e["tid"], []).append(e["ts"])
+    for tid, ts in by_tid.items():
+        assert ts == sorted(ts), f"non-monotone ts in tid {tid}"
+    x_names = {e["name"] for e in data["traceEvents"] if e["ph"] == "X"}
+    assert {"request", "admit", "queue_wait", "device"} <= x_names
 
 
 def _b64(data):

@@ -1,0 +1,440 @@
+"""Unit tests for request-scoped tracing (obs/trace.py), the flight
+recorder (obs/flight.py), compile telemetry, and the trace-export /
+bench-trend tools.
+
+The serving e2e test (test_serving.py) checks the full request
+lifecycle tree over a live server; here the mechanisms are exercised in
+isolation: context propagation across the batcher's thread boundary
+with a fake clock, fan-out span emission for shared batches, flight
+dumps on simulated watchdog/stall fires, and the Chrome trace event
+format contract (ph/ts/pid/tid, monotone ts per tid) of
+tools/trace_export.py.
+"""
+
+import gzip
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from conftest import assert_valid_runlog
+from ncnet_tpu import obs
+from ncnet_tpu.obs import events as obs_events
+from ncnet_tpu.obs import flight, trace
+from ncnet_tpu.serving.batcher import DeadlineBatcher
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import bench_trend  # noqa: E402
+import trace_export  # noqa: E402
+
+
+def _load(path):
+    with open(path, encoding="utf-8") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# -- span tree basics -----------------------------------------------------
+
+
+def test_trace_span_nesting_ids(tmp_path):
+    path = tmp_path / "t.jsonl"
+    run = obs.init_run("unit", str(path), heartbeat_s=600.0)
+    try:
+        with trace.trace("request", q=1) as root:
+            with trace.span("admit") as (admit,):
+                with trace.span("parse"):
+                    pass
+            with trace.span("respond"):
+                pass
+    finally:
+        run.close()
+    records = assert_valid_runlog(path, component="unit")
+    spans = {r["event"]: r for r in records if r.get("kind") == "span"}
+    assert set(spans) == {"request", "admit", "parse", "respond"}
+    req = spans["request"]
+    assert req["trace_id"] == root.trace_id
+    assert req["span_id"] == root.span_id
+    assert req["parent_id"] is None and req["q"] == 1
+    for name in ("admit", "respond"):
+        assert spans[name]["parent_id"] == root.span_id
+        assert spans[name]["trace_id"] == root.trace_id
+    assert spans["parse"]["parent_id"] == admit.span_id
+    # After the trace block the ambient context is clean again.
+    assert trace.current() == ()
+
+
+def test_span_without_trace_degrades_flat(tmp_path):
+    run = obs.init_run("unit", str(tmp_path / "f.jsonl"), heartbeat_s=0)
+    assert trace.current() == ()
+    with trace.span("lonely"):
+        pass
+    trace.emit_span("measured", dur_s=0.5)
+    run.close()
+    records = _load(tmp_path / "f.jsonl")
+    lonely = next(r for r in records if r["event"] == "lonely")
+    measured = next(r for r in records if r["event"] == "measured")
+    assert "trace_id" not in lonely and "trace_id" not in measured
+    assert measured["dur_s"] == 0.5
+
+
+def test_trace_span_error_recorded(tmp_path):
+    run = obs.init_run("unit", str(tmp_path / "e.jsonl"), heartbeat_s=0)
+    with pytest.raises(ValueError):
+        with trace.trace("request"):
+            with trace.span("work"):
+                raise ValueError("nope")
+    run.close()
+    records = _load(tmp_path / "e.jsonl")
+    work = next(r for r in records if r["event"] == "work")
+    req = next(r for r in records if r["event"] == "request")
+    assert work["error"].startswith("ValueError")
+    assert req["error"].startswith("ValueError")
+    assert work["parent_id"] == req["span_id"]
+    assert trace.current() == ()
+
+
+def test_fanout_one_event_per_rider(tmp_path):
+    run = obs.init_run("unit", str(tmp_path / "fan.jsonl"), heartbeat_s=0)
+    a = trace.SpanCtx("trace-a", "span-a")
+    b = trace.SpanCtx("trace-b", "span-b")
+    with trace.attach((a, b)):
+        with trace.span("device", batch_size=2):
+            pass
+        trace.emit_span("queue_wait", dur_s=0.25)
+    run.close()
+    records = _load(tmp_path / "fan.jsonl")
+    dev = [r for r in records if r["event"] == "device"]
+    qw = [r for r in records if r["event"] == "queue_wait"]
+    assert {r["trace_id"] for r in dev} == {"trace-a", "trace-b"}
+    assert {r["parent_id"] for r in dev} == {"span-a", "span-b"}
+    assert {r["trace_id"] for r in qw} == {"trace-a", "trace-b"}
+    # Same shared duration, distinct span ids.
+    assert len({r["span_id"] for r in dev + qw}) == 4
+    assert dev[0]["dur_s"] == dev[1]["dur_s"]
+
+
+# -- propagation across the batcher thread --------------------------------
+
+
+def test_batcher_propagates_trace_across_thread(tmp_path):
+    path = tmp_path / "b.jsonl"
+    run = obs.init_run("unit", str(path), heartbeat_s=600.0)
+    clock = FakeClock()
+    worker_ctx = {}
+
+    def runner(key, payloads):
+        # The worker thread has NO ambient context of its own; the
+        # batcher attaches the riders' contexts around this call.
+        worker_ctx["riders"] = trace.current()
+        with trace.span("device", batch_size=len(payloads)):
+            pass
+        return list(payloads)
+
+    batcher = DeadlineBatcher(runner, max_batch=2, max_delay_s=10.0,
+                              clock=clock)
+    try:
+        with trace.trace("request") as root1:
+            f1 = batcher.submit("k", "a")
+        clock.t = 1.5
+        with trace.trace("request") as root2:
+            f2 = batcher.submit("k", "b")  # fills the bucket -> ready
+        # Run the batch from ANOTHER thread: contextvars do not flow
+        # there implicitly; propagation must be the explicit capture at
+        # submit + attach in _run.
+        t = threading.Thread(target=batcher.poll)
+        t.start()
+        t.join(timeout=10)
+        assert f1.result(timeout=1).result == "a"
+        assert f2.result(timeout=1).result == "b"
+    finally:
+        batcher.close()
+        run.close()
+    assert {c.trace_id for c in worker_ctx["riders"]} == {
+        root1.trace_id, root2.trace_id}
+    records = assert_valid_runlog(path)
+    qw = [r for r in records if r.get("event") == "queue_wait"]
+    dev = [r for r in records if r.get("event") == "device"]
+    assert len(qw) == 2 and len(dev) == 2
+    # queue_wait parents onto each request ROOT with the fake-clock
+    # measured wait (t_run - t_submit).
+    by_trace = {r["trace_id"]: r for r in qw}
+    assert by_trace[root1.trace_id]["parent_id"] == root1.span_id
+    assert by_trace[root1.trace_id]["dur_s"] == pytest.approx(1.5)
+    assert by_trace[root2.trace_id]["dur_s"] == pytest.approx(0.0)
+    # device fans out into both riders' trees.
+    assert {r["parent_id"] for r in dev} == {root1.span_id, root2.span_id}
+    assert all(r["batch_size"] == 2 for r in dev)
+
+
+# -- flight recorder ------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    rec = flight.FlightRecorder(capacity=16)
+    for i in range(40):
+        rec.record({"event": "e", "i": i})
+    assert len(rec) == 16
+    assert rec.snapshot()[0]["i"] == 24  # oldest surviving record
+    path = rec.dump("test", directory=str(tmp_path))
+    assert path and os.path.exists(path)
+    lines = _load(path)
+    assert lines[0]["event"] == "flight_dump"
+    assert lines[0]["reason"] == "test"
+    assert lines[0]["n_records"] == 16
+    assert [l["i"] for l in lines[1:]] == list(range(24, 40))
+    # Same-reason redump inside the cooldown window is suppressed...
+    assert rec.dump("test", directory=str(tmp_path)) is None
+    # ...unless forced; other reasons are independent.
+    assert rec.dump("test", directory=str(tmp_path), force=True)
+    assert rec.dump("other", directory=str(tmp_path))
+
+
+def test_flight_ring_taps_events_without_run():
+    assert obs.get_run() is obs.NULL_RUN
+    flight.recorder().clear()
+    obs.event("flight_probe", k=1)
+    recs = flight.recorder().snapshot()
+    assert any(r["event"] == "flight_probe" and r["k"] == 1 for r in recs)
+    # The no-run record still carries the envelope.
+    probe = next(r for r in recs if r["event"] == "flight_probe")
+    assert probe["v"] == obs_events.SCHEMA_VERSION
+    assert probe["run_id"] is None
+
+
+def test_watchdog_fire_dumps_flight(tmp_path, monkeypatch):
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", str(tmp_path))
+    flight.recorder().clear()
+    obs.event("about_to_wedge", step=7)
+    clock = FakeClock()
+    fired = []
+    wd = obs.Watchdog(label="wedge_test", clock=clock,
+                      on_expire=lambda: fired.append(1))
+    wd.arm(10.0)
+    clock.t = 11.0
+    assert wd.check() is True and fired == [1]
+    dumps = [p for p in os.listdir(tmp_path)
+             if p.startswith("flight-watchdog-wedge_test")]
+    assert len(dumps) == 1
+    lines = _load(tmp_path / dumps[0])
+    assert lines[0]["reason"] == "watchdog-wedge_test"
+    assert any(r.get("event") == "about_to_wedge" for r in lines[1:])
+
+
+def test_stall_dumps_flight_next_to_runlog(tmp_path):
+    flight.recorder().clear()
+    clock = FakeClock()
+    run = obs_events.RunLog(str(tmp_path / "s.jsonl"), "unit", clock=clock)
+    hb = obs.Heartbeat(run, interval_s=10.0, stall_after_s=25.0, clock=clock)
+    assert hb.beat_once()["stalled"] is False
+    clock.t = 30.0
+    assert hb.beat_once()["stalled"] is True
+    run.close()
+    dumps = [p for p in os.listdir(tmp_path) if p.startswith("flight-stall")]
+    assert len(dumps) == 1
+    lines = _load(tmp_path / dumps[0])
+    assert lines[0]["reason"] == "stall"
+    assert any(r.get("event") == "stall" for r in lines[1:])
+
+
+def test_thread_excepthook_dumps_flight(tmp_path, monkeypatch):
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", str(tmp_path))
+    obs_events._install_exit_hooks()
+    flight.recorder().clear()
+    obs.event("pre_crash_marker")
+
+    def boom():
+        raise RuntimeError("worker died")
+
+    t = threading.Thread(target=boom, name="crashy_worker")
+    t.start()
+    t.join(timeout=10)
+    dumps = [p for p in os.listdir(tmp_path)
+             if p.startswith("flight-thread-RuntimeError")]
+    assert len(dumps) == 1
+    lines = _load(tmp_path / dumps[0])
+    assert any(r.get("event") == "pre_crash_marker" for r in lines[1:])
+
+
+# -- compile telemetry ----------------------------------------------------
+
+
+def test_compile_telemetry_listener(tmp_path):
+    from jax import monitoring
+
+    assert obs.install_compile_telemetry() is True
+    run = obs.init_run("unit", str(tmp_path / "c.jsonl"), heartbeat_s=0)
+    try:
+        monitoring.record_event_duration_secs(
+            "/jax/core/compile/backend_compile_duration", 0.123)
+        monitoring.record_event_duration_secs(
+            "/jax/core/compile/jaxpr_trace_duration", 0.01)
+    finally:
+        run.close()
+    snap = obs.snapshot()
+    assert snap["counters"].get("jit.compiles", 0) >= 1
+    assert snap["histograms"]["jit.compile_time_s"]["count"] >= 1
+    # Non-backend stages feed histograms but emit no events (they fire
+    # on cache hits too and would drown the storm signal).
+    assert snap["histograms"]["jit.jaxpr_trace_s"]["count"] >= 1
+    records = _load(tmp_path / "c.jsonl")
+    compiles = [r for r in records if r["event"] == "compile"]
+    assert any(r["dur_s"] == pytest.approx(0.123) for r in compiles)
+    assert not any(
+        "jaxpr_trace" in r.get("jax_event", "") for r in compiles)
+
+
+# -- trace_export ---------------------------------------------------------
+
+
+def _make_traced_runlog(tmp_path):
+    path = tmp_path / "x.jsonl"
+    run = obs.init_run("unit", str(path), heartbeat_s=0)
+    try:
+        for q in range(2):
+            with trace.trace("request", q=q):
+                with trace.span("admit"):
+                    pass
+                with trace.span("device"):
+                    time.sleep(0.002)
+    finally:
+        run.close()
+    return path
+
+
+def test_trace_export_chrome_format(tmp_path):
+    log = _make_traced_runlog(tmp_path)
+    out = tmp_path / "out.trace.json"
+    data = trace_export.export(str(log), str(out))
+    with open(out, encoding="utf-8") as fh:
+        assert json.load(fh) == data
+    events = data["traceEvents"]
+    assert data["displayTimeUnit"] == "ms"
+    assert events, "no events exported"
+    for e in events:
+        assert e["ph"] in ("X", "i", "M")
+        assert isinstance(e["pid"], int)
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], float) and e["ts"] > 0
+            assert isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0.0 and e["name"]
+    # Metadata: one process row + one thread row per trace (+untraced).
+    meta = [e for e in events if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in meta)
+    thread_names = [e for e in meta if e["name"] == "thread_name"]
+    assert len(thread_names) == 3  # untraced + 2 request traces
+    # ts monotone within each tid (the acceptance contract).
+    by_tid = {}
+    for e in events:
+        if e["ph"] != "M":
+            by_tid.setdefault(e["tid"], []).append(e["ts"])
+    assert by_tid
+    for tid, ts in by_tid.items():
+        assert ts == sorted(ts), f"non-monotone ts in tid {tid}"
+    # One swimlane per trace: each request tid carries its 3 spans.
+    x_by_tid = {}
+    for e in events:
+        if e["ph"] == "X":
+            x_by_tid.setdefault(e["tid"], set()).add(e["name"])
+    assert sum(1 for names in x_by_tid.values()
+               if names == {"request", "admit", "device"}) == 2
+
+
+def test_trace_export_merges_profile_capture(tmp_path):
+    # Synthetic jax.profiler capture in the on-disk layout traceagg
+    # reads: <dir>/plugins/profile/<stamp>/*.trace.json.gz.
+    prof_dir = tmp_path / "prof"
+    stamp_dir = prof_dir / "plugins" / "profile" / "20260805"
+    os.makedirs(stamp_dir)
+    capture = {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "name": "fusion.1", "pid": 7, "tid": 1,
+             "ts": 1000.0, "dur": 50.0, "args": {}},
+        ]
+    }
+    with gzip.open(stamp_dir / "host.trace.json.gz", "wt") as fh:
+        json.dump(capture, fh)
+
+    path = tmp_path / "p.jsonl"
+    run = obs.init_run("unit", str(path), heartbeat_s=0)
+    try:
+        wall = time.time()
+        run.event("profile_capture", phase="start",
+                  logdir=str(prof_dir), t_capture_wall=wall)
+        with trace.trace("request"):
+            pass
+        run.event("profile_capture", phase="end",
+                  logdir=str(prof_dir), t_capture_wall=time.time())
+    finally:
+        run.close()
+    out = tmp_path / "merged.trace.json"
+    data = trace_export.export(str(path), str(out),
+                               profile_dir=str(prof_dir))
+    fusion = [e for e in data["traceEvents"] if e.get("name") == "fusion.1"]
+    assert len(fusion) == 1
+    # pid offset keeps the device plane distinct from the runlog plane;
+    # ts is shifted onto the run log's wall-clock timebase.
+    assert fusion[0]["pid"] == trace_export.PROFILE_PID_BASE + 7
+    assert fusion[0]["ts"] == pytest.approx(wall * 1e6, abs=5e6)
+    req = [e for e in data["traceEvents"]
+           if e.get("name") == "request" and e["ph"] == "X"]
+    assert req and abs(req[0]["ts"] - fusion[0]["ts"]) < 60e6
+
+
+# -- bench_trend ----------------------------------------------------------
+
+
+def _write_round(d, n, metric, value):
+    rec = {"n": n, "cmd": "bench", "rc": 0,
+           "parsed": {"metric": metric, "value": value, "unit": "pairs/s"}}
+    with open(os.path.join(d, f"BENCH_r{n:02d}.json"), "w") as fh:
+        json.dump(rec, fh)
+
+
+def test_bench_trend_report_and_gate(tmp_path, capsys):
+    d = str(tmp_path)
+    _write_round(d, 1, "m_cpu_smoke", 0.45)   # different metric: ignored
+    _write_round(d, 2, "m", 8.0)
+    _write_round(d, 3, "m", 10.0)
+    _write_round(d, 4, "m", 9.8)              # -2%: within threshold
+    assert bench_trend.main(["--dir", d, "--strict"]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["metric"] == "m"
+    assert report["latest"] == 9.8 and report["latest_round"] == 4
+    assert report["best_prior"] == 10.0
+    assert report["rel_vs_best_prior"] == pytest.approx(-0.02)
+    assert report["regressed"] is False
+    # Only same-metric rounds enter the series.
+    assert [r["round"] for r in report["rounds"]] == [2, 3, 4]
+
+    _write_round(d, 5, "m", 5.0)              # -50%: regression
+    assert bench_trend.main(["--dir", d]) == 0          # report-only
+    assert bench_trend.main(["--dir", d, "--strict"]) == 1
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["regressed"] is True
+
+    # A cross-hardware metric change is a fresh series, not a regression.
+    _write_round(d, 6, "m_other_chip", 1.0)
+    assert bench_trend.main(["--dir", d, "--strict"]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["metric"] == "m_other_chip"
+    assert report["best_prior"] is None
+
+
+def test_bench_trend_empty_dir(tmp_path, capsys):
+    assert bench_trend.main(["--dir", str(tmp_path), "--strict"]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["metric"] is None and report["n_rounds"] == 0

@@ -1,0 +1,119 @@
+"""Trend report over the repo's ``BENCH_r*.json`` benchmark rounds.
+
+Each bench round drops one ``BENCH_r<NN>.json`` (bench.py's contract:
+``{n, cmd, rc, parsed}`` with the headline under ``parsed``:
+``{metric, value, unit, ...}``). This tool reads every round, groups by
+headline metric name — rounds benched on different hardware use
+different metric names (the ``_cpu_smoke`` suffix), and cross-hardware
+numbers must never be compared — and prints ONE JSON line::
+
+    python tools/bench_trend.py
+    {"metric": "...", "rounds": [...], "latest": 9.71, "best_prior": ...,
+     "rel_vs_best_prior": ..., "regressed": false, ...}
+
+``--strict`` makes a regression (latest more than ``--threshold``
+below the best prior same-metric round, higher-is-better) a nonzero
+exit, so a session script can gate on it the same way tier-1 tests
+gate a commit. One JSON line on stdout is the whole machine-readable
+contract (the bench_serving.py posture); prose goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_rounds(directory: str) -> List[Tuple[int, dict]]:
+    """[(round number, record)] for every parseable BENCH_r*.json."""
+    rounds = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"skipping {path}: {exc}", file=sys.stderr)
+            continue
+        rounds.append((int(m.group(1)), rec))
+    return sorted(rounds)
+
+
+def _headline(rec: dict) -> Optional[dict]:
+    p = rec.get("parsed")
+    if isinstance(p, dict) and "metric" in p and "value" in p:
+        return p
+    return None
+
+
+def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
+    """Trend of the LATEST round's headline metric vs prior rounds of
+    the SAME metric (higher is better — every headline so far is a
+    throughput)."""
+    parsed = [(n, _headline(rec)) for n, rec in rounds]
+    parsed = [(n, h) for n, h in parsed if h is not None]
+    if not parsed:
+        return {"metric": None, "rounds": [], "latest": None,
+                "best_prior": None, "rel_vs_best_prior": None,
+                "regressed": False, "n_rounds": 0,
+                "threshold": threshold}
+    latest_n, latest = parsed[-1]
+    metric = latest["metric"]
+    same = [(n, h["value"]) for n, h in parsed if h["metric"] == metric]
+    series = [{"round": n, "value": v} for n, v in same]
+    prior = [v for n, v in same if n != latest_n]
+    best_prior = max(prior) if prior else None
+    rel = None
+    regressed = False
+    if best_prior:
+        rel = (latest["value"] - best_prior) / best_prior
+        regressed = rel < -threshold
+    return {
+        "metric": metric,
+        "unit": latest.get("unit"),
+        "rounds": series,
+        "latest": latest["value"],
+        "latest_round": latest_n,
+        "best_prior": best_prior,
+        "rel_vs_best_prior": rel,
+        "regressed": regressed,
+        "n_rounds": len(parsed),
+        "threshold": threshold,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default .)")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative drop vs best prior same-metric round "
+                         "that counts as a regression (default 0.05)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on regression")
+    args = ap.parse_args(argv)
+
+    report = trend(load_rounds(args.dir), args.threshold)
+    print(json.dumps(report))
+    if report["metric"] is None:
+        print("no parseable bench rounds found", file=sys.stderr)
+    elif report["regressed"]:
+        print(
+            f"REGRESSION: {report['metric']} {report['latest']:g} is "
+            f"{-report['rel_vs_best_prior']:.1%} below best prior "
+            f"{report['best_prior']:g}", file=sys.stderr,
+        )
+    return 1 if (args.strict and report["regressed"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,6 +90,43 @@ def span_rollup(records: List[dict]) -> Dict[str, dict]:
     return out
 
 
+def _spans(records: List[dict]) -> List[dict]:
+    return [r for r in records
+            if r.get("kind") == "span" and "dur_s" in r]
+
+
+def span_tree(records: List[dict]) -> Dict[tuple, dict]:
+    """Aggregate traced spans (schema v2 trace_id/span_id/parent_id)
+    by their NAME PATH from root: {("request", "queue_wait"): {count,
+    total_s, max_s, mean_s}, ...}.
+
+    A span whose parent_id doesn't resolve (its parent record was lost
+    to a crash mid-write) roots its own subtree rather than vanishing.
+    """
+    spans = [r for r in _spans(records) if r.get("span_id")]
+    by_id = {r["span_id"]: r for r in spans}
+    out: Dict[tuple, dict] = {}
+    for r in spans:
+        path, node, seen = [], r, set()
+        while node is not None and node["span_id"] not in seen:
+            seen.add(node["span_id"])
+            path.append(node["event"])
+            node = by_id.get(node.get("parent_id"))
+        key = tuple(reversed(path))
+        agg = out.setdefault(key, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += r["dur_s"]
+        agg["max_s"] = max(agg["max_s"], r["dur_s"])
+    for agg in out.values():
+        agg["mean_s"] = agg["total_s"] / agg["count"]
+    return out
+
+
+def slowest_spans(records: List[dict], n: int = 10) -> List[dict]:
+    """The n individually-slowest span records, longest first."""
+    return sorted(_spans(records), key=lambda r: -r["dur_s"])[:n]
+
+
 def summarize(path: str, records: List[dict], out=None) -> None:
     w = (out or sys.stdout).write
     if not records:
@@ -134,6 +171,23 @@ def summarize(path: str, records: List[dict], out=None) -> None:
             w(f"    {name:<28} x{agg['count']:<5} total "
               f"{agg['total_s']:8.2f}s  mean {agg['mean_s']:.3f}s  "
               f"max {agg['max_s']:.3f}s\n")
+
+    tree = span_tree(records)
+    if tree:
+        w("  span tree (traced):\n")
+        # Lexicographic path order keeps children under their parent;
+        # indentation = depth.
+        for path, agg in sorted(tree.items()):
+            indent = "  " * (len(path) - 1)
+            label = indent + path[-1]
+            w(f"    {label:<28} x{agg['count']:<5} total "
+              f"{agg['total_s']:8.2f}s  mean {agg['mean_s']:.3f}s  "
+              f"max {agg['max_s']:.3f}s\n")
+        slow = slowest_spans(records, n=10)
+        w("  slowest spans:\n")
+        for r in slow:
+            tid = (r.get("trace_id") or "-")[:8]
+            w(f"    {r['event']:<28} {r['dur_s']:9.3f}s  trace {tid}\n")
 
     metrics = final_metrics(records)
     if metrics:

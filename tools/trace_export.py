@@ -1,0 +1,222 @@
+"""Export a structured run log as Chrome-trace / Perfetto JSON.
+
+Converts the ``runlog-*.jsonl`` span records (schema v2,
+docs/OBSERVABILITY.md) into the Chrome trace event format that
+``chrome://tracing`` and https://ui.perfetto.dev load directly::
+
+    python tools/trace_export.py out/runlog-serving-*.jsonl -o trace.json
+
+Mapping:
+
+* every ``kind: "span"`` record becomes one complete ("X") event; its
+  begin timestamp is ``t_wall - dur_s`` (spans are logged at close);
+* each ``trace_id`` gets its own thread row (tid), so one serving
+  request's admit → queue_wait → batch_assemble → device → respond
+  chain reads as one swimlane; spans without trace ids share an
+  "untraced" row;
+* other events (``request``, ``compile``, ``stall``, ...) become
+  instant ("i") events on their trace's row; bulky payloads
+  (``metrics`` snapshots) are elided to a marker;
+* process/thread names are emitted as metadata ("M") events.
+
+``--profile_dir`` additionally merges the newest ``jax.profiler``
+capture under that directory (the ``<dir>/plugins/profile/<stamp>/``
+layout ``utils/profiling.trace_context`` writes) into the same file,
+aligned on wall-clock time via the ``profile_capture`` run-log event —
+host-side request spans and the device-side XLA op timeline in one
+Perfetto view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: pid of the run-log (host) process row in the exported trace.
+RUNLOG_PID = 1
+
+#: Profiler planes keep their own pids, offset past the run-log's.
+PROFILE_PID_BASE = 1000
+
+#: Events whose payloads are too bulky to inline as instant-event args.
+_ELIDE_ARGS_EVENTS = frozenset({"metrics", "run_start"})
+
+
+def load_records(path: str) -> List[dict]:
+    """All complete JSON records of one run log (same crash tolerance
+    as tools/obs_report.load_run)."""
+    records = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records
+
+
+_ENVELOPE = frozenset({"v", "run_id", "event", "t_wall", "t_mono",
+                       "kind", "dur_s", "trace_id", "span_id",
+                       "parent_id"})
+
+
+def _args_of(rec: dict) -> dict:
+    """Scalar non-envelope fields -> Chrome event args."""
+    out = {}
+    for k, v in rec.items():
+        if k in _ENVELOPE:
+            continue
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+    for k in ("span_id", "parent_id"):
+        if rec.get(k) is not None:
+            out[k] = rec[k]
+    return out
+
+
+def records_to_trace(records: List[dict]) -> List[dict]:
+    """Run-log records -> Chrome trace events (sorted by ts, metadata
+    first; ts is monotone within every (pid, tid))."""
+    tids: Dict[Optional[str], int] = {None: 0}
+
+    def tid_of(trace_id: Optional[str]) -> int:
+        if trace_id not in tids:
+            tids[trace_id] = len(tids)
+        return tids[trace_id]
+
+    events: List[dict] = []
+    component = None
+    for rec in records:
+        if rec.get("event") == "run_start" and component is None:
+            component = rec.get("component")
+        t_wall = rec.get("t_wall")
+        if t_wall is None:
+            continue
+        tid = tid_of(rec.get("trace_id"))
+        if rec.get("kind") == "span" and rec.get("dur_s") is not None:
+            dur_s = float(rec["dur_s"])
+            events.append({
+                "name": rec.get("event", "?"),
+                "ph": "X",
+                "ts": (float(t_wall) - dur_s) * 1e6,
+                "dur": dur_s * 1e6,
+                "pid": RUNLOG_PID,
+                "tid": tid,
+                "args": _args_of(rec),
+            })
+        else:
+            name = rec.get("event", "?")
+            args = ({} if name in _ELIDE_ARGS_EVENTS else _args_of(rec))
+            events.append({
+                "name": name,
+                "ph": "i",
+                "ts": float(t_wall) * 1e6,
+                "pid": RUNLOG_PID,
+                "tid": tid,
+                "s": "t",  # thread-scoped instant marker
+                "args": args,
+            })
+    events.sort(key=lambda e: e["ts"])
+
+    meta: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": RUNLOG_PID,
+        "args": {"name": f"runlog {component or '?'}"},
+    }]
+    for trace_id, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        label = "untraced" if trace_id is None else f"trace {trace_id[:8]}"
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": RUNLOG_PID,
+            "tid": tid, "args": {"name": label},
+        })
+    return meta + events
+
+
+def _import_traceagg():
+    try:
+        from ncnet_tpu.utils import traceagg
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from ncnet_tpu.utils import traceagg
+    return traceagg
+
+
+def merge_profile(
+    trace_events: List[dict],
+    profile_dir: str,
+    records: List[dict],
+) -> Tuple[str, int]:
+    """Append the newest jax.profiler capture under ``profile_dir``,
+    shifted onto the run log's wall-clock timebase.
+
+    The profiler's ``ts`` values are in its own timebase; the run log's
+    ``profile_capture`` (phase=start) event records the wall time the
+    capture began, so ``wall_start*1e6 - min(ts)`` is the alignment
+    offset. Without that event the capture is appended unshifted — the
+    two timelines are still in one file, just not co-registered.
+    Returns (capture path, number of merged events).
+    """
+    traceagg = _import_traceagg()
+    path, prof_events = traceagg.load_events(profile_dir)
+    start = next(
+        (r for r in records
+         if r.get("event") == "profile_capture" and r.get("phase") == "start"),
+        None,
+    )
+    offset = 0.0
+    ts_vals = [float(e["ts"]) for e in prof_events if "ts" in e]
+    if start is not None and ts_vals:
+        wall = float(start.get("t_capture_wall", start.get("t_wall", 0.0)))
+        offset = wall * 1e6 - min(ts_vals)
+    n = 0
+    for e in prof_events:
+        e = dict(e)
+        if "pid" in e:
+            e["pid"] = PROFILE_PID_BASE + int(e["pid"])
+        if "ts" in e:
+            e["ts"] = float(e["ts"]) + offset
+        trace_events.append(e)
+        n += 1
+    return path, n
+
+
+def export(log_path: str, out_path: str,
+           profile_dir: Optional[str] = None) -> dict:
+    """Convert one run log (plus optional profiler capture) and write
+    the Chrome-trace JSON; returns the trace dict."""
+    records = load_records(log_path)
+    events = records_to_trace(records)
+    if profile_dir:
+        merge_profile(events, profile_dir, records)
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(trace, fh)
+    return trace
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log", help="run-log JSONL file")
+    ap.add_argument("-o", "--out", default="",
+                    help="output path (default <log>.trace.json)")
+    ap.add_argument("--profile_dir", default="",
+                    help="merge the newest jax.profiler capture under "
+                         "this directory (plugins/profile/<stamp>/)")
+    args = ap.parse_args(argv)
+    out = args.out or (os.path.splitext(args.log)[0] + ".trace.json")
+    trace = export(args.log, out, profile_dir=args.profile_dir or None)
+    n_x = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    n_i = sum(1 for e in trace["traceEvents"] if e.get("ph") == "i")
+    print(f"wrote {out}: {len(trace['traceEvents'])} events "
+          f"({n_x} spans, {n_i} instants)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
