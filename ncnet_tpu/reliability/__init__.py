@@ -1,0 +1,50 @@
+"""Reliability subsystem: fault injection, retries, circuit breaking.
+
+Production posture (ROADMAP north star, the FireCaffe / large-scale
+training lineage in PAPERS.md): component failure is the steady state,
+so every failure domain gets (1) a named injection site to *create*
+the failure on demand, (2) a recovery policy, and (3) a test. The
+three legs:
+
+* :mod:`.failpoints` — named chaos-injection sites
+  (``NCNET_FAILPOINTS="engine.device=error:0.5"``), planted through
+  data, serving, and checkpoint paths;
+* :mod:`.retry` — the shared deadline-aware
+  :class:`~ncnet_tpu.reliability.retry.RetryPolicy` (exponential
+  backoff + full jitter + retry budget);
+* :mod:`.breaker` — the :class:`~ncnet_tpu.reliability.breaker.CircuitBreaker`
+  around the serving engine's device dispatch.
+
+Poison-batch isolation (bisecting a failed shared batch so one bad
+rider cannot fail its co-batched strangers) lives with the batcher it
+protects — ``serving/batcher.py`` — and is documented with the rest of
+the contract in docs/RELIABILITY.md.
+
+Everything here is stdlib + obs only: the serving client (which must
+stay numpy/jax-free) imports it, and so can any test environment.
+"""
+
+from .breaker import CircuitBreaker, BreakerOpenError
+from .failpoints import (
+    Failpoint,
+    FailpointRegistry,
+    InjectedFault,
+    failpoint,
+)
+from .retry import RetryBudget, RetryPolicy
+
+from . import breaker, failpoints, retry
+
+__all__ = [
+    "CircuitBreaker",
+    "BreakerOpenError",
+    "Failpoint",
+    "FailpointRegistry",
+    "InjectedFault",
+    "failpoint",
+    "RetryBudget",
+    "RetryPolicy",
+    "breaker",
+    "failpoints",
+    "retry",
+]

@@ -1,0 +1,225 @@
+"""Circuit breaker for the serving engine's device dispatch.
+
+When the accelerator path is *down* (device lost, compile storm,
+wedged tunnel), every admitted request pays the full failure latency
+— queue wait, dispatch, exception — before its client learns anything,
+and the queue stays full of work that cannot succeed. The breaker
+converts that into the cheapest possible answer: after
+``failure_threshold`` consecutive dispatch failures it OPENS, and the
+server front-door turns requests away immediately with 503 +
+``Retry-After`` (clients' jittered backoff — reliability/retry.py — is
+the cooperative half). After ``reset_timeout_s`` it goes HALF_OPEN and
+lets ``half_open_probes`` real requests through: one success closes it
+(the device came back), one failure re-opens it for another timeout.
+
+State changes are loud: an obs ``breaker`` event per transition, a
+``breaker.state`` gauge (0 closed / 1 half-open / 2 open), and — on
+open — a one-shot flight-recorder dump (obs/flight.py) capturing the
+last N events leading into the outage, which is exactly the window a
+post-mortem needs.
+
+Clock-injected and lock-guarded; tests drive open/half-open/close with
+a fake clock and no real failures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from .. import obs
+from ..obs import flight
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+_STATE_GAUGE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class BreakerOpenError(RuntimeError):
+    """Dispatch refused: the breaker is open. Carries the Retry-After
+    hint the server forwards to clients."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"circuit breaker open; retry after {retry_after_s:.3f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probing."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 10.0,
+        half_open_probes: int = 1,
+        name: str = "engine",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.half_open_probes = max(int(half_open_probes), 1)
+        self.name = name
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probes_inflight = 0
+        self.transitions = 0
+
+    # -- state transitions (callers hold no lock) --------------------------
+
+    def _transition(self, new: str, **fields) -> None:
+        """Move to ``new`` state; caller holds self._lock."""
+        prev, self._state = self._state, new
+        self.transitions += 1
+        obs.gauge(f"breaker.{self.name}.state").set(_STATE_GAUGE[new])
+        # obs calls under the lock are safe (metrics use their own
+        # locks) but the flight dump does file IO — defer it.
+        self._pending_dump = (new == OPEN)
+        self._last_event = dict(
+            state=new, prev=prev,
+            consecutive_failures=self._consecutive_failures, **fields
+        )
+
+    def _emit_transition(self) -> None:
+        ev = self.__dict__.pop("_last_event", None)
+        if ev is None:
+            return
+        obs.event("breaker", breaker=self.name, **ev)
+        if self.__dict__.pop("_pending_dump", False):
+            obs.counter(f"breaker.{self.name}.opens").inc()
+            # Cooldown-deduped: a flapping breaker dumps once per
+            # episode window, not once per flap.
+            flight.dump(f"breaker-open-{self.name}")
+
+    # -- the guarded-call protocol ----------------------------------------
+
+    def retry_after_s(self) -> float:
+        """Suggested Retry-After while open (time to next probe)."""
+        with self._lock:
+            if self._state != OPEN or self._opened_at is None:
+                return 0.0
+            return max(
+                self._opened_at + self.reset_timeout_s - self.clock(), 0.01
+            )
+
+    def admit(self) -> Optional[float]:
+        """Front-door check (no side effects on counts): None = admit,
+        else a Retry-After hint to reject with. Requests arriving after
+        the reset timeout are admitted so they can serve as half-open
+        probes."""
+        with self._lock:
+            if self._state != OPEN:
+                return None
+            if (self._opened_at is not None
+                    and self.clock() - self._opened_at
+                    >= self.reset_timeout_s):
+                return None
+            return max(
+                self._opened_at + self.reset_timeout_s - self.clock(), 0.01
+            )
+
+    def allow(self) -> None:
+        """Gate one dispatch; raises :class:`BreakerOpenError` or
+        registers the call as a half-open probe."""
+        with self._lock:
+            if self._state == CLOSED:
+                return
+            now = self.clock()
+            if self._state == OPEN:
+                if (self._opened_at is None
+                        or now - self._opened_at < self.reset_timeout_s):
+                    retry = max(
+                        (self._opened_at or now) + self.reset_timeout_s - now,
+                        0.01,
+                    )
+                    raise BreakerOpenError(retry)
+                self._transition(HALF_OPEN, reason="reset_timeout")
+                self._probes_inflight = 0
+            # HALF_OPEN: admit a bounded number of concurrent probes.
+            if self._probes_inflight >= self.half_open_probes:
+                exc = BreakerOpenError(max(self.reset_timeout_s, 0.01))
+            else:
+                self._probes_inflight += 1
+                exc = None
+        self._emit_transition()
+        if exc is not None:
+            raise exc
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == HALF_OPEN:
+                self._probes_inflight = max(self._probes_inflight - 1, 0)
+                self._transition(CLOSED, reason="probe_success")
+                self._opened_at = None
+        self._emit_transition()
+
+    def record_failure(self, exc: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN:
+                self._probes_inflight = max(self._probes_inflight - 1, 0)
+                self._opened_at = self.clock()
+                self._transition(OPEN, reason="probe_failure",
+                                 error=_exc_str(exc))
+            elif (self._state == CLOSED
+                  and self._consecutive_failures >= self.failure_threshold):
+                self._opened_at = self.clock()
+                self._transition(OPEN, reason="failure_threshold",
+                                 error=_exc_str(exc))
+        self._emit_transition()
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """``allow`` + run + record — the wrap-a-runner form the server
+        uses around ``MatchEngine.run_batch``."""
+        self.allow()
+        try:
+            result = fn(*args, **kwargs)
+        except BreakerOpenError:
+            raise
+        except Exception as exc:
+            self.record_failure(exc)
+            raise
+        self.record_success()
+        return result
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        """State dict for /healthz and tests."""
+        with self._lock:
+            snap = {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "transitions": self.transitions,
+            }
+            if self._state == OPEN and self._opened_at is not None:
+                snap["retry_after_s"] = round(max(
+                    self._opened_at + self.reset_timeout_s - self.clock(),
+                    0.01,
+                ), 3)
+            return snap
+
+    def reset(self) -> None:
+        """Force-close (tests / operator action)."""
+        with self._lock:
+            self._state = CLOSED
+            self._consecutive_failures = 0
+            self._opened_at = None
+            self._probes_inflight = 0
+        obs.gauge(f"breaker.{self.name}.state").set(0.0)
+
+
+def _exc_str(exc: Optional[BaseException]) -> Optional[str]:
+    return None if exc is None else f"{type(exc).__name__}: {exc}"

@@ -1,0 +1,336 @@
+"""Named fault-injection sites ("failpoints") for chaos testing.
+
+A production service is only as reliable as its *tested* failure
+paths: a device error handler that has never fired is a hypothesis,
+not a recovery policy. This module gives every failure domain in the
+repo a named injection site that is a no-op in normal operation (one
+dict lookup on a module-level registry) and, when armed, injects one
+of three fault modes:
+
+* ``error`` — raise :class:`InjectedFault` at the site;
+* ``delay`` — sleep a configured duration (timeout / stall paths);
+* ``corrupt`` — mangle a value passing through the site (NaN-poison a
+  numpy array, truncate bytes) via :func:`corrupt`.
+
+Arming is either programmatic (tests: :func:`failpoint` context
+manager, :func:`set_failpoint`) or environmental::
+
+    NCNET_FAILPOINTS="engine.device=error:0.5,loader.read=delay:200ms"
+
+Spec grammar, comma-separated ``site=mode[:args]`` terms:
+
+* ``site=error`` / ``site=error:0.5`` — raise with probability (default
+  1.0);
+* ``site=error:1.0x3`` — ``xN`` caps total fires (the site disarms
+  after N injections — "fail twice then recover" in one spec);
+* ``site=delay:200ms`` / ``site=delay:1.5s:0.25`` — sleep, optional
+  probability;
+* ``site=corrupt`` / ``site=corrupt:0.1`` — corrupt values at
+  :func:`corrupt` call sites.
+
+Probabilistic sites draw from a per-site ``random.Random`` seeded by
+``(NCNET_FAILPOINTS_SEED, site)`` — runs are deterministic given the
+seed, and one site's draw order never perturbs another's.
+
+Planted sites (grep ``failpoints.fire`` for the live list):
+
+``loader.read`` (data/image_io), ``batcher.run``
+(serving/batcher worker), ``engine.device`` (serving/engine dispatch),
+``server.handle`` (serving/server request handler), ``client.transport``
+(serving/client), ``checkpoint.save`` / ``checkpoint.save.commit`` /
+``checkpoint.load`` (training/checkpoint).
+
+Every injection is an obs event (``failpoint``) and a counter
+(``failpoint.<site>``) so a chaos run's run log records exactly what
+was injected where (docs/RELIABILITY.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from .. import obs
+
+
+class InjectedFault(RuntimeError):
+    """An error injected by an armed failpoint (never raised in
+    production unless someone armed the site)."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at failpoint {site!r}")
+        self.site = site
+
+
+_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m)?$")
+
+
+def _parse_duration_s(text: str) -> Optional[float]:
+    m = _DURATION_RE.match(text)
+    if not m:
+        return None
+    value = float(m.group(1))
+    unit = m.group(2)
+    if unit == "ms":
+        return value / 1e3
+    if unit == "m":
+        return value * 60.0
+    # "s" or a bare float: delay args always carry a unit in specs,
+    # but programmatic strings may not.
+    return value
+
+
+@dataclass
+class Failpoint:
+    """One armed site: mode + probability + optional fire cap/matcher."""
+
+    site: str
+    mode: str  # "error" | "delay" | "corrupt"
+    prob: float = 1.0
+    delay_s: float = 0.0
+    max_fires: Optional[int] = None
+    #: Optional payload predicate: the site only fires for payloads the
+    #: callable accepts (per-rider poison in a shared batch).
+    match: Optional[Callable[[Any], bool]] = None
+    #: Optional custom corruptor for ``corrupt`` mode.
+    corruptor: Optional[Callable[[Any], Any]] = None
+    fires: int = field(default=0)
+
+    def spent(self) -> bool:
+        return self.max_fires is not None and self.fires >= self.max_fires
+
+
+def _parse_term(term: str) -> Failpoint:
+    site, _, spec = term.partition("=")
+    site, spec = site.strip(), spec.strip()
+    if not site or not spec:
+        raise ValueError(f"bad failpoint term {term!r} (want site=mode[:args])")
+    parts = spec.split(":")
+    mode = parts[0].strip().lower()
+    if mode not in ("error", "delay", "corrupt"):
+        raise ValueError(f"bad failpoint mode {mode!r} in {term!r}")
+    prob, delay_s, max_fires = 1.0, 0.0, None
+    args = [a.strip() for a in parts[1:] if a.strip()]
+    if mode == "delay":
+        if not args:
+            raise ValueError(f"delay failpoint {term!r} needs a duration")
+        delay_s = _parse_duration_s(args.pop(0))
+        if delay_s is None:
+            raise ValueError(f"bad delay duration in {term!r}")
+    for arg in args:
+        body, _, cap = arg.partition("x")
+        if cap:
+            max_fires = int(cap)
+        if body:
+            prob = float(body)
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"failpoint probability out of [0,1] in {term!r}")
+    return Failpoint(site=site, mode=mode, prob=prob, delay_s=delay_s,
+                     max_fires=max_fires)
+
+
+def parse_spec(spec: str) -> Dict[str, Failpoint]:
+    """Parse an ``NCNET_FAILPOINTS`` spec string into site -> Failpoint."""
+    out: Dict[str, Failpoint] = {}
+    for term in spec.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        fp = _parse_term(term)
+        out[fp.site] = fp
+    return out
+
+
+class FailpointRegistry:
+    """Process-global map of armed sites; `fire` is the hot-path check.
+
+    The unarmed fast path is one lock-free dict ``get`` returning None
+    — cheap enough to plant on per-request serving paths. All mutation
+    happens under a lock; ``_sites`` is swapped wholesale so readers
+    never see a half-built table.
+    """
+
+    def __init__(self, seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._lock = threading.Lock()
+        self._sites: Dict[str, Failpoint] = {}
+        self._rngs: Dict[str, random.Random] = {}
+        self._sleep = sleep
+        self.seed = seed
+
+    # -- arming -----------------------------------------------------------
+
+    def _seed(self) -> int:
+        if self.seed is not None:
+            return self.seed
+        try:
+            return int(os.environ.get("NCNET_FAILPOINTS_SEED", "0"))
+        except ValueError:
+            return 0
+
+    def configure(self, spec: str) -> Dict[str, Failpoint]:
+        """Replace the armed set from a spec string ('' disarms all)."""
+        sites = parse_spec(spec)
+        with self._lock:
+            self._sites = sites
+            self._rngs = {}
+        if sites:
+            obs.event("failpoint", action="configure",
+                      sites={s: fp.mode for s, fp in sites.items()})
+        return sites
+
+    def configure_from_env(self) -> Dict[str, Failpoint]:
+        return self.configure(os.environ.get("NCNET_FAILPOINTS", ""))
+
+    def set(self, site: str, mode: str, prob: float = 1.0,
+            delay_s: float = 0.0, max_fires: Optional[int] = None,
+            match: Optional[Callable[[Any], bool]] = None,
+            corruptor: Optional[Callable[[Any], Any]] = None) -> Failpoint:
+        """Arm (or re-arm) one site programmatically."""
+        if mode not in ("error", "delay", "corrupt"):
+            raise ValueError(f"bad failpoint mode {mode!r}")
+        fp = Failpoint(site=site, mode=mode, prob=prob, delay_s=delay_s,
+                       max_fires=max_fires, match=match, corruptor=corruptor)
+        with self._lock:
+            sites = dict(self._sites)
+            sites[site] = fp
+            self._sites = sites
+            self._rngs.pop(site, None)
+        return fp
+
+    def clear(self, site: Optional[str] = None) -> None:
+        """Disarm one site, or all of them (site=None)."""
+        with self._lock:
+            if site is None:
+                self._sites = {}
+                self._rngs = {}
+            else:
+                sites = dict(self._sites)
+                sites.pop(site, None)
+                self._sites = sites
+                self._rngs.pop(site, None)
+
+    def active(self) -> Dict[str, Failpoint]:
+        """Snapshot of the armed sites (for /healthz and reports)."""
+        return dict(self._sites)
+
+    # -- firing -----------------------------------------------------------
+
+    def _should_fire(self, fp: Failpoint, payload: Any) -> bool:
+        with self._lock:
+            if fp.spent():
+                return False
+            if fp.match is not None:
+                try:
+                    if not fp.match(payload):
+                        return False
+                except Exception:
+                    return False
+            if fp.prob < 1.0:
+                rng = self._rngs.get(fp.site)
+                if rng is None:
+                    rng = random.Random(f"{self._seed()}:{fp.site}")
+                    self._rngs[fp.site] = rng
+                if rng.random() >= fp.prob:
+                    return False
+            fp.fires += 1
+        obs.counter(f"failpoint.{fp.site}").inc()
+        obs.event("failpoint", site=fp.site, mode=fp.mode, fire=fp.fires)
+        return True
+
+    def fire(self, site: str, payload: Any = None) -> None:
+        """Evaluate one site: no-op when unarmed; may sleep or raise."""
+        fp = self._sites.get(site)
+        if fp is None or fp.mode == "corrupt":
+            return
+        if not self._should_fire(fp, payload):
+            return
+        if fp.mode == "delay":
+            self._sleep(fp.delay_s)
+        else:
+            raise InjectedFault(site)
+
+    def corrupt(self, site: str, value: Any) -> Any:
+        """Pass ``value`` through the site; an armed corrupt-mode site
+        returns a mangled copy (NaN-poisoned array, truncated bytes)."""
+        fp = self._sites.get(site)
+        if fp is None or fp.mode != "corrupt":
+            return value
+        if not self._should_fire(fp, value):
+            return value
+        if fp.corruptor is not None:
+            return fp.corruptor(value)
+        return _default_corrupt(value)
+
+
+def _default_corrupt(value: Any) -> Any:
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray) and value.size:
+            out = np.array(value)
+            if np.issubdtype(out.dtype, np.floating):
+                out.reshape(-1)[:: max(out.size // 16, 1)] = np.nan
+            else:
+                out.reshape(-1)[:: max(out.size // 16, 1)] = 0
+            return out
+    except ImportError:
+        pass
+    if isinstance(value, (bytes, bytearray)) and value:
+        return value[: max(len(value) // 2, 1)]
+    return value
+
+
+_REGISTRY = FailpointRegistry()
+# Env arming at import: ANY entry point (serving, eval, train, a bare
+# pytest process) honors NCNET_FAILPOINTS without per-CLI wiring.
+_REGISTRY.configure_from_env()
+
+
+def registry() -> FailpointRegistry:
+    return _REGISTRY
+
+
+def fire(site: str, payload: Any = None) -> None:
+    """Module-level site check (the form planted in library code)."""
+    _REGISTRY.fire(site, payload=payload)
+
+
+def corrupt(site: str, value: Any) -> Any:
+    return _REGISTRY.corrupt(site, value)
+
+
+def configure(spec: str) -> Dict[str, Failpoint]:
+    return _REGISTRY.configure(spec)
+
+
+def configure_from_env() -> Dict[str, Failpoint]:
+    return _REGISTRY.configure_from_env()
+
+
+def set_failpoint(site: str, mode: str, **kwargs) -> Failpoint:
+    return _REGISTRY.set(site, mode, **kwargs)
+
+
+def clear(site: Optional[str] = None) -> None:
+    _REGISTRY.clear(site)
+
+
+def active() -> Dict[str, Failpoint]:
+    return _REGISTRY.active()
+
+
+@contextlib.contextmanager
+def failpoint(site: str, mode: str, **kwargs):
+    """Arm one site for a block (the test-suite form); always disarms."""
+    fp = _REGISTRY.set(site, mode, **kwargs)
+    try:
+        yield fp
+    finally:
+        _REGISTRY.clear(site)

@@ -1,0 +1,174 @@
+"""Deadline-aware retries: exponential backoff, full jitter, budget.
+
+One retry policy for every transient-failure path in the repo — the
+serving client's 503 backoff, loader IO, checkpoint IO — instead of a
+per-call-site ad-hoc loop, because the failure modes of ad-hoc loops
+are all the same: unbounded cumulative sleeping past the caller's
+deadline, synchronized lockstep retries from clients that share a
+clock edge, and retry storms that amplify an outage (every retry is
+extra load on the thing that is already failing).
+
+* **Exponential backoff + full jitter**: attempt ``k`` sleeps
+  ``uniform(0, min(max_delay, base * 2**k))`` — the decorrelated form
+  that spreads a thundering herd (the AWS architecture-blog result).
+* **Deadline-aware**: an overall ``deadline_s`` caps the *sum* of
+  sleeps; a retry that cannot finish before the deadline is not
+  attempted, and each sleep is clipped to the time remaining.
+* **Retry budget**: an optional shared :class:`RetryBudget` bounds the
+  retry *rate* across calls (a token bucket refilled by successes) so
+  a full outage degrades to roughly one retry per successful call
+  instead of multiplying offered load.
+
+Clock/sleep/rng are injectable: tests drive retry schedules with a
+fake clock and assert on the exact sleep sequence.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from .. import obs
+
+
+class RetryBudget:
+    """Token bucket bounding cross-call retry rate.
+
+    Starts full at ``capacity``. Each retry spends one token; each
+    *success* deposits ``refill_per_success`` (default 0.1: sustained,
+    one retry per ten successes). An empty bucket means "stop retrying,
+    fail fast" — the anti-amplification valve during a full outage.
+    """
+
+    def __init__(self, capacity: float = 10.0,
+                 refill_per_success: float = 0.1):
+        self.capacity = float(capacity)
+        self.refill_per_success = float(refill_per_success)
+        self._tokens = float(capacity)
+        self._lock = threading.Lock()
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._tokens = min(self.capacity,
+                               self._tokens + self.refill_per_success)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._tokens < 1.0:
+                return False
+            self._tokens -= 1.0
+            return True
+
+
+class RetryPolicy:
+    """Reusable retry schedule; one instance may serve many calls.
+
+    ``max_attempts`` counts *total* tries (1 = no retries). Use
+    :meth:`call` for the wrap-a-callable form or :meth:`session` when
+    the retry loop must stay inline (the HTTP client inspects status
+    codes and Retry-After hints between tries).
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        base_delay_s: float = 0.05,
+        max_delay_s: float = 5.0,
+        deadline_s: Optional[float] = None,
+        budget: Optional[RetryBudget] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.budget = budget
+        self.clock = clock
+        self.sleep = sleep
+        self.rng = rng if rng is not None else random.Random()
+
+    def session(self, deadline_s: Optional[float] = None) -> "RetrySession":
+        """A per-call session holding the attempt counter + deadline."""
+        d = self.deadline_s if deadline_s is None else float(deadline_s)
+        return RetrySession(self, deadline=None if d is None
+                            else self.clock() + d)
+
+    def call(self, fn: Callable, retry_on: Tuple[Type[BaseException], ...]
+             = (OSError,), site: str = ""):
+        """Run ``fn()``, retrying on ``retry_on`` per the schedule.
+
+        The terminal exception is re-raised unchanged (callers keep
+        their existing error contracts); every retry is an obs event so
+        a run log shows transient-failure churn even when the call
+        ultimately succeeds.
+        """
+        session = self.session()
+        while True:
+            try:
+                result = fn()
+            except retry_on as exc:
+                delay = session.next_delay()
+                if delay is None:
+                    raise
+                obs.counter("retry.attempts").inc()
+                obs.event("retry", site=site or getattr(fn, "__name__", ""),
+                          attempt=session.attempt,
+                          delay_s=round(delay, 6),
+                          error=f"{type(exc).__name__}: {exc}")
+                self.sleep(delay)
+                continue
+            if self.budget is not None:
+                self.budget.record_success()
+            return result
+
+
+class RetrySession:
+    """One call's retry state: attempts used, absolute deadline."""
+
+    def __init__(self, policy: RetryPolicy, deadline: Optional[float]):
+        self.policy = policy
+        self.deadline = deadline
+        self.attempt = 0  # completed (failed) attempts so far
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - self.policy.clock()
+
+    def next_delay(self, hint_s: Optional[float] = None) -> Optional[float]:
+        """Seconds to sleep before the next attempt, or None = give up.
+
+        ``hint_s`` (a server's Retry-After) acts as the floor of the
+        jitter window: the sleep is ``uniform(hint, max(hint, backoff))``
+        — the hint is honored, but synchronized clients still spread
+        out. Returns None when attempts, deadline, or budget are
+        exhausted; the caller raises its own terminal error.
+        """
+        p = self.policy
+        self.attempt += 1
+        if self.attempt >= p.max_attempts:
+            return None
+        if p.budget is not None and not p.budget.try_spend():
+            obs.counter("retry.budget_exhausted").inc()
+            return None
+        ceiling = min(p.max_delay_s, p.base_delay_s * (2 ** (self.attempt - 1)))
+        lo = 0.0 if hint_s is None else max(0.0, float(hint_s))
+        delay = p.rng.uniform(lo, max(lo, ceiling))
+        remaining = self.remaining_s()
+        if remaining is not None:
+            if remaining <= 0.0 or delay >= remaining:
+                obs.counter("retry.deadline_exhausted").inc()
+                return None
+            delay = min(delay, remaining)
+        return delay

@@ -28,6 +28,13 @@ program. This module is the traffic half of that story:
   whichever comes first. Deadlines shape WHEN a batch runs; admitted
   requests are never dropped (the drain contract below).
 
+* **Poison-batch isolation**: a shared batch couples strangers — one
+  malformed rider would otherwise fail every co-batched request. When
+  a batch raises, :meth:`_run_chunk` bisects and retries the halves so
+  the poison rider fails alone (:class:`PoisonRequestError`) and the
+  innocents complete (docs/RELIABILITY.md; ``isolate_poison=False``
+  restores fail-the-batch).
+
 * **Graceful drain**: :meth:`close` stops admission, flushes every
   partial bucket, and completes every admitted request before
   returning — a rolling restart loses nothing it accepted.
@@ -48,6 +55,8 @@ from typing import Any, Callable, List, Optional, Tuple
 
 from .. import obs
 from ..obs import trace
+from ..reliability import failpoints
+from ..reliability.breaker import BreakerOpenError
 from ..utils.batching import ShapeBuckets
 
 
@@ -61,6 +70,24 @@ class RejectedError(Exception):
         )
         self.retry_after_s = retry_after_s
         self.depth = depth
+
+
+class PoisonRequestError(Exception):
+    """This request — isolated alone by batch bisection — still failed:
+    the failure is its own, not collateral from a co-batched stranger.
+    The server maps it to a structured per-request error (HTTP 422)."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(
+            f"request failed in isolation: {type(cause).__name__}: {cause}"
+        )
+        self.cause = cause
+
+
+#: Errors that must NOT trigger bisection: re-running sub-batches
+#: cannot help when the device path is refusing all work (open breaker)
+#: — it just multiplies load on a known-down dependency.
+_NO_BISECT = (BreakerOpenError,)
 
 
 @dataclass
@@ -113,6 +140,7 @@ class DeadlineBatcher:
         deadline_slack_s: float = 0.0,
         default_timeout_s: float = 30.0,
         backlog_cap: Optional[int] = None,
+        isolate_poison: bool = True,
         clock: Callable[[], float] = time.monotonic,
     ):
         if max_batch < 1:
@@ -120,6 +148,7 @@ class DeadlineBatcher:
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         self.runner = runner
+        self.isolate_poison = isolate_poison
         self.max_batch = max_batch
         self.max_queue = max_queue
         self.max_delay_s = float(max_delay_s)
@@ -240,24 +269,75 @@ class DeadlineBatcher:
             # into each request's tree explicitly.
             trace.emit_span("queue_wait", dur_s=t_run - p.t_submit,
                             parents=p.trace_ctx, batch_size=len(chunk))
+        self._run_chunk(chunk, t_run, depth=0)
+
+    def _run_chunk(self, chunk: List[_Pending], t_run: float,
+                   depth: int) -> None:
+        """Run one (sub-)batch; on failure, bisect to isolate poison.
+
+        A shared batch couples strangers: one malformed rider failing
+        the dispatch would fail every co-batched request. Instead, a
+        failed batch of n > 1 splits in half and each half retries —
+        recursively, so after <= ceil(log2 n) extra rounds the poison
+        rider fails ALONE (a structured :class:`PoisonRequestError`)
+        while every innocent rider completes. ``depth`` > 0 marks a
+        bisection retry; each rider's trace records the isolation
+        outcome as an ``isolation`` span (docs/RELIABILITY.md).
+        """
         # The runner executes ONE batch serving MANY traces: attach the
         # union of the riders' contexts so engine spans (batch_assemble,
         # device) fan out into every request's tree.
         riders = tuple(c for p in chunk for c in p.trace_ctx)
         try:
+            failpoints.fire("batcher.run", payload=chunk)
             with trace.attach(riders):
                 results = self.runner(chunk[0].bucket_key,
                                       [p.payload for p in chunk])
-        except BaseException as exc:  # noqa: BLE001 — forwarded per-request
+        except Exception as exc:  # noqa: BLE001 — forwarded per-request
+            if (self.isolate_poison and len(chunk) > 1
+                    and not isinstance(exc, _NO_BISECT)):
+                obs.counter("serving.poison_bisects").inc()
+                obs.event("poison_bisect", batch_size=len(chunk),
+                          depth=depth,
+                          error=f"{type(exc).__name__}: {exc}")
+                mid = len(chunk) // 2
+                self._run_chunk(chunk[:mid], t_run, depth + 1)
+                self._run_chunk(chunk[mid:], t_run, depth + 1)
+                return
             obs.counter("serving.batch_errors").inc()
+            poison = len(chunk) == 1 and depth > 0
+            if poison:
+                obs.counter("serving.poison_isolated").inc()
             for p in chunk:
+                outcome = "poison" if poison else "error"
+                trace.emit_span("isolation", dur_s=self.clock() - t_run,
+                                parents=p.trace_ctx, outcome=outcome,
+                                depth=depth, batch_size=len(chunk))
                 if not p.future.set_running_or_notify_cancel():
                     continue
-                p.future.set_exception(exc)
+                if poison:
+                    err = PoisonRequestError(exc)
+                    err.__cause__ = exc
+                    p.future.set_exception(err)
+                else:
+                    p.future.set_exception(exc)
+            return
+        except BaseException as exc:  # worker must survive; forward raw
+            obs.counter("serving.batch_errors").inc()
+            for p in chunk:
+                if p.future.set_running_or_notify_cancel():
+                    p.future.set_exception(exc)
             return
         run_s = self.clock() - t_run
         obs.histogram("serving.run_batch_s").observe(run_s)
         for p, r in zip(chunk, results):
+            if depth > 0:
+                # This rider survived a bisection round: its original
+                # batch failed but the failure was not its own.
+                obs.counter("serving.poison_survivors").inc()
+                trace.emit_span("isolation", dur_s=run_s,
+                                parents=p.trace_ctx, outcome="innocent",
+                                depth=depth, batch_size=len(chunk))
             if not p.future.set_running_or_notify_cancel():
                 continue
             p.future.set_result(BatchResult(

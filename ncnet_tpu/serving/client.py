@@ -2,10 +2,15 @@
 
 Deliberately free of jax/numpy imports: the threaded load generator
 (tools/bench_serving.py) runs dozens of these concurrently and a
-client needs nothing but `urllib` + `json`. Mirrors the server's
-schema (docs/SERVING.md) and backoff contract: 503 responses carry
-``Retry-After``; :meth:`MatchClient.match` honors it up to
-``retries`` times before surfacing :class:`OverCapacityError`.
+client needs nothing but `urllib` + `json` (ncnet_tpu.reliability is
+stdlib-only by contract). Mirrors the server's schema
+(docs/SERVING.md) and backoff contract: 503 responses carry
+``Retry-After``; :meth:`MatchClient.match` honors it through the
+shared deadline-aware :class:`~ncnet_tpu.reliability.retry.RetryPolicy`
+— the hint is the *floor* of a jittered backoff window (synchronized
+clients must not retry in lockstep), cumulative sleeps never exceed
+``retry_deadline_s``, and exhaustion surfaces
+:class:`OverCapacityError`.
 """
 
 from __future__ import annotations
@@ -16,6 +21,9 @@ import time
 import urllib.error
 import urllib.request
 from typing import Optional
+
+from ..reliability import failpoints
+from ..reliability.retry import RetryPolicy
 
 
 class ServingError(Exception):
@@ -31,16 +39,38 @@ class OverCapacityError(ServingError):
     """503 after exhausting Retry-After backoff retries."""
 
 
+class PoisonRequestError(ServingError):
+    """422: the server isolated THIS request as a poison rider — the
+    failure is the request's own and a retry will not help."""
+
+
 class MatchClient:
     def __init__(self, base_url: str, timeout_s: float = 60.0,
-                 retries: int = 2):
+                 retries: int = 2, retry_deadline_s: Optional[float] = None,
+                 sleep=time.sleep):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self.retries = retries
+        # Overall backoff budget: cumulative Retry-After sleeps are
+        # capped here no matter what the server hints (a misconfigured
+        # Retry-After must not pin a client for minutes). Defaults to
+        # the transport timeout — "one request costs at most ~2x
+        # timeout_s wall time" is the invariant callers can plan on.
+        self.retry_deadline_s = (
+            timeout_s if retry_deadline_s is None else retry_deadline_s
+        )
+        self._policy = RetryPolicy(
+            max_attempts=retries + 1,
+            base_delay_s=0.05,
+            max_delay_s=5.0,
+            deadline_s=self.retry_deadline_s,
+            sleep=sleep,
+        )
 
     # -- transport --------------------------------------------------------
 
     def _request(self, method: str, path: str, body: Optional[dict] = None):
+        failpoints.fire("client.transport", payload=path)
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self.base_url + path,
@@ -50,7 +80,7 @@ class MatchClient:
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                raw = resp.read()
+                raw = failpoints.corrupt("client.transport", resp.read())
                 ctype = resp.headers.get("Content-Type", "")
                 if ctype.startswith("application/json"):
                     return resp.status, json.loads(raw), resp.headers
@@ -76,9 +106,13 @@ class MatchClient:
     ) -> dict:
         """POST /v1/match; returns the response dict on 200.
 
-        503s are retried after the server's ``Retry-After`` hint (up to
-        ``retries`` times — the cooperative half of admission control);
-        any other non-200 raises :class:`ServingError`.
+        503s (over capacity, open breaker, draining replica) are
+        retried up to ``retries`` times with jittered backoff floored
+        at the server's ``Retry-After`` hint, the total sleep bounded
+        by ``retry_deadline_s`` — then :class:`OverCapacityError`. A
+        422 raises :class:`PoisonRequestError` immediately (the server
+        proved the failure is this request's own; retrying resends
+        poison); any other non-200 raises :class:`ServingError`.
         """
         body = {}
         if query_path:
@@ -93,23 +127,26 @@ class MatchClient:
             body["deadline_ms"] = deadline_ms
         if max_matches is not None:
             body["max_matches"] = max_matches
-        attempt = 0
+        session = self._policy.session()
         while True:
             status, payload, headers = self._request(
                 "POST", "/v1/match", body
             )
             if status == 200:
                 return payload
-            if status == 503 and attempt < self.retries:
-                attempt += 1
+            if status == 503:
                 try:
-                    delay = float(headers.get("Retry-After", "0.1"))
+                    hint = float(headers.get("Retry-After", "0.1"))
                 except (TypeError, ValueError):
-                    delay = 0.1
-                time.sleep(min(delay, 5.0))
-                continue
-            cls = OverCapacityError if status == 503 else ServingError
-            raise cls(status, payload)
+                    hint = 0.1
+                delay = session.next_delay(hint_s=min(hint, 5.0))
+                if delay is not None:
+                    self._policy.sleep(delay)
+                    continue
+                raise OverCapacityError(status, payload)
+            if status == 422:
+                raise PoisonRequestError(status, payload)
+            raise ServingError(status, payload)
 
     def healthz(self) -> dict:
         status, payload, _ = self._request("GET", "/healthz")

@@ -37,6 +37,7 @@ import numpy as np
 
 from .. import obs
 from ..obs import trace
+from ..reliability import failpoints
 from ..cli.eval_inloc import inloc_resize_shape, resolve_feat_units
 from ..evals import dedup_matches, inloc_device_matches
 from ..models.ncnet import extract_features, ncnet_forward_from_features
@@ -289,6 +290,13 @@ class MatchEngine:
                         batch_size=len(batch))
 
         t_dev = time.monotonic()
+        # Device-dispatch failure domain: `engine.device` injects a whole
+        # batch failure (lost device, OOM); `engine.rider` fires per
+        # rider (with a match= predicate) — the poison-batch chaos site:
+        # the batcher's bisection must isolate exactly the marked rider.
+        failpoints.fire("engine.device", payload=bucket_key)
+        for p in batch:
+            failpoints.fire("engine.rider", payload=p)
         if mode == "cached":
             ms = self._batch_pairs_cached(self.params, q_stack, f_stack)
         elif mode == "with_feats":

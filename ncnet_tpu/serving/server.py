@@ -12,9 +12,12 @@ Endpoints (schema: docs/SERVING.md):
   clients and the load-gen bench can see batching happen. Over-capacity
   requests get 503 + ``Retry-After`` (admission control), malformed
   ones 400, deadline overruns 504.
-* ``GET /healthz`` — liveness + the PR-1 heartbeat's stall flag: a
-  wedged replica (device hang, starved batcher) reports ``stalled`` and
-  503 so a balancer drains it.
+* ``GET /healthz`` — liveness + degradation: the PR-1 heartbeat's
+  stall flag (a wedged replica reports ``stalled`` + 503 so a balancer
+  drains it), the circuit-breaker state (``degraded`` + 503 while
+  open, ``recovering`` + 200 during half-open probing), and
+  ``draining`` + 503 for the whole shutdown window
+  (docs/RELIABILITY.md).
 * ``GET /metrics`` — Prometheus text exposition of the whole
   `obs.metrics` registry (obs.render_text).
 
@@ -36,7 +39,10 @@ from typing import Optional
 
 from .. import obs
 from ..obs import trace
-from .batcher import DeadlineBatcher, RejectedError
+from ..reliability import failpoints
+from ..reliability.breaker import BreakerOpenError, CircuitBreaker
+from ..reliability.failpoints import InjectedFault
+from .batcher import DeadlineBatcher, PoisonRequestError, RejectedError
 from .engine import MatchEngine
 
 #: Grace added past a request's deadline before the handler gives up
@@ -58,19 +64,34 @@ class MatchServer:
         max_delay_s: float = 0.05,
         deadline_slack_s: float = 0.1,
         default_timeout_s: float = 30.0,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 10.0,
+        isolate_poison: bool = True,
         run_log=None,
     ):
         self.engine = engine
         self.run_log = run_log
+        # The breaker guards every device dispatch — including the
+        # sub-batches of a poison bisection, since the batcher calls
+        # this same runner for them: consecutive dispatch failures
+        # (dead device, compile storm) open it and the front door turns
+        # requests away with 503 + Retry-After instead of queueing work
+        # that cannot succeed (docs/RELIABILITY.md).
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            reset_timeout_s=breaker_reset_s,
+        )
         self.batcher = DeadlineBatcher(
-            engine.run_batch,
+            self.breaker_runner(engine.run_batch),
             max_batch=max_batch,
             max_queue=max_queue,
             max_delay_s=max_delay_s,
             deadline_slack_s=deadline_slack_s,
             default_timeout_s=default_timeout_s,
+            isolate_poison=isolate_poison,
         )
         self.t_start = time.monotonic()
+        self._draining = False
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -126,15 +147,46 @@ class MatchServer:
 
     # -- endpoint logic (handler-thread context) --------------------------
 
+    def breaker_runner(self, run_batch):
+        """Wrap the engine runner with the breaker's call protocol."""
+
+        def guarded(bucket_key, batch):
+            return self.breaker.call(run_batch, bucket_key, batch)
+
+        return guarded
+
     def healthz(self):
+        """Liveness + degradation: stall flag, breaker state, drain.
+
+        503 while draining (a balancer must stop routing here the
+        moment shutdown starts — today's requests finish, new ones go
+        elsewhere), while stalled, and while the breaker is open
+        (``degraded``: the device path is failing; probes will reopen
+        traffic via ``recovering``/200 once the reset timeout passes).
+        """
         hb = self.run_log.heartbeat if self.run_log is not None else None
         stalled = bool(hb.in_stall) if hb is not None else False
+        br = self.breaker.snapshot()
+        if self._draining:
+            status, code = "draining", 503
+        elif stalled:
+            status, code = "stalled", 503
+        elif br["state"] == "open":
+            status, code = "degraded", 503
+        elif br["state"] == "half_open":
+            status, code = "recovering", 200
+        else:
+            status, code = "ok", 200
         payload = {
-            "status": "stalled" if stalled else "ok",
+            "status": status,
             "uptime_s": round(time.monotonic() - self.t_start, 3),
             "queue_depth": self.batcher.depth,
+            "breaker": br,
         }
-        return (503 if stalled else 200), payload
+        fps = failpoints.active()
+        if fps:  # chaos visibility: an armed replica says so
+            payload["failpoints"] = {s: fp.mode for s, fp in fps.items()}
+        return code, payload
 
     def handle_match(self, handler):
         """Parse, admit, wait, respond. Returns (code, payload, headers).
@@ -146,11 +198,31 @@ class MatchServer:
         at submit, ``respond`` (payload build) back here.
         """
         with trace.trace("request") as root:
+            try:
+                # Handler-thread failure domain (chaos site): an
+                # injected handler fault must become a structured 500,
+                # never a dropped connection.
+                failpoints.fire("server.handle")
+            except InjectedFault as exc:
+                obs.counter("serving.errors").inc()
+                return 500, {"error": str(exc), "kind": "injected_fault"}, None
             return self._handle_match_traced(handler, root)
 
     def _handle_match_traced(self, handler, root):
         t0 = time.monotonic()
         obs.counter("serving.requests").inc()
+        # Open breaker: reject at the front door — cheapest work a
+        # degraded replica can do, and the Retry-After hint tells
+        # clients when the half-open probe window starts.
+        retry_in = self.breaker.admit()
+        if retry_in is not None:
+            obs.counter("serving.breaker_rejected").inc()
+            return (
+                503,
+                {"error": "service degraded (circuit breaker open)",
+                 "retry_after_s": round(retry_in, 3)},
+                {"Retry-After": f"{retry_in:.3f}"},
+            )
         # ``admit`` covers parse + host-side prepare only; submit happens
         # AFTER the span closes so the worker's queue_wait span parents
         # onto the request root, not onto admit.
@@ -199,6 +271,30 @@ class MatchServer:
         except FutureTimeoutError:
             obs.counter("serving.deadline_exceeded").inc()
             return 504, {"error": "deadline exceeded"}, None
+        except BreakerOpenError as exc:
+            # The breaker opened while this request was queued: its
+            # dispatch was refused, not attempted. Same contract as the
+            # front-door rejection — 503 + Retry-After, retryable.
+            obs.counter("serving.breaker_rejected").inc()
+            return (
+                503,
+                {"error": "service degraded (circuit breaker open)",
+                 "retry_after_s": round(exc.retry_after_s, 3)},
+                {"Retry-After": f"{exc.retry_after_s:.3f}"},
+            )
+        except PoisonRequestError as exc:
+            # Bisection isolated THIS request as the poison rider: the
+            # failure is its own (bad input for the model), not
+            # collateral — a structured, non-retryable per-request error.
+            obs.counter("serving.poison_requests").inc()
+            obs.event("request_error", kind="poison",
+                      error=f"{type(exc.cause).__name__}: {exc.cause}")
+            return (
+                422,
+                {"error": str(exc), "kind": "poison_request",
+                 "cause": f"{type(exc.cause).__name__}: {exc.cause}"},
+                None,
+            )
         except Exception as exc:  # noqa: BLE001 — model failure -> 500
             obs.counter("serving.errors").inc()
             obs.event("request_error", error=f"{type(exc).__name__}: {exc}")
@@ -256,7 +352,10 @@ class MatchServer:
 
     def stop(self) -> None:
         """Graceful drain: stop accepting, finish every admitted request,
-        then shut the listener down."""
+        then shut the listener down. ``/healthz`` reports ``draining``
+        with 503 for the whole window so a balancer stops routing here
+        before the listener disappears."""
+        self._draining = True
         self.batcher.close()
         self.httpd.shutdown()
         self.httpd.server_close()
@@ -297,6 +396,15 @@ def main(argv=None):
     parser.add_argument("--max_delay_ms", type=float, default=50.0)
     parser.add_argument("--deadline_slack_ms", type=float, default=100.0)
     parser.add_argument("--default_timeout_s", type=float, default=30.0)
+    parser.add_argument("--breaker_threshold", type=int, default=5,
+                        help="consecutive dispatch failures that open "
+                        "the circuit breaker")
+    parser.add_argument("--breaker_reset_s", type=float, default=10.0,
+                        help="seconds the breaker stays open before a "
+                        "half-open probe")
+    parser.add_argument("--no_isolate_poison", action="store_true",
+                        help="disable poison-batch bisection (a failed "
+                        "shared batch fails every rider)")
     parser.add_argument("--cache_mb", type=int, default=2048,
                         help="pano feature cache budget (0 disables)")
     parser.add_argument("--cache_dir", type=str, default="")
@@ -344,6 +452,13 @@ def main(argv=None):
         n = engine.warmup(shapes, batch_sizes=batches)
         print(f"warmup: {n} programs compiled", file=sys.stderr, flush=True)
 
+    # Chaos arming (NCNET_FAILPOINTS) happens at failpoints import; the
+    # explicit re-read here makes `main` honest under embedding (a test
+    # or supervisor that set the env after the first import).
+    armed = failpoints.configure_from_env()
+    if armed:
+        print(f"failpoints armed: {sorted(armed)}", file=sys.stderr,
+              flush=True)
     server = MatchServer(
         engine,
         host=args.host,
@@ -353,6 +468,9 @@ def main(argv=None):
         max_delay_s=args.max_delay_ms / 1e3,
         deadline_slack_s=args.deadline_slack_ms / 1e3,
         default_timeout_s=args.default_timeout_s,
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
+        isolate_poison=not args.no_isolate_poison,
         run_log=run_log,
     ).start()
     print(f"serving on {server.url}", file=sys.stderr, flush=True)

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..models.backbone import BackboneConfig
 from ..models.ncnet import NCNetConfig
+from ..reliability import failpoints
 
 
 def _config_to_dict(config: NCNetConfig) -> dict:
@@ -162,6 +163,7 @@ def save_checkpoint(
     rm step.old), so a kill at ANY point leaves at least one complete
     dir among step / step.tmp / step.old; `resolve_resume_dir` (used by
     cli/train.py --resume) checks all three in that order."""
+    failpoints.fire("checkpoint.save", payload=directory)
     os.makedirs(directory, exist_ok=True)
     rolling = tag is not None
     final_tag = os.path.join(directory, tag if rolling else f"epoch_{epoch}")
@@ -189,6 +191,11 @@ def save_checkpoint(
         json.dump(meta, f, indent=2, default=float)
     os.replace(meta_path + ".tmp", meta_path)
     if rolling:
+        # Fires between "new checkpoint fully written" and "swapped
+        # live" — the kill-window the rename-aside dance exists for;
+        # chaos tests inject here and assert resolve_resume_dir still
+        # finds a complete dir.
+        failpoints.fire("checkpoint.save.commit", payload=final_tag)
         # ADVICE r3: the old rmtree(final)-then-replace order had a
         # window where only a partial dir existed.
         _swap_aside(tag, final_tag)
@@ -234,6 +241,7 @@ def load_checkpoint(path: str, opt_state_template=None):
     The stored config wins over caller-supplied architecture args, matching
     the reference restore behavior (lib/model.py:217-220).
     """
+    failpoints.fire("checkpoint.load", payload=path)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     config = config_from_dict(meta["config"])

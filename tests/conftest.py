@@ -65,6 +65,18 @@ def _reset_obs_metrics():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _clear_failpoints():
+    """The failpoint registry is process-global (armed from the env in
+    production); disarm everything per test so one test's chaos cannot
+    leak into another's happy path."""
+    from ncnet_tpu.reliability import failpoints
+
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
 @pytest.fixture(scope="session")
 def tiny_serving_model():
     """Session-shared tiny model for the serving tests (the eval CLI

@@ -82,6 +82,42 @@ def test_bench_serving_emits_one_json_line(tiny_serving_model, capsys):
     assert rec["errors"] == 0
 
 
+def test_chaos_serving_emits_one_json_line(tiny_serving_model, capsys):
+    """tools/chaos_serving.py stdout contract (ISSUE 5): the chaos
+    harness — in-process server, open-loop load, a timed engine.device
+    fault window — prints ONE JSON line with the survival metric,
+    per-outcome accounting that sums to every scheduled request (no
+    silent drops), and the observed breaker transitions."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import json as _json
+
+    import chaos_serving
+
+    rc = chaos_serving.main([
+        "--synthetic", "96x128", "--rate", "4", "--duration_s", "2",
+        "--threads", "4", "--max_batch", "2",
+        "--breaker_threshold", "2", "--breaker_reset_s", "0.4",
+        "--fault", "engine.device=error:1.0@0.4-1.2",
+    ], model=tiny_serving_model)
+    assert rc == 0, "a nonzero rc means a request was silently dropped"
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = _json.loads(lines[0])
+    assert rec["metric"] == "chaos_serving_survival"
+    assert rec["unit"] == "frac"
+    assert 0.0 <= rec["value"] <= 1.0
+    assert rec["dropped"] == 0
+    assert rec["sent"] == 8
+    assert (rec["ok"] + rec["rejected"] + rec["poison"] + rec["errors"]
+            == rec["sent"])
+    assert rec["ok"] >= 1, "requests outside the fault window succeed"
+    assert rec["faults"]["engine.device"] == [
+        {"t_s": 0.4, "action": "arm"}, {"t_s": 1.2, "action": "disarm"},
+    ]
+    assert isinstance(rec["breaker_transitions"], list)
+    assert rec["duration_s"] > 0
+
+
 def test_autotune_cli_emits_one_json_line(tmp_path, capsys, monkeypatch):
     """tools/autotune_consensus.py stdout contract (ISSUE 3): run
     in-process with the fake timer (no device dial, no compiles) and a

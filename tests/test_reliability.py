@@ -1,0 +1,530 @@
+"""Reliability layer (ncnet_tpu/reliability, ISSUE 5).
+
+Chaos-path coverage in four layers, all fake-clock / threadless where
+the semantics allow:
+
+* failpoint registry — spec grammar, determinism, fire caps, delay and
+  corrupt modes, the context-manager form, per-payload matchers;
+* retry policy — exact backoff schedules under an injected rng/clock,
+  the deadline cap on cumulative sleeps, Retry-After hints as jitter
+  floors, budget exhaustion;
+* circuit breaker — open on consecutive failures, half-open probing,
+  re-open on probe failure, the one-shot flight dump and obs signals;
+* integration — loader IO retry-then-succeed / retry-then-fail,
+  poison-batch bisection in the batcher, checkpoint save/load faults.
+"""
+
+import glob
+import random
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.reliability import failpoints
+from ncnet_tpu.reliability.breaker import BreakerOpenError, CircuitBreaker
+from ncnet_tpu.reliability.failpoints import (
+    FailpointRegistry,
+    InjectedFault,
+    parse_spec,
+)
+from ncnet_tpu.reliability.retry import RetryBudget, RetryPolicy
+
+# -- failpoints ------------------------------------------------------------
+
+
+def test_parse_spec_grammar():
+    fps = parse_spec(
+        "engine.device=error:0.5, loader.read=delay:200ms:0.25,"
+        "server.handle=error:1.0x3, client.transport=corrupt"
+    )
+    assert set(fps) == {"engine.device", "loader.read", "server.handle",
+                        "client.transport"}
+    assert fps["engine.device"].mode == "error"
+    assert fps["engine.device"].prob == 0.5
+    assert fps["loader.read"].mode == "delay"
+    assert fps["loader.read"].delay_s == pytest.approx(0.2)
+    assert fps["loader.read"].prob == 0.25
+    assert fps["server.handle"].max_fires == 3
+    assert fps["client.transport"].mode == "corrupt"
+    assert parse_spec("") == {}
+
+
+@pytest.mark.parametrize("bad", [
+    "noequals", "site=", "site=explode", "site=error:2.0",
+    "site=delay", "site=delay:abc",
+])
+def test_parse_spec_rejects_bad_terms(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_fire_unarmed_is_noop_and_armed_raises():
+    reg = FailpointRegistry()
+    reg.fire("engine.device")  # unarmed: no-op
+    reg.set("engine.device", "error")
+    with pytest.raises(InjectedFault) as exc_info:
+        reg.fire("engine.device")
+    assert exc_info.value.site == "engine.device"
+    snap = obs.snapshot()
+    assert snap["counters"]["failpoint.engine.device"] == 1.0
+    reg.clear("engine.device")
+    reg.fire("engine.device")  # disarmed again
+
+
+def test_probabilistic_fire_is_deterministic_per_seed():
+    def pattern(seed):
+        reg = FailpointRegistry(seed=seed)
+        reg.set("s", "error", prob=0.5)
+        out = []
+        for _ in range(64):
+            try:
+                reg.fire("s")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a, b, c = pattern(7), pattern(7), pattern(8)
+    assert a == b, "same seed, same fire pattern"
+    assert a != c, "different seed perturbs the pattern"
+    assert 0 < sum(a) < 64
+
+
+def test_max_fires_cap_disarms_site():
+    reg = FailpointRegistry()
+    reg.set("s", "error", max_fires=2)
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            reg.fire("s")
+    reg.fire("s")  # spent: no-op from here on
+    assert reg.active()["s"].fires == 2
+
+
+def test_delay_mode_sleeps_injected():
+    slept = []
+    reg = FailpointRegistry(sleep=slept.append)
+    reg.set("s", "delay", delay_s=0.2)
+    reg.fire("s")
+    assert slept == [0.2]
+
+
+def test_corrupt_mode_default_and_custom():
+    reg = FailpointRegistry()
+    assert reg.corrupt("s", b"payload") == b"payload", "unarmed passthrough"
+    reg.set("s", "corrupt")
+    arr = np.ones((4, 4), np.float32)
+    out = reg.corrupt("s", arr)
+    assert np.isnan(out).any()
+    assert not np.isnan(arr).any(), "input not mutated in place"
+    assert len(reg.corrupt("s", b"0123456789")) == 5, "bytes truncate"
+    # error/delay-armed sites never corrupt values.
+    reg.set("s", "error")
+    assert reg.corrupt("s", b"ok") == b"ok"
+    reg.set("s", "corrupt", corruptor=lambda v: b"mangled")
+    assert reg.corrupt("s", b"ok") == b"mangled"
+
+
+def test_match_predicate_scopes_fire_to_payload():
+    reg = FailpointRegistry()
+    reg.set("s", "error", match=lambda p: p == "poison")
+    reg.fire("s", payload="innocent")
+    with pytest.raises(InjectedFault):
+        reg.fire("s", payload="poison")
+
+
+def test_failpoint_contextmanager_and_env(monkeypatch):
+    with failpoints.failpoint("ctx.site", "error"):
+        assert "ctx.site" in failpoints.active()
+        with pytest.raises(InjectedFault):
+            failpoints.fire("ctx.site")
+    assert "ctx.site" not in failpoints.active()
+
+    monkeypatch.setenv("NCNET_FAILPOINTS", "env.site=error:1.0x1")
+    armed = failpoints.configure_from_env()
+    assert set(armed) == {"env.site"}
+    with pytest.raises(InjectedFault):
+        failpoints.fire("env.site")
+    monkeypatch.setenv("NCNET_FAILPOINTS", "")
+    assert failpoints.configure_from_env() == {}
+
+
+# -- retry policy ----------------------------------------------------------
+
+
+class FakeTime:
+    """Clock + sleep pair: sleeping advances the clock."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def test_retry_backoff_schedule_and_exhaustion():
+    ft = FakeTime()
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.1, max_delay_s=0.25,
+                         clock=ft.clock, sleep=ft.sleep,
+                         rng=random.Random(0))
+    session = policy.session()
+    delays = [session.next_delay() for _ in range(4)]
+    assert delays[3] is None, "max_attempts exhausts"
+    # Full jitter: each delay lands in [0, min(max, base * 2^k)].
+    for k, d in enumerate(delays[:3]):
+        assert 0.0 <= d <= min(0.25, 0.1 * 2 ** k)
+
+
+def test_retry_deadline_caps_cumulative_sleep():
+    ft = FakeTime()
+    policy = RetryPolicy(max_attempts=100, base_delay_s=1.0, max_delay_s=1.0,
+                         deadline_s=2.5, clock=ft.clock, sleep=ft.sleep,
+                         rng=random.Random(3))
+    session = policy.session()
+    total = 0.0
+    while True:
+        d = session.next_delay(hint_s=1.0)  # hint pins each sleep to 1s
+        if d is None:
+            break
+        total += d
+        ft.sleep(d)
+    assert total <= 2.5, "cumulative sleeps never exceed the deadline"
+    assert session.attempt < 100, "deadline, not attempts, stopped it"
+    snap = obs.snapshot()
+    assert snap["counters"]["retry.deadline_exhausted"] == 1.0
+
+
+def test_retry_hint_is_jitter_floor():
+    policy = RetryPolicy(max_attempts=10, base_delay_s=0.05, max_delay_s=5.0,
+                         rng=random.Random(1))
+    session = policy.session()
+    for _ in range(5):
+        d = session.next_delay(hint_s=0.5)
+        assert d >= 0.5, "Retry-After hint is honored as the floor"
+
+
+def test_retry_budget_exhaustion_fails_fast():
+    budget = RetryBudget(capacity=2.0, refill_per_success=1.0)
+    policy = RetryPolicy(max_attempts=10, budget=budget,
+                         rng=random.Random(0))
+    session = policy.session()
+    assert session.next_delay() is not None
+    assert session.next_delay() is not None
+    assert session.next_delay() is None, "bucket empty: stop retrying"
+    assert obs.snapshot()["counters"]["retry.budget_exhausted"] == 1.0
+    budget.record_success()
+    assert policy.session().next_delay() is not None, "successes refill"
+
+
+def test_retry_call_retries_then_succeeds():
+    ft = FakeTime()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                         clock=ft.clock, sleep=ft.sleep,
+                         rng=random.Random(0))
+    assert policy.call(flaky, retry_on=(OSError,), site="test") == "ok"
+    assert calls["n"] == 3
+    assert obs.snapshot()["counters"]["retry.attempts"] == 2.0
+
+    calls["n"] = -10  # now it fails more times than the policy allows
+    with pytest.raises(OSError, match="transient"):
+        policy.call(flaky, retry_on=(OSError,), site="test")
+
+
+# -- circuit breaker -------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_open_halfopen_close_cycle(tmp_path, monkeypatch):
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", str(tmp_path))
+    from ncnet_tpu.obs import flight
+
+    flight.recorder().clear()
+    obs.event("warm", note="ring must be non-empty for the dump")
+
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0,
+                        clock=clock)
+    boom = RuntimeError("device on fire")
+
+    def failing():
+        raise boom
+
+    for _ in range(3):
+        with pytest.raises(RuntimeError, match="device on fire"):
+            br.call(failing)
+    assert br.state == "open"
+    snap = obs.snapshot()
+    assert snap["gauges"]["breaker.engine.state"] == 2.0
+    assert snap["counters"]["breaker.engine.opens"] == 1.0
+    dumps = glob.glob(str(tmp_path / "flight-breaker-open-engine-*.jsonl"))
+    assert len(dumps) == 1, "exactly one flight dump per open episode"
+
+    # While open: dispatch refused with a shrinking Retry-After.
+    with pytest.raises(BreakerOpenError) as exc_info:
+        br.call(lambda: "nope")
+    assert 0 < exc_info.value.retry_after_s <= 10.0
+    assert br.admit() is not None, "front door rejects too"
+    clock.t += 4.0
+    assert br.retry_after_s() == pytest.approx(6.0)
+
+    # Past the reset timeout: the next call is a half-open probe; its
+    # success closes the breaker and traffic flows again.
+    clock.t += 7.0
+    assert br.admit() is None, "probe-window requests are admitted"
+    assert br.call(lambda: "ok") == "ok"
+    assert br.state == "closed"
+    assert obs.snapshot()["gauges"]["breaker.engine.state"] == 0.0
+    assert br.call(lambda: "ok") == "ok"
+    # One open -> half_open -> closed cycle: no re-dump (cooldown), one
+    # opens count.
+    assert obs.snapshot()["counters"]["breaker.engine.opens"] == 1.0
+
+
+def test_breaker_probe_failure_reopens():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                        clock=clock)
+    with pytest.raises(ValueError):
+        br.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+    assert br.state == "open"
+    clock.t += 6.0
+    with pytest.raises(ValueError):  # the probe itself fails
+        br.call(lambda: (_ for _ in ()).throw(ValueError("y")))
+    assert br.state == "open", "failed probe re-opens for another window"
+    with pytest.raises(BreakerOpenError):
+        br.call(lambda: "still rejected")
+
+
+def test_breaker_bounds_concurrent_halfopen_probes():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0,
+                        half_open_probes=1, clock=clock)
+    br.record_failure(RuntimeError("x"))
+    clock.t += 2.0
+    br.allow()  # first probe admitted; still in flight
+    assert br.state == "half_open"
+    with pytest.raises(BreakerOpenError):
+        br.allow()
+    br.record_success()
+    assert br.state == "closed"
+
+
+# -- loader IO: retry + decode-error accounting ----------------------------
+
+
+def _write_jpeg(path, seed=0):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    Image.fromarray((rng.random((24, 32, 3)) * 255).astype("uint8")).save(
+        path, format="JPEG"
+    )
+
+
+def test_loader_read_retries_injected_faults(tmp_path):
+    from ncnet_tpu.data.image_io import load_and_resize_chw
+
+    path = str(tmp_path / "img.jpg")
+    _write_jpeg(path)
+    # Fail the first two reads; the retry guard absorbs both.
+    failpoints.set_failpoint("loader.read", "error", max_fires=2)
+    chw, im_size = load_and_resize_chw(path, 16, 16)
+    assert chw.shape == (3, 16, 16)
+    snap = obs.snapshot()
+    assert snap["counters"]["failpoint.loader.read"] == 2.0
+    assert snap["counters"]["retry.attempts"] == 2.0
+
+
+def test_loader_read_terminal_failure_surfaces(tmp_path):
+    from ncnet_tpu.data.image_io import load_and_resize_chw
+
+    path = str(tmp_path / "img.jpg")
+    _write_jpeg(path)
+    failpoints.set_failpoint("loader.read", "error")  # every attempt
+    with pytest.raises(InjectedFault):
+        load_and_resize_chw(path, 16, 16)
+    assert obs.snapshot()["counters"]["failpoint.loader.read"] == 3.0
+
+
+def test_loader_corrupt_mode_poisons_array(tmp_path):
+    from ncnet_tpu.data.image_io import load_and_resize_chw
+
+    path = str(tmp_path / "img.jpg")
+    _write_jpeg(path)
+    failpoints.set_failpoint("loader.read", "corrupt")
+    chw, _ = load_and_resize_chw(path, 16, 16)
+    assert np.isnan(chw).any(), "corrupt mode NaN-poisons the decode"
+
+
+def test_native_decode_error_is_counted_not_swallowed(tmp_path, monkeypatch):
+    """The ISSUE-5 satellite: a native-decoder failure must increment
+    image_io.decode_errors and emit an event before falling back to PIL
+    — never a bare ``pass``."""
+    from ncnet_tpu import native
+    from ncnet_tpu.data.image_io import load_and_resize_chw
+
+    path = str(tmp_path / "img.jpg")
+    _write_jpeg(path)
+    monkeypatch.setattr(native, "image_available", lambda: True)
+
+    def broken_native(*args, **kwargs):
+        raise RuntimeError("decoder exploded")
+
+    monkeypatch.setattr(native, "load_image_chw_native", broken_native,
+                        raising=False)
+    chw, im_size = load_and_resize_chw(path, 16, 16)
+    assert chw.shape == (3, 16, 16), "PIL fallback still serves the read"
+    assert obs.snapshot()["counters"]["image_io.decode_errors"] == 1.0
+
+
+# -- poison-batch isolation (batcher unit, fake clock) ---------------------
+
+
+def _poison_runner(calls):
+    def runner(bucket_key, payloads):
+        calls.append(list(payloads))
+        if any(p == "poison" for p in payloads):
+            raise ValueError("poison rider in batch")
+        return [f"r:{p}" for p in payloads]
+
+    return runner
+
+
+def test_poison_bisection_isolates_one_rider():
+    from ncnet_tpu.serving.batcher import DeadlineBatcher, PoisonRequestError
+
+    clock, calls = FakeClock(), []
+    b = DeadlineBatcher(_poison_runner(calls), max_batch=4, clock=clock)
+    futs = [b.submit("a", p)
+            for p in ("p0", "poison", "p2", "p3")]
+    assert b.poll() == 1
+    # Innocent riders complete with correct results...
+    assert futs[0].result(0).result == "r:p0"
+    assert futs[2].result(0).result == "r:p2"
+    assert futs[3].result(0).result == "r:p3"
+    # ...and the poison rider alone gets the structured isolation error.
+    with pytest.raises(PoisonRequestError) as exc_info:
+        futs[1].result(0)
+    assert isinstance(exc_info.value.cause, ValueError)
+    snap = obs.snapshot()["counters"]
+    assert snap["serving.poison_isolated"] == 1.0
+    assert snap["serving.poison_survivors"] == 3.0
+    assert snap["serving.poison_bisects"] >= 1.0
+    # Bisection re-ran subsets: full batch, halves, then singles as
+    # needed — every call either excludes the poison or shrinks it.
+    assert calls[0] == ["p0", "poison", "p2", "p3"]
+    assert ["poison"] in calls
+
+
+def test_isolate_poison_off_fails_whole_batch():
+    from ncnet_tpu.serving.batcher import DeadlineBatcher
+
+    clock, calls = FakeClock(), []
+    b = DeadlineBatcher(_poison_runner(calls), max_batch=2, clock=clock,
+                        isolate_poison=False)
+    f1 = b.submit("a", "p0")
+    f2 = b.submit("a", "poison")
+    assert b.poll() == 1
+    for f in (f1, f2):
+        with pytest.raises(ValueError, match="poison rider"):
+            f.result(0)
+    assert len(calls) == 1, "no bisection retries"
+    assert obs.snapshot()["counters"]["serving.batch_errors"] == 1.0
+
+
+def test_breaker_open_error_is_not_bisected():
+    from ncnet_tpu.serving.batcher import DeadlineBatcher
+
+    clock = FakeClock()
+
+    def refused(bucket_key, payloads):
+        raise BreakerOpenError(1.0)
+
+    b = DeadlineBatcher(refused, max_batch=2, clock=clock)
+    f1 = b.submit("a", "p0")
+    f2 = b.submit("a", "p1")
+    assert b.poll() == 1
+    for f in (f1, f2):
+        with pytest.raises(BreakerOpenError):
+            f.result(0)
+    assert "serving.poison_bisects" not in obs.snapshot()["counters"], (
+        "re-running sub-batches against an open breaker multiplies load"
+    )
+
+
+# -- checkpoint fault windows ----------------------------------------------
+
+
+def _tiny_checkpoint_args():
+    from ncnet_tpu.models.backbone import BackboneConfig
+    from ncnet_tpu.models.ncnet import NCNetConfig
+
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+    )
+    params = {"conv": {"w": np.arange(6, dtype=np.float32)}}
+    return params, config
+
+
+def test_checkpoint_commit_fault_leaves_resumable_state(tmp_path):
+    from ncnet_tpu.training.checkpoint import (
+        load_checkpoint,
+        resolve_resume_dir,
+        save_checkpoint,
+    )
+
+    params, config = _tiny_checkpoint_args()
+    directory = str(tmp_path)
+    save_checkpoint(directory, params, config, epoch=1, tag="step")
+
+    # Kill the NEXT rolling save in the commit window: the fresh dir is
+    # fully written but not yet swapped live.
+    failpoints.set_failpoint("checkpoint.save.commit", "error", max_fires=1)
+    params2 = {"conv": {"w": np.arange(6, dtype=np.float32) * 2}}
+    with pytest.raises(InjectedFault):
+        save_checkpoint(directory, params2, config, epoch=2, tag="step")
+
+    resumed = resolve_resume_dir(str(tmp_path / "step"))
+    assert resumed is not None, "a complete checkpoint survives the kill"
+    restored = load_checkpoint(resumed)
+    # The .tmp is complete and newer, so the epoch-2 save wins.
+    assert restored["meta"]["epoch"] == 2
+    np.testing.assert_array_equal(restored["params"]["conv"]["w"],
+                                  params2["conv"]["w"])
+
+
+def test_checkpoint_save_and_load_entry_faults(tmp_path):
+    from ncnet_tpu.training.checkpoint import load_checkpoint, save_checkpoint
+
+    params, config = _tiny_checkpoint_args()
+    failpoints.set_failpoint("checkpoint.save", "error", max_fires=1)
+    with pytest.raises(InjectedFault):
+        save_checkpoint(str(tmp_path), params, config, epoch=1)
+    tag = save_checkpoint(str(tmp_path), params, config, epoch=1)
+
+    failpoints.set_failpoint("checkpoint.load", "error", max_fires=1)
+    with pytest.raises(InjectedFault):
+        load_checkpoint(tag)
+    assert load_checkpoint(tag)["meta"]["epoch"] == 1

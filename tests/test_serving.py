@@ -138,12 +138,16 @@ def test_batcher_drain_on_close_completes_all_admitted():
 
 
 def test_batcher_runner_exception_propagates_per_request():
+    # isolate_poison=False: the pre-bisection contract — a failed batch
+    # forwards the raw runner exception to every rider. The bisection
+    # semantics of the default path live in test_reliability.py.
     clock = FakeClock()
 
     def boom(bucket_key, payloads):
         raise ValueError("device on fire")
 
-    b = DeadlineBatcher(boom, max_batch=2, clock=clock)
+    b = DeadlineBatcher(boom, max_batch=2, clock=clock,
+                        isolate_poison=False)
     f1 = b.submit("a", "p1")
     f2 = b.submit("a", "p2")
     assert b.poll() == 1
@@ -390,3 +394,180 @@ def _b64(data):
     import base64
 
     return base64.b64encode(data).decode()
+
+
+# -- chaos e2e: breaker, poison isolation, env-armed failpoints ------------
+
+
+def test_serving_e2e_breaker_opens_and_recovers(tiny_serving_model,
+                                                tmp_path, monkeypatch):
+    """ISSUE-5 acceptance: with engine.device=error:1.0 injected, the
+    breaker opens (503 + Retry-After, zero hung requests), /healthz
+    degrades, the flight dump is written exactly once; after the fault
+    clears and the reset window passes, the half-open probe closes it
+    and requests succeed again."""
+    import glob
+    import time
+
+    from ncnet_tpu.obs import flight
+    from ncnet_tpu.reliability import failpoints
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    flight_dir = str(tmp_path / "flight")
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", flight_dir)
+    flight.recorder().clear()  # resets the per-reason dump cooldown too
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    server = MatchServer(
+        engine, port=0, max_batch=1, max_queue=16, max_delay_s=0.01,
+        default_timeout_s=60.0, breaker_threshold=2, breaker_reset_s=2.0,
+    ).start()
+    try:
+        client = MatchClient(server.url, timeout_s=120.0, retries=0)
+        qb = _jpeg_bytes(96, 128, 0)
+        pb = _jpeg_bytes(96, 128, 1)
+        kwargs = dict(query_bytes=qb, pano_bytes=pb, max_matches=8)
+        assert client.match(**kwargs)["n_matches"] >= 1, "warm request"
+
+        failpoints.set_failpoint("engine.device", "error")
+        # Threshold consecutive dispatch failures: each is a structured
+        # 500 (the request is answered, not dropped)...
+        for _ in range(2):
+            with pytest.raises(ServingError) as exc_info:
+                client.match(**kwargs)
+            assert exc_info.value.status == 500
+        # ...then the breaker is open: immediate 503 + Retry-After from
+        # the front door, no device work attempted.
+        with pytest.raises(OverCapacityError) as exc_info:
+            client.match(**kwargs)
+        assert exc_info.value.status == 503
+        assert exc_info.value.payload["retry_after_s"] > 0
+        hz = client.healthz()
+        assert hz["status"] == "degraded"
+        assert hz["breaker"]["state"] == "open"
+        assert hz["failpoints"] == {"engine.device": "error"}
+        dumps = glob.glob(
+            flight_dir + "/flight-breaker-open-engine-*.jsonl")
+        assert len(dumps) == 1, "exactly one flight dump per open episode"
+        assert obs.snapshot()["counters"]["breaker.engine.opens"] == 1.0
+
+        # Fault cleared + reset window passed: the next request is the
+        # half-open probe; its success closes the breaker.
+        failpoints.clear("engine.device")
+        time.sleep(2.1)
+        assert client.match(**kwargs)["n_matches"] >= 1
+        assert server.breaker.state == "closed"
+        assert client.healthz()["status"] == "ok"
+    finally:
+        server.stop()
+
+
+def test_serving_e2e_poison_rider_isolated(tiny_serving_model):
+    """ISSUE-5 acceptance: one poison rider in a shared batch of 4
+    fails alone (structured PoisonRequestError) while the other three
+    riders return correct matches."""
+    from ncnet_tpu.reliability import failpoints
+    from ncnet_tpu.reliability.failpoints import InjectedFault
+    from ncnet_tpu.serving.batcher import PoisonRequestError
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    server = MatchServer(
+        engine, port=0, max_batch=4, max_queue=16, max_delay_s=0.5,
+        default_timeout_s=300.0, breaker_threshold=50,
+    ).start()
+    try:
+        qb = _jpeg_bytes(96, 128, 0)
+        pb = _jpeg_bytes(96, 128, 1)
+        body = {"query_b64": _b64(qb), "pano_b64": _b64(pb),
+                "max_matches": 8}
+        prepared = [server.engine.prepare(body) for _ in range(4)]
+        prepared[1].poison = True
+        # The per-rider chaos site: only the marked payload faults, so
+        # the dispatch fails exactly when rider 1 is in the batch.
+        failpoints.set_failpoint(
+            "engine.rider", "error",
+            match=lambda p: getattr(p, "poison", False),
+        )
+        futs = [server.batcher.submit(p.bucket_key, p) for p in prepared]
+        results, errors = {}, {}
+        for i, f in enumerate(futs):
+            try:
+                results[i] = f.result(timeout=300)
+            except Exception as exc:  # noqa: BLE001 — sorted below
+                errors[i] = exc
+        assert set(errors) == {1}, f"only the poison rider fails: {errors}"
+        assert isinstance(errors[1], PoisonRequestError)
+        assert isinstance(errors[1].cause, InjectedFault)
+        reference = None
+        for i in (0, 2, 3):
+            br = results[i]
+            assert br.result["n_matches"] >= 1
+            assert br.batch_size < 4, "innocents completed post-bisection"
+            if reference is None:
+                reference = br.result["matches"].tolist()
+            else:
+                assert br.result["matches"].tolist() == reference, (
+                    "identical innocent requests must return identical "
+                    "matches after isolation"
+                )
+        snap = obs.snapshot()["counters"]
+        assert snap["serving.poison_isolated"] == 1.0
+        assert snap["serving.poison_survivors"] == 3.0
+        assert snap["serving.poison_bisects"] >= 1.0
+    finally:
+        server.stop()
+
+
+def test_serving_e2e_env_failpoints_no_silent_drops(tiny_serving_model,
+                                                    monkeypatch):
+    """ISSUE-5 satellite: with NCNET_FAILPOINTS armed from the
+    environment, every request still gets a structured response — the
+    injected ones a 500 tagged kind=injected_fault, the rest correct
+    matches; nothing hangs or vanishes."""
+    from ncnet_tpu.reliability import failpoints
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    monkeypatch.setenv("NCNET_FAILPOINTS", "server.handle=error:1.0x2")
+    armed = failpoints.configure_from_env()
+    assert set(armed) == {"server.handle"}
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    server = MatchServer(
+        engine, port=0, max_batch=1, max_queue=16, max_delay_s=0.01,
+        default_timeout_s=60.0,
+    ).start()
+    try:
+        client = MatchClient(server.url, timeout_s=120.0, retries=0)
+        qb = _jpeg_bytes(96, 128, 0)
+        pb = _jpeg_bytes(96, 128, 1)
+        outcomes = []
+        for _ in range(4):
+            try:
+                outcomes.append(
+                    ("ok", client.match(query_bytes=qb, pano_bytes=pb,
+                                        max_matches=8)))
+            except ServingError as exc:
+                outcomes.append(("error", exc))
+        assert len(outcomes) == 4, "no silent drops"
+        injected = [o for kind, o in outcomes if kind == "error"]
+        served = [o for kind, o in outcomes if kind == "ok"]
+        assert len(injected) == 2, "x2 cap: exactly two injected faults"
+        for exc in injected:
+            assert exc.status == 500
+            assert exc.payload["kind"] == "injected_fault"
+        assert len(served) == 2
+        for resp in served:
+            assert resp["n_matches"] >= 1
+        assert obs.snapshot()["counters"]["failpoint.server.handle"] == 2.0
+    finally:
+        server.stop()

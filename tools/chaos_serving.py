@@ -1,0 +1,282 @@
+"""Chaos harness for the online matching service: load + timed faults.
+
+Spins up an in-process :class:`MatchServer` (failpoints are
+process-local, so the faults must be injected from inside), drives it
+with the same open-loop arrival schedule as tools/bench_serving.py,
+and arms/disarms failpoint windows on a wall-clock schedule::
+
+    python tools/chaos_serving.py --synthetic 96x128 --rate 6 \
+        --duration_s 8 --breaker_threshold 3 --breaker_reset_s 1.0 \
+        --fault "engine.device=error:1.0@2.0-4.0"
+
+``--fault "site=mode[:args]@start-end"`` (repeatable) arms the term at
+``start`` seconds into the run and disarms it at ``end``;
+``--failpoints SPEC`` arms a static spec for the whole run. A healthz
+poller records every breaker state change it observes.
+
+Prints ONE JSON line (the repo's bench stdout contract,
+tests/test_bench_contract.py)::
+
+    {"metric": "chaos_serving_survival", "value": <ok+rejected+poison
+     fraction of sent>, "unit": "frac", "sent": ..., "ok": ...,
+     "rejected": ..., "poison": ..., "errors": ..., "dropped": ...,
+     "breaker_transitions": [...], "faults": {...}, "duration_s": ...}
+
+``dropped`` is the no-silent-drops check: every scheduled request must
+come back as ok / rejected / poison / error — anything unaccounted for
+is a hung or vanished request, and the exit code is nonzero.
+Stage notes go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+from bench_serving import note, percentile, synth_jpegs  # noqa: E402
+
+
+def parse_fault_window(spec):
+    """``site=mode[:args]@start-end`` -> (term, site, start_s, end_s)."""
+    term, sep, window = spec.rpartition("@")
+    if not sep:
+        raise ValueError(f"bad --fault {spec!r} (want term@start-end)")
+    start_s, _, end_s = window.partition("-")
+    site = term.partition("=")[0].strip()
+    return term.strip(), site, float(start_s), float(end_s)
+
+
+def main(argv=None, model=None):
+    parser = argparse.ArgumentParser(
+        description="chaos harness: in-process serving under load + faults"
+    )
+    parser.add_argument("--rate", type=float, default=6.0,
+                        help="open-loop arrival rate, requests/s")
+    parser.add_argument("--duration_s", type=float, default=8.0)
+    parser.add_argument("--threads", type=int, default=8)
+    parser.add_argument("--synthetic", type=str, default="96x128",
+                        help="HxW: random images, sent inline b64")
+    parser.add_argument("--fault", action="append", default=[],
+                        help="timed window: site=mode[:args]@start-end "
+                        "seconds into the run (repeatable)")
+    parser.add_argument("--failpoints", type=str, default="",
+                        help="static spec armed for the whole run "
+                        "(NCNET_FAILPOINTS grammar)")
+    parser.add_argument("--image_size", type=int, default=64)
+    parser.add_argument("--max_batch", type=int, default=4)
+    parser.add_argument("--max_delay_ms", type=float, default=50.0)
+    parser.add_argument("--breaker_threshold", type=int, default=3)
+    parser.add_argument("--breaker_reset_s", type=float, default=1.0)
+    parser.add_argument("--no_isolate_poison", action="store_true")
+    parser.add_argument("--client_retries", type=int, default=2)
+    parser.add_argument("--health_poll_s", type=float, default=0.1)
+    parser.add_argument("--run_log", type=str, default="",
+                        help="structured JSONL run log path (empty disables)")
+    args = parser.parse_args(argv)
+    windows = [parse_fault_window(s) for s in args.fault]
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from ncnet_tpu import obs
+    from ncnet_tpu.reliability import failpoints
+    from ncnet_tpu.serving.client import (
+        MatchClient,
+        OverCapacityError,
+        PoisonRequestError,
+        ServingError,
+    )
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    run_log = None
+    if args.run_log:
+        run_log = obs.init_run("chaos_serving", args.run_log, args=args)
+
+    if model is None:
+        from ncnet_tpu.cli.common import build_model
+
+        note("building tiny model (pass model= to reuse one in-process)")
+        model = build_model(
+            ncons_kernel_sizes=(3, 3),
+            ncons_channels=(16, 1),
+            relocalization_k_size=2,
+            half_precision=True,
+            backbone_bf16=True,
+        )
+    config, params = model
+    engine = MatchEngine(config, params, k_size=2,
+                         image_size=args.image_size, cache_mb=0)
+    h, w = (int(v) for v in args.synthetic.split("x"))
+    # Warm the exact buckets the load hits: the run must measure the
+    # reliability machinery, not first-request XLA compiles racing the
+    # fault windows.
+    engine.warmup([(h, w, h, w)],
+                  batch_sizes=sorted({1, max(1, args.max_batch // 2),
+                                      args.max_batch}))
+    if args.failpoints:
+        failpoints.configure(args.failpoints)
+        note(f"static failpoints: {sorted(failpoints.active())}")
+    server = MatchServer(
+        engine, port=0,
+        max_batch=args.max_batch,
+        max_delay_s=args.max_delay_ms / 1e3,
+        default_timeout_s=max(args.duration_s * 4, 60.0),
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
+        isolate_poison=not args.no_isolate_poison,
+        run_log=run_log,
+    ).start()
+    note(f"serving on {server.url}; fault windows: "
+         f"{[(t, a, b) for t, _, a, b in windows]}")
+
+    q_bytes, p_bytes = synth_jpegs(args.synthetic)
+    kwargs = {"query_bytes": q_bytes, "pano_bytes": p_bytes,
+              "max_matches": 8}
+    client = MatchClient(server.url, timeout_s=max(args.duration_s * 4, 60.0),
+                         retries=args.client_retries,
+                         retry_deadline_s=args.duration_s)
+
+    stop = threading.Event()
+    t0 = time.monotonic()
+
+    fault_log = {}
+
+    def fault_scheduler():
+        """Arm/disarm each window at its wall-clock offsets."""
+        events = sorted(
+            [(start, "arm", term, site) for term, site, start, _ in windows]
+            + [(end, "disarm", term, site) for term, site, _, end in windows]
+        )
+        for at, action, term, site in events:
+            delay = t0 + at - time.monotonic()
+            if delay > 0 and stop.wait(delay):
+                return
+            if action == "arm":
+                fp = failpoints.parse_spec(term)[site]
+                failpoints.registry().set(
+                    site, fp.mode, prob=fp.prob, delay_s=fp.delay_s,
+                    max_fires=fp.max_fires,
+                )
+                note(f"t+{at:.1f}s armed {term}")
+            else:
+                failpoints.clear(site)
+                note(f"t+{at:.1f}s cleared {site}")
+            fault_log.setdefault(site, []).append(
+                {"t_s": at, "action": action})
+
+    transitions = []
+
+    def health_poller():
+        """Record every /healthz status + breaker state change seen."""
+        probe = MatchClient(server.url, timeout_s=5.0, retries=0)
+        last = None
+        while not stop.is_set():
+            try:
+                hz = probe.healthz()
+            except (ServingError, OSError):
+                stop.wait(args.health_poll_s)
+                continue
+            cur = (hz["status"], hz["breaker"]["state"])
+            if cur != last:
+                transitions.append({
+                    "t_s": round(time.monotonic() - t0, 3),
+                    "status": cur[0], "breaker": cur[1],
+                })
+                last = cur
+            stop.wait(args.health_poll_s)
+
+    n_requests = max(1, int(args.rate * args.duration_s))
+    lock = threading.Lock()
+    lat_ms = []
+    counts = {"sent": 0, "ok": 0, "rejected": 0, "poison": 0, "errors": 0}
+    sched = {"next": 0}
+
+    def worker():
+        while True:
+            with lock:
+                i = sched["next"]
+                if i >= n_requests:
+                    return
+                sched["next"] = i + 1
+            due = t0 + i / args.rate
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t_req = time.monotonic()
+            try:
+                client.match(**kwargs)
+            except OverCapacityError:
+                with lock:
+                    counts["sent"] += 1
+                    counts["rejected"] += 1
+                continue
+            except PoisonRequestError:
+                with lock:
+                    counts["sent"] += 1
+                    counts["poison"] += 1
+                continue
+            except (ServingError, OSError) as exc:
+                with lock:
+                    counts["sent"] += 1
+                    counts["errors"] += 1
+                note(f"error on req {i}: {exc}")
+                continue
+            dt_ms = (time.monotonic() - t_req) * 1e3
+            with lock:
+                counts["sent"] += 1
+                counts["ok"] += 1
+                lat_ms.append(dt_ms)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(min(args.threads, n_requests))]
+    aux = [threading.Thread(target=fault_scheduler, daemon=True),
+           threading.Thread(target=health_poller, daemon=True)]
+    for t in aux + threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    for t in aux:
+        t.join(timeout=5)
+    elapsed = time.monotonic() - t0
+    failpoints.clear()
+    server.stop()
+    if run_log is not None:
+        run_log.close("ok")
+
+    # Survival: every request is accounted for AND got a structured
+    # outcome the client can act on (success, retryable 503, or a
+    # proven-poison 422). errors (500s, transport) and silent drops are
+    # the chaos failures this tool exists to surface.
+    accounted = sum(counts[k] for k in ("ok", "rejected", "poison", "errors"))
+    dropped = n_requests - accounted
+    survived = counts["ok"] + counts["rejected"] + counts["poison"]
+    lat_ms.sort()
+    rec = {
+        "metric": "chaos_serving_survival",
+        "value": round(survived / n_requests, 4),
+        "unit": "frac",
+        "sent": counts["sent"],
+        "ok": counts["ok"],
+        "rejected": counts["rejected"],
+        "poison": counts["poison"],
+        "errors": counts["errors"],
+        "dropped": dropped,
+        "latency_ms": {
+            "p50": round(percentile(lat_ms, 50), 3) if lat_ms else None,
+            "p99": round(percentile(lat_ms, 99), 3) if lat_ms else None,
+        },
+        "breaker_transitions": transitions,
+        "faults": fault_log,
+        "duration_s": round(elapsed, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    return 0 if dropped == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
