@@ -19,7 +19,7 @@ unconditionally — they no-op (or accumulate invisibly) unless an entry
 point opened a run log.
 """
 
-from . import flight, trace
+from . import aggregate, flight, slo, trace
 from .events import (
     NULL_RUN,
     RunLog,
@@ -37,14 +37,22 @@ from .metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    bucket_quantile,
     counter,
     default_registry,
+    format_series,
     gauge,
     histogram,
+    parse_series,
     render_text,
+    replica_id,
+    replica_labels,
     reset,
+    set_build_info,
+    set_replica_id,
     snapshot,
 )
+from .slo import SloEngine, SloSpec, default_serving_slos
 
 __all__ = [
     "NULL_RUN",
@@ -54,8 +62,13 @@ __all__ = [
     "get_run",
     "init_run",
     "span",
+    "aggregate",
     "flight",
+    "slo",
     "trace",
+    "SloEngine",
+    "SloSpec",
+    "default_serving_slos",
     "FlightRecorder",
     "SpanCtx",
     "install_compile_telemetry",
@@ -65,11 +78,18 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "bucket_quantile",
     "counter",
     "default_registry",
+    "format_series",
     "gauge",
     "histogram",
+    "parse_series",
     "render_text",
+    "replica_id",
+    "replica_labels",
     "reset",
+    "set_build_info",
+    "set_replica_id",
     "snapshot",
 ]

@@ -1,0 +1,323 @@
+"""Cross-replica metric aggregation: N snapshots/scrapes -> one fleet view.
+
+The fleet primitive for ROADMAP item 1 (multi-replica serving): each
+replica labels its hot-path series with ``replica="<id>"``
+(obs/metrics.py), and this module merges any number of registry
+snapshots — or live ``GET /metrics`` scrapes — into a single view:
+
+* **counters** sum across replicas;
+* **histograms** merge at bucket resolution: per-bucket deltas add, so
+  the fleet p50/p95/p99 are EXACT at the shared ladder's resolution
+  (the same :func:`~ncnet_tpu.obs.metrics.bucket_quantile` math a local
+  histogram uses — not an average of per-replica percentiles, which
+  would be statistically meaningless);
+* **gauges** keep per-replica values plus min/max/mean (a queue depth
+  summed across replicas is a lie; the dispatcher wants the spread).
+
+Series identity: the ``replica`` label IS the identity. Two sources
+reporting the same (name, labels, replica) series are the same series
+observed twice — last wins, no double count (this also makes merging
+two servers that share one process registry correct, the tier-1 demo's
+shape). Series WITHOUT a replica label are treated per-source.
+
+Everything here is stdlib-only and host-side: the dashboard
+(tools/fleet_status.py) and tests consume it without jax.
+"""
+
+from __future__ import annotations
+
+import math
+import urllib.request
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .metrics import (
+    _LABEL_RE,
+    _unescape_label_value,
+    bucket_quantile,
+    format_series,
+    parse_series,
+)
+
+#: The label that names a series' owning replica (obs/metrics.py
+#: replica_labels / the serving --replica_id identity).
+REPLICA_LABEL = "replica"
+
+
+def _merge_histograms(entries: List[dict]) -> dict:
+    """Merge snapshot-form histogram entries exactly, at bucket resolution.
+
+    Each entry carries ``buckets`` as sparse cumulative ``[le, cum]``
+    pairs (obs/metrics.Histogram.snapshot); cumulative counts convert
+    to per-bucket deltas, deltas add across entries, and the merged
+    quantiles run the same bucket interpolation a local histogram uses.
+    """
+    deltas: Dict[float, float] = {}
+    inf = 0.0
+    count = 0.0
+    total_sum = 0.0
+    mn = mx = last = None
+    for h in entries:
+        c = float(h.get("count") or 0)
+        count += c
+        total_sum += float(h.get("sum") or 0.0)
+        if h.get("min") is not None:
+            mn = h["min"] if mn is None else min(mn, h["min"])
+        if h.get("max") is not None:
+            mx = h["max"] if mx is None else max(mx, h["max"])
+        if h.get("last") is not None:
+            last = h["last"]
+        prev = 0.0
+        for le, cum in h.get("buckets") or []:
+            deltas[float(le)] = deltas.get(float(le), 0.0) + (cum - prev)
+            prev = cum
+        inf += c - prev  # observations above the last finite bound
+    bounds = sorted(deltas)
+    counts = [deltas[b] for b in bounds] + [inf]
+
+    def q(p):
+        return bucket_quantile(bounds, counts, count, p,
+                               lo_clamp=mn, hi_clamp=mx)
+
+    cum, buckets = 0.0, []
+    for b in bounds:
+        cum += deltas[b]
+        buckets.append([b, cum])
+    return {
+        "count": count,
+        "sum": total_sum,
+        "mean": (total_sum / count) if count else None,
+        "min": mn,
+        "max": mx,
+        "last": last,
+        "p50": q(0.50),
+        "p95": q(0.95),
+        "p99": q(0.99),
+        "buckets": buckets,
+    }
+
+
+def merge_snapshots(snaps: Iterable[dict]) -> dict:
+    """Merge N registry snapshots (or parsed scrapes) into a fleet view.
+
+    Returns::
+
+        {"n_sources": N, "replicas": [...sorted replica ids...],
+         "counters":   {series: summed value},
+         "gauges":     {series: {"min","max","mean","n",
+                                 "per_replica": {id: value}}},
+         "histograms": {series: merged entry (snapshot shape)},
+         "per_replica": {id: {"counters": {...}, "gauges": {...},
+                              "histograms": {...}}}}
+
+    Series keys in the output have the ``replica`` label STRIPPED (it
+    became the aggregation dimension); all other labels survive. A
+    source with no replica-labeled series contributes under the
+    synthetic id ``source<i>``.
+    """
+    snaps = list(snaps)
+    stores = {"counters": {}, "gauges": {}, "histograms": {}}
+    replicas = set()
+    for i, snap in enumerate(snaps):
+        for kind, store in stores.items():
+            for series, val in (snap.get(kind) or {}).items():
+                name, lbls = parse_series(series)
+                rid = lbls.pop(REPLICA_LABEL, None)
+                rest = tuple(sorted(lbls.items()))
+                if rid is None:
+                    ident = f"source{i}"
+                else:
+                    ident = rid
+                    replicas.add(rid)
+                # Same (name, labels, replica) from two sources is ONE
+                # series observed twice: last wins, no double count.
+                store.setdefault((name, rest), {})[ident] = val
+
+    out = {
+        "n_sources": len(snaps),
+        "replicas": sorted(replicas),
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+        "per_replica": {},
+    }
+
+    def per_replica(ident):
+        return out["per_replica"].setdefault(
+            ident, {"counters": {}, "gauges": {}, "histograms": {}})
+
+    for (name, rest), by_id in sorted(stores["counters"].items()):
+        key = format_series(name, dict(rest))
+        out["counters"][key] = sum(by_id.values())
+        for ident, v in sorted(by_id.items()):
+            per_replica(ident)["counters"][key] = v
+
+    for (name, rest), by_id in sorted(stores["gauges"].items()):
+        key = format_series(name, dict(rest))
+        vals = {i: v for i, v in by_id.items() if v is not None}
+        entry = {"n": len(vals), "per_replica": dict(sorted(vals.items()))}
+        if vals:
+            entry["min"] = min(vals.values())
+            entry["max"] = max(vals.values())
+            entry["mean"] = sum(vals.values()) / len(vals)
+        out["gauges"][key] = entry
+        for ident, v in sorted(vals.items()):
+            per_replica(ident)["gauges"][key] = v
+
+    for (name, rest), by_id in sorted(stores["histograms"].items()):
+        key = format_series(name, dict(rest))
+        out["histograms"][key] = _merge_histograms(list(by_id.values()))
+        for ident, h in sorted(by_id.items()):
+            per_replica(ident)["histograms"][key] = h
+    return out
+
+
+# -- Prometheus text exposition -> snapshot form -------------------------
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_sample(line: str) -> Optional[Tuple[str, Dict[str, str], float]]:
+    rest = line
+    name, labels = rest, {}
+    if "{" in line:
+        name, _, rest = line.partition("{")
+        body, _, rest = rest.rpartition("}")
+        labels = {k: _unescape_label_value(v)
+                  for k, v in _LABEL_RE.findall(body)}
+    else:
+        name, _, rest = line.partition(" ")
+    try:
+        value = float(rest.strip())
+    except ValueError:
+        return None
+    return name.strip(), labels, value
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Parse one ``GET /metrics`` body back into registry-snapshot form.
+
+    The inverse of ``MetricsRegistry.render_text`` (modulo the dotted->
+    underscore name sanitization, which is not invertible: scraped
+    snapshots carry prom-style names, so only merge scrapes with
+    scrapes). ``_total`` counters lose the suffix marker back into the
+    counter map; histogram ``_bucket``/``_sum``/``_count`` lines and the
+    ``_min``/``_max``/``_last`` companion gauges fold back into one
+    histogram entry per labeled series.
+    """
+    types: Dict[str, str] = {}
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        s = _parse_sample(line)
+        if s is not None:
+            samples.append(s)
+
+    hist_families = {n for n, t in types.items() if t == "histogram"}
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    raw_hists: Dict[Tuple[str, tuple], dict] = {}
+
+    def hist_entry(base, labels):
+        key = (base, tuple(sorted(labels.items())))
+        return raw_hists.setdefault(
+            key, {"buckets": {}, "count": 0.0, "sum": 0.0})
+
+    for name, labels, value in samples:
+        if types.get(name) == "counter" and name.endswith("_total"):
+            out["counters"][format_series(name[:-6], labels)] = value
+            continue
+        matched = False
+        for suffix in _HIST_SUFFIXES:
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and base in hist_families:
+                if suffix == "_bucket":
+                    le = labels.pop("le", None)
+                    if le is not None:
+                        b = float(le)
+                        if math.isfinite(b):
+                            hist_entry(base, labels)["buckets"][b] = value
+                elif suffix == "_sum":
+                    hist_entry(base, labels)["sum"] = value
+                else:
+                    hist_entry(base, labels)["count"] = value
+                matched = True
+                break
+        if matched:
+            continue
+        for suffix in ("_min", "_max", "_last"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and base in hist_families:
+                hist_entry(base, labels)[suffix[1:]] = value
+                matched = True
+                break
+        if not matched:
+            out["gauges"][format_series(name, labels)] = value
+
+    for (base, lbls), raw in sorted(raw_hists.items()):
+        count = raw.get("count", 0.0)
+        bounds = sorted(raw["buckets"])
+        # Cumulative finite-bucket lines -> sparse [le, cum] pairs
+        # (drop repeats: the exposition elides empties, but a merged
+        # upstream may not have).
+        prev, buckets = 0.0, []
+        for b in bounds:
+            cum = raw["buckets"][b]
+            if cum != prev:
+                buckets.append([b, cum])
+            prev = cum
+        entry = {
+            "count": count,
+            "sum": raw.get("sum", 0.0),
+            "mean": (raw.get("sum", 0.0) / count) if count else None,
+            "min": raw.get("min"),
+            "max": raw.get("max"),
+            "last": raw.get("last"),
+            "buckets": buckets,
+        }
+        deltas, p = [], 0.0
+        for b in bounds:
+            deltas.append(raw["buckets"][b] - p)
+            p = raw["buckets"][b]
+        deltas.append(count - p)
+        for qname, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            entry[qname] = bucket_quantile(
+                bounds, deltas, count, q,
+                lo_clamp=entry["min"], hi_clamp=entry["max"])
+        out["histograms"][format_series(base, dict(lbls))] = entry
+    return out
+
+
+def scrape(url: str, timeout_s: float = 5.0) -> dict:
+    """Fetch one replica's ``/metrics`` and parse it to snapshot form."""
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        body = resp.read().decode("utf-8", "replace")
+    return parse_prometheus_text(body)
+
+
+def fleet_view(urls: Iterable[str], timeout_s: float = 5.0) -> dict:
+    """Scrape every url and merge: the dashboard's one-call primitive.
+
+    Unreachable replicas do not fail the view — they land in
+    ``errors`` (url -> reason) and the merge covers the rest; a fleet
+    view that dies with its least healthy member is useless exactly
+    when it matters.
+    """
+    snaps, errors, sources = [], {}, []
+    for url in urls:
+        try:
+            snaps.append(scrape(url, timeout_s=timeout_s))
+            sources.append(url)
+        except Exception as exc:  # noqa: BLE001 — per-source isolation
+            errors[url] = f"{type(exc).__name__}: {exc}"
+    view = merge_snapshots(snaps)
+    view["sources"] = sources
+    view["errors"] = errors
+    return view

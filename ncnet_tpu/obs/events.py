@@ -342,6 +342,13 @@ def init_run(
     with _active_lock:
         _active.append(run)
     _install_exit_hooks()
+    # Identity as a metric (Prometheus info idiom): version/backend/
+    # replica ride the labels of a constant-1 gauge, so a scraper knows
+    # who it is talking to without parsing /healthz.
+    try:
+        _metrics.set_build_info(registry=run.registry, component=component)
+    except Exception:
+        pass
     # Compile telemetry rides every run: recompile storms are a serving
     # problem first, but an eval that silently retraces per query is
     # the same disease (obs/trace.install_compile_telemetry).

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from . import metrics as _metrics
+
 
 class Heartbeat:
     """Background thread emitting periodic ``heartbeat`` events on a RunLog.
@@ -81,9 +83,14 @@ class Heartbeat:
         now = self.clock()
         idle_s = now - self.runlog.last_progress_mono
         stalled = idle_s >= self.stall_after_s
+        # Liveness as metrics, not just events: a scraper (or the fleet
+        # dashboard) sees a wedged replica without reading its run log.
+        registry = (getattr(self.runlog, "registry", None)
+                    or _metrics.default_registry())
         if stalled and not self._in_stall:
             self._in_stall = True
             self.stalls += 1
+            registry.counter("obs.heartbeat.stalls").inc()
             self.runlog.event("stall", idle_s=idle_s,
                               stall_after_s=self.stall_after_s)
             # Dump the flight ring at the START of the episode — the
@@ -101,6 +108,8 @@ class Heartbeat:
                 pass
         elif not stalled:
             self._in_stall = False
+        registry.gauge("obs.heartbeat.in_stall").set(
+            1.0 if self._in_stall else 0.0)
         self.beats += 1
         fields = {"idle_s": idle_s, "stalled": stalled, "beat": self.beats}
         self.runlog.event("heartbeat", **fields)

@@ -21,14 +21,24 @@ Metric naming convention (docs/OBSERVABILITY.md): dotted lowercase
 ``component.subsystem.name`` with the unit as a suffix where ambiguous
 (``_s``, ``_bytes``, ``_frac``) — e.g. ``train.step_time_s``,
 ``eval_inloc.cache.hits``, ``data.loader.starved``.
+
+Labels (ISSUE 6 tentpole): every accessor takes an optional label set
+(``counter("serving.requests", labels={"replica": "r0"})``). A metric
+name now addresses a *family*; each distinct label set is its own child
+series with its own lock and state. Unlabeled access is the child with
+the empty label set, so pre-label callers and snapshot consumers see
+byte-identical behavior. Labeled series appear in ``snapshot()`` under
+``name{k="v",...}`` keys (sorted keys — see :func:`format_series`) and
+in ``render_text()`` as standard Prometheus label blocks.
 """
 
 from __future__ import annotations
 
 import bisect
+import os
 import re
 import threading
-from typing import Dict, Optional
+from typing import Dict, Iterable, Mapping, Optional, Tuple, Union
 
 #: Fixed log-spaced histogram buckets: 4 per decade over 1e-4 .. 1e4
 #: (upper bounds, Prometheus ``le`` semantics; everything above the
@@ -39,12 +49,110 @@ from typing import Dict, Optional
 #: = 34 ints per histogram: bounded state, unlike a sample list.
 DEFAULT_BUCKETS = tuple(10.0 ** (k / 4.0) for k in range(-16, 17))
 
+#: A normalized label set: sorted ``(key, value)`` pairs. The empty
+#: tuple is the unlabeled series.
+LabelKey = Tuple[Tuple[str, str], ...]
+
+Labels = Union[None, Mapping[str, object], Iterable[Tuple[str, object]]]
+
+
+def label_key(labels: Labels) -> LabelKey:
+    """Normalize a label mapping into the canonical sorted-tuple key."""
+    if not labels:
+        return ()
+    items = labels.items() if isinstance(labels, Mapping) else labels
+    return tuple(sorted((_prom_name(str(k)), str(v)) for k, v in items))
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _unescape_label_value(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            n = v[i + 1]
+            out.append({"n": "\n"}.get(n, n))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _render_labels(labels: LabelKey) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labels)
+    return "{" + body + "}"
+
+
+def format_series(name: str, labels: Labels = None) -> str:
+    """Canonical series key: ``name`` or ``name{k="v",...}`` (sorted keys).
+
+    Shared by ``snapshot()``, ``obs/aggregate.py`` and
+    ``tools/obs_report.py`` so every layer agrees on series identity.
+    """
+    return name + _render_labels(label_key(labels))
+
+
+_SERIES_RE = re.compile(r"^(?P<name>[^{]+?)(?:\{(?P<labels>.*)\})?$")
+_LABEL_RE = re.compile(r'([A-Za-z_:][A-Za-z0-9_:.]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_series(series: str) -> Tuple[str, Dict[str, str]]:
+    """Inverse of :func:`format_series`: ``name{k="v"}`` -> (name, labels)."""
+    m = _SERIES_RE.match(series)
+    if not m:
+        return series, {}
+    labels = {}
+    if m.group("labels"):
+        for k, v in _LABEL_RE.findall(m.group("labels")):
+            labels[k] = _unescape_label_value(v)
+    return m.group("name"), labels
+
+
+def bucket_quantile(bounds, bucket_counts, count, q,
+                    lo_clamp=None, hi_clamp=None) -> Optional[float]:
+    """Bucket-interpolated quantile over per-bucket (delta) counts.
+
+    ``bucket_counts`` has ``len(bounds) + 1`` entries, the last being
+    the +Inf bucket. Shared by :class:`Histogram` and the fleet-level
+    merge in ``obs/aggregate.py`` so a merged histogram quantiles
+    exactly like a local one.
+    """
+    if not count:
+        return None
+    target = q * count
+    cum = 0
+    for i, c in enumerate(bucket_counts):
+        if not c:
+            continue
+        cum += c
+        if cum >= target:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = (bounds[i] if i < len(bounds)
+                  else (hi_clamp if hi_clamp is not None else lo))
+            frac = (target - (cum - c)) / c
+            est = lo + (hi - lo) * frac
+            # The ladder is coarser than the data near the edges:
+            # never report outside the observed range.
+            if lo_clamp is not None:
+                est = max(est, lo_clamp)
+            if hi_clamp is not None:
+                est = min(est, hi_clamp)
+            return est
+    return hi_clamp
+
 
 class Counter:
     """Monotonically increasing count (events, items, bytes)."""
 
     def __init__(self, name: str, lock: threading.Lock):
         self.name = name
+        self.labels: LabelKey = ()
         self._lock = lock
         self._value = 0.0
 
@@ -66,6 +174,7 @@ class Gauge:
 
     def __init__(self, name: str, lock: threading.Lock):
         self.name = name
+        self.labels: LabelKey = ()
         self._lock = lock
         self._value: Optional[float] = None
 
@@ -96,6 +205,7 @@ class Histogram:
     def __init__(self, name: str, lock: threading.Lock,
                  buckets=DEFAULT_BUCKETS):
         self.name = name
+        self.labels: LabelKey = ()
         self._lock = lock
         self.buckets = tuple(sorted(float(b) for b in buckets))
         self._bucket_counts = [0] * (len(self.buckets) + 1)  # last: +Inf
@@ -119,28 +229,9 @@ class Histogram:
 
     def _quantile_locked(self, q: float) -> Optional[float]:
         """Bucket-interpolated quantile; caller holds the lock."""
-        if not self.count:
-            return None
-        target = q * self.count
-        cum = 0
-        for i, c in enumerate(self._bucket_counts):
-            if not c:
-                continue
-            cum += c
-            if cum >= target:
-                lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = (self.buckets[i] if i < len(self.buckets)
-                      else (self.max if self.max is not None else lo))
-                frac = (target - (cum - c)) / c
-                est = lo + (hi - lo) * frac
-                # The ladder is coarser than the data near the edges:
-                # never report outside the observed range.
-                if self.min is not None:
-                    est = max(est, self.min)
-                if self.max is not None:
-                    est = min(est, self.max)
-                return est
-        return self.max
+        return bucket_quantile(self.buckets, self._bucket_counts,
+                               self.count, q,
+                               lo_clamp=self.min, hi_clamp=self.max)
 
     def quantile(self, q: float) -> Optional[float]:
         with self._lock:
@@ -159,6 +250,15 @@ class Histogram:
     def snapshot(self) -> dict:
         with self._lock:
             mean = self.sum / self.count if self.count else None
+            # Sparse cumulative bucket list: only the finite bounds
+            # whose bucket is non-empty ([le, cumulative] pairs; the
+            # +Inf remainder is implied by `count`). This is what lets
+            # obs/aggregate.py merge replicas' histograms exactly.
+            buckets, cum = [], 0
+            for b, c in zip(self.buckets, self._bucket_counts):
+                cum += c
+                if c:
+                    buckets.append([b, cum])
             return {
                 "count": self.count,
                 "sum": self.sum,
@@ -169,11 +269,23 @@ class Histogram:
                 "p50": self._quantile_locked(0.50),
                 "p95": self._quantile_locked(0.95),
                 "p99": self._quantile_locked(0.99),
+                "buckets": buckets,
             }
 
 
+class _Family:
+    """One metric name -> its children, keyed by normalized label set."""
+
+    __slots__ = ("name", "cls", "children")
+
+    def __init__(self, name: str, cls):
+        self.name = name
+        self.cls = cls
+        self.children: Dict[LabelKey, object] = {}
+
+
 class MetricsRegistry:
-    """Name -> metric map with get-or-create accessors.
+    """Name -> metric-family map with get-or-create accessors.
 
     One process-wide default registry (module functions below) so
     library code (data/loader.py, localization/driver.py) can record
@@ -183,45 +295,59 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, object] = {}
+        self._families: Dict[str, _Family] = {}
 
-    def _get_or_create(self, name: str, cls):
+    def _get_or_create(self, name: str, cls, labels: Labels = None):
+        key = label_key(labels)
         with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                # Each metric gets its own lock: a hot counter on the
-                # loader's producer thread must not contend with the
-                # registry-structure lock held during snapshot().
-                m = cls(name, threading.Lock())
-                self._metrics[name] = m
-            elif not isinstance(m, cls):
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, cls)
+                self._families[name] = fam
+            elif fam.cls is not cls:
                 raise TypeError(
                     f"metric {name!r} already registered as "
-                    f"{type(m).__name__}, requested {cls.__name__}"
+                    f"{fam.cls.__name__}, requested {cls.__name__}"
                 )
-            return m
+            child = fam.children.get(key)
+            if child is None:
+                # Each child gets its own lock: a hot counter on the
+                # loader's producer thread must not contend with the
+                # registry-structure lock held during snapshot().
+                child = cls(name, threading.Lock())
+                child.labels = key
+                fam.children[key] = child
+            return child
 
-    def counter(self, name: str) -> Counter:
-        return self._get_or_create(name, Counter)
+    def counter(self, name: str, labels: Labels = None) -> Counter:
+        return self._get_or_create(name, Counter, labels)
 
-    def gauge(self, name: str) -> Gauge:
-        return self._get_or_create(name, Gauge)
+    def gauge(self, name: str, labels: Labels = None) -> Gauge:
+        return self._get_or_create(name, Gauge, labels)
 
-    def histogram(self, name: str) -> Histogram:
-        return self._get_or_create(name, Histogram)
+    def histogram(self, name: str, labels: Labels = None) -> Histogram:
+        return self._get_or_create(name, Histogram, labels)
+
+    def _sorted_families(self):
+        with self._lock:
+            fams = sorted(self._families.items())
+            return [(name, fam.cls,
+                     [fam.children[k] for k in sorted(fam.children)])
+                    for name, fam in fams]
 
     def snapshot(self) -> dict:
-        """Serialize every metric into a plain-JSON dict, grouped by kind."""
-        with self._lock:
-            items = list(self._metrics.items())
+        """Serialize every series into a plain-JSON dict, grouped by kind.
+
+        Unlabeled series keep their bare name as the key (pre-label
+        files stay readable by the same tools); labeled series key as
+        ``name{k="v",...}`` via :func:`format_series`.
+        """
         out = {"counters": {}, "gauges": {}, "histograms": {}}
-        for name, m in sorted(items):
-            if isinstance(m, Counter):
-                out["counters"][name] = m.snapshot()
-            elif isinstance(m, Gauge):
-                out["gauges"][name] = m.snapshot()
-            else:
-                out["histograms"][name] = m.snapshot()
+        for name, cls, children in self._sorted_families():
+            kind = ("counters" if cls is Counter
+                    else "gauges" if cls is Gauge else "histograms")
+            for ch in children:
+                out[kind][name + _render_labels(ch.labels)] = ch.snapshot()
         return out
 
     def render_text(self) -> str:
@@ -232,6 +358,8 @@ class MetricsRegistry:
 
           * dotted metric names sanitize to underscores
             (``serving.queue_wait_s`` -> ``serving_queue_wait_s``);
+          * labeled children render as standard ``{k="v"}`` blocks,
+            one ``# TYPE`` line per family;
           * Counter -> ``<name>_total`` counter;
           * Gauge   -> gauge (unset gauges are omitted — Prometheus has
             no null and 0.0 would be a lie);
@@ -242,50 +370,73 @@ class MetricsRegistry:
             emitting ``+Inf``), ``_sum``/``_count``, plus
             ``<name>_min``/``<name>_max``/``<name>_last`` gauges.
         """
-        with self._lock:
-            items = sorted(self._metrics.items())
         lines = []
-
-        def emit(name, kind, value):
-            lines.append(f"# TYPE {name} {kind}")
-            lines.append(f"{name} {float(value):g}")
-
-        for name, m in items:
+        for name, cls, children in self._sorted_families():
             pname = _prom_name(name)
-            if isinstance(m, Counter):
-                emit(f"{pname}_total", "counter", m.snapshot())
-            elif isinstance(m, Gauge):
-                v = m.snapshot()
-                if v is not None:
-                    emit(pname, "gauge", v)
+            if cls is Counter:
+                lines.append(f"# TYPE {pname}_total counter")
+                for ch in children:
+                    lines.append(
+                        f"{pname}_total{_render_labels(ch.labels)}"
+                        f" {float(ch.snapshot()):g}"
+                    )
+            elif cls is Gauge:
+                rows = [(ch.labels, ch.snapshot()) for ch in children]
+                rows = [(l, v) for l, v in rows if v is not None]
+                if rows:
+                    lines.append(f"# TYPE {pname} gauge")
+                    for l, v in rows:
+                        lines.append(
+                            f"{pname}{_render_labels(l)} {float(v):g}")
             else:
-                s = m.snapshot()
-                bounds, cum = m.bucket_counts()
                 lines.append(f"# TYPE {pname} histogram")
-                # Elide the empty head (cum 0) and the saturated tail
-                # (every bound past the max is a repeat of count) —
-                # the ladder spans 8 decades and most metrics live in
-                # 2; scrape size should track the data, not the ladder.
-                prev = 0
-                for b, c in zip(bounds, cum):
-                    if c == 0 or (c == prev and c == s["count"]):
+                aux = {"min": [], "max": [], "last": []}
+                for ch in children:
+                    s = ch.snapshot()
+                    bounds, cum = ch.bucket_counts()
+                    # Elide the empty head (cum 0) and the saturated
+                    # tail (every bound past the max repeats count) —
+                    # the ladder spans 8 decades and most metrics live
+                    # in 2; scrape size should track the data, not the
+                    # ladder.
+                    prev = 0
+                    for b, c in zip(bounds, cum):
+                        if c == 0 or (c == prev and c == s["count"]):
+                            prev = c
+                            continue
                         prev = c
-                        continue
-                    prev = c
-                    lines.append(f'{pname}_bucket{{le="{b:g}"}} {c:g}')
-                lines.append(
-                    f'{pname}_bucket{{le="+Inf"}} {float(s["count"]):g}'
-                )
-                lines.append(f"{pname}_sum {float(s['sum']):g}")
-                lines.append(f"{pname}_count {float(s['count']):g}")
-                for field in ("min", "max", "last"):
-                    if s[field] is not None:
-                        emit(f"{pname}_{field}", "gauge", s[field])
+                        lbls = ch.labels + (("le", f"{b:g}"),)
+                        lines.append(
+                            f"{pname}_bucket{_render_labels(lbls)} {c:g}")
+                    lbls = ch.labels + (("le", "+Inf"),)
+                    lines.append(
+                        f"{pname}_bucket{_render_labels(lbls)}"
+                        f" {float(s['count']):g}"
+                    )
+                    lines.append(
+                        f"{pname}_sum{_render_labels(ch.labels)}"
+                        f" {float(s['sum']):g}"
+                    )
+                    lines.append(
+                        f"{pname}_count{_render_labels(ch.labels)}"
+                        f" {float(s['count']):g}"
+                    )
+                    for field in aux:
+                        if s[field] is not None:
+                            aux[field].append((ch.labels, s[field]))
+                for field, rows in aux.items():
+                    if rows:
+                        lines.append(f"# TYPE {pname}_{field} gauge")
+                        for l, v in rows:
+                            lines.append(
+                                f"{pname}_{field}{_render_labels(l)}"
+                                f" {float(v):g}"
+                            )
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:
         with self._lock:
-            self._metrics.clear()
+            self._families.clear()
 
 
 _PROM_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
@@ -301,21 +452,75 @@ def _prom_name(name: str) -> str:
 
 _DEFAULT = MetricsRegistry()
 
+# --- replica identity -------------------------------------------------
+#
+# A process serving as part of a fleet labels its hot-path series with
+# `replica="<id>"` so obs/aggregate.py can merge N scrapes without
+# double counting. Identity resolution: explicit set_replica_id() (the
+# serving CLI's --replica_id) > NCNET_REPLICA_ID env > unlabeled.
+# Objects that need per-instance identity in ONE process (two
+# MatchServers in a test) pass explicit labels instead.
+
+_replica_lock = threading.Lock()
+_replica_id: Optional[str] = None
+
+
+def set_replica_id(rid: Optional[str]) -> None:
+    global _replica_id
+    with _replica_lock:
+        _replica_id = str(rid) if rid else None
+
+
+def replica_id() -> Optional[str]:
+    with _replica_lock:
+        if _replica_id is not None:
+            return _replica_id
+    return os.environ.get("NCNET_REPLICA_ID") or None
+
+
+def replica_labels() -> Dict[str, str]:
+    """`{"replica": id}` when an identity is configured, else `{}`."""
+    rid = replica_id()
+    return {"replica": rid} if rid else {}
+
+
+def set_build_info(registry: Optional[MetricsRegistry] = None,
+                   **extra: object) -> Gauge:
+    """Register the `ncnet.build_info` identity gauge (value always 1).
+
+    Prometheus "info metric" idiom: identity rides the labels (version,
+    backend, replica id), the value is constant — scrapers see who a
+    replica is without parsing /healthz.
+    """
+    from ncnet_tpu import __version__
+
+    info = {"version": __version__,
+            "backend": os.environ.get("JAX_PLATFORMS") or "default"}
+    rid = replica_id()
+    if rid:
+        info["replica"] = rid
+    for k, v in extra.items():
+        if v:
+            info[k] = str(v)
+    g = (registry or _DEFAULT).gauge("ncnet.build_info", labels=info)
+    g.set(1.0)
+    return g
+
 
 def default_registry() -> MetricsRegistry:
     return _DEFAULT
 
 
-def counter(name: str) -> Counter:
-    return _DEFAULT.counter(name)
+def counter(name: str, labels: Labels = None) -> Counter:
+    return _DEFAULT.counter(name, labels)
 
 
-def gauge(name: str) -> Gauge:
-    return _DEFAULT.gauge(name)
+def gauge(name: str, labels: Labels = None) -> Gauge:
+    return _DEFAULT.gauge(name, labels)
 
 
-def histogram(name: str) -> Histogram:
-    return _DEFAULT.histogram(name)
+def histogram(name: str, labels: Labels = None) -> Histogram:
+    return _DEFAULT.histogram(name, labels)
 
 
 def snapshot() -> dict:

@@ -1,0 +1,326 @@
+"""Declarative SLOs with multi-window burn-rate alerts and error budgets.
+
+The DeadlineBatcher enforces per-request deadlines and the breaker
+contains device outages, but nothing ACCOUNTS for them: how much of the
+month's error budget did that 40-second breaker episode spend? This
+module is the Google-SRE-workbook answer, sized for this repo:
+
+* :class:`SloSpec` — one declarative objective. Two shapes:
+
+  - **counter ratio**: ``good`` / ``total`` name the counters whose
+    deltas define success (availability: responses vs requests-that-
+    deserved-an-answer; deadline hit rate: responses vs responses +
+    deadline_exceeded);
+  - **latency threshold**: ``histogram`` + ``threshold_s`` count the
+    observations at-or-under the threshold as good. Exact at bucket
+    resolution: the effective threshold is the largest bucket bound
+    <= ``threshold_s`` (the shared ladder, obs/metrics.DEFAULT_BUCKETS).
+
+* :class:`SloEngine` — evaluates every spec against registry snapshots
+  on an injectable clock. Burn rate = (bad fraction over a rolling
+  window) / (1 - objective); the **multi-window rule** pages only when
+  BOTH the fast window (default 5 min, threshold 14x) and the slow
+  window (default 1 h, threshold 6x) burn hot — fast-only is noise,
+  slow-only is too late (Google SRE workbook, ch. 5).
+
+Paging is loud in every channel at once: ``slo.<name>.*`` gauges and a
+``pages`` counter in the registry, an obs ``slo`` event per episode
+edge, a ``/healthz`` budget field (serving/server.py), and — once per
+episode, riding the flight recorder's per-reason cooldown — a
+``slo-burn-<name>`` flight dump capturing the events that led in.
+
+Windows hold (t, good, total) samples pruned to the slow window; the
+30-day error budget runs on a coarser sample train (bounded at ~256
+points) so a month of accounting costs kilobytes, not a sample per
+scrape.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, Optional, Tuple, Union
+
+from . import events as _events
+from . import flight as _flight
+from . import metrics as _metrics
+
+Names = Union[str, Tuple[str, ...]]
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One service-level objective, counter-ratio or latency-threshold."""
+
+    name: str
+    objective: float                      # e.g. 0.999
+    good: Optional[Names] = None          # counter name(s) counting good
+    total: Optional[Names] = None         # counter name(s) counting all
+    histogram: Optional[str] = None       # latency-mode histogram name
+    threshold_s: Optional[float] = None   # latency-mode "good" bound
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+    fast_burn: float = 14.0               # page when BOTH windows exceed
+    slow_burn: float = 6.0
+    budget_window_s: float = 30 * 86400.0
+
+    def __post_init__(self):
+        if not (0.0 < self.objective < 1.0):
+            raise ValueError(f"objective must be in (0, 1): {self.objective}")
+        counter_mode = self.good is not None and self.total is not None
+        latency_mode = (self.histogram is not None
+                        and self.threshold_s is not None)
+        if counter_mode == latency_mode:
+            raise ValueError(
+                f"SLO {self.name!r} needs exactly one of good+total "
+                "counters or histogram+threshold_s")
+        if self.fast_window_s >= self.slow_window_s:
+            raise ValueError("fast_window_s must be < slow_window_s")
+
+    @property
+    def budget_frac(self) -> float:
+        return 1.0 - self.objective
+
+
+def _as_names(names: Names) -> Tuple[str, ...]:
+    return (names,) if isinstance(names, str) else tuple(names)
+
+
+class SloEngine:
+    """Evaluate :class:`SloSpec` s against registry snapshots over time.
+
+    ``labels`` scopes which series count: a spec's counters/histogram
+    match any series whose labels are a superset of the engine's (so a
+    replica-labeled engine reads its own series, and an unlabeled one
+    reads everything — summing children, which is what a whole-process
+    SLO means).
+    """
+
+    def __init__(
+        self,
+        specs: Iterable[SloSpec],
+        registry: Optional[_metrics.MetricsRegistry] = None,
+        labels=None,
+        clock: Callable[[], float] = time.monotonic,
+        min_interval_s: float = 0.0,
+        flight_dump: bool = True,
+    ):
+        self.specs = list(specs)
+        names = [s.name for s in self.specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO names: {names}")
+        self.registry = registry or _metrics.default_registry()
+        self.labels = dict(labels or {})
+        self.clock = clock
+        self.min_interval_s = float(min_interval_s)
+        self.flight_dump = flight_dump
+        self._samples = {s.name: deque() for s in self.specs}
+        self._budget = {s.name: deque() for s in self.specs}
+        self._paging = {s.name: False for s in self.specs}
+        self._pages = {s.name: 0 for s in self.specs}
+        self._last_results: Dict[str, dict] = {}
+        self._last_eval: Optional[float] = None
+
+    # -- snapshot readers -------------------------------------------------
+
+    def _matches(self, lbls: Dict[str, str]) -> bool:
+        return all(lbls.get(k) == v for k, v in self.labels.items())
+
+    def _sum_counters(self, snap: dict, names: Names) -> float:
+        wanted = _as_names(names)
+        total = 0.0
+        for series, v in (snap.get("counters") or {}).items():
+            name, lbls = _metrics.parse_series(series)
+            if name in wanted and self._matches(lbls):
+                total += v
+        return total
+
+    def _hist_good_total(self, snap: dict, spec: SloSpec
+                         ) -> Tuple[float, float]:
+        good = total = 0.0
+        for series, h in (snap.get("histograms") or {}).items():
+            name, lbls = _metrics.parse_series(series)
+            if name != spec.histogram or not self._matches(lbls):
+                continue
+            total += float(h.get("count") or 0)
+            at_or_under = 0.0
+            for le, cum in h.get("buckets") or []:
+                if le <= spec.threshold_s:
+                    at_or_under = cum
+                else:
+                    break
+            good += at_or_under
+        return good, total
+
+    def _read(self, snap: dict, spec: SloSpec) -> Tuple[float, float]:
+        if spec.histogram is not None:
+            return self._hist_good_total(snap, spec)
+        return (self._sum_counters(snap, spec.good),
+                self._sum_counters(snap, spec.total))
+
+    # -- window math ------------------------------------------------------
+
+    @staticmethod
+    def _window_bad_frac(samples, now: float, window_s: float,
+                         g_now: float, t_now: float) -> float:
+        """Bad fraction over [now - window_s, now].
+
+        Baseline = the latest sample at or before the window start; a
+        window that predates the engine uses the oldest sample (burn
+        over available history — an engine younger than its window
+        reports what it can see rather than nothing).
+        """
+        base = None
+        for t, g, tot in samples:
+            if t <= now - window_s:
+                base = (g, tot)
+            else:
+                break
+        if base is None:
+            base = (samples[0][1], samples[0][2]) if samples else (g_now,
+                                                                   t_now)
+        d_total = t_now - base[1]
+        d_good = g_now - base[0]
+        if d_total <= 0:
+            return 0.0
+        return max(d_total - d_good, 0.0) / d_total
+
+    # -- evaluation -------------------------------------------------------
+
+    def maybe_evaluate(self, snapshot: Optional[dict] = None
+                       ) -> Dict[str, dict]:
+        """Rate-limited :meth:`evaluate` — the /healthz and /metrics
+        hook, so a scrape storm cannot turn SLO math into load."""
+        now = self.clock()
+        if (self._last_eval is not None and self.min_interval_s > 0
+                and now - self._last_eval < self.min_interval_s):
+            return self._last_results
+        return self.evaluate(snapshot)
+
+    def evaluate(self, snapshot: Optional[dict] = None) -> Dict[str, dict]:
+        """One evaluation pass: sample, burn, budget, page edges."""
+        now = self.clock()
+        snap = snapshot if snapshot is not None else self.registry.snapshot()
+        results: Dict[str, dict] = {}
+        for spec in self.specs:
+            good, total = self._read(snap, spec)
+            samples = self._samples[spec.name]
+            samples.append((now, good, total))
+            while samples and samples[0][0] < now - spec.slow_window_s:
+                samples.popleft()
+            # Budget train: coarse (<= ~256 live points) so 30 days of
+            # accounting stays bounded no matter the scrape rate.
+            budget = self._budget[spec.name]
+            step = spec.budget_window_s / 256.0
+            if not budget or now - budget[-1][0] >= step:
+                budget.append((now, good, total))
+            while len(budget) > 2 and budget[1][0] < now - spec.budget_window_s:
+                budget.popleft()
+
+            burn_fast = self._window_bad_frac(
+                samples, now, spec.fast_window_s, good, total
+            ) / spec.budget_frac
+            burn_slow = self._window_bad_frac(
+                samples, now, spec.slow_window_s, good, total
+            ) / spec.budget_frac
+
+            b0 = budget[0]
+            b_total = total - b0[2]
+            b_bad = max(b_total - (good - b0[1]), 0.0)
+            allowed = spec.budget_frac * b_total
+            if allowed > 0:
+                remaining = 1.0 - b_bad / allowed
+            else:
+                remaining = 1.0
+            remaining = max(min(remaining, 1.0), -1.0)
+
+            paging = (burn_fast >= spec.fast_burn
+                      and burn_slow >= spec.slow_burn)
+            was = self._paging[spec.name]
+            self._paging[spec.name] = paging
+            if paging and not was:
+                self._pages[spec.name] += 1
+                self.registry.counter(f"slo.{spec.name}.pages",
+                                      labels=self.labels).inc()
+                _events.event("slo", slo=spec.name, state="page_start",
+                              burn_fast=round(burn_fast, 4),
+                              burn_slow=round(burn_slow, 4),
+                              budget_remaining_frac=round(remaining, 6))
+                if self.flight_dump:
+                    # One dump per episode (this edge fires once per
+                    # episode) AND per-reason cooldown underneath, so a
+                    # flapping alert cannot fill a disk (obs/flight.py).
+                    _flight.dump(f"slo-burn-{spec.name}")
+            elif was and not paging:
+                _events.event("slo", slo=spec.name, state="page_end",
+                              burn_fast=round(burn_fast, 4),
+                              burn_slow=round(burn_slow, 4),
+                              budget_remaining_frac=round(remaining, 6))
+
+            for suffix, value in (
+                ("burn_fast", burn_fast),
+                ("burn_slow", burn_slow),
+                ("budget_remaining_frac", remaining),
+                ("paging", 1.0 if paging else 0.0),
+            ):
+                self.registry.gauge(f"slo.{spec.name}.{suffix}",
+                                    labels=self.labels).set(value)
+
+            results[spec.name] = {
+                "objective": spec.objective,
+                "good": good,
+                "total": total,
+                "burn_fast": round(burn_fast, 4),
+                "burn_slow": round(burn_slow, 4),
+                "fast_window_s": spec.fast_window_s,
+                "slow_window_s": spec.slow_window_s,
+                "paging": paging,
+                "pages": self._pages[spec.name],
+                "budget_remaining_frac": round(remaining, 6),
+            }
+        self._last_results = results
+        self._last_eval = now
+        return results
+
+    @property
+    def paging(self) -> bool:
+        """True while ANY spec is in a page episode."""
+        return any(self._paging.values())
+
+
+def default_serving_slos(
+    availability: float = 0.999,
+    deadline_hit: float = 0.99,
+    p99_target_s: float = 0.5,
+    fast_window_s: float = 300.0,
+    slow_window_s: float = 3600.0,
+) -> Tuple[SloSpec, ...]:
+    """The serving front end's three standing objectives.
+
+    * ``availability`` — responses vs requests the server owed an
+      answer: 200s vs 200s + 500s + 504s. Client errors (400) and
+      load-shed 503s are excluded — a shed request was answered
+      honestly and retried; counting it would make admission control
+      look like an outage.
+    * ``deadline_hit`` — of requests that ran, how many beat their
+      deadline (the DeadlineBatcher's contract, measured).
+    * ``latency_p99`` — fraction of requests at or under the p99
+      target; exact at the shared bucket ladder's resolution.
+    """
+    win = dict(fast_window_s=fast_window_s, slow_window_s=slow_window_s)
+    return (
+        SloSpec("availability", availability,
+                good="serving.responses",
+                total=("serving.responses", "serving.errors",
+                       "serving.deadline_exceeded"),
+                **win),
+        SloSpec("deadline_hit", deadline_hit,
+                good="serving.responses",
+                total=("serving.responses", "serving.deadline_exceeded"),
+                **win),
+        SloSpec("latency_p99", 0.99,
+                histogram="serving.e2e_latency_s",
+                threshold_s=p99_target_s,
+                **win),
+    )

@@ -56,6 +56,7 @@ class CircuitBreaker:
         half_open_probes: int = 1,
         name: str = "engine",
         clock: Callable[[], float] = time.monotonic,
+        labels=None,
     ):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
@@ -63,6 +64,9 @@ class CircuitBreaker:
         self.reset_timeout_s = float(reset_timeout_s)
         self.half_open_probes = max(int(half_open_probes), 1)
         self.name = name
+        # e.g. {"replica": "r0"}: per-replica breaker series in a fleet
+        # (and two breakers sharing one process registry in tests).
+        self.labels = dict(labels or {})
         self.clock = clock
         self._lock = threading.Lock()
         self._state = CLOSED
@@ -77,7 +81,8 @@ class CircuitBreaker:
         """Move to ``new`` state; caller holds self._lock."""
         prev, self._state = self._state, new
         self.transitions += 1
-        obs.gauge(f"breaker.{self.name}.state").set(_STATE_GAUGE[new])
+        obs.gauge(f"breaker.{self.name}.state",
+                  labels=self.labels).set(_STATE_GAUGE[new])
         # obs calls under the lock are safe (metrics use their own
         # locks) but the flight dump does file IO — defer it.
         self._pending_dump = (new == OPEN)
@@ -92,7 +97,8 @@ class CircuitBreaker:
             return
         obs.event("breaker", breaker=self.name, **ev)
         if self.__dict__.pop("_pending_dump", False):
-            obs.counter(f"breaker.{self.name}.opens").inc()
+            obs.counter(f"breaker.{self.name}.opens",
+                        labels=self.labels).inc()
             # Cooldown-deduped: a flapping breaker dumps once per
             # episode window, not once per flap.
             flight.dump(f"breaker-open-{self.name}")
@@ -218,7 +224,8 @@ class CircuitBreaker:
             self._consecutive_failures = 0
             self._opened_at = None
             self._probes_inflight = 0
-        obs.gauge(f"breaker.{self.name}.state").set(0.0)
+        obs.gauge(f"breaker.{self.name}.state",
+                  labels=self.labels).set(0.0)
 
 
 def _exc_str(exc: Optional[BaseException]) -> Optional[str]:

@@ -142,12 +142,17 @@ class DeadlineBatcher:
         backlog_cap: Optional[int] = None,
         isolate_poison: bool = True,
         clock: Callable[[], float] = time.monotonic,
+        labels=None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         self.runner = runner
+        # Per-instance metric labels (e.g. {"replica": "r0"}): a fleet
+        # member tags its hot-path series so obs/aggregate.py can merge
+        # scrapes; empty means the unlabeled pre-fleet series.
+        self.labels = dict(labels or {})
         self.isolate_poison = isolate_poison
         self.max_batch = max_batch
         self.max_queue = max_queue
@@ -196,7 +201,7 @@ class DeadlineBatcher:
                 raise RuntimeError("batcher is closed to new requests")
             depth = len(self._buckets) + sum(len(b) for b in self._ready)
             if depth >= self.max_queue:
-                obs.counter("serving.rejected").inc()
+                obs.counter("serving.rejected", labels=self.labels).inc()
                 # One max_delay is roughly one batch-formation window: by
                 # then at least one queued batch has flushed and a slot
                 # opened (saturated steady state drains max_batch per
@@ -206,8 +211,9 @@ class DeadlineBatcher:
                     retry_after_s=max(self.max_delay_s, 0.01), depth=depth
                 )
             self._buckets.add(bucket_key, pending)
-            obs.counter("serving.admitted").inc()
-            obs.gauge("serving.queue_depth").set(len(self._buckets))
+            obs.counter("serving.admitted", labels=self.labels).inc()
+            obs.gauge("serving.queue_depth", labels=self.labels).set(
+                len(self._buckets))
             self._cond.notify_all()
         return pending.future
 
@@ -249,7 +255,8 @@ class DeadlineBatcher:
             )
             ready, self._ready = self._ready, []
             self._inflight += len(ready)
-            obs.gauge("serving.queue_depth").set(len(self._buckets))
+            obs.gauge("serving.queue_depth", labels=self.labels).set(
+                len(self._buckets))
         for chunk in ready:
             self._run(chunk)
         if ready:
@@ -260,10 +267,12 @@ class DeadlineBatcher:
 
     def _run(self, chunk: List[_Pending]) -> None:
         t_run = self.clock()
-        obs.counter("serving.batches").inc()
-        obs.histogram("serving.batch_size").observe(len(chunk))
+        obs.counter("serving.batches", labels=self.labels).inc()
+        obs.histogram("serving.batch_size",
+                      labels=self.labels).observe(len(chunk))
         for p in chunk:
-            obs.histogram("serving.queue_wait_s").observe(t_run - p.t_submit)
+            obs.histogram("serving.queue_wait_s",
+                          labels=self.labels).observe(t_run - p.t_submit)
             # Queue wait spans two threads (submit → here); it can't be
             # a `with` block anywhere, so book the measured duration
             # into each request's tree explicitly.
@@ -296,7 +305,7 @@ class DeadlineBatcher:
         except Exception as exc:  # noqa: BLE001 — forwarded per-request
             if (self.isolate_poison and len(chunk) > 1
                     and not isinstance(exc, _NO_BISECT)):
-                obs.counter("serving.poison_bisects").inc()
+                obs.counter("serving.poison_bisects", labels=self.labels).inc()
                 obs.event("poison_bisect", batch_size=len(chunk),
                           depth=depth,
                           error=f"{type(exc).__name__}: {exc}")
@@ -304,10 +313,10 @@ class DeadlineBatcher:
                 self._run_chunk(chunk[:mid], t_run, depth + 1)
                 self._run_chunk(chunk[mid:], t_run, depth + 1)
                 return
-            obs.counter("serving.batch_errors").inc()
+            obs.counter("serving.batch_errors", labels=self.labels).inc()
             poison = len(chunk) == 1 and depth > 0
             if poison:
-                obs.counter("serving.poison_isolated").inc()
+                obs.counter("serving.poison_isolated", labels=self.labels).inc()
             for p in chunk:
                 outcome = "poison" if poison else "error"
                 trace.emit_span("isolation", dur_s=self.clock() - t_run,
@@ -323,18 +332,19 @@ class DeadlineBatcher:
                     p.future.set_exception(exc)
             return
         except BaseException as exc:  # worker must survive; forward raw
-            obs.counter("serving.batch_errors").inc()
+            obs.counter("serving.batch_errors", labels=self.labels).inc()
             for p in chunk:
                 if p.future.set_running_or_notify_cancel():
                     p.future.set_exception(exc)
             return
         run_s = self.clock() - t_run
-        obs.histogram("serving.run_batch_s").observe(run_s)
+        obs.histogram("serving.run_batch_s", labels=self.labels).observe(run_s)
         for p, r in zip(chunk, results):
             if depth > 0:
                 # This rider survived a bisection round: its original
                 # batch failed but the failure was not its own.
-                obs.counter("serving.poison_survivors").inc()
+                obs.counter("serving.poison_survivors",
+                            labels=self.labels).inc()
                 trace.emit_span("isolation", dur_s=run_s,
                                 parents=p.trace_ctx, outcome="innocent",
                                 depth=depth, batch_size=len(chunk))

@@ -78,11 +78,15 @@ class MatchEngine:
         cache_mb: int = 0,
         cache_dir: str = "",
         cache_model_key: str = "",
+        labels=None,
     ):
         import jax
         import jax.numpy as jnp
 
         self._jax, self._jnp = jax, jnp
+        # Per-instance metric labels ({"replica": ...} in a fleet); the
+        # owning MatchServer sets this when it has a replica identity.
+        self.labels = dict(labels or {})
         self.config = config
         self.params = params
         self.k_size = k_size
@@ -310,7 +314,8 @@ class MatchEngine:
         np_ms = self._jax.device_get(ms)
         device_s = time.monotonic() - t_dev
         trace.emit_span("device", dur_s=device_s, batch_size=len(batch))
-        obs.histogram("serving.device_time_s").observe(device_s)
+        obs.histogram("serving.device_time_s",
+                      labels=self.labels).observe(device_s)
 
         timing = {
             "batch_assemble_ms": assemble_s * 1e3,
@@ -330,8 +335,10 @@ class MatchEngine:
             with self._store_lock:
                 self.cache.put(p.pano_path, p.pano_shape, f)
         if self.cache is not None:
-            obs.gauge("serving.cache.hits").set(self.cache.hits)
-            obs.gauge("serving.cache.misses").set(self.cache.misses)
+            obs.gauge("serving.cache.hits",
+                      labels=self.labels).set(self.cache.hits)
+            obs.gauge("serving.cache.misses",
+                      labels=self.labels).set(self.cache.misses)
         return out
 
     # -- startup ----------------------------------------------------------
@@ -372,5 +379,5 @@ class MatchEngine:
                               cache_hit=plan.get("cache_hit"),
                               ms=plan.get("cache_ms"), plan=plan)
                 n += 1
-        obs.counter("serving.warmup_programs").inc(n)
+        obs.counter("serving.warmup_programs", labels=self.labels).inc(n)
         return n

@@ -68,9 +68,22 @@ class MatchServer:
         breaker_reset_s: float = 10.0,
         isolate_poison: bool = True,
         run_log=None,
+        replica_id: Optional[str] = None,
+        slo_specs=None,
+        slo_p99_target_s: float = 0.5,
     ):
         self.engine = engine
         self.run_log = run_log
+        # Fleet identity: explicit ctor arg > --replica_id /
+        # NCNET_REPLICA_ID (obs.replica_id). Labels must be PER-OBJECT,
+        # not process-global: two MatchServers in one process (the
+        # tier-1 fleet demo) share the default registry, and only
+        # per-instance labels keep their series apart.
+        rid = replica_id if replica_id is not None else obs.replica_id()
+        self.replica_id = str(rid) if rid else None
+        self.labels = {"replica": self.replica_id} if self.replica_id else {}
+        if self.labels and not getattr(engine, "labels", None):
+            engine.labels = dict(self.labels)
         # The breaker guards every device dispatch — including the
         # sub-batches of a poison bisection, since the batcher calls
         # this same runner for them: consecutive dispatch failures
@@ -80,6 +93,7 @@ class MatchServer:
         self.breaker = CircuitBreaker(
             failure_threshold=breaker_threshold,
             reset_timeout_s=breaker_reset_s,
+            labels=self.labels,
         )
         self.batcher = DeadlineBatcher(
             self.breaker_runner(engine.run_batch),
@@ -89,7 +103,20 @@ class MatchServer:
             deadline_slack_s=deadline_slack_s,
             default_timeout_s=default_timeout_s,
             isolate_poison=isolate_poison,
+            labels=self.labels,
         )
+        # Standing SLOs (obs/slo.py), evaluated lazily on /healthz and
+        # /metrics reads behind a 1 s floor — no extra thread, and a
+        # scrape storm cannot turn burn math into load. slo_specs=()
+        # disables; None takes the serving defaults.
+        if slo_specs is None:
+            slo_specs = obs.default_serving_slos(
+                p99_target_s=slo_p99_target_s)
+        self.slo = obs.SloEngine(
+            slo_specs, labels=self.labels, min_interval_s=1.0,
+        ) if slo_specs else None
+        if self.replica_id:
+            obs.set_build_info(replica=self.replica_id)
         self.t_start = time.monotonic()
         self._draining = False
         server = self
@@ -120,6 +147,9 @@ class MatchServer:
                 if self.path == "/healthz":
                     self._send_json(*server.healthz())
                 elif self.path == "/metrics":
+                    # Refresh the slo.* gauges so a scrape always sees
+                    # current burn/budget (rate-limited inside).
+                    server.slo_status()
                     text = obs.render_text().encode()
                     self.send_response(200)
                     self.send_header(
@@ -155,6 +185,12 @@ class MatchServer:
 
         return guarded
 
+    def slo_status(self):
+        """Evaluate the standing SLOs (rate-limited); {} when disabled."""
+        if self.slo is None:
+            return {}
+        return self.slo.maybe_evaluate()
+
     def healthz(self):
         """Liveness + degradation: stall flag, breaker state, drain.
 
@@ -183,6 +219,21 @@ class MatchServer:
             "queue_depth": self.batcher.depth,
             "breaker": br,
         }
+        if self.replica_id:
+            payload["replica"] = self.replica_id
+        slo = self.slo_status()
+        if slo:
+            # The balancer-facing error-budget readout: per SLO, how
+            # much budget is left and whether the burn alert is paging.
+            payload["slo"] = {
+                name: {
+                    "budget_remaining_frac": r["budget_remaining_frac"],
+                    "burn_fast": r["burn_fast"],
+                    "burn_slow": r["burn_slow"],
+                    "paging": r["paging"],
+                }
+                for name, r in slo.items()
+            }
         fps = failpoints.active()
         if fps:  # chaos visibility: an armed replica says so
             payload["failpoints"] = {s: fp.mode for s, fp in fps.items()}
@@ -204,19 +255,19 @@ class MatchServer:
                 # never a dropped connection.
                 failpoints.fire("server.handle")
             except InjectedFault as exc:
-                obs.counter("serving.errors").inc()
+                obs.counter("serving.errors", labels=self.labels).inc()
                 return 500, {"error": str(exc), "kind": "injected_fault"}, None
             return self._handle_match_traced(handler, root)
 
     def _handle_match_traced(self, handler, root):
         t0 = time.monotonic()
-        obs.counter("serving.requests").inc()
+        obs.counter("serving.requests", labels=self.labels).inc()
         # Open breaker: reject at the front door — cheapest work a
         # degraded replica can do, and the Retry-After hint tells
         # clients when the half-open probe window starts.
         retry_in = self.breaker.admit()
         if retry_in is not None:
-            obs.counter("serving.breaker_rejected").inc()
+            obs.counter("serving.breaker_rejected", labels=self.labels).inc()
             return (
                 503,
                 {"error": "service degraded (circuit breaker open)",
@@ -232,7 +283,7 @@ class MatchServer:
                 length = int(handler.headers.get("Content-Length", 0))
                 request = json.loads(handler.rfile.read(length) or b"{}")
             except (ValueError, OSError) as exc:
-                obs.counter("serving.bad_requests").inc()
+                obs.counter("serving.bad_requests", labels=self.labels).inc()
                 return 400, {"error": f"malformed request: {exc}"}, None
             timeout_s = None
             if request.get("deadline_ms") is not None:
@@ -241,13 +292,13 @@ class MatchServer:
                         float(request["deadline_ms"]) / 1000.0, 1e-3
                     )
                 except (TypeError, ValueError):
-                    obs.counter("serving.bad_requests").inc()
+                    obs.counter("serving.bad_requests", labels=self.labels).inc()
                     return (400, {"error": "deadline_ms must be a number"},
                             None)
             try:
                 prepared = self.engine.prepare(request)
             except ValueError as exc:
-                obs.counter("serving.bad_requests").inc()
+                obs.counter("serving.bad_requests", labels=self.labels).inc()
                 return 400, {"error": str(exc)}, None
         admit_s = time.monotonic() - t_admit
         try:
@@ -269,13 +320,13 @@ class MatchServer:
         try:
             br = fut.result(timeout=wait_s)
         except FutureTimeoutError:
-            obs.counter("serving.deadline_exceeded").inc()
+            obs.counter("serving.deadline_exceeded", labels=self.labels).inc()
             return 504, {"error": "deadline exceeded"}, None
         except BreakerOpenError as exc:
             # The breaker opened while this request was queued: its
             # dispatch was refused, not attempted. Same contract as the
             # front-door rejection — 503 + Retry-After, retryable.
-            obs.counter("serving.breaker_rejected").inc()
+            obs.counter("serving.breaker_rejected", labels=self.labels).inc()
             return (
                 503,
                 {"error": "service degraded (circuit breaker open)",
@@ -286,7 +337,7 @@ class MatchServer:
             # Bisection isolated THIS request as the poison rider: the
             # failure is its own (bad input for the model), not
             # collateral — a structured, non-retryable per-request error.
-            obs.counter("serving.poison_requests").inc()
+            obs.counter("serving.poison_requests", labels=self.labels).inc()
             obs.event("request_error", kind="poison",
                       error=f"{type(exc.cause).__name__}: {exc.cause}")
             return (
@@ -296,7 +347,7 @@ class MatchServer:
                 None,
             )
         except Exception as exc:  # noqa: BLE001 — model failure -> 500
-            obs.counter("serving.errors").inc()
+            obs.counter("serving.errors", labels=self.labels).inc()
             obs.event("request_error", error=f"{type(exc).__name__}: {exc}")
             return 500, {"error": f"{type(exc).__name__}: {exc}"}, None
         t_respond = time.monotonic()
@@ -322,8 +373,9 @@ class MatchServer:
             "respond_ms": round(respond_s * 1e3, 3),
             "total_ms": round(e2e_s * 1e3, 3),
         }
-        obs.counter("serving.responses").inc()
-        obs.histogram("serving.e2e_latency_s").observe(e2e_s)
+        obs.counter("serving.responses", labels=self.labels).inc()
+        obs.histogram("serving.e2e_latency_s",
+                      labels=self.labels).observe(e2e_s)
         obs.event(
             "request",
             bucket=repr(prepared.bucket_key),
@@ -387,6 +439,17 @@ def main(argv=None):
     parser.add_argument("--host", type=str, default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8080,
                         help="0 = ephemeral (bound port printed on stderr)")
+    parser.add_argument("--replica_id", type=str, default="",
+                        help="fleet identity: labels every hot-path "
+                        "metric series with replica=<id> so "
+                        "obs/aggregate + tools/fleet_status.py can merge "
+                        "scrapes (default: NCNET_REPLICA_ID, else "
+                        "unlabeled)")
+    parser.add_argument("--slo_p99_ms", type=float, default=500.0,
+                        help="latency SLO target: 99%% of requests at or "
+                        "under this many ms (bucket-resolution exact)")
+    parser.add_argument("--no_slo", action="store_true",
+                        help="disable the standing SLO engine")
     parser.add_argument("--checkpoint", type=str, default="")
     parser.add_argument("--k_size", type=int, default=2)
     parser.add_argument("--image_size", type=int, default=1600)
@@ -422,6 +485,8 @@ def main(argv=None):
     from ..cli.common import build_model
     from ..evals.feature_cache import model_cache_key
 
+    if args.replica_id:
+        obs.set_replica_id(args.replica_id)
     run_log = None
     if args.run_log:
         run_log = obs.init_run("serving", args.run_log, args=args)
@@ -472,6 +537,8 @@ def main(argv=None):
         breaker_reset_s=args.breaker_reset_s,
         isolate_poison=not args.no_isolate_poison,
         run_log=run_log,
+        slo_specs=() if args.no_slo else None,
+        slo_p99_target_s=args.slo_p99_ms / 1e3,
     ).start()
     print(f"serving on {server.url}", file=sys.stderr, flush=True)
     try:

@@ -54,6 +54,23 @@ def rng():
     return np.random.RandomState(0)
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _pin_flight_dir(tmp_path_factory):
+    """Pin flight-recorder dumps to a session tmp dir.
+
+    obs/flight.py falls back NCNET_FLIGHT_DIR > run-log dir > cwd; a
+    test that trips a dump outside an init_run would otherwise litter
+    the repo root with flight-*.jsonl files (docs/OBSERVABILITY.md).
+    Tests that assert on dumps still monkeypatch their own dir — that
+    override wins per-test and restores to this pin. Also clears any
+    ambient NCNET_REPLICA_ID so label assertions see only what a test
+    sets itself."""
+    os.environ["NCNET_FLIGHT_DIR"] = str(
+        tmp_path_factory.mktemp("flight_dumps"))
+    os.environ.pop("NCNET_REPLICA_ID", None)
+    yield
+
+
 @pytest.fixture(autouse=True)
 def _reset_obs_metrics():
     """The obs default registry is process-global (one CLI run per

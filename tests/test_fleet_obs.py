@@ -1,0 +1,512 @@
+"""Unit tests for the fleet-observability layer (ISSUE 6 tentpole):
+labeled metric families (obs/metrics.py), cross-replica aggregation
+(obs/aggregate.py), and the SLO burn-rate engine (obs/slo.py).
+
+Everything runs on private registries and fake clocks — no server, no
+sleeps; the end-to-end two-replica demo lives in test_fleet_serving.py.
+"""
+
+import glob
+import os
+import random
+import re
+import sys
+import threading
+
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.obs import aggregate, flight
+from ncnet_tpu.obs.metrics import MetricsRegistry
+from ncnet_tpu.obs.slo import SloEngine, SloSpec, default_serving_slos
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import obs_report  # noqa: E402
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- labels ---------------------------------------------------------------
+
+
+def test_labeled_children_are_independent_series():
+    reg = MetricsRegistry()
+    reg.counter("c", labels={"replica": "r0"}).inc(3)
+    reg.counter("c", labels={"replica": "r1"}).inc(5)
+    reg.counter("c").inc()  # the unlabeled child coexists
+    snap = reg.snapshot()
+    assert snap["counters"]['c{replica="r0"}'] == 3.0
+    assert snap["counters"]['c{replica="r1"}'] == 5.0
+    assert snap["counters"]["c"] == 1.0
+    # Label ORDER never matters: one child per normalized set.
+    reg.gauge("g", labels={"a": "1", "b": "2"}).set(7.0)
+    assert reg.gauge("g", labels={"b": "2", "a": "1"}).value == 7.0
+    assert list(reg.snapshot()["gauges"]) == ['g{a="1",b="2"}']
+
+
+def test_unlabeled_behavior_is_byte_identical():
+    """Pre-label callers see the old keys and the old exposition."""
+    reg = MetricsRegistry()
+    reg.counter("serving.requests").inc(3)
+    reg.histogram("lat_s").observe(0.25)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"serving.requests": 3.0}
+    assert "lat_s" in snap["histograms"]
+    text = reg.render_text()
+    assert "serving_requests_total 3" in text
+    assert 'lat_s_bucket{le="+Inf"} 1' in text
+
+
+def test_kind_mismatch_is_per_family_not_per_child():
+    reg = MetricsRegistry()
+    reg.counter("x", labels={"replica": "r0"})
+    with pytest.raises(TypeError):
+        reg.gauge("x", labels={"replica": "r1"})
+
+
+def test_format_parse_series_roundtrip_with_escaping():
+    hostile = 'a"b\\c\nd'
+    key = obs.format_series("m", {"replica": "r0", "tenant": hostile})
+    name, labels = obs.parse_series(key)
+    assert name == "m"
+    assert labels == {"replica": "r0", "tenant": hostile}
+    assert obs.parse_series("bare") == ("bare", {})
+    assert obs.format_series("bare") == "bare"
+
+
+def test_concurrent_labeled_writers_no_lost_increments():
+    """ISSUE 6 satellite: N threads hammer their own labeled child plus
+    one shared child while another thread renders/snapshots under load —
+    no lost increments, no torn exposition."""
+    reg = MetricsRegistry()
+    n_threads, n_iter = 8, 2000
+    stop = threading.Event()
+
+    def work(i):
+        mine = {"replica": f"r{i}"}
+        for _ in range(n_iter):
+            reg.counter("fleet.requests", labels=mine).inc()
+            reg.counter("fleet.requests").inc()
+            reg.histogram("fleet.lat_s", labels=mine).observe(0.1 * (i + 1))
+
+    def reader():
+        while not stop.is_set():
+            reg.snapshot()
+            reg.render_text()
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    snap = reg.snapshot()
+    assert snap["counters"]["fleet.requests"] == float(n_threads * n_iter)
+    for i in range(n_threads):
+        key = f'fleet.requests{{replica="r{i}"}}'
+        assert snap["counters"][key] == float(n_iter)
+        hkey = f'fleet.lat_s{{replica="r{i}"}}'
+        assert snap["histograms"][hkey]["count"] == n_iter
+    # The final exposition parses back to the same totals.
+    parsed = aggregate.parse_prometheus_text(reg.render_text())
+    total = sum(v for k, v in parsed["counters"].items()
+                if k.startswith("fleet_requests"))
+    assert total == float(2 * n_threads * n_iter)
+
+
+def test_render_text_labeled_exposition():
+    reg = MetricsRegistry()
+    reg.counter("c", labels={"replica": "r0"}).inc(2)
+    reg.counter("c", labels={"replica": "r1"}).inc(3)
+    h = reg.histogram("h_s", labels={"replica": "r0"})
+    h.observe(0.5)
+    text = reg.render_text()
+    # ONE TYPE line per family, children as label blocks.
+    assert text.count("# TYPE c_total counter") == 1
+    assert 'c_total{replica="r0"} 2' in text
+    assert 'c_total{replica="r1"} 3' in text
+    # Bucket lines: the instance labels come first, `le` appended.
+    assert re.search(r'h_s_bucket\{replica="r0",le="[^"]+"\} 1', text)
+    assert 'h_s_bucket{replica="r0",le="+Inf"} 1' in text
+    assert 'h_s_count{replica="r0"} 1' in text
+    assert 'h_s_min{replica="r0"} 0.5' in text
+
+
+def test_replica_identity_resolution(monkeypatch):
+    monkeypatch.delenv("NCNET_REPLICA_ID", raising=False)
+    obs.set_replica_id(None)
+    try:
+        assert obs.replica_id() is None
+        assert obs.replica_labels() == {}
+        monkeypatch.setenv("NCNET_REPLICA_ID", "env-r")
+        assert obs.replica_id() == "env-r"
+        obs.set_replica_id("cli-r")  # explicit beats env
+        assert obs.replica_labels() == {"replica": "cli-r"}
+    finally:
+        obs.set_replica_id(None)
+
+
+def test_set_build_info_gauge():
+    reg = MetricsRegistry()
+    obs.set_build_info(registry=reg, component="serving")
+    snap = reg.snapshot()
+    (key,) = snap["gauges"]
+    name, labels = obs.parse_series(key)
+    assert name == "ncnet.build_info"
+    assert snap["gauges"][key] == 1.0
+    assert labels["component"] == "serving"
+    assert "version" in labels and "backend" in labels
+
+
+# -- aggregation ----------------------------------------------------------
+
+
+def _load(reg, rid, values, n=1):
+    lbl = {"replica": rid}
+    for v in values:
+        reg.counter("req", labels=lbl).inc(n)
+        reg.histogram("lat_s", labels=lbl).observe(v)
+
+
+def test_merge_of_splits_equals_unsplit_whole():
+    """The aggregation property (ISSUE 6 satellite): any split of the
+    observations across replicas merges back to the same fleet view as
+    the unsplit whole — counters exactly, histograms exactly at bucket
+    resolution (count/sum/buckets identical, hence identical
+    quantiles)."""
+    rng = random.Random(0)
+    values = [rng.lognormvariate(-2.0, 1.5) for _ in range(500)]
+    whole = MetricsRegistry()
+    _load(whole, "all", values)
+    ref = whole.snapshot()["histograms"]['lat_s{replica="all"}']
+
+    cut = rng.randrange(1, len(values) - 1)
+    a, b = MetricsRegistry(), MetricsRegistry()
+    _load(a, "r0", values[:cut])
+    _load(b, "r1", values[cut:])
+    view = aggregate.merge_snapshots([a.snapshot(), b.snapshot()])
+
+    assert view["n_sources"] == 2
+    assert view["replicas"] == ["r0", "r1"]
+    assert view["counters"]["req"] == float(len(values))
+    merged = view["histograms"]["lat_s"]
+    assert merged["count"] == ref["count"]
+    assert merged["sum"] == pytest.approx(ref["sum"])
+    assert merged["min"] == ref["min"] and merged["max"] == ref["max"]
+    assert [tuple(p) for p in merged["buckets"]] == \
+        [tuple(p) for p in ref["buckets"]]
+    for q in ("p50", "p95", "p99"):
+        assert merged[q] == pytest.approx(ref[q]), q
+    # Per-replica slices survive alongside the merge.
+    assert view["per_replica"]["r0"]["counters"]["req"] == float(cut)
+    assert view["per_replica"]["r1"]["counters"]["req"] == float(
+        len(values) - cut)
+
+
+def test_merge_dedups_same_replica_series():
+    """The replica label IS series identity: the same replica seen by
+    two sources is one series observed twice (last wins), while
+    unlabeled series stay per-source."""
+    reg = MetricsRegistry()
+    reg.counter("req", labels={"replica": "r0"}).inc(5)
+    reg.counter("anon").inc(2)
+    snap1 = reg.snapshot()
+    reg.counter("req", labels={"replica": "r0"}).inc(2)  # now 7
+    snap2 = reg.snapshot()
+    view = aggregate.merge_snapshots([snap1, snap2])
+    assert view["counters"]["req"] == 7.0  # dedup: NOT 5 + 7
+    # Unlabeled series never claimed an identity: per-source, summed.
+    assert view["counters"]["anon"] == 4.0
+    assert view["per_replica"]["source0"]["counters"]["anon"] == 2.0
+
+
+def test_merge_gauges_keep_spread_not_sum():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.gauge("queue", labels={"replica": "r0"}).set(4.0)
+    b.gauge("queue", labels={"replica": "r1"}).set(10.0)
+    view = aggregate.merge_snapshots([a.snapshot(), b.snapshot()])
+    entry = view["gauges"]["queue"]
+    assert entry["min"] == 4.0 and entry["max"] == 10.0
+    assert entry["mean"] == pytest.approx(7.0)
+    assert entry["per_replica"] == {"r0": 4.0, "r1": 10.0}
+
+
+def test_parse_prometheus_text_inverts_render_text():
+    reg = MetricsRegistry()
+    reg.counter("serving.requests", labels={"replica": "r0"}).inc(4)
+    reg.gauge("serving.queue_depth", labels={"replica": "r0"}).set(2.0)
+    h = reg.histogram("serving.e2e_latency_s", labels={"replica": "r0"})
+    for v in (0.05, 0.2, 0.2, 1.5):
+        h.observe(v)
+    parsed = aggregate.parse_prometheus_text(reg.render_text())
+    # Names come back prom-sanitized; labels and values exact.
+    assert parsed["counters"]['serving_requests{replica="r0"}'] == 4.0
+    assert parsed["gauges"]['serving_queue_depth{replica="r0"}'] == 2.0
+    got = parsed["histograms"]['serving_e2e_latency_s{replica="r0"}']
+    ref = reg.histogram(
+        "serving.e2e_latency_s", labels={"replica": "r0"}).snapshot()
+    assert got["count"] == ref["count"]
+    assert got["sum"] == pytest.approx(ref["sum"])
+    assert got["min"] == ref["min"] and got["max"] == ref["max"]
+    # Bucket bounds ride the text format's %g (6 significant digits):
+    # counts exact, bounds approx, quantiles approx.
+    assert len(got["buckets"]) == len(ref["buckets"])
+    for (gle, gcum), (rle, rcum) in zip(got["buckets"], ref["buckets"]):
+        assert gcum == rcum
+        assert gle == pytest.approx(rle, rel=1e-5)
+    for q in ("p50", "p95", "p99"):
+        assert got[q] == pytest.approx(ref[q], rel=1e-4), q
+
+
+# -- SLO engine -----------------------------------------------------------
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        SloSpec("bad", objective=1.0, good="g", total="t")
+    with pytest.raises(ValueError):
+        SloSpec("bad", objective=0.99)  # neither mode
+    with pytest.raises(ValueError):
+        SloSpec("bad", objective=0.99, good="g", total="t",
+                histogram="h", threshold_s=0.1)  # both modes
+    with pytest.raises(ValueError):
+        SloSpec("bad", objective=0.99, good="g", total="t",
+                fast_window_s=60.0, slow_window_s=60.0)
+    with pytest.raises(ValueError):
+        SloEngine([SloSpec("a", 0.99, good="g", total="t"),
+                   SloSpec("a", 0.9, good="g", total="t")])
+
+
+def _ratio_engine(clock, registry, **kw):
+    spec = SloSpec("avail", objective=0.99, good="ok", total="all",
+                   fast_window_s=10.0, slow_window_s=60.0,
+                   fast_burn=14.0, slow_burn=6.0, **kw)
+    return spec, SloEngine([spec], registry=registry, clock=clock,
+                           flight_dump=False)
+
+
+def test_slo_healthy_traffic_no_burn():
+    clock, reg = FakeClock(), MetricsRegistry()
+    _spec, eng = _ratio_engine(clock, reg)
+    for _ in range(30):
+        reg.counter("ok").inc(10)
+        reg.counter("all").inc(10)
+        clock.t += 2.0
+        res = eng.evaluate()["avail"]
+    assert res["burn_fast"] == 0.0 and res["burn_slow"] == 0.0
+    assert not res["paging"]
+    assert res["budget_remaining_frac"] == 1.0
+    assert reg.gauge("slo.avail.paging").value == 0.0
+
+
+def test_slo_page_requires_both_windows_then_recovers():
+    """The multi-window rule: a fast-window spike alone does not page;
+    sustained burn pages ONCE (edge, not level); recovery ends the
+    episode and the budget readout climbs back."""
+    clock, reg = FakeClock(), MetricsRegistry()
+    _spec, eng = _ratio_engine(clock, reg)
+    ok, all_ = reg.counter("ok"), reg.counter("all")
+    # 60 s of clean traffic fills the slow window with good history.
+    for _ in range(30):
+        ok.inc(10), all_.inc(10)
+        clock.t += 2.0
+        eng.evaluate()
+    # A short total outage: fast window saturates quickly, but the
+    # hour-scale window still remembers the good hour -> no page.
+    all_.inc(10)
+    clock.t += 2.0
+    res = eng.evaluate()["avail"]
+    assert res["burn_fast"] >= 14.0
+    assert res["burn_slow"] < 6.0
+    assert not res["paging"]
+    # Sustained outage: bad fraction over the slow window crosses too.
+    pages_before = res["pages"]
+    while not res["paging"]:
+        all_.inc(10)
+        clock.t += 2.0
+        res = eng.evaluate()["avail"]
+        assert clock.t < 300.0, "sustained outage never paged"
+    assert res["pages"] == pages_before + 1
+    assert reg.counter("slo.avail.pages").value == 1.0
+    assert eng.paging
+    assert res["budget_remaining_frac"] < 1.0
+    # More outage: still the SAME episode, no second page.
+    for _ in range(5):
+        all_.inc(10)
+        clock.t += 2.0
+        res = eng.evaluate()["avail"]
+    assert res["pages"] == pages_before + 1
+    burned = res["budget_remaining_frac"]
+    # Recovery: good traffic ages the outage out of both windows.
+    while res["paging"]:
+        ok.inc(50), all_.inc(50)
+        clock.t += 2.0
+        res = eng.evaluate()["avail"]
+        assert clock.t < 600.0, "recovery never cleared the page"
+    assert not eng.paging
+    assert reg.gauge("slo.avail.paging").value == 0.0
+    # The budget is SPENT, not reset, by recovery — but enough good
+    # volume earns it back (bad/allowed shrinks as total grows).
+    for _ in range(40):
+        ok.inc(1000), all_.inc(1000)
+        clock.t += 2.0
+        res = eng.evaluate()["avail"]
+    assert res["budget_remaining_frac"] > max(burned, 0.0)
+
+
+def test_slo_latency_threshold_mode():
+    """Latency-mode 'good' = cumulative count at the largest bucket
+    bound <= threshold — exact at bucket resolution."""
+    clock, reg = FakeClock(), MetricsRegistry()
+    spec = SloSpec("p99", objective=0.5, histogram="lat_s",
+                   threshold_s=0.1, fast_window_s=10.0,
+                   slow_window_s=60.0)
+    eng = SloEngine([spec], registry=reg, clock=clock, flight_dump=False)
+    h = reg.histogram("lat_s")
+    for _ in range(9):
+        h.observe(0.01)  # fast: well under threshold
+    h.observe(50.0)      # one slow outlier
+    res = eng.evaluate()["p99"]
+    assert res["total"] == 10.0
+    assert res["good"] == 9.0
+
+
+def test_slo_labels_scope_which_series_count():
+    clock, reg = FakeClock(), MetricsRegistry()
+    spec = SloSpec("avail", 0.99, good="ok", total="all",
+                   fast_window_s=10.0, slow_window_s=60.0)
+    eng = SloEngine([spec], registry=reg, labels={"replica": "r0"},
+                    clock=clock, flight_dump=False)
+    reg.counter("ok", labels={"replica": "r0"}).inc(3)
+    reg.counter("all", labels={"replica": "r0"}).inc(3)
+    reg.counter("all", labels={"replica": "r1"}).inc(100)  # not ours
+    res = eng.evaluate()["avail"]
+    assert res["good"] == 3.0 and res["total"] == 3.0
+    # The engine's own gauges carry its labels.
+    assert reg.gauge("slo.avail.paging",
+                     labels={"replica": "r0"}).value == 0.0
+
+
+def test_slo_page_writes_exactly_one_flight_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", str(tmp_path))
+    flight.recorder().clear()
+    clock, reg = FakeClock(), MetricsRegistry()
+    spec = SloSpec("avail", objective=0.99, good="ok", total="all",
+                   fast_window_s=10.0, slow_window_s=60.0)
+    eng = SloEngine([spec], registry=reg, clock=clock)  # dumps ON
+    all_ = reg.counter("all")
+    reg.counter("ok")
+    for _ in range(40):  # total outage from t=0
+        all_.inc(10)
+        clock.t += 2.0
+        eng.evaluate()
+    assert eng.paging
+    dumps = glob.glob(str(tmp_path / "flight-slo-burn-avail-*.jsonl"))
+    assert len(dumps) == 1, dumps
+
+
+def test_slo_maybe_evaluate_rate_limits():
+    clock, reg = FakeClock(), MetricsRegistry()
+    spec = SloSpec("avail", 0.99, good="ok", total="all",
+                   fast_window_s=10.0, slow_window_s=60.0)
+    eng = SloEngine([spec], registry=reg, clock=clock,
+                    min_interval_s=1.0, flight_dump=False)
+    reg.counter("all").inc(10)
+    first = eng.maybe_evaluate()
+    clock.t += 0.5
+    assert eng.maybe_evaluate() is first  # cached: under the interval
+    clock.t += 1.0
+    assert eng.maybe_evaluate() is not first
+
+
+def test_default_serving_slos_shapes():
+    specs = {s.name: s for s in default_serving_slos(p99_target_s=0.25)}
+    assert set(specs) == {"availability", "deadline_hit", "latency_p99"}
+    # Availability's denominator owes an answer: 200s + 500s + 504s.
+    assert specs["availability"].total == (
+        "serving.responses", "serving.errors", "serving.deadline_exceeded")
+    assert specs["latency_p99"].histogram == "serving.e2e_latency_s"
+    assert specs["latency_p99"].threshold_s == 0.25
+
+
+# -- heartbeat metrics satellite ------------------------------------------
+
+
+def test_heartbeat_stall_metrics(tmp_path):
+    from ncnet_tpu.obs import events as obs_events
+
+    clock = FakeClock()
+    run = obs_events.RunLog(str(tmp_path / "hb.jsonl"), "unit",
+                            clock=clock)
+    hb = obs.Heartbeat(run, interval_s=10.0, stall_after_s=25.0,
+                       clock=clock)
+    hb.beat_once()
+    assert obs.gauge("obs.heartbeat.in_stall").value == 0.0
+    clock.t = 30.0
+    hb.beat_once()
+    assert obs.gauge("obs.heartbeat.in_stall").value == 1.0
+    assert obs.counter("obs.heartbeat.stalls").value == 1.0
+    run.event("progress")
+    clock.t = 35.0
+    hb.beat_once()
+    assert obs.gauge("obs.heartbeat.in_stall").value == 0.0
+    assert obs.counter("obs.heartbeat.stalls").value == 1.0
+    run.close()
+
+
+# -- obs_report labeled diff satellite ------------------------------------
+
+
+def _runlog_with_snapshot(path, snapshot):
+    import json
+
+    with open(path, "w") as fh:
+        for rec in (
+            {"v": 1, "run_id": "r", "event": "run_start", "t_wall": 0.0,
+             "t_mono": 0.0, "component": "unit", "schema": 1},
+            {"v": 1, "run_id": "r", "event": "metrics", "t_wall": 1.0,
+             "t_mono": 1.0, "snapshot": snapshot},
+            {"v": 1, "run_id": "r", "event": "run_end", "t_wall": 2.0,
+             "t_mono": 2.0, "status": "ok", "dur_s": 2.0},
+        ):
+            fh.write(json.dumps(rec) + "\n")
+
+
+def test_obs_report_diff_understands_labeled_series(tmp_path):
+    """ISSUE 6 satellite: per-series diff rows for labeled children,
+    stable (base, labels) ordering, histogram stats keyed with the
+    label block kept terminal."""
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    for reg, n0, n1, lat in ((reg_a, 10, 20, 0.1), (reg_b, 15, 20, 0.4)):
+        reg.counter("serving.requests", labels={"replica": "r0"}).inc(n0)
+        reg.counter("serving.requests", labels={"replica": "r1"}).inc(n1)
+        reg.histogram("lat_s", labels={"replica": "r0"}).observe(lat)
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _runlog_with_snapshot(a, reg_a.snapshot())
+    _runlog_with_snapshot(b, reg_b.snapshot())
+
+    fa = obs_report.final_metrics(obs_report.load_run(str(a)))
+    fb = obs_report.final_metrics(obs_report.load_run(str(b)))
+    assert fa['serving.requests{replica="r0"}'] == 10.0
+    assert fa['lat_s.mean{replica="r0"}'] == pytest.approx(0.1)
+    rows = obs_report.diff_metrics(fa, fb, threshold=0.05)
+    by_name = {r["name"]: r for r in rows}
+    r0 = by_name['serving.requests{replica="r0"}']
+    assert r0["rel"] == pytest.approx(0.5) and r0["flagged"]
+    assert not by_name['serving.requests{replica="r1"}']["flagged"]
+    assert by_name['lat_s.mean{replica="r0"}']["flagged"]
+    # Stable sort: a family's children group together by base name.
+    names = [r["name"] for r in rows]
+    assert names == sorted(names, key=obs_report._series_parts)
+    i0 = names.index('serving.requests{replica="r0"}')
+    assert names[i0 + 1] == 'serving.requests{replica="r1"}'

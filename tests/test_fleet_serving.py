@@ -1,0 +1,271 @@
+"""ISSUE 6 acceptance: the two-replica fleet demo.
+
+Two real MatchServers with distinct replica ids share one process (and
+therefore one obs registry — the hardest aliasing case for label
+identity), serve real load over HTTP, and:
+
+* ``aggregate.fleet_view`` over both ``/metrics`` endpoints produces
+  one fleet view whose summed counters equal the per-replica totals
+  and whose fleet p99 is consistent with the merged buckets;
+* ``tools/fleet_status.py`` polls the same endpoints and emits the
+  house one-JSON-line record with matching numbers;
+* an induced failure window (failpoint-armed, the PR-5 sites) flips
+  the availability SLO's fast-burn alert and writes exactly one flight
+  dump; recovery clears the page and the error-budget readout climbs
+  back — all on a fake clock.
+"""
+
+import glob
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.obs import aggregate, flight
+from ncnet_tpu.reliability import failpoints
+from ncnet_tpu.serving.client import MatchClient, ServingError
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _jpeg_bytes(h, w, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    img = Image.fromarray((rng.random((h, w, 3)) * 255).astype("uint8"))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _make_server(model, rid, **kw):
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_delay_s", 0.01)
+    kw.setdefault("default_timeout_s", 120.0)
+    # CPU-tier latency (first requests pay a compile) must not burn the
+    # latency SLO's budget; these tests drive the availability SLO.
+    kw.setdefault("slo_p99_target_s", 60.0)
+    return MatchServer(engine, port=0, replica_id=rid, **kw).start()
+
+
+def test_two_replica_fleet_view_and_dashboard(tiny_serving_model, capsys):
+    """The fleet-equality demo: load through two labeled replicas, one
+    merged view, summed counters == per-replica totals, fleet p99
+    consistent with the merged bucket ladder, fleet_status contract."""
+    s0 = _make_server(tiny_serving_model, "r0")
+    s1 = _make_server(tiny_serving_model, "r1")
+    kwargs = dict(query_bytes=_jpeg_bytes(96, 128, 0),
+                  pano_bytes=_jpeg_bytes(96, 128, 1), max_matches=8)
+    n0, n1 = 5, 3
+    try:
+        c0 = MatchClient(s0.url, timeout_s=120.0, retries=0)
+        c1 = MatchClient(s1.url, timeout_s=120.0, retries=0)
+        for _ in range(n0):
+            assert c0.match(**kwargs)["n_matches"] >= 1
+        for _ in range(n1):
+            assert c1.match(**kwargs)["n_matches"] >= 1
+
+        # /healthz carries the replica identity and the SLO budget
+        # readout (the balancer-facing fields).
+        hz = c0.healthz()
+        assert hz["replica"] == "r0"
+        assert set(hz["slo"]) == {"availability", "deadline_hit",
+                                  "latency_p99"}
+        for r in hz["slo"].values():
+            assert not r["paging"]
+            assert r["budget_remaining_frac"] == 1.0
+
+        view = aggregate.fleet_view([s0.url, s1.url])
+        assert view["errors"] == {}
+        assert view["replicas"] == ["r0", "r1"]
+
+        # Summed counters == per-replica totals (replica-labeled
+        # series: exact, no double count).
+        per = view["per_replica"]
+        assert per["r0"]["counters"]["serving_requests"] == float(n0)
+        assert per["r1"]["counters"]["serving_requests"] == float(n1)
+        assert view["counters"]["serving_requests"] == float(n0 + n1)
+        assert view["counters"]["serving_responses"] == float(n0 + n1)
+        # (per_replica may also hold synthetic source<i> idents for
+        # unlabeled series, e.g. process-global jit.* telemetry — the
+        # fleet equality is over the replica-labeled series.)
+        assert view["counters"]["serving_requests"] == sum(
+            per[rid]["counters"]["serving_requests"]
+            for rid in view["replicas"])
+
+        # Fleet p99: consistent with the merged cumulative buckets —
+        # p99 sits inside the first bucket whose cumulative count
+        # covers 99% of the fleet's observations.
+        merged = view["histograms"]["serving_e2e_latency_s"]
+        assert merged["count"] == float(n0 + n1)
+        assert merged["count"] == sum(
+            per[rid]["histograms"]["serving_e2e_latency_s"]["count"]
+            for rid in view["replicas"])
+        assert merged["min"] <= merged["p50"] <= merged["p95"] \
+            <= merged["p99"] <= merged["max"]
+        target = 0.99 * merged["count"]
+        lo = 0.0
+        for le, cum in merged["buckets"]:
+            if cum >= target:
+                assert lo <= merged["p99"] <= max(le, merged["min"])
+                break
+            lo = le
+        else:
+            pytest.fail("merged buckets never cover the p99 target")
+
+        # The build-info gauge carries both identities: the replica
+        # label became the aggregation dimension, the other identity
+        # labels (version/backend/...) stay in the series key.
+        info_ids = set()
+        for key, entry in view["gauges"].items():
+            if key.startswith("ncnet_build_info"):
+                info_ids |= set(entry["per_replica"])
+        assert info_ids >= {"r0", "r1"}
+
+        # The dashboard over the same endpoints: one stdout JSON line.
+        import fleet_status
+
+        rc = fleet_status.main([s0.url, s1.url, "--iterations", "2",
+                                "--interval_s", "0"])
+        assert rc == 0
+        out_lines = [l for l in capsys.readouterr().out.splitlines()
+                     if l.strip()]
+        assert len(out_lines) == 1, out_lines
+        rec = json.loads(out_lines[0])
+        assert rec["metric"] == "fleet_status"
+        assert rec["unit"] == "requests"
+        assert rec["value"] == float(n0 + n1)
+        assert rec["fleet"]["requests"] == float(n0 + n1)
+        assert rec["replicas"]["r0"]["requests"] == float(n0)
+        assert rec["replicas"]["r1"]["requests"] == float(n1)
+        assert rec["polls"] == 2
+        assert rec["unreachable"] == []
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_fleet_status_isolates_unreachable_replica(tiny_serving_model,
+                                                   capsys):
+    s0 = _make_server(tiny_serving_model, "r0")
+    dead = "http://127.0.0.1:9"  # discard port: connection refused
+    try:
+        import fleet_status
+
+        rc = fleet_status.main([s0.url, dead, "--iterations", "1"])
+        assert rc == 1  # nonzero: somebody was unreachable
+        out_lines = [l for l in capsys.readouterr().out.splitlines()
+                     if l.strip()]
+        rec = json.loads(out_lines[0])
+        assert rec["unreachable"] == [dead]
+        assert rec["fleet"]["n_sources"] == 1  # the live one still merged
+    finally:
+        s0.stop()
+
+
+def test_slo_burn_page_and_recovery_e2e(tiny_serving_model, tmp_path,
+                                        monkeypatch):
+    """The induced-failure acceptance: a failpoint-armed 500 window
+    flips the availability fast-burn alert through the REAL server path
+    (healthz -> slo_status -> SloEngine over the live registry), writes
+    exactly one flight dump, and recovery clears the page and restores
+    the budget readout. Fake SLO clock; breaker threshold set high so
+    errors stay 500s (breaker 503s are excluded from availability by
+    design)."""
+    flight_dir = str(tmp_path / "flight")
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", flight_dir)
+    flight.recorder().clear()
+
+    server = _make_server(tiny_serving_model, "r0",
+                          breaker_threshold=1000)
+    clock = FakeClock()
+    # Same engine the server built, re-clocked for determinism: short
+    # windows so the page fits in a few evaluation steps.
+    server.slo = obs.SloEngine(
+        obs.default_serving_slos(p99_target_s=60.0, fast_window_s=10.0,
+                                 slow_window_s=60.0),
+        labels=server.labels, clock=clock, min_interval_s=0.0,
+    )
+    kwargs = dict(query_bytes=_jpeg_bytes(96, 128, 0),
+                  pano_bytes=_jpeg_bytes(96, 128, 1), max_matches=8)
+    try:
+        client = MatchClient(server.url, timeout_s=120.0, retries=0)
+
+        def tick(n=1):
+            """Advance the SLO clock and evaluate via the server path."""
+            for _ in range(n):
+                clock.t += 2.0
+                hz = client.healthz()
+            return hz["slo"]["availability"]
+
+        # A healthy baseline fills both windows with good history.
+        for _ in range(6):
+            assert client.match(**kwargs)["n_matches"] >= 1
+            tick()
+        assert not server.slo.paging
+
+        # Failure window: every device dispatch 500s (PR-5 site).
+        failpoints.set_failpoint("engine.device", "error")
+        avail = None
+        for i in range(20):
+            with pytest.raises(ServingError) as exc_info:
+                client.match(**kwargs)
+            assert exc_info.value.status == 500
+            avail = tick()
+            if avail["paging"]:
+                break
+        assert avail is not None and avail["paging"], \
+            "sustained 500s never flipped the burn alert"
+        assert avail["burn_fast"] >= 14.0 and avail["burn_slow"] >= 6.0
+        burned = avail["budget_remaining_frac"]
+        assert burned < 1.0
+        pages = obs.counter("slo.availability.pages",
+                            labels=server.labels).value
+        assert pages == 1.0
+        dumps = glob.glob(
+            flight_dir + "/flight-slo-burn-availability-*.jsonl")
+        assert len(dumps) == 1, "exactly one dump per page episode"
+        header = json.loads(open(dumps[0]).readline())
+        assert header["reason"] == "slo-burn-availability"
+
+        # Recovery: disarm, serve good traffic, age the failure window
+        # out. The page clears, no second dump, and the budget readout
+        # climbs off its low as good volume accumulates.
+        failpoints.clear("engine.device")
+        for i in range(30):
+            assert client.match(**kwargs)["n_matches"] >= 1
+            avail = tick()
+            if not avail["paging"]:
+                break
+        assert not avail["paging"], "recovery never cleared the page"
+        assert not server.slo.paging
+        for _ in range(10):
+            assert client.match(**kwargs)["n_matches"] >= 1
+            avail = tick()
+        assert avail["budget_remaining_frac"] >= burned
+        assert obs.counter("slo.availability.pages",
+                           labels=server.labels).value == 1.0
+        assert len(glob.glob(
+            flight_dir + "/flight-slo-burn-availability-*.jsonl")) == 1
+        assert client.healthz()["status"] == "ok"
+    finally:
+        failpoints.clear()
+        server.stop()

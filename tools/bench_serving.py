@@ -10,7 +10,16 @@ tests/test_bench_contract.py):
     {"metric": "serving_match_throughput_rps", "value": N,
      "unit": "req/s", "latency_ms": {"p50": ..., "p95": ..., "p99": ...},
      "sent": ..., "ok": ..., "rejected": ..., "errors": ...,
-     "batched_frac": ..., "duration_s": ...}
+     "deadline_exceeded": ..., "batched_frac": ..., "duration_s": ...,
+     "slo": {"availability": ..., "availability_objective": ...,
+             "availability_met": ..., "deadline_hit_rate": ...,
+             "p99_ms": ..., "p99_target_ms": ..., "p99_met": ...,
+             "met": ...}}
+
+The ``slo`` block applies obs/slo.py's serving definitions from the
+client side (``--slo_availability``, ``--slo_p99_ms``); ``--slo_strict``
+turns a missed objective into a nonzero exit, so a bench run can gate a
+deploy the way tier-1 tests gate a commit.
 
 Request payloads: ``--query/--pano`` point at server-readable files, or
 ``--synthetic HxW`` generates random JPEGs once and ships them inline
@@ -86,6 +95,13 @@ def main(argv=None):
     parser.add_argument("--max_matches", type=int, default=16)
     parser.add_argument("--no_retry", action="store_true",
                         help="count 503s as rejected instead of retrying")
+    parser.add_argument("--slo_availability", type=float, default=0.999,
+                        help="availability objective for the SLO summary")
+    parser.add_argument("--slo_p99_ms", type=float, default=0.0,
+                        help="p99 latency target for the SLO summary "
+                             "(0 = no latency gate)")
+    parser.add_argument("--slo_strict", action="store_true",
+                        help="exit 1 when the run misses its SLOs")
     args = parser.parse_args(argv)
     if bool(args.synthetic) == bool(args.query and args.pano):
         parser.error("pass either --synthetic HxW or both --query/--pano")
@@ -116,7 +132,8 @@ def main(argv=None):
     n_requests = max(1, int(args.rate * args.duration_s))
     lock = threading.Lock()
     lat_ms, batch_sizes = [], []
-    counts = {"sent": 0, "ok": 0, "rejected": 0, "errors": 0}
+    counts = {"sent": 0, "ok": 0, "rejected": 0, "errors": 0,
+              "deadline_exceeded": 0}
     # Open loop: request i fires at t0 + i/rate regardless of completions.
     # A schedule index handed out under the lock keeps workers from
     # coordinating on anything but the wall clock.
@@ -143,9 +160,13 @@ def main(argv=None):
                     counts["rejected"] += 1
                 continue
             except (ServingError, OSError) as exc:
+                # 504 = the server's DeadlineBatcher gave up honestly;
+                # it feeds the deadline-hit SLO, not the error count.
+                deadline = getattr(exc, "status", None) == 504
                 with lock:
                     counts["sent"] += 1
-                    counts["errors"] += 1
+                    counts["deadline_exceeded" if deadline
+                           else "errors"] += 1
                 note(f"error on req {i}: {exc}")
                 continue
             dt_ms = (time.monotonic() - t_req) * 1e3
@@ -169,6 +190,33 @@ def main(argv=None):
 
     lat_ms.sort()
     batched = sum(1 for b in batch_sizes if b > 1)
+
+    # SLO summary — the same definitions obs/slo.default_serving_slos
+    # uses, measured from the client side: availability over requests
+    # the server owed an answer (200/500/504; shed 503s excluded),
+    # deadline-hit over requests that ran, p99 vs an optional target.
+    answered = counts["ok"] + counts["errors"] + counts["deadline_exceeded"]
+    availability = counts["ok"] / answered if answered else None
+    ran = counts["ok"] + counts["deadline_exceeded"]
+    deadline_hit_rate = counts["ok"] / ran if ran else None
+    p99_ms = percentile(lat_ms, 99) if lat_ms else None
+    availability_met = (availability is None
+                        or availability >= args.slo_availability)
+    p99_met = (args.slo_p99_ms <= 0 or p99_ms is None
+               or p99_ms <= args.slo_p99_ms)
+    slo = {
+        "availability": round(availability, 6)
+        if availability is not None else None,
+        "availability_objective": args.slo_availability,
+        "availability_met": availability_met,
+        "deadline_hit_rate": round(deadline_hit_rate, 6)
+        if deadline_hit_rate is not None else None,
+        "p99_ms": round(p99_ms, 3) if p99_ms is not None else None,
+        "p99_target_ms": args.slo_p99_ms if args.slo_p99_ms > 0 else None,
+        "p99_met": p99_met,
+        "met": availability_met and p99_met,
+    }
+
     rec = {
         "metric": "serving_match_throughput_rps",
         "value": round(counts["ok"] / elapsed, 4) if elapsed > 0 else 0.0,
@@ -182,13 +230,17 @@ def main(argv=None):
         "ok": counts["ok"],
         "rejected": counts["rejected"],
         "errors": counts["errors"],
+        "deadline_exceeded": counts["deadline_exceeded"],
         "batched_frac": round(batched / len(batch_sizes), 4)
         if batch_sizes else 0.0,
         "mean_batch_size": round(sum(batch_sizes) / len(batch_sizes), 3)
         if batch_sizes else None,
         "duration_s": round(elapsed, 3),
+        "slo": slo,
     }
     print(json.dumps(rec), flush=True)
+    if args.slo_strict and not slo["met"]:
+        return 1
     return 0 if counts["errors"] == 0 else 1
 
 

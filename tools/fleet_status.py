@@ -1,0 +1,187 @@
+"""Live fleet status: poll N replicas' /metrics into one terminal view.
+
+The dashboard half of obs/aggregate.py: scrape every replica's
+``GET /metrics`` each poll, merge the scrapes into a fleet view, and
+render a per-replica table to STDERR —
+
+    replica      req/s   err/s   p99 ms   queue  breaker  burn
+    r0            12.4     0.0     38.2       1   closed   0.1
+    r1            11.9     0.0     41.7       0   closed   0.2
+    FLEET         24.3     0.0     40.9       1        -   0.2
+
+req/s and err/s are counter deltas between polls; p99 is exact at the
+shared bucket ladder's resolution (merged buckets for the FLEET row,
+never an average of per-replica percentiles); breaker decodes the
+``breaker_engine_state`` gauge; burn is the availability SLO's
+fast-window burn rate (obs/slo.py) — at or above 1.0 the fleet is
+spending error budget faster than it earns it.
+
+On exit (``--iterations N``, or Ctrl-C when polling forever) it prints
+ONE JSON line to stdout, the house contract every tool in tools/
+follows, with fleet totals, per-replica counters, and the unreachable
+list — so a session script can watch a rollout and assert on the
+result.
+
+Example::
+
+    python tools/fleet_status.py http://127.0.0.1:8123 \
+        http://127.0.0.1:8124 --interval_s 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ncnet_tpu.obs.aggregate import fleet_view  # noqa: E402
+
+# Scraped series carry Prometheus-sanitized names (dots -> underscores;
+# obs/aggregate.parse_prometheus_text docstring).
+REQS = "serving_requests"
+ERRS = "serving_errors"
+LAT = "serving_e2e_latency_s"
+QUEUE = "serving_queue_depth"
+BREAKER = "breaker_engine_state"
+BURN = "slo_availability_burn_fast"
+
+_BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+
+
+def note(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _fmt(v, width, prec=1):
+    if v is None:
+        return "-".rjust(width)
+    return f"{v:.{prec}f}".rjust(width)
+
+
+def _rate(now, prev, key, dt):
+    """Counter delta per second between two counter maps (None on the
+    first poll, when there is no baseline)."""
+    if prev is None or dt <= 0:
+        return None
+    return max(now.get(key, 0.0) - prev.get(key, 0.0), 0.0) / dt
+
+
+def _p99_ms(hists, key):
+    h = hists.get(key)
+    if not h or not h.get("count"):
+        return None
+    p99 = h.get("p99")
+    return p99 * 1e3 if p99 is not None else None
+
+
+def render(view, prev_counters, dt, out=None):
+    """One poll's table; returns {ident: counters} for the next delta."""
+    w = (out or sys.stderr).write
+    rows = []
+    idents = sorted(view["per_replica"])
+    for ident in idents:
+        rep = view["per_replica"][ident]
+        prev = (prev_counters or {}).get(ident)
+        state = rep["gauges"].get(BREAKER)
+        burn = rep["gauges"].get(BURN)
+        rows.append((
+            ident,
+            _rate(rep["counters"], prev, REQS, dt),
+            _rate(rep["counters"], prev, ERRS, dt),
+            _p99_ms(rep["histograms"], LAT),
+            rep["gauges"].get(QUEUE),
+            _BREAKER_STATES.get(state, "?") if state is not None else "-",
+            burn,
+        ))
+    fleet_prev = (prev_counters or {}).get("FLEET")
+    burn_entry = view["gauges"].get(BURN) or {}
+    rows.append((
+        "FLEET",
+        _rate(view["counters"], fleet_prev, REQS, dt),
+        _rate(view["counters"], fleet_prev, ERRS, dt),
+        _p99_ms(view["histograms"], LAT),
+        (view["gauges"].get(QUEUE) or {}).get("max"),
+        "-",
+        burn_entry.get("max"),
+    ))
+    w(f"{'replica':<12} {'req/s':>8} {'err/s':>8} {'p99 ms':>8} "
+      f"{'queue':>6} {'breaker':>9} {'burn':>6}\n")
+    for ident, rps, eps, p99, q, brk, burn in rows:
+        qs = f"{q:.0f}".rjust(6) if q is not None else "-".rjust(6)
+        w(f"{ident:<12} {_fmt(rps, 8)} {_fmt(eps, 8)} {_fmt(p99, 8)} "
+          f"{qs} {brk:>9} {_fmt(burn, 6)}\n")
+    for url, why in sorted(view["errors"].items()):
+        w(f"  unreachable {url}: {why}\n")
+    nxt = {i: dict(view["per_replica"][i]["counters"]) for i in idents}
+    nxt["FLEET"] = dict(view["counters"])
+    return nxt
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="poll replicas' /metrics into one live fleet view")
+    parser.add_argument("urls", nargs="+",
+                        help="replica base URLs (or /metrics endpoints)")
+    parser.add_argument("--interval_s", type=float, default=2.0)
+    parser.add_argument("--iterations", type=int, default=0,
+                        help="polls before exiting (0 = until Ctrl-C)")
+    parser.add_argument("--timeout_s", type=float, default=5.0)
+    parser.add_argument("--clear", action="store_true",
+                        help="clear the terminal between polls (ANSI)")
+    args = parser.parse_args(argv)
+
+    prev, last_t = None, None
+    view = None
+    polls = 0
+    try:
+        while args.iterations <= 0 or polls < args.iterations:
+            if polls and args.interval_s > 0:
+                time.sleep(args.interval_s)
+            view = fleet_view(args.urls, timeout_s=args.timeout_s)
+            now = time.monotonic()
+            dt = (now - last_t) if last_t is not None else 0.0
+            if args.clear:
+                sys.stderr.write("\x1b[2J\x1b[H")
+            note(f"poll {polls + 1}: {len(view['sources'])}/"
+                 f"{len(args.urls)} replicas up")
+            prev = render(view, prev, dt)
+            last_t = now
+            polls += 1
+    except KeyboardInterrupt:
+        pass
+
+    if view is None:
+        return 1
+    replicas = {
+        ident: {
+            "requests": rep["counters"].get(REQS, 0.0),
+            "errors": rep["counters"].get(ERRS, 0.0),
+            "p99_ms": _p99_ms(rep["histograms"], LAT),
+        }
+        for ident, rep in sorted(view["per_replica"].items())
+    }
+    rec = {
+        "metric": "fleet_status",
+        "value": view["counters"].get(REQS, 0.0),
+        "unit": "requests",
+        "replicas": replicas,
+        "fleet": {
+            "requests": view["counters"].get(REQS, 0.0),
+            "errors": view["counters"].get(ERRS, 0.0),
+            "p99_ms": _p99_ms(view["histograms"], LAT),
+            "n_sources": view["n_sources"],
+        },
+        "polls": polls,
+        "unreachable": sorted(view["errors"]),
+    }
+    print(json.dumps(rec), flush=True)
+    return 0 if not view["errors"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

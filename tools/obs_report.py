@@ -52,12 +52,31 @@ def _last_metrics(records: List[dict]) -> Optional[dict]:
     return snaps[-1]["snapshot"] if snaps else None
 
 
+def _series_parts(key: str) -> tuple:
+    """Split a (possibly labeled) series key into (base, labels):
+    ``serving.requests{replica="r0"}`` -> ``("serving.requests",
+    '{replica="r0"}')``. Unlabeled keys get an empty labels part, so a
+    (base, labels) sort groups a family's children together with the
+    unlabeled parent first."""
+    base, brace, rest = key.partition("{")
+    return base, brace + rest
+
+
+def _suffixed(key: str, suffix: str) -> str:
+    """Append a derived-stat suffix to the series BASE name, keeping the
+    label block terminal: ``h{replica="r0"}`` + ``.mean`` ->
+    ``h.mean{replica="r0"}``."""
+    base, labels = _series_parts(key)
+    return base + suffix + labels
+
+
 def final_metrics(records: List[dict]) -> Dict[str, float]:
-    """Flatten the run's last metrics snapshot to {name: value}.
+    """Flatten the run's last metrics snapshot to {series: value}.
 
     Counters and gauges map directly; histograms contribute their mean
     as ``<name>.mean`` plus ``<name>.count`` (the two numbers a
-    regression diff can act on).
+    regression diff can act on). Labeled series (obs/metrics.py labels)
+    keep their full ``name{k="v"}`` key, one row per child.
     """
     snap = _last_metrics(records)
     if snap is None:
@@ -69,8 +88,8 @@ def final_metrics(records: List[dict]) -> Dict[str, float]:
         out[name] = float(v)
     for name, h in snap.get("histograms", {}).items():
         if h.get("count"):
-            out[name + ".mean"] = float(h["mean"])
-            out[name + ".count"] = float(h["count"])
+            out[_suffixed(name, ".mean")] = float(h["mean"])
+            out[_suffixed(name, ".count")] = float(h["count"])
     return out
 
 
@@ -192,7 +211,8 @@ def summarize(path: str, records: List[dict], out=None) -> None:
     metrics = final_metrics(records)
     if metrics:
         w("  final metrics:\n")
-        for name, v in sorted(metrics.items()):
+        for name, v in sorted(metrics.items(),
+                              key=lambda kv: _series_parts(kv[0])):
             w(f"    {name:<40} {v:g}\n")
 
 
@@ -207,7 +227,9 @@ def diff_metrics(
     the threshold's job is only to separate noise from signal.
     """
     rows = []
-    for name in sorted(set(a) | set(b)):
+    # Sort by (base, labels) so a labeled family's children sit together
+    # under the unlabeled parent, in stable label order.
+    for name in sorted(set(a) | set(b), key=_series_parts):
         va, vb = a.get(name), b.get(name)
         delta = rel = None
         if va is not None and vb is not None:
