@@ -31,6 +31,7 @@ on store).
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
 import threading
@@ -100,6 +101,44 @@ class PanoFeatureCache:
     def _hash(key: tuple) -> str:
         return hashlib.sha1(repr(key).encode()).hexdigest()
 
+    @contextlib.contextmanager
+    def _disk_lock(self):
+        """Serialize cross-process compound disk mutations.
+
+        Single writes are already atomic (tmp + rename, _disk_write);
+        this guards the MULTI-step sequences a fleet of engines — or
+        several server processes sharing one disk_dir — can interleave:
+        the legacy migration's write-new-then-unlink-old, and put()'s
+        exists-probe-then-write. An advisory ``fcntl.flock`` on a
+        sidecar lock file; where flock is unavailable (non-posix) the
+        in-process lock still holds and the atomic renames keep the
+        worst cross-process outcome at a redundant write, never a
+        corrupt or vanished entry."""
+        if not self.disk_dir:
+            yield
+            return
+        fh = None
+        try:
+            import fcntl
+
+            fh = open(os.path.join(self.disk_dir, ".cache.lock"), "a+b")
+            fcntl.flock(fh, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            if fh is not None:
+                fh.close()
+                fh = None
+        try:
+            yield
+        finally:
+            if fh is not None:
+                try:
+                    import fcntl
+
+                    fcntl.flock(fh, fcntl.LOCK_UN)
+                except (ImportError, OSError):
+                    pass
+                fh.close()
+
     def _disk_path(self, key: tuple) -> str:
         # feat2_: the uint16-view+tag format. Versioned name so a reader
         # from a pre-bf16 build sharing this dir misses (recomputes)
@@ -157,17 +196,21 @@ class PanoFeatureCache:
                 # dir then misses and recomputes — safe; a failed write
                 # must not orphan the only disk copy).
                 feats = feats.astype(self.store_dtype)
-                if self._disk_write(path, feats) and read_path == legacy_path:
-                    try:
-                        os.unlink(legacy_path)
-                    except OSError:
-                        pass
+                with self._disk_lock():
+                    if (self._disk_write(path, feats)
+                            and read_path == legacy_path):
+                        try:
+                            os.unlink(legacy_path)
+                        except OSError:
+                            pass
             if feats is not None:
-                self.hits += 1
-                self.disk_hits += 1
+                with self._lock:
+                    self.hits += 1
+                    self.disk_hits += 1
                 self._store_mem(key, feats)
                 return feats
-        self.misses += 1
+        with self._lock:
+            self.misses += 1
         return None
 
     def put(self, pano_path: str, shape: Tuple[int, int],
@@ -181,8 +224,9 @@ class PanoFeatureCache:
             feats = feats.astype(self.store_dtype)
         if self.disk_dir:
             path = self._disk_path(key)
-            if not os.path.exists(path):
-                self._disk_write(path, feats)
+            with self._disk_lock():
+                if not os.path.exists(path):
+                    self._disk_write(path, feats)
         self._store_mem(key, feats)
 
     def _disk_write(self, path: str, feats: np.ndarray) -> bool:

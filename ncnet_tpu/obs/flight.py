@@ -36,7 +36,8 @@ import time
 from typing import Optional
 
 #: Dump files: ``flight-<reason>-<stamp>.jsonl`` in the first of
-#: ``NCNET_FLIGHT_DIR``, the active run log's directory, or cwd.
+#: ``NCNET_FLIGHT_DIR``, the active run log's directory, or a
+#: ``flight/`` subdir of cwd (never bare cwd).
 _DUMP_PREFIX = "flight"
 
 #: Minimum seconds between dumps for one reason (flap guard).
@@ -94,7 +95,10 @@ class FlightRecorder:
                 return os.path.dirname(os.path.abspath(run.path)) or "."
         except Exception:
             pass
-        return "."
+        # Last resort: a flight/ subdir of the CWD — NEVER the bare CWD,
+        # which litters whatever directory the process happened to start
+        # in (dump() creates the dir).
+        return os.path.join(".", "flight")
 
     def dump(self, reason: str, directory: Optional[str] = None,
              force: bool = False) -> Optional[str]:

@@ -1,7 +1,7 @@
 """Parallelism: mesh construction, DP sharding, corr-tensor spatial sharding."""
 
 from . import multihost
-from .mesh import make_mesh, batch_sharding, replicated
+from .mesh import make_mesh, batch_sharding, replicated, serving_devices
 from .corr_sharding import (
     make_sharded_match_pipeline,
     sharded_correlation,
@@ -18,6 +18,7 @@ __all__ = [
     "make_mesh",
     "batch_sharding",
     "replicated",
+    "serving_devices",
     "make_sharded_match_pipeline",
     "sharded_correlation",
     "match_pipeline_sharded",

@@ -46,6 +46,28 @@ def make_mesh(
     return Mesh(dev_array, axis_names)
 
 
+def serving_devices(n: Optional[int] = None, backend: Optional[str] = None):
+    """Devices for the serving replica pool, in stable id order.
+
+    The fleet builder (serving/fleet.MatchFleet.build) assigns one
+    MatchEngine per entry; LOCAL devices only — a replica's engine must
+    dispatch without cross-host transfers, and multihost deployments run
+    one fleet process per host behind their own balancer
+    (parallel/multihost.py). ``n`` requests exactly that many devices
+    and raises when the host has fewer (an operator asking for 8
+    replicas-with-distinct-devices on a 4-chip host should hear about
+    it at startup, not discover 2x-subscribed chips under load).
+    """
+    devs = sorted(jax.local_devices(backend=backend), key=lambda d: d.id)
+    if n is not None:
+        if n > len(devs):
+            raise ValueError(
+                f"asked for {n} serving devices, host has {len(devs)}"
+            )
+        devs = devs[:n]
+    return devs
+
+
 def shard_map_compat(f, mesh, in_specs, out_specs, check=False):
     """`shard_map` across jax versions: the export moved
     (jax.experimental.shard_map -> jax.shard_map) and the replication

@@ -22,6 +22,7 @@ from __future__ import annotations
 _EXPORTS = {
     "DeadlineBatcher": "batcher",
     "RejectedError": "batcher",
+    "ReplicaDeadError": "batcher",
     "BatchResult": "batcher",
     "MatchEngine": "engine",
     "Prepared": "engine",
@@ -29,6 +30,11 @@ _EXPORTS = {
     "MatchClient": "client",
     "ServingError": "client",
     "OverCapacityError": "client",
+    "FleetDispatcher": "dispatcher",
+    "NoHealthyReplicaError": "dispatcher",
+    "MatchFleet": "fleet",
+    "Replica": "fleet",
+    "SharedFeatureStore": "feature_store",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -84,10 +84,22 @@ class PoisonRequestError(Exception):
         self.cause = cause
 
 
+class ReplicaDeadError(RuntimeError):
+    """The replica that owns this batcher has been stopped (fleet kill
+    or drain, serving/fleet.py). The dispatch was refused, not
+    attempted, so riders are safe to re-route: the dispatcher resubmits
+    them to a healthy replica (serving/dispatcher.py)."""
+
+    def __init__(self, replica_id: str = ""):
+        super().__init__(f"replica {replica_id or '?'} is stopped")
+        self.replica_id = replica_id
+
+
 #: Errors that must NOT trigger bisection: re-running sub-batches
-#: cannot help when the device path is refusing all work (open breaker)
-#: — it just multiplies load on a known-down dependency.
-_NO_BISECT = (BreakerOpenError,)
+#: cannot help when the device path is refusing all work (open breaker,
+#: stopped replica) — it just multiplies load on a known-down
+#: dependency. The fleet dispatcher re-routes these instead.
+_NO_BISECT = (BreakerOpenError, ReplicaDeadError)
 
 
 @dataclass
@@ -407,3 +419,16 @@ class DeadlineBatcher:
     def depth(self) -> int:
         with self._cond:
             return len(self._buckets) + sum(len(b) for b in self._ready)
+
+    @property
+    def inflight(self) -> int:
+        """Batches currently running in the worker (or a poll caller).
+        ``depth + inflight`` is the load signal the fleet dispatcher's
+        least-loaded routing reads (serving/dispatcher.py)."""
+        with self._cond:
+            return self._inflight
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed

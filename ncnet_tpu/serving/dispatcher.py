@@ -1,0 +1,180 @@
+"""Least-loaded healthy routing in front of the replica pool.
+
+The :class:`FleetDispatcher` sits where the single-engine server's
+``DeadlineBatcher`` used to: the HTTP handler submits one prepared
+request + bucket key and gets back a Future. The dispatcher picks the
+least-loaded *healthy* replica (alive, admitting, breaker not
+refusing — serving/fleet.Replica.healthy), ties rotating so an idle
+fleet spreads work across devices instead of dog-piling replica 0.
+
+**Re-route on refusal**: a request can be queued on a replica whose
+breaker opens or which is killed before its batch runs. Those failures
+(:class:`~ncnet_tpu.reliability.breaker.BreakerOpenError`,
+:class:`~ncnet_tpu.serving.batcher.ReplicaDeadError`) mean the dispatch
+was REFUSED, never attempted — so the rider is resubmitted to a
+different healthy replica (each replica tried at most once, bounded by
+``max_redispatch``) instead of bouncing a 503 to a client while seven
+healthy replicas idle. Attempted-but-failed work (model errors, poison
+riders) is NOT re-routed: those outcomes belong to the request and
+propagate unchanged (422/500, exactly the single-engine contract).
+
+Admission composes: each replica keeps its own bounded queue, so the
+fleet's capacity is ``n_replicas x max_queue``; when every healthy
+replica rejects, the dispatcher surfaces the RejectedError (503 +
+Retry-After), and when NO replica is healthy it raises
+:class:`NoHealthyReplicaError` — a BreakerOpenError subclass, so the
+server's existing 503 mapping covers the whole-fleet-down case with no
+new handler branch.
+
+Clock-free and thread-safe; the fake-clock unit suite drives it with
+threadless replicas via ``batcher.poll()`` (tests/test_fleet_dispatch.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+from .. import obs
+from ..obs import trace
+from ..reliability.breaker import BreakerOpenError
+from .batcher import RejectedError, ReplicaDeadError
+
+
+class NoHealthyReplicaError(BreakerOpenError):
+    """Every replica is dead, draining, or breaker-open. Subclasses
+    BreakerOpenError so the server's front-door 503 + Retry-After
+    mapping applies unchanged."""
+
+
+class FleetDispatcher:
+    """Route bucket submissions to the least-loaded healthy replica."""
+
+    def __init__(self, replicas: Sequence, max_redispatch: Optional[int]
+                 = None, labels=None):
+        if not replicas:
+            raise ValueError("dispatcher needs at least one replica")
+        self.replicas = list(replicas)
+        # Each replica is tried at most once per request; the default
+        # budget lets a request visit every other replica before its
+        # failure surfaces.
+        self.max_redispatch = (len(self.replicas) - 1
+                               if max_redispatch is None
+                               else int(max_redispatch))
+        self.labels = dict(labels or {})
+        self._lock = threading.Lock()
+        self._rr = 0
+
+    # -- routing ----------------------------------------------------------
+
+    def healthy(self) -> List:
+        return [r for r in self.replicas if r.healthy]
+
+    def _retry_after(self) -> float:
+        hints = [r.breaker.retry_after_s() for r in self.replicas]
+        hints = [h for h in hints if h > 0]
+        return min(hints) if hints else 1.0
+
+    def admit(self) -> Optional[float]:
+        """Front-door hint: None while any replica can take work, else
+        the soonest Retry-After across the fleet's breakers."""
+        n = len(self.healthy())
+        obs.gauge("serving.fleet.healthy", labels=self.labels).set(float(n))
+        if n:
+            return None
+        return self._retry_after()
+
+    def pick(self, exclude=()):
+        """Least-loaded healthy replica not in ``exclude`` (ties rotate
+        round-robin), or None."""
+        cands = [r for r in self.replicas
+                 if r.healthy and r not in exclude]
+        if not cands:
+            return None
+        with self._lock:
+            self._rr += 1
+            k = self._rr
+        n = len(cands)
+        order = [cands[(k + i) % n] for i in range(n)]
+        return min(order, key=lambda r: r.load)
+
+    # -- request path -----------------------------------------------------
+
+    def submit(self, bucket_key, payload, timeout_s: Optional[float] = None
+               ) -> Future:
+        """Admit one request somewhere healthy; returns a Future with
+        the single-engine BatchResult contract. Raises RejectedError
+        (every healthy queue full) or NoHealthyReplicaError."""
+        outer: Future = Future()
+        state = {
+            "tried": [],
+            "attempts": 0,
+            # Captured on the handler thread: a re-route happens on a
+            # worker-thread callback where contextvars are empty, so the
+            # resubmit re-attaches the request's trace explicitly.
+            "ctx": trace.current(),
+        }
+        self._dispatch(outer, bucket_key, payload, timeout_s, state)
+        return outer
+
+    def _dispatch(self, outer, bucket_key, payload, timeout_s, state):
+        """Pick + submit, walking past full queues; raises when nothing
+        can take the request (callers: submit re-raises to the handler,
+        _on_done converts into the outer future's exception)."""
+        last_reject = None
+        while True:
+            r = self.pick(exclude=state["tried"])
+            if r is None:
+                if last_reject is not None:
+                    raise last_reject
+                raise NoHealthyReplicaError(self._retry_after())
+            try:
+                with trace.attach(state["ctx"]):
+                    inner = r.submit(bucket_key, payload,
+                                     timeout_s=timeout_s)
+            except RejectedError as exc:
+                state["tried"].append(r)
+                last_reject = exc
+                continue
+            except RuntimeError:  # closed between pick and submit
+                state["tried"].append(r)
+                continue
+            inner.add_done_callback(
+                lambda fut, rep=r: self._on_done(
+                    outer, rep, bucket_key, payload, timeout_s, state, fut)
+            )
+            return
+
+    def _on_done(self, outer, replica, bucket_key, payload, timeout_s,
+                 state, fut):
+        exc = fut.exception()
+        if exc is None:
+            outer.set_result(fut.result())
+            return
+        refused = isinstance(exc, (ReplicaDeadError, BreakerOpenError))
+        if refused and state["attempts"] < self.max_redispatch:
+            state["attempts"] += 1
+            state["tried"].append(replica)
+            obs.counter("serving.redispatched", labels=self.labels).inc()
+            obs.event("redispatch", replica=replica.replica_id,
+                      attempt=state["attempts"],
+                      error=type(exc).__name__)
+            try:
+                self._dispatch(outer, bucket_key, payload, timeout_s, state)
+            except Exception as exc2:  # noqa: BLE001 — forwarded
+                outer.set_exception(exc2)
+            return
+        outer.set_exception(exc)
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    @property
+    def depth(self) -> int:
+        return sum(r.batcher.depth for r in self.replicas)
+
+    def close(self, timeout_s: float = 60.0) -> None:
+        """Drain every replica; dead ones first so their riders can
+        re-route into the still-open rest (fleet.MatchFleet.close)."""
+        for r in sorted(self.replicas, key=lambda r: not r.dead):
+            r.close(timeout_s=timeout_s)

@@ -78,8 +78,22 @@ class MatchEngine:
         cache_mb: int = 0,
         cache_dir: str = "",
         cache_model_key: str = "",
+        device=None,
+        cache=None,
         labels=None,
     ):
+        """``device``: pin this engine to one accelerator (a fleet builds
+        one engine per device, serving/fleet.py) — params are committed
+        there and every batch's input stacks are placed there, so N
+        engines dispatch to N devices concurrently. None keeps jax's
+        default placement (the single-engine path, unchanged).
+
+        ``cache``: an externally owned feature store (duck-compatible
+        with PanoFeatureCache — the fleet passes one SharedFeatureStore
+        to every engine so a pano computed by any replica is a hit for
+        all). When set, ``cache_mb``/``cache_dir`` are ignored; the
+        caller owns the producer key.
+        """
         import jax
         import jax.numpy as jnp
 
@@ -88,6 +102,12 @@ class MatchEngine:
         # owning MatchServer sets this when it has a replica identity.
         self.labels = dict(labels or {})
         self.config = config
+        self.device = device
+        if device is not None:
+            # Commit the weights to this engine's device: committed
+            # params drive jit placement, so the whole batch program
+            # (and its outputs) live on the replica's accelerator.
+            params = jax.device_put(params, device)
         self.params = params
         self.k_size = k_size
         self.image_size = image_size
@@ -151,8 +171,8 @@ class MatchEngine:
         self._batch_pairs_with_feats = _batch_pairs_with_feats
         self._batch_pairs_cached = _batch_pairs_cached
 
-        self.cache = None
-        if cache_mb > 0:
+        self.cache = cache
+        if self.cache is None and cache_mb > 0:
             from ..evals.feature_cache import PanoFeatureCache
 
             # Producer key "serve": the serving miss program (per-pair
@@ -169,6 +189,13 @@ class MatchEngine:
         # put() fetches D2H; serialize stores so a burst of misses can't
         # stack redundant fetches of one shortlist-popular pano.
         self._store_lock = threading.Lock()
+
+    def _put(self, x):
+        """Place one input stack on this engine's device (no-op when the
+        engine is unpinned — jax's default placement applies)."""
+        if self.device is None:
+            return x
+        return self._jax.device_put(x, self.device)
 
     # -- host-side request preparation -----------------------------------
 
@@ -276,17 +303,18 @@ class MatchEngine:
         """
         jnp = self._jnp
         t_asm = time.monotonic()
-        q_stack = jnp.concatenate([p.query for p in batch], axis=0)
+        q_stack = self._put(jnp.concatenate([p.query for p in batch], axis=0))
         store = []
         f_stack = t_stack = None
         mode = "plain"
         if batch[0].pano_feats is not None:
-            f_stack = jnp.stack(
+            f_stack = self._put(jnp.stack(
                 [jnp.asarray(p.pano_feats) for p in batch], axis=0
-            )
+            ))
             mode = "cached"
         else:
-            t_stack = jnp.concatenate([p.pano for p in batch], axis=0)
+            t_stack = self._put(
+                jnp.concatenate([p.pano for p in batch], axis=0))
             if self.cache is not None and any(p.pano_path for p in batch):
                 mode = "with_feats"
         assemble_s = time.monotonic() - t_asm
@@ -359,8 +387,10 @@ class MatchEngine:
             q_shape = self._resize_shape(qh, qw)
             p_shape = self._resize_shape(ph, pw)
             for b in batch_sizes:
-                q = self._jnp.zeros((b, 3) + q_shape, self._jnp.float32)
-                t = self._jnp.zeros((b, 3) + p_shape, self._jnp.float32)
+                q = self._put(
+                    self._jnp.zeros((b, 3) + q_shape, self._jnp.float32))
+                t = self._put(
+                    self._jnp.zeros((b, 3) + p_shape, self._jnp.float32))
                 with obs.span("serving.warmup", q_shape=list(q_shape),
                               p_shape=list(p_shape), batch=b):
                     self._jax.block_until_ready(

@@ -1,0 +1,150 @@
+"""Serving-wide pano feature store shared by every fleet replica.
+
+The eval-grade :class:`~ncnet_tpu.evals.feature_cache.PanoFeatureCache`
+(byte-bounded memory LRU + atomic disk tier) was written for one CLI
+process; the fleet promotes ONE instance of it into a store every
+:class:`~ncnet_tpu.serving.engine.MatchEngine` in the process shares,
+so a pano whose backbone features were computed by replica d3 is a
+memory hit for d0..d7 — the backbone cost of a popular pano is paid at
+most once per fleet, not once per replica. Across processes/hosts the
+disk tier plays the same role (its writes are atomic and flock-guarded,
+evals/feature_cache.py).
+
+Two serving-specific additions over the raw cache:
+
+* **Content-addressed keys**: the raw cache keys by pano *path*, which
+  is identity enough for one CLI run but not for a fleet where the same
+  gallery image can arrive under different mount points, symlinks, or
+  staging copies. The store translates each path to a
+  ``sha256:<digest>`` identity (digest of the file BYTES, memoized by
+  (realpath, size, mtime_ns) so steady state costs one stat, not one
+  hash) before delegating — same content, same entry, regardless of
+  where it lives. Unreadable paths fall back to the literal path key.
+
+* **Startup prewarming**: :meth:`prewarm` probes a declared pano list
+  against the disk tier through the normal ``get`` path, promoting
+  every on-disk entry into the shared memory LRU before the first
+  request lands (probe misses are no-ops — prewarm never computes).
+
+Thread-safety is the underlying cache's (all mutation under its lock);
+the identity memo has its own lock and a bounded LRU so a long-lived
+server cannot grow it without bound.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Iterable, Optional, Tuple
+
+from ..evals.feature_cache import PanoFeatureCache
+
+#: Identity-memo bound: entries are ~100 bytes; 64k covers any sane
+#: gallery while capping a pathological path churn at ~6 MB.
+_IDENT_MEMO_MAX = 65536
+
+
+class SharedFeatureStore:
+    """Content-addressed, fleet-shared wrapper over PanoFeatureCache.
+
+    Duck-compatible with the raw cache where the engine touches it
+    (``get``/``put``/``hits``/``misses``/``stats``), so
+    ``MatchEngine(cache=store)`` needs no special casing.
+    """
+
+    def __init__(self, max_bytes: int, disk_dir: Optional[str] = None,
+                 model_key: str = "", store_dtype=None,
+                 content_addressed: bool = True):
+        self._cache = PanoFeatureCache(
+            max_bytes, disk_dir=disk_dir, model_key=model_key,
+            store_dtype=store_dtype,
+        )
+        self.content_addressed = content_addressed
+        self._idents: "OrderedDict[str, tuple]" = OrderedDict()
+        self._ident_lock = threading.Lock()
+
+    # -- content addressing ----------------------------------------------
+
+    def _identity(self, pano_path: str) -> str:
+        """Path -> stable content identity (``sha256:<digest>``).
+
+        Memoized by (realpath, size, mtime_ns): an edited file re-hashes,
+        an untouched one costs a stat. Unreadable/unstat-able paths key
+        by the literal path (the request will miss and fail downstream
+        with the proper decode error, not here)."""
+        if not self.content_addressed:
+            return pano_path
+        try:
+            real = os.path.realpath(pano_path)
+            st = os.stat(real)
+        except OSError:
+            return pano_path
+        sig = (st.st_size, st.st_mtime_ns)
+        with self._ident_lock:
+            memo = self._idents.get(real)
+            if memo is not None and memo[0] == sig:
+                self._idents.move_to_end(real)
+                return memo[1]
+        h = hashlib.sha256()
+        try:
+            with open(real, "rb") as fh:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    h.update(chunk)
+        except OSError:
+            return pano_path
+        digest = "sha256:" + h.hexdigest()
+        with self._ident_lock:
+            self._idents[real] = (sig, digest)
+            self._idents.move_to_end(real)
+            while len(self._idents) > _IDENT_MEMO_MAX:
+                self._idents.popitem(last=False)
+        return digest
+
+    # -- the engine-facing cache surface ----------------------------------
+
+    def get(self, pano_path: str, shape: Tuple[int, int]):
+        return self._cache.get(self._identity(pano_path), shape)
+
+    def put(self, pano_path: str, shape: Tuple[int, int], feats) -> None:
+        self._cache.put(self._identity(pano_path), shape, feats)
+
+    @property
+    def hits(self) -> int:
+        return self._cache.hits
+
+    @property
+    def misses(self) -> int:
+        return self._cache.misses
+
+    @property
+    def disk_hits(self) -> int:
+        return self._cache.disk_hits
+
+    @property
+    def nbytes(self) -> int:
+        return self._cache.nbytes
+
+    def stats(self) -> str:
+        return self._cache.stats()
+
+    # -- startup ----------------------------------------------------------
+
+    def prewarm(self, paths: Iterable[str],
+                shape_fn: Callable[[str], Tuple[int, int]]) -> int:
+        """Probe each pano against the store (disk hits promote into the
+        shared memory LRU); returns how many were warm. ``shape_fn``
+        maps a path to its resize bucket — the server passes the
+        engine's bucket snap so prewarm keys exactly match request keys.
+        Misses are recorded in ``misses`` but compute nothing.
+        """
+        warm = 0
+        for p in paths:
+            try:
+                shape = shape_fn(p)
+            except Exception:  # noqa: BLE001 — unreadable pano: skip
+                continue
+            if self.get(p, shape) is not None:
+                warm += 1
+        return warm

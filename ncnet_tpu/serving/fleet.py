@@ -1,0 +1,277 @@
+"""Replica pool for the serving layer: one engine per device.
+
+A :class:`Replica` is the unit of failure the fleet reasons about —
+one :class:`~ncnet_tpu.serving.engine.MatchEngine` pinned to one
+device, one :class:`~ncnet_tpu.serving.batcher.DeadlineBatcher` (the
+device schedule), and one per-replica
+:class:`~ncnet_tpu.reliability.breaker.CircuitBreaker` so a dead or
+flapping device degrades ONE replica while the rest keep serving
+(FireCaffe's failure-as-steady-state posture, PAPERS.md).
+
+:class:`MatchFleet` builds N replicas over the host's devices
+(parallel/mesh.serving_devices), shares one
+:class:`~ncnet_tpu.serving.feature_store.SharedFeatureStore` across
+every engine — a pano computed anywhere is a hit everywhere — and
+fronts them with a :class:`~ncnet_tpu.serving.dispatcher.FleetDispatcher`
+(least-loaded healthy routing + re-route on replica failure).
+
+``kill``/``revive`` model a replica stopping mid-load (the chaos verb
+``kill_replica``, tools/chaos_serving.py): a killed replica refuses
+every dispatch with :class:`~ncnet_tpu.serving.batcher.ReplicaDeadError`
+— refused, not attempted — so the dispatcher re-routes its queued
+riders to healthy replicas within one flush window and no admitted
+request is ever silently dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from .. import obs
+from ..reliability.breaker import CircuitBreaker
+from .batcher import DeadlineBatcher, ReplicaDeadError
+
+
+class Replica:
+    """One engine + batcher + breaker with a fleet identity.
+
+    ``runner`` overrides the engine dispatch for tests (fake-clock unit
+    suites drive echo runners with no jax); production wires
+    ``engine.run_batch``.
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        engine=None,
+        runner: Optional[Callable] = None,
+        max_batch: int = 4,
+        max_queue: int = 32,
+        max_delay_s: float = 0.05,
+        deadline_slack_s: float = 0.1,
+        default_timeout_s: float = 30.0,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 10.0,
+        isolate_poison: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if engine is None and runner is None:
+            raise ValueError("need an engine or a runner")
+        self.replica_id = str(replica_id)
+        self.engine = engine
+        self.labels = {"replica": self.replica_id}
+        self._runner = runner if runner is not None else engine.run_batch
+        self._dead = False
+        self._dead_lock = threading.Lock()
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            reset_timeout_s=breaker_reset_s,
+            labels=self.labels,
+            clock=clock,
+        )
+        self.batcher = DeadlineBatcher(
+            self._run,
+            max_batch=max_batch,
+            max_queue=max_queue,
+            max_delay_s=max_delay_s,
+            deadline_slack_s=deadline_slack_s,
+            default_timeout_s=default_timeout_s,
+            isolate_poison=isolate_poison,
+            clock=clock,
+            labels=self.labels,
+        )
+
+    def _run(self, bucket_key, batch):
+        # The dead check sits OUTSIDE the breaker: a kill is an operator
+        # / chaos action, not a device failure — it must not pollute the
+        # breaker's failure counts, and `healthy` reads the dead flag
+        # directly.
+        if self.dead:
+            raise ReplicaDeadError(self.replica_id)
+        return self.breaker.call(self._runner, bucket_key, batch)
+
+    # -- routing signals (read by the dispatcher) -------------------------
+
+    @property
+    def dead(self) -> bool:
+        with self._dead_lock:
+            return self._dead
+
+    @property
+    def healthy(self) -> bool:
+        """Routable: alive, admitting, and the breaker is not refusing
+        (an open breaker past its reset window still counts healthy so
+        routed requests can serve as half-open probes)."""
+        return (not self.dead and not self.batcher.closed
+                and self.breaker.admit() is None)
+
+    @property
+    def load(self) -> int:
+        """Least-loaded routing signal: queued requests + running
+        batches."""
+        return self.batcher.depth + self.batcher.inflight
+
+    # -- request path -----------------------------------------------------
+
+    def submit(self, bucket_key, payload, timeout_s=None):
+        return self.batcher.submit(bucket_key, payload, timeout_s=timeout_s)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "Replica":
+        self.batcher.start()
+        return self
+
+    def kill(self) -> None:
+        """Stop doing work (chaos / operator): every queued and future
+        dispatch is refused with ReplicaDeadError for the dispatcher to
+        re-route. Admission stays open only at the batcher level —
+        `healthy` goes False immediately, so the dispatcher stops
+        routing here the moment the flag flips."""
+        with self._dead_lock:
+            self._dead = True
+        # Wake the worker so queued buckets flush (and re-route) now,
+        # not at the next deadline tick.
+        with self.batcher._cond:
+            self.batcher._cond.notify_all()
+
+    def revive(self) -> None:
+        with self._dead_lock:
+            self._dead = False
+        self.breaker.reset()
+
+    def close(self, timeout_s: float = 60.0) -> None:
+        self.batcher.close(timeout_s=timeout_s)
+
+    def snapshot(self) -> dict:
+        return {
+            "replica": self.replica_id,
+            "state": self.breaker.state,
+            "depth": self.batcher.depth,
+            "dead": self.dead,
+            "healthy": self.healthy,
+        }
+
+
+class MatchFleet:
+    """N replicas + shared feature store + dispatcher, one lifecycle."""
+
+    def __init__(self, replicas: List[Replica], store=None,
+                 max_redispatch: Optional[int] = None):
+        from .dispatcher import FleetDispatcher
+
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas = list(replicas)
+        self.store = store
+        self.dispatcher = FleetDispatcher(
+            self.replicas, max_redispatch=max_redispatch)
+
+    @classmethod
+    def build(
+        cls,
+        config,
+        params,
+        n_replicas: int = 0,
+        devices=None,
+        base_id: str = "",
+        store=None,
+        cache_mb: int = 0,
+        cache_dir: str = "",
+        cache_model_key: str = "",
+        engine_kwargs: Optional[dict] = None,
+        replica_kwargs: Optional[dict] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> "MatchFleet":
+        """One engine per device (round-robin when n_replicas exceeds
+        the device count — useful for CPU smoke fleets), every engine
+        sharing one feature store. ``n_replicas=0`` means one replica
+        per visible device."""
+        from ..parallel.mesh import serving_devices
+        from .engine import MatchEngine
+
+        devices = list(devices) if devices is not None else serving_devices()
+        n = int(n_replicas) or len(devices)
+        if store is None and cache_mb > 0:
+            import ml_dtypes
+
+            from .feature_store import SharedFeatureStore
+
+            # Same producer key + dtype the single-engine path uses
+            # (engine.py): the serving miss program's features, bf16.
+            store = SharedFeatureStore(
+                cache_mb * 1024 * 1024,
+                disk_dir=cache_dir or None,
+                model_key=cache_model_key + "|serve",
+                store_dtype=ml_dtypes.bfloat16,
+            )
+        replicas = []
+        for k in range(n):
+            rid = f"{base_id}-d{k}" if base_id else f"d{k}"
+            engine = MatchEngine(
+                config, params,
+                device=devices[k % len(devices)],
+                cache=store,
+                labels={"replica": rid},
+                **(engine_kwargs or {}),
+            )
+            replicas.append(Replica(
+                rid, engine=engine, clock=clock, **(replica_kwargs or {})
+            ))
+        return cls(replicas, store=store)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "MatchFleet":
+        for r in self.replicas:
+            r.start()
+        return self
+
+    def warmup(self, raw_shapes, batch_sizes=(1,)) -> int:
+        """Precompile declared buckets on every replica. Replica 0 pays
+        the trace; the rest mostly hit the persistent compile cache."""
+        return sum(r.engine.warmup(raw_shapes, batch_sizes=batch_sizes)
+                   for r in self.replicas if r.engine is not None)
+
+    def close(self, timeout_s: float = 60.0) -> None:
+        """Drain the whole fleet. Dead replicas close FIRST so their
+        queued riders re-route into still-open healthy ones — the
+        no-drop drain contract holds fleet-wide."""
+        for r in sorted(self.replicas, key=lambda r: not r.dead):
+            r.close(timeout_s=timeout_s)
+
+    # -- chaos / operator actions -----------------------------------------
+
+    def _resolve(self, which) -> Replica:
+        if isinstance(which, Replica):
+            return which
+        if isinstance(which, str):
+            for r in self.replicas:
+                if r.replica_id == which:
+                    return r
+            raise KeyError(f"no replica {which!r}")
+        return self.replicas[int(which)]
+
+    def kill(self, which=-1) -> Replica:
+        r = self._resolve(which)
+        r.kill()
+        obs.counter("serving.fleet.kills").inc()
+        obs.event("replica_kill", replica=r.replica_id)
+        return r
+
+    def revive(self, which=-1) -> Replica:
+        r = self._resolve(which)
+        r.revive()
+        obs.event("replica_revive", replica=r.replica_id)
+        return r
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        return sum(r.batcher.depth for r in self.replicas)
+
+    def snapshot(self) -> List[dict]:
+        return [r.snapshot() for r in self.replicas]

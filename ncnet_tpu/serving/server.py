@@ -42,7 +42,12 @@ from ..obs import trace
 from ..reliability import failpoints
 from ..reliability.breaker import BreakerOpenError, CircuitBreaker
 from ..reliability.failpoints import InjectedFault
-from .batcher import DeadlineBatcher, PoisonRequestError, RejectedError
+from .batcher import (
+    DeadlineBatcher,
+    PoisonRequestError,
+    RejectedError,
+    ReplicaDeadError,
+)
 from .engine import MatchEngine
 
 #: Grace added past a request's deadline before the handler gives up
@@ -71,7 +76,19 @@ class MatchServer:
         replica_id: Optional[str] = None,
         slo_specs=None,
         slo_p99_target_s: float = 0.5,
+        fleet=None,
     ):
+        """``fleet``: a started-or-startable serving/fleet.MatchFleet.
+        When set, the server fronts the fleet's dispatcher instead of
+        building its own breaker + batcher (each replica owns those;
+        ``max_batch``/``max_queue``/... and ``breaker_*`` here are
+        ignored — configure them per replica via MatchFleet.build), and
+        ``engine`` may be None (host-side prepare uses replica 0's
+        engine; the shared feature store makes its cache probe valid
+        fleet-wide). The single-engine path is unchanged."""
+        self.fleet = fleet
+        if fleet is not None and engine is None:
+            engine = fleet.replicas[0].engine
         self.engine = engine
         self.run_log = run_log
         # Fleet identity: explicit ctor arg > --replica_id /
@@ -82,29 +99,42 @@ class MatchServer:
         rid = replica_id if replica_id is not None else obs.replica_id()
         self.replica_id = str(rid) if rid else None
         self.labels = {"replica": self.replica_id} if self.replica_id else {}
-        if self.labels and not getattr(engine, "labels", None):
+        if (self.labels and engine is not None
+                and not getattr(engine, "labels", None)):
             engine.labels = dict(self.labels)
-        # The breaker guards every device dispatch — including the
-        # sub-batches of a poison bisection, since the batcher calls
-        # this same runner for them: consecutive dispatch failures
-        # (dead device, compile storm) open it and the front door turns
-        # requests away with 503 + Retry-After instead of queueing work
-        # that cannot succeed (docs/RELIABILITY.md).
-        self.breaker = CircuitBreaker(
-            failure_threshold=breaker_threshold,
-            reset_timeout_s=breaker_reset_s,
-            labels=self.labels,
-        )
-        self.batcher = DeadlineBatcher(
-            self.breaker_runner(engine.run_batch),
-            max_batch=max_batch,
-            max_queue=max_queue,
-            max_delay_s=max_delay_s,
-            deadline_slack_s=deadline_slack_s,
-            default_timeout_s=default_timeout_s,
-            isolate_poison=isolate_poison,
-            labels=self.labels,
-        )
+        self._default_timeout_s = float(default_timeout_s)
+        if fleet is not None:
+            # Fleet mode: per-replica breakers/batchers live inside the
+            # fleet; the dispatcher is the submit target and the
+            # front-door health authority.
+            self.breaker = None
+            self.batcher = None
+            self.dispatcher = fleet.dispatcher
+            self._default_timeout_s = float(
+                fleet.replicas[0].batcher.default_timeout_s)
+        else:
+            # The breaker guards every device dispatch — including the
+            # sub-batches of a poison bisection, since the batcher calls
+            # this same runner for them: consecutive dispatch failures
+            # (dead device, compile storm) open it and the front door
+            # turns requests away with 503 + Retry-After instead of
+            # queueing work that cannot succeed (docs/RELIABILITY.md).
+            self.breaker = CircuitBreaker(
+                failure_threshold=breaker_threshold,
+                reset_timeout_s=breaker_reset_s,
+                labels=self.labels,
+            )
+            self.batcher = DeadlineBatcher(
+                self.breaker_runner(engine.run_batch),
+                max_batch=max_batch,
+                max_queue=max_queue,
+                max_delay_s=max_delay_s,
+                deadline_slack_s=deadline_slack_s,
+                default_timeout_s=default_timeout_s,
+                isolate_poison=isolate_poison,
+                labels=self.labels,
+            )
+            self.dispatcher = None
         # Standing SLOs (obs/slo.py), evaluated lazily on /healthz and
         # /metrics reads behind a 1 s floor — no extra thread, and a
         # scrape storm cannot turn burn math into load. slo_specs=()
@@ -202,6 +232,48 @@ class MatchServer:
         """
         hb = self.run_log.heartbeat if self.run_log is not None else None
         stalled = bool(hb.in_stall) if hb is not None else False
+        if self.fleet is not None:
+            # Fleet health: the server stays routable while ANY replica
+            # is (the dispatcher steers around the rest); `recovering`
+            # (200) flags partial capacity to a balancer, `degraded`
+            # (503) means no replica can take work.
+            snap = self.fleet.snapshot()
+            healthy = sum(1 for s in snap if s["healthy"])
+            if self._draining:
+                status, code = "draining", 503
+            elif stalled:
+                status, code = "stalled", 503
+            elif healthy == 0:
+                status, code = "degraded", 503
+            elif healthy < len(snap):
+                status, code = "recovering", 200
+            else:
+                status, code = "ok", 200
+            payload = {
+                "status": status,
+                "uptime_s": round(time.monotonic() - self.t_start, 3),
+                "queue_depth": self.fleet.depth,
+                "fleet": {"size": len(snap), "healthy": healthy,
+                          "replicas": snap},
+            }
+            if self.replica_id:
+                payload["replica"] = self.replica_id
+            slo = self.slo_status()
+            if slo:
+                payload["slo"] = {
+                    name: {
+                        "budget_remaining_frac": r["budget_remaining_frac"],
+                        "burn_fast": r["burn_fast"],
+                        "burn_slow": r["burn_slow"],
+                        "paging": r["paging"],
+                    }
+                    for name, r in slo.items()
+                }
+            fps = failpoints.active()
+            if fps:
+                payload["failpoints"] = {
+                    s: fp.mode for s, fp in fps.items()}
+            return code, payload
         br = self.breaker.snapshot()
         if self._draining:
             status, code = "draining", 503
@@ -262,10 +334,12 @@ class MatchServer:
     def _handle_match_traced(self, handler, root):
         t0 = time.monotonic()
         obs.counter("serving.requests", labels=self.labels).inc()
-        # Open breaker: reject at the front door — cheapest work a
-        # degraded replica can do, and the Retry-After hint tells
-        # clients when the half-open probe window starts.
-        retry_in = self.breaker.admit()
+        # Open breaker (or, fleet mode, no healthy replica at all):
+        # reject at the front door — cheapest work a degraded replica
+        # can do, and the Retry-After hint tells clients when the
+        # half-open probe window starts.
+        retry_in = (self.dispatcher.admit() if self.fleet is not None
+                    else self.breaker.admit())
         if retry_in is not None:
             obs.counter("serving.breaker_rejected", labels=self.labels).inc()
             return (
@@ -301,9 +375,21 @@ class MatchServer:
                 obs.counter("serving.bad_requests", labels=self.labels).inc()
                 return 400, {"error": str(exc)}, None
         admit_s = time.monotonic() - t_admit
+        submitter = (self.dispatcher if self.fleet is not None
+                     else self.batcher)
         try:
-            fut = self.batcher.submit(
+            fut = submitter.submit(
                 prepared.bucket_key, prepared, timeout_s=timeout_s
+            )
+        except BreakerOpenError as exc:
+            # Fleet mode: every replica went unhealthy between the
+            # front-door check and the submit (NoHealthyReplicaError).
+            obs.counter("serving.breaker_rejected", labels=self.labels).inc()
+            return (
+                503,
+                {"error": "service degraded (no healthy replica)",
+                 "retry_after_s": round(exc.retry_after_s, 3)},
+                {"Retry-After": f"{exc.retry_after_s:.3f}"},
             )
         except RejectedError as exc:
             obs.event("reject", depth=exc.depth,
@@ -316,7 +402,7 @@ class MatchServer:
         except RuntimeError as exc:  # draining for shutdown
             return 503, {"error": str(exc)}, {"Retry-After": "1"}
         wait_s = (timeout_s if timeout_s is not None
-                  else self.batcher.default_timeout_s) + DEADLINE_GRACE_S
+                  else self._default_timeout_s) + DEADLINE_GRACE_S
         try:
             br = fut.result(timeout=wait_s)
         except FutureTimeoutError:
@@ -332,6 +418,17 @@ class MatchServer:
                 {"error": "service degraded (circuit breaker open)",
                  "retry_after_s": round(exc.retry_after_s, 3)},
                 {"Retry-After": f"{exc.retry_after_s:.3f}"},
+            )
+        except ReplicaDeadError as exc:
+            # Fleet mode: the request's replica was killed and every
+            # re-route alternative was exhausted. The dispatch was
+            # refused, never attempted — retryable 503, accounted.
+            obs.counter("serving.breaker_rejected", labels=self.labels).inc()
+            return (
+                503,
+                {"error": f"replica stopped mid-request: {exc}",
+                 "retry_after_s": 1.0},
+                {"Retry-After": "1"},
             )
         except PoisonRequestError as exc:
             # Bisection isolated THIS request as the poison rider: the
@@ -394,7 +491,10 @@ class MatchServer:
         return f"http://{self.host}:{self.port}"
 
     def start(self) -> "MatchServer":
-        self.batcher.start()
+        if self.fleet is not None:
+            self.fleet.start()
+        else:
+            self.batcher.start()
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="serving-http", daemon=True
         )
@@ -408,13 +508,18 @@ class MatchServer:
         with 503 for the whole window so a balancer stops routing here
         before the listener disappears."""
         self._draining = True
-        self.batcher.close()
+        if self.fleet is not None:
+            self.fleet.close()
+        else:
+            self.batcher.close()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=10)
             self._serve_thread = None
-        obs.event("serving_stop", queue_depth=self.batcher.depth)
+        depth = (self.fleet.depth if self.fleet is not None
+                 else self.batcher.depth)
+        obs.event("serving_stop", queue_depth=depth)
 
 
 def _parse_warmup(specs):
@@ -468,9 +573,22 @@ def main(argv=None):
     parser.add_argument("--no_isolate_poison", action="store_true",
                         help="disable poison-batch bisection (a failed "
                         "shared batch fails every rider)")
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="serve a replica fleet: one engine per "
+                        "device, least-loaded dispatch, per-replica "
+                        "breakers, shared feature store "
+                        "(0 = single-engine legacy path; N > device "
+                        "count round-robins devices)")
     parser.add_argument("--cache_mb", type=int, default=2048,
                         help="pano feature cache budget (0 disables)")
     parser.add_argument("--cache_dir", type=str, default="")
+    parser.add_argument(
+        "--prewarm", action="append", default=[],
+        help="glob of server-readable pano paths to probe against the "
+        "feature store's disk tier at startup (repeatable; fleet mode "
+        "with --cache_mb > 0): warm entries promote into the shared "
+        "memory LRU before the first request",
+    )
     parser.add_argument(
         "--warmup", action="append", default=[],
         help="precompile a bucket at startup: qHxqW:pHxpW[:b1,b2] raw "
@@ -503,19 +621,71 @@ def main(argv=None):
         half_precision=True,
         backbone_bf16=True,
     )
-    engine = MatchEngine(
-        config, params,
+    fleet = engine = None
+    engine_kwargs = dict(
         k_size=args.k_size,
         image_size=args.image_size,
         feat_unit=args.feat_unit,
-        cache_mb=args.cache_mb,
-        cache_dir=args.cache_dir,
-        cache_model_key=model_cache_key(args.checkpoint, seed=1),
     )
-    if args.warmup:
-        shapes, batches = _parse_warmup(args.warmup)
-        n = engine.warmup(shapes, batch_sizes=batches)
-        print(f"warmup: {n} programs compiled", file=sys.stderr, flush=True)
+    if args.replicas > 0:
+        from .fleet import MatchFleet
+
+        fleet = MatchFleet.build(
+            config, params,
+            n_replicas=args.replicas,
+            base_id=args.replica_id or obs.replica_id() or "",
+            cache_mb=args.cache_mb,
+            cache_dir=args.cache_dir,
+            cache_model_key=model_cache_key(args.checkpoint, seed=1),
+            engine_kwargs=engine_kwargs,
+            replica_kwargs=dict(
+                max_batch=args.max_batch,
+                max_queue=args.max_queue,
+                max_delay_s=args.max_delay_ms / 1e3,
+                deadline_slack_s=args.deadline_slack_ms / 1e3,
+                default_timeout_s=args.default_timeout_s,
+                breaker_threshold=args.breaker_threshold,
+                breaker_reset_s=args.breaker_reset_s,
+                isolate_poison=not args.no_isolate_poison,
+            ),
+        )
+        print(f"fleet: {len(fleet.replicas)} replicas over "
+              f"{len({r.engine.device for r in fleet.replicas})} devices",
+              file=sys.stderr, flush=True)
+        if args.warmup:
+            shapes, batches = _parse_warmup(args.warmup)
+            n = fleet.warmup(shapes, batch_sizes=batches)
+            print(f"warmup: {n} programs compiled (fleet-wide)",
+                  file=sys.stderr, flush=True)
+        if args.prewarm and fleet.store is not None:
+            import glob as _glob
+
+            paths = sorted(
+                p for pat in args.prewarm for p in _glob.glob(pat))
+
+            def _bucket(path, _eng=fleet.replicas[0].engine):
+                from PIL import Image
+
+                with Image.open(path) as im:  # header-only dims read
+                    w, h = im.size
+                return _eng._resize_shape(h, w)
+
+            warm = fleet.store.prewarm(paths, _bucket)
+            print(f"prewarm: {warm}/{len(paths)} panos warm from disk",
+                  file=sys.stderr, flush=True)
+    else:
+        engine = MatchEngine(
+            config, params,
+            cache_mb=args.cache_mb,
+            cache_dir=args.cache_dir,
+            cache_model_key=model_cache_key(args.checkpoint, seed=1),
+            **engine_kwargs,
+        )
+        if args.warmup:
+            shapes, batches = _parse_warmup(args.warmup)
+            n = engine.warmup(shapes, batch_sizes=batches)
+            print(f"warmup: {n} programs compiled", file=sys.stderr,
+                  flush=True)
 
     # Chaos arming (NCNET_FAILPOINTS) happens at failpoints import; the
     # explicit re-read here makes `main` honest under embedding (a test
@@ -539,6 +709,7 @@ def main(argv=None):
         run_log=run_log,
         slo_specs=() if args.no_slo else None,
         slo_p99_target_s=args.slo_p99_ms / 1e3,
+        fleet=fleet,
     ).start()
     print(f"serving on {server.url}", file=sys.stderr, flush=True)
     try:

@@ -82,6 +82,87 @@ def test_bench_serving_emits_one_json_line(tiny_serving_model, capsys):
     assert rec["errors"] == 0
 
 
+def test_bench_serving_fleet_mode_contract(tiny_serving_model, capsys):
+    """tools/bench_serving.py --replicas N (ISSUE 7 satellite): the
+    weak-scaling fleet bench — in-process 1-replica baseline, then an
+    N-replica fleet at N x the offered rate — prints ONE JSON line with
+    the fleet headline, the per-replica breakdown, and an HONEST
+    scaling_efficiency (structure asserted, not a speedup number: these
+    CPU replicas time-slice one host)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import json as _json
+
+    import bench_serving
+
+    rc = bench_serving.main([
+        "--replicas", "2", "--synthetic", "96x128",
+        "--rate", "4", "--duration_s", "1", "--baseline_duration_s", "1",
+        "--threads", "4", "--max_batch", "2",
+    ], model=tiny_serving_model)
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = _json.loads(lines[0])
+    assert rec["metric"] == "serving_fleet_pairs_per_s"
+    assert rec["unit"] == "pairs/s"
+    assert rec["value"] > 0
+    assert rec["replicas"] == 2
+    assert rec["single_replica_pairs_per_s"] > 0
+    assert rec["scaling_x"] > 0
+    assert rec["scaling_efficiency"] == pytest.approx(
+        rec["scaling_x"] / 2, rel=1e-3)
+    assert rec["errors"] == 0
+    assert rec["sent"] == rec["ok"] + rec["rejected"]
+    # Per-replica accounting: both fleet replicas exist in the
+    # breakdown and their admissions cover every ok request.
+    assert set(rec["per_replica"]) == {"fleet-d0", "fleet-d1"}
+    admitted = sum(v["admitted"] for v in rec["per_replica"].values())
+    assert admitted >= rec["ok"]
+    assert all(v["batches"] >= 0 for v in rec["per_replica"].values())
+    assert rec["redispatched"] == 0  # nobody was killed
+    # The --url and --replicas modes are mutually exclusive.
+    with pytest.raises(SystemExit):
+        bench_serving.main(["--url", "http://x", "--replicas", "2",
+                            "--synthetic", "96x128"])
+
+
+def test_chaos_serving_kill_replica_contract(tiny_serving_model, capsys):
+    """tools/chaos_serving.py kill_replica verb (ISSUE 7 satellite): a
+    two-replica fleet with one replica killed mid-window — zero silent
+    drops (the exit gate), the fault log records the window, and the
+    output carries the fleet fields."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import json as _json
+
+    import chaos_serving
+
+    rc = chaos_serving.main([
+        "--replicas", "2", "--synthetic", "96x128",
+        "--rate", "4", "--duration_s", "2", "--threads", "4",
+        "--max_batch", "2", "--breaker_reset_s", "0.4",
+        "--fault", "kill_replica:0@0.4-1.2",
+    ], model=tiny_serving_model)
+    assert rc == 0, "a nonzero rc means a request was silently dropped"
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = _json.loads(lines[0])
+    assert rec["metric"] == "chaos_serving_survival"
+    assert rec["dropped"] == 0
+    assert rec["replicas"] == 2
+    assert rec["redispatched"] >= 0
+    assert rec["sent"] == 8
+    assert (rec["ok"] + rec["rejected"] + rec["poison"] + rec["errors"]
+            == rec["sent"])
+    assert rec["ok"] >= 1, "the surviving replica kept serving"
+    assert rec["faults"]["kill_replica:0"] == [
+        {"t_s": 0.4, "action": "arm"}, {"t_s": 1.2, "action": "disarm"},
+    ]
+    # kill_replica without a fleet is a usage error, not a hang.
+    with pytest.raises(SystemExit):
+        chaos_serving.main(["--fault", "kill_replica@0.1-0.2"],
+                           model=tiny_serving_model)
+
+
 def test_chaos_serving_emits_one_json_line(tiny_serving_model, capsys):
     """tools/chaos_serving.py stdout contract (ISSUE 5): the chaos
     harness — in-process server, open-loop load, a timed engine.device

@@ -142,3 +142,145 @@ def test_model_cache_key_checkpoint_vs_seed(tmp_path):
 
     os.utime(ck / "params.npz", (time.time() + 5, time.time() + 5))
     assert model_cache_key(str(ck)) != k1  # re-save invalidates
+
+
+# -- disk-tier concurrency (serving fleet regression) --------------------
+
+
+def test_disk_tier_concurrent_writers_and_migration(tmp_path):
+    """Two cache instances sharing one disk_dir (the fleet / multi-
+    process sweep shape) under racing gets and puts — including both
+    racing the SAME legacy-entry migration: every read returns correct
+    values, the legacy file migrates to exactly one versioned entry,
+    and every file on disk stays loadable (no torn writes, no vanished
+    entries)."""
+    import os
+    import threading
+
+    import ml_dtypes
+
+    d = str(tmp_path / "shared")
+    os.makedirs(d)
+    f32 = _feat(7)
+    probe = PanoFeatureCache(max_bytes=4 * 1024 * 1024, disk_dir=d,
+                             model_key="m",
+                             store_dtype=ml_dtypes.bfloat16)
+    # Plant a pre-bf16 legacy entry (raw untagged f32 npz).
+    legacy = probe._legacy_disk_path(probe._key("pano_legacy", (8, 8)))
+    with open(legacy, "wb") as fh:
+        np.savez(fh, feats=f32)
+    expect = f32.astype(ml_dtypes.bfloat16)
+
+    caches = [PanoFeatureCache(max_bytes=2 * 1024 * 1024, disk_dir=d,
+                               model_key="m",
+                               store_dtype=ml_dtypes.bfloat16)
+              for _ in range(2)]
+    errors = []
+
+    def work(c):
+        try:
+            for i in range(10):
+                got = c.get("pano_legacy", (8, 8))
+                assert got is not None, "legacy entry vanished mid-race"
+                np.testing.assert_array_equal(np.asarray(got), expect)
+                key = f"pano{i % 4}"
+                if c.get(key, (8, 8)) is None:
+                    c.put(key, (8, 8), _feat(i % 4))
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=work, args=(c,))
+               for c in caches for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+    # The migration landed exactly once: versioned entry present and
+    # tagged, the legacy file gone.
+    new_path = probe._disk_path(probe._key("pano_legacy", (8, 8)))
+    assert os.path.exists(new_path) and not os.path.exists(legacy)
+    with np.load(new_path) as z:
+        assert str(z["dtype"][()]) == "bfloat16"
+        np.testing.assert_array_equal(
+            z["feats"].view(ml_dtypes.bfloat16), expect)
+    # Every racing writer's entry loads clean from a fresh instance.
+    fresh = PanoFeatureCache(max_bytes=8 * 1024 * 1024, disk_dir=d,
+                             model_key="m",
+                             store_dtype=ml_dtypes.bfloat16)
+    for i in range(4):
+        got = fresh.get(f"pano{i}", (8, 8))
+        assert got is not None
+        np.testing.assert_array_equal(
+            np.asarray(got), _feat(i).astype(ml_dtypes.bfloat16))
+    assert not [p for p in os.listdir(d) if p.endswith(".tmp")], \
+        "torn temp files left behind"
+
+
+# -- SharedFeatureStore (serving/feature_store.py) -----------------------
+
+
+def test_shared_store_content_addressed_identity(tmp_path):
+    from ncnet_tpu.serving.feature_store import SharedFeatureStore
+
+    store = SharedFeatureStore(8 * 1024 * 1024, model_key="m")
+    p1, p2 = tmp_path / "a.bin", tmp_path / "b.bin"
+    p1.write_bytes(b"x" * 100)
+    p2.write_bytes(b"x" * 100)  # same bytes, different path
+    f = _feat(0)
+    store.put(str(p1), (8, 8), f)
+    got = store.get(str(p2), (8, 8))
+    assert got is not None, "byte-identical copy missed"
+    np.testing.assert_array_equal(got, f)
+    assert store.hits == 1 and store.misses == 0
+
+    p3 = tmp_path / "c.bin"
+    p3.write_bytes(b"y" * 100)  # same size, different content
+    assert store.get(str(p3), (8, 8)) is None
+    assert store.misses == 1
+    # Unreadable path: literal-path fallback, a miss, never a crash.
+    assert store.get(str(tmp_path / "ghost.bin"), (8, 8)) is None
+
+
+def test_shared_store_rehashes_on_content_change(tmp_path):
+    import os
+
+    from ncnet_tpu.serving.feature_store import SharedFeatureStore
+
+    store = SharedFeatureStore(8 * 1024 * 1024, model_key="m")
+    p = tmp_path / "a.bin"
+    p.write_bytes(b"x" * 100)
+    os.utime(p, ns=(1_000_000_000, 1_000_000_000))
+    store.put(str(p), (8, 8), _feat(0))
+    assert store.get(str(p), (8, 8)) is not None
+    # New content under the SAME path and size: the (size, mtime_ns)
+    # memo signature changes, the store re-hashes, the old entry no
+    # longer answers for this path.
+    p.write_bytes(b"z" * 100)
+    os.utime(p, ns=(2_000_000_000, 2_000_000_000))
+    assert store.get(str(p), (8, 8)) is None
+
+
+def test_shared_store_prewarm_promotes_disk_tier(tmp_path):
+    from ncnet_tpu.serving.feature_store import SharedFeatureStore
+
+    d = str(tmp_path / "disk")
+    pano = tmp_path / "a.bin"
+    pano.write_bytes(b"x" * 100)
+    cold = tmp_path / "cold.bin"
+    cold.write_bytes(b"q" * 100)
+
+    seed = SharedFeatureStore(8 * 1024 * 1024, disk_dir=d, model_key="m")
+    seed.put(str(pano), (8, 8), _feat(0))
+
+    # A fresh store (restarted server) sharing the disk dir: prewarm
+    # promotes the on-disk entry into memory, misses compute nothing.
+    store = SharedFeatureStore(8 * 1024 * 1024, disk_dir=d, model_key="m")
+    warm = store.prewarm([str(pano), str(cold), str(tmp_path / "nope")],
+                         lambda path: (8, 8))
+    assert warm == 1
+    assert store.disk_hits == 1 and store.nbytes > 0
+    got = store.get(str(pano), (8, 8))
+    np.testing.assert_array_equal(got, _feat(0))
+    assert store.disk_hits == 1  # second get served from memory

@@ -1,0 +1,364 @@
+"""Fleet dispatcher + replica pool (serving/dispatcher.py, fleet.py).
+
+Two layers:
+
+* fake-clock unit suite — threadless Replicas around echo runners,
+  driven by ``batcher.poll()``: least-loaded routing, tie rotation,
+  unhealthy exclusion (killed replica, open breaker), whole-fleet-down
+  (NoHealthyReplicaError IS a BreakerOpenError), full-queue rejection,
+  re-route on kill (the zero-silent-drops mechanism), redispatch
+  exhaustion, and the drain-on-shutdown no-drop contract;
+* a two-replica CPU e2e over the real engine stack asserting the
+  tentpole's shared-feature-store claim: a pano computed by one replica
+  is a cache hit on the other (content-addressed, so a byte-identical
+  copy under a different path hits too).
+"""
+
+import io
+import threading
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.reliability.breaker import BreakerOpenError
+from ncnet_tpu.serving.batcher import RejectedError, ReplicaDeadError
+from ncnet_tpu.serving.dispatcher import (
+    FleetDispatcher,
+    NoHealthyReplicaError,
+)
+from ncnet_tpu.serving.fleet import MatchFleet, Replica
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _echo(bucket_key, batch):
+    return [{"payload": p, "bucket": bucket_key} for p in batch]
+
+
+def _make_pool(n, clock, runner=_echo, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_queue", 4)
+    kw.setdefault("max_delay_s", 0.05)
+    return [Replica(f"r{i}", runner=runner, clock=clock, **kw)
+            for i in range(n)]
+
+
+def _poll_all(replicas):
+    """One synchronous device round across the pool; returns batches run."""
+    return sum(r.batcher.poll() for r in replicas)
+
+
+def test_least_loaded_routing():
+    clock = FakeClock()
+    pool = _make_pool(3, clock)
+    disp = FleetDispatcher(pool)
+    # Load r1 with two queued requests, r2 with one; r0 idle.
+    pool[1].submit("b", "x1")
+    pool[1].submit("b", "x2")
+    pool[2].submit("b", "x3")
+    assert [r.load for r in pool] == [0, 2, 1]
+    assert disp.pick().replica_id == "r0"
+    # Route through the dispatcher: r0 takes it (still the least
+    # loaded), and its load signal reflects the admission.
+    fut = disp.submit("b", "y")
+    assert pool[0].load == 1
+    clock.t += 0.1
+    assert _poll_all(pool) > 0
+    assert fut.result(timeout=1).result["payload"] == "y"
+
+
+def test_idle_tie_rotation_spreads_picks():
+    clock = FakeClock()
+    pool = _make_pool(4, clock)
+    disp = FleetDispatcher(pool)
+    # All loads equal (idle): successive picks must not dog-pile one
+    # replica — the rotation makes an idle fleet use all its devices.
+    seen = {disp.pick().replica_id for _ in range(8)}
+    assert len(seen) == len(pool), seen
+
+
+def test_unhealthy_replicas_excluded():
+    clock = FakeClock()
+    pool = _make_pool(3, clock, breaker_threshold=1,
+                      breaker_reset_s=10.0)
+    disp = FleetDispatcher(pool)
+    pool[0].kill()
+    assert not pool[0].healthy
+    # Open r1's breaker with one failed call (threshold 1).
+    with pytest.raises(RuntimeError):
+        pool[1].breaker.call(lambda: (_ for _ in ()).throw(
+            RuntimeError("device died")))
+    assert pool[1].breaker.state == "open"
+    assert not pool[1].healthy
+    for _ in range(6):
+        assert disp.pick().replica_id == "r2"
+    assert [r.replica_id for r in disp.healthy()] == ["r2"]
+    # admit() publishes the healthy-count gauge.
+    assert disp.admit() is None
+    assert obs.gauge("serving.fleet.healthy").value == 1.0
+
+
+def test_no_healthy_replica_is_breaker_open():
+    clock = FakeClock()
+    pool = _make_pool(2, clock)
+    disp = FleetDispatcher(pool)
+    for r in pool:
+        r.kill()
+    hint = disp.admit()
+    assert hint is not None and hint > 0
+    assert obs.gauge("serving.fleet.healthy").value == 0.0
+    with pytest.raises(NoHealthyReplicaError) as exc_info:
+        disp.submit("b", "x")
+    # The server's 503 + Retry-After mapping hinges on this subclassing.
+    assert isinstance(exc_info.value, BreakerOpenError)
+    assert exc_info.value.retry_after_s > 0
+
+
+def test_every_queue_full_rejects():
+    clock = FakeClock()
+    pool = _make_pool(2, clock, max_queue=1)
+    disp = FleetDispatcher(pool)
+    disp.submit("b", "x0")
+    disp.submit("b", "x1")
+    # Fleet capacity = n_replicas x max_queue = 2; the third admission
+    # walks every healthy replica, collects only RejectedErrors, and
+    # surfaces the last one (503 + Retry-After upstream).
+    with pytest.raises(RejectedError):
+        disp.submit("b", "x2")
+    clock.t += 0.1
+    _poll_all(pool)
+
+
+def test_redispatch_on_kill_resolves_on_survivor():
+    clock = FakeClock()
+    pool = _make_pool(2, clock)
+    disp = FleetDispatcher(pool)
+    before = obs.counter("serving.redispatched").value
+    fut = disp.submit("b", "x")
+    victim = next(r for r in pool if r.load > 0)
+    survivor = next(r for r in pool if r is not victim)
+    victim.kill()
+    clock.t += 0.1
+    # The victim's flush refuses the rider (ReplicaDeadError: refused,
+    # never attempted) and the done-callback re-routes it.
+    victim.batcher.poll()
+    assert survivor.load == 1, "rider was not re-routed"
+    clock.t += 0.1  # age the re-routed rider past the flush delay
+    survivor.batcher.poll()
+    assert fut.result(timeout=1).result["payload"] == "x"
+    assert obs.counter("serving.redispatched").value == before + 1
+
+
+def test_redispatch_exhausted_surfaces_refusal():
+    clock = FakeClock()
+    pool = _make_pool(1, clock)
+    disp = FleetDispatcher(pool)  # max_redispatch defaults to n-1 = 0
+    fut = disp.submit("b", "x")
+    pool[0].kill()
+    clock.t += 0.1
+    pool[0].batcher.poll()
+    with pytest.raises(ReplicaDeadError):
+        fut.result(timeout=1)
+
+
+def test_drain_on_shutdown_completes_everything():
+    clock = FakeClock()
+    pool = _make_pool(3, clock)
+    disp = FleetDispatcher(pool)
+    futs = [disp.submit("b", f"x{i}") for i in range(6)]
+    # Threadless close: drains every partial bucket on the caller — the
+    # fleet-wide no-drop contract.
+    disp.close()
+    for i, fut in enumerate(futs):
+        assert fut.result(timeout=1).result["payload"] == f"x{i}"
+    with pytest.raises((NoHealthyReplicaError, RuntimeError)):
+        disp.submit("b", "late")
+
+
+def test_dead_replicas_drain_first_so_riders_reroute():
+    clock = FakeClock()
+    pool = _make_pool(2, clock)
+    fleet = MatchFleet(pool)
+    fut = fleet.dispatcher.submit("b", "x")
+    victim = next(r for r in pool if r.load > 0)
+    fleet.kill(victim.replica_id)
+    # close() drains the dead replica FIRST: its refusal re-routes the
+    # rider into the still-open survivor, which then completes it.
+    fleet.close()
+    assert fut.result(timeout=1).result["payload"] == "x"
+
+
+def test_fleet_kill_revive_and_snapshot():
+    clock = FakeClock()
+    pool = _make_pool(2, clock)
+    fleet = MatchFleet(pool)
+    kills0 = obs.counter("serving.fleet.kills").value
+    r = fleet.kill(1)
+    assert r.replica_id == "r1" and r.dead
+    assert obs.counter("serving.fleet.kills").value == kills0 + 1
+    snap = {s["replica"]: s for s in fleet.snapshot()}
+    assert snap["r1"]["dead"] and not snap["r1"]["healthy"]
+    assert snap["r0"]["healthy"]
+    fleet.revive("r1")
+    assert not fleet._resolve("r1").dead
+    assert all(s["healthy"] for s in fleet.snapshot())
+
+
+# -- two-replica CPU e2e: shared feature store across the fleet ----------
+
+
+def _jpeg_bytes(h, w, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    img = Image.fromarray((rng.random((h, w, 3)) * 255).astype("uint8"))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_two_replica_fleet_shares_feature_store(tiny_serving_model,
+                                                tmp_path):
+    """The tentpole's cache claim, end to end over HTTP: replica A's
+    pano backbone work is replica B's cache hit, and the store's
+    content-addressed keys make a byte-identical copy under a DIFFERENT
+    path hit without a recompute."""
+    from ncnet_tpu.serving.client import MatchClient
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    pano_path = str(tmp_path / "pano_a.jpg")
+    with open(pano_path, "wb") as fh:
+        fh.write(_jpeg_bytes(96, 128, 1))
+
+    fleet = MatchFleet.build(
+        config, params,
+        n_replicas=2,
+        base_id="e2e",
+        cache_mb=64,
+        cache_model_key="fleet-test",
+        engine_kwargs=dict(k_size=2, image_size=64),
+        replica_kwargs=dict(max_batch=2, max_delay_s=0.01,
+                            default_timeout_s=120.0),
+    )
+    store = fleet.store
+    assert store is not None
+    rids = [r.replica_id for r in fleet.replicas]
+    assert rids == ["e2e-d0", "e2e-d1"]
+    batches0 = {
+        rid: obs.counter("serving.batches", labels={"replica": rid}).value
+        for rid in rids
+    }
+    server = MatchServer(None, port=0, fleet=fleet,
+                         slo_p99_target_s=60.0).start()
+    try:
+        client = MatchClient(server.url, timeout_s=120.0, retries=0)
+        hz = client.healthz()
+        assert hz["status"] == "ok"
+        assert hz["fleet"]["size"] == 2 and hz["fleet"]["healthy"] == 2
+
+        kwargs = dict(query_bytes=_jpeg_bytes(96, 128, 0),
+                      pano_path=pano_path, max_matches=8)
+        first = client.match(**kwargs)
+        assert first["n_matches"] >= 1
+        assert store.misses == 1 and store.hits == 0
+
+        # Sequential requests against an idle fleet rotate across the
+        # replicas — every later request rides the shared store's entry
+        # no matter which replica serves it.
+        results = [client.match(**kwargs) for _ in range(5)]
+        assert store.hits >= 5 and store.misses == 1
+        for resp in results:
+            assert resp["n_matches"] >= 1
+            assert np.allclose(resp["matches"], results[0]["matches"],
+                               atol=1e-3)
+        served = {
+            rid: obs.counter("serving.batches",
+                             labels={"replica": rid}).value - batches0[rid]
+            for rid in rids
+        }
+        assert all(v >= 1 for v in served.values()), \
+            f"idle-fleet rotation left a replica cold: {served}"
+
+        # Content addressing: the same bytes under a NEW path hit
+        # without a recompute (identity = sha256 of file content).
+        pano_copy = str(tmp_path / "pano_b.jpg")
+        with open(pano_copy, "wb") as fh:
+            fh.write(open(pano_path, "rb").read())
+        misses_before = store.misses
+        copy_resp = client.match(**dict(kwargs, pano_path=pano_copy))
+        assert copy_resp["n_matches"] >= 1
+        assert store.misses == misses_before, \
+            "byte-identical pano under a new path recomputed"
+
+        # Kill one replica: the server stays routable (recovering, 200)
+        # and requests keep succeeding on the survivor.
+        fleet.kill("e2e-d1")
+        hz = client.healthz()
+        assert hz["status"] == "recovering"
+        assert hz["fleet"]["healthy"] == 1
+        assert client.match(**kwargs)["n_matches"] >= 1
+        fleet.revive("e2e-d1")
+        assert client.healthz()["status"] == "ok"
+    finally:
+        server.stop()
+
+
+def test_fleet_build_validates_and_round_robins(tiny_serving_model):
+    """n_replicas > device count round-robins devices (the CPU smoke
+    posture); serving_devices(n) refuses n beyond the host."""
+    import jax
+
+    from ncnet_tpu.parallel import serving_devices
+
+    devs = serving_devices()
+    assert [d.id for d in devs] == sorted(d.id for d in devs)
+    assert len(devs) == len(jax.local_devices())
+    with pytest.raises(ValueError):
+        serving_devices(len(devs) + 1)
+
+    config, params = tiny_serving_model
+    fleet = MatchFleet.build(
+        config, params, n_replicas=3,
+        engine_kwargs=dict(k_size=2, image_size=64),
+    )
+    assert [r.replica_id for r in fleet.replicas] == ["d0", "d1", "d2"]
+    seen = {r.engine.device for r in fleet.replicas}
+    assert len(seen) <= len(devs)
+    assert all(r.engine.device is not None for r in fleet.replicas)
+
+
+def test_dispatcher_thread_safety_under_concurrent_submit():
+    """Many submitting threads against a started (threaded) pool: every
+    future resolves, nothing drops, accounting adds up."""
+    clock = None  # real clock — threaded replicas need monotonic time
+    pool = [Replica(f"t{i}", runner=_echo, max_batch=4, max_queue=64,
+                    max_delay_s=0.005).start() for i in range(3)]
+    disp = FleetDispatcher(pool)
+    futs = []
+    lock = threading.Lock()
+
+    def submitter(k):
+        for j in range(10):
+            f = disp.submit("b", f"{k}-{j}")
+            with lock:
+                futs.append(f)
+
+    threads = [threading.Thread(target=submitter, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = [f.result(timeout=30) for f in futs]
+    assert len(results) == 40
+    assert {r.result["payload"] for r in results} \
+        == {f"{k}-{j}" for k in range(4) for j in range(10)}
+    disp.close()

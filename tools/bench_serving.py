@@ -30,6 +30,25 @@ Example (CPU smoke)::
     python -m ncnet_tpu.serving.server --port 8123 --image_size 64 &
     python tools/bench_serving.py --url http://127.0.0.1:8123 \
         --synthetic 96x128 --rate 4 --duration_s 5
+
+**Fleet mode** (``--replicas N``, mutually exclusive with ``--url``):
+spins up TWO in-process fleets — a 1-replica baseline at ``--rate``,
+then N replicas at ``--rate x N`` (weak scaling: offered load grows
+with capacity, so a fleet that keeps up IS the scaling evidence) — and
+prints one line with the fleet headline::
+
+    {"metric": "serving_fleet_pairs_per_s", "value": ..., "unit":
+     "pairs/s", "replicas": N, "single_replica_pairs_per_s": ...,
+     "scaling_x": ..., "scaling_efficiency": ..., "per_replica":
+     {"fleet-d0": {"admitted": ..., "batches": ...}, ...}, ...}
+
+``scaling_efficiency`` = scaling_x / N is reported HONESTLY: on a
+single-core CPU host the replicas time-slice one core and efficiency
+lands near 1/N; the >= 0.75 deployments should gate on needs one real
+device per replica (``parallel.serving_devices``).
+
+    python tools/bench_serving.py --replicas 8 --synthetic 96x128 \
+        --rate 2 --duration_s 5
 """
 
 from __future__ import annotations
@@ -74,67 +93,19 @@ def synth_jpegs(spec, seed=0):
     return out
 
 
-def main(argv=None):
-    parser = argparse.ArgumentParser(
-        description="open-loop load generator for the matching service"
-    )
-    parser.add_argument("--url", type=str, required=True)
-    parser.add_argument("--rate", type=float, default=8.0,
-                        help="open-loop arrival rate, requests/s")
-    parser.add_argument("--duration_s", type=float, default=10.0)
-    parser.add_argument("--threads", type=int, default=16,
-                        help="worker pool size (bounds in-flight requests)")
-    parser.add_argument("--query", type=str, default="",
-                        help="server-readable query image path")
-    parser.add_argument("--pano", type=str, default="",
-                        help="server-readable pano image path")
-    parser.add_argument("--synthetic", type=str, default="",
-                        help="HxW: generate random images, send inline b64")
-    parser.add_argument("--deadline_ms", type=float, default=0.0,
-                        help="per-request deadline (0 = server default)")
-    parser.add_argument("--max_matches", type=int, default=16)
-    parser.add_argument("--no_retry", action="store_true",
-                        help="count 503s as rejected instead of retrying")
-    parser.add_argument("--slo_availability", type=float, default=0.999,
-                        help="availability objective for the SLO summary")
-    parser.add_argument("--slo_p99_ms", type=float, default=0.0,
-                        help="p99 latency target for the SLO summary "
-                             "(0 = no latency gate)")
-    parser.add_argument("--slo_strict", action="store_true",
-                        help="exit 1 when the run misses its SLOs")
-    args = parser.parse_args(argv)
-    if bool(args.synthetic) == bool(args.query and args.pano):
-        parser.error("pass either --synthetic HxW or both --query/--pano")
+def run_load(client, kwargs, rate, duration_s, threads):
+    """Open-loop load against one client: request i fires at t0 + i/rate
+    regardless of completions (closed-loop clients hide queueing
+    collapse by slowing down with the server). Returns
+    ``{counts, lat_ms (sorted), batch_sizes, elapsed, n_requests}`` —
+    shared by the URL mode and both fleet-bench phases."""
+    from ncnet_tpu.serving.client import OverCapacityError, ServingError
 
-    import os
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from ncnet_tpu.serving.client import (
-        MatchClient,
-        OverCapacityError,
-        ServingError,
-    )
-
-    kwargs = {"max_matches": args.max_matches}
-    if args.deadline_ms > 0:
-        kwargs["deadline_ms"] = args.deadline_ms
-    if args.synthetic:
-        q_bytes, p_bytes = synth_jpegs(args.synthetic)
-        kwargs.update(query_bytes=q_bytes, pano_bytes=p_bytes)
-    else:
-        kwargs.update(query_path=args.query, pano_path=args.pano)
-
-    client = MatchClient(args.url, retries=0 if args.no_retry else 2)
-    health = client.healthz()
-    note(f"healthz: {health}")
-
-    n_requests = max(1, int(args.rate * args.duration_s))
+    n_requests = max(1, int(rate * duration_s))
     lock = threading.Lock()
     lat_ms, batch_sizes = [], []
     counts = {"sent": 0, "ok": 0, "rejected": 0, "errors": 0,
               "deadline_exceeded": 0}
-    # Open loop: request i fires at t0 + i/rate regardless of completions.
     # A schedule index handed out under the lock keeps workers from
     # coordinating on anything but the wall clock.
     sched = {"next": 0}
@@ -147,7 +118,7 @@ def main(argv=None):
                 if i >= n_requests:
                     return
                 sched["next"] = i + 1
-            due = t0 + i / args.rate
+            due = t0 + i / rate
             delay = due - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
@@ -176,19 +147,221 @@ def main(argv=None):
                 lat_ms.append(dt_ms)
                 batch_sizes.append(resp.get("batch_size", 1))
 
-    threads = [
+    workers = [
         threading.Thread(target=worker, daemon=True)
-        for _ in range(min(args.threads, n_requests))
+        for _ in range(min(threads, n_requests))
     ]
-    note(f"load: {n_requests} requests at {args.rate}/s open-loop, "
-         f"{len(threads)} workers")
-    for t in threads:
+    note(f"load: {n_requests} requests at {rate:g}/s open-loop, "
+         f"{len(workers)} workers")
+    for t in workers:
         t.start()
-    for t in threads:
+    for t in workers:
         t.join()
-    elapsed = time.monotonic() - t0
-
     lat_ms.sort()
+    return {"counts": counts, "lat_ms": lat_ms,
+            "batch_sizes": batch_sizes,
+            "elapsed": time.monotonic() - t0, "n_requests": n_requests}
+
+
+def fleet_bench(args, model=None):
+    """Two-phase weak-scaling bench over in-process replica fleets."""
+    from ncnet_tpu import obs
+    from ncnet_tpu.serving.client import MatchClient
+    from ncnet_tpu.serving.fleet import MatchFleet
+    from ncnet_tpu.serving.server import MatchServer
+
+    if model is None:
+        from ncnet_tpu.cli.common import build_model
+
+        note("building tiny model (pass model= to reuse one in-process)")
+        model = build_model(
+            ncons_kernel_sizes=(3, 3),
+            ncons_channels=(16, 1),
+            relocalization_k_size=2,
+            half_precision=True,
+            backbone_bf16=True,
+        )
+    config, params = model
+    h, w = (int(v) for v in args.synthetic.split("x"))
+    q_bytes, p_bytes = synth_jpegs(args.synthetic)
+    kwargs = {"query_bytes": q_bytes, "pano_bytes": p_bytes,
+              "max_matches": args.max_matches}
+
+    def phase(n_replicas, base_id, rate, duration_s):
+        timeout_s = max(duration_s * 4, 60.0)
+        fleet = MatchFleet.build(
+            config, params,
+            n_replicas=n_replicas,
+            base_id=base_id,
+            cache_mb=0,  # inline-b64 payloads never touch the store
+            engine_kwargs=dict(k_size=2, image_size=args.image_size),
+            replica_kwargs=dict(
+                max_batch=args.max_batch,
+                max_delay_s=args.max_delay_ms / 1e3,
+                default_timeout_s=timeout_s,
+            ),
+        )
+        # Warm the exact buckets the load hits: the bench must measure
+        # serving, not first-request XLA compiles.
+        fleet.warmup([(h, w, h, w)],
+                     batch_sizes=sorted({1, max(1, args.max_batch // 2),
+                                         args.max_batch}))
+        rids = [r.replica_id for r in fleet.replicas]
+        # Counters are process-cumulative; deltas keep repeated
+        # in-process runs (tests call main() directly) honest.
+        before = {
+            rid: (obs.counter("serving.admitted",
+                              labels={"replica": rid}).value,
+                  obs.counter("serving.batches",
+                              labels={"replica": rid}).value)
+            for rid in rids
+        }
+        redisp0 = obs.counter("serving.redispatched").value
+        server = MatchServer(None, port=0, fleet=fleet).start()
+        try:
+            client = MatchClient(server.url, timeout_s=timeout_s,
+                                 retries=0 if args.no_retry else 2)
+            res = run_load(client, kwargs, rate, duration_s, args.threads)
+        finally:
+            server.stop()
+        res["per_replica"] = {
+            rid: {
+                "admitted": obs.counter(
+                    "serving.admitted", labels={"replica": rid}
+                ).value - before[rid][0],
+                "batches": obs.counter(
+                    "serving.batches", labels={"replica": rid}
+                ).value - before[rid][1],
+            }
+            for rid in rids
+        }
+        res["redispatched"] = (
+            obs.counter("serving.redispatched").value - redisp0)
+        return res
+
+    base_dur = args.baseline_duration_s or args.duration_s
+    note(f"phase 1/2: baseline — 1 replica at {args.rate:g}/s")
+    base = phase(1, "base", args.rate, base_dur)
+    fleet_rate = args.rate * args.replicas
+    note(f"phase 2/2: fleet — {args.replicas} replicas at "
+         f"{fleet_rate:g}/s (weak scaling)")
+    flt = phase(args.replicas, "fleet", fleet_rate, args.duration_s)
+
+    base_tp = (base["counts"]["ok"] / base["elapsed"]
+               if base["elapsed"] > 0 else 0.0)
+    fleet_tp = (flt["counts"]["ok"] / flt["elapsed"]
+                if flt["elapsed"] > 0 else 0.0)
+    scaling_x = fleet_tp / base_tp if base_tp > 0 else None
+    lat = flt["lat_ms"]
+    counts = flt["counts"]
+    rec = {
+        "metric": "serving_fleet_pairs_per_s",
+        "value": round(fleet_tp, 4),
+        "unit": "pairs/s",
+        "replicas": args.replicas,
+        "single_replica_pairs_per_s": round(base_tp, 4),
+        "scaling_x": round(scaling_x, 4) if scaling_x is not None else None,
+        "scaling_efficiency": round(scaling_x / args.replicas, 4)
+        if scaling_x is not None else None,
+        "latency_ms": {
+            "p50": round(percentile(lat, 50), 3) if lat else None,
+            "p95": round(percentile(lat, 95), 3) if lat else None,
+            "p99": round(percentile(lat, 99), 3) if lat else None,
+        },
+        "sent": counts["sent"],
+        "ok": counts["ok"],
+        "rejected": counts["rejected"],
+        "errors": counts["errors"],
+        "deadline_exceeded": counts["deadline_exceeded"],
+        "redispatched": flt["redispatched"],
+        "per_replica": flt["per_replica"],
+        "duration_s": round(flt["elapsed"], 3),
+    }
+    print(json.dumps(rec), flush=True)
+    bad = counts["errors"] + base["counts"]["errors"]
+    return 0 if bad == 0 else 1
+
+
+def main(argv=None, model=None):
+    parser = argparse.ArgumentParser(
+        description="open-loop load generator for the matching service"
+    )
+    parser.add_argument("--url", type=str, default="",
+                        help="target server (mutually exclusive with "
+                             "--replicas)")
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="fleet mode: bench an in-process N-replica "
+                             "fleet vs a 1-replica baseline (weak "
+                             "scaling; no --url)")
+    parser.add_argument("--image_size", type=int, default=64,
+                        help="fleet mode: engine bucket image size")
+    parser.add_argument("--max_batch", type=int, default=4,
+                        help="fleet mode: per-replica batch bound")
+    parser.add_argument("--max_delay_ms", type=float, default=50.0,
+                        help="fleet mode: per-replica batching delay")
+    parser.add_argument("--baseline_duration_s", type=float, default=0.0,
+                        help="fleet mode: baseline phase length "
+                             "(0 = --duration_s)")
+    parser.add_argument("--rate", type=float, default=8.0,
+                        help="open-loop arrival rate, requests/s")
+    parser.add_argument("--duration_s", type=float, default=10.0)
+    parser.add_argument("--threads", type=int, default=16,
+                        help="worker pool size (bounds in-flight requests)")
+    parser.add_argument("--query", type=str, default="",
+                        help="server-readable query image path")
+    parser.add_argument("--pano", type=str, default="",
+                        help="server-readable pano image path")
+    parser.add_argument("--synthetic", type=str, default="",
+                        help="HxW: generate random images, send inline b64")
+    parser.add_argument("--deadline_ms", type=float, default=0.0,
+                        help="per-request deadline (0 = server default)")
+    parser.add_argument("--max_matches", type=int, default=16)
+    parser.add_argument("--no_retry", action="store_true",
+                        help="count 503s as rejected instead of retrying")
+    parser.add_argument("--slo_availability", type=float, default=0.999,
+                        help="availability objective for the SLO summary")
+    parser.add_argument("--slo_p99_ms", type=float, default=0.0,
+                        help="p99 latency target for the SLO summary "
+                             "(0 = no latency gate)")
+    parser.add_argument("--slo_strict", action="store_true",
+                        help="exit 1 when the run misses its SLOs")
+    args = parser.parse_args(argv)
+    if bool(args.url) == bool(args.replicas > 0):
+        parser.error("pass exactly one of --url or --replicas N")
+    if bool(args.synthetic) == bool(args.query and args.pano):
+        parser.error("pass either --synthetic HxW or both --query/--pano")
+
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    if args.replicas > 0:
+        if not args.synthetic:
+            parser.error("fleet mode needs --synthetic HxW (inline "
+                         "payloads; the in-process servers have no "
+                         "shared file gallery)")
+        return fleet_bench(args, model=model)
+
+    from ncnet_tpu.serving.client import MatchClient
+
+    kwargs = {"max_matches": args.max_matches}
+    if args.deadline_ms > 0:
+        kwargs["deadline_ms"] = args.deadline_ms
+    if args.synthetic:
+        q_bytes, p_bytes = synth_jpegs(args.synthetic)
+        kwargs.update(query_bytes=q_bytes, pano_bytes=p_bytes)
+    else:
+        kwargs.update(query_path=args.query, pano_path=args.pano)
+
+    client = MatchClient(args.url, retries=0 if args.no_retry else 2)
+    health = client.healthz()
+    note(f"healthz: {health}")
+
+    res = run_load(client, kwargs, args.rate, args.duration_s,
+                   args.threads)
+    counts, lat_ms = res["counts"], res["lat_ms"]
+    batch_sizes, elapsed = res["batch_sizes"], res["elapsed"]
     batched = sum(1 for b in batch_sizes if b > 1)
 
     # SLO summary — the same definitions obs/slo.default_serving_slos

@@ -77,7 +77,7 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
     if best_prior:
         rel = (latest["value"] - best_prior) / best_prior
         regressed = rel < -threshold
-    return {
+    report = {
         "metric": metric,
         "unit": latest.get("unit"),
         "rounds": series,
@@ -89,6 +89,14 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
         "n_rounds": len(parsed),
         "threshold": threshold,
     }
+    # Fleet-bench headlines (tools/bench_serving.py --replicas) carry
+    # the scaling context a raw pairs/s trend is meaningless without —
+    # pass it through so a trend over fleet rounds stays interpretable.
+    for key in ("replicas", "single_replica_pairs_per_s", "scaling_x",
+                "scaling_efficiency"):
+        if key in latest:
+            report[key] = latest[key]
+    return report
 
 
 def main(argv=None) -> int:

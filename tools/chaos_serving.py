@@ -14,6 +14,15 @@ and arms/disarms failpoint windows on a wall-clock schedule::
 ``--failpoints SPEC`` arms a static spec for the whole run. A healthz
 poller records every breaker state change it observes.
 
+With ``--replicas N`` the harness serves an in-process replica FLEET
+(serving/fleet.py) instead of a single engine, and the fault verb
+``kill_replica[:idx]@start-end`` stops that replica for the window
+(revived at ``end``): its queued riders must re-route to the surviving
+replicas within one breaker window — the acceptance check is the same
+``dropped == 0`` exit gate, plus the ``redispatched`` count in the
+output line. ``kill_replica`` requires ``--replicas >= 2`` (someone
+has to be left to re-route to).
+
 Prints ONE JSON line (the repo's bench stdout contract,
 tests/test_bench_contract.py)::
 
@@ -72,12 +81,20 @@ def main(argv=None, model=None):
     parser.add_argument("--breaker_threshold", type=int, default=3)
     parser.add_argument("--breaker_reset_s", type=float, default=1.0)
     parser.add_argument("--no_isolate_poison", action="store_true")
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="serve an in-process N-replica fleet "
+                             "(enables the kill_replica fault verb; "
+                             "0 = single engine)")
     parser.add_argument("--client_retries", type=int, default=2)
     parser.add_argument("--health_poll_s", type=float, default=0.1)
     parser.add_argument("--run_log", type=str, default="",
                         help="structured JSONL run log path (empty disables)")
     args = parser.parse_args(argv)
     windows = [parse_fault_window(s) for s in args.fault]
+    if any(site.startswith("kill_replica") for _, site, _, _ in windows) \
+            and args.replicas < 2:
+        parser.error("kill_replica faults need --replicas >= 2 "
+                     "(survivors to re-route the riders to)")
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -108,20 +125,42 @@ def main(argv=None, model=None):
             backbone_bf16=True,
         )
     config, params = model
-    engine = MatchEngine(config, params, k_size=2,
-                         image_size=args.image_size, cache_mb=0)
     h, w = (int(v) for v in args.synthetic.split("x"))
-    # Warm the exact buckets the load hits: the run must measure the
-    # reliability machinery, not first-request XLA compiles racing the
-    # fault windows.
-    engine.warmup([(h, w, h, w)],
-                  batch_sizes=sorted({1, max(1, args.max_batch // 2),
-                                      args.max_batch}))
+    warm_batches = sorted({1, max(1, args.max_batch // 2),
+                           args.max_batch})
+    fleet = None
+    if args.replicas > 0:
+        from ncnet_tpu.serving.fleet import MatchFleet
+
+        fleet = MatchFleet.build(
+            config, params,
+            n_replicas=args.replicas,
+            base_id="chaos",
+            cache_mb=0,
+            engine_kwargs=dict(k_size=2, image_size=args.image_size),
+            replica_kwargs=dict(
+                max_batch=args.max_batch,
+                max_delay_s=args.max_delay_ms / 1e3,
+                default_timeout_s=max(args.duration_s * 4, 60.0),
+                breaker_threshold=args.breaker_threshold,
+                breaker_reset_s=args.breaker_reset_s,
+                isolate_poison=not args.no_isolate_poison,
+            ),
+        )
+        # Warm the exact buckets the load hits: the run must measure
+        # the reliability machinery, not first-request XLA compiles
+        # racing the fault windows.
+        fleet.warmup([(h, w, h, w)], batch_sizes=warm_batches)
+    else:
+        engine = MatchEngine(config, params, k_size=2,
+                             image_size=args.image_size, cache_mb=0)
+        engine.warmup([(h, w, h, w)], batch_sizes=warm_batches)
     if args.failpoints:
         failpoints.configure(args.failpoints)
         note(f"static failpoints: {sorted(failpoints.active())}")
+    redispatched0 = obs.counter("serving.redispatched").value
     server = MatchServer(
-        engine, port=0,
+        None if fleet is not None else engine, port=0,
         max_batch=args.max_batch,
         max_delay_s=args.max_delay_ms / 1e3,
         default_timeout_s=max(args.duration_s * 4, 60.0),
@@ -129,9 +168,11 @@ def main(argv=None, model=None):
         breaker_reset_s=args.breaker_reset_s,
         isolate_poison=not args.no_isolate_poison,
         run_log=run_log,
+        fleet=fleet,
     ).start()
-    note(f"serving on {server.url}; fault windows: "
-         f"{[(t, a, b) for t, _, a, b in windows]}")
+    note(f"serving on {server.url}"
+         + (f" ({args.replicas} replicas)" if fleet is not None else "")
+         + f"; fault windows: {[(t, a, b) for t, _, a, b in windows]}")
 
     q_bytes, p_bytes = synth_jpegs(args.synthetic)
     kwargs = {"query_bytes": q_bytes, "pano_bytes": p_bytes,
@@ -155,7 +196,18 @@ def main(argv=None, model=None):
             delay = t0 + at - time.monotonic()
             if delay > 0 and stop.wait(delay):
                 return
-            if action == "arm":
+            if site.startswith("kill_replica"):
+                # Fleet verb, not a failpoint: kill_replica[:idx]
+                # stops that replica (default: the last one) for the
+                # window; revive at disarm.
+                idx = int(site.partition(":")[2] or -1)
+                if action == "arm":
+                    r = fleet.kill(idx)
+                    note(f"t+{at:.1f}s killed {r.replica_id}")
+                else:
+                    r = fleet.revive(idx)
+                    note(f"t+{at:.1f}s revived {r.replica_id}")
+            elif action == "arm":
                 fp = failpoints.parse_spec(term)[site]
                 failpoints.registry().set(
                     site, fp.mode, prob=fp.prob, delay_s=fp.delay_s,
@@ -180,7 +232,12 @@ def main(argv=None, model=None):
             except (ServingError, OSError):
                 stop.wait(args.health_poll_s)
                 continue
-            cur = (hz["status"], hz["breaker"]["state"])
+            if "fleet" in hz:
+                detail = (f"healthy={hz['fleet']['healthy']}"
+                          f"/{hz['fleet']['size']}")
+            else:
+                detail = hz["breaker"]["state"]
+            cur = (hz["status"], detail)
             if cur != last:
                 transitions.append({
                     "t_s": round(time.monotonic() - t0, 3),
@@ -266,6 +323,9 @@ def main(argv=None, model=None):
         "poison": counts["poison"],
         "errors": counts["errors"],
         "dropped": dropped,
+        "replicas": args.replicas,
+        "redispatched": (obs.counter("serving.redispatched").value
+                         - redispatched0),
         "latency_ms": {
             "p50": round(percentile(lat_ms, 50), 3) if lat_ms else None,
             "p99": round(percentile(lat_ms, 99), 3) if lat_ms else None,
