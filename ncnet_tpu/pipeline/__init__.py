@@ -1,0 +1,36 @@
+"""Offline bulk pipelines: throughput workloads over the serving stack.
+
+The paper's benchmarks (PF-Pascal, TSS, InLoc) are bulk jobs — a fixed
+corpus of pairs mapped through the matcher — and at corpus scale the
+binding constraint is surviving interruption without redoing work, not
+step speed (FireCaffe, arXiv:1511.00175). This package runs that
+workload on the same fleet the online service uses:
+
+* :mod:`.bulk` — crash-safe resumable map of the matcher over a
+  manifest of image pairs: exactly-once JSONL ledger + atomic cursor
+  checkpoint, per-pair retries on a shared budget, poison quarantine,
+  ``bulk.*`` failpoints/metrics (``tools/bulk_match.py`` is the CLI);
+* :mod:`.echo` — a jax-free stand-in matcher so crash/chaos drills
+  exercise the real replica/batcher/dispatcher stack in milliseconds.
+
+Everything here is stdlib + obs + reliability + serving-core only; jax
+enters only when the caller wires a real :class:`MatchEngine` fleet.
+"""
+
+from .bulk import (
+    BulkLedger,
+    LedgerError,
+    PairRow,
+    iter_manifest,
+    manifest_digest,
+    run_bulk,
+)
+
+__all__ = [
+    "BulkLedger",
+    "LedgerError",
+    "PairRow",
+    "iter_manifest",
+    "manifest_digest",
+    "run_bulk",
+]

@@ -1,0 +1,659 @@
+"""Crash-safe resumable bulk map of the matcher over a pair manifest.
+
+The contract is **exactly-once**: a ``kill -9`` at ANY point — mid
+dispatch, mid ledger append, between a checkpoint's tmp write and its
+rename — resumes with zero lost and zero duplicated results, and an
+interrupted-then-resumed run's ledger is *byte-identical* to an
+uninterrupted one's (tests/test_bulk_crash_e2e.py proves this with
+real SIGKILLs). The machinery:
+
+* **Ledger** (``ledger.jsonl``): append-only canonical-JSON lines, one
+  per pair, written strictly in row order (a reorder buffer holds
+  results that finish out of order). Each commit is flushed *and
+  fsynced* before the cursor may advance, so the only possible damage
+  from a crash is one torn trailing line — which recovery truncates.
+* **Checkpoint** (``checkpoint.json``): the shard cursor, written
+  tmp + fsync + atomic ``os.replace`` (the ``evals/feature_cache.py``
+  idiom). It pins the manifest digest, so resuming against an edited
+  manifest is refused instead of silently mismatching rows. The
+  checkpoint is an *optimization*: recovery re-scans the ledger tail
+  past it, so a checkpoint lost mid-rename costs re-counting, never
+  correctness.
+* **Quarantine** (``quarantine.jsonl``): poison pairs — those that
+  keep failing even after the batcher's bisection isolates them, until
+  their retry schedule exhausts — land here with their failure record
+  instead of aborting the run. The pair's ledger line says
+  ``"status": "quarantined"``; the sidecar carries the diagnosis (and,
+  being appended before the ledger line commits, may hold duplicates
+  after a crash — the ledger is the exactly-once record).
+* **Lock** (``.bulk.lock``): an exclusive ``flock`` so two resumes
+  cannot interleave appends into one ledger.
+
+Failure handling composes the whole reliability layer: per-pair
+:class:`~ncnet_tpu.reliability.retry.RetryPolicy` sessions draw on one
+shared :class:`~ncnet_tpu.reliability.retry.RetryBudget`; fleet
+backpressure (``RejectedError``) re-queues without spending attempts;
+replica death is absorbed upstream by ``FleetDispatcher`` re-routing.
+Chaos hooks: ``bulk.read`` / ``bulk.dispatch`` / ``bulk.commit`` /
+``bulk.checkpoint`` failpoints (docs/RELIABILITY.md), ``bulk.*``
+metrics (docs/OBSERVABILITY.md), and flat ``bulk.commit`` /
+``bulk.shard`` trace spans.
+
+The driver is engine-agnostic: ``prepare(PairRow) -> (bucket_key,
+payload)`` and ``submit(bucket_key, payload) -> Future`` are whatever
+the caller wires — a real ``MatchFleet`` dispatcher, the jax-free
+:mod:`~ncnet_tpu.pipeline.echo` fleet, or a bare test stub.
+"""
+
+from __future__ import annotations
+
+import csv
+import glob
+import hashlib
+import heapq
+import json
+import os
+import queue
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from .. import obs
+from ..obs import trace
+from ..reliability import failpoints
+from ..reliability.retry import RetryBudget, RetryPolicy
+from ..serving.batcher import PoisonRequestError, RejectedError
+
+LEDGER_NAME = "ledger.jsonl"
+CHECKPOINT_NAME = "checkpoint.json"
+QUARANTINE_NAME = "quarantine.jsonl"
+LOCK_NAME = ".bulk.lock"
+
+#: Permanent per-pair input errors: retrying cannot help, quarantine
+#: immediately (a missing/corrupt image stays missing).
+_BAD_INPUT = (ValueError, TypeError, KeyError, FileNotFoundError)
+
+
+class LedgerError(RuntimeError):
+    """The out_dir's ledger state is unusable (concurrent writer,
+    manifest mismatch, corrupt non-tail ledger line)."""
+
+
+@dataclass
+class PairRow:
+    """One manifest row: a (query, pano) pair plus caller context."""
+
+    row: int          # 0-based manifest position — the resume key
+    pair_id: str
+    query: str
+    pano: str
+    extra: dict = field(default_factory=dict)
+
+
+def manifest_digest(path: str) -> str:
+    """Content digest pinning a ledger to the manifest that built it."""
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def iter_manifest(path: str) -> Iterator[PairRow]:
+    """Stream PairRows from a CSV (header: query,pano[,id]) or JSONL
+    (``{"query":..., "pano":..., "id":...}``) manifest. Never loads the
+    file — million-row manifests stream at O(1) memory. Extra columns /
+    keys ride along in ``PairRow.extra``.
+    """
+    if path.endswith(".csv"):
+        with open(path, newline="") as fh:
+            reader = csv.DictReader(fh)
+            for n, rec in enumerate(reader):
+                yield _pair_row(n, rec, path)
+        return
+    with open(path) as fh:
+        n = 0
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise LedgerError(
+                    f"bad manifest line {n} in {path}: {exc}") from exc
+            yield _pair_row(n, rec, path)
+            n += 1
+
+
+def _pair_row(n: int, rec: dict, path: str) -> PairRow:
+    try:
+        query, pano = rec["query"], rec["pano"]
+    except KeyError as exc:
+        raise LedgerError(
+            f"manifest row {n} in {path} missing {exc} "
+            "(need query,pano[,id])") from exc
+    if not query or not pano:
+        raise LedgerError(f"manifest row {n} in {path}: empty query/pano")
+    pair_id = rec.get("id") or f"pair-{n:08d}"
+    extra = {k: v for k, v in rec.items()
+             if k not in ("query", "pano", "id") and v not in (None, "")}
+    return PairRow(row=n, pair_id=str(pair_id), query=str(query),
+                   pano=str(pano), extra=extra)
+
+
+def canonical_line(rec: dict) -> str:
+    """The ledger's byte format: sorted keys, no whitespace, one line.
+    Determinism here is what makes resumed runs byte-identical."""
+    return json.dumps(rec, sort_keys=True, separators=(",", ":")) + "\n"
+
+
+class BulkLedger:
+    """Crash-safe exactly-once progress journal for one bulk run.
+
+    Layout under ``out_dir``: ``ledger.jsonl`` (results, row-ordered),
+    ``checkpoint.json`` (cursor), ``quarantine.jsonl`` (poison
+    diagnoses), ``.bulk.lock`` (single-writer flock). See the module
+    docstring for the recovery protocol.
+    """
+
+    def __init__(self, out_dir: str, manifest_sha: str):
+        self.out_dir = out_dir
+        self.manifest_sha = manifest_sha
+        os.makedirs(out_dir, exist_ok=True)
+        self.ledger_path = os.path.join(out_dir, LEDGER_NAME)
+        self.checkpoint_path = os.path.join(out_dir, CHECKPOINT_NAME)
+        self.quarantine_path = os.path.join(out_dir, QUARANTINE_NAME)
+        self.next_row = 0
+        self.resumes = 0
+        self.truncated_tail = False
+        self._lfh = None
+        self._qfh = None
+        self._lock_fh = open(os.path.join(out_dir, LOCK_NAME), "a+")
+        try:
+            import fcntl
+
+            try:
+                fcntl.flock(self._lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                self._lock_fh.close()
+                raise LedgerError(
+                    f"another bulk run holds {out_dir!r} "
+                    "(exclusive .bulk.lock)") from None
+        except ImportError:  # non-posix: no advisory locking
+            pass
+
+    # -- recovery ---------------------------------------------------------
+
+    def recover(self) -> int:
+        """Rebuild the cursor from disk; returns the first undone row.
+
+        Order of trust: the ledger is authoritative, the checkpoint is
+        a scan hint. Recovery (1) drops orphan checkpoint tmps from a
+        crash mid-write, (2) validates the checkpoint's manifest pin,
+        (3) scans ledger lines from the checkpointed byte offset
+        verifying rows are consecutive, (4) truncates a torn trailing
+        line (the only damage an fsync-per-commit ledger can take), and
+        (5) persists a fresh checkpoint so the recovered state is
+        itself durable before any new work commits.
+        """
+        for tmp in glob.glob(self.checkpoint_path + ".*.tmp"):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        ck = None
+        if os.path.exists(self.checkpoint_path):
+            try:
+                with open(self.checkpoint_path) as fh:
+                    ck = json.load(fh)
+            except (OSError, json.JSONDecodeError) as exc:
+                raise LedgerError(
+                    f"corrupt checkpoint {self.checkpoint_path}: {exc}"
+                ) from exc
+            if ck.get("manifest_sha256") != self.manifest_sha:
+                raise LedgerError(
+                    "manifest changed since this ledger was started "
+                    f"(checkpoint pins {ck.get('manifest_sha256')!r}); "
+                    "bulk resume requires the identical manifest")
+        base_bytes = int(ck["ledger_bytes"]) if ck else 0
+        self.next_row = int(ck["next_row"]) if ck else 0
+        prior_resumes = int(ck.get("resumes", 0)) if ck else 0
+        had_state = ck is not None or os.path.exists(self.ledger_path)
+        if os.path.exists(self.ledger_path):
+            self._scan_tail(base_bytes)
+        elif base_bytes:
+            raise LedgerError("checkpoint present but ledger.jsonl missing")
+        self._truncate_torn(self.quarantine_path)
+        self.resumes = prior_resumes + (1 if had_state else 0)
+        self._lfh = open(self.ledger_path, "ab")
+        self._qfh = open(self.quarantine_path, "ab")
+        # Durable immediately: the very first commit of this run already
+        # has a checkpoint carrying the manifest pin behind it.
+        self.write_checkpoint()
+        if had_state:
+            obs.counter("bulk.resumes").inc()
+        return self.next_row
+
+    def _scan_tail(self, base_bytes: int) -> None:
+        size = os.path.getsize(self.ledger_path)
+        if size < base_bytes:
+            raise LedgerError(
+                f"ledger shorter ({size}B) than its checkpoint claims "
+                f"({base_bytes}B) — the ledger was edited or truncated")
+        with open(self.ledger_path, "rb+") as fh:
+            fh.seek(base_bytes)
+            data = fh.read()
+            good = data.rfind(b"\n") + 1
+            expect = self.next_row
+            for line in data[:good].splitlines():
+                try:
+                    rec = json.loads(line)
+                    row = int(rec["row"])
+                except (ValueError, KeyError) as exc:
+                    raise LedgerError(
+                        f"corrupt ledger line at row {expect}: {exc}"
+                    ) from exc
+                if row != expect:
+                    raise LedgerError(
+                        f"ledger rows not consecutive: saw {row}, "
+                        f"expected {expect}")
+                expect += 1
+            if good < len(data):
+                # Torn tail: the crash interrupted an append mid-line.
+                # The row it carried was never acked, so dropping it
+                # loses nothing — the resume recomputes it.
+                fh.truncate(base_bytes + good)
+                self.truncated_tail = True
+            self.next_row = expect
+
+    def _truncate_torn(self, path: str) -> None:
+        """Drop a torn (newline-less) trailing line from an append log."""
+        if not os.path.exists(path):
+            return
+        with open(path, "rb+") as fh:
+            data = fh.read()
+            good = data.rfind(b"\n") + 1
+            if good < len(data):
+                fh.truncate(good)
+                self.truncated_tail = True
+
+    # -- writes -----------------------------------------------------------
+
+    def commit(self, records: List[dict]) -> None:
+        """Append a contiguous run of row-ordered records, durably.
+
+        ``records[i]["row"]`` must continue ``next_row`` exactly — the
+        driver's reorder buffer guarantees it; anything else is a bug
+        worth dying loudly for. The ``bulk.commit`` failpoint fires
+        before any byte is written: a kill there loses only un-acked
+        work, which the resume redoes.
+        """
+        for i, rec in enumerate(records):
+            if rec.get("row") != self.next_row + i:
+                raise LedgerError(
+                    f"commit out of order: record {i} has row "
+                    f"{rec.get('row')}, ledger expects {self.next_row + i}")
+        failpoints.fire("bulk.commit", payload=self.next_row)
+        t0 = time.monotonic()
+        buf = "".join(canonical_line(r) for r in records).encode()
+        self._lfh.write(buf)
+        self._lfh.flush()
+        os.fsync(self._lfh.fileno())
+        self.next_row += len(records)
+        obs.counter("bulk.commits").inc()
+        trace.emit_span("bulk.commit", time.monotonic() - t0,
+                        rows=len(records))
+
+    def quarantine(self, record: dict) -> None:
+        """Durably append one poison diagnosis to the sidecar. Called
+        *before* the pair's ledger line commits, so a crash in between
+        can duplicate a sidecar entry but never lose one."""
+        self._qfh.write(canonical_line(record).encode())
+        self._qfh.flush()
+        os.fsync(self._qfh.fileno())
+        obs.counter("bulk.quarantined").inc()
+        obs.event("bulk_quarantine", **record)
+
+    def write_checkpoint(self) -> None:
+        """Atomically persist the cursor: tmp + fsync + rename.
+
+        The ``bulk.checkpoint`` failpoint sits exactly between the
+        fsynced tmp write and the ``os.replace`` — the nastiest window,
+        where a crash leaves a complete orphan tmp beside a stale live
+        checkpoint. Recovery deletes the orphan and re-scans from the
+        stale cursor; nothing is lost either way.
+        """
+        self._lfh.flush()
+        rec = {
+            "version": 1,
+            "manifest_sha256": self.manifest_sha,
+            "next_row": self.next_row,
+            "ledger_bytes": self._lfh.tell(),
+            "resumes": self.resumes,
+        }
+        tmp = f"{self.checkpoint_path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            fh.write(canonical_line(rec))
+            fh.flush()
+            os.fsync(fh.fileno())
+        failpoints.fire("bulk.checkpoint", payload=self.next_row)
+        os.replace(tmp, self.checkpoint_path)
+        try:  # directory fsync: make the rename itself power-durable
+            dfd = os.open(self.out_dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+        obs.counter("bulk.checkpoints").inc()
+
+    def ledger_rows(self) -> Iterator[dict]:
+        """Stream committed ledger records (verification / reporting)."""
+        if not os.path.exists(self.ledger_path):
+            return
+        with open(self.ledger_path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+    def close(self) -> None:
+        for fh in (self._lfh, self._qfh):
+            if fh is not None:
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+        self._lfh = self._qfh = None
+        try:
+            self._lock_fh.close()  # closing drops the flock
+        except OSError:
+            pass
+
+
+# -- result records -------------------------------------------------------
+
+
+def default_record(pair: PairRow, result: Any) -> dict:
+    """Ledger record for one matched pair: id + match digest.
+
+    Deliberately free of timing, attempt counts, and replica ids —
+    anything nondeterministic would break the byte-identical-resume
+    guarantee. The matches themselves are digested, not stored: a
+    million-pair ledger stays grep-able, and the digest still catches
+    any resume that recomputes a different answer.
+    """
+    matches = result.get("matches") if isinstance(result, dict) else result
+    if hasattr(matches, "tobytes"):
+        blob = matches.tobytes()
+    elif isinstance(matches, (bytes, bytearray)):
+        blob = bytes(matches)
+    elif isinstance(matches, str):
+        blob = matches.encode()
+    else:
+        blob = json.dumps(matches, sort_keys=True, default=str).encode()
+    n = result.get("n_matches") if isinstance(result, dict) else None
+    if n is None:
+        n = getattr(matches, "shape", (0,))[0] if matches is not None else 0
+    return {
+        "id": pair.pair_id,
+        "n_matches": int(n),
+        "row": pair.row,
+        "sha256": hashlib.sha256(blob).hexdigest(),
+        "status": "ok",
+    }
+
+
+def _quarantine_ledger_record(pair: PairRow, kind: str, error: str) -> dict:
+    return {
+        "error": error[:200],
+        "id": pair.pair_id,
+        "kind": kind,
+        "row": pair.row,
+        "status": "quarantined",
+    }
+
+
+# -- the driver -----------------------------------------------------------
+
+
+@dataclass
+class _Flight:
+    """One in-flight pair: its prepared payload + retry state."""
+
+    pair: PairRow
+    session: Any  # RetrySession
+    bucket_key: Any = None
+    payload: Any = None
+    attempts: int = 0
+
+
+def run_bulk(
+    manifest: str,
+    out_dir: str,
+    prepare: Callable[[PairRow], Tuple[Any, Any]],
+    submit: Callable[[Any, Any], Any],
+    *,
+    shard_size: int = 512,
+    max_inflight: int = 32,
+    checkpoint_every: int = 64,
+    retry_policy: Optional[RetryPolicy] = None,
+    record_fn: Callable[[PairRow, Any], dict] = default_record,
+    drive: Optional[Callable[[], None]] = None,
+    clock: Callable[[], float] = time.monotonic,
+    poll_s: float = 0.05,
+    total_rows: Optional[int] = None,
+) -> dict:
+    """Map ``submit`` over every manifest row, exactly once, resumably.
+
+    Keeps up to ``max_inflight`` pairs in the fleet at a time; results
+    may complete in any order (retries, multi-replica routing) but
+    commit strictly in row order through a reorder buffer. A shard is
+    ``shard_size`` consecutive rows — purely a checkpoint/progress
+    granule (``bulk.shards_done``), forced-checkpointed at its
+    boundary; within a shard the cursor also checkpoints every
+    ``checkpoint_every`` committed rows, bounding redo-after-crash.
+
+    ``drive`` is the threadless test hook: when set, the loop calls it
+    instead of blocking on the completion queue (fake-clock suites pump
+    replica ``poll()`` there). ``submit`` must return a Future whose
+    result carries the BatchResult contract (``.result`` attribute) or
+    the raw engine result dict.
+    """
+    if retry_policy is None:
+        retry_policy = RetryPolicy(
+            max_attempts=4, base_delay_s=0.05, max_delay_s=2.0,
+            budget=RetryBudget(capacity=50.0, refill_per_success=0.5),
+            clock=clock,
+        )
+    shard_size = max(1, int(shard_size))
+    checkpoint_every = max(1, int(checkpoint_every))
+    max_inflight = max(1, int(max_inflight))
+
+    ledger = BulkLedger(out_dir, manifest_digest(manifest))
+    t_start = clock()
+    start_row = ledger.recover()
+    source = (p for p in iter_manifest(manifest) if p.row >= start_row)
+    if total_rows is not None:
+        obs.gauge("bulk.pairs_total").set(int(total_rows))
+
+    inflight: Dict[int, _Flight] = {}
+    ready: Dict[int, dict] = {}      # reorder buffer: row -> ledger record
+    doneq: "queue.Queue" = queue.Queue()
+    retries: List[Tuple[float, int, int]] = []  # (due, seq, row) heap
+    seq = 0
+    exhausted = False
+    since_checkpoint = 0
+    quarantined = retried = 0
+    shard_t0: Dict[int, float] = {}  # shard index -> first-launch clock
+
+    def _finish(row: int, record: dict) -> None:
+        inflight.pop(row, None)
+        ready[row] = record
+
+    def _quarantine(fl: _Flight, kind: str, exc: BaseException) -> None:
+        nonlocal quarantined
+        err = f"{type(exc).__name__}: {exc}"
+        ledger.quarantine({
+            "attempts": fl.attempts or 1,
+            "error": err,
+            "id": fl.pair.pair_id,
+            "kind": kind,
+            "query": fl.pair.query,
+            "row": fl.pair.row,
+        })
+        quarantined += 1
+        _finish(fl.pair.row, _quarantine_ledger_record(fl.pair, kind, err))
+
+    def _schedule_retry(fl: _Flight, delay: float) -> None:
+        nonlocal seq
+        heapq.heappush(retries, (clock() + max(0.0, delay), seq, fl.pair.row))
+        seq += 1
+
+    def _fail(fl: _Flight, exc: BaseException) -> None:
+        nonlocal retried
+        if isinstance(exc, _BAD_INPUT) and not isinstance(
+                exc, failpoints.InjectedFault):
+            _quarantine(fl, "bad_input", exc)
+            return
+        # PoisonRequestError (the batcher's bisection isolated this pair
+        # failing alone) is still retried: a transient device fault on a
+        # singleton batch is indistinguishable from poison in one
+        # sample, but real poison keeps failing and exhausts the
+        # schedule — then it is quarantined as poison.
+        fl.attempts += 1
+        hint = getattr(exc, "retry_after_s", None)
+        delay = fl.session.next_delay(hint_s=hint)
+        if delay is None:
+            kind = ("poison" if isinstance(exc, PoisonRequestError)
+                    else "retries_exhausted")
+            _quarantine(fl, kind, exc)
+            return
+        retried += 1
+        obs.counter("bulk.retries").inc()
+        obs.event("bulk_retry", row=fl.pair.row, attempt=fl.attempts,
+                  delay_s=round(delay, 4),
+                  error=f"{type(exc).__name__}: {exc}"[:200])
+        _schedule_retry(fl, delay)
+
+    def _launch(fl: _Flight) -> None:
+        row = fl.pair.row
+        shard = row // shard_size
+        if shard not in shard_t0:
+            shard_t0[shard] = clock()
+        try:
+            if fl.payload is None:
+                failpoints.fire("bulk.read", payload=fl.pair)
+                fl.bucket_key, fl.payload = prepare(fl.pair)
+            failpoints.fire("bulk.dispatch", payload=fl.pair)
+            fut = submit(fl.bucket_key, fl.payload)
+        except RejectedError as exc:
+            # Backpressure, not failure: the fleet refused admission
+            # before attempting anything — requeue on the server's
+            # hint without spending a retry attempt or budget token.
+            _schedule_retry(fl, getattr(exc, "retry_after_s", poll_s))
+            return
+        except BaseException as exc:  # noqa: BLE001 — classified below
+            _fail(fl, exc)
+            return
+        fut.add_done_callback(lambda f, r=row: doneq.put((r, f)))
+
+    def _complete(row: int, fut) -> None:
+        fl = inflight.get(row)
+        if fl is None:  # late duplicate callback; already settled
+            return
+        exc = fut.exception()
+        if exc is not None:
+            _fail(fl, exc)
+            return
+        res = fut.result()
+        res = getattr(res, "result", res)  # unwrap BatchResult
+        if retry_policy.budget is not None:
+            retry_policy.budget.record_success()
+        _finish(row, record_fn(fl.pair, res))
+
+    def _commit_ready() -> None:
+        nonlocal since_checkpoint
+        batch: List[dict] = []
+        while ledger.next_row + len(batch) in ready:
+            batch.append(ready.pop(ledger.next_row + len(batch)))
+        if not batch:
+            return
+        first, last = batch[0]["row"], batch[-1]["row"]
+        ledger.commit(batch)
+        obs.counter("bulk.pairs_done").inc(len(batch))
+        since_checkpoint += len(batch)
+        crossed = range(first // shard_size,
+                        (last + 1) // shard_size)
+        for shard in crossed:  # shard boundary: force a durable cursor
+            obs.counter("bulk.shards_done").inc()
+            t0 = shard_t0.pop(shard, None)
+            if t0 is not None:
+                trace.emit_span("bulk.shard", max(0.0, clock() - t0),
+                                shard=shard)
+            ledger.write_checkpoint()
+            since_checkpoint = 0
+        if since_checkpoint >= checkpoint_every:
+            ledger.write_checkpoint()
+            since_checkpoint = 0
+
+    try:
+        while True:
+            while len(inflight) + len(ready) < max_inflight and not exhausted:
+                pair = next(source, None)
+                if pair is None:
+                    exhausted = True
+                    break
+                fl = _Flight(pair=pair, session=retry_policy.session())
+                inflight[pair.row] = fl
+                _launch(fl)
+            now = clock()
+            while retries and retries[0][0] <= now:
+                _, _, row = heapq.heappop(retries)
+                fl = inflight.get(row)
+                if fl is not None:
+                    _launch(fl)
+            obs.gauge("bulk.inflight").set(len(inflight))
+            if exhausted and not inflight and not ready:
+                break
+            if drive is not None:
+                drive()
+            else:
+                wait = poll_s
+                if retries:
+                    wait = min(wait, max(0.0, retries[0][0] - clock()))
+                try:
+                    row, fut = doneq.get(timeout=max(wait, 1e-3))
+                    _complete(row, fut)
+                except queue.Empty:
+                    pass
+            while True:  # drain whatever else already completed
+                try:
+                    row, fut = doneq.get_nowait()
+                except queue.Empty:
+                    break
+                _complete(row, fut)
+            _commit_ready()
+        ledger.write_checkpoint()
+        duration = max(clock() - t_start, 1e-9)
+        done_this_run = ledger.next_row - start_row
+        summary = {
+            "pairs_done": ledger.next_row,
+            "pairs_this_run": done_this_run,
+            "pairs_s": done_this_run / duration,
+            "quarantined": quarantined,
+            "retries": retried,
+            "resumes": ledger.resumes,
+            "start_row": start_row,
+            "duration_s": duration,
+            "truncated_tail": ledger.truncated_tail,
+            "ledger": ledger.ledger_path,
+            "quarantine": ledger.quarantine_path,
+        }
+        obs.event("bulk_done", **{k: v for k, v in summary.items()
+                                  if isinstance(v, (int, float, bool))})
+        return summary
+    finally:
+        ledger.close()

@@ -1,0 +1,116 @@
+"""Jax-free stand-in matcher for bulk crash/chaos drills.
+
+The crash-resume e2e suite SIGKILLs subprocesses dozens of times; with
+the real engine each leg would pay a jax import + compile. The echo
+matcher keeps everything *around* the model real — `Replica` batchers,
+circuit breakers, `FleetDispatcher` re-routing, `engine.device` /
+`engine.rider` failpoints, shape buckets — and replaces only the model
+step with a deterministic digest of the pair's file bytes. Determinism
+matters: resumed runs must reproduce the interrupted run's results
+bit-for-bit for the ledger byte-identity check to mean anything.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from ..reliability import failpoints
+from ..serving.fleet import MatchFleet, Replica
+from .bulk import PairRow
+
+
+@dataclass
+class EchoPrepared:
+    """Echo analogue of ``serving.engine.Prepared``: digest + meta."""
+
+    bucket_key: Tuple
+    digest: bytes  # sha256 over query||pano file bytes
+    meta: dict = field(default_factory=dict)
+
+
+def _image_dims(blob: bytes) -> Optional[Tuple[int, int]]:
+    try:
+        from PIL import Image
+
+        with Image.open(io.BytesIO(blob)) as im:
+            return im.size  # header-only decode
+    except Exception:
+        return None
+
+
+def prepare(pair: PairRow) -> Tuple[Tuple, EchoPrepared]:
+    """Read both images, digest them, bucket by query dimensions."""
+    with open(pair.query, "rb") as fh:
+        q = fh.read()
+    with open(pair.pano, "rb") as fh:
+        p = fh.read()
+    dims = _image_dims(q)
+    bucket_key = ("echo",) if dims is None else ("echo",) + dims
+    digest = hashlib.sha256(q + b"\x00" + p).digest()
+    prepared = EchoPrepared(bucket_key=bucket_key, digest=digest,
+                            meta={"row": pair.row, **pair.extra})
+    return bucket_key, prepared
+
+
+class EchoPoisonError(RuntimeError):
+    """A manifest-marked poison pair 'crashed the model'. Raised for
+    the whole batch, exactly like a real device fault — the batcher's
+    bisection must isolate the marked rider on its own."""
+
+
+class EchoMatcher:
+    """Batch runner with the engine's failpoint plants but no model.
+
+    ``delay_s`` simulates model time per batch so chaos schedules
+    (kill a replica while work is queued on it) have a real window.
+    Pairs whose manifest row carries ``"poison"`` fail deterministically
+    on every attempt — the injected-poison fixture for chaos gates.
+    """
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = float(delay_s)
+        self.batches = 0
+
+    def run_batch(self, bucket_key, batch):
+        failpoints.fire("engine.device", payload=bucket_key)
+        for p in batch:
+            failpoints.fire("engine.rider", payload=p)
+        for p in batch:
+            if p.meta.get("poison"):
+                raise EchoPoisonError(
+                    f"poison pair at manifest row {p.meta.get('row')}")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.batches += 1
+        out = []
+        for p in batch:
+            out.append({
+                "matches": p.digest,  # the "answer": deterministic bytes
+                "n_matches": 1 + p.digest[0] % 16,
+                "timing": {"model_s": self.delay_s},
+            })
+        return out
+
+
+def build_echo_fleet(n_replicas: int = 2, max_batch: int = 4,
+                     max_queue: int = 64, max_delay_s: float = 0.005,
+                     delay_s: float = 0.0) -> Tuple[MatchFleet, EchoMatcher]:
+    """A real MatchFleet (batchers, breakers, dispatcher) over echo
+    replicas — deadlines off, as every bulk caller runs it."""
+    matcher = EchoMatcher(delay_s=delay_s)
+    replicas = [
+        Replica(
+            f"echo{i}",
+            runner=matcher.run_batch,
+            max_batch=max_batch,
+            max_queue=max_queue,
+            max_delay_s=max_delay_s,
+            default_timeout_s=None,
+        )
+        for i in range(n_replicas)
+    ]
+    return MatchFleet(replicas), matcher

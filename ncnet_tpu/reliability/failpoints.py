@@ -10,7 +10,10 @@ of three fault modes:
 * ``error`` — raise :class:`InjectedFault` at the site;
 * ``delay`` — sleep a configured duration (timeout / stall paths);
 * ``corrupt`` — mangle a value passing through the site (NaN-poison a
-  numpy array, truncate bytes) via :func:`corrupt`.
+  numpy array, truncate bytes) via :func:`corrupt`;
+* ``kill`` — ``SIGKILL`` the process at the site (crash-resume drills:
+  the process dies with no chance to flush or clean up, exactly like
+  an OOM kill or a preemption).
 
 Arming is either programmatic (tests: :func:`failpoint` context
 manager, :func:`set_failpoint`) or environmental::
@@ -26,7 +29,11 @@ Spec grammar, comma-separated ``site=mode[:args]`` terms:
 * ``site=delay:200ms`` / ``site=delay:1.5s:0.25`` — sleep, optional
   probability;
 * ``site=corrupt`` / ``site=corrupt:0.1`` — corrupt values at
-  :func:`corrupt` call sites.
+  :func:`corrupt` call sites;
+* ``site=kill`` / ``site=kill:+3`` — SIGKILL the process; ``+N`` skips
+  the first N evaluations of the site, so ``bulk.commit=kill:+3`` dies
+  on exactly the 4th commit (deterministic crash placement for
+  resume tests). ``+N`` composes with every mode.
 
 Probabilistic sites draw from a per-site ``random.Random`` seeded by
 ``(NCNET_FAILPOINTS_SEED, site)`` — runs are deterministic given the
@@ -38,7 +45,11 @@ Planted sites (grep ``failpoints.fire`` for the live list):
 (serving/batcher worker), ``engine.device`` (serving/engine dispatch),
 ``server.handle`` (serving/server request handler), ``client.transport``
 (serving/client), ``checkpoint.save`` / ``checkpoint.save.commit`` /
-``checkpoint.load`` (training/checkpoint).
+``checkpoint.load`` (training/checkpoint), ``bulk.read`` /
+``bulk.dispatch`` / ``bulk.commit`` / ``bulk.checkpoint``
+(pipeline/bulk). The full site table with failure domains lives in
+docs/RELIABILITY.md and is lint-enforced
+(tests/test_failpoint_docs_lint.py).
 
 Every injection is an obs event (``failpoint``) and a counter
 (``failpoint.<site>``) so a chaos run's run log records exactly what
@@ -51,6 +62,7 @@ import contextlib
 import os
 import random
 import re
+import signal
 import threading
 import time
 from dataclasses import dataclass, field
@@ -91,16 +103,20 @@ class Failpoint:
     """One armed site: mode + probability + optional fire cap/matcher."""
 
     site: str
-    mode: str  # "error" | "delay" | "corrupt"
+    mode: str  # "error" | "delay" | "corrupt" | "kill"
     prob: float = 1.0
     delay_s: float = 0.0
     max_fires: Optional[int] = None
+    #: Skip the first N evaluations of the site before it can fire
+    #: (``+N`` in specs) — pins a crash to "the Nth+1 commit".
+    skip_first: int = 0
     #: Optional payload predicate: the site only fires for payloads the
     #: callable accepts (per-rider poison in a shared batch).
     match: Optional[Callable[[Any], bool]] = None
     #: Optional custom corruptor for ``corrupt`` mode.
     corruptor: Optional[Callable[[Any], Any]] = None
     fires: int = field(default=0)
+    skips: int = field(default=0)
 
     def spent(self) -> bool:
         return self.max_fires is not None and self.fires >= self.max_fires
@@ -113,9 +129,9 @@ def _parse_term(term: str) -> Failpoint:
         raise ValueError(f"bad failpoint term {term!r} (want site=mode[:args])")
     parts = spec.split(":")
     mode = parts[0].strip().lower()
-    if mode not in ("error", "delay", "corrupt"):
+    if mode not in ("error", "delay", "corrupt", "kill"):
         raise ValueError(f"bad failpoint mode {mode!r} in {term!r}")
-    prob, delay_s, max_fires = 1.0, 0.0, None
+    prob, delay_s, max_fires, skip_first = 1.0, 0.0, None, 0
     args = [a.strip() for a in parts[1:] if a.strip()]
     if mode == "delay":
         if not args:
@@ -124,6 +140,9 @@ def _parse_term(term: str) -> Failpoint:
         if delay_s is None:
             raise ValueError(f"bad delay duration in {term!r}")
     for arg in args:
+        if arg.startswith("+"):
+            skip_first = int(arg[1:])
+            continue
         body, _, cap = arg.partition("x")
         if cap:
             max_fires = int(cap)
@@ -132,7 +151,7 @@ def _parse_term(term: str) -> Failpoint:
         if not 0.0 <= prob <= 1.0:
             raise ValueError(f"failpoint probability out of [0,1] in {term!r}")
     return Failpoint(site=site, mode=mode, prob=prob, delay_s=delay_s,
-                     max_fires=max_fires)
+                     max_fires=max_fires, skip_first=skip_first)
 
 
 def parse_spec(spec: str) -> Dict[str, Failpoint]:
@@ -190,13 +209,15 @@ class FailpointRegistry:
 
     def set(self, site: str, mode: str, prob: float = 1.0,
             delay_s: float = 0.0, max_fires: Optional[int] = None,
+            skip_first: int = 0,
             match: Optional[Callable[[Any], bool]] = None,
             corruptor: Optional[Callable[[Any], Any]] = None) -> Failpoint:
         """Arm (or re-arm) one site programmatically."""
-        if mode not in ("error", "delay", "corrupt"):
+        if mode not in ("error", "delay", "corrupt", "kill"):
             raise ValueError(f"bad failpoint mode {mode!r}")
         fp = Failpoint(site=site, mode=mode, prob=prob, delay_s=delay_s,
-                       max_fires=max_fires, match=match, corruptor=corruptor)
+                       max_fires=max_fires, skip_first=skip_first,
+                       match=match, corruptor=corruptor)
         with self._lock:
             sites = dict(self._sites)
             sites[site] = fp
@@ -226,6 +247,9 @@ class FailpointRegistry:
         with self._lock:
             if fp.spent():
                 return False
+            if fp.skips < fp.skip_first:
+                fp.skips += 1
+                return False
             if fp.match is not None:
                 try:
                     if not fp.match(payload):
@@ -253,6 +277,10 @@ class FailpointRegistry:
             return
         if fp.mode == "delay":
             self._sleep(fp.delay_s)
+        elif fp.mode == "kill":
+            # A real crash, not an exception: no finally blocks, no
+            # buffered-write flush — whatever isn't fsynced is gone.
+            os.kill(os.getpid(), signal.SIGKILL)
         else:
             raise InjectedFault(site)
 

@@ -110,7 +110,9 @@ class _Pending:
     payload: Any
     future: Future
     t_submit: float
-    deadline: float
+    #: None = deadlines-off (bulk/offline riders): the bucket flushes on
+    #: size or linger only, never because this rider is about to expire.
+    deadline: Optional[float]
     # Trace context captured on the SUBMITTING thread (obs/trace.py) —
     # the batch runs on the worker thread, where contextvars would be
     # empty; the worker re-attaches these so batch/device spans land in
@@ -118,8 +120,9 @@ class _Pending:
     trace_ctx: Tuple[trace.SpanCtx, ...] = ()
 
     def __repr__(self):  # payloads are image arrays; keep logs sane
+        dl = "none" if self.deadline is None else f"{self.deadline:.3f}"
         return (f"_Pending(bucket={self.bucket_key!r}, "
-                f"t_submit={self.t_submit:.3f}, deadline={self.deadline:.3f})")
+                f"t_submit={self.t_submit:.3f}, deadline={dl})")
 
 
 @dataclass
@@ -150,7 +153,7 @@ class DeadlineBatcher:
         max_queue: int = 32,
         max_delay_s: float = 0.05,
         deadline_slack_s: float = 0.0,
-        default_timeout_s: float = 30.0,
+        default_timeout_s: Optional[float] = 30.0,
         backlog_cap: Optional[int] = None,
         isolate_poison: bool = True,
         clock: Callable[[], float] = time.monotonic,
@@ -170,7 +173,11 @@ class DeadlineBatcher:
         self.max_queue = max_queue
         self.max_delay_s = float(max_delay_s)
         self.deadline_slack_s = float(deadline_slack_s)
-        self.default_timeout_s = float(default_timeout_s)
+        # None = deadlines-off: offline/bulk callers opt out of deadline
+        # flushes entirely rather than passing a sentinel huge timeout
+        # (which would still schedule spurious deadline wakeups).
+        self.default_timeout_s = (
+            None if default_timeout_s is None else float(default_timeout_s))
         self.clock = clock
         self._cond = threading.Condition()
         # dispatch target: full buckets (and backlog early-flushes) land
@@ -197,6 +204,9 @@ class DeadlineBatcher:
         (batcher closed). ``timeout_s`` sets the request's deadline
         relative to now; the batcher flushes the request's bucket
         before the deadline (minus ``deadline_slack_s``) passes.
+        ``timeout_s=None`` inherits ``default_timeout_s``; when that is
+        also None the request rides deadline-free (bulk mode) and only
+        size/linger flushes apply.
         """
         now = self.clock()
         timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
@@ -205,7 +215,7 @@ class DeadlineBatcher:
             payload=payload,
             future=Future(),
             t_submit=now,
-            deadline=now + float(timeout_s),
+            deadline=None if timeout_s is None else now + float(timeout_s),
             trace_ctx=trace.current(),
         )
         with self._cond:
@@ -233,10 +243,10 @@ class DeadlineBatcher:
 
     def _flush_due(self, pendings: List[_Pending], now: float) -> bool:
         oldest = pendings[0]
-        return (
-            now - oldest.t_submit >= self.max_delay_s
-            or oldest.deadline - self.deadline_slack_s <= now
-        )
+        if now - oldest.t_submit >= self.max_delay_s:
+            return True
+        return (oldest.deadline is not None
+                and oldest.deadline - self.deadline_slack_s <= now)
 
     def _next_wake(self, now: float) -> Optional[float]:
         """Seconds until the earliest pending flush trigger, or None."""
@@ -245,10 +255,9 @@ class DeadlineBatcher:
             if not g:
                 continue
             oldest = g[0]
-            due = min(
-                oldest.t_submit + self.max_delay_s,
-                oldest.deadline - self.deadline_slack_s,
-            )
+            due = oldest.t_submit + self.max_delay_s
+            if oldest.deadline is not None:
+                due = min(due, oldest.deadline - self.deadline_slack_s)
             t = due if t is None else min(t, due)
         if t is None:
             return None

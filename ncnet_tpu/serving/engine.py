@@ -54,6 +54,10 @@ class Prepared:
     pano_path: Optional[str]          # cache store key (None = no store)
     pano_shape: Optional[Tuple[int, int]]
     max_matches: int = 0              # 0 = all
+    #: Caller-attached context the engine never reads (bulk pipeline row
+    #: numbers, chaos poison markers) — failpoint match predicates on
+    #: ``engine.rider`` can target it to poison one specific pair.
+    meta: Optional[dict] = None
 
 
 class MatchEngine:

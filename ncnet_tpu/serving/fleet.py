@@ -51,7 +51,7 @@ class Replica:
         max_queue: int = 32,
         max_delay_s: float = 0.05,
         deadline_slack_s: float = 0.1,
-        default_timeout_s: float = 30.0,
+        default_timeout_s: Optional[float] = 30.0,
         breaker_threshold: int = 5,
         breaker_reset_s: float = 10.0,
         isolate_poison: bool = True,

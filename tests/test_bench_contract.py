@@ -414,3 +414,52 @@ def test_traceagg_self_time_for_nested_containers(tmp_path):
     assert abs(stages["backbone"]["ms"] - 0.120) < 1e-9
     assert abs(stages["other"]["ms"] - 0.040) < 1e-9
     assert abs(stages["consensus"]["ms"] - 0.040) < 1e-9
+
+
+def test_bulk_match_emits_one_json_line(tmp_path, capsys):
+    """tools/bulk_match.py stdout contract (ISSUE 8): a synthetic echo
+    corpus run prints ONE JSON line with the throughput metric and the
+    completion/health counters tools/bench_trend.py passes through."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bulk_match
+
+    rc = bulk_match.main([
+        "--out_dir", str(tmp_path / "run"), "--engine", "echo",
+        "--synthetic", "8@32x48", "--replicas", "2", "--max_batch", "2",
+        "--checkpoint_every", "4",
+    ])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "bulk_match_pairs_per_s"
+    assert rec["unit"] == "pairs/s"
+    assert rec["value"] > 0
+    for key in ("pairs_done", "pairs_this_run", "pairs_s", "quarantined",
+                "retries", "resumes", "duration_s", "ledger"):
+        assert key in rec, rec
+    assert rec["pairs_done"] == 8
+    assert rec["resumes"] == 0
+
+
+def test_bulk_match_chaos_contract(tmp_path, capsys):
+    """`--chaos` gate contract (ISSUE 8): two SIGKILL-resume legs plus
+    a faulted final leg over the default synthetic corpus; rc 0 only
+    when the audit finds zero lost/duplicated pairs and every poison
+    pair quarantined — and ONE stdout JSON line says so."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bulk_match
+
+    rc = bulk_match.main(["--chaos", "--out_dir", str(tmp_path / "run")])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "bulk_chaos_survival"
+    assert rec["unit"] == "frac"
+    assert rc == 0, f"chaos gate failed: {rec}"
+    assert rec["value"] == 1.0
+    assert rec["lost"] == 0 and rec["duplicates"] == 0
+    assert rec["poison_quarantined"] == rec["poison_expected"] == 3
+    assert rec["wrongly_quarantined"] == 0
+    assert rec["kills"] == 2
+    assert rec["resumes"] >= 2

@@ -1,0 +1,351 @@
+"""Bulk pipeline units (ncnet_tpu/pipeline/bulk.py, ISSUE 8).
+
+Three layers, all jax-free and threadless:
+
+* manifest parsing (CSV + JSONL, ids, extras, malformed rows);
+* BulkLedger crash-state recovery — torn tails, checkpoints behind the
+  ledger, orphan tmps, manifest pinning, the single-writer lock;
+* run_bulk driver control flow with stub submit functions — in-order
+  commit from out-of-order completions, retry/backpressure/poison
+  classification, resume idempotence, bulk.* failpoints.
+
+Real-SIGKILL crash coverage lives in test_bulk_crash_e2e.py.
+"""
+
+import json
+import os
+from concurrent.futures import Future
+
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.pipeline.bulk import (
+    BulkLedger,
+    LedgerError,
+    PairRow,
+    canonical_line,
+    iter_manifest,
+    manifest_digest,
+    run_bulk,
+)
+from ncnet_tpu.reliability import failpoints
+from ncnet_tpu.reliability.retry import RetryPolicy
+from ncnet_tpu.serving.batcher import PoisonRequestError, RejectedError
+
+
+def write_jsonl(path, rows):
+    with open(path, "w") as fh:
+        for rec in rows:
+            fh.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def make_manifest(tmp_path, n=6, **extra):
+    rows = [{"id": f"p{i}", "query": f"/img/q{i}.jpg",
+             "pano": f"/img/p{i}.jpg", **extra} for i in range(n)]
+    return write_jsonl(tmp_path / "manifest.jsonl", rows)
+
+
+def ok_future(value):
+    f = Future()
+    f.set_result(value)
+    return f
+
+
+def err_future(exc):
+    f = Future()
+    f.set_exception(exc)
+    return f
+
+
+def echo_submit(bucket_key, pair):
+    return ok_future({"matches": f"m{pair.row}", "n_matches": pair.row})
+
+
+def prep(pair):
+    return ("b",), pair
+
+
+def fast_policy(**kw):
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("base_delay_s", 1e-4)
+    kw.setdefault("max_delay_s", 1e-3)
+    return RetryPolicy(**kw)
+
+
+# -- manifests ------------------------------------------------------------
+
+
+def test_iter_manifest_jsonl_ids_and_extras(tmp_path):
+    path = write_jsonl(tmp_path / "m.jsonl", [
+        {"query": "a.jpg", "pano": "b.jpg"},
+        {"id": "x", "query": "c.jpg", "pano": "d.jpg", "poison": 1},
+    ])
+    rows = list(iter_manifest(path))
+    assert [p.row for p in rows] == [0, 1]
+    assert rows[0].pair_id == "pair-00000000"  # stable synthesized id
+    assert rows[1].pair_id == "x"
+    assert rows[1].extra == {"poison": 1}
+
+
+def test_iter_manifest_csv(tmp_path):
+    path = tmp_path / "m.csv"
+    path.write_text("query,pano,id,scene\n"
+                    "q0.jpg,p0.jpg,a,indoor\n"
+                    "q1.jpg,p1.jpg,b,\n")
+    rows = list(iter_manifest(str(path)))
+    assert [(p.pair_id, p.query) for p in rows] == [("a", "q0.jpg"),
+                                                    ("b", "q1.jpg")]
+    assert rows[0].extra == {"scene": "indoor"}
+    assert rows[1].extra == {}  # empty cells don't ride along
+
+
+def test_iter_manifest_rejects_missing_columns(tmp_path):
+    path = write_jsonl(tmp_path / "m.jsonl", [{"query": "only.jpg"}])
+    with pytest.raises(LedgerError, match="missing"):
+        list(iter_manifest(path))
+
+
+# -- ledger recovery ------------------------------------------------------
+
+
+def rec_for(row):
+    return {"id": f"p{row}", "n_matches": 1, "row": row,
+            "sha256": "0" * 64, "status": "ok"}
+
+
+def open_ledger(tmp_path, sha="m" * 64):
+    led = BulkLedger(str(tmp_path / "out"), sha)
+    led.recover()
+    return led
+
+
+def test_ledger_commit_resume_continuity(tmp_path):
+    led = open_ledger(tmp_path)
+    led.commit([rec_for(0), rec_for(1)])
+    led.write_checkpoint()
+    led.commit([rec_for(2)])  # committed past the checkpoint
+    led.close()
+    led2 = open_ledger(tmp_path)
+    # The scan walks ledger lines beyond the checkpointed cursor.
+    assert led2.next_row == 3
+    assert led2.resumes == 1
+    led2.close()
+
+
+def test_ledger_truncates_torn_tail(tmp_path):
+    led = open_ledger(tmp_path)
+    led.commit([rec_for(0)])
+    led.close()
+    with open(tmp_path / "out" / "ledger.jsonl", "a") as fh:
+        fh.write('{"row": 1, "status": "ok"')  # crash mid-append
+    led2 = open_ledger(tmp_path)
+    assert led2.next_row == 1
+    assert led2.truncated_tail
+    led2.commit([rec_for(1)])
+    rows = [r["row"] for r in led2.ledger_rows()]
+    assert rows == [0, 1], "torn line replaced, no duplicate"
+    led2.close()
+
+
+def test_ledger_refuses_manifest_change(tmp_path):
+    led = open_ledger(tmp_path, sha="a" * 64)
+    led.close()
+    with pytest.raises(LedgerError, match="manifest"):
+        open_ledger(tmp_path, sha="b" * 64)
+
+
+def test_ledger_refuses_out_of_order_commit(tmp_path):
+    led = open_ledger(tmp_path)
+    with pytest.raises(LedgerError, match="out of order"):
+        led.commit([rec_for(3)])
+    led.close()
+
+
+def test_ledger_single_writer_lock(tmp_path):
+    led = open_ledger(tmp_path)
+    with pytest.raises(LedgerError, match="another bulk run"):
+        BulkLedger(str(tmp_path / "out"), "m" * 64)
+    led.close()
+    # lock released on close: reopening works
+    open_ledger(tmp_path).close()
+
+
+def test_ledger_cleans_orphan_checkpoint_tmp(tmp_path):
+    led = open_ledger(tmp_path)
+    led.commit([rec_for(0)])
+    led.close()
+    orphan = tmp_path / "out" / "checkpoint.json.999.tmp"
+    orphan.write_text('{"left": "by a crash mid-rename"}')
+    led2 = open_ledger(tmp_path)
+    assert not orphan.exists()
+    assert led2.next_row == 1
+    led2.close()
+
+
+def test_ledger_rejects_corrupt_interior_line(tmp_path):
+    led = open_ledger(tmp_path)
+    led.commit([rec_for(0)])
+    led.close()
+    path = tmp_path / "out" / "ledger.jsonl"
+    path.write_text("not json at all\n" + path.read_text())
+    with pytest.raises(LedgerError):
+        open_ledger(tmp_path)
+
+
+def test_canonical_line_is_deterministic():
+    a = canonical_line({"b": 1, "a": 2})
+    b = canonical_line({"a": 2, "b": 1})
+    assert a == b == '{"a":2,"b":1}\n'
+
+
+# -- run_bulk driver ------------------------------------------------------
+
+
+def test_run_bulk_happy_path_and_noop_resume(tmp_path):
+    manifest = make_manifest(tmp_path, n=7)
+    out = str(tmp_path / "out")
+    summary = run_bulk(manifest, out, prep, echo_submit,
+                       shard_size=3, max_inflight=2, checkpoint_every=2,
+                       retry_policy=fast_policy())
+    assert summary["pairs_done"] == 7
+    assert summary["pairs_this_run"] == 7
+    assert summary["quarantined"] == 0
+    rows = [json.loads(line) for line in open(out + "/ledger.jsonl")]
+    assert [r["row"] for r in rows] == list(range(7))
+    assert all(r["status"] == "ok" for r in rows)
+    ck = json.load(open(out + "/checkpoint.json"))
+    assert ck["next_row"] == 7
+    # Resume over a complete ledger: zero work, nothing rewritten.
+    before = open(out + "/ledger.jsonl", "rb").read()
+    summary2 = run_bulk(manifest, out, prep, echo_submit,
+                        retry_policy=fast_policy())
+    assert summary2["pairs_this_run"] == 0
+    assert summary2["resumes"] == 1
+    assert open(out + "/ledger.jsonl", "rb").read() == before
+
+
+def test_run_bulk_commits_in_row_order_from_reordered_completions(tmp_path):
+    manifest = make_manifest(tmp_path, n=6)
+    held = {}
+
+    def submit(bucket_key, pair):
+        f = Future()
+        held[pair.row] = f
+        return f
+
+    def drive():
+        # Resolve whatever is outstanding in REVERSE row order.
+        for row in sorted(list(held), reverse=True):
+            held.pop(row).set_result({"matches": f"m{row}",
+                                      "n_matches": row})
+
+    out = str(tmp_path / "out")
+    run_bulk(manifest, out, prep, submit, max_inflight=3,
+             retry_policy=fast_policy(), drive=drive)
+    rows = [json.loads(line)["row"] for line in open(out + "/ledger.jsonl")]
+    assert rows == list(range(6)), "ledger is row-ordered regardless"
+
+
+def test_run_bulk_retries_transient_then_succeeds(tmp_path):
+    manifest = make_manifest(tmp_path, n=4)
+    failures = {1: 2}  # row 1 fails twice, then succeeds
+
+    def submit(bucket_key, pair):
+        if failures.get(pair.row, 0) > 0:
+            failures[pair.row] -= 1
+            return err_future(RuntimeError("transient device error"))
+        return ok_future({"matches": f"m{pair.row}", "n_matches": 0})
+
+    out = str(tmp_path / "out")
+    summary = run_bulk(manifest, out, prep, submit,
+                       retry_policy=fast_policy(max_attempts=4))
+    assert summary["quarantined"] == 0
+    assert summary["retries"] == 2
+    assert summary["pairs_done"] == 4
+
+
+def test_run_bulk_backpressure_requeues_without_spending_attempts(tmp_path):
+    manifest = make_manifest(tmp_path, n=3)
+    rejections = {0: 3}
+
+    def submit(bucket_key, pair):
+        if rejections.get(pair.row, 0) > 0:
+            rejections[pair.row] -= 1
+            raise RejectedError(retry_after_s=1e-4, depth=9)
+        return ok_future({"matches": "m", "n_matches": 0})
+
+    out = str(tmp_path / "out")
+    # max_attempts=1 = no error retries at all: if backpressure spent
+    # attempts, row 0 would quarantine instead of completing.
+    summary = run_bulk(manifest, out, prep, submit,
+                       retry_policy=fast_policy(max_attempts=1))
+    assert summary["pairs_done"] == 3
+    assert summary["quarantined"] == 0
+
+
+def test_run_bulk_quarantines_bad_input_immediately(tmp_path):
+    manifest = make_manifest(tmp_path, n=3)
+
+    def bad_prep(pair):
+        if pair.row == 1:
+            raise ValueError("corrupt JPEG header")
+        return prep(pair)
+
+    out = str(tmp_path / "out")
+    summary = run_bulk(manifest, out, bad_prep, echo_submit,
+                       retry_policy=fast_policy())
+    assert summary["quarantined"] == 1
+    assert summary["retries"] == 0, "permanent input errors never retry"
+    ledger = {r["row"]: r for r in
+              (json.loads(line) for line in open(out + "/ledger.jsonl"))}
+    assert ledger[1]["status"] == "quarantined"
+    assert ledger[1]["kind"] == "bad_input"
+    side = [json.loads(line) for line in open(out + "/quarantine.jsonl")]
+    assert side[0]["row"] == 1 and "corrupt JPEG" in side[0]["error"]
+
+
+def test_run_bulk_quarantines_persistent_poison(tmp_path):
+    manifest = make_manifest(tmp_path, n=4)
+
+    def submit(bucket_key, pair):
+        if pair.row == 2:
+            return err_future(PoisonRequestError("isolated rider died"))
+        return ok_future({"matches": "m", "n_matches": 0})
+
+    out = str(tmp_path / "out")
+    summary = run_bulk(manifest, out, prep, submit,
+                       retry_policy=fast_policy(max_attempts=2))
+    assert summary["pairs_done"] == 4, "poison never blocks the corpus"
+    assert summary["quarantined"] == 1
+    side = [json.loads(line) for line in open(out + "/quarantine.jsonl")]
+    assert side[0]["kind"] == "poison"
+    assert side[0]["attempts"] == 2
+    assert "isolated rider died" in side[0]["error"]
+
+
+def test_run_bulk_retryable_failpoints_on_read_and_dispatch(tmp_path):
+    manifest = make_manifest(tmp_path, n=4)
+    out = str(tmp_path / "out")
+    failpoints.registry().set("bulk.read", "error", max_fires=1)
+    failpoints.registry().set("bulk.dispatch", "error", max_fires=1)
+    try:
+        summary = run_bulk(manifest, out, prep, echo_submit,
+                           retry_policy=fast_policy(max_attempts=4))
+    finally:
+        failpoints.clear()
+    assert summary["pairs_done"] == 4
+    assert summary["quarantined"] == 0
+    assert summary["retries"] == 2
+    assert obs.counter("bulk.retries").value == 2
+
+
+def test_run_bulk_metrics_registered(tmp_path):
+    manifest = make_manifest(tmp_path, n=5)
+    run_bulk(manifest, str(tmp_path / "out"), prep, echo_submit,
+             shard_size=2, retry_policy=fast_policy(), total_rows=5)
+    assert obs.counter("bulk.pairs_done").value == 5
+    assert obs.counter("bulk.commits").value >= 1
+    assert obs.counter("bulk.checkpoints").value >= 2  # startup + shards
+    assert obs.counter("bulk.shards_done").value == 2  # rows 0-1, 2-3
+    assert obs.gauge("bulk.pairs_total").value == 5

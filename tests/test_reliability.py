@@ -50,6 +50,19 @@ def test_parse_spec_grammar():
     assert parse_spec("") == {}
 
 
+def test_parse_spec_kill_mode_and_skip_first():
+    fps = parse_spec("bulk.commit=kill:+3,engine.device=error:0.5x4:+2")
+    assert fps["bulk.commit"].mode == "kill"
+    assert fps["bulk.commit"].skip_first == 3
+    assert fps["bulk.commit"].prob == 1.0
+    # +N composes with probability and the xN fire cap in one term.
+    fp = fps["engine.device"]
+    assert (fp.mode, fp.prob, fp.skip_first, fp.max_fires) == (
+        "error", 0.5, 2, 4)
+    with pytest.raises(ValueError):
+        parse_spec("s=kill:+abc")
+
+
 @pytest.mark.parametrize("bad", [
     "noequals", "site=", "site=explode", "site=error:2.0",
     "site=delay", "site=delay:abc",
@@ -99,6 +112,18 @@ def test_max_fires_cap_disarms_site():
             reg.fire("s")
     reg.fire("s")  # spent: no-op from here on
     assert reg.active()["s"].fires == 2
+
+
+def test_skip_first_defers_firing():
+    reg = FailpointRegistry()
+    reg.set("s", "error", skip_first=2, max_fires=1)
+    reg.fire("s")  # skipped
+    reg.fire("s")  # skipped
+    with pytest.raises(InjectedFault):
+        reg.fire("s")
+    reg.fire("s")  # max_fires spent after the one real injection
+    fp = reg.active()["s"]
+    assert (fp.skips, fp.fires) == (2, 1)
 
 
 def test_delay_mode_sleeps_injected():

@@ -80,6 +80,31 @@ def test_batcher_max_delay_flush():
     assert f.result(0).queue_wait_s == pytest.approx(0.06)
 
 
+def test_batcher_deadlines_off_mode():
+    """default_timeout_s=None: no rider ever gets a deadline — bulk
+    riders flush on size/linger only, across arbitrarily long waits."""
+    clock, calls = FakeClock(), []
+    b = make_batcher(clock, calls, default_timeout_s=None,
+                     max_delay_s=0.05, deadline_slack_s=10.0)
+    f = b.submit("a", "p1")
+    # With any finite deadline, a 10s slack would force an immediate
+    # deadline-near flush; deadline-free riders must not.
+    for pend in b._buckets.groups["a"]:
+        assert pend.deadline is None
+    assert b.poll() == 0, "no deadline flush in deadlines-off mode"
+    # A simulated *month* of waiting expires nothing — the rider is
+    # still served by the ordinary linger flush, never deadline-killed.
+    clock.t += 30 * 24 * 3600.0
+    assert b.poll() == 1
+    assert f.result(0).result == "r:p1"
+    # An explicit per-request timeout still opts a rider back in: with
+    # deadline 3s and slack 10s the deadline-near flush fires at once.
+    f2 = b.submit("a", "p2", timeout_s=3.0)
+    assert b._buckets.groups["a"][0].deadline is not None
+    assert b.poll() == 1
+    assert f2.result(0).result == "r:p2"
+
+
 def test_batcher_deadline_flush_beats_max_delay():
     clock, calls = FakeClock(), []
     b = make_batcher(clock, calls, max_delay_s=10.0, deadline_slack_s=0.005)

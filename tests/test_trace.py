@@ -438,3 +438,23 @@ def test_bench_trend_empty_dir(tmp_path, capsys):
     assert bench_trend.main(["--dir", str(tmp_path), "--strict"]) == 0
     report = json.loads(capsys.readouterr().out.strip())
     assert report["metric"] is None and report["n_rounds"] == 0
+
+
+def test_bench_trend_passes_bulk_fields_through(tmp_path, capsys):
+    """A bulk_match round's completion/health counters survive into the
+    trend report (ISSUE 8) — a pairs/s trend over a resumable corpus
+    run is meaningless without pairs_done/quarantined/resumes context."""
+    d = str(tmp_path)
+    rec = {"n": 1, "cmd": "bench", "rc": 0,
+           "parsed": {"metric": "bulk_match_pairs_per_s", "value": 120.0,
+                      "unit": "pairs/s", "pairs_done": 1000,
+                      "pairs_s": 120.0, "quarantined": 3, "resumes": 2}}
+    with open(os.path.join(d, "BENCH_r01.json"), "w") as fh:
+        json.dump(rec, fh)
+    assert bench_trend.main(["--dir", d]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["metric"] == "bulk_match_pairs_per_s"
+    assert report["pairs_done"] == 1000
+    assert report["pairs_s"] == 120.0
+    assert report["quarantined"] == 3
+    assert report["resumes"] == 2

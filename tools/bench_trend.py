@@ -92,8 +92,11 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
     # Fleet-bench headlines (tools/bench_serving.py --replicas) carry
     # the scaling context a raw pairs/s trend is meaningless without —
     # pass it through so a trend over fleet rounds stays interpretable.
+    # Likewise the bulk-pipeline headline (tools/bulk_match.py): a
+    # corpus run's trend needs its completion/health counters.
     for key in ("replicas", "single_replica_pairs_per_s", "scaling_x",
-                "scaling_efficiency"):
+                "scaling_efficiency", "pairs_done", "pairs_s",
+                "quarantined", "resumes"):
         if key in latest:
             report[key] = latest[key]
     return report

@@ -1,0 +1,339 @@
+"""Bulk matcher CLI: crash-safe resumable map over a pair manifest.
+
+Runs ``ncnet_tpu/pipeline/bulk.py`` against a CSV/JSONL manifest of
+image pairs on a replica fleet — the paper's benchmark workload
+(PF-Pascal / TSS / InLoc are all bulk jobs) run as throughput instead
+of latency. Kill it at any point and re-run the same command line: it
+resumes from the ledger with zero lost and zero duplicated results.
+
+    # synthesize a corpus, then map it (resumable: re-run to resume)
+    python tools/bulk_match.py --synthetic 64@48x64 --out_dir /tmp/bulk \
+        --engine echo --replicas 2
+
+    # real model fleet over an existing manifest
+    python tools/bulk_match.py --manifest pairs.csv --out_dir out \
+        --engine real --replicas 2 --image_size 64
+
+Prints ONE JSON line (the repo's bench stdout contract)::
+
+    {"metric": "bulk_match_pairs_per_s", "value": ..., "unit":
+     "pairs/s", "pairs_done": ..., "pairs_s": ..., "quarantined": ...,
+     "resumes": ..., ...}
+
+``--chaos`` replays a crash-resume-crash schedule against one corpus:
+two subprocess legs die by real SIGKILL at armed ``bulk.commit`` /
+``bulk.checkpoint`` failpoints, then an in-process leg resumes with
+``engine.device`` + ``bulk.read`` / ``bulk.dispatch`` error faults
+armed, kills (and revives) a replica mid-run, and routes
+manifest-marked poison pairs through bisection into the quarantine
+sidecar. The gate: the final ledger holds every manifest row exactly
+once, every poison pair is quarantined with its failure record, and
+the exit code is nonzero on any drop, duplicate, or missed poison.
+Stage notes go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_serving import note  # noqa: E402
+
+
+def synth_corpus(corpus_dir, n_pairs, spec="48x64", poison=0, seed=0):
+    """Write ``n_pairs`` random JPEG pairs + a JSONL manifest; the last
+    ``poison`` rows are marked (EchoMatcher fails them on sight).
+    Returns the manifest path. Deterministic in ``seed``."""
+    import numpy as np
+    from PIL import Image
+
+    h, w = (int(v) for v in spec.split("x"))
+    os.makedirs(corpus_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    manifest = os.path.join(corpus_dir, "manifest.jsonl")
+    with open(manifest + ".tmp", "w") as fh:
+        for i in range(n_pairs):
+            paths = []
+            for side in ("q", "p"):
+                img = Image.fromarray(
+                    (rng.random((h, w, 3)) * 255).astype("uint8"))
+                path = os.path.join(corpus_dir, f"{side}{i:05d}.jpg")
+                img.save(path, format="JPEG")
+                paths.append(path)
+            rec = {"id": f"synth-{i:05d}", "query": paths[0],
+                   "pano": paths[1]}
+            if poison and i >= n_pairs - poison:
+                rec["poison"] = 1
+            fh.write(json.dumps(rec) + "\n")
+    os.replace(manifest + ".tmp", manifest)
+    return manifest
+
+
+def _build_fleet(args, model):
+    """(fleet, prepare) per --engine; deadlines off on every replica."""
+    if args.engine == "echo":
+        from ncnet_tpu.pipeline import echo
+
+        fleet, _ = echo.build_echo_fleet(
+            n_replicas=args.replicas, max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1e3,
+            delay_s=args.echo_delay_ms / 1e3)
+        return fleet, echo.prepare
+
+    from ncnet_tpu.serving.fleet import MatchFleet
+
+    if model is None:
+        from ncnet_tpu.cli.common import build_model
+
+        note("building tiny model (pass model= to reuse one in-process)")
+        model = build_model(
+            ncons_kernel_sizes=(3, 3),
+            ncons_channels=(16, 1),
+            relocalization_k_size=2,
+            half_precision=True,
+            backbone_bf16=True,
+        )
+    config, params = model
+    fleet = MatchFleet.build(
+        config, params,
+        n_replicas=args.replicas,
+        base_id="bulk",
+        cache_mb=args.cache_mb,
+        engine_kwargs=dict(k_size=2, image_size=args.image_size),
+        replica_kwargs=dict(
+            max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1e3,
+            default_timeout_s=None,  # bulk mode: no deadline flushes
+        ),
+    )
+    engine = fleet.replicas[0].engine
+
+    def prepare(pair):
+        p = engine.prepare({"query_path": pair.query,
+                            "pano_path": pair.pano})
+        p.meta = {"row": pair.row, **pair.extra}
+        return p.bucket_key, p
+
+    return fleet, prepare
+
+
+def run_once(args, model=None, extra_failpoints=None, on_dispatch=None):
+    """One (possibly resuming) bulk pass; returns the run_bulk summary."""
+    from ncnet_tpu.pipeline.bulk import run_bulk
+    from ncnet_tpu.reliability import failpoints
+    from ncnet_tpu.reliability.retry import RetryBudget, RetryPolicy
+
+    for site, kwargs in (extra_failpoints or {}).items():
+        failpoints.registry().set(site, **kwargs)
+    fleet, prepare = _build_fleet(args, model)
+    fleet.start()
+    dispatches = [0]
+
+    def submit(bucket_key, payload):
+        dispatches[0] += 1
+        if on_dispatch is not None:
+            on_dispatch(dispatches[0], fleet)
+        return fleet.dispatcher.submit(bucket_key, payload)
+
+    try:
+        return run_bulk(
+            args.manifest, args.out_dir, prepare, submit,
+            shard_size=args.shard_size,
+            max_inflight=args.max_inflight,
+            checkpoint_every=args.checkpoint_every,
+            retry_policy=RetryPolicy(
+                max_attempts=args.retries + 1,
+                base_delay_s=0.02, max_delay_s=1.0,
+                budget=RetryBudget(capacity=100.0, refill_per_success=1.0),
+            ),
+        )
+    finally:
+        fleet.close()
+        for site in (extra_failpoints or {}):
+            failpoints.clear(site)
+
+
+def chaos(args, model=None):
+    """Crash-resume-crash schedule over one corpus; 0 = gate green."""
+    from ncnet_tpu.pipeline.bulk import iter_manifest
+
+    if args.engine != "echo":
+        note("chaos legs respawn the tool; forcing --engine echo")
+        args.engine = "echo"
+    if not args.echo_delay_ms:
+        # A real per-batch model time gives the kill_replica verb a
+        # window with work actually queued on the victim.
+        args.echo_delay_ms = 5.0
+    rows = list(iter_manifest(args.manifest))
+    poison_rows = {p.row for p in rows if p.extra.get("poison")}
+    note(f"chaos corpus: {len(rows)} pairs, {len(poison_rows)} poison")
+
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--manifest", args.manifest, "--out_dir", args.out_dir,
+        "--engine", "echo", "--replicas", str(args.replicas),
+        "--max_inflight", "4", "--checkpoint_every", "2",
+        "--shard_size", str(args.shard_size),
+        "--echo_delay_ms", str(args.echo_delay_ms),
+    ]
+    kills = 0
+    for leg, spec in (("commit-window", "bulk.commit=kill:+1"),
+                      ("checkpoint-rename", "bulk.checkpoint=kill:+2")):
+        env = dict(os.environ, NCNET_FAILPOINTS=spec)
+        note(f"leg {kills + 1}: SIGKILL at {spec} ...")
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              timeout=120)
+        if proc.returncode == 0:
+            note(f"leg {leg}: expected a mid-run kill but the run "
+                 "completed — corpus too small for the schedule")
+            return 1, {"error": f"kill never fired in leg {leg}"}
+        kills += 1
+        note(f"leg {leg}: died rc={proc.returncode} (good)")
+
+    # Final leg, in-process: resume under error faults + replica death.
+    def on_dispatch(n, fleet):
+        if n == 3 and args.replicas > 1:
+            note("chaos: kill_replica mid-run")
+            fleet.kill(-1)
+        elif n == 9 and args.replicas > 1:
+            fleet.revive(-1)
+
+    note("leg 3: resume with engine.device/bulk.read/bulk.dispatch "
+         "faults + kill_replica")
+    summary = run_once(
+        args, model,
+        extra_failpoints={
+            "engine.device": dict(mode="error", max_fires=2),
+            "bulk.read": dict(mode="error", max_fires=2),
+            "bulk.dispatch": dict(mode="error", max_fires=2),
+        },
+        on_dispatch=on_dispatch,
+    )
+
+    # -- verify exactly-once + poison quarantine --------------------------
+    ledger_rows, statuses = [], {}
+    with open(os.path.join(args.out_dir, "ledger.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            ledger_rows.append(rec["row"])
+            statuses[rec["row"]] = rec["status"]
+    lost = sorted(set(range(len(rows))) - set(ledger_rows))
+    dupes = len(ledger_rows) - len(set(ledger_rows))
+    quarantined = {}
+    qpath = os.path.join(args.out_dir, "quarantine.jsonl")
+    if os.path.exists(qpath):
+        with open(qpath) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                quarantined[rec["row"]] = rec
+    poison_missed = sorted(
+        r for r in poison_rows
+        if r not in quarantined or not quarantined[r].get("error"))
+    wrongly_quarantined = sorted(
+        r for r, s in statuses.items()
+        if s == "quarantined" and r not in poison_rows)
+    ok = not lost and not dupes and not poison_missed \
+        and not wrongly_quarantined and kills == 2
+    rec = {
+        "metric": "bulk_chaos_survival",
+        "value": 1.0 if ok else 0.0,
+        "unit": "frac",
+        "pairs": len(rows),
+        "pairs_done": summary["pairs_done"],
+        "pairs_s": round(summary["pairs_s"], 3),
+        "lost": len(lost),
+        "duplicates": dupes,
+        "poison_expected": len(poison_rows),
+        "poison_quarantined": sum(
+            1 for r in poison_rows if r in quarantined),
+        "wrongly_quarantined": len(wrongly_quarantined),
+        "quarantined": summary["quarantined"],
+        "retries": summary["retries"],
+        "resumes": summary["resumes"],
+        "kills": kills,
+    }
+    return (0 if ok else 1), rec
+
+
+def main(argv=None, model=None):
+    parser = argparse.ArgumentParser(
+        description="crash-safe resumable bulk matcher over a manifest")
+    parser.add_argument("--manifest", type=str, default="",
+                        help="CSV (query,pano[,id]) or JSONL pair list")
+    parser.add_argument("--out_dir", type=str, required=True,
+                        help="ledger/checkpoint/quarantine directory")
+    parser.add_argument("--engine", choices=("real", "echo"),
+                        default="real")
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--shard_size", type=int, default=512)
+    parser.add_argument("--max_inflight", type=int, default=32)
+    parser.add_argument("--checkpoint_every", type=int, default=64)
+    parser.add_argument("--retries", type=int, default=4,
+                        help="per-pair retry attempts after the first")
+    parser.add_argument("--max_batch", type=int, default=4)
+    parser.add_argument("--max_delay_ms", type=float, default=5.0)
+    parser.add_argument("--image_size", type=int, default=64)
+    parser.add_argument("--cache_mb", type=int, default=0)
+    parser.add_argument("--echo_delay_ms", type=float, default=0.0,
+                        help="echo engine: simulated model time/batch")
+    parser.add_argument("--synthetic", type=str, default="",
+                        help="N@HxW: synthesize a corpus + manifest "
+                        "under out_dir/corpus")
+    parser.add_argument("--poison", type=int, default=0,
+                        help="with --synthetic: mark the last N rows "
+                        "poison (echo engine fails them)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="crash-resume-crash gate; nonzero exit on "
+                        "any lost/duplicated/unquarantined pair")
+    parser.add_argument("--run_log", type=str, default="")
+    args = parser.parse_args(argv)
+
+    from ncnet_tpu import obs
+
+    if args.run_log:
+        obs.init_run("bulk_match", args.run_log, args=args)
+    if args.chaos and not args.synthetic and not args.manifest:
+        args.synthetic = "24@48x64"
+        args.poison = args.poison or 3
+    if args.synthetic and not args.manifest:
+        n, _, spec = args.synthetic.partition("@")
+        args.manifest = synth_corpus(
+            os.path.join(args.out_dir, "corpus"),
+            int(n), spec or "48x64", poison=args.poison)
+        note(f"synthesized corpus manifest: {args.manifest}")
+    if not args.manifest:
+        parser.error("need --manifest or --synthetic")
+
+    if args.chaos:
+        rc, rec = chaos(args, model)
+        print(json.dumps(rec), flush=True)
+        return rc
+
+    summary = run_once(args, model)
+    rec = {
+        "metric": "bulk_match_pairs_per_s",
+        "value": round(summary["pairs_s"], 3),
+        "unit": "pairs/s",
+        "engine": args.engine,
+        "replicas": args.replicas,
+        "pairs_done": summary["pairs_done"],
+        "pairs_this_run": summary["pairs_this_run"],
+        "pairs_s": round(summary["pairs_s"], 3),
+        "quarantined": summary["quarantined"],
+        "retries": summary["retries"],
+        "resumes": summary["resumes"],
+        "duration_s": round(summary["duration_s"], 3),
+        "ledger": summary["ledger"],
+    }
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
