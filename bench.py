@@ -489,6 +489,136 @@ def main():
                 else:
                     shutil.rmtree(tdir, ignore_errors=True)
 
+    # Coarse-to-fine section: (a) consensus-stage A/B at the reference
+    # post-pool shape — the c2f replacement (coarse consensus + top-K
+    # window refinement) must beat the one-shot consensus stage it
+    # displaces, the cell-count arithmetic made wall-clock; (b) a
+    # high-res point at 2x the reference feature grid that runs ONLY
+    # under c2f — the one-shot 4D tensor at that shape is the memory
+    # wall the mode exists to dodge (docs/PERF.md). Both fenced: the
+    # headline must survive any c2f failure. NCNET_BENCH_C2F=0 skips.
+    c2f_fields = {
+        "coarse_factor": None, "topk": None,
+        "consensus_oneshot_ms": None, "consensus_c2f_ms": None,
+        "c2f_pairs_s": None, "c2f_hires_input": None,
+    }
+    if os.environ.get("NCNET_BENCH_C2F", "1") != "0":
+        try:
+            from ncnet_tpu.models.ncnet import (
+                c2f_raw_matches_from_features,
+                c2f_stride,
+                extract_features as _extract_features,
+            )
+            from ncnet_tpu.ops.c2f import refine_consensus
+            from ncnet_tpu.ops.conv4d import neigh_consensus_apply
+            from ncnet_tpu.ops.mutual import mutual_matching
+            from ncnet_tpu.utils.profiling import timed_steady
+
+            c2f_config = NCNetConfig(
+                backbone=BackboneConfig(compute_dtype="bfloat16"),
+                ncons_kernel_sizes=(3, 3),
+                ncons_channels=(16, 1),
+                relocalization_k_size=2,
+                half_precision=True,
+                use_fused_corr_pool=tier[0] != "unfused",
+                fused_impl="xla" if tier[0] == "xla" else "auto",
+                mode="c2f",
+            )
+            stride = c2f_stride(c2f_config)  # coarse factor x reloc k
+            c2f_fields["coarse_factor"] = c2f_config.c2f_coarse_factor
+            c2f_fields["topk"] = c2f_config.c2f_topk
+            # Reference feature grid (backbone 1/16 scale), snapped to
+            # the c2f stride so the coarse/fine shapes are the ones the
+            # engine would actually bucket this input into.
+            fh = max((h_a // 16) // stride * stride, stride)
+            fw = max((w_a // 16) // stride * stride, stride)
+            ph, pw = fh // 2, fw // 2            # post reloc-pool (k=2)
+            cph, cpw = fh // stride, fw // stride  # coarse post-pool
+            kk = min(c2f_config.c2f_topk, cph * cpw)
+            wbh = min((2 * c2f_config.c2f_radius + 1) * stride, fh)
+            wbw = min((2 * c2f_config.c2f_radius + 1) * stride, fw)
+            cons = params["neigh_consensus"]
+            ka, kb, kc = jax.random.split(jax.random.PRNGKey(7), 3)
+            corr_os = jax.random.normal(
+                ka, (1, 1, ph, pw, ph, pw), jnp.float32
+            ).astype(jnp.bfloat16)
+            corr_coarse = jax.random.normal(
+                kb, (1, 1, cph, cpw, cph, cpw), jnp.float32
+            ).astype(jnp.bfloat16)
+            # Two window stacks (per-B + per-A refinement directions),
+            # f32 as ops.c2f.window_correlation produces them.
+            wins = jax.random.normal(
+                kc, (2, kk, 1, stride, stride, wbh, wbw), jnp.float32
+            )
+
+            @jax.jit
+            def oneshot_stage(cons, c):
+                c = mutual_matching(c)
+                c = neigh_consensus_apply(cons, c, symmetric=True)
+                return jnp.sum(mutual_matching(c).astype(jnp.float32))
+
+            @jax.jit
+            def c2f_stage(cons, c, wins):
+                c = mutual_matching(c)
+                c = neigh_consensus_apply(cons, c, symmetric=True)
+                acc = jnp.sum(mutual_matching(c).astype(jnp.float32))
+                for w in (wins[0], wins[1]):
+                    acc = acc + jnp.sum(
+                        refine_consensus(cons, w, corr_dtype=jnp.bfloat16)
+                    )
+                return acc
+
+            note(f"c2f consensus A/B: oneshot [1,1,{ph},{pw},{ph},{pw}] "
+                 f"vs coarse [1,1,{cph},{cpw},{cph},{cpw}] + 2x[{kk},1,"
+                 f"{stride},{stride},{wbh},{wbw}] windows")
+            _, dt_os, _ = timed_steady(oneshot_stage, cons, corr_os,
+                                       iters=3)
+            _, dt_c2f, _ = timed_steady(c2f_stage, cons, corr_coarse,
+                                        wins, iters=3)
+            c2f_fields["consensus_oneshot_ms"] = round(dt_os * 1e3, 3)
+            c2f_fields["consensus_c2f_ms"] = round(dt_c2f * 1e3, 3)
+            note(f"consensus stage: oneshot {dt_os * 1e3:.1f} ms, c2f "
+                 f"{dt_c2f * 1e3:.1f} ms ("
+                 f"{'c2f faster' if dt_c2f < dt_os else 'c2f NOT faster'})")
+
+            try:
+                # >=2x the reference grid, pixel dims snapped to
+                # 16*stride so the fine grid divides the c2f stride.
+                unit = 16 * stride
+                hi_h = max(unit, int(round(2 * h_a / unit)) * unit)
+                hi_w = max(unit, int(round(2 * w_a / unit)) * unit)
+                note(f"c2f high-res point: {hi_h}x{hi_w} images "
+                     f"({hi_h // 16}x{hi_w // 16} feature grid; the "
+                     "one-shot 4D tensor is never materialized here)")
+                k3, k4 = jax.random.split(jax.random.PRNGKey(8))
+                src_hi = jax.random.normal(
+                    k3, (1, 3, hi_h, hi_w), jnp.float32)
+                tgt_hi = jax.random.normal(
+                    k4, (1, 3, hi_h, hi_w), jnp.float32)
+
+                @jax.jit
+                def c2f_pair(params, src, tgt):
+                    fa = _extract_features(c2f_config, params, src)
+                    fb = _extract_features(c2f_config, params, tgt)
+                    outs = c2f_raw_matches_from_features(
+                        c2f_config, params, fa, fb, both_directions=True
+                    )
+                    return sum(
+                        jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+                _, dt_hi, _ = timed_steady(
+                    c2f_pair, params, src_hi, tgt_hi, iters=2)
+                c2f_fields["c2f_pairs_s"] = round(1.0 / dt_hi, 4)
+                c2f_fields["c2f_hires_input"] = [hi_h, hi_w]
+                note(f"c2f high-res pair: {dt_hi * 1e3:.0f} ms/pair "
+                     f"({1.0 / dt_hi:.2f} pairs/s)")
+            except Exception as exc:  # noqa: BLE001
+                note(f"c2f high-res point failed ({type(exc).__name__}: "
+                     f"{exc}); omitted")
+        except Exception as exc:  # noqa: BLE001
+            note(f"c2f section failed ({type(exc).__name__}: {exc}); "
+                 "omitted")
+
     # The consensus plan the measured program actually traced (recorded
     # by neigh_consensus_apply at trace time): makes BENCH_r0*.json
     # trajectories attributable to plan changes — fused? strategies?
@@ -504,6 +634,7 @@ def main():
         "fused": fused_ran,
         "path": name,
         "util": util,
+        **c2f_fields,
         "consensus_plan": consensus_last_plan(),
     }
     if run_log is not None:

@@ -15,7 +15,13 @@ import numpy as np
 
 from ..data import DataLoader
 from ..evals import pck_metric
-from ..models.ncnet import ncnet_forward
+from ..models.ncnet import (
+    c2f_coarse_from_features,
+    c2f_is_degenerate,
+    c2f_raw_matches_from_features,
+    extract_features,
+    ncnet_forward,
+)
 from ..ops import corr_to_matches
 
 
@@ -28,12 +34,44 @@ def evaluate_pck(
     num_workers: int = 8,
     verbose: bool = True,
 ):
-    """Run keypoint-transfer PCK over a dataset; returns (mean_pck, per_pair)."""
+    """Run keypoint-transfer PCK over a dataset; returns (mean_pck, per_pair).
+
+    ``config.mode == 'c2f'`` runs the coarse-to-fine matcher instead of the
+    one-shot tensor: the per-B-cell spliced match field feeds the same
+    bilinear transfer (row-major over the fine B grid, the contract
+    ops.matches.bilinear_point_transfer assumes). Degenerate c2f knobs
+    route through the one-shot extraction on the stage-1 tensor, so the
+    factor-1/top-K=all setting scores identically to mode='oneshot'.
+    """
+    use_c2f = getattr(config, "mode", "oneshot") == "c2f"
 
     @jax.jit
     def step(params, source, target, batch_points):
-        corr, _ = ncnet_forward(config, params, source, target)
-        xa, ya, xb, yb, _ = corr_to_matches(corr, do_softmax=True)
+        if not use_c2f:
+            corr, _ = ncnet_forward(config, params, source, target)
+            xa, ya, xb, yb, _ = corr_to_matches(corr, do_softmax=True)
+        else:
+            feat_a = extract_features(config, params, source)
+            feat_b = extract_features(config, params, target)
+            if c2f_is_degenerate(config, feat_a.shape, feat_b.shape):
+                corr, _ = c2f_coarse_from_features(
+                    config, params, feat_a, feat_b
+                )
+                xa, ya, xb, yb, _ = corr_to_matches(corr, do_softmax=True)
+            else:
+                # The c2f machinery is per-pair (static top-K gather);
+                # sequential map over the batch keeps one compiled pair
+                # program instead of a batch-size family.
+                def per_pair(feats):
+                    fa, fb = feats
+                    return c2f_raw_matches_from_features(
+                        config, params, fa[None], fb[None],
+                        both_directions=False, invert_direction=False,
+                        scale="centered",
+                    )
+
+                outs = jax.lax.map(per_pair, (feat_a, feat_b))
+                xa, ya, xb, yb, _ = (o[:, 0] for o in outs)
         return pck_metric(batch_points, (xa, ya, xb, yb), alpha)
 
     loader = DataLoader(

@@ -3,6 +3,7 @@
 from .pck import pck, pck_metric
 from .flow_eval import dense_warp_grid, write_flow_output
 from .inloc import (
+    c2f_device_matches,
     dedup_matches,
     extract_inloc_matches,
     inloc_device_matches,
@@ -17,6 +18,7 @@ __all__ = [
     "pck_metric",
     "dense_warp_grid",
     "write_flow_output",
+    "c2f_device_matches",
     "dedup_matches",
     "extract_inloc_matches",
     "inloc_device_matches",

@@ -169,6 +169,47 @@ def inloc_device_matches(
     return _sort_and_recenter(raw, shape4d, k_size)
 
 
+def c2f_device_matches(config, params, feat_a, feat_b,
+                       do_softmax: bool = True):
+    """Coarse-to-fine device-side match extraction for one pair.
+
+    Same return contract as :func:`inloc_device_matches` (both directions,
+    'positive' scale, descending-score sort, pixel-cell recentring), so the
+    downstream dedup / .mat flow is mode-agnostic. Jit-safe; callers jit it
+    together with feature extraction.
+
+    Degenerate knobs (models.ncnet.c2f_is_degenerate) route through the
+    one-shot extraction on the stage-1 tensor — bit-identical to the
+    one-shot program, relocalization included. On the refined path
+    `do_softmax` is ignored: spliced scores are raw filtered-consensus
+    values (ops.c2f.splice_matches).
+    """
+    # Local import: evals must stay importable without pulling the model
+    # stack until a c2f caller actually needs it.
+    from ..models.ncnet import (
+        c2f_coarse_from_features,
+        c2f_is_degenerate,
+        c2f_raw_matches_from_features,
+    )
+
+    if c2f_is_degenerate(config, feat_a.shape, feat_b.shape):
+        corr4d, delta4d = c2f_coarse_from_features(
+            config, params, feat_a, feat_b
+        )
+        return inloc_device_matches(
+            corr4d, delta4d=delta4d,
+            k_size=max(config.relocalization_k_size, 1),
+            do_softmax=do_softmax,
+        )
+    raw = c2f_raw_matches_from_features(
+        config, params, feat_a, feat_b, both_directions=True,
+        scale="positive",
+    )
+    fine_shape = (feat_a.shape[2], feat_a.shape[3],
+                  feat_b.shape[2], feat_b.shape[3])
+    return _sort_and_recenter(raw, fine_shape, 1)
+
+
 def inloc_matches_from_consensus(
     consensus4d,
     delta4d=None,

@@ -9,6 +9,10 @@ from .ncnet import (
     ncnet_forward,
     extract_features,
     match_pipeline,
+    c2f_stride,
+    c2f_is_degenerate,
+    c2f_coarse_from_features,
+    c2f_raw_matches_from_features,
 )
 
 __all__ = [
@@ -22,4 +26,8 @@ __all__ = [
     "ncnet_forward",
     "extract_features",
     "match_pipeline",
+    "c2f_stride",
+    "c2f_is_degenerate",
+    "c2f_coarse_from_features",
+    "c2f_raw_matches_from_features",
 ]

@@ -29,10 +29,12 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.c2f import c2f_refine_direction
 from ..ops.correlation import feature_correlation, feature_l2norm
 from ..ops.conv4d import neigh_consensus_apply, neigh_consensus_init
+from ..ops.matches import relocalize_and_coords
 from ..ops.mutual import mutual_matching
-from ..ops.pool4d import maxpool4d
+from ..ops.pool4d import avgpool2d_features, maxpool4d
 from .backbone import BackboneConfig, backbone_apply, backbone_init
 
 Params = Dict[str, Any]
@@ -63,11 +65,34 @@ class NCNetConfig:
     # fallback ladder (same never-materialize memory behavior, no Mosaic
     # dependency) if the Pallas kernel fails on a new backend/shape.
     fused_impl: str = "auto"
+    # Matching mode. 'oneshot' = the reference single-resolution pipeline.
+    # 'c2f' = coarse-to-fine (ops/c2f.py): stage 1 runs the pipeline on
+    # features pooled by c2f_coarse_factor; stage 2 re-runs consensus on
+    # static high-res windows around the c2f_topk surviving coarse cells
+    # (window half-extent c2f_radius coarse cells). factor 1 + topk
+    # covering every cell is the degenerate setting — it routes through
+    # the unmodified one-shot program (the exact-equivalence quality gate).
+    mode: str = "oneshot"
+    c2f_coarse_factor: int = 2
+    c2f_topk: int = 8  # <= 0 means refine every coarse cell
+    c2f_radius: int = 1
 
     def __post_init__(self):
         if self.fused_impl not in ("auto", "xla"):
             raise ValueError(
                 f"fused_impl must be 'auto' or 'xla', got {self.fused_impl!r}"
+            )
+        if self.mode not in ("oneshot", "c2f"):
+            raise ValueError(
+                f"mode must be 'oneshot' or 'c2f', got {self.mode!r}"
+            )
+        if self.c2f_coarse_factor < 1:
+            raise ValueError(
+                f"c2f_coarse_factor must be >= 1, got {self.c2f_coarse_factor}"
+            )
+        if self.c2f_radius < 0:
+            raise ValueError(
+                f"c2f_radius must be >= 0, got {self.c2f_radius}"
             )
 
     @property
@@ -237,3 +262,117 @@ def ncnet_forward_from_features(config: NCNetConfig, params: Params, feat_a,
         mutual1_maxes=mutual1_maxes,
     )
     return corr4d, delta4d
+
+
+# -- coarse-to-fine composition (mode='c2f') --------------------------------
+
+
+def c2f_stride(config: NCNetConfig) -> int:
+    """Fine cells per coarse cell per axis: pool factor x relocalization k.
+
+    With relocalization, stage 1 maxpool4d's the COARSE correlation, so one
+    coarse tensor cell covers factor*k fine feature cells. Fine feature
+    grids must be divisible by this stride on both axes (the aligned-block
+    splice invariant, ops/c2f.py).
+    """
+    return config.c2f_coarse_factor * max(config.relocalization_k_size, 1)
+
+
+def c2f_is_degenerate(config: NCNetConfig, feat_a_shape, feat_b_shape) -> bool:
+    """Static (trace-time) predicate: do the c2f knobs reduce to one-shot?
+
+    True when nothing is pooled (factor 1) and the top-K gate keeps every
+    coarse cell in BOTH probe directions — stage 1 is then exactly the
+    one-shot forward and refinement would recompute what it already has,
+    so callers run the unmodified one-shot program instead (bit-identical
+    by construction; the factor-1 equivalence test pins this).
+    """
+    if config.c2f_coarse_factor != 1:
+        return False
+    if config.c2f_topk <= 0:
+        return True
+    k = max(config.relocalization_k_size, 1)
+    cells = max(
+        (shp[-2] // k) * (shp[-1] // k)
+        for shp in (feat_a_shape, feat_b_shape)
+    )
+    return config.c2f_topk >= cells
+
+
+def c2f_coarse_from_features(config: NCNetConfig, params: Params, feat_a,
+                             feat_b, final_mutual: bool = True):
+    """Stage 1: pool the feature grids, run the unmodified pipeline.
+
+    Everything downstream of the pooling — correlation, fused corr+pool,
+    relocalization, autotuned consensus — is ncnet_forward_from_features
+    verbatim at the smaller shape signature, so the autotuner and
+    branch-fuse arms apply unchanged.
+    """
+    f = config.c2f_coarse_factor
+    renorm = (config.normalize_features
+              and config.backbone.cnn != "resnet101fpn")
+    coarse_a = avgpool2d_features(feat_a, f, renorm=renorm)
+    coarse_b = avgpool2d_features(feat_b, f, renorm=renorm)
+    return ncnet_forward_from_features(
+        config, params, coarse_a, coarse_b, final_mutual=final_mutual
+    )
+
+
+def c2f_raw_matches_from_features(
+    config: NCNetConfig,
+    params: Params,
+    feat_a,
+    feat_b,
+    *,
+    both_directions: bool = True,
+    invert_direction: bool = False,
+    scale: str = "positive",
+):
+    """Coarse-to-fine match extraction from backbone features.
+
+    Runs stage 1 (coarse pipeline) then, per probe direction, the stage-2
+    gate -> window gather -> window consensus -> splice (ops/c2f.py), and
+    maps the spliced fine indices to normalized coordinates through the
+    shared relocalize_and_coords tail (delta4d=None, k_size=1: the spliced
+    indices are already at fine-grid granularity).
+
+    Scores are raw filtered-consensus values (no softmax) — see
+    ops.c2f.splice_matches for why a softmax over the spliced field is
+    ill-defined. Unsorted; callers sort/recenter as needed
+    (evals.inloc.c2f_device_matches).
+
+    Returns (xA, yA, xB, yB, score) each [1, n]; with both_directions the
+    per-B and per-A fields are concatenated in that order (the
+    _raw_matches_xla convention).
+    """
+    if feat_a.shape[0] != 1 or feat_b.shape[0] != 1:
+        raise ValueError("c2f matching is per-pair (batch 1); batch via scan")
+    coarse4d, _delta = c2f_coarse_from_features(config, params, feat_a, feat_b)
+    stride = c2f_stride(config)
+    fine_shape = (feat_a.shape[2], feat_a.shape[3],
+                  feat_b.shape[2], feat_b.shape[3])
+    kwargs = dict(
+        stride=stride, radius=config.c2f_radius, topk=config.c2f_topk,
+        symmetric=config.symmetric_mode, corr_dtype=config.corr_dtype,
+    )
+    consensus = params["neigh_consensus"]
+
+    def direction(invert):
+        if invert:  # one match per fine A cell: probe = A, native layout
+            i_a, j_a, i_b, j_b, score = c2f_refine_direction(
+                consensus, coarse4d, feat_a, feat_b, **kwargs
+            )
+        else:  # one match per fine B cell: transpose roles
+            coarse_t = jnp.transpose(coarse4d, (0, 1, 4, 5, 2, 3))
+            i_b, j_b, i_a, j_a, score = c2f_refine_direction(
+                consensus, coarse_t, feat_b, feat_a, **kwargs
+            )
+        return relocalize_and_coords(
+            i_a, j_a, i_b, j_b, score, None, 1, fine_shape, scale
+        )
+
+    if both_directions:
+        d0 = direction(False)
+        d1 = direction(True)
+        return tuple(jnp.concatenate([u, v], axis=1) for u, v in zip(d0, d1))
+    return direction(invert_direction)

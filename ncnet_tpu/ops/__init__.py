@@ -9,7 +9,16 @@ from .conv4d import (
     neigh_consensus_init,
 )
 from .mutual import mutual_matching
-from .pool4d import maxpool4d
+from .pool4d import avgpool2d_features, maxpool4d
+from .c2f import (
+    c2f_refine_direction,
+    coarse_gate,
+    gather_windows,
+    refine_consensus,
+    refine_from_gate,
+    splice_matches,
+    window_correlation,
+)
 from .matches import (
     corr_to_matches,
     nearest_neighbour_point_transfer,
@@ -26,7 +35,15 @@ __all__ = [
     "neigh_consensus_apply",
     "neigh_consensus_init",
     "mutual_matching",
+    "avgpool2d_features",
     "maxpool4d",
+    "c2f_refine_direction",
+    "coarse_gate",
+    "gather_windows",
+    "refine_consensus",
+    "refine_from_gate",
+    "splice_matches",
+    "window_correlation",
     "corr_to_matches",
     "nearest_neighbour_point_transfer",
     "bilinear_point_transfer",

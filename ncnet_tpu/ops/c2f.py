@@ -1,0 +1,243 @@
+"""Coarse-to-fine refinement ops: gate, window gather, window consensus, splice.
+
+The one-shot pipeline pays for consensus on the FULL 4-D tensor
+(O((h*w)^2) cells); docs/NEXT.md's roofline verdict pinned that cost at the
+reference shape. The coarse-to-fine path (X-Resolution Correspondence
+Networks, arXiv:2012.09842) shrinks the tensor instead of re-scheduling it:
+stage 1 runs the existing stack on features pooled by `factor`, cutting the
+4-D cell count by factor^4; stage 2 re-runs consensus only on static-shape
+high-res windows around the top-K surviving coarse cells. The full fine 4-D
+tensor NEVER materializes — the window correlation einsum builds only the
+[K, 1, s, s, wbh, wbw] sub-tensors — which is what opens feature grids the
+one-shot path cannot afford.
+
+Everything here is pure jnp with static shapes (top-K, window extents and
+the splice layout are all trace-time constants), so a jitted caller stays
+bucketable under utils/batching.ShapeBuckets.
+
+Layout invariant: each coarse cell covers an aligned `stride x stride`
+block of the fine grid (stride = pool factor x relocalization k), so the
+fine dims must be divisible by the stride — callers (models.ncnet,
+serving.engine's shape snapping) enforce that.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .conv4d import neigh_consensus_apply
+from .mutual import mutual_matching
+
+
+def coarse_gate(coarse4d, topk: int):
+    """Per-coarse-A-cell match statistics + top-K surviving cells.
+
+    Args:
+      coarse4d: [1, 1, Ha, Wa, Hb, Wb] filtered coarse tensor (the
+        stage-1 match_pipeline output).
+      topk: number of coarse A cells to refine; <= 0 means all cells.
+
+    Returns:
+      (top_scores [K], top_cells [K] int32 flat A-cell indices,
+       cell_scores [Ha*Wa] f32 per-cell best score,
+       matched_b [Ha*Wa] int32 flat argmax B cell). K is static:
+      min(topk, Ha*Wa) (or Ha*Wa when topk <= 0).
+    """
+    b, c, ha, wa, hb, wb = coarse4d.shape
+    if b != 1 or c != 1:
+        raise ValueError(f"coarse_gate expects [1, 1, ...], got {coarse4d.shape}")
+    # Minor-axis reduce over B cells — the TPU-fast axis class
+    # (ops/matches._minor_score_argmax).
+    flat = coarse4d.reshape(ha * wa, hb * wb).astype(jnp.float32)
+    cell_scores = jnp.max(flat, axis=-1)
+    matched_b = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    n = ha * wa
+    k = n if topk <= 0 else min(topk, n)
+    top_scores, top_cells = jax.lax.top_k(cell_scores, k)
+    return top_scores, top_cells.astype(jnp.int32), cell_scores, matched_b
+
+
+def gather_windows(feat_a, feat_b, top_cells, matched_b, *, stride: int,
+                   radius: int, coarse_shape):
+    """Crop fine-feature windows around the surviving coarse cells.
+
+    The A window of a coarse cell is its aligned stride x stride fine
+    block (exact — no clipping needed). The B window is a static-shape
+    (2*radius+1)*stride crop centered on the matched coarse B cell,
+    clipped to the grid. Starts are clipped EXPLICITLY rather than left
+    to dynamic_slice's clamping, because they also feed the coordinate
+    splice (splice_matches) and must equal what was actually sliced.
+
+    Returns (win_a [K, C, s, s], win_b [K, C, wbh, wbw],
+             start_bi [K] int32, start_bj [K] int32).
+    """
+    ha, wa, hb, wb = coarse_shape
+    s = stride
+    _, ch, fha, fwa = feat_a.shape
+    _, _, fhb, fwb = feat_b.shape
+    wbh = min((2 * radius + 1) * s, fhb)
+    wbw = min((2 * radius + 1) * s, fwb)
+
+    ia = top_cells // wa
+    ja = top_cells % wa
+    mb = jnp.take(matched_b, top_cells)
+    ib = mb // wb
+    jb = mb % wb
+    start_ai = ia * s
+    start_aj = ja * s
+    start_bi = jnp.clip(ib * s + s // 2 - wbh // 2, 0, fhb - wbh)
+    start_bj = jnp.clip(jb * s + s // 2 - wbw // 2, 0, fwb - wbw)
+
+    fa = feat_a[0]
+    fb = feat_b[0]
+
+    def slice_a(i0, j0):
+        return jax.lax.dynamic_slice(fa, (0, i0, j0), (ch, s, s))
+
+    def slice_b(i0, j0):
+        return jax.lax.dynamic_slice(fb, (0, i0, j0), (ch, wbh, wbw))
+
+    win_a = jax.vmap(slice_a)(start_ai, start_aj)
+    win_b = jax.vmap(slice_b)(start_bi, start_bj)
+    return win_a, win_b, start_bi.astype(jnp.int32), start_bj.astype(jnp.int32)
+
+
+def window_correlation(win_a, win_b, compute_dtype=jnp.bfloat16):
+    """Per-window 4-D correlation: [K,C,s,s] x [K,C,wbh,wbw] -> [K,1,s,s,wbh,wbw].
+
+    Same numerics as ops.correlation.feature_correlation (bf16 contraction,
+    f32 accumulation), batched over the K windows — the only fine-resolution
+    correlation that ever materializes.
+    """
+    corr = jnp.einsum(
+        "kcij,kcmn->kijmn",
+        win_a.astype(compute_dtype),
+        win_b.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return corr[:, None]
+
+
+def refine_consensus(consensus_params, win_corr, *, symmetric: bool = True,
+                     corr_dtype=jnp.float32):
+    """mutual -> neighborhood consensus -> mutual on the window stack.
+
+    The windows ride the batch axis, and both mutual_matching and
+    neigh_consensus_apply reduce/convolve per batch element, so each
+    window gets its own mutual-NN normalization — the semantics the
+    one-shot pipeline applies globally, restricted to the crop.
+    """
+    c = win_corr.astype(corr_dtype)
+    c = mutual_matching(c)
+    c = neigh_consensus_apply(consensus_params, c, symmetric=symmetric)
+    c = mutual_matching(c)
+    return c.astype(jnp.float32)
+
+
+def splice_matches(refined, top_cells, cell_scores, matched_b, start_bi,
+                   start_bj, *, coarse_shape, fine_shape, stride: int):
+    """Splice refined window matches over the coarse fallback field.
+
+    Every fine probe cell gets a match (the downstream extraction and
+    bilinear transfer contracts assume a dense row-major field): cells
+    inside a surviving window take the refined per-subcell argmax over
+    their B window; all other cells fall back to the center of their
+    coarse cell's matched coarse B cell, carrying the coarse score.
+    Refined and fallback scores are both raw filtered-consensus values
+    (no softmax — a softmax over the mixed field would normalize across
+    two different tensors).
+
+    Args:
+      refined: [K, 1, s, s, wbh, wbw] filtered window stack.
+      top_cells / cell_scores / matched_b: from :func:`coarse_gate`.
+      start_bi / start_bj: from :func:`gather_windows`.
+      coarse_shape: (Ha, Wa, Hb, Wb); fine_shape: (fha, fwa, fhb, fwb).
+
+    Returns:
+      (i_a, j_a, i_b, j_b, score), each [1, fha*fwa] row-major over the
+      full fine probe grid — the index-level contract of
+      ops.matches.corr_to_matches before relocalize_and_coords.
+    """
+    ha, wa, hb, wb = coarse_shape
+    fha, fwa, fhb, fwb = fine_shape
+    s = stride
+    k = refined.shape[0]
+    wbh, wbw = refined.shape[4], refined.shape[5]
+
+    fi = jnp.arange(fha, dtype=jnp.int32)
+    fj = jnp.arange(fwa, dtype=jnp.int32)
+    cell = ((fi[:, None] // s) * wa + fj[None, :] // s).reshape(-1)
+    mb = jnp.take(matched_b, cell)
+    base_ib = jnp.clip((mb // wb) * s + s // 2, 0, fhb - 1)
+    base_jb = jnp.clip((mb % wb) * s + s // 2, 0, fwb - 1)
+    base_score = jnp.take(cell_scores, cell)
+    i_a = jnp.repeat(fi, fwa)
+    j_a = jnp.tile(fj, fha)
+
+    # Per-subcell argmax over the window's B extent (minor-axis reduce),
+    # mapped to global fine-B indices via the window starts.
+    flat = refined.reshape(k, s * s, wbh * wbw)
+    r_score = jnp.max(flat, axis=-1)
+    r_idx = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    r_ib = start_bi[:, None] + r_idx // wbw
+    r_jb = start_bj[:, None] + r_idx % wbw
+
+    ia_c = top_cells // wa
+    ja_c = top_cells % wa
+    d = jnp.arange(s, dtype=jnp.int32)
+    rows = (
+        (ia_c[:, None, None] * s + d[None, :, None]) * fwa
+        + ja_c[:, None, None] * s + d[None, None, :]
+    ).reshape(-1)
+
+    # Distinct top-K cells own disjoint aligned blocks, so the scattered
+    # rows never collide.
+    score = base_score.at[rows].set(r_score.reshape(-1))
+    out_ib = base_ib.at[rows].set(r_ib.reshape(-1))
+    out_jb = base_jb.at[rows].set(r_jb.reshape(-1))
+    return (i_a[None], j_a[None], out_ib[None], out_jb[None], score[None])
+
+
+def refine_from_gate(consensus_params, top_cells, cell_scores, matched_b,
+                     feat_a, feat_b, *, coarse_shape, stride: int,
+                     radius: int, symmetric: bool = True,
+                     corr_dtype=jnp.float32):
+    """Stage 2 from precomputed gate arrays: gather -> correlate ->
+    consensus -> splice. Split out of :func:`c2f_refine_direction` so a
+    serving engine can run the gate (stage 1) and the refinement (stage 2)
+    as separate device programs with a host decision point between.
+    """
+    win_a, win_b, start_bi, start_bj = gather_windows(
+        feat_a, feat_b, top_cells, matched_b, stride=stride, radius=radius,
+        coarse_shape=coarse_shape,
+    )
+    corr = window_correlation(win_a, win_b)
+    refined = refine_consensus(
+        consensus_params, corr, symmetric=symmetric, corr_dtype=corr_dtype
+    )
+    fine_shape = (feat_a.shape[2], feat_a.shape[3],
+                  feat_b.shape[2], feat_b.shape[3])
+    return splice_matches(
+        refined, top_cells, cell_scores, matched_b, start_bi, start_bj,
+        coarse_shape=coarse_shape, fine_shape=fine_shape, stride=stride,
+    )
+
+
+def c2f_refine_direction(consensus_params, coarse4d, feat_a, feat_b, *,
+                         stride: int, radius: int, topk: int,
+                         symmetric: bool = True, corr_dtype=jnp.float32):
+    """Full stage-2 for one probe direction (one match per fine A cell).
+
+    For the per-B direction, call with the coarse tensor transposed
+    (0, 1, 4, 5, 2, 3) and the features swapped, then reorder the outputs.
+    """
+    _, _, ha, wa, hb, wb = coarse4d.shape
+    _top_scores, top_cells, cell_scores, matched_b = coarse_gate(
+        coarse4d, topk
+    )
+    return refine_from_gate(
+        consensus_params, top_cells, cell_scores, matched_b, feat_a, feat_b,
+        coarse_shape=(ha, wa, hb, wb), stride=stride, radius=radius,
+        symmetric=symmetric, corr_dtype=corr_dtype,
+    )

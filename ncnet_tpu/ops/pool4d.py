@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from .correlation import feature_l2norm
+
 
 def maxpool4d(corr4d, k_size: int = 4):
     """Blockwise 4-D max pool with relative-offset argmax decode.
@@ -38,3 +40,37 @@ def maxpool4d(corr4d, k_size: int = 4):
     max_j = (idx // (k * k)) % k
     max_i = idx // (k * k * k)
     return pooled, (max_i, max_j, max_k, max_l)
+
+
+def avgpool2d_features(feats, factor: int, renorm: bool = True,
+                       eps: float = 1e-6):
+    """Blockwise 2-D average pool of a feature grid (coarse-to-fine stage 1).
+
+    Same reshape-to-expose-blocks formulation as :func:`maxpool4d` — no
+    replicated intermediate. Average (not max) pooling keeps the pooled
+    descriptor a convex blend of its block, so the coarse correlation is a
+    smoothed proxy of the fine one rather than a per-channel winner mix.
+
+    Args:
+      feats: [b, c, h, w] with h and w divisible by factor.
+      factor: pooling factor per spatial dim; 1 returns feats unchanged.
+      renorm: re-apply per-cell L2 normalization after pooling (averaging
+        L2-normalized descriptors shrinks their norm, which would scale the
+        whole coarse correlation tensor down).
+
+    Returns:
+      [b, c, h/factor, w/factor] in the input dtype.
+    """
+    if factor == 1:
+        return feats
+    b, c, h, w = feats.shape
+    f = factor
+    if h % f or w % f:
+        raise ValueError(
+            f"feature grid {h}x{w} not divisible by pool factor {f}"
+        )
+    x = feats.reshape(b, c, h // f, f, w // f, f)
+    pooled = jnp.mean(x.astype(jnp.float32), axis=(3, 5))
+    if renorm:
+        pooled = feature_l2norm(pooled, eps=eps)
+    return pooled.astype(feats.dtype)

@@ -103,6 +103,7 @@ class MatchClient:
         pano_bytes: Optional[bytes] = None,
         deadline_ms: Optional[float] = None,
         max_matches: Optional[int] = None,
+        mode: Optional[str] = None,
     ) -> dict:
         """POST /v1/match; returns the response dict on 200.
 
@@ -127,6 +128,8 @@ class MatchClient:
             body["deadline_ms"] = deadline_ms
         if max_matches is not None:
             body["max_matches"] = max_matches
+        if mode is not None:
+            body["mode"] = mode
         session = self._policy.session()
         while True:
             status, payload, headers = self._request(

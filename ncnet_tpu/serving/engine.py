@@ -27,6 +27,7 @@ over unchanged).
 from __future__ import annotations
 
 import base64
+import dataclasses
 import io
 import threading
 import time
@@ -40,7 +41,24 @@ from ..obs import trace
 from ..reliability import failpoints
 from ..cli.eval_inloc import inloc_resize_shape, resolve_feat_units
 from ..evals import dedup_matches, inloc_device_matches
-from ..models.ncnet import extract_features, ncnet_forward_from_features
+from ..evals.inloc import _sort_and_recenter
+from ..models.ncnet import (
+    c2f_coarse_from_features,
+    c2f_is_degenerate,
+    c2f_stride,
+    extract_features,
+    ncnet_forward_from_features,
+)
+from ..ops.c2f import coarse_gate, refine_from_gate
+from ..ops.matches import relocalize_and_coords
+
+#: Engine modes a request may select (`mode` knob on /v1/match).
+ENGINE_MODES = ("oneshot", "c2f")
+
+#: Backbone feature stride in pixels (the 1/16 scale_factor of
+#: inloc_resize_shape) — used to map bucket image dims to feature dims
+#: for the host-side c2f degeneracy decision.
+_FEAT_STRIDE_PX = 16
 
 
 @dataclass
@@ -58,6 +76,9 @@ class Prepared:
     #: numbers, chaos poison markers) — failpoint match predicates on
     #: ``engine.rider`` can target it to poison one specific pair.
     meta: Optional[dict] = None
+    #: Engine mode ('oneshot' | 'c2f') — part of the bucket key, so a
+    #: batch is mode-homogeneous and each mode compiles its own program.
+    mode: str = "oneshot"
 
 
 class MatchEngine:
@@ -85,8 +106,15 @@ class MatchEngine:
         device=None,
         cache=None,
         labels=None,
+        c2f_coarse_factor=None,
+        c2f_topk=None,
+        c2f_radius=None,
     ):
-        """``device``: pin this engine to one accelerator (a fleet builds
+        """``c2f_*``: override the config's coarse-to-fine knobs for this
+        engine (None keeps the config value) — the server CLI threads its
+        ``--c2f_*`` flags through here.
+
+        ``device``: pin this engine to one accelerator (a fleet builds
         one engine per device, serving/fleet.py) — params are committed
         there and every batch's input stacks are placed there, so N
         engines dispatch to N devices concurrently. None keeps jax's
@@ -105,6 +133,15 @@ class MatchEngine:
         # Per-instance metric labels ({"replica": ...} in a fleet); the
         # owning MatchServer sets this when it has a replica identity.
         self.labels = dict(labels or {})
+        overrides = {
+            k: v for k, v in (
+                ("c2f_coarse_factor", c2f_coarse_factor),
+                ("c2f_topk", c2f_topk),
+                ("c2f_radius", c2f_radius),
+            ) if v is not None
+        }
+        if overrides:
+            config = dataclasses.replace(config, **overrides)
         self.config = config
         self.device = device
         if device is not None:
@@ -175,6 +212,103 @@ class MatchEngine:
         self._batch_pairs_with_feats = _batch_pairs_with_feats
         self._batch_pairs_cached = _batch_pairs_cached
 
+        # -- coarse-to-fine programs (mode='c2f') -------------------------
+        # Two device programs with a host decision point between: stage 1
+        # extracts features, runs the pipeline on the POOLED grids and
+        # gates the top-K coarse cells per probe direction; stage 2
+        # gathers high-res windows around the survivors, re-runs consensus
+        # on the cropped sub-tensors and splices the refined matches.
+        # Features are cast to bf16 right after extraction — the cache's
+        # store dtype — so the cache-hit and miss paths stay bit-identical
+        # (the oneshot paths get this for free because correlation casts
+        # first; here the coarse pooling intervenes).
+        stride = c2f_stride(config)
+
+        def _c2f_stage1(params, feat_a, feat_b):
+            coarse4d, _delta = c2f_coarse_from_features(
+                config, params, feat_a, feat_b
+            )
+            # Gate both probe directions; per-B probes the transposed
+            # tensor (A<->B axis swap) with the feature roles swapped.
+            coarse_t = jnp.transpose(coarse4d, (0, 1, 4, 5, 2, 3))
+            return (coarse_gate(coarse_t, config.c2f_topk),
+                    coarse_gate(coarse4d, config.c2f_topk))
+
+        def _c2f_match_one(params, feat_a, feat_b, gate_b, gate_a):
+            consensus = params["neigh_consensus"]
+            s = stride
+            ha, wa = feat_a.shape[2] // s, feat_a.shape[3] // s
+            hb, wb = feat_b.shape[2] // s, feat_b.shape[3] // s
+            fine_shape = (feat_a.shape[2], feat_a.shape[3],
+                          feat_b.shape[2], feat_b.shape[3])
+            kw = dict(stride=s, radius=config.c2f_radius,
+                      symmetric=config.symmetric_mode,
+                      corr_dtype=config.corr_dtype)
+
+            def per_b():  # one match per fine B cell
+                _ts, tc, cs, mb = gate_b
+                i_b, j_b, i_a, j_a, score = refine_from_gate(
+                    consensus, tc, cs, mb, feat_b, feat_a,
+                    coarse_shape=(hb, wb, ha, wa), **kw)
+                return relocalize_and_coords(
+                    i_a, j_a, i_b, j_b, score, None, 1, fine_shape,
+                    "positive")
+
+            def per_a():  # one match per fine A cell
+                _ts, tc, cs, mb = gate_a
+                i_a, j_a, i_b, j_b, score = refine_from_gate(
+                    consensus, tc, cs, mb, feat_a, feat_b,
+                    coarse_shape=(ha, wa, hb, wb), **kw)
+                return relocalize_and_coords(
+                    i_a, j_a, i_b, j_b, score, None, 1, fine_shape,
+                    "positive")
+
+            if both_directions:
+                d0, d1 = per_b(), per_a()
+                raw = tuple(jnp.concatenate([u, v], axis=1)
+                            for u, v in zip(d0, d1))
+            else:
+                raw = per_a() if invert_direction else per_b()
+            return _sort_and_recenter(raw, fine_shape, 1)
+
+        @jax.jit
+        def _c2f_coarse(params, q_stack, t_stack):
+            def body(_, qt):
+                q, t = qt
+                fa = extract_features(config, params, q[None]).astype(
+                    jnp.bfloat16)
+                fb = extract_features(config, params, t[None]).astype(
+                    jnp.bfloat16)
+                return None, (fa, fb, _c2f_stage1(params, fa, fb))
+
+            _, out = jax.lax.scan(body, None, (q_stack, t_stack))
+            return out
+
+        @jax.jit
+        def _c2f_coarse_cached(params, q_stack, featb_stack):
+            def body(_, qf):
+                q, fb = qf
+                fa = extract_features(config, params, q[None]).astype(
+                    jnp.bfloat16)
+                fb = fb.astype(jnp.bfloat16)
+                return None, (fa, fb, _c2f_stage1(params, fa, fb))
+
+            _, out = jax.lax.scan(body, None, (q_stack, featb_stack))
+            return out
+
+        @jax.jit
+        def _c2f_refine(params, fa_stack, fb_stack, gates):
+            def body(_, x):
+                fa, fb, (gate_b, gate_a) = x
+                return None, _c2f_match_one(params, fa, fb, gate_b, gate_a)
+
+            _, ms = jax.lax.scan(body, None, (fa_stack, fb_stack, gates))
+            return ms
+
+        self._c2f_coarse = _c2f_coarse
+        self._c2f_coarse_cached = _c2f_coarse_cached
+        self._c2f_refine = _c2f_refine
+
         self.cache = cache
         if self.cache is None and cache_mb > 0:
             from ..evals.feature_cache import PanoFeatureCache
@@ -203,15 +337,25 @@ class MatchEngine:
 
     # -- host-side request preparation -----------------------------------
 
-    def _resize_shape(self, h: int, w: int) -> Tuple[int, int]:
+    def _resize_shape(self, h: int, w: int,
+                      mode: str = "oneshot") -> Tuple[int, int]:
         h_unit, w_unit = resolve_feat_units(
             self.feat_unit, self.image_size, self.k_size
         )
+        if mode == "c2f":
+            # The c2f splice needs BOTH fine feature axes divisible by
+            # the coarse stride (the aligned-block invariant, ops/c2f.py)
+            # — resolve_feat_units' extra_align only hardens the height
+            # unit, so lcm both axes here.
+            stride = c2f_stride(self.config)
+            h_unit = int(np.lcm(h_unit, stride))
+            w_unit = int(np.lcm(w_unit, stride))
         return inloc_resize_shape(
             h, w, self.image_size, self.k_size, h_unit=h_unit, w_unit=w_unit
         )
 
-    def _load_image(self, path: Optional[str], b64: Optional[str]
+    def _load_image(self, path: Optional[str], b64: Optional[str],
+                    mode: str = "oneshot"
                     ) -> Tuple[np.ndarray, Tuple[int, int]]:
         """Decode + bucket-resize + normalize one image (path or base64
         payload) into the model's [1, 3, H, W] layout."""
@@ -223,13 +367,13 @@ class MatchEngine:
         if path:
             with Image.open(path) as im:  # header-only dims read
                 w, h = im.size
-            oh, ow = self._resize_shape(h, w)
+            oh, ow = self._resize_shape(h, w, mode)
             chw, _ = load_and_resize_chw(path, oh, ow, normalize=True)
             return chw[None], (oh, ow)
         raw = base64.b64decode(b64)
         with Image.open(io.BytesIO(raw)) as im:
             img = np.asarray(im.convert("RGB"), dtype=np.float32)
-        oh, ow = self._resize_shape(*img.shape[:2])
+        oh, ow = self._resize_shape(*img.shape[:2], mode)
         chw = resize_bilinear_np(img, oh, ow).transpose(2, 0, 1)
         chw = normalize_image(chw / 255.0).astype(np.float32)
         return np.ascontiguousarray(chw)[None], (oh, ow)
@@ -238,7 +382,8 @@ class MatchEngine:
         """Decode/resize a request's images, probe the feature cache.
 
         Request schema (docs/SERVING.md): ``query_path`` | ``query_b64``
-        plus ``pano_path`` | ``pano_b64``; optional ``max_matches``.
+        plus ``pano_path`` | ``pano_b64``; optional ``max_matches`` and
+        ``mode`` ('oneshot' default | 'c2f' — the coarse-to-fine path).
         Raises ValueError on malformed input (the server maps it to 400).
         """
         if not isinstance(request, dict):
@@ -249,9 +394,14 @@ class MatchEngine:
             raise ValueError("exactly one of query_path/query_b64 required")
         if bool(p_path) == bool(p_b64):
             raise ValueError("exactly one of pano_path/pano_b64 required")
+        mode = str(request.get("mode", "oneshot") or "oneshot")
+        if mode not in ENGINE_MODES:
+            raise ValueError(
+                f"unknown mode {mode!r}; expected one of {ENGINE_MODES}"
+            )
         max_matches = int(request.get("max_matches", 0) or 0)
         try:
-            query, _ = self._load_image(q_path, q_b64)
+            query, _ = self._load_image(q_path, q_b64, mode)
         except (OSError, ValueError) as exc:
             raise ValueError(f"query image unreadable: {exc}") from exc
 
@@ -266,11 +416,11 @@ class MatchEngine:
                     pw, ph = im.size
             except (OSError, ValueError) as exc:
                 raise ValueError(f"pano image unreadable: {exc}") from exc
-            pano_shape = self._resize_shape(ph, pw)
+            pano_shape = self._resize_shape(ph, pw, mode)
             pano_feats = self.cache.get(p_path, pano_shape)
         if pano_feats is None:
             try:
-                pano, pano_shape = self._load_image(p_path, p_b64)
+                pano, pano_shape = self._load_image(p_path, p_b64, mode)
             except (OSError, ValueError) as exc:
                 raise ValueError(f"pano image unreadable: {exc}") from exc
 
@@ -278,21 +428,37 @@ class MatchEngine:
         # Hit and miss requests compile DIFFERENT programs, so the cache
         # state is part of the key (a hit riding a miss batch would need
         # its features re-derived; keep the buckets disjoint instead).
+        # The engine mode joins for the same reason: each mode is its own
+        # program family (and c2f snaps shapes to stride-aligned buckets).
         if pano_feats is not None:
             kind = ("feat", tuple(pano_feats.shape))
         else:
             kind = ("img", tuple(pano.shape[2:]))
         return Prepared(
-            bucket_key=(tuple(query.shape[2:]), kind),
+            bucket_key=(tuple(query.shape[2:]), kind, mode),
             query=query,
             pano=pano,
             pano_feats=pano_feats,
             pano_path=p_path if (p_path and self.cache is not None) else None,
             pano_shape=pano_shape,
             max_matches=max_matches,
+            mode=mode,
         )
 
     # -- batched device dispatch ------------------------------------------
+
+    def _c2f_bucket_degenerate(self, bucket_key) -> bool:
+        """Host-side mirror of models.ncnet.c2f_is_degenerate for one
+        bucket: map the bucket's image dims to feature dims (backbone
+        1/16 stride) and ask whether the c2f knobs reduce to one-shot."""
+        (qh, qw), kind, _mode = bucket_key
+        q_feat = (qh // _FEAT_STRIDE_PX, qw // _FEAT_STRIDE_PX)
+        if kind[0] == "feat":
+            p_feat = tuple(kind[1][-2:])
+        else:
+            ph, pw = kind[1]
+            p_feat = (ph // _FEAT_STRIDE_PX, pw // _FEAT_STRIDE_PX)
+        return c2f_is_degenerate(self.config, q_feat, p_feat)
 
     def run_batch(self, bucket_key, batch: List[Prepared]) -> List[dict]:
         """Run one same-bucket batch as one device dispatch; returns one
@@ -333,25 +499,79 @@ class MatchEngine:
         failpoints.fire("engine.device", payload=bucket_key)
         for p in batch:
             failpoints.fire("engine.rider", payload=p)
-        if mode == "cached":
-            ms = self._batch_pairs_cached(self.params, q_stack, f_stack)
-        elif mode == "with_feats":
-            ms, feats = self._batch_pairs_with_feats(
-                self.params, q_stack, t_stack
-            )
-            store = [(p, feats[k]) for k, p in enumerate(batch)
-                     if p.pano_path]
+        timing_extra = {}
+        if batch[0].mode == "c2f" and not self._c2f_bucket_degenerate(
+                bucket_key):
+            # Two-stage dispatch with a host decision point: the coarse
+            # gate scores cross to the host (stage timings + survivor
+            # counts), then the refinement program launches on the
+            # still-on-device feature/gate stacks. Children of the
+            # device span so a request trace shows both stages.
+            with trace.span("device", batch_size=len(batch)):
+                t_c = time.monotonic()
+                if mode == "cached":
+                    fa_s, fb_s, gates = self._c2f_coarse_cached(
+                        self.params, q_stack, f_stack)
+                else:
+                    fa_s, fb_s, gates = self._c2f_coarse(
+                        self.params, q_stack, t_stack)
+                top_b = np.asarray(self._jax.device_get(gates[0][0]))
+                top_a = np.asarray(self._jax.device_get(gates[1][0]))
+                coarse_s = time.monotonic() - t_c
+                trace.emit_span("coarse", dur_s=coarse_s,
+                                batch_size=len(batch))
+                obs.histogram("engine.c2f.coarse_s",
+                              labels=self.labels).observe(coarse_s)
+                surv = obs.histogram("engine.c2f.survivors",
+                                     labels=self.labels)
+                for k in range(len(batch)):
+                    surv.observe(float((top_b[k] > 0).sum()))
+                    surv.observe(float((top_a[k] > 0).sum()))
+                # Stage-2 gather failure domain: a refinement that dies
+                # AFTER a good coarse pass — the chaos site for partial
+                # c2f progress.
+                failpoints.fire("engine.refine", payload=bucket_key)
+                t_r = time.monotonic()
+                ms = self._c2f_refine(self.params, fa_s, fb_s, gates)
+                np_ms = self._jax.device_get(ms)
+                refine_s = time.monotonic() - t_r
+                trace.emit_span("refine", dur_s=refine_s,
+                                batch_size=len(batch))
+                obs.histogram("engine.c2f.refine_s",
+                              labels=self.labels).observe(refine_s)
+            if mode == "with_feats":
+                store = [(p, fb_s[k]) for k, p in enumerate(batch)
+                         if p.pano_path]
+            timing_extra = {"coarse_ms": coarse_s * 1e3,
+                            "refine_ms": refine_s * 1e3}
+            device_s = time.monotonic() - t_dev
         else:
-            ms = self._batch_pairs(self.params, q_stack, t_stack)
-        np_ms = self._jax.device_get(ms)
-        device_s = time.monotonic() - t_dev
-        trace.emit_span("device", dur_s=device_s, batch_size=len(batch))
+            if batch[0].mode == "c2f":
+                # Degenerate c2f knobs (factor 1, top-K = all): stage 1
+                # IS the one-shot program, so refinement would recompute
+                # what it already has — dispatch one-shot instead.
+                obs.counter("engine.c2f.refine_skipped",
+                            labels=self.labels).inc(len(batch))
+            if mode == "cached":
+                ms = self._batch_pairs_cached(self.params, q_stack, f_stack)
+            elif mode == "with_feats":
+                ms, feats = self._batch_pairs_with_feats(
+                    self.params, q_stack, t_stack
+                )
+                store = [(p, feats[k]) for k, p in enumerate(batch)
+                         if p.pano_path]
+            else:
+                ms = self._batch_pairs(self.params, q_stack, t_stack)
+            np_ms = self._jax.device_get(ms)
+            device_s = time.monotonic() - t_dev
+            trace.emit_span("device", dur_s=device_s, batch_size=len(batch))
         obs.histogram("serving.device_time_s",
                       labels=self.labels).observe(device_s)
 
         timing = {
             "batch_assemble_ms": assemble_s * 1e3,
             "device_ms": device_s * 1e3,
+            **timing_extra,
         }
         out = []
         for k, p in enumerate(batch):
@@ -375,43 +595,68 @@ class MatchEngine:
 
     # -- startup ----------------------------------------------------------
 
-    def warmup(self, raw_shapes, batch_sizes=(1,)) -> int:
-        """Precompile the match program for declared traffic buckets.
+    def warmup(self, raw_shapes, batch_sizes=(1,),
+               modes=("oneshot",)) -> int:
+        """Precompile the match programs for declared traffic buckets.
 
         ``raw_shapes``: iterable of (query_h, query_w, pano_h, pano_w)
         RAW pixel dims (deployment knows its camera/gallery resolutions;
-        the engine applies the same bucket snap requests get). Returns
-        the number of programs compiled. Compiles land in the persistent
-        compile cache, so a restarted replica warms from disk.
+        the engine applies the same bucket snap requests get).
+        ``modes``: which engine modes to compile per bucket — a
+        deployment expecting c2f traffic passes ("oneshot", "c2f") so
+        the first c2f request doesn't eat a cold compile under deadline
+        (the c2f entry warms BOTH stage programs; degenerate c2f knobs
+        warm the one-shot program that bucket actually dispatches).
+        Returns the number of (bucket, batch, mode) programs compiled.
+        Compiles land in the persistent compile cache, so a restarted
+        replica warms from disk.
         """
         from ncnet_tpu.ops import consensus_last_plan
 
         n = 0
         for qh, qw, ph, pw in raw_shapes:
-            q_shape = self._resize_shape(qh, qw)
-            p_shape = self._resize_shape(ph, pw)
-            for b in batch_sizes:
-                q = self._put(
-                    self._jnp.zeros((b, 3) + q_shape, self._jnp.float32))
-                t = self._put(
-                    self._jnp.zeros((b, 3) + p_shape, self._jnp.float32))
-                with obs.span("serving.warmup", q_shape=list(q_shape),
-                              p_shape=list(p_shape), batch=b):
-                    self._jax.block_until_ready(
-                        self._batch_pairs(self.params, q, t)
+            for engine_mode in modes:
+                if engine_mode not in ENGINE_MODES:
+                    raise ValueError(
+                        f"unknown warmup mode {engine_mode!r}; expected "
+                        f"one of {ENGINE_MODES}"
                     )
-                # The trace above consulted the strategy cache
-                # (ops/autotune.py) for this bucket's consensus shape;
-                # surface what it resolved — tuned plan or heuristic —
-                # so a replica's run log shows which buckets are tuned.
-                plan = consensus_last_plan()
-                if plan is not None:
-                    obs.event("autotune", action="consult",
-                              where="serving.warmup",
-                              q_shape=list(q_shape),
-                              p_shape=list(p_shape), batch=b,
-                              cache_hit=plan.get("cache_hit"),
-                              ms=plan.get("cache_ms"), plan=plan)
-                n += 1
+                q_shape = self._resize_shape(qh, qw, engine_mode)
+                p_shape = self._resize_shape(ph, pw, engine_mode)
+                c2f_live = engine_mode == "c2f" and \
+                    not self._c2f_bucket_degenerate(
+                        (q_shape, ("img", p_shape), engine_mode))
+                for b in batch_sizes:
+                    q = self._put(
+                        self._jnp.zeros((b, 3) + q_shape, self._jnp.float32))
+                    t = self._put(
+                        self._jnp.zeros((b, 3) + p_shape, self._jnp.float32))
+                    with obs.span("serving.warmup", q_shape=list(q_shape),
+                                  p_shape=list(p_shape), batch=b,
+                                  mode=engine_mode):
+                        if c2f_live:
+                            coarse = self._c2f_coarse(self.params, q, t)
+                            self._jax.block_until_ready(coarse)
+                            self._jax.block_until_ready(
+                                self._c2f_refine(self.params, *coarse)
+                            )
+                        else:
+                            self._jax.block_until_ready(
+                                self._batch_pairs(self.params, q, t)
+                            )
+                    # The trace above consulted the strategy cache
+                    # (ops/autotune.py) for this bucket's consensus
+                    # shape; surface what it resolved — tuned plan or
+                    # heuristic — so a replica's run log shows which
+                    # buckets are tuned.
+                    plan = consensus_last_plan()
+                    if plan is not None:
+                        obs.event("autotune", action="consult",
+                                  where="serving.warmup",
+                                  q_shape=list(q_shape),
+                                  p_shape=list(p_shape), batch=b,
+                                  cache_hit=plan.get("cache_hit"),
+                                  ms=plan.get("cache_ms"), plan=plan)
+                    n += 1
         obs.counter("serving.warmup_programs", labels=self.labels).inc(n)
         return n

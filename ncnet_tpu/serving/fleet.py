@@ -229,10 +229,12 @@ class MatchFleet:
             r.start()
         return self
 
-    def warmup(self, raw_shapes, batch_sizes=(1,)) -> int:
+    def warmup(self, raw_shapes, batch_sizes=(1,),
+               modes=("oneshot",)) -> int:
         """Precompile declared buckets on every replica. Replica 0 pays
         the trace; the rest mostly hit the persistent compile cache."""
-        return sum(r.engine.warmup(raw_shapes, batch_sizes=batch_sizes)
+        return sum(r.engine.warmup(raw_shapes, batch_sizes=batch_sizes,
+                                   modes=modes)
                    for r in self.replicas if r.engine is not None)
 
     def close(self, timeout_s: float = 60.0) -> None:

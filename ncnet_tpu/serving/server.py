@@ -470,6 +470,11 @@ class MatchServer:
             "respond_ms": round(respond_s * 1e3, 3),
             "total_ms": round(e2e_s * 1e3, 3),
         }
+        # Mode-specific stage timings (c2f coarse_ms/refine_ms) ride
+        # through: the device_ms split is the first thing an operator
+        # asks for when a two-stage request is slow.
+        for key, val in engine_timing.items():
+            payload["timing"].setdefault(key, round(val, 3))
         obs.counter("serving.responses", labels=self.labels).inc()
         obs.histogram("serving.e2e_latency_s",
                       labels=self.labels).observe(e2e_s)
@@ -595,6 +600,21 @@ def main(argv=None):
         "pixel dims (repeatable)",
     )
     parser.add_argument(
+        "--warmup_modes", type=str, default="oneshot",
+        help="comma list of engine modes to warm per --warmup bucket "
+        "(oneshot,c2f) — warm c2f too when clients send mode=c2f, so "
+        "their first request doesn't pay the two-stage compile",
+    )
+    parser.add_argument("--c2f_coarse_factor", type=int, default=None,
+                        help="coarse-to-fine feature pool factor "
+                        "(default: model config)")
+    parser.add_argument("--c2f_topk", type=int, default=None,
+                        help="coarse cells refined per image, <=0 = all "
+                        "(default: model config)")
+    parser.add_argument("--c2f_radius", type=int, default=None,
+                        help="refinement window half-extent in coarse "
+                        "cells (default: model config)")
+    parser.add_argument(
         "--run_log", type=str, default="",
         help="structured JSONL run log path (empty disables)",
     )
@@ -626,7 +646,12 @@ def main(argv=None):
         k_size=args.k_size,
         image_size=args.image_size,
         feat_unit=args.feat_unit,
+        c2f_coarse_factor=args.c2f_coarse_factor,
+        c2f_topk=args.c2f_topk,
+        c2f_radius=args.c2f_radius,
     )
+    warmup_modes = tuple(
+        m for m in args.warmup_modes.split(",") if m) or ("oneshot",)
     if args.replicas > 0:
         from .fleet import MatchFleet
 
@@ -654,7 +679,8 @@ def main(argv=None):
               file=sys.stderr, flush=True)
         if args.warmup:
             shapes, batches = _parse_warmup(args.warmup)
-            n = fleet.warmup(shapes, batch_sizes=batches)
+            n = fleet.warmup(shapes, batch_sizes=batches,
+                             modes=warmup_modes)
             print(f"warmup: {n} programs compiled (fleet-wide)",
                   file=sys.stderr, flush=True)
         if args.prewarm and fleet.store is not None:
@@ -683,7 +709,8 @@ def main(argv=None):
         )
         if args.warmup:
             shapes, batches = _parse_warmup(args.warmup)
-            n = engine.warmup(shapes, batch_sizes=batches)
+            n = engine.warmup(shapes, batch_sizes=batches,
+                              modes=warmup_modes)
             print(f"warmup: {n} programs compiled", file=sys.stderr,
                   flush=True)
 

@@ -1,0 +1,286 @@
+"""Coarse-to-fine matching: ops bookkeeping, factor-1 equivalence, eval path.
+
+The c2f mode's quality story rests on two invariants this file pins:
+
+* Degenerate knobs (factor 1, top-K >= all cells) route through the
+  UNMODIFIED one-shot program — bit-identical outputs, relocalization
+  included — so turning the mode on with neutral knobs can never change
+  a result (the exact quality gate of docs/PERF.md).
+* The live path's crop/splice bookkeeping is exact: window starts equal
+  what was sliced, refined rows land on their aligned fine-grid blocks,
+  and every non-refined cell carries its coarse fallback — checked here
+  on hand-built tensors and on ragged, non-square grids.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.evals import c2f_device_matches, inloc_device_matches
+from ncnet_tpu.models import BackboneConfig, NCNetConfig, ncnet_init
+from ncnet_tpu.models.ncnet import (
+    c2f_coarse_from_features,
+    c2f_is_degenerate,
+    c2f_raw_matches_from_features,
+    c2f_stride,
+    ncnet_forward_from_features,
+)
+from ncnet_tpu.ops import avgpool2d_features
+from ncnet_tpu.ops.c2f import coarse_gate, gather_windows, splice_matches
+
+
+def _cfg(**kw):
+    base = dict(
+        backbone=BackboneConfig(cnn="vgg", last_layer="pool3"),
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(4, 1),
+        relocalization_k_size=2,
+        mode="c2f",
+        c2f_coarse_factor=2,
+        c2f_topk=4,
+        c2f_radius=1,
+    )
+    base.update(kw)
+    return NCNetConfig(**base)
+
+
+def _feats(key, c, h, w):
+    f = jax.random.normal(key, (1, c, h, w), jnp.float32)
+    return f / jnp.linalg.norm(f, axis=1, keepdims=True)
+
+
+# -- config ---------------------------------------------------------------
+
+
+def test_config_validates_c2f_knobs():
+    with pytest.raises(ValueError):
+        _cfg(mode="bogus")
+    with pytest.raises(ValueError):
+        _cfg(c2f_coarse_factor=0)
+    with pytest.raises(ValueError):
+        _cfg(c2f_radius=-1)
+    assert c2f_stride(_cfg()) == 4                # factor 2 x reloc k 2
+    assert c2f_stride(_cfg(relocalization_k_size=1)) == 2
+
+
+def test_degenerate_predicate():
+    shp = (1, 8, 8, 8)
+    # Factor 1 + keep-everything gate -> one-shot by construction.
+    assert c2f_is_degenerate(_cfg(c2f_coarse_factor=1, c2f_topk=0),
+                             shp, shp)
+    # k=2 relocalization: 8x8 features -> 16 coarse cells per direction.
+    assert c2f_is_degenerate(_cfg(c2f_coarse_factor=1, c2f_topk=16),
+                             shp, shp)
+    assert not c2f_is_degenerate(_cfg(c2f_coarse_factor=1, c2f_topk=15),
+                                 shp, shp)
+    # Ragged: the gate must keep all cells in BOTH probe directions.
+    assert not c2f_is_degenerate(_cfg(c2f_coarse_factor=1, c2f_topk=16),
+                                 shp, (1, 8, 8, 10))
+    # Any real pooling is never degenerate.
+    assert not c2f_is_degenerate(_cfg(c2f_topk=0), shp, shp)
+
+
+# -- ops ------------------------------------------------------------------
+
+
+def test_avgpool2d_features():
+    f = jax.random.normal(jax.random.PRNGKey(0), (1, 6, 8, 12), jnp.float32)
+    p = avgpool2d_features(f, 2)
+    assert p.shape == (1, 6, 4, 6)
+    norms = jnp.linalg.norm(p, axis=1)
+    np.testing.assert_allclose(np.asarray(norms), 1.0, atol=1e-5)
+    raw = avgpool2d_features(f, 2, renorm=False)
+    np.testing.assert_allclose(
+        np.asarray(raw[0, :, 0, 0]),
+        np.asarray(f[0, :, :2, :2].mean(axis=(1, 2))), rtol=1e-5)
+    assert avgpool2d_features(f, 1) is f
+    with pytest.raises(ValueError):
+        avgpool2d_features(f, 3)  # 8 % 3 != 0
+
+
+def test_coarse_gate_statistics_and_topk():
+    flat = jnp.asarray([
+        [0.1, 0.9, 0.0, 0.2],
+        [0.5, 0.1, 0.3, 0.0],
+        [0.2, 0.2, 0.8, 0.1],
+        [0.0, 0.3, 0.1, 0.7],
+    ], jnp.float32)
+    coarse4d = flat.reshape(1, 1, 2, 2, 2, 2)
+    top_s, top_c, cell_s, mb = coarse_gate(coarse4d, 2)
+    np.testing.assert_allclose(np.asarray(top_s), [0.9, 0.8])
+    assert np.asarray(top_c).tolist() == [0, 2]
+    np.testing.assert_allclose(np.asarray(cell_s), [0.9, 0.5, 0.8, 0.7])
+    assert np.asarray(mb).tolist() == [1, 0, 2, 3]
+    # topk <= 0 keeps every cell; topk > n clamps.
+    for k in (0, 9):
+        top_s, top_c, _, _ = coarse_gate(coarse4d, k)
+        assert top_c.shape == (4,)
+        assert np.asarray(top_c).tolist() == [0, 2, 3, 1]
+    with pytest.raises(ValueError):
+        coarse_gate(jnp.zeros((2, 1, 2, 2, 2, 2)), 2)
+
+
+def test_gather_windows_starts_and_content():
+    ka, kb = jax.random.split(jax.random.PRNGKey(1))
+    feat_a = _feats(ka, 3, 8, 8)
+    feat_b = _feats(kb, 3, 8, 8)
+    top_cells = jnp.asarray([3], jnp.int32)        # coarse A cell (1, 1)
+    matched_b = jnp.asarray([0, 0, 0, 2], jnp.int32)  # -> B cell (1, 0)
+    win_a, win_b, sbi, sbj = gather_windows(
+        feat_a, feat_b, top_cells, matched_b, stride=4, radius=0,
+        coarse_shape=(2, 2, 2, 2),
+    )
+    assert win_a.shape == (1, 3, 4, 4) and win_b.shape == (1, 3, 4, 4)
+    # A window: the aligned fine block of coarse cell (1, 1), exact.
+    np.testing.assert_array_equal(
+        np.asarray(win_a[0]), np.asarray(feat_a[0, :, 4:8, 4:8]))
+    # B window: centered on B cell (1, 0), clipped into the grid — the
+    # returned starts must equal what was sliced.
+    assert (int(sbi[0]), int(sbj[0])) == (4, 0)
+    np.testing.assert_array_equal(
+        np.asarray(win_b[0]), np.asarray(feat_b[0, :, 4:8, 0:4]))
+    # radius 1 covers the whole 8-cell grid: starts clip to 0.
+    _, win_b, sbi, sbj = gather_windows(
+        feat_a, feat_b, top_cells, matched_b, stride=4, radius=1,
+        coarse_shape=(2, 2, 2, 2),
+    )
+    assert win_b.shape == (1, 3, 8, 8)
+    assert (int(sbi[0]), int(sbj[0])) == (0, 0)
+
+
+def test_splice_matches_bookkeeping():
+    """Refined rows land exactly on their aligned fine block; every other
+    row carries the coarse fallback (matched coarse-B cell center +
+    coarse score)."""
+    s, k = 2, 1
+    top_cells = jnp.asarray([3], jnp.int32)        # coarse A cell (1, 1)
+    cell_scores = jnp.asarray([0.1, 0.2, 0.3, 0.4], jnp.float32)
+    matched_b = jnp.asarray([0, 1, 2, 3], jnp.int32)
+    refined = jnp.zeros((k, 1, s, s, 4, 4), jnp.float32)
+    refined = refined.at[0, 0, 0, 0, 2, 3].set(5.0)
+    i_a, j_a, i_b, j_b, score = splice_matches(
+        refined, top_cells, cell_scores, matched_b,
+        jnp.asarray([0], jnp.int32), jnp.asarray([0], jnp.int32),
+        coarse_shape=(2, 2, 2, 2), fine_shape=(4, 4, 4, 4), stride=s,
+    )
+    i_a, j_a, i_b, j_b, score = (np.asarray(v)[0]
+                                 for v in (i_a, j_a, i_b, j_b, score))
+    assert i_a.tolist() == np.repeat(np.arange(4), 4).tolist()
+    assert j_a.tolist() == np.tile(np.arange(4), 4).tolist()
+    # Refined block: fine rows {10, 11, 14, 15} = coarse cell (1,1)*s.
+    # Subcell (0,0) (row 10) took the planted max at window B (2, 3).
+    assert (i_b[10], j_b[10], score[10]) == (2, 3, 5.0)
+    # Its siblings saw all-zero windows: argmax 0 -> window origin.
+    for row in (11, 14, 15):
+        assert (i_b[row], j_b[row], score[row]) == (0, 0, 0.0)
+    # Fallbacks: fine (0,0) -> coarse cell 0, matched B cell 0, whose
+    # fine-grid center is (1, 1); fine (0,3) -> coarse cell 1 -> B cell
+    # 1 -> center (1, 3). Scores are the coarse cell scores.
+    assert (i_b[0], j_b[0], score[0]) == (1, 1, np.float32(0.1))
+    assert (i_b[3], j_b[3], score[3]) == (1, 3, np.float32(0.2))
+
+
+# -- factor-1 equivalence (the exact quality gate) ------------------------
+
+
+@pytest.mark.parametrize("k_size", [1, 2])
+def test_factor1_topk_all_bit_identical_to_oneshot(k_size):
+    config = _cfg(relocalization_k_size=k_size, c2f_coarse_factor=1,
+                  c2f_topk=0)
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    ka, kb = jax.random.split(jax.random.PRNGKey(2))
+    feat_a = _feats(ka, 8, 8, 8)
+    feat_b = _feats(kb, 8, 8, 8)
+
+    oneshot = dataclasses.replace(config, mode="oneshot")
+    corr, delta = ncnet_forward_from_features(oneshot, params,
+                                              feat_a, feat_b)
+    ref = jax.jit(inloc_device_matches, static_argnames=("k_size",))(
+        corr, delta4d=delta, k_size=max(k_size, 1))
+    got = jax.jit(c2f_device_matches, static_argnums=0)(
+        config, params, feat_a, feat_b)
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+    # Stage 1 at factor 1 IS the one-shot forward, bitwise.
+    c_corr, c_delta = c2f_coarse_from_features(config, params,
+                                               feat_a, feat_b)
+    np.testing.assert_array_equal(np.asarray(c_corr), np.asarray(corr))
+    if delta is None:
+        assert c_delta is None
+    else:
+        np.testing.assert_array_equal(np.asarray(c_delta),
+                                      np.asarray(delta))
+
+
+# -- live path on ragged, non-square grids --------------------------------
+
+
+def test_c2f_live_ragged_grids():
+    config = _cfg(c2f_topk=3)
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+    ka, kb = jax.random.split(jax.random.PRNGKey(3))
+    feat_a = _feats(ka, 6, 16, 12)   # 16x12 vs 12x20: ragged AND
+    feat_b = _feats(kb, 6, 12, 20)   # non-square on both sides
+    outs = c2f_raw_matches_from_features(
+        config, params, feat_a, feat_b, both_directions=True,
+        scale="positive",
+    )
+    n = 12 * 20 + 16 * 12  # per-B field + per-A field
+    for o in outs:
+        assert o.shape == (1, n)
+        assert np.isfinite(np.asarray(o)).all()
+    xa, ya, xb, yb, _ = (np.asarray(o) for o in outs)
+    for v in (xa, ya, xb, yb):
+        assert (v >= 0.0).all() and (v <= 1.0).all()
+
+    # The sorted device-matches wrapper: descending scores, same count.
+    got = jax.jit(c2f_device_matches, static_argnums=0)(
+        config, params, feat_a, feat_b)
+    score = np.asarray(got[4])
+    assert score.shape == (n,)
+    assert (np.diff(score) <= 1e-6).all()
+
+    # Batch > 1 is a contract violation, not a silent wrong answer.
+    with pytest.raises(ValueError):
+        c2f_raw_matches_from_features(
+            config, params, jnp.concatenate([feat_a, feat_a]), feat_b)
+
+
+# -- eval harness ---------------------------------------------------------
+
+
+def test_evaluate_pck_c2f_modes(tmp_path):
+    """evaluate_pck under mode='c2f': the degenerate route scores
+    IDENTICALLY to one-shot, and the live route runs end to end on a
+    real (synthetic) dataset through the batched lax.map path."""
+    from tests.test_evals_data import _write_synthetic_dataset
+
+    from ncnet_tpu.cli.eval_pck import evaluate_pck
+    from ncnet_tpu.data import PFPascalDataset
+
+    root = str(tmp_path)
+    _write_synthetic_dataset(root, n_pairs=2, size=64)
+    dataset = PFPascalDataset(os.path.join(root, "eval.csv"), root,
+                              output_size=(64, 64))
+    config = _cfg()                 # vgg pool3: 64 px -> 8x8 features
+    params = ncnet_init(jax.random.PRNGKey(0), config)
+
+    oneshot = dataclasses.replace(config, mode="oneshot")
+    _, per_os = evaluate_pck(oneshot, params, dataset, batch_size=2,
+                             num_workers=1, verbose=False)
+    degen = dataclasses.replace(config, c2f_coarse_factor=1, c2f_topk=0)
+    _, per_deg = evaluate_pck(degen, params, dataset, batch_size=2,
+                              num_workers=1, verbose=False)
+    np.testing.assert_array_equal(per_os, per_deg)
+
+    _, per_c2f = evaluate_pck(config, params, dataset, batch_size=2,
+                              num_workers=1, verbose=False)
+    assert per_c2f.shape == per_os.shape
+    assert np.isfinite(per_c2f).all()
+    assert ((per_c2f >= 0) & (per_c2f <= 1)).all()

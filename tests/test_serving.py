@@ -596,3 +596,90 @@ def test_serving_e2e_env_failpoints_no_silent_drops(tiny_serving_model,
         assert obs.snapshot()["counters"]["failpoint.server.handle"] == 2.0
     finally:
         server.stop()
+
+
+def test_serving_e2e_c2f_mode(tiny_serving_model, monkeypatch):
+    """Coarse-to-fine over HTTP: mode='c2f' requests run the two-stage
+    engine path (coarse/refine stage timings in the response), land in
+    their own mode-keyed bucket, degrade cleanly under the engine.refine
+    failpoint, and leave one-shot requests on the same server untouched.
+    Degenerate knobs are covered engine-side: factor 1 + keep-all top-K
+    must dispatch the unmodified one-shot program bit-identically."""
+    from ncnet_tpu.reliability import failpoints
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=96,
+                         cache_mb=0, c2f_topk=4)
+    server = MatchServer(
+        engine, port=0, max_batch=2, max_queue=16, max_delay_s=0.05,
+        default_timeout_s=600.0,
+    ).start()
+    try:
+        client = MatchClient(server.url, timeout_s=600.0, retries=0)
+        qb = _jpeg_bytes(96, 128, 0)
+        pb = _jpeg_bytes(96, 128, 1)
+
+        r = client.match(query_bytes=qb, pano_bytes=pb, mode="c2f")
+        assert r["n_matches"] >= 1
+        assert all(len(row) == 5 for row in r["matches"])
+        # Two-stage path: per-stage timings rode the response, and the
+        # c2f stage metrics recorded the run.
+        assert r["timing"]["coarse_ms"] >= 0.0
+        assert r["timing"]["refine_ms"] >= 0.0
+        snap = obs.snapshot()["histograms"]
+        assert any(k.startswith("engine.c2f.coarse_s") for k in snap)
+        assert any(k.startswith("engine.c2f.survivors") for k in snap)
+
+        # One-shot on the same server: untouched timing schema.
+        r_os = client.match(query_bytes=qb, pano_bytes=pb)
+        assert r_os["n_matches"] >= 1
+        assert "coarse_ms" not in r_os["timing"]
+
+        # Unknown mode is the request's own fault: 400, not 500.
+        with pytest.raises(ServingError) as exc:
+            client.match(query_bytes=qb, pano_bytes=pb, mode="fine2coarse")
+        assert exc.value.status == 400
+
+        # The stage-2 failpoint (docs/RELIABILITY.md planted sites):
+        # injected fault surfaces as a structured error, and the very
+        # next c2f request serves normally.
+        monkeypatch.setenv("NCNET_FAILPOINTS", "engine.refine=error:1.0x1")
+        assert set(failpoints.configure_from_env()) == {"engine.refine"}
+        with pytest.raises(ServingError) as exc:
+            client.match(query_bytes=qb, pano_bytes=pb, mode="c2f")
+        assert exc.value.status == 500
+        r2 = client.match(query_bytes=qb, pano_bytes=pb, mode="c2f")
+        assert r2["n_matches"] >= 1
+    finally:
+        server.stop()
+
+
+def test_engine_c2f_degenerate_routes_oneshot(tiny_serving_model):
+    """Factor-1 + keep-everything knobs: the c2f bucket is degenerate,
+    run_batch dispatches the one-shot program (bit-identical matches),
+    and the refine_skipped counter records the routing decision."""
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0, c2f_coarse_factor=1, c2f_topk=0)
+    qb = _jpeg_bytes(96, 128, 0)
+    pb = _jpeg_bytes(96, 128, 1)
+    import base64
+
+    req = {"query_b64": base64.b64encode(qb).decode(),
+           "pano_b64": base64.b64encode(pb).decode()}
+    p_c2f = engine.prepare(dict(req, mode="c2f"))
+    p_os = engine.prepare(req)
+    assert p_c2f.bucket_key != p_os.bucket_key  # mode keys the bucket
+    assert engine._c2f_bucket_degenerate(p_c2f.bucket_key)
+    out_c2f = engine.run_batch(p_c2f.bucket_key, [p_c2f])
+    out_os = engine.run_batch(p_os.bucket_key, [p_os])
+    np.testing.assert_array_equal(out_c2f[0]["matches"],
+                                  out_os[0]["matches"])
+    assert "coarse_ms" not in out_c2f[0]["timing"]
+    counters = obs.snapshot()["counters"]
+    assert any(k.startswith("engine.c2f.refine_skipped")
+               for k in counters)

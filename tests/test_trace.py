@@ -458,3 +458,24 @@ def test_bench_trend_passes_bulk_fields_through(tmp_path, capsys):
     assert report["pairs_s"] == 120.0
     assert report["quarantined"] == 3
     assert report["resumes"] == 2
+
+
+def test_bench_trend_passes_c2f_fields_through(tmp_path, capsys):
+    """A c2f round's knobs and quality delta survive into the trend
+    report — a c2f_pairs_s trend is only readable next to the
+    coarse_factor/topk that produced it and the PCK delta that
+    licenses the speed (docs/PERF.md quality gate)."""
+    d = str(tmp_path)
+    rec = {"n": 1, "cmd": "bench", "rc": 0,
+           "parsed": {"metric": "inloc_dense_match_pairs_per_s_per_chip",
+                      "value": 9.7, "unit": "pairs/s/chip",
+                      "c2f_pairs_s": 6.2, "coarse_factor": 2, "topk": 8,
+                      "c2f_pck_delta": -0.004}}
+    with open(os.path.join(d, "BENCH_r01.json"), "w") as fh:
+        json.dump(rec, fh)
+    assert bench_trend.main(["--dir", d]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["c2f_pairs_s"] == 6.2
+    assert report["coarse_factor"] == 2
+    assert report["topk"] == 8
+    assert report["c2f_pck_delta"] == -0.004

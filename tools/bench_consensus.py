@@ -100,6 +100,30 @@ def main(argv=None):
         c = neigh_consensus_apply(params, c, symmetric=True, chunk_i=25)
         return mutual_matching(c)
 
+    def c2f_stage(c):
+        # The coarse-to-fine replacement for the full stage at this
+        # shape (ops/c2f.py, docs/PERF.md): coarse consensus at factor 2
+        # + two top-K window-stack refinements (per-B and per-A). Inputs
+        # are carved from `c` inside the jit so the case slots into the
+        # shared chain_reps/timed_steady loop unchanged.
+        from ncnet_tpu.ops.c2f import refine_consensus
+
+        s, topk = 4, 8
+        ii2, jj2 = ii // 2, jj // 2
+        wbh, wbw = min(3 * s, ii), min(3 * s, jj)
+        coarse = mutual_matching(c[:, :, :ii2, :jj2, :ii2, :jj2])
+        coarse = neigh_consensus_apply(
+            params, coarse, symmetric=True, chunk_i=0)
+        acc = jnp.sum(mutual_matching(coarse).astype(jnp.float32))
+        for off in (0, 1):
+            wins = jnp.stack(
+                [c[0, 0, (k + off) % s:(k + off) % s + s, :s, :wbh, :wbw]
+                 for k in range(topk)]
+            )[:, None].astype(jnp.float32)
+            acc = acc + jnp.sum(
+                refine_consensus(params, wins, corr_dtype=jnp.bfloat16))
+        return acc
+
     def convs_only(c):
         return neigh_consensus_apply(params, c, symmetric=True, chunk_i=0)
 
@@ -128,6 +152,7 @@ def main(argv=None):
     cases = [
         ("oneshot-auto (default, full stage)", full_stage, {}),
         ("chunk25-auto (chunked sanity)", chunked_stage, {}),
+        ("c2f stage (coarse f2 + topk windows)", c2f_stage, {}),
         ("convs-only symmetric", convs_only, {}),
         ("convs-only non-symmetric", convs_nonsym, {}),
         ("l1-only stacked (1->16)", l1_only, {}),

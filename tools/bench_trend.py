@@ -94,9 +94,14 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
     # pass it through so a trend over fleet rounds stays interpretable.
     # Likewise the bulk-pipeline headline (tools/bulk_match.py): a
     # corpus run's trend needs its completion/health counters.
+    # And the coarse-to-fine fields (bench.py c2f section +
+    # tools/real_parity.py --c2f): a c2f throughput trend is only
+    # readable next to the knobs that produced it and the PCK delta
+    # that licenses the speed.
     for key in ("replicas", "single_replica_pairs_per_s", "scaling_x",
                 "scaling_efficiency", "pairs_done", "pairs_s",
-                "quarantined", "resumes"):
+                "quarantined", "resumes",
+                "c2f_pairs_s", "coarse_factor", "topk", "c2f_pck_delta"):
         if key in latest:
             report[key] = latest[key]
     return report

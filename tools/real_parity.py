@@ -168,7 +168,70 @@ def run_pfpascal(args):
         rec["parity"] = bool(
             abs(float(mean_pck) - args.expected_pck) <= args.tolerance
         )
+    if args.c2f:
+        rec.update(_pfpascal_c2f_delta(args, config, params, mean_pck))
     return rec
+
+
+def _pfpascal_c2f_delta(args, config, params, oneshot_pck):
+    """A/B the coarse-to-fine matcher against one-shot on PF-Pascal.
+
+    The c2f quality gate (docs/PERF.md): the default knobs must hold PCK
+    within 1 point of one-shot, or the mode stays opt-in. The delta is
+    recorded, never hard-failed — c2f IS opt-in, and the number in the
+    parity record is exactly what decides whether that changes.
+
+    c2f needs feature grids divisible by the stride on both axes, so the
+    eval image size snaps to a multiple of 16*stride — and the one-shot
+    baseline re-runs at the SAME snapped size when it differs from
+    --image_size, so the delta compares identical inputs.
+    """
+    import dataclasses
+
+    from ncnet_tpu.cli.eval_pck import evaluate_pck
+    from ncnet_tpu.data import PFPascalDataset
+
+    c2f_config = dataclasses.replace(
+        config, mode="c2f",
+        c2f_coarse_factor=args.c2f_coarse_factor,
+        c2f_topk=args.c2f_topk,
+        c2f_radius=args.c2f_radius,
+    )
+    stride = args.c2f_coarse_factor * max(config.relocalization_k_size, 1)
+    unit = 16 * stride
+    c2f_size = max(unit, int(round(args.image_size / unit)) * unit)
+    csv = os.path.join(args.dataset_path, "image_pairs", "test_pairs.csv")
+    dataset = PFPascalDataset(
+        csv, args.dataset_path, output_size=(c2f_size, c2f_size),
+        pck_procedure="scnet",
+    )
+    base_pck = float(oneshot_pck)
+    if c2f_size != args.image_size:
+        log(f"c2f grid alignment snaps eval to {c2f_size} px; re-running "
+            "the one-shot baseline there for a like-for-like delta ...")
+        base_pck, _ = evaluate_pck(
+            config, params, dataset, args.batch_size, args.alpha,
+            num_workers=args.num_workers,
+        )
+        base_pck = float(base_pck)
+    log(f"evaluating c2f PCK@{args.alpha} at {c2f_size} px (factor="
+        f"{args.c2f_coarse_factor}, topk={args.c2f_topk}, "
+        f"radius={args.c2f_radius}) ...")
+    c2f_pck, _ = evaluate_pck(
+        c2f_config, params, dataset, args.batch_size, args.alpha,
+        num_workers=args.num_workers,
+    )
+    delta = float(c2f_pck) - base_pck
+    return {
+        "c2f_pck": round(float(c2f_pck), 4),
+        "c2f_baseline_pck": round(base_pck, 4),
+        "c2f_pck_delta": round(delta, 4),
+        "c2f_image_size": c2f_size,
+        "c2f_coarse_factor": args.c2f_coarse_factor,
+        "c2f_topk": args.c2f_topk,
+        "c2f_radius": args.c2f_radius,
+        "c2f_within_gate": bool(abs(delta) <= 0.01),
+    }
 
 
 def run_pfwillow(args):
@@ -424,6 +487,13 @@ def main(argv=None):
                     "pass -1 to skip the comparison")
     ap.add_argument("--tolerance", type=float, default=0.02)
     ap.add_argument("--image_size", type=int, default=400)
+    ap.add_argument("--c2f", action="store_true",
+                    help="also eval PF-Pascal under mode='c2f' and record "
+                    "the PCK delta vs one-shot (the c2f quality gate; "
+                    "report-only — the mode is opt-in)")
+    ap.add_argument("--c2f_coarse_factor", type=int, default=2)
+    ap.add_argument("--c2f_topk", type=int, default=8)
+    ap.add_argument("--c2f_radius", type=int, default=1)
     ap.add_argument("--alpha", type=float, default=0.1)
     ap.add_argument("--batch_size", type=int, default=8)
     ap.add_argument("--num_workers", type=int, default=4)
