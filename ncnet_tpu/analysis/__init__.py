@@ -1,0 +1,45 @@
+"""Unified static-analysis pass over the ncnet_tpu codebase.
+
+The repo grew three serving-critical concurrency layers (batcher
+threads, fleet replicas, bulk-pipeline writers) and a family of ad-hoc
+AST lints that each reimplemented file walking, AST visiting, and
+reporting. This package is the shared home:
+
+* :mod:`~ncnet_tpu.analysis.engine` — repo file discovery, per-file
+  AST + line cache, the :class:`~ncnet_tpu.analysis.engine.Rule`
+  protocol, :class:`~ncnet_tpu.analysis.engine.Finding` records,
+  ``# ncnet-lint: disable=<rule>`` pragma and ``baseline.json``
+  suppression.
+* :mod:`~ncnet_tpu.analysis.rules` — the rule set: ``trace-purity``
+  (host syncs inside jitted code), ``lock-order`` (deadlock-hazard
+  cycles in the lock-acquisition graph), ``recompile-hazard``
+  (unhashable / nondeterministic cache-key construction), and the
+  ported docs cross-checks (``bare-print``, ``metrics-docs``,
+  ``failpoint-docs``).
+
+Run it via ``python tools/ncnet_lint.py`` (one-JSON-line contract,
+nonzero exit on non-baselined findings) or the tier-1 test
+``tests/test_analysis_engine.py``. Rule catalog, pragma grammar, and
+the generated lock-acquisition-order table live in docs/ANALYSIS.md.
+"""
+
+from .engine import (  # noqa: F401
+    Baseline,
+    Finding,
+    Report,
+    Repo,
+    Rule,
+    run_rules,
+)
+from .rules import all_rules, get_rules  # noqa: F401
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "Report",
+    "Repo",
+    "Rule",
+    "run_rules",
+    "all_rules",
+    "get_rules",
+]

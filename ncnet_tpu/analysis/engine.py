@@ -1,0 +1,359 @@
+"""Shared AST-walking rule engine for the repo's static-analysis pass.
+
+One parse per file per run: rules receive a :class:`Repo` whose
+:class:`SourceFile` objects cache source text, line lists, and the
+parsed AST, so adding a rule costs one more tree walk, not one more
+disk+parse sweep (the pre-engine lints each re-walked the package).
+
+Suppression has exactly two grammars, both deliberate-and-visible:
+
+* **Pragma** — ``# ncnet-lint: disable=<rule>[,<rule>...]`` on the
+  flagged line or the line directly above it silences those rules for
+  that line; ``# ncnet-lint: disable-file=<rule>[,...]`` anywhere in a
+  file's first 10 lines silences the whole file. ``disable=all`` is
+  accepted but discouraged — name the rule you mean.
+* **Baseline** — ``ncnet_tpu/analysis/baseline.json`` carries
+  deliberate, *commented* exceptions: every entry needs a nonempty
+  ``reason`` (the tier-1 test enforces it). A finding matching a
+  baseline entry still counts in ``findings`` but not in ``new``; only
+  ``new`` findings fail the lint. The baseline is for exceptions, not
+  for burying violations — fix the code or pragma it with a
+  justification instead.
+
+See docs/ANALYSIS.md for the rule catalog and how to add a rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Pragma grammar (docs/ANALYSIS.md): trailing comment on the flagged
+#: line or alone on the line above it.
+PRAGMA_RE = re.compile(
+    r"#\s*ncnet-lint:\s*(disable(?:-file)?)\s*=\s*([a-z0-9_,\-\s]+)"
+)
+
+#: How deep a ``disable-file`` pragma may sit (a header pragma, not a
+#: buried one).
+_FILE_PRAGMA_LINES = 10
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a repo-relative file + line.
+
+    ``symbol`` is an optional stable anchor (a function/lock/site name)
+    baselines can match on so entries survive unrelated line churn.
+    """
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    symbol: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "message": self.message}
+        if self.symbol:
+            d["symbol"] = self.symbol
+        return d
+
+
+class SourceFile:
+    """One parsed file: text, split lines, AST, and pragma map — each
+    computed once and cached for every rule that asks."""
+
+    def __init__(self, root: str, rel: str):
+        self.rel = rel.replace(os.sep, "/")
+        self.path = os.path.join(root, rel)
+        self._text: Optional[str] = None
+        self._lines: Optional[List[str]] = None
+        self._tree: Optional[ast.AST] = None
+        self._pragmas: Optional[Dict[int, set]] = None
+        self._file_pragmas: Optional[set] = None
+
+    @property
+    def text(self) -> str:
+        if self._text is None:
+            with open(self.path, encoding="utf-8") as fh:
+                self._text = fh.read()
+        return self._text
+
+    @property
+    def lines(self) -> List[str]:
+        if self._lines is None:
+            self._lines = self.text.splitlines()
+        return self._lines
+
+    @property
+    def tree(self) -> ast.AST:
+        if self._tree is None:
+            self._tree = ast.parse(self.text, filename=self.path)
+        return self._tree
+
+    def _scan_pragmas(self) -> None:
+        self._pragmas = {}
+        self._file_pragmas = set()
+        for i, line in enumerate(self.lines, start=1):
+            if "ncnet-lint" not in line:
+                continue
+            m = PRAGMA_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            if m.group(1) == "disable-file":
+                if i <= _FILE_PRAGMA_LINES:
+                    self._file_pragmas |= rules
+            else:
+                self._pragmas.setdefault(i, set()).update(rules)
+
+    def disabled_rules(self, line: int) -> set:
+        """Rules pragma-disabled at ``line`` (same line, the line
+        above, or file-wide)."""
+        if self._pragmas is None:
+            self._scan_pragmas()
+        out = set(self._file_pragmas or ())
+        out |= self._pragmas.get(line, set())
+        out |= self._pragmas.get(line - 1, set())
+        return out
+
+    def suppresses(self, finding: Finding) -> bool:
+        disabled = self.disabled_rules(finding.line)
+        return "all" in disabled or finding.rule in disabled
+
+
+class Repo:
+    """File discovery + per-file cache over the ``ncnet_tpu`` package.
+
+    ``files()`` is the full library file set (every ``*.py`` under
+    ``<root>/ncnet_tpu``, ``__pycache__`` excluded); ``selected()`` is
+    the subset per-file rules should lint — the lint CLI's
+    ``--changed-only`` narrows it while repo-wide cross-check rules
+    (docs tables, the lock graph) keep reading ``files()`` so a partial
+    file set can never fake a stale-docs or broken-graph verdict.
+    """
+
+    PKG = "ncnet_tpu"
+
+    def __init__(self, root: Optional[str] = None,
+                 selected: Optional[Sequence[str]] = None):
+        if root is None:
+            import ncnet_tpu
+
+            root = os.path.dirname(
+                os.path.dirname(os.path.abspath(ncnet_tpu.__file__)))
+        self.root = os.path.abspath(root)
+        self._cache: Dict[str, SourceFile] = {}
+        self._all: Optional[List[str]] = None
+        self._selected = (None if selected is None else
+                          [p.replace(os.sep, "/") for p in selected])
+
+    def _discover(self) -> List[str]:
+        if self._all is None:
+            out = []
+            pkg_dir = os.path.join(self.root, self.PKG)
+            for dirpath, dirs, names in os.walk(pkg_dir):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for fn in sorted(names):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), self.root)
+                        out.append(rel.replace(os.sep, "/"))
+            self._all = sorted(out)
+        return self._all
+
+    def file(self, rel: str) -> SourceFile:
+        rel = rel.replace(os.sep, "/")
+        sf = self._cache.get(rel)
+        if sf is None:
+            sf = self._cache[rel] = SourceFile(self.root, rel)
+        return sf
+
+    def files(self, under: Tuple[str, ...] = ()) -> List[SourceFile]:
+        """Every library file, optionally filtered to repo-relative
+        prefixes (e.g. ``("ncnet_tpu/serving/",)``)."""
+        rels = self._discover()
+        if under:
+            rels = [r for r in rels if r.startswith(tuple(under))]
+        return [self.file(r) for r in rels]
+
+    def selected(self, under: Tuple[str, ...] = ()) -> List[SourceFile]:
+        """The per-file-rule lint set: ``files()`` unless a selection
+        (``--changed-only``) narrows it."""
+        out = self.files(under)
+        if self._selected is None:
+            return out
+        keep = set(self._selected)
+        return [f for f in out if f.rel in keep]
+
+    def read_doc(self, rel: str) -> Optional[str]:
+        """A non-Python repo file's text (docs cross-checks), or None."""
+        path = os.path.join(self.root, rel)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+
+class Rule:
+    """Protocol every rule implements.
+
+    ``full_repo`` rules reason about cross-file invariants (docs
+    tables, the lock graph) and always see the whole file set;
+    per-file rules iterate ``repo.selected()`` so ``--changed-only``
+    applies. ``check`` yields raw findings; pragma/baseline filtering
+    is the engine's job, not the rule's.
+    """
+
+    rule_id: str = ""
+    description: str = ""
+    full_repo: bool = False
+
+    def check(self, repo: Repo) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Baseline:
+    """``baseline.json``: deliberate, commented exceptions.
+
+    Grammar (docs/ANALYSIS.md)::
+
+        {"version": 1, "entries": [
+          {"rule": "trace-purity", "path": "ncnet_tpu/x.py",
+           "line": 12, "symbol": "f", "reason": "why this is OK"}]}
+
+    Matching: ``rule`` and ``path`` must equal the finding's; then
+    ``symbol`` (when the entry carries one) or ``line`` anchors it.
+    Symbol matches survive line churn; line matches are for findings
+    with no stable symbol.
+    """
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries = list(entries or [])
+
+    @classmethod
+    def default_path(cls, repo: Repo) -> str:
+        return os.path.join(repo.root, Repo.PKG, "analysis",
+                            "baseline.json")
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            return cls([])
+        return cls(data.get("entries", []))
+
+    def save(self, path: str) -> None:
+        data = {"version": 1, "entries": self.entries}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    def matches(self, finding: Finding) -> bool:
+        for e in self.entries:
+            if e.get("rule") != finding.rule:
+                continue
+            if e.get("path") != finding.path:
+                continue
+            if e.get("symbol"):
+                if e["symbol"] == finding.symbol:
+                    return True
+                continue
+            if e.get("line") == finding.line:
+                return True
+        return False
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        entries = []
+        for f in findings:
+            e = {"rule": f.rule, "path": f.path, "line": f.line,
+                 "reason": ""}
+            if f.symbol:
+                e["symbol"] = f.symbol
+            entries.append(e)
+        return cls(entries)
+
+
+@dataclass
+class Report:
+    """One engine run: what was found, what suppressed it."""
+
+    findings: List[Finding] = field(default_factory=list)  # non-pragma'd
+    new: List[Finding] = field(default_factory=list)  # not baselined
+    suppressed: int = 0  # pragma-silenced
+    rules: List[str] = field(default_factory=list)
+    files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": len(self.findings),
+            "new": len(self.new),
+            "rules": list(self.rules),
+            "suppressed": self.suppressed,
+            "files": self.files,
+        }
+
+
+def run_rules(repo: Repo, rules: Sequence[Rule],
+              baseline: Optional[Baseline] = None) -> Report:
+    """Run ``rules`` over ``repo``; pragma-filter, then baseline-split.
+
+    Findings pointing into files the repo can parse get pragma
+    filtering; findings anchored elsewhere (docs files) never do —
+    docs rows are fixed in the docs, not pragma'd.
+    """
+    baseline = baseline or Baseline([])
+    report = Report(rules=[r.rule_id for r in rules],
+                    files=len(repo.selected()))
+    for rule in rules:
+        for finding in rule.check(repo):
+            if finding.path.endswith(".py"):
+                try:
+                    if repo.file(finding.path).suppresses(finding):
+                        report.suppressed += 1
+                        continue
+                except OSError:
+                    pass
+            report.findings.append(finding)
+            if not baseline.matches(finding):
+                report.new.append(finding)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    report.new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report
+
+
+# -- shared AST helpers (used by several rules) ---------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """The dotted name a call targets, or None for computed callees."""
+    return dotted_name(node.func)

@@ -1,0 +1,50 @@
+"""Rule registry: every first-class rule, by stable id.
+
+Adding a rule (docs/ANALYSIS.md "Adding a rule"): implement the
+:class:`~ncnet_tpu.analysis.engine.Rule` protocol in a module here,
+register it in :data:`_RULES`, document it in the docs catalog, and
+seed a known-bad fixture in tests/test_analysis_engine.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..engine import Rule
+from .bare_print import BarePrintRule
+from .failpoint_docs import FailpointDocsRule
+from .lock_order import LockOrderRule
+from .metrics_docs import MetricsDocsRule
+from .recompile_hazard import RecompileHazardRule
+from .trace_purity import TracePurityRule
+
+_RULES = (
+    TracePurityRule,
+    LockOrderRule,
+    RecompileHazardRule,
+    BarePrintRule,
+    MetricsDocsRule,
+    FailpointDocsRule,
+)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in _RULES]
+
+
+def rule_ids() -> List[str]:
+    return [cls.rule_id for cls in _RULES]
+
+
+def get_rules(ids: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instantiate the named rules (all, when ``ids`` is falsy)."""
+    if not ids:
+        return all_rules()
+    by_id = {cls.rule_id: cls for cls in _RULES}
+    out = []
+    for rid in ids:
+        if rid not in by_id:
+            raise KeyError(
+                f"unknown rule {rid!r}; known: {sorted(by_id)}")
+        out.append(by_id[rid]())
+    return out

@@ -1,0 +1,47 @@
+"""``bare-print``: no bare ``print()`` in library code.
+
+Library modules under ``ncnet_tpu/`` (everything except ``cli/``, which
+IS the user-facing stdout surface) must report through the structured
+run log (``ncnet_tpu.obs``) or an explicit stream (``file=sys.stderr``),
+never bare ``print()``: library stdout interleaves with machine-read
+contracts like bench.py's single headline JSON line and is invisible to
+tools/obs_report.py.
+
+Port of tests/test_no_bare_print.py (verdict-identical; the engine's
+pragma replaces that test's ALLOWED dict — it was empty at port time).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, Repo, Rule
+
+#: cli/ prints to the terminal by design; that is its job.
+_EXCLUDED_PREFIX = "ncnet_tpu/cli/"
+
+
+class BarePrintRule(Rule):
+    rule_id = "bare-print"
+    description = ("bare print() in library code (use ncnet_tpu.obs.event "
+                   "or file=sys.stderr); cli/ exempt")
+
+    def check(self, repo: Repo) -> Iterable[Finding]:
+        for sf in repo.selected():
+            if sf.rel.startswith(_EXCLUDED_PREFIX):
+                continue
+            try:
+                tree = sf.tree
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"
+                        and not any(kw.arg == "file"
+                                    for kw in node.keywords)):
+                    yield Finding(
+                        self.rule_id, sf.rel, node.lineno,
+                        "bare print() in library code (use "
+                        "ncnet_tpu.obs.event or file=sys.stderr)")

@@ -1,0 +1,115 @@
+"""``failpoint-docs``: every failpoint site documented, both ways.
+
+Port of tests/test_failpoint_docs_lint.py (verdict-identical). An AST
+walk over ``ncnet_tpu/`` collects every *named* failpoint plant —
+``failpoints.fire("site", ...)`` and ``failpoints.corrupt("site",
+...)`` with a literal first argument — and cross-checks the set
+against the "Planted sites" table in docs/RELIABILITY.md:
+
+* a site in code but not the table is an undocumented chaos hook
+  (nobody will ever arm it, so its failure path stays untested);
+* a site in the table but not the code is stale docs (a chaos spec
+  naming it silently arms nothing — worse than an error).
+
+One docs row may carry several backticked site names in its first cell
+(the checkpoint family does); all of them count. ``full_repo``: a
+partial ``--changed-only`` set must not fake stale-docs verdicts.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..engine import Finding, Repo, Rule
+
+DOC_PATH = "docs/RELIABILITY.md"
+DOCS_MARKER = "Planted sites"
+
+_SITE_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+
+def planted_sites(repo: Repo) -> List[Tuple[str, int, str]]:
+    """(repo-relative path, lineno, site) for every literal-named plant
+    under ncnet_tpu/. Non-literal first args are skipped — sites must
+    be grep-able string literals by convention."""
+    out = []
+    for sf in repo.files():
+        try:
+            tree = sf.tree
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in ("fire", "corrupt")
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "failpoints"):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append((sf.rel, node.lineno, arg.value))
+    return out
+
+
+def docs_table_sites(repo: Repo) -> Optional[Set[str]]:
+    """All backticked names from the site table's first column, or None
+    when the docs file / marker is missing."""
+    text = repo.read_doc(DOC_PATH)
+    if text is None or DOCS_MARKER not in text:
+        return None
+    section = text.split(DOCS_MARKER, 1)[1].split("\n## ", 1)[0]
+    sites: Set[str] = set()
+    for cell in re.findall(r"^\|([^|]*)\|", section, re.MULTILINE):
+        sites.update(re.findall(r"`([a-z][a-z0-9_.]*)`", cell))
+    sites.discard("failpoints.fire")  # the grep hint in the intro text
+    return sites
+
+
+class FailpointDocsRule(Rule):
+    rule_id = "failpoint-docs"
+    description = ("failpoint sites must be dotted lowercase and match "
+                   "the docs/RELIABILITY.md 'Planted sites' table both "
+                   "ways")
+    full_repo = True
+
+    def check(self, repo: Repo) -> Iterable[Finding]:
+        planted = planted_sites(repo)
+        for rel, line, site in planted:
+            if not _SITE_RE.match(site):
+                yield Finding(
+                    self.rule_id, rel, line,
+                    f"failpoint site {site!r} must be dotted lowercase "
+                    f"(domain.site)",
+                    symbol=site)
+        docs = docs_table_sites(repo)
+        if docs is None:
+            yield Finding(
+                self.rule_id, DOC_PATH, 1,
+                f"{DOC_PATH} lost its {DOCS_MARKER!r} table intro",
+                symbol="docs-section")
+            return
+        if not docs:
+            yield Finding(self.rule_id, DOC_PATH, 1,
+                          "the Planted sites table has no rows",
+                          symbol="docs-section")
+            return
+        code_sites = {}
+        for rel, line, site in planted:
+            code_sites.setdefault(site, (rel, line))
+        for site in sorted(set(code_sites) - docs):
+            rel, line = code_sites[site]
+            yield Finding(
+                self.rule_id, rel, line,
+                f"failpoint site {site!r} missing from the {DOC_PATH} "
+                f"'Planted sites' table",
+                symbol=site)
+        for site in sorted(docs - set(code_sites)):
+            yield Finding(
+                self.rule_id, DOC_PATH, 1,
+                f"{DOC_PATH} lists failpoint site {site!r} no code "
+                f"plants (stale row)",
+                symbol=site)

@@ -1,0 +1,654 @@
+"""``lock-order``: deadlock hazards in the lock-acquisition graph.
+
+The serving stack holds real locks across real calls: the batcher's
+condition is held while metrics record, the engine's store lock is held
+across a feature-cache put, the fleet kills replicas that own batchers.
+Two threads acquiring the same two locks in opposite orders deadlock
+only under load — the one failure mode no unit test schedule reliably
+reproduces. So this rule derives the acquisition graph statically:
+
+* **Lock identity** — ``Class._attr`` for instance locks
+  (``self._x = threading.Lock()/RLock()/Condition()``, or
+  ``self._x = <param>`` where the parameter is named ``lock``/``cond``
+  — the metrics children receive their locks that way), ``module._name``
+  for module-level locks, and ``Class._m`` for contextmanager methods
+  with ``lock`` in the name (the feature cache's flock wrapper).
+* **Acquisition sites** — ``with`` statements only: ``with self._x:``,
+  ``with self.attr._x:`` (via the ``self.attr = ClassName(...)`` type
+  map), ``with modlock:``, ``with self._m():``. ``Condition.wait`` is
+  not an acquisition edge (it *releases* while waiting).
+* **Edges** — lock A is held at a site that acquires B directly
+  (nested ``with``) or calls code that *may acquire* B. ``may_acquire``
+  is a fixed point over a resolved call graph: ``self.m()``,
+  ``self.attr.m()``, module-local ``f()``, ``alias.f()`` with one
+  re-export hop (``from .. import obs`` → ``obs/__init__`` →
+  ``from .metrics import counter``), ``Class(...)`` → ``__init__``,
+  module-var methods (``_DEFAULT.counter``), and the metrics chain
+  idiom ``obs.counter(...).inc()`` / ``.observe()`` / ``.set()``.
+
+Any cycle (including a self-edge on a non-reentrant ``Lock``) is a
+deadlock-hazard finding. The acquisition-order table is emitted into
+docs/ANALYSIS.md between generated-block markers; this rule also
+verifies that block is fresh (``tools/ncnet_lint.py --write-docs``
+regenerates it).
+
+Unresolved calls (cross-package helpers, stdlib) contribute no edges:
+the graph is an under-approximation of runtime behavior, which is why
+lock scope is kept to the concurrency-bearing trees below.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..engine import Finding, Repo, Rule, dotted_name
+
+#: The concurrency-bearing trees the graph is built from (ISSUE 10).
+SCOPE = (
+    "ncnet_tpu/serving/",
+    "ncnet_tpu/obs/",
+    "ncnet_tpu/reliability/",
+    "ncnet_tpu/pipeline/",
+    "ncnet_tpu/evals/feature_cache.py",
+)
+
+#: Generated-block markers in docs/ANALYSIS.md.
+DOC_PATH = "docs/ANALYSIS.md"
+BEGIN_MARK = "<!-- BEGIN GENERATED: lock-order -->"
+END_MARK = "<!-- END GENERATED: lock-order -->"
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+}
+
+#: Reentrant kinds: a same-thread re-acquire does not deadlock
+#: (Condition wraps an RLock by default), so self-edges are exempt.
+_REENTRANT = {"RLock", "Condition", "contextmanager"}
+
+#: The metrics chain idiom: ``<...>.counter(...).inc()`` resolves to
+#: the child-metric method without return-type inference.
+_CHAIN_FACTORY = {"counter": "Counter", "gauge": "Gauge",
+                  "histogram": "Histogram"}
+_CHAIN_METHODS = {"inc", "set", "observe"}
+
+
+def _is_contextmanager(func: ast.AST) -> bool:
+    for dec in getattr(func, "decorator_list", ()):
+        if dotted_name(dec) in ("contextmanager",
+                                "contextlib.contextmanager"):
+            return True
+    return False
+
+
+class _Class:
+    def __init__(self, name: str, rel: str):
+        self.name = name
+        self.rel = rel
+        self.methods: Dict[str, ast.AST] = {}
+        self.attr_locks: Dict[str, Tuple[str, int]] = {}  # attr -> kind,line
+        self.attr_types: Dict[str, str] = {}  # attr -> class-name string
+        self.pseudo_locks: Dict[str, int] = {}  # method name -> def line
+
+
+class _Module:
+    def __init__(self, rel: str, tree: ast.AST):
+        self.rel = rel
+        # ncnet_tpu/obs/metrics.py -> pkg ["ncnet_tpu","obs"], base
+        # "metrics". A package __init__ IS its package: relative
+        # imports inside it resolve against the package itself.
+        parts = rel[: -len(".py")].split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+            self.pkg = parts
+        else:
+            self.pkg = parts[:-1]
+        self.base = parts[-1]
+        self.funcs: Dict[str, ast.AST] = {}
+        self.classes: Dict[str, _Class] = {}
+        self.imports: Dict[str, str] = {}  # alias -> module rel path
+        self.from_binds: Dict[str, Tuple[str, str]] = {}  # name->(rel,name)
+        self.mod_locks: Dict[str, Tuple[str, int]] = {}  # name->(kind,line)
+        self.mod_vars: Dict[str, str] = {}  # name -> class-name string
+        self._index(tree)
+
+    def _module_rel(self, dotted: Sequence[str]) -> Optional[str]:
+        """Dotted module parts -> repo-relative path, if it exists as a
+        module or package in the file set (checked by the caller)."""
+        return "/".join(dotted)
+
+    def _resolve_import(self, level: int, module: str) -> List[str]:
+        if level == 0:
+            return module.split(".") if module else []
+        base = self.pkg[: len(self.pkg) - (level - 1)]
+        if module:
+            base = base + module.split(".")
+        return base
+
+    def _index(self, tree: ast.AST) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    self.imports[name] = alias.name.replace(".", "/")
+            elif isinstance(node, ast.ImportFrom):
+                target = self._resolve_import(node.level, node.module or "")
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    # `from X import y`: y may itself be module X/y, or
+                    # an object in module X — record both candidates;
+                    # the resolver checks against the real file set.
+                    self.imports.setdefault(
+                        name, "/".join(target + [alias.name]))
+                    self.from_binds[name] = ("/".join(target), alias.name)
+            elif isinstance(node, ast.FunctionDef):
+                self.funcs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = self._index_class(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name) and isinstance(node.value,
+                                                            ast.Call):
+                    ctor = dotted_name(node.value.func)
+                    kind = _LOCK_CTORS.get(ctor or "")
+                    if kind:
+                        self.mod_locks[tgt.id] = (kind, node.lineno)
+                    elif ctor:
+                        self.mod_vars[tgt.id] = ctor.split(".")[-1]
+
+    def _index_class(self, node: ast.ClassDef) -> _Class:
+        cls = _Class(node.name, self.rel)
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            cls.methods[item.name] = item
+            if _is_contextmanager(item) and "lock" in item.name:
+                cls.pseudo_locks[item.name] = item.lineno
+            params = {a.arg for a in item.args.args}
+            for sub in ast.walk(item):
+                if not (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1):
+                    continue
+                tgt = sub.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                if isinstance(sub.value, ast.Call):
+                    ctor = dotted_name(sub.value.func)
+                    kind = _LOCK_CTORS.get(ctor or "")
+                    if kind:
+                        cls.attr_locks[tgt.attr] = (kind, sub.lineno)
+                    elif ctor:
+                        cls.attr_types.setdefault(
+                            tgt.attr, ctor.split(".")[-1])
+                elif (isinstance(sub.value, ast.Name)
+                      and sub.value.id in params
+                      and (sub.value.id in ("lock", "cond")
+                           or sub.value.id.endswith(("_lock", "_cond")))):
+                    # Lock handed in via a constructor parameter (the
+                    # metrics children): non-reentrant by assumption.
+                    cls.attr_locks.setdefault(
+                        tgt.attr, ("Lock", sub.lineno))
+        return cls
+
+
+class _Graph:
+    """Lock nodes + ordered acquisition edges with one example site."""
+
+    def __init__(self):
+        self.nodes: Dict[str, Tuple[str, str, int]] = {}  # kind, rel, line
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_node(self, name: str, kind: str, rel: str, line: int) -> None:
+        self.nodes.setdefault(name, (kind, rel, line))
+
+    def add_edge(self, a: str, b: str, rel: str, line: int,
+                 via: str) -> None:
+        self.edges.setdefault((a, b), (rel, line, via))
+
+    def cycles(self) -> List[List[str]]:
+        """Tarjan SCCs of size > 1, plus Lock self-loops as [n, n]."""
+        adj: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in adj.get(v, ()):  # iterative depth is tiny here
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    out.append(sorted(scc))
+
+        for v in sorted(self.nodes):
+            if v not in index:
+                strongconnect(v)
+        for (a, b) in sorted(self.edges):
+            if a == b and self.nodes[a][0] not in _REENTRANT:
+                out.append([a, a])
+        return out
+
+    def topo_order(self) -> List[str]:
+        """Kahn topological order (alphabetical tie-break); falls back
+        to alphabetical when a cycle blocks it."""
+        indeg = {n: 0 for n in self.nodes}
+        for a, b in self.edges:
+            if a != b:
+                indeg[b] += 1
+        ready = sorted(n for n, d in indeg.items() if d == 0)
+        out: List[str] = []
+        while ready:
+            n = ready.pop(0)
+            out.append(n)
+            for (a, b), _ in sorted(self.edges.items()):
+                if a == n and b != n:
+                    indeg[b] -= 1
+                    if indeg[b] == 0 and b not in out:
+                        ready.append(b)
+            ready.sort()
+        if len(out) != len(self.nodes):
+            return sorted(self.nodes)
+        return out
+
+
+class _Analyzer:
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        self.modules: Dict[str, _Module] = {}
+        self.class_index: Dict[str, _Class] = {}
+        self.graph = _Graph()
+        self.findings: List[Finding] = []
+        # function key -> (module, class-or-None, ast node)
+        self.funcs: Dict[str, Tuple[_Module, Optional[_Class], ast.AST]] = {}
+        self.may: Dict[str, Set[str]] = {}
+        self.calls: Dict[str, Set[str]] = {}
+        self.direct: Dict[str, Set[Tuple[str, int]]] = {}
+
+    # -- pass 1: index ----------------------------------------------------
+
+    def build(self) -> None:
+        for sf in self.repo.files(under=SCOPE):
+            try:
+                mod = _Module(sf.rel, sf.tree)
+            except SyntaxError as exc:
+                self.findings.append(Finding(
+                    "lock-order", sf.rel, exc.lineno or 1,
+                    f"unparseable file: {exc.msg}"))
+                continue
+            self.modules[mod.rel] = mod
+            for cls in mod.classes.values():
+                self.class_index[cls.name] = cls
+        for mod in self.modules.values():
+            for name, (kind, line) in mod.mod_locks.items():
+                self.graph.add_node(f"{mod.base}.{name}", kind,
+                                    mod.rel, line)
+            for cls in mod.classes.values():
+                for attr, (kind, line) in cls.attr_locks.items():
+                    self.graph.add_node(f"{cls.name}.{attr}", kind,
+                                        mod.rel, line)
+                for meth, line in cls.pseudo_locks.items():
+                    self.graph.add_node(f"{cls.name}.{meth}",
+                                        "contextmanager", mod.rel, line)
+                for meth, node in cls.methods.items():
+                    self._register(f"{mod.rel}::{cls.name}.{meth}",
+                                   mod, cls, node)
+            for name, node in mod.funcs.items():
+                self._register(f"{mod.rel}::{name}", mod, None, node)
+        self._collect_all()
+        self._propagate()
+        self._edges_all()
+
+    def _register(self, key: str, mod: _Module, cls: Optional[_Class],
+                  node: ast.AST) -> None:
+        self.funcs[key] = (mod, cls, node)
+        self.calls[key] = set()
+        self.direct[key] = set()
+
+    # -- resolution helpers ----------------------------------------------
+
+    def _module_by_path(self, parts_path: str) -> Optional[_Module]:
+        for cand in (parts_path + ".py", parts_path + "/__init__.py"):
+            if cand in self.modules:
+                return self.modules[cand]
+        return None
+
+    def _attr_class(self, cls: Optional[_Class],
+                    attr: str) -> Optional[_Class]:
+        if cls is None:
+            return None
+        tname = cls.attr_types.get(attr)
+        return self.class_index.get(tname) if tname else None
+
+    def _lock_of(self, expr: ast.AST, mod: _Module,
+                 cls: Optional[_Class]) -> Optional[str]:
+        """The lock node a ``with`` context expression acquires."""
+        if isinstance(expr, ast.Call):
+            fn = dotted_name(expr.func)
+            if fn and fn.startswith("self.") and cls is not None:
+                meth = fn.split(".")[-1]
+                if fn.count(".") == 1 and meth in cls.pseudo_locks:
+                    return f"{cls.name}.{meth}"
+            return None
+        name = dotted_name(expr)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and cls is not None:
+            if len(parts) == 2 and parts[1] in cls.attr_locks:
+                return f"{cls.name}.{parts[1]}"
+            if len(parts) == 3:
+                owner = self._attr_class(cls, parts[1])
+                if owner is not None and parts[2] in owner.attr_locks:
+                    return f"{owner.name}.{parts[2]}"
+            return None
+        if len(parts) == 1 and parts[0] in mod.mod_locks:
+            return f"{mod.base}.{parts[0]}"
+        if len(parts) == 2:
+            # alias._lock for a module-level lock in an imported module
+            target = mod.imports.get(parts[0])
+            if target:
+                tmod = self._module_by_path(target)
+                if tmod is not None and parts[1] in tmod.mod_locks:
+                    return f"{tmod.base}.{parts[1]}"
+        return None
+
+    def _func_in_module(self, tmod: _Module, name: str,
+                        hop: bool = True) -> List[str]:
+        if name in tmod.funcs:
+            return [f"{tmod.rel}::{name}"]
+        if name in tmod.classes and "__init__" in tmod.classes[name].methods:
+            return [f"{tmod.rel}::{name}.__init__"]
+        if hop and name in tmod.from_binds:
+            # one re-export hop: obs/__init__ `from .metrics import counter`
+            src, orig = tmod.from_binds[name]
+            smod = self._module_by_path(src)
+            if smod is not None:
+                return self._func_in_module(smod, orig, hop=False)
+        return []
+
+    def _resolve_call(self, call: ast.Call, mod: _Module,
+                      cls: Optional[_Class]) -> List[str]:
+        out: List[str] = []
+        fn = call.func
+        # metrics chain: <anything>.counter(...).inc()
+        if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Call)
+                and fn.attr in _CHAIN_METHODS):
+            inner = dotted_name(fn.value.func)
+            factory = (inner or "").split(".")[-1]
+            child_cls = _CHAIN_FACTORY.get(factory)
+            if child_cls and child_cls in self.class_index:
+                owner = self.class_index[child_cls]
+                if fn.attr in owner.methods:
+                    out.append(f"{owner.rel}::{child_cls}.{fn.attr}")
+            # the inner factory call is visited separately by the walk
+            return out
+        name = dotted_name(fn)
+        if not name:
+            return out
+        parts = name.split(".")
+        if parts[0] == "self" and cls is not None:
+            if len(parts) == 2:
+                if parts[1] in cls.methods:
+                    out.append(f"{cls.rel}::{cls.name}.{parts[1]}")
+            elif len(parts) == 3:
+                owner = self._attr_class(cls, parts[1])
+                if owner is not None and parts[2] in owner.methods:
+                    out.append(f"{owner.rel}::{owner.name}.{parts[2]}")
+            return out
+        if len(parts) == 1:
+            if parts[0] in mod.funcs:
+                out.append(f"{mod.rel}::{parts[0]}")
+            elif parts[0] in mod.from_binds:
+                src, orig = mod.from_binds[parts[0]]
+                smod = self._module_by_path(src)
+                if smod is not None:
+                    out.extend(self._func_in_module(smod, orig, hop=False))
+                elif parts[0] in self.class_index:
+                    c = self.class_index[parts[0]]
+                    if "__init__" in c.methods:
+                        out.append(f"{c.rel}::{c.name}.__init__")
+            elif parts[0] in self.class_index:
+                c = self.class_index[parts[0]]
+                if "__init__" in c.methods:
+                    out.append(f"{c.rel}::{c.name}.__init__")
+            return out
+        if len(parts) == 2:
+            head, meth = parts
+            target = mod.imports.get(head)
+            if target:
+                tmod = self._module_by_path(target)
+                if tmod is not None:
+                    out.extend(self._func_in_module(tmod, meth))
+                    return out
+            if head in mod.mod_vars:
+                owner = self.class_index.get(mod.mod_vars[head])
+                if owner is not None and meth in owner.methods:
+                    out.append(f"{owner.rel}::{owner.name}.{meth}")
+                return out
+            if head in self.class_index:  # ClassName.static_method(...)
+                owner = self.class_index[head]
+                if meth in owner.methods:
+                    out.append(f"{owner.rel}::{owner.name}.{meth}")
+        return out
+
+    # -- pass 2a: direct acquisitions + call graph ------------------------
+
+    def _collect_all(self) -> None:
+        for key, (mod, cls, node) in self.funcs.items():
+            self._collect(node, key, mod, cls)
+
+    def _collect(self, node: ast.AST, key: str, mod: _Module,
+                 cls: Optional[_Class]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    lk = self._lock_of(item.context_expr, mod, cls)
+                    if lk:
+                        self.direct[key].add((lk, item.context_expr.lineno))
+            elif isinstance(sub, ast.Call):
+                for tgt in self._resolve_call(sub, mod, cls):
+                    if tgt != key:
+                        self.calls[key].add(tgt)
+
+    def _propagate(self) -> None:
+        for key in self.funcs:
+            self.may[key] = {lk for lk, _ in self.direct[key]}
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in self.calls.items():
+                for callee in callees:
+                    extra = self.may.get(callee, set()) - self.may[key]
+                    if extra:
+                        self.may[key] |= extra
+                        changed = True
+
+    # -- pass 2b: held-context edges --------------------------------------
+
+    def _edges_all(self) -> None:
+        for key, (mod, cls, node) in self.funcs.items():
+            for stmt in getattr(node, "body", ()):
+                self._edge_walk(stmt, (), mod, cls)
+
+    def _edge_walk(self, node: ast.AST, held: Tuple[str, ...],
+                   mod: _Module, cls: Optional[_Class]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                lk = self._lock_of(item.context_expr, mod, cls)
+                if lk:
+                    for h in held + tuple(acquired):
+                        self.graph.add_edge(h, lk, mod.rel,
+                                            item.context_expr.lineno,
+                                            "nested with")
+                    acquired.append(lk)
+                else:
+                    self._edge_walk(item.context_expr, held, mod, cls)
+            for stmt in node.body:
+                self._edge_walk(stmt, held + tuple(acquired), mod, cls)
+            return
+        if isinstance(node, ast.Call):
+            if held:
+                for tgt in self._resolve_call(node, mod, cls):
+                    for lk in sorted(self.may.get(tgt, ())):
+                        short = tgt.split("::")[-1]
+                        for h in held:
+                            self.graph.add_edge(h, lk, mod.rel,
+                                                node.lineno,
+                                                f"calls {short}")
+        # Nested defs/lambdas walk with the current held set: the
+        # serving flush callbacks run synchronously under the lock, and
+        # an escaping closure over-approximates to extra edges, never
+        # missed ones.
+        for child in ast.iter_child_nodes(node):
+            self._edge_walk(child, held, mod, cls)
+
+
+def build_graph(repo: Repo) -> _Graph:
+    """The lock-acquisition graph for the scoped trees (public: the
+    docs writer in tools/ncnet_lint.py renders it)."""
+    an = _Analyzer(repo)
+    an.build()
+    return an.graph
+
+
+def render_lock_table(graph: _Graph) -> str:
+    """The markdown acquisition-order table (generated-block body)."""
+    lines = [
+        "Generated by `python tools/ncnet_lint.py --write-docs` — do not",
+        "edit by hand. Locks are listed in acquisition order: a lock may",
+        "only be taken while holding locks that appear ABOVE it.",
+        "",
+        "| Order | Lock | Kind | Defined at | May acquire while held |",
+        "|---|---|---|---|---|",
+    ]
+    order = graph.topo_order()
+    succ: Dict[str, List[str]] = {}
+    for (a, b), _site in sorted(graph.edges.items()):
+        if a != b:
+            succ.setdefault(a, []).append(b)
+    for i, name in enumerate(order, start=1):
+        kind, rel, line = graph.nodes[name]
+        outs = ", ".join(f"`{s}`" for s in sorted(set(succ.get(name, ()))))
+        lines.append(
+            f"| {i} | `{name}` | {kind} | `{rel}:{line}` "
+            f"| {outs or '(leaf)'} |"
+        )
+    cycles = graph.cycles()
+    lines.append("")
+    if cycles:
+        lines.append("**Deadlock hazards (cycles):** "
+                     + "; ".join(" -> ".join(c + [c[0]]) for c in cycles))
+    else:
+        lines.append("The graph is **acyclic**: no lock-order deadlock is "
+                     "possible among these locks.")
+    return "\n".join(lines)
+
+
+def _normalize(text: str) -> str:
+    return "\n".join(l.rstrip() for l in text.strip().splitlines())
+
+
+class LockOrderRule(Rule):
+    rule_id = "lock-order"
+    description = ("deadlock-hazard cycles in the lock-acquisition graph "
+                   "across serving/, obs/, reliability/, pipeline/, and "
+                   "the feature cache; docs/ANALYSIS.md table freshness")
+    full_repo = True  # the graph must never be built from a partial set
+
+    def check(self, repo: Repo) -> Iterable[Finding]:
+        an = _Analyzer(repo)
+        an.build()
+        yield from an.findings
+        graph = an.graph
+        for cyc in graph.cycles():
+            first = cyc[0]
+            kind, rel, line = graph.nodes[first]
+            if len(set(cyc)) == 1:
+                msg = (f"non-reentrant {kind} {first!r} may be "
+                       f"re-acquired while already held (self-deadlock)")
+            else:
+                path = " -> ".join(cyc + [cyc[0]])
+                msg = (f"lock-order cycle (deadlock hazard): {path}; "
+                       f"break it by fixing one acquisition order")
+            yield Finding(self.rule_id, rel, line, msg,
+                          symbol="->".join(cyc))
+        yield from self._check_docs(repo, graph)
+
+    def _check_docs(self, repo: Repo, graph: _Graph) -> Iterable[Finding]:
+        doc = repo.read_doc(DOC_PATH)
+        want = _normalize(render_lock_table(graph))
+        if doc is None:
+            yield Finding(self.rule_id, DOC_PATH, 1,
+                          f"{DOC_PATH} is missing; run "
+                          "`python tools/ncnet_lint.py --write-docs`",
+                          symbol="docs-block")
+            return
+        if BEGIN_MARK not in doc or END_MARK not in doc:
+            yield Finding(self.rule_id, DOC_PATH, 1,
+                          f"{DOC_PATH} lacks the generated lock-order "
+                          f"block markers ({BEGIN_MARK}); run "
+                          "`python tools/ncnet_lint.py --write-docs`",
+                          symbol="docs-block")
+            return
+        begin_line = doc[: doc.index(BEGIN_MARK)].count("\n") + 1
+        body = doc.split(BEGIN_MARK, 1)[1].split(END_MARK, 1)[0]
+        if _normalize(body) != want:
+            yield Finding(self.rule_id, DOC_PATH, begin_line,
+                          "generated lock-order table is stale; run "
+                          "`python tools/ncnet_lint.py --write-docs`",
+                          symbol="docs-block")
+
+
+def write_docs_block(repo: Repo) -> bool:
+    """Rewrite the generated block in docs/ANALYSIS.md in place.
+
+    Returns True when the file changed. The surrounding prose is left
+    untouched; only the text between the markers is regenerated.
+    """
+    import os
+
+    doc_path = os.path.join(repo.root, DOC_PATH)
+    try:
+        with open(doc_path, encoding="utf-8") as fh:
+            doc = fh.read()
+    except OSError:
+        return False
+    if BEGIN_MARK not in doc or END_MARK not in doc:
+        return False
+    head, rest = doc.split(BEGIN_MARK, 1)
+    _stale, tail = rest.split(END_MARK, 1)
+    table = render_lock_table(build_graph(repo))
+    new = head + BEGIN_MARK + "\n" + table + "\n" + END_MARK + tail
+    if new == doc:
+        return False
+    with open(doc_path, "w", encoding="utf-8") as fh:
+        fh.write(new)
+    return True

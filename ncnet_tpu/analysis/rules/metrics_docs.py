@@ -1,0 +1,175 @@
+"""``metrics-docs``: metric names Prometheus-safe and documented.
+
+Port of tests/test_metrics_docs_lint.py (verdict-identical). Two
+invariants:
+
+1. **Prometheus safety** — every metric name passed to
+   ``counter()``/``gauge()``/``histogram()`` anywhere under
+   ``ncnet_tpu/`` is dotted lowercase (``[a-z0-9_.]``, no spaces, no
+   leading digit/dot, no empty segments), so the ``/metrics``
+   sanitization (dots -> underscores) can never produce an invalid or
+   colliding Prometheus family name.
+
+2. **Docs cross-check** — the serving / SLO / heartbeat / breaker /
+   build-info families must match the canonical table in
+   docs/OBSERVABILITY.md ("Serving & SLO metric families") BOTH ways:
+   a family in code but not the table is undocumented; a family in the
+   table but not the code is stale docs. Runtime-formatted segments
+   (f-string fields) normalize to ``<field>`` on both sides.
+
+Dynamic pass-through call sites (a bare variable forwarded by a
+wrapper, e.g. ``obs.counter(name)``) are unresolvable and skipped;
+every resolvable shape — literals, f-strings, conditional literals,
+string concatenation — is linted. This is a ``full_repo`` rule: a
+``--changed-only`` run must not let a partial file set fake a
+stale-docs verdict.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..engine import Finding, Repo, Rule
+
+DOC_PATH = "docs/OBSERVABILITY.md"
+DOCS_SECTION = "## Serving & SLO metric families"
+
+#: Families the docs table must cover, both ways (the fleet surface).
+SCOPED_PREFIXES = ("serving.", "slo.", "obs.heartbeat.", "breaker.",
+                   "ncnet.", "bulk.", "engine.")
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_<>]+)*$")
+
+
+def _field_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return "x"
+
+
+def _resolve(node: ast.AST) -> Optional[str]:
+    """A metric-name expression -> normalized template, or None when
+    the shape is a pure pass-through (bare variable) we cannot lint.
+
+    f-string fields and other embedded dynamic parts become
+    ``<field>`` (the attribute/variable name when there is one)."""
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, str) else None
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                parts.append(f"<{_field_name(v.value)}>")
+        return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _resolve(node.left)
+        right = _resolve(node.right)
+        return ((left if left is not None else f"<{_field_name(node.left)}>")
+                + (right if right is not None
+                   else f"<{_field_name(node.right)}>"))
+    return None
+
+
+def _names(node: ast.AST) -> List[str]:
+    """All normalized names one metric-name argument can evaluate to."""
+    if isinstance(node, ast.IfExp):
+        return _names(node.body) + _names(node.orelse)
+    resolved = _resolve(node)
+    # A lone pass-through variable is unresolvable — skip it; a partial
+    # resolution (concat/f-string) keeps its <placeholders>.
+    if resolved is None or resolved.startswith("<"):
+        return []
+    return [resolved]
+
+
+def registered_metric_names(repo: Repo) -> List[Tuple[str, int, str]]:
+    """(repo-relative path, lineno, normalized name) for every
+    resolvable metric registration under ncnet_tpu/."""
+    out = []
+    for sf in repo.files():
+        try:
+            tree = sf.tree
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fname = (node.func.attr
+                     if isinstance(node.func, ast.Attribute)
+                     else node.func.id
+                     if isinstance(node.func, ast.Name) else None)
+            if fname not in ("counter", "gauge", "histogram"):
+                continue
+            for name in _names(node.args[0]):
+                out.append((sf.rel, node.lineno, name))
+    return out
+
+
+def docs_table_families(repo: Repo) -> Optional[Set[str]]:
+    """Backticked first-cell names from the canonical docs table, or
+    None when the docs file / section is missing (reported as a
+    finding by the rule)."""
+    text = repo.read_doc(DOC_PATH)
+    if text is None or DOCS_SECTION not in text:
+        return None
+    section = text.split(DOCS_SECTION, 1)[1].split("\n## ", 1)[0]
+    return set(re.findall(r"^\|\s*`([^`]+)`\s*\|", section, re.MULTILINE))
+
+
+class MetricsDocsRule(Rule):
+    rule_id = "metrics-docs"
+    description = ("metric names must be Prometheus-safe; fleet families "
+                   "must match the docs/OBSERVABILITY.md table both ways")
+    full_repo = True
+
+    def check(self, repo: Repo) -> Iterable[Finding]:
+        registered = registered_metric_names(repo)
+        for rel, line, name in registered:
+            # Placeholders stand in for one sanitized segment.
+            probe = re.sub(r"<[^>]*>", "x", name)
+            if not _NAME_RE.match(probe.replace("<", "").replace(">", "")):
+                yield Finding(
+                    self.rule_id, rel, line,
+                    f"metric name {name!r} is not dotted lowercase "
+                    f"[a-z0-9_.] (docs/OBSERVABILITY.md metric naming)",
+                    symbol=name)
+            elif ".." in probe or probe.endswith("."):
+                yield Finding(
+                    self.rule_id, rel, line,
+                    f"metric name {name!r} has an empty segment",
+                    symbol=name)
+        docs = docs_table_families(repo)
+        if docs is None:
+            yield Finding(
+                self.rule_id, DOC_PATH, 1,
+                f"{DOC_PATH} lost its {DOCS_SECTION!r} section",
+                symbol="docs-section")
+            return
+        if not docs:
+            yield Finding(self.rule_id, DOC_PATH, 1,
+                          "the family table has no rows",
+                          symbol="docs-section")
+            return
+        code_sites = {}
+        for rel, line, name in registered:
+            if name.startswith(SCOPED_PREFIXES):
+                code_sites.setdefault(name, (rel, line))
+        for name in sorted(set(code_sites) - docs):
+            rel, line = code_sites[name]
+            yield Finding(
+                self.rule_id, rel, line,
+                f"metric family {name!r} missing from the "
+                f"{DOC_PATH} 'Serving & SLO metric families' table",
+                symbol=name)
+        for name in sorted(docs - set(code_sites)):
+            yield Finding(
+                self.rule_id, DOC_PATH, 1,
+                f"{DOC_PATH} lists family {name!r} no code registers "
+                f"(stale row)",
+                symbol=name)

@@ -1,0 +1,216 @@
+"""``recompile-hazard``: unstable values flowing into jit/cache keys.
+
+The serving engine buckets compiled programs by key (resolution bucket,
+feature kind, match mode); the feature cache keys persisted artifacts.
+Three mistakes silently wreck those keys:
+
+* **Unhashable values** — a ``list``/``dict``/``set`` (or
+  ``np.array``) in a jit bucket key raises ``TypeError`` at lookup
+  time, or worse, gets stringified differently per process.
+* **Nondeterministic values** — ``time.*``/``random.*``/``uuid.*``/
+  ``id()`` in a key means every process (or every call) computes a
+  fresh key: a 100% cache-miss rate that profiles as "recompiles
+  forever" (exactly the stall PR 4's compile telemetry counts).
+  ``os.stat`` mtimes are deliberately allowed — the model cache key
+  uses them to *invalidate on change*, which is the point.
+* **Dict iteration order** — ``d.items()`` feeding a key is stable
+  within one process but not across processes/runs; keys built from
+  mappings must go through ``sorted(...)`` (the metrics registry's
+  ``label_key`` is the reference idiom).
+
+*Key expressions* are recognized syntactically: assignments to names
+ending in ``key``, keyword arguments ``*_key=`` (and bare ``key=``
+outside the ``sorted``/``min``/``max`` family), and return values of
+functions named ``*_key``. Hash-sanitizers (``tuple``, ``frozenset``,
+``str``, ``repr``, ``json.dumps``, ``hashlib.*``, ``.hexdigest()``,
+``"".join``, and the repo's own ``format_series`` — it canonicalizes
+labels into a sorted string key) excuse the unhashable check; only
+``sorted(...)`` (or ``format_series``) excuses dict iteration. ``@jax.jit(static_argnums=...)`` parameters with
+unhashable defaults are flagged too — static args must be hashable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..engine import Finding, Repo, Rule, dotted_name
+
+_UNHASHABLE_NODES = (ast.List, ast.Dict, ast.Set,
+                     ast.ListComp, ast.SetComp, ast.DictComp)
+
+_UNHASHABLE_CALLS = {"list", "set", "dict", "bytearray",
+                     "np.array", "np.asarray",
+                     "numpy.array", "numpy.asarray"}
+
+_NONDET_EXACT = {"id", "os.urandom", "uuid.uuid4", "uuid.uuid1"}
+_NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "uuid.")
+
+#: Wrapping any of these makes the value hashable/stable regardless of
+#: what's inside (a digest of a list is a fine key).
+_HASH_SANITIZERS = {"tuple", "frozenset", "str", "repr", "bytes",
+                    "json.dumps", "format", "format_series"}
+_HASH_SANITIZER_METHODS = {"hexdigest", "digest", "join", "format"}
+
+#: ``key=`` on these is a sort-comparator, not a cache key.
+_SORT_FAMILY = {"sorted", "min", "max", "sort", "nsmallest", "nlargest",
+                "groupby"}
+
+
+def _call_sanitizes(call: ast.Call) -> bool:
+    fn = dotted_name(call.func)
+    if fn in _HASH_SANITIZERS or (fn or "").startswith("hashlib."):
+        return True
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr in _HASH_SANITIZER_METHODS
+    return False
+
+
+class _KeyScan:
+    """Walk one key expression, tracking sanitizer context."""
+
+    def __init__(self):
+        self.hits: List[Tuple[int, str]] = []
+
+    def scan(self, node: ast.AST, hash_safe: bool,
+             order_safe: bool) -> None:
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn is not None:
+                if fn in _NONDET_EXACT or fn.startswith(_NONDET_PREFIXES):
+                    self.hits.append((
+                        node.lineno,
+                        f"nondeterministic {fn}() in a cache/bucket key "
+                        f"defeats caching (fresh key every call)"))
+                elif not hash_safe and fn in _UNHASHABLE_CALLS:
+                    self.hits.append((
+                        node.lineno,
+                        f"unhashable {fn}() in a cache/bucket key "
+                        f"(wrap in tuple()/frozenset() or hash it)"))
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("items", "keys", "values")
+                    and not node.args and not order_safe):
+                self.hits.append((
+                    node.lineno,
+                    f".{node.func.attr}() iteration order feeds this key; "
+                    f"wrap in sorted(...) for a cross-run-stable key"))
+            child_hash = hash_safe or _call_sanitizes(node)
+            child_order = order_safe or fn in ("sorted", "format_series")
+            for child in ast.iter_child_nodes(node):
+                self.scan(child, child_hash, child_order)
+            return
+        if isinstance(node, _UNHASHABLE_NODES) and not hash_safe:
+            kind = type(node).__name__.lower()
+            self.hits.append((
+                node.lineno,
+                f"unhashable {kind} literal in a cache/bucket key "
+                f"(use a tuple)"))
+        for child in ast.iter_child_nodes(node):
+            self.scan(child, hash_safe, order_safe)
+
+
+def _static_indices(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """``static_argnums``/``static_argnames`` from a jit call's kwargs."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+
+    def consts(node: ast.AST) -> list:
+        if isinstance(node, ast.Constant):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [c.value for c in node.elts
+                    if isinstance(c, ast.Constant)]
+        return []
+
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums |= {v for v in consts(kw.value) if isinstance(v, int)}
+        elif kw.arg == "static_argnames":
+            names |= {v for v in consts(kw.value) if isinstance(v, str)}
+    return nums, names
+
+
+def _check_static_defaults(func: ast.AST, nums: Set[int],
+                           names: Set[str]) -> Iterable[Tuple[int, str]]:
+    args = func.args.args
+    defaults = func.args.defaults  # align to the LAST len(defaults) args
+    offset = len(args) - len(defaults)
+    for i, arg in enumerate(args):
+        if i not in nums and arg.arg not in names:
+            continue
+        d = i - offset
+        if 0 <= d < len(defaults) and isinstance(defaults[d],
+                                                 _UNHASHABLE_NODES):
+            yield (defaults[d].lineno,
+                   f"static arg {arg.arg!r} of jitted {func.name}() has "
+                   f"an unhashable default (static args must be "
+                   f"hashable)")
+
+
+class RecompileHazardRule(Rule):
+    rule_id = "recompile-hazard"
+    description = ("unhashable / nondeterministic values and unsorted "
+                   "dict iteration flowing into jit bucket keys, cache "
+                   "keys, and static_argnums")
+
+    def check(self, repo: Repo) -> Iterable[Finding]:
+        for sf in repo.selected():
+            try:
+                tree = sf.tree
+            except SyntaxError:
+                continue  # trace-purity already reports unparseable files
+            yield from self._check_tree(sf.rel, tree)
+
+    def _check_tree(self, rel: str, tree: ast.AST) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            exprs: List[Tuple[ast.AST, str]] = []
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Name)
+                            and tgt.id.lower().endswith("key")):
+                        exprs.append((node.value, tgt.id))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt = node.target
+                if (isinstance(tgt, ast.Name)
+                        and tgt.id.lower().endswith("key")):
+                    exprs.append((node.value, tgt.id))
+            elif isinstance(node, ast.Call):
+                callee = (dotted_name(node.func) or "").split(".")[-1]
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    if kw.arg.endswith("_key") or (
+                            kw.arg == "key"
+                            and callee not in _SORT_FAMILY):
+                        exprs.append((kw.value, kw.arg))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name.lower().endswith("key"):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Return) and sub.value:
+                            exprs.append((sub.value, node.name))
+                yield from self._check_jit_statics(rel, node)
+            for expr, symbol in exprs:
+                scan = _KeyScan()
+                scan.scan(expr, hash_safe=False, order_safe=False)
+                for line, msg in scan.hits:
+                    yield Finding(self.rule_id, rel, line, msg,
+                                  symbol=symbol)
+
+    def _check_jit_statics(self, rel: str,
+                           func: ast.AST) -> Iterable[Finding]:
+        for dec in func.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            fn = dotted_name(dec.func)
+            is_jit = fn in ("jax.jit", "jit")
+            is_partial_jit = (fn in ("partial", "functools.partial")
+                              and dec.args
+                              and dotted_name(dec.args[0])
+                              in ("jax.jit", "jit"))
+            if not (is_jit or is_partial_jit):
+                continue
+            nums, names = _static_indices(dec)
+            for line, msg in _check_static_defaults(func, nums, names):
+                yield Finding(self.rule_id, rel, line, msg,
+                              symbol=func.name)

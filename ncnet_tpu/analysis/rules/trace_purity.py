@@ -1,0 +1,214 @@
+"""``trace-purity``: no host syncs or impure calls inside traced code.
+
+A host sync hiding inside a jitted hot path (``float()`` on a traced
+array, ``np.asarray``, ``jax.device_get``, ``.item()``) either crashes
+at trace time or — worse — silently forces a device round-trip per
+step that PR 4's compile telemetry can only observe *after* it ships.
+Impure calls (``time.*``, ``print``, host RNG) trace to a constant or
+interleave with the XLA program in ways no test pins. GSPMD-era JAX
+systems live or die on static-shape, sync-free traced code
+(arXiv:2105.04663) — so this rule enforces it statically.
+
+What counts as *traced code*:
+
+* a function decorated ``@jax.jit`` / ``@jit`` /
+  ``@(functools.)partial(jax.jit, ...)``;
+* a function passed to ``jax.jit(f)`` / ``jit(f)``;
+* a function (or lambda) passed as the body of ``(jax.)lax.scan`` /
+  ``(jax.)lax.map`` — including scans *inside* an already-traced
+  function;
+* everything lexically nested inside the above;
+* **one level** of call-graph resolution within the same module: a
+  traced function calling module-local helper ``f()`` gets ``f``'s
+  body scanned too (cross-module calls are out of scope — the module
+  boundary is where shape/purity contracts are documented).
+
+Flagged calls: ``float()``, ``.item()``, ``.tolist()``,
+``np.asarray``/``np.array``, ``jax.device_get``,
+``.block_until_ready()``, ``time.*``, bare ``print``, and host RNG
+(``np.random.*``, stdlib ``random.*``, ``os.urandom``, ``uuid.*``).
+``jax.random.*`` is pure and exempt. Deliberate exceptions get a
+one-line ``# ncnet-lint: disable=trace-purity`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..engine import Finding, Repo, Rule, dotted_name
+
+#: Exact dotted callees that sync or break purity inside a trace.
+_BANNED_EXACT = {
+    "float": "host conversion of a traced value",
+    "print": "host I/O inside traced code",
+    "np.asarray": "forces a device->host transfer",
+    "np.array": "forces a device->host transfer",
+    "numpy.asarray": "forces a device->host transfer",
+    "numpy.array": "forces a device->host transfer",
+    "jax.device_get": "explicit device->host fetch",
+    "os.urandom": "host RNG traces to a constant",
+}
+
+#: Dotted-prefix callees (module families) banned inside a trace.
+_BANNED_PREFIXES = {
+    "time.": "wall-clock reads trace to a constant",
+    "np.random.": "host RNG traces to a constant (use jax.random)",
+    "numpy.random.": "host RNG traces to a constant (use jax.random)",
+    "random.": "host RNG traces to a constant (use jax.random)",
+    "uuid.": "host RNG traces to a constant",
+}
+
+#: Banned method calls on any object (attribute name alone).
+_BANNED_METHODS = {
+    "item": "syncs one element to the host",
+    "tolist": "syncs the whole array to the host",
+    "block_until_ready": "host sync inside traced code",
+    "device_get": "explicit device->host fetch",
+}
+
+#: Function-position argument index for trace-body-taking callees.
+_BODY_TAKERS = {
+    "lax.scan": 0, "jax.lax.scan": 0,
+    "lax.map": 0, "jax.lax.map": 0,
+    "lax.fori_loop": 2, "jax.lax.fori_loop": 2,
+    "lax.while_loop": 1, "jax.lax.while_loop": 1,
+}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` / ``(functools.)partial(jax.jit, ...)``."""
+    name = dotted_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+class _ModuleIndex:
+    """Per-module maps the scanner needs: every function def by name
+    (for one-level resolution) and the set of traced roots."""
+
+    def __init__(self, tree: ast.AST):
+        self.funcs: Dict[str, ast.AST] = {}
+        self.traced: List[Tuple[ast.AST, str]] = []  # (func node, why)
+        traced_ids: Set[int] = set()
+
+        def mark(node: ast.AST, why: str) -> None:
+            if node is not None and id(node) not in traced_ids:
+                traced_ids.add(id(node))
+                self.traced.append((node, why))
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Last def wins on name collisions; good enough for
+                # one-level resolution of module-local helpers.
+                self.funcs[node.name] = node
+                for dec in node.decorator_list:
+                    if _is_jit_expr(dec):
+                        mark(node, f"@jit {node.name}")
+            if isinstance(node, ast.Call):
+                fn = dotted_name(node.func)
+                if fn in ("jax.jit", "jit") and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name):
+                        self._defer = getattr(self, "_defer", [])
+                        self._defer.append((arg.id, f"jit({arg.id})"))
+                    elif isinstance(arg, ast.Lambda):
+                        mark(arg, "jit(lambda)")
+                body_pos = _BODY_TAKERS.get(fn or "")
+                if body_pos is not None and len(node.args) > body_pos:
+                    arg = node.args[body_pos]
+                    if isinstance(arg, ast.Name):
+                        self._defer = getattr(self, "_defer", [])
+                        self._defer.append((arg.id, f"{fn}({arg.id})"))
+                    elif isinstance(arg, ast.Lambda):
+                        mark(arg, f"{fn}(lambda)")
+        # Name references resolve after the full def map exists (a body
+        # may be defined after — or before — the site that traces it).
+        for name, why in getattr(self, "_defer", []):
+            fn_node = self.funcs.get(name)
+            if fn_node is not None:
+                mark(fn_node, why)
+
+
+def _scan_body(func: ast.AST, index: _ModuleIndex, resolve: bool,
+               seen_funcs: Set[int]) -> Iterable[Tuple[ast.Call, str]]:
+    """Yield (banned call node, why) inside one traced function body.
+
+    ``resolve``: follow one level of bare-name calls to module-local
+    defs. ``seen_funcs`` stops revisits (recursion, diamond calls).
+    """
+    if id(func) in seen_funcs:
+        return
+    seen_funcs.add(id(func))
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = dotted_name(node.func)
+        if fn is not None:
+            if fn in _BANNED_EXACT:
+                yield node, f"{fn}(): {_BANNED_EXACT[fn]}"
+                continue
+            hit = next((p for p in _BANNED_PREFIXES if fn.startswith(p)),
+                       None)
+            if hit is not None:
+                yield node, f"{fn}(): {_BANNED_PREFIXES[hit]}"
+                continue
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            # Skip dotted module calls already vetted above (e.g.
+            # jax.random.split) — only flag *method* names when the
+            # full dotted form wasn't a known-pure module path.
+            if meth in _BANNED_METHODS and not (
+                    fn and (fn.startswith("jax.random.")
+                            or fn.startswith("jnp."))):
+                yield node, f".{meth}(): {_BANNED_METHODS[meth]}"
+                continue
+        if resolve and isinstance(node.func, ast.Name):
+            callee = index.funcs.get(node.func.id)
+            if callee is not None:
+                for call, why in _scan_body(callee, index, resolve=False,
+                                            seen_funcs=seen_funcs):
+                    # Attribute the finding to the impure line itself;
+                    # the message names the traced entry it is reached
+                    # from via this call.
+                    yield call, why + f" (reached via {node.func.id}())"
+
+
+class TracePurityRule(Rule):
+    rule_id = "trace-purity"
+    description = ("host-sync / impure calls inside jax.jit, lax.scan, "
+                   "and lax.map bodies (one-level module-local call "
+                   "resolution)")
+
+    def check(self, repo: Repo) -> Iterable[Finding]:
+        for sf in repo.selected():
+            try:
+                index = _ModuleIndex(sf.tree)
+            except SyntaxError as exc:
+                yield Finding(self.rule_id, sf.rel, exc.lineno or 1,
+                              f"unparseable file: {exc.msg}")
+                continue
+            reported: Set[Tuple[int, str]] = set()
+            for func, why in index.traced:
+                seen: Set[int] = set()
+                # The traced set is walked per root; nested defs inside
+                # this root are covered by ast.walk, other roots get
+                # their own pass (seen_funcs is per-root so a shared
+                # helper is attributed from each trace reaching it).
+                for call, reason in _scan_body(func, index, resolve=True,
+                                               seen_funcs=seen):
+                    key = (call.lineno, reason)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    name = getattr(func, "name", "<lambda>")
+                    yield Finding(
+                        self.rule_id, sf.rel, call.lineno,
+                        f"impure call in traced code ({why}): {reason}",
+                        symbol=name,
+                    )

@@ -1,0 +1,430 @@
+"""The analysis engine itself: seeded violations, suppression, and the
+tier-1 repo-wide gate.
+
+Fixture repos are built under tmp_path (a ``ncnet_tpu/`` tree the
+:class:`~ncnet_tpu.analysis.engine.Repo` discovers like the real one)
+with one known-bad file per rule — the lint must FIRE on each of them
+and stay quiet on the clean counterparts, or a refactor could silently
+empty a rule and every downstream gate would pass trivially.
+
+The repo-wide test at the bottom is the actual tier-1 gate: all rules
+over the real repo, zero new findings, acyclic lock graph, every
+baseline entry justified. Fast, ``JAX_PLATFORMS=cpu``-safe, no model
+build — it never imports jax.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from ncnet_tpu.analysis import (
+    Baseline,
+    Repo,
+    all_rules,
+    get_rules,
+    run_rules,
+)
+from ncnet_tpu.analysis.rules.lock_order import build_graph
+
+
+def make_repo(tmp_path, files):
+    """A fixture repo: {relpath: source} -> Repo rooted at tmp_path."""
+    for rel, text in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    return Repo(root=str(tmp_path))
+
+
+def run_rule(repo, rule_id, baseline=None):
+    return run_rules(repo, get_rules([rule_id]), baseline)
+
+
+# -- trace-purity ---------------------------------------------------------
+
+
+TRACED_BAD = {
+    "ncnet_tpu/bad_jit.py": """
+        import time
+        import numpy as np
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            print("step", t)
+            return _helper(x)
+
+
+        def _helper(x):
+            return float(np.asarray(x).mean())
+
+
+        def body(c, x):
+            return c, x.item()
+
+
+        def run(xs):
+            return jax.lax.scan(body, 0, xs)
+    """,
+}
+
+TRACED_CLEAN = {
+    "ncnet_tpu/good_jit.py": """
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def step(key, x):
+            noise = jax.random.normal(key, x.shape)
+            return jnp.asarray(x) + noise
+
+
+        def host_driver(x):
+            # Host-side code may sync freely: not reached from a trace.
+            print("result", float(x.mean()))
+    """,
+}
+
+
+def test_trace_purity_fires_on_seeded_jit_host_sync(tmp_path):
+    repo = make_repo(tmp_path, TRACED_BAD)
+    report = run_rule(repo, "trace-purity")
+    msgs = {(f.line, f.symbol) for f in report.findings}
+    lines = [l.rstrip() for l in (tmp_path / "ncnet_tpu/bad_jit.py")
+             .read_text().splitlines()]
+    def line_of(snippet):
+        return next(i for i, l in enumerate(lines, 1) if snippet in l)
+    assert (line_of("time.time()"), "step") in msgs          # direct
+    assert (line_of('print("step"'), "step") in msgs         # print
+    assert (line_of("float(np.asarray"), "step") in msgs     # via helper
+    assert (line_of("x.item()"), "body") in msgs             # scan body
+    assert len(report.findings) >= 5  # float + asarray on the same line
+
+
+def test_trace_purity_quiet_on_pure_traced_code(tmp_path):
+    repo = make_repo(tmp_path, TRACED_CLEAN)
+    report = run_rule(repo, "trace-purity")
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+# -- lock-order -----------------------------------------------------------
+
+
+LOCK_CYCLE = {
+    "ncnet_tpu/serving/locks.py": """
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._l1 = threading.Lock()
+                self._l2 = threading.Lock()
+
+            def forward(self):
+                with self._l1:
+                    with self._l2:
+                        pass
+
+            def backward(self):
+                with self._l2:
+                    self._grab_l1()
+
+            def _grab_l1(self):
+                with self._l1:
+                    pass
+    """,
+}
+
+LOCK_SELF = {
+    "ncnet_tpu/serving/selflock.py": """
+        import threading
+
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """,
+}
+
+LOCK_CLEAN = {
+    "ncnet_tpu/serving/ordered.py": """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def both(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def also_both(self):
+                with self._a:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b:
+                    pass
+    """,
+}
+
+
+def _cycle_findings(report):
+    return [f for f in report.findings if f.symbol != "docs-block"]
+
+
+def test_lock_order_detects_two_lock_cycle(tmp_path):
+    repo = make_repo(tmp_path, LOCK_CYCLE)
+    report = run_rule(repo, "lock-order")
+    cycles = _cycle_findings(report)
+    assert cycles, "two-lock cycle not detected"
+    assert any("A._l1" in f.message and "A._l2" in f.message
+               for f in cycles)
+    g = build_graph(repo)
+    assert ("A._l1", "A._l2") in g.edges  # nested with
+    assert ("A._l2", "A._l1") in g.edges  # via call resolution
+
+
+def test_lock_order_detects_nonreentrant_self_acquire(tmp_path):
+    repo = make_repo(tmp_path, LOCK_SELF)
+    report = run_rule(repo, "lock-order")
+    assert any("re-acquired" in f.message
+               for f in _cycle_findings(report))
+
+
+def test_lock_order_quiet_on_consistent_order(tmp_path):
+    repo = make_repo(tmp_path, LOCK_CLEAN)
+    report = run_rule(repo, "lock-order")
+    assert _cycle_findings(report) == [], (
+        [f.message for f in report.findings])
+    g = build_graph(repo)
+    assert ("C._a", "C._b") in g.edges
+    assert not g.cycles()
+
+
+# -- recompile-hazard -----------------------------------------------------
+
+
+KEY_BAD = {
+    "ncnet_tpu/keys.py": """
+        import time
+
+
+        def submit(x, h, w, d):
+            bucket_key = [h, w]
+            cache_key = (time.time(), x)
+            table_key = tuple(d.items())
+            return bucket_key, cache_key, table_key
+    """,
+}
+
+KEY_CLEAN = {
+    "ncnet_tpu/goodkeys.py": """
+        import hashlib
+
+
+        def submit(x, h, w, d):
+            bucket_key = (h, w)
+            table_key = tuple(sorted(d.items()))
+            blob_key = hashlib.sha256(repr([h, w]).encode()).hexdigest()
+            return bucket_key, table_key, blob_key
+    """,
+}
+
+STATIC_BAD = {
+    "ncnet_tpu/statics.py": """
+        from functools import partial
+
+        import jax
+
+
+        @partial(jax.jit, static_argnums=(1,))
+        def f(x, cfg=[1, 2]):
+            return x
+    """,
+}
+
+
+def test_recompile_hazard_fires_on_seeded_keys(tmp_path):
+    repo = make_repo(tmp_path, KEY_BAD)
+    report = run_rule(repo, "recompile-hazard")
+    by_symbol = {f.symbol: f.message for f in report.findings}
+    assert "unhashable" in by_symbol["bucket_key"]
+    assert "nondeterministic time.time" in by_symbol["cache_key"]
+    assert "iteration order" in by_symbol["table_key"]
+
+
+def test_recompile_hazard_quiet_on_sanitized_keys(tmp_path):
+    repo = make_repo(tmp_path, KEY_CLEAN)
+    report = run_rule(repo, "recompile-hazard")
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+def test_recompile_hazard_flags_unhashable_static_default(tmp_path):
+    repo = make_repo(tmp_path, STATIC_BAD)
+    report = run_rule(repo, "recompile-hazard")
+    assert any("static arg" in f.message and f.symbol == "f"
+               for f in report.findings)
+
+
+# -- bare-print -----------------------------------------------------------
+
+
+PRINT_FILES = {
+    "ncnet_tpu/libmod.py": """
+        import sys
+
+
+        def report(x):
+            print("bad", x)
+            print("fine", x, file=sys.stderr)
+    """,
+    "ncnet_tpu/cli/tool.py": """
+        def main():
+            print("cli stdout is the contract")
+    """,
+}
+
+
+def test_bare_print_flags_library_not_cli(tmp_path):
+    repo = make_repo(tmp_path, PRINT_FILES)
+    report = run_rule(repo, "bare-print")
+    paths = [f.path for f in report.findings]
+    assert paths == ["ncnet_tpu/libmod.py"], paths
+
+
+# -- pragma + baseline suppression ---------------------------------------
+
+
+def test_pragma_suppresses_same_line_and_line_above(tmp_path):
+    repo = make_repo(tmp_path, {
+        "ncnet_tpu/pragmas.py": """
+            def f(x):
+                print("same-line")  # ncnet-lint: disable=bare-print
+                # ncnet-lint: disable=bare-print
+                print("line-above")
+                # ncnet-lint: disable=all
+                print("disable-all")
+                print("still flagged")
+        """,
+    })
+    report = run_rule(repo, "bare-print")
+    assert len(report.findings) == 1
+    assert report.suppressed == 3
+    assert "still flagged" in repo.file("ncnet_tpu/pragmas.py").lines[
+        report.findings[0].line - 1]
+
+
+def test_file_pragma_only_in_header(tmp_path):
+    header = make_repo(tmp_path / "hdr", {
+        "ncnet_tpu/wholefile.py": """
+            # ncnet-lint: disable-file=bare-print
+            def f():
+                print("a")
+                print("b")
+        """,
+    })
+    assert run_rule(header, "bare-print").findings == []
+    buried = make_repo(tmp_path / "buried", {
+        "ncnet_tpu/late.py": "\n" * 30 + textwrap.dedent("""
+            # ncnet-lint: disable-file=bare-print
+            def f():
+                print("a")
+        """),
+    })
+    assert len(run_rule(buried, "bare-print").findings) == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    repo = make_repo(tmp_path, PRINT_FILES)
+    first = run_rule(repo, "bare-print")
+    assert first.new and not first.ok
+    bl = Baseline.from_findings(first.findings)
+    path = str(tmp_path / "baseline.json")
+    bl.save(path)
+    second = run_rule(repo, "bare-print", Baseline.load(path))
+    assert second.ok
+    assert len(second.findings) == len(first.findings)  # still counted
+    assert second.new == []
+    data = json.loads((tmp_path / "baseline.json").read_text())
+    assert data["version"] == 1 and data["entries"]
+
+
+def test_baseline_symbol_match_survives_line_churn(tmp_path):
+    bl = Baseline([{"rule": "trace-purity", "path": "ncnet_tpu/x.py",
+                    "line": 999, "symbol": "step", "reason": "ok"}])
+    from ncnet_tpu.analysis import Finding
+    moved = Finding("trace-purity", "ncnet_tpu/x.py", 12, "msg",
+                    symbol="step")
+    other = Finding("trace-purity", "ncnet_tpu/x.py", 12, "msg",
+                    symbol="other")
+    assert bl.matches(moved)
+    assert not bl.matches(other)
+
+
+def test_changed_only_selection_cannot_fake_docs_verdicts(tmp_path):
+    """full_repo rules must see every file even when a selection narrows
+    the per-file set — otherwise --changed-only on an unrelated file
+    would report every docs row stale (or none)."""
+    repo_all = Repo()
+    repo_narrow = Repo(selected=["ncnet_tpu/version.py"])
+    full = run_rules(repo_all, get_rules(["metrics-docs"]))
+    narrow = run_rules(repo_narrow, get_rules(["metrics-docs"]))
+    assert ([f.message for f in full.findings]
+            == [f.message for f in narrow.findings])
+    # while a per-file rule genuinely narrows:
+    assert len(repo_narrow.selected()) <= 1
+
+
+# -- the tier-1 repo-wide gate -------------------------------------------
+
+
+def test_repo_passes_full_analysis():
+    """The gate: all rules, real repo, zero new findings. A real
+    violation must be FIXED (or pragma'd with a justification) — the
+    baseline is for deliberate exceptions only."""
+    repo = Repo()
+    report = run_rules(repo, all_rules(),
+                       Baseline.load(Baseline.default_path(repo)))
+    assert report.ok, "\n".join(
+        f"{f.rule} {f.location()} {f.message}" for f in report.new)
+
+
+def test_repo_lock_graph_is_acyclic():
+    """ISSUE 10 acceptance: the serving+obs+pipeline lock set admits a
+    total acquisition order (no deadlock hazard)."""
+    g = build_graph(Repo())
+    assert g.cycles() == []
+    # the graph is non-trivial: the known held-across-call edges exist
+    assert ("DeadlineBatcher._cond", "MetricsRegistry._lock") in g.edges
+    assert ("MatchEngine._store_lock", "PanoFeatureCache._lock") in g.edges
+
+
+def test_baseline_entries_are_justified():
+    """Every committed baseline entry carries a nonempty reason, and
+    none hide serving/ or obs/ findings (ISSUE 10 satellite: zero
+    unexplained entries in those trees)."""
+    repo = Repo()
+    bl = Baseline.load(Baseline.default_path(repo))
+    for e in bl.entries:
+        assert e.get("reason"), f"baseline entry needs a reason: {e}"
+        assert not e.get("path", "").startswith(
+            ("ncnet_tpu/serving/", "ncnet_tpu/obs/")), (
+            f"serving/obs findings must be fixed or pragma'd in code, "
+            f"not baselined: {e}")

@@ -463,3 +463,88 @@ def test_bulk_match_chaos_contract(tmp_path, capsys):
     assert rec["wrongly_quarantined"] == 0
     assert rec["kills"] == 2
     assert rec["resumes"] >= 2
+
+
+def test_ncnet_lint_emits_one_json_line(capsys):
+    """tools/ncnet_lint.py stdout contract (ISSUE 10): the full-repo
+    lint, run in-process, prints ONE JSON line with the findings/new
+    counts and the rule list, and exits 0 on the clean repo."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import ncnet_lint
+
+    rc = ncnet_lint.main([])
+    assert rc == 0, capsys.readouterr().err
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    for key in ("findings", "new", "rules", "files", "suppressed",
+                "duration_s"):
+        assert key in rec, rec
+    assert rec["new"] == 0
+    assert set(rec["rules"]) == {
+        "bare-print", "failpoint-docs", "lock-order", "metrics-docs",
+        "recompile-hazard", "trace-purity",
+    }
+    # Unknown rules are a usage error (rc 2), not a silent pass.
+    assert ncnet_lint.main(["--rule", "nope"]) == 2
+    capsys.readouterr()
+
+
+def test_ncnet_lint_nonzero_on_seeded_fixtures(tmp_path, capsys):
+    """ISSUE 10 acceptance: the tool (not just the engine) exits
+    nonzero on each seeded violation class, driven through --root."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import textwrap
+
+    import ncnet_lint
+
+    fixtures = {
+        "trace-purity": ("ncnet_tpu/bad.py", """
+            import time
+
+            import jax
+
+
+            @jax.jit
+            def step(x):
+                return x + time.time()
+        """),
+        "lock-order": ("ncnet_tpu/serving/bad.py", """
+            import threading
+
+
+            class A:
+                def __init__(self):
+                    self._l1 = threading.Lock()
+                    self._l2 = threading.Lock()
+
+                def f(self):
+                    with self._l1:
+                        with self._l2:
+                            pass
+
+                def g(self):
+                    with self._l2:
+                        with self._l1:
+                            pass
+        """),
+        "recompile-hazard": ("ncnet_tpu/bad.py", """
+            def f(h, w):
+                bucket_key = [h, w]
+                return bucket_key
+        """),
+        "bare-print": ("ncnet_tpu/bad.py", """
+            def f(x):
+                print("x", x)
+        """),
+    }
+    for rule, (rel, src) in fixtures.items():
+        root = tmp_path / rule
+        path = root / rel
+        path.parent.mkdir(parents=True)
+        path.write_text(textwrap.dedent(src))
+        rc = ncnet_lint.main(["--root", str(root), "--rule", rule])
+        err = capsys.readouterr()
+        assert rc == 1, f"{rule} fixture should fail the lint: {err.err}"
+        rec = json.loads(err.out.strip())
+        assert rec["new"] >= 1, (rule, rec)

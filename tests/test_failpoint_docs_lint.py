@@ -1,91 +1,39 @@
-"""Tier-1 style gate: every failpoint site is documented, both ways.
+"""Tier-1 gate: every failpoint site is documented, both ways.
 
-Mirrors tests/test_metrics_docs_lint.py for the chaos surface: an AST
-walk over ``ncnet_tpu/`` collects every *named* failpoint plant —
-``failpoints.fire("site", ...)`` and ``failpoints.corrupt("site",
-...)`` with a literal first argument — and cross-checks the set
-against the "Planted sites" table in docs/RELIABILITY.md:
-
-* a site in code but not the table is an undocumented chaos hook
-  (nobody will ever arm it, so its failure path stays untested);
-* a site in the table but not the code is stale docs (a chaos spec
-  naming it silently arms nothing — worse than an error).
-
-One docs row may carry several backticked site names in its first cell
-(the checkpoint family does); all of them count.
+Thin wrapper over the engine's ``failpoint-docs`` rule
+(ncnet_tpu/analysis/rules/failpoint_docs.py) — the AST walking and
+docs parsing that used to live here moved into the shared analysis
+engine. The tests split the rule's findings back into the pre-port
+verdicts and keep the known-surface canary pinning the collector
+(corrupt-form plants, multi-site docs rows, the bulk commit-window
+sites).
 """
 
-import ast
-import os
-import re
-
-import ncnet_tpu
-
-PKG_DIR = os.path.dirname(os.path.abspath(ncnet_tpu.__file__))
-REPO = os.path.dirname(PKG_DIR)
-DOCS = os.path.join(REPO, "docs", "RELIABILITY.md")
-DOCS_MARKER = "Planted sites"
-
-_SITE_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+from ncnet_tpu.analysis import Repo, get_rules, run_rules
+from ncnet_tpu.analysis.rules.failpoint_docs import (
+    docs_table_sites,
+    planted_sites,
+)
 
 
-def planted_sites():
-    """(relpath, lineno, site) for every literal-named plant under
-    ncnet_tpu/. Non-literal first args (none exist today) are skipped —
-    sites must be grep-able string literals by convention."""
-    out = []
-    for root, _dirs, files in os.walk(PKG_DIR):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, PKG_DIR)
-            with open(path, encoding="utf-8") as fh:
-                tree = ast.parse(fh.read(), filename=path)
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call) and node.args):
-                    continue
-                func = node.func
-                if not (isinstance(func, ast.Attribute)
-                        and func.attr in ("fire", "corrupt")
-                        and isinstance(func.value, ast.Name)
-                        and func.value.id == "failpoints"):
-                    continue
-                arg = node.args[0]
-                if isinstance(arg, ast.Constant) and isinstance(
-                        arg.value, str):
-                    out.append((rel, node.lineno, arg.value))
-    return out
-
-
-def docs_table_sites():
-    """All backticked names from the site table's first column."""
-    with open(DOCS, encoding="utf-8") as fh:
-        text = fh.read()
-    assert DOCS_MARKER in text, (
-        f"docs/RELIABILITY.md lost its {DOCS_MARKER!r} table intro")
-    section = text.split(DOCS_MARKER, 1)[1].split("\n## ", 1)[0]
-    sites = set()
-    for cell in re.findall(r"^\|([^|]*)\|", section, re.MULTILINE):
-        sites.update(re.findall(r"`([a-z][a-z0-9_.]*)`", cell))
-    sites.discard("failpoints.fire")  # the grep hint in the intro text
-    assert sites, "the Planted sites table has no rows"
-    return sites
+def _findings():
+    repo = Repo()
+    return repo, run_rules(repo, get_rules(["failpoint-docs"])).findings
 
 
 def test_site_names_are_well_formed():
-    bad = [f"{rel}:{line} {site!r}"
-           for rel, line, site in planted_sites()
-           if not _SITE_RE.match(site)]
+    _repo, findings = _findings()
+    bad = [f"{f.location()} {f.symbol!r}" for f in findings
+           if "dotted lowercase" in f.message]
     assert not bad, (
         f"failpoint sites must be dotted lowercase (domain.site): {bad}")
 
 
 def test_planted_sites_match_docs_table():
-    code = {site for _rel, _line, site in planted_sites()}
-    docs = docs_table_sites()
-    undocumented = sorted(code - docs)
-    stale = sorted(docs - code)
+    _repo, findings = _findings()
+    undocumented = [f"{f.location()} {f.symbol}" for f in findings
+                    if "missing from" in f.message]
+    stale = [f.symbol for f in findings if "stale row" in f.message]
     assert not undocumented, (
         "failpoint sites missing from the docs/RELIABILITY.md "
         f"'Planted sites' table: {undocumented}"
@@ -99,12 +47,14 @@ def test_planted_sites_match_docs_table():
 def test_lint_sees_the_known_surface():
     """Keep the collector honest: the sites every chaos gate depends on
     must be visible, including corrupt-form plants, multi-site docs
-    rows, and the new bulk commit-window sites."""
-    sites = {s for _r, _l, s in planted_sites()}
+    rows, and the bulk commit-window sites."""
+    repo = Repo()
+    sites = {s for _r, _l, s in planted_sites(repo)}
     for expected in ("engine.device", "loader.read", "client.transport",
                      "checkpoint.save.commit", "bulk.commit",
                      "bulk.checkpoint", "bulk.read", "bulk.dispatch"):
         assert expected in sites, f"collector lost {expected}"
-    docs = docs_table_sites()
+    docs = docs_table_sites(repo)
+    assert docs, "docs/RELIABILITY.md Planted sites table went missing"
     assert "checkpoint.save.commit" in docs, (
         "multi-site docs cells must contribute every backticked name")

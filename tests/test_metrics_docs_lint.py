@@ -1,142 +1,32 @@
-"""Tier-1 style gate: metric names are Prometheus-safe and documented.
+"""Tier-1 gate: metric names are Prometheus-safe and documented.
 
-Two invariants, enforced the test_no_bare_print.py way (AST over the
-whole package, so docstrings and comments don't trip it):
-
-1. **Prometheus safety** — every metric name passed to
-   ``counter()``/``gauge()``/``histogram()`` anywhere under
-   ``ncnet_tpu/`` is dotted lowercase (``[a-z0-9_.]``, no spaces, no
-   leading digit/dot, no empty segments), so the ``/metrics``
-   sanitization (dots -> underscores) can never produce an invalid or
-   colliding Prometheus family name.
-
-2. **Docs cross-check** — the serving / SLO / heartbeat / breaker /
-   build-info families (the fleet-observability surface this repo's
-   dashboards and SLOs are built on) must match the canonical table in
-   docs/OBSERVABILITY.md ("Serving & SLO metric families") BOTH ways:
-   a family in code but not the table is undocumented; a family in the
-   table but not the code is stale docs. Runtime-formatted segments
-   (f-string fields) normalize to ``<field>`` on both sides.
-
-Dynamic pass-through call sites (a bare variable forwarded by a
-wrapper, e.g. ``obs.counter(name)``) are unresolvable and skipped;
-every resolvable shape — literals, f-strings, conditional literals,
-string concatenation — is linted.
+Thin wrapper over the engine's ``metrics-docs`` rule
+(ncnet_tpu/analysis/rules/metrics_docs.py) — the AST walking and docs
+parsing that used to live here moved into the shared analysis engine.
+The tests split the rule's findings back into the two pre-port
+verdicts (Prometheus safety, docs cross-check) so a regression names
+the invariant it broke, and keep the known-surface canary that pins
+the collector's resolvable shapes (literal, f-string, conditional,
+concatenation).
 """
 
-import ast
-import os
-import re
-
-import ncnet_tpu
-
-PKG_DIR = os.path.dirname(os.path.abspath(ncnet_tpu.__file__))
-REPO = os.path.dirname(PKG_DIR)
-DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
-DOCS_SECTION = "## Serving & SLO metric families"
-
-#: Families the docs table must cover, both ways (the fleet surface).
-SCOPED_PREFIXES = ("serving.", "slo.", "obs.heartbeat.", "breaker.",
-                   "ncnet.", "bulk.", "engine.")
-
-_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_<>]+)*$")
+from ncnet_tpu.analysis import Repo, get_rules, run_rules
+from ncnet_tpu.analysis.rules.metrics_docs import (
+    docs_table_families,
+    registered_metric_names,
+)
 
 
-def _resolve(node):
-    """A metric-name expression -> normalized template, or None when
-    the shape is a pure pass-through (bare variable) we cannot lint.
-
-    f-string fields and other embedded dynamic parts become
-    ``<field>`` (the attribute/variable name when there is one)."""
-    if isinstance(node, ast.Constant):
-        return node.value if isinstance(node.value, str) else None
-    if isinstance(node, ast.JoinedStr):
-        parts = []
-        for v in node.values:
-            if isinstance(v, ast.Constant):
-                parts.append(str(v.value))
-            elif isinstance(v, ast.FormattedValue):
-                parts.append(f"<{_field_name(v.value)}>")
-        return "".join(parts)
-    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
-        left = _resolve(node.left)
-        right = _resolve(node.right)
-        return ((left if left is not None else f"<{_field_name(node.left)}>")
-                + (right if right is not None
-                   else f"<{_field_name(node.right)}>"))
-    if isinstance(node, ast.IfExp):
-        # Both branches are names; the caller gets a list via _names().
-        return None
-    return None
-
-
-def _field_name(expr):
-    if isinstance(expr, ast.Name):
-        return expr.id
-    if isinstance(expr, ast.Attribute):
-        return expr.attr
-    return "x"
-
-
-def _names(node):
-    """All normalized names one metric-name argument can evaluate to."""
-    if isinstance(node, ast.IfExp):
-        return _names(node.body) + _names(node.orelse)
-    resolved = _resolve(node)
-    # A lone pass-through variable is unresolvable — skip it; a partial
-    # resolution (concat/f-string) keeps its <placeholders>.
-    if resolved is None or resolved.startswith("<"):
-        return []
-    return [resolved]
-
-
-def registered_metric_names():
-    """(relpath, lineno, normalized name) for every resolvable metric
-    registration under ncnet_tpu/."""
-    out = []
-    for root, _dirs, files in os.walk(PKG_DIR):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, PKG_DIR)
-            with open(path, encoding="utf-8") as fh:
-                tree = ast.parse(fh.read(), filename=path)
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call) and node.args):
-                    continue
-                fname = (node.func.attr
-                         if isinstance(node.func, ast.Attribute)
-                         else node.func.id
-                         if isinstance(node.func, ast.Name) else None)
-                if fname not in ("counter", "gauge", "histogram"):
-                    continue
-                for name in _names(node.args[0]):
-                    out.append((rel, node.lineno, name))
-    return out
-
-
-def docs_table_families():
-    """Backticked first-cell names from the canonical docs table."""
-    with open(DOCS, encoding="utf-8") as fh:
-        text = fh.read()
-    assert DOCS_SECTION in text, (
-        f"docs/OBSERVABILITY.md lost its {DOCS_SECTION!r} section")
-    section = text.split(DOCS_SECTION, 1)[1].split("\n## ", 1)[0]
-    names = re.findall(r"^\|\s*`([^`]+)`\s*\|", section, re.MULTILINE)
-    assert names, "the family table has no rows"
-    return set(names)
+def _findings():
+    repo = Repo()
+    return repo, run_rules(repo, get_rules(["metrics-docs"])).findings
 
 
 def test_metric_names_are_prometheus_safe():
-    bad = []
-    for rel, line, name in registered_metric_names():
-        # Placeholders stand in for one sanitized segment.
-        probe = re.sub(r"<[^>]*>", "x", name)
-        if not _NAME_RE.match(probe.replace("<", "").replace(">", "")):
-            bad.append(f"{rel}:{line} {name!r}")
-        if ".." in probe or probe.endswith("."):
-            bad.append(f"{rel}:{line} {name!r} (empty segment)")
+    _repo, findings = _findings()
+    bad = [f"{f.location()} {f.symbol!r}" for f in findings
+           if "dotted lowercase" in f.message
+           or "empty segment" in f.message]
     assert not bad, (
         "metric names must be dotted lowercase [a-z0-9_.] "
         f"(docs/OBSERVABILITY.md metric naming): {bad}"
@@ -144,13 +34,10 @@ def test_metric_names_are_prometheus_safe():
 
 
 def test_fleet_families_match_docs_table():
-    code = {
-        name for _rel, _line, name in registered_metric_names()
-        if name.startswith(SCOPED_PREFIXES)
-    }
-    docs = docs_table_families()
-    undocumented = sorted(code - docs)
-    stale = sorted(docs - code)
+    _repo, findings = _findings()
+    undocumented = [f"{f.location()} {f.symbol}" for f in findings
+                    if "missing from" in f.message]
+    stale = [f.symbol for f in findings if "stale row" in f.message]
     assert not undocumented, (
         "metric families missing from the docs/OBSERVABILITY.md "
         f"'Serving & SLO metric families' table: {undocumented}"
@@ -165,9 +52,13 @@ def test_lint_sees_the_known_surface():
     """The AST collector must keep resolving the shapes the codebase
     actually uses (literal, f-string, conditional); a refactor that
     silently empties the lint would otherwise pass trivially."""
-    names = {n for _r, _l, n in registered_metric_names()}
+    repo = Repo()
+    names = {n for _r, _l, n in registered_metric_names(repo)}
     assert "serving.requests" in names            # literal
     assert "breaker.<name>.state" in names        # f-string
     assert "slo.<name>.<suffix>" in names         # f-string, two fields
     assert "eval_inloc.dispatch.ragged" in names  # IfExp branch
     assert "jit.<x>_s" in names                   # concatenation
+    docs = docs_table_families(repo)
+    assert docs, "docs/OBSERVABILITY.md family table went missing"
+    assert "serving.requests" in docs

@@ -1,74 +1,21 @@
-"""Tier-1 style gate: no bare ``print()`` in library code.
+"""Tier-1 gate: no bare ``print()`` in library code.
 
-Library modules under ``ncnet_tpu/`` (everything except ``cli/``, which
-IS the user-facing stdout surface) must report through the structured
-run log (``ncnet_tpu.obs``) or an explicit stream (``file=sys.stderr``),
-never bare ``print()``: library stdout interleaves with machine-read
-contracts like bench.py's single headline JSON line
-(test_bench_contract.py) and is invisible to tools/obs_report.py.
-
-AST-based, so docstring usage examples (e.g. utils/profiling.PhaseTimer)
-don't trip it. Intentional stdout contracts get an explicit allowlist
-entry with a rationale, not an exemption pattern.
+Thin wrapper over the engine's ``bare-print`` rule
+(ncnet_tpu/analysis/rules/bare_print.py) — the AST walking that used to
+live here moved into the shared analysis engine; this test pins that
+the ported rule reproduces the pre-port verdict (zero bare prints
+outside ``cli/``). Seeded-violation coverage (the rule actually fires
+on a bad file, the cli/ exemption, pragma suppression) lives in
+tests/test_analysis_engine.py.
 """
 
-import ast
-import os
-
-import ncnet_tpu
-
-PKG_DIR = os.path.dirname(os.path.abspath(ncnet_tpu.__file__))
-
-# (relative path, line) -> rationale. Every entry is a deliberate stdout
-# contract; anything not listed here is a failure.
-ALLOWED = {
-    # e.g. ("utils/example.py", 10): "machine-read JSON contract",
-}
-
-
-def _bare_prints(path):
-    with open(path, encoding="utf-8") as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    hits = []
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-            and not any(kw.arg == "file" for kw in node.keywords)
-        ):
-            hits.append(node.lineno)
-    return hits
+from ncnet_tpu.analysis import Repo, get_rules, run_rules
 
 
 def test_no_bare_print_in_library_code():
-    violations = []
-    for root, dirs, files in os.walk(PKG_DIR):
-        rel_root = os.path.relpath(root, PKG_DIR)
-        # cli/ prints to the terminal by design; that is its job.
-        if rel_root == "cli" or rel_root.startswith("cli" + os.sep):
-            continue
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, PKG_DIR)
-            for line in _bare_prints(path):
-                if ALLOWED.get((rel, line)):
-                    continue
-                violations.append(f"{rel}:{line}")
+    report = run_rules(Repo(), get_rules(["bare-print"]))
+    violations = [f.location() for f in report.findings]
     assert not violations, (
         "bare print() in library code (use ncnet_tpu.obs.event or "
-        f"file=sys.stderr, or allowlist with a rationale): {violations}"
+        f"file=sys.stderr, or pragma with a rationale): {violations}"
     )
-
-
-def test_allowlist_is_current():
-    """Stale allowlist entries (code moved/removed) must be pruned."""
-    for (rel, line), rationale in ALLOWED.items():
-        assert rationale, f"allowlist entry {rel}:{line} needs a rationale"
-        path = os.path.join(PKG_DIR, rel)
-        assert os.path.exists(path), f"allowlisted file gone: {rel}"
-        assert line in _bare_prints(path), (
-            f"allowlisted print at {rel}:{line} no longer exists"
-        )

@@ -1,0 +1,158 @@
+"""Run the unified static-analysis pass (docs/ANALYSIS.md) over the repo.
+
+Repo tool convention: stdout carries EXACTLY ONE machine-readable JSON
+line (the contract tested in tests/test_bench_contract.py style)::
+
+    {"findings": N, "new": M, "rules": [...], ...}
+
+Finding detail goes to stderr. Exit status is nonzero iff there are
+*new* (non-baselined, non-pragma'd) findings — the tier-1 gate and any
+session script can consume the exit code directly.
+
+Usage::
+
+    python tools/ncnet_lint.py                  # full repo, all rules
+    python tools/ncnet_lint.py --rule lock-order --rule trace-purity
+    python tools/ncnet_lint.py --format text    # human-readable findings
+    python tools/ncnet_lint.py --changed-only   # only files changed vs
+                                                # git merge-base (repo-wide
+                                                # rules still see all files)
+    python tools/ncnet_lint.py --write-baseline # snapshot findings into
+                                                # analysis/baseline.json
+                                                # (fill in the reasons!)
+    python tools/ncnet_lint.py --write-docs     # regenerate the lock-order
+                                                # table in docs/ANALYSIS.md
+
+The baseline is for deliberate, commented exceptions only — fix real
+violations (or pragma them with a justification) instead of baselining.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ncnet_tpu.analysis import Baseline, Repo, get_rules, run_rules
+from ncnet_tpu.analysis.rules import rule_ids
+from ncnet_tpu.analysis.rules.lock_order import write_docs_block
+
+
+def _changed_files(root: str, base: str) -> Optional[List[str]]:
+    """Repo-relative ncnet_tpu/*.py files changed vs the merge-base
+    with ``base`` (plus untracked), or None when git can't answer —
+    the caller falls back to the full file set, never a silent skip."""
+
+    def git(*args: str) -> str:
+        return subprocess.check_output(
+            ("git", "-C", root) + args, text=True,
+            stderr=subprocess.DEVNULL)
+
+    try:
+        mb = git("merge-base", "HEAD", base).strip()
+        changed = git("diff", "--name-only", mb).splitlines()
+        changed += git("ls-files", "--others",
+                       "--exclude-standard").splitlines()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return sorted({
+        p for p in changed
+        if p.startswith("ncnet_tpu/") and p.endswith(".py")
+    })
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="unified static-analysis pass (docs/ANALYSIS.md)")
+    parser.add_argument("--rule", action="append", default=[],
+                        metavar="ID",
+                        help=f"run only this rule (repeatable); known: "
+                             f"{', '.join(rule_ids())}")
+    parser.add_argument("--format", choices=("json", "text"),
+                        default="json",
+                        help="json: one summary line on stdout, detail "
+                             "on stderr; text: findings on stdout")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="lint only files changed vs the git "
+                             "merge-base (repo-wide rules still see "
+                             "every file)")
+    parser.add_argument("--base", default="main",
+                        help="merge-base ref for --changed-only "
+                             "(default: main)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="snapshot current findings into "
+                             "analysis/baseline.json (add reasons "
+                             "before committing)")
+    parser.add_argument("--write-docs", action="store_true",
+                        help="regenerate the generated lock-order table "
+                             "in docs/ANALYSIS.md, then lint")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="baseline file (default: "
+                             "ncnet_tpu/analysis/baseline.json)")
+    parser.add_argument("--root", default=_REPO,
+                        help="repo root to lint (default: this repo; "
+                             "fixture repos in tests use this)")
+    args = parser.parse_args(argv)
+
+    t0 = time.time()
+    selected = None
+    if args.changed_only:
+        selected = _changed_files(args.root, args.base)
+        if selected is None:
+            print("ncnet_lint: git unavailable; linting the full repo",
+                  file=sys.stderr)
+    repo = Repo(root=args.root, selected=selected)
+
+    docs_updated = False
+    if args.write_docs:
+        docs_updated = write_docs_block(repo)
+
+    try:
+        rules = get_rules(args.rule)
+    except KeyError as exc:
+        print(f"ncnet_lint: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or Baseline.default_path(repo)
+    baseline = Baseline.load(baseline_path)
+    report = run_rules(repo, rules, baseline)
+
+    if args.write_baseline:
+        Baseline.from_findings(report.findings).save(baseline_path)
+        # Re-split against the fresh baseline: everything just written
+        # is by definition no longer "new".
+        report = run_rules(repo, rules, Baseline.load(baseline_path))
+
+    out = report.to_dict()
+    out["duration_s"] = round(time.time() - t0, 3)
+    if args.changed_only:
+        out["changed_only"] = True
+    if args.write_docs:
+        out["docs_updated"] = docs_updated
+    if args.write_baseline:
+        out["baseline_written"] = baseline_path
+
+    detail = sys.stdout if args.format == "text" else sys.stderr
+    for f in report.findings:
+        marker = "NEW " if f in report.new else "baselined "
+        print(f"{marker}{f.rule} {f.location()} {f.message}", file=detail)
+    if args.format == "json":
+        print(json.dumps(out, sort_keys=True))
+    else:
+        print(f"{out['findings']} finding(s), {out['new']} new, "
+              f"{out['suppressed']} pragma-suppressed, "
+              f"{out['files']} file(s), rules: {', '.join(out['rules'])}",
+              file=sys.stdout)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
