@@ -19,7 +19,7 @@ unconditionally — they no-op (or accumulate invisibly) unless an entry
 point opened a run log.
 """
 
-from . import aggregate, flight, slo, trace
+from . import aggregate, costcards, exemplar, flight, slo, trace
 from .events import (
     NULL_RUN,
     RunLog,
@@ -63,6 +63,8 @@ __all__ = [
     "init_run",
     "span",
     "aggregate",
+    "costcards",
+    "exemplar",
     "flight",
     "slo",
     "trace",

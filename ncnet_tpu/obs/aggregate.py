@@ -177,6 +177,13 @@ _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
 def _parse_sample(line: str) -> Optional[Tuple[str, Dict[str, str], float]]:
+    # OpenMetrics exemplar suffix (` # {trace_id="..."} value ts` on
+    # histogram _bucket lines — obs/metrics.py) is scrape metadata, not
+    # part of the sample: strip it before the label/value split, or the
+    # rpartition("}") below would grab the exemplar's closing brace.
+    cut = line.find(" # {")
+    if cut != -1:
+        line = line[:cut]
     rest = line
     name, labels = rest, {}
     if "{" in line:

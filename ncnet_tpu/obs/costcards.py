@@ -1,0 +1,388 @@
+"""Program cost cards + device HBM accounting (the cost observatory).
+
+A *cost card* is the compile-time answer to "what does this program
+cost": the XLA ``cost_analysis()`` FLOP/byte totals and the
+``memory_analysis()`` argument/output/temp footprint of one AOT-compiled
+(bucket, batch, mode) program, cross-checked against an analytic model
+of the consensus conv4d stack (the paper's k^4-kernel math). The
+analytic side is a deliberate LOWER bound of the whole program (the
+backbone, correlation and match extraction ride on top), so the
+honesty flag is one-directional: ``model_ok`` means "the analytic
+consensus cost does not exceed what XLA measured for the whole
+program" — the same publish-the-check posture as bench's ``scale_ok``.
+
+Producers: ``serving.engine.MatchEngine.warmup`` cards every program it
+precompiles; ``ops.autotune.autotune`` cards the winning plan and
+persists the card next to the strategy cache (the sidecar), so a cached
+plan carries the cost signature that explains *why* it won. Consumers:
+``tools/program_cards.py`` (roofline table, diff, ``--strict``
+regression gate) and the ``program_card`` runlog events + labeled
+``engine.costcard.*`` gauges.
+
+HBM accounting rides here too: ``device.hbm.*`` gauges polled lazily
+(rate-limited, no thread — the ``SloEngine.maybe_evaluate`` pattern)
+from ``/healthz`` and ``/metrics`` reads, plus the warmup headroom
+check comparing the warmed programs' summed temp bytes against the
+device limit.
+
+Everything is fenced: a backend without cost/memory analysis (or with
+``memory_stats() is None`` — CPU) degrades to partial cards and absent
+gauges, never to a serving failure. ``NCNET_COSTCARDS=0`` disables
+capture entirely.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .events import event
+from .metrics import gauge
+
+#: Sidecar basename, written next to the autotune strategy cache
+#: (``trained_models/consensus_autotune.json`` by default).
+SIDECAR_BASENAME = "program_cards.json"
+
+SIDECAR_VERSION = 1
+
+#: ``model_ok`` tolerance: the analytic consensus lower bound may
+#: exceed the XLA total by at most this factor before the card calls
+#: itself out (covers FLOP-counting slack between XLA's HLO accounting
+#: and the textbook 2*MAC convolution formula).
+MODEL_TOL = 1.05
+
+
+def enabled() -> bool:
+    """Cost-card capture gate: on by default, ``NCNET_COSTCARDS=0`` off."""
+    return os.environ.get("NCNET_COSTCARDS", "1") != "0"
+
+
+# --- AOT capture ------------------------------------------------------
+
+
+def _cost_dict(compiled) -> Dict[str, float]:
+    """Normalize ``compiled.cost_analysis()`` (dict, or list of dicts on
+    older jax) into one flat {str: float} map."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return {k: float(v) for k, v in dict(ca).items()
+            if isinstance(v, (int, float))}
+
+
+def _memory_dict(compiled) -> Dict[str, Optional[int]]:
+    ma = compiled.memory_analysis()
+    out = {}
+    for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "generated_code_size_in_bytes"):
+        v = getattr(ma, field, None)
+        short = field.replace("_size_in_bytes", "_bytes")
+        out[short] = int(v) if v is not None else None
+    return out
+
+
+def aot_capture(jitted, *args) -> Optional[dict]:
+    """Lower+compile ``jitted(*args)`` ahead of time and read its cost
+    and memory analyses. Returns ``{"xla": {...}, "memory": {...}}``
+    with whichever halves the backend supports, or None when even the
+    compile fails (the card is then skipped, never fatal — the program
+    itself already compiled through the normal jit path)."""
+    try:
+        compiled = jitted.lower(*args).compile()
+    except Exception:  # noqa: BLE001 — capture must never break warmup
+        return None
+    out: dict = {"xla": None, "memory": None}
+    try:
+        ca = _cost_dict(compiled)
+        out["xla"] = {
+            "flops": ca.get("flops"),
+            "bytes_accessed": ca.get("bytes accessed"),
+            "transcendentals": ca.get("transcendentals"),
+        }
+    except Exception:  # noqa: BLE001 — backend without cost_analysis
+        pass
+    try:
+        out["memory"] = _memory_dict(compiled)
+    except Exception:  # noqa: BLE001 — backend without memory_analysis
+        pass
+    if out["xla"] is None and out["memory"] is None:
+        return None
+    return out
+
+
+# --- the analytic consensus model -------------------------------------
+
+
+def consensus_layers(params) -> List[Tuple[Tuple[int, ...], int, int]]:
+    """``[(kernel_dims, cin, cout)]`` from a neigh-consensus params list
+    (``{'weight': [k,k,k,k,cin,cout], ...}`` per layer)."""
+    out = []
+    for layer in params:
+        shape = tuple(int(d) for d in layer["weight"].shape)
+        out.append((shape[:4], shape[4], shape[5]))
+    return out
+
+
+def layers_from_config(config) -> List[Tuple[Tuple[int, ...], int, int]]:
+    """The same layer spec derived from an NCNetConfig (no params in
+    hand — the serving warmup path)."""
+    out, cin = [], 1
+    for k, cout in zip(config.ncons_kernel_sizes, config.ncons_channels):
+        out.append(((int(k),) * 4, cin, int(cout)))
+        cin = int(cout)
+    return out
+
+
+def consensus_model(layers, cells: int, *, symmetric: bool,
+                    dtype_bytes: int, batch: int = 1,
+                    applications: int = 1) -> dict:
+    """Textbook cost of the consensus stack over ``cells`` 4-D positions.
+
+    Per layer: ``2 * cells * prod(kernel) * cin * cout`` FLOPs (2 per
+    MAC) and ``cells * (cin + cout) * dtype_bytes`` activation traffic
+    (weights are negligible at these channel counts). ``symmetric``
+    doubles everything (the A<->B-transposed second branch);
+    ``batch``/``applications`` scale for scanned pair stacks and
+    repeated window applies. Deliberately a lower bound: no bias/ReLU
+    FLOPs, no layout copies — see module docstring for why that is the
+    honest direction."""
+    flops = 0.0
+    byts = 0.0
+    for kernel, cin, cout in layers:
+        k4 = 1
+        for k in kernel:
+            k4 *= int(k)
+        flops += 2.0 * cells * k4 * cin * cout
+        byts += float(cells) * (cin + cout) * dtype_bytes
+    mult = (2 if symmetric else 1) * max(int(batch), 1) \
+        * max(int(applications), 1)
+    return {
+        "consensus_flops": flops * mult,
+        "consensus_bytes": byts * mult,
+        "cells": int(cells),
+        "layers": len(layers),
+        "symmetric": bool(symmetric),
+        "applications": int(applications) * max(int(batch), 1),
+    }
+
+
+def model_check(model: Optional[dict], xla: Optional[dict]) -> Optional[bool]:
+    """``model_ok``: analytic consensus lower bound <= measured XLA
+    total (within MODEL_TOL). None when either side is missing."""
+    if not model or not xla:
+        return None
+    measured = xla.get("flops")
+    if measured is None or measured <= 0:
+        return None
+    return model["consensus_flops"] <= measured * MODEL_TOL
+
+
+# --- card assembly + emission -----------------------------------------
+
+
+def card_key(program: str, q_shape, p_shape, batch: int, mode: str) -> str:
+    qs = "x".join(str(int(d)) for d in q_shape)
+    ps = "x".join(str(int(d)) for d in p_shape)
+    return f"{program}|q{qs}|p{ps}|b{int(batch)}|{mode}"
+
+
+def make_card(*, program: str, q_shape, p_shape, batch: int, mode: str,
+              captured: dict, model: Optional[dict],
+              backend: Optional[str] = None) -> dict:
+    xla = captured.get("xla")
+    card = {
+        "key": card_key(program, q_shape, p_shape, batch, mode),
+        "program": program,
+        "q_shape": [int(d) for d in q_shape],
+        "p_shape": [int(d) for d in p_shape],
+        "batch": int(batch),
+        "mode": mode,
+        "backend": backend,
+        "xla": xla,
+        "memory": captured.get("memory"),
+        "model": model,
+        "model_ok": model_check(model, xla),
+    }
+    flops = (xla or {}).get("flops")
+    byts = (xla or {}).get("bytes_accessed")
+    if flops and byts:
+        # Arithmetic intensity — the roofline x-axis
+        # (tools/program_cards.py places it against the chip ridge).
+        card["flops_per_byte"] = flops / byts
+    return card
+
+
+def emit_card(card: dict, labels=None) -> None:
+    """One ``program_card`` runlog event + the labeled
+    ``engine.costcard.*`` gauges for the card's hot numbers."""
+    event("program_card", **card)
+    lbls = dict(labels or {})
+    lbls.update({
+        "program": card["program"],
+        "bucket": "x".join(str(d) for d in card["q_shape"]) + "-"
+        + "x".join(str(d) for d in card["p_shape"]),
+        "batch": str(card["batch"]),
+        "mode": card["mode"],
+    })
+    xla = card.get("xla") or {}
+    mem = card.get("memory") or {}
+    if xla.get("flops") is not None:
+        gauge("engine.costcard.flops", labels=lbls).set(xla["flops"])
+    if xla.get("bytes_accessed") is not None:
+        gauge("engine.costcard.bytes_accessed",
+              labels=lbls).set(xla["bytes_accessed"])
+    if mem.get("temp_bytes") is not None:
+        gauge("engine.costcard.temp_bytes",
+              labels=lbls).set(mem["temp_bytes"])
+    if card.get("model_ok") is not None:
+        gauge("engine.costcard.model_ok",
+              labels=lbls).set(1.0 if card["model_ok"] else 0.0)
+
+
+# --- sidecar persistence ----------------------------------------------
+
+
+def sidecar_path(cache_file: Optional[str]) -> Optional[str]:
+    """Resolve the sidecar path next to a strategy-cache file.
+
+    ``NCNET_COSTCARDS_PATH`` overrides (empty string disables);
+    otherwise the sidecar is ``SIDECAR_BASENAME`` in the cache file's
+    directory, and a disabled cache (None) disables the sidecar too —
+    the sidecar only ever piggybacks on an explicitly consented write.
+    """
+    env = os.environ.get("NCNET_COSTCARDS_PATH")
+    if env is not None:
+        return env or None
+    if not cache_file:
+        return None
+    return os.path.join(os.path.dirname(cache_file) or ".",
+                        SIDECAR_BASENAME)
+
+
+def load_cards(path: str) -> Dict[str, dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    return dict(data.get("cards") or {})
+
+
+def save_cards(cards: Sequence[dict], path: str) -> str:
+    """Merge ``cards`` into the sidecar keyed by card key (read-modify-
+    write, rename-aside — the save_plan durability posture)."""
+    data = {"version": SIDECAR_VERSION, "cards": load_cards(path)}
+    for card in cards:
+        data["cards"][card["key"]] = card
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+# --- HBM accounting ---------------------------------------------------
+
+
+def device_memory_stats(device) -> Optional[dict]:
+    """Fenced ``device.memory_stats()`` — None on backends that don't
+    report (CPU), on no device, and on any backend error."""
+    if device is None:
+        return None
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — accounting never breaks serving
+        return None
+    return dict(stats) if stats else None
+
+
+class HbmMonitor:
+    """Lazy per-device HBM gauge poller.
+
+    No thread: callers (the serving ``/healthz`` and ``/metrics``
+    handlers) invoke :meth:`maybe_poll` on every read and the monitor
+    rate-limits the actual ``memory_stats()`` calls behind
+    ``min_interval_s`` — the exact ``SloEngine.maybe_evaluate``
+    pattern, so a scrape storm cannot turn accounting into load.
+    """
+
+    def __init__(self, min_interval_s: float = 1.0):
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._last = 0.0
+
+    def maybe_poll(self, entries) -> bool:
+        """``entries``: iterable of (device, labels). Returns True when
+        a poll actually ran (rate-limit window open)."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last < self.min_interval_s:
+                return False
+            self._last = now
+        for device, labels in entries:
+            stats = device_memory_stats(device)
+            if not stats:
+                continue
+            if stats.get("bytes_in_use") is not None:
+                gauge("device.hbm.bytes_in_use",
+                      labels=labels).set(stats["bytes_in_use"])
+            if stats.get("peak_bytes_in_use") is not None:
+                gauge("device.hbm.peak_bytes",
+                      labels=labels).set(stats["peak_bytes_in_use"])
+            if stats.get("bytes_limit") is not None:
+                gauge("device.hbm.limit_bytes",
+                      labels=labels).set(stats["bytes_limit"])
+        return True
+
+
+#: Process-wide monitor (one device set per process; per-object labels
+#: keep fleet replicas' series apart, like the metrics registry itself).
+_HBM = HbmMonitor()
+
+
+def poll_hbm(entries) -> bool:
+    return _HBM.maybe_poll(entries)
+
+
+def check_headroom(cards: Sequence[dict], device, labels=None,
+                   stats: Optional[dict] = None) -> Optional[dict]:
+    """Warmup headroom check: do the declared buckets' programs fit?
+
+    Sums the warmed cards' temp bytes (the transient working set each
+    program needs on top of its arguments) and compares against the
+    device's ``bytes_limit``. Emits an ``hbm_headroom`` obs event
+    either way; the caller surfaces ``ok=False`` as a degraded-healthz
+    warning. ``NCNET_HBM_HEADROOM_STRICT=1`` upgrades a violation to a
+    RuntimeError (refuse to serve a config that cannot fit). Returns
+    the verdict dict, or None when the device doesn't report limits
+    (CPU) or no card carried temp bytes."""
+    if stats is None:
+        stats = device_memory_stats(device)
+    limit = (stats or {}).get("bytes_limit")
+    if limit is None:
+        return None
+    temps = [c.get("memory", {}).get("temp_bytes") for c in cards
+             if c.get("memory")]
+    temps = [t for t in temps if t is not None]
+    if not temps:
+        return None
+    verdict = {
+        "ok": sum(temps) <= limit,
+        "temp_bytes": int(sum(temps)),
+        "limit_bytes": int(limit),
+        "bytes_in_use": stats.get("bytes_in_use"),
+        "programs": len(temps),
+    }
+    event("hbm_headroom", **verdict)
+    if not verdict["ok"] and \
+            os.environ.get("NCNET_HBM_HEADROOM_STRICT") == "1":
+        raise RuntimeError(
+            f"warmup headroom: declared buckets need "
+            f"{verdict['temp_bytes']} temp bytes > device limit {limit}"
+        )
+    return verdict

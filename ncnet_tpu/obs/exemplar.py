@@ -1,0 +1,102 @@
+"""Tail-latency exemplars: slowest-request reservoirs + slow dumps.
+
+The histogram side lives in obs/metrics.py (``Histogram.observe``
+accepts a ``trace_id`` and ``render_text`` appends the OpenMetrics
+exemplar suffix to ``_bucket`` lines); this module owns the request
+side: a bounded per-endpoint reservoir of the slowest requests seen,
+and the rate-limited flight dump for requests breaching the SLO p99
+target — so a tail spike always leaves a full span tree behind, not
+just a histogram bump.
+
+The dump reason is ``slow-exemplar-<endpoint>`` and rides the flight
+recorder's per-reason cooldown (obs/flight.py): a burst of slow
+requests produces exactly one dump per cooldown window, never a dump
+storm on top of an already-slow replica.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import flight
+from .events import event
+from .metrics import counter
+
+#: Slowest requests kept per endpoint (a tail forensics working set,
+#: not a log — the run log has every request event).
+RESERVOIR_SIZE = 16
+
+
+class SlowReservoir:
+    """Bounded per-endpoint reservoir of the slowest observations.
+
+    A min-heap of (dur_s, seq, record) per endpoint: offering a new
+    observation evicts the fastest member once the reservoir is full,
+    so membership is exactly "the N slowest seen". Thread-safe — the
+    serving handler threads offer concurrently.
+    """
+
+    def __init__(self, size: int = RESERVOIR_SIZE):
+        self.size = int(size)
+        self._lock = threading.Lock()
+        self._heaps: Dict[str, list] = {}
+        self._seq = 0
+
+    def offer(self, endpoint: str, dur_s: float,
+              trace_id: Optional[str], **meta) -> None:
+        rec = {"endpoint": endpoint, "dur_s": float(dur_s),
+               "trace_id": trace_id, "t_wall": time.time(), **meta}
+        with self._lock:
+            heap = self._heaps.setdefault(endpoint, [])
+            self._seq += 1
+            item = (float(dur_s), self._seq, rec)
+            if len(heap) < self.size:
+                heapq.heappush(heap, item)
+            elif item > heap[0]:
+                heapq.heapreplace(heap, item)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._heaps.clear()
+
+    def snapshot(self, endpoint: Optional[str] = None) -> List[dict]:
+        """Slowest-first records for one endpoint (or all)."""
+        with self._lock:
+            if endpoint is not None:
+                items = list(self._heaps.get(endpoint, ()))
+            else:
+                items = [i for h in self._heaps.values() for i in h]
+        return [rec for _, _, rec in sorted(items, reverse=True)]
+
+
+#: Process-wide reservoir (per-object labels are already inside the
+#: offered records via the endpoint name; tests build private ones).
+_RESERVOIR = SlowReservoir()
+
+
+def reservoir() -> SlowReservoir:
+    return _RESERVOIR
+
+
+def observe_request(endpoint: str, dur_s: float, trace_id: Optional[str],
+                    threshold_s: Optional[float] = None,
+                    labels=None) -> Optional[str]:
+    """Book one finished request into the tail machinery.
+
+    Always feeds the reservoir; when ``threshold_s`` is set and
+    breached, emits a ``slow_request`` event (carrying the trace_id —
+    it lands in the flight ring alongside the request's spans), bumps
+    ``serving.slow_requests`` and triggers the rate-limited
+    ``slow-exemplar-<endpoint>`` dump. Returns the dump path when a
+    dump was actually written (None when suppressed by cooldown or not
+    slow)."""
+    _RESERVOIR.offer(endpoint, dur_s, trace_id)
+    if threshold_s is None or dur_s <= threshold_s:
+        return None
+    counter("serving.slow_requests", labels=labels).inc()
+    event("slow_request", endpoint=endpoint, trace_id=trace_id,
+          e2e_s=round(float(dur_s), 6), threshold_s=float(threshold_s))
+    return flight.dump(f"slow-exemplar-{endpoint}")

@@ -38,6 +38,7 @@ import bisect
 import os
 import re
 import threading
+import time
 from typing import Dict, Iterable, Mapping, Optional, Tuple, Union
 
 #: Fixed log-spaced histogram buckets: 4 per decade over 1e-4 .. 1e4
@@ -209,13 +210,17 @@ class Histogram:
         self._lock = lock
         self.buckets = tuple(sorted(float(b) for b in buckets))
         self._bucket_counts = [0] * (len(self.buckets) + 1)  # last: +Inf
+        # Per-bucket exemplar: idx -> (trace_id, value, t_wall). Bounded
+        # by construction (one slot per bucket, last observation wins)
+        # and only populated when a caller attaches a trace_id.
+        self._exemplars: Dict[int, tuple] = {}
         self.count = 0
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.last: Optional[float] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         v = float(v)
         # Prometheus `le`: the first bucket whose upper bound is >= v.
         idx = bisect.bisect_left(self.buckets, v)
@@ -226,6 +231,14 @@ class Histogram:
             self.max = v if self.max is None else max(self.max, v)
             self.last = v
             self._bucket_counts[idx] += 1
+            if trace_id is not None:
+                self._exemplars[idx] = (str(trace_id), v, time.time())
+
+    def exemplars(self) -> Dict[int, tuple]:
+        """Bucket-index -> (trace_id, value, t_wall) exemplar map (the
+        index aligns with ``buckets``; len(buckets) is +Inf)."""
+        with self._lock:
+            return dict(self._exemplars)
 
     def _quantile_locked(self, q: float) -> Optional[float]:
         """Bucket-interpolated quantile; caller holds the lock."""
@@ -369,6 +382,11 @@ class MetricsRegistry:
             elided, the cumulative contract is preserved by always
             emitting ``+Inf``), ``_sum``/``_count``, plus
             ``<name>_min``/``<name>_max``/``<name>_last`` gauges.
+            Buckets that carry an exemplar (an ``observe`` with a
+            ``trace_id`` — serving's latency histograms) get the
+            OpenMetrics exemplar suffix
+            `` # {trace_id="..."} <value> <timestamp>`` appended, so a
+            scrape links a tail bucket straight to a request trace.
         """
         lines = []
         for name, cls, children in self._sorted_families():
@@ -394,24 +412,28 @@ class MetricsRegistry:
                 for ch in children:
                     s = ch.snapshot()
                     bounds, cum = ch.bucket_counts()
+                    exemplars = ch.exemplars()
                     # Elide the empty head (cum 0) and the saturated
                     # tail (every bound past the max repeats count) —
                     # the ladder spans 8 decades and most metrics live
                     # in 2; scrape size should track the data, not the
                     # ladder.
                     prev = 0
-                    for b, c in zip(bounds, cum):
+                    for i, (b, c) in enumerate(zip(bounds, cum)):
                         if c == 0 or (c == prev and c == s["count"]):
                             prev = c
                             continue
                         prev = c
                         lbls = ch.labels + (("le", f"{b:g}"),)
                         lines.append(
-                            f"{pname}_bucket{_render_labels(lbls)} {c:g}")
+                            f"{pname}_bucket{_render_labels(lbls)} {c:g}"
+                            + _render_exemplar(exemplars.get(i))
+                        )
                     lbls = ch.labels + (("le", "+Inf"),)
                     lines.append(
                         f"{pname}_bucket{_render_labels(lbls)}"
                         f" {float(s['count']):g}"
+                        + _render_exemplar(exemplars.get(len(bounds)))
                     )
                     lines.append(
                         f"{pname}_sum{_render_labels(ch.labels)}"
@@ -448,6 +470,20 @@ def _prom_name(name: str) -> str:
     if name and name[0].isdigit():
         name = "_" + name
     return name
+
+
+def _render_exemplar(ex) -> str:
+    """OpenMetrics exemplar suffix for one ``_bucket`` line.
+
+    ``ex``: (trace_id, value, t_wall) from ``Histogram.exemplars``, or
+    None (empty suffix). The trace_id is sanitized to the exemplar
+    label charset (aggregate's parser strips the whole suffix either
+    way — see ``_parse_sample``)."""
+    if not ex:
+        return ""
+    trace_id, value, t_wall = ex
+    tid = re.sub(r'[\\"\n]', "", str(trace_id))
+    return f' # {{trace_id="{tid}"}} {float(value):g} {t_wall:.3f}'
 
 
 _DEFAULT = MetricsRegistry()

@@ -363,6 +363,52 @@ def device_timer(params, corr, symmetric, plan, *, reps=4, iters=3):
     return first_s, steady_s / max(reps, 1) * 1000.0
 
 
+def winner_card(params, corr, symmetric, plan, ms):
+    """Cost card for a tuned winner: AOT-compile the plan's consensus
+    apply under the plan's env and read the XLA cost/memory analyses,
+    cross-checked against the analytic conv4d model
+    (obs/costcards.py). Returns the card dict, or None when the backend
+    can't report — tuning never fails on accounting."""
+    import numpy as np
+
+    from ..obs import costcards
+    from .conv4d import neigh_consensus_apply
+
+    try:
+        import jax
+
+        with plan_overrides(plan):
+            captured = costcards.aot_capture(
+                jax.jit(lambda c: neigh_consensus_apply(
+                    params, c, symmetric=symmetric)),
+                corr,
+            )
+        if captured is None:
+            return None
+        cells = 1
+        for d in corr.shape[2:]:
+            cells *= int(d)
+        model = costcards.consensus_model(
+            costcards.consensus_layers(params), cells,
+            symmetric=symmetric,
+            dtype_bytes=int(np.dtype(corr.dtype).itemsize),
+            batch=int(corr.shape[0]),
+        )
+        card = costcards.make_card(
+            program="consensus_plan",
+            q_shape=corr.shape[2:4], p_shape=corr.shape[4:6],
+            batch=int(corr.shape[0]), mode="plan",
+            captured=captured, model=model, backend=backend_kind(),
+        )
+        card["plan_label"] = plan_label(plan)
+        card["sig"] = shape_signature(corr.shape, corr.dtype, params,
+                                      symmetric)
+        card["ms"] = float(ms)
+        return card
+    except Exception:  # noqa: BLE001 — accounting fence
+        return None
+
+
 def autotune(params, corr, *, symmetric: bool = True, plans=None,
              reps: int = 4, iters: int = 3, timer=None, save: bool = True,
              log=None):
@@ -408,7 +454,22 @@ def autotune(params, corr, *, symmetric: bool = True, plans=None,
         saved_path = save_plan(corr.shape, corr.dtype, params, plan, ms,
                                symmetric=symmetric,
                                candidates=len(plans))
+    # Cost signature of the winner (obs/costcards.py): the `winner`
+    # event says WHY this plan won in FLOP/byte terms, and the sidecar
+    # next to the strategy cache persists it with the cached plan.
+    card = None
+    from ..obs import costcards
+
+    if costcards.enabled():
+        card = winner_card(params, corr, symmetric, plan, ms)
+        if card is not None and saved_path:
+            side = costcards.sidecar_path(saved_path)
+            if side:
+                try:
+                    costcards.save_cards([card], side)
+                except OSError:
+                    side = None
     obs.event("autotune", action="winner", plan=plan,
               label=plan_label(plan), ms=ms, candidates=len(plans),
-              cache_path=saved_path)
+              cache_path=saved_path, card=card)
     return plan, ms, results

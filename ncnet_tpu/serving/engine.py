@@ -327,6 +327,12 @@ class MatchEngine:
         # put() fetches D2H; serialize stores so a burst of misses can't
         # stack redundant fetches of one shortlist-popular pano.
         self._store_lock = threading.Lock()
+        # Cost observatory state (obs/costcards.py): warmup replaces
+        # cost_cards wholesale with one card per warmed program, and
+        # hbm_headroom holds the latest declared-buckets-vs-device-limit
+        # verdict (None on backends with no memory accounting).
+        self.cost_cards: List[dict] = []
+        self.hbm_headroom: Optional[dict] = None
 
     def _put(self, x):
         """Place one input stack on this engine's device (no-op when the
@@ -446,6 +452,92 @@ class MatchEngine:
         )
 
     # -- batched device dispatch ------------------------------------------
+
+    # -- cost observatory --------------------------------------------------
+
+    def accounting_device(self):
+        """The device whose memory this engine accounts against: the
+        pinned replica device, else the process default."""
+        if self.device is not None:
+            return self.device
+        try:
+            return self._jax.devices()[0]
+        except Exception:  # noqa: BLE001 — no backend, no accounting
+            return None
+
+    def _consensus_cells(self, q_shape, p_shape,
+                         program: str) -> Tuple[int, int]:
+        """(4-D cells the consensus stack convolves over, applications)
+        for one warmed program — the analytic model's geometry.
+
+        Mirrors the device pipeline's shape math: features at 1/16 of
+        the bucket dims, maxpool4d by relocalization k before consensus;
+        the c2f coarse stage additionally pools features by
+        c2f_coarse_factor, and the refine stage re-runs consensus per
+        gated window (one direction counted — a deliberate lower bound,
+        matching the model_ok contract)."""
+        fa = (q_shape[0] // _FEAT_STRIDE_PX, q_shape[1] // _FEAT_STRIDE_PX)
+        fb = (p_shape[0] // _FEAT_STRIDE_PX, p_shape[1] // _FEAT_STRIDE_PX)
+        k = max(self.config.relocalization_k_size, 1)
+        if program == "c2f_refine":
+            # Window consensus geometry (ops/c2f.py): K surviving coarse
+            # cells, each an s x s fine block against a B window whose
+            # static extent is (2r+1)*s clipped to the feature dims; K
+            # itself clips to the coarse grid. One direction counted.
+            s = c2f_stride(self.config)
+            ca = (fa[0] // s) * (fa[1] // s)
+            cb = (fb[0] // s) * (fb[1] // s)
+            win = (2 * self.config.c2f_radius + 1) * s
+            win_h = min(win, fa[0], fb[0])
+            win_w = min(win, fa[1], fb[1])
+            k_eff = max(min(int(self.config.c2f_topk), ca, cb), 1)
+            return s * s * win_h * win_w, k_eff
+        if program == "c2f_coarse":
+            f = self.config.c2f_coarse_factor
+            fa = (fa[0] // f, fa[1] // f)
+            fb = (fb[0] // f, fb[1] // f)
+        return ((fa[0] // k) * (fa[1] // k)
+                * (fb[0] // k) * (fb[1] // k)), 1
+
+    def _cost_card(self, program: str, jitted, args, q_shape, p_shape,
+                   batch: int, mode: str) -> List[dict]:
+        """AOT-capture one warmed program's cost card and emit it
+        (event + engine.costcard.* gauges). Returns [card] or [] when
+        the backend can't report — warmup never fails on accounting."""
+        from ..obs import costcards
+        from ..ops.autotune import backend_kind
+
+        captured = costcards.aot_capture(jitted, *args)
+        if captured is None:
+            return []
+        model = None
+        try:
+            cells, applications = self._consensus_cells(
+                q_shape, p_shape, program)
+            if cells > 0:
+                model = costcards.consensus_model(
+                    costcards.consensus_layers(
+                        self.params["neigh_consensus"]),
+                    cells,
+                    symmetric=self.config.symmetric_mode,
+                    dtype_bytes=int(
+                        np.dtype(self.config.corr_dtype).itemsize),
+                    batch=batch,
+                    applications=applications,
+                )
+        except Exception:  # noqa: BLE001 — model is best-effort
+            model = None
+        try:
+            backend = backend_kind()
+        except Exception:  # noqa: BLE001
+            backend = None
+        card = costcards.make_card(
+            program=program, q_shape=q_shape, p_shape=p_shape,
+            batch=batch, mode=mode, captured=captured, model=model,
+            backend=backend,
+        )
+        costcards.emit_card(card, labels=self.labels)
+        return [card]
 
     def _c2f_bucket_degenerate(self, bucket_key) -> bool:
         """Host-side mirror of models.ncnet.c2f_is_degenerate for one
@@ -610,10 +702,21 @@ class MatchEngine:
         Returns the number of (bucket, batch, mode) programs compiled.
         Compiles land in the persistent compile cache, so a restarted
         replica warms from disk.
+
+        Unless ``NCNET_COSTCARDS=0``, every warmed program is also
+        AOT-captured into a cost card (obs/costcards.py): a
+        ``program_card`` event + ``engine.costcard.*`` gauges carrying
+        the XLA FLOP/byte totals, the memory_analysis footprint and the
+        analytic consensus cross-check — followed by the HBM headroom
+        check over the declared buckets' summed temp bytes.
         """
         from ncnet_tpu.ops import consensus_last_plan
 
+        from ..obs import costcards
+
         n = 0
+        cards: List[dict] = []
+        with_cards = costcards.enabled()
         for qh, qw, ph, pw in raw_shapes:
             for engine_mode in modes:
                 if engine_mode not in ENGINE_MODES:
@@ -631,6 +734,7 @@ class MatchEngine:
                         self._jnp.zeros((b, 3) + q_shape, self._jnp.float32))
                     t = self._put(
                         self._jnp.zeros((b, 3) + p_shape, self._jnp.float32))
+                    coarse = None
                     with obs.span("serving.warmup", q_shape=list(q_shape),
                                   p_shape=list(p_shape), batch=b,
                                   mode=engine_mode):
@@ -644,6 +748,25 @@ class MatchEngine:
                             self._jax.block_until_ready(
                                 self._batch_pairs(self.params, q, t)
                             )
+                    if with_cards:
+                        # AOT lower+compile hits the jit/persistent
+                        # compile cache the calls above just populated,
+                        # so the card costs an analysis read, not a
+                        # second compile.
+                        if c2f_live:
+                            cards += self._cost_card(
+                                "c2f_coarse", self._c2f_coarse,
+                                (self.params, q, t),
+                                q_shape, p_shape, b, engine_mode)
+                            cards += self._cost_card(
+                                "c2f_refine", self._c2f_refine,
+                                (self.params,) + tuple(coarse),
+                                q_shape, p_shape, b, engine_mode)
+                        else:
+                            cards += self._cost_card(
+                                "batch_pairs", self._batch_pairs,
+                                (self.params, q, t),
+                                q_shape, p_shape, b, engine_mode)
                     # The trace above consulted the strategy cache
                     # (ops/autotune.py) for this bucket's consensus
                     # shape; surface what it resolved — tuned plan or
@@ -659,4 +782,10 @@ class MatchEngine:
                                   ms=plan.get("cache_ms"), plan=plan)
                     n += 1
         obs.counter("serving.warmup_programs", labels=self.labels).inc(n)
+        if with_cards:
+            self.cost_cards = cards
+            # Do the declared buckets fit the device? (No-op on
+            # backends without memory accounting — CPU returns None.)
+            self.hbm_headroom = costcards.check_headroom(
+                cards, self.accounting_device(), labels=self.labels)
         return n

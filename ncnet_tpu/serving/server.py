@@ -38,7 +38,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import obs
-from ..obs import trace
+from ..obs import costcards, exemplar, trace
 from ..reliability import failpoints
 from ..reliability.breaker import BreakerOpenError, CircuitBreaker
 from ..reliability.failpoints import InjectedFault
@@ -145,6 +145,11 @@ class MatchServer:
         self.slo = obs.SloEngine(
             slo_specs, labels=self.labels, min_interval_s=1.0,
         ) if slo_specs else None
+        # Tail-exemplar threshold: a request slower than the p99 target
+        # leaves a rate-limited slow-exemplar flight dump behind
+        # (obs/exemplar.py). 0/None disables.
+        self.slo_p99_target_s = (float(slo_p99_target_s)
+                                 if slo_p99_target_s else None)
         if self.replica_id:
             obs.set_build_info(replica=self.replica_id)
         self.t_start = time.monotonic()
@@ -175,11 +180,14 @@ class MatchServer:
 
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
+                    server.poll_hbm()
                     self._send_json(*server.healthz())
                 elif self.path == "/metrics":
                     # Refresh the slo.* gauges so a scrape always sees
-                    # current burn/budget (rate-limited inside).
+                    # current burn/budget (rate-limited inside), and
+                    # the device.hbm.* gauges likewise.
                     server.slo_status()
+                    server.poll_hbm()
                     text = obs.render_text().encode()
                     self.send_response(200)
                     self.send_header(
@@ -221,6 +229,38 @@ class MatchServer:
             return {}
         return self.slo.maybe_evaluate()
 
+    def poll_hbm(self):
+        """Refresh the ``device.hbm.*`` gauges for this server's
+        device(s) — lazily from the /healthz and /metrics readers, no
+        thread, rate-limited inside (obs/costcards.py HbmMonitor)."""
+        if self.fleet is not None:
+            entries = [(r.engine.accounting_device(), r.labels)
+                       for r in self.fleet.replicas
+                       if r.engine is not None]
+        elif self.engine is not None:
+            entries = [(self.engine.accounting_device(), self.labels)]
+        else:
+            entries = []
+        return costcards.poll_hbm(entries)
+
+    def _headroom_warnings(self):
+        """Per-engine hbm_headroom verdicts that failed, as healthz
+        payload fields ({} when everything fits or nothing reported)."""
+        if self.fleet is not None:
+            bad = {
+                r.replica_id: r.engine.hbm_headroom
+                for r in self.fleet.replicas
+                if r.engine is not None and r.engine.hbm_headroom
+                and not r.engine.hbm_headroom.get("ok")
+            }
+            if bad:
+                return {"warnings": ["hbm_headroom"], "hbm_headroom": bad}
+            return {}
+        hh = getattr(self.engine, "hbm_headroom", None)
+        if hh and not hh.get("ok"):
+            return {"warnings": ["hbm_headroom"], "hbm_headroom": hh}
+        return {}
+
     def healthz(self):
         """Liveness + degradation: stall flag, breaker state, drain.
 
@@ -258,6 +298,7 @@ class MatchServer:
             }
             if self.replica_id:
                 payload["replica"] = self.replica_id
+            payload.update(self._headroom_warnings())
             slo = self.slo_status()
             if slo:
                 payload["slo"] = {
@@ -293,6 +334,10 @@ class MatchServer:
         }
         if self.replica_id:
             payload["replica"] = self.replica_id
+        # Degraded-healthz warning, not a 503: a config whose declared
+        # buckets oversubscribe HBM still serves what fits, but the
+        # operator should know before the OOM does the telling.
+        payload.update(self._headroom_warnings())
         slo = self.slo_status()
         if slo:
             # The balancer-facing error-budget readout: per SLO, how
@@ -476,8 +521,12 @@ class MatchServer:
         for key, val in engine_timing.items():
             payload["timing"].setdefault(key, round(val, 3))
         obs.counter("serving.responses", labels=self.labels).inc()
+        # Exemplar attach: the latency histogram bucket this request
+        # lands in remembers its trace_id, so a /metrics scrape links a
+        # tail bucket straight to a trace (OpenMetrics exposition).
         obs.histogram("serving.e2e_latency_s",
-                      labels=self.labels).observe(e2e_s)
+                      labels=self.labels).observe(
+                          e2e_s, trace_id=root.trace_id)
         obs.event(
             "request",
             bucket=repr(prepared.bucket_key),
@@ -487,6 +536,11 @@ class MatchServer:
             e2e_s=round(e2e_s, 6),
             trace_id=root.trace_id,
         )
+        # Tail bookkeeping AFTER the request event, so a slow-exemplar
+        # flight dump's ring already holds this request's spans + event.
+        exemplar.observe_request(
+            "v1_match", e2e_s, root.trace_id,
+            threshold_s=self.slo_p99_target_s, labels=self.labels)
         return 200, payload, None
 
     # -- lifecycle --------------------------------------------------------

@@ -75,10 +75,18 @@ def _pin_flight_dir(tmp_path_factory):
 def _reset_obs_metrics():
     """The obs default registry is process-global (one CLI run per
     process in production); zero it per test so metric assertions see
-    only their own run's increments."""
+    only their own run's increments. The slow-request reservoir is
+    process-global for the same reason — clear it too, or serving
+    tests earlier in the suite (whose first-compile requests are the
+    slowest thing the process ever sees) evict later tests' entries.
+    Same story for the flight ring and its per-reason dump cooldown: a
+    dump asserted by one test must contain only that test's records and
+    must not be rate-limited by a breach three tests ago."""
     from ncnet_tpu import obs
 
     obs.reset()
+    obs.exemplar.reservoir().clear()
+    obs.flight.recorder().clear()
     yield
 
 

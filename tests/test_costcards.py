@@ -1,0 +1,603 @@
+"""Device cost observatory (ISSUE 11): program cost cards, HBM
+accounting, tail-latency exemplars, and the tools riding on them.
+
+Layers:
+
+* pure-math unit tests — the analytic consensus model, card assembly,
+  the headroom verdict, the reservoir (fake stats, no jax device API);
+* CPU end-to-end — a real MatchEngine warmup emits model_ok=true cards
+  for every warmed program, and a live MatchServer turns a
+  failpoint-slowed request into exactly ONE rate-limited slow-exemplar
+  flight dump with the trace_id in the ring and in /metrics;
+* tool contracts — tools/program_cards.py --strict fails on a seeded
+  cost regression vs a baseline set; tools/ci_gate.py aggregates;
+  tools/obs_report.py groups truncated-parent spans under <orphaned>.
+"""
+
+import glob
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.obs import aggregate, costcards, exemplar
+from ncnet_tpu.obs.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _read_log(path):
+    with open(path, encoding="utf-8") as fh:
+        return [json.loads(l) for l in fh if l.strip()]
+
+
+# -- analytic model (pure) ------------------------------------------------
+
+
+def test_layers_from_config_matches_params(tiny_serving_model):
+    config, params = tiny_serving_model
+    assert costcards.layers_from_config(config) == \
+        costcards.consensus_layers(params["neigh_consensus"])
+
+
+def test_consensus_model_scales_linearly():
+    layers = [((3, 3, 3, 3), 1, 16), ((3, 3, 3, 3), 16, 1)]
+    one = costcards.consensus_model(layers, 100, symmetric=False,
+                                    dtype_bytes=2)
+    # Per-layer FLOPs: 2 * cells * 81 * cin * cout.
+    assert one["consensus_flops"] == 2 * 100 * 81 * (16 + 16)
+    sym = costcards.consensus_model(layers, 100, symmetric=True,
+                                    dtype_bytes=2)
+    assert sym["consensus_flops"] == 2 * one["consensus_flops"]
+    big = costcards.consensus_model(layers, 100, symmetric=False,
+                                    dtype_bytes=2, batch=3,
+                                    applications=5)
+    assert big["consensus_flops"] == 15 * one["consensus_flops"]
+    # The reported applications fold batch in (total program applies).
+    assert big["applications"] == 15
+
+
+def test_model_check_is_one_directional():
+    model = {"consensus_flops": 100.0}
+    assert costcards.model_check(model, {"flops": 1000.0}) is True
+    # Within tolerance: analytic may exceed measured by up to 5%.
+    assert costcards.model_check(model, {"flops": 96.0}) is True
+    assert costcards.model_check(model, {"flops": 50.0}) is False
+    assert costcards.model_check(model, {"flops": None}) is None
+    assert costcards.model_check(None, {"flops": 10.0}) is None
+
+
+# -- HBM accounting (fake stats, no device API) ---------------------------
+
+
+class FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+        self.calls = 0
+
+    def memory_stats(self):
+        self.calls += 1
+        return self._stats
+
+
+def _card_with_temp(temp):
+    return {"key": f"k{temp}", "memory": {"temp_bytes": temp}}
+
+
+def test_check_headroom_verdict_and_event(tmp_path):
+    log = str(tmp_path / "rl.jsonl")
+    run = obs.init_run("t", log, heartbeat_s=0)
+    try:
+        stats = {"bytes_limit": 1000, "bytes_in_use": 100}
+        bad = costcards.check_headroom(
+            [_card_with_temp(600), _card_with_temp(600)], None,
+            stats=stats)
+        assert bad == {"ok": False, "temp_bytes": 1200,
+                       "limit_bytes": 1000, "bytes_in_use": 100,
+                       "programs": 2}
+        ok = costcards.check_headroom([_card_with_temp(600)], None,
+                                      stats=stats)
+        assert ok["ok"] is True
+        # No limit (CPU) or no temp data -> None, no verdict invented.
+        assert costcards.check_headroom([_card_with_temp(1)], None,
+                                        stats={}) is None
+        assert costcards.check_headroom([{"key": "x"}], None,
+                                        stats=stats) is None
+    finally:
+        run.close("ok")
+    events = [r for r in _read_log(log) if r.get("event") == "hbm_headroom"]
+    assert [e["ok"] for e in events] == [False, True]
+
+
+def test_check_headroom_strict_refuses(monkeypatch):
+    monkeypatch.setenv("NCNET_HBM_HEADROOM_STRICT", "1")
+    with pytest.raises(RuntimeError, match="headroom"):
+        costcards.check_headroom(
+            [_card_with_temp(2000)], None,
+            stats={"bytes_limit": 1000, "bytes_in_use": 0})
+
+
+def test_hbm_monitor_sets_gauges_and_rate_limits():
+    dev = FakeDevice({"bytes_in_use": 7, "peak_bytes_in_use": 9,
+                      "bytes_limit": 100})
+    mon = costcards.HbmMonitor(min_interval_s=3600.0)
+    assert mon.maybe_poll([(dev, {"replica": "r9"})]) is True
+    snap = obs.snapshot()["gauges"]
+    assert snap['device.hbm.bytes_in_use{replica="r9"}'] == 7.0
+    assert snap['device.hbm.peak_bytes{replica="r9"}'] == 9.0
+    assert snap['device.hbm.limit_bytes{replica="r9"}'] == 100.0
+    # Second read inside the window: rate-limited, no device call.
+    assert mon.maybe_poll([(dev, {"replica": "r9"})]) is False
+    assert dev.calls == 1
+    # A CPU-style device (memory_stats -> None) sets nothing and
+    # breaks nothing.
+    mon2 = costcards.HbmMonitor(min_interval_s=0.0)
+    assert mon2.maybe_poll([(FakeDevice(None), {})]) is True
+
+
+# -- warmup cost cards (CPU end-to-end) -----------------------------------
+
+
+def test_warmup_emits_cost_cards(tiny_serving_model, tmp_path):
+    """ISSUE 11 acceptance: every warmed (bucket, batch, mode) program
+    emits a program_card event with XLA flops/bytes, memory_analysis
+    temp bytes, and a PASSING analytic cross-check on CPU smoke shapes
+    (a c2f bucket warms BOTH stage programs -> 3 cards for 2 warms)."""
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    config, params = tiny_serving_model
+    log = str(tmp_path / "rl.jsonl")
+    run = obs.init_run("warmup", log, heartbeat_s=0)
+    try:
+        engine = MatchEngine(config, params, k_size=2, image_size=64,
+                             cache_mb=0)
+        n = engine.warmup([(96, 128, 96, 128)],
+                          modes=("oneshot", "c2f"))
+    finally:
+        run.close("ok")
+    assert n == 2
+    cards = engine.cost_cards
+    assert sorted(c["program"] for c in cards) == \
+        ["batch_pairs", "c2f_coarse", "c2f_refine"]
+    for c in cards:
+        assert c["xla"]["flops"] > 0, c
+        assert c["xla"]["bytes_accessed"] > 0, c
+        assert c["memory"]["temp_bytes"] > 0, c
+        assert c["model"]["consensus_flops"] > 0, c
+        assert c["model_ok"] is True, \
+            f"analytic model exceeded measured cost: {c}"
+        assert c["flops_per_byte"] > 0
+    # The events made it to the run log with the same keys...
+    logged = [r for r in _read_log(log)
+              if r.get("event") == "program_card"]
+    assert sorted(r["key"] for r in logged) == \
+        sorted(c["key"] for c in cards)
+    # ...and the labeled gauges expose the hot numbers.
+    gauges = obs.snapshot()["gauges"]
+    flops_keys = [k for k in gauges if k.startswith("engine.costcard.flops")]
+    assert len(flops_keys) == 3
+    ok_keys = [k for k in gauges
+               if k.startswith("engine.costcard.model_ok")]
+    assert all(gauges[k] == 1.0 for k in ok_keys)
+    # CPU reports no memory_stats: no headroom verdict is invented.
+    assert engine.hbm_headroom is None
+
+
+def test_warmup_costcards_disabled(tiny_serving_model, monkeypatch):
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    monkeypatch.setenv("NCNET_COSTCARDS", "0")
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    assert engine.warmup([(96, 128, 96, 128)]) == 1
+    assert engine.cost_cards == []
+
+
+def test_warmup_headroom_refusal_with_fake_stats(tiny_serving_model,
+                                                 monkeypatch):
+    """ISSUE 11 satellite: with memory_stats faked to a tiny limit and
+    strict mode on, warmup REFUSES (RuntimeError) instead of declaring
+    buckets that cannot fit; without strict it serves degraded with the
+    verdict on the engine."""
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    monkeypatch.setattr(
+        costcards, "device_memory_stats",
+        lambda d: {"bytes_limit": 1024, "bytes_in_use": 512})
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    engine.warmup([(96, 128, 96, 128)])
+    assert engine.hbm_headroom is not None
+    assert engine.hbm_headroom["ok"] is False
+    assert engine.hbm_headroom["limit_bytes"] == 1024
+
+    monkeypatch.setenv("NCNET_HBM_HEADROOM_STRICT", "1")
+    engine2 = MatchEngine(config, params, k_size=2, image_size=64,
+                          cache_mb=0)
+    with pytest.raises(RuntimeError, match="headroom"):
+        engine2.warmup([(96, 128, 96, 128)])
+
+
+# -- histogram exemplars --------------------------------------------------
+
+
+def test_histogram_exemplar_exposition_roundtrip():
+    reg = MetricsRegistry()
+    h = reg.histogram("serving.e2e_latency_s", labels={"replica": "r0"})
+    h.observe(0.001, trace_id="abc123")  # distinct buckets: the later
+    h.observe(5.0, trace_id="def456")    # one must not overwrite
+    text = reg.render_text()
+    assert '# {trace_id="def456"}' in text
+    # The exemplar suffix is OpenMetrics decoration: the Prometheus
+    # parser (fleet_status / aggregate round-trips) must still read the
+    # bucket counts exactly.
+    parsed = aggregate.parse_prometheus_text(text)
+    key = 'serving_e2e_latency_s{replica="r0"}'
+    assert parsed["histograms"][key]["count"] == 2
+    # Exemplars accessor: bucket index -> (trace_id, value, t_wall).
+    exs = h.exemplars()
+    assert any(e[0] == "abc123" for e in exs.values())
+
+
+def test_concurrent_exemplar_writers_no_torn_exposition():
+    """ISSUE 11 satellite (the test_fleet_obs concurrency pattern, now
+    with exemplars): N threads observe with trace_ids on their own
+    labeled child while a reader renders/snapshots under load — exact
+    counts, parseable exposition, every bucket's exemplar is a real
+    trace_id one of the writers attached."""
+    reg = MetricsRegistry()
+    n_threads, n_iter = 8, 2000
+    stop = threading.Event()
+
+    def work(i):
+        mine = {"replica": f"r{i}"}
+        for j in range(n_iter):
+            reg.histogram("serving.e2e_latency_s", labels=mine).observe(
+                0.01 * (i + 1), trace_id=f"t{i}-{j}")
+
+    def reader():
+        while not stop.is_set():
+            reg.snapshot()
+            reg.render_text()
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    snap = reg.snapshot()
+    for i in range(n_threads):
+        key = f'serving.e2e_latency_s{{replica="r{i}"}}'
+        assert snap["histograms"][key]["count"] == n_iter
+        exs = reg.histogram("serving.e2e_latency_s",
+                            labels={"replica": f"r{i}"}).exemplars()
+        assert exs, "at least one bucket carries an exemplar"
+        assert all(tid.startswith(f"t{i}-") for tid, _, _ in exs.values())
+    parsed = aggregate.parse_prometheus_text(reg.render_text())
+    total = sum(v["count"] for k, v in parsed["histograms"].items()
+                if k.startswith("serving_e2e_latency_s"))
+    assert total == n_threads * n_iter
+
+
+# -- slow reservoir + dump ------------------------------------------------
+
+
+def test_slow_reservoir_keeps_the_slowest():
+    res = exemplar.SlowReservoir(size=4)
+    for i in range(10):
+        res.offer("ep", dur_s=float(i), trace_id=f"t{i}")
+    snap = res.snapshot("ep")
+    assert [r["dur_s"] for r in snap] == [9.0, 8.0, 7.0, 6.0]
+    assert snap[0]["trace_id"] == "t9"
+    res.offer("other", 99.0, "tx")
+    assert res.snapshot()[0]["endpoint"] == "other"
+    assert res.snapshot("ep")[0]["dur_s"] == 9.0
+
+
+def test_observe_request_threshold_and_cooldown(tmp_path, monkeypatch):
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", str(tmp_path))
+    # Fast request: reservoir only, no counter, no dump.
+    assert exemplar.observe_request("unit_ep", 0.01, "fast",
+                                    threshold_s=0.5) is None
+    assert "serving.slow_requests" not in obs.snapshot()["counters"]
+    # Slow request: counter + dump.
+    path = exemplar.observe_request("unit_ep", 0.9, "slow1",
+                                    threshold_s=0.5)
+    assert path is not None and os.path.exists(path)
+    recs = _read_log(path)
+    assert recs[0]["event"] == "flight_dump"
+    assert recs[0]["reason"] == "slow-exemplar-unit_ep"
+    assert any(r.get("event") == "slow_request"
+               and r.get("trace_id") == "slow1" for r in recs)
+    assert obs.snapshot()["counters"]["serving.slow_requests"] == 1.0
+    # A second breach inside the cooldown window: counted, not dumped.
+    assert exemplar.observe_request("unit_ep", 0.9, "slow2",
+                                    threshold_s=0.5) is None
+    assert obs.snapshot()["counters"]["serving.slow_requests"] == 2.0
+    assert len(glob.glob(os.path.join(
+        str(tmp_path), "flight-slow-exemplar-unit_ep-*.jsonl"))) == 1
+
+
+def test_slow_exemplar_serving_e2e(tiny_serving_model, tmp_path,
+                                   monkeypatch):
+    """ISSUE 11 acceptance: a failpoint-slowed request through the live
+    server produces exactly ONE rate-limited slow-exemplar flight dump
+    whose ring contains the request's trace_id, and that trace_id
+    appears as a histogram exemplar in /metrics."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from ncnet_tpu.reliability import failpoints
+    from ncnet_tpu.serving.client import MatchClient
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", str(tmp_path))
+
+    def jpeg(seed):
+        rng = np.random.default_rng(seed)
+        img = Image.fromarray(
+            (rng.random((96, 128, 3)) * 255).astype("uint8"))
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG")
+        return buf.getvalue()
+
+    config, params = tiny_serving_model
+    log = str(tmp_path / "rl.jsonl")
+    run = obs.init_run("serving", log, heartbeat_s=0)
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    server = MatchServer(engine, port=0, max_batch=2, max_queue=16,
+                         max_delay_s=0.01, default_timeout_s=300.0,
+                         run_log=run, slo_p99_target_s=0.2).start()
+    try:
+        client = MatchClient(server.url, timeout_s=600.0)
+        # Every device dispatch sleeps past the p99 target: both
+        # requests breach, the cooldown admits one dump.
+        with failpoints.failpoint("engine.device", "delay", delay_s=0.3):
+            r1 = client.match(query_bytes=jpeg(0), pano_bytes=jpeg(1))
+            r2 = client.match(query_bytes=jpeg(0), pano_bytes=jpeg(2))
+        metrics_text = client.metrics()
+    finally:
+        server.stop()
+        run.close("ok")
+    trace_ids = {r1["trace_id"], r2["trace_id"]}
+    dumps = glob.glob(os.path.join(
+        str(tmp_path), "flight-slow-exemplar-v1_match-*.jsonl"))
+    assert len(dumps) == 1, dumps
+    recs = _read_log(dumps[0])
+    # The ring is process-wide, so filter to THIS test's verdicts.
+    slow = [r for r in recs if r.get("event") == "slow_request"
+            and r.get("trace_id") in trace_ids]
+    assert slow, recs
+    # The dumped ring holds the slow request's span tree, not just the
+    # verdict: spans carrying its trace_id are present.
+    assert any(r.get("kind") == "span"
+               and r.get("trace_id") == slow[0]["trace_id"]
+               for r in recs)
+    # The /metrics exposition carries a bucket exemplar with a real
+    # trace_id from this run.
+    assert 'serving_slow_requests_total 2' in metrics_text
+    assert any(f'# {{trace_id="{tid}"}}' in metrics_text
+               for tid in trace_ids)
+    # Both slow requests landed in the reservoir.
+    tails = exemplar.reservoir().snapshot("v1_match")
+    assert trace_ids <= {r["trace_id"] for r in tails}
+
+
+# -- tools/program_cards.py ----------------------------------------------
+
+
+def _fake_card(key, flops, nbytes, temp):
+    return {"key": key, "program": key.split("|")[0],
+            "q_shape": [64, 64], "p_shape": [64, 64], "batch": 1,
+            "mode": "oneshot",
+            "xla": {"flops": flops, "bytes_accessed": nbytes},
+            "memory": {"temp_bytes": temp},
+            "flops_per_byte": flops / nbytes, "model_ok": True}
+
+
+def test_program_cards_strict_fails_on_seeded_regression(tmp_path,
+                                                         capsys):
+    """ISSUE 11 acceptance: --strict exits nonzero when a card's cost
+    grew past the threshold vs the committed baseline."""
+    import program_cards
+
+    base = str(tmp_path / "base.json")
+    cur = str(tmp_path / "cur.json")
+    costcards.save_cards(
+        [_fake_card("a|x", 100.0, 50.0, 10), _fake_card("b|y", 200.0,
+                                                        80.0, 20)],
+        base)
+    # Identical set: clean pass.
+    costcards.save_cards([_fake_card("a|x", 100.0, 50.0, 10),
+                          _fake_card("b|y", 200.0, 80.0, 20)], cur)
+    assert program_cards.main(
+        [cur, "--baseline", base, "--strict"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["regressed"] is False and rec["n_cards"] == 2
+    # Seeded regression: +20% flops on one card.
+    costcards.save_cards([_fake_card("a|x", 120.0, 50.0, 10)], cur)
+    assert program_cards.main(
+        [cur, "--baseline", base, "--strict"]) == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["regressed"] is True
+    assert rec["diff"]["regressions"] == ["a|x"]
+    assert rec["diff"]["entries"][0]["flops_rel"] == pytest.approx(0.2)
+    # Growth under the threshold: not a regression.
+    costcards.save_cards([_fake_card("a|x", 105.0, 50.0, 10)], cur)
+    assert program_cards.main(
+        [cur, "--baseline", base, "--strict"]) == 0
+    capsys.readouterr()
+
+
+def test_program_cards_reads_runlog_and_flags_model_failures(tmp_path,
+                                                             capsys):
+    import program_cards
+
+    log = tmp_path / "rl.jsonl"
+    bad = dict(_fake_card("c|z", 10.0, 5.0, 1), model_ok=False)
+    lines = [json.dumps({"event": "program_card", **bad})]
+    log.write_text("\n".join(lines) + "\n")
+    assert program_cards.main([str(log), "--strict"]) == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["model_failures"] == ["c|z"]
+    assert rec["cards"][0]["roofline"] == "mem"
+
+
+def test_program_cards_committed_baseline_round_trips(capsys):
+    """The committed baseline must parse and pass against itself — the
+    gate a future PR's cost change is measured by."""
+    import program_cards
+
+    base = os.path.join(REPO, "trained_models",
+                        "program_cards_baseline.json")
+    assert program_cards.main([base, "--baseline", base,
+                               "--strict"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["n_cards"] >= 3
+    assert rec["model_failures"] == []
+    assert all(c["roofline"] in ("mem", "comp") for c in rec["cards"])
+
+
+# -- tools/ci_gate.py -----------------------------------------------------
+
+
+def test_ci_gate_skips_are_recorded_not_green(capsys):
+    import ci_gate
+
+    rc = ci_gate.main(["--skip", "tier1", "--skip", "lint",
+                       "--skip", "bench_trend"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    rec = json.loads(out[0])
+    assert rec["metric"] == "ci_gate" and rec["ok"] is True
+    assert rec["skipped"] == ["bench_trend", "lint", "tier1"]
+    assert all(c == {"skipped": True} for c in rec["checks"].values())
+
+
+def test_ci_gate_run_captures_failure():
+    import ci_gate
+
+    res = ci_gate._run([sys.executable, "-c",
+                        "import sys; print('boom'); sys.exit(3)"], 30)
+    assert res["ok"] is False and res["rc"] == 3
+    assert res["tail"] == "boom"
+    ok = ci_gate._run([sys.executable, "-c", "print('fine')"], 30)
+    assert ok["ok"] is True and ok["rc"] == 0
+
+
+# -- tools/obs_report.py <orphaned> root ----------------------------------
+
+
+def test_span_tree_orphans_group_under_synthetic_root():
+    """ISSUE 11 satellite regression: a hand-built TRUNCATED runlog —
+    the parent record lost mid-write — must group the surviving child
+    under <orphaned>, while intact trees and genuine roots (null
+    parent) stay unmarked."""
+    import obs_report
+
+    def span(event, span_id, parent_id, dur=0.1):
+        return {"kind": "span", "event": event, "dur_s": dur,
+                "span_id": span_id, "parent_id": parent_id,
+                "trace_id": "t1"}
+
+    records = [
+        span("request", "a", None),        # genuine root
+        span("device", "b", "a"),          # intact child
+        span("respond", "c", "LOST"),      # parent record truncated
+        span("decode", "d", "c"),          # grandchild of the orphan
+    ]
+    tree = obs_report.span_tree(records)
+    assert ("request",) in tree
+    assert ("request", "device") in tree
+    assert ("<orphaned>", "respond") in tree
+    assert ("<orphaned>", "respond", "decode") in tree
+    assert ("respond",) not in tree, \
+        "an orphan must not masquerade as a top-level span"
+    # Cycles (defensive) are cut, not marked orphaned.
+    cyc = obs_report.span_tree([span("x", "e", "f"), span("y", "f", "e")])
+    assert set(cyc) == {("x", "y"), ("y", "x")}
+
+
+# -- autotune winner card + sidecar ---------------------------------------
+
+
+def test_autotune_winner_persists_card_sidecar(tmp_path, capsys,
+                                               monkeypatch):
+    """The autotune winner event carries its cost card and the card
+    lands in the program_cards.json sidecar next to the strategy
+    cache — so `winner` events say WHY a plan won."""
+    import autotune_consensus
+
+    from ncnet_tpu.ops import autotune
+
+    cache = tmp_path / "cache.json"
+    monkeypatch.setenv("NCNET_AUTOTUNE_FAKE_TIMER", "1")
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE", str(cache))
+    for k in autotune.PLAN_ENV_KEYS:
+        monkeypatch.delenv(k, raising=False)
+    rc = autotune_consensus.main([
+        "--shape", "1,1,6,5,7,6", "--dtype", "float32",
+        "--kernel_sizes", "3", "3", "--channels", "16", "1",
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    side = tmp_path / costcards.SIDECAR_BASENAME
+    assert side.exists(), "sidecar rides the consented cache write"
+    cards = costcards.load_cards(str(side))
+    plan_cards = [c for c in cards.values()
+                  if c["program"] == "consensus_plan"]
+    assert len(plan_cards) == 1
+    card = plan_cards[0]
+    assert card["xla"]["flops"] > 0
+    assert card["model_ok"] is not False
+    assert "plan_label" in card and "ms" in card
+
+
+# -- bench overhead contract ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_costcard_overhead_within_5pct():
+    """ISSUE 11 acceptance: capture lives OUTSIDE the timed region — the
+    CPU smoke headline with NCNET_COSTCARDS=1 stays within ±5% of the
+    =0 run, and only the =1 run carries the costcard field."""
+    import subprocess
+
+    def run(costcards_on):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   NCNET_BENCH_SMOKE_SIZE="96",
+                   NCNET_BENCH_DIAL_TIMEOUT="60",
+                   NCNET_BENCH_C2F="0",
+                   NCNET_COSTCARDS="1" if costcards_on else "0")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=REPO)
+        assert res.returncode == 0, res.stderr[-2000:]
+        return json.loads(res.stdout.strip())
+
+    with_cards = run(True)
+    without = run(False)
+    assert with_cards["costcard"] is not None
+    assert with_cards["costcard"]["model_ok"] is True
+    assert without["costcard"] is None
+    rel = abs(with_cards["value"] - without["value"]) / without["value"]
+    assert rel < 0.05, \
+        f"cost-card capture changed the headline by {rel:.1%}"

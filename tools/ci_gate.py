@@ -1,0 +1,138 @@
+"""One-command commit gate: tier-1 tests + lint + bench trend.
+
+Runs the three checks every PR must pass, in order, and prints ONE
+aggregated JSON line (the house tool contract)::
+
+    python tools/ci_gate.py
+    {"metric": "ci_gate", "value": 1, "ok": true, "checks": {
+        "tier1": {"ok": true, "rc": 0, "s": 412.3, ...},
+        "lint":  {"ok": true, "rc": 0, ...},
+        "bench_trend": {"ok": true, "rc": 0, ...}}}
+
+The checks:
+
+- ``tier1``: the ROADMAP.md tier-1 pytest lane (``-m 'not slow'``,
+  CPU-forced, collection errors tolerated per-file) — the same command
+  the PR driver enforces, so a green gate here predicts a green driver.
+- ``lint``: ``tools/ncnet_lint.py --changed-only`` — the unified
+  static-analysis pass over files changed vs the merge base (full-repo
+  rules still see everything).
+- ``bench_trend``: ``tools/bench_trend.py --strict`` — the committed
+  BENCH_r*.json trend; regression vs best prior same-metric round
+  fails the gate.
+
+``--skip NAME`` (repeatable) drops a check — skipped checks are
+recorded as ``{"skipped": true}`` and do NOT fail the gate, but the
+JSON says so; nothing is silently green. Child stdout/stderr stream to
+stderr live (the gate's own stdout stays one JSON line). Exit 0 iff
+every non-skipped check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Tier-1 must run CPU-side: this box's sitecustomize auto-dials the
+# axon TPU tunnel unless the pool env is dropped (verify skill,
+# "Platform gotcha").
+_CPU_ENV = {"JAX_PLATFORMS": "cpu"}
+_CPU_DROP = ("PALLAS_AXON_POOL_IPS",)
+
+CHECKS = ("tier1", "lint", "bench_trend")
+
+
+def _run(cmd, timeout_s, cpu_env=False) -> dict:
+    env = dict(os.environ)
+    if cpu_env:
+        env.update(_CPU_ENV)
+        for k in _CPU_DROP:
+            env.pop(k, None)
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, env=env, timeout=timeout_s,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        rc, out = proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as exc:
+        rc = -1
+        out = (exc.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(exc.stdout, bytes) else (exc.stdout or "")
+        out += f"\n[ci_gate] TIMEOUT after {timeout_s}s"
+    sys.stderr.write(out if out.endswith("\n") or not out else out + "\n")
+    sys.stderr.flush()
+    return {"ok": rc == 0, "rc": rc, "cmd": " ".join(cmd),
+            "s": round(time.monotonic() - t0, 1),
+            "tail": out.strip().splitlines()[-1] if out.strip() else ""}
+
+
+def run_tier1(timeout_s: float) -> dict:
+    return _run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+         "--continue-on-collection-errors", "-p", "no:cacheprovider",
+         "-p", "no:xdist", "-p", "no:randomly"],
+        timeout_s, cpu_env=True)
+
+
+def run_lint(timeout_s: float) -> dict:
+    return _run(
+        [sys.executable, os.path.join("tools", "ncnet_lint.py"),
+         "--changed-only"], timeout_s)
+
+
+def run_bench_trend(timeout_s: float) -> dict:
+    return _run(
+        [sys.executable, os.path.join("tools", "bench_trend.py"),
+         "--strict"], timeout_s)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--skip", action="append", default=[],
+                    choices=list(CHECKS),
+                    help="drop a check (recorded as skipped, not green)")
+    ap.add_argument("--tier1-timeout-s", type=float, default=870.0,
+                    help="tier-1 pytest wall-clock fence (ROADMAP's "
+                         "870 s default)")
+    ap.add_argument("--timeout-s", type=float, default=300.0,
+                    help="per-check fence for lint / bench_trend")
+    args = ap.parse_args(argv)
+
+    runners = {
+        "tier1": lambda: run_tier1(args.tier1_timeout_s),
+        "lint": lambda: run_lint(args.timeout_s),
+        "bench_trend": lambda: run_bench_trend(args.timeout_s),
+    }
+    checks = {}
+    for name in CHECKS:
+        if name in args.skip:
+            print(f"[ci_gate] {name}: SKIPPED", file=sys.stderr)
+            checks[name] = {"skipped": True}
+            continue
+        print(f"[ci_gate] {name}: running...", file=sys.stderr)
+        checks[name] = runners[name]()
+        verdict = "ok" if checks[name]["ok"] else "FAIL"
+        print(f"[ci_gate] {name}: {verdict} "
+              f"(rc={checks[name]['rc']}, {checks[name]['s']}s)",
+              file=sys.stderr)
+
+    ok = all(c.get("ok", True) for c in checks.values())
+    print(json.dumps({
+        "metric": "ci_gate",
+        "value": 1 if ok else 0,
+        "unit": "pass",
+        "ok": ok,
+        "skipped": sorted(args.skip),
+        "checks": checks,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

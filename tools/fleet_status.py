@@ -4,17 +4,22 @@ The dashboard half of obs/aggregate.py: scrape every replica's
 ``GET /metrics`` each poll, merge the scrapes into a fleet view, and
 render a per-replica table to STDERR —
 
-    replica      req/s   err/s   p99 ms   queue  breaker  burn
-    r0            12.4     0.0     38.2       1   closed   0.1
-    r1            11.9     0.0     41.7       0   closed   0.2
-    FLEET         24.3     0.0     40.9       1        -   0.2
+    replica      req/s   err/s   p99 ms   queue  breaker  burn  hbm GB  head%  warm
+    r0            12.4     0.0     38.2       1   closed   0.1    21.40     33     4
+    r1            11.9     0.0     41.7       0   closed   0.2    21.38     33     4
+    FLEET         24.3     0.0     40.9       1        -   0.2    42.78     33     8
 
 req/s and err/s are counter deltas between polls; p99 is exact at the
 shared bucket ladder's resolution (merged buckets for the FLEET row,
 never an average of per-replica percentiles); breaker decodes the
 ``breaker_engine_state`` gauge; burn is the availability SLO's
 fast-window burn rate (obs/slo.py) — at or above 1.0 the fleet is
-spending error budget faster than it earns it.
+spending error budget faster than it earns it. hbm GB / head% read the
+``device.hbm.*`` gauges (obs/costcards.py, polled by the server on
+/metrics) — bytes in use and percent of the device limit still free
+("-" on backends that don't report memory stats, e.g. CPU); warm is
+the ``serving.warmup_programs`` counter, how many (bucket, batch,
+mode) programs the replica precompiled.
 
 On exit (``--iterations N``, or Ctrl-C when polling forever) it prints
 ONE JSON line to stdout, the house contract every tool in tools/
@@ -49,6 +54,9 @@ LAT = "serving_e2e_latency_s"
 QUEUE = "serving_queue_depth"
 BREAKER = "breaker_engine_state"
 BURN = "slo_availability_burn_fast"
+HBM_USE = "device_hbm_bytes_in_use"
+HBM_LIM = "device_hbm_limit_bytes"
+WARMED = "serving_warmup_programs"
 
 _BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
 
@@ -79,6 +87,23 @@ def _p99_ms(hists, key):
     return p99 * 1e3 if p99 is not None else None
 
 
+def _headroom_pct(use, lim):
+    """Percent of the device HBM limit still free (None when the
+    backend doesn't report memory stats — CPU replicas)."""
+    if use is None or not lim:
+        return None
+    return max(0.0, 1.0 - use / lim) * 100.0
+
+
+def _gauge_sum(view, key):
+    """Sum a gauge across replicas (fleet HBM totals — the merged
+    entry only carries min/max/mean, but per_replica has every value)."""
+    entry = view["gauges"].get(key) or {}
+    vals = (entry.get("per_replica") or {}).values()
+    vals = [v for v in vals if v is not None]
+    return sum(vals) if vals else None
+
+
 def render(view, prev_counters, dt, out=None):
     """One poll's table; returns {ident: counters} for the next delta."""
     w = (out or sys.stderr).write
@@ -89,6 +114,8 @@ def render(view, prev_counters, dt, out=None):
         prev = (prev_counters or {}).get(ident)
         state = rep["gauges"].get(BREAKER)
         burn = rep["gauges"].get(BURN)
+        use = rep["gauges"].get(HBM_USE)
+        lim = rep["gauges"].get(HBM_LIM)
         rows.append((
             ident,
             _rate(rep["counters"], prev, REQS, dt),
@@ -97,9 +124,14 @@ def render(view, prev_counters, dt, out=None):
             rep["gauges"].get(QUEUE),
             _BREAKER_STATES.get(state, "?") if state is not None else "-",
             burn,
+            use / 1e9 if use is not None else None,
+            _headroom_pct(use, lim),
+            rep["counters"].get(WARMED),
         ))
     fleet_prev = (prev_counters or {}).get("FLEET")
     burn_entry = view["gauges"].get(BURN) or {}
+    fleet_use = _gauge_sum(view, HBM_USE)
+    fleet_lim = _gauge_sum(view, HBM_LIM)
     rows.append((
         "FLEET",
         _rate(view["counters"], fleet_prev, REQS, dt),
@@ -108,13 +140,19 @@ def render(view, prev_counters, dt, out=None):
         (view["gauges"].get(QUEUE) or {}).get("max"),
         "-",
         burn_entry.get("max"),
+        fleet_use / 1e9 if fleet_use is not None else None,
+        _headroom_pct(fleet_use, fleet_lim),
+        view["counters"].get(WARMED),
     ))
     w(f"{'replica':<12} {'req/s':>8} {'err/s':>8} {'p99 ms':>8} "
-      f"{'queue':>6} {'breaker':>9} {'burn':>6}\n")
-    for ident, rps, eps, p99, q, brk, burn in rows:
+      f"{'queue':>6} {'breaker':>9} {'burn':>6} {'hbm GB':>7} "
+      f"{'head%':>6} {'warm':>5}\n")
+    for ident, rps, eps, p99, q, brk, burn, hbm, head, warm in rows:
         qs = f"{q:.0f}".rjust(6) if q is not None else "-".rjust(6)
+        ws_ = f"{warm:.0f}".rjust(5) if warm is not None else "-".rjust(5)
         w(f"{ident:<12} {_fmt(rps, 8)} {_fmt(eps, 8)} {_fmt(p99, 8)} "
-          f"{qs} {brk:>9} {_fmt(burn, 6)}\n")
+          f"{qs} {brk:>9} {_fmt(burn, 6)} {_fmt(hbm, 7, 2)} "
+          f"{_fmt(head, 6, 0)} {ws_}\n")
     for url, why in sorted(view["errors"].items()):
         w(f"  unreachable {url}: {why}\n")
     nxt = {i: dict(view["per_replica"][i]["counters"]) for i in idents}
@@ -157,14 +195,23 @@ def main(argv=None):
 
     if view is None:
         return 1
-    replicas = {
-        ident: {
+    # New fields (HBM accounting, warmed programs) are ADDED to the
+    # exit record; every pre-existing key keeps its name and meaning —
+    # session scripts parsing older outputs keep working.
+    replicas = {}
+    for ident, rep in sorted(view["per_replica"].items()):
+        use = rep["gauges"].get(HBM_USE)
+        lim = rep["gauges"].get(HBM_LIM)
+        replicas[ident] = {
             "requests": rep["counters"].get(REQS, 0.0),
             "errors": rep["counters"].get(ERRS, 0.0),
             "p99_ms": _p99_ms(rep["histograms"], LAT),
+            "hbm_bytes_in_use": use,
+            "hbm_headroom_pct": _headroom_pct(use, lim),
+            "warmed_programs": rep["counters"].get(WARMED),
         }
-        for ident, rep in sorted(view["per_replica"].items())
-    }
+    fleet_use = _gauge_sum(view, HBM_USE)
+    fleet_lim = _gauge_sum(view, HBM_LIM)
     rec = {
         "metric": "fleet_status",
         "value": view["counters"].get(REQS, 0.0),
@@ -175,6 +222,9 @@ def main(argv=None):
             "errors": view["counters"].get(ERRS, 0.0),
             "p99_ms": _p99_ms(view["histograms"], LAT),
             "n_sources": view["n_sources"],
+            "hbm_bytes_in_use": fleet_use,
+            "hbm_limit_bytes": fleet_lim,
+            "warmed_programs": view["counters"].get(WARMED),
         },
         "polls": polls,
         "unreachable": sorted(view["errors"]),

@@ -120,7 +120,12 @@ def span_tree(records: List[dict]) -> Dict[tuple, dict]:
     total_s, max_s, mean_s}, ...}.
 
     A span whose parent_id doesn't resolve (its parent record was lost
-    to a crash mid-write) roots its own subtree rather than vanishing.
+    to a crash mid-write, or the log was truncated) is grouped under a
+    synthetic ``<orphaned>`` root rather than silently posing as a
+    top-level span — a truncated runlog then reads as truncated
+    instead of as a differently-shaped request. Spans with a null
+    parent_id are genuine roots and stay unmarked; cycles (defensive:
+    the walk's ``seen`` guard) are not marked either.
     """
     spans = [r for r in _spans(records) if r.get("span_id")]
     by_id = {r["span_id"]: r for r in spans}
@@ -130,7 +135,10 @@ def span_tree(records: List[dict]) -> Dict[tuple, dict]:
         while node is not None and node["span_id"] not in seen:
             seen.add(node["span_id"])
             path.append(node["event"])
-            node = by_id.get(node.get("parent_id"))
+            parent_id = node.get("parent_id")
+            node = by_id.get(parent_id)
+            if node is None and parent_id is not None:
+                path.append("<orphaned>")
         key = tuple(reversed(path))
         agg = out.setdefault(key, {"count": 0, "total_s": 0.0, "max_s": 0.0})
         agg["count"] += 1

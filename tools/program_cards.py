@@ -1,0 +1,239 @@
+"""Program cost-card report: roofline table, diff, and cost gate.
+
+Reads a card set — the ``program_cards.json`` sidecar that warmup /
+autotune persist next to the strategy cache (obs/costcards.py), or the
+``program_card`` events of a runlog — and renders a per-bucket table to
+STDERR with each program's roofline placement:
+
+    key                                  GFLOP    MB acc   FLOP/B  side
+    batch_pairs|q64x64|p64x64|b1|oneshot  5.15      83.2      62.0  mem
+    ...
+
+``side`` is where the program sits relative to the chip ridge point
+(PEAK_TFLOPS_BF16 / PEAK_HBM_GBS, utils/traceagg.py): arithmetic
+intensity below the ridge is memory-bound ("mem"), above is
+compute-bound ("comp"). On CPU-captured cards the placement still uses
+the TPU ridge — the cards exist to predict device behavior.
+
+``--diff OTHER`` compares a second card set key-by-key (relative FLOP
+/ bytes / temp deltas). ``--baseline PATH --strict`` turns any shared
+card whose flops, bytes_accessed, or temp_bytes grew more than
+``--threshold`` (default 10%) over the committed baseline into a
+nonzero exit — the bench_trend.py gate posture, applied to compiled
+program cost instead of wall clock.
+
+One JSON line on stdout is the whole machine-readable contract; prose
+goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ncnet_tpu.utils.traceagg import PEAK_HBM_GBS, PEAK_TFLOPS_BF16  # noqa: E402
+
+RIDGE_FLOPS_PER_BYTE = PEAK_TFLOPS_BF16 * 1e12 / (PEAK_HBM_GBS * 1e9)
+DEFAULT_CARDS = os.path.join("trained_models", "program_cards.json")
+
+# The cost axes the gate watches. Growth on any of them past the
+# threshold is a regression: more FLOPs or more bytes moved per
+# program is slower at fixed roofline, and more temp HBM shrinks the
+# batch/bucket headroom warmup accounts for.
+GATE_FIELDS = (
+    ("flops", ("xla", "flops")),
+    ("bytes_accessed", ("xla", "bytes_accessed")),
+    ("temp_bytes", ("memory", "temp_bytes")),
+)
+
+
+def _field(card: dict, path) -> Optional[float]:
+    node = card
+    for part in path:
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return float(node) if node is not None else None
+
+
+def load_card_set(path: str) -> Dict[str, dict]:
+    """Cards keyed by card key, from a sidecar JSON or a runlog JSONL
+    (``program_card`` events; the last event per key wins)."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        data = json.loads(text)
+        if isinstance(data, dict) and "cards" in data:
+            return dict(data["cards"] or {})
+    except ValueError:
+        pass
+    cards: Dict[str, dict] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("event") == "program_card" and rec.get("key"):
+            cards[rec["key"]] = rec
+    return cards
+
+
+def roofline_side(card: dict) -> Optional[str]:
+    ai = card.get("flops_per_byte")
+    if ai is None:
+        return None
+    return "comp" if float(ai) >= RIDGE_FLOPS_PER_BYTE else "mem"
+
+
+def card_rows(cards: Dict[str, dict]) -> List[dict]:
+    rows = []
+    for key in sorted(cards):
+        card = cards[key]
+        rows.append({
+            "key": key,
+            "program": card.get("program"),
+            "flops": _field(card, ("xla", "flops")),
+            "bytes_accessed": _field(card, ("xla", "bytes_accessed")),
+            "temp_bytes": _field(card, ("memory", "temp_bytes")),
+            "flops_per_byte": card.get("flops_per_byte"),
+            "model_ok": card.get("model_ok"),
+            "roofline": roofline_side(card),
+            "backend": card.get("backend"),
+        })
+    return rows
+
+
+def diff_card_sets(cards: Dict[str, dict], other: Dict[str, dict],
+                   threshold: float) -> dict:
+    """Per-key relative cost deltas of ``cards`` vs ``other`` (the
+    baseline). A key regresses when any gate field grew more than
+    ``threshold`` relative to the baseline value."""
+    shared = sorted(set(cards) & set(other))
+    entries, regressions = [], []
+    for key in shared:
+        entry = {"key": key}
+        worst = None
+        for name, path in GATE_FIELDS:
+            new = _field(cards[key], path)
+            old = _field(other[key], path)
+            if new is None or old is None or old <= 0:
+                continue
+            rel = (new - old) / old
+            entry[f"{name}_rel"] = round(rel, 6)
+            worst = rel if worst is None else max(worst, rel)
+        entry["regressed"] = worst is not None and worst > threshold
+        if entry["regressed"]:
+            regressions.append(key)
+        entries.append(entry)
+    return {
+        "shared": len(shared),
+        "only_current": sorted(set(cards) - set(other)),
+        "only_baseline": sorted(set(other) - set(cards)),
+        "entries": entries,
+        "regressions": regressions,
+        "threshold": threshold,
+    }
+
+
+def _fmt(v, scale, nd=2) -> str:
+    return f"{v / scale:.{nd}f}" if v is not None else "-"
+
+
+def render_table(rows: List[dict]) -> str:
+    width = max([len(r["key"]) for r in rows] + [len("key")])
+    lines = [f"{'key':<{width}}  {'GFLOP':>9}  {'MB acc':>9}  "
+             f"{'MB tmp':>9}  {'FLOP/B':>7}  {'model':>5}  side"]
+    for r in rows:
+        ai = r["flops_per_byte"]
+        model = {True: "ok", False: "FAIL", None: "-"}[r["model_ok"]]
+        lines.append(
+            f"{r['key']:<{width}}  {_fmt(r['flops'], 1e9):>9}  "
+            f"{_fmt(r['bytes_accessed'], 1e6):>9}  "
+            f"{_fmt(r['temp_bytes'], 1e6):>9}  "
+            f"{(f'{ai:.1f}' if ai is not None else '-'):>7}  "
+            f"{model:>5}  {r['roofline'] or '-'}")
+    lines.append(f"ridge: {RIDGE_FLOPS_PER_BYTE:.1f} FLOP/byte "
+                 f"({PEAK_TFLOPS_BF16:g} TFLOP/s bf16 / "
+                 f"{PEAK_HBM_GBS:g} GB/s HBM)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("cards", nargs="?", default=DEFAULT_CARDS,
+                    help="card set: sidecar JSON or runlog JSONL "
+                         f"(default {DEFAULT_CARDS})")
+    ap.add_argument("--diff", metavar="OTHER",
+                    help="second card set to diff against (baseline)")
+    ap.add_argument("--baseline",
+                    help="committed baseline card set for --strict "
+                         "(implies a diff against it)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative cost growth vs baseline that counts "
+                         "as a regression (default 0.10)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on regression vs --baseline/--diff, "
+                         "or on any model_ok=false card")
+    args = ap.parse_args(argv)
+
+    try:
+        cards = load_card_set(args.cards)
+    except (OSError, ValueError) as exc:
+        print(json.dumps({"cards": None, "error": str(exc)}))
+        print(f"cannot read {args.cards}: {exc}", file=sys.stderr)
+        return 1 if args.strict else 0
+
+    rows = card_rows(cards)
+    report = {
+        "source": args.cards,
+        "n_cards": len(rows),
+        "ridge_flops_per_byte": round(RIDGE_FLOPS_PER_BYTE, 2),
+        "cards": rows,
+        "model_failures": [r["key"] for r in rows
+                           if r["model_ok"] is False],
+    }
+    if rows:
+        print(render_table(rows), file=sys.stderr)
+    else:
+        print(f"no cards in {args.cards}", file=sys.stderr)
+
+    base_path = args.baseline or args.diff
+    if base_path:
+        try:
+            base = load_card_set(base_path)
+        except (OSError, ValueError) as exc:
+            report["diff"] = {"error": str(exc), "baseline": base_path}
+            print(f"cannot read baseline {base_path}: {exc}",
+                  file=sys.stderr)
+            print(json.dumps(report))
+            return 1 if args.strict else 0
+        diff = diff_card_sets(cards, base, args.threshold)
+        diff["baseline"] = base_path
+        report["diff"] = diff
+        for key in diff["regressions"]:
+            entry = next(e for e in diff["entries"] if e["key"] == key)
+            rels = {k: v for k, v in entry.items()
+                    if k.endswith("_rel")}
+            print(f"COST REGRESSION: {key} {rels}", file=sys.stderr)
+
+    regressed = bool(report.get("diff", {}).get("regressions"))
+    report["regressed"] = regressed
+    print(json.dumps(report))
+    if args.strict and report["model_failures"]:
+        print("model_ok=false card(s): "
+              + ", ".join(report["model_failures"]), file=sys.stderr)
+        return 1
+    return 1 if (args.strict and regressed) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
