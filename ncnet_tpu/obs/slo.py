@@ -233,7 +233,11 @@ class SloEngine:
                 remaining = 1.0 - b_bad / allowed
             else:
                 remaining = 1.0
-            remaining = max(min(remaining, 1.0), -1.0)
+            # Clamp at zero: "budget exhausted" is the floor the
+            # balancer-facing readout reports — how far PAST empty the
+            # window burned is burn-rate territory, and a negative
+            # fraction reads as a telemetry bug to consumers.
+            remaining = max(min(remaining, 1.0), 0.0)
 
             paging = (burn_fast >= spec.fast_burn
                       and burn_slow >= spec.slow_burn)

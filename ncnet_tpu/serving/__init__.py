@@ -35,6 +35,15 @@ _EXPORTS = {
     "MatchFleet": "fleet",
     "Replica": "fleet",
     "SharedFeatureStore": "feature_store",
+    "QosController": "qos",
+    "QosDecision": "qos",
+    "Rung": "qos",
+    "TenantTable": "qos",
+    "TenantPolicy": "qos",
+    "TokenBucket": "qos",
+    "parse_ladder": "qos",
+    "parse_tenant_spec": "qos",
+    "PRIORITY_CLASSES": "qos",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -61,15 +61,23 @@ from ..utils.batching import ShapeBuckets
 
 
 class RejectedError(Exception):
-    """Admission queue full: back off and retry after ``retry_after_s``."""
+    """Admission queue full: back off and retry after ``retry_after_s``.
 
-    def __init__(self, retry_after_s: float, depth: int):
+    ``scope`` says WHICH bound rejected: ``"queue"`` (the shared
+    admission queue — genuine over-capacity) or ``"tenant"`` (one
+    tenant hit its queue-slot share while the shared queue still had
+    room — fairness isolation, not capacity; the server reports it as
+    its own 503 kind so the chaos gate can tell them apart)."""
+
+    def __init__(self, retry_after_s: float, depth: int,
+                 scope: str = "queue"):
         super().__init__(
             f"admission queue full ({depth} pending); "
             f"retry after {retry_after_s:.3f}s"
         )
         self.retry_after_s = retry_after_s
         self.depth = depth
+        self.scope = scope
 
 
 class PoisonRequestError(Exception):
@@ -113,6 +121,10 @@ class _Pending:
     #: None = deadlines-off (bulk/offline riders): the bucket flushes on
     #: size or linger only, never because this rider is about to expire.
     deadline: Optional[float]
+    #: Tenant identity (serving/qos.py) — counted against the tenant's
+    #: queue-slot share when ``tenant_queue_frac`` is set; None rides
+    #: untracked (the pre-QoS path).
+    tenant: Optional[str] = None
     # Trace context captured on the SUBMITTING thread (obs/trace.py) —
     # the batch runs on the worker thread, where contextvars would be
     # empty; the worker re-attaches these so batch/device spans land in
@@ -156,13 +168,21 @@ class DeadlineBatcher:
         default_timeout_s: Optional[float] = 30.0,
         backlog_cap: Optional[int] = None,
         isolate_poison: bool = True,
+        tenant_queue_frac: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
         labels=None,
     ):
+        """``tenant_queue_frac``: one tenant's share of ``max_queue``
+        (0 < frac <= 1, floored at one slot). A tenant at its share is
+        rejected (``scope="tenant"``) while other tenants still admit —
+        the per-tenant fairness bound under the shared queue
+        (serving/qos.py). None (default) disables the accounting."""
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        if tenant_queue_frac is not None and not 0 < tenant_queue_frac <= 1:
+            raise ValueError("tenant_queue_frac must be in (0, 1]")
         self.runner = runner
         # Per-instance metric labels (e.g. {"replica": "r0"}): a fleet
         # member tags its hot-path series so obs/aggregate.py can merge
@@ -171,6 +191,8 @@ class DeadlineBatcher:
         self.isolate_poison = isolate_poison
         self.max_batch = max_batch
         self.max_queue = max_queue
+        self.tenant_queue_frac = tenant_queue_frac
+        self._tenant_pending: dict = {}
         self.max_delay_s = float(max_delay_s)
         self.deadline_slack_s = float(deadline_slack_s)
         # None = deadlines-off: offline/bulk callers opt out of deadline
@@ -196,17 +218,17 @@ class DeadlineBatcher:
 
     # -- admission --------------------------------------------------------
 
-    def submit(self, bucket_key, payload, timeout_s: Optional[float] = None
-               ) -> Future:
+    def submit(self, bucket_key, payload, timeout_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Admit one request; returns a Future resolving to BatchResult.
 
-        Raises :class:`RejectedError` (queue full) or RuntimeError
-        (batcher closed). ``timeout_s`` sets the request's deadline
-        relative to now; the batcher flushes the request's bucket
-        before the deadline (minus ``deadline_slack_s``) passes.
-        ``timeout_s=None`` inherits ``default_timeout_s``; when that is
-        also None the request rides deadline-free (bulk mode) and only
-        size/linger flushes apply.
+        Raises :class:`RejectedError` (queue full, or ``tenant`` at its
+        queue-slot share) or RuntimeError (batcher closed).
+        ``timeout_s`` sets the request's deadline relative to now; the
+        batcher flushes the request's bucket before the deadline (minus
+        ``deadline_slack_s``) passes. ``timeout_s=None`` inherits
+        ``default_timeout_s``; when that is also None the request rides
+        deadline-free (bulk mode) and only size/linger flushes apply.
         """
         now = self.clock()
         timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
@@ -216,6 +238,7 @@ class DeadlineBatcher:
             future=Future(),
             t_submit=now,
             deadline=None if timeout_s is None else now + float(timeout_s),
+            tenant=tenant,
             trace_ctx=trace.current(),
         )
         with self._cond:
@@ -232,6 +255,18 @@ class DeadlineBatcher:
                 raise RejectedError(
                     retry_after_s=max(self.max_delay_s, 0.01), depth=depth
                 )
+            if tenant is not None and self.tenant_queue_frac is not None:
+                cap = max(1, int(self.max_queue * self.tenant_queue_frac))
+                used = self._tenant_pending.get(tenant, 0)
+                if used >= cap:
+                    obs.counter(
+                        "serving.tenant.rejected",
+                        labels={**self.labels, "tenant": tenant}).inc()
+                    raise RejectedError(
+                        retry_after_s=max(self.max_delay_s, 0.01),
+                        depth=depth, scope="tenant",
+                    )
+                self._tenant_pending[tenant] = used + 1
             self._buckets.add(bucket_key, pending)
             obs.counter("serving.admitted", labels=self.labels).inc()
             obs.gauge("serving.queue_depth", labels=self.labels).set(
@@ -288,6 +323,19 @@ class DeadlineBatcher:
 
     def _run(self, chunk: List[_Pending]) -> None:
         t_run = self.clock()
+        if self.tenant_queue_frac is not None:
+            # A rider leaving the queue frees its tenant's slot whether
+            # the batch then succeeds or fails — the share bounds queue
+            # occupancy, not outcomes.
+            with self._cond:
+                for p in chunk:
+                    if p.tenant is None:
+                        continue
+                    left = self._tenant_pending.get(p.tenant, 0) - 1
+                    if left > 0:
+                        self._tenant_pending[p.tenant] = left
+                    else:
+                        self._tenant_pending.pop(p.tenant, None)
         obs.counter("serving.batches", labels=self.labels).inc()
         obs.histogram("serving.batch_size",
                       labels=self.labels).observe(len(chunk))

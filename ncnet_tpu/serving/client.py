@@ -36,7 +36,7 @@ class ServingError(Exception):
 
 
 class OverCapacityError(ServingError):
-    """503 after exhausting Retry-After backoff retries."""
+    """503/429 after exhausting Retry-After backoff retries."""
 
 
 class PoisonRequestError(ServingError):
@@ -69,14 +69,17 @@ class MatchClient:
 
     # -- transport --------------------------------------------------------
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None):
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 headers: Optional[dict] = None):
         failpoints.fire("client.transport", payload=path)
         data = json.dumps(body).encode() if body is not None else None
+        hdrs = {"Content-Type": "application/json"} if data else {}
+        hdrs.update(headers or {})
         req = urllib.request.Request(
             self.base_url + path,
             data=data,
             method=method,
-            headers={"Content-Type": "application/json"} if data else {},
+            headers=hdrs,
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
@@ -104,16 +107,25 @@ class MatchClient:
         deadline_ms: Optional[float] = None,
         max_matches: Optional[int] = None,
         mode: Optional[str] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> dict:
         """POST /v1/match; returns the response dict on 200.
 
-        503s (over capacity, open breaker, draining replica) are
-        retried up to ``retries`` times with jittered backoff floored
-        at the server's ``Retry-After`` hint, the total sleep bounded
-        by ``retry_deadline_s`` — then :class:`OverCapacityError`. A
-        422 raises :class:`PoisonRequestError` immediately (the server
-        proved the failure is this request's own; retrying resends
-        poison); any other non-200 raises :class:`ServingError`.
+        503s (over capacity, open breaker, draining replica, QoS shed)
+        and 429s (this tenant's own admission budget / queue share)
+        are retried up to ``retries`` times with jittered backoff
+        floored at the server's ``Retry-After`` hint, the total sleep
+        bounded by ``retry_deadline_s`` — then
+        :class:`OverCapacityError`. A 422 raises
+        :class:`PoisonRequestError` immediately (the server proved the
+        failure is this request's own; retrying resends poison); any
+        other non-200 raises :class:`ServingError`.
+
+        ``tenant``/``priority`` ride as the ``X-NCNet-Tenant`` /
+        ``X-NCNet-Priority`` headers (docs/SERVING.md, multi-tenant
+        QoS); the priority hint can only LOWER the request below its
+        tenant's declared class.
         """
         body = {}
         if query_path:
@@ -130,14 +142,19 @@ class MatchClient:
             body["max_matches"] = max_matches
         if mode is not None:
             body["mode"] = mode
+        hdrs = {}
+        if tenant is not None:
+            hdrs["X-NCNet-Tenant"] = tenant
+        if priority is not None:
+            hdrs["X-NCNet-Priority"] = priority
         session = self._policy.session()
         while True:
             status, payload, headers = self._request(
-                "POST", "/v1/match", body
+                "POST", "/v1/match", body, headers=hdrs
             )
             if status == 200:
                 return payload
-            if status == 503:
+            if status in (503, 429):
                 try:
                     hint = float(headers.get("Retry-After", "0.1"))
                 except (TypeError, ValueError):

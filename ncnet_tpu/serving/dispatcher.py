@@ -101,15 +101,18 @@ class FleetDispatcher:
 
     # -- request path -----------------------------------------------------
 
-    def submit(self, bucket_key, payload, timeout_s: Optional[float] = None
-               ) -> Future:
+    def submit(self, bucket_key, payload, timeout_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Admit one request somewhere healthy; returns a Future with
         the single-engine BatchResult contract. Raises RejectedError
-        (every healthy queue full) or NoHealthyReplicaError."""
+        (every healthy queue full) or NoHealthyReplicaError. ``tenant``
+        rides along to each replica's batcher for per-tenant queue-slot
+        accounting."""
         outer: Future = Future()
         state = {
             "tried": [],
             "attempts": 0,
+            "tenant": tenant,
             # Captured on the handler thread: a re-route happens on a
             # worker-thread callback where contextvars are empty, so the
             # resubmit re-attaches the request's trace explicitly.
@@ -132,7 +135,8 @@ class FleetDispatcher:
             try:
                 with trace.attach(state["ctx"]):
                     inner = r.submit(bucket_key, payload,
-                                     timeout_s=timeout_s)
+                                     timeout_s=timeout_s,
+                                     tenant=state["tenant"])
             except RejectedError as exc:
                 state["tried"].append(r)
                 last_reject = exc

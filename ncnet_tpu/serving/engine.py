@@ -79,6 +79,12 @@ class Prepared:
     #: Engine mode ('oneshot' | 'c2f') — part of the bucket key, so a
     #: batch is mode-homogeneous and each mode compiles its own program.
     mode: str = "oneshot"
+    #: Non-default c2f operating point (coarse_factor, topk, radius) —
+    #: set when the request (or the QoS ladder, serving/qos.py) chose
+    #: knobs other than the engine config's. Part of the bucket key, so
+    #: a batch is op-homogeneous; None = the engine default, whose
+    #: bucket keys are identical to the pre-QoS 3-tuples.
+    c2f_op: Optional[Tuple[int, int, int]] = None
 
 
 class MatchEngine:
@@ -213,15 +219,113 @@ class MatchEngine:
         self._batch_pairs_cached = _batch_pairs_cached
 
         # -- coarse-to-fine programs (mode='c2f') -------------------------
-        # Two device programs with a host decision point between: stage 1
-        # extracts features, runs the pipeline on the POOLED grids and
-        # gates the top-K coarse cells per probe direction; stage 2
-        # gathers high-res windows around the survivors, re-runs consensus
-        # on the cropped sub-tensors and splices the refined matches.
-        # Features are cast to bf16 right after extraction — the cache's
-        # store dtype — so the cache-hit and miss paths stay bit-identical
-        # (the oneshot paths get this for free because correlation casts
-        # first; here the coarse pooling intervenes).
+        # c2f programs compile per OPERATING POINT (coarse_factor, topk,
+        # radius): the QoS quality ladder (serving/qos.py) degrades
+        # requests to coarser points at runtime, and each point is its
+        # own pair of jitted programs. The config's own knobs are the
+        # default point; its programs build eagerly so the no-ladder
+        # path is unchanged.
+        self._both_directions = both_directions
+        self._invert_direction = invert_direction
+        self._c2f_programs: dict = {}
+        self._c2f_default_op = (config.c2f_coarse_factor, config.c2f_topk,
+                                config.c2f_radius)
+        self._c2f_coarse, self._c2f_coarse_cached, self._c2f_refine = \
+            self.c2f_programs_for(None)
+
+        self.cache = cache
+        if self.cache is None and cache_mb > 0:
+            from ..evals.feature_cache import PanoFeatureCache
+
+            # Producer key "serve": the serving miss program (per-pair
+            # backbone inside the pair scan) is a different XLA artifact
+            # from the eval CLI's bb-grouped one — a shared disk tier
+            # must not cross-hit between them (the eval producer-key
+            # rule, cli/eval_inloc.py).
+            self.cache = PanoFeatureCache(
+                cache_mb * 1024 * 1024,
+                disk_dir=cache_dir or None,
+                model_key=cache_model_key + "|serve",
+                store_dtype=jnp.bfloat16,
+            )
+        # put() fetches D2H; serialize stores so a burst of misses can't
+        # stack redundant fetches of one shortlist-popular pano.
+        self._store_lock = threading.Lock()
+        # Cost observatory state (obs/costcards.py): warmup replaces
+        # cost_cards wholesale with one card per warmed program, and
+        # hbm_headroom holds the latest declared-buckets-vs-device-limit
+        # verdict (None on backends with no memory accounting).
+        self.cost_cards: List[dict] = []
+        self.hbm_headroom: Optional[dict] = None
+
+    def _put(self, x):
+        """Place one input stack on this engine's device (no-op when the
+        engine is unpinned — jax's default placement applies)."""
+        if self.device is None:
+            return x
+        return self._jax.device_put(x, self.device)
+
+    # -- c2f operating points ---------------------------------------------
+
+    def _config_for_op(self, op: Optional[Tuple[int, int, int]]):
+        """The model config with one operating point's c2f knobs applied
+        (validation rides NCNetConfig.__post_init__). None / the default
+        point return the engine config itself."""
+        if op is None or tuple(op) == self._c2f_default_op:
+            return self.config
+        f, k, r = op
+        return dataclasses.replace(
+            self.config, c2f_coarse_factor=int(f), c2f_topk=int(k),
+            c2f_radius=int(r))
+
+    def _op_from_knobs(self, knobs: dict) -> Optional[Tuple[int, int, int]]:
+        """Request-level ``c2f`` knob dict -> normalized op tuple, or
+        None when the knobs equal the engine default (so default-op
+        requests keep their pre-QoS bucket keys). Raises ValueError on
+        bad knobs."""
+        allowed = {"coarse_factor", "topk", "radius"}
+        unknown = set(knobs) - allowed
+        if unknown:
+            raise ValueError(f"unknown c2f knobs: {sorted(unknown)}")
+        try:
+            op = (int(knobs.get("coarse_factor",
+                                self.config.c2f_coarse_factor)),
+                  int(knobs.get("topk", self.config.c2f_topk)),
+                  int(knobs.get("radius", self.config.c2f_radius)))
+        except (TypeError, ValueError) as exc:
+            raise ValueError(f"c2f knobs must be integers: {exc}") from exc
+        self._config_for_op(op)  # knob validation
+        return None if op == self._c2f_default_op else op
+
+    def c2f_programs_for(self, op: Optional[Tuple[int, int, int]]):
+        """(coarse, coarse_cached, refine) jitted programs for one
+        operating point, built on first use and cached. Callers are the
+        batcher worker and startup warmup — effectively single-threaded;
+        a rare duplicate build is harmless (same programs, jit cache
+        dedups the compile)."""
+        key = self._c2f_default_op if op is None else tuple(op)
+        progs = self._c2f_programs.get(key)
+        if progs is None:
+            progs = self._build_c2f_programs(self._config_for_op(key))
+            self._c2f_programs[key] = progs
+        return progs
+
+    def _build_c2f_programs(self, config):
+        """Build one operating point's c2f program pair.
+
+        Two device programs with a host decision point between: stage 1
+        extracts features, runs the pipeline on the POOLED grids and
+        gates the top-K coarse cells per probe direction; stage 2
+        gathers high-res windows around the survivors, re-runs consensus
+        on the cropped sub-tensors and splices the refined matches.
+        Features are cast to bf16 right after extraction — the cache's
+        store dtype — so the cache-hit and miss paths stay bit-identical
+        (the oneshot paths get this for free because correlation casts
+        first; here the coarse pooling intervenes).
+        """
+        jax, jnp = self._jax, self._jnp
+        both_directions = self._both_directions
+        invert_direction = self._invert_direction
         stride = c2f_stride(config)
 
         def _c2f_stage1(params, feat_a, feat_b):
@@ -305,46 +409,13 @@ class MatchEngine:
             _, ms = jax.lax.scan(body, None, (fa_stack, fb_stack, gates))
             return ms
 
-        self._c2f_coarse = _c2f_coarse
-        self._c2f_coarse_cached = _c2f_coarse_cached
-        self._c2f_refine = _c2f_refine
-
-        self.cache = cache
-        if self.cache is None and cache_mb > 0:
-            from ..evals.feature_cache import PanoFeatureCache
-
-            # Producer key "serve": the serving miss program (per-pair
-            # backbone inside the pair scan) is a different XLA artifact
-            # from the eval CLI's bb-grouped one — a shared disk tier
-            # must not cross-hit between them (the eval producer-key
-            # rule, cli/eval_inloc.py).
-            self.cache = PanoFeatureCache(
-                cache_mb * 1024 * 1024,
-                disk_dir=cache_dir or None,
-                model_key=cache_model_key + "|serve",
-                store_dtype=jnp.bfloat16,
-            )
-        # put() fetches D2H; serialize stores so a burst of misses can't
-        # stack redundant fetches of one shortlist-popular pano.
-        self._store_lock = threading.Lock()
-        # Cost observatory state (obs/costcards.py): warmup replaces
-        # cost_cards wholesale with one card per warmed program, and
-        # hbm_headroom holds the latest declared-buckets-vs-device-limit
-        # verdict (None on backends with no memory accounting).
-        self.cost_cards: List[dict] = []
-        self.hbm_headroom: Optional[dict] = None
-
-    def _put(self, x):
-        """Place one input stack on this engine's device (no-op when the
-        engine is unpinned — jax's default placement applies)."""
-        if self.device is None:
-            return x
-        return self._jax.device_put(x, self.device)
+        return _c2f_coarse, _c2f_coarse_cached, _c2f_refine
 
     # -- host-side request preparation -----------------------------------
 
-    def _resize_shape(self, h: int, w: int,
-                      mode: str = "oneshot") -> Tuple[int, int]:
+    def _resize_shape(self, h: int, w: int, mode: str = "oneshot",
+                      op: Optional[Tuple[int, int, int]] = None
+                      ) -> Tuple[int, int]:
         h_unit, w_unit = resolve_feat_units(
             self.feat_unit, self.image_size, self.k_size
         )
@@ -352,8 +423,10 @@ class MatchEngine:
             # The c2f splice needs BOTH fine feature axes divisible by
             # the coarse stride (the aligned-block invariant, ops/c2f.py)
             # — resolve_feat_units' extra_align only hardens the height
-            # unit, so lcm both axes here.
-            stride = c2f_stride(self.config)
+            # unit, so lcm both axes here. The stride depends on the
+            # operating point's coarse factor, so a degraded request
+            # snaps to ITS op's buckets.
+            stride = c2f_stride(self._config_for_op(op))
             h_unit = int(np.lcm(h_unit, stride))
             w_unit = int(np.lcm(w_unit, stride))
         return inloc_resize_shape(
@@ -361,7 +434,8 @@ class MatchEngine:
         )
 
     def _load_image(self, path: Optional[str], b64: Optional[str],
-                    mode: str = "oneshot"
+                    mode: str = "oneshot",
+                    op: Optional[Tuple[int, int, int]] = None
                     ) -> Tuple[np.ndarray, Tuple[int, int]]:
         """Decode + bucket-resize + normalize one image (path or base64
         payload) into the model's [1, 3, H, W] layout."""
@@ -373,13 +447,13 @@ class MatchEngine:
         if path:
             with Image.open(path) as im:  # header-only dims read
                 w, h = im.size
-            oh, ow = self._resize_shape(h, w, mode)
+            oh, ow = self._resize_shape(h, w, mode, op)
             chw, _ = load_and_resize_chw(path, oh, ow, normalize=True)
             return chw[None], (oh, ow)
         raw = base64.b64decode(b64)
         with Image.open(io.BytesIO(raw)) as im:
             img = np.asarray(im.convert("RGB"), dtype=np.float32)
-        oh, ow = self._resize_shape(*img.shape[:2], mode)
+        oh, ow = self._resize_shape(*img.shape[:2], mode, op)
         chw = resize_bilinear_np(img, oh, ow).transpose(2, 0, 1)
         chw = normalize_image(chw / 255.0).astype(np.float32)
         return np.ascontiguousarray(chw)[None], (oh, ow)
@@ -390,7 +464,12 @@ class MatchEngine:
         Request schema (docs/SERVING.md): ``query_path`` | ``query_b64``
         plus ``pano_path`` | ``pano_b64``; optional ``max_matches`` and
         ``mode`` ('oneshot' default | 'c2f' — the coarse-to-fine path).
-        Raises ValueError on malformed input (the server maps it to 400).
+        c2f requests may carry a ``c2f`` knob object
+        (``{"coarse_factor": 4, "topk": 8, "radius": 1}``, every key
+        optional) selecting a non-default operating point — the QoS
+        quality ladder's rewrite target (serving/qos.py), also usable
+        directly by clients. Raises ValueError on malformed input (the
+        server maps it to 400).
         """
         if not isinstance(request, dict):
             raise ValueError("request body must be a JSON object")
@@ -405,9 +484,17 @@ class MatchEngine:
             raise ValueError(
                 f"unknown mode {mode!r}; expected one of {ENGINE_MODES}"
             )
+        op = None
+        knobs = request.get("c2f")
+        if knobs is not None:
+            if mode != "c2f":
+                raise ValueError("c2f knobs require mode='c2f'")
+            if not isinstance(knobs, dict):
+                raise ValueError("c2f must be a JSON object of knobs")
+            op = self._op_from_knobs(knobs)
         max_matches = int(request.get("max_matches", 0) or 0)
         try:
-            query, _ = self._load_image(q_path, q_b64, mode)
+            query, _ = self._load_image(q_path, q_b64, mode, op)
         except (OSError, ValueError) as exc:
             raise ValueError(f"query image unreadable: {exc}") from exc
 
@@ -422,11 +509,11 @@ class MatchEngine:
                     pw, ph = im.size
             except (OSError, ValueError) as exc:
                 raise ValueError(f"pano image unreadable: {exc}") from exc
-            pano_shape = self._resize_shape(ph, pw, mode)
+            pano_shape = self._resize_shape(ph, pw, mode, op)
             pano_feats = self.cache.get(p_path, pano_shape)
         if pano_feats is None:
             try:
-                pano, pano_shape = self._load_image(p_path, p_b64, mode)
+                pano, pano_shape = self._load_image(p_path, p_b64, mode, op)
             except (OSError, ValueError) as exc:
                 raise ValueError(f"pano image unreadable: {exc}") from exc
 
@@ -440,8 +527,14 @@ class MatchEngine:
             kind = ("feat", tuple(pano_feats.shape))
         else:
             kind = ("img", tuple(pano.shape[2:]))
+        # Non-default operating points extend the key (each op is its
+        # own program family); default-op keys stay the pre-QoS 3-tuple
+        # so existing buckets, warmups and logs are unchanged.
+        bucket_key = (tuple(query.shape[2:]), kind, mode)
+        if op is not None:
+            bucket_key = bucket_key + (op,)
         return Prepared(
-            bucket_key=(tuple(query.shape[2:]), kind, mode),
+            bucket_key=bucket_key,
             query=query,
             pano=pano,
             pano_feats=pano_feats,
@@ -449,6 +542,7 @@ class MatchEngine:
             pano_shape=pano_shape,
             max_matches=max_matches,
             mode=mode,
+            c2f_op=op,
         )
 
     # -- batched device dispatch ------------------------------------------
@@ -542,15 +636,17 @@ class MatchEngine:
     def _c2f_bucket_degenerate(self, bucket_key) -> bool:
         """Host-side mirror of models.ncnet.c2f_is_degenerate for one
         bucket: map the bucket's image dims to feature dims (backbone
-        1/16 stride) and ask whether the c2f knobs reduce to one-shot."""
-        (qh, qw), kind, _mode = bucket_key
+        1/16 stride) and ask whether the bucket's c2f knobs (its op's,
+        when the 4-tuple key carries one) reduce to one-shot."""
+        (qh, qw), kind, _mode = bucket_key[:3]
+        op = bucket_key[3] if len(bucket_key) > 3 else None
         q_feat = (qh // _FEAT_STRIDE_PX, qw // _FEAT_STRIDE_PX)
         if kind[0] == "feat":
             p_feat = tuple(kind[1][-2:])
         else:
             ph, pw = kind[1]
             p_feat = (ph // _FEAT_STRIDE_PX, pw // _FEAT_STRIDE_PX)
-        return c2f_is_degenerate(self.config, q_feat, p_feat)
+        return c2f_is_degenerate(self._config_for_op(op), q_feat, p_feat)
 
     def run_batch(self, bucket_key, batch: List[Prepared]) -> List[dict]:
         """Run one same-bucket batch as one device dispatch; returns one
@@ -599,13 +695,15 @@ class MatchEngine:
             # counts), then the refinement program launches on the
             # still-on-device feature/gate stacks. Children of the
             # device span so a request trace shows both stages.
+            coarse_prog, coarse_cached_prog, refine_prog = \
+                self.c2f_programs_for(batch[0].c2f_op)
             with trace.span("device", batch_size=len(batch)):
                 t_c = time.monotonic()
                 if mode == "cached":
-                    fa_s, fb_s, gates = self._c2f_coarse_cached(
+                    fa_s, fb_s, gates = coarse_cached_prog(
                         self.params, q_stack, f_stack)
                 else:
-                    fa_s, fb_s, gates = self._c2f_coarse(
+                    fa_s, fb_s, gates = coarse_prog(
                         self.params, q_stack, t_stack)
                 top_b = np.asarray(self._jax.device_get(gates[0][0]))
                 top_a = np.asarray(self._jax.device_get(gates[1][0]))
@@ -624,7 +722,7 @@ class MatchEngine:
                 # c2f progress.
                 failpoints.fire("engine.refine", payload=bucket_key)
                 t_r = time.monotonic()
-                ms = self._c2f_refine(self.params, fa_s, fb_s, gates)
+                ms = refine_prog(self.params, fa_s, fb_s, gates)
                 np_ms = self._jax.device_get(ms)
                 refine_s = time.monotonic() - t_r
                 trace.emit_span("refine", dur_s=refine_s,
@@ -688,7 +786,7 @@ class MatchEngine:
     # -- startup ----------------------------------------------------------
 
     def warmup(self, raw_shapes, batch_sizes=(1,),
-               modes=("oneshot",)) -> int:
+               modes=("oneshot",), c2f_ops=()) -> int:
         """Precompile the match programs for declared traffic buckets.
 
         ``raw_shapes``: iterable of (query_h, query_w, pano_h, pano_w)
@@ -699,9 +797,16 @@ class MatchEngine:
         the first c2f request doesn't eat a cold compile under deadline
         (the c2f entry warms BOTH stage programs; degenerate c2f knobs
         warm the one-shot program that bucket actually dispatches).
-        Returns the number of (bucket, batch, mode) programs compiled.
-        Compiles land in the persistent compile cache, so a restarted
-        replica warms from disk.
+        ``c2f_ops``: extra c2f operating points to warm per bucket —
+        knob dicts (``{"coarse_factor": 4, "topk": 8}``) or
+        (factor, topk, radius) tuples. A QoS deployment passes its
+        ladder's rungs here so a degraded request under overload never
+        pays a cold compile (serving/qos.py); ignored unless "c2f" is
+        in ``modes``. Cost cards cover the default point only (the
+        card's mode label stays the plain engine mode).
+        Returns the number of (bucket, batch, mode, op) programs
+        compiled. Compiles land in the persistent compile cache, so a
+        restarted replica warms from disk.
 
         Unless ``NCNET_COSTCARDS=0``, every warmed program is also
         AOT-captured into a cost card (obs/costcards.py): a
@@ -717,6 +822,15 @@ class MatchEngine:
         n = 0
         cards: List[dict] = []
         with_cards = costcards.enabled()
+        # Normalize the extra operating points once; None (the default
+        # point) always leads, and ops that fold into it are deduped.
+        warm_ops: List[Optional[Tuple[int, int, int]]] = [None]
+        for o in c2f_ops:
+            op = (self._op_from_knobs(o) if isinstance(o, dict)
+                  else self._op_from_knobs(
+                      dict(zip(("coarse_factor", "topk", "radius"), o))))
+            if op not in warm_ops:
+                warm_ops.append(op)
         for qh, qw, ph, pw in raw_shapes:
             for engine_mode in modes:
                 if engine_mode not in ENGINE_MODES:
@@ -724,63 +838,73 @@ class MatchEngine:
                         f"unknown warmup mode {engine_mode!r}; expected "
                         f"one of {ENGINE_MODES}"
                     )
-                q_shape = self._resize_shape(qh, qw, engine_mode)
-                p_shape = self._resize_shape(ph, pw, engine_mode)
-                c2f_live = engine_mode == "c2f" and \
-                    not self._c2f_bucket_degenerate(
-                        (q_shape, ("img", p_shape), engine_mode))
-                for b in batch_sizes:
-                    q = self._put(
-                        self._jnp.zeros((b, 3) + q_shape, self._jnp.float32))
-                    t = self._put(
-                        self._jnp.zeros((b, 3) + p_shape, self._jnp.float32))
-                    coarse = None
-                    with obs.span("serving.warmup", q_shape=list(q_shape),
-                                  p_shape=list(p_shape), batch=b,
-                                  mode=engine_mode):
-                        if c2f_live:
-                            coarse = self._c2f_coarse(self.params, q, t)
-                            self._jax.block_until_ready(coarse)
-                            self._jax.block_until_ready(
-                                self._c2f_refine(self.params, *coarse)
-                            )
-                        else:
-                            self._jax.block_until_ready(
-                                self._batch_pairs(self.params, q, t)
-                            )
-                    if with_cards:
-                        # AOT lower+compile hits the jit/persistent
-                        # compile cache the calls above just populated,
-                        # so the card costs an analysis read, not a
-                        # second compile.
-                        if c2f_live:
-                            cards += self._cost_card(
-                                "c2f_coarse", self._c2f_coarse,
-                                (self.params, q, t),
-                                q_shape, p_shape, b, engine_mode)
-                            cards += self._cost_card(
-                                "c2f_refine", self._c2f_refine,
-                                (self.params,) + tuple(coarse),
-                                q_shape, p_shape, b, engine_mode)
-                        else:
-                            cards += self._cost_card(
-                                "batch_pairs", self._batch_pairs,
-                                (self.params, q, t),
-                                q_shape, p_shape, b, engine_mode)
-                    # The trace above consulted the strategy cache
-                    # (ops/autotune.py) for this bucket's consensus
-                    # shape; surface what it resolved — tuned plan or
-                    # heuristic — so a replica's run log shows which
-                    # buckets are tuned.
-                    plan = consensus_last_plan()
-                    if plan is not None:
-                        obs.event("autotune", action="consult",
-                                  where="serving.warmup",
-                                  q_shape=list(q_shape),
-                                  p_shape=list(p_shape), batch=b,
-                                  cache_hit=plan.get("cache_hit"),
-                                  ms=plan.get("cache_ms"), plan=plan)
-                    n += 1
+                ops = warm_ops if engine_mode == "c2f" else [None]
+                for op in ops:
+                    q_shape = self._resize_shape(qh, qw, engine_mode, op)
+                    p_shape = self._resize_shape(ph, pw, engine_mode, op)
+                    bucket = (q_shape, ("img", p_shape), engine_mode)
+                    if op is not None:
+                        bucket = bucket + (op,)
+                    c2f_live = engine_mode == "c2f" and \
+                        not self._c2f_bucket_degenerate(bucket)
+                    if c2f_live:
+                        coarse_prog, _cc, refine_prog = \
+                            self.c2f_programs_for(op)
+                    for b in batch_sizes:
+                        q = self._put(self._jnp.zeros(
+                            (b, 3) + q_shape, self._jnp.float32))
+                        t = self._put(self._jnp.zeros(
+                            (b, 3) + p_shape, self._jnp.float32))
+                        coarse = None
+                        span_kw = dict(q_shape=list(q_shape),
+                                       p_shape=list(p_shape), batch=b,
+                                       mode=engine_mode)
+                        if op is not None:
+                            span_kw["c2f_op"] = list(op)
+                        with obs.span("serving.warmup", **span_kw):
+                            if c2f_live:
+                                coarse = coarse_prog(self.params, q, t)
+                                self._jax.block_until_ready(coarse)
+                                self._jax.block_until_ready(
+                                    refine_prog(self.params, *coarse)
+                                )
+                            else:
+                                self._jax.block_until_ready(
+                                    self._batch_pairs(self.params, q, t)
+                                )
+                        if with_cards and op is None:
+                            # AOT lower+compile hits the jit/persistent
+                            # compile cache the calls above just
+                            # populated, so the card costs an analysis
+                            # read, not a second compile.
+                            if c2f_live:
+                                cards += self._cost_card(
+                                    "c2f_coarse", coarse_prog,
+                                    (self.params, q, t),
+                                    q_shape, p_shape, b, engine_mode)
+                                cards += self._cost_card(
+                                    "c2f_refine", refine_prog,
+                                    (self.params,) + tuple(coarse),
+                                    q_shape, p_shape, b, engine_mode)
+                            else:
+                                cards += self._cost_card(
+                                    "batch_pairs", self._batch_pairs,
+                                    (self.params, q, t),
+                                    q_shape, p_shape, b, engine_mode)
+                        # The trace above consulted the strategy cache
+                        # (ops/autotune.py) for this bucket's consensus
+                        # shape; surface what it resolved — tuned plan
+                        # or heuristic — so a replica's run log shows
+                        # which buckets are tuned.
+                        plan = consensus_last_plan()
+                        if plan is not None:
+                            obs.event("autotune", action="consult",
+                                      where="serving.warmup",
+                                      q_shape=list(q_shape),
+                                      p_shape=list(p_shape), batch=b,
+                                      cache_hit=plan.get("cache_hit"),
+                                      ms=plan.get("cache_ms"), plan=plan)
+                        n += 1
         obs.counter("serving.warmup_programs", labels=self.labels).inc(n)
         if with_cards:
             self.cost_cards = cards

@@ -55,6 +55,7 @@ class Replica:
         breaker_threshold: int = 5,
         breaker_reset_s: float = 10.0,
         isolate_poison: bool = True,
+        tenant_queue_frac: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         if engine is None and runner is None:
@@ -79,6 +80,7 @@ class Replica:
             deadline_slack_s=deadline_slack_s,
             default_timeout_s=default_timeout_s,
             isolate_poison=isolate_poison,
+            tenant_queue_frac=tenant_queue_frac,
             clock=clock,
             labels=self.labels,
         )
@@ -115,8 +117,9 @@ class Replica:
 
     # -- request path -----------------------------------------------------
 
-    def submit(self, bucket_key, payload, timeout_s=None):
-        return self.batcher.submit(bucket_key, payload, timeout_s=timeout_s)
+    def submit(self, bucket_key, payload, timeout_s=None, tenant=None):
+        return self.batcher.submit(bucket_key, payload, timeout_s=timeout_s,
+                                   tenant=tenant)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -230,11 +233,14 @@ class MatchFleet:
         return self
 
     def warmup(self, raw_shapes, batch_sizes=(1,),
-               modes=("oneshot",)) -> int:
+               modes=("oneshot",), c2f_ops=()) -> int:
         """Precompile declared buckets on every replica. Replica 0 pays
-        the trace; the rest mostly hit the persistent compile cache."""
+        the trace; the rest mostly hit the persistent compile cache.
+        ``c2f_ops`` (knob dicts) additionally warms QoS-ladder c2f
+        operating points so degraded traffic never pays a cold compile
+        mid-overload."""
         return sum(r.engine.warmup(raw_shapes, batch_sizes=batch_sizes,
-                                   modes=modes)
+                                   modes=modes, c2f_ops=c2f_ops)
                    for r in self.replicas if r.engine is not None)
 
     def close(self, timeout_s: float = 60.0) -> None:

@@ -1,0 +1,432 @@
+"""Multi-tenant QoS: degrade quality, not availability, under overload.
+
+The serving stack can already refuse work (bounded admission, breaker,
+poison isolation) but refusal is its only overload response. This
+module adds the two missing levers (docs/RELIABILITY.md,
+"degradation before refusal"):
+
+* **Tenant identity + admission budgets.** Requests carry an
+  ``X-NCNet-Tenant`` header (unlabeled traffic folds into the default
+  tenant). A :class:`TenantTable` maps each tenant to a priority class
+  (``interactive`` > ``batch`` > ``best_effort``) and a
+  :class:`TokenBucket` admission budget, so one tenant's flood is
+  throttled at ITS budget instead of consuming every queue slot. The
+  table is bounded: past ``max_tenants`` distinct names, strangers
+  share one overflow identity (``other``) so neither the bucket dict
+  nor the per-tenant metric cardinality can grow without limit.
+
+* **A quality ladder.** A declared sequence of coarse-to-fine
+  operating points (:func:`parse_ladder`), rung 0 = the request as
+  sent, rung N = the coarsest gated config. The
+  :class:`QosController` walks the ladder on overload — its primary
+  input is the standing :class:`~ncnet_tpu.obs.slo.SloEngine`'s
+  multi-window burn verdict (page = fast AND slow windows hot), with
+  queue high-water as the fast path for bursts too sharp for burn
+  windows — and steps back up only after a sustained cool period
+  (hysteresis, no flapping). Past the last quality rung come the shed
+  positions, applied bottom-priority-first: best_effort is refused
+  (503 + Retry-After) first, then batch, then — only at the very last
+  position — interactive. Interactive traffic is never
+  quality-degraded; it is only ever shed at that final position.
+
+Every transition is an obs event plus ``serving.qos.{rung,
+transitions}`` gauge/counter updates; sheds and degrades count in
+``serving.qos.{shed,degraded}``. An empty ladder with no shed pressure
+is exactly today's admission path (the degenerate-ladder contract,
+tests/test_qos.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from .. import obs
+
+#: Priority classes, highest first. Shedding walks this list from the
+#: BOTTOM; quality degradation applies to every class but the first.
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+
+#: Identity of unlabeled traffic.
+DEFAULT_TENANT = "default"
+
+#: Identity assigned past the table's ``max_tenants`` bound.
+OVERFLOW_TENANT = "other"
+
+TENANT_HEADER = "X-NCNet-Tenant"
+PRIORITY_HEADER = "X-NCNet-Priority"
+
+
+class TokenBucket:
+    """Sustained-rate admission budget with a burst allowance.
+
+    ``rate`` tokens/s refill up to ``burst``; each admitted request
+    spends one. ``rate <= 0`` means unlimited (every take succeeds).
+    Thread-safe; clock-injected for the fake-clock tests.
+    """
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst else max(self.rate, 1.0)
+        self.clock = clock
+        self._tokens = self.burst
+        self._t: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def try_take(self) -> Optional[float]:
+        """Spend one token. None = admitted; else seconds until the
+        next token exists (the 503's Retry-After hint)."""
+        if self.rate <= 0:
+            return None
+        with self._lock:
+            now = self.clock()
+            if self._t is not None:
+                self._tokens = min(
+                    self.burst, self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return None
+            return (1.0 - self._tokens) / self.rate
+
+
+@dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's class + admission budget (rate 0 = unlimited)."""
+
+    tenant: str
+    priority: str = PRIORITY_CLASSES[0]
+    rate: float = 0.0
+    burst: float = 0.0
+
+    def __post_init__(self):
+        if self.priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority {self.priority!r}; expected one of "
+                f"{PRIORITY_CLASSES}")
+
+
+def parse_tenant_spec(spec: str) -> TenantPolicy:
+    """``name:priority[:rate[:burst]]`` -> :class:`TenantPolicy`.
+
+    ``rate`` is the sustained admission budget in requests/s (0 or
+    omitted = unlimited); ``burst`` the bucket depth (default
+    ``max(rate, 1)``).
+    """
+    parts = spec.split(":")
+    if not 2 <= len(parts) <= 4 or not parts[0]:
+        raise ValueError(
+            f"bad tenant spec {spec!r}; expected name:priority[:rate[:burst]]")
+    try:
+        rate = float(parts[2]) if len(parts) > 2 and parts[2] else 0.0
+        burst = float(parts[3]) if len(parts) > 3 and parts[3] else 0.0
+    except ValueError as exc:
+        raise ValueError(f"bad tenant spec {spec!r}: {exc}") from exc
+    return TenantPolicy(parts[0], parts[1], rate, burst)
+
+
+class TenantTable:
+    """Tenant name -> (policy, token bucket), bounded.
+
+    Declared tenants get their declared policy; strangers get the
+    default policy but their OWN bucket (one loud unknown tenant must
+    not spend the quiet ones' budget) until ``max_tenants`` distinct
+    names exist — past that, newcomers share the overflow identity so
+    state and metric cardinality stay bounded.
+    """
+
+    def __init__(self, policies: Sequence[TenantPolicy] = (),
+                 default: Optional[TenantPolicy] = None,
+                 max_tenants: int = 64,
+                 clock: Callable[[], float] = time.monotonic):
+        self.default = default or TenantPolicy(DEFAULT_TENANT)
+        self.max_tenants = int(max_tenants)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._policies: Dict[str, TenantPolicy] = {
+            p.tenant: p for p in policies}
+        self._buckets: Dict[str, TokenBucket] = {}
+
+    def _bucket(self, name: str, policy: TenantPolicy) -> TokenBucket:
+        b = self._buckets.get(name)
+        if b is None:
+            b = TokenBucket(policy.rate, policy.burst or None,
+                            clock=self.clock)
+            self._buckets[name] = b
+        return b
+
+    def resolve(self, tenant: Optional[str],
+                priority_hint: Optional[str] = None
+                ) -> Tuple[str, str, TokenBucket]:
+        """Header values -> (tenant name, priority class, bucket).
+
+        The priority hint (``X-NCNet-Priority``) can only LOWER a
+        request below its tenant's class — a client may self-declare
+        batch, never self-upgrade to interactive.
+        """
+        name = str(tenant).strip() if tenant else DEFAULT_TENANT
+        with self._lock:
+            policy = self._policies.get(name)
+            if policy is None:
+                # Only NEW names overflow: a stranger that earned a
+                # bucket while the table had room keeps its identity.
+                if (name != DEFAULT_TENANT
+                        and name not in self._buckets
+                        and len(self._buckets) >= self.max_tenants):
+                    name = OVERFLOW_TENANT
+                policy = TenantPolicy(
+                    name, self.default.priority, self.default.rate,
+                    self.default.burst)
+            priority = policy.priority
+            if (priority_hint in PRIORITY_CLASSES
+                    and PRIORITY_CLASSES.index(priority_hint)
+                    > PRIORITY_CLASSES.index(priority)):
+                priority = priority_hint
+            return name, priority, self._bucket(name, policy)
+
+    def known(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(set(self._policies) | set(self._buckets)))
+
+
+# -- quality ladder --------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Rung:
+    """One c2f operating point on the quality ladder. ``radius=None``
+    keeps the engine config's refinement radius."""
+
+    coarse_factor: int
+    topk: int
+    radius: Optional[int] = None
+
+    def __post_init__(self):
+        if self.coarse_factor < 1:
+            raise ValueError(f"coarse_factor must be >= 1: {self}")
+        if self.radius is not None and self.radius < 0:
+            raise ValueError(f"radius must be >= 0: {self}")
+
+    def knobs(self) -> dict:
+        """The request-level ``c2f`` knob dict this rung rewrites in
+        (serving/engine.MatchEngine.prepare's schema)."""
+        d = {"coarse_factor": self.coarse_factor, "topk": self.topk}
+        if self.radius is not None:
+            d["radius"] = self.radius
+        return d
+
+
+def parse_ladder(spec: str) -> Tuple[Rung, ...]:
+    """``c2f:factor=2,topk=32;c2f:factor=4,topk=8`` -> rung tuple.
+
+    Semicolon-separated rungs, best quality first; each rung is
+    ``c2f:`` followed by comma-separated ``key=int`` knobs (keys:
+    ``factor``/``coarse_factor``, ``topk``, ``radius``). Empty spec =
+    empty ladder (controller sheds only, no quality degradation).
+    """
+    rungs = []
+    for part in (p.strip() for p in spec.split(";") if p.strip()):
+        if not part.startswith("c2f:"):
+            raise ValueError(
+                f"bad ladder rung {part!r}: rungs are 'c2f:key=val,...'")
+        kw: Dict[str, int] = {}
+        for item in (i for i in part[len("c2f:"):].split(",") if i):
+            key, _, val = item.partition("=")
+            key = key.strip()
+            if key == "factor":
+                key = "coarse_factor"
+            if key not in ("coarse_factor", "topk", "radius"):
+                raise ValueError(f"bad ladder knob {item!r} in {part!r}")
+            try:
+                kw[key] = int(val)
+            except ValueError as exc:
+                raise ValueError(
+                    f"bad ladder knob {item!r} in {part!r}") from exc
+        if "coarse_factor" not in kw or "topk" not in kw:
+            raise ValueError(
+                f"ladder rung {part!r} needs at least factor= and topk=")
+        rungs.append(Rung(**kw))
+    return tuple(rungs)
+
+
+@dataclass(frozen=True)
+class QosDecision:
+    """One request's QoS verdict at the controller's current position."""
+
+    position: int                 # controller position when resolved
+    rung_index: int = 0           # 0 = as requested
+    rung: Optional[Rung] = None   # set when quality-degraded
+    shed: bool = False
+    retry_after_s: float = 1.0
+
+    def apply(self, request: dict) -> dict:
+        """Rewrite a request dict to this decision's operating point
+        (in place; BEFORE engine.prepare — the bucket snap depends on
+        the coarse stride). No-op at rung 0."""
+        if self.rung is not None:
+            request["mode"] = "c2f"
+            request["c2f"] = self.rung.knobs()
+        return request
+
+
+class QosController:
+    """The quality-ladder state machine.
+
+    Position ``p`` walks ``0 .. len(ladder) + len(PRIORITY_CLASSES)``:
+    positions 1..N select ladder rungs (degradable classes run rung
+    ``min(p, N)``; interactive always runs as requested), positions
+    N+1..N+3 additionally shed whole classes bottom-first
+    (best_effort, then batch, then interactive — 503 + Retry-After as
+    the LAST rung).
+
+    Inputs, evaluated by :meth:`update` (called per request and from
+    /healthz): any standing SLO paging (the multi-window burn verdict,
+    obs/slo.py) or queue depth at/above the high-water fraction steps
+    DOWN (rate-limited by ``step_down_interval_s`` so one evaluation
+    burst can't fall straight to the bottom); both signals cool for
+    ``step_up_hold_s`` steps UP one position, re-arming the hold per
+    step so recovery is gradual (hysteresis).
+    """
+
+    def __init__(
+        self,
+        ladder: Sequence[Rung] = (),
+        slo=None,
+        depth_fn: Optional[Callable[[], int]] = None,
+        max_queue: int = 0,
+        high_water_frac: float = 0.75,
+        step_down_interval_s: float = 0.25,
+        step_up_hold_s: float = 5.0,
+        retry_after_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+        labels=None,
+    ):
+        self.ladder = tuple(ladder)
+        self.slo = slo
+        self.depth_fn = depth_fn
+        self.max_queue = int(max_queue)
+        self.high_water_frac = float(high_water_frac)
+        self.step_down_interval_s = float(step_down_interval_s)
+        self.step_up_hold_s = float(step_up_hold_s)
+        self.retry_after_s = float(retry_after_s)
+        self.clock = clock
+        self.labels = dict(labels or {})
+        self.max_position = len(self.ladder) + len(PRIORITY_CLASSES)
+        self._lock = threading.Lock()
+        self._pos = 0
+        self._transitions = 0
+        self._shed_total = 0
+        self._last_step: Optional[float] = None
+        self._cool_since: Optional[float] = None
+        obs.gauge("serving.qos.rung", labels=self.labels).set(0.0)
+
+    def bind(self, slo=None, depth_fn=None, max_queue=None,
+             labels=None) -> "QosController":
+        """Late-wire the inputs the owning server knows (its SloEngine,
+        its batcher/dispatcher depth). Only fills fields still unset."""
+        if self.slo is None and slo is not None:
+            self.slo = slo
+        if self.depth_fn is None and depth_fn is not None:
+            self.depth_fn = depth_fn
+        if not self.max_queue and max_queue:
+            self.max_queue = int(max_queue)
+        if not self.labels and labels:
+            self.labels = dict(labels)
+        return self
+
+    # -- state machine ----------------------------------------------------
+
+    def _shed_classes(self, pos: int) -> Tuple[str, ...]:
+        level = max(pos - len(self.ladder), 0)
+        if level <= 0:
+            return ()
+        return PRIORITY_CLASSES[len(PRIORITY_CLASSES) - level:]
+
+    def _step(self, new_pos: int, reason: str, now: float) -> None:
+        old = self._pos
+        self._pos = new_pos
+        self._transitions += 1
+        self._last_step = now
+        obs.gauge("serving.qos.rung", labels=self.labels).set(float(new_pos))
+        obs.counter("serving.qos.transitions", labels=self.labels).inc()
+        obs.event("qos_transition", rung_from=old, rung_to=new_pos,
+                  reason=reason, quality_rungs=len(self.ladder),
+                  shedding=list(self._shed_classes(new_pos)))
+
+    def update(self) -> int:
+        """Evaluate the inputs, maybe transition; returns the position."""
+        now = self.clock()
+        hot_burn = hot_queue = False
+        if self.slo is not None:
+            results = self.slo.maybe_evaluate()
+            hot_burn = any(r.get("paging") for r in results.values())
+        if self.depth_fn is not None and self.max_queue > 0:
+            hot_queue = (self.depth_fn()
+                         >= self.high_water_frac * self.max_queue)
+        with self._lock:
+            if hot_burn or hot_queue:
+                self._cool_since = None
+                if (self._pos < self.max_position
+                        and (self._last_step is None
+                             or now - self._last_step
+                             >= self.step_down_interval_s)):
+                    self._step(self._pos + 1,
+                               "burn" if hot_burn else "queue", now)
+            else:
+                if self._cool_since is None:
+                    self._cool_since = now
+                elif (self._pos > 0
+                        and now - self._cool_since >= self.step_up_hold_s):
+                    self._step(self._pos - 1, "recovered", now)
+                    self._cool_since = now
+            return self._pos
+
+    def resolve(self, priority: str) -> QosDecision:
+        """One request's verdict at the current position. Unknown
+        priority strings resolve as the lowest class."""
+        with self._lock:
+            pos = self._pos
+        n = len(self.ladder)
+        rank = (PRIORITY_CLASSES.index(priority)
+                if priority in PRIORITY_CLASSES
+                else len(PRIORITY_CLASSES) - 1)
+        if (pos > n
+                and rank >= len(PRIORITY_CLASSES) - (pos - n)):
+            with self._lock:
+                self._shed_total += 1
+            return QosDecision(position=pos, rung_index=n, shed=True,
+                               retry_after_s=self.retry_after_s)
+        if rank == 0 or n == 0 or pos == 0:
+            return QosDecision(position=pos)
+        q = min(pos, n)
+        return QosDecision(position=pos, rung_index=q,
+                           rung=self.ladder[q - 1])
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def position(self) -> int:
+        with self._lock:
+            return self._pos
+
+    @property
+    def transitions(self) -> int:
+        with self._lock:
+            return self._transitions
+
+    def snapshot(self) -> dict:
+        """The /healthz ``qos`` block (docs/SERVING.md)."""
+        with self._lock:
+            pos = self._pos
+            return {
+                "rung": pos,
+                "quality_rungs": len(self.ladder),
+                "max_rung": self.max_position,
+                "shedding": list(self._shed_classes(pos)),
+                "transitions": self._transitions,
+                "shed_total": self._shed_total,
+                "ladder": [r.knobs() for r in self.ladder],
+            }

@@ -49,6 +49,16 @@ from .batcher import (
     ReplicaDeadError,
 )
 from .engine import MatchEngine
+from .qos import (
+    DEFAULT_TENANT,
+    PRIORITY_HEADER,
+    TENANT_HEADER,
+    QosController,
+    TenantPolicy,
+    TenantTable,
+    parse_ladder,
+    parse_tenant_spec,
+)
 
 #: Grace added past a request's deadline before the handler gives up
 #: waiting (504). Admitted requests are still completed by the batcher —
@@ -77,6 +87,9 @@ class MatchServer:
         slo_specs=None,
         slo_p99_target_s: float = 0.5,
         fleet=None,
+        qos: Optional[QosController] = None,
+        tenants: Optional[TenantTable] = None,
+        tenant_queue_frac: Optional[float] = None,
     ):
         """``fleet``: a started-or-startable serving/fleet.MatchFleet.
         When set, the server fronts the fleet's dispatcher instead of
@@ -85,7 +98,19 @@ class MatchServer:
         ignored — configure them per replica via MatchFleet.build), and
         ``engine`` may be None (host-side prepare uses replica 0's
         engine; the shared feature store makes its cache probe valid
-        fleet-wide). The single-engine path is unchanged."""
+        fleet-wide). The single-engine path is unchanged.
+
+        ``qos``: a serving/qos.QosController — the quality-ladder
+        overload state machine; its SLO / queue-depth inputs are
+        late-bound here from this server's own slo engine and submit
+        target. ``tenants``: a serving/qos.TenantTable mapping the
+        ``X-NCNet-Tenant`` header to priority class + admission budget
+        (qos set but tenants None builds an all-default table so
+        per-tenant accounting still works). ``tenant_queue_frac``
+        bounds any single tenant's share of the single-engine batcher
+        queue (fleet mode: configure per replica via replica_kwargs).
+        All three default off — the degenerate path is bit-identical
+        to a server without this layer."""
         self.fleet = fleet
         if fleet is not None and engine is None:
             engine = fleet.replicas[0].engine
@@ -132,6 +157,7 @@ class MatchServer:
                 deadline_slack_s=deadline_slack_s,
                 default_timeout_s=default_timeout_s,
                 isolate_poison=isolate_poison,
+                tenant_queue_frac=tenant_queue_frac,
                 labels=self.labels,
             )
             self.dispatcher = None
@@ -150,6 +176,23 @@ class MatchServer:
         # (obs/exemplar.py). 0/None disables.
         self.slo_p99_target_s = (float(slo_p99_target_s)
                                  if slo_p99_target_s else None)
+        # Multi-tenant QoS (serving/qos.py): a controller without a
+        # tenant table still needs identities for priority resolution
+        # and per-tenant metrics, so one is built all-default.
+        self.tenants = tenants
+        if qos is not None and self.tenants is None:
+            self.tenants = TenantTable()
+        self.qos = qos
+        if self.qos is not None:
+            if fleet is not None:
+                depth_fn = lambda: self.fleet.depth  # noqa: E731
+                qos_max_queue = sum(
+                    r.batcher.max_queue for r in fleet.replicas)
+            else:
+                depth_fn = lambda: self.batcher.depth  # noqa: E731
+                qos_max_queue = max_queue
+            self.qos.bind(slo=self.slo, depth_fn=depth_fn,
+                          max_queue=qos_max_queue, labels=self.labels)
         if self.replica_id:
             obs.set_build_info(replica=self.replica_id)
         self.t_start = time.monotonic()
@@ -243,6 +286,15 @@ class MatchServer:
             entries = []
         return costcards.poll_hbm(entries)
 
+    def _qos_block(self):
+        """The /healthz ``qos`` payload field ({} when QoS is off).
+        Reading health also ticks the controller, so an idle-but-
+        scraped server still recovers rungs between requests."""
+        if self.qos is None:
+            return {}
+        self.qos.update()
+        return {"qos": self.qos.snapshot()}
+
     def _headroom_warnings(self):
         """Per-engine hbm_headroom verdicts that failed, as healthz
         payload fields ({} when everything fits or nothing reported)."""
@@ -299,6 +351,7 @@ class MatchServer:
             if self.replica_id:
                 payload["replica"] = self.replica_id
             payload.update(self._headroom_warnings())
+            payload.update(self._qos_block())
             slo = self.slo_status()
             if slo:
                 payload["slo"] = {
@@ -338,6 +391,7 @@ class MatchServer:
         # buckets oversubscribe HBM still serves what fits, but the
         # operator should know before the OOM does the telling.
         payload.update(self._headroom_warnings())
+        payload.update(self._qos_block())
         slo = self.slo_status()
         if slo:
             # The balancer-facing error-budget readout: per SLO, how
@@ -372,13 +426,46 @@ class MatchServer:
                 # never a dropped connection.
                 failpoints.fire("server.handle")
             except InjectedFault as exc:
-                obs.counter("serving.errors", labels=self.labels).inc()
+                obs.counter(
+                    "serving.errors",
+                    labels={**self.labels, "kind": "injected_fault"}).inc()
                 return 500, {"error": str(exc), "kind": "injected_fault"}, None
             return self._handle_match_traced(handler, root)
 
     def _handle_match_traced(self, handler, root):
         t0 = time.monotonic()
         obs.counter("serving.requests", labels=self.labels).inc()
+        # Tenant identity first: every later verdict (budget, breaker,
+        # shed) is per-tenant accountable. Unlabeled traffic folds into
+        # the default tenant; the priority header can only self-LOWER.
+        tenant = priority = None
+        if self.tenants is not None:
+            tenant, priority, bucket = self.tenants.resolve(
+                handler.headers.get(TENANT_HEADER),
+                handler.headers.get(PRIORITY_HEADER),
+            )
+            obs.counter(
+                "serving.tenant.requests",
+                labels={**self.labels, "tenant": tenant,
+                        "priority": priority}).inc()
+            retry_in = bucket.try_take()
+            if retry_in is not None:
+                # The tenant's OWN admission budget, not service
+                # pressure: a flood throttles at its declared rate
+                # before it can touch anyone else's queue slots.
+                obs.counter(
+                    "serving.tenant.throttled",
+                    labels={**self.labels, "tenant": tenant}).inc()
+                obs.event("tenant_throttled", tenant=tenant,
+                          priority=priority,
+                          retry_after_s=round(retry_in, 3))
+                return (
+                    429,
+                    {"error": "tenant admission budget exhausted",
+                     "kind": "tenant_budget", "tenant": tenant,
+                     "retry_after_s": round(retry_in, 3)},
+                    {"Retry-After": f"{retry_in:.3f}"},
+                )
         # Open breaker (or, fleet mode, no healthy replica at all):
         # reject at the front door — cheapest work a degraded replica
         # can do, and the Retry-After hint tells clients when the
@@ -390,9 +477,36 @@ class MatchServer:
             return (
                 503,
                 {"error": "service degraded (circuit breaker open)",
+                 "kind": "breaker_open",
                  "retry_after_s": round(retry_in, 3)},
                 {"Retry-After": f"{retry_in:.3f}"},
             )
+        # QoS verdict: under overload, low-priority traffic steps down
+        # the quality ladder; 503 is the LAST rung, lowest class first
+        # (docs/RELIABILITY.md, degradation before refusal).
+        decision = None
+        if self.qos is not None:
+            self.qos.update()
+            decision = self.qos.resolve(priority or "interactive")
+            if decision.shed:
+                obs.counter(
+                    "serving.qos.shed",
+                    labels={**self.labels,
+                            "priority": priority or "interactive"}).inc()
+                if tenant is not None:
+                    obs.counter(
+                        "serving.tenant.shed",
+                        labels={**self.labels, "tenant": tenant}).inc()
+                obs.event("qos_shed", tenant=tenant, priority=priority,
+                          rung=decision.position)
+                return (
+                    503,
+                    {"error": "shedding %s traffic (overload)"
+                     % (priority or "interactive"),
+                     "kind": "shed", "qos_rung": decision.position,
+                     "retry_after_s": decision.retry_after_s},
+                    {"Retry-After": f"{decision.retry_after_s:.3f}"},
+                )
         # ``admit`` covers parse + host-side prepare only; submit happens
         # AFTER the span closes so the worker's queue_wait span parents
         # onto the request root, not onto admit.
@@ -414,6 +528,17 @@ class MatchServer:
                     obs.counter("serving.bad_requests", labels=self.labels).inc()
                     return (400, {"error": "deadline_ms must be a number"},
                             None)
+            if decision is not None and decision.rung is not None:
+                # Quality degradation: rewrite the request to the
+                # ladder rung BEFORE prepare — the bucket snap and
+                # cache probe depend on the rung's coarse stride.
+                decision.apply(request)
+                obs.counter("serving.qos.degraded",
+                            labels=self.labels).inc()
+                if tenant is not None:
+                    obs.counter(
+                        "serving.tenant.degraded",
+                        labels={**self.labels, "tenant": tenant}).inc()
             try:
                 prepared = self.engine.prepare(request)
             except ValueError as exc:
@@ -424,7 +549,8 @@ class MatchServer:
                      else self.batcher)
         try:
             fut = submitter.submit(
-                prepared.bucket_key, prepared, timeout_s=timeout_s
+                prepared.bucket_key, prepared, timeout_s=timeout_s,
+                tenant=tenant,
             )
         except BreakerOpenError as exc:
             # Fleet mode: every replica went unhealthy between the
@@ -433,19 +559,40 @@ class MatchServer:
             return (
                 503,
                 {"error": "service degraded (no healthy replica)",
+                 "kind": "breaker_open",
                  "retry_after_s": round(exc.retry_after_s, 3)},
                 {"Retry-After": f"{exc.retry_after_s:.3f}"},
             )
         except RejectedError as exc:
+            if getattr(exc, "scope", "queue") == "tenant":
+                # Fairness isolation, not service pressure: THIS tenant
+                # hit its queue-slot share while the queue itself still
+                # has room for everyone else.
+                obs.event("reject", depth=exc.depth, scope="tenant",
+                          tenant=tenant,
+                          retry_after_s=exc.retry_after_s)
+                return (
+                    429,
+                    {"error": "tenant queue share exhausted",
+                     "kind": "tenant_slots", "tenant": tenant,
+                     "retry_after_s": exc.retry_after_s},
+                    {"Retry-After": f"{exc.retry_after_s:.3f}"},
+                )
             obs.event("reject", depth=exc.depth,
                       retry_after_s=exc.retry_after_s)
-            return (
-                503,
-                {"error": "over capacity", "retry_after_s": exc.retry_after_s},
-                {"Retry-After": f"{exc.retry_after_s:.3f}"},
-            )
+            payload = {"error": "over capacity", "kind": "over_capacity",
+                       "retry_after_s": exc.retry_after_s}
+            if self.qos is not None:
+                # The degradation-before-refusal audit hook: a refusal
+                # that still had coarser rungs to try is a contract
+                # violation the chaos gate looks for.
+                payload["qos_rung"] = self.qos.position
+            return 503, payload, {"Retry-After": f"{exc.retry_after_s:.3f}"}
         except RuntimeError as exc:  # draining for shutdown
-            return 503, {"error": str(exc)}, {"Retry-After": "1"}
+            obs.counter("serving.errors",
+                        labels={**self.labels, "kind": "draining"}).inc()
+            return (503, {"error": str(exc), "kind": "draining"},
+                    {"Retry-After": "1"})
         wait_s = (timeout_s if timeout_s is not None
                   else self._default_timeout_s) + DEADLINE_GRACE_S
         try:
@@ -461,6 +608,7 @@ class MatchServer:
             return (
                 503,
                 {"error": "service degraded (circuit breaker open)",
+                 "kind": "breaker_open",
                  "retry_after_s": round(exc.retry_after_s, 3)},
                 {"Retry-After": f"{exc.retry_after_s:.3f}"},
             )
@@ -472,6 +620,7 @@ class MatchServer:
             return (
                 503,
                 {"error": f"replica stopped mid-request: {exc}",
+                 "kind": "replica_dead",
                  "retry_after_s": 1.0},
                 {"Retry-After": "1"},
             )
@@ -489,9 +638,11 @@ class MatchServer:
                 None,
             )
         except Exception as exc:  # noqa: BLE001 — model failure -> 500
-            obs.counter("serving.errors", labels=self.labels).inc()
+            obs.counter("serving.errors",
+                        labels={**self.labels, "kind": "internal"}).inc()
             obs.event("request_error", error=f"{type(exc).__name__}: {exc}")
-            return 500, {"error": f"{type(exc).__name__}: {exc}"}, None
+            return (500, {"error": f"{type(exc).__name__}: {exc}",
+                          "kind": "internal"}, None)
         t_respond = time.monotonic()
         with trace.span("respond"):
             engine_timing = br.result.get("timing", {})
@@ -506,6 +657,11 @@ class MatchServer:
         respond_s = time.monotonic() - t_respond
         e2e_s = time.monotonic() - t0
         payload["latency_ms"] = round(e2e_s * 1e3, 3)
+        if decision is not None:
+            # The bench/chaos tools read this to audit which rungs a
+            # mixed load actually visited (additive key).
+            payload["qos"] = {"rung": decision.position,
+                              "degraded": decision.rung is not None}
         payload["timing"] = {
             "admit_ms": round(admit_s * 1e3, 3),
             "queue_wait_ms": round(br.queue_wait_s * 1e3, 3),
@@ -521,6 +677,14 @@ class MatchServer:
         for key, val in engine_timing.items():
             payload["timing"].setdefault(key, round(val, 3))
         obs.counter("serving.responses", labels=self.labels).inc()
+        if tenant is not None:
+            obs.counter(
+                "serving.tenant.responses",
+                labels={**self.labels, "tenant": tenant,
+                        "priority": priority}).inc()
+            obs.histogram(
+                "serving.tenant.e2e_latency_s",
+                labels={**self.labels, "tenant": tenant}).observe(e2e_s)
         # Exemplar attach: the latency histogram bucket this request
         # lands in remembers its trace_id, so a /metrics scrape links a
         # tail bucket straight to a trace (OpenMetrics exposition).
@@ -632,6 +796,44 @@ def main(argv=None):
     parser.add_argument("--no_isolate_poison", action="store_true",
                         help="disable poison-batch bisection (a failed "
                         "shared batch fails every rider)")
+    parser.add_argument(
+        "--tenant", action="append", default=[],
+        help="declare a tenant: name:priority[:rate[:burst]] "
+        "(priority in interactive|batch|best_effort; rate = sustained "
+        "admission budget in req/s, 0 = unlimited; repeatable). "
+        "Unlabeled traffic is the 'default' tenant.",
+    )
+    parser.add_argument("--default_tenant_priority", type=str,
+                        default="interactive",
+                        help="priority class for undeclared tenants")
+    parser.add_argument("--default_tenant_rate", type=float, default=0.0,
+                        help="admission budget (req/s) for undeclared "
+                        "tenants, 0 = unlimited")
+    parser.add_argument(
+        "--tenant_queue_frac", type=float, default=0.0,
+        help="cap any single tenant at this fraction of the queue "
+        "slots (per replica in fleet mode; 0 disables)",
+    )
+    parser.add_argument(
+        "--qos_ladder", type=str, default="",
+        help="quality ladder for overload degradation, best rung "
+        "first: 'c2f:factor=2,topk=32;c2f:factor=4,topk=8' "
+        "(docs/SERVING.md). Setting it enables the QoS controller.",
+    )
+    parser.add_argument("--qos", action="store_true",
+                        help="enable the QoS controller even with no "
+                        "--qos_ladder (shed-only mode: 503s walk "
+                        "priority classes bottom-first, no quality "
+                        "degradation)")
+    parser.add_argument("--qos_step_down_s", type=float, default=0.25,
+                        help="min seconds between QoS step-downs")
+    parser.add_argument("--qos_step_up_hold_s", type=float, default=5.0,
+                        help="seconds both overload signals must stay "
+                        "cool before each QoS step back up")
+    parser.add_argument("--qos_high_water", type=float, default=0.75,
+                        help="queue-depth fraction that counts as "
+                        "overload (the burst fast path; burn-rate "
+                        "paging is the steady-state signal)")
     parser.add_argument("--replicas", type=int, default=0,
                         help="serve a replica fleet: one engine per "
                         "device, least-loaded dispatch, per-replica "
@@ -706,6 +908,31 @@ def main(argv=None):
     )
     warmup_modes = tuple(
         m for m in args.warmup_modes.split(",") if m) or ("oneshot",)
+    # Multi-tenant QoS wiring (serving/qos.py): the controller's SLO /
+    # queue inputs are late-bound inside MatchServer; a declared ladder
+    # also joins the warmup set so degraded traffic never pays a cold
+    # compile mid-overload.
+    ladder = parse_ladder(args.qos_ladder) if args.qos_ladder else ()
+    qos = None
+    if args.qos or ladder:
+        qos = QosController(
+            ladder,
+            high_water_frac=args.qos_high_water,
+            step_down_interval_s=args.qos_step_down_s,
+            step_up_hold_s=args.qos_step_up_hold_s,
+        )
+    tenants = None
+    if args.tenant or args.default_tenant_rate > 0 or qos is not None:
+        tenants = TenantTable(
+            [parse_tenant_spec(s) for s in args.tenant],
+            default=TenantPolicy(DEFAULT_TENANT,
+                                 args.default_tenant_priority,
+                                 args.default_tenant_rate),
+        )
+    ladder_ops = [r.knobs() for r in ladder]
+    if ladder_ops and args.warmup and "c2f" not in warmup_modes:
+        warmup_modes = warmup_modes + ("c2f",)
+    tenant_queue_frac = args.tenant_queue_frac or None
     if args.replicas > 0:
         from .fleet import MatchFleet
 
@@ -726,6 +953,7 @@ def main(argv=None):
                 breaker_threshold=args.breaker_threshold,
                 breaker_reset_s=args.breaker_reset_s,
                 isolate_poison=not args.no_isolate_poison,
+                tenant_queue_frac=tenant_queue_frac,
             ),
         )
         print(f"fleet: {len(fleet.replicas)} replicas over "
@@ -734,7 +962,7 @@ def main(argv=None):
         if args.warmup:
             shapes, batches = _parse_warmup(args.warmup)
             n = fleet.warmup(shapes, batch_sizes=batches,
-                             modes=warmup_modes)
+                             modes=warmup_modes, c2f_ops=ladder_ops)
             print(f"warmup: {n} programs compiled (fleet-wide)",
                   file=sys.stderr, flush=True)
         if args.prewarm and fleet.store is not None:
@@ -764,7 +992,7 @@ def main(argv=None):
         if args.warmup:
             shapes, batches = _parse_warmup(args.warmup)
             n = engine.warmup(shapes, batch_sizes=batches,
-                              modes=warmup_modes)
+                              modes=warmup_modes, c2f_ops=ladder_ops)
             print(f"warmup: {n} programs compiled", file=sys.stderr,
                   flush=True)
 
@@ -791,6 +1019,9 @@ def main(argv=None):
         slo_specs=() if args.no_slo else None,
         slo_p99_target_s=args.slo_p99_ms / 1e3,
         fleet=fleet,
+        qos=qos,
+        tenants=tenants,
+        tenant_queue_frac=tenant_queue_frac,
     ).start()
     print(f"serving on {server.url}", file=sys.stderr, flush=True)
     try:

@@ -199,6 +199,102 @@ def test_chaos_serving_emits_one_json_line(tiny_serving_model, capsys):
     assert rec["duration_s"] > 0
 
 
+def test_chaos_serving_tenant_flood_contract(tiny_serving_model, capsys):
+    """tools/chaos_serving.py --tenant_flood (ISSUE 12): victim /
+    lowpri / flood tenants against a laddered server with a pinned-slow
+    device — the gate passes (victims 100% available, rung transitions
+    recorded, low-priority traffic ran degraded, no over_capacity 503
+    while a coarser rung was untried) and the JSON line carries the
+    per-tenant accounting."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import json as _json
+
+    import chaos_serving
+
+    rc = chaos_serving.main([
+        "--tenant_flood", "--synthetic", "96x128",
+        "--duration_s", "4", "--threads", "8",
+        "--max_batch", "2", "--flood_x", "10",
+    ], model=tiny_serving_model)
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = _json.loads(lines[0])
+    assert rc == 0, f"gate violations: {rec['violations']}"
+    assert rec["metric"] == "chaos_tenant_flood"
+    assert rec["unit"] == "frac"
+    assert rec["value"] == 1.0, "every victim request served"
+    assert rec["violations"] == []
+    assert rec["dropped"] == 0
+    assert rec["transitions"] >= 1, "the ladder engaged"
+    assert rec["quality_rungs"] == 2  # the default two-rung ladder
+    # Self-calibration (measured capacity -> offered load) is reported.
+    assert rec["capacity_rps"] > 0
+    assert rec["base_rate_rps"] == pytest.approx(
+        rec["capacity_rps"] / 4, rel=1e-2)
+    t = rec["tenants"]
+    assert set(t) == {"victim", "lowpri", "flood"}
+    assert t["victim"]["ok"] == t["victim"]["sent"]
+    assert (t["lowpri"]["degraded"] + t["flood"]["degraded"]) >= 1
+    # Per-tenant outcome accounting covers every scheduled request.
+    for st in t.values():
+        assert (st["ok"] + st["shed"] + st["over_capacity"]
+                + st["tenant_budget"] + st["tenant_slots"]
+                + st["breaker"] + st["errors"]) == st["sent"]
+    # An empty ladder is a usage error, not a silent no-op run.
+    with pytest.raises(SystemExit):
+        chaos_serving.main(["--tenant_flood", "--qos_ladder", ""],
+                           model=tiny_serving_model)
+
+
+def test_bench_serving_tenants_mode_contract(tiny_serving_model, capsys):
+    """tools/bench_serving.py --tenants (ISSUE 12): concurrent
+    per-tenant open-loop loads against one server, ONE JSON line with
+    per-tenant availability / p99 / rungs visited."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import json as _json
+
+    import bench_serving
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    engine.warmup([(96, 128, 96, 128)], batch_sizes=(1, 2))
+    server = MatchServer(engine, port=0, max_batch=2, max_delay_s=0.05,
+                         default_timeout_s=120.0).start()
+    try:
+        rc = bench_serving.main([
+            "--url", server.url, "--synthetic", "96x128",
+            "--duration_s", "1", "--threads", "4",
+            "--tenants", "alpha:interactive:4",
+            "--tenants", "beta:batch:2",
+        ])
+    finally:
+        server.stop()
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = _json.loads(lines[0])
+    assert rec["metric"] == "serving_tenant_mix_rps"
+    assert rec["unit"] == "req/s"
+    assert rec["value"] > 0
+    assert set(rec["tenants"]) == {"alpha", "beta"}
+    for name, expect_rate in (("alpha", 4.0), ("beta", 2.0)):
+        tr = rec["tenants"][name]
+        assert tr["rate"] == expect_rate
+        assert tr["sent"] >= 1 and tr["errors"] == 0
+        assert tr["availability"] == 1.0
+        assert tr["p99_ms"] > 0
+        assert tr["rungs_visited"] == []  # no QoS layer on this server
+        assert tr["degraded"] == 0
+    # --tenants drives ONE server over HTTP; the in-process fleet
+    # bench is a different mode.
+    with pytest.raises(SystemExit):
+        bench_serving.main(["--replicas", "2", "--synthetic", "96x128",
+                            "--tenants", "a:batch:1"])
+
+
 def test_autotune_cli_emits_one_json_line(tmp_path, capsys, monkeypatch):
     """tools/autotune_consensus.py stdout contract (ISSUE 3): run
     in-process with the fake timer (no device dial, no compiles) and a

@@ -487,7 +487,15 @@ def test_ci_gate_skips_are_recorded_not_green(capsys):
     rec = json.loads(out[0])
     assert rec["metric"] == "ci_gate" and rec["ok"] is True
     assert rec["skipped"] == ["bench_trend", "lint", "tier1"]
-    assert all(c == {"skipped": True} for c in rec["checks"].values())
+    for name, check in rec["checks"].items():
+        want = {"skipped": True}
+        if name in ci_gate.OPTIONAL_CHECKS:
+            want["optional"] = True
+        assert check == want
+    # Opt-in checks are never silently green: a default run records
+    # them as skipped AND optional.
+    assert rec["checks"]["tenant_flood"] == {
+        "skipped": True, "optional": True}
 
 
 def test_ci_gate_run_captures_failure():

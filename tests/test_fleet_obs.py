@@ -364,6 +364,33 @@ def test_slo_page_requires_both_windows_then_recovers():
     assert res["budget_remaining_frac"] > max(burned, 0.0)
 
 
+def test_slo_budget_exhaustion_clamps_at_zero():
+    """A window burned far past empty reads budget_remaining_frac ==
+    0.0 — never negative (a negative fraction reads as a telemetry bug
+    to balancer-facing consumers) — and the page persists for as long
+    as the burn stays hot."""
+    clock, reg = FakeClock(), MetricsRegistry()
+    _spec, eng = _ratio_engine(clock, reg)
+    ok, all_ = reg.counter("ok"), reg.counter("all")
+    ok.inc(10), all_.inc(10)
+    eng.evaluate()  # seed the budget train with a good baseline
+    # Sustained total outage: with a 1% budget this exhausts the
+    # 30-day allowance almost immediately, then keeps burning.
+    res = None
+    for _ in range(60):
+        all_.inc(100)
+        clock.t += 2.0
+        res = eng.evaluate()["avail"]
+        assert res["budget_remaining_frac"] >= 0.0, \
+            "budget readout must never go negative"
+    assert res["budget_remaining_frac"] == 0.0
+    assert reg.gauge("slo.avail.budget_remaining_frac").value == 0.0
+    # Burn is still hot, so the page episode is still open — exhaustion
+    # does not silence the alert.
+    assert res["paging"] and eng.paging
+    assert res["pages"] == 1, "one episode, page edge fired once"
+
+
 def test_slo_latency_threshold_mode():
     """Latency-mode 'good' = cumulative count at the largest bucket
     bound <= threshold — exact at bucket resolution."""

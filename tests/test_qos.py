@@ -1,0 +1,641 @@
+"""Multi-tenant QoS (ncnet_tpu/serving/qos.py, ISSUE 12).
+
+Three layers of coverage:
+
+* Unit — TokenBucket / TenantTable / ladder grammar / QosController
+  state machine on fake clocks: admission budgets, priority-hint
+  lowering, bounded tenant cardinality, step-down rate limiting,
+  step-up hysteresis, and bottom-priority-first shed order are pure
+  control flow and must be testable at microsecond cost.
+* Batcher — the per-tenant queue-slot cap (fairness isolation inside
+  DeadlineBatcher, scope="tenant" rejections, slot release after run).
+* CPU end-to-end — a real MatchServer with a quality ladder under
+  synthetic pressure: low-priority traffic degrades then sheds while
+  interactive traffic keeps serving; tenant budgets surface as 429s;
+  an idle QoS layer is bit-identical to the plain path (the
+  degenerate-ladder contract); draining refusals carry their kind.
+"""
+
+import threading
+
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.serving.batcher import DeadlineBatcher, RejectedError
+from ncnet_tpu.serving.qos import (
+    PRIORITY_CLASSES,
+    QosController,
+    QosDecision,
+    Rung,
+    TenantPolicy,
+    TenantTable,
+    TokenBucket,
+    parse_ladder,
+    parse_tenant_spec,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# -- token bucket ----------------------------------------------------------
+
+
+def test_token_bucket_rate_and_refill():
+    clk = FakeClock()
+    b = TokenBucket(rate=2.0, burst=2.0, clock=clk)
+    assert b.try_take() is None
+    assert b.try_take() is None
+    wait = b.try_take()
+    assert wait == pytest.approx(0.5)  # 1 token at 2/s = 0.5 s away
+    clk.t += 0.5
+    assert b.try_take() is None, "refilled token admits"
+    # Refill never exceeds burst: a long idle spell buys burst, not more.
+    clk.t += 100.0
+    assert b.try_take() is None
+    assert b.try_take() is None
+    assert b.try_take() is not None
+
+
+def test_token_bucket_unlimited_and_default_burst():
+    clk = FakeClock()
+    assert TokenBucket(0.0, clock=clk).try_take() is None
+    assert TokenBucket(-1.0, clock=clk).try_take() is None
+    # Default burst = max(rate, 1): rate 0.5 still admits one request.
+    b = TokenBucket(0.5, clock=clk)
+    assert b.try_take() is None
+    assert b.try_take() is not None
+
+
+# -- tenant specs and table ------------------------------------------------
+
+
+def test_parse_tenant_spec_grammar():
+    p = parse_tenant_spec("acme:batch")
+    assert (p.tenant, p.priority, p.rate, p.burst) == ("acme", "batch",
+                                                       0.0, 0.0)
+    p = parse_tenant_spec("acme:interactive:5:10")
+    assert (p.rate, p.burst) == (5.0, 10.0)
+    for bad in ("acme", ":batch", "a:b:c:d:e", "acme:nope",
+                "acme:batch:notanumber"):
+        with pytest.raises(ValueError):
+            parse_tenant_spec(bad)
+
+
+def test_tenant_table_resolve_and_priority_hint_only_lowers():
+    clk = FakeClock()
+    table = TenantTable([TenantPolicy("acme", "batch", rate=1.0)],
+                        clock=clk)
+    # Unlabeled traffic folds into the default (interactive) tenant.
+    name, prio, bucket = table.resolve(None)
+    assert (name, prio) == ("default", "interactive")
+    assert bucket.try_take() is None  # default rate 0 = unlimited
+    # Declared tenant gets its declared class and its own budget.
+    name, prio, bucket = table.resolve("acme")
+    assert (name, prio) == ("acme", "batch")
+    assert bucket is table.resolve("acme")[2], "bucket is stable"
+    # The hint can self-LOWER below the declared class...
+    assert table.resolve("acme", "best_effort")[1] == "best_effort"
+    # ...but never self-UPGRADE, and garbage hints are ignored.
+    assert table.resolve("acme", "interactive")[1] == "batch"
+    assert table.resolve("acme", "platinum")[1] == "batch"
+
+
+def test_tenant_table_strangers_bounded_by_overflow():
+    clk = FakeClock()
+    table = TenantTable(max_tenants=2, clock=clk)
+    assert table.resolve("x1")[0] == "x1"
+    assert table.resolve("x2")[0] == "x2"
+    # Past the bound, newcomers share one overflow identity (bounded
+    # state and metric cardinality)...
+    assert table.resolve("x3")[0] == "other"
+    assert table.resolve("x4")[0] == "other"
+    assert table.resolve("x3")[2] is table.resolve("x4")[2]
+    # ...but already-seen names and the default keep their identity.
+    assert table.resolve("x1")[0] == "x1"
+    assert table.resolve(None)[0] == "default"
+
+
+# -- quality ladder grammar ------------------------------------------------
+
+
+def test_parse_ladder_grammar():
+    ladder = parse_ladder("c2f:factor=2,topk=16; c2f:coarse_factor=4,"
+                          "topk=8,radius=1")
+    assert ladder == (Rung(2, 16), Rung(4, 8, radius=1))
+    assert ladder[0].knobs() == {"coarse_factor": 2, "topk": 16}
+    assert ladder[1].knobs() == {"coarse_factor": 4, "topk": 8,
+                                 "radius": 1}
+    assert parse_ladder("") == ()
+    assert parse_ladder(" ; ") == ()
+    for bad in ("oneshot:factor=2", "c2f:topk=8", "c2f:factor=2",
+                "c2f:factor=x,topk=8", "c2f:factor=2,topk=8,zoom=3"):
+        with pytest.raises(ValueError):
+            parse_ladder(bad)
+
+
+def test_rung_validation():
+    with pytest.raises(ValueError):
+        Rung(0, 8)
+    with pytest.raises(ValueError):
+        Rung(2, 8, radius=-1)
+
+
+def test_qos_decision_apply_rewrites_request():
+    req = {"query_b64": "x", "pano_b64": "y"}
+    assert QosDecision(position=0).apply(dict(req)) == req, "rung 0 no-op"
+    out = QosDecision(position=2, rung_index=2,
+                      rung=Rung(4, 8)).apply(dict(req))
+    assert out["mode"] == "c2f"
+    assert out["c2f"] == {"coarse_factor": 4, "topk": 8}
+
+
+# -- controller state machine ----------------------------------------------
+
+
+def make_controller(clk, ladder=parse_ladder("c2f:factor=2,topk=16;"
+                                             "c2f:factor=4,topk=8"),
+                    **kw):
+    depth = {"d": 0}
+    kw.setdefault("step_down_interval_s", 1.0)
+    kw.setdefault("step_up_hold_s", 5.0)
+    ctl = QosController(ladder, depth_fn=lambda: depth["d"], max_queue=10,
+                        high_water_frac=0.5, clock=clk, **kw)
+    return ctl, depth
+
+
+def test_controller_steps_down_rate_limited_on_queue_pressure():
+    clk = FakeClock()
+    ctl, depth = make_controller(clk)
+    assert ctl.update() == 0, "no pressure, no transition"
+    depth["d"] = 5  # == high_water_frac * max_queue
+    assert ctl.update() == 1
+    assert ctl.update() == 1, "rate-limited: one step per interval"
+    clk.t += 1.0
+    assert ctl.update() == 2
+    assert ctl.transitions == 2
+    assert obs.gauge("serving.qos.rung").value == 2.0
+    assert obs.counter("serving.qos.transitions").value == 2.0
+    # Pressure forever still bottoms out at max_position.
+    for _ in range(10):
+        clk.t += 1.0
+        ctl.update()
+    assert ctl.position == ctl.max_position == 2 + len(PRIORITY_CLASSES)
+    events = [r for r in obs.flight.recorder().snapshot()
+              if r.get("event") == "qos_transition"]
+    assert events and events[0]["reason"] == "queue"
+    assert (events[0]["rung_from"], events[0]["rung_to"]) == (0, 1)
+
+
+def test_controller_burn_signal_steps_down():
+    class StubSlo:
+        paging = False
+
+        def maybe_evaluate(self):
+            return {"availability": {"paging": self.paging}}
+
+    clk = FakeClock()
+    slo = StubSlo()
+    ctl = QosController(parse_ladder("c2f:factor=2,topk=8"), slo=slo,
+                        clock=clk, step_down_interval_s=1.0)
+    assert ctl.update() == 0
+    slo.paging = True
+    assert ctl.update() == 1
+    events = [r for r in obs.flight.recorder().snapshot()
+              if r.get("event") == "qos_transition"]
+    assert events[-1]["reason"] == "burn"
+
+
+def test_controller_recovery_hysteresis_rearms_per_step():
+    clk = FakeClock()
+    ctl, depth = make_controller(clk)
+    depth["d"] = 10
+    for _ in range(3):
+        ctl.update()
+        clk.t += 1.0
+    assert ctl.position == 3
+    depth["d"] = 0
+    ctl.update()  # arms the cool timer, no step yet
+    assert ctl.position == 3
+    clk.t += 4.9
+    assert ctl.update() == 3, "hold not yet satisfied"
+    clk.t += 0.2
+    assert ctl.update() == 2, "sustained cool steps up ONE"
+    assert ctl.update() == 2, "hold re-arms per step (no free-fall up)"
+    clk.t += 5.1
+    assert ctl.update() == 1
+    # A pressure blip during recovery resets the cool timer.
+    depth["d"] = 10
+    clk.t += 1.0
+    assert ctl.update() == 2
+    depth["d"] = 0
+    clk.t += 4.0
+    assert ctl.update() == 2, "cool restarted by the blip"
+
+
+def test_controller_resolve_shed_order_bottom_priority_first():
+    clk = FakeClock()
+    ladder = parse_ladder("c2f:factor=2,topk=16;c2f:factor=4,topk=8")
+    ctl, depth = make_controller(clk, ladder=ladder)
+    n = len(ladder)
+
+    def verdicts():
+        return {p: ctl.resolve(p) for p in PRIORITY_CLASSES}
+
+    # Position 0: everyone runs as requested.
+    assert all(d.rung is None and not d.shed
+               for d in verdicts().values())
+    depth["d"] = 10
+    ctl.update()  # pos 1
+    v = verdicts()
+    assert v["interactive"].rung is None, "interactive never degraded"
+    assert v["batch"].rung == ladder[0] and not v["batch"].shed
+    assert v["best_effort"].rung == ladder[0]
+    clk.t += 1.0
+    ctl.update()  # pos 2 = last quality rung
+    v = verdicts()
+    assert v["batch"].rung == ladder[1]
+    clk.t += 1.0
+    ctl.update()  # pos n+1: shed best_effort only
+    v = verdicts()
+    assert v["best_effort"].shed
+    assert v["batch"].rung == ladder[1] and not v["batch"].shed
+    assert v["interactive"].rung is None and not v["interactive"].shed
+    assert ctl.snapshot()["shedding"] == ["best_effort"]
+    clk.t += 1.0
+    ctl.update()  # pos n+2: shed batch too
+    v = verdicts()
+    assert v["batch"].shed and v["best_effort"].shed
+    assert not v["interactive"].shed
+    clk.t += 1.0
+    ctl.update()  # pos n+3 = the LAST rung: interactive sheds
+    v = verdicts()
+    assert all(d.shed for d in v.values())
+    assert ctl.position == ctl.max_position == n + 3
+    assert ctl.snapshot()["shedding"] == list(PRIORITY_CLASSES)
+    assert ctl.snapshot()["shed_total"] >= 4
+    # Unknown priority strings resolve as the lowest class.
+    assert ctl.resolve("platinum").shed
+
+
+def test_controller_degenerate_empty_ladder_sheds_only():
+    clk = FakeClock()
+    ctl, depth = make_controller(clk, ladder=())
+    assert ctl.max_position == len(PRIORITY_CLASSES)
+    depth["d"] = 10
+    ctl.update()
+    assert ctl.resolve("best_effort").shed
+    d = ctl.resolve("batch")
+    assert d.rung is None and not d.shed, "no ladder = no degradation"
+    assert ctl.snapshot()["quality_rungs"] == 0
+
+
+# -- batcher per-tenant queue slots ----------------------------------------
+
+
+def echo_runner(calls):
+    def runner(bucket_key, payloads):
+        calls.append((bucket_key, list(payloads)))
+        return [f"r:{p}" for p in payloads]
+
+    return runner
+
+
+def test_batcher_tenant_slot_cap_and_release():
+    clk, calls = FakeClock(), []
+    b = DeadlineBatcher(echo_runner(calls), clock=clk, max_batch=8,
+                        max_queue=8, max_delay_s=0.05,
+                        tenant_queue_frac=0.25)
+    # cap = max(1, int(8 * 0.25)) = 2 slots per tenant.
+    f1 = b.submit("a", "p1", tenant="loud")
+    f2 = b.submit("a", "p2", tenant="loud")
+    with pytest.raises(RejectedError) as ei:
+        b.submit("a", "p3", tenant="loud")
+    assert ei.value.scope == "tenant"
+    assert ei.value.retry_after_s > 0
+    assert obs.counter("serving.tenant.rejected",
+                       labels={"tenant": "loud"}).value == 1.0
+    # Other tenants and untagged riders are untouched by loud's cap.
+    f3 = b.submit("a", "q1", tenant="quiet")
+    f4 = b.submit("a", "n1")
+    # The run releases the slots: loud can queue again afterwards.
+    clk.t += 0.06
+    assert b.poll() == 1
+    assert b._tenant_pending == {}
+    f5 = b.submit("a", "p3", tenant="loud")
+    clk.t += 0.06
+    assert b.poll() == 1
+    for f in (f1, f2, f3, f4, f5):
+        assert f.result(0).result.startswith("r:")
+
+
+def test_batcher_queue_full_rejection_keeps_queue_scope():
+    clk, calls = FakeClock(), []
+    b = DeadlineBatcher(echo_runner(calls), clock=clk, max_batch=4,
+                        max_queue=1, max_delay_s=0.05,
+                        tenant_queue_frac=0.5)
+    b.submit("a", "p1", tenant="t")
+    with pytest.raises(RejectedError) as ei:
+        b.submit("a", "p2", tenant="t")
+    assert ei.value.scope == "queue", "capacity rejection, not fairness"
+
+
+def test_batcher_tenant_frac_validation():
+    with pytest.raises(ValueError):
+        DeadlineBatcher(lambda k, p: p, tenant_queue_frac=0.0)
+    with pytest.raises(ValueError):
+        DeadlineBatcher(lambda k, p: p, tenant_queue_frac=1.5)
+
+
+# -- engine: per-op c2f knob parsing ---------------------------------------
+
+
+def _jpeg_b64(h, w, seed):
+    import base64
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    img = Image.fromarray(
+        rng.randint(0, 255, size=(h, w, 3), dtype="uint8"))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def test_engine_prepare_c2f_knobs_and_bucket_keys(tiny_serving_model):
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    req = {"query_b64": _jpeg_b64(96, 128, 0),
+           "pano_b64": _jpeg_b64(96, 128, 1)}
+    # Default-op requests (no knobs, or knobs equal to the config)
+    # keep the pre-QoS 3-tuple bucket key — warmups and logs unchanged.
+    p0 = engine.prepare(dict(req, mode="c2f"))
+    assert len(p0.bucket_key) == 3 and p0.c2f_op is None
+    pd = engine.prepare(dict(req, mode="c2f", c2f={}))
+    assert len(pd.bucket_key) == 3 and pd.c2f_op is None
+    # A non-default operating point extends the key with its op tuple.
+    factor = int(config.c2f_coarse_factor) * 2
+    p1 = engine.prepare(dict(req, mode="c2f",
+                             c2f={"coarse_factor": factor, "topk": 8}))
+    assert p1.c2f_op == (factor, 8, int(config.c2f_radius))
+    assert len(p1.bucket_key) == 4 and p1.bucket_key[3] == p1.c2f_op
+    # Malformed knob payloads are 400-class ValueErrors, not 500s.
+    with pytest.raises(ValueError, match="require mode='c2f'"):
+        engine.prepare(dict(req, c2f={"topk": 8}))
+    with pytest.raises(ValueError, match="JSON object"):
+        engine.prepare(dict(req, mode="c2f", c2f=[8]))
+    with pytest.raises(ValueError, match="unknown c2f knobs"):
+        engine.prepare(dict(req, mode="c2f", c2f={"zoom": 2}))
+    with pytest.raises(ValueError, match="integers"):
+        engine.prepare(dict(req, mode="c2f", c2f={"topk": "lots"}))
+
+
+# -- end-to-end over HTTP --------------------------------------------------
+
+
+class _QuietSlo:
+    """Stub SLO feed: never paging. The e2e tests drive the controller
+    from queue pressure alone — the server's real SloEngine would page
+    on first-compile latency (seconds against a 0.5 s p99 target) and
+    correctly pin the ladder down, which is the behavior under test in
+    the chaos gate, not here."""
+
+    def maybe_evaluate(self):
+        return {}
+
+
+def _start_server(engine, **kw):
+    from ncnet_tpu.serving.server import MatchServer
+
+    kw.setdefault("port", 0)
+    kw.setdefault("max_batch", 1)
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("max_delay_s", 0.01)
+    kw.setdefault("default_timeout_s", 300.0)
+    return MatchServer(engine, **kw).start()
+
+
+def _client(url, **kw):
+    from ncnet_tpu.serving.client import MatchClient
+
+    kw.setdefault("timeout_s", 600.0)
+    kw.setdefault("retries", 0)
+    return MatchClient(url, **kw)
+
+
+def test_serving_e2e_qos_degrade_then_shed_then_recover(
+        tiny_serving_model):
+    """The tentpole contract end to end: under pressure low-priority
+    traffic first runs degraded, then sheds bottom-first; interactive
+    keeps serving until the very last position; recovery climbs back
+    to rung 0."""
+    from ncnet_tpu.serving.client import OverCapacityError
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    pressure = {"on": True}
+    ladder = parse_ladder("c2f:factor=2,topk=8")
+    qos = QosController(
+        ladder,
+        slo=_QuietSlo(),
+        depth_fn=lambda: 100 if pressure["on"] else 0,
+        max_queue=10,
+        step_down_interval_s=0.0,  # one step per request, deterministic
+        step_up_hold_s=0.05,
+    )
+    tenants = TenantTable([TenantPolicy("victim", "interactive"),
+                           TenantPolicy("lowpri", "best_effort")])
+    server = _start_server(engine, qos=qos, tenants=tenants)
+    try:
+        client = _client(server.url)
+        qb = _jpeg_b64(96, 128, 0)
+        pano = _jpeg_b64(96, 128, 1)
+        import base64
+
+        kwargs = dict(query_bytes=base64.b64decode(qb),
+                      pano_bytes=base64.b64decode(pano), max_matches=8)
+        # Request 1 (pos 0 -> 1): lowpri runs, but degraded onto rung 1.
+        r1 = client.match(tenant="lowpri", **kwargs)
+        assert r1["qos"] == {"rung": 1, "degraded": True}
+        assert r1["n_matches"] >= 1
+        # Request 2 (pos 2 = quality rungs exhausted): best_effort sheds.
+        with pytest.raises(OverCapacityError) as ei:
+            client.match(tenant="lowpri", **kwargs)
+        assert ei.value.status == 503
+        assert ei.value.payload["kind"] == "shed"
+        assert ei.value.payload["qos_rung"] == 2
+        # Request 3 (pos 3, batch shed too): interactive still serves.
+        r3 = client.match(tenant="victim", **kwargs)
+        assert r3["qos"] == {"rung": 3, "degraded": False}
+        # Request 4 (pos 4 = the LAST position): even interactive sheds
+        # — 503 + Retry-After really is the bottom of the ladder.
+        with pytest.raises(OverCapacityError) as ei:
+            client.match(tenant="victim", **kwargs)
+        assert ei.value.payload["kind"] == "shed"
+        assert qos.position == qos.max_position == 4
+        health = client.healthz()
+        assert health["qos"]["rung"] == 4
+        assert health["qos"]["shedding"] == list(PRIORITY_CLASSES)
+        assert obs.counter("serving.qos.degraded").value >= 1.0
+        assert obs.counter(
+            "serving.qos.shed",
+            labels={"priority": "best_effort"}).value >= 1.0
+        assert obs.counter(
+            "serving.tenant.shed", labels={"tenant": "victim"}).value \
+            == 1.0
+        assert obs.counter(
+            "serving.tenant.requests",
+            labels={"tenant": "lowpri",
+                    "priority": "best_effort"}).value == 2.0
+        # Recovery: pressure off, hysteresis climbs back to rung 0.
+        pressure["on"] = False
+        import time
+
+        deadline = time.monotonic() + 30.0
+        while client.healthz()["qos"]["rung"] > 0:
+            assert time.monotonic() < deadline, "never recovered"
+            time.sleep(0.06)
+        r5 = client.match(tenant="lowpri", **kwargs)
+        assert r5["qos"] == {"rung": 0, "degraded": False}
+    finally:
+        server.stop()
+
+
+def test_serving_e2e_tenant_budget_429(tiny_serving_model):
+    """A tenant over its admission budget gets 429 + Retry-After with
+    kind=tenant_budget — its own limit, not service pressure."""
+    from ncnet_tpu.serving.client import OverCapacityError
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    tenants = TenantTable([
+        TenantPolicy("capped", "interactive", rate=0.01, burst=1.0)])
+    server = _start_server(engine, tenants=tenants)
+    try:
+        client = _client(server.url)
+        import base64
+
+        kwargs = dict(query_bytes=base64.b64decode(_jpeg_b64(96, 128, 0)),
+                      pano_bytes=base64.b64decode(_jpeg_b64(96, 128, 1)))
+        r = client.match(tenant="capped", **kwargs)
+        assert r["n_matches"] >= 1
+        assert "qos" not in r, "no controller, no qos block"
+        with pytest.raises(OverCapacityError) as ei:
+            client.match(tenant="capped", **kwargs)
+        assert ei.value.status == 429
+        assert ei.value.payload["kind"] == "tenant_budget"
+        assert ei.value.payload["tenant"] == "capped"
+        assert obs.counter("serving.tenant.throttled",
+                           labels={"tenant": "capped"}).value == 1.0
+        # Unlabeled traffic is accounted as the default tenant and is
+        # not touched by capped's budget.
+        r = client.match(**kwargs)
+        assert r["n_matches"] >= 1
+        assert obs.counter(
+            "serving.tenant.requests",
+            labels={"tenant": "default",
+                    "priority": "interactive"}).value == 1.0
+    finally:
+        server.stop()
+
+
+def test_serving_e2e_qos_idle_is_bit_identical(tiny_serving_model):
+    """The degenerate-ladder contract: a QoS layer that never engages
+    (controller pinned at rung 0) serves bit-identical matches to the
+    plain admission path."""
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    import base64
+
+    kwargs = dict(query_bytes=base64.b64decode(_jpeg_b64(96, 128, 0)),
+                  pano_bytes=base64.b64decode(_jpeg_b64(96, 128, 1)),
+                  max_matches=8)
+    plain = _start_server(engine)
+    try:
+        r_plain = _client(plain.url).match(**kwargs)
+    finally:
+        plain.stop()
+    qos = QosController(parse_ladder("c2f:factor=2,topk=8"),
+                        slo=_QuietSlo(), depth_fn=lambda: 0,
+                        max_queue=16)
+    servered = _start_server(engine, qos=qos)
+    try:
+        r_qos = _client(servered.url).match(**kwargs)
+    finally:
+        servered.stop()
+    assert r_qos["qos"] == {"rung": 0, "degraded": False}
+    assert r_qos["matches"] == r_plain["matches"]
+    assert r_qos["n_matches"] == r_plain["n_matches"]
+
+
+def test_serving_e2e_draining_503_carries_kind(tiny_serving_model):
+    """The shutdown drain window refuses with kind=draining and counts
+    a labeled serving.errors increment — not a bare unexplained 503."""
+    from ncnet_tpu.serving.client import OverCapacityError
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    server = _start_server(engine)
+    try:
+        client = _client(server.url)
+        import base64
+
+        kwargs = dict(query_bytes=base64.b64decode(_jpeg_b64(96, 128, 0)),
+                      pano_bytes=base64.b64decode(_jpeg_b64(96, 128, 1)))
+        assert client.match(**kwargs)["n_matches"] >= 1
+        # Close admission while HTTP still serves — the drain window.
+        server.batcher.close()
+        with pytest.raises(OverCapacityError) as ei:
+            client.match(**kwargs)
+        assert ei.value.status == 503
+        assert ei.value.payload["kind"] == "draining"
+        assert obs.counter("serving.errors",
+                           labels={"kind": "draining"}).value == 1.0
+    finally:
+        server.stop()
+
+
+def test_qos_threaded_update_and_resolve_are_safe():
+    """Smoke the controller's locking: concurrent update/resolve from
+    many threads never crashes and lands on a valid position."""
+    clk = FakeClock()
+    ctl, depth = make_controller(clk, step_down_interval_s=0.0)
+    depth["d"] = 10
+    errs = []
+
+    def hammer():
+        try:
+            for _ in range(200):
+                ctl.update()
+                ctl.resolve("batch")
+                ctl.snapshot()
+        except Exception as exc:  # noqa: BLE001 — the assertion surface
+            errs.append(exc)
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert 0 <= ctl.position <= ctl.max_position

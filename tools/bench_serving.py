@@ -104,8 +104,9 @@ def run_load(client, kwargs, rate, duration_s, threads):
     n_requests = max(1, int(rate * duration_s))
     lock = threading.Lock()
     lat_ms, batch_sizes = [], []
-    counts = {"sent": 0, "ok": 0, "rejected": 0, "errors": 0,
-              "deadline_exceeded": 0}
+    rungs, degraded = set(), [0]
+    counts = {"sent": 0, "ok": 0, "rejected": 0, "throttled": 0,
+              "errors": 0, "deadline_exceeded": 0}
     # A schedule index handed out under the lock keeps workers from
     # coordinating on anything but the wall clock.
     sched = {"next": 0}
@@ -125,10 +126,19 @@ def run_load(client, kwargs, rate, duration_s, threads):
             t_req = time.monotonic()
             try:
                 resp = client.match(**kwargs)
-            except OverCapacityError:
+            except OverCapacityError as exc:
+                # Tenant-scoped refusals (429 tenant_budget /
+                # tenant_slots) are this tenant throttling at its OWN
+                # limits, not service pressure — split them out so a
+                # mixed-tenant report doesn't read fairness isolation
+                # as an availability problem.
+                kind = (exc.payload or {}).get("kind") \
+                    if isinstance(exc.payload, dict) else None
                 with lock:
                     counts["sent"] += 1
-                    counts["rejected"] += 1
+                    counts["throttled" if kind in
+                           ("tenant_budget", "tenant_slots")
+                           else "rejected"] += 1
                 continue
             except (ServingError, OSError) as exc:
                 # 504 = the server's DeadlineBatcher gave up honestly;
@@ -146,6 +156,11 @@ def run_load(client, kwargs, rate, duration_s, threads):
                 counts["ok"] += 1
                 lat_ms.append(dt_ms)
                 batch_sizes.append(resp.get("batch_size", 1))
+                qv = resp.get("qos")
+                if qv:  # QoS-enabled server: audit the rungs visited
+                    rungs.add(int(qv.get("rung", 0)))
+                    if qv.get("degraded"):
+                        degraded[0] += 1
 
     workers = [
         threading.Thread(target=worker, daemon=True)
@@ -160,7 +175,80 @@ def run_load(client, kwargs, rate, duration_s, threads):
     lat_ms.sort()
     return {"counts": counts, "lat_ms": lat_ms,
             "batch_sizes": batch_sizes,
+            "rungs": sorted(rungs), "degraded": degraded[0],
             "elapsed": time.monotonic() - t0, "n_requests": n_requests}
+
+
+def tenants_bench(args, kwargs):
+    """Mixed multi-tenant load against one server (``--tenants``).
+
+    Each ``name:priority:rate`` spec drives its own open-loop load with
+    that tenant's headers, all concurrently; the report is per-tenant
+    availability / p99 / rungs visited — the client-side audit of the
+    server's QoS ladder (docs/SERVING.md, multi-tenant QoS).
+    """
+    from ncnet_tpu.serving.client import MatchClient
+
+    specs = []
+    for s in args.tenants:
+        parts = s.split(":")
+        if len(parts) != 3:
+            raise SystemExit(
+                f"bad --tenants spec {s!r} (want name:priority:rate)")
+        specs.append((parts[0], parts[1], float(parts[2])))
+
+    results = {}
+    lock = threading.Lock()
+
+    def run_one(name, priority, rate):
+        client = MatchClient(args.url, retries=0 if args.no_retry else 2)
+        kw = dict(kwargs, tenant=name, priority=priority)
+        res = run_load(client, kw, rate, args.duration_s, args.threads)
+        with lock:
+            results[name] = res
+
+    drivers = [threading.Thread(target=run_one, args=spec, daemon=True)
+               for spec in specs]
+    for t in drivers:
+        t.start()
+    for t in drivers:
+        t.join()
+
+    per_tenant = {}
+    total_ok, elapsed = 0, 0.0
+    for name, priority, rate in specs:
+        res = results[name]
+        counts, lat = res["counts"], res["lat_ms"]
+        answered = (counts["ok"] + counts["errors"]
+                    + counts["deadline_exceeded"])
+        per_tenant[name] = {
+            "priority": priority,
+            "rate": rate,
+            "sent": counts["sent"],
+            "ok": counts["ok"],
+            "rejected": counts["rejected"],
+            "throttled": counts["throttled"],
+            "errors": counts["errors"],
+            "deadline_exceeded": counts["deadline_exceeded"],
+            "availability": round(counts["ok"] / answered, 6)
+            if answered else None,
+            "p50_ms": round(percentile(lat, 50), 3) if lat else None,
+            "p99_ms": round(percentile(lat, 99), 3) if lat else None,
+            "rungs_visited": res["rungs"],
+            "degraded": res["degraded"],
+        }
+        total_ok += counts["ok"]
+        elapsed = max(elapsed, res["elapsed"])
+    rec = {
+        "metric": "serving_tenant_mix_rps",
+        "value": round(total_ok / elapsed, 4) if elapsed > 0 else 0.0,
+        "unit": "req/s",
+        "tenants": per_tenant,
+        "duration_s": round(elapsed, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    errors = sum(results[n]["counts"]["errors"] for n, _, _ in specs)
+    return 0 if errors == 0 else 1
 
 
 def fleet_bench(args, model=None):
@@ -318,6 +406,13 @@ def main(argv=None, model=None):
     parser.add_argument("--max_matches", type=int, default=16)
     parser.add_argument("--no_retry", action="store_true",
                         help="count 503s as rejected instead of retrying")
+    parser.add_argument(
+        "--tenants", action="append", default=[],
+        help="mixed-load mode (with --url): drive one open-loop load "
+        "per name:priority:rate spec, each with its tenant headers, "
+        "all concurrently; reports per-tenant availability/p99 and "
+        "the QoS rungs visited (repeatable)",
+    )
     parser.add_argument("--slo_availability", type=float, default=0.999,
                         help="availability objective for the SLO summary")
     parser.add_argument("--slo_p99_ms", type=float, default=0.0,
@@ -328,6 +423,9 @@ def main(argv=None, model=None):
     args = parser.parse_args(argv)
     if bool(args.url) == bool(args.replicas > 0):
         parser.error("pass exactly one of --url or --replicas N")
+    if args.tenants and args.replicas > 0:
+        parser.error("--tenants is a --url mode (it drives one "
+                     "already-running server)")
     if bool(args.synthetic) == bool(args.query and args.pano):
         parser.error("pass either --synthetic HxW or both --query/--pano")
 
@@ -353,6 +451,9 @@ def main(argv=None, model=None):
         kwargs.update(query_bytes=q_bytes, pano_bytes=p_bytes)
     else:
         kwargs.update(query_path=args.query, pano_path=args.pano)
+
+    if args.tenants:
+        return tenants_bench(args, kwargs)
 
     client = MatchClient(args.url, retries=0 if args.no_retry else 2)
     health = client.healthz()

@@ -35,11 +35,44 @@ tests/test_bench_contract.py)::
 come back as ok / rejected / poison / error — anything unaccounted for
 is a hung or vanished request, and the exit code is nonzero.
 Stage notes go to stderr.
+
+``--tenant_flood`` runs the multi-tenant QoS contract instead
+(docs/RELIABILITY.md, degradation before refusal): three tenants —
+``victim`` (interactive), ``lowpri`` (batch), ``flood`` (best_effort,
+bursting at ``--flood_x`` times the base rate) — against a server with
+a declared quality ladder and a deliberately slowed device
+(``engine.device`` delay failpoint pins a capacity floor). The verb
+SELF-CALIBRATES: after warmup it times one batch through the armed
+delay failpoint and derives the base (victim/lowpri) rate as a
+quarter of the measured capacity, and the rung step-down interval as
+the time the device needs to drain two tenants' queue slots. Absolute
+rates make the gate flaky — a load that is a gentle nudge on a TPU is
+an unwinnable 10x overload on a laptop CPU, and an unwinnable
+overload ends with the controller correctly shedding the victim.
+``--qos_base_rate`` overrides the calibration. The gate FAILS
+(nonzero exit) if:
+
+* any ``victim`` request gets anything but a 200 (availability is the
+  thing being protected);
+* the QoS controller records no rung transition (the ladder never
+  engaged — the scenario proved nothing);
+* low-priority traffic never ran degraded (the ladder was skipped);
+* any ``over_capacity`` 503 was served while a coarser quality rung
+  was still untried (``qos_rung`` < the ladder length — refusal
+  before degradation, the contract violation this verb exists to
+  catch). Tenant-scoped 429s (``tenant_budget`` / ``tenant_slots``)
+  are the flood throttling at its OWN limits and are exempt, as are
+  breaker/replica-death 503s (device failure, not load shedding).
+
+Prints ONE JSON line: ``{"metric": "chaos_tenant_flood", "value":
+<victim availability frac>, ...}`` with per-tenant outcome counts,
+rungs visited, transition counts, and the violation list.
 """
 
 from __future__ import annotations
 
 import argparse
+import base64
 import json
 import os
 import sys
@@ -57,6 +90,256 @@ def parse_fault_window(spec):
     start_s, _, end_s = window.partition("-")
     site = term.partition("=")[0].strip()
     return term.strip(), site, float(start_s), float(end_s)
+
+
+def run_tenant_flood(args, model=None):
+    """The multi-tenant QoS chaos contract (module docstring)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from ncnet_tpu import obs
+    from ncnet_tpu.reliability import failpoints
+    from ncnet_tpu.serving.client import (
+        MatchClient,
+        OverCapacityError,
+        PoisonRequestError,
+        ServingError,
+    )
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.qos import (
+        QosController,
+        TenantPolicy,
+        TenantTable,
+        parse_ladder,
+    )
+    from ncnet_tpu.serving.server import MatchServer
+
+    run_log = None
+    if args.run_log:
+        run_log = obs.init_run("chaos_serving", args.run_log, args=args)
+    if model is None:
+        from ncnet_tpu.cli.common import build_model
+
+        note("building tiny model (pass model= to reuse one in-process)")
+        model = build_model(
+            ncons_kernel_sizes=(3, 3),
+            ncons_channels=(16, 1),
+            relocalization_k_size=2,
+            half_precision=True,
+            backbone_bf16=True,
+        )
+    config, params = model
+    h, w = (int(v) for v in args.synthetic.split("x"))
+    ladder = parse_ladder(args.qos_ladder)
+    if not ladder:
+        raise SystemExit("--tenant_flood needs a non-empty --qos_ladder")
+    engine = MatchEngine(config, params, k_size=2,
+                         image_size=args.image_size, cache_mb=0)
+    warm_batches = sorted({1, max(1, args.max_batch // 2), args.max_batch})
+    # Warm every ladder rung too: the contract measures the QoS
+    # machinery, not cold XLA compiles racing the flood.
+    engine.warmup([(h, w, h, w)], batch_sizes=warm_batches,
+                  modes=("oneshot", "c2f"),
+                  c2f_ops=[r.knobs() for r in ladder])
+    # Pin a device-capacity floor: a fixed per-batch delay keeps "the
+    # flood outruns the device" true even on fast hosts.
+    failpoints.configure(
+        f"engine.device=delay:{args.device_delay_ms:g}ms")
+    q_bytes, p_bytes = synth_jpegs(args.synthetic)
+    # Calibrate (docstring): time a warmed batch THROUGH the armed
+    # delay failpoint and size the offered load off what this host can
+    # actually serve, so the overload is winnable by shedding the
+    # flood — never so deep that protecting the victim is impossible.
+    cal_req = {
+        "query_b64": base64.b64encode(q_bytes).decode("ascii"),
+        "pano_b64": base64.b64encode(p_bytes).decode("ascii"),
+        "max_matches": 8,
+    }
+    cal = [engine.prepare(dict(cal_req)) for _ in range(args.max_batch)]
+    t_cal = time.monotonic()
+    for _ in range(2):
+        engine.run_batch(cal[0].bucket_key, cal)
+    t_batch = max((time.monotonic() - t_cal) / 2.0, 1e-3)
+    capacity = args.max_batch / t_batch
+    base_rate = args.qos_base_rate or capacity / 4.0
+    slot_cap = max(1, int(args.max_queue * args.tenant_queue_frac))
+    # One tenant's already-admitted queue slots must drain before the
+    # controller may take another step, or backlog the shed can't
+    # cancel ratchets the rung straight past the relief it just
+    # engaged and into shedding higher priorities.
+    step_down_s = max(args.qos_step_down_s, 2.0 * slot_cap / capacity)
+    qos = QosController(
+        ladder,
+        high_water_frac=args.qos_high_water,
+        step_down_interval_s=step_down_s,
+        step_up_hold_s=args.qos_step_up_hold_s,
+    )
+    tenants = TenantTable([
+        TenantPolicy("victim", "interactive"),
+        TenantPolicy("lowpri", "batch"),
+        TenantPolicy("flood", "best_effort", rate=args.flood_budget_rps),
+    ])
+    transitions0 = obs.counter("serving.qos.transitions").value
+    server = MatchServer(
+        engine, port=0,
+        max_batch=args.max_batch,
+        max_queue=args.max_queue,
+        max_delay_s=args.max_delay_ms / 1e3,
+        default_timeout_s=max(args.duration_s * 4, 60.0),
+        isolate_poison=not args.no_isolate_poison,
+        run_log=run_log,
+        qos=qos,
+        tenants=tenants,
+        tenant_queue_frac=args.tenant_queue_frac,
+    ).start()
+    note(f"serving on {server.url}; ladder={args.qos_ladder!r} "
+         f"flood={args.flood_x:g}x device_delay={args.device_delay_ms:g}ms "
+         f"capacity={capacity:.2f}rps base_rate={base_rate:.2f}rps "
+         f"step_down={step_down_s:.2f}s")
+
+    kwargs = {"query_bytes": q_bytes, "pano_bytes": p_bytes,
+              "max_matches": 8}
+    n_quality = len(ladder)
+    t0 = time.monotonic()
+    lock = threading.Lock()
+    stats = {
+        name: {"sent": 0, "ok": 0, "degraded": 0, "shed": 0,
+               "over_capacity": 0, "tenant_budget": 0, "tenant_slots": 0,
+               "breaker": 0, "errors": 0, "rungs": set(), "lat_ms": []}
+        for name in ("victim", "lowpri", "flood")
+    }
+    violations = []
+
+    def account(name, status, payload):
+        """Classify one response under the gate's rules (caller holds
+        ``lock``)."""
+        st = stats[name]
+        st["sent"] += 1
+        if status == 200:
+            st["ok"] += 1
+            qv = (payload or {}).get("qos") or {}
+            st["rungs"].add(int(qv.get("rung", 0)))
+            if qv.get("degraded"):
+                st["degraded"] += 1
+            return
+        kind = (payload or {}).get("kind") if isinstance(payload, dict) \
+            else None
+        if kind == "shed":
+            st["shed"] += 1
+        elif kind == "over_capacity":
+            st["over_capacity"] += 1
+            rung = (payload or {}).get("qos_rung", 0)
+            if rung < n_quality:
+                violations.append(
+                    f"over_capacity 503 to {name} at rung {rung} "
+                    f"with {n_quality - rung} coarser rung(s) untried")
+        elif kind in ("tenant_budget", "tenant_slots"):
+            st[kind] += 1
+        elif kind in ("breaker_open", "replica_dead"):
+            st["breaker"] += 1
+        else:
+            st["errors"] += 1
+        if name == "victim":
+            violations.append(
+                f"victim got {status} kind={kind} (availability)")
+
+    def drive(name, rate, n_requests, retries=0):
+        client = MatchClient(
+            server.url, timeout_s=max(args.duration_s * 4, 60.0),
+            retries=retries)
+        sched = {"next": 0}
+
+        def worker():
+            while True:
+                with lock:
+                    i = sched["next"]
+                    if i >= n_requests:
+                        return
+                    sched["next"] = i + 1
+                due = t0 + i / rate
+                delay = due - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                t_req = time.monotonic()
+                try:
+                    payload = client.match(tenant=name, **kwargs)
+                    status = 200
+                except (OverCapacityError, PoisonRequestError,
+                        ServingError) as exc:
+                    payload, status = exc.payload, exc.status
+                except OSError as exc:
+                    with lock:
+                        stats[name]["sent"] += 1
+                        stats[name]["errors"] += 1
+                        violations.append(f"{name} transport error: {exc}")
+                    continue
+                with lock:
+                    account(name, status, payload)
+                    if status == 200:
+                        stats[name]["lat_ms"].append(
+                            (time.monotonic() - t_req) * 1e3)
+
+        n_threads = max(4, min(args.threads, n_requests))
+        return [threading.Thread(target=worker, daemon=True)
+                for _ in range(n_threads)], n_requests
+
+    plans = [
+        drive("victim", base_rate,
+              max(1, int(base_rate * args.duration_s))),
+        drive("lowpri", base_rate,
+              max(1, int(base_rate * args.duration_s))),
+        drive("flood", base_rate * args.flood_x,
+              max(1, int(base_rate * args.flood_x * args.duration_s))),
+    ]
+    threads = [t for ts, _ in plans for t in ts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    failpoints.clear()
+    qos_snap = qos.snapshot()
+    transitions = (obs.counter("serving.qos.transitions").value
+                   - transitions0)
+    server.stop()
+    if run_log is not None:
+        run_log.close("ok")
+
+    scheduled = sum(n for _, n in plans)
+    accounted = sum(st["sent"] for st in stats.values())
+    dropped = scheduled - accounted
+    if dropped:
+        violations.append(f"{dropped} request(s) unaccounted for")
+    if transitions <= 0:
+        violations.append("no qos rung transitions recorded")
+    if stats["lowpri"]["degraded"] + stats["flood"]["degraded"] <= 0:
+        violations.append("low-priority traffic never ran degraded")
+    victim = stats["victim"]
+    value = victim["ok"] / max(victim["sent"], 1)
+    for st in stats.values():
+        st["rungs"] = sorted(st["rungs"])
+        lat = sorted(st.pop("lat_ms"))
+        st["p99_ms"] = round(percentile(lat, 99), 3) if lat else None
+    rec = {
+        "metric": "chaos_tenant_flood",
+        "value": round(value, 4),
+        "unit": "frac",
+        "flood_x": args.flood_x,
+        "capacity_rps": round(capacity, 3),
+        "base_rate_rps": round(base_rate, 3),
+        "step_down_s": round(step_down_s, 3),
+        "quality_rungs": n_quality,
+        "transitions": transitions,
+        "shed_total": qos_snap["shed_total"],
+        "final_rung": qos_snap["rung"],
+        "tenants": stats,
+        "dropped": dropped,
+        "violations": violations,
+        "duration_s": round(elapsed, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    if violations:
+        note("VIOLATIONS: " + "; ".join(violations))
+    return 0 if not violations else 1
 
 
 def main(argv=None, model=None):
@@ -89,7 +372,48 @@ def main(argv=None, model=None):
     parser.add_argument("--health_poll_s", type=float, default=0.1)
     parser.add_argument("--run_log", type=str, default="",
                         help="structured JSONL run log path (empty disables)")
+    parser.add_argument("--tenant_flood", action="store_true",
+                        help="run the multi-tenant QoS contract instead "
+                        "of fault windows (module docstring): victim/"
+                        "lowpri/flood tenants, quality ladder, "
+                        "degradation-before-refusal gate")
+    parser.add_argument("--flood_x", type=float, default=10.0,
+                        help="flood tenant bursts at this multiple of "
+                        "the base (victim/lowpri) rate")
+    parser.add_argument("--qos_base_rate", type=float, default=0.0,
+                        help="victim/lowpri arrival rate for "
+                        "--tenant_flood, requests/s (0 = auto: a "
+                        "quarter of the measured post-warmup device "
+                        "capacity, so the overload is winnable on any "
+                        "host)")
+    parser.add_argument("--qos_ladder", type=str,
+                        default="c2f:factor=2,topk=16;c2f:factor=4,topk=8",
+                        help="quality ladder under test (serving/qos.py "
+                        "grammar)")
+    parser.add_argument("--device_delay_ms", type=float, default=250.0,
+                        help="engine.device delay failpoint pinning a "
+                        "capacity floor for --tenant_flood (measured "
+                        "calibration includes it)")
+    parser.add_argument("--max_queue", type=int, default=16)
+    parser.add_argument("--tenant_queue_frac", type=float, default=0.25,
+                        help="per-tenant queue-slot share for "
+                        "--tenant_flood")
+    parser.add_argument("--flood_budget_rps", type=float, default=0.0,
+                        help="flood tenant's token-bucket admission "
+                        "budget (0 = unlimited; throttled requests are "
+                        "429 tenant_budget, exempt from the gate)")
+    parser.add_argument("--qos_high_water", type=float, default=0.3,
+                        help="queue fraction that counts as overload "
+                        "(above one tenant's slot share, so a single "
+                        "capped tenant can't pin the signal hot alone)")
+    parser.add_argument("--qos_step_down_s", type=float, default=0.05,
+                        help="FLOOR for the rung step-down interval; "
+                        "--tenant_flood auto-raises it to the time the "
+                        "device needs to drain two tenants' queue slots")
+    parser.add_argument("--qos_step_up_hold_s", type=float, default=1.0)
     args = parser.parse_args(argv)
+    if args.tenant_flood:
+        return run_tenant_flood(args, model)
     windows = [parse_fault_window(s) for s in args.fault]
     if any(site.startswith("kill_replica") for _, site, _, _ in windows) \
             and args.replicas < 2:

@@ -21,6 +21,14 @@ The checks:
   BENCH_r*.json trend; regression vs best prior same-metric round
   fails the gate.
 
+One OPTIONAL check rides behind a flag: ``--with-tenant-flood`` runs
+the multi-tenant QoS chaos contract (``tools/chaos_serving.py
+--tenant_flood`` — victims stay 100% available while a flood tenant
+bursts 10x). It is off by default because it serves live traffic for
+several seconds; a default run still RECORDS it as
+``{"skipped": true, "optional": true}`` so the JSON never reads as if
+the contract were exercised when it was not.
+
 ``--skip NAME`` (repeatable) drops a check — skipped checks are
 recorded as ``{"skipped": true}`` and do NOT fail the gate, but the
 JSON says so; nothing is silently green. Child stdout/stderr stream to
@@ -46,6 +54,9 @@ _CPU_ENV = {"JAX_PLATFORMS": "cpu"}
 _CPU_DROP = ("PALLAS_AXON_POOL_IPS",)
 
 CHECKS = ("tier1", "lint", "bench_trend")
+# Opt-in checks: never run by default, never silently green — a
+# default run records them as {"skipped": true, "optional": true}.
+OPTIONAL_CHECKS = ("tenant_flood",)
 
 
 def _run(cmd, timeout_s, cpu_env=False) -> dict:
@@ -92,6 +103,16 @@ def run_bench_trend(timeout_s: float) -> dict:
          "--strict"], timeout_s)
 
 
+def run_tenant_flood(timeout_s: float) -> dict:
+    # Short-duration flavor of the chaos contract: same violation
+    # rules and self-calibrated rates as the full run, sized so the
+    # gate adds seconds, not minutes.
+    return _run(
+        [sys.executable, os.path.join("tools", "chaos_serving.py"),
+         "--tenant_flood", "--duration_s", "6"],
+        timeout_s, cpu_env=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--skip", action="append", default=[],
@@ -102,18 +123,28 @@ def main(argv=None) -> int:
                          "870 s default)")
     ap.add_argument("--timeout-s", type=float, default=300.0,
                     help="per-check fence for lint / bench_trend")
+    ap.add_argument("--with-tenant-flood", action="store_true",
+                    help="also run the multi-tenant QoS chaos contract "
+                         "(tools/chaos_serving.py --tenant_flood); off "
+                         "by default, recorded as skipped when off")
+    ap.add_argument("--chaos-timeout-s", type=float, default=300.0,
+                    help="wall-clock fence for the tenant_flood check")
     args = ap.parse_args(argv)
 
     runners = {
         "tier1": lambda: run_tier1(args.tier1_timeout_s),
         "lint": lambda: run_lint(args.timeout_s),
         "bench_trend": lambda: run_bench_trend(args.timeout_s),
+        "tenant_flood": lambda: run_tenant_flood(args.chaos_timeout_s),
     }
+    enabled = {"tenant_flood": args.with_tenant_flood}
     checks = {}
-    for name in CHECKS:
-        if name in args.skip:
+    for name in CHECKS + OPTIONAL_CHECKS:
+        if name in args.skip or not enabled.get(name, True):
             print(f"[ci_gate] {name}: SKIPPED", file=sys.stderr)
             checks[name] = {"skipped": True}
+            if name in OPTIONAL_CHECKS:
+                checks[name]["optional"] = True
             continue
         print(f"[ci_gate] {name}: running...", file=sys.stderr)
         checks[name] = runners[name]()

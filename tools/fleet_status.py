@@ -4,10 +4,11 @@ The dashboard half of obs/aggregate.py: scrape every replica's
 ``GET /metrics`` each poll, merge the scrapes into a fleet view, and
 render a per-replica table to STDERR —
 
-    replica      req/s   err/s   p99 ms   queue  breaker  burn  hbm GB  head%  warm
-    r0            12.4     0.0     38.2       1   closed   0.1    21.40     33     4
-    r1            11.9     0.0     41.7       0   closed   0.2    21.38     33     4
-    FLEET         24.3     0.0     40.9       1        -   0.2    42.78     33     8
+    replica      req/s   err/s   p99 ms   queue  breaker  burn  hbm GB  head%  warm  rung
+    r0            12.4     0.0     38.2       1   closed   0.1    21.40     33     4     0
+    r1            11.9     0.0     41.7       0   closed   0.2    21.38     33     4     0
+    FLEET         24.3     0.0     40.9       1        -   0.2    42.78     33     8     0
+      tenants: default=112  lowpri=38
 
 req/s and err/s are counter deltas between polls; p99 is exact at the
 shared bucket ladder's resolution (merged buckets for the FLEET row,
@@ -19,7 +20,11 @@ spending error budget faster than it earns it. hbm GB / head% read the
 /metrics) — bytes in use and percent of the device limit still free
 ("-" on backends that don't report memory stats, e.g. CPU); warm is
 the ``serving.warmup_programs`` counter, how many (bucket, batch,
-mode) programs the replica precompiled.
+mode) programs the replica precompiled; rung is the
+``serving.qos.rung`` gauge — the QoS controller's current ladder
+position ("-" on servers without the multi-tenant QoS layer) — and a
+``tenants:`` line breaks fleet-wide request totals out per
+``serving.tenant.requests`` tenant label.
 
 On exit (``--iterations N``, or Ctrl-C when polling forever) it prints
 ONE JSON line to stdout, the house contract every tool in tools/
@@ -38,6 +43,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -57,6 +63,8 @@ BURN = "slo_availability_burn_fast"
 HBM_USE = "device_hbm_bytes_in_use"
 HBM_LIM = "device_hbm_limit_bytes"
 WARMED = "serving_warmup_programs"
+RUNG = "serving_qos_rung"
+TENANT_REQS = "serving_tenant_requests"
 
 _BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
 
@@ -104,6 +112,23 @@ def _gauge_sum(view, key):
     return sum(vals) if vals else None
 
 
+_TENANT_LABEL_RE = re.compile(r'tenant="([^"]*)"')
+
+
+def _tenant_totals(counters):
+    """Per-tenant request totals from the labeled
+    ``serving_tenant_requests{tenant=...}`` series ({} on servers
+    without the multi-tenant QoS layer)."""
+    out = {}
+    for key, val in counters.items():
+        if not key.startswith(TENANT_REQS + "{"):
+            continue
+        m = _TENANT_LABEL_RE.search(key)
+        if m:
+            out[m.group(1)] = out.get(m.group(1), 0.0) + val
+    return out
+
+
 def render(view, prev_counters, dt, out=None):
     """One poll's table; returns {ident: counters} for the next delta."""
     w = (out or sys.stderr).write
@@ -127,6 +152,7 @@ def render(view, prev_counters, dt, out=None):
             use / 1e9 if use is not None else None,
             _headroom_pct(use, lim),
             rep["counters"].get(WARMED),
+            rep["gauges"].get(RUNG),
         ))
     fleet_prev = (prev_counters or {}).get("FLEET")
     burn_entry = view["gauges"].get(BURN) or {}
@@ -143,16 +169,24 @@ def render(view, prev_counters, dt, out=None):
         fleet_use / 1e9 if fleet_use is not None else None,
         _headroom_pct(fleet_use, fleet_lim),
         view["counters"].get(WARMED),
+        (view["gauges"].get(RUNG) or {}).get("max"),
     ))
     w(f"{'replica':<12} {'req/s':>8} {'err/s':>8} {'p99 ms':>8} "
       f"{'queue':>6} {'breaker':>9} {'burn':>6} {'hbm GB':>7} "
-      f"{'head%':>6} {'warm':>5}\n")
-    for ident, rps, eps, p99, q, brk, burn, hbm, head, warm in rows:
+      f"{'head%':>6} {'warm':>5} {'rung':>5}\n")
+    for (ident, rps, eps, p99, q, brk, burn, hbm, head, warm,
+         rung) in rows:
         qs = f"{q:.0f}".rjust(6) if q is not None else "-".rjust(6)
         ws_ = f"{warm:.0f}".rjust(5) if warm is not None else "-".rjust(5)
+        rg = f"{rung:.0f}".rjust(5) if rung is not None else "-".rjust(5)
         w(f"{ident:<12} {_fmt(rps, 8)} {_fmt(eps, 8)} {_fmt(p99, 8)} "
           f"{qs} {brk:>9} {_fmt(burn, 6)} {_fmt(hbm, 7, 2)} "
-          f"{_fmt(head, 6, 0)} {ws_}\n")
+          f"{_fmt(head, 6, 0)} {ws_} {rg}\n")
+    tenants = _tenant_totals(view["counters"])
+    if tenants:
+        w("  tenants: " + "  ".join(
+            f"{name}={total:.0f}" for name, total in
+            sorted(tenants.items())) + "\n")
     for url, why in sorted(view["errors"].items()):
         w(f"  unreachable {url}: {why}\n")
     nxt = {i: dict(view["per_replica"][i]["counters"]) for i in idents}
@@ -209,6 +243,8 @@ def main(argv=None):
             "hbm_bytes_in_use": use,
             "hbm_headroom_pct": _headroom_pct(use, lim),
             "warmed_programs": rep["counters"].get(WARMED),
+            "qos_rung": rep["gauges"].get(RUNG),
+            "tenants": _tenant_totals(rep["counters"]),
         }
     fleet_use = _gauge_sum(view, HBM_USE)
     fleet_lim = _gauge_sum(view, HBM_LIM)
@@ -225,6 +261,8 @@ def main(argv=None):
             "hbm_bytes_in_use": fleet_use,
             "hbm_limit_bytes": fleet_lim,
             "warmed_programs": view["counters"].get(WARMED),
+            "qos_rung": (view["gauges"].get(RUNG) or {}).get("max"),
+            "tenants": _tenant_totals(view["counters"]),
         },
         "polls": polls,
         "unreachable": sorted(view["errors"]),
