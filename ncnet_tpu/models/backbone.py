@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 from typing import Any, Dict, List
 
 import jax
@@ -139,9 +140,17 @@ class BackboneConfig:
 # T(2,128) tiling — ~46 ops x 1.46 ms, two thirds of the backbone's cost.
 # In NHWC the 1024-wide channel axis is the lane dimension and elementwise
 # ops tile natively. The flag is trace-time state scoped by a context
-# manager (single-threaded tracing), so the VGG/DenseNet paths and every
-# existing caller stay NCHW untouched.
-_CHANNELS_LAST = False
+# manager and stored per-thread: a serving fleet runs one batcher thread
+# per replica, and two replicas can trace backbone programs concurrently
+# (warmup covers declared buckets only — session/QoS traffic still traces
+# at runtime), so a process-global flag lets one replica's NHWC scope
+# corrupt another's mid-flight trace into mixed-layout convs. The
+# VGG/DenseNet paths and every existing caller stay NCHW untouched.
+_LAYOUT_STATE = threading.local()
+
+
+def _channels_last_on() -> bool:
+    return getattr(_LAYOUT_STATE, "channels_last", False)
 
 
 class _channels_last:
@@ -149,13 +158,11 @@ class _channels_last:
         self.enabled = enabled
 
     def __enter__(self):
-        global _CHANNELS_LAST
-        self.prev = _CHANNELS_LAST
-        _CHANNELS_LAST = self.enabled
+        self.prev = _channels_last_on()
+        _LAYOUT_STATE.channels_last = self.enabled
 
     def __exit__(self, *exc):
-        global _CHANNELS_LAST
-        _CHANNELS_LAST = self.prev
+        _LAYOUT_STATE.channels_last = self.prev
 
 
 def conv2d(x, w, stride: int = 1, padding: int = 0):
@@ -163,7 +170,8 @@ def conv2d(x, w, stride: int = 1, padding: int = 0):
 
     Input/output layout is NCHW, or NHWC inside a _channels_last scope.
     """
-    dims = ("NHWC", "HWIO", "NHWC") if _CHANNELS_LAST else ("NCHW", "HWIO", "NCHW")
+    dims = (("NHWC", "HWIO", "NHWC") if _channels_last_on()
+            else ("NCHW", "HWIO", "NCHW"))
     return lax.conv_general_dilated(
         x,
         w,
@@ -187,13 +195,13 @@ def frozen_bn(x, bn: Params, eps: float = 1e-5):
     shift = bn["bias"].astype(jnp.float32) - bn["mean"].astype(jnp.float32) * scale
     scale = scale.astype(x.dtype)
     shift = shift.astype(x.dtype)
-    shape = (1, 1, 1, -1) if _CHANNELS_LAST else (1, -1, 1, 1)
+    shape = (1, 1, 1, -1) if _channels_last_on() else (1, -1, 1, 1)
     return x * scale.reshape(shape) + shift.reshape(shape)
 
 
 def max_pool(x, window: int, stride: int, padding: int):
     """Torch-style max pool (pads with -inf)."""
-    if _CHANNELS_LAST:
+    if _channels_last_on():
         wd = (1, window, window, 1)
         ws = (1, stride, stride, 1)
         pd = ((0, 0), (padding, padding), (padding, padding), (0, 0))
@@ -297,7 +305,7 @@ def _fold_conv1_weight(w):
 
 def _space_to_depth_2x2(x):
     """[B,C,H,W] (or NHWC in a _channels_last scope) -> 2x2-folded, 4C."""
-    if _CHANNELS_LAST:
+    if _channels_last_on():
         b, h, w, c = x.shape
         x = x.reshape(b, h // 2, 2, w // 2, 2, c)
         return jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(
@@ -321,7 +329,7 @@ def _conv1_apply(params, x):
     not exact (different contraction order); tests pin 1e-5.
     """
     w = params["conv1"]
-    h, wd = (x.shape[1], x.shape[2]) if _CHANNELS_LAST else (
+    h, wd = (x.shape[1], x.shape[2]) if _channels_last_on() else (
         x.shape[2], x.shape[3]
     )
     fold = (
@@ -332,7 +340,7 @@ def _conv1_apply(params, x):
     if not fold:
         return conv2d(x, w, stride=2, padding=3)
     xf = _space_to_depth_2x2(x)
-    dims = (("NHWC", "HWIO", "NHWC") if _CHANNELS_LAST
+    dims = (("NHWC", "HWIO", "NHWC") if _channels_last_on()
             else ("NCHW", "HWIO", "NCHW"))
     return lax.conv_general_dilated(
         xf,

@@ -241,3 +241,125 @@ def c2f_refine_direction(consensus_params, coarse4d, feat_a, feat_b, *,
         coarse_shape=(ha, wa, hb, wb), stride=stride, radius=radius,
         symmetric=symmetric, corr_dtype=corr_dtype,
     )
+
+
+# -- frame-to-frame seeding (streaming sessions, serving/session.py) -------
+#
+# A video session makes the previous frame the best possible nominator:
+# instead of re-running the coarse pass every frame, the previous frame's
+# surviving cells — dilated by a small Chebyshev radius to absorb motion —
+# nominate the refinement set, and the refined output hands back an updated
+# gate for the NEXT frame. The coarse stage drops out of the steady state
+# entirely; a full coarse pass runs only on the first frame, on a
+# seed-quality drop, or after replica failover (the session layer decides).
+
+
+def dilate_seed(seed_cells, *, grid, radius: int):
+    """[K] flat coarse-cell indices -> [H, W] bool membership mask of
+    every cell within Chebyshev ``radius`` of at least one seed cell.
+    ``radius`` 0 is the identity set; shapes stay static (K is fixed, the
+    mask covers the whole grid)."""
+    h, w = grid
+    si = seed_cells // w
+    sj = seed_cells % w
+    gi = jnp.arange(h, dtype=jnp.int32)
+    gj = jnp.arange(w, dtype=jnp.int32)
+    hit_i = jnp.abs(gi[:, None] - si[None, :]) <= radius  # [h, K]
+    hit_j = jnp.abs(gj[:, None] - sj[None, :]) <= radius  # [w, K]
+    return (hit_i[:, None, :] & hit_j[None, :, :]).any(axis=-1)
+
+
+def seed_gate(seed_cells, cell_scores, matched_b, *, grid,
+              seed_radius: int, topk: int):
+    """Gate arrays for a seeded frame: the previous frame's survivors,
+    dilated, nominate this frame's refinement set.
+
+    The dilated membership mask restricts top-K selection; the score and
+    match-table fields carry over from the previous frame unmasked (they
+    are only window centers and fallback values — splice_matches keeps
+    the full-field contract). With a seed covering every cell this
+    reduces EXACTLY to :func:`coarse_gate`'s selection over the same
+    ``cell_scores``, which is the bitwise-equality contract
+    tests/test_session.py pins.
+
+    Returns the same tuple shape as :func:`coarse_gate`.
+    """
+    h, w = grid
+    n = h * w
+    k = n if topk <= 0 else min(topk, n)
+    mask = dilate_seed(seed_cells, grid=grid, radius=seed_radius)
+    masked = jnp.where(mask.reshape(-1), cell_scores.astype(jnp.float32),
+                       -jnp.inf)
+    top_scores, top_cells = jax.lax.top_k(masked, k)
+    return top_scores, top_cells.astype(jnp.int32), cell_scores, matched_b
+
+
+def gate_update_from_splice(i_m, j_m, score, *, coarse_shape, stride: int,
+                            topk: int):
+    """Next frame's gate from this frame's spliced match field.
+
+    Each coarse probe cell owns an aligned stride x stride fine block;
+    its new cell score is the best spliced score in the block and its new
+    match-table entry is the coarse cell of that best match's fine B
+    index — refined-scale statistics replacing the coarse ones, so a
+    long-running session never has to re-touch the coarse tensor while
+    the seed stays healthy.
+
+    Args:
+      i_m / j_m / score: [n] matched-side fine indices and spliced scores,
+        row-major over the probe fine grid (one splice_matches row).
+      coarse_shape: (Hp, Wp, Hm, Wm) probe/matched coarse grids.
+
+    Returns (top_scores [K], top_cells [K] int32,
+             cell_scores [Hp*Wp] f32, matched_m [Hp*Wp] int32).
+    """
+    hp, wp, hm, wm = coarse_shape
+    s = stride
+
+    def blockify(x):
+        return x.reshape(hp, s, wp, s).transpose(0, 2, 1, 3).reshape(
+            hp * wp, s * s)
+
+    blocks = blockify(score.astype(jnp.float32))
+    cell_scores = jnp.max(blocks, axis=-1)
+    best = jnp.argmax(blocks, axis=-1).astype(jnp.int32)
+    rows = jnp.arange(hp * wp)
+    bi = blockify(i_m)[rows, best]
+    bj = blockify(j_m)[rows, best]
+    matched_m = ((bi // s) * wm + bj // s).astype(jnp.int32)
+    n = hp * wp
+    k = n if topk <= 0 else min(topk, n)
+    top_scores, top_cells = jax.lax.top_k(cell_scores, k)
+    return top_scores, top_cells.astype(jnp.int32), cell_scores, matched_m
+
+
+def refine_from_seed(consensus_params, seed_cells, cell_scores, matched_b,
+                     feat_a, feat_b, *, coarse_shape, stride: int,
+                     radius: int, seed_radius: int, topk: int,
+                     symmetric: bool = True, corr_dtype=jnp.float32):
+    """Stage 2 gated by the previous frame's survivors instead of a
+    coarse pass: dilate -> select -> gather -> correlate -> consensus ->
+    splice, plus the updated gate the NEXT frame seeds from.
+
+    ``seed_cells`` / ``cell_scores`` / ``matched_b`` are the previous
+    frame's gate (coarse-scale on the frame after a full pass,
+    refined-scale afterwards). Returns ``(fields, new_gate)`` where
+    ``fields`` is the splice output (i_a, j_a, i_b, j_b, score) and
+    ``new_gate`` matches :func:`coarse_gate`'s tuple shape.
+    """
+    ha, wa, hb, wb = coarse_shape
+    _, top_cells, _, _ = seed_gate(
+        seed_cells, cell_scores, matched_b, grid=(ha, wa),
+        seed_radius=seed_radius, topk=topk,
+    )
+    fields = refine_from_gate(
+        consensus_params, top_cells, cell_scores, matched_b, feat_a, feat_b,
+        coarse_shape=coarse_shape, stride=stride, radius=radius,
+        symmetric=symmetric, corr_dtype=corr_dtype,
+    )
+    _i_a, _j_a, i_b, j_b, score = fields
+    new_gate = gate_update_from_splice(
+        i_b[0], j_b[0], score[0], coarse_shape=coarse_shape, stride=stride,
+        topk=topk,
+    )
+    return fields, new_gate

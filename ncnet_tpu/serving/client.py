@@ -179,3 +179,146 @@ class MatchClient:
         if status != 200:
             raise ServingError(status, payload)
         return payload
+
+    # -- streaming sessions -----------------------------------------------
+
+    def session(
+        self,
+        ref_path: Optional[str] = None,
+        ref_bytes: Optional[bytes] = None,
+        c2f: Optional[dict] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
+    ) -> "MatchSession":
+        """Open a streaming session (``with client.session(...) as s:``).
+
+        The returned :class:`MatchSession` posts frames against the
+        session's reference image and transparently RE-OPENS on ``410
+        session_lost`` (TTL eviction, server restart) — the resent
+        frame runs a full coarse pass on the fresh session and the
+        stream continues. Exiting the ``with`` block deletes the
+        session server-side (best-effort)."""
+        return MatchSession(self, ref_path=ref_path, ref_bytes=ref_bytes,
+                            c2f=c2f, tenant=tenant, priority=priority)
+
+
+class MatchSession:
+    """One open streaming session; created via :meth:`MatchClient.session`.
+
+    ``frame()`` mirrors :meth:`MatchClient.match`'s retry contract for
+    503/429 and additionally handles 410 ``session_lost`` by re-opening
+    once per frame and resending — the server's TTL eviction or a
+    restart costs one full coarse pass, never the stream."""
+
+    def __init__(self, client: MatchClient, ref_path=None, ref_bytes=None,
+                 c2f=None, tenant=None, priority=None):
+        self._client = client
+        self._open_body = {}
+        if ref_path:
+            self._open_body["ref_path"] = ref_path
+        if ref_bytes:
+            self._open_body["ref_b64"] = base64.b64encode(ref_bytes).decode()
+        if not self._open_body:
+            raise ValueError("session needs ref_path or ref_bytes")
+        if c2f is not None:
+            self._open_body["c2f"] = c2f
+        self._headers = {}
+        if tenant is not None:
+            self._headers["X-NCNet-Tenant"] = tenant
+        if priority is not None:
+            self._headers["X-NCNet-Priority"] = priority
+        self.session_id: Optional[str] = None
+        self.reopens = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def open(self) -> "MatchSession":
+        policy = self._client._policy.session()
+        while True:
+            status, payload, headers = self._client._request(
+                "POST", "/v1/session", self._open_body,
+                headers=self._headers)
+            if status == 200:
+                self.session_id = payload["session_id"]
+                return self
+            if status in (503, 429):
+                try:
+                    hint = float(headers.get("Retry-After", "0.1"))
+                except (TypeError, ValueError):
+                    hint = 0.1
+                delay = policy.next_delay(hint_s=min(hint, 5.0))
+                if delay is not None:
+                    self._client._policy.sleep(delay)
+                    continue
+                raise OverCapacityError(status, payload)
+            raise ServingError(status, payload)
+
+    def close(self) -> Optional[dict]:
+        """DELETE the session; returns its lifetime stats (None when it
+        was never opened or is already gone)."""
+        if self.session_id is None:
+            return None
+        sid, self.session_id = self.session_id, None
+        status, payload, _ = self._client._request(
+            "DELETE", f"/v1/session/{sid}")
+        return payload if status == 200 else None
+
+    def __enter__(self) -> "MatchSession":
+        if self.session_id is None:
+            self.open()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- frames -----------------------------------------------------------
+
+    def frame(
+        self,
+        query_path: Optional[str] = None,
+        query_bytes: Optional[bytes] = None,
+        deadline_ms: Optional[float] = None,
+        max_matches: Optional[int] = None,
+    ) -> dict:
+        """POST one query frame; returns the response dict on 200."""
+        if self.session_id is None:
+            self.open()
+        body = {}
+        if query_path:
+            body["query_path"] = query_path
+        if query_bytes:
+            body["query_b64"] = base64.b64encode(query_bytes).decode()
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        if max_matches is not None:
+            body["max_matches"] = max_matches
+        policy = self._client._policy.session()
+        reopened = False
+        while True:
+            status, payload, headers = self._client._request(
+                "POST", f"/v1/session/{self.session_id}/frame", body,
+                headers=self._headers)
+            if status == 200:
+                return payload
+            if status == 410 and not reopened:
+                # session_lost: evicted or server restarted. One
+                # transparent re-open per frame, then resend — the
+                # fresh session's first frame re-runs the coarse pass.
+                reopened = True
+                self.session_id = None
+                self.open()
+                self.reopens += 1
+                continue
+            if status in (503, 429):
+                try:
+                    hint = float(headers.get("Retry-After", "0.1"))
+                except (TypeError, ValueError):
+                    hint = 0.1
+                delay = policy.next_delay(hint_s=min(hint, 5.0))
+                if delay is not None:
+                    self._client._policy.sleep(delay)
+                    continue
+                raise OverCapacityError(status, payload)
+            if status == 422:
+                raise PoisonRequestError(status, payload)
+            raise ServingError(status, payload)

@@ -99,20 +99,44 @@ class FleetDispatcher:
         order = [cands[(k + i) % n] for i in range(n)]
         return min(order, key=lambda r: r.load)
 
+    def find(self, replica_id: Optional[str]):
+        """Replica by id, or None — the session layer resolves its
+        affinity target through this before every seeded frame."""
+        for r in self.replicas:
+            if r.replica_id == replica_id:
+                return r
+        return None
+
     # -- request path -----------------------------------------------------
 
     def submit(self, bucket_key, payload, timeout_s: Optional[float] = None,
-               tenant: Optional[str] = None) -> Future:
+               tenant: Optional[str] = None, affinity=None,
+               sticky: bool = False) -> Future:
         """Admit one request somewhere healthy; returns a Future with
         the single-engine BatchResult contract. Raises RejectedError
         (every healthy queue full) or NoHealthyReplicaError. ``tenant``
         rides along to each replica's batcher for per-tenant queue-slot
-        accounting."""
+        accounting.
+
+        ``affinity``: prefer this replica when it is healthy — session
+        frames are sticky to the replica holding their seed
+        (serving/session.py). ``sticky`` additionally disables refusal
+        re-routing: a seeded frame refused by its affinity replica
+        (killed or breaker-open between submit and run) must NOT land on
+        a stranger replica that never saw the seed — the refusal
+        surfaces to the session layer, which re-seeds on a survivor
+        instead (the re-seed-not-die contract, docs/RELIABILITY.md).
+        A sticky submit whose affinity replica is already unhealthy
+        raises :class:`~ncnet_tpu.serving.batcher.ReplicaDeadError`
+        for the same reason.
+        """
         outer: Future = Future()
         state = {
             "tried": [],
             "attempts": 0,
             "tenant": tenant,
+            "affinity": affinity,
+            "sticky": bool(sticky),
             # Captured on the handler thread: a re-route happens on a
             # worker-thread callback where contextvars are empty, so the
             # resubmit re-attaches the request's trace explicitly.
@@ -127,7 +151,25 @@ class FleetDispatcher:
         _on_done converts into the outer future's exception)."""
         last_reject = None
         while True:
-            r = self.pick(exclude=state["tried"])
+            r = None
+            aff = state.get("affinity")
+            if aff is not None and aff not in state["tried"]:
+                if aff.healthy:
+                    r = aff
+                elif state["sticky"]:
+                    raise ReplicaDeadError(aff.replica_id)
+            if r is None and state["sticky"]:
+                # The affinity replica refused or is gone; a sticky
+                # rider must not run anywhere else (its payload seeds
+                # from state only that replica served). A full queue is
+                # plain backpressure (RejectedError -> 503 Retry-After),
+                # not a reason to re-seed.
+                if last_reject is not None:
+                    raise last_reject
+                raise ReplicaDeadError(
+                    aff.replica_id if aff is not None else "")
+            if r is None:
+                r = self.pick(exclude=state["tried"])
             if r is None:
                 if last_reject is not None:
                     raise last_reject
@@ -157,7 +199,8 @@ class FleetDispatcher:
             outer.set_result(fut.result())
             return
         refused = isinstance(exc, (ReplicaDeadError, BreakerOpenError))
-        if refused and state["attempts"] < self.max_redispatch:
+        if refused and not state["sticky"] \
+                and state["attempts"] < self.max_redispatch:
             state["attempts"] += 1
             state["tried"].append(replica)
             obs.counter("serving.redispatched", labels=self.labels).inc()

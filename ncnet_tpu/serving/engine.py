@@ -49,7 +49,7 @@ from ..models.ncnet import (
     extract_features,
     ncnet_forward_from_features,
 )
-from ..ops.c2f import coarse_gate, refine_from_gate
+from ..ops.c2f import coarse_gate, refine_from_gate, refine_from_seed
 from ..ops.matches import relocalize_and_coords
 
 #: Engine modes a request may select (`mode` knob on /v1/match).
@@ -85,6 +85,13 @@ class Prepared:
     #: a batch is op-homogeneous; None = the engine default, whose
     #: bucket keys are identical to the pre-QoS 3-tuples.
     c2f_op: Optional[Tuple[int, int, int]] = None
+    #: Streaming-session context (serving/session.py), set only by
+    #: :meth:`MatchEngine.prepare_session_frame`. Keys: ``seed`` (the
+    #: previous frame's gate arrays, or None for a full coarse frame),
+    #: ``want_ref_feats`` (capture the reference features so the session
+    #: can reuse them). Session riders get a ``session`` block in their
+    #: result dict (next-frame gates, seed mass, serving replica).
+    session: Optional[dict] = None
 
 
 class MatchEngine:
@@ -115,10 +122,16 @@ class MatchEngine:
         c2f_coarse_factor=None,
         c2f_topk=None,
         c2f_radius=None,
+        session_seed_radius: int = 1,
     ):
         """``c2f_*``: override the config's coarse-to-fine knobs for this
         engine (None keeps the config value) — the server CLI threads its
         ``--c2f_*`` flags through here.
+
+        ``session_seed_radius``: Chebyshev dilation applied to the
+        previous frame's surviving coarse cells when a streaming-session
+        frame seeds the refinement gate (ops/c2f.refine_from_seed) —
+        static, so it is baked into the seeded program.
 
         ``device``: pin this engine to one accelerator (a fleet builds
         one engine per device, serving/fleet.py) — params are committed
@@ -227,6 +240,8 @@ class MatchEngine:
         # path is unchanged.
         self._both_directions = both_directions
         self._invert_direction = invert_direction
+        self.session_seed_radius = int(session_seed_radius)
+        self._session_programs: dict = {}
         self._c2f_programs: dict = {}
         self._c2f_default_op = (config.c2f_coarse_factor, config.c2f_topk,
                                 config.c2f_radius)
@@ -411,6 +426,105 @@ class MatchEngine:
 
         return _c2f_coarse, _c2f_coarse_cached, _c2f_refine
 
+    # -- streaming-session seeded programs --------------------------------
+
+    def session_programs_for(self, op: Optional[Tuple[int, int, int]]):
+        """The seeded-frame program for one c2f operating point, built
+        on first use and cached (same lifecycle as c2f_programs_for)."""
+        key = self._c2f_default_op if op is None else tuple(op)
+        prog = self._session_programs.get(key)
+        if prog is None:
+            prog = self._build_session_program(self._config_for_op(key))
+            self._session_programs[key] = prog
+        return prog
+
+    def _build_session_program(self, config):
+        """Build one operating point's seeded-frame program.
+
+        ONE device program per steady-state session frame: extract the
+        query's features, then refine directly from the previous frame's
+        dilated survivors (ops/c2f.refine_from_seed) — the coarse
+        pipeline never runs, which is the whole point of the session
+        verb. Alongside the matches it returns the updated per-direction
+        gates (next frame's nominator) and the surviving-score mass (the
+        re-seed quality signal the session layer thresholds).
+        """
+        jax, jnp = self._jax, self._jnp
+        both_directions = self._both_directions
+        invert_direction = self._invert_direction
+        stride = c2f_stride(config)
+        seed_radius = self.session_seed_radius
+
+        def _seeded_one(params, feat_a, feat_b, seed_b, seed_a):
+            consensus = params["neigh_consensus"]
+            s = stride
+            ha, wa = feat_a.shape[2] // s, feat_a.shape[3] // s
+            hb, wb = feat_b.shape[2] // s, feat_b.shape[3] // s
+            fine_shape = (feat_a.shape[2], feat_a.shape[3],
+                          feat_b.shape[2], feat_b.shape[3])
+            kw = dict(stride=s, radius=config.c2f_radius,
+                      seed_radius=seed_radius, topk=config.c2f_topk,
+                      symmetric=config.symmetric_mode,
+                      corr_dtype=config.corr_dtype)
+
+            def passthrough(seed):
+                # Direction this engine never probes: hand the seed back
+                # unchanged so the session state keeps uniform shape.
+                cells, cs, mb = seed
+                return (jnp.take(cs, cells), cells, cs, mb)
+
+            def per_b():  # one match per fine B cell
+                cells, cs, mb = seed_b
+                (i_b, j_b, i_a, j_a, score), gate = refine_from_seed(
+                    consensus, cells, cs, mb, feat_b, feat_a,
+                    coarse_shape=(hb, wb, ha, wa), **kw)
+                coords = relocalize_and_coords(
+                    i_a, j_a, i_b, j_b, score, None, 1, fine_shape,
+                    "positive")
+                return coords, gate
+
+            def per_a():  # one match per fine A cell
+                cells, cs, mb = seed_a
+                (i_a, j_a, i_b, j_b, score), gate = refine_from_seed(
+                    consensus, cells, cs, mb, feat_a, feat_b,
+                    coarse_shape=(ha, wa, hb, wb), **kw)
+                coords = relocalize_and_coords(
+                    i_a, j_a, i_b, j_b, score, None, 1, fine_shape,
+                    "positive")
+                return coords, gate
+
+            if both_directions:
+                (d0, g_b), (d1, g_a) = per_b(), per_a()
+                raw = tuple(jnp.concatenate([u, v], axis=1)
+                            for u, v in zip(d0, d1))
+                mass = (jnp.maximum(g_b[0], 0.0).sum()
+                        + jnp.maximum(g_a[0], 0.0).sum())
+            elif invert_direction:
+                raw, g_a = per_a()
+                g_b = passthrough(seed_b)
+                mass = jnp.maximum(g_a[0], 0.0).sum()
+            else:
+                raw, g_b = per_b()
+                g_a = passthrough(seed_a)
+                mass = jnp.maximum(g_b[0], 0.0).sum()
+            return (_sort_and_recenter(raw, fine_shape, 1), (g_b, g_a),
+                    mass)
+
+        @jax.jit
+        def _c2f_seeded(params, q_stack, featb_stack, seeds):
+            def body(_, x):
+                q, fb, (sb, sa) = x
+                fa = extract_features(config, params, q[None]).astype(
+                    jnp.bfloat16)
+                fb = fb.astype(jnp.bfloat16)
+                return None, _seeded_one(params, fa, fb, sb, sa)
+
+            _, out = jax.lax.scan(body, None,
+                                  (q_stack, featb_stack, seeds))
+            return out
+
+        return _c2f_seeded
+
     # -- host-side request preparation -----------------------------------
 
     def _resize_shape(self, h: int, w: int, mode: str = "oneshot",
@@ -545,6 +659,105 @@ class MatchEngine:
             c2f_op=op,
         )
 
+    def prepare_session_frame(
+        self,
+        request: dict,
+        *,
+        ref_path: Optional[str] = None,
+        ref_b64: Optional[str] = None,
+        ref_feats=None,
+        op: Optional[Tuple[int, int, int]] = None,
+        seed=None,
+        seed_bucket=None,
+    ) -> Prepared:
+        """Prepare one streaming-session frame (serving/session.py).
+
+        The query comes from the request (``query_path``/``query_b64``);
+        the reference side comes from the SESSION — captured features
+        when the session already holds them (the steady state), else the
+        reference source recorded at open (path refs probe the shared
+        feature store exactly like /v1/match panos). ``seed`` is the
+        previous frame's per-direction gate arrays and ``seed_bucket``
+        the base bucket they were minted at: the seed rides only when
+        the buckets still agree and the operating point is non-degenerate
+        — otherwise the frame falls back to a full coarse pass and the
+        caller re-seeds from its gates. Seeded frames extend the bucket
+        key with a ``"seed"`` marker so they batch only with other
+        seeded frames (a different program family).
+        """
+        if not isinstance(request, dict):
+            raise ValueError("request body must be a JSON object")
+        q_path, q_b64 = request.get("query_path"), request.get("query_b64")
+        if bool(q_path) == bool(q_b64):
+            raise ValueError("exactly one of query_path/query_b64 required")
+        max_matches = int(request.get("max_matches", 0) or 0)
+        try:
+            query, _ = self._load_image(q_path, q_b64, "c2f", op)
+        except (OSError, ValueError) as exc:
+            raise ValueError(f"query image unreadable: {exc}") from exc
+
+        pano = pano_feats = pano_shape = None
+        p_path = None
+        if ref_feats is not None:
+            pano_feats = np.asarray(ref_feats)
+        elif ref_path:
+            if self.cache is not None:
+                try:
+                    from PIL import Image
+
+                    with Image.open(ref_path) as im:
+                        pw, ph = im.size
+                except (OSError, ValueError) as exc:
+                    raise ValueError(
+                        f"reference image unreadable: {exc}") from exc
+                pano_shape = self._resize_shape(ph, pw, "c2f", op)
+                pano_feats = self.cache.get(ref_path, pano_shape)
+                p_path = ref_path
+            if pano_feats is None:
+                try:
+                    pano, pano_shape = self._load_image(
+                        ref_path, None, "c2f", op)
+                except (OSError, ValueError) as exc:
+                    raise ValueError(
+                        f"reference image unreadable: {exc}") from exc
+        elif ref_b64:
+            try:
+                pano, pano_shape = self._load_image(None, ref_b64, "c2f", op)
+            except (OSError, ValueError) as exc:
+                raise ValueError(
+                    f"reference image unreadable: {exc}") from exc
+        else:
+            raise ValueError("session holds no reference source")
+
+        if pano_feats is not None:
+            kind = ("feat", tuple(np.asarray(pano_feats).shape))
+        else:
+            kind = ("img", tuple(pano.shape[2:]))
+        bucket_key = (tuple(query.shape[2:]), kind, "c2f")
+        if op is not None:
+            bucket_key = bucket_key + (op,)
+        use_seed = (seed is not None
+                    and seed_bucket == bucket_key
+                    and not self._c2f_bucket_degenerate(bucket_key))
+        session_info = {
+            "seed": tuple(seed) if use_seed else None,
+            "want_ref_feats": pano_feats is None,
+        }
+        if use_seed:
+            bucket_key = bucket_key + ("seed",)
+        return Prepared(
+            bucket_key=bucket_key,
+            query=query,
+            pano=pano,
+            pano_feats=None if pano_feats is None else np.asarray(pano_feats),
+            pano_path=p_path,
+            pano_shape=pano_shape,
+            max_matches=max_matches,
+            mode="c2f",
+            c2f_op=op,
+            session=session_info,
+        )
+
     # -- batched device dispatch ------------------------------------------
 
     # -- cost observatory --------------------------------------------------
@@ -640,6 +853,8 @@ class MatchEngine:
         when the 4-tuple key carries one) reduce to one-shot."""
         (qh, qw), kind, _mode = bucket_key[:3]
         op = bucket_key[3] if len(bucket_key) > 3 else None
+        if op == "seed":  # seeded session buckets append a marker, not an op
+            op = None
         q_feat = (qh // _FEAT_STRIDE_PX, qw // _FEAT_STRIDE_PX)
         if kind[0] == "feat":
             p_feat = tuple(kind[1][-2:])
@@ -688,7 +903,51 @@ class MatchEngine:
         for p in batch:
             failpoints.fire("engine.rider", payload=p)
         timing_extra = {}
-        if batch[0].mode == "c2f" and not self._c2f_bucket_degenerate(
+        session_out: dict = {}
+        sess0 = batch[0].session or {}
+        if batch[0].mode == "c2f" and sess0.get("seed") is not None:
+            # Steady-state session frame: the previous frame's dilated
+            # survivors gate the refinement directly, so the coarse
+            # pipeline never dispatches — one program extracts the query
+            # features, refines, and hands back next frame's gates plus
+            # the surviving-score mass (serving/session.py thresholds it
+            # for the re-seed decision).
+            if f_stack is None:
+                raise ValueError(
+                    "seeded session frames require captured reference "
+                    "features")
+            seeded_prog = self.session_programs_for(batch[0].c2f_op)
+            seeds = tuple(
+                tuple(self._put(jnp.stack(
+                    [jnp.asarray(p.session["seed"][d][i]) for p in batch]))
+                    for i in range(3))
+                for d in range(2))
+            with trace.span("device", batch_size=len(batch)):
+                failpoints.fire("engine.refine", payload=bucket_key)
+                t_r = time.monotonic()
+                ms, new_gates, mass = seeded_prog(
+                    self.params, q_stack, f_stack, seeds)
+                np_ms = self._jax.device_get(ms)
+                gates_np = self._jax.device_get(new_gates)
+                mass_np = np.asarray(self._jax.device_get(mass))
+                refine_s = time.monotonic() - t_r
+                trace.emit_span("refine", dur_s=refine_s,
+                                batch_size=len(batch))
+                obs.histogram("engine.c2f.refine_s",
+                              labels=self.labels).observe(refine_s)
+            obs.counter("engine.session.seeded",
+                        labels=self.labels).inc(len(batch))
+            for k, p in enumerate(batch):
+                session_out[k] = {
+                    "seeded": True,
+                    "mass": float(mass_np[k]),
+                    "gates": tuple(
+                        tuple(np.asarray(d[i][k]) for i in (1, 2, 3))
+                        for d in gates_np),
+                }
+            timing_extra = {"refine_ms": refine_s * 1e3}
+            device_s = time.monotonic() - t_dev
+        elif batch[0].mode == "c2f" and not self._c2f_bucket_degenerate(
                 bucket_key):
             # Two-stage dispatch with a host decision point: the coarse
             # gate scores cross to the host (stage timings + survivor
@@ -732,6 +991,22 @@ class MatchEngine:
             if mode == "with_feats":
                 store = [(p, fb_s[k]) for k, p in enumerate(batch)
                          if p.pano_path]
+            if any(p.session is not None for p in batch):
+                # Session riders on a full coarse frame (first frame or
+                # re-seed): hand their gates — and the reference
+                # features, when the session wants to capture them —
+                # back to the session layer as next frame's seed.
+                g_np = self._jax.device_get(gates)
+                for k, p in enumerate(batch):
+                    if p.session is None:
+                        continue
+                    entry = {"seeded": False, "gates": tuple(
+                        tuple(np.asarray(d[i][k]) for i in (1, 2, 3))
+                        for d in g_np)}
+                    if p.session.get("want_ref_feats"):
+                        entry["ref_feats"] = np.asarray(
+                            self._jax.device_get(fb_s[k]))
+                    session_out[k] = entry
             timing_extra = {"coarse_ms": coarse_s * 1e3,
                             "refine_ms": refine_s * 1e3}
             device_s = time.monotonic() - t_dev
@@ -753,6 +1028,16 @@ class MatchEngine:
             else:
                 ms = self._batch_pairs(self.params, q_stack, t_stack)
             np_ms = self._jax.device_get(ms)
+            for k, p in enumerate(batch):
+                if p.session is None:
+                    continue
+                # Degenerate-op session frames route one-shot and have no
+                # gate to seed from — the session simply never seeds.
+                entry: dict = {"seeded": False, "gates": None}
+                if p.session.get("want_ref_feats") and mode == "with_feats":
+                    entry["ref_feats"] = np.asarray(
+                        self._jax.device_get(feats[k]))
+                session_out[k] = entry
             device_s = time.monotonic() - t_dev
             trace.emit_span("device", dur_s=device_s, batch_size=len(batch))
         obs.histogram("serving.device_time_s",
@@ -769,8 +1054,12 @@ class MatchEngine:
             rows = np.stack(tup, axis=1).astype(np.float32)  # [n, 5]
             if p.max_matches > 0:
                 rows = rows[: p.max_matches]
-            out.append({"matches": rows, "n_matches": int(rows.shape[0]),
-                        "timing": dict(timing)})
+            rec = {"matches": rows, "n_matches": int(rows.shape[0]),
+                   "timing": dict(timing)}
+            if k in session_out:
+                session_out[k]["replica"] = self.labels.get("replica")
+                rec["session"] = session_out[k]
+            out.append(rec)
         for p, f in store:
             # D2H fetch inside put(); serialized so concurrent batches
             # don't race duplicate stores of the same pano.

@@ -250,6 +250,11 @@ class MatchFleet:
         for r in sorted(self.replicas, key=lambda r: not r.dead):
             r.close(timeout_s=timeout_s)
 
+    def find(self, replica_id: Optional[str]) -> Optional[Replica]:
+        """Replica by id, or None — the session layer's affinity lookup
+        (an evicted/renamed id simply means re-seed, never KeyError)."""
+        return self.dispatcher.find(replica_id)
+
     # -- chaos / operator actions -----------------------------------------
 
     def _resolve(self, which) -> Replica:

@@ -12,6 +12,13 @@ Endpoints (schema: docs/SERVING.md):
   clients and the load-gen bench can see batching happen. Over-capacity
   requests get 503 + ``Retry-After`` (admission control), malformed
   ones 400, deadline overruns 504.
+* ``POST /v1/session`` / ``POST /v1/session/<id>/frame`` /
+  ``DELETE /v1/session/<id>`` — streaming video sessions: one
+  reference image, consecutive query frames, the previous frame's
+  surviving coarse cells seeding the next frame's refinement
+  (serving/session.py; docs/SERVING.md "Streaming sessions"). Unknown
+  or evicted sessions get 410 ``session_lost``; a full session table
+  429 ``session_slots``.
 * ``GET /healthz`` — liveness + degradation: the PR-1 heartbeat's
   stall flag (a wedged replica reports ``stalled`` + 503 so a balancer
   drains it), the circuit-breaker state (``degraded`` + 503 while
@@ -29,6 +36,7 @@ contract as every other entry point (docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import sys
 import threading
@@ -49,6 +57,7 @@ from .batcher import (
     ReplicaDeadError,
 )
 from .engine import MatchEngine
+from .session import SessionCapError, SessionLostError, SessionManager
 from .qos import (
     DEFAULT_TENANT,
     PRIORITY_HEADER,
@@ -64,6 +73,15 @@ from .qos import (
 #: waiting (504). Admitted requests are still completed by the batcher —
 #: the drain contract — the client has just stopped listening.
 DEADLINE_GRACE_S = 30.0
+
+
+def _session_frame_path(path: str) -> Optional[str]:
+    """``/v1/session/<id>/frame`` -> session id, else None."""
+    parts = path.strip("/").split("/")
+    if (len(parts) == 4 and parts[0] == "v1" and parts[1] == "session"
+            and parts[3] == "frame" and parts[2]):
+        return parts[2]
+    return None
 
 
 class MatchServer:
@@ -90,6 +108,10 @@ class MatchServer:
         qos: Optional[QosController] = None,
         tenants: Optional[TenantTable] = None,
         tenant_queue_frac: Optional[float] = None,
+        max_sessions: int = 64,
+        session_ttl_s: float = 300.0,
+        tenant_session_frac: Optional[float] = None,
+        session_reseed_frac: float = 0.5,
     ):
         """``fleet``: a started-or-startable serving/fleet.MatchFleet.
         When set, the server fronts the fleet's dispatcher instead of
@@ -193,6 +215,18 @@ class MatchServer:
                 qos_max_queue = max_queue
             self.qos.bind(slo=self.slo, depth_fn=depth_fn,
                           max_queue=qos_max_queue, labels=self.labels)
+        # Streaming sessions (serving/session.py): always constructed —
+        # the table is tiny and an un-streamed server pays nothing. The
+        # per-tenant seat share composes with (not replaces) the QoS
+        # admission stack: session FRAMES still ride tenant budgets,
+        # quality rungs, and queue-slot caps like any other request.
+        self.sessions = SessionManager(
+            max_sessions=max_sessions,
+            tenant_frac=tenant_session_frac,
+            ttl_s=session_ttl_s,
+            reseed_frac=session_reseed_frac,
+            labels=self.labels,
+        )
         if self.replica_id:
             obs.set_build_info(replica=self.replica_id)
         self.t_start = time.monotonic()
@@ -244,11 +278,27 @@ class MatchServer:
                     self._send_json(404, {"error": "not found"})
 
             def do_POST(self):  # noqa: N802
-                if self.path != "/v1/match":
-                    self._send_json(404, {"error": "not found"})
-                    return
-                code, payload, headers = server.handle_match(self)
+                if self.path == "/v1/match":
+                    code, payload, headers = server.handle_match(self)
+                elif self.path == "/v1/session":
+                    code, payload, headers = server.handle_session_open(self)
+                else:
+                    sid = _session_frame_path(self.path)
+                    if sid is None:
+                        self._send_json(404, {"error": "not found"})
+                        return
+                    code, payload, headers = server.handle_session_frame(
+                        self, sid)
                 self._send_json(code, payload, headers)
+
+            def do_DELETE(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[:2] == ["v1", "session"]:
+                    code, payload, headers = server.handle_session_close(
+                        parts[2])
+                    self._send_json(code, payload, headers)
+                    return
+                self._send_json(404, {"error": "not found"})
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True
@@ -350,6 +400,7 @@ class MatchServer:
             }
             if self.replica_id:
                 payload["replica"] = self.replica_id
+            payload["sessions"] = self.sessions.snapshot()
             payload.update(self._headroom_warnings())
             payload.update(self._qos_block())
             slo = self.slo_status()
@@ -387,6 +438,7 @@ class MatchServer:
         }
         if self.replica_id:
             payload["replica"] = self.replica_id
+        payload["sessions"] = self.sessions.snapshot()
         # Degraded-healthz warning, not a 503: a config whose declared
         # buckets oversubscribe HBM still serves what fits, but the
         # operator should know before the OOM does the telling.
@@ -707,6 +759,468 @@ class MatchServer:
             threshold_s=self.slo_p99_target_s, labels=self.labels)
         return 200, payload, None
 
+    # -- streaming sessions (docs/SERVING.md, "Streaming sessions") -------
+
+    def _resolve_tenant(self, handler):
+        """Tenant identity + admission-budget verdict shared by the
+        session verbs. Returns (tenant, priority, error_triple|None)."""
+        if self.tenants is None:
+            return None, None, None
+        tenant, priority, bucket = self.tenants.resolve(
+            handler.headers.get(TENANT_HEADER),
+            handler.headers.get(PRIORITY_HEADER),
+        )
+        obs.counter(
+            "serving.tenant.requests",
+            labels={**self.labels, "tenant": tenant,
+                    "priority": priority}).inc()
+        retry_in = bucket.try_take()
+        if retry_in is None:
+            return tenant, priority, None
+        obs.counter("serving.tenant.throttled",
+                    labels={**self.labels, "tenant": tenant}).inc()
+        obs.event("tenant_throttled", tenant=tenant, priority=priority,
+                  retry_after_s=round(retry_in, 3))
+        return tenant, priority, (
+            429,
+            {"error": "tenant admission budget exhausted",
+             "kind": "tenant_budget", "tenant": tenant,
+             "retry_after_s": round(retry_in, 3)},
+            {"Retry-After": f"{retry_in:.3f}"},
+        )
+
+    def handle_session_open(self, handler):
+        """POST /v1/session: seat a streaming session against ONE
+        reference image (``ref_path`` | ``ref_b64``; optional ``c2f``
+        knob object pins the session's operating point). Opening is
+        host-side only — no device work until the first frame."""
+        with trace.trace("session_open") as root:
+            try:
+                failpoints.fire("server.handle")
+            except InjectedFault as exc:
+                obs.counter(
+                    "serving.errors",
+                    labels={**self.labels, "kind": "injected_fault"}).inc()
+                return 500, {"error": str(exc), "kind": "injected_fault"}, None
+            tenant, priority, err = self._resolve_tenant(handler)
+            if err is not None:
+                return err
+            try:
+                length = int(handler.headers.get("Content-Length", 0))
+                request = json.loads(handler.rfile.read(length) or b"{}")
+            except (ValueError, OSError) as exc:
+                obs.counter("serving.bad_requests", labels=self.labels).inc()
+                return 400, {"error": f"malformed request: {exc}"}, None
+            if not isinstance(request, dict):
+                obs.counter("serving.bad_requests", labels=self.labels).inc()
+                return 400, {"error": "request body must be a JSON "
+                             "object"}, None
+            ref_path = request.get("ref_path")
+            ref_b64 = request.get("ref_b64")
+            if bool(ref_path) == bool(ref_b64):
+                obs.counter("serving.bad_requests", labels=self.labels).inc()
+                return (400, {"error": "exactly one of ref_path/ref_b64 "
+                              "required"}, None)
+            op = None
+            knobs = request.get("c2f")
+            if knobs is not None:
+                if not isinstance(knobs, dict):
+                    obs.counter("serving.bad_requests",
+                                labels=self.labels).inc()
+                    return (400, {"error": "c2f must be a JSON object of "
+                                  "knobs"}, None)
+                try:
+                    op = self.engine._op_from_knobs(knobs)
+                except ValueError as exc:
+                    obs.counter("serving.bad_requests",
+                                labels=self.labels).inc()
+                    return 400, {"error": str(exc)}, None
+            digest = hashlib.sha256(
+                (ref_path or ref_b64).encode()).hexdigest()[:16]
+            try:
+                session = self.sessions.open(
+                    tenant or DEFAULT_TENANT, priority or "interactive",
+                    digest, ref_path=ref_path, ref_b64=ref_b64, op=op,
+                    trace_id=root.trace_id)
+            except SessionCapError as exc:
+                return (
+                    429,
+                    {"error": str(exc), "kind": "session_slots",
+                     "scope": exc.scope,
+                     "retry_after_s": exc.retry_after_s},
+                    {"Retry-After": f"{exc.retry_after_s:.3f}"},
+                )
+            return 200, {
+                "session_id": session.session_id,
+                "ttl_s": self.sessions.ttl_s,
+                "trace_id": root.trace_id,
+            }, None
+
+    def handle_session_close(self, sid: str):
+        """DELETE /v1/session/<id>: release the seat, return the
+        session's lifetime stats."""
+        try:
+            session = self.sessions.close(sid)
+        except SessionLostError as exc:
+            return (410, {"error": str(exc), "kind": "session_lost",
+                          "session_id": sid}, None)
+        obs.event("session_close", session_id=sid, frames=session.frames,
+                  seeded_frames=session.seeded_frames,
+                  reseeds=session.reseeds)
+        return 200, {
+            "session_id": sid,
+            "frames": session.frames,
+            "seeded_frames": session.seeded_frames,
+            "reseeds": session.reseeds,
+            "seed_hit_frac": round(session.seed_hit_frac(), 4),
+        }, None
+
+    def handle_session_frame(self, handler, sid: str):
+        """POST /v1/session/<id>/frame — one streaming query frame."""
+        with trace.trace("session_frame") as root:
+            try:
+                failpoints.fire("server.handle")
+            except InjectedFault as exc:
+                obs.counter(
+                    "serving.errors",
+                    labels={**self.labels, "kind": "injected_fault"}).inc()
+                return 500, {"error": str(exc), "kind": "injected_fault"}, None
+            return self._handle_frame_traced(handler, sid, root)
+
+    def _submit_frame(self, prepared, timeout_s, tenant, affinity, sticky):
+        """One dispatch of a prepared session frame (fleet: optionally
+        sticky to the seed's replica)."""
+        if self.fleet is not None:
+            return self.dispatcher.submit(
+                prepared.bucket_key, prepared, timeout_s=timeout_s,
+                tenant=tenant, affinity=affinity, sticky=sticky)
+        return self.batcher.submit(
+            prepared.bucket_key, prepared, timeout_s=timeout_s,
+            tenant=tenant)
+
+    def _handle_frame_traced(self, handler, sid, root):
+        t0 = time.monotonic()
+        obs.counter("serving.requests", labels=self.labels).inc()
+        tenant, priority, err = self._resolve_tenant(handler)
+        if err is not None:
+            return err
+        try:
+            session = self.sessions.get(sid)
+        except SessionLostError as exc:
+            return (410, {"error": str(exc), "kind": "session_lost",
+                          "session_id": sid}, None)
+        retry_in = (self.dispatcher.admit() if self.fleet is not None
+                    else self.breaker.admit())
+        if retry_in is not None:
+            obs.counter("serving.breaker_rejected", labels=self.labels).inc()
+            return (
+                503,
+                {"error": "service degraded (circuit breaker open)",
+                 "kind": "breaker_open",
+                 "retry_after_s": round(retry_in, 3)},
+                {"Retry-After": f"{retry_in:.3f}"},
+            )
+        # Session frames are degradable traffic like any other: the QoS
+        # ladder sheds / degrades them by the session's priority class
+        # (a rung's operating point differing from the seed's simply
+        # forces a re-seed at that rung — quality drops, the stream
+        # lives).
+        decision = None
+        if self.qos is not None:
+            self.qos.update()
+            decision = self.qos.resolve(priority or session.priority
+                                        or "interactive")
+            if decision.shed:
+                obs.counter(
+                    "serving.qos.shed",
+                    labels={**self.labels,
+                            "priority": priority or session.priority}).inc()
+                if tenant is not None:
+                    obs.counter(
+                        "serving.tenant.shed",
+                        labels={**self.labels, "tenant": tenant}).inc()
+                obs.event("qos_shed", tenant=tenant,
+                          priority=priority or session.priority,
+                          rung=decision.position)
+                return (
+                    503,
+                    {"error": "shedding %s traffic (overload)"
+                     % (priority or session.priority),
+                     "kind": "shed", "qos_rung": decision.position,
+                     "retry_after_s": decision.retry_after_s},
+                    {"Retry-After": f"{decision.retry_after_s:.3f}"},
+                )
+        # Frames within one session serialize on its lock: the seed
+        # chains frame N's gates into frame N+1's prepare, so the whole
+        # prepare -> submit -> record window is one critical section.
+        with session.lock:
+            reseeds_before = session.reseeds
+            t_admit = time.monotonic()
+            with trace.span("admit"):
+                try:
+                    length = int(handler.headers.get("Content-Length", 0))
+                    request = json.loads(handler.rfile.read(length) or b"{}")
+                except (ValueError, OSError) as exc:
+                    obs.counter("serving.bad_requests",
+                                labels=self.labels).inc()
+                    return 400, {"error": f"malformed request: {exc}"}, None
+                timeout_s = None
+                if isinstance(request, dict) \
+                        and request.get("deadline_ms") is not None:
+                    try:
+                        timeout_s = max(
+                            float(request["deadline_ms"]) / 1000.0, 1e-3)
+                    except (TypeError, ValueError):
+                        obs.counter("serving.bad_requests",
+                                    labels=self.labels).inc()
+                        return (400, {"error": "deadline_ms must be a "
+                                      "number"}, None)
+                rung_op = session.op
+                if decision is not None and decision.rung is not None:
+                    # Quality degradation: run THIS frame at the rung's
+                    # operating point instead of the session's pinned
+                    # one (the seed re-establishes at the rung).
+                    rung_op = self.engine._op_from_knobs(
+                        decision.rung.knobs())
+                    obs.counter("serving.qos.degraded",
+                                labels=self.labels).inc()
+                    if tenant is not None:
+                        obs.counter(
+                            "serving.tenant.degraded",
+                            labels={**self.labels, "tenant": tenant}).inc()
+                if session.seed is not None \
+                        and session.seed.op != rung_op:
+                    self.sessions.drop_seed(session, "qos_degrade",
+                                            trace_id=root.trace_id)
+                affinity = None
+                if session.seed is not None and self.fleet is not None:
+                    # Affinity health check BEFORE prepare: a seed whose
+                    # replica died re-seeds now, on a survivor.
+                    affinity = self.fleet.find(session.seed.replica_id)
+                    if affinity is None or not affinity.healthy:
+                        self.sessions.drop_seed(session, "replica_failover",
+                                                trace_id=root.trace_id)
+                        affinity = None
+                seed = session.seed
+                try:
+                    prepared = self.engine.prepare_session_frame(
+                        request,
+                        ref_path=session.ref_path,
+                        ref_b64=session.ref_b64,
+                        ref_feats=session.ref_feats,
+                        op=rung_op,
+                        seed=seed.gates if seed is not None else None,
+                        seed_bucket=seed.bucket if seed is not None
+                        else None)
+                except ValueError as exc:
+                    obs.counter("serving.bad_requests",
+                                labels=self.labels).inc()
+                    return 400, {"error": str(exc)}, None
+                if seed is not None \
+                        and prepared.session.get("seed") is None:
+                    # The frame snapped to a different bucket than the
+                    # seed was minted at (resolution change): full
+                    # coarse pass, fresh seed.
+                    self.sessions.drop_seed(session, "bucket_change",
+                                            trace_id=root.trace_id)
+                    seed = None
+                    affinity = None
+            admit_s = time.monotonic() - t_admit
+            sticky = (seed is not None and self.fleet is not None
+                      and affinity is not None)
+            wait_s = (timeout_s if timeout_s is not None
+                      else self._default_timeout_s) + DEADLINE_GRACE_S
+            br = None
+            for attempt in (0, 1):
+                try:
+                    fut = self._submit_frame(prepared, timeout_s, tenant,
+                                             affinity, sticky)
+                    br = fut.result(timeout=wait_s)
+                    break
+                except FutureTimeoutError:
+                    obs.counter("serving.deadline_exceeded",
+                                labels=self.labels).inc()
+                    return 504, {"error": "deadline exceeded"}, None
+                except (ReplicaDeadError, BreakerOpenError) as exc:
+                    if sticky and attempt == 0:
+                        # The replica holding the seed refused the frame
+                        # (killed / breaker-open mid-stream): re-seed —
+                        # not die — by re-preparing the SAME frame
+                        # without the seed and letting the dispatcher
+                        # place the full coarse pass on any survivor.
+                        # The frame is never dropped.
+                        self.sessions.drop_seed(session, "replica_failover",
+                                                trace_id=root.trace_id)
+                        try:
+                            prepared = self.engine.prepare_session_frame(
+                                request,
+                                ref_path=session.ref_path,
+                                ref_b64=session.ref_b64,
+                                ref_feats=session.ref_feats,
+                                op=rung_op, seed=None)
+                        except ValueError as exc2:
+                            obs.counter("serving.bad_requests",
+                                        labels=self.labels).inc()
+                            return 400, {"error": str(exc2)}, None
+                        seed = None
+                        affinity = None
+                        sticky = False
+                        continue
+                    obs.counter("serving.breaker_rejected",
+                                labels=self.labels).inc()
+                    retry_s = (round(exc.retry_after_s, 3)
+                               if isinstance(exc, BreakerOpenError) else 1.0)
+                    return (
+                        503,
+                        {"error": f"service degraded: {exc}",
+                         "kind": ("replica_dead"
+                                  if isinstance(exc, ReplicaDeadError)
+                                  else "breaker_open"),
+                         "retry_after_s": retry_s},
+                        {"Retry-After": f"{retry_s:.3f}"},
+                    )
+                except RejectedError as exc:
+                    if getattr(exc, "scope", "queue") == "tenant":
+                        obs.event("reject", depth=exc.depth, scope="tenant",
+                                  tenant=tenant,
+                                  retry_after_s=exc.retry_after_s)
+                        return (
+                            429,
+                            {"error": "tenant queue share exhausted",
+                             "kind": "tenant_slots", "tenant": tenant,
+                             "retry_after_s": exc.retry_after_s},
+                            {"Retry-After": f"{exc.retry_after_s:.3f}"},
+                        )
+                    obs.event("reject", depth=exc.depth,
+                              retry_after_s=exc.retry_after_s)
+                    return (503, {"error": "over capacity",
+                                  "kind": "over_capacity",
+                                  "retry_after_s": exc.retry_after_s},
+                            {"Retry-After": f"{exc.retry_after_s:.3f}"})
+                except PoisonRequestError as exc:
+                    obs.counter("serving.poison_requests",
+                                labels=self.labels).inc()
+                    obs.event("request_error", kind="poison",
+                              error=f"{type(exc.cause).__name__}: "
+                                    f"{exc.cause}")
+                    return (
+                        422,
+                        {"error": str(exc), "kind": "poison_request",
+                         "cause": f"{type(exc.cause).__name__}: "
+                                  f"{exc.cause}"},
+                        None,
+                    )
+                except RuntimeError as exc:  # draining for shutdown
+                    obs.counter("serving.errors",
+                                labels={**self.labels,
+                                        "kind": "draining"}).inc()
+                    return (503, {"error": str(exc), "kind": "draining"},
+                            {"Retry-After": "1"})
+                except Exception as exc:  # noqa: BLE001 — model -> 500
+                    obs.counter("serving.errors",
+                                labels={**self.labels,
+                                        "kind": "internal"}).inc()
+                    obs.event("request_error",
+                              error=f"{type(exc).__name__}: {exc}")
+                    return (500, {"error": f"{type(exc).__name__}: {exc}",
+                                  "kind": "internal"}, None)
+            if br is None:  # unreachable: loop returns or breaks
+                return 500, {"error": "frame dispatch fell through",
+                             "kind": "internal"}, None
+            rider = br.result.get("session") or {}
+            if rider.get("ref_feats") is not None \
+                    and session.ref_feats is None:
+                # Steady state from here: the reference features crossed
+                # to the host once; every later frame batches in the
+                # cached family with no reference re-extraction.
+                session.ref_feats = rider["ref_feats"]
+                session.ref_shape = tuple(rider["ref_feats"].shape)
+            base_bucket = prepared.bucket_key
+            if base_bucket and base_bucket[-1] == "seed":
+                base_bucket = base_bucket[:-1]
+            if session.ref_feats is not None:
+                # The seed is minted at the bucket the NEXT frame will
+                # snap to: once the reference features are captured,
+                # that is the feat-kind bucket, not this frame's
+                # img-kind one (first frame decodes the reference;
+                # every later frame rides the captured features).
+                kind = ("feat", tuple(session.ref_feats.shape))
+                base_bucket = (base_bucket[0], kind) + base_bucket[2:]
+            self.sessions.record_frame(
+                session,
+                seeded=bool(rider.get("seeded")),
+                gates=rider.get("gates"),
+                replica_id=rider.get("replica"),
+                op=rung_op,
+                bucket=base_bucket,
+                mass=rider.get("mass"),
+                trace_id=root.trace_id)
+            frame_no = session.frames
+            seed_hit = session.seed_hit_frac()
+            reseeded = session.reseeds > reseeds_before
+        t_respond = time.monotonic()
+        with trace.span("respond"):
+            engine_timing = br.result.get("timing", {})
+            payload = {
+                "matches": br.result["matches"].tolist(),
+                "n_matches": br.result["n_matches"],
+                "batch_size": br.batch_size,
+                "queue_wait_ms": round(br.queue_wait_s * 1e3, 3),
+                "run_ms": round(br.run_s * 1e3, 3),
+                "trace_id": root.trace_id,
+                "session": {
+                    "id": sid,
+                    "frame": frame_no,
+                    "seeded": bool(rider.get("seeded")),
+                    "reseeded": reseeded,
+                    "seed_hit_frac": round(seed_hit, 4),
+                },
+            }
+        respond_s = time.monotonic() - t_respond
+        e2e_s = time.monotonic() - t0
+        payload["latency_ms"] = round(e2e_s * 1e3, 3)
+        if decision is not None:
+            payload["qos"] = {"rung": decision.position,
+                              "degraded": decision.rung is not None}
+        payload["timing"] = {
+            "admit_ms": round(admit_s * 1e3, 3),
+            "queue_wait_ms": round(br.queue_wait_s * 1e3, 3),
+            "batch_assemble_ms": round(
+                engine_timing.get("batch_assemble_ms", 0.0), 3),
+            "device_ms": round(engine_timing.get("device_ms", 0.0), 3),
+            "respond_ms": round(respond_s * 1e3, 3),
+            "total_ms": round(e2e_s * 1e3, 3),
+        }
+        for key, val in engine_timing.items():
+            payload["timing"].setdefault(key, round(val, 3))
+        obs.counter("serving.responses", labels=self.labels).inc()
+        if tenant is not None:
+            obs.counter(
+                "serving.tenant.responses",
+                labels={**self.labels, "tenant": tenant,
+                        "priority": priority}).inc()
+            obs.histogram(
+                "serving.tenant.e2e_latency_s",
+                labels={**self.labels, "tenant": tenant}).observe(e2e_s)
+        obs.histogram("serving.session.frame_latency_s",
+                      labels=self.labels).observe(
+                          e2e_s, trace_id=root.trace_id)
+        obs.event(
+            "session_frame",
+            session_id=sid,
+            frame=frame_no,
+            seeded=bool(rider.get("seeded")),
+            reseeded=reseeded,
+            bucket=repr(prepared.bucket_key),
+            n_matches=br.result["n_matches"],
+            e2e_s=round(e2e_s, 6),
+            trace_id=root.trace_id,
+        )
+        exemplar.observe_request(
+            "v1_session_frame", e2e_s, root.trace_id,
+            threshold_s=self.slo_p99_target_s, labels=self.labels)
+        return 200, payload, None
+
     # -- lifecycle --------------------------------------------------------
 
     @property
@@ -870,6 +1384,24 @@ def main(argv=None):
     parser.add_argument("--c2f_radius", type=int, default=None,
                         help="refinement window half-extent in coarse "
                         "cells (default: model config)")
+    parser.add_argument("--max_sessions", type=int, default=64,
+                        help="streaming-session table seats "
+                        "(POST /v1/session past this = 429)")
+    parser.add_argument("--session_ttl_s", type=float, default=300.0,
+                        help="idle seconds before a session is evicted "
+                        "(later frames get 410 session_lost)")
+    parser.add_argument("--tenant_session_frac", type=float, default=0.0,
+                        help="cap any single tenant at this fraction of "
+                        "the session seats (0 disables)")
+    parser.add_argument("--session_reseed_frac", type=float, default=0.5,
+                        help="seeded frame surviving-score mass below "
+                        "this fraction of the seed's reference mass "
+                        "drops the seed (next frame re-runs the coarse "
+                        "pass)")
+    parser.add_argument("--session_seed_radius", type=int, default=1,
+                        help="Chebyshev dilation (coarse cells) applied "
+                        "to the previous frame's survivors when they "
+                        "gate the next session frame")
     parser.add_argument(
         "--run_log", type=str, default="",
         help="structured JSONL run log path (empty disables)",
@@ -905,6 +1437,7 @@ def main(argv=None):
         c2f_coarse_factor=args.c2f_coarse_factor,
         c2f_topk=args.c2f_topk,
         c2f_radius=args.c2f_radius,
+        session_seed_radius=args.session_seed_radius,
     )
     warmup_modes = tuple(
         m for m in args.warmup_modes.split(",") if m) or ("oneshot",)
@@ -1022,6 +1555,10 @@ def main(argv=None):
         qos=qos,
         tenants=tenants,
         tenant_queue_frac=tenant_queue_frac,
+        max_sessions=args.max_sessions,
+        session_ttl_s=args.session_ttl_s,
+        tenant_session_frac=args.tenant_session_frac or None,
+        session_reseed_frac=args.session_reseed_frac,
     ).start()
     print(f"serving on {server.url}", file=sys.stderr, flush=True)
     try:

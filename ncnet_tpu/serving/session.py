@@ -1,0 +1,310 @@
+"""Streaming video-session state: bounded, TTL-evicted, re-seedable.
+
+A session turns the one-pair ``/v1/match`` verb into a stream: the
+client opens a session against one reference image, then posts
+consecutive query frames. The coarse-to-fine machinery (ops/c2f.py) is
+the unlock — the previous frame's surviving coarse cells, dilated by
+``seed_radius``, nominate the next frame's refinement windows
+(:func:`~ncnet_tpu.ops.c2f.refine_from_seed`), so the steady state
+skips the full coarse pass entirely. This module owns everything about
+a session EXCEPT the device work:
+
+* **Bounded per-session state** (:class:`Session`): session id,
+  reference identity digest, the reference features once computed, the
+  last frame's surviving cells + match table per direction
+  (:class:`Seed`), a monotonic frame counter, and the affinity replica.
+  The table is bounded two ways: ``max_sessions`` seats total and an
+  optional per-tenant share (``tenant_frac``), so one tenant cannot
+  hold every seat — opening past either bound raises
+  :class:`SessionCapError` (the server's 429 ``session_slots``).
+
+* **Idle TTL eviction**: sessions untouched for ``ttl_s`` are evicted
+  opportunistically on every open/lookup (clock-injected — the tests
+  drive it with a fake clock). An evicted or unknown id raises
+  :class:`SessionLostError` (the server's 410 ``session_lost``; the
+  client transparently re-opens, serving/client.py).
+
+* **The re-seed decision** (:meth:`SessionManager.record_frame`): a
+  seeded frame reports its surviving-score mass; the first seeded
+  frame after a (re)seed establishes the reference mass, and a later
+  frame falling below ``reseed_frac`` of it drops the seed so the NEXT
+  frame runs a full coarse pass. Replica failover and QoS operating-
+  point changes drop the seed the same way (:meth:`drop_seed`) —
+  sessions re-seed, they never die with the replica
+  (docs/RELIABILITY.md, "re-seed, not die").
+
+Every transition feeds the ``serving.session.*`` metric family and the
+``session_open`` / ``session_reseed`` events (trace-linked via the
+caller's ``trace_id``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+from .. import obs
+
+
+class SessionError(Exception):
+    """Base class for session-layer failures."""
+
+
+class SessionLostError(SessionError):
+    """Unknown, closed, or TTL-evicted session id (HTTP 410)."""
+
+    def __init__(self, session_id: str):
+        super().__init__(f"session {session_id!r} not found "
+                         f"(closed, evicted, or never opened)")
+        self.session_id = session_id
+
+
+class SessionCapError(SessionError):
+    """No session seat available (HTTP 429 ``session_slots``).
+
+    ``scope`` says which bound refused: ``"table"`` (every seat taken)
+    or ``"tenant"`` (this tenant at its share while seats remain)."""
+
+    def __init__(self, scope: str, limit: int, retry_after_s: float = 1.0):
+        super().__init__(f"session table full (scope={scope}, "
+                         f"limit={limit}); retry later")
+        self.scope = scope
+        self.limit = limit
+        self.retry_after_s = retry_after_s
+
+
+@dataclass
+class Seed:
+    """One direction-pair of gate state nominated by the last frame.
+
+    ``gates`` is a 2-tuple (per-B probe, per-A probe) of
+    ``(top_cells, cell_scores, matched)`` numpy arrays — exactly the
+    host side of :func:`~ncnet_tpu.ops.c2f.coarse_gate`'s output, which
+    is also what :func:`~ncnet_tpu.ops.c2f.refine_from_seed` consumes.
+    ``mass_ref`` is the refined-scale surviving-score mass of the first
+    seeded frame after this seed was (re)established; None until then
+    (coarse-scale and refined-scale masses are not comparable, so the
+    quality check only starts once a refined reference exists).
+    """
+
+    gates: Tuple[tuple, tuple]
+    replica_id: Optional[str] = None
+    op: Optional[tuple] = None
+    #: Base bucket key the gates were minted at — seed geometry is
+    #: bucket-specific, so a frame snapping to a different bucket
+    #: (resolution change, QoS op change) re-seeds instead of riding it.
+    bucket: Optional[tuple] = None
+    mass_ref: Optional[float] = None
+
+
+@dataclass
+class Session:
+    """Bounded per-session state. Frames within one session serialize
+    on ``lock`` (the seed chains frame to frame)."""
+
+    session_id: str
+    tenant: str
+    priority: str
+    ref_digest: str
+    created: float
+    last_used: float
+    ref_path: Optional[str] = None
+    ref_b64: Optional[str] = None
+    ref_feats: Optional[object] = None   # np [1,C,h,w] once computed
+    ref_shape: Optional[tuple] = None
+    op: Optional[tuple] = None           # pinned c2f operating point
+    seed: Optional[Seed] = None
+    frames: int = 0
+    seeded_frames: int = 0
+    reseeds: int = 0
+    closed: bool = False
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def seed_hit_frac(self) -> float:
+        return self.seeded_frames / self.frames if self.frames else 0.0
+
+
+class SessionManager:
+    """The bounded session table + seed lifecycle + session metrics."""
+
+    def __init__(
+        self,
+        max_sessions: int = 64,
+        tenant_frac: Optional[float] = None,
+        ttl_s: float = 300.0,
+        reseed_frac: float = 0.5,
+        clock: Callable[[], float] = time.monotonic,
+        labels=None,
+    ):
+        if max_sessions < 1:
+            raise ValueError("max_sessions must be >= 1")
+        if tenant_frac is not None and not 0 < tenant_frac <= 1:
+            raise ValueError("tenant_frac must be in (0, 1]")
+        self.max_sessions = int(max_sessions)
+        self.tenant_frac = tenant_frac
+        self.ttl_s = float(ttl_s)
+        self.reseed_frac = float(reseed_frac)
+        self.clock = clock
+        self.labels = dict(labels or {})
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, Session] = {}
+        obs.gauge("serving.session.active", labels=self.labels).set(0.0)
+
+    # -- table ------------------------------------------------------------
+
+    def _set_active_locked(self) -> None:
+        obs.gauge("serving.session.active", labels=self.labels).set(
+            float(len(self._sessions)))
+
+    def _evict_idle_locked(self, now: float) -> int:
+        stale = [sid for sid, s in self._sessions.items()
+                 if now - s.last_used >= self.ttl_s]
+        for sid in stale:
+            s = self._sessions.pop(sid)
+            s.closed = True
+            obs.counter("serving.session.evicted", labels=self.labels).inc()
+            obs.event("session_evicted", session_id=sid, tenant=s.tenant,
+                      frames=s.frames, idle_s=round(now - s.last_used, 3))
+        if stale:
+            self._set_active_locked()
+        return len(stale)
+
+    def evict_idle(self) -> int:
+        """Evict every session idle past the TTL; returns the count."""
+        with self._lock:
+            return self._evict_idle_locked(self.clock())
+
+    def open(self, tenant: str, priority: str, ref_digest: str, *,
+             ref_path: Optional[str] = None,
+             ref_b64: Optional[str] = None,
+             op: Optional[tuple] = None,
+             trace_id: Optional[str] = None) -> Session:
+        """Seat a new session; raises :class:`SessionCapError` when the
+        table (or this tenant's share of it) is full."""
+        now = self.clock()
+        sid = uuid.uuid4().hex[:16]
+        with self._lock:
+            self._evict_idle_locked(now)
+            if len(self._sessions) >= self.max_sessions:
+                raise SessionCapError("table", self.max_sessions)
+            if self.tenant_frac is not None:
+                cap = max(1, int(self.max_sessions * self.tenant_frac))
+                held = sum(1 for s in self._sessions.values()
+                           if s.tenant == tenant)
+                if held >= cap:
+                    raise SessionCapError("tenant", cap)
+            session = Session(
+                session_id=sid, tenant=tenant, priority=priority,
+                ref_digest=ref_digest, created=now, last_used=now,
+                ref_path=ref_path, ref_b64=ref_b64, op=op,
+            )
+            self._sessions[sid] = session
+            self._set_active_locked()
+        obs.counter("serving.session.open", labels=self.labels).inc()
+        obs.event("session_open", session_id=sid, tenant=tenant,
+                  priority=priority, ref_digest=ref_digest,
+                  trace_id=trace_id)
+        return session
+
+    def get(self, session_id: str) -> Session:
+        """Look up + touch; raises :class:`SessionLostError` when the
+        id is unknown (never opened, closed, or TTL-evicted)."""
+        now = self.clock()
+        with self._lock:
+            self._evict_idle_locked(now)
+            session = self._sessions.get(session_id)
+            if session is None:
+                raise SessionLostError(session_id)
+            session.last_used = now
+            return session
+
+    def close(self, session_id: str) -> Session:
+        with self._lock:
+            session = self._sessions.pop(session_id, None)
+            if session is None:
+                raise SessionLostError(session_id)
+            session.closed = True
+            self._set_active_locked()
+        return session
+
+    def active(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    # -- seed lifecycle ---------------------------------------------------
+    #
+    # Callers hold ``session.lock`` across prepare -> submit -> record,
+    # so these helpers mutate the session without further locking.
+
+    def drop_seed(self, session: Session, reason: str,
+                  trace_id: Optional[str] = None) -> None:
+        """Invalidate the seed: the next frame runs a full coarse pass.
+        This is the re-seed half of the "re-seed, not die" contract —
+        called on replica failover, QoS operating-point change, and
+        seed-quality drop."""
+        if session.seed is None:
+            return
+        session.seed = None
+        session.reseeds += 1
+        obs.counter("serving.session.reseeds", labels=self.labels).inc()
+        obs.event("session_reseed", session_id=session.session_id,
+                  tenant=session.tenant, reason=reason,
+                  frame=session.frames, trace_id=trace_id)
+
+    def record_frame(self, session: Session, *, seeded: bool, gates,
+                     replica_id: Optional[str] = None,
+                     op: Optional[tuple] = None,
+                     bucket: Optional[tuple] = None,
+                     mass: Optional[float] = None,
+                     trace_id: Optional[str] = None) -> None:
+        """Book one completed frame and roll the seed forward.
+
+        ``gates`` is the next frame's nominator (numpy, both
+        directions; None when the frame ran a gate-less path — the
+        session then simply never seeds); ``mass`` is a seeded frame's
+        surviving-score mass. A mass below ``reseed_frac`` of the
+        seed's reference mass drops the seed, so the NEXT frame re-runs
+        the coarse pass.
+        """
+        session.frames += 1
+        session.last_used = self.clock()
+        obs.counter("serving.session.frames", labels=self.labels).inc()
+        if seeded:
+            session.seeded_frames += 1
+            obs.counter("serving.session.seeded_frames",
+                        labels=self.labels).inc()
+        obs.gauge("serving.session.seed_hit_frac", labels=self.labels).set(
+            session.seed_hit_frac())
+        if gates is None:
+            session.seed = None
+            return
+        prev = session.seed if seeded else None
+        session.seed = Seed(gates=gates, replica_id=replica_id, op=op,
+                            bucket=bucket,
+                            mass_ref=prev.mass_ref if prev else None)
+        if seeded and mass is not None:
+            if session.seed.mass_ref is None:
+                # First seeded frame after a (re)seed: refined-scale
+                # reference the quality check compares against.
+                session.seed.mass_ref = max(float(mass), 1e-12)
+            elif float(mass) < self.reseed_frac * session.seed.mass_ref:
+                self.drop_seed(session, "seed_quality", trace_id=trace_id)
+
+    # -- introspection ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /healthz ``sessions`` block (docs/SERVING.md)."""
+        with self._lock:
+            sessions = list(self._sessions.values())
+        return {
+            "active": len(sessions),
+            "max_sessions": self.max_sessions,
+            "ttl_s": self.ttl_s,
+            "tenant_frac": self.tenant_frac,
+            "reseed_frac": self.reseed_frac,
+            "seeded_frames": sum(s.seeded_frames for s in sessions),
+            "frames": sum(s.frames for s in sessions),
+        }

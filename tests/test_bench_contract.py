@@ -295,6 +295,103 @@ def test_bench_serving_tenants_mode_contract(tiny_serving_model, capsys):
                             "--tenants", "a:batch:1"])
 
 
+def test_bench_serving_session_mode_contract(tiny_serving_model, capsys):
+    """tools/bench_serving.py --session (ISSUE 13): one streaming
+    session (open -> frames -> close) against a one-shot c2f baseline
+    of the SAME frames; ONE JSON line with frames/s, the seeded /
+    unseeded / full-c2f latency split, and the seed hit accounting
+    (structure asserted, not the speedup number: CPU boxes jitter)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import json as _json
+
+    import bench_serving
+
+    rc = bench_serving.main([
+        "--replicas", "1", "--session", "--synthetic", "96x128",
+        "--frames", "6", "--warmup_frames", "1", "--max_batch", "2",
+    ], model=tiny_serving_model)
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = _json.loads(lines[0])
+    assert rec["metric"] == "serving_session_fps"
+    assert rec["unit"] == "frames/s"
+    assert rec["value"] > 0
+    assert rec["frames"] == 6
+    assert rec["warmup_frames"] == 1
+    assert rec["errors"] == 0
+    # Frame 1 runs the full coarse pass; every later frame rides the
+    # previous frame's seed (no kills in this run -> no re-seeds).
+    assert rec["seeded_frames"] >= 4
+    assert rec["seed_hit_frac"] > 0
+    assert rec["reseeds"] == 0
+    lat = rec["latency_ms"]
+    assert lat["full_c2f"]["n"] == 5 and lat["full_c2f"]["p50"] > 0
+    assert lat["seeded"]["n"] >= 3 and lat["seeded"]["p50"] > 0
+    # Post-warmup session frames are all accounted seeded-or-not.
+    assert lat["seeded"]["n"] + lat["unseeded"]["n"] == 5
+    assert rec["seeded_speedup_p50"] is not None
+    assert rec["seeded_speedup_p50"] > 0
+    # Frames are generated client-side: --session without --synthetic
+    # is a usage error, not a silent fallback.
+    with pytest.raises(SystemExit):
+        bench_serving.main(["--session", "--replicas", "1"],
+                           model=tiny_serving_model)
+
+
+def test_chaos_serving_session_stream_contract(tiny_serving_model, capsys):
+    """tools/chaos_serving.py --session_stream (ISSUE 13): streams over
+    a two-replica fleet with a kill window over EACH replica in turn —
+    whichever replica holds a stream's seed gets killed, so the gate
+    (a kill mid-stream must re-seed on a survivor, never kill the
+    session, drop a frame, or answer non-200) is exercised
+    deterministically."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import json as _json
+
+    import chaos_serving
+
+    rc = chaos_serving.main([
+        "--session_stream", "--replicas", "2", "--sessions", "2",
+        "--synthetic", "96x128", "--duration_s", "6",
+        "--fault", "kill_replica:0@1.0-2.5",
+        "--fault", "kill_replica:1@3.5-5.0",
+    ], model=tiny_serving_model)
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = _json.loads(lines[0])
+    assert rc == 0, f"gate violations: {rec['violations']}"
+    assert rec["metric"] == "chaos_session_stream"
+    assert rec["unit"] == "frac"
+    assert rec["value"] == 1.0, "every frame answered 200"
+    assert rec["violations"] == []
+    assert rec["session_deaths"] == []
+    assert rec["dropped"] == 0
+    assert rec["sessions"] == 2 and rec["replicas"] == 2
+    f = rec["frames"]
+    assert f["ok"] + f["rejected"] + f["errors"] == f["sent"]
+    assert f["errors"] == 0
+    assert f["seeded"] >= 1, "the stream rode its seed"
+    assert f["reseeded"] >= 1, "a kill window forced a re-seed"
+    assert rec["reseeds"] >= 1
+    # Both kill windows armed and disarmed on schedule.
+    for site, t0, t1 in (("kill_replica:0", 1.0, 2.5),
+                         ("kill_replica:1", 3.5, 5.0)):
+        assert rec["faults"][site] == [
+            {"t_s": t0, "action": "arm"}, {"t_s": t1, "action": "disarm"},
+        ]
+    # Every stream survived to a clean close with its counters.
+    assert len(rec["session_close"]) == 2
+    assert all(cs["frames"] >= 1 for cs in rec["session_close"])
+    # One replica is not a streaming fleet: there must be a survivor
+    # to re-seed on.
+    with pytest.raises(SystemExit):
+        chaos_serving.main(["--session_stream", "--replicas", "1",
+                            "--synthetic", "96x128",
+                            "--fault", "kill_replica:0@0.1-0.2"],
+                           model=tiny_serving_model)
+
+
 def test_autotune_cli_emits_one_json_line(tmp_path, capsys, monkeypatch):
     """tools/autotune_consensus.py stdout contract (ISSUE 3): run
     in-process with the fake timer (no device dial, no compiles) and a

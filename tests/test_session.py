@@ -1,0 +1,307 @@
+"""Streaming video-session matching (ISSUE 13).
+
+Unit half: the seed ops' geometry (dilate/select) and the
+full-coverage bitwise-equality contract — a seed covering every coarse
+cell makes :func:`~ncnet_tpu.ops.c2f.refine_from_seed` reproduce the
+coarse-gated refinement exactly, so seeding can only ever *restrict*
+the nomination set, never change the refinement math. Session-table
+half: TTL eviction, the seed-quality re-seed threshold, and the
+table/tenant seat caps, all on a fake clock.
+
+E2E half: the ``/v1/session`` verb over HTTP on a two-replica fleet —
+steady-state frames run seeded (no coarse stage in the timing block),
+a mid-stream kill of the seed-holding replica re-seeds on a survivor
+(the "re-seed, not die" contract), a lost session id answers 410 and
+the client transparently re-opens, and a full session table answers
+429 ``session_slots``.
+"""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ncnet_tpu.ops import neigh_consensus_init
+from ncnet_tpu.ops.c2f import (
+    coarse_gate,
+    dilate_seed,
+    refine_from_gate,
+    refine_from_seed,
+    seed_gate,
+)
+from ncnet_tpu.serving.session import (
+    SessionCapError,
+    SessionLostError,
+    SessionManager,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _jpeg_bytes(h, w, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    img = Image.fromarray((rng.random((h, w, 3)) * 255).astype("uint8"))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+# -- seed ops ---------------------------------------------------------------
+
+
+def test_dilate_seed_radius_zero_is_identity():
+    mask = dilate_seed(jnp.array([5], dtype=jnp.int32), grid=(4, 4),
+                       radius=0)
+    expect = np.zeros((4, 4), bool)
+    expect[1, 1] = True
+    assert np.array_equal(np.asarray(mask), expect)
+
+
+def test_dilate_seed_chebyshev_radius_one():
+    # Cell 5 = (1, 1) on a 4x4 grid: radius 1 covers the 3x3 block
+    # around it; a corner seed (cell 15 = (3, 3)) clips at the edge.
+    mask = dilate_seed(jnp.array([5, 15], dtype=jnp.int32), grid=(4, 4),
+                      radius=1)
+    expect = np.zeros((4, 4), bool)
+    expect[0:3, 0:3] = True
+    expect[2:4, 2:4] = True
+    assert np.array_equal(np.asarray(mask), expect)
+
+
+def test_seed_gate_full_coverage_equals_coarse_gate(rng):
+    # A seed containing every coarse cell reduces seed_gate EXACTLY to
+    # coarse_gate's selection over the same cell_scores (the docstring
+    # contract in ops/c2f.py).
+    ha = wa = hb = wb = 3
+    coarse4d = jnp.asarray(
+        rng.rand(1, 1, ha, wa, hb, wb).astype(np.float32))
+    topk = 4
+    ts, tc, cs, mb = coarse_gate(coarse4d, topk)
+    all_cells = jnp.arange(ha * wa, dtype=jnp.int32)
+    s_ts, s_tc, s_cs, s_mb = seed_gate(
+        all_cells, cs, mb, grid=(ha, wa), seed_radius=0, topk=topk)
+    assert np.array_equal(np.asarray(ts), np.asarray(s_ts))
+    assert np.array_equal(np.asarray(tc), np.asarray(s_tc))
+    assert np.array_equal(np.asarray(cs), np.asarray(s_cs))
+    assert np.array_equal(np.asarray(mb), np.asarray(s_mb))
+
+
+def test_refine_from_seed_full_coverage_bitwise(rng):
+    # Full pipeline equality: refine_from_seed with a full-coverage
+    # seed produces bit-identical match fields to coarse_gate +
+    # refine_from_gate (same gather, same consensus, same splice).
+    stride, radius, topk = 2, 1, 4
+    ha = wa = hb = wb = 2  # coarse grids; fine = coarse * stride
+    c = 8
+    feat_a = jnp.asarray(
+        rng.rand(1, c, ha * stride, wa * stride).astype(np.float32))
+    feat_b = jnp.asarray(
+        rng.rand(1, c, hb * stride, wb * stride).astype(np.float32))
+    coarse4d = jnp.asarray(
+        rng.rand(1, 1, ha, wa, hb, wb).astype(np.float32))
+    consensus = neigh_consensus_init(
+        jax.random.PRNGKey(0), (3, 3), (16, 1))
+
+    _ts, tc, cs, mb = coarse_gate(coarse4d, topk)
+    kw = dict(coarse_shape=(ha, wa, hb, wb), stride=stride, radius=radius,
+              symmetric=True, corr_dtype=jnp.float32)
+    base = refine_from_gate(consensus, tc, cs, mb, feat_a, feat_b, **kw)
+    seeded, new_gate = refine_from_seed(
+        consensus, jnp.arange(ha * wa, dtype=jnp.int32), cs, mb,
+        feat_a, feat_b, seed_radius=0, topk=topk, **kw)
+    for a, b in zip(base, seeded):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # The updated gate has coarse_gate's tuple shape — next frame's
+    # nominator stays structurally identical frame over frame (the
+    # engine's seeded program relies on this to avoid retraces).
+    assert len(new_gate) == 4
+    assert np.asarray(new_gate[1]).shape == (topk,)
+    assert np.asarray(new_gate[2]).shape == (ha * wa,)
+    assert np.asarray(new_gate[3]).shape == (ha * wa,)
+
+
+# -- session table ----------------------------------------------------------
+
+
+def _gates():
+    """Minimal well-formed gates payload (numpy, both directions)."""
+    one = (np.arange(4, dtype=np.int32), np.ones(4, np.float32),
+           np.zeros(4, np.int32))
+    return (one, one)
+
+
+def test_session_ttl_eviction_fake_clock():
+    clock = FakeClock()
+    mgr = SessionManager(max_sessions=4, ttl_s=10.0, clock=clock)
+    s = mgr.open("default", "interactive", "digest", ref_b64="x")
+    assert mgr.get(s.session_id) is s
+    clock.t = 9.0
+    assert mgr.get(s.session_id) is s  # touch resets idleness
+    clock.t = 19.5
+    assert mgr.evict_idle() == 1
+    with pytest.raises(SessionLostError):
+        mgr.get(s.session_id)
+    assert mgr.active() == 0
+
+
+def test_session_get_unknown_and_closed_raise():
+    mgr = SessionManager(max_sessions=2, clock=FakeClock())
+    with pytest.raises(SessionLostError):
+        mgr.get("nope")
+    s = mgr.open("default", "interactive", "digest", ref_b64="x")
+    mgr.close(s.session_id)
+    with pytest.raises(SessionLostError):
+        mgr.get(s.session_id)
+    with pytest.raises(SessionLostError):
+        mgr.close(s.session_id)
+
+
+def test_seed_quality_threshold_drives_reseed():
+    mgr = SessionManager(max_sessions=2, reseed_frac=0.5,
+                         clock=FakeClock())
+    s = mgr.open("default", "interactive", "digest", ref_b64="x")
+    # Full-coarse frame mints the seed; coarse-scale mass is not a
+    # reference (refined-scale masses are not comparable to it).
+    mgr.record_frame(s, seeded=False, gates=_gates(), replica_id="d0",
+                     bucket=("b",))
+    assert s.seed is not None and s.seed.mass_ref is None
+    # First seeded frame establishes the refined-scale reference.
+    mgr.record_frame(s, seeded=True, gates=_gates(), mass=10.0)
+    assert s.seed.mass_ref == 10.0
+    # At/above the threshold the seed rolls forward (mass_ref sticks).
+    mgr.record_frame(s, seeded=True, gates=_gates(), mass=6.0)
+    assert s.seed is not None and s.reseeds == 0
+    assert s.seed.mass_ref == 10.0
+    # Below reseed_frac * mass_ref: the seed drops, the NEXT frame
+    # re-runs the coarse pass.
+    mgr.record_frame(s, seeded=True, gates=_gates(), mass=4.0)
+    assert s.seed is None
+    assert s.reseeds == 1
+    assert s.frames == 4 and s.seeded_frames == 3
+    # Gate-less frame (degenerate op path): the session simply never
+    # seeds, without counting a re-seed.
+    mgr.record_frame(s, seeded=False, gates=None)
+    assert s.seed is None and s.reseeds == 1
+
+
+def test_session_table_and_tenant_caps():
+    mgr = SessionManager(max_sessions=2, tenant_frac=0.5,
+                         clock=FakeClock())
+    mgr.open("t1", "interactive", "d", ref_b64="x")
+    with pytest.raises(SessionCapError) as exc:
+        mgr.open("t1", "interactive", "d", ref_b64="x")
+    assert exc.value.scope == "tenant" and exc.value.limit == 1
+    mgr.open("t2", "interactive", "d", ref_b64="x")
+    with pytest.raises(SessionCapError) as exc:
+        mgr.open("t3", "interactive", "d", ref_b64="x")
+    assert exc.value.scope == "table" and exc.value.limit == 2
+    snap = mgr.snapshot()
+    assert snap["active"] == 2 and snap["max_sessions"] == 2
+
+
+# -- HTTP e2e ---------------------------------------------------------------
+
+
+def _session_fleet_server(model, **server_kw):
+    from ncnet_tpu.serving.fleet import MatchFleet
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = model
+    fleet = MatchFleet.build(
+        config, params, n_replicas=2, base_id="sess", cache_mb=0,
+        engine_kwargs=dict(k_size=2, image_size=64),
+        replica_kwargs=dict(max_batch=2, max_delay_s=0.01,
+                            default_timeout_s=120.0),
+    )
+    server_kw.setdefault("slo_p99_target_s", 60.0)
+    server = MatchServer(None, port=0, fleet=fleet, **server_kw).start()
+    return fleet, server
+
+
+def test_session_stream_kill_reseeds_and_reopen(tiny_serving_model):
+    """The acceptance scenario over real HTTP: seeded steady state,
+    replica kill mid-stream re-seeds on the survivor with a 200 (never
+    a dead session), and a server-side close answers 410 which the
+    client absorbs with one transparent re-open."""
+    from ncnet_tpu.serving.client import MatchClient
+
+    fleet, server = _session_fleet_server(tiny_serving_model)
+    ref = _jpeg_bytes(96, 128, 1)
+    frames = [_jpeg_bytes(96, 128, s) for s in range(2, 6)]
+    try:
+        client = MatchClient(server.url, timeout_s=120.0, retries=0)
+        with client.session(ref_bytes=ref) as s:
+            first = s.frame(query_bytes=frames[0])
+            assert first["n_matches"] >= 1
+            assert first["session"]["seeded"] is False
+            assert first["session"]["frame"] == 1
+
+            second = s.frame(query_bytes=frames[1])
+            assert second["session"]["seeded"] is True
+            # Steady state: the coarse stage never dispatched.
+            assert "coarse_ms" not in second["timing"]
+            assert "refine_ms" in second["timing"]
+
+            # Kill the replica holding the seed: the next frame must
+            # answer 200 on a survivor and report the re-seed.
+            sess = server.sessions.get(s.session_id)
+            holder = sess.seed.replica_id
+            assert holder in {"sess-d0", "sess-d1"}
+            fleet.kill(holder)
+            third = s.frame(query_bytes=frames[2])
+            assert third["n_matches"] >= 1
+            assert third["session"]["reseeded"] is True
+            assert third["session"]["seeded"] is False  # full coarse pass
+            fleet.revive(holder)
+
+            # Seed re-establishes on the survivor's full-coarse gates.
+            fourth = s.frame(query_bytes=frames[3])
+            assert fourth["session"]["seeded"] is True
+
+            sess = server.sessions.get(s.session_id)
+            assert sess.frames == 4
+            assert sess.reseeds >= 1
+
+            # Server-side loss (TTL eviction stand-in): the client
+            # absorbs the 410 with exactly one transparent re-open.
+            server.sessions.close(s.session_id)
+            fifth = s.frame(query_bytes=frames[0])
+            assert fifth["n_matches"] >= 1
+            assert s.reopens == 1
+
+            hz = client.healthz()
+            assert hz["sessions"]["active"] == 1
+
+            # close() answers for the RE-OPENED session (the original
+            # died server-side): one frame, no re-seeds yet.
+            stats = s.close()
+            assert stats is not None
+            assert stats["frames"] == 1
+    finally:
+        server.stop()
+
+
+def test_session_table_full_answers_429_session_slots(tiny_serving_model):
+    from ncnet_tpu.serving.client import MatchClient, OverCapacityError
+
+    fleet, server = _session_fleet_server(tiny_serving_model,
+                                          max_sessions=1)
+    ref = _jpeg_bytes(96, 128, 1)
+    try:
+        client = MatchClient(server.url, timeout_s=120.0, retries=0)
+        with client.session(ref_bytes=ref):
+            with pytest.raises(OverCapacityError):
+                with client.session(ref_bytes=ref):
+                    pass
+    finally:
+        server.stop()

@@ -49,6 +49,15 @@ device per replica (``parallel.serving_devices``).
 
     python tools/bench_serving.py --replicas 8 --synthetic 96x128 \
         --rate 2 --duration_s 5
+
+**Session mode** (``--session``): one streaming video session (open ->
+``--frames`` frames -> close) against a baseline of the same frames as
+one-shot ``mode='c2f'`` requests; prints one ``serving_session_fps``
+line with the seeded / unseeded / full-coarse latency split and the
+seed-hit fraction::
+
+    python tools/bench_serving.py --replicas 1 --session \
+        --synthetic 96x128 --frames 16
 """
 
 from __future__ import annotations
@@ -75,15 +84,17 @@ def percentile(sorted_vals, q):
     return sorted_vals[idx]
 
 
-def synth_jpegs(spec, seed=0):
-    """Two random JPEGs (query, pano) at HxW — encoded once, sent inline."""
+def synth_jpegs(spec, seed=0, n=2):
+    """``n`` random JPEGs at HxW — encoded once, sent inline. The
+    default two are the (query, pano) pair; session mode asks for a
+    reference plus one image per frame."""
     import numpy as np
     from PIL import Image
 
     h, w = (int(v) for v in spec.split("x"))
     rng = np.random.default_rng(seed)
     out = []
-    for _ in range(2):
+    for _ in range(n):
         img = Image.fromarray(
             (rng.random((h, w, 3)) * 255).astype("uint8")
         )
@@ -370,6 +381,144 @@ def fleet_bench(args, model=None):
     return 0 if bad == 0 else 1
 
 
+def session_bench(args, model=None):
+    """Streaming-session bench (``--session``): one video-style stream,
+    open -> N frames -> close, against a baseline of the SAME frames as
+    one-shot ``mode='c2f'`` /v1/match requests. The split answers the
+    tentpole question directly: what does frame-to-frame seeding save
+    over re-running the coarse pass (and the reference extraction)
+    every frame? Prints one ``serving_session_fps`` JSON line.
+
+    Warmup frames are excluded from the latency stats on BOTH sides
+    (the first baseline request compiles the c2f programs; the first
+    session frames compile the cached-coarse and seeded programs) —
+    the bench measures serving, not XLA.
+    """
+    from ncnet_tpu.serving.client import MatchClient
+
+    n_frames = args.frames
+    warm = min(args.warmup_frames, max(0, n_frames - 1))
+    imgs = synth_jpegs(args.synthetic, n=n_frames + 1)
+    ref, frames = imgs[0], imgs[1:]
+
+    server = None
+    if args.replicas > 0:
+        from ncnet_tpu.serving.fleet import MatchFleet
+        from ncnet_tpu.serving.server import MatchServer
+
+        if model is None:
+            from ncnet_tpu.cli.common import build_model
+
+            note("building tiny model (pass model= to reuse one "
+                 "in-process)")
+            model = build_model(
+                ncons_kernel_sizes=(3, 3),
+                ncons_channels=(16, 1),
+                relocalization_k_size=2,
+                half_precision=True,
+                backbone_bf16=True,
+            )
+        config, params = model
+        fleet = MatchFleet.build(
+            config, params,
+            n_replicas=args.replicas,
+            base_id="sess",
+            cache_mb=0,
+            engine_kwargs=dict(k_size=2, image_size=args.image_size,
+                               c2f_topk=args.c2f_topk),
+            replica_kwargs=dict(
+                max_batch=args.max_batch,
+                max_delay_s=args.max_delay_ms / 1e3,
+                default_timeout_s=600.0,
+            ),
+        )
+        server = MatchServer(None, port=0, fleet=fleet).start()
+        url = server.url
+    else:
+        url = args.url
+    client = MatchClient(url, timeout_s=600.0,
+                         retries=0 if args.no_retry else 2)
+    try:
+        # Phase 1: one-shot c2f baseline — every frame pays the full
+        # coarse pass AND the reference feature extraction.
+        note(f"phase 1/2: {n_frames} one-shot c2f frames (baseline)")
+        full_ms, errors = [], 0
+        for i, fb in enumerate(frames):
+            t = time.monotonic()
+            try:
+                client.match(query_bytes=fb, pano_bytes=ref, mode="c2f",
+                             max_matches=args.max_matches)
+            except Exception as exc:  # noqa: BLE001 — counted, reported
+                errors += 1
+                note(f"baseline error on frame {i}: {exc}")
+                continue
+            if i >= warm:
+                full_ms.append((time.monotonic() - t) * 1e3)
+
+        # Phase 2: the stream — one session, same frames.
+        note(f"phase 2/2: session stream, {n_frames} frames")
+        seeded_ms, unseeded_ms = [], []
+        seeded_n = reseeds = 0
+        t0 = time.monotonic()
+        with client.session(ref_bytes=ref) as s:
+            for i, fb in enumerate(frames):
+                t = time.monotonic()
+                try:
+                    resp = s.frame(query_bytes=fb,
+                                   max_matches=args.max_matches)
+                except Exception as exc:  # noqa: BLE001
+                    errors += 1
+                    note(f"session error on frame {i}: {exc}")
+                    continue
+                dt_ms = (time.monotonic() - t) * 1e3
+                sess = resp.get("session", {})
+                if sess.get("seeded"):
+                    seeded_n += 1
+                if i >= warm:
+                    (seeded_ms if sess.get("seeded")
+                     else unseeded_ms).append(dt_ms)
+            elapsed = time.monotonic() - t0
+            stats = s.close() or {}
+            reseeds = stats.get("reseeds", 0)
+    finally:
+        if server is not None:
+            server.stop()
+
+    full_ms.sort()
+    seeded_ms.sort()
+    unseeded_ms.sort()
+    done = len(seeded_ms) + len(unseeded_ms)
+
+    def _split(vals):
+        return {"p50": round(percentile(vals, 50), 3) if vals else None,
+                "p99": round(percentile(vals, 99), 3) if vals else None,
+                "n": len(vals)}
+
+    seeded_p50 = percentile(seeded_ms, 50) if seeded_ms else None
+    full_p50 = percentile(full_ms, 50) if full_ms else None
+    rec = {
+        "metric": "serving_session_fps",
+        "value": round(done / elapsed, 4) if elapsed > 0 else 0.0,
+        "unit": "frames/s",
+        "frames": n_frames,
+        "warmup_frames": warm,
+        "seeded_frames": seeded_n,
+        "seed_hit_frac": round(seeded_n / n_frames, 4) if n_frames else 0.0,
+        "reseeds": reseeds,
+        "latency_ms": {
+            "seeded": _split(seeded_ms),
+            "unseeded": _split(unseeded_ms),
+            "full_c2f": _split(full_ms),
+        },
+        "seeded_speedup_p50": round(full_p50 / seeded_p50, 4)
+        if seeded_p50 and full_p50 else None,
+        "errors": errors,
+        "duration_s": round(elapsed, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    return 0 if errors == 0 else 1
+
+
 def main(argv=None, model=None):
     parser = argparse.ArgumentParser(
         description="open-loop load generator for the matching service"
@@ -413,6 +562,24 @@ def main(argv=None, model=None):
         "all concurrently; reports per-tenant availability/p99 and "
         "the QoS rungs visited (repeatable)",
     )
+    parser.add_argument("--session", action="store_true",
+                        help="streaming-session bench: open one "
+                        "/v1/session stream, post --frames frames, "
+                        "close; reports seeded vs full-coarse frame "
+                        "p50/p99 + seed-hit fraction (one "
+                        "serving_session_fps line). Needs --synthetic; "
+                        "works with --url or an in-process --replicas "
+                        "fleet")
+    parser.add_argument("--frames", type=int, default=16,
+                        help="session mode: frames per stream")
+    parser.add_argument("--warmup_frames", type=int, default=2,
+                        help="session mode: leading frames excluded "
+                        "from latency stats (compile + first-seed "
+                        "cost)")
+    parser.add_argument("--c2f_topk", type=int, default=4,
+                        help="session mode, in-process fleet: coarse "
+                        "survivors refined per frame (keeps the c2f "
+                        "path non-degenerate at smoke image sizes)")
     parser.add_argument("--slo_availability", type=float, default=0.999,
                         help="availability objective for the SLO summary")
     parser.add_argument("--slo_p99_ms", type=float, default=0.0,
@@ -433,6 +600,12 @@ def main(argv=None, model=None):
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+    if args.session:
+        if not args.synthetic:
+            parser.error("--session needs --synthetic HxW (frames are "
+                         "generated client-side)")
+        return session_bench(args, model=model)
 
     if args.replicas > 0:
         if not args.synthetic:

@@ -67,6 +67,27 @@ overload ends with the controller correctly shedding the victim.
 Prints ONE JSON line: ``{"metric": "chaos_tenant_flood", "value":
 <victim availability frac>, ...}`` with per-tenant outcome counts,
 rungs visited, transition counts, and the violation list.
+
+``--session_stream`` runs the streaming-session chaos contract
+(docs/RELIABILITY.md, re-seed-not-die): ``--sessions`` concurrent
+video sessions stream closed-loop frames against an in-process
+replica fleet while ``kill_replica`` fault windows take replicas down
+mid-stream. The seed held by a killed replica is useless to the
+survivors, so the contract is that the session layer RE-SEEDS — the
+next frame pays one full coarse pass on a healthy replica and the
+stream continues. The gate FAILS (nonzero exit) if:
+
+* any session DIES (an exception escapes the stream — a kill must
+  never end a session);
+* any frame is silently dropped (sent but unaccounted);
+* any frame gets a non-retryable error (the re-seed path must answer
+  200, not 5xx);
+* a kill window was armed but no frame ever reported ``reseeded``
+  (the scenario proved nothing).
+
+Prints ONE JSON line: ``{"metric": "chaos_session_stream", "value":
+<delivered frac>, ...}`` with frame outcome counts, per-session close
+stats, re-seed counts, and the violation list.
 """
 
 from __future__ import annotations
@@ -342,6 +363,228 @@ def run_tenant_flood(args, model=None):
     return 0 if not violations else 1
 
 
+def run_session_stream(args, model=None):
+    """The streaming-session chaos contract (module docstring)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from ncnet_tpu import obs
+    from ncnet_tpu.serving.client import (
+        MatchClient,
+        OverCapacityError,
+        PoisonRequestError,
+        ServingError,
+    )
+    from ncnet_tpu.serving.fleet import MatchFleet
+    from ncnet_tpu.serving.server import MatchServer
+
+    windows = [parse_fault_window(s) for s in args.fault]
+    for _, site, _, _ in windows:
+        if not site.startswith("kill_replica"):
+            raise SystemExit("--session_stream only takes kill_replica "
+                             f"fault windows (got {site!r})")
+    if args.replicas < 2:
+        raise SystemExit("--session_stream needs --replicas >= 2 "
+                         "(a survivor to re-seed on)")
+    run_log = None
+    if args.run_log:
+        run_log = obs.init_run("chaos_serving", args.run_log, args=args)
+    if model is None:
+        from ncnet_tpu.cli.common import build_model
+
+        note("building tiny model (pass model= to reuse one in-process)")
+        model = build_model(
+            ncons_kernel_sizes=(3, 3),
+            ncons_channels=(16, 1),
+            relocalization_k_size=2,
+            half_precision=True,
+            backbone_bf16=True,
+        )
+    config, params = model
+    h, w = (int(v) for v in args.synthetic.split("x"))
+    fleet = MatchFleet.build(
+        config, params,
+        n_replicas=args.replicas,
+        base_id="chaos",
+        cache_mb=0,
+        engine_kwargs=dict(k_size=2, image_size=args.image_size,
+                           c2f_topk=4),
+        replica_kwargs=dict(
+            max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1e3,
+            default_timeout_s=max(args.duration_s * 4, 60.0),
+            breaker_threshold=args.breaker_threshold,
+            breaker_reset_s=args.breaker_reset_s,
+            isolate_poison=not args.no_isolate_poison,
+        ),
+    )
+    # Warm the WHOLE session program family on every replica before the
+    # measured clock starts: the open frame (full coarse from the ref
+    # image), the cached-ref full coarse (what a frame runs right after
+    # a re-seed), and the seeded refinement program. Leaving any of
+    # these to compile cold mid-run eats the duration in compile time
+    # and the kill windows never intersect live seeded traffic — the
+    # re-seed gate then fails on timing, not on correctness.
+    warm_batches = sorted({1, args.max_batch})
+    fleet.warmup([(h, w, h, w)], batch_sizes=warm_batches,
+                 modes=("oneshot", "c2f"))
+    sess_batches = sorted({1, min(args.max_batch, args.sessions)})
+    warm_imgs = synth_jpegs(args.synthetic, seed=11, n=2)
+    warm_ref = base64.b64encode(warm_imgs[0]).decode()
+    warm_q = base64.b64encode(warm_imgs[1]).decode()
+    t_warm = time.monotonic()
+    for r in fleet.replicas:
+        eng = r.engine
+        for n in sess_batches:
+            p1 = [eng.prepare_session_frame({"query_b64": warm_q},
+                                            ref_b64=warm_ref)
+                  for _ in range(n)]
+            out = eng.run_batch(p1[0].bucket_key, p1)
+            rider = out[0]["session"]
+            p2 = [eng.prepare_session_frame({"query_b64": warm_q},
+                                            ref_feats=rider["ref_feats"])
+                  for _ in range(n)]
+            eng.run_batch(p2[0].bucket_key, p2)
+            p3 = [eng.prepare_session_frame(
+                      {"query_b64": warm_q}, ref_feats=rider["ref_feats"],
+                      seed=rider["gates"], seed_bucket=p2[0].bucket_key)
+                  for _ in range(n)]
+            eng.run_batch(p3[0].bucket_key, p3)
+    note(f"session warmup: {len(fleet.replicas)} replica(s) x "
+         f"batch {sess_batches} in {time.monotonic() - t_warm:.1f}s")
+    server = MatchServer(
+        None, port=0,
+        max_batch=args.max_batch,
+        max_delay_s=args.max_delay_ms / 1e3,
+        default_timeout_s=max(args.duration_s * 4, 60.0),
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
+        isolate_poison=not args.no_isolate_poison,
+        run_log=run_log,
+        fleet=fleet,
+    ).start()
+    note(f"serving on {server.url} ({args.replicas} replicas); "
+         f"{args.sessions} session(s); fault windows: "
+         f"{[(t, a, b) for t, _, a, b in windows]}")
+
+    imgs = synth_jpegs(args.synthetic, seed=7, n=6)
+    ref, frame_pool = imgs[0], imgs[1:]
+    t0 = time.monotonic()
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = {"sent": 0, "ok": 0, "rejected": 0, "errors": 0,
+             "seeded": 0, "reseeded": 0}
+    deaths = []
+    close_stats = []
+
+    def stream(sess_idx):
+        client = MatchClient(
+            server.url, timeout_s=max(args.duration_s * 4, 60.0),
+            retries=args.client_retries,
+            retry_deadline_s=args.duration_s)
+        i = sess_idx  # offset so sessions don't send identical frames
+        try:
+            with client.session(ref_bytes=ref) as s:
+                while time.monotonic() - t0 < args.duration_s:
+                    fb = frame_pool[i % len(frame_pool)]
+                    i += 1
+                    with lock:
+                        stats["sent"] += 1
+                    try:
+                        resp = s.frame(query_bytes=fb)
+                    except OverCapacityError:
+                        with lock:
+                            stats["rejected"] += 1
+                        continue
+                    except (PoisonRequestError, ServingError,
+                            OSError) as exc:
+                        with lock:
+                            stats["errors"] += 1
+                        note(f"session {sess_idx} frame error: {exc}")
+                        continue
+                    info = resp.get("session") or {}
+                    with lock:
+                        stats["ok"] += 1
+                        if info.get("seeded"):
+                            stats["seeded"] += 1
+                        if info.get("reseeded"):
+                            stats["reseeded"] += 1
+                cs = s.close()
+                if cs is not None:
+                    with lock:
+                        close_stats.append(cs)
+        except Exception as exc:  # noqa: BLE001 — any escape IS the gate
+            with lock:
+                deaths.append(f"session {sess_idx}: {exc!r}")
+
+    fault_log = {}
+
+    def fault_scheduler():
+        events = sorted(
+            [(s0, "arm", site) for _, site, s0, _ in windows]
+            + [(e0, "disarm", site) for _, site, _, e0 in windows]
+        )
+        for at, action, site in events:
+            delay = t0 + at - time.monotonic()
+            if delay > 0 and stop.wait(delay):
+                return
+            idx = int(site.partition(":")[2] or -1)
+            if action == "arm":
+                r = fleet.kill(idx)
+                note(f"t+{at:.1f}s killed {r.replica_id}")
+            else:
+                r = fleet.revive(idx)
+                note(f"t+{at:.1f}s revived {r.replica_id}")
+            fault_log.setdefault(site, []).append(
+                {"t_s": at, "action": action})
+
+    threads = [threading.Thread(target=stream, args=(k,), daemon=True)
+               for k in range(args.sessions)]
+    aux = threading.Thread(target=fault_scheduler, daemon=True)
+    aux.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    aux.join(timeout=5)
+    elapsed = time.monotonic() - t0
+    server.stop()
+    if run_log is not None:
+        run_log.close("ok")
+
+    violations = list(deaths)
+    dropped = stats["sent"] - (stats["ok"] + stats["rejected"]
+                               + stats["errors"])
+    if dropped:
+        violations.append(f"{dropped} frame(s) unaccounted for")
+    if stats["errors"]:
+        violations.append(
+            f"{stats['errors']} non-retryable frame error(s) "
+            "(re-seed must answer 200)")
+    if windows and stats["reseeded"] < 1:
+        violations.append("kill window armed but no frame reseeded")
+    reseeds = sum(cs.get("reseeds", 0) for cs in close_stats)
+    rec = {
+        "metric": "chaos_session_stream",
+        "value": round(stats["ok"] / max(stats["sent"], 1), 4),
+        "unit": "frac",
+        "sessions": args.sessions,
+        "replicas": args.replicas,
+        "frames": stats,
+        "dropped": dropped,
+        "session_deaths": deaths,
+        "reseeds": reseeds,
+        "session_close": close_stats,
+        "faults": fault_log,
+        "violations": violations,
+        "duration_s": round(elapsed, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    if violations:
+        note("VIOLATIONS: " + "; ".join(violations))
+    return 0 if not violations else 1
+
+
 def main(argv=None, model=None):
     parser = argparse.ArgumentParser(
         description="chaos harness: in-process serving under load + faults"
@@ -411,9 +654,19 @@ def main(argv=None, model=None):
                         "--tenant_flood auto-raises it to the time the "
                         "device needs to drain two tenants' queue slots")
     parser.add_argument("--qos_step_up_hold_s", type=float, default=1.0)
+    parser.add_argument("--session_stream", action="store_true",
+                        help="run the streaming-session chaos contract "
+                        "instead of open-loop match load (module "
+                        "docstring): concurrent sessions must survive "
+                        "kill_replica windows by re-seeding")
+    parser.add_argument("--sessions", type=int, default=2,
+                        help="concurrent streaming sessions for "
+                        "--session_stream")
     args = parser.parse_args(argv)
     if args.tenant_flood:
         return run_tenant_flood(args, model)
+    if args.session_stream:
+        return run_session_stream(args, model)
     windows = [parse_fault_window(s) for s in args.fault]
     if any(site.startswith("kill_replica") for _, site, _, _ in windows) \
             and args.replicas < 2:

@@ -21,11 +21,14 @@ The checks:
   BENCH_r*.json trend; regression vs best prior same-metric round
   fails the gate.
 
-One OPTIONAL check rides behind a flag: ``--with-tenant-flood`` runs
-the multi-tenant QoS chaos contract (``tools/chaos_serving.py
+OPTIONAL checks ride behind flags: ``--with-tenant-flood`` runs the
+multi-tenant QoS chaos contract (``tools/chaos_serving.py
 --tenant_flood`` — victims stay 100% available while a flood tenant
-bursts 10x). It is off by default because it serves live traffic for
-several seconds; a default run still RECORDS it as
+bursts 10x), and ``--with-session-chaos`` runs the streaming-session
+chaos contract (``tools/chaos_serving.py --session_stream`` — a
+mid-stream replica kill must re-seed, never kill the session or drop
+a frame). Both are off by default because they serve live traffic for
+several seconds; a default run still RECORDS them as
 ``{"skipped": true, "optional": true}`` so the JSON never reads as if
 the contract were exercised when it was not.
 
@@ -56,7 +59,7 @@ _CPU_DROP = ("PALLAS_AXON_POOL_IPS",)
 CHECKS = ("tier1", "lint", "bench_trend")
 # Opt-in checks: never run by default, never silently green — a
 # default run records them as {"skipped": true, "optional": true}.
-OPTIONAL_CHECKS = ("tenant_flood",)
+OPTIONAL_CHECKS = ("tenant_flood", "session_chaos")
 
 
 def _run(cmd, timeout_s, cpu_env=False) -> dict:
@@ -113,6 +116,21 @@ def run_tenant_flood(timeout_s: float) -> dict:
         timeout_s, cpu_env=True)
 
 
+def run_session_chaos(timeout_s: float) -> dict:
+    # Short flavor of the re-seed-not-die contract: 2 replicas, 2
+    # streams, and a kill window over EACH replica in turn — whichever
+    # replica holds a stream's seed gets killed at some point, so the
+    # "a kill window must produce at least one re-seed" violation rule
+    # is deterministic, not a coin flip on seed placement.
+    return _run(
+        [sys.executable, os.path.join("tools", "chaos_serving.py"),
+         "--session_stream", "--replicas", "2", "--sessions", "2",
+         "--duration_s", "14",
+         "--fault", "kill_replica:0@3.0-6.0",
+         "--fault", "kill_replica:1@8.0-11.0"],
+        timeout_s, cpu_env=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--skip", action="append", default=[],
@@ -127,8 +145,13 @@ def main(argv=None) -> int:
                     help="also run the multi-tenant QoS chaos contract "
                          "(tools/chaos_serving.py --tenant_flood); off "
                          "by default, recorded as skipped when off")
+    ap.add_argument("--with-session-chaos", action="store_true",
+                    help="also run the streaming-session chaos contract "
+                         "(tools/chaos_serving.py --session_stream with "
+                         "a mid-stream replica kill); off by default, "
+                         "recorded as skipped when off")
     ap.add_argument("--chaos-timeout-s", type=float, default=300.0,
-                    help="wall-clock fence for the tenant_flood check")
+                    help="wall-clock fence for the optional chaos checks")
     args = ap.parse_args(argv)
 
     runners = {
@@ -136,8 +159,10 @@ def main(argv=None) -> int:
         "lint": lambda: run_lint(args.timeout_s),
         "bench_trend": lambda: run_bench_trend(args.timeout_s),
         "tenant_flood": lambda: run_tenant_flood(args.chaos_timeout_s),
+        "session_chaos": lambda: run_session_chaos(args.chaos_timeout_s),
     }
-    enabled = {"tenant_flood": args.with_tenant_flood}
+    enabled = {"tenant_flood": args.with_tenant_flood,
+               "session_chaos": args.with_session_chaos}
     checks = {}
     for name in CHECKS + OPTIONAL_CHECKS:
         if name in args.skip or not enabled.get(name, True):

@@ -4,10 +4,10 @@ The dashboard half of obs/aggregate.py: scrape every replica's
 ``GET /metrics`` each poll, merge the scrapes into a fleet view, and
 render a per-replica table to STDERR —
 
-    replica      req/s   err/s   p99 ms   queue  breaker  burn  hbm GB  head%  warm  rung
-    r0            12.4     0.0     38.2       1   closed   0.1    21.40     33     4     0
-    r1            11.9     0.0     41.7       0   closed   0.2    21.38     33     4     0
-    FLEET         24.3     0.0     40.9       1        -   0.2    42.78     33     8     0
+    replica      req/s   err/s   p99 ms   queue  breaker  burn  hbm GB  head%  warm  rung  sess
+    r0            12.4     0.0     38.2       1   closed   0.1    21.40     33     4     0     3
+    r1            11.9     0.0     41.7       0   closed   0.2    21.38     33     4     0     1
+    FLEET         24.3     0.0     40.9       1        -   0.2    42.78     33     8     0     4
       tenants: default=112  lowpri=38
 
 req/s and err/s are counter deltas between polls; p99 is exact at the
@@ -22,7 +22,9 @@ spending error budget faster than it earns it. hbm GB / head% read the
 the ``serving.warmup_programs`` counter, how many (bucket, batch,
 mode) programs the replica precompiled; rung is the
 ``serving.qos.rung`` gauge — the QoS controller's current ladder
-position ("-" on servers without the multi-tenant QoS layer) — and a
+position ("-" on servers without the multi-tenant QoS layer); sess is
+the ``serving.session.active`` gauge — open streaming sessions on
+that front door ("-" before the first session ever opens). A
 ``tenants:`` line breaks fleet-wide request totals out per
 ``serving.tenant.requests`` tenant label.
 
@@ -64,6 +66,7 @@ HBM_USE = "device_hbm_bytes_in_use"
 HBM_LIM = "device_hbm_limit_bytes"
 WARMED = "serving_warmup_programs"
 RUNG = "serving_qos_rung"
+SESSIONS = "serving_session_active"
 TENANT_REQS = "serving_tenant_requests"
 
 _BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
@@ -153,6 +156,7 @@ def render(view, prev_counters, dt, out=None):
             _headroom_pct(use, lim),
             rep["counters"].get(WARMED),
             rep["gauges"].get(RUNG),
+            rep["gauges"].get(SESSIONS),
         ))
     fleet_prev = (prev_counters or {}).get("FLEET")
     burn_entry = view["gauges"].get(BURN) or {}
@@ -170,18 +174,20 @@ def render(view, prev_counters, dt, out=None):
         _headroom_pct(fleet_use, fleet_lim),
         view["counters"].get(WARMED),
         (view["gauges"].get(RUNG) or {}).get("max"),
+        _gauge_sum(view, SESSIONS),
     ))
     w(f"{'replica':<12} {'req/s':>8} {'err/s':>8} {'p99 ms':>8} "
       f"{'queue':>6} {'breaker':>9} {'burn':>6} {'hbm GB':>7} "
-      f"{'head%':>6} {'warm':>5} {'rung':>5}\n")
+      f"{'head%':>6} {'warm':>5} {'rung':>5} {'sess':>5}\n")
     for (ident, rps, eps, p99, q, brk, burn, hbm, head, warm,
-         rung) in rows:
+         rung, sess) in rows:
         qs = f"{q:.0f}".rjust(6) if q is not None else "-".rjust(6)
         ws_ = f"{warm:.0f}".rjust(5) if warm is not None else "-".rjust(5)
         rg = f"{rung:.0f}".rjust(5) if rung is not None else "-".rjust(5)
+        ss = f"{sess:.0f}".rjust(5) if sess is not None else "-".rjust(5)
         w(f"{ident:<12} {_fmt(rps, 8)} {_fmt(eps, 8)} {_fmt(p99, 8)} "
           f"{qs} {brk:>9} {_fmt(burn, 6)} {_fmt(hbm, 7, 2)} "
-          f"{_fmt(head, 6, 0)} {ws_} {rg}\n")
+          f"{_fmt(head, 6, 0)} {ws_} {rg} {ss}\n")
     tenants = _tenant_totals(view["counters"])
     if tenants:
         w("  tenants: " + "  ".join(
@@ -244,6 +250,7 @@ def main(argv=None):
             "hbm_headroom_pct": _headroom_pct(use, lim),
             "warmed_programs": rep["counters"].get(WARMED),
             "qos_rung": rep["gauges"].get(RUNG),
+            "sessions": rep["gauges"].get(SESSIONS),
             "tenants": _tenant_totals(rep["counters"]),
         }
     fleet_use = _gauge_sum(view, HBM_USE)
@@ -262,6 +269,7 @@ def main(argv=None):
             "hbm_limit_bytes": fleet_lim,
             "warmed_programs": view["counters"].get(WARMED),
             "qos_rung": (view["gauges"].get(RUNG) or {}).get("max"),
+            "sessions": _gauge_sum(view, SESSIONS),
             "tenants": _tenant_totals(view["counters"]),
         },
         "polls": polls,

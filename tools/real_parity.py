@@ -170,6 +170,8 @@ def run_pfpascal(args):
         )
     if args.c2f:
         rec.update(_pfpascal_c2f_delta(args, config, params, mean_pck))
+    if args.session:
+        rec.update(_pfpascal_session_delta(args, config, params))
     return rec
 
 
@@ -231,6 +233,126 @@ def _pfpascal_c2f_delta(args, config, params, oneshot_pck):
         "c2f_topk": args.c2f_topk,
         "c2f_radius": args.c2f_radius,
         "c2f_within_gate": bool(abs(delta) <= 0.01),
+    }
+
+
+def _pfpascal_session_delta(args, config, params):
+    """A/B the streaming-session seeded refinement against full c2f.
+
+    Simulates the session steady state on the still-image benchmark:
+    per pair, "frame 1" runs the full c2f coarse pass and emits the
+    gate (ops/c2f.coarse_gate); "frame 2" is the SAME pair refined
+    purely from that seed dilated by --session_seed_radius
+    (ops/c2f.refine_from_seed) — the coarse pipeline never touches
+    frame 2, exactly what serving/engine.py's seeded program does. The
+    PCK delta vs a full c2f eval at the same snapped size is the
+    seeded-quality number docs/SERVING.md cites. Recorded, never
+    hard-failed — same ±0.01 report-only gate as --c2f.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.cli.eval_pck import evaluate_pck
+    from ncnet_tpu.data import DataLoader, PFPascalDataset
+    from ncnet_tpu.evals import pck_metric
+    from ncnet_tpu.models.ncnet import (
+        c2f_coarse_from_features,
+        c2f_stride,
+        extract_features,
+    )
+    from ncnet_tpu.ops.c2f import coarse_gate, refine_from_seed
+    from ncnet_tpu.ops.matches import relocalize_and_coords
+
+    if args.c2f_coarse_factor <= 1:
+        return {"session_skipped": "factor<=1 has no coarse stage to "
+                                   "seed from"}
+    c2f_config = dataclasses.replace(
+        config, mode="c2f",
+        c2f_coarse_factor=args.c2f_coarse_factor,
+        c2f_topk=args.c2f_topk,
+        c2f_radius=args.c2f_radius,
+    )
+    stride = args.c2f_coarse_factor * max(config.relocalization_k_size, 1)
+    unit = 16 * stride
+    size = max(unit, int(round(args.image_size / unit)) * unit)
+    csv = os.path.join(args.dataset_path, "image_pairs", "test_pairs.csv")
+    dataset = PFPascalDataset(
+        csv, args.dataset_path, output_size=(size, size),
+        pck_procedure="scnet",
+    )
+    log(f"evaluating full c2f PCK@{args.alpha} at {size} px (session "
+        "baseline) ...")
+    base_pck, _ = evaluate_pck(
+        c2f_config, params, dataset, args.batch_size, args.alpha,
+        num_workers=args.num_workers,
+    )
+    base_pck = float(base_pck)
+
+    log(f"evaluating seeded PCK@{args.alpha} (seed_radius="
+        f"{args.session_seed_radius}) ...")
+
+    @jax.jit
+    def step(params, source, target, batch_points):
+        def per_pair(feats):
+            fa, fb = (f[None] for f in feats)
+            coarse4d, _ = c2f_coarse_from_features(
+                c2f_config, params, fa, fb)
+            # Per-B probe direction (the eval convention): transpose
+            # the coarse tensor and swap feature roles.
+            coarse_t = jnp.transpose(coarse4d, (0, 1, 4, 5, 2, 3))
+            _, cells, cs, mb = coarse_gate(coarse_t, c2f_config.c2f_topk)
+            s = c2f_stride(c2f_config)
+            hb, wb = fb.shape[2] // s, fb.shape[3] // s
+            ha, wa = fa.shape[2] // s, fa.shape[3] // s
+            (i_b, j_b, i_a, j_a, score), _gate = refine_from_seed(
+                params["neigh_consensus"], cells, cs, mb, fb, fa,
+                coarse_shape=(hb, wb, ha, wa), stride=s,
+                radius=c2f_config.c2f_radius,
+                seed_radius=args.session_seed_radius,
+                topk=c2f_config.c2f_topk,
+                symmetric=c2f_config.symmetric_mode,
+                corr_dtype=c2f_config.corr_dtype,
+            )
+            fine_shape = (fa.shape[2], fa.shape[3],
+                          fb.shape[2], fb.shape[3])
+            return relocalize_and_coords(
+                i_a, j_a, i_b, j_b, score, None, 1, fine_shape,
+                "centered")
+
+        feat_a = extract_features(c2f_config, params, source)
+        feat_b = extract_features(c2f_config, params, target)
+        outs = jax.lax.map(per_pair, (feat_a, feat_b))
+        xa, ya, xb, yb, _ = (o[:, 0] for o in outs)
+        return pck_metric(batch_points, (xa, ya, xb, yb), args.alpha)
+
+    loader = DataLoader(dataset, args.batch_size, shuffle=False,
+                        num_workers=args.num_workers)
+    values = []
+    for batch in loader:
+        batch_points = {
+            k: jnp.asarray(batch[k])
+            for k in ("source_points", "target_points", "source_im_size",
+                      "target_im_size", "L_pck")
+        }
+        values.append(np.asarray(step(
+            params,
+            jnp.asarray(batch["source_image"]),
+            jnp.asarray(batch["target_image"]),
+            batch_points,
+        )))
+    per_pair = np.concatenate(values)
+    good = np.flatnonzero((per_pair != -1) & ~np.isnan(per_pair))
+    sess_pck = float(per_pair[good].mean()) if good.size else float("nan")
+    delta = sess_pck - base_pck
+    return {
+        "session_pck": round(sess_pck, 4),
+        "session_baseline_c2f_pck": round(base_pck, 4),
+        "session_pck_delta": round(delta, 4),
+        "session_image_size": size,
+        "session_seed_radius": args.session_seed_radius,
+        "session_within_gate": bool(abs(delta) <= 0.01),
     }
 
 
@@ -494,6 +616,14 @@ def main(argv=None):
     ap.add_argument("--c2f_coarse_factor", type=int, default=2)
     ap.add_argument("--c2f_topk", type=int, default=8)
     ap.add_argument("--c2f_radius", type=int, default=1)
+    ap.add_argument("--session", action="store_true",
+                    help="also eval the streaming-session seeded path "
+                    "(frame 1 c2f coarse emits the gate, frame 2 = same "
+                    "pair refined from the dilated seed) and record the "
+                    "PCK delta vs full c2f (report-only, like --c2f)")
+    ap.add_argument("--session_seed_radius", type=int, default=1,
+                    help="Chebyshev seed dilation, matching the serving "
+                    "engine's --session_seed_radius")
     ap.add_argument("--alpha", type=float, default=0.1)
     ap.add_argument("--batch_size", type=int, default=8)
     ap.add_argument("--num_workers", type=int, default=4)
