@@ -1,6 +1,12 @@
 """Evaluation: PCK keypoint transfer, TSS flow output, InLoc match export."""
 
 from .pck import pck, pck_metric
+from .agreement import (
+    delta_within_gate,
+    match_table_agreement,
+    mutual_nn_fraction,
+    within_tolerance,
+)
 from .flow_eval import dense_warp_grid, write_flow_output
 from .inloc import (
     c2f_device_matches,
@@ -16,6 +22,10 @@ from .inloc import (
 __all__ = [
     "pck",
     "pck_metric",
+    "delta_within_gate",
+    "match_table_agreement",
+    "mutual_nn_fraction",
+    "within_tolerance",
     "dense_warp_grid",
     "write_flow_output",
     "c2f_device_matches",

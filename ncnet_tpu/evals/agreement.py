@@ -1,0 +1,152 @@
+"""Shared match-quality comparison: one routine for both quality gates.
+
+The offline parity gate (``tools/real_parity.py``) and the online
+shadow comparator (``ncnet_tpu/serving/shadow.py``) both answer the
+same question — "do two match results agree within a pixel tolerance?"
+— and MUST keep answering it the same way, or the production quality
+numbers drift apart from the numbers the parity gate was calibrated
+on. This module is the single home for that math:
+
+* ``within_tolerance`` / ``delta_within_gate`` — the scalar gates
+  real_parity applies to PCK values and A/B deltas.
+* ``match_table_agreement`` — agreement@τ px between two serving match
+  tables (the ``[n, 5]`` ``(xa, ya, xb, yb, score)`` rows
+  ``serving/engine.py`` returns): the thresholded-distance criterion is
+  the same "endpoint within τ of reference" rule PCK uses
+  (``evals/pck.py``), applied per source keypoint instead of per
+  annotated keypoint.
+* ``mutual_nn_fraction`` — forward↔backward mutual-nearest-neighbour
+  agreement recovered host-side from a merged match table (the engine
+  concatenates both probe directions before dedup, so both maps are
+  present in the one table).
+
+Everything here is plain numpy on host arrays — it runs in the serving
+hot path's host tail and in offline tools, never under jit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "within_tolerance",
+    "delta_within_gate",
+    "match_table_agreement",
+    "mutual_nn_fraction",
+]
+
+#: The report-only A/B gate width real_parity applies to c2f and
+#: session PCK deltas (docs/PERF.md: within 1 PCK point of baseline).
+DELTA_GATE = 0.01
+
+
+def within_tolerance(value, expected, tolerance):
+    """The parity gate: |value - expected| <= tolerance."""
+    return bool(abs(float(value) - float(expected)) <= float(tolerance))
+
+
+def delta_within_gate(delta, gate=DELTA_GATE):
+    """The A/B delta gate: |delta| <= gate (default ±0.01 PCK)."""
+    return bool(abs(float(delta)) <= float(gate))
+
+
+def _best_by_source(rows):
+    """Highest-score target per source coordinate.
+
+    Returns ``{(xa, ya): (xb, yb)}`` keeping the best-scoring row per
+    source point — the same keep-first-best-after-sort convention
+    ``evals/inloc.dedup_matches`` applies to whole rows.
+    """
+    if rows is None or len(rows) == 0:
+        return {}
+    rows = np.asarray(rows, dtype=np.float32)
+    order = np.argsort(-rows[:, 4], kind="stable")
+    best = {}
+    for i in order:
+        key = (float(rows[i, 0]), float(rows[i, 1]))
+        if key not in best:
+            best[key] = (float(rows[i, 2]), float(rows[i, 3]))
+    return best
+
+
+def match_table_agreement(ref_rows, cand_rows, tau_px=2.0):
+    """Agreement@τ px between two ``[n, 5]`` serving match tables.
+
+    ``ref_rows`` is the trusted result (rung 0 / unseeded shadow
+    re-run), ``cand_rows`` the one under test (the degraded response).
+    Per source point present in BOTH tables, the candidate agrees when
+    its best-scoring target endpoint lies within ``tau_px`` (Euclidean)
+    of the reference's — PCK's thresholded-distance criterion with the
+    reference table standing in for ground truth.
+
+    Returns a dict::
+
+        agreement  fraction of compared source points within tau_px
+                   (1.0 when both tables are empty)
+        compared   source points present in both tables
+        coverage   compared / reference source points
+        n_ref, n_cand   raw row counts
+        bitwise    np.array_equal over the full tables — the exactness
+                   control the rung-0 shadow samples must pass
+        tau_px     the tolerance used
+    """
+    ref = np.asarray(ref_rows, dtype=np.float32) if ref_rows is not None \
+        else np.zeros((0, 5), np.float32)
+    cand = np.asarray(cand_rows, dtype=np.float32) if cand_rows is not None \
+        else np.zeros((0, 5), np.float32)
+    ref_best = _best_by_source(ref)
+    cand_best = _best_by_source(cand)
+    shared = [k for k in ref_best if k in cand_best]
+    agree = 0
+    for key in shared:
+        rx, ry = ref_best[key]
+        cx, cy = cand_best[key]
+        if float(np.hypot(rx - cx, ry - cy)) <= float(tau_px):
+            agree += 1
+    if shared:
+        agreement = agree / len(shared)
+    else:
+        # No overlap to compare: identical emptiness is agreement,
+        # anything else is a miss.
+        agreement = 1.0 if (not ref_best and not cand_best) else 0.0
+    return {
+        "agreement": float(agreement),
+        "compared": int(len(shared)),
+        "coverage": float(len(shared) / len(ref_best)) if ref_best else 1.0,
+        "n_ref": int(ref.shape[0]),
+        "n_cand": int(cand.shape[0]),
+        "bitwise": bool(ref.shape == cand.shape and np.array_equal(ref,
+                                                                   cand)),
+        "tau_px": float(tau_px),
+    }
+
+
+def mutual_nn_fraction(rows):
+    """Forward↔backward mutual-NN agreement from one merged table.
+
+    The engine's match table concatenates both probe directions (per-B
+    and per-A) before dedup, so it holds both the forward map
+    source→target and the backward map target→source. A source point is
+    *mutual* when its best target's own best source points back at it
+    (exact coordinate round-trip — the soft mutual-NN filter's hard
+    counterpart, computable host-side with no device work).
+
+    Returns the mutual fraction over forward entries (0.0 for an empty
+    table).
+    """
+    if rows is None or len(rows) == 0:
+        return 0.0
+    forward = _best_by_source(rows)
+    if not forward:
+        return 0.0
+    rows = np.asarray(rows, dtype=np.float32)
+    # Backward best: highest-score source per target coordinate.
+    order = np.argsort(-rows[:, 4], kind="stable")
+    backward = {}
+    for i in order:
+        key = (float(rows[i, 2]), float(rows[i, 3]))
+        if key not in backward:
+            backward[key] = (float(rows[i, 0]), float(rows[i, 1]))
+    mutual = sum(1 for src, tgt in forward.items()
+                 if backward.get(tgt) == src)
+    return float(mutual / len(forward))

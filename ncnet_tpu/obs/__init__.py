@@ -19,7 +19,7 @@ unconditionally — they no-op (or accumulate invisibly) unless an entry
 point opened a run log.
 """
 
-from . import aggregate, costcards, exemplar, flight, slo, trace
+from . import aggregate, costcards, exemplar, flight, quality, slo, trace
 from .events import (
     NULL_RUN,
     RunLog,
@@ -66,6 +66,7 @@ __all__ = [
     "costcards",
     "exemplar",
     "flight",
+    "quality",
     "slo",
     "trace",
     "SloEngine",

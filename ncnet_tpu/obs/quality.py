@@ -1,0 +1,320 @@
+"""Online match-quality telemetry: signals, drift detection, quality SLOs.
+
+Everything shipped so far (tracing, SLO burn, cost cards, exemplars)
+is systems-level; the match scores themselves — NCNet's whole
+confidence signal — were never observed in production. This module
+closes that gap on the host side of the serving tail:
+
+* :func:`QualityMonitor.record` books per-request quality signals the
+  server already holds (the ``[n, 5]`` match table): mean/max match
+  score, the forward↔backward mutual-NN agreement fraction recovered
+  from the merged table (``evals/agreement.mutual_nn_fraction``),
+  match count, c2f survivor count and the session's ``seed_hit_frac``
+  — into labeled histograms per endpoint/mode/rung/tenant.
+* :class:`DriftDetector` scores the live score distribution against a
+  frozen reference window with PSI (population stability index) over
+  the SAME fixed log-bucket ladder every histogram uses
+  (``metrics.DEFAULT_BUCKETS``) — bounded state, bucket-aligned with
+  every other quality readout. Sustained drift (PSI over threshold for
+  ``sustain`` consecutive checks) emits ONE ``quality_drift`` obs
+  event and ONE rate-limited ``quality-drift-<endpoint>`` flight dump
+  per episode (edge-triggered, plus the flight recorder's per-reason
+  cooldown underneath).
+* :func:`quality_slos` declares the counter-ratio ``SloSpec`` that
+  pages on sustained drift through the EXISTING ``SloEngine`` burn
+  machinery — quality pages ride the same multi-window rule, flight
+  dumps and ``/healthz`` plumbing as availability pages.
+
+Host-side only, no jax, no device sync: every input is a float or a
+numpy array the response path already materialized.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+from . import flight as _flight
+from .events import event
+from .metrics import (
+    DEFAULT_BUCKETS,
+    counter,
+    gauge,
+    histogram,
+    label_key,
+    replica_labels,
+)
+from .slo import SloSpec
+
+#: Observations per drift window (reference and live alike).
+DRIFT_WINDOW = 256
+#: PSI above this is "shifted" (industry rule of thumb: 0.25 = major).
+DRIFT_THRESHOLD = 0.25
+#: Consecutive over-threshold checks before an episode starts —
+#: one-off spikes (a burst of hard queries) are not drift.
+DRIFT_SUSTAIN = 3
+#: Observations between PSI evaluations (the check is O(buckets)).
+DRIFT_CHECK_EVERY = 32
+
+
+class DriftDetector:
+    """Reference-vs-live PSI over the shared log-bucket sketch.
+
+    The first ``window`` observations freeze the reference sketch; the
+    live sketch is a rolling window of the same size. Both are bucket
+    count vectors over ``metrics.DEFAULT_BUCKETS`` (+Inf tail), so the
+    whole detector is ~70 ints — the same bounded-state bargain the
+    histograms make. PSI uses add-half smoothing per bucket so empty
+    buckets never produce infinities.
+
+    Not thread-safe on its own; :class:`QualityMonitor` holds the lock.
+    """
+
+    def __init__(self, window: int = DRIFT_WINDOW,
+                 threshold: float = DRIFT_THRESHOLD,
+                 sustain: int = DRIFT_SUSTAIN,
+                 check_every: int = DRIFT_CHECK_EVERY,
+                 buckets=DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        n = len(self.buckets) + 1  # +Inf tail, Prometheus le semantics
+        self.window = int(window)
+        self.threshold = float(threshold)
+        self.sustain = int(sustain)
+        self.check_every = int(check_every)
+        self._ref = [0] * n
+        self._ref_n = 0
+        self._live: deque = deque()
+        self._live_counts = [0] * n
+        self._over = 0
+        self._since_check = 0
+        self.psi = 0.0
+        self.drifting = False
+
+    def offer(self, v: float) -> Optional[str]:
+        """One observation; returns ``"start"``/``"end"`` on an episode
+        edge, None otherwise."""
+        idx = bisect.bisect_left(self.buckets, float(v))
+        if self._ref_n < self.window:
+            self._ref[idx] += 1
+            self._ref_n += 1
+            return None
+        self._live.append(idx)
+        self._live_counts[idx] += 1
+        if len(self._live) > self.window:
+            self._live_counts[self._live.popleft()] -= 1
+        self._since_check += 1
+        if len(self._live) < self.window \
+                or self._since_check < self.check_every:
+            return None
+        self._since_check = 0
+        self.psi = self._psi()
+        self._over = self._over + 1 if self.psi > self.threshold else 0
+        was = self.drifting
+        self.drifting = self._over >= self.sustain
+        if self.drifting and not was:
+            return "start"
+        if was and not self.drifting:
+            return "end"
+        return None
+
+    def _psi(self) -> float:
+        eps = 0.5
+        n = len(self._ref)
+        ref_tot = self._ref_n + eps * n
+        live_tot = len(self._live) + eps * n
+        psi = 0.0
+        for r, l in zip(self._ref, self._live_counts):
+            p = (r + eps) / ref_tot
+            q = (l + eps) / live_tot
+            psi += (q - p) * math.log(q / p)
+        return psi
+
+    def snapshot(self) -> dict:
+        return {
+            "psi": round(float(self.psi), 4),
+            "drifting": bool(self.drifting),
+            "reference_full": self._ref_n >= self.window,
+            "live_n": len(self._live),
+            "window": self.window,
+            "threshold": self.threshold,
+        }
+
+
+class QualityMonitor:
+    """Per-request quality signal recorder + per-endpoint drift scoring.
+
+    One process-wide instance (module accessor below, the
+    exemplar/flight pattern); servers pass their instance ``labels`` so
+    two front doors in one process keep distinct series AND distinct
+    drift detectors (keyed by endpoint + labels). Thread-safe — the
+    serving handler threads record concurrently.
+    """
+
+    def __init__(self, window: int = DRIFT_WINDOW,
+                 threshold: float = DRIFT_THRESHOLD,
+                 sustain: int = DRIFT_SUSTAIN,
+                 check_every: int = DRIFT_CHECK_EVERY):
+        self._lock = threading.Lock()
+        self._drift_kwargs = dict(window=window, threshold=threshold,
+                                  sustain=sustain,
+                                  check_every=check_every)
+        self._detectors: Dict[tuple, DriftDetector] = {}
+        self._episodes = 0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._detectors.clear()
+            self._episodes = 0
+
+    # -- recording --------------------------------------------------------
+
+    def record(self, endpoint: str, rows, *, mode: str = "oneshot",
+               rung: int = 0, tenant: Optional[str] = None,
+               survivors: Optional[float] = None,
+               seed_hit_frac: Optional[float] = None,
+               trace_id: Optional[str] = None, labels=None) -> dict:
+        """Book one finished request's quality signals.
+
+        ``rows`` is the host match table the response already holds
+        (``[n, 5]`` ``(xa, ya, xb, yb, score)``, or None). Returns the
+        signals dict — the server attaches it to the response as the
+        additive ``quality`` key.
+        """
+        import numpy as np
+
+        # Deferred: evals pulls jax at package import; the obs package
+        # must stay importable without it (tools, offline reports).
+        from ncnet_tpu.evals.agreement import mutual_nn_fraction
+
+        rows = (np.asarray(rows, dtype=np.float32) if rows is not None
+                else np.zeros((0, 5), np.float32))
+        n = int(rows.shape[0])
+        score_mean = float(rows[:, 4].mean()) if n else 0.0
+        score_max = float(rows[:, 4].max()) if n else 0.0
+        mutual = mutual_nn_fraction(rows)
+        signals = {
+            "n_matches": n,
+            "score_mean": round(score_mean, 6),
+            "score_max": round(score_max, 6),
+            "mutual_frac": round(mutual, 4),
+        }
+        base = dict(labels) if labels is not None else replica_labels()
+        lbls = dict(base)
+        lbls.update(endpoint=str(endpoint), mode=str(mode),
+                    rung=str(int(rung)))
+        if tenant:
+            lbls["tenant"] = str(tenant)
+        histogram("serving.quality.matches",
+                  labels=lbls).observe(n, trace_id=trace_id)
+        histogram("serving.quality.score_mean",
+                  labels=lbls).observe(score_mean, trace_id=trace_id)
+        histogram("serving.quality.score_max",
+                  labels=lbls).observe(score_max, trace_id=trace_id)
+        histogram("serving.quality.mutual_frac",
+                  labels=lbls).observe(mutual, trace_id=trace_id)
+        if survivors is not None:
+            signals["survivors"] = int(survivors)
+        if seed_hit_frac is not None:
+            signals["seed_hit_frac"] = round(float(seed_hit_frac), 4)
+            histogram("serving.quality.seed_hit_frac",
+                      labels=lbls).observe(float(seed_hit_frac),
+                                           trace_id=trace_id)
+        self._offer_drift(endpoint, score_mean, base, trace_id)
+        return signals
+
+    def _offer_drift(self, endpoint, score_mean, labels, trace_id):
+        """Feed the endpoint's detector; page counters + episode edges.
+
+        The drift counters deliberately drop the mode/rung/tenant label
+        dims: drift is a property of the endpoint's whole score stream
+        (a reference frozen per (endpoint, rung, tenant, ...) cell
+        would never fill on low-traffic cells).
+        """
+        base = dict(labels)
+        key = (str(endpoint), label_key(labels))
+        base["endpoint"] = str(endpoint)
+        with self._lock:
+            det = self._detectors.get(key)
+            if det is None:
+                det = DriftDetector(**self._drift_kwargs)
+                self._detectors[key] = det
+            edge = det.offer(score_mean)
+            psi, drifting = det.psi, det.drifting
+            if edge == "start":
+                self._episodes += 1
+        counter("serving.quality.drift_checks", labels=base).inc()
+        if not drifting:
+            counter("serving.quality.drift_ok", labels=base).inc()
+        gauge("serving.quality.drift_psi", labels=base).set(psi)
+        if edge == "start":
+            counter("serving.quality.drift_episodes", labels=base).inc()
+            event("quality_drift", endpoint=str(endpoint), state="start",
+                  psi=round(float(psi), 4),
+                  threshold=det.threshold, window=det.window,
+                  trace_id=trace_id)
+            _flight.dump(f"quality-drift-{endpoint}")
+        elif edge == "end":
+            event("quality_drift", endpoint=str(endpoint), state="end",
+                  psi=round(float(psi), 4),
+                  threshold=det.threshold, window=det.window,
+                  trace_id=trace_id)
+
+    # -- readouts ---------------------------------------------------------
+
+    @property
+    def drifting(self) -> bool:
+        with self._lock:
+            return any(d.drifting for d in self._detectors.values())
+
+    def snapshot(self, labels=None) -> dict:
+        """The /healthz ``quality.drift`` block: per-endpoint detector
+        state (optionally scoped to one server's label set)."""
+        want = label_key(labels) if labels is not None else None
+        with self._lock:
+            per_endpoint = {
+                ep: det.snapshot()
+                for (ep, lk), det in sorted(self._detectors.items())
+                if want is None or lk == want
+            }
+            return {
+                "drifting": any(d["drifting"]
+                                for d in per_endpoint.values()),
+                "episodes": self._episodes,
+                "per_endpoint": per_endpoint,
+            }
+
+
+#: Process-wide monitor (tests reset via conftest's _reset_obs_metrics,
+#: alongside the exemplar reservoir and flight recorder).
+_MONITOR = QualityMonitor()
+
+
+def monitor() -> QualityMonitor:
+    return _MONITOR
+
+
+def quality_slos(
+    drift_objective: float = 0.99,
+    fast_window_s: float = 300.0,
+    slow_window_s: float = 3600.0,
+) -> tuple:
+    """The quality objectives, shaped for the existing ``SloEngine``.
+
+    ``quality_drift`` is a counter ratio over the drift health counters
+    :func:`QualityMonitor.record` books per request: while an endpoint
+    drifts, every request is "bad", so the bad fraction saturates at
+    1.0 and the burn rate hits 1/(1-objective) = 100x — comfortably
+    past both multi-window thresholds. Transient PSI blips never page:
+    the detector's ``sustain`` gate runs UNDER this spec, and the
+    multi-window burn rule runs on top.
+    """
+    return (
+        SloSpec("quality_drift", drift_objective,
+                good="serving.quality.drift_ok",
+                total="serving.quality.drift_checks",
+                fast_window_s=fast_window_s,
+                slow_window_s=slow_window_s),
+    )

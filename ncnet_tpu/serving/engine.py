@@ -904,6 +904,7 @@ class MatchEngine:
             failpoints.fire("engine.rider", payload=p)
         timing_extra = {}
         session_out: dict = {}
+        surv_out: dict = {}
         sess0 = batch[0].session or {}
         if batch[0].mode == "c2f" and sess0.get("seed") is not None:
             # Steady-state session frame: the previous frame's dilated
@@ -973,9 +974,19 @@ class MatchEngine:
                               labels=self.labels).observe(coarse_s)
                 surv = obs.histogram("engine.c2f.survivors",
                                      labels=self.labels)
+                sfrac = obs.histogram("engine.quality.survivor_frac",
+                                      labels=self.labels)
                 for k in range(len(batch)):
-                    surv.observe(float((top_b[k] > 0).sum()))
-                    surv.observe(float((top_a[k] > 0).sum()))
+                    s_b = float((top_b[k] > 0).sum())
+                    s_a = float((top_a[k] > 0).sum())
+                    surv.observe(s_b)
+                    surv.observe(s_a)
+                    # Per-request survivor fraction: the quality layer's
+                    # c2f confidence signal (obs/quality.py) — how much
+                    # of the top-K gate actually carried consensus mass.
+                    denom = int(top_b[k].size + top_a[k].size)
+                    surv_out[k] = int(s_b + s_a)
+                    sfrac.observe((s_b + s_a) / denom if denom else 0.0)
                 # Stage-2 gather failure domain: a refinement that dies
                 # AFTER a good coarse pass — the chaos site for partial
                 # c2f progress.
@@ -1059,6 +1070,8 @@ class MatchEngine:
             if k in session_out:
                 session_out[k]["replica"] = self.labels.get("replica")
                 rec["session"] = session_out[k]
+            if k in surv_out:
+                rec["quality"] = {"survivors": surv_out[k]}
             out.append(rec)
         for p, f in store:
             # D2H fetch inside put(); serialized so concurrent batches

@@ -58,6 +58,7 @@ from .batcher import (
 )
 from .engine import MatchEngine
 from .session import SessionCapError, SessionLostError, SessionManager
+from .shadow import ShadowSampler
 from .qos import (
     DEFAULT_TENANT,
     PRIORITY_HEADER,
@@ -112,6 +113,13 @@ class MatchServer:
         session_ttl_s: float = 300.0,
         tenant_session_frac: Optional[float] = None,
         session_reseed_frac: float = 0.5,
+        quality: bool = True,
+        quality_monitor=None,
+        shadow_rate: float = 0.0,
+        shadow_burst: Optional[float] = None,
+        shadow_tau_px: float = 2.0,
+        shadow_low_water_frac: float = 0.25,
+        shadow_executor=None,
     ):
         """``fleet``: a started-or-startable serving/fleet.MatchFleet.
         When set, the server fronts the fleet's dispatcher instead of
@@ -190,6 +198,11 @@ class MatchServer:
         if slo_specs is None:
             slo_specs = obs.default_serving_slos(
                 p99_target_s=slo_p99_target_s)
+            if quality:
+                # Quality pages ride the same burn machinery as
+                # availability pages (obs/quality.quality_slos);
+                # explicit slo_specs callers keep exactly their set.
+                slo_specs = tuple(slo_specs) + obs.quality.quality_slos()
         self.slo = obs.SloEngine(
             slo_specs, labels=self.labels, min_interval_s=1.0,
         ) if slo_specs else None
@@ -227,6 +240,37 @@ class MatchServer:
             reseed_frac=session_reseed_frac,
             labels=self.labels,
         )
+        # Match-quality observatory (obs/quality.py): per-request
+        # signals + drift detection over the process-wide monitor
+        # (instance labels keep two servers' series and detectors
+        # apart); tests inject a private monitor for small windows.
+        self.quality = (quality_monitor if quality_monitor is not None
+                        else obs.quality.monitor()) if quality else None
+        # Shadow sampler (serving/shadow.py): off by default; when on,
+        # it re-dispatches sampled responses at full quality through
+        # THIS server's own submit target, gated off whenever the queue
+        # is above low-water.
+        self.shadow = None
+        if shadow_rate > 0:
+            if fleet is not None:
+                sh_depth = lambda: self.fleet.depth  # noqa: E731
+                sh_max_queue = sum(
+                    r.batcher.max_queue for r in fleet.replicas)
+                sh_submit = self.dispatcher.submit
+            else:
+                sh_depth = lambda: self.batcher.depth  # noqa: E731
+                sh_max_queue = max_queue
+                sh_submit = self.batcher.submit
+            self.shadow = ShadowSampler(
+                self.engine.prepare, sh_submit,
+                rate=shadow_rate, burst=shadow_burst,
+                depth_fn=sh_depth, max_queue=sh_max_queue,
+                low_water_frac=shadow_low_water_frac,
+                tau_px=shadow_tau_px,
+                timeout_s=self._default_timeout_s,
+                labels=self.labels,
+                executor=shadow_executor,
+            )
         if self.replica_id:
             obs.set_build_info(replica=self.replica_id)
         self.t_start = time.monotonic()
@@ -345,6 +389,17 @@ class MatchServer:
         self.qos.update()
         return {"qos": self.qos.snapshot()}
 
+    def _quality_block(self):
+        """The /healthz ``quality`` payload field ({} when the quality
+        layer is off): per-endpoint drift state plus, when the shadow
+        sampler is on, the per-rung agreement aggregates."""
+        if self.quality is None:
+            return {}
+        block = {"drift": self.quality.snapshot(labels=self.labels)}
+        if self.shadow is not None:
+            block["shadow"] = self.shadow.snapshot()
+        return {"quality": block}
+
     def _headroom_warnings(self):
         """Per-engine hbm_headroom verdicts that failed, as healthz
         payload fields ({} when everything fits or nothing reported)."""
@@ -403,6 +458,7 @@ class MatchServer:
             payload["sessions"] = self.sessions.snapshot()
             payload.update(self._headroom_warnings())
             payload.update(self._qos_block())
+            payload.update(self._quality_block())
             slo = self.slo_status()
             if slo:
                 payload["slo"] = {
@@ -444,6 +500,7 @@ class MatchServer:
         # operator should know before the OOM does the telling.
         payload.update(self._headroom_warnings())
         payload.update(self._qos_block())
+        payload.update(self._quality_block())
         slo = self.slo_status()
         if slo:
             # The balancer-facing error-budget readout: per SLO, how
@@ -580,6 +637,11 @@ class MatchServer:
                     obs.counter("serving.bad_requests", labels=self.labels).inc()
                     return (400, {"error": "deadline_ms must be a number"},
                             None)
+            # Shadow baseline: the client's ask BEFORE any QoS rewrite
+            # (decision.apply mutates in place) — what the sampled
+            # full-quality re-run will prepare from.
+            baseline_request = (dict(request) if self.shadow is not None
+                                else None)
             if decision is not None and decision.rung is not None:
                 # Quality degradation: rewrite the request to the
                 # ladder rung BEFORE prepare — the bucket snap and
@@ -757,6 +819,26 @@ class MatchServer:
         exemplar.observe_request(
             "v1_match", e2e_s, root.trace_id,
             threshold_s=self.slo_p99_target_s, labels=self.labels)
+        # rung_index, not position: an interactive request at a
+        # shedding position still SERVED at full quality, and the
+        # quality-cost table keys by what actually ran.
+        rung = decision.rung_index if decision is not None else 0
+        if self.quality is not None:
+            payload["quality"] = self.quality.record(
+                "v1_match", br.result["matches"],
+                mode=getattr(prepared, "mode", None) or "oneshot",
+                rung=rung, tenant=tenant,
+                survivors=(br.result.get("quality")
+                           or {}).get("survivors"),
+                trace_id=root.trace_id, labels=self.labels)
+        if self.shadow is not None:
+            # Degraded rungs measure the quality cost; rung 0 is the
+            # bitwise-determinism control. The sampler's own budget and
+            # low-water gate bound the extra load.
+            self.shadow.offer(
+                baseline_request, br.result["matches"], rung=rung,
+                endpoint="v1_match", tenant=tenant,
+                trace_id=root.trace_id)
         return 200, payload, None
 
     # -- streaming sessions (docs/SERVING.md, "Streaming sessions") -------
@@ -1219,6 +1301,32 @@ class MatchServer:
         exemplar.observe_request(
             "v1_session_frame", e2e_s, root.trace_id,
             threshold_s=self.slo_p99_target_s, labels=self.labels)
+        # rung_index, not position: an interactive request at a
+        # shedding position still SERVED at full quality, and the
+        # quality-cost table keys by what actually ran.
+        rung = decision.rung_index if decision is not None else 0
+        if self.quality is not None:
+            payload["quality"] = self.quality.record(
+                "v1_session_frame", br.result["matches"],
+                mode=getattr(prepared, "mode", None) or "c2f",
+                rung=rung, tenant=tenant,
+                survivors=(br.result.get("quality")
+                           or {}).get("survivors"),
+                seed_hit_frac=seed_hit,
+                trace_id=root.trace_id, labels=self.labels)
+        if self.shadow is not None and bool(rider.get("seeded")):
+            # Seeded frames shadow against the UNSEEDED full-coarse run
+            # of the same frame at the session's pinned operating point
+            # — the seeded-quality cost, measured online.
+            def _prep_unseeded(req, _s=session):
+                return self.engine.prepare_session_frame(
+                    req, ref_path=_s.ref_path, ref_b64=_s.ref_b64,
+                    ref_feats=_s.ref_feats, op=_s.op, seed=None)
+
+            self.shadow.offer(
+                request, br.result["matches"], rung=rung,
+                endpoint="v1_session_frame", seeded=True, tenant=tenant,
+                trace_id=root.trace_id, prepare=_prep_unseeded)
         return 200, payload, None
 
     # -- lifecycle --------------------------------------------------------
@@ -1402,6 +1510,26 @@ def main(argv=None):
                         help="Chebyshev dilation (coarse cells) applied "
                         "to the previous frame's survivors when they "
                         "gate the next session frame")
+    parser.add_argument("--no_quality", action="store_true",
+                        help="disable the match-quality observatory "
+                        "(per-request quality signals, score-drift "
+                        "detection, the quality_drift SLO)")
+    parser.add_argument("--shadow_rate", type=float, default=0.0,
+                        help="shadow-sample budget in samples/s: "
+                        "re-dispatch sampled responses at full quality "
+                        "and record agreement@tau per rung "
+                        "(0 disables; docs/RELIABILITY.md back-pressure "
+                        "contract)")
+    parser.add_argument("--shadow_burst", type=float, default=None,
+                        help="shadow token-bucket burst "
+                        "(default: max(rate, 1))")
+    parser.add_argument("--shadow_tau_px", type=float, default=2.0,
+                        help="agreement tolerance in pixels for shadow "
+                        "match-table comparison")
+    parser.add_argument("--shadow_low_water_frac", type=float,
+                        default=0.25,
+                        help="queue-depth fraction above which shadow "
+                        "dispatch is gated off")
     parser.add_argument(
         "--run_log", type=str, default="",
         help="structured JSONL run log path (empty disables)",
@@ -1559,6 +1687,11 @@ def main(argv=None):
         session_ttl_s=args.session_ttl_s,
         tenant_session_frac=args.tenant_session_frac or None,
         session_reseed_frac=args.session_reseed_frac,
+        quality=not args.no_quality,
+        shadow_rate=args.shadow_rate,
+        shadow_burst=args.shadow_burst,
+        shadow_tau_px=args.shadow_tau_px,
+        shadow_low_water_frac=args.shadow_low_water_frac,
     ).start()
     print(f"serving on {server.url}", file=sys.stderr, flush=True)
     try:

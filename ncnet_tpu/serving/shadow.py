@@ -1,0 +1,229 @@
+"""Budgeted shadow sampling: measure what degradation actually costs.
+
+The QoS ladder (serving/qos.py) walks overloaded traffic down coarser
+c2f operating points and sessions skip the coarse pass entirely — both
+on the THEORY that quality stays acceptable. This module turns the
+theory into a measured contract: a small sampled fraction of degraded
+(and seeded) responses is re-dispatched through the same submit target
+at the full-quality operating point (rung 0 / unseeded), and the two
+match tables are compared with the SAME agreement@τ px routine the
+offline parity gate uses (``evals/agreement.match_table_agreement``)
+— producing the per-rung quality-cost table
+(``serving.quality.shadow_agreement{rung=...}``) the ladder's knob
+choices can finally be audited against. Rung-0 responses are sampled
+too: their re-run must agree 1.0 BITWISE (the engine is
+deterministic), so the comparator is continuously self-tested.
+
+Back-pressure contract (docs/RELIABILITY.md): shadow work is strictly
+best-effort and must never compete with user traffic —
+
+* **low-water gate**: no shadow dispatch while the submit queue is
+  above ``low_water_frac * max_queue`` (the queue must be nearly idle;
+  shadow re-runs are the first load shed, before any user impact);
+* **token budget**: at most ``rate`` samples/s (burst ``burst``),
+  reusing :class:`serving.qos.TokenBucket` — the same admission
+  primitive tenants are budgeted with;
+* **fire-and-forget**: the comparison runs on a daemon thread (tests
+  inject a synchronous executor); errors count
+  ``serving.quality.shadow.errors`` and never surface to the request
+  path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..obs.events import event
+from ..obs.metrics import counter, histogram, replica_labels
+from .qos import TokenBucket
+
+#: Default fraction of max_queue the queue must be AT or UNDER for
+#: shadow traffic to dispatch. 0.25: a quarter-full queue still has
+#: batching slack; anything above it, user work owns the device.
+LOW_WATER_FRAC = 0.25
+
+#: Default agreement tolerance: a degraded match endpoint within 2 px
+#: of the full-quality one counts as agreeing (feature-grid cell scale
+#: at reference resolution).
+TAU_PX = 2.0
+
+
+class ShadowSampler:
+    """Re-dispatch sampled responses at full quality and compare.
+
+    ``prepare``/``submit`` are the server's own host-prepare and submit
+    callables (single mode: ``engine.prepare`` + ``batcher.submit``;
+    fleet mode: the dispatcher's) — a shadow sample is an ordinary
+    rider in an ordinary batch, indistinguishable to the batcher.
+    Instance-scoped (one per server): per-rung aggregates feed that
+    server's /healthz ``quality.shadow`` block.
+    """
+
+    def __init__(
+        self,
+        prepare: Callable,
+        submit: Callable,
+        rate: float,
+        burst: Optional[float] = None,
+        depth_fn: Optional[Callable[[], int]] = None,
+        max_queue: Optional[int] = None,
+        low_water_frac: float = LOW_WATER_FRAC,
+        tau_px: float = TAU_PX,
+        timeout_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        labels=None,
+        executor: Optional[Callable[[Callable], None]] = None,
+    ):
+        self._prepare = prepare
+        self._submit = submit
+        self.rate = float(rate)
+        self.enabled = self.rate > 0.0
+        # TokenBucket treats rate<=0 as UNLIMITED (qos.py); the sampler
+        # treats it as OFF — hence the explicit `enabled` gate above.
+        self._bucket = TokenBucket(max(self.rate, 1e-9), burst,
+                                   clock=clock) if self.enabled else None
+        self._depth_fn = depth_fn
+        self.low_water = (max(1, int(low_water_frac * max_queue))
+                          if max_queue else None)
+        self.tau_px = float(tau_px)
+        self.timeout_s = float(timeout_s)
+        self.labels = dict(labels if labels is not None
+                           else replica_labels())
+        self._executor = executor or self._spawn
+        self._lock = threading.Lock()
+        self._sampled = 0
+        self._skipped = {"backpressure": 0, "budget": 0}
+        self._errors = 0
+        self._rungs: dict = {}
+
+    @staticmethod
+    def _spawn(fn: Callable) -> None:
+        threading.Thread(target=fn, daemon=True,
+                         name="shadow-compare").start()
+
+    # -- admission --------------------------------------------------------
+
+    def _admit(self) -> Optional[str]:
+        """Skip reason, or None to sample. Depth gate FIRST so a busy
+        queue never spends budget tokens it didn't use."""
+        if self._depth_fn is not None and self.low_water is not None \
+                and self._depth_fn() > self.low_water:
+            return "backpressure"
+        if self._bucket is not None and self._bucket.try_take() is not None:
+            return "budget"
+        return None
+
+    def offer(self, baseline_request: dict, live_rows, *, rung: int,
+              endpoint: str = "v1_match", seeded: bool = False,
+              tenant: Optional[str] = None,
+              trace_id: Optional[str] = None,
+              prepare: Optional[Callable] = None) -> bool:
+        """Maybe shadow-sample one finished response.
+
+        ``baseline_request`` is the request dict snapshotted BEFORE the
+        QoS decision rewrote it (the client's full-quality ask);
+        ``live_rows`` the degraded response's match table. The session
+        path passes ``prepare`` — a closure re-preparing the frame
+        unseeded at the session's pinned operating point. Returns True
+        when a sample was dispatched.
+        """
+        if not self.enabled:
+            return False
+        reason = self._admit()
+        if reason is not None:
+            with self._lock:
+                self._skipped[reason] = self._skipped.get(reason, 0) + 1
+            counter("serving.quality.shadow.skipped",
+                    labels={**self.labels, "reason": reason}).inc()
+            return False
+        with self._lock:
+            self._sampled += 1
+        counter("serving.quality.shadow.sampled",
+                labels=self.labels).inc()
+        import numpy as np
+
+        live = (np.asarray(live_rows, dtype=np.float32)
+                if live_rows is not None else np.zeros((0, 5), np.float32))
+        req = dict(baseline_request)
+        prep_fn = prepare or self._prepare
+        self._executor(lambda: self._compare(
+            req, live, rung=int(rung), endpoint=endpoint, seeded=seeded,
+            tenant=tenant, trace_id=trace_id, prepare=prep_fn))
+        return True
+
+    # -- the background half ----------------------------------------------
+
+    def _compare(self, request, live_rows, *, rung, endpoint, seeded,
+                 tenant, trace_id, prepare):
+        from ncnet_tpu.evals.agreement import match_table_agreement
+
+        try:
+            prepared = prepare(request)
+            fut = self._submit(prepared.bucket_key, prepared,
+                               timeout_s=self.timeout_s, tenant=tenant)
+            br = fut.result(timeout=self.timeout_s)
+            ref_rows = br.result["matches"]
+        except Exception as exc:  # noqa: BLE001 — best-effort, counted
+            with self._lock:
+                self._errors += 1
+            counter("serving.quality.shadow.errors",
+                    labels=self.labels).inc()
+            event("shadow_compare", endpoint=endpoint, rung=rung,
+                  error=f"{type(exc).__name__}: {exc}", trace_id=trace_id)
+            return
+        rep = match_table_agreement(ref_rows, live_rows,
+                                    tau_px=self.tau_px)
+        histogram("serving.quality.shadow_agreement",
+                  labels={**self.labels, "rung": str(rung)}).observe(
+                      rep["agreement"], trace_id=trace_id)
+        counter("serving.quality.shadow.compares",
+                labels=self.labels).inc()
+        with self._lock:
+            agg = self._rungs.setdefault(rung, {
+                "n": 0, "sum": 0.0, "min": None, "bitwise": 0,
+                "seeded": 0})
+            agg["n"] += 1
+            agg["sum"] += rep["agreement"]
+            agg["min"] = (rep["agreement"] if agg["min"] is None
+                          else min(agg["min"], rep["agreement"]))
+            if rep["bitwise"]:
+                agg["bitwise"] += 1
+            if seeded:
+                agg["seeded"] += 1
+        event("shadow_compare", endpoint=endpoint, rung=rung,
+              agreement=round(rep["agreement"], 4),
+              bitwise=rep["bitwise"], compared=rep["compared"],
+              coverage=round(rep["coverage"], 4),
+              tau_px=self.tau_px, seeded=seeded, trace_id=trace_id)
+
+    # -- readouts ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /healthz ``quality.shadow`` block and quality_report
+        source: budget knobs + per-rung agreement aggregates."""
+        with self._lock:
+            rungs = {
+                str(rung): {
+                    "n": agg["n"],
+                    "mean_agreement": round(agg["sum"] / agg["n"], 4)
+                    if agg["n"] else None,
+                    "min_agreement": (round(agg["min"], 4)
+                                      if agg["min"] is not None else None),
+                    "bitwise_frac": round(agg["bitwise"] / agg["n"], 4)
+                    if agg["n"] else None,
+                    "seeded": agg["seeded"],
+                }
+                for rung, agg in sorted(self._rungs.items())
+            }
+            return {
+                "enabled": self.enabled,
+                "rate": self.rate,
+                "tau_px": self.tau_px,
+                "low_water": self.low_water,
+                "sampled": self._sampled,
+                "skipped": dict(self._skipped),
+                "errors": self._errors,
+                "rungs": rungs,
+            }

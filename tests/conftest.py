@@ -81,12 +81,15 @@ def _reset_obs_metrics():
     slowest thing the process ever sees) evict later tests' entries.
     Same story for the flight ring and its per-reason dump cooldown: a
     dump asserted by one test must contain only that test's records and
-    must not be rate-limited by a breach three tests ago."""
+    must not be rate-limited by a breach three tests ago. And for the
+    quality monitor's drift detectors: a reference window frozen from
+    one test's score stream would misread every later test as drift."""
     from ncnet_tpu import obs
 
     obs.reset()
     obs.exemplar.reservoir().clear()
     obs.flight.recorder().clear()
+    obs.quality.monitor().clear()
     yield
 
 

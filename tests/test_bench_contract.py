@@ -741,3 +741,25 @@ def test_ncnet_lint_nonzero_on_seeded_fixtures(tmp_path, capsys):
         assert rc == 1, f"{rule} fixture should fail the lint: {err.err}"
         rec = json.loads(err.out.strip())
         assert rec["new"] >= 1, (rule, rec)
+
+
+def test_bench_trend_passes_quality_fields_through(tmp_path, capsys):
+    """tools/bench_trend.py forwards the quality-observatory fields
+    (ISSUE 14): a throughput trend earned by walking tenants down QoS
+    rungs is only honest next to the measured shadow agreement and the
+    drift state that licensed it (tools/quality_report.py)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_trend
+
+    rec = {"n": 1, "cmd": "bench", "rc": 0,
+           "parsed": {"metric": "serving_match_throughput_rps",
+                      "value": 24.0, "unit": "req/s",
+                      "shadow_agreement": 0.97,
+                      "quality_drift_psi": 0.04}}
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump(rec, fh)
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["metric"] == "serving_match_throughput_rps"
+    assert report["shadow_agreement"] == 0.97
+    assert report["quality_drift_psi"] == 0.04

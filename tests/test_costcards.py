@@ -496,6 +496,8 @@ def test_ci_gate_skips_are_recorded_not_green(capsys):
     # them as skipped AND optional.
     assert rec["checks"]["tenant_flood"] == {
         "skipped": True, "optional": True}
+    assert rec["checks"]["quality_report"] == {
+        "skipped": True, "optional": True}
 
 
 def test_ci_gate_run_captures_failure():

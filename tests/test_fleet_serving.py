@@ -88,7 +88,7 @@ def test_two_replica_fleet_view_and_dashboard(tiny_serving_model, capsys):
         hz = c0.healthz()
         assert hz["replica"] == "r0"
         assert set(hz["slo"]) == {"availability", "deadline_hit",
-                                  "latency_p99"}
+                                  "latency_p99", "quality_drift"}
         for r in hz["slo"].values():
             assert not r["paging"]
             assert r["budget_remaining_frac"] == 1.0

@@ -1,0 +1,577 @@
+"""ISSUE 14 acceptance: the match-quality observatory.
+
+Three layers, cheapest first:
+
+* **unit** — the shared comparison math (``evals/agreement.py``), the
+  PSI :class:`DriftDetector`'s episode edges, and
+  :class:`QualityMonitor` signals feeding a REAL ``SloEngine`` on a
+  fake clock: a seeded score-distribution shift flips the
+  ``quality_drift`` page with exactly one flight dump per episode;
+* **ShadowSampler under a fake clock** — off at rate 0, the depth gate
+  runs BEFORE the token gate (backpressure skips spend no budget — the
+  load-shed-first contract docs/RELIABILITY.md promises), per-rung
+  aggregates, errors counted and never raised;
+* **e2e** — a live server driven down one QoS rung produces the
+  per-rung ``serving.quality.shadow_agreement`` table (rung 0 agrees
+  1.0 BITWISE — the comparator self-test against the deterministic
+  engine; rung 1 is a measured number), and ``tools/quality_report.py``
+  renders and gates it over the same ``/healthz``.
+"""
+
+import glob
+import io
+import json
+import os
+import sys
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.evals.agreement import (
+    delta_within_gate,
+    match_table_agreement,
+    mutual_nn_fraction,
+    within_tolerance,
+)
+from ncnet_tpu.obs import flight
+from ncnet_tpu.obs.quality import (
+    DriftDetector,
+    QualityMonitor,
+    quality_slos,
+)
+from ncnet_tpu.serving.shadow import ShadowSampler
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _table(*rows):
+    return np.asarray(rows, dtype=np.float32).reshape(-1, 5)
+
+
+# -- the shared comparison math (satellite 1: one home for both gates) ----
+
+
+def test_scalar_gates():
+    assert within_tolerance(0.805, 0.8, 0.01)
+    assert not within_tolerance(0.82, 0.8, 0.01)
+    assert delta_within_gate(0.009)
+    assert not delta_within_gate(-0.02)
+
+
+def test_match_table_agreement_identical_is_bitwise():
+    t = _table([0, 0, 5, 5, 0.9], [1, 1, 7, 7, 0.8])
+    rep = match_table_agreement(t, t.copy())
+    assert rep["agreement"] == 1.0
+    assert rep["bitwise"] is True
+    assert rep["compared"] == 2
+    assert rep["coverage"] == 1.0
+
+
+def test_match_table_agreement_tau_window():
+    ref = _table([0, 0, 5, 5, 0.9], [1, 1, 7, 7, 0.8])
+    near = _table([0, 0, 6, 5, 0.9], [1, 1, 7, 8, 0.8])  # 1 px off
+    far = _table([0, 0, 15, 5, 0.9], [1, 1, 7, 17, 0.8])  # 10 px off
+    rep = match_table_agreement(ref, near, tau_px=2.0)
+    assert rep["agreement"] == 1.0 and rep["bitwise"] is False
+    assert match_table_agreement(ref, near, tau_px=0.5)["agreement"] == 0.0
+    assert match_table_agreement(ref, far, tau_px=2.0)["agreement"] == 0.0
+
+
+def test_match_table_agreement_empty_and_disjoint():
+    empty = match_table_agreement(None, None)
+    assert empty["agreement"] == 1.0 and empty["bitwise"] is True
+    ref = _table([0, 0, 5, 5, 0.9])
+    rep = match_table_agreement(ref, None)
+    assert rep["agreement"] == 0.0 and rep["coverage"] == 0.0
+    # Disjoint source sets: nothing comparable, and that is NOT
+    # agreement — coverage carries the miss.
+    rep = match_table_agreement(ref, _table([9, 9, 5, 5, 0.9]))
+    assert rep["compared"] == 0 and rep["agreement"] == 0.0
+
+
+def test_match_table_agreement_keeps_best_by_source():
+    # The low-score duplicate pointing far away must lose to the
+    # high-score row for the same source point (dedup convention).
+    ref = _table([0, 0, 5, 5, 0.9])
+    cand = _table([0, 0, 50, 50, 0.1], [0, 0, 5, 5, 0.9])
+    rep = match_table_agreement(ref, cand)
+    assert rep["agreement"] == 1.0 and rep["n_cand"] == 2
+
+
+def test_mutual_nn_fraction():
+    assert mutual_nn_fraction(None) == 0.0
+    assert mutual_nn_fraction(_table([0, 0, 5, 5, 0.9])) == 1.0
+    # Two sources claim the same target; the target's best source is
+    # the higher-scoring one, so only that forward entry is mutual.
+    t = _table([0, 0, 5, 5, 0.9], [2, 2, 5, 5, 0.95])
+    assert mutual_nn_fraction(t) == 0.5
+
+
+# -- drift detection -------------------------------------------------------
+
+
+def test_drift_detector_stable_stream_never_drifts():
+    det = DriftDetector(window=8, sustain=2, check_every=2)
+    for _ in range(100):
+        assert det.offer(0.9) is None
+    assert not det.drifting
+    assert det.psi <= det.threshold
+    snap = det.snapshot()
+    assert snap["reference_full"] and snap["live_n"] == 8
+
+
+def test_drift_detector_episode_edges():
+    det = DriftDetector(window=8, sustain=2, check_every=2)
+    for _ in range(8):  # freeze the reference
+        det.offer(0.9)
+    edges = [det.offer(0.05) for _ in range(10)]
+    assert edges.count("start") == 1
+    assert det.drifting and det.psi > det.threshold
+    # Sustained drift is ONE episode: no second start edge.
+    assert all(det.offer(0.05) is None for _ in range(20))
+    # Recovery: the live window refills with reference-like scores and
+    # the episode closes with a single end edge.
+    for _ in range(50):
+        if det.offer(0.9) == "end":
+            break
+    else:
+        pytest.fail("drift episode never ended")
+    assert not det.drifting
+
+
+# -- the quality monitor ---------------------------------------------------
+
+
+def test_quality_monitor_signals_and_histograms():
+    mon = QualityMonitor(window=8, sustain=2, check_every=2)
+    rows = _table([0, 0, 5, 5, 0.9], [1, 1, 7, 7, 0.8])
+    sig = mon.record("v1_match", rows, mode="c2f", rung=1, tenant="t0",
+                     survivors=12, seed_hit_frac=0.5, labels={})
+    assert sig["n_matches"] == 2
+    assert sig["score_mean"] == pytest.approx(0.85, abs=1e-4)
+    assert sig["score_max"] == pytest.approx(0.9, abs=1e-4)
+    assert sig["mutual_frac"] == 1.0
+    assert sig["survivors"] == 12
+    assert sig["seed_hit_frac"] == 0.5
+    lbls = {"endpoint": "v1_match", "mode": "c2f", "rung": "1",
+            "tenant": "t0"}
+    assert obs.histogram("serving.quality.matches", labels=lbls).count == 1
+    assert obs.histogram("serving.quality.score_mean",
+                         labels=lbls).last == pytest.approx(0.85, abs=1e-4)
+    assert obs.histogram("serving.quality.mutual_frac",
+                         labels=lbls).last == 1.0
+    assert obs.histogram("serving.quality.seed_hit_frac",
+                         labels=lbls).count == 1
+    # Drift health counters drop the mode/rung/tenant dims by design.
+    assert obs.counter("serving.quality.drift_checks",
+                       labels={"endpoint": "v1_match"}).value == 1.0
+    assert obs.counter("serving.quality.drift_ok",
+                       labels={"endpoint": "v1_match"}).value == 1.0
+    # An empty table is recordable (failed match, shed retry): zeros,
+    # not crashes.
+    sig = mon.record("v1_match", None, labels={})
+    assert sig == {"n_matches": 0, "score_mean": 0.0, "score_max": 0.0,
+                   "mutual_frac": 0.0}
+
+
+def test_drift_pages_real_slo_engine_one_dump_per_episode(tmp_path,
+                                                          monkeypatch):
+    """The tentpole drift acceptance: a seeded score-distribution shift
+    flips the quality_drift page through the REAL SloEngine burn
+    machinery — with exactly one quality-drift flight dump and exactly
+    one slo-burn dump for the episode, on a fake clock."""
+    flight_dir = str(tmp_path / "flight")
+    monkeypatch.setenv("NCNET_FLIGHT_DIR", flight_dir)
+    flight.recorder().clear()
+    clk = FakeClock()
+    mon = QualityMonitor(window=8, sustain=2, check_every=2)
+    engine = obs.SloEngine(
+        quality_slos(fast_window_s=10.0, slow_window_s=60.0),
+        labels={}, clock=clk, min_interval_s=0.0)
+
+    def feed(score, n):
+        for _ in range(n):
+            mon.record("v1_match", _table([0, 0, 5, 5, score]), labels={})
+
+    feed(0.9, 8)   # reference window freezes on the healthy stream
+    feed(0.9, 16)  # healthy live history
+    res = engine.evaluate()
+    qd = res["quality_drift"]
+    assert not qd["paging"] and qd["budget_remaining_frac"] == 1.0
+
+    clk.t = 5.0
+    feed(0.05, 40)  # the shift: every record after the flip is "bad"
+    snap = mon.snapshot(labels={})
+    assert snap["drifting"] and snap["episodes"] == 1
+    assert snap["per_endpoint"]["v1_match"]["psi"] > 0.25
+    assert obs.counter("serving.quality.drift_episodes",
+                       labels={"endpoint": "v1_match"}).value == 1.0
+    dumps = glob.glob(flight_dir + "/flight-quality-drift-v1_match-*.jsonl")
+    assert len(dumps) == 1, "exactly one dump per drift episode"
+    header = json.loads(open(dumps[0]).readline())
+    assert header["reason"] == "quality-drift-v1_match"
+    feed(0.05, 20)  # still the SAME episode: edge-triggered, no second
+    assert len(glob.glob(
+        flight_dir + "/flight-quality-drift-v1_match-*.jsonl")) == 1
+
+    res = engine.evaluate()
+    qd = res["quality_drift"]
+    assert qd["paging"], "sustained drift never flipped the burn alert"
+    assert qd["burn_fast"] >= 14.0 and qd["burn_slow"] >= 6.0
+    assert obs.counter("slo.quality_drift.pages").value == 1.0
+    assert len(glob.glob(
+        flight_dir + "/flight-slo-burn-quality_drift-*.jsonl")) == 1
+    clk.t = 6.0
+    assert engine.evaluate()["quality_drift"]["paging"]
+    assert obs.counter("slo.quality_drift.pages").value == 1.0
+    assert len(glob.glob(
+        flight_dir + "/flight-slo-burn-quality_drift-*.jsonl")) == 1
+
+
+# -- the shadow sampler (fake clock) ---------------------------------------
+
+
+class _Fut:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def result(self, timeout=None):
+        return SimpleNamespace(result={"matches": self._rows})
+
+
+def _prepare(request):
+    return SimpleNamespace(bucket_key="bk")
+
+
+def _submit_returning(rows, calls=None):
+    def submit(bucket_key, prepared, timeout_s=None, tenant=None):
+        if calls is not None:
+            calls.append((bucket_key, tenant))
+        return _Fut(rows)
+    return submit
+
+
+def test_shadow_sampler_off_at_rate_zero():
+    s = ShadowSampler(_prepare, _submit_returning(None), rate=0.0,
+                      labels={}, executor=lambda fn: fn())
+    assert s.enabled is False
+    assert s.offer({"mode": "oneshot"}, None, rung=1) is False
+    snap = s.snapshot()
+    assert snap["enabled"] is False and snap["sampled"] == 0
+    assert snap["rungs"] == {}
+
+
+def test_shadow_backpressure_gates_before_budget():
+    """The load-shed-first pin: no shadow dispatch while the queue is
+    above low-water, and those skips spend NO tokens — when the queue
+    drains, the full burst is still there. Fake clock throughout."""
+    clk = FakeClock()
+    depth = {"n": 100}
+    ref = _table([0, 0, 5, 5, 0.9])
+    calls = []
+    s = ShadowSampler(_prepare, _submit_returning(ref, calls),
+                      rate=1.0, burst=1,
+                      depth_fn=lambda: depth["n"], max_queue=16,
+                      clock=clk, labels={}, executor=lambda fn: fn())
+    assert s.low_water == 4  # 0.25 * 16
+    for _ in range(3):
+        assert s.offer({}, ref, rung=1) is False
+    snap = s.snapshot()
+    assert snap["skipped"] == {"backpressure": 3, "budget": 0}
+    assert snap["sampled"] == 0 and calls == []
+    assert obs.counter("serving.quality.shadow.skipped",
+                       labels={"reason": "backpressure"}).value == 3.0
+    # Queue drains: burst=1 and zero time passed, so the very first
+    # offer being admitted proves the backpressure skips were free.
+    depth["n"] = 0
+    assert s.offer({}, ref, rung=1) is True
+    assert s.offer({}, ref, rung=1) is False  # budget: burst spent
+    assert s.snapshot()["skipped"]["budget"] == 1
+    clk.t += 1.0  # one token refills at rate=1/s
+    assert s.offer({}, ref, rung=1) is True
+    assert s.snapshot()["sampled"] == 2 and len(calls) == 2
+
+
+def test_shadow_compare_books_per_rung_table():
+    ref = _table([0, 0, 5, 5, 0.9], [1, 1, 7, 7, 0.8])
+    live_off = ref.copy()
+    live_off[:, 2] += 10.0  # endpoints 10 px off: disagrees at tau=2
+    s = ShadowSampler(_prepare, _submit_returning(ref), rate=1e6,
+                      labels={}, executor=lambda fn: fn())
+    assert s.offer({}, ref.copy(), rung=0) is True
+    assert s.offer({}, live_off, rung=1, seeded=True) is True
+    snap = s.snapshot()
+    assert snap["rungs"]["0"] == {
+        "n": 1, "mean_agreement": 1.0, "min_agreement": 1.0,
+        "bitwise_frac": 1.0, "seeded": 0}
+    r1 = snap["rungs"]["1"]
+    assert r1["n"] == 1 and r1["seeded"] == 1
+    assert r1["mean_agreement"] == 0.0 and r1["bitwise_frac"] == 0.0
+    h0 = obs.histogram("serving.quality.shadow_agreement",
+                       labels={"rung": "0"})
+    h1 = obs.histogram("serving.quality.shadow_agreement",
+                       labels={"rung": "1"})
+    assert h0.count == 1 and h0.last == 1.0
+    assert h1.count == 1 and h1.last == 0.0
+    assert obs.counter("serving.quality.shadow.compares").value == 2.0
+    assert obs.counter("serving.quality.shadow.sampled").value == 2.0
+
+
+def test_shadow_errors_counted_never_raised():
+    def submit(bucket_key, prepared, timeout_s=None, tenant=None):
+        raise RuntimeError("device fell over")
+
+    s = ShadowSampler(_prepare, submit, rate=1e6, labels={},
+                      executor=lambda fn: fn())
+    assert s.offer({}, _table([0, 0, 5, 5, 0.9]), rung=2) is True
+    snap = s.snapshot()
+    assert snap["errors"] == 1 and snap["rungs"] == {}
+    assert obs.counter("serving.quality.shadow.errors").value == 1.0
+
+
+# -- quality_report (fetch-injected) ---------------------------------------
+
+
+def _healthz(rungs, drift=None):
+    return {"quality": {
+        "drift": drift or {"drifting": False, "episodes": 0,
+                           "per_endpoint": {}},
+        "shadow": {"enabled": True, "rate": 5.0, "tau_px": 2.0,
+                   "low_water": 4, "sampled": 5,
+                   "skipped": {"backpressure": 0, "budget": 0},
+                   "errors": 0, "rungs": rungs},
+    }}
+
+
+_GOOD_RUNGS = {
+    "0": {"n": 3, "mean_agreement": 1.0, "min_agreement": 1.0,
+          "bitwise_frac": 1.0, "seeded": 0},
+    "1": {"n": 2, "mean_agreement": 0.95, "min_agreement": 0.93,
+          "bitwise_frac": 0.0, "seeded": 1},
+}
+
+
+def _report_line(capsys):
+    out = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(out) == 1, out  # the house contract: ONE stdout line
+    return json.loads(out[0])
+
+
+def test_quality_report_contract_and_strict_rules(capsys):
+    import quality_report
+
+    fetch = lambda url, t: _healthz(_GOOD_RUNGS)  # noqa: E731
+    rc = quality_report.main(["http://x", "--strict"], fetch=fetch)
+    rec = _report_line(capsys)
+    assert rc == 0
+    assert rec["metric"] == "quality_report" and rec["unit"] == "frac"
+    assert rec["value"] == 0.95  # the worst rung's mean agreement
+    assert rec["ok"] and rec["failures"] == []
+    assert rec["rungs"]["1"]["seeded"] == 1
+
+    # Floor violation: strict exits 1; non-strict reports and exits 0.
+    rc = quality_report.main(["http://x", "--strict", "--floor", "0.97"],
+                             fetch=fetch)
+    rec = _report_line(capsys)
+    assert rc == 1 and not rec["ok"]
+    assert "below floor" in rec["failures"][0]
+    assert quality_report.main(["http://x", "--floor", "0.97"],
+                               fetch=fetch) == 0
+    capsys.readouterr()
+
+    # Rung 0 not bitwise = the comparator itself is broken.
+    broken = {"0": {"n": 3, "mean_agreement": 1.0, "min_agreement": 1.0,
+                    "bitwise_frac": 0.5, "seeded": 0}}
+    rc = quality_report.main(["http://x", "--strict", "--floor", "0.0"],
+                             fetch=lambda u, t: _healthz(broken))
+    rec = _report_line(capsys)
+    assert rc == 1 and any("comparator" in f for f in rec["failures"])
+
+    # A report that measured nothing must never read as green.
+    rc = quality_report.main(["http://x", "--strict"],
+                             fetch=lambda u, t: _healthz({}))
+    rec = _report_line(capsys)
+    assert rc == 1
+    assert any("no shadow comparisons" in f for f in rec["failures"])
+
+
+def test_quality_report_unreachable_and_arg_validation(capsys):
+    import quality_report
+
+    def fetch(url, t):
+        raise OSError("connection refused")
+
+    rc = quality_report.main(["http://x"], fetch=fetch)
+    rec = _report_line(capsys)
+    assert rc == 1 and rec["ok"] is False and rec["value"] is None
+    with pytest.raises(SystemExit):
+        quality_report.main([])  # neither url nor --smoke
+    with pytest.raises(SystemExit):
+        quality_report.main(["http://x", "--smoke"])  # both
+    capsys.readouterr()
+
+
+def test_obs_report_renders_quality_events():
+    import obs_report
+
+    recs = [
+        {"event": "shadow_compare", "rung": 0, "agreement": 1.0,
+         "bitwise": True},
+        {"event": "shadow_compare", "rung": 1, "agreement": 0.9,
+         "bitwise": False, "seeded": True},
+        {"event": "shadow_compare", "rung": 1, "agreement": 0.7,
+         "bitwise": False},
+        {"event": "shadow_compare", "rung": 1,
+         "error": "RuntimeError: boom"},
+        {"event": "quality_drift", "endpoint": "v1_match",
+         "state": "start", "psi": 0.41, "threshold": 0.25, "window": 256},
+    ]
+    roll = obs_report.shadow_rollup(recs)
+    assert roll["errors"] == 1
+    assert roll["rungs"][0] == {"count": 1, "sum": 1.0, "min": 1.0,
+                                "bitwise": 1, "seeded": 0, "mean": 1.0}
+    r1 = roll["rungs"][1]
+    assert r1["count"] == 2 and r1["min"] == 0.7 and r1["seeded"] == 1
+    assert r1["mean"] == pytest.approx(0.8)
+    buf = io.StringIO()
+    obs_report.summarize("run.jsonl", recs, out=buf)
+    text = buf.getvalue()
+    assert "quality drift episodes:" in text
+    assert "v1_match" in text and "psi 0.410" in text
+    assert "shadow comparisons" in text
+    assert "1 comparison error(s)" in text
+
+
+# -- end to end: the per-rung quality-cost table ---------------------------
+
+
+class _QuietSlo:
+    """Stub SLO feed for the QosController (the real server SloEngine
+    still runs): the e2e drives the ladder from queue pressure alone."""
+
+    def maybe_evaluate(self):
+        return {}
+
+
+def _jpeg_bytes(h, w, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    img = Image.fromarray((rng.random((h, w, 3)) * 255).astype("uint8"))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_shadow_e2e_per_rung_cost_table_and_report(tiny_serving_model,
+                                                   capsys):
+    """The acceptance e2e: a live server driven down one QoS rung
+    produces the per-rung shadow-agreement series, /healthz carries the
+    quality block, and quality_report's JSON line shows rung-0
+    agreement 1.0 BITWISE with a measured degraded-rung number."""
+    import quality_report
+    from ncnet_tpu.serving.client import MatchClient
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.qos import (
+        QosController,
+        TenantPolicy,
+        TenantTable,
+        parse_ladder,
+    )
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    pressure = {"on": True}
+    qos = QosController(
+        parse_ladder("c2f:factor=2,topk=8"),
+        slo=_QuietSlo(),
+        depth_fn=lambda: 100 if pressure["on"] else 0,
+        max_queue=10,
+        step_down_interval_s=0.0,
+        step_up_hold_s=0.05,
+    )
+    tenants = TenantTable([TenantPolicy("lowpri", "best_effort")])
+    # Shadow wide open + synchronous executor: every response is
+    # re-run at full quality and compared before it returns, so the
+    # healthz assertions below are deterministic.
+    server = MatchServer(engine, port=0, max_batch=1, max_queue=16,
+                         max_delay_s=0.01, default_timeout_s=300.0,
+                         slo_p99_target_s=60.0, qos=qos, tenants=tenants,
+                         shadow_rate=1e6,
+                         shadow_executor=lambda fn: fn()).start()
+    try:
+        client = MatchClient(server.url, timeout_s=600.0, retries=0)
+        kwargs = dict(query_bytes=_jpeg_bytes(96, 128, 0),
+                      pano_bytes=_jpeg_bytes(96, 128, 1), max_matches=8)
+        # Pressure on: the best_effort request runs degraded at rung 1;
+        # its shadow re-runs the pre-QoS request at rung 0.
+        r1 = client.match(tenant="lowpri", **kwargs)
+        assert r1["qos"]["degraded"] is True
+        # The additive per-response quality block (tentpole signals).
+        assert r1["quality"]["n_matches"] == r1["n_matches"]
+        assert 0.0 <= r1["quality"]["mutual_frac"] <= 1.0
+        assert r1["quality"]["score_max"] >= r1["quality"]["score_mean"]
+        # Recovery, then a rung-0 request: the bitwise control sample.
+        pressure["on"] = False
+        deadline = time.monotonic() + 30.0
+        while client.healthz()["qos"]["rung"] > 0:
+            assert time.monotonic() < deadline, "qos never recovered"
+            time.sleep(0.06)
+        r2 = client.match(tenant="lowpri", **kwargs)
+        assert r2["qos"]["degraded"] is False
+
+        hz = client.healthz()
+        q = hz["quality"]
+        assert "v1_match" in q["drift"]["per_endpoint"]
+        sh = q["shadow"]
+        assert sh["enabled"] and sh["errors"] == 0
+        assert sh["sampled"] >= 2
+        # Rung 0: the comparator self-test — deterministic engine, so
+        # the re-run must agree 1.0 bitwise.
+        assert sh["rungs"]["0"]["n"] >= 1
+        assert sh["rungs"]["0"]["mean_agreement"] == 1.0
+        assert sh["rungs"]["0"]["bitwise_frac"] == 1.0
+        # Rung 1: the measured degradation cost — a real number in
+        # [0, 1], not an assumption.
+        r1agg = sh["rungs"]["1"]
+        assert r1agg["n"] >= 1
+        assert 0.0 <= r1agg["mean_agreement"] <= 1.0
+        # The per-rung metric series the fleet view aggregates.
+        snap = obs.snapshot()
+        keys = [k for k in snap["histograms"]
+                if k.startswith("serving.quality.shadow_agreement")]
+        assert any('rung="0"' in k for k in keys)
+        assert any('rung="1"' in k for k in keys)
+
+        # The report tool over the live server: one JSON line whose
+        # rung table matches the healthz block, strict-green at any
+        # achievable floor...
+        rc = quality_report.main([server.url, "--strict", "--floor",
+                                  "0.0"])
+        out = [l for l in capsys.readouterr().out.splitlines()
+               if l.strip()]
+        rec = json.loads(out[-1])
+        assert rc == 0 and rec["ok"]
+        assert rec["rungs"]["0"]["bitwise_frac"] == 1.0
+        assert rec["rungs"]["0"]["mean_agreement"] == 1.0
+        assert rec["rungs"]["1"]["n"] >= 1
+        assert rec["value"] is not None
+        # ...and strict-red at an unachievable one (rc 1, not silence).
+        assert quality_report.main([server.url, "--strict", "--floor",
+                                    "1.5"]) == 1
+        capsys.readouterr()
+    finally:
+        server.stop()

@@ -98,10 +98,14 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
     # tools/real_parity.py --c2f): a c2f throughput trend is only
     # readable next to the knobs that produced it and the PCK delta
     # that licenses the speed.
+    # And the quality-observatory fields (tools/quality_report.py /
+    # obs/quality.py): a throughput trend earned by degrading rungs is
+    # only honest next to the measured agreement cost and drift state.
     for key in ("replicas", "single_replica_pairs_per_s", "scaling_x",
                 "scaling_efficiency", "pairs_done", "pairs_s",
                 "quarantined", "resumes",
-                "c2f_pairs_s", "coarse_factor", "topk", "c2f_pck_delta"):
+                "c2f_pairs_s", "coarse_factor", "topk", "c2f_pck_delta",
+                "shadow_agreement", "quality_drift_psi"):
         if key in latest:
             report[key] = latest[key]
     return report

@@ -27,8 +27,11 @@ multi-tenant QoS chaos contract (``tools/chaos_serving.py
 bursts 10x), and ``--with-session-chaos`` runs the streaming-session
 chaos contract (``tools/chaos_serving.py --session_stream`` — a
 mid-stream replica kill must re-seed, never kill the session or drop
-a frame). Both are off by default because they serve live traffic for
-several seconds; a default run still RECORDS them as
+a frame). ``--with-quality-report`` runs the match-quality comparator
+self-test (``tools/quality_report.py --smoke --strict`` — a tiny
+self-hosted server shadow-re-runs every response; rung-0 agreement
+must be 1.0 bitwise). All are off by default because they serve live
+traffic for several seconds; a default run still RECORDS them as
 ``{"skipped": true, "optional": true}`` so the JSON never reads as if
 the contract were exercised when it was not.
 
@@ -59,7 +62,7 @@ _CPU_DROP = ("PALLAS_AXON_POOL_IPS",)
 CHECKS = ("tier1", "lint", "bench_trend")
 # Opt-in checks: never run by default, never silently green — a
 # default run records them as {"skipped": true, "optional": true}.
-OPTIONAL_CHECKS = ("tenant_flood", "session_chaos")
+OPTIONAL_CHECKS = ("tenant_flood", "session_chaos", "quality_report")
 
 
 def _run(cmd, timeout_s, cpu_env=False) -> dict:
@@ -131,6 +134,17 @@ def run_session_chaos(timeout_s: float) -> dict:
         timeout_s, cpu_env=True)
 
 
+def run_quality_report(timeout_s: float) -> dict:
+    # The comparator self-test: a self-hosted smoke server with the
+    # shadow sampler wide open; --strict fails on any rung-0 re-run
+    # that is not 1.0 bitwise (the engine is deterministic) and on a
+    # run that recorded no comparisons at all.
+    return _run(
+        [sys.executable, os.path.join("tools", "quality_report.py"),
+         "--smoke", "--strict"],
+        timeout_s, cpu_env=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--skip", action="append", default=[],
@@ -150,6 +164,11 @@ def main(argv=None) -> int:
                          "(tools/chaos_serving.py --session_stream with "
                          "a mid-stream replica kill); off by default, "
                          "recorded as skipped when off")
+    ap.add_argument("--with-quality-report", action="store_true",
+                    help="also run the match-quality comparator "
+                         "self-test (tools/quality_report.py --smoke "
+                         "--strict); off by default, recorded as "
+                         "skipped when off")
     ap.add_argument("--chaos-timeout-s", type=float, default=300.0,
                     help="wall-clock fence for the optional chaos checks")
     args = ap.parse_args(argv)
@@ -160,9 +179,12 @@ def main(argv=None) -> int:
         "bench_trend": lambda: run_bench_trend(args.timeout_s),
         "tenant_flood": lambda: run_tenant_flood(args.chaos_timeout_s),
         "session_chaos": lambda: run_session_chaos(args.chaos_timeout_s),
+        "quality_report": lambda: run_quality_report(
+            args.chaos_timeout_s),
     }
     enabled = {"tenant_flood": args.with_tenant_flood,
-               "session_chaos": args.with_session_chaos}
+               "session_chaos": args.with_session_chaos,
+               "quality_report": args.with_quality_report}
     checks = {}
     for name in CHECKS + OPTIONAL_CHECKS:
         if name in args.skip or not enabled.get(name, True):

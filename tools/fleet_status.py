@@ -4,10 +4,10 @@ The dashboard half of obs/aggregate.py: scrape every replica's
 ``GET /metrics`` each poll, merge the scrapes into a fleet view, and
 render a per-replica table to STDERR —
 
-    replica      req/s   err/s   p99 ms   queue  breaker  burn  hbm GB  head%  warm  rung  sess
-    r0            12.4     0.0     38.2       1   closed   0.1    21.40     33     4     0     3
-    r1            11.9     0.0     41.7       0   closed   0.2    21.38     33     4     0     1
-    FLEET         24.3     0.0     40.9       1        -   0.2    42.78     33     8     0     4
+    replica      req/s   err/s   p99 ms   queue  breaker  burn  hbm GB  head%  warm  rung  sess  drift  shad%
+    r0            12.4     0.0     38.2       1   closed   0.1    21.40     33     4     0     3   0.04     99
+    r1            11.9     0.0     41.7       0   closed   0.2    21.38     33     4     0     1   0.05    100
+    FLEET         24.3     0.0     40.9       1        -   0.2    42.78     33     8     0     4   0.05     99
       tenants: default=112  lowpri=38
 
 req/s and err/s are counter deltas between polls; p99 is exact at the
@@ -24,9 +24,15 @@ mode) programs the replica precompiled; rung is the
 ``serving.qos.rung`` gauge — the QoS controller's current ladder
 position ("-" on servers without the multi-tenant QoS layer); sess is
 the ``serving.session.active`` gauge — open streaming sessions on
-that front door ("-" before the first session ever opens). A
-``tenants:`` line breaks fleet-wide request totals out per
-``serving.tenant.requests`` tenant label.
+that front door ("-" before the first session ever opens); drift is
+the worst ``serving.quality.drift_psi`` across the replica's
+endpoints (obs/quality.py — 0.25+ means the live score distribution
+shifted); shad% is the count-weighted mean
+``serving.quality.shadow_agreement`` across rungs (serving/shadow.py
+— "-" until the shadow sampler has compared something; the per-rung
+split lives in tools/quality_report.py). A ``tenants:`` line breaks
+fleet-wide request totals out per ``serving.tenant.requests`` tenant
+label.
 
 On exit (``--iterations N``, or Ctrl-C when polling forever) it prints
 ONE JSON line to stdout, the house contract every tool in tools/
@@ -68,6 +74,8 @@ WARMED = "serving_warmup_programs"
 RUNG = "serving_qos_rung"
 SESSIONS = "serving_session_active"
 TENANT_REQS = "serving_tenant_requests"
+DRIFT_PSI = "serving_quality_drift_psi"
+SHADOW_AGREE = "serving_quality_shadow_agreement"
 
 _BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
 
@@ -115,6 +123,41 @@ def _gauge_sum(view, key):
     return sum(vals) if vals else None
 
 
+def _family(store, base):
+    """A labeled family's children in a flat series map: the bare name
+    plus every ``name{...}`` key (drift psi is labeled per endpoint,
+    shadow agreement per rung)."""
+    return [v for k, v in store.items()
+            if k == base or k.startswith(base + "{")]
+
+
+def _label_max(store, base):
+    """Worst (max) value across one gauge family's labeled children —
+    the drift column shows the most-drifted endpoint."""
+    vals = [v for v in _family(store, base) if v is not None]
+    return max(vals) if vals else None
+
+
+def _hist_family_mean(hists, base):
+    """Count-weighted mean across one histogram family's labeled
+    children (the per-rung shadow-agreement series fold into one
+    fleet-readable number; the per-rung split stays in
+    tools/quality_report.py)."""
+    tot_sum = tot_n = 0.0
+    for h in _family(hists, base):
+        tot_sum += float(h.get("sum") or 0.0)
+        tot_n += float(h.get("count") or 0.0)
+    return tot_sum / tot_n if tot_n else None
+
+
+def _fleet_gauge_max(view, base):
+    """Fleet-wide max over a labeled gauge family (merged gauge entries
+    carry min/max/mean per series; take the worst across series)."""
+    vals = [(e or {}).get("max") for e in _family(view["gauges"], base)]
+    vals = [v for v in vals if v is not None]
+    return max(vals) if vals else None
+
+
 _TENANT_LABEL_RE = re.compile(r'tenant="([^"]*)"')
 
 
@@ -157,6 +200,8 @@ def render(view, prev_counters, dt, out=None):
             rep["counters"].get(WARMED),
             rep["gauges"].get(RUNG),
             rep["gauges"].get(SESSIONS),
+            _label_max(rep["gauges"], DRIFT_PSI),
+            _hist_family_mean(rep["histograms"], SHADOW_AGREE),
         ))
     fleet_prev = (prev_counters or {}).get("FLEET")
     burn_entry = view["gauges"].get(BURN) or {}
@@ -175,19 +220,25 @@ def render(view, prev_counters, dt, out=None):
         view["counters"].get(WARMED),
         (view["gauges"].get(RUNG) or {}).get("max"),
         _gauge_sum(view, SESSIONS),
+        _fleet_gauge_max(view, DRIFT_PSI),
+        _hist_family_mean(view["histograms"], SHADOW_AGREE),
     ))
     w(f"{'replica':<12} {'req/s':>8} {'err/s':>8} {'p99 ms':>8} "
       f"{'queue':>6} {'breaker':>9} {'burn':>6} {'hbm GB':>7} "
-      f"{'head%':>6} {'warm':>5} {'rung':>5} {'sess':>5}\n")
+      f"{'head%':>6} {'warm':>5} {'rung':>5} {'sess':>5} "
+      f"{'drift':>6} {'shad%':>6}\n")
     for (ident, rps, eps, p99, q, brk, burn, hbm, head, warm,
-         rung, sess) in rows:
+         rung, sess, drift, shad) in rows:
         qs = f"{q:.0f}".rjust(6) if q is not None else "-".rjust(6)
         ws_ = f"{warm:.0f}".rjust(5) if warm is not None else "-".rjust(5)
         rg = f"{rung:.0f}".rjust(5) if rung is not None else "-".rjust(5)
         ss = f"{sess:.0f}".rjust(5) if sess is not None else "-".rjust(5)
+        sh = (f"{shad * 100:.0f}".rjust(6) if shad is not None
+              else "-".rjust(6))
         w(f"{ident:<12} {_fmt(rps, 8)} {_fmt(eps, 8)} {_fmt(p99, 8)} "
           f"{qs} {brk:>9} {_fmt(burn, 6)} {_fmt(hbm, 7, 2)} "
-          f"{_fmt(head, 6, 0)} {ws_} {rg} {ss}\n")
+          f"{_fmt(head, 6, 0)} {ws_} {rg} {ss} "
+          f"{_fmt(drift, 6, 2)} {sh}\n")
     tenants = _tenant_totals(view["counters"])
     if tenants:
         w("  tenants: " + "  ".join(
@@ -252,6 +303,9 @@ def main(argv=None):
             "qos_rung": rep["gauges"].get(RUNG),
             "sessions": rep["gauges"].get(SESSIONS),
             "tenants": _tenant_totals(rep["counters"]),
+            "drift_psi": _label_max(rep["gauges"], DRIFT_PSI),
+            "shadow_agreement": _hist_family_mean(
+                rep["histograms"], SHADOW_AGREE),
         }
     fleet_use = _gauge_sum(view, HBM_USE)
     fleet_lim = _gauge_sum(view, HBM_LIM)
@@ -271,6 +325,9 @@ def main(argv=None):
             "qos_rung": (view["gauges"].get(RUNG) or {}).get("max"),
             "sessions": _gauge_sum(view, SESSIONS),
             "tenants": _tenant_totals(view["counters"]),
+            "drift_psi": _fleet_gauge_max(view, DRIFT_PSI),
+            "shadow_agreement": _hist_family_mean(
+                view["histograms"], SHADOW_AGREE),
         },
         "polls": polls,
         "unreachable": sorted(view["errors"]),

@@ -154,6 +154,35 @@ def slowest_spans(records: List[dict], n: int = 10) -> List[dict]:
     return sorted(_spans(records), key=lambda r: -r["dur_s"])[:n]
 
 
+def shadow_rollup(records: List[dict]) -> dict:
+    """Per-rung aggregates over ``shadow_compare`` events
+    (serving/shadow.py): {"rungs": {rung: {count, mean, min, bitwise,
+    seeded}}, "errors": n} — the run-log view of the quality-cost
+    table /healthz serves live."""
+    rungs: Dict[int, dict] = {}
+    errors = 0
+    for r in records:
+        if r.get("event") != "shadow_compare":
+            continue
+        if "error" in r:
+            errors += 1
+            continue
+        agg = rungs.setdefault(r.get("rung", 0), {
+            "count": 0, "sum": 0.0, "min": None,
+            "bitwise": 0, "seeded": 0})
+        a = float(r.get("agreement", 0.0))
+        agg["count"] += 1
+        agg["sum"] += a
+        agg["min"] = a if agg["min"] is None else min(agg["min"], a)
+        if r.get("bitwise"):
+            agg["bitwise"] += 1
+        if r.get("seeded"):
+            agg["seeded"] += 1
+    for agg in rungs.values():
+        agg["mean"] = agg["sum"] / agg["count"]
+    return {"rungs": rungs, "errors": errors}
+
+
 def summarize(path: str, records: List[dict], out=None) -> None:
     w = (out or sys.stdout).write
     if not records:
@@ -189,6 +218,27 @@ def summarize(path: str, records: List[dict], out=None) -> None:
     for r in stalls:
         w(f"    stall after {r.get('idle_s', 0):.1f}s idle "
           f"(threshold {r.get('stall_after_s', 0):.1f}s)\n")
+
+    drift_events = [r for r in records
+                    if r.get("event") == "quality_drift"]
+    if drift_events:
+        w("  quality drift episodes:\n")
+        for r in drift_events:
+            w(f"    {r.get('endpoint', '?'):<16} {r.get('state', '?'):<6}"
+              f" psi {r.get('psi', 0.0):.3f}"
+              f" (threshold {r.get('threshold', 0.0):g},"
+              f" window {r.get('window', '?')})\n")
+    shadow = shadow_rollup(records)
+    if shadow["rungs"] or shadow["errors"]:
+        w("  shadow comparisons (agreement@τ vs full quality):\n")
+        for rung, agg in sorted(shadow["rungs"].items()):
+            w(f"    rung {rung:<3} x{agg['count']:<5}"
+              f" mean agree {agg['mean']:.4f}"
+              f"  min {agg['min']:.4f}"
+              f"  bitwise {agg['bitwise']}/{agg['count']}"
+              f"  seeded {agg['seeded']}\n")
+        if shadow["errors"]:
+            w(f"    {shadow['errors']} comparison error(s)\n")
 
     spans = span_rollup(records)
     if spans:

@@ -1,0 +1,230 @@
+"""Per-rung quality-cost table + drift report from a live server.
+
+Reads one server's ``GET /healthz`` ``quality`` block (obs/quality.py
+drift detectors + serving/shadow.py per-rung shadow-agreement
+aggregates) and renders the measured degradation cost —
+
+    rung     n   mean agree   min agree   bitwise%   seeded
+    0       14       1.0000      1.0000        100        0
+    1        9       0.9631      0.9200          0        0
+    drift: v1_match psi 0.04 (ok)
+
+Rung 0 is the comparator's self-test: the engine is deterministic, so
+a rung-0 shadow re-run must agree 1.0 bitwise — anything else means
+the comparison itself is broken, not the ladder. Degraded rungs carry
+the number the QoS ladder's knob choices are audited against.
+
+On exit it prints ONE JSON line to stdout (the house tools/ contract;
+prose goes to stderr). ``--strict`` makes quality failures a nonzero
+exit so a session script (or ci_gate --with-quality-report) can gate
+on it:
+
+* any rung's mean agreement below ``--floor``;
+* rung 0 present but not 100% bitwise (broken comparator);
+* no shadow comparisons recorded at all — a report that measured
+  nothing must never read as green.
+
+Example::
+
+    python tools/quality_report.py http://127.0.0.1:8123 \
+        --strict --floor 0.9
+
+``--smoke`` self-hosts a tiny CPU server (no url needed), drives a
+handful of synthetic requests through it with the shadow sampler wide
+open and synchronous, and reports on the result — every sample runs at
+rung 0, so a green smoke is exactly the comparator self-test: the
+deterministic engine re-ran every response and agreed 1.0 bitwise.
+This is the flavor ``ci_gate --with-quality-report`` runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+# Runnable as `python tools/quality_report.py` from the repo root: the
+# --smoke path imports ncnet_tpu (the scrape path never does).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def note(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def fetch_healthz(url: str, timeout_s: float = 5.0) -> dict:
+    if not url.rstrip("/").endswith("/healthz"):
+        url = url.rstrip("/") + "/healthz"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+def evaluate(quality: dict, floor: float) -> dict:
+    """The report record from one /healthz ``quality`` block.
+
+    ``ok`` reflects the strict gate's three rules; ``failures`` names
+    each violated one (empty = clean).
+    """
+    drift = quality.get("drift") or {}
+    shadow = quality.get("shadow") or {}
+    rungs = shadow.get("rungs") or {}
+    failures = []
+    for rung, agg in sorted(rungs.items()):
+        mean = agg.get("mean_agreement")
+        if mean is not None and mean < floor:
+            failures.append(
+                f"rung {rung} mean agreement {mean:g} below floor {floor:g}")
+    zero = rungs.get("0")
+    if zero and zero.get("n") and (zero.get("bitwise_frac") or 0.0) < 1.0:
+        failures.append(
+            f"rung 0 bitwise_frac {zero['bitwise_frac']:g} != 1.0 "
+            "(comparator self-test failed)")
+    if not any(agg.get("n") for agg in rungs.values()):
+        failures.append("no shadow comparisons recorded")
+    means = [agg["mean_agreement"] for agg in rungs.values()
+             if agg.get("mean_agreement") is not None]
+    return {
+        "metric": "quality_report",
+        "value": min(means) if means else None,
+        "unit": "frac",
+        "rungs": rungs,
+        "drift": drift,
+        "drifting": bool(drift.get("drifting")),
+        "shadow_enabled": bool(shadow.get("enabled")),
+        "sampled": shadow.get("sampled"),
+        "skipped": shadow.get("skipped"),
+        "shadow_errors": shadow.get("errors"),
+        "tau_px": shadow.get("tau_px"),
+        "floor": floor,
+        "ok": not failures,
+        "failures": failures,
+    }
+
+
+def _cell(v, width, prec=4, scale=1.0):
+    if v is None:
+        return "-".rjust(width)
+    return f"{v * scale:.{prec}f}".rjust(width)
+
+
+def render(rec: dict) -> None:
+    rungs = rec["rungs"]
+    if rungs:
+        note(f"{'rung':<5} {'n':>5} {'mean agree':>11} {'min agree':>10} "
+             f"{'bitwise%':>9} {'seeded':>7}")
+        for rung, agg in sorted(rungs.items(), key=lambda kv: kv[0]):
+            note(f"{rung:<5} {agg.get('n', 0):>5} "
+                 f"{_cell(agg.get('mean_agreement'), 11)} "
+                 f"{_cell(agg.get('min_agreement'), 10)} "
+                 f"{_cell(agg.get('bitwise_frac'), 9, 0, 100.0)} "
+                 f"{agg.get('seeded', 0):>7}")
+    else:
+        note("no shadow comparisons recorded "
+             "(shadow sampler off, or nothing sampled yet)")
+    for ep, det in sorted((rec["drift"].get("per_endpoint") or {}).items()):
+        state = "DRIFTING" if det.get("drifting") else (
+            "ok" if det.get("reference_full") else
+            f"warming ({det.get('live_n', 0)}/{det.get('window')})")
+        note(f"drift: {ep} psi {det.get('psi', 0.0):.3f} ({state})")
+    for f in rec["failures"]:
+        note(f"FAIL: {f}")
+
+
+def run_smoke(n_requests: int, model=None) -> dict:
+    """Self-hosted comparator self-test; returns the final /healthz.
+
+    Heavy imports stay in here — the scrape path must work without
+    jax installed (offline dashboards, report-only hosts).
+    """
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from ncnet_tpu.serving.client import MatchClient
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    if model is None:
+        from ncnet_tpu.cli.common import build_model
+
+        note("building tiny model (pass model= to reuse one in-process)")
+        model = build_model(
+            ncons_kernel_sizes=(3, 3),
+            ncons_channels=(16, 1),
+            relocalization_k_size=2,
+            half_precision=True,
+            backbone_bf16=True,
+        )
+    config, params = model
+    rng = np.random.default_rng(0)
+    imgs = []
+    for _ in range(2):
+        buf = io.BytesIO()
+        Image.fromarray(
+            (rng.random((96, 128, 3)) * 255).astype("uint8")
+        ).save(buf, format="JPEG")
+        imgs.append(buf.getvalue())
+
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    engine.warmup([(96, 128, 96, 128)], batch_sizes=(1,))
+    # Shadow wide open + synchronous executor: every request is
+    # re-dispatched and compared before its response returns, so the
+    # final healthz deterministically holds n_requests rung-0 compares.
+    server = MatchServer(engine, port=0, max_batch=1, max_delay_s=0.0,
+                         default_timeout_s=120.0, shadow_rate=1e6,
+                         shadow_executor=lambda fn: fn()).start()
+    try:
+        client = MatchClient(server.url, timeout_s=120.0)
+        for i in range(n_requests):
+            client.match(query_bytes=imgs[0], pano_bytes=imgs[1],
+                         max_matches=16)
+            note(f"smoke request {i + 1}/{n_requests} ok")
+        return client.healthz()
+    finally:
+        server.stop()
+
+
+def main(argv=None, fetch=None, model=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", nargs="?", default="",
+                    help="server base URL (or /healthz endpoint)")
+    ap.add_argument("--floor", type=float, default=0.9,
+                    help="minimum acceptable per-rung mean agreement "
+                         "(default 0.9)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any quality failure")
+    ap.add_argument("--timeout_s", type=float, default=5.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-host a tiny CPU server and report on its "
+                         "own shadow compares (no url)")
+    ap.add_argument("--smoke_requests", type=int, default=4,
+                    help="requests the smoke run drives (default 4)")
+    args = ap.parse_args(argv)
+    if bool(args.smoke) == bool(args.url):
+        ap.error("exactly one of url or --smoke is required")
+
+    fetch = fetch or fetch_healthz
+    try:
+        if args.smoke:
+            health = run_smoke(args.smoke_requests, model=model)
+        else:
+            health = fetch(args.url, args.timeout_s)
+    except Exception as exc:  # noqa: BLE001 — report, one exit path
+        note(f"{'smoke failed' if args.smoke else 'unreachable'}: {exc}")
+        print(json.dumps({"metric": "quality_report", "value": None,
+                          "unit": "frac", "ok": False,
+                          "failures": [f"unreachable: {exc}"]}))
+        return 1
+    quality = health.get("quality") or {}
+    rec = evaluate(quality, args.floor)
+    render(rec)
+    print(json.dumps(rec), flush=True)
+    return 1 if (args.strict and not rec["ok"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -165,9 +165,10 @@ def run_pfpascal(args):
     if args.expected_pck >= 0:
         rec["expected"] = args.expected_pck
         rec["tolerance"] = args.tolerance
-        rec["parity"] = bool(
-            abs(float(mean_pck) - args.expected_pck) <= args.tolerance
-        )
+        from ncnet_tpu.evals import within_tolerance
+
+        rec["parity"] = within_tolerance(
+            float(mean_pck), args.expected_pck, args.tolerance)
     if args.c2f:
         rec.update(_pfpascal_c2f_delta(args, config, params, mean_pck))
     if args.session:
@@ -192,6 +193,7 @@ def _pfpascal_c2f_delta(args, config, params, oneshot_pck):
 
     from ncnet_tpu.cli.eval_pck import evaluate_pck
     from ncnet_tpu.data import PFPascalDataset
+    from ncnet_tpu.evals import delta_within_gate
 
     c2f_config = dataclasses.replace(
         config, mode="c2f",
@@ -232,7 +234,7 @@ def _pfpascal_c2f_delta(args, config, params, oneshot_pck):
         "c2f_coarse_factor": args.c2f_coarse_factor,
         "c2f_topk": args.c2f_topk,
         "c2f_radius": args.c2f_radius,
-        "c2f_within_gate": bool(abs(delta) <= 0.01),
+        "c2f_within_gate": delta_within_gate(delta),
     }
 
 
@@ -256,7 +258,7 @@ def _pfpascal_session_delta(args, config, params):
 
     from ncnet_tpu.cli.eval_pck import evaluate_pck
     from ncnet_tpu.data import DataLoader, PFPascalDataset
-    from ncnet_tpu.evals import pck_metric
+    from ncnet_tpu.evals import delta_within_gate, pck_metric
     from ncnet_tpu.models.ncnet import (
         c2f_coarse_from_features,
         c2f_stride,
@@ -352,7 +354,7 @@ def _pfpascal_session_delta(args, config, params):
         "session_pck_delta": round(delta, 4),
         "session_image_size": size,
         "session_seed_radius": args.session_seed_radius,
-        "session_within_gate": bool(abs(delta) <= 0.01),
+        "session_within_gate": delta_within_gate(delta),
     }
 
 
