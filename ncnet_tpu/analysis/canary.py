@@ -1,0 +1,165 @@
+"""Dynamic race canary: runtime assertions for ``# guarded-by:`` claims.
+
+The ``shared-state-race`` rule (rules/races.py) is a static
+under-approximation; annotations are where a human overrides it
+("this field IS guarded by that lock", "only one thread writes
+this"). This module keeps those claims honest: under
+``NCNET_RACE_CANARY=1`` the pytest hook in tests/conftest.py calls
+:func:`install_canaries`, which replaces every *annotated* instance
+field with a data descriptor that asserts the annotation at each
+write:
+
+* ``guarded-by: <lock>`` (same-object locks only, e.g.
+  ``Session.lock`` / ``self._lock``) — every write after the first
+  (the constructor's) must happen while the lock is held. ``RLock`` /
+  ``Condition`` expose ``_is_owned`` (held *by this thread*); a plain
+  ``Lock`` only exposes ``locked()`` — weaker, but it still catches
+  the lock-free write path.
+* ``guarded-by: single-writer`` — the main-thread-handoff model:
+  writes may come from the main thread until the first non-main
+  writer appears; from then on only that one thread may write.
+
+A violation raises :class:`RaceCanaryError` naming the field, the
+writing thread, and the claimed guard — so the serving e2e / chaos
+suites double as a cheap sanitizer pass. ``threading.local`` /
+``atomic`` / ``external`` annotations and module globals carry no
+runtime check. With the env var unset nothing is installed; the
+production code path never imports this module.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import List, Optional
+
+ENV_KNOB = "NCNET_RACE_CANARY"
+
+
+class RaceCanaryError(AssertionError):
+    """An annotated guard did not hold at a runtime write."""
+
+
+def _lock_is_held(lock) -> bool:
+    owned = getattr(lock, "_is_owned", None)
+    if callable(owned):
+        try:
+            return bool(owned())
+        except Exception:
+            pass
+    locked = getattr(lock, "locked", None)
+    if callable(locked):
+        try:
+            return bool(locked())
+        except Exception:
+            pass
+    # Unrecognized lock object: nothing cheap to assert.
+    return True
+
+
+class _Canary:
+    """Data descriptor asserting a field's guarded-by claim per write.
+
+    The value lives in the instance ``__dict__`` under a private slot
+    key, so the descriptor (a *data* descriptor — it defines
+    ``__set__``) keeps intercepting every store. The first write per
+    instance is the constructor's and is exempt — ``__init__`` /
+    dataclass field defaults run before the guard can exist.
+    """
+
+    def __init__(self, cls_name: str, attr: str, kind: str,
+                 lock_attr: Optional[str] = None):
+        self.cls_name = cls_name
+        self.attr = attr
+        self.kind = kind          # "lock" | "single-writer"
+        self.lock_attr = lock_attr
+        self._slot = f"__canary_{attr}"
+        self._writer_slot = f"__canary_writer_{attr}"
+
+    def __set_name__(self, owner, name):  # pragma: no cover - trivial
+        self.attr = name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        try:
+            return obj.__dict__[self._slot]
+        except KeyError:
+            raise AttributeError(
+                f"{self.cls_name}.{self.attr}") from None
+
+    def __set__(self, obj, value):
+        first = self._slot not in obj.__dict__
+        if not first:
+            self._check(obj)
+        obj.__dict__[self._slot] = value
+
+    def __delete__(self, obj):
+        obj.__dict__.pop(self._slot, None)
+        obj.__dict__.pop(self._writer_slot, None)
+
+    def _check(self, obj) -> None:
+        if self.kind == "lock":
+            lock = getattr(obj, self.lock_attr, None)
+            if lock is not None and not _lock_is_held(lock):
+                raise RaceCanaryError(
+                    f"{self.cls_name}.{self.attr} written by thread "
+                    f"{threading.current_thread().name!r} without "
+                    f"holding the annotated guard "
+                    f"{self.cls_name}.{self.lock_attr}"
+                )
+        elif self.kind == "single-writer":
+            me = threading.get_ident()
+            if me == threading.main_thread().ident:
+                if obj.__dict__.get(self._writer_slot) is not None:
+                    raise RaceCanaryError(
+                        f"{self.cls_name}.{self.attr} is annotated "
+                        f"single-writer and was handed off to thread "
+                        f"{obj.__dict__[self._writer_slot]!r}, but the "
+                        f"main thread wrote it again"
+                    )
+                return
+            owner = obj.__dict__.get(self._writer_slot)
+            if owner is None:
+                obj.__dict__[self._writer_slot] = (
+                    threading.current_thread().name, me)
+            elif owner[1] != me:
+                raise RaceCanaryError(
+                    f"{self.cls_name}.{self.attr} is annotated "
+                    f"single-writer (owner thread {owner[0]!r}) but "
+                    f"thread {threading.current_thread().name!r} "
+                    f"wrote it"
+                )
+
+
+def _module_name(rel: str) -> str:
+    return rel[:-3].replace("/", ".")
+
+
+def install_canaries(root: Optional[str] = None) -> List[str]:
+    """Wrap every annotated instance field from the static plan.
+
+    Imports each owning module and replaces the class attribute with a
+    :class:`_Canary` descriptor. Idempotent (re-wrapping a descriptor
+    is skipped). Returns the installed field labels, for logging and
+    for the tests that assert the plan is non-trivial.
+    """
+    from .engine import Repo
+    from .rules import races
+
+    repo = Repo(root=root) if root else Repo()
+    installed: List[str] = []
+    for spec in races.canary_plan(repo):
+        try:
+            mod = importlib.import_module(_module_name(spec["module_rel"]))
+            cls = getattr(mod, spec["cls"])
+        except Exception:
+            continue  # gated/optional module: nothing to wrap
+        if isinstance(cls.__dict__.get(spec["attr"]), _Canary):
+            installed.append(f"{spec['cls']}.{spec['attr']}")
+            continue
+        desc = _Canary(spec["cls"], spec["attr"], spec["kind"],
+                       lock_attr=spec.get("lock_attr"))
+        setattr(cls, spec["attr"], desc)
+        installed.append(f"{spec['cls']}.{spec['attr']}")
+    return installed

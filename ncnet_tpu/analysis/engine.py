@@ -10,8 +10,13 @@ Suppression has exactly two grammars, both deliberate-and-visible:
 * **Pragma** — ``# ncnet-lint: disable=<rule>[,<rule>...]`` on the
   flagged line or the line directly above it silences those rules for
   that line; ``# ncnet-lint: disable-file=<rule>[,...]`` anywhere in a
-  file's first 10 lines silences the whole file. ``disable=all`` is
-  accepted but discouraged — name the rule you mean.
+  file's first 10 lines silences the whole file. A pragma in a
+  function's *header* (the ``def`` line, any decorator line, or the
+  line directly above the first decorator) suppresses findings
+  attributed to that function — by symbol or by a line inside its
+  body, the same symbol-or-line matching the baseline uses, so a
+  pragma on a decorated ``def`` covers the whole def. ``disable=all``
+  is accepted but discouraged — name the rule you mean.
 * **Baseline** — ``ncnet_tpu/analysis/baseline.json`` carries
   deliberate, *commented* exceptions: every entry needs a nonempty
   ``reason`` (the tier-1 test enforces it). A finding matching a
@@ -80,6 +85,7 @@ class SourceFile:
         self._tree: Optional[ast.AST] = None
         self._pragmas: Optional[Dict[int, set]] = None
         self._file_pragmas: Optional[set] = None
+        self._def_spans: Optional[List[Tuple[str, set, int, int]]] = None
 
     @property
     def text(self) -> str:
@@ -126,8 +132,48 @@ class SourceFile:
         out |= self._pragmas.get(line - 1, set())
         return out
 
+    def _scan_defs(self) -> None:
+        """Index every def's header lines + body span for pragma
+        matching (``_header_disabled``)."""
+        self._def_spans = []
+        try:
+            tree = self.tree
+        except (OSError, SyntaxError):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            header = {d.lineno for d in node.decorator_list}
+            header.add(node.lineno)
+            first = min(header)
+            header.add(first - 1)
+            self._def_spans.append(
+                (node.name, header, first,
+                 node.end_lineno or node.lineno))
+
+    def _header_disabled(self, finding: Finding) -> set:
+        """Rules disabled by a pragma in the header of a def the
+        finding belongs to — matched by symbol (its leaf name) or by a
+        line inside the def's body, mirroring the baseline's
+        symbol-or-line matching so a pragma on a decorator line covers
+        findings attributed to the decorated def."""
+        if self._def_spans is None:
+            self._scan_defs()
+        leaf = finding.symbol.rsplit(".", 1)[-1] if finding.symbol else ""
+        out: set = set()
+        for name, header, start, end in self._def_spans:
+            if name != leaf and not (start <= finding.line <= end):
+                continue
+            for ln in header:
+                out |= (self._pragmas or {}).get(ln, set())
+        return out
+
     def suppresses(self, finding: Finding) -> bool:
         disabled = self.disabled_rules(finding.line)
+        if "all" in disabled or finding.rule in disabled:
+            return True
+        disabled = self._header_disabled(finding)
         return "all" in disabled or finding.rule in disabled
 
 

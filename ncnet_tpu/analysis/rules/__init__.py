@@ -15,12 +15,14 @@ from .bare_print import BarePrintRule
 from .failpoint_docs import FailpointDocsRule
 from .lock_order import LockOrderRule
 from .metrics_docs import MetricsDocsRule
+from .races import SharedStateRaceRule
 from .recompile_hazard import RecompileHazardRule
 from .trace_purity import TracePurityRule
 
 _RULES = (
     TracePurityRule,
     LockOrderRule,
+    SharedStateRaceRule,
     RecompileHazardRule,
     BarePrintRule,
     MetricsDocsRule,

@@ -164,6 +164,14 @@ class _Module:
     def _index_class(self, node: ast.ClassDef) -> _Class:
         cls = _Class(node.name, self.rel)
         for item in node.body:
+            if (isinstance(item, ast.AnnAssign)
+                    and isinstance(item.target, ast.Name)):
+                # Dataclass-style field lock: ``lock: threading.Lock =
+                # field(default_factory=threading.Lock)``.
+                kind = _LOCK_CTORS.get(dotted_name(item.annotation) or "")
+                if kind:
+                    cls.attr_locks[item.target.id] = (kind, item.lineno)
+                continue
             if not isinstance(item, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
@@ -279,8 +287,9 @@ class _Graph:
 
 
 class _Analyzer:
-    def __init__(self, repo: Repo):
+    def __init__(self, repo: Repo, scope: Tuple[str, ...] = SCOPE):
         self.repo = repo
+        self.scope = scope
         self.modules: Dict[str, _Module] = {}
         self.class_index: Dict[str, _Class] = {}
         self.graph = _Graph()
@@ -294,7 +303,7 @@ class _Analyzer:
     # -- pass 1: index ----------------------------------------------------
 
     def build(self) -> None:
-        for sf in self.repo.files(under=SCOPE):
+        for sf in self.repo.files(under=self.scope):
             try:
                 mod = _Module(sf.rel, sf.tree)
             except SyntaxError as exc:

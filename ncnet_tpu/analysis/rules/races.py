@@ -1,0 +1,1028 @@
+"""``shared-state-race``: unguarded cross-thread shared mutable state.
+
+PR 13's headline bug — the backbone's channels-last trace flag was a
+module global, silently corrupting concurrent replica-thread traces —
+is the bug class the lock-order rule cannot see: it reasons about locks
+that *exist*, not shared state that has *no* lock. This rule closes the
+gap with RacerD-flavored ownership + lock-set reasoning over the same
+AST index the lock-order rule builds:
+
+* **Thread roots** — functions that run concurrently, discovered by
+  AST: ``threading.Thread(target=...)`` / ``threading.Timer``, calls
+  through *spawner* helpers (a function that hands one of its own
+  parameters to ``Thread`` — the shadow sampler's ``_spawn``),
+  ``executor.submit(fn, ...)`` / ``future.add_done_callback(fn)``, and
+  HTTP handler methods (``do_*`` handler classes, plus every
+  ``handle_*`` / ``healthz`` method of a class that constructs a
+  ``ThreadingHTTPServer``). HTTP and executor roots are
+  *self-concurrent* (two requests run the same handler at once);
+  dedicated threads and timers are one thread each.
+* **Shared-state inventory** — module-level mutable globals written
+  from function bodies (``global`` rebinds, subscript stores, mutator
+  calls; ``threading.local()`` values, locks, and module-init-only
+  constants are excluded) and instance attributes of the lock-order
+  class set whose accessors are reachable from two concurrent contexts
+  (roots, counted with self-concurrency, plus "main" when an accessor
+  is not reachable from any root). Module globals are *always* treated
+  as shared: jit tracing and closures break static call chains (the PR
+  13 flag was only reachable through a traced function), so requiring
+  root-reachability would miss exactly the motivating bug.
+* **Guarded-by inference** — a must-held-lock analysis reusing the
+  lock-order acquisition data: a root enters with no locks; a callee's
+  entry set is the intersection over known call sites of (caller entry
+  ∪ locks lexically held at the site); a write's effective guard is its
+  lexical held set ∪ the entry set. A field is guarded when the
+  intersection over all its non-``__init__`` write sites is nonempty.
+  Unguarded (or inconsistently guarded) writes to shared state are
+  findings, as are check-then-act pairs (an ``if`` that reads a shared
+  field with no lock held and writes it in the body — the double-init
+  idiom that still races when only the write is locked).
+* **Annotations** — ``# guarded-by: <guard>[ -- <justification>]`` on
+  the field's defining line (or the line above) resolves a field
+  deliberately. ``<guard>`` is a lock (``self._lock``, ``Class.attr``,
+  ``modlock``) cross-checked against the known lock set, or one of the
+  lock-free disciplines ``threading.local`` / ``single-writer`` /
+  ``atomic`` / ``external`` — the lock-free kinds *require* the
+  ``-- justification`` text. Annotated fields are exempt from findings
+  and feed the dynamic race canary (``ncnet_tpu/analysis/canary.py``),
+  which asserts at runtime, under ``NCNET_RACE_CANARY=1``, that the
+  annotated guard actually holds at every write.
+
+The shared-state inventory table is emitted into docs/ANALYSIS.md
+between generated-block markers; like the lock-order table, this rule
+fails the lint when the block is stale (``tools/ncnet_lint.py
+--write-docs`` regenerates both).
+
+Like the lock graph, everything here under-approximates runtime
+behavior (unresolved calls contribute no reachability and no guards),
+which is why scope is held to the concurrency-bearing trees plus
+``models/`` and ``ops/`` — the trees replica threads trace through.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..engine import Finding, Repo, Rule, dotted_name
+from . import lock_order
+from .lock_order import _Analyzer, _Class, _Module
+
+#: The lock-order trees plus the model/op code replica threads trace
+#: through (the PR 13 flag lived in models/backbone.py).
+SCOPE = lock_order.SCOPE + (
+    "ncnet_tpu/models/",
+    "ncnet_tpu/ops/",
+)
+
+DOC_PATH = "docs/ANALYSIS.md"
+BEGIN_MARK = "<!-- BEGIN GENERATED: shared-state -->"
+END_MARK = "<!-- END GENERATED: shared-state -->"
+
+#: ``# guarded-by: <guard>[ -- <justification>]``
+ANNOT_RE = re.compile(
+    r"#\s*guarded-by:\s*(?P<guard>[A-Za-z_][\w.\-]*)"
+    r"(?:\s*--\s*(?P<why>\S.*?))?\s*$"
+)
+
+#: Lock-free disciplines; all of them REQUIRE a justification.
+_FREE_KINDS = ("threading.local", "single-writer", "atomic", "external")
+
+#: Container mutations that count as writes.
+_MUTATORS = {
+    "append", "appendleft", "add", "update", "pop", "popitem", "clear",
+    "extend", "extendleft", "insert", "remove", "discard", "setdefault",
+}
+
+_HTTP_SERVER_CTORS = {
+    "ThreadingHTTPServer", "HTTPServer", "ThreadingTCPServer",
+}
+_HTTP_METHOD_PREFIXES = ("do_", "handle_")
+_INIT_METHODS = ("__init__", "__post_init__")
+
+#: Self-concurrent root kinds run the same entry point on two threads
+#: at once; a dedicated thread/timer is one thread.
+_ROOT_WEIGHT = {"http": 2, "executor": 2, "thread": 1, "timer": 1}
+
+
+@dataclass
+class _Annot:
+    guard: str  # normalized guard text as written
+    kind: str  # "lock" or one of _FREE_KINDS
+    why: str
+    rel: str
+    line: int
+    lock_node: str = ""  # resolved "Class.attr"/"mod.name" for kind=lock
+
+
+@dataclass
+class _Access:
+    func: str  # function key ("rel::Class.meth" / "rel::fn")
+    rel: str
+    line: int
+    held: frozenset
+    write: bool
+    init: bool  # write inside __init__/__post_init__
+
+
+@dataclass
+class _FieldInfo:
+    key: Tuple[str, str, str]  # (kind, owner, name)
+    def_rel: str = ""
+    def_line: int = 0
+    accesses: List[_Access] = dc_field(default_factory=list)
+    annot: Optional[_Annot] = None
+    contexts: Dict[str, str] = dc_field(default_factory=dict)  # root->kind
+    main_context: bool = False
+    guard: frozenset = frozenset()
+
+    @property
+    def label(self) -> str:
+        return f"{self.key[1]}.{self.key[2]}"
+
+    def weight(self) -> int:
+        w = sum(_ROOT_WEIGHT.get(k, 1) for k in self.contexts.values())
+        return w + (1 if self.main_context else 0)
+
+    def writes(self) -> List[_Access]:
+        return [a for a in self.accesses if a.write and not a.init]
+
+
+class _Ctx:
+    """Per-function walk context."""
+
+    def __init__(self, key: str, mod: _Module, cls: Optional[_Class],
+                 node: ast.AST):
+        self.key = key
+        self.mod = mod
+        self.cls = cls
+        self.node = node
+        self.init = getattr(node, "name", "") in _INIT_METHODS
+        self.params = {a.arg for a in node.args.args} if hasattr(
+            node, "args") else set()
+        self.globals_decl: Set[str] = set()
+        self.local_stores: Set[str] = set()
+        self.param_types: Dict[str, str] = {}
+        for a in getattr(node, "args", None) and node.args.args or ():
+            ann = a.annotation
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                self.param_types[a.arg] = ann.value.split(".")[-1]
+            elif ann is not None:
+                nm = dotted_name(ann)
+                if nm:
+                    self.param_types[a.arg] = nm.split(".")[-1]
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Global):
+                self.globals_decl.update(sub.names)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                          ast.Store):
+                self.local_stores.add(sub.id)
+
+
+class _RaceAnalyzer(_Analyzer):
+    """Extends the lock-order analyzer with access collection, thread
+    roots, reachability, and the must-held-at-entry fixpoint."""
+
+    def __init__(self, repo: Repo):
+        super().__init__(repo, scope=SCOPE)
+        self.fields: Dict[Tuple[str, str, str], _FieldInfo] = {}
+        self.call_sites: Dict[str, List[Tuple[str, frozenset]]] = {}
+        self.reach_calls: Dict[str, Set[str]] = {}
+        self.roots: Dict[str, str] = {}  # func key -> kind
+        self.spawners: Set[str] = set()
+        self.cta: List[Tuple[Tuple[str, str, str], str, str, int,
+                             frozenset]] = []
+        self.entry: Dict[str, Optional[frozenset]] = {}
+        #: module rel -> {name: (line, style)}; style "local" for
+        #: threading.local values (excluded from the shared set).
+        self.global_defs: Dict[str, Dict[str, Tuple[int, str]]] = {}
+        self.attr_defs: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        #: (owner, name) pairs whose definition is a container literal
+        #: or ctor — the only targets subscript/mutator writes hit.
+        self.containers: Set[Tuple[str, str]] = set()
+        self.race_findings: List[Finding] = []
+
+    def analyze(self) -> None:
+        self.build()  # lock-order passes: index, call graph, may-sets
+        self._collect_defs()
+        self._find_roots()
+        self._collect_accesses()
+        self._reachability()
+        self._entry_fixpoint()
+        self._assemble()
+
+    # -- definitions ------------------------------------------------------
+
+    def _collect_defs(self) -> None:
+        for mod in self.modules.values():
+            defs: Dict[str, Tuple[int, str]] = {}
+            try:
+                tree = self.repo.file(mod.rel).tree
+            except (OSError, SyntaxError):
+                continue
+            for node in tree.body:
+                tgts = []
+                if isinstance(node, ast.Assign):
+                    tgts = [t for t in node.targets
+                            if isinstance(t, ast.Name)]
+                elif (isinstance(node, ast.AnnAssign)
+                      and isinstance(node.target, ast.Name)):
+                    tgts = [node.target]
+                for t in tgts:
+                    if t.id.startswith("__") or t.id in mod.mod_locks:
+                        continue
+                    style = "plain"
+                    val = node.value
+                    if isinstance(val, ast.Call):
+                        ctor = dotted_name(val.func) or ""
+                        if ctor.split(".")[-1] == "local":
+                            style = "local"
+                    if _is_container_expr(val) or (
+                            isinstance(node, ast.AnnAssign)
+                            and _is_container_ann(node.annotation)):
+                        self.containers.add((mod.rel, t.id))
+                    defs.setdefault(t.id, (t.lineno, style))
+            self.global_defs[mod.rel] = defs
+            # Instance-attr definition lines: class-body AnnAssign
+            # (dataclass fields), else first `self.X = ...` in __init__.
+            for node in tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for item in node.body:
+                    if (isinstance(item, ast.AnnAssign)
+                            and isinstance(item.target, ast.Name)):
+                        self.attr_defs.setdefault(
+                            (node.name, item.target.id),
+                            (mod.rel, item.lineno))
+                        if (_is_container_ann(item.annotation)
+                                or _is_container_expr(item.value)):
+                            self.containers.add(
+                                (node.name, item.target.id))
+                for item in node.body:
+                    if not isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    if item.name not in _INIT_METHODS:
+                        continue
+                    for sub in ast.walk(item):
+                        if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                            continue
+                        tl = (sub.targets if isinstance(sub, ast.Assign)
+                              else [sub.target])
+                        for tgt in tl:
+                            if (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"):
+                                self.attr_defs.setdefault(
+                                    (node.name, tgt.attr),
+                                    (mod.rel, tgt.lineno))
+                                if _is_container_expr(sub.value):
+                                    self.containers.add(
+                                        (node.name, tgt.attr))
+
+    # -- thread roots -----------------------------------------------------
+
+    def _callable_targets(self, expr: ast.AST, mod: _Module,
+                          cls: Optional[_Class]) -> List[str]:
+        """Function keys a callable expression may run: ``self.m``,
+        module functions, ``functools.partial(f, ..)``, and every call
+        a lambda body makes (the sampler's ``lambda: self._compare(..)``
+        idiom)."""
+        if isinstance(expr, ast.Lambda):
+            out: List[str] = []
+            for sub in ast.walk(expr.body):
+                if isinstance(sub, ast.Call):
+                    out.extend(self._resolve_call(sub, mod, cls))
+            return out
+        if isinstance(expr, ast.Call):
+            fn = dotted_name(expr.func) or ""
+            if fn.split(".")[-1] == "partial" and expr.args:
+                return self._callable_targets(expr.args[0], mod, cls)
+            return []
+        name = dotted_name(expr)
+        if not name:
+            return []
+        parts = name.split(".")
+        if parts[0] == "self" and cls is not None:
+            if len(parts) == 2 and parts[1] in cls.methods:
+                return [f"{cls.rel}::{cls.name}.{parts[1]}"]
+            if len(parts) == 3:
+                owner = self._attr_class(cls, parts[1])
+                if owner is not None and parts[2] in owner.methods:
+                    return [f"{owner.rel}::{owner.name}.{parts[2]}"]
+            return []
+        if len(parts) == 1:
+            if parts[0] in mod.funcs:
+                return [f"{mod.rel}::{parts[0]}"]
+            if parts[0] in mod.from_binds:
+                src, orig = mod.from_binds[parts[0]]
+                smod = self._module_by_path(src)
+                if smod is not None:
+                    return self._func_in_module(smod, orig, hop=False)
+            return []
+        if len(parts) == 2:
+            target = mod.imports.get(parts[0])
+            if target:
+                tmod = self._module_by_path(target)
+                if tmod is not None:
+                    return self._func_in_module(tmod, parts[1])
+        return []
+
+    def _find_roots(self) -> None:
+        pending: List[Tuple[List[str], List[str]]] = []
+        for key, (mod, cls, node) in self.funcs.items():
+            params = {a.arg for a in node.args.args} if hasattr(
+                node, "args") else set()
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = dotted_name(sub.func) or ""
+                last = fn.split(".")[-1]
+                target_expr = None
+                kind = ""
+                if last in ("Thread", "Timer"):
+                    for kw in sub.keywords:
+                        if kw.arg in ("target", "function"):
+                            target_expr = kw.value
+                    if (target_expr is None and last == "Timer"
+                            and len(sub.args) >= 2):
+                        target_expr = sub.args[1]
+                    kind = "thread" if last == "Thread" else "timer"
+                elif (isinstance(sub.func, ast.Attribute)
+                      and sub.func.attr in ("submit", "add_done_callback")
+                      and sub.args):
+                    target_expr = sub.args[0]
+                    kind = "executor"
+                if target_expr is None:
+                    continue
+                if (kind == "thread"
+                        and isinstance(target_expr, ast.Name)
+                        and target_expr.id in params):
+                    # This function Thread()s one of its own params:
+                    # it is a spawner, its callers pass the real root.
+                    self.spawners.add(key)
+                    continue
+                for tgt in self._callable_targets(target_expr, mod, cls):
+                    self.roots.setdefault(tgt, kind)
+            # HTTP server owners: every handle_*/do_*/healthz method of
+            # a class that constructs a ThreadingHTTPServer runs on
+            # handler threads (the nested Handler delegates to them).
+            if cls is not None and self._builds_http_server(node):
+                for meth in cls.methods:
+                    if (meth.startswith(_HTTP_METHOD_PREFIXES)
+                            or meth == "healthz"):
+                        self.roots.setdefault(
+                            f"{cls.rel}::{cls.name}.{meth}", "http")
+        # Plain handler classes (module-level do_GET/do_POST/...).
+        for key, (mod, cls, node) in self.funcs.items():
+            name = getattr(node, "name", "")
+            if cls is not None and name.startswith("do_"):
+                self.roots.setdefault(key, "http")
+        # Calls through spawners: the callable argument is the root.
+        for key, (mod, cls, node) in self.funcs.items():
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                callees = self._resolve_call(sub, mod, cls)
+                if not any(c in self.spawners for c in callees):
+                    continue
+                for arg in list(sub.args) + [kw.value
+                                             for kw in sub.keywords]:
+                    for tgt in self._callable_targets(arg, mod, cls):
+                        self.roots.setdefault(tgt, "executor")
+
+    @staticmethod
+    def _builds_http_server(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                fn = dotted_name(sub.func) or ""
+                if fn.split(".")[-1] in _HTTP_SERVER_CTORS:
+                    return True
+        return False
+
+    # -- access collection ------------------------------------------------
+
+    def _field(self, key: Tuple[str, str, str]) -> _FieldInfo:
+        fi = self.fields.get(key)
+        if fi is None:
+            fi = self.fields[key] = _FieldInfo(key=key)
+        return fi
+
+    def _global_key(self, name: str,
+                    ctx: _Ctx) -> Optional[Tuple[str, str, str]]:
+        defs = self.global_defs.get(ctx.mod.rel, {})
+        if name not in defs:
+            return None
+        if defs[name][1] == "local":  # threading.local: per-thread
+            return None
+        if name not in ctx.globals_decl and (
+                name in ctx.params or name in ctx.local_stores):
+            return None  # shadowed by a local/param
+        return ("global", ctx.mod.rel, name)
+
+    def _attr_key(self, dotted: str,
+                  ctx: _Ctx) -> Optional[Tuple[str, str, str]]:
+        parts = dotted.split(".")
+        if len(parts) == 2:
+            base, attr = parts
+            owner: Optional[_Class] = None
+            if base == "self":
+                owner = ctx.cls
+            elif base in ctx.param_types:
+                owner = self.class_index.get(ctx.param_types[base])
+            if owner is not None and attr not in owner.attr_locks:
+                return ("attr", owner.name, attr)
+            return None
+        if len(parts) == 3 and parts[0] == "self" and ctx.cls is not None:
+            owner = self._attr_class(ctx.cls, parts[1])
+            if owner is not None and parts[2] not in owner.attr_locks:
+                return ("attr", owner.name, parts[2])
+        return None
+
+    def _record(self, key: Optional[Tuple[str, str, str]], line: int,
+                held: Tuple[str, ...], ctx: _Ctx, write: bool) -> None:
+        if key is None:
+            return
+        self._field(key).accesses.append(_Access(
+            func=ctx.key, rel=ctx.mod.rel, line=line,
+            held=frozenset(held), write=write,
+            init=ctx.init and write and key[0] == "attr"))
+
+    def _is_container(self, key: Tuple[str, str, str]) -> bool:
+        return (key[1], key[2]) in self.containers
+
+    def _store_keys(self, tgt: ast.AST, ctx: _Ctx,
+                    through_sub: bool = False
+                    ) -> List[Tuple[str, str, str]]:
+        out: List[Tuple[str, str, str]] = []
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                out.extend(self._store_keys(el, ctx, through_sub))
+            return out
+        if isinstance(tgt, ast.Starred):
+            return self._store_keys(tgt.value, ctx, through_sub)
+        if isinstance(tgt, ast.Subscript):
+            tgt = tgt.value  # X[...] = v mutates X
+            through_sub = True
+        if isinstance(tgt, ast.Name):
+            # A bare `X = v` without `global X` is a local bind, not a
+            # global write; subscript/mutator forms reach the global —
+            # but only when the definition really is a container.
+            if tgt.id in ctx.globals_decl or not _is_plain_store(tgt):
+                gk = self._global_key(tgt.id, ctx)
+                if gk is not None and not (
+                        through_sub and not self._is_container(gk)):
+                    out.append(gk)
+            return out
+        name = dotted_name(tgt)
+        if name:
+            ak = self._attr_key(name, ctx)
+            if ak is not None and not (
+                    through_sub and not self._is_container(ak)):
+                out.append(ak)
+        return out
+
+    def _collect_accesses(self) -> None:
+        for key, (mod, cls, node) in self.funcs.items():
+            ctx = _Ctx(key, mod, cls, node)
+            for stmt in getattr(node, "body", ()):
+                self._walk_access(stmt, (), ctx)
+
+    def _walk_access(self, node: ast.AST, held: Tuple[str, ...],
+                     ctx: _Ctx) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                lk = self._lock_of(item.context_expr, ctx.mod, ctx.cls)
+                if lk:
+                    acquired.append(lk)
+                else:
+                    self._walk_access(item.context_expr, held, ctx)
+            inner = held + tuple(acquired)
+            for stmt in node.body:
+                self._walk_access(stmt, inner, ctx)
+            return
+        if isinstance(node, ast.If):
+            self._check_then_act(node, held, ctx)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for k in self._store_keys(tgt, ctx):
+                    self._record(k, node.lineno, held, ctx, write=True)
+        elif isinstance(node, ast.AugAssign):
+            for k in self._store_keys(node.target, ctx):
+                self._record(k, node.lineno, held, ctx, write=True)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            for k in self._store_keys(node.target, ctx):
+                self._record(k, node.lineno, held, ctx, write=True)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                for k in self._store_keys(tgt, ctx):
+                    self._record(k, node.lineno, held, ctx, write=True)
+        elif isinstance(node, ast.Call):
+            resolved = self._resolve_call(node, ctx.mod, ctx.cls)
+            for tgt_key in resolved:
+                self.call_sites.setdefault(tgt_key, []).append(
+                    (ctx.key, frozenset(held)))
+            fn = node.func
+            # A resolvable call (`self.qos.update()`) is a method whose
+            # body is analyzed directly — only unresolved attr calls
+            # count as container mutations.
+            if (not resolved and isinstance(fn, ast.Attribute)
+                    and fn.attr in _MUTATORS):
+                base = dotted_name(fn.value)
+                if base:
+                    k = (self._attr_key(base, ctx) if "." in base
+                         else self._global_key(base, ctx))
+                    if k is not None and self._is_container(k):
+                        self._record(k, node.lineno, held, ctx,
+                                     write=True)
+        elif isinstance(node, ast.Attribute) and isinstance(node.ctx,
+                                                            ast.Load):
+            name = dotted_name(node)
+            if name:
+                self._record(self._attr_key(name, ctx), node.lineno,
+                             held, ctx, write=False)
+                # Property access across objects (healthz reading
+                # `self.heartbeat.in_stall`): a call edge for
+                # reachability, so the owner's fields see this context.
+                parts = name.split(".")
+                if (len(parts) == 3 and parts[0] == "self"
+                        and ctx.cls is not None):
+                    owner = self._attr_class(ctx.cls, parts[1])
+                    if owner is not None and parts[2] in owner.methods:
+                        self.calls[ctx.key].add(
+                            f"{owner.rel}::{owner.name}.{parts[2]}")
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            self._record(self._global_key(node.id, ctx), node.lineno,
+                         held, ctx, write=False)
+        for child in ast.iter_child_nodes(node):
+            self._walk_access(child, held, ctx)
+
+    def _check_then_act(self, node: ast.If, held: Tuple[str, ...],
+                        ctx: _Ctx) -> None:
+        if ctx.init:
+            return
+        read: Set[Tuple[str, str, str]] = set()
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Attribute) and isinstance(sub.ctx,
+                                                             ast.Load):
+                k = self._attr_key(dotted_name(sub) or "", ctx)
+                if k:
+                    read.add(k)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                          ast.Load):
+                k = self._global_key(sub.id, ctx)
+                if k:
+                    read.add(k)
+        if not read:
+            return
+        written: Set[Tuple[str, str, str]] = set()
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        written.update(self._store_keys(tgt, ctx))
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    written.update(self._store_keys(sub.target, ctx))
+                elif (isinstance(sub, ast.Call)
+                      and isinstance(sub.func, ast.Attribute)
+                      and sub.func.attr in _MUTATORS
+                      and not self._resolve_call(sub, ctx.mod, ctx.cls)):
+                    base = dotted_name(sub.func.value)
+                    if base:
+                        k = (self._attr_key(base, ctx) if "." in base
+                             else self._global_key(base, ctx))
+                        if k and self._is_container(k):
+                            written.add(k)
+        for k in sorted(read & written):
+            self.cta.append((k, ctx.key, ctx.mod.rel, node.lineno,
+                             frozenset(held)))
+
+    # -- reachability + must-held entry -----------------------------------
+
+    def _reachability(self) -> None:
+        self.func_roots: Dict[str, Dict[str, str]] = {
+            k: {} for k in self.funcs}
+        for root, kind in self.roots.items():
+            if root not in self.funcs:
+                continue
+            seen = {root}
+            stack = [root]
+            while stack:
+                cur = stack.pop()
+                self.func_roots.setdefault(cur, {})[root] = kind
+                for nxt in self.calls.get(cur, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+
+    def _entry_fixpoint(self) -> None:
+        # Optimistic must-analysis from TOP (None): entry(callee) =
+        # ∩ over known call sites of (entry(caller) ∪ held-at-site);
+        # roots enter bare. Functions with no known callers resolve to
+        # the empty set — an unknown caller guarantees nothing.
+        entry: Dict[str, Optional[frozenset]] = {
+            k: None for k in self.funcs}
+        for r in self.roots:
+            if r in entry:
+                entry[r] = frozenset()
+        for _ in range(len(self.funcs)):
+            changed = False
+            for callee, sites in self.call_sites.items():
+                if callee not in entry or entry.get(callee) == frozenset():
+                    continue
+                if callee in self.roots:
+                    continue
+                acc: Optional[frozenset] = None
+                for caller, held in sites:
+                    ce = entry.get(caller)
+                    # An unknown caller (TOP) still guarantees what the
+                    # site holds lexically — `_transition` called only
+                    # inside `with self._lock:` blocks is guarded even
+                    # when its callers' own entries never resolve.
+                    val = held if ce is None else (ce | held)
+                    acc = val if acc is None else (acc & val)
+                if acc is not None and acc != entry[callee]:
+                    entry[callee] = acc
+                    changed = True
+            if not changed:
+                break
+        self.entry = entry
+
+    def _entry_of(self, func: str) -> frozenset:
+        e = self.entry.get(func)
+        return e if e is not None else frozenset()
+
+    # -- assemble fields, annotations, findings ---------------------------
+
+    def _definition_of(self, fi: _FieldInfo) -> Tuple[str, int]:
+        kind, owner, name = fi.key
+        if kind == "global":
+            line, _style = self.global_defs.get(owner, {}).get(
+                name, (0, "plain"))
+            if line:
+                return owner, line
+        else:
+            got = self.attr_defs.get((owner, name))
+            if got:
+                return got
+        first = min(fi.accesses, key=lambda a: (a.rel, a.line),
+                    default=None)
+        return (first.rel, first.line) if first else ("", 0)
+
+    def _parse_annotation(self, fi: _FieldInfo) -> Optional[_Annot]:
+        rel, line = fi.def_rel, fi.def_line
+        if not rel or not line:
+            return None
+        try:
+            lines = self.repo.file(rel).lines
+        except OSError:
+            return None
+        for ln in (line, line - 1):
+            if not (1 <= ln <= len(lines)):
+                continue
+            m = ANNOT_RE.search(lines[ln - 1])
+            if not m:
+                continue
+            guard = m.group("guard")
+            why = (m.group("why") or "").strip()
+            kind = "lock"
+            if guard in _FREE_KINDS or (
+                    guard == "threading.local"):
+                kind = guard
+            elif guard.split(".")[-1] == "local" and guard.startswith(
+                    "threading"):
+                kind = "threading.local"
+            return _Annot(guard=guard, kind=kind, why=why, rel=rel,
+                          line=ln)
+        return None
+
+    def _resolve_annot_lock(self, fi: _FieldInfo,
+                            an: _Annot) -> Optional[str]:
+        parts = an.guard.split(".")
+        kind, owner_name, _ = fi.key
+        if parts[0] == "self" and len(parts) == 2 and kind == "attr":
+            owner = self.class_index.get(owner_name)
+            if owner is not None and parts[1] in owner.attr_locks:
+                return f"{owner_name}.{parts[1]}"
+            return None
+        if len(parts) == 2:
+            owner = self.class_index.get(parts[0])
+            if owner is not None and parts[1] in owner.attr_locks:
+                return f"{parts[0]}.{parts[1]}"
+            for mod in self.modules.values():
+                if mod.base == parts[0] and parts[1] in mod.mod_locks:
+                    return f"{mod.base}.{parts[1]}"
+            return None
+        if len(parts) == 1:
+            for mod in self.modules.values():
+                if kind == "global" and mod.rel != fi.key[1]:
+                    continue
+                if parts[0] in mod.mod_locks:
+                    return f"{mod.base}.{parts[0]}"
+        return None
+
+    def _assemble(self) -> None:
+        for fi in self.fields.values():
+            for a in fi.accesses:
+                roots = self.func_roots.get(a.func, {})
+                if roots:
+                    fi.contexts.update(roots)
+                else:
+                    fi.main_context = True
+            fi.def_rel, fi.def_line = self._definition_of(fi)
+            fi.annot = self._parse_annotation(fi)
+            writes = fi.writes()
+            if writes:
+                guard = None
+                for a in writes:
+                    eff = a.held | self._entry_of(a.func)
+                    guard = eff if guard is None else (guard & eff)
+                fi.guard = guard or frozenset()
+        self._emit_findings()
+
+    def shared_fields(self) -> List[_FieldInfo]:
+        """Inventory: function-written module globals, plus instance
+        attrs written outside init and reachable from >= 2 concurrent
+        contexts."""
+        out = []
+        for key in sorted(self.fields):
+            fi = self.fields[key]
+            if not fi.writes():
+                continue
+            if key[0] == "global" or fi.weight() >= 2:
+                out.append(fi)
+        return out
+
+    def _ctx_summary(self, fi: _FieldInfo) -> str:
+        counts: Dict[str, int] = {}
+        for kind in fi.contexts.values():
+            counts[kind] = counts.get(kind, 0) + 1
+        parts = [f"{n} {k}" for k, n in sorted(counts.items())]
+        if fi.main_context:
+            parts.append("main")
+        if fi.key[0] == "global":
+            return "any trace/serving thread"
+        return ", ".join(parts) if parts else "-"
+
+    def _emit_findings(self) -> None:
+        flagged: Set[Tuple[str, str, str]] = set()
+        for fi in self.shared_fields():
+            if fi.annot is not None:
+                self._validate_annotation(fi)
+                continue
+            if fi.guard:
+                continue
+            writes = fi.writes()
+            bare = [a for a in writes
+                    if not (a.held | self._entry_of(a.func))]
+            flagged.add(fi.key)
+            what = ("module global" if fi.key[0] == "global"
+                    else f"instance attr (contexts: "
+                         f"{self._ctx_summary(fi)})")
+            if bare:
+                a = min(bare, key=lambda x: (x.rel, x.line))
+                self.race_findings.append(Finding(
+                    "shared-state-race", a.rel, a.line,
+                    f"unguarded write to shared {what} {fi.label!r}: "
+                    f"no dominating lock and no `# guarded-by:` "
+                    f"annotation (add the lock, use threading.local, "
+                    f"or annotate the definition at "
+                    f"{fi.def_rel}:{fi.def_line})",
+                    symbol=fi.label))
+            else:
+                a = min(writes, key=lambda x: (x.rel, x.line))
+                locks = sorted({lk for w in writes
+                                for lk in (w.held
+                                           | self._entry_of(w.func))})
+                self.race_findings.append(Finding(
+                    "shared-state-race", a.rel, a.line,
+                    f"inconsistently guarded writes to shared {what} "
+                    f"{fi.label!r}: no single lock dominates "
+                    f"(saw {', '.join(locks)}); pick one or annotate",
+                    symbol=fi.label))
+        for key, func, rel, line, held in self.cta:
+            fi = self.fields.get(key)
+            if fi is None or key in flagged or fi.annot is not None:
+                continue
+            if not fi.writes():
+                continue
+            if key[0] != "global" and fi.weight() < 2:
+                continue
+            if held | self._entry_of(func):
+                continue
+            self.race_findings.append(Finding(
+                "shared-state-race", rel, line,
+                f"check-then-act on shared state {fi.label!r}: the "
+                f"test reads it with no lock held, the body writes it "
+                f"- two threads can both pass the check (hold the "
+                f"lock across the check, or annotate the definition)",
+                symbol=fi.label))
+
+    def _validate_annotation(self, fi: _FieldInfo) -> None:
+        an = fi.annot
+        assert an is not None
+        if an.kind == "lock":
+            node = self._resolve_annot_lock(fi, an)
+            if node is None:
+                self.race_findings.append(Finding(
+                    "shared-state-race", an.rel, an.line,
+                    f"`# guarded-by: {an.guard}` on {fi.label!r} names "
+                    f"no known lock (known kinds: a lock attr/module "
+                    f"lock, or {', '.join(_FREE_KINDS)})",
+                    symbol=fi.label))
+            else:
+                an.lock_node = node
+        elif not an.why:
+            self.race_findings.append(Finding(
+                "shared-state-race", an.rel, an.line,
+                f"`# guarded-by: {an.kind}` on {fi.label!r} needs a "
+                f"justification: `# guarded-by: {an.kind} -- <why "
+                f"this lock-free discipline is safe>`",
+                symbol=fi.label))
+
+
+def _is_plain_store(tgt: ast.Name) -> bool:
+    return isinstance(tgt.ctx, ast.Store)
+
+
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter",
+}
+_CONTAINER_ANNS = {"dict", "list", "set", "Dict", "List", "Set",
+                   "MutableMapping", "deque", "DefaultDict"}
+
+
+def _is_container_expr(val: Optional[ast.AST]) -> bool:
+    if isinstance(val, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                        ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(val, ast.Call):
+        nm = (dotted_name(val.func) or "").split(".")[-1]
+        return nm in _CONTAINER_CTORS
+    return False
+
+
+def _is_container_ann(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Subscript):
+        ann = ann.value
+    nm = (dotted_name(ann) or "").split(".")[-1]
+    return nm in _CONTAINER_ANNS
+
+
+def analyze(repo: Repo) -> _RaceAnalyzer:
+    an = _RaceAnalyzer(repo)
+    an.analyze()
+    return an
+
+
+# -- generated docs block --------------------------------------------------
+
+
+def _guard_text(fi: _FieldInfo) -> str:
+    if fi.annot is not None:
+        if fi.annot.kind == "lock":
+            tgt = fi.annot.lock_node or fi.annot.guard
+            return f"`{tgt}` (annotated)"
+        return f"`{fi.annot.kind}` (annotated)"
+    if fi.guard:
+        return ", ".join(f"`{g}`" for g in sorted(fi.guard)) + " (inferred)"
+    return "**UNGUARDED**"
+
+
+def render_inventory_table(an: _RaceAnalyzer) -> str:
+    lines = [
+        "Generated by `python tools/ncnet_lint.py --write-docs` — do not",
+        "edit by hand. Shared mutable state (module globals written from",
+        "functions; instance attrs written outside `__init__` and",
+        "reachable from two concurrent contexts) with the guard that",
+        "protects each field — a lock the `shared-state-race` rule",
+        "inferred from the write sites, or a `# guarded-by:` annotation",
+        "at the definition.",
+        "",
+        "| Shared state | Kind | Defined at | Guard | Concurrent "
+        "contexts |",
+        "|---|---|---|---|---|",
+    ]
+    rows = []
+    for fi in an.shared_fields():
+        kind = "global" if fi.key[0] == "global" else "attr"
+        label = (f"{fi.key[1].rsplit('/', 1)[-1][:-3]}.{fi.key[2]}"
+                 if kind == "global" else fi.label)
+        rows.append((label, kind, f"{fi.def_rel}:{fi.def_line}",
+                     _guard_text(fi), self_ctx(an, fi)))
+    for label, kind, where, guard, ctx in sorted(rows):
+        lines.append(f"| `{label}` | {kind} | `{where}` | {guard} "
+                     f"| {ctx} |")
+    lines.append("")
+    n_ann = sum(1 for fi in an.shared_fields() if fi.annot is not None)
+    lines.append(f"{len(rows)} shared field(s); {n_ann} annotated, "
+                 f"the rest lock-guarded by inference. The rule fails "
+                 f"the lint when any row is unguarded or this table "
+                 f"is stale.")
+    return "\n".join(lines)
+
+
+def self_ctx(an: _RaceAnalyzer, fi: _FieldInfo) -> str:
+    return an._ctx_summary(fi)
+
+
+def write_docs_block(repo: Repo) -> bool:
+    """Rewrite the generated shared-state block in docs/ANALYSIS.md.
+
+    Returns True when the file changed; prose outside the markers is
+    untouched."""
+    import os
+
+    doc_path = os.path.join(repo.root, DOC_PATH)
+    try:
+        with open(doc_path, encoding="utf-8") as fh:
+            doc = fh.read()
+    except OSError:
+        return False
+    if BEGIN_MARK not in doc or END_MARK not in doc:
+        return False
+    head, rest = doc.split(BEGIN_MARK, 1)
+    _stale, tail = rest.split(END_MARK, 1)
+    table = render_inventory_table(analyze(repo))
+    new = head + BEGIN_MARK + "\n" + table + "\n" + END_MARK + tail
+    if new == doc:
+        return False
+    with open(doc_path, "w", encoding="utf-8") as fh:
+        fh.write(new)
+    return True
+
+
+def canary_plan(repo: Repo) -> List[dict]:
+    """Annotated instance fields the dynamic race canary can wrap:
+    lock-annotated fields (assert the lock is held at every write) and
+    single-writer fields (assert writes stay on one thread after the
+    main-thread handoff). Other kinds (threading.local, atomic,
+    external) and module globals carry no runtime check."""
+    an = analyze(repo)
+    plan: List[dict] = []
+    for fi in an.fields.values():
+        if fi.key[0] != "attr" or fi.annot is None:
+            continue
+        spec = {"module_rel": fi.def_rel, "cls": fi.key[1],
+                "attr": fi.key[2], "kind": fi.annot.kind}
+        if fi.annot.kind == "lock":
+            node = fi.annot.lock_node or ""
+            if not node or node.split(".")[0] != fi.key[1]:
+                continue  # only same-object locks are checkable
+            spec["lock_attr"] = node.split(".")[1]
+        elif fi.annot.kind != "single-writer":
+            continue
+        plan.append(spec)
+    plan.sort(key=lambda s: (s["cls"], s["attr"]))
+    return plan
+
+
+class SharedStateRaceRule(Rule):
+    rule_id = "shared-state-race"
+    description = ("unguarded writes / check-then-act races on shared "
+                   "mutable state (module globals, multi-thread-root "
+                   "instance attrs) across serving/, obs/, "
+                   "reliability/, pipeline/, models/, ops/; "
+                   "docs/ANALYSIS.md inventory freshness")
+    full_repo = True  # reachability must never see a partial repo
+
+    def check(self, repo: Repo) -> Iterable[Finding]:
+        an = _RaceAnalyzer(repo)
+        an.analyze()
+        for f in an.findings:  # unparseable-file findings from build()
+            yield Finding(self.rule_id, f.path, f.line, f.message,
+                          f.symbol)
+        yield from an.race_findings
+        yield from self._check_docs(repo, an)
+
+    def _check_docs(self, repo: Repo,
+                    an: _RaceAnalyzer) -> Iterable[Finding]:
+        doc = repo.read_doc(DOC_PATH)
+        want = lock_order._normalize(render_inventory_table(an))
+        if doc is None:
+            yield Finding(self.rule_id, DOC_PATH, 1,
+                          f"{DOC_PATH} is missing; run "
+                          "`python tools/ncnet_lint.py --write-docs`",
+                          symbol="docs-block")
+            return
+        if BEGIN_MARK not in doc or END_MARK not in doc:
+            yield Finding(self.rule_id, DOC_PATH, 1,
+                          f"{DOC_PATH} lacks the generated shared-state "
+                          f"block markers ({BEGIN_MARK}); run "
+                          "`python tools/ncnet_lint.py --write-docs`",
+                          symbol="docs-block")
+            return
+        begin_line = doc[: doc.index(BEGIN_MARK)].count("\n") + 1
+        body = doc.split(BEGIN_MARK, 1)[1].split(END_MARK, 1)[0]
+        if lock_order._normalize(body) != want:
+            yield Finding(self.rule_id, DOC_PATH, begin_line,
+                          "generated shared-state inventory table is "
+                          "stale; run "
+                          "`python tools/ncnet_lint.py --write-docs`",
+                          symbol="docs-block")

@@ -266,6 +266,7 @@ NULL_RUN = _NullRunLog()
 _active_lock = threading.Lock()
 _active: list = []  # innermost-last stack of open RunLogs
 _exit_hooks_installed = False
+_hooks_lock = threading.Lock()
 
 
 def _deactivate(run: RunLog) -> None:
@@ -294,9 +295,10 @@ def _install_exit_hooks() -> None:
     utils/profiling.run_with_alarm owns it.
     """
     global _exit_hooks_installed
-    if _exit_hooks_installed:
-        return
-    _exit_hooks_installed = True
+    with _hooks_lock:
+        if _exit_hooks_installed:
+            return
+        _exit_hooks_installed = True
     atexit.register(_close_all, "atexit")
     # Unhandled exceptions (main thread or any worker) dump the flight
     # recorder's ring before the traceback prints — the last N events

@@ -140,7 +140,8 @@ class FlightRecorder:
                     fh.write(json.dumps(rec, default=str) + "\n")
         except OSError:
             return None
-        self.dumps += 1
+        with self._lock:
+            self.dumps += 1
         return path
 
 
@@ -162,6 +163,7 @@ def dump(reason: str, directory: Optional[str] = None,
 
 
 _hooks_installed = False
+_hooks_lock = threading.Lock()
 
 
 def install_excepthooks() -> None:
@@ -169,9 +171,10 @@ def install_excepthooks() -> None:
     exceptions; installed once (idempotent), called from
     ``obs.events._install_exit_hooks``."""
     global _hooks_installed
-    if _hooks_installed:
-        return
-    _hooks_installed = True
+    with _hooks_lock:
+        if _hooks_installed:
+            return
+        _hooks_installed = True
 
     import sys
 

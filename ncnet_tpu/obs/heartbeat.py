@@ -60,8 +60,14 @@ class Heartbeat:
             else 4.0 * self.interval_s
         )
         self.clock = clock
+        # Counters below are written by beat_once only: the heartbeat
+        # thread, plus one synchronous seed call in start() made before
+        # that thread exists. /healthz readers tolerate a stale value.
+        # guarded-by: single-writer -- beat_once is heartbeat-thread-only
         self.beats = 0
+        # guarded-by: single-writer -- beat_once is heartbeat-thread-only
         self.stalls = 0
+        # guarded-by: single-writer -- beat_once is heartbeat-thread-only
         self._in_stall = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

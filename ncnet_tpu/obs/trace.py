@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import threading
 import time
 import uuid
 from typing import Iterable, NamedTuple, Optional, Tuple
@@ -199,6 +200,7 @@ def trace(name: str, **fields):
 # -- jax.monitoring compile telemetry -------------------------------------
 
 _compile_telemetry_installed = False
+_install_lock = threading.Lock()
 
 
 def install_compile_telemetry() -> bool:
@@ -221,12 +223,14 @@ def install_compile_telemetry() -> bool:
     stubbed-out environments.
     """
     global _compile_telemetry_installed
-    if _compile_telemetry_installed:
-        return True
-    try:
-        from jax import monitoring as _monitoring
-    except Exception:
-        return False
+    with _install_lock:
+        if _compile_telemetry_installed:
+            return True
+        try:
+            from jax import monitoring as _monitoring
+        except Exception:
+            return False
+        _compile_telemetry_installed = True
 
     def _listener(jax_event: str, duration: float, **kwargs) -> None:
         try:
@@ -250,5 +254,4 @@ def install_compile_telemetry() -> bool:
             pass
 
     _monitoring.register_event_duration_secs_listener(_listener)
-    _compile_telemetry_installed = True
     return True

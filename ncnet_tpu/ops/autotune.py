@@ -78,6 +78,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 # (path, mtime, size) -> parsed cache dict; lookup_plan runs at trace
 # time (serving warmup traces per shape bucket), so the JSON parse must
 # not repeat per trace.
+# guarded-by: atomic -- GIL-atomic dict ops; racing warmup threads
 _CACHE_MEMO: dict = {}
 
 

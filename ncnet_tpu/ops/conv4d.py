@@ -46,6 +46,7 @@ _DEFAULT_STRATEGY = "auto"
 # from: arg | env | cache | auto). Introspection only — bench.py reports
 # it in the headline payload and the autotuner tests assert on it; it
 # carries no numerics. None until the first call.
+# guarded-by: atomic -- single reference assignment, last-writer-wins
 LAST_PLAN: dict | None = None
 
 

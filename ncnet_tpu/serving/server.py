@@ -274,6 +274,7 @@ class MatchServer:
         if self.replica_id:
             obs.set_build_info(replica=self.replica_id)
         self.t_start = time.monotonic()
+        # guarded-by: atomic -- bool publish; drain tolerates stale reads
         self._draining = False
         server = self
 

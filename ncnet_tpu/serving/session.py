@@ -110,16 +110,22 @@ class Session:
     priority: str
     ref_digest: str
     created: float
+    # Mutable frame-to-frame state: handler threads hold ``lock``
+    # across prepare -> submit -> record. ``last_used`` is the one
+    # exception — ``SessionManager.get`` touches it under the manager
+    # lock, so it is a deliberate last-writer-wins timestamp.
+    # guarded-by: atomic -- touch timestamp; last-writer-wins is correct
     last_used: float
     ref_path: Optional[str] = None
     ref_b64: Optional[str] = None
     ref_feats: Optional[object] = None   # np [1,C,h,w] once computed
     ref_shape: Optional[tuple] = None
     op: Optional[tuple] = None           # pinned c2f operating point
-    seed: Optional[Seed] = None
-    frames: int = 0
+    seed: Optional[Seed] = None  # guarded-by: Session.lock -- per frame
+    frames: int = 0  # guarded-by: Session.lock -- held across a frame
+    # guarded-by: Session.lock -- held across a frame
     seeded_frames: int = 0
-    reseeds: int = 0
+    reseeds: int = 0  # guarded-by: Session.lock -- held across a frame
     closed: bool = False
     lock: threading.Lock = field(default_factory=threading.Lock)
 

@@ -49,6 +49,20 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    """NCNET_RACE_CANARY=1 arms the dynamic race canary: every
+    `# guarded-by:` lock / single-writer annotation in the repo becomes
+    a per-write runtime assertion (docs/ANALYSIS.md "Race canary"), so
+    this very suite doubles as a sanitizer pass over the annotations."""
+    if os.environ.get("NCNET_RACE_CANARY") == "1":
+        from ncnet_tpu.analysis.canary import install_canaries
+
+        installed = install_canaries()
+        config._ncnet_race_canaries = installed
+        print(f"[race-canary] armed {len(installed)} annotated "
+              f"field(s)", flush=True)
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)

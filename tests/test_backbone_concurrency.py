@@ -1,0 +1,65 @@
+"""Concurrency regression for the PR 13 backbone layout fix.
+
+The channels-last trace flag was once a module global: one replica
+thread entering an NHWC scope flipped every other thread's in-flight
+trace into mixed-layout convs. It is now ``threading.local`` state
+(models/backbone.py ``_LAYOUT_STATE``), and this test pins that down:
+N threads trace channels-first and channels-last CONCURRENTLY - a
+barrier inside each thread's layout scope guarantees every scope is
+simultaneously open - and each thread must see its own layout, both in
+the flag and in the conv output shape. Deterministic (the barrier
+forces the overlap; no sleeps) and fast (tiny eager convs, no jit).
+"""
+
+import threading
+
+import numpy as np
+
+from ncnet_tpu.models.backbone import (
+    _channels_last,
+    _channels_last_on,
+    conv2d,
+)
+
+N_THREADS = 8
+ROUNDS = 3
+
+
+def test_concurrent_layout_scopes_never_mix():
+    cin, cout, hw = 3, 5, 8
+    w = np.zeros((3, 3, cin, cout), np.float32)
+    x_nchw = np.zeros((1, cin, hw, hw), np.float32)
+    x_nhwc = np.zeros((1, hw, hw, cin), np.float32)
+    barrier = threading.Barrier(N_THREADS)
+    errors = []
+
+    def worker(idx):
+        nhwc = idx % 2 == 1
+        try:
+            for _ in range(ROUNDS):
+                with _channels_last(nhwc):
+                    # Every thread sits here with its scope OPEN until
+                    # all N scopes are open: a module-global flag would
+                    # now hold the last writer's layout for everyone.
+                    barrier.wait(timeout=30)
+                    assert _channels_last_on() is nhwc
+                    out = conv2d(x_nhwc if nhwc else x_nchw, w,
+                                 stride=1, padding=1)
+                    want = ((1, hw, hw, cout) if nhwc
+                            else (1, cout, hw, hw))
+                    assert out.shape == want, (
+                        f"thread {idx}: mixed-layout conv "
+                        f"(got {out.shape}, want {want})")
+                assert _channels_last_on() is False  # scope restored
+        except Exception as exc:  # noqa: BLE001 - reported by the main thread
+            errors.append((idx, exc))
+            barrier.abort()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads)

@@ -676,7 +676,7 @@ def test_ncnet_lint_emits_one_json_line(capsys):
     assert rec["new"] == 0
     assert set(rec["rules"]) == {
         "bare-print", "failpoint-docs", "lock-order", "metrics-docs",
-        "recompile-hazard", "trace-purity",
+        "recompile-hazard", "shared-state-race", "trace-purity",
     }
     # Unknown rules are a usage error (rc 2), not a silent pass.
     assert ncnet_lint.main(["--rule", "nope"]) == 2

@@ -1,0 +1,433 @@
+"""The shared-state-race rule family + the dynamic race canary.
+
+Fixture repos (the test_analysis_engine.py idiom: a ``ncnet_tpu/``
+tree under tmp_path) seed each finding class the rule must fire on —
+including the reverted-PR-13 backbone-style module global, proving the
+rule would have caught that bug — and the clean/annotated
+counterparts it must stay quiet on. The canary tests exercise the
+runtime half: a ``# guarded-by:`` annotation becomes a per-write
+assertion, and a seeded violation actually raises.
+
+Never imports jax; tier-1 fast.
+"""
+
+import textwrap
+import threading
+
+from ncnet_tpu.analysis import Repo, get_rules, run_rules
+from ncnet_tpu.analysis.canary import RaceCanaryError, _Canary
+from ncnet_tpu.analysis.rules import races
+from tools.ncnet_lint import main as lint_main
+
+
+def make_repo(tmp_path, files):
+    for rel, text in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    return Repo(root=str(tmp_path))
+
+
+def race_findings(repo):
+    """Code findings only (every fixture repo lacks docs/ANALYSIS.md,
+    so the docs-block freshness finding is asserted separately)."""
+    report = run_rules(repo, get_rules(["shared-state-race"]))
+    return [f for f in report.new if f.symbol != "docs-block"]
+
+
+# -- seeded fixtures the rule must fire on --------------------------------
+
+
+# PR 13's bug, reverted: the channels-last trace flag as a module
+# global written from a layout scope that any replica thread enters.
+BACKBONE_GLOBAL = {
+    "ncnet_tpu/models/bb.py": """
+        _CHANNELS_LAST = False
+
+
+        def set_layout(flag):
+            global _CHANNELS_LAST
+            _CHANNELS_LAST = flag
+
+
+        def conv(x):
+            if _CHANNELS_LAST:
+                return x[::-1]
+            return x
+    """,
+}
+
+# One instance attr written from an HTTP handler root AND a dedicated
+# thread root, no lock anywhere.
+TWO_ROOT_ATTR = {
+    "ncnet_tpu/serving/srv.py": """
+        import threading
+        from http.server import ThreadingHTTPServer
+
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+                self.httpd = ThreadingHTTPServer(("", 0), None)
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self.count += 1
+
+            def handle_frame(self):
+                self.count += 1
+    """,
+}
+
+# The double-init idiom: the write is locked (so the field has a
+# consistent guard) but the check is not — two threads can both pass.
+CHECK_THEN_ACT = {
+    "ncnet_tpu/obs/cta.py": """
+        import threading
+
+        _LOCK = threading.Lock()
+        _INSTALLED = False
+
+
+        def install():
+            global _INSTALLED
+            if not _INSTALLED:
+                with _LOCK:
+                    _INSTALLED = True
+    """,
+}
+
+
+def test_fires_on_reverted_backbone_module_global(tmp_path):
+    repo = make_repo(tmp_path, BACKBONE_GLOBAL)
+    found = race_findings(repo)
+    assert any("_CHANNELS_LAST" in f.symbol
+               and "unguarded write" in f.message for f in found), found
+
+
+def test_fires_on_two_root_unguarded_instance_attr(tmp_path):
+    repo = make_repo(tmp_path, TWO_ROOT_ATTR)
+    found = race_findings(repo)
+    assert any(f.symbol == "Worker.count"
+               and "unguarded write" in f.message for f in found), found
+
+
+def test_fires_on_check_then_act_pair(tmp_path):
+    repo = make_repo(tmp_path, CHECK_THEN_ACT)
+    found = race_findings(repo)
+    assert any("_INSTALLED" in f.symbol
+               and "check-then-act" in f.message for f in found), found
+    # The locked write itself is consistently guarded - the CHECK is
+    # the finding, not the write.
+    assert not any("unguarded write" in f.message for f in found), found
+
+
+def test_cli_exits_nonzero_on_each_seeded_fixture(tmp_path, capsys):
+    for i, fixture in enumerate(
+            (BACKBONE_GLOBAL, TWO_ROOT_ATTR, CHECK_THEN_ACT)):
+        root = tmp_path / f"fix{i}"
+        root.mkdir()
+        make_repo(root, fixture)
+        rc = lint_main(["--root", str(root),
+                        "--rule", "shared-state-race"])
+        capsys.readouterr()
+        assert rc == 1, f"fixture {i} did not fail the lint"
+
+
+# -- clean + annotated counterparts the rule must stay quiet on -----------
+
+
+CLEAN_GUARDED = {
+    "ncnet_tpu/serving/clean.py": """
+        import threading
+        from http.server import ThreadingHTTPServer
+
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                self.httpd = ThreadingHTTPServer(("", 0), None)
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                with self._lock:
+                    self.n += 1
+
+            def handle_frame(self):
+                with self._lock:
+                    self.n += 1
+    """,
+}
+
+ANNOTATED = {
+    "ncnet_tpu/serving/annot.py": """
+        import threading
+        from http.server import ThreadingHTTPServer
+
+        # guarded-by: atomic -- last-writer-wins debug slot
+        _LAST = None
+
+
+        class Annotated:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # guarded-by: single-writer -- loop thread only
+                self.beats = 0
+                self.httpd = ThreadingHTTPServer(("", 0), None)
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                global _LAST
+                self.beats += 1
+                _LAST = self.beats
+
+            def handle_frame(self):
+                return self.beats
+    """,
+}
+
+
+def test_quiet_on_lock_guarded_writes(tmp_path):
+    repo = make_repo(tmp_path, CLEAN_GUARDED)
+    assert race_findings(repo) == []
+
+
+def test_quiet_on_annotated_fields(tmp_path):
+    repo = make_repo(tmp_path, ANNOTATED)
+    assert race_findings(repo) == []
+
+
+# -- annotation validation ------------------------------------------------
+
+
+BAD_ANNOTATIONS = {
+    "ncnet_tpu/serving/badann.py": """
+        import threading
+        from http.server import ThreadingHTTPServer
+
+
+        class Bad:
+            def __init__(self):
+                # guarded-by: self._nope
+                self.a = 0
+                # guarded-by: atomic
+                self.b = 0
+                self.httpd = ThreadingHTTPServer(("", 0), None)
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self.a += 1
+                self.b += 1
+
+            def handle_frame(self):
+                self.a += 1
+                self.b += 1
+    """,
+}
+
+
+def test_annotation_validation(tmp_path):
+    repo = make_repo(tmp_path, BAD_ANNOTATIONS)
+    found = race_findings(repo)
+    assert any(f.symbol == "Bad.a" and "no known lock" in f.message
+               for f in found), found
+    assert any(f.symbol == "Bad.b" and "justification" in f.message
+               for f in found), found
+
+
+# -- docs freshness -------------------------------------------------------
+
+
+def test_docs_block_freshness(tmp_path):
+    repo = make_repo(tmp_path, dict(BACKBONE_GLOBAL))
+    report = run_rules(repo, get_rules(["shared-state-race"]))
+    assert any(f.symbol == "docs-block" and "missing" in f.message
+               for f in report.new)
+
+    # Markers present but the table stale: the freshness finding names
+    # the block, not the file.
+    doc = tmp_path / "docs" / "ANALYSIS.md"
+    doc.parent.mkdir(parents=True, exist_ok=True)
+    doc.write_text(f"# x\n\n{races.BEGIN_MARK}\nstale\n{races.END_MARK}\n")
+    repo = Repo(root=str(tmp_path))
+    report = run_rules(repo, get_rules(["shared-state-race"]))
+    assert any(f.symbol == "docs-block" and "stale" in f.message
+               for f in report.new)
+
+    # write_docs_block regenerates it in place; the finding clears.
+    assert races.write_docs_block(repo) is True
+    repo = Repo(root=str(tmp_path))
+    report = run_rules(repo, get_rules(["shared-state-race"]))
+    assert not any(f.symbol == "docs-block" for f in report.new)
+    assert "\nstale\n" not in doc.read_text()
+
+
+def test_real_repo_inventory_is_fresh_and_cross_checked():
+    """The committed docs table matches the code (both directions: a
+    row per shared field, a field per row), and the real repo carries
+    zero race findings with the EMPTY baseline - the sweep contract."""
+    repo = Repo()
+    report = run_rules(repo, get_rules(["shared-state-race"]))
+    assert report.new == [], [f.message for f in report.new]
+    an = races.analyze(repo)
+    table = repo.read_doc(races.DOC_PATH)
+    body = table.split(races.BEGIN_MARK, 1)[1].split(races.END_MARK, 1)[0]
+    fields = an.shared_fields()
+    assert fields, "inventory unexpectedly empty"
+    for fi in fields:
+        label = (f"{fi.key[1].rsplit('/', 1)[-1][:-3]}.{fi.key[2]}"
+                 if fi.key[0] == "global" else fi.label)
+        assert f"`{label}`" in body, f"missing row for {label}"
+    n_rows = sum(1 for ln in body.splitlines()
+                 if ln.startswith("| `"))
+    assert n_rows == len(fields), "table has rows with no field"
+
+
+# -- pragma scoping: decorator-line pragma covers the decorated def -------
+
+
+PRAGMA_ON_DECORATOR = {
+    "ncnet_tpu/models/bbp.py": """
+        import functools
+
+        _FLAG = False
+
+
+        @functools.lru_cache()  # ncnet-lint: disable=shared-state-race
+        def set_flag(v):
+            global _FLAG
+            _FLAG = v
+    """,
+}
+
+
+def test_pragma_on_decorator_line_suppresses_body_findings(tmp_path):
+    repo = make_repo(tmp_path, PRAGMA_ON_DECORATOR)
+    report = run_rules(repo, get_rules(["shared-state-race"]))
+    assert not [f for f in report.new if f.symbol != "docs-block"], [
+        f.message for f in report.new]
+    assert report.suppressed >= 1
+
+
+PRAGMA_ABOVE_DECORATOR = {
+    "ncnet_tpu/models/bbp.py": """
+        import functools
+
+        _FLAG = False
+
+
+        # ncnet-lint: disable=shared-state-race
+        @functools.lru_cache()
+        def set_flag(v):
+            global _FLAG
+            _FLAG = v
+    """,
+}
+
+
+def test_pragma_above_decorator_suppresses_by_symbol(tmp_path):
+    # Pragma alone on the line above the decorator; the finding's line
+    # sits inside the def body, so this exercises the baseline-style
+    # symbol-or-line matching, not same-line adjacency.
+    repo = make_repo(tmp_path, PRAGMA_ABOVE_DECORATOR)
+    report = run_rules(repo, get_rules(["shared-state-race"]))
+    assert not [f for f in report.new if f.symbol != "docs-block"], [
+        f.message for f in report.new]
+    assert report.suppressed >= 1
+
+
+# -- the dynamic race canary ----------------------------------------------
+
+
+class _Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.val = 0  # first write: constructor, exempt
+
+
+def test_canary_lock_descriptor_fires_and_stays_quiet():
+    cls = type("BoxL", (_Box,), {})
+    cls.val = _Canary("BoxL", "val", "lock", lock_attr="_lock")
+    box = cls()
+    with box._lock:
+        box.val = 1  # guarded write: quiet
+    assert box.val == 1
+    try:
+        box.val = 2
+    except RaceCanaryError as exc:
+        assert "BoxL.val" in str(exc) and "_lock" in str(exc)
+    else:
+        raise AssertionError("canary did not fire on unguarded write")
+
+
+def test_canary_single_writer_handoff():
+    cls = type("BoxS", (_Box,), {})
+    cls.val = _Canary("BoxS", "val", "single-writer")
+    box = cls()
+    box.val = 1  # main-thread seed before handoff: allowed
+
+    def writer():
+        box.val = 2  # handoff: this thread owns the field now
+        box.val = 3
+
+    t = threading.Thread(target=writer)
+    t.start()
+    t.join()
+    assert box.val == 3
+    fired = []
+
+    def intruder():
+        try:
+            box.val = 4
+        except RaceCanaryError as exc:
+            fired.append(exc)
+
+    t2 = threading.Thread(target=intruder)
+    t2.start()
+    t2.join()
+    assert fired, "second thread wrote a single-writer field unnoticed"
+
+
+def test_install_canaries_fires_on_real_session_in_subprocess():
+    """End-to-end seeded violation: install the real canary plan over
+    the real classes (in a subprocess, so this suite's own Session
+    instances stay undecorated) and write a Session field without the
+    session lock - the wrap must raise. This is the NCNET_RACE_CANARY=1
+    path tests/conftest.py arms, minus pytest."""
+    import subprocess
+    import sys
+
+    code = (
+        "from ncnet_tpu.analysis.canary import install_canaries, "
+        "RaceCanaryError\n"
+        "installed = install_canaries()\n"
+        "assert 'Session.frames' in installed, installed\n"
+        "from ncnet_tpu.serving.session import Session\n"
+        "s = Session(session_id='s', tenant='t', priority='p',\n"
+        "            ref_digest='d', created=0.0, last_used=0.0)\n"
+        "with s.lock:\n"
+        "    s.frames += 1  # guarded: quiet\n"
+        "try:\n"
+        "    s.frames += 1\n"
+        "except RaceCanaryError:\n"
+        "    print('CANARY_FIRED')\n"
+        "else:\n"
+        "    raise SystemExit('canary did not fire')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "CANARY_FIRED" in proc.stdout
+
+
+def test_canary_plan_covers_repo_annotations():
+    plan = races.canary_plan(Repo())
+    got = {(s["cls"], s["attr"]): s for s in plan}
+    assert ("Session", "frames") in got
+    assert got[("Session", "frames")]["kind"] == "lock"
+    assert got[("Session", "frames")]["lock_attr"] == "lock"
+    assert ("Heartbeat", "beats") in got
+    assert got[("Heartbeat", "beats")]["kind"] == "single-writer"
+    # atomic/external/threading.local carry no runtime check.
+    assert all(s["kind"] in ("lock", "single-writer") for s in plan)

@@ -170,28 +170,33 @@ def test_seed_quality_threshold_drives_reseed():
     mgr = SessionManager(max_sessions=2, reseed_frac=0.5,
                          clock=FakeClock())
     s = mgr.open("default", "interactive", "digest", ref_b64="x")
-    # Full-coarse frame mints the seed; coarse-scale mass is not a
-    # reference (refined-scale masses are not comparable to it).
-    mgr.record_frame(s, seeded=False, gates=_gates(), replica_id="d0",
-                     bucket=("b",))
-    assert s.seed is not None and s.seed.mass_ref is None
-    # First seeded frame establishes the refined-scale reference.
-    mgr.record_frame(s, seeded=True, gates=_gates(), mass=10.0)
-    assert s.seed.mass_ref == 10.0
-    # At/above the threshold the seed rolls forward (mass_ref sticks).
-    mgr.record_frame(s, seeded=True, gates=_gates(), mass=6.0)
-    assert s.seed is not None and s.reseeds == 0
-    assert s.seed.mass_ref == 10.0
-    # Below reseed_frac * mass_ref: the seed drops, the NEXT frame
-    # re-runs the coarse pass.
-    mgr.record_frame(s, seeded=True, gates=_gates(), mass=4.0)
-    assert s.seed is None
-    assert s.reseeds == 1
-    assert s.frames == 4 and s.seeded_frames == 3
-    # Gate-less frame (degenerate op path): the session simply never
-    # seeds, without counting a re-seed.
-    mgr.record_frame(s, seeded=False, gates=None)
-    assert s.seed is None and s.reseeds == 1
+    # record_frame's contract (and the race canary under
+    # NCNET_RACE_CANARY=1): callers hold the session lock across each
+    # frame, like the server's prepare -> submit -> record window.
+    with s.lock:
+        # Full-coarse frame mints the seed; coarse-scale mass is not a
+        # reference (refined-scale masses are not comparable to it).
+        mgr.record_frame(s, seeded=False, gates=_gates(),
+                         replica_id="d0", bucket=("b",))
+        assert s.seed is not None and s.seed.mass_ref is None
+        # First seeded frame establishes the refined-scale reference.
+        mgr.record_frame(s, seeded=True, gates=_gates(), mass=10.0)
+        assert s.seed.mass_ref == 10.0
+        # At/above the threshold the seed rolls forward (mass_ref
+        # sticks).
+        mgr.record_frame(s, seeded=True, gates=_gates(), mass=6.0)
+        assert s.seed is not None and s.reseeds == 0
+        assert s.seed.mass_ref == 10.0
+        # Below reseed_frac * mass_ref: the seed drops, the NEXT frame
+        # re-runs the coarse pass.
+        mgr.record_frame(s, seeded=True, gates=_gates(), mass=4.0)
+        assert s.seed is None
+        assert s.reseeds == 1
+        assert s.frames == 4 and s.seeded_frames == 3
+        # Gate-less frame (degenerate op path): the session simply
+        # never seeds, without counting a re-seed.
+        mgr.record_frame(s, seeded=False, gates=None)
+        assert s.seed is None and s.reseeds == 1
 
 
 def test_session_table_and_tenant_caps():

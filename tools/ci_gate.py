@@ -21,7 +21,10 @@ The checks:
   BENCH_r*.json trend; regression vs best prior same-metric round
   fails the gate.
 
-OPTIONAL checks ride behind flags: ``--with-tenant-flood`` runs the
+OPTIONAL checks ride behind flags: ``--with-full-lint`` runs
+``tools/ncnet_lint.py`` over the WHOLE repo (every rule, no
+``--changed-only`` narrowing — the run that must stay clean for the
+shared-state race rule's empty-baseline contract). ``--with-tenant-flood`` runs the
 multi-tenant QoS chaos contract (``tools/chaos_serving.py
 --tenant_flood`` — victims stay 100% available while a flood tenant
 bursts 10x), and ``--with-session-chaos`` runs the streaming-session
@@ -62,7 +65,8 @@ _CPU_DROP = ("PALLAS_AXON_POOL_IPS",)
 CHECKS = ("tier1", "lint", "bench_trend")
 # Opt-in checks: never run by default, never silently green — a
 # default run records them as {"skipped": true, "optional": true}.
-OPTIONAL_CHECKS = ("tenant_flood", "session_chaos", "quality_report")
+OPTIONAL_CHECKS = ("full_lint", "tenant_flood", "session_chaos",
+                   "quality_report")
 
 
 def _run(cmd, timeout_s, cpu_env=False) -> dict:
@@ -107,6 +111,15 @@ def run_bench_trend(timeout_s: float) -> dict:
     return _run(
         [sys.executable, os.path.join("tools", "bench_trend.py"),
          "--strict"], timeout_s)
+
+
+def run_full_lint(timeout_s: float) -> dict:
+    # The whole-repo pass: every rule over every file, no merge-base
+    # narrowing — what the race rule's "exit 0 with an EMPTY baseline"
+    # acceptance criterion means in CI terms.
+    return _run(
+        [sys.executable, os.path.join("tools", "ncnet_lint.py")],
+        timeout_s)
 
 
 def run_tenant_flood(timeout_s: float) -> dict:
@@ -155,6 +168,10 @@ def main(argv=None) -> int:
                          "870 s default)")
     ap.add_argument("--timeout-s", type=float, default=300.0,
                     help="per-check fence for lint / bench_trend")
+    ap.add_argument("--with-full-lint", action="store_true",
+                    help="also run ncnet_lint over the whole repo (all "
+                         "rules, not --changed-only); off by default, "
+                         "recorded as skipped when off")
     ap.add_argument("--with-tenant-flood", action="store_true",
                     help="also run the multi-tenant QoS chaos contract "
                          "(tools/chaos_serving.py --tenant_flood); off "
@@ -177,12 +194,14 @@ def main(argv=None) -> int:
         "tier1": lambda: run_tier1(args.tier1_timeout_s),
         "lint": lambda: run_lint(args.timeout_s),
         "bench_trend": lambda: run_bench_trend(args.timeout_s),
+        "full_lint": lambda: run_full_lint(args.timeout_s),
         "tenant_flood": lambda: run_tenant_flood(args.chaos_timeout_s),
         "session_chaos": lambda: run_session_chaos(args.chaos_timeout_s),
         "quality_report": lambda: run_quality_report(
             args.chaos_timeout_s),
     }
-    enabled = {"tenant_flood": args.with_tenant_flood,
+    enabled = {"full_lint": args.with_full_lint,
+               "tenant_flood": args.with_tenant_flood,
                "session_chaos": args.with_session_chaos,
                "quality_report": args.with_quality_report}
     checks = {}

@@ -20,8 +20,9 @@ Usage::
     python tools/ncnet_lint.py --write-baseline # snapshot findings into
                                                 # analysis/baseline.json
                                                 # (fill in the reasons!)
-    python tools/ncnet_lint.py --write-docs     # regenerate the lock-order
-                                                # table in docs/ANALYSIS.md
+    python tools/ncnet_lint.py --write-docs     # regenerate the generated
+                                                # lock-order + shared-state
+                                                # tables in docs/ANALYSIS.md
 
 The baseline is for deliberate, commented exceptions only — fix real
 violations (or pragma them with a justification) instead of baselining.
@@ -43,7 +44,7 @@ if _REPO not in sys.path:
 
 from ncnet_tpu.analysis import Baseline, Repo, get_rules, run_rules
 from ncnet_tpu.analysis.rules import rule_ids
-from ncnet_tpu.analysis.rules.lock_order import write_docs_block
+from ncnet_tpu.analysis.rules import lock_order, races
 
 
 def _changed_files(root: str, base: str) -> Optional[List[str]]:
@@ -92,8 +93,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "analysis/baseline.json (add reasons "
                              "before committing)")
     parser.add_argument("--write-docs", action="store_true",
-                        help="regenerate the generated lock-order table "
-                             "in docs/ANALYSIS.md, then lint")
+                        help="regenerate the generated lock-order and "
+                             "shared-state tables in docs/ANALYSIS.md, "
+                             "then lint")
     parser.add_argument("--baseline", default=None, metavar="PATH",
                         help="baseline file (default: "
                              "ncnet_tpu/analysis/baseline.json)")
@@ -113,7 +115,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     docs_updated = False
     if args.write_docs:
-        docs_updated = write_docs_block(repo)
+        docs_updated = lock_order.write_docs_block(repo)
+        docs_updated = races.write_docs_block(repo) or docs_updated
 
     try:
         rules = get_rules(args.rule)
