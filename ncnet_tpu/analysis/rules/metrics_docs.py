@@ -38,7 +38,7 @@ DOCS_SECTION = "## Serving & SLO metric families"
 
 #: Families the docs table must cover, both ways (the fleet surface).
 SCOPED_PREFIXES = ("serving.", "slo.", "obs.heartbeat.", "breaker.",
-                   "ncnet.", "bulk.", "engine.", "device.")
+                   "ncnet.", "bulk.", "engine.", "device.", "trace.")
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_<>]+)*$")
 

@@ -27,6 +27,7 @@ from .events import (
     event,
     get_run,
     init_run,
+    runlog_segments,
     span,
 )
 from .flight import FlightRecorder
@@ -61,6 +62,7 @@ __all__ = [
     "event",
     "get_run",
     "init_run",
+    "runlog_segments",
     "span",
     "aggregate",
     "costcards",

@@ -314,14 +314,18 @@ class HbmMonitor:
     def __init__(self, min_interval_s: float = 1.0):
         self.min_interval_s = float(min_interval_s)
         self._lock = threading.Lock()
-        self._last = 0.0
+        # None = never polled; a 0.0 sentinel would alias boot time and
+        # rate-limit the FIRST poll on hosts up less than min_interval_s
+        # (time.monotonic() is boot-relative on Linux).
+        self._last = None
 
     def maybe_poll(self, entries) -> bool:
         """``entries``: iterable of (device, labels). Returns True when
         a poll actually ran (rate-limit window open)."""
         now = time.monotonic()
         with self._lock:
-            if now - self._last < self.min_interval_s:
+            if (self._last is not None
+                    and now - self._last < self.min_interval_s):
                 return False
             self._last = now
         for device, labels in entries:

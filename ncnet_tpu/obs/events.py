@@ -105,9 +105,25 @@ class RunLog:
         registry: Optional[_metrics.MetricsRegistry] = None,
         clock=time.monotonic,
         run_id: Optional[str] = None,
+        max_bytes: Optional[int] = None,
     ):
         self.path = path
         self.component = component
+        # Size-based segment rotation: when the active file crosses
+        # max_bytes it is renamed to the next `<stem>.00N<ext>` segment
+        # and a fresh base file opened — a serving run can no longer
+        # grow one unbounded file. None reads NCNET_RUNLOG_MAX_MB
+        # (unset/0 = unbounded). Readers (tools/trace_export.py,
+        # tools/obs_report.py, runlog_segments) see the segment set as
+        # one log.
+        if max_bytes is None:
+            try:
+                mb = float(os.environ.get("NCNET_RUNLOG_MAX_MB", "0"))
+            except ValueError:
+                mb = 0.0
+            max_bytes = int(mb * 1_000_000) if mb > 0 else 0
+        self.max_bytes = int(max_bytes or 0)
+        self._segments = 0
         self.run_id = run_id or (
             time.strftime("%Y%m%d-%H%M%S") + "-" + uuid.uuid4().hex[:8]
         )
@@ -169,6 +185,23 @@ class RunLog:
                 self.last_progress_mono = rec["t_mono"]
             self._fh.write(line + "\n")
             self._fh.flush()
+            if self.max_bytes and self._fh.tell() >= self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Roll the active file out to the next numbered segment and
+        reopen the base path fresh. Called with ``self._lock`` held.
+        Rotation failures (read-only fs mid-run) degrade to an
+        unbounded log rather than taking the run down."""
+        try:
+            self._fh.close()
+            self._segments += 1
+            os.replace(self.path, _segment_name(self.path, self._segments))
+            self._fh = open(self.path, "a", encoding="utf-8")
+        except OSError:
+            self.max_bytes = 0
+            if self._fh.closed:
+                self._fh = open(self.path, "a", encoding="utf-8")
 
     @contextlib.contextmanager
     def span(self, name: str, sync=None, **fields):
@@ -383,6 +416,37 @@ def event(name: str, **fields) -> None:
 
 def span(name: str, sync=None, **fields):
     return get_run().span(name, sync=sync, **fields)
+
+
+def _segment_name(path: str, n: int) -> str:
+    """``runlog-x.jsonl`` + 3 -> ``runlog-x.003.jsonl``."""
+    stem, ext = os.path.splitext(path)
+    return f"{stem}.{n:03d}{ext}"
+
+
+def runlog_segments(path: str) -> list:
+    """All on-disk segments of a (possibly rotated) run log, oldest
+    first, the active base file last. An unrotated log returns
+    ``[path]`` — readers can always iterate the result and see one
+    chronological record stream."""
+    stem, ext = os.path.splitext(path)
+    directory = os.path.dirname(path) or "."
+    prefix = os.path.basename(stem) + "."
+    segments = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        names = []
+    for n in names:
+        if not (n.startswith(prefix) and n.endswith(ext)):
+            continue
+        mid = n[len(prefix):len(n) - len(ext)] if ext else n[len(prefix):]
+        if len(mid) == 3 and mid.isdigit():
+            segments.append(os.path.join(directory, n))
+    segments.sort()
+    if os.path.exists(path) or not segments:
+        segments.append(path)
+    return segments
 
 
 def default_log_path(directory: str, component: str) -> str:

@@ -220,7 +220,8 @@ class Histogram:
         self.max: Optional[float] = None
         self.last: Optional[float] = None
 
-    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None,
+                sampled: bool = True) -> None:
         v = float(v)
         # Prometheus `le`: the first bucket whose upper bound is >= v.
         idx = bisect.bisect_left(self.buckets, v)
@@ -231,7 +232,10 @@ class Histogram:
             self.max = v if self.max is None else max(self.max, v)
             self.last = v
             self._bucket_counts[idx] += 1
-            if trace_id is not None:
+            # ``sampled=False`` (head-sampled-out trace, obs/trace.py)
+            # still counts the observation but skips the exemplar: a
+            # trace_id with no spans behind it is a dead link.
+            if trace_id is not None and sampled:
                 self._exemplars[idx] = (str(trace_id), v, time.time())
 
     def exemplars(self) -> Dict[int, tuple]:

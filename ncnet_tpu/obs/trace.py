@@ -27,6 +27,22 @@ Spans opened with no active trace degrade to the flat PR-1 form (a
 ``kind: "span"`` event with no IDs) — library code instruments
 unconditionally, exactly like ``obs.event``.
 
+Cross-process propagation (docs/OBSERVABILITY.md, "Cross-process
+tracing"): :func:`inject` serializes a context into the
+``X-NCNet-Trace: <trace_id>-<span_id>-<flags>`` header and
+:func:`extract` parses it back on the far side; ``trace(parent=...)``
+then CONTINUES the caller's trace (same ``trace_id``, ``parent_id``
+pointing at the remote span, ``remote_parent: true`` on the root
+record) instead of rooting a new one, so ``tools/trace_export.py`` can
+join a client runlog and N replica runlogs into one tree. Head
+sampling rides the header's flags byte: :func:`set_sample_rate` sets
+the local root-sampling probability, the decision propagates with the
+context, and unsampled traces write no span events — except error
+paths (exceptions, and anything a handler marks via :func:`force`),
+which are always recorded locally. ``trace.sampled`` /
+``trace.dropped`` count root decisions; ``trace.remote_spans`` counts
+roots continued from a remote parent.
+
 Also here: :func:`install_compile_telemetry` hooks ``jax.monitoring``
 duration listeners so every XLA backend compile lands in the run log as
 a ``compile`` event and in the ``jit.compile_time_s`` histogram — the
@@ -38,17 +54,35 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import random
 import threading
 import time
 import uuid
 from typing import Iterable, NamedTuple, Optional, Tuple
 
+#: Wire header carrying trace context across processes
+#: (docs/SERVING.md): ``X-NCNet-Trace: <trace_id>-<span_id>-<flags>``,
+#: ids lowercase hex, flags a two-digit hex byte (bit 0 = sampled).
+TRACE_HEADER = "X-NCNet-Trace"
+
+FLAG_SAMPLED = 0x1
+
+_HEX = frozenset("0123456789abcdef")
+
 
 class SpanCtx(NamedTuple):
-    """One active span: everything a child needs to parent onto it."""
+    """One active span: everything a child needs to parent onto it.
+
+    ``sampled`` is the propagated head-sampling decision (made once at
+    the root, inherited by every child and across the wire);
+    ``remote`` marks a context that arrived via :func:`extract` — its
+    span lives in another process's runlog.
+    """
 
     trace_id: str
     span_id: str
+    sampled: bool = True
+    remote: bool = False
 
 
 #: Active span contexts for this thread/task. A tuple because one unit
@@ -61,6 +95,136 @@ _CTX: "contextvars.ContextVar[Tuple[SpanCtx, ...]]" = contextvars.ContextVar(
 
 def _new_id() -> str:
     return uuid.uuid4().hex[:16]
+
+
+# -- head sampling --------------------------------------------------------
+
+# guarded-by: atomic -- float publish; a racing reader roots at the old rate
+_sample_rate = 1.0
+
+_forced_lock = threading.Lock()
+# guarded-by: _forced_lock
+_forced: dict = {}  # trace_id -> extra fields for the (late) root record
+_FORCED_MAX = 1024
+
+
+def set_sample_rate(rate: float) -> float:
+    """Set the local head-sampling probability for NEW roots (clamped
+    to [0, 1]); remote-continued traces keep the caller's decision.
+    Error paths are recorded regardless. Returns the clamped rate."""
+    global _sample_rate
+    rate = min(1.0, max(0.0, float(rate)))
+    _sample_rate = rate
+    from . import metrics
+
+    metrics.gauge("trace.sample_rate").set(rate)
+    return rate
+
+
+def sample_rate() -> float:
+    return _sample_rate
+
+
+def _decide() -> bool:
+    rate = _sample_rate
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return random.random() < rate
+
+
+def force(ctx: SpanCtx, **fields) -> None:
+    """Record this trace's root span even if unsampled.
+
+    For error/breaker/poison response paths: the handler discovers the
+    outcome AFTER children were (correctly) suppressed, but the root —
+    with whatever ``fields`` are passed here — must still land locally
+    so a failing unsampled request is never invisible. Bounded map;
+    consumed at root emission."""
+    with _forced_lock:
+        if len(_forced) >= _FORCED_MAX:
+            _forced.pop(next(iter(_forced)))
+        prev = _forced.setdefault(ctx.trace_id, {})
+        prev.update(fields)
+
+
+def _take_forced(trace_id: str) -> Optional[dict]:
+    with _forced_lock:
+        return _forced.pop(trace_id, None)
+
+
+# -- wire propagation -----------------------------------------------------
+
+
+def inject(ctx: Optional[SpanCtx] = None) -> Optional[str]:
+    """Serialize ``ctx`` (default: the first active context) into the
+    ``X-NCNet-Trace`` header value, or None with no active trace."""
+    if ctx is None:
+        cur = current()
+        ctx = cur[0] if cur else None
+    if ctx is None:
+        return None
+    flags = FLAG_SAMPLED if ctx.sampled else 0
+    return f"{ctx.trace_id}-{ctx.span_id}-{flags:02x}"
+
+
+def extract(value) -> Optional[SpanCtx]:
+    """Parse an ``X-NCNet-Trace`` header value into a remote
+    :class:`SpanCtx`; malformed or absent values return None (the
+    server then roots a fresh trace — propagation is best-effort,
+    never a 400)."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) != 3:
+        return None
+    trace_id, span_id, flags = parts
+    if not trace_id or not span_id:
+        return None
+    if not (set(trace_id) <= _HEX and set(span_id) <= _HEX):
+        return None
+    try:
+        bits = int(flags, 16)
+    except ValueError:
+        return None
+    return SpanCtx(trace_id, span_id, bool(bits & FLAG_SAMPLED), True)
+
+
+def new_root(parent: Optional[SpanCtx] = None) -> SpanCtx:
+    """Mint a context WITHOUT opening a ``with`` block — for
+    state-machine lifecycles (a client request crossing a retry loop,
+    a bulk flight bouncing through an event loop) whose root span
+    closes far from where it opens. ``parent`` (local or extracted)
+    continues its trace and inherits its sampled flag; None roots a
+    new trace under the head-sampling decision. Close it with
+    :func:`emit_root`."""
+    if parent is not None:
+        return SpanCtx(parent.trace_id, _new_id(), parent.sampled)
+    return SpanCtx(_new_id(), _new_id(), _decide())
+
+
+def child_of(ctx: SpanCtx) -> SpanCtx:
+    """A fresh child context under ``ctx`` (same trace, new span id)."""
+    return SpanCtx(ctx.trace_id, _new_id(), ctx.sampled)
+
+
+def emit_root(ctx: SpanCtx, name: str, dur_s: float,
+              parent: Optional[SpanCtx] = None, **fields) -> None:
+    """Write the span record for a :func:`new_root`-minted context.
+    Suppressed for unsampled traces unless the fields carry ``error``
+    or the trace was :func:`force`-marked."""
+    extra = _take_forced(ctx.trace_id)
+    if not (ctx.sampled or "error" in fields or extra is not None):
+        return
+    if extra:
+        fields = {**fields, **extra}
+    if not ctx.sampled:
+        fields.setdefault("sampled", False)
+    _emit(name, kind="span", dur_s=dur_s, trace_id=ctx.trace_id,
+          span_id=ctx.span_id,
+          parent_id=parent.span_id if parent is not None else None,
+          **fields)
 
 
 def current() -> Tuple[SpanCtx, ...]:
@@ -108,6 +272,8 @@ def emit_span(
         _emit(name, kind="span", dur_s=dur_s, **fields)
         return
     for p in parents:
+        if not (p.sampled or "error" in fields):
+            continue  # head sampling: unsampled trees write no spans
         _emit(
             name,
             kind="span",
@@ -138,7 +304,7 @@ def span(name: str, sync=None, **fields):
         with events.span(name, sync=sync, **fields):
             yield ()
         return
-    children = tuple(SpanCtx(p.trace_id, _new_id()) for p in parents)
+    children = tuple(child_of(p) for p in parents)
     token = _CTX.set(children)
     t0 = time.monotonic()
     try:
@@ -147,6 +313,8 @@ def span(name: str, sync=None, **fields):
         dur = time.monotonic() - t0
         _CTX.reset(token)
         token = None
+        # Error spans are always recorded, sampled or not — a failing
+        # unsampled request must still leave a local trail.
         for p, c in zip(parents, children):
             _emit(name, kind="span", dur_s=dur, trace_id=c.trace_id,
                   span_id=c.span_id, parent_id=p.span_id,
@@ -162,6 +330,8 @@ def span(name: str, sync=None, **fields):
                 pass
         dur = time.monotonic() - t0
         for p, c in zip(parents, children):
+            if not p.sampled:
+                continue
             _emit(name, kind="span", dur_s=dur, trace_id=c.trace_id,
                   span_id=c.span_id, parent_id=p.span_id, **fields)
     finally:
@@ -170,29 +340,62 @@ def span(name: str, sync=None, **fields):
 
 
 @contextlib.contextmanager
-def trace(name: str, **fields):
-    """Root span of a NEW trace (one serving request, one eval query).
+def trace(name: str, parent: Optional[SpanCtx] = None,
+          kind: Optional[str] = None, **fields):
+    """Root span of a trace (one serving request, one eval query).
 
     Yields the root :class:`SpanCtx`; everything opened inside — in
     this thread, or on another thread via :func:`current`/
     :func:`attach` — parents onto it. The root event is written at
     close (after its children; readers build the tree from IDs, not
-    file order) with ``parent_id: None`` marking it a root.
+    file order).
+
+    ``parent=None`` roots a NEW trace (``parent_id: None``) under the
+    local head-sampling decision. ``parent`` set — typically an
+    :func:`extract`-ed wire context — CONTINUES the caller's trace:
+    same ``trace_id``, ``parent_id`` pointing at the remote span,
+    inherited sampled flag, and ``remote_parent: true`` on the record
+    when the parent crossed a process boundary. ``kind`` labels the
+    span's role (``client``/``server``/``internal``) as ``span_kind``
+    on the record.
     """
-    root = SpanCtx(_new_id(), _new_id())
+    from . import metrics
+
+    if parent is not None:
+        root = SpanCtx(parent.trace_id, _new_id(), parent.sampled)
+        parent_id: Optional[str] = parent.span_id
+    else:
+        root = SpanCtx(_new_id(), _new_id(), _decide())
+        parent_id = None
+    metrics.counter(
+        "trace.sampled" if root.sampled else "trace.dropped").inc()
+    if parent is not None and parent.remote:
+        metrics.counter("trace.remote_spans").inc()
+        fields.setdefault("remote_parent", True)
+    if kind is not None:
+        fields.setdefault("span_kind", kind)
     token = _CTX.set((root,))
     t0 = time.monotonic()
     try:
         yield root
     except BaseException as exc:
+        extra = _take_forced(root.trace_id) or {}
+        if not root.sampled:
+            extra.setdefault("sampled", False)
         _emit(name, kind="span", dur_s=time.monotonic() - t0,
-              trace_id=root.trace_id, span_id=root.span_id, parent_id=None,
-              error=f"{type(exc).__name__}: {exc}", **fields)
+              trace_id=root.trace_id, span_id=root.span_id,
+              parent_id=parent_id,
+              error=f"{type(exc).__name__}: {exc}", **{**fields, **extra})
         raise
     else:
-        _emit(name, kind="span", dur_s=time.monotonic() - t0,
-              trace_id=root.trace_id, span_id=root.span_id, parent_id=None,
-              **fields)
+        extra = _take_forced(root.trace_id)
+        if root.sampled or extra is not None:
+            merged = {**fields, **(extra or {})}
+            if not root.sampled:
+                merged.setdefault("sampled", False)
+            _emit(name, kind="span", dur_s=time.monotonic() - t0,
+                  trace_id=root.trace_id, span_id=root.span_id,
+                  parent_id=parent_id, **merged)
     finally:
         _CTX.reset(token)
 

@@ -428,6 +428,14 @@ class _Flight:
     bucket_key: Any = None
     payload: Any = None
     attempts: int = 0
+    #: Per-pair trace context (obs/trace.py): minted at flight
+    #: creation, attached around every dispatch so the batcher/
+    #: dispatcher spans (and, through a MatchClient-backed submit, the
+    #: wire header) parent onto ONE ``bulk.pair`` root per manifest
+    #: row — retries and redispatch hops included. ``t0`` is the
+    #: flight-creation clock the root's duration is measured from.
+    ctx: Any = ()
+    t0: float = 0.0
 
 
 def run_bulk(
@@ -490,8 +498,15 @@ def run_bulk(
     shard_t0: Dict[int, float] = {}  # shard index -> first-launch clock
 
     def _finish(row: int, record: dict) -> None:
-        inflight.pop(row, None)
+        fl = inflight.pop(row, None)
         ready[row] = record
+        if fl is not None and fl.ctx:
+            # Close the pair's trace root: one span per manifest row,
+            # however many retries/requeues it took to settle.
+            trace.emit_root(
+                fl.ctx[0], "bulk.pair", max(0.0, clock() - fl.t0),
+                row=row, attempts=fl.attempts or 1,
+                status=record.get("status"))
 
     def _quarantine(fl: _Flight, kind: str, exc: BaseException) -> None:
         nonlocal quarantined
@@ -544,11 +559,16 @@ def run_bulk(
         if shard not in shard_t0:
             shard_t0[shard] = clock()
         try:
-            if fl.payload is None:
-                failpoints.fire("bulk.read", payload=fl.pair)
-                fl.bucket_key, fl.payload = prepare(fl.pair)
-            failpoints.fire("bulk.dispatch", payload=fl.pair)
-            fut = submit(fl.bucket_key, fl.payload)
+            # Every dispatch (first launch and each retry) runs under
+            # the flight's trace context: a dispatcher submit captures
+            # it for its worker spans, and a client-backed submit
+            # continues it across the wire.
+            with trace.attach(fl.ctx):
+                if fl.payload is None:
+                    failpoints.fire("bulk.read", payload=fl.pair)
+                    fl.bucket_key, fl.payload = prepare(fl.pair)
+                failpoints.fire("bulk.dispatch", payload=fl.pair)
+                fut = submit(fl.bucket_key, fl.payload)
         except RejectedError as exc:
             # Backpressure, not failure: the fleet refused admission
             # before attempting anything — requeue on the server's
@@ -606,7 +626,8 @@ def run_bulk(
                 if pair is None:
                     exhausted = True
                     break
-                fl = _Flight(pair=pair, session=retry_policy.session())
+                fl = _Flight(pair=pair, session=retry_policy.session(),
+                             ctx=(trace.new_root(),), t0=clock())
                 inflight[pair.row] = fl
                 _launch(fl)
             now = clock()

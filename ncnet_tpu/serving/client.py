@@ -11,6 +11,14 @@ shared deadline-aware :class:`~ncnet_tpu.reliability.retry.RetryPolicy`
 clients must not retry in lockstep), cumulative sleeps never exceed
 ``retry_deadline_s``, and exhaustion surfaces
 :class:`OverCapacityError`.
+
+Every request carries the ``X-NCNet-Trace`` header (docs/SERVING.md):
+the client roots a ``client.request`` span per logical call, opens a
+``client.attempt`` child per wire attempt (each retry is its own
+child), and injects the attempt's context so the server CONTINUES the
+client's trace — ``tools/trace_export.py`` then joins the client and
+server runlogs into one tree. The obs package stays stdlib-only at
+import time, so this does not break the no-jax/numpy contract above.
 """
 
 from __future__ import annotations
@@ -22,6 +30,8 @@ import urllib.error
 import urllib.request
 from typing import Optional
 
+from ..obs import events as _obs_events
+from ..obs import trace as _obs_trace
 from ..reliability import failpoints
 from ..reliability.retry import RetryPolicy
 
@@ -44,13 +54,80 @@ class PoisonRequestError(ServingError):
     failure is the request's own and a retry will not help."""
 
 
+class _RequestTrace:
+    """Books one logical client call into the client's span sink.
+
+    One ``client.request`` root per call (continuing any ambient trace,
+    e.g. a bulk flight's), one ``client.attempt`` child per wire
+    attempt — so a retried request reads as one root with N children,
+    and the server's spans hang off the attempt that reached it.
+    """
+
+    def __init__(self, client: "MatchClient", endpoint: str):
+        self._client = client
+        self.endpoint = endpoint
+        cur = _obs_trace.current()
+        self.parent = cur[0] if cur else None
+        self.root = _obs_trace.new_root(self.parent)
+        self.attempts = 0
+        self.status: Optional[int] = None
+        self._t0 = time.monotonic()
+
+    def attempt_headers(self, base: dict) -> dict:
+        """Open the next attempt's child span; returns a copy of
+        ``base`` with the injected ``X-NCNet-Trace`` header."""
+        self.attempts += 1
+        self._attempt = _obs_trace.child_of(self.root)
+        self._t_attempt = time.monotonic()
+        hdrs = dict(base)
+        hdrs[_obs_trace.TRACE_HEADER] = _obs_trace.inject(self._attempt)
+        return hdrs
+
+    def attempt_done(self, status: Optional[int] = None,
+                     error: Optional[str] = None) -> None:
+        if status is not None:
+            self.status = status
+        fields = dict(endpoint=self.endpoint, attempt=self.attempts)
+        if status is not None:
+            fields["status"] = status
+        if error is not None:
+            fields["error"] = error
+        self._client._span_event(
+            "client.attempt", time.monotonic() - self._t_attempt,
+            self._attempt, parent_id=self.root.span_id, **fields)
+
+    def close(self, error: Optional[str] = None) -> None:
+        fields = dict(endpoint=self.endpoint, span_kind="client",
+                      attempts=self.attempts)
+        if self.status is not None:
+            fields["status"] = self.status
+        if error is not None:
+            fields["error"] = error
+        self._client._span_event(
+            "client.request", time.monotonic() - self._t0, self.root,
+            parent_id=(self.parent.span_id
+                       if self.parent is not None else None),
+            **fields)
+
+
 class MatchClient:
     def __init__(self, base_url: str, timeout_s: float = 60.0,
                  retries: int = 2, retry_deadline_s: Optional[float] = None,
-                 sleep=time.sleep):
+                 sleep=time.sleep, run_log=None):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self.retries = retries
+        # Span sink: client span events go to this RunLog when set,
+        # else to the ambient obs run. An explicit sink matters when
+        # client and server share a process (tests, in-proc harnesses):
+        # the ambient run is the SERVER's log, and client spans written
+        # there would blur the two processes the trace join exists to
+        # distinguish.
+        self._run_log = run_log
+        # guarded-by: atomic -- dict publish of per-(tenant, priority)
+        # header dicts; racing writers recompute identical values, and
+        # readers copy before mutating.
+        self._hdr_cache: dict = {}
         # Overall backoff budget: cumulative Retry-After sleeps are
         # capped here no matter what the server hints (a misconfigured
         # Retry-After must not pin a client for minutes). Defaults to
@@ -95,6 +172,38 @@ class MatchClient:
             except ValueError:
                 payload = raw.decode(errors="replace")
             return exc.code, payload, exc.headers
+
+    # -- tracing ----------------------------------------------------------
+
+    def _span_event(self, name: str, dur_s: float, ctx, parent_id=None,
+                    **fields) -> None:
+        """Write one client span record (head-sampling-gated: unsampled
+        traces record nothing unless the fields carry ``error``)."""
+        if not (ctx.sampled or "error" in fields):
+            return
+        if not ctx.sampled:
+            fields.setdefault("sampled", False)
+        sink = self._run_log if self._run_log is not None else (
+            _obs_events.get_run())
+        sink.event(name, kind="span", dur_s=dur_s, trace_id=ctx.trace_id,
+                   span_id=ctx.span_id, parent_id=parent_id, **fields)
+
+    def _base_headers(self, tenant: Optional[str],
+                      priority: Optional[str]) -> dict:
+        """Fresh header dict for a (tenant, priority) pair, via a small
+        bounded cache (hot loops resend the same identity on every
+        frame). Always returns a copy the caller may mutate."""
+        key = (tenant, priority)
+        cached = self._hdr_cache.get(key)
+        if cached is None:
+            cached = {}
+            if tenant is not None:
+                cached["X-NCNet-Tenant"] = tenant
+            if priority is not None:
+                cached["X-NCNet-Priority"] = priority
+            if len(self._hdr_cache) < 64:
+                self._hdr_cache[key] = cached
+        return dict(cached)
 
     # -- endpoints --------------------------------------------------------
 
@@ -142,31 +251,41 @@ class MatchClient:
             body["max_matches"] = max_matches
         if mode is not None:
             body["mode"] = mode
-        hdrs = {}
-        if tenant is not None:
-            hdrs["X-NCNet-Tenant"] = tenant
-        if priority is not None:
-            hdrs["X-NCNet-Priority"] = priority
+        hdrs = self._base_headers(tenant, priority)
         session = self._policy.session()
-        while True:
-            status, payload, headers = self._request(
-                "POST", "/v1/match", body, headers=hdrs
-            )
-            if status == 200:
-                return payload
-            if status in (503, 429):
+        rt = _RequestTrace(self, "/v1/match")
+        err: Optional[str] = None
+        try:
+            while True:
                 try:
-                    hint = float(headers.get("Retry-After", "0.1"))
-                except (TypeError, ValueError):
-                    hint = 0.1
-                delay = session.next_delay(hint_s=min(hint, 5.0))
-                if delay is not None:
-                    self._policy.sleep(delay)
-                    continue
-                raise OverCapacityError(status, payload)
-            if status == 422:
-                raise PoisonRequestError(status, payload)
-            raise ServingError(status, payload)
+                    status, payload, headers = self._request(
+                        "POST", "/v1/match", body,
+                        headers=rt.attempt_headers(hdrs)
+                    )
+                except Exception as exc:
+                    rt.attempt_done(error=f"{type(exc).__name__}: {exc}")
+                    raise
+                rt.attempt_done(status=status)
+                if status == 200:
+                    return payload
+                if status in (503, 429):
+                    try:
+                        hint = float(headers.get("Retry-After", "0.1"))
+                    except (TypeError, ValueError):
+                        hint = 0.1
+                    delay = session.next_delay(hint_s=min(hint, 5.0))
+                    if delay is not None:
+                        self._policy.sleep(delay)
+                        continue
+                    raise OverCapacityError(status, payload)
+                if status == 422:
+                    raise PoisonRequestError(status, payload)
+                raise ServingError(status, payload)
+        except BaseException as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            rt.close(error=err)
 
     def healthz(self) -> dict:
         status, payload, _ = self._request("GET", "/healthz")
@@ -222,11 +341,7 @@ class MatchSession:
             raise ValueError("session needs ref_path or ref_bytes")
         if c2f is not None:
             self._open_body["c2f"] = c2f
-        self._headers = {}
-        if tenant is not None:
-            self._headers["X-NCNet-Tenant"] = tenant
-        if priority is not None:
-            self._headers["X-NCNet-Priority"] = priority
+        self._headers = client._base_headers(tenant, priority)
         self.session_id: Optional[str] = None
         self.reopens = 0
 
@@ -234,24 +349,37 @@ class MatchSession:
 
     def open(self) -> "MatchSession":
         policy = self._client._policy.session()
-        while True:
-            status, payload, headers = self._client._request(
-                "POST", "/v1/session", self._open_body,
-                headers=self._headers)
-            if status == 200:
-                self.session_id = payload["session_id"]
-                return self
-            if status in (503, 429):
+        rt = _RequestTrace(self._client, "/v1/session")
+        err: Optional[str] = None
+        try:
+            while True:
                 try:
-                    hint = float(headers.get("Retry-After", "0.1"))
-                except (TypeError, ValueError):
-                    hint = 0.1
-                delay = policy.next_delay(hint_s=min(hint, 5.0))
-                if delay is not None:
-                    self._client._policy.sleep(delay)
-                    continue
-                raise OverCapacityError(status, payload)
-            raise ServingError(status, payload)
+                    status, payload, headers = self._client._request(
+                        "POST", "/v1/session", self._open_body,
+                        headers=rt.attempt_headers(self._headers))
+                except Exception as exc:
+                    rt.attempt_done(error=f"{type(exc).__name__}: {exc}")
+                    raise
+                rt.attempt_done(status=status)
+                if status == 200:
+                    self.session_id = payload["session_id"]
+                    return self
+                if status in (503, 429):
+                    try:
+                        hint = float(headers.get("Retry-After", "0.1"))
+                    except (TypeError, ValueError):
+                        hint = 0.1
+                    delay = policy.next_delay(hint_s=min(hint, 5.0))
+                    if delay is not None:
+                        self._client._policy.sleep(delay)
+                        continue
+                    raise OverCapacityError(status, payload)
+                raise ServingError(status, payload)
+        except BaseException as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            rt.close(error=err)
 
     def close(self) -> Optional[dict]:
         """DELETE the session; returns its lifetime stats (None when it
@@ -259,9 +387,23 @@ class MatchSession:
         if self.session_id is None:
             return None
         sid, self.session_id = self.session_id, None
-        status, payload, _ = self._client._request(
-            "DELETE", f"/v1/session/{sid}")
-        return payload if status == 200 else None
+        rt = _RequestTrace(self._client, "/v1/session/close")
+        err: Optional[str] = None
+        try:
+            try:
+                status, payload, _ = self._client._request(
+                    "DELETE", f"/v1/session/{sid}",
+                    headers=rt.attempt_headers(self._headers))
+            except Exception as exc:
+                rt.attempt_done(error=f"{type(exc).__name__}: {exc}")
+                raise
+            rt.attempt_done(status=status)
+            return payload if status == 200 else None
+        except BaseException as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            rt.close(error=err)
 
     def __enter__(self) -> "MatchSession":
         if self.session_id is None:
@@ -294,31 +436,46 @@ class MatchSession:
             body["max_matches"] = max_matches
         policy = self._client._policy.session()
         reopened = False
-        while True:
-            status, payload, headers = self._client._request(
-                "POST", f"/v1/session/{self.session_id}/frame", body,
-                headers=self._headers)
-            if status == 200:
-                return payload
-            if status == 410 and not reopened:
-                # session_lost: evicted or server restarted. One
-                # transparent re-open per frame, then resend — the
-                # fresh session's first frame re-runs the coarse pass.
-                reopened = True
-                self.session_id = None
-                self.open()
-                self.reopens += 1
-                continue
-            if status in (503, 429):
+        rt = _RequestTrace(self._client, "/v1/session/frame")
+        err: Optional[str] = None
+        try:
+            while True:
                 try:
-                    hint = float(headers.get("Retry-After", "0.1"))
-                except (TypeError, ValueError):
-                    hint = 0.1
-                delay = policy.next_delay(hint_s=min(hint, 5.0))
-                if delay is not None:
-                    self._client._policy.sleep(delay)
+                    status, payload, headers = self._client._request(
+                        "POST", f"/v1/session/{self.session_id}/frame",
+                        body, headers=rt.attempt_headers(self._headers))
+                except Exception as exc:
+                    rt.attempt_done(error=f"{type(exc).__name__}: {exc}")
+                    raise
+                rt.attempt_done(status=status)
+                if status == 200:
+                    return payload
+                if status == 410 and not reopened:
+                    # session_lost: evicted or server restarted. One
+                    # transparent re-open per frame, then resend — the
+                    # fresh session's first frame re-runs the coarse
+                    # pass. The re-open books its own client.request
+                    # root (it IS a separate wire request).
+                    reopened = True
+                    self.session_id = None
+                    self.open()
+                    self.reopens += 1
                     continue
-                raise OverCapacityError(status, payload)
-            if status == 422:
-                raise PoisonRequestError(status, payload)
-            raise ServingError(status, payload)
+                if status in (503, 429):
+                    try:
+                        hint = float(headers.get("Retry-After", "0.1"))
+                    except (TypeError, ValueError):
+                        hint = 0.1
+                    delay = policy.next_delay(hint_s=min(hint, 5.0))
+                    if delay is not None:
+                        self._client._policy.sleep(delay)
+                        continue
+                    raise OverCapacityError(status, payload)
+                if status == 422:
+                    raise PoisonRequestError(status, payload)
+                raise ServingError(status, payload)
+        except BaseException as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            rt.close(error=err)

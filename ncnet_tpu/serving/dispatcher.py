@@ -207,6 +207,14 @@ class FleetDispatcher:
             obs.event("redispatch", replica=replica.replica_id,
                       attempt=state["attempts"],
                       error=type(exc).__name__)
+            # The hop itself is a span in the request's tree (carrying
+            # `error`, it is recorded even for unsampled traces): the
+            # joined cross-process view shows WHERE the request bounced
+            # between replicas, not just that it eventually landed.
+            trace.emit_span("redispatch", 0.0, parents=state["ctx"],
+                            replica=replica.replica_id,
+                            attempt=state["attempts"],
+                            error=type(exc).__name__)
             try:
                 self._dispatch(outer, bucket_key, payload, timeout_s, state)
             except Exception as exc2:  # noqa: BLE001 — forwarded

@@ -120,6 +120,7 @@ class MatchServer:
         shadow_tau_px: float = 2.0,
         shadow_low_water_frac: float = 0.25,
         shadow_executor=None,
+        trace_sample_rate: Optional[float] = None,
     ):
         """``fleet``: a started-or-startable serving/fleet.MatchFleet.
         When set, the server fronts the fleet's dispatcher instead of
@@ -273,6 +274,13 @@ class MatchServer:
             )
         if self.replica_id:
             obs.set_build_info(replica=self.replica_id)
+        # Head sampling (obs/trace.py): process-wide root-sampling
+        # probability for NEW traces; remote-continued requests keep
+        # the caller's propagated decision, and error/breaker/poison
+        # paths are force-recorded regardless. None leaves the current
+        # process-wide rate untouched.
+        if trace_sample_rate is not None:
+            trace.set_sample_rate(trace_sample_rate)
         self.t_start = time.monotonic()
         # guarded-by: atomic -- bool publish; drain tolerates stale reads
         self._draining = False
@@ -340,7 +348,7 @@ class MatchServer:
                 parts = self.path.strip("/").split("/")
                 if len(parts) == 3 and parts[:2] == ["v1", "session"]:
                     code, payload, headers = server.handle_session_close(
-                        parts[2])
+                        self, parts[2])
                     self._send_json(code, payload, headers)
                     return
                 self._send_json(404, {"error": "not found"})
@@ -520,6 +528,24 @@ class MatchServer:
             payload["failpoints"] = {s: fp.mode for s, fp in fps.items()}
         return code, payload
 
+    @staticmethod
+    def _wire_parent(handler):
+        """The caller's propagated trace context (``X-NCNet-Trace``),
+        or None when absent/malformed — the server then roots fresh."""
+        return trace.extract(handler.headers.get(trace.TRACE_HEADER))
+
+    @staticmethod
+    def _force_errors(root, result):
+        """Pin error responses into the trace even when unsampled: a
+        failing request must never be invisible locally (obs/trace.py
+        head-sampling contract). Pass-through for the result triple."""
+        code, payload, _ = result
+        if code >= 400:
+            trace.force(root, status=code,
+                        error_kind=(payload.get("kind")
+                                    if isinstance(payload, dict) else None))
+        return result
+
     def handle_match(self, handler):
         """Parse, admit, wait, respond. Returns (code, payload, headers).
 
@@ -527,9 +553,13 @@ class MatchServer:
         (obs/trace.py): ``admit`` (parse + host prepare) on this handler
         thread, ``queue_wait``/``batch_assemble``/``device`` booked by
         the batcher's worker into the same tree via the context captured
-        at submit, ``respond`` (payload build) back here.
+        at submit, ``respond`` (payload build) back here. A propagated
+        ``X-NCNet-Trace`` header CONTINUES the caller's trace — the
+        response ``trace_id`` is then the caller's, and the exported
+        tree joins across the process boundary.
         """
-        with trace.trace("request") as root:
+        with trace.trace("request", parent=self._wire_parent(handler),
+                         kind="server") as root:
             try:
                 # Handler-thread failure domain (chaos site): an
                 # injected handler fault must become a structured 500,
@@ -539,8 +569,11 @@ class MatchServer:
                 obs.counter(
                     "serving.errors",
                     labels={**self.labels, "kind": "injected_fault"}).inc()
-                return 500, {"error": str(exc), "kind": "injected_fault"}, None
-            return self._handle_match_traced(handler, root)
+                return self._force_errors(root, (
+                    500, {"error": str(exc), "kind": "injected_fault"},
+                    None))
+            return self._force_errors(
+                root, self._handle_match_traced(handler, root))
 
     def _handle_match_traced(self, handler, root):
         t0 = time.monotonic()
@@ -803,9 +836,12 @@ class MatchServer:
         # Exemplar attach: the latency histogram bucket this request
         # lands in remembers its trace_id, so a /metrics scrape links a
         # tail bucket straight to a trace (OpenMetrics exposition).
+        # Unsampled traces skip the attach — their spans were never
+        # written, so the link would dangle.
         obs.histogram("serving.e2e_latency_s",
                       labels=self.labels).observe(
-                          e2e_s, trace_id=root.trace_id)
+                          e2e_s, trace_id=root.trace_id,
+                          sampled=root.sampled)
         obs.event(
             "request",
             bucket=repr(prepared.bucket_key),
@@ -818,7 +854,7 @@ class MatchServer:
         # Tail bookkeeping AFTER the request event, so a slow-exemplar
         # flight dump's ring already holds this request's spans + event.
         exemplar.observe_request(
-            "v1_match", e2e_s, root.trace_id,
+            "v1_match", e2e_s, root.trace_id if root.sampled else None,
             threshold_s=self.slo_p99_target_s, labels=self.labels)
         # rung_index, not position: an interactive request at a
         # shedding position still SERVED at full quality, and the
@@ -876,99 +912,121 @@ class MatchServer:
         """POST /v1/session: seat a streaming session against ONE
         reference image (``ref_path`` | ``ref_b64``; optional ``c2f``
         knob object pins the session's operating point). Opening is
-        host-side only — no device work until the first frame."""
-        with trace.trace("session_open") as root:
+        host-side only — no device work until the first frame. A
+        propagated ``X-NCNet-Trace`` header continues the caller's
+        trace, like every other verb."""
+        with trace.trace("session_open", parent=self._wire_parent(handler),
+                         kind="server") as root:
             try:
                 failpoints.fire("server.handle")
             except InjectedFault as exc:
                 obs.counter(
                     "serving.errors",
                     labels={**self.labels, "kind": "injected_fault"}).inc()
-                return 500, {"error": str(exc), "kind": "injected_fault"}, None
-            tenant, priority, err = self._resolve_tenant(handler)
-            if err is not None:
-                return err
+                return self._force_errors(root, (
+                    500, {"error": str(exc), "kind": "injected_fault"},
+                    None))
+            return self._force_errors(
+                root, self._handle_session_open_traced(handler, root))
+
+    def _handle_session_open_traced(self, handler, root):
+        tenant, priority, err = self._resolve_tenant(handler)
+        if err is not None:
+            return err
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            request = json.loads(handler.rfile.read(length) or b"{}")
+        except (ValueError, OSError) as exc:
+            obs.counter("serving.bad_requests", labels=self.labels).inc()
+            return 400, {"error": f"malformed request: {exc}"}, None
+        if not isinstance(request, dict):
+            obs.counter("serving.bad_requests", labels=self.labels).inc()
+            return 400, {"error": "request body must be a JSON "
+                         "object"}, None
+        ref_path = request.get("ref_path")
+        ref_b64 = request.get("ref_b64")
+        if bool(ref_path) == bool(ref_b64):
+            obs.counter("serving.bad_requests", labels=self.labels).inc()
+            return (400, {"error": "exactly one of ref_path/ref_b64 "
+                          "required"}, None)
+        op = None
+        knobs = request.get("c2f")
+        if knobs is not None:
+            if not isinstance(knobs, dict):
+                obs.counter("serving.bad_requests",
+                            labels=self.labels).inc()
+                return (400, {"error": "c2f must be a JSON object of "
+                              "knobs"}, None)
             try:
-                length = int(handler.headers.get("Content-Length", 0))
-                request = json.loads(handler.rfile.read(length) or b"{}")
-            except (ValueError, OSError) as exc:
-                obs.counter("serving.bad_requests", labels=self.labels).inc()
-                return 400, {"error": f"malformed request: {exc}"}, None
-            if not isinstance(request, dict):
-                obs.counter("serving.bad_requests", labels=self.labels).inc()
-                return 400, {"error": "request body must be a JSON "
-                             "object"}, None
-            ref_path = request.get("ref_path")
-            ref_b64 = request.get("ref_b64")
-            if bool(ref_path) == bool(ref_b64):
-                obs.counter("serving.bad_requests", labels=self.labels).inc()
-                return (400, {"error": "exactly one of ref_path/ref_b64 "
-                              "required"}, None)
-            op = None
-            knobs = request.get("c2f")
-            if knobs is not None:
-                if not isinstance(knobs, dict):
-                    obs.counter("serving.bad_requests",
-                                labels=self.labels).inc()
-                    return (400, {"error": "c2f must be a JSON object of "
-                                  "knobs"}, None)
-                try:
-                    op = self.engine._op_from_knobs(knobs)
-                except ValueError as exc:
-                    obs.counter("serving.bad_requests",
-                                labels=self.labels).inc()
-                    return 400, {"error": str(exc)}, None
-            digest = hashlib.sha256(
-                (ref_path or ref_b64).encode()).hexdigest()[:16]
+                op = self.engine._op_from_knobs(knobs)
+            except ValueError as exc:
+                obs.counter("serving.bad_requests",
+                            labels=self.labels).inc()
+                return 400, {"error": str(exc)}, None
+        digest = hashlib.sha256(
+            (ref_path or ref_b64).encode()).hexdigest()[:16]
+        try:
+            session = self.sessions.open(
+                tenant or DEFAULT_TENANT, priority or "interactive",
+                digest, ref_path=ref_path, ref_b64=ref_b64, op=op,
+                trace_id=root.trace_id)
+        except SessionCapError as exc:
+            return (
+                429,
+                {"error": str(exc), "kind": "session_slots",
+                 "scope": exc.scope,
+                 "retry_after_s": exc.retry_after_s},
+                {"Retry-After": f"{exc.retry_after_s:.3f}"},
+            )
+        return 200, {
+            "session_id": session.session_id,
+            "ttl_s": self.sessions.ttl_s,
+            "trace_id": root.trace_id,
+        }, None
+
+    def handle_session_close(self, handler, sid: str):
+        """DELETE /v1/session/<id>: release the seat, return the
+        session's lifetime stats. Traced like the other verbs — the
+        client's DELETE carries ``X-NCNet-Trace`` too, so a session's
+        teardown lands in the caller's tree."""
+        with trace.trace("session_close",
+                         parent=self._wire_parent(handler),
+                         kind="server") as root:
             try:
-                session = self.sessions.open(
-                    tenant or DEFAULT_TENANT, priority or "interactive",
-                    digest, ref_path=ref_path, ref_b64=ref_b64, op=op,
-                    trace_id=root.trace_id)
-            except SessionCapError as exc:
-                return (
-                    429,
-                    {"error": str(exc), "kind": "session_slots",
-                     "scope": exc.scope,
-                     "retry_after_s": exc.retry_after_s},
-                    {"Retry-After": f"{exc.retry_after_s:.3f}"},
-                )
+                session = self.sessions.close(sid)
+            except SessionLostError as exc:
+                return self._force_errors(root, (
+                    410, {"error": str(exc), "kind": "session_lost",
+                          "session_id": sid}, None))
+            obs.event("session_close", session_id=sid,
+                      frames=session.frames,
+                      seeded_frames=session.seeded_frames,
+                      reseeds=session.reseeds)
             return 200, {
-                "session_id": session.session_id,
-                "ttl_s": self.sessions.ttl_s,
+                "session_id": sid,
+                "frames": session.frames,
+                "seeded_frames": session.seeded_frames,
+                "reseeds": session.reseeds,
+                "seed_hit_frac": round(session.seed_hit_frac(), 4),
                 "trace_id": root.trace_id,
             }, None
 
-    def handle_session_close(self, sid: str):
-        """DELETE /v1/session/<id>: release the seat, return the
-        session's lifetime stats."""
-        try:
-            session = self.sessions.close(sid)
-        except SessionLostError as exc:
-            return (410, {"error": str(exc), "kind": "session_lost",
-                          "session_id": sid}, None)
-        obs.event("session_close", session_id=sid, frames=session.frames,
-                  seeded_frames=session.seeded_frames,
-                  reseeds=session.reseeds)
-        return 200, {
-            "session_id": sid,
-            "frames": session.frames,
-            "seeded_frames": session.seeded_frames,
-            "reseeds": session.reseeds,
-            "seed_hit_frac": round(session.seed_hit_frac(), 4),
-        }, None
-
     def handle_session_frame(self, handler, sid: str):
         """POST /v1/session/<id>/frame — one streaming query frame."""
-        with trace.trace("session_frame") as root:
+        with trace.trace("session_frame",
+                         parent=self._wire_parent(handler),
+                         kind="server") as root:
             try:
                 failpoints.fire("server.handle")
             except InjectedFault as exc:
                 obs.counter(
                     "serving.errors",
                     labels={**self.labels, "kind": "injected_fault"}).inc()
-                return 500, {"error": str(exc), "kind": "injected_fault"}, None
-            return self._handle_frame_traced(handler, sid, root)
+                return self._force_errors(root, (
+                    500, {"error": str(exc), "kind": "injected_fault"},
+                    None))
+            return self._force_errors(
+                root, self._handle_frame_traced(handler, sid, root))
 
     def _submit_frame(self, prepared, timeout_s, tenant, affinity, sticky):
         """One dispatch of a prepared session frame (fleet: optionally
@@ -1287,7 +1345,8 @@ class MatchServer:
                 labels={**self.labels, "tenant": tenant}).observe(e2e_s)
         obs.histogram("serving.session.frame_latency_s",
                       labels=self.labels).observe(
-                          e2e_s, trace_id=root.trace_id)
+                          e2e_s, trace_id=root.trace_id,
+                          sampled=root.sampled)
         obs.event(
             "session_frame",
             session_id=sid,
@@ -1300,7 +1359,8 @@ class MatchServer:
             trace_id=root.trace_id,
         )
         exemplar.observe_request(
-            "v1_session_frame", e2e_s, root.trace_id,
+            "v1_session_frame", e2e_s,
+            root.trace_id if root.sampled else None,
             threshold_s=self.slo_p99_target_s, labels=self.labels)
         # rung_index, not position: an interactive request at a
         # shedding position still SERVED at full quality, and the
@@ -1535,6 +1595,14 @@ def main(argv=None):
         "--run_log", type=str, default="",
         help="structured JSONL run log path (empty disables)",
     )
+    parser.add_argument(
+        "--trace_sample_rate", type=float, default=1.0,
+        help="head-sampling probability for request traces "
+        "(docs/OBSERVABILITY.md, Cross-process tracing): new roots "
+        "sample at this rate, propagated X-NCNet-Trace contexts keep "
+        "the caller's decision, and error/breaker/poison paths are "
+        "always recorded locally",
+    )
     args = parser.parse_args(argv)
 
     from ..cli.common import build_model
@@ -1693,6 +1761,7 @@ def main(argv=None):
         shadow_burst=args.shadow_burst,
         shadow_tau_px=args.shadow_tau_px,
         shadow_low_water_frac=args.shadow_low_water_frac,
+        trace_sample_rate=args.trace_sample_rate,
     ).start()
     print(f"serving on {server.url}", file=sys.stderr, flush=True)
     try:

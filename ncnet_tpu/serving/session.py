@@ -121,6 +121,11 @@ class Session:
     ref_feats: Optional[object] = None   # np [1,C,h,w] once computed
     ref_shape: Optional[tuple] = None
     op: Optional[tuple] = None           # pinned c2f operating point
+    #: Trace id of the request that opened the session: the TTL evictor
+    #: runs on some OTHER request's trace, so the eviction event needs
+    #: this stored link back to the opener's (possibly cross-process)
+    #: tree. Immutable after open.
+    open_trace_id: Optional[str] = None
     seed: Optional[Seed] = None  # guarded-by: Session.lock -- per frame
     frames: int = 0  # guarded-by: Session.lock -- held across a frame
     # guarded-by: Session.lock -- held across a frame
@@ -173,7 +178,8 @@ class SessionManager:
             s.closed = True
             obs.counter("serving.session.evicted", labels=self.labels).inc()
             obs.event("session_evicted", session_id=sid, tenant=s.tenant,
-                      frames=s.frames, idle_s=round(now - s.last_used, 3))
+                      frames=s.frames, idle_s=round(now - s.last_used, 3),
+                      trace_id=s.open_trace_id)
         if stale:
             self._set_active_locked()
         return len(stale)
@@ -206,6 +212,7 @@ class SessionManager:
                 session_id=sid, tenant=tenant, priority=priority,
                 ref_digest=ref_digest, created=now, last_used=now,
                 ref_path=ref_path, ref_b64=ref_b64, op=op,
+                open_trace_id=trace_id,
             )
             self._sessions[sid] = session
             self._set_active_locked()

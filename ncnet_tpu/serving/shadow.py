@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..obs import trace
 from ..obs.events import event
 from ..obs.metrics import counter, histogram, replica_labels
 from .qos import TokenBucket
@@ -148,23 +149,32 @@ class ShadowSampler:
                 if live_rows is not None else np.zeros((0, 5), np.float32))
         req = dict(baseline_request)
         prep_fn = prepare or self._prepare
+        # Capture the request's trace context NOW (handler thread):
+        # the comparison thread re-attaches it so the shadow re-run's
+        # prepare/submit spans land in the sampled request's own tree —
+        # the cross-thread half of propagation, same idiom as the
+        # dispatcher's submit capture.
+        ctx = trace.current()
         self._executor(lambda: self._compare(
             req, live, rung=int(rung), endpoint=endpoint, seeded=seeded,
-            tenant=tenant, trace_id=trace_id, prepare=prep_fn))
+            tenant=tenant, trace_id=trace_id, prepare=prep_fn, ctx=ctx))
         return True
 
     # -- the background half ----------------------------------------------
 
     def _compare(self, request, live_rows, *, rung, endpoint, seeded,
-                 tenant, trace_id, prepare):
+                 tenant, trace_id, prepare, ctx=()):
         from ncnet_tpu.evals.agreement import match_table_agreement
 
         try:
-            prepared = prepare(request)
-            fut = self._submit(prepared.bucket_key, prepared,
-                               timeout_s=self.timeout_s, tenant=tenant)
-            br = fut.result(timeout=self.timeout_s)
-            ref_rows = br.result["matches"]
+            with trace.attach(ctx), \
+                    trace.span("shadow_compare", endpoint=endpoint,
+                               rung=rung, seeded=seeded):
+                prepared = prepare(request)
+                fut = self._submit(prepared.bucket_key, prepared,
+                                   timeout_s=self.timeout_s, tenant=tenant)
+                br = fut.result(timeout=self.timeout_s)
+                ref_rows = br.result["matches"]
         except Exception as exc:  # noqa: BLE001 — best-effort, counted
             with self._lock:
                 self._errors += 1

@@ -145,10 +145,18 @@ def assert_valid_runlog(path, component=None):
     run_start (host/git/args metadata), records >= 1 heartbeat and
     >= 1 metrics snapshot, and closes with run_end. Traced span records
     must form a valid tree: every non-null parent_id resolves to a
-    span_id in the same log. Returns the parsed records.
+    span_id in the same log — except spans marked ``remote_parent``,
+    whose parent lives in the CALLER's runlog across the
+    ``X-NCNet-Trace`` wire boundary by design. Rotated logs
+    (NCNET_RUNLOG_MAX_MB) are validated over their whole segment set.
+    Returns the parsed records (all segments, oldest first).
     """
-    with open(path, encoding="utf-8") as fh:
-        records = [json.loads(line) for line in fh if line.strip()]
+    from ncnet_tpu.obs.events import runlog_segments
+
+    records = []
+    for seg in runlog_segments(str(path)):
+        with open(seg, encoding="utf-8") as fh:
+            records.extend(json.loads(line) for line in fh if line.strip())
     assert records, f"empty run log {path}"
     names = [r["event"] for r in records]
     for r in records:
@@ -164,7 +172,7 @@ def assert_valid_runlog(path, component=None):
     for r in records:
         if r.get("kind") == "span" and r.get("trace_id"):
             assert r.get("span_id"), f"traced span missing span_id: {r}"
-            if r.get("parent_id") is not None:
+            if r.get("parent_id") is not None and not r.get("remote_parent"):
                 assert r["parent_id"] in span_ids, (
                     f"unresolved parent_id in {r}"
                 )

@@ -743,6 +743,28 @@ def test_ncnet_lint_nonzero_on_seeded_fixtures(tmp_path, capsys):
         assert rec["new"] >= 1, (rule, rec)
 
 
+def test_trace_export_selftest_emits_one_json_line():
+    """tools/trace_export.py --selftest stdout contract: the multi-
+    runlog join verification (synthetic client + skewed server logs)
+    prints ONE JSON line and exits 0 — the shape ci_gate's optional
+    --with-trace-join check records."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         "--selftest"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = [l for l in res.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "trace_export_selftest"
+    assert rec["ok"] is True
+    for key in ("single_tree", "skew_recovered", "nested",
+                "remote_marked", "clock_offset_s"):
+        assert key in rec, rec
+
+
 def test_bench_trend_passes_quality_fields_through(tmp_path, capsys):
     """tools/bench_trend.py forwards the quality-observatory fields
     (ISSUE 14): a throughput trend earned by walking tenants down QoS

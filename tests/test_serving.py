@@ -367,10 +367,15 @@ def test_serving_e2e_cpu(tiny_serving_model, tmp_path):
     # Request spans form a valid tree (the schema-v2 acceptance
     # contract): every HTTP-served request root nests queue_wait +
     # batch_assemble + device children booked from the worker thread.
+    # MatchClient injects X-NCNet-Trace, so HTTP-served roots CONTINUE
+    # the client's trace (remote_parent; the parent span lives in the
+    # caller's runlog) — only the raw _request 400 probes are local
+    # roots with parent_id None.
     spans = [r for r in records
              if r.get("kind") == "span" and r.get("trace_id")]
     roots = [r for r in spans
-             if r["event"] == "request" and r.get("parent_id") is None]
+             if r["event"] == "request"
+             and (r.get("parent_id") is None or r.get("remote_parent"))]
     children = {}
     for r in spans:
         if r.get("parent_id") is not None:

@@ -1,0 +1,496 @@
+"""Cross-process tracing plane: wire propagation, head sampling,
+bounded runlogs, multi-runlog assembly (obs/trace.py, obs/events.py,
+tools/trace_export.py, tools/obs_report.py — docs/OBSERVABILITY.md,
+"Cross-process tracing").
+
+Unit layer: the ``X-NCNet-Trace`` header grammar (inject/extract
+round-trip, malformed values rejected to None), trace continuation
+with the ``remote_parent`` marker and the ``trace.*`` counters,
+sample-rate-0 suppression with the error/force escape hatches, runlog
+size rotation (segment sets read identically to an unrotated
+reference), clock-skew recovery on synthetic records, the redispatch
+hop landing in the request's own tree, and obs_report's
+``<remote ...>`` vs ``<orphaned>`` grouping.
+
+E2e layer: a real stdlib client and a 2-replica fleet server share a
+process but write SEPARATE runlogs (the client gets an explicit
+``run_log`` sink); the exported join of the two logs must be ONE tree
+per request rooted at the client span, with the response ``trace_id``
+equal to the id the client injected.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from conftest import assert_valid_runlog
+from ncnet_tpu import obs
+from ncnet_tpu.obs import trace
+from ncnet_tpu.obs.events import RunLog, runlog_segments
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import obs_report  # noqa: E402
+import trace_export  # noqa: E402
+
+
+def _load(path):
+    with open(path, encoding="utf-8") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# -- wire grammar ---------------------------------------------------------
+
+
+def test_inject_extract_roundtrip():
+    ctx = trace.SpanCtx("ab" * 8, "cd" * 8, sampled=True)
+    value = trace.inject(ctx)
+    assert value == f"{'ab' * 8}-{'cd' * 8}-01"
+    back = trace.extract(value)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    # An extracted context is marked remote: its span lives in the
+    # caller's runlog, and trace() counts the continuation.
+    assert back.remote is True
+
+    unsampled = trace.inject(trace.SpanCtx("ab" * 8, "cd" * 8,
+                                           sampled=False))
+    assert unsampled.endswith("-00")
+    assert trace.extract(unsampled).sampled is False
+
+    # inject() with no argument serializes the ambient context.
+    assert trace.inject() is None
+    with trace.trace("request") as root:
+        hdr = trace.inject()
+    assert trace.extract(hdr).trace_id == root.trace_id
+    assert trace.extract(hdr).span_id == root.span_id
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    "",
+    "justonechunk",
+    "two-chunks",
+    "a" * 16 + "-" + "b" * 16 + "-01-extra",
+    "zz!" + "-" + "b" * 16 + "-01",          # non-hex trace id
+    "a" * 16 + "-" + "b!" * 8 + "-01",       # non-hex span id
+    "a" * 16 + "-" + "b" * 16 + "-xx",       # non-hex flags
+    "-" + "b" * 16 + "-01",                  # empty trace id
+    42,                                       # not a string at all
+])
+def test_extract_rejects_malformed(bad):
+    # Malformed propagation is best-effort-dropped, never an error:
+    # the server roots a fresh trace instead of failing the request.
+    assert trace.extract(bad) is None
+
+
+# -- continuation + counters ----------------------------------------------
+
+
+def test_trace_continuation_counters_and_remote_marker(tmp_path):
+    path = tmp_path / "t.jsonl"
+    run = obs.init_run("unit", str(path), heartbeat_s=0)
+    try:
+        with trace.trace("client.request") as croot:
+            wire = trace.inject()
+        remote = trace.extract(wire)
+        with trace.trace("request", parent=remote, kind="server") as sroot:
+            pass
+    finally:
+        run.close()
+    # Continuation: same trace, parented onto the wire span.
+    assert sroot.trace_id == croot.trace_id
+    records = _load(path)
+    req = next(r for r in records if r["event"] == "request")
+    assert req["trace_id"] == croot.trace_id
+    assert req["parent_id"] == croot.span_id
+    assert req["remote_parent"] is True
+    assert req["span_kind"] == "server"
+    assert obs.counter("trace.remote_spans").value == 1
+    assert obs.counter("trace.sampled").value == 2
+    assert obs.counter("trace.dropped").value == 0
+
+
+def test_sample_rate_zero_suppresses_spans_but_records_errors(tmp_path):
+    path = tmp_path / "s.jsonl"
+    run = obs.init_run("unit", str(path), heartbeat_s=0)
+    try:
+        trace.set_sample_rate(0.0)
+        assert obs.gauge("trace.sample_rate").value == 0.0
+        # Happy path: root + child write NOTHING.
+        with trace.trace("request") as root:
+            assert not root.sampled
+            with trace.span("child"):
+                pass
+            # inject propagates the negative decision downstream.
+            assert trace.inject().endswith("-00")
+        # Instant events are never sampling-gated.
+        obs.event("request_summary", trace_id=root.trace_id)
+        # An exception inside an unsampled trace still leaves a trail.
+        with pytest.raises(RuntimeError):
+            with trace.trace("boom"):
+                raise RuntimeError("x")
+        # force(): the handler discovers a 4xx/5xx outcome after the
+        # fact; the root must land with the forced fields.
+        with trace.trace("forced_req") as froot:
+            trace.force(froot, status=503, error_kind="over_capacity")
+    finally:
+        trace.set_sample_rate(1.0)
+        run.close()
+    records = _load(path)
+    spans = [r for r in records if r.get("kind") == "span"]
+    names = {r["event"] for r in spans}
+    assert "request" not in names and "child" not in names
+    assert any(r["event"] == "request_summary" for r in records)
+    boom = next(r for r in spans if r["event"] == "boom")
+    assert boom["error"].startswith("RuntimeError")
+    assert boom["sampled"] is False
+    forced = next(r for r in spans if r["event"] == "forced_req")
+    assert forced["status"] == 503
+    assert forced["error_kind"] == "over_capacity"
+    assert forced["sampled"] is False
+    # Counters reconcile: every root decision counted, all dropped.
+    assert obs.counter("trace.dropped").value == 3
+    assert obs.counter("trace.sampled").value == 0
+
+
+# -- runlog rotation ------------------------------------------------------
+
+
+def test_runlog_rotation_segment_set_reads_as_one_log(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = RunLog(path, "unit", max_bytes=4000)
+    for i in range(60):
+        log.event("tick", i=i, pad="x" * 40)
+    log.event("heartbeat", idle_s=0.0)
+    log.flush_metrics()
+    log.close("ok")
+    segs = runlog_segments(path)
+    assert len(segs) >= 3, "4 kB cap over ~10 kB of events must rotate"
+    assert segs[-1] == path, "active base file is always the newest"
+    mids = [os.path.basename(s) for s in segs[:-1]]
+    assert mids == sorted(mids)
+    # conftest's schema check reads the segment set transparently and
+    # sees the full ordered stream.
+    records = assert_valid_runlog(path, component="unit")
+    assert [r["i"] for r in records
+            if r["event"] == "tick"] == list(range(60))
+
+    # Reader equivalence: the rotated set exports identically to a
+    # hand-merged unrotated reference file.
+    merged = str(tmp_path / "merged.jsonl")
+    with open(merged, "w", encoding="utf-8") as out:
+        for seg in segs:
+            with open(seg, encoding="utf-8") as fh:
+                out.write(fh.read())
+    ta = trace_export.export(path, str(tmp_path / "a.trace.json"))
+    tb = trace_export.export(merged, str(tmp_path / "b.trace.json"))
+    assert ta["traceEvents"] == tb["traceEvents"]
+    assert obs_report.load_run(path) == obs_report.load_run(merged)
+
+
+def test_runlog_rotation_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("NCNET_RUNLOG_MAX_MB", "0.002")  # 2000 bytes
+    path = str(tmp_path / "e.jsonl")
+    log = RunLog(path, "unit")
+    assert log.max_bytes == 2000
+    for i in range(40):
+        log.event("tick", i=i, pad="y" * 60)
+    log.close("ok")
+    assert len(runlog_segments(path)) >= 2
+
+    # A garbage value degrades to unbounded, never takes the run down.
+    monkeypatch.setenv("NCNET_RUNLOG_MAX_MB", "junk")
+    log2 = RunLog(str(tmp_path / "j.jsonl"), "unit")
+    assert log2.max_bytes == 0
+    log2.close("ok")
+
+
+# -- clock-skew pairing ---------------------------------------------------
+
+
+def _span(tw, dur, sid, pid=None, **fields):
+    return {"kind": "span", "event": "s", "t_wall": tw, "dur_s": dur,
+            "span_id": sid, "parent_id": pid, **fields}
+
+
+def test_clock_offsets_recover_skew_from_remote_edges():
+    t0 = 1000.0
+    skew = 12.5  # server wall clock runs this far AHEAD of the client
+    client = [_span(t0 + 1.0, 1.0, "a"),
+              _span(t0 + 0.9, 0.8, "b", "a")]
+    # The server span covers the same instant as its client parent, but
+    # timestamped on the skewed clock.
+    server = [_span(t0 + skew + 0.85, 0.7, "c", "b", remote_parent=True)]
+    offs = trace_export.clock_offsets([client, server])
+    assert offs[0] == 0.0, "file 0 is the reference timebase"
+    assert offs[1] == pytest.approx(-skew, abs=0.2)
+
+    # A file with no remote edge to the reference keeps offset 0.
+    lonely = [_span(t0 + 99.0, 1.0, "z")]
+    offs = trace_export.clock_offsets([client, server, lonely])
+    assert offs[1] == pytest.approx(-skew, abs=0.2)
+    assert offs[2] == 0.0
+
+    # Transitive correction: a third file hanging off the SERVER's
+    # spans corrects through the chain back to the client's timebase.
+    skew2 = -5.0
+    replica = [_span(t0 + skew + skew2 + 0.75, 0.5, "d", "c",
+                     remote_parent=True)]
+    offs = trace_export.clock_offsets([client, server, replica])
+    assert offs[2] == pytest.approx(-(skew + skew2), abs=0.4)
+
+
+def test_trace_export_selftest_passes(capsys):
+    assert trace_export._selftest() == 0
+    line = capsys.readouterr().out.strip()
+    report = json.loads(line)
+    assert report["metric"] == "trace_export_selftest"
+    assert report["ok"] is True
+    assert report["clock_offset_s"] == pytest.approx(-30.0, abs=0.5)
+
+
+# -- obs_report grouping --------------------------------------------------
+
+
+def test_obs_report_remote_vs_orphaned_grouping():
+    recs = [
+        {"kind": "span", "event": "request", "dur_s": 0.5, "t_wall": 1.0,
+         "trace_id": "t1", "span_id": "s1", "parent_id": "w" * 16,
+         "remote_parent": True},
+        {"kind": "span", "event": "admit", "dur_s": 0.1, "t_wall": 1.0,
+         "trace_id": "t1", "span_id": "s2", "parent_id": "s1"},
+        {"kind": "span", "event": "lost_child", "dur_s": 0.1,
+         "t_wall": 1.0, "trace_id": "t2", "span_id": "s3",
+         "parent_id": "gone"},
+    ]
+    tree = obs_report.span_tree(recs)
+    remote_root = f"<remote {'w' * 8}>"
+    # The wire-parented span roots under <remote ...> (join the
+    # caller's log to resolve it), NOT under <orphaned> — which stays
+    # reserved for genuinely lost parents.
+    assert (remote_root, "request") in tree
+    assert (remote_root, "request", "admit") in tree
+    assert ("<orphaned>", "lost_child") in tree
+    assert not any("<orphaned>" in p and "request" in p for p in tree)
+
+
+def test_obs_report_join_renders_one_tree(tmp_path, capsys):
+    client = [
+        {"v": 2, "run_id": "c", "event": "run_start", "t_wall": 1.0,
+         "t_mono": 0.0, "component": "client", "pid": 11},
+        {"v": 2, "run_id": "c", "event": "client.request", "kind": "span",
+         "t_wall": 2.0, "t_mono": 1.0, "dur_s": 1.0,
+         "trace_id": "t" * 16, "span_id": "a" * 16, "parent_id": None},
+        {"v": 2, "run_id": "c", "event": "client.attempt", "kind": "span",
+         "t_wall": 1.95, "t_mono": 0.95, "dur_s": 0.9,
+         "trace_id": "t" * 16, "span_id": "b" * 16, "parent_id": "a" * 16},
+    ]
+    server = [
+        {"v": 2, "run_id": "s", "event": "run_start", "t_wall": 1.0,
+         "t_mono": 0.0, "component": "serving", "pid": 12},
+        {"v": 2, "run_id": "s", "event": "request", "kind": "span",
+         "t_wall": 1.9, "t_mono": 0.9, "dur_s": 0.8,
+         "trace_id": "t" * 16, "span_id": "c" * 16, "parent_id": "b" * 16,
+         "remote_parent": True},
+    ]
+    paths = [str(tmp_path / "c.jsonl"), str(tmp_path / "s.jsonl")]
+    for path, recs in zip(paths, (client, server)):
+        with open(path, "w", encoding="utf-8") as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+    assert obs_report.main(["--join"] + paths) == 0
+    out = capsys.readouterr().out
+    assert "cross-process span tree" in out
+    assert "client.request" in out and "client.attempt" in out
+    # The remote edge RESOLVED across the join: no synthetic roots.
+    assert "<remote" not in out and "<orphaned>" not in out
+    assert "joined traces: 1" in out
+
+
+# -- redispatch hop in the request's tree ---------------------------------
+
+
+def _echo(bucket_key, batch):
+    return [{"payload": p, "bucket": bucket_key} for p in batch]
+
+
+def test_redispatch_span_lands_in_request_trace(tmp_path):
+    from ncnet_tpu.serving.dispatcher import FleetDispatcher
+    from ncnet_tpu.serving.fleet import Replica
+
+    path = tmp_path / "d.jsonl"
+    run = obs.init_run("unit", str(path), heartbeat_s=0)
+    clock = FakeClock()
+    pool = [Replica(f"r{i}", runner=_echo, clock=clock, max_batch=2,
+                    max_queue=4, max_delay_s=0.05) for i in range(2)]
+    disp = FleetDispatcher(pool)
+    try:
+        with trace.trace("request") as root:
+            fut = disp.submit("b", "x")
+        victim = next(r for r in pool if r.load > 0)
+        survivor = next(r for r in pool if r is not victim)
+        victim.kill()
+        clock.t += 0.1
+        victim.batcher.poll()  # refusal -> done-callback redispatches
+        clock.t += 0.1
+        survivor.batcher.poll()
+        assert fut.result(timeout=1).result["payload"] == "x"
+    finally:
+        run.close()
+    records = _load(path)
+    # The flat `redispatch` instant event predates the trace plane and
+    # stays; the SPAN record is the new tree-linked hop.
+    red = [r for r in records if r.get("event") == "redispatch"
+           and r.get("kind") == "span"]
+    assert len(red) == 1
+    # The hop parents onto the submitting request's root — a cross-
+    # replica retry stays visible inside the request's own tree.
+    assert red[0]["trace_id"] == root.trace_id
+    assert red[0]["parent_id"] == root.span_id
+    assert "error" in red[0]
+    assert red[0]["attempt"] >= 1
+    assert red[0]["replica"] == victim.replica_id
+
+
+# -- e2e: client + 2-replica fleet, separate runlogs, one joined tree -----
+
+
+def _jpeg_bytes(h, w, seed):
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    img = Image.fromarray((rng.random((h, w, 3)) * 255).astype("uint8"))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_cross_process_trace_e2e(tiny_serving_model, tmp_path):
+    from ncnet_tpu.serving.client import MatchClient
+    from ncnet_tpu.serving.fleet import MatchFleet
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    server_log_path = str(tmp_path / "server.jsonl")
+    client_log_path = str(tmp_path / "client.jsonl")
+    # Server logs through the ambient run; the client gets an EXPLICIT
+    # sink — in-process client+server must not interleave one file, or
+    # the join below would be vacuous.
+    run_log = obs.init_run("serving", server_log_path)
+    client_log = RunLog(client_log_path, "client")
+    fleet = MatchFleet.build(
+        config, params, n_replicas=2, base_id="e2e", cache_mb=64,
+        cache_model_key="trace-e2e",
+        engine_kwargs=dict(k_size=2, image_size=64),
+        replica_kwargs=dict(max_batch=2, max_delay_s=0.01,
+                            default_timeout_s=120.0))
+    server = MatchServer(None, port=0, fleet=fleet,
+                         slo_p99_target_s=60.0).start()
+    try:
+        client = MatchClient(server.url, timeout_s=120.0, retries=0,
+                             run_log=client_log)
+        qb = _jpeg_bytes(96, 128, 0)
+        pb = _jpeg_bytes(96, 128, 1)
+        r1 = client.match(query_bytes=qb, pano_bytes=pb, max_matches=8)
+        assert r1["n_matches"] >= 1
+
+        # Kill a replica mid-stream: traffic keeps flowing on the
+        # survivor and the trace plane keeps propagating.
+        fleet.kill("e2e-d1")
+        r2 = client.match(query_bytes=qb, pano_bytes=pb, max_matches=8)
+        assert r2["n_matches"] >= 1
+        fleet.revive("e2e-d1")
+
+        # Head sampling off: the request succeeds, writes NO span
+        # events anywhere, but the root decision is still counted.
+        sampled0 = obs.counter("trace.sampled").value
+        dropped0 = obs.counter("trace.dropped").value
+        trace.set_sample_rate(0.0)
+        try:
+            r3 = client.match(query_bytes=qb, pano_bytes=pb,
+                              max_matches=8)
+        finally:
+            trace.set_sample_rate(1.0)
+        assert r3["trace_id"]
+        assert obs.counter("trace.sampled").value == sampled0
+        assert obs.counter("trace.dropped").value == dropped0 + 1
+    finally:
+        server.stop()
+        run_log.close("ok")
+        client_log.close("ok")
+
+    server_records = assert_valid_runlog(server_log_path,
+                                         component="serving")
+    client_records = _load(client_log_path)
+
+    # The response trace_id IS the client-injected id: the client log's
+    # request roots carry exactly the ids the server echoed back.
+    creqs = [r for r in client_records
+             if r.get("event") == "client.request"]
+    assert len(creqs) == 2, "unsampled r3 must not write a client root"
+    assert {r["trace_id"] for r in creqs} == {r1["trace_id"],
+                                              r2["trace_id"]}
+    for r in creqs:
+        assert r["span_kind"] == "client"
+        assert r["parent_id"] is None
+        assert r["attempts"] == 1 and r["status"] == 200
+
+    # The server CONTINUED those traces across the wire.
+    sreqs = [r for r in server_records
+             if r.get("event") == "request" and r.get("kind") == "span"]
+    assert {r["trace_id"] for r in sreqs} == {r1["trace_id"],
+                                              r2["trace_id"]}
+    for r in sreqs:
+        assert r["remote_parent"] is True
+        assert r["span_kind"] == "server"
+
+    # r3 (unsampled) left no span record in EITHER log.
+    assert all(r.get("trace_id") != r3["trace_id"]
+               for r in server_records + client_records
+               if r.get("kind") == "span")
+
+    # The join: every span of each request walks up to ONE root — the
+    # client.request span — across the two files.
+    by_id = {r["span_id"]: r
+             for r in client_records + server_records
+             if r.get("kind") == "span" and r.get("span_id")}
+    for resp in (r1, r2):
+        tspans = [r for r in by_id.values()
+                  if r.get("trace_id") == resp["trace_id"]]
+        assert len(tspans) >= 4, (
+            "expected client root + attempt + server request + "
+            f"lifecycle children, got {[r['event'] for r in tspans]}")
+        roots = [r for r in tspans if r.get("parent_id") is None]
+        assert [r["event"] for r in roots] == ["client.request"]
+        for r in tspans:
+            node, hops = r, 0
+            while node.get("parent_id") is not None:
+                node = by_id[node["parent_id"]]
+                hops += 1
+                assert hops < 50, "cycle in joined span tree"
+            assert node["event"] == "client.request"
+
+    # And the exporter agrees: 2 cross-file traces, near-zero skew
+    # (same host clock), output written.
+    out = str(tmp_path / "joined.trace.json")
+    data = trace_export.export([client_log_path, server_log_path], out)
+    assert os.path.exists(out)
+    assert trace_export._cross_file_traces(
+        [client_records, server_records]) == 2
+    off = data["otherData"]["clock_offsets_s"][server_log_path]
+    assert abs(off) < 2.0

@@ -33,8 +33,12 @@ mid-stream replica kill must re-seed, never kill the session or drop
 a frame). ``--with-quality-report`` runs the match-quality comparator
 self-test (``tools/quality_report.py --smoke --strict`` — a tiny
 self-hosted server shadow-re-runs every response; rung-0 agreement
-must be 1.0 bitwise). All are off by default because they serve live
-traffic for several seconds; a default run still RECORDS them as
+must be 1.0 bitwise). ``--with-trace-join`` runs the multi-runlog
+trace-assembly self-test (``tools/trace_export.py --selftest`` —
+synthetic client + skewed server logs must join into ONE tree with
+the clock skew recovered). All are off by default because they serve
+live traffic for several seconds (or, for trace_join, are covered by
+tier-1); a default run still RECORDS them as
 ``{"skipped": true, "optional": true}`` so the JSON never reads as if
 the contract were exercised when it was not.
 
@@ -66,7 +70,7 @@ CHECKS = ("tier1", "lint", "bench_trend")
 # Opt-in checks: never run by default, never silently green — a
 # default run records them as {"skipped": true, "optional": true}.
 OPTIONAL_CHECKS = ("full_lint", "tenant_flood", "session_chaos",
-                   "quality_report")
+                   "quality_report", "trace_join")
 
 
 def _run(cmd, timeout_s, cpu_env=False) -> dict:
@@ -158,6 +162,16 @@ def run_quality_report(timeout_s: float) -> dict:
         timeout_s, cpu_env=True)
 
 
+def run_trace_join(timeout_s: float) -> dict:
+    # The distributed-trace assembly self-test: two synthetic runlogs
+    # (client, server skewed +30s) must export as ONE joined tree with
+    # the skew recovered by client-send/server-receive pairing.
+    return _run(
+        [sys.executable, os.path.join("tools", "trace_export.py"),
+         "--selftest"],
+        timeout_s, cpu_env=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--skip", action="append", default=[],
@@ -186,6 +200,10 @@ def main(argv=None) -> int:
                          "self-test (tools/quality_report.py --smoke "
                          "--strict); off by default, recorded as "
                          "skipped when off")
+    ap.add_argument("--with-trace-join", action="store_true",
+                    help="also run the multi-runlog trace-assembly "
+                         "self-test (tools/trace_export.py --selftest); "
+                         "off by default, recorded as skipped when off")
     ap.add_argument("--chaos-timeout-s", type=float, default=300.0,
                     help="wall-clock fence for the optional chaos checks")
     args = ap.parse_args(argv)
@@ -199,11 +217,13 @@ def main(argv=None) -> int:
         "session_chaos": lambda: run_session_chaos(args.chaos_timeout_s),
         "quality_report": lambda: run_quality_report(
             args.chaos_timeout_s),
+        "trace_join": lambda: run_trace_join(args.timeout_s),
     }
     enabled = {"full_lint": args.with_full_lint,
                "tenant_flood": args.with_tenant_flood,
                "session_chaos": args.with_session_chaos,
-               "quality_report": args.with_quality_report}
+               "quality_report": args.with_quality_report,
+               "trace_join": args.with_trace_join}
     checks = {}
     for name in CHECKS + OPTIONAL_CHECKS:
         if name in args.skip or not enabled.get(name, True):

@@ -17,33 +17,64 @@ bench runs)::
 ``--strict`` makes flagged rows a nonzero exit, so the diff can gate a
 session script the way tier-1 tests gate a commit.
 
+Join mode — N runlogs (client + replicas) rendered as ONE
+cross-process span tree, spans joined by ``trace_id``/``parent_id``
+across files (the ``X-NCNet-Trace`` propagation makes ids global —
+docs/OBSERVABILITY.md, "Cross-process tracing")::
+
+    python tools/obs_report.py --join client.jsonl replica0.jsonl
+
+A span whose parent lives in ANOTHER process's runlog (its record
+carries ``remote_parent: true``) renders as a ``<remote xxxxxxxx>``
+root showing the wire parent id — not as ``<orphaned>``, which stays
+reserved for genuinely lost parents (crash-truncated logs).
+
 Truncated final lines (a run killed mid-write) are tolerated: every
 complete line still parses, which is the crash-safety point of the
-line-flushed JSONL format.
+line-flushed JSONL format. Rotated logs (obs/events.py,
+``NCNET_RUNLOG_MAX_MB``) are read as their whole segment set — pass
+the base path.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
 
+def _segments(path: str) -> List[str]:
+    """The (possibly rotated) log's segment set, oldest first — the
+    canonical lister lives in ncnet_tpu.obs.events.runlog_segments."""
+    try:
+        from ncnet_tpu.obs.events import runlog_segments
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from ncnet_tpu.obs.events import runlog_segments
+    return runlog_segments(path)
+
+
 def load_run(path: str) -> List[dict]:
-    """All complete JSON records of one run log, in file order."""
+    """All complete JSON records of one run log, in file order —
+    including any rotated-out segments."""
     records = []
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except json.JSONDecodeError:
-                # A SIGKILL mid-write loses at most the final line; the
-                # rest of the run stays reportable.
-                continue
+    for seg in _segments(path):
+        if not os.path.exists(seg):
+            continue
+        with open(seg, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    # A SIGKILL mid-write loses at most the final line;
+                    # the rest of the run stays reportable.
+                    continue
     return records
 
 
@@ -123,9 +154,14 @@ def span_tree(records: List[dict]) -> Dict[tuple, dict]:
     to a crash mid-write, or the log was truncated) is grouped under a
     synthetic ``<orphaned>`` root rather than silently posing as a
     top-level span — a truncated runlog then reads as truncated
-    instead of as a differently-shaped request. Spans with a null
-    parent_id are genuine roots and stay unmarked; cycles (defensive:
-    the walk's ``seen`` guard) are not marked either.
+    instead of as a differently-shaped request. One exception: a span
+    carrying ``remote_parent`` (serving/server.py continued a trace
+    from the ``X-NCNet-Trace`` header) has its parent in the CALLER'S
+    runlog by design, so it roots under ``<remote xxxxxxxx>`` showing
+    the wire parent id — join the caller's log (``--join``) to resolve
+    it into one tree. Spans with a null parent_id are genuine roots
+    and stay unmarked; cycles (defensive: the walk's ``seen`` guard)
+    are not marked either.
     """
     spans = [r for r in _spans(records) if r.get("span_id")]
     by_id = {r["span_id"]: r for r in spans}
@@ -136,9 +172,13 @@ def span_tree(records: List[dict]) -> Dict[tuple, dict]:
             seen.add(node["span_id"])
             path.append(node["event"])
             parent_id = node.get("parent_id")
+            last = node
             node = by_id.get(parent_id)
             if node is None and parent_id is not None:
-                path.append("<orphaned>")
+                if last.get("remote_parent"):
+                    path.append(f"<remote {parent_id[:8]}>")
+                else:
+                    path.append("<orphaned>")
         key = tuple(reversed(path))
         agg = out.setdefault(key, {"count": 0, "total_s": 0.0, "max_s": 0.0})
         agg["count"] += 1
@@ -319,9 +359,56 @@ def render_diff(rows: List[dict], path_a: str, path_b: str,
     return n_flagged
 
 
+def render_join(paths: List[str], record_sets: List[List[dict]],
+                out=None) -> None:
+    """One cross-process span tree over N runlogs, joined by span ids.
+
+    Wire propagation (X-NCNet-Trace) makes trace/span ids global, so
+    concatenating the record sets lets ``span_tree`` resolve a server
+    span's ``remote_parent`` edge into the client's own span — the
+    joined tree shows a /v1/match request as client.request →
+    client.attempt → request → admit/... in ONE rooted tree. Durations
+    are wall-clock per process (no skew correction here — that's
+    tools/trace_export.py's job, which emits aligned timelines).
+    """
+    w = (out or sys.stdout).write
+    merged: List[dict] = []
+    w(f"joined trace view over {len(paths)} log(s):\n")
+    for path, records in zip(paths, record_sets):
+        start = next((r for r in records
+                      if r.get("event") == "run_start"), {})
+        comp = start.get("component", "?")
+        w(f"  {path}  component={comp}"
+          f"  pid={start.get('pid')}  spans="
+          f"{sum(1 for r in _spans(records) if r.get('span_id'))}\n")
+        merged.extend(records)
+    traces = {r["trace_id"] for r in _spans(merged) if r.get("trace_id")}
+    w(f"  joined traces: {len(traces)}\n")
+    tree = span_tree(merged)
+    if not tree:
+        w("  no traced spans\n")
+        return
+    w("  cross-process span tree:\n")
+    for path_key, agg in sorted(tree.items()):
+        indent = "  " * (len(path_key) - 1)
+        label = indent + path_key[-1]
+        w(f"    {label:<36} x{agg['count']:<5} total "
+          f"{agg['total_s']:8.2f}s  mean {agg['mean_s']:.3f}s  "
+          f"max {agg['max_s']:.3f}s\n")
+    unresolved = [p for p in tree if any(
+        part.startswith("<remote ") or part == "<orphaned>"
+        for part in p)]
+    if unresolved:
+        w(f"  {len(unresolved)} path(s) still unresolved — a parent's "
+          f"runlog is missing from the join\n")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("logs", nargs="+", help="run-log JSONL file(s)")
+    ap.add_argument("--join", action="store_true",
+                    help="merge all logs and render one cross-process "
+                         "span tree (spans joined by trace/span ids)")
     ap.add_argument("--diff", action="store_true",
                     help="diff the final metrics of exactly two runs")
     ap.add_argument("--threshold", type=float, default=0.05,
@@ -330,6 +417,12 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 when the diff flags any metric")
     args = ap.parse_args(argv)
+
+    if args.join:
+        if args.diff:
+            ap.error("--join and --diff are mutually exclusive")
+        render_join(args.logs, [load_run(p) for p in args.logs])
+        return 0
 
     if args.diff:
         if len(args.logs) != 2:

@@ -1,10 +1,12 @@
-"""Export a structured run log as Chrome-trace / Perfetto JSON.
+"""Export structured run logs as Chrome-trace / Perfetto JSON.
 
 Converts the ``runlog-*.jsonl`` span records (schema v2,
 docs/OBSERVABILITY.md) into the Chrome trace event format that
 ``chrome://tracing`` and https://ui.perfetto.dev load directly::
 
     python tools/trace_export.py out/runlog-serving-*.jsonl -o trace.json
+    python tools/trace_export.py client.jsonl replica0.jsonl \\
+        replica1.jsonl -o joined.json
 
 Mapping:
 
@@ -19,12 +21,29 @@ Mapping:
   (``metrics`` snapshots) are elided to a marker;
 * process/thread names are emitted as metadata ("M") events.
 
+Multi-runlog join (docs/OBSERVABILITY.md, "Cross-process tracing"):
+given N logs — a client's plus the replicas' — each becomes its own
+Perfetto process row, spans join across files by ``trace_id`` /
+``parent_id`` (the ``X-NCNet-Trace`` propagation makes ids global),
+and per-process clock skew is corrected by pairing each remote-edge
+child span (server side) with its parent span (client side): the
+midpoints of the two spans measure the same instant on two clocks, so
+their averaged difference per file pair is that pair's skew. File 0 is
+the reference timebase. A rotated log's segment set
+(``run.jsonl`` + ``run.00N.jsonl``, obs/events.runlog_segments) is
+read transparently — pass the base path.
+
 ``--profile_dir`` additionally merges the newest ``jax.profiler``
 capture under that directory (the ``<dir>/plugins/profile/<stamp>/``
 layout ``utils/profiling.trace_context`` writes) into the same file,
 aligned on wall-clock time via the ``profile_capture`` run-log event —
 host-side request spans and the device-side XLA op timeline in one
 Perfetto view.
+
+Stdout is exactly one JSON line (the bench-contract idiom:
+``{"metric": "trace_export", ...}``); the human summary goes to
+stderr. ``--selftest`` builds two synthetic runlogs with a known
+clock skew, joins them, and verifies the tree + the correction.
 """
 
 from __future__ import annotations
@@ -33,7 +52,7 @@ import argparse
 import json
 import os
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 #: pid of the run-log (host) process row in the exported trace.
 RUNLOG_PID = 1
@@ -45,19 +64,35 @@ PROFILE_PID_BASE = 1000
 _ELIDE_ARGS_EVENTS = frozenset({"metrics", "run_start"})
 
 
+def _segments(path: str) -> List[str]:
+    """The (possibly rotated) log's segment set, oldest first — the
+    canonical lister lives in ncnet_tpu.obs.events.runlog_segments."""
+    try:
+        from ncnet_tpu.obs.events import runlog_segments
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from ncnet_tpu.obs.events import runlog_segments
+    return runlog_segments(path)
+
+
 def load_records(path: str) -> List[dict]:
-    """All complete JSON records of one run log (same crash tolerance
-    as tools/obs_report.load_run)."""
+    """All complete JSON records of one run log — reading a rotated
+    log's whole segment set (same crash tolerance as
+    tools/obs_report.load_run)."""
     records = []
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+    for seg in _segments(path):
+        if not os.path.exists(seg):
+            continue
+        with open(seg, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
     return records
 
 
@@ -80,9 +115,15 @@ def _args_of(rec: dict) -> dict:
     return out
 
 
-def records_to_trace(records: List[dict]) -> List[dict]:
+def records_to_trace(records: List[dict], pid: int = RUNLOG_PID,
+                     ts_offset_s: float = 0.0) -> List[dict]:
     """Run-log records -> Chrome trace events (sorted by ts, metadata
-    first; ts is monotone within every (pid, tid))."""
+    first; ts is monotone within every (pid, tid)).
+
+    ``pid`` is the Perfetto process row this log renders as (each file
+    of a multi-log join gets its own); ``ts_offset_s`` is added to
+    every wall timestamp — the clock-skew correction onto the
+    reference file's timebase (:func:`clock_offsets`)."""
     tids: Dict[Optional[str], int] = {None: 0}
 
     def tid_of(trace_id: Optional[str]) -> int:
@@ -98,15 +139,16 @@ def records_to_trace(records: List[dict]) -> List[dict]:
         t_wall = rec.get("t_wall")
         if t_wall is None:
             continue
+        t_wall = float(t_wall) + ts_offset_s
         tid = tid_of(rec.get("trace_id"))
         if rec.get("kind") == "span" and rec.get("dur_s") is not None:
             dur_s = float(rec["dur_s"])
             events.append({
                 "name": rec.get("event", "?"),
                 "ph": "X",
-                "ts": (float(t_wall) - dur_s) * 1e6,
+                "ts": (t_wall - dur_s) * 1e6,
                 "dur": dur_s * 1e6,
-                "pid": RUNLOG_PID,
+                "pid": pid,
                 "tid": tid,
                 "args": _args_of(rec),
             })
@@ -116,8 +158,8 @@ def records_to_trace(records: List[dict]) -> List[dict]:
             events.append({
                 "name": name,
                 "ph": "i",
-                "ts": float(t_wall) * 1e6,
-                "pid": RUNLOG_PID,
+                "ts": t_wall * 1e6,
+                "pid": pid,
                 "tid": tid,
                 "s": "t",  # thread-scoped instant marker
                 "args": args,
@@ -125,16 +167,74 @@ def records_to_trace(records: List[dict]) -> List[dict]:
     events.sort(key=lambda e: e["ts"])
 
     meta: List[dict] = [{
-        "name": "process_name", "ph": "M", "pid": RUNLOG_PID,
+        "name": "process_name", "ph": "M", "pid": pid,
         "args": {"name": f"runlog {component or '?'}"},
     }]
     for trace_id, tid in sorted(tids.items(), key=lambda kv: kv[1]):
         label = "untraced" if trace_id is None else f"trace {trace_id[:8]}"
         meta.append({
-            "name": "thread_name", "ph": "M", "pid": RUNLOG_PID,
+            "name": "thread_name", "ph": "M", "pid": pid,
             "tid": tid, "args": {"name": label},
         })
     return meta + events
+
+
+def clock_offsets(record_sets: Sequence[List[dict]]) -> List[float]:
+    """Per-file wall-clock correction (seconds to ADD to file i's
+    timestamps), file 0 the reference at 0.0.
+
+    Every remote edge — a span in file i whose ``parent_id`` resolves
+    in file j but not locally — pairs two measurements of (nearly) the
+    same instant on two clocks: the child span's midpoint on i's clock
+    and its parent's midpoint on j's. Span records carry close-time
+    ``t_wall`` and ``dur_s``, so midpoint = ``t_wall - dur_s/2``. The
+    per-pair deltas are averaged (network latency is symmetric noise
+    around the true skew) and offsets propagate breadth-first from
+    file 0; a file with no edge path to the reference keeps 0.0."""
+    spans = []  # per file: span_id -> record
+    for records in record_sets:
+        by_id = {}
+        for r in records:
+            if r.get("kind") == "span" and r.get("span_id") \
+                    and r.get("dur_s") is not None:
+                by_id[r["span_id"]] = r
+        spans.append(by_id)
+
+    def _mid(rec: dict) -> float:
+        return float(rec["t_wall"]) - float(rec["dur_s"]) / 2.0
+
+    # edge (i, j) -> list of (parent_mid_on_j - child_mid_on_i)
+    deltas: Dict[Tuple[int, int], List[float]] = {}
+    for i, by_id in enumerate(spans):
+        for rec in by_id.values():
+            parent = rec.get("parent_id")
+            if not parent or parent in by_id:
+                continue  # local edge (or root): no clock crossing
+            for j, other in enumerate(spans):
+                if j == i or parent not in other:
+                    continue
+                deltas.setdefault((i, j), []).append(
+                    _mid(other[parent]) - _mid(rec))
+                break
+
+    offsets = [0.0] * len(record_sets)
+    seen = {0}
+    frontier = [0]
+    while frontier:
+        j = frontier.pop(0)
+        for (a, b), ds in deltas.items():
+            d = sum(ds) / len(ds)
+            # (a, b): child file a is skewed by -d relative to parent
+            # file b, so a's correction is b's plus d (and vice versa).
+            if b == j and a not in seen:
+                offsets[a] = offsets[j] + d
+                seen.add(a)
+                frontier.append(a)
+            elif a == j and b not in seen:
+                offsets[b] = offsets[j] - d
+                seen.add(b)
+                frontier.append(b)
+    return offsets
 
 
 def _import_traceagg():
@@ -186,35 +286,158 @@ def merge_profile(
     return path, n
 
 
-def export(log_path: str, out_path: str,
+def export(log_path: Union[str, Sequence[str]], out_path: str,
            profile_dir: Optional[str] = None) -> dict:
-    """Convert one run log (plus optional profiler capture) and write
-    the Chrome-trace JSON; returns the trace dict."""
-    records = load_records(log_path)
-    events = records_to_trace(records)
+    """Convert one or more run logs (plus optional profiler capture)
+    and write the Chrome-trace JSON; returns the trace dict.
+
+    A list of paths is the multi-runlog join: file i renders as pid
+    ``RUNLOG_PID + i``, clock-skew-corrected onto file 0's timebase
+    (:func:`clock_offsets`); ``otherData`` records the inputs and the
+    applied offsets."""
+    paths = [log_path] if isinstance(log_path, str) else list(log_path)
+    record_sets = [load_records(p) for p in paths]
+    offsets = (clock_offsets(record_sets) if len(record_sets) > 1
+               else [0.0] * len(record_sets))
+    events: List[dict] = []
+    for i, records in enumerate(record_sets):
+        events.extend(records_to_trace(records, pid=RUNLOG_PID + i,
+                                       ts_offset_s=offsets[i]))
     if profile_dir:
-        merge_profile(events, profile_dir, records)
-    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+        merge_profile(events, profile_dir, record_sets[0])
+    trace = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "logs": paths,
+            "clock_offsets_s": {p: round(o, 6)
+                                for p, o in zip(paths, offsets)},
+        },
+    }
     with open(out_path, "w", encoding="utf-8") as fh:
         json.dump(trace, fh)
     return trace
 
 
+def _cross_file_traces(record_sets: Sequence[List[dict]]) -> int:
+    """How many trace ids have span records in more than one file —
+    the joined-tree count the summary line reports."""
+    per_file = []
+    for records in record_sets:
+        per_file.append({r["trace_id"] for r in records
+                         if r.get("kind") == "span" and r.get("trace_id")})
+    counts: Dict[str, int] = {}
+    for ids in per_file:
+        for t in ids:
+            counts[t] = counts.get(t, 0) + 1
+    return sum(1 for n in counts.values() if n > 1)
+
+
+def _selftest() -> int:
+    """Build a synthetic client log + a server log whose clock runs
+    30 s ahead, join them, and verify (a) the spans form ONE tree
+    rooted at the client span and (b) the correction pulls the server
+    span back inside its parent's window. One JSON line on stdout."""
+    import tempfile
+
+    skew = 30.0  # server wall clock runs this far ahead
+    t0 = 1_700_000_000.0
+    client = [
+        {"v": 2, "run_id": "c", "event": "run_start", "t_wall": t0,
+         "t_mono": 0.0, "component": "client"},
+        {"v": 2, "run_id": "c", "event": "client.request", "kind": "span",
+         "t_wall": t0 + 1.0, "t_mono": 1.0, "dur_s": 1.0,
+         "trace_id": "t" * 16, "span_id": "a" * 16, "parent_id": None},
+        {"v": 2, "run_id": "c", "event": "client.attempt", "kind": "span",
+         "t_wall": t0 + 0.95, "t_mono": 0.95, "dur_s": 0.9,
+         "trace_id": "t" * 16, "span_id": "b" * 16,
+         "parent_id": "a" * 16},
+    ]
+    server = [
+        {"v": 2, "run_id": "s", "event": "run_start", "t_wall": t0 + skew,
+         "t_mono": 0.0, "component": "serving"},
+        {"v": 2, "run_id": "s", "event": "request", "kind": "span",
+         "t_wall": t0 + skew + 0.9, "t_mono": 0.9, "dur_s": 0.8,
+         "trace_id": "t" * 16, "span_id": "c" * 16,
+         "parent_id": "b" * 16, "remote_parent": True,
+         "span_kind": "server"},
+        {"v": 2, "run_id": "s", "event": "admit", "kind": "span",
+         "t_wall": t0 + skew + 0.2, "t_mono": 0.2, "dur_s": 0.1,
+         "trace_id": "t" * 16, "span_id": "d" * 16,
+         "parent_id": "c" * 16},
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        paths = [os.path.join(td, "client.jsonl"),
+                 os.path.join(td, "server.jsonl")]
+        for path, recs in zip(paths, (client, server)):
+            with open(path, "w", encoding="utf-8") as fh:
+                for r in recs:
+                    fh.write(json.dumps(r) + "\n")
+        out = os.path.join(td, "joined.json")
+        trace = export(paths, out)
+    spans = {e["args"]["span_id"]: e for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    off = trace["otherData"]["clock_offsets_s"]
+    measured = off[paths[1]]
+    root = spans["a" * 16]
+    remote = spans["c" * 16]
+    checks = {
+        # one tree: every span reaches the client root by parent links
+        "single_tree": all(
+            s["args"].get("parent_id") in spans or s is root
+            for s in spans.values()),
+        # the skew estimate recovered -30 s (midpoint noise is the
+        # client/server midpoint mismatch, well under a second here)
+        "skew_recovered": abs(measured + skew) < 0.5,
+        # after correction, the server span nests inside the client
+        # root's [start, end] window
+        "nested": (root["ts"] <= remote["ts"]
+                   and remote["ts"] + remote["dur"]
+                   <= root["ts"] + root["dur"] + 1.0),
+        "remote_marked": remote["args"].get("remote_parent") is True,
+    }
+    ok = all(checks.values())
+    print(json.dumps({"metric": "trace_export_selftest", "ok": ok,
+                      "clock_offset_s": round(measured, 3), **checks}))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("log", help="run-log JSONL file")
+    ap.add_argument("log", nargs="*",
+                    help="run-log JSONL file(s); several = cross-"
+                         "process join, first file is the clock "
+                         "reference")
     ap.add_argument("-o", "--out", default="",
                     help="output path (default <log>.trace.json)")
     ap.add_argument("--profile_dir", default="",
                     help="merge the newest jax.profiler capture under "
                          "this directory (plugins/profile/<stamp>/)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in join/skew verification "
+                         "against synthetic logs and exit")
     args = ap.parse_args(argv)
-    out = args.out or (os.path.splitext(args.log)[0] + ".trace.json")
+    if args.selftest:
+        return _selftest()
+    if not args.log:
+        ap.error("at least one run-log path is required")
+    out = args.out or (os.path.splitext(args.log[0])[0] + ".trace.json")
     trace = export(args.log, out, profile_dir=args.profile_dir or None)
     n_x = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
     n_i = sum(1 for e in trace["traceEvents"] if e.get("ph") == "i")
+    record_sets = [load_records(p) for p in args.log]
     print(f"wrote {out}: {len(trace['traceEvents'])} events "
           f"({n_x} spans, {n_i} instants)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "trace_export",
+        "logs": len(args.log),
+        "events": len(trace["traceEvents"]),
+        "spans": n_x,
+        "instants": n_i,
+        "joined_traces": _cross_file_traces(record_sets),
+        "clock_offsets_s": trace["otherData"]["clock_offsets_s"],
+        "out": out,
+    }))
     return 0
 
 
