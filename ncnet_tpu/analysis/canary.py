@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import importlib
 import threading
+import weakref
 from typing import List, Optional
 
 ENV_KNOB = "NCNET_RACE_CANARY"
@@ -109,26 +110,31 @@ class _Canary:
                     f"{self.cls_name}.{self.lock_attr}"
                 )
         elif self.kind == "single-writer":
-            me = threading.get_ident()
-            if me == threading.main_thread().ident:
-                if obj.__dict__.get(self._writer_slot) is not None:
+            me = threading.current_thread()
+            if me is threading.main_thread():
+                owner = obj.__dict__.get(self._writer_slot)
+                if owner is not None:
                     raise RaceCanaryError(
                         f"{self.cls_name}.{self.attr} is annotated "
                         f"single-writer and was handed off to thread "
-                        f"{obj.__dict__[self._writer_slot]!r}, but the "
-                        f"main thread wrote it again"
+                        f"{owner[0]!r}, but the main thread wrote it "
+                        f"again"
                     )
                 return
             owner = obj.__dict__.get(self._writer_slot)
             if owner is None:
+                # Identity is the Thread OBJECT (weakly held), not the
+                # OS ident: idents are recycled as soon as a thread
+                # exits, so an ident match would let a later thread
+                # impersonate a dead owner. A dead weakref can never be
+                # the current thread, which keeps ownership permanent.
                 obj.__dict__[self._writer_slot] = (
-                    threading.current_thread().name, me)
-            elif owner[1] != me:
+                    me.name, weakref.ref(me))
+            elif owner[1]() is not me:
                 raise RaceCanaryError(
                     f"{self.cls_name}.{self.attr} is annotated "
                     f"single-writer (owner thread {owner[0]!r}) but "
-                    f"thread {threading.current_thread().name!r} "
-                    f"wrote it"
+                    f"thread {me.name!r} wrote it"
                 )
 
 

@@ -266,18 +266,35 @@ def dedup_matches(xa, ya, xb, yb, score):
 
     Expects descending-score-sorted inputs; np.unique keeps the first = best
     occurrence index per unique coordinate row.
+
+    The returned order is CANONICAL, tied scores included: descending
+    score, ties broken by the lexicographic coordinate row, then by the
+    original (stable) index. The upstream device sort only orders by
+    score, so rows sharing a score can arrive in any permutation
+    (extraction impl, direction-concat order); without a deterministic
+    tiebreak here, two runs over the same pair produce tables that are
+    equal as sets but not bitwise — which breaks the content-addressed
+    result cache and the shadow comparator's rung-0 bitwise contract.
     """
     coords = np.stack(
         [np.asarray(xa), np.asarray(ya), np.asarray(xb), np.asarray(yb)], axis=0
     )
     _, unique_idx = np.unique(coords, axis=1, return_index=True)
     unique_idx = np.sort(unique_idx)
+    uscore = np.asarray(score)[unique_idx]
+    sub = coords[:, unique_idx]
+    # np.lexsort keys run minor-to-major: primary -score (descending),
+    # then xa, ya, xb, yb, then the surviving input index.
+    order = np.lexsort(
+        (unique_idx, sub[3], sub[2], sub[1], sub[0], -uscore)
+    )
+    keep = unique_idx[order]
     return (
-        coords[0, unique_idx],
-        coords[1, unique_idx],
-        coords[2, unique_idx],
-        coords[3, unique_idx],
-        np.asarray(score)[unique_idx],
+        coords[0, keep],
+        coords[1, keep],
+        coords[2, keep],
+        coords[3, keep],
+        uscore[order],
     )
 
 

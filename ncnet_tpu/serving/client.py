@@ -287,6 +287,89 @@ class MatchClient:
         finally:
             rt.close(error=err)
 
+    def localize(
+        self,
+        query_path: Optional[str] = None,
+        query_bytes: Optional[bytes] = None,
+        panos=None,
+        deadline_ms: Optional[float] = None,
+        max_matches: Optional[int] = None,
+        mode: Optional[str] = None,
+        top_k: Optional[int] = None,
+        include_matches: bool = False,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
+    ) -> dict:
+        """POST /v1/localize: one query against a shortlist of panos;
+        returns the ranked per-pano response on 200 (docs/SERVING.md,
+        "Localization as a service").
+
+        ``panos`` is a list of pano paths (str) and/or raw image bytes
+        — bytes entries upload inline as ``pano_b64``. The retry
+        contract is :meth:`match`'s: whole-query 503/429 refusals back
+        off and retry; per-pano failures do NOT raise — they come back
+        as structured entries in ``payload["panos"]`` (the server
+        answers 200 while at least one pano leg succeeded).
+        """
+        body = {}
+        if query_path:
+            body["query_path"] = query_path
+        if query_bytes:
+            body["query_b64"] = base64.b64encode(query_bytes).decode()
+        entries = []
+        for p in panos or []:
+            if isinstance(p, (bytes, bytearray, memoryview)):
+                entries.append(
+                    {"pano_b64": base64.b64encode(bytes(p)).decode()})
+            else:
+                entries.append(p)
+        body["panos"] = entries
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        if max_matches is not None:
+            body["max_matches"] = max_matches
+        if mode is not None:
+            body["mode"] = mode
+        if top_k is not None:
+            body["top_k"] = top_k
+        if include_matches:
+            body["include_matches"] = True
+        hdrs = self._base_headers(tenant, priority)
+        session = self._policy.session()
+        rt = _RequestTrace(self, "/v1/localize")
+        err: Optional[str] = None
+        try:
+            while True:
+                try:
+                    status, payload, headers = self._request(
+                        "POST", "/v1/localize", body,
+                        headers=rt.attempt_headers(hdrs)
+                    )
+                except Exception as exc:
+                    rt.attempt_done(error=f"{type(exc).__name__}: {exc}")
+                    raise
+                rt.attempt_done(status=status)
+                if status == 200:
+                    return payload
+                if status in (503, 429):
+                    try:
+                        hint = float(headers.get("Retry-After", "0.1"))
+                    except (TypeError, ValueError):
+                        hint = 0.1
+                    delay = session.next_delay(hint_s=min(hint, 5.0))
+                    if delay is not None:
+                        self._policy.sleep(delay)
+                        continue
+                    raise OverCapacityError(status, payload)
+                if status == 422:
+                    raise PoisonRequestError(status, payload)
+                raise ServingError(status, payload)
+        except BaseException as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            rt.close(error=err)
+
     def healthz(self) -> dict:
         status, payload, _ = self._request("GET", "/healthz")
         if status not in (200, 503):

@@ -172,7 +172,7 @@ class MatchEngine:
         self.k_size = k_size
         self.image_size = image_size
         self.feat_unit = feat_unit
-        match_kwargs = dict(
+        self._match_kwargs = match_kwargs = dict(
             k_size=k_size,
             do_softmax=do_softmax,
             both_directions=both_directions,
@@ -571,6 +571,34 @@ class MatchEngine:
         chw = resize_bilinear_np(img, oh, ow).transpose(2, 0, 1)
         chw = normalize_image(chw / 255.0).astype(np.float32)
         return np.ascontiguousarray(chw)[None], (oh, ow)
+
+    def result_op_key(self, prepared: Prepared) -> tuple:
+        """Everything besides the two image contents that shapes a
+        prepared pair's match table — the op-key leg of the
+        content-addressed result-cache key (serving/result_cache.py).
+
+        Mode + the RESOLVED c2f operating point (the default op is
+        spelled out, so a request pinning the default knobs explicitly
+        and one omitting them share an entry), max_matches, and the
+        resize/extraction policy knobs that select the device program.
+        Model identity is NOT here — the cache's ``model_key`` carries
+        it, exactly like the feature cache.
+        """
+        op = prepared.c2f_op
+        if prepared.mode == "c2f" and op is None:
+            op = self._c2f_default_op
+        mk = self._match_kwargs
+        return (
+            prepared.mode,
+            tuple(op) if op is not None else None,
+            int(prepared.max_matches),
+            int(self.image_size),
+            int(self.feat_unit),
+            mk["k_size"],
+            bool(mk["do_softmax"]),
+            bool(mk["both_directions"]),
+            bool(mk["invert_direction"]),
+        )
 
     def prepare(self, request: dict) -> Prepared:
         """Decode/resize a request's images, probe the feature cache.

@@ -46,6 +46,30 @@ from ..evals.feature_cache import PanoFeatureCache
 _IDENT_MEMO_MAX = 65536
 
 
+def content_digest(path_or_bytes) -> str:
+    """Stable content identity (``sha256:<digest>``) for a file path or
+    a raw bytes body.
+
+    The one hashing routine every content-addressed key in serving goes
+    through: the same image bytes yield the same digest whether they
+    arrive as a path (two mounts, a symlink, a staging copy) or inline
+    as a decoded ``*_b64`` body — which is what lets uploaded images
+    dedup against on-disk galleries in the feature store and the
+    match-result cache. Bytes are hashed directly; paths are streamed
+    in 1 MB chunks (no whole-file read). Unreadable paths raise OSError
+    — callers that want a fallback key decide their own (the store's
+    memoized :meth:`SharedFeatureStore.content_digest` falls back to
+    the literal path).
+    """
+    if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
+        return "sha256:" + hashlib.sha256(bytes(path_or_bytes)).hexdigest()
+    h = hashlib.sha256()
+    with open(path_or_bytes, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return "sha256:" + h.hexdigest()
+
+
 class SharedFeatureStore:
     """Content-addressed, fleet-shared wrapper over PanoFeatureCache.
 
@@ -87,20 +111,29 @@ class SharedFeatureStore:
             if memo is not None and memo[0] == sig:
                 self._idents.move_to_end(real)
                 return memo[1]
-        h = hashlib.sha256()
         try:
-            with open(real, "rb") as fh:
-                for chunk in iter(lambda: fh.read(1 << 20), b""):
-                    h.update(chunk)
+            digest = content_digest(real)
         except OSError:
             return pano_path
-        digest = "sha256:" + h.hexdigest()
         with self._ident_lock:
             self._idents[real] = (sig, digest)
             self._idents.move_to_end(real)
             while len(self._idents) > _IDENT_MEMO_MAX:
                 self._idents.popitem(last=False)
         return digest
+
+    def content_digest(self, path_or_bytes) -> str:
+        """Public content identity for a path OR a raw bytes body.
+
+        Paths route through the memoized :meth:`_identity` (steady
+        state is one stat; unreadable paths fall back to the literal
+        path key, matching ``get``/``put``). Bytes — a decoded
+        ``*_b64`` upload — hash directly, so an uploaded image and its
+        on-disk twin produce ONE digest and dedup against each other.
+        """
+        if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
+            return content_digest(path_or_bytes)
+        return self._identity(path_or_bytes)
 
     # -- the engine-facing cache surface ----------------------------------
 

@@ -1,0 +1,343 @@
+"""``POST /v1/localize``: one query against a shortlist, fleet-wide.
+
+The InLoc localization workload (evals/inloc.py) promoted to an online
+verb: a query image plus a shortlist of N reference panos becomes N
+pair-match legs fanned out ACROSS the replica fleet in parallel through
+the :class:`~ncnet_tpu.serving.dispatcher.FleetDispatcher` — where a
+plain ``/v1/match`` occupies one replica, a localize query's legs land
+on every healthy replica the least-loaded picker reaches, so the
+query's wall clock approaches ``N / fleet_width`` pair times instead of
+``N`` of them. Legs ride the dispatcher's ordinary refusal re-route: a
+replica killed mid-fan-out has its queued legs REDISPATCHED to
+survivors (each leg bounded by ``max_redispatch``), so the query
+answers 200 with every pano accounted for instead of failing on the
+share a dead replica held. Single-engine servers serve the same verb
+degenerately (all legs on the one batcher — still one round trip for N
+pairs instead of N).
+
+The gathered legs rank panos by **consensus mass** — the summed match
+score of the pair's deduped match table, the same quantity the offline
+InLoc ranking trusts (evals/inloc.py match extraction: each row's score
+is the pair's soft-mutual consensus at that correspondence; their sum
+is how much total consensus the pano musters for the query). Ties
+cannot reorder across runs: the tables themselves are canonically
+ordered (evals/inloc.dedup_matches) and the rank sort breaks score
+ties by input index.
+
+Every leg is a child of the request's trace root: a ``localize.pano``
+span per leg (error legs force-recorded), plus the dispatcher's own
+``redispatch`` spans for bounced legs — the joined tree shows exactly
+where each pano ran. When the server carries a match-result cache
+(serving/result_cache.py), legs consult it like any ``/v1/match``:
+repeated-shortlist traffic turns into cache hits and single-flight
+coalescing instead of dispatches.
+
+Metrics: ``serving.localize.requests`` / ``.panos`` / ``.fanout_width``
+/ ``.pano_latency_s`` / ``.pano_errors`` / ``.redispatched``
+(docs/OBSERVABILITY.md).
+
+Request schema (docs/SERVING.md, "Localization as a service")::
+
+    {"query_path"|"query_b64": ...,
+     "panos": ["path", ...] | [{"pano_path"|"pano_b64": ...}, ...],
+     "mode": "oneshot"|"c2f", "c2f": {...}, "max_matches": int,
+     "deadline_ms": float, "top_k": int, "include_matches": bool}
+
+Response: per-pano outcome list in INPUT order (no silent drops — a
+failed leg is a structured per-pano error, and the query is 200 while
+at least one leg succeeded), plus a ``ranked`` list (descending
+consensus mass, ``top_k``-truncated) carrying the match tables when
+``include_matches`` is set.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..obs import trace
+from ..reliability.breaker import BreakerOpenError
+from .batcher import PoisonRequestError, RejectedError, ReplicaDeadError
+from .feature_store import content_digest
+
+#: Fan-out ceiling per query: a shortlist wider than this is a bulk job
+#: (tools/bulk_match.py), not an online request — reject with 400
+#: instead of letting one query occupy a fleet's whole queue budget.
+MAX_PANOS = 64
+
+
+def consensus_mass(table) -> float:
+    """Summed match score of one pair's [n, 5] table — the pano's total
+    soft-mutual consensus for the query (the InLoc ranking signal)."""
+    t = np.asarray(table)
+    if t.size == 0:
+        return 0.0
+    return float(t[:, 4].sum())
+
+
+def parse_pano_list(request: dict) -> List[dict]:
+    """``panos`` -> per-leg pano fragments (``{"pano_path": ...}`` or
+    ``{"pano_b64": ...}``), validating shape. Raises ValueError (the
+    server maps it to 400)."""
+    panos = request.get("panos")
+    if not isinstance(panos, list) or not panos:
+        raise ValueError("panos must be a non-empty list")
+    if len(panos) > MAX_PANOS:
+        raise ValueError(
+            f"panos is {len(panos)} wide; the per-query fan-out cap is "
+            f"{MAX_PANOS} (use tools/bulk_match.py for bulk sweeps)")
+    out = []
+    for i, p in enumerate(panos):
+        if isinstance(p, str) and p:
+            out.append({"pano_path": p})
+            continue
+        if isinstance(p, dict):
+            path, b64 = p.get("pano_path"), p.get("pano_b64")
+            if bool(path) != bool(b64):
+                out.append({"pano_path": path} if path
+                           else {"pano_b64": b64})
+                continue
+        raise ValueError(
+            f"panos[{i}] must be a path string or an object with "
+            "exactly one of pano_path/pano_b64")
+    return out
+
+
+def pano_label(frag: dict) -> str:
+    """Stable per-pano identifier for the response: the path, or a
+    digest tag for inline uploads (the bytes have no name)."""
+    if frag.get("pano_path"):
+        return frag["pano_path"]
+    digest = content_digest(base64.b64decode(frag["pano_b64"]))
+    return "b64:" + digest.split(":", 1)[1][:16]
+
+
+def _leg_error(exc: BaseException) -> Tuple[str, str, bool]:
+    """(kind, message, retryable) for one failed leg — the same taxonomy
+    the /v1/match ladder answers with, flattened per-pano."""
+    if isinstance(exc, FutureTimeoutError):
+        return "deadline_exceeded", "deadline exceeded", False
+    if isinstance(exc, ReplicaDeadError):
+        return "replica_dead", str(exc), True
+    if isinstance(exc, BreakerOpenError):
+        return "breaker_open", "circuit breaker open", True
+    if isinstance(exc, RejectedError):
+        scope = getattr(exc, "scope", "queue")
+        if scope == "tenant":
+            return "tenant_slots", "tenant queue share exhausted", True
+        return "over_capacity", "over capacity", True
+    if isinstance(exc, PoisonRequestError):
+        return "poison_request", str(exc), False
+    if isinstance(exc, ValueError):
+        # engine.prepare refused this leg's inputs (bad b64, missing
+        # file, unknown mode) — the client's error, not the service's.
+        return "bad_request", str(exc), False
+    return "internal", f"{type(exc).__name__}: {exc}", False
+
+
+def fan_out(server, request: dict, root, timeout_s: Optional[float],
+            tenant: Optional[str]):
+    """The whole verb past admission: prepare N legs, fan them out,
+    gather, rank. Returns the handler's ``(code, payload, headers)``.
+
+    Runs on the HTTP handler thread with the request trace attached —
+    each ``submit`` captures that context, so the batcher/dispatcher
+    spans of every leg parent onto the request root.
+    """
+    from .server import DEADLINE_GRACE_S  # deferred: server imports us
+
+    labels = server.labels
+    t0 = time.monotonic()
+    pano_frags = parse_pano_list(request)  # ValueError -> caller's 400
+    base = {k: request[k] for k in ("mode", "c2f", "max_matches")
+            if request.get(k) is not None}
+    if request.get("query_path"):
+        base["query_path"] = request["query_path"]
+    else:
+        base["query_b64"] = request.get("query_b64")
+
+    n = len(pano_frags)
+    obs.counter("serving.localize.requests", labels=labels).inc()
+    obs.counter("serving.localize.panos", labels=labels).inc(n)
+    obs.histogram("serving.localize.fanout_width",
+                  labels=labels).observe(float(n))
+    rescache = getattr(server, "rescache", None)
+    store = getattr(server.engine, "cache", None)
+    redisp0 = obs.counter("serving.redispatched",
+                          labels=getattr(server.dispatcher, "labels", {})
+                          if server.dispatcher is not None else {}).value
+
+    # Prepare + submit every leg before waiting on any: the fleet's
+    # least-loaded picker then spreads the whole shortlist across
+    # healthy replicas at once (the fan-out the verb exists for).
+    legs = []
+    ctx = trace.current()
+    wait_s = ((timeout_s if timeout_s is not None
+               else server._default_timeout_s)
+              + DEADLINE_GRACE_S)
+    query_digest = None
+    for idx, frag in enumerate(pano_frags):
+        leg = {"idx": idx, "frag": frag, "fut": None, "error": None,
+               "t_submit": time.monotonic(), "t_done": None}
+        legs.append(leg)
+        leg_req = dict(base)
+        leg_req.update(frag)
+        try:
+            prepared = server.engine.prepare(leg_req)
+        except ValueError as exc:
+            leg["error"] = exc
+            continue
+        if rescache is not None:
+            try:
+                if query_digest is None:
+                    if base.get("query_b64"):
+                        query_digest = content_digest(
+                            base64.b64decode(base["query_b64"]))
+                    elif store is not None and hasattr(store,
+                                                      "content_digest"):
+                        query_digest = store.content_digest(
+                            base["query_path"])
+                    else:
+                        query_digest = content_digest(base["query_path"])
+                if frag.get("pano_b64"):
+                    pano_digest = content_digest(
+                        base64.b64decode(frag["pano_b64"]))
+                elif store is not None and hasattr(store, "content_digest"):
+                    pano_digest = store.content_digest(frag["pano_path"])
+                else:
+                    pano_digest = content_digest(frag["pano_path"])
+            except (OSError, ValueError):
+                pano_digest = None  # undigestable: this leg runs uncached
+            if pano_digest is not None:
+                prepared.meta = dict(prepared.meta or {})
+                prepared.meta["rescache_key"] = rescache.key(
+                    query_digest, pano_digest,
+                    server.engine.result_op_key(prepared))
+        try:
+            # Non-sticky: a refused leg re-routes to any healthy
+            # replica (the dispatcher's re-dispatch machinery) instead
+            # of failing the pano.
+            leg["fut"] = server.submitter.submit(
+                prepared.bucket_key, prepared, timeout_s=timeout_s,
+                tenant=tenant)
+        except (RejectedError, BreakerOpenError, RuntimeError) as exc:
+            leg["error"] = exc
+
+    # Gather in input order against ONE shared deadline: the budget is
+    # the query's, not per-leg (legs run concurrently, so the first
+    # wait absorbs most of the clock and later ones return instantly).
+    deadline = t0 + wait_s
+    results = [None] * n
+    for leg in legs:
+        if leg["fut"] is None:
+            leg["t_done"] = time.monotonic()
+            continue
+        try:
+            results[leg["idx"]] = leg["fut"].result(
+                timeout=max(deadline - time.monotonic(), 1e-3))
+        except Exception as exc:  # noqa: BLE001 — per-leg taxonomy below
+            leg["error"] = exc
+        leg["t_done"] = time.monotonic()
+
+    # Per-pano outcome rows, input order; every leg accounted for.
+    panos_out, ok_rows = [], []
+    for leg in legs:
+        idx, frag = leg["idx"], leg["frag"]
+        leg_s = leg["t_done"] - leg["t_submit"]
+        try:
+            label = pano_label(frag)
+        except (ValueError, KeyError):
+            label = f"panos[{idx}]"
+        if leg["error"] is not None:
+            kind, msg, retryable = _leg_error(leg["error"])
+            obs.counter("serving.localize.pano_errors",
+                        labels={**labels, "kind": kind}).inc()
+            trace.emit_span("localize.pano", leg_s, parents=ctx,
+                            pano=label, error=kind)
+            panos_out.append({"pano": label, "ok": False, "kind": kind,
+                              "error": msg, "retryable": retryable})
+            continue
+        br = results[idx]
+        table = br.result["matches"]
+        score = consensus_mass(table)
+        obs.histogram("serving.localize.pano_latency_s",
+                      labels=labels).observe(leg_s)
+        if root.sampled:
+            trace.emit_span("localize.pano", leg_s, parents=ctx,
+                            pano=label, n_matches=br.result["n_matches"],
+                            score=round(score, 6))
+        row = {"pano": label, "ok": True, "score": score,
+               "n_matches": int(br.result["n_matches"]),
+               "latency_ms": round(leg_s * 1e3, 3)}
+        tag = br.extra.get("rescache")
+        if tag is not None:
+            row["rescache"] = tag
+        panos_out.append(row)
+        ok_rows.append((idx, score, table, row))
+
+    # Redispatched legs during THIS fan-out window (the counter is
+    # fleet-wide, so concurrent traffic can inflate the delta — the
+    # trace's redispatch spans are the per-query record of truth).
+    redispatched = 0
+    if server.dispatcher is not None:
+        redispatched = max(0, int(
+            obs.counter("serving.redispatched",
+                        labels=getattr(server.dispatcher, "labels", {})
+                        ).value - redisp0))
+        if redispatched:
+            obs.counter("serving.localize.redispatched",
+                        labels=labels).inc(redispatched)
+
+    # Rank by descending consensus mass, score ties broken by input
+    # index (stable + canonical tables upstream = reproducible ranks).
+    ok_rows.sort(key=lambda r: (-r[1], r[0]))
+    top_k = int(request.get("top_k", 0) or 0)
+    ranked_rows = ok_rows[:top_k] if top_k > 0 else ok_rows
+    include_matches = bool(request.get("include_matches"))
+    ranked = []
+    for rank, (idx, score, table, row) in enumerate(ranked_rows):
+        entry = {"rank": rank, "index": idx, "pano": row["pano"],
+                 "score": score, "n_matches": row["n_matches"]}
+        if include_matches:
+            entry["matches"] = np.asarray(table).tolist()
+        ranked.append(entry)
+
+    n_ok = len(ok_rows)
+    e2e_s = time.monotonic() - t0
+    payload = {
+        "panos": panos_out,
+        "ranked": ranked,
+        "fanout_width": n,
+        "n_ok": n_ok,
+        "n_failed": n - n_ok,
+        "redispatched": redispatched,
+        "trace_id": root.trace_id,
+        "latency_ms": round(e2e_s * 1e3, 3),
+    }
+    if n_ok:
+        return 200, payload, None
+    # Every leg failed: answer with the shortlist's collective verdict —
+    # non-retryable failures dominate (a retry resends the same poison),
+    # else the whole query is retryable service pressure.
+    kinds = {p["kind"] for p in panos_out if not p["ok"]}
+    if kinds <= {"bad_request"}:
+        payload.update(error="every pano in the shortlist was rejected",
+                       kind="bad_request")
+        return 400, payload, None
+    if "internal" in kinds:
+        payload.update(error="all panos failed", kind="internal")
+        return 500, payload, None
+    if "poison_request" in kinds or "deadline_exceeded" in kinds:
+        code = 422 if "poison_request" in kinds else 504
+        payload.update(error="all panos failed",
+                       kind=("poison_request" if code == 422
+                             else "deadline_exceeded"))
+        return code, payload, None
+    payload.update(error="all panos refused", kind="over_capacity",
+                   retry_after_s=1.0)
+    return 503, payload, {"Retry-After": "1"}

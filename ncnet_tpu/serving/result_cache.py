@@ -1,0 +1,433 @@
+"""Content-addressed match-result cache with single-flight coalescing.
+
+The pano feature store (serving/feature_store.py) removes the backbone
+cost of a repeated pano; this layer removes the WHOLE dispatch for a
+repeated (query, pano, operating point) triple. Localization traffic is
+exactly that shape: the InLoc shortlist replay repeats pano sets across
+queries at a measured 44-62% hit-rate (docs/NEXT.md), and a fleet
+serving million-user localization sees the same query image fanned out
+against the same shortlist again and again — at scale the cheapest
+match is the one never dispatched.
+
+Keying (content-addressed, never path-addressed):
+
+  key = (digest_query, digest_pano, op_key)
+
+where the digests come from :func:`serving.feature_store.content_digest`
+— the same image yields one digest whether it arrives as a path or an
+inline ``*_b64`` body — and ``op_key`` is the engine's
+:meth:`~ncnet_tpu.serving.engine.MatchEngine.result_op_key`: every knob
+besides the two image contents that shapes the match table (mode, c2f
+operating point, max_matches, resize bucket policy, extraction
+direction flags). The ``model_key`` ctor arg joins the persistent key
+the same way it does for the feature cache, so a shared disk dir can
+never serve tables across weights.
+
+Storage mirrors evals/feature_cache.py: a byte-bounded memory LRU over
+bf16 match tables plus an optional disk tier with atomic
+tmp+``os.replace`` writes under an advisory flock. Tables are stored in
+bf16 and served as ``float32(bf16(table))`` — the MISS that populates
+an entry returns the same rounded table, so a later hit is bitwise
+identical to the response that created it (the rung-0 comparator
+contract, evals/agreement.py).
+
+**Single-flight coalescing**: concurrent identical requests share ONE
+in-flight computation. The first requester for a key becomes the
+leader and dispatches; every concurrent duplicate becomes a follower
+parked on the leader's Future. K identical concurrent requests cost
+exactly one engine dispatch (counter-asserted in tests); a failed
+leader wakes its followers with the same exception — identical inputs,
+identical verdict, and the server's existing error ladder maps it.
+
+:class:`ResultCachingSubmitter` packages the whole protocol behind the
+batcher/dispatcher ``submit()`` surface, so the server's match handler
+and the localize fan-out consult the cache without new control flow:
+hits resolve immediately, followers ride the leader, and the
+``BatchResult.extra["rescache"]`` tag ("hit" | "miss" | "coalesced")
+tells the response builder what happened.
+
+Metrics: ``serving.rescache.{hits,misses,coalesced,stores,disk_hits}``
+counters + ``serving.rescache.bytes`` gauge (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import base64
+import contextlib
+import dataclasses
+import hashlib
+import os
+import threading
+import uuid
+import zipfile
+from collections import OrderedDict
+from concurrent.futures import Future
+from typing import Optional, Tuple
+
+import ml_dtypes  # ships with jax
+import numpy as np
+
+from .. import obs
+from .batcher import BatchResult
+from .feature_store import content_digest
+
+
+def request_digests(request: dict, store=None) -> Tuple[str, str]:
+    """(query_digest, pano_digest) for a /v1/match-shaped body.
+
+    Call AFTER a successful ``engine.prepare`` — the images are then
+    known decodable. Inline ``*_b64`` bodies hash their raw bytes;
+    paths go through the store's memoized digest when one is attached
+    (``SharedFeatureStore.content_digest``), else stream-hash directly,
+    falling back to the literal path on an unreadable file (matching
+    the feature store's key fallback).
+    """
+
+    def one(path, b64):
+        if b64:
+            return content_digest(base64.b64decode(b64))
+        if store is not None and hasattr(store, "content_digest"):
+            return store.content_digest(path)
+        try:
+            return content_digest(path)
+        except OSError:
+            return str(path)
+
+    return (
+        one(request.get("query_path"), request.get("query_b64")),
+        one(request.get("pano_path"), request.get("pano_b64")),
+    )
+
+
+class MatchResultCache:
+    """Byte-bounded LRU of bf16 match tables + disk tier + single-flight.
+
+    Thread-safe. ``lookup_or_begin`` is the one entry point a request
+    path needs; ``complete``/``abandon`` close a leader's flight.
+    """
+
+    def __init__(self, max_bytes: int, disk_dir: Optional[str] = None,
+                 model_key: str = "", labels=None):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        self.max_bytes = int(max_bytes)
+        self.disk_dir = disk_dir
+        self.model_key = model_key
+        self.labels = dict(labels or {})
+        self._lru: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        # In-flight table: key -> the leader's Future. Guarded by its
+        # own lock so a long disk probe cannot stall completions.
+        self._flights: dict = {}
+        self._flight_lock = threading.Lock()
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+
+    # -- keying -----------------------------------------------------------
+
+    def key(self, digest_a: str, digest_b: str, op_key: tuple) -> tuple:
+        return (self.model_key, digest_a, digest_b, tuple(op_key))
+
+    @staticmethod
+    def _hash(key: tuple) -> str:
+        return hashlib.sha1(repr(key).encode()).hexdigest()
+
+    def _disk_path(self, key: tuple) -> str:
+        # res1_: bf16-as-uint16 npz (the feature cache's feat2_ format
+        # versioning rule — a future entry-format change bumps the
+        # prefix instead of corrupting old readers).
+        return os.path.join(self.disk_dir, f"res1_{self._hash(key)}.npz")
+
+    # -- canonical rounding ------------------------------------------------
+
+    @staticmethod
+    def canonical(table: np.ndarray) -> np.ndarray:
+        """The table as every cache consumer sees it: f32 view of the
+        bf16 entry. The populating miss returns THIS, so hits replay it
+        bitwise."""
+        return np.asarray(table).astype(ml_dtypes.bfloat16).astype(
+            np.float32)
+
+    # -- disk tier (evals/feature_cache.py idiom) -------------------------
+
+    @contextlib.contextmanager
+    def _disk_lock(self):
+        """Advisory flock over compound disk mutations (see
+        feature_cache._disk_lock; single writes are already atomic)."""
+        if not self.disk_dir:
+            yield
+            return
+        fh = None
+        try:
+            import fcntl
+
+            fh = open(os.path.join(self.disk_dir, ".rescache.lock"), "a+b")
+            fcntl.flock(fh, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            if fh is not None:
+                fh.close()
+                fh = None
+        try:
+            yield
+        finally:
+            if fh is not None:
+                try:
+                    import fcntl
+
+                    fcntl.flock(fh, fcntl.LOCK_UN)
+                except (ImportError, OSError):
+                    pass
+                fh.close()
+
+    def _disk_write(self, path: str, table_bf16: np.ndarray) -> bool:
+        # Unique tmp + os.replace: a killed run must not leave a
+        # truncated npz, and two writers (prewarm sweep x live server)
+        # must not publish each other's half-written file.
+        tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                np.savez(fh, table=table_bf16.view(np.uint16),
+                         dtype="bfloat16")
+            os.replace(tmp, path)
+            return True
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+    def _disk_read(self, key: tuple) -> Optional[np.ndarray]:
+        if not self.disk_dir:
+            return None
+        path = self._disk_path(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path) as z:
+                t = z["table"]
+                if "dtype" in z and str(z["dtype"][()]) == "bfloat16":
+                    t = t.view(ml_dtypes.bfloat16)
+                return np.asarray(t)
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            return None  # partial/corrupt file: a miss, not a crash
+
+    # -- memory tier -------------------------------------------------------
+
+    def _store_mem(self, key: tuple, table_bf16: np.ndarray) -> None:
+        if table_bf16.nbytes > self.max_bytes:
+            return  # bigger than the whole budget: disk-only (if any)
+        with self._lock:
+            if key in self._lru:
+                return
+            self._lru[key] = table_bf16
+            self._bytes += table_bf16.nbytes
+            while self._bytes > self.max_bytes and len(self._lru) > 1:
+                _, old = self._lru.popitem(last=False)
+                self._bytes -= old.nbytes
+            obs.gauge("serving.rescache.bytes",
+                      labels=self.labels).set(float(self._bytes))
+
+    def _probe(self, key: tuple) -> Optional[np.ndarray]:
+        """Memory then disk; disk hits promote into the LRU. Returns the
+        bf16 entry (not yet widened)."""
+        with self._lock:
+            t = self._lru.get(key)
+            if t is not None:
+                self._lru.move_to_end(key)
+                return t
+        t = self._disk_read(key)
+        if t is not None:
+            obs.counter("serving.rescache.disk_hits",
+                        labels=self.labels).inc()
+            self._store_mem(key, t)
+        return t
+
+    # -- request protocol --------------------------------------------------
+
+    def lookup_or_begin(self, key: tuple):
+        """One atomic step of the request protocol. Returns one of::
+
+            ("hit", np.ndarray)     # canonical f32 table, respond now
+            ("leader", Future)      # you dispatch; complete()/abandon()
+            ("follower", Future)    # park on the leader's Future
+
+        The flight probe and the cache probe run under one lock so a
+        leader completing between a caller's miss and its begin cannot
+        strand the caller on a fresh needless dispatch.
+        """
+        with self._flight_lock:
+            fl = self._flights.get(key)
+            if fl is not None:
+                obs.counter("serving.rescache.coalesced",
+                            labels=self.labels).inc()
+                return "follower", fl
+            t = self._probe(key)
+            if t is not None:
+                obs.counter("serving.rescache.hits",
+                            labels=self.labels).inc()
+                return "hit", t.astype(np.float32)
+            obs.counter("serving.rescache.misses",
+                        labels=self.labels).inc()
+            fl = Future()
+            self._flights[key] = fl
+            return "leader", fl
+
+    def get(self, key: tuple) -> Optional[np.ndarray]:
+        """Plain probe (no flight bookkeeping): canonical f32 table or
+        None. Counts a hit/miss — prewarm probes and tools use this."""
+        t = self._probe(key)
+        if t is None:
+            obs.counter("serving.rescache.misses",
+                        labels=self.labels).inc()
+            return None
+        obs.counter("serving.rescache.hits", labels=self.labels).inc()
+        return t.astype(np.float32)
+
+    def put(self, key: tuple, table: np.ndarray) -> np.ndarray:
+        """Store a table (memory + disk); returns the canonical f32
+        round-trip the caller must respond/continue with."""
+        t16 = np.ascontiguousarray(
+            np.asarray(table).astype(ml_dtypes.bfloat16))
+        if self.disk_dir:
+            path = self._disk_path(key)
+            with self._disk_lock():
+                if not os.path.exists(path):
+                    self._disk_write(path, t16)
+        self._store_mem(key, t16)
+        obs.counter("serving.rescache.stores", labels=self.labels).inc()
+        return t16.astype(np.float32)
+
+    def complete(self, key: tuple, table: np.ndarray) -> np.ndarray:
+        """Leader success: store, wake followers with the canonical
+        table, return it for the leader's own response."""
+        out = self.put(key, table)
+        with self._flight_lock:
+            fl = self._flights.pop(key, None)
+        if fl is not None and not fl.done():
+            fl.set_result(out)
+        return out
+
+    def abandon(self, key: tuple, exc: BaseException) -> None:
+        """Leader failure: wake followers with the leader's exception
+        (identical inputs fail identically; the server's error ladder
+        maps it per-follower). The key stays uncached — the next
+        request starts a fresh flight."""
+        with self._flight_lock:
+            fl = self._flights.pop(key, None)
+        if fl is not None and not fl.done():
+            fl.set_exception(exc)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def stats(self) -> str:
+        h = obs.counter("serving.rescache.hits", labels=self.labels).value
+        m = obs.counter("serving.rescache.misses",
+                        labels=self.labels).value
+        total = h + m
+        pct = 100.0 * h / total if total else 0.0
+        return (f"match-result cache: {h:.0f}/{total:.0f} hits "
+                f"({pct:.0f}%), {len(self._lru)} entries / "
+                f"{self._bytes / 1e6:.1f} MB in memory")
+
+
+class ResultCachingSubmitter:
+    """The cache protocol behind the ``submit()`` surface.
+
+    Wraps a batcher/dispatcher submit target. A rider whose
+    ``prepared.meta["rescache_key"]`` is set consults the cache:
+
+    * hit — the returned Future is already resolved with a synthesized
+      :class:`BatchResult` (``extra["rescache"] = "hit"``, zero queue
+      wait, no dispatch);
+    * miss — the rider dispatches through the inner target as the
+      key's single-flight LEADER; its result is stored and rounded
+      canonical before the Future resolves (``"miss"``);
+    * coalesced — an identical rider is already in flight; the Future
+      parks on the leader's and resolves with the same canonical table
+      (``"coalesced"``), or the leader's exception.
+
+    Riders without a key (no cache key derivable, sessions, shadow
+    re-runs) pass straight through.
+    """
+
+    def __init__(self, cache: MatchResultCache, inner):
+        self.cache = cache
+        self.inner = inner
+
+    def submit(self, bucket_key, prepared, timeout_s=None, tenant=None,
+               **kw) -> Future:
+        meta = prepared.meta
+        key = meta.get("rescache_key") if meta else None
+        if key is None:
+            return self.inner.submit(bucket_key, prepared,
+                                     timeout_s=timeout_s, tenant=tenant,
+                                     **kw)
+        verdict, val = self.cache.lookup_or_begin(key)
+        if verdict == "hit":
+            out: Future = Future()
+            out.set_result(BatchResult(
+                result={"matches": val, "n_matches": int(val.shape[0])},
+                batch_size=1, queue_wait_s=0.0, run_s=0.0,
+                extra={"rescache": "hit"}))
+            return out
+        if verdict == "follower":
+            out = Future()
+
+            def _adopt(fl: Future, _out=out):
+                exc = fl.exception()
+                if exc is not None:
+                    _out.set_exception(exc)
+                    return
+                t = fl.result()
+                _out.set_result(BatchResult(
+                    result={"matches": t, "n_matches": int(t.shape[0])},
+                    batch_size=1, queue_wait_s=0.0, run_s=0.0,
+                    extra={"rescache": "coalesced"}))
+
+            val.add_done_callback(_adopt)
+            return out
+        # Leader: dispatch, then publish through the flight. The inner
+        # submit itself can refuse (queue full, no healthy replica) —
+        # the flight must be abandoned on THAT path too, or followers
+        # hang for their full deadline on a dispatch that never ran.
+        try:
+            fut = self.inner.submit(bucket_key, prepared,
+                                    timeout_s=timeout_s, tenant=tenant,
+                                    **kw)
+        except BaseException as exc:
+            self.cache.abandon(key, exc)
+            raise
+        out = Future()
+
+        def _publish(inner_fut: Future, _out=out, _key=key):
+            exc = inner_fut.exception()
+            if exc is not None:
+                self.cache.abandon(_key, exc)
+                _out.set_exception(exc)
+                return
+            br = inner_fut.result()
+            table = self.cache.complete(_key, br.result["matches"])
+            res = dict(br.result)
+            res["matches"] = table
+            res["n_matches"] = int(table.shape[0])
+            extra = dict(br.extra)
+            extra["rescache"] = "miss"
+            _out.set_result(dataclasses.replace(
+                br, result=res, extra=extra))
+
+        fut.add_done_callback(_publish)
+        return out
+
+    def __getattr__(self, name):
+        # Everything that is not submit() (admit, depth, close, find,
+        # healthy...) belongs to the wrapped target.
+        return getattr(self.inner, name)

@@ -57,6 +57,7 @@ from .batcher import (
     ReplicaDeadError,
 )
 from .engine import MatchEngine
+from .result_cache import ResultCachingSubmitter, request_digests
 from .session import SessionCapError, SessionLostError, SessionManager
 from .shadow import ShadowSampler
 from .qos import (
@@ -121,6 +122,7 @@ class MatchServer:
         shadow_low_water_frac: float = 0.25,
         shadow_executor=None,
         trace_sample_rate: Optional[float] = None,
+        result_cache=None,
     ):
         """``fleet``: a started-or-startable serving/fleet.MatchFleet.
         When set, the server fronts the fleet's dispatcher instead of
@@ -192,6 +194,21 @@ class MatchServer:
                 labels=self.labels,
             )
             self.dispatcher = None
+        # Content-addressed match-result cache (serving/result_cache.py):
+        # wrapping the submit target — instead of threading hit/miss
+        # branches through the handler ladder — keeps /v1/match,
+        # /v1/localize fan-out legs, and the future-shaped error paths
+        # identical whether an answer came from the device or the cache.
+        # Work without a rescache key (session frames, shadow re-runs,
+        # undigestable inputs) passes through untouched.
+        self.rescache = result_cache
+        raw_target = self.dispatcher if fleet is not None else self.batcher
+        if result_cache is not None:
+            if self.labels and not getattr(result_cache, "labels", None):
+                result_cache.labels = dict(self.labels)
+            self.submitter = ResultCachingSubmitter(result_cache, raw_target)
+        else:
+            self.submitter = raw_target
         # Standing SLOs (obs/slo.py), evaluated lazily on /healthz and
         # /metrics reads behind a 1 s floor — no extra thread, and a
         # scrape storm cannot turn burn math into load. slo_specs=()
@@ -333,6 +350,8 @@ class MatchServer:
             def do_POST(self):  # noqa: N802
                 if self.path == "/v1/match":
                     code, payload, headers = server.handle_match(self)
+                elif self.path == "/v1/localize":
+                    code, payload, headers = server.handle_localize(self)
                 elif self.path == "/v1/session":
                     code, payload, headers = server.handle_session_open(self)
                 else:
@@ -692,11 +711,22 @@ class MatchServer:
             except ValueError as exc:
                 obs.counter("serving.bad_requests", labels=self.labels).inc()
                 return 400, {"error": str(exc)}, None
+            if self.rescache is not None:
+                # Content digests AFTER prepare (the images are proven
+                # decodable); the op key already reflects any QoS rung
+                # rewrite, so degraded tables key separately from full
+                # quality. Undigestable inputs just serve uncached.
+                try:
+                    dq, dp = request_digests(
+                        request, store=getattr(self.engine, "cache", None))
+                    prepared.meta = dict(prepared.meta or {})
+                    prepared.meta["rescache_key"] = self.rescache.key(
+                        dq, dp, self.engine.result_op_key(prepared))
+                except (OSError, ValueError, TypeError):
+                    pass
         admit_s = time.monotonic() - t_admit
-        submitter = (self.dispatcher if self.fleet is not None
-                     else self.batcher)
         try:
-            fut = submitter.submit(
+            fut = self.submitter.submit(
                 prepared.bucket_key, prepared, timeout_s=timeout_s,
                 tenant=tenant,
             )
@@ -802,6 +832,9 @@ class MatchServer:
                 "run_ms": round(br.run_s * 1e3, 3),
                 "trace_id": root.trace_id,
             }
+            rescache_tag = br.extra.get("rescache")
+            if rescache_tag is not None:
+                payload["rescache"] = rescache_tag
         respond_s = time.monotonic() - t_respond
         e2e_s = time.monotonic() - t0
         payload["latency_ms"] = round(e2e_s * 1e3, 3)
@@ -868,15 +901,147 @@ class MatchServer:
                 survivors=(br.result.get("quality")
                            or {}).get("survivors"),
                 trace_id=root.trace_id, labels=self.labels)
-        if self.shadow is not None:
+        if self.shadow is not None and rescache_tag in (None, "miss"):
             # Degraded rungs measure the quality cost; rung 0 is the
             # bitwise-determinism control. The sampler's own budget and
-            # low-water gate bound the extra load.
+            # low-water gate bound the extra load. Cache hits and
+            # coalesced riders replay an already-shadowable dispatch —
+            # re-offering them would double-count the same table.
             self.shadow.offer(
                 baseline_request, br.result["matches"], rung=rung,
                 endpoint="v1_match", tenant=tenant,
                 trace_id=root.trace_id)
         return 200, payload, None
+
+    # -- localization fan-out (docs/SERVING.md) ---------------------------
+
+    def handle_localize(self, handler):
+        """``POST /v1/localize``: one query against a pano shortlist,
+        fanned out across the fleet and gathered into a consensus-mass
+        ranking (serving/localize.py). Same trace + failpoint envelope
+        as ``handle_match``; per-pano legs land as children of this
+        request root."""
+        with trace.trace("request", parent=self._wire_parent(handler),
+                         kind="server") as root:
+            try:
+                failpoints.fire("server.handle")
+            except InjectedFault as exc:
+                obs.counter(
+                    "serving.errors",
+                    labels={**self.labels, "kind": "injected_fault"}).inc()
+                return self._force_errors(root, (
+                    500, {"error": str(exc), "kind": "injected_fault"},
+                    None))
+            return self._force_errors(
+                root, self._handle_localize_traced(handler, root))
+
+    def _handle_localize_traced(self, handler, root):
+        from . import localize as _localize
+
+        obs.counter("serving.requests", labels=self.labels).inc()
+        # The admission stack is the match handler's, applied ONCE per
+        # query (not per leg): the shortlist is one client ask, so one
+        # tenant-budget token and one QoS verdict cover all N legs —
+        # per-leg queue-slot fairness still applies inside the batchers.
+        tenant, priority, err = self._resolve_tenant(handler)
+        if err is not None:
+            return err
+        retry_in = (self.dispatcher.admit() if self.fleet is not None
+                    else self.breaker.admit())
+        if retry_in is not None:
+            obs.counter("serving.breaker_rejected", labels=self.labels).inc()
+            return (
+                503,
+                {"error": "service degraded (circuit breaker open)",
+                 "kind": "breaker_open",
+                 "retry_after_s": round(retry_in, 3)},
+                {"Retry-After": f"{retry_in:.3f}"},
+            )
+        decision = None
+        if self.qos is not None:
+            self.qos.update()
+            decision = self.qos.resolve(priority or "interactive")
+            if decision.shed:
+                obs.counter(
+                    "serving.qos.shed",
+                    labels={**self.labels,
+                            "priority": priority or "interactive"}).inc()
+                return (
+                    503,
+                    {"error": "shedding %s traffic (overload)"
+                     % (priority or "interactive"),
+                     "kind": "shed", "qos_rung": decision.position,
+                     "retry_after_s": decision.retry_after_s},
+                    {"Retry-After": f"{decision.retry_after_s:.3f}"},
+                )
+        with trace.span("admit"):
+            try:
+                length = int(handler.headers.get("Content-Length", 0))
+                request = json.loads(handler.rfile.read(length) or b"{}")
+            except (ValueError, OSError) as exc:
+                obs.counter("serving.bad_requests", labels=self.labels).inc()
+                return 400, {"error": f"malformed request: {exc}"}, None
+            timeout_s = None
+            if request.get("deadline_ms") is not None:
+                try:
+                    timeout_s = max(
+                        float(request["deadline_ms"]) / 1000.0, 1e-3)
+                except (TypeError, ValueError):
+                    obs.counter("serving.bad_requests",
+                                labels=self.labels).inc()
+                    return (400, {"error": "deadline_ms must be a number"},
+                            None)
+            if decision is not None and decision.rung is not None:
+                # One rung rewrite covers every leg — the shortlist
+                # degrades as a unit, so its ranking stays comparable
+                # across panos (mixed rungs would skew consensus mass).
+                decision.apply(request)
+                obs.counter("serving.qos.degraded",
+                            labels=self.labels).inc()
+        try:
+            code, payload, headers = _localize.fan_out(
+                self, request, root, timeout_s, tenant)
+        except ValueError as exc:  # shortlist/schema shape
+            obs.counter("serving.bad_requests", labels=self.labels).inc()
+            return 400, {"error": str(exc)}, None
+        except Exception as exc:  # noqa: BLE001 — structured 500, always
+            obs.counter("serving.errors",
+                        labels={**self.labels, "kind": "internal"}).inc()
+            obs.event("request_error",
+                      error=f"{type(exc).__name__}: {exc}")
+            return (500, {"error": f"{type(exc).__name__}: {exc}",
+                          "kind": "internal"}, None)
+        if decision is not None:
+            payload["qos"] = {"rung": decision.position,
+                              "degraded": decision.rung is not None}
+        e2e_s = payload.get("latency_ms", 0.0) / 1e3
+        if code == 200:
+            obs.counter("serving.responses", labels=self.labels).inc()
+            if tenant is not None:
+                obs.counter(
+                    "serving.tenant.responses",
+                    labels={**self.labels, "tenant": tenant,
+                            "priority": priority}).inc()
+                obs.histogram(
+                    "serving.tenant.e2e_latency_s",
+                    labels={**self.labels, "tenant": tenant}).observe(e2e_s)
+            obs.histogram("serving.e2e_latency_s",
+                          labels=self.labels).observe(
+                              e2e_s, trace_id=root.trace_id,
+                              sampled=root.sampled)
+            exemplar.observe_request(
+                "v1_localize", e2e_s,
+                root.trace_id if root.sampled else None,
+                threshold_s=self.slo_p99_target_s, labels=self.labels)
+        obs.event(
+            "localize",
+            n_panos=payload.get("fanout_width"),
+            n_ok=payload.get("n_ok"),
+            redispatched=payload.get("redispatched"),
+            e2e_s=round(e2e_s, 6),
+            trace_id=root.trace_id,
+        )
+        return code, payload, headers
 
     # -- streaming sessions (docs/SERVING.md, "Streaming sessions") -------
 
@@ -1526,6 +1691,17 @@ def main(argv=None):
     parser.add_argument("--cache_mb", type=int, default=2048,
                         help="pano feature cache budget (0 disables)")
     parser.add_argument("--cache_dir", type=str, default="")
+    parser.add_argument("--rescache_mb", type=int, default=0,
+                        help="content-addressed match-RESULT cache "
+                        "memory budget in MB (0 disables): repeated "
+                        "(query, pano, operating point) triples answer "
+                        "from cache instead of dispatching, and "
+                        "concurrent identical requests coalesce onto "
+                        "one in-flight computation (docs/SERVING.md)")
+    parser.add_argument("--rescache_dir", type=str, default="",
+                        help="match-result cache disk tier (sharable "
+                        "across replicas/restarts; prewarm it with "
+                        "tools/bulk_match.py --prewarm-results)")
     parser.add_argument(
         "--prewarm", action="append", default=[],
         help="glob of server-readable pano paths to probe against the "
@@ -1733,6 +1909,19 @@ def main(argv=None):
     if armed:
         print(f"failpoints armed: {sorted(armed)}", file=sys.stderr,
               flush=True)
+    result_cache = None
+    if args.rescache_mb > 0:
+        from .result_cache import MatchResultCache
+
+        # "|res" keeps result entries distinct from feature entries
+        # should the two tiers ever share a model-key namespace; the
+        # weights identity itself is the same derivation as the
+        # feature cache's.
+        result_cache = MatchResultCache(
+            args.rescache_mb * 1024 * 1024,
+            disk_dir=args.rescache_dir or None,
+            model_key=model_cache_key(args.checkpoint, seed=1) + "|res",
+        )
     server = MatchServer(
         engine,
         host=args.host,
@@ -1762,6 +1951,7 @@ def main(argv=None):
         shadow_tau_px=args.shadow_tau_px,
         shadow_low_water_frac=args.shadow_low_water_frac,
         trace_sample_rate=args.trace_sample_rate,
+        result_cache=result_cache,
     ).start()
     print(f"serving on {server.url}", file=sys.stderr, flush=True)
     try:

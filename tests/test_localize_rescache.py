@@ -1,0 +1,488 @@
+"""Localization-as-a-service + match-result cache acceptance (ISSUE 17).
+
+* content addressing: one digest for one image regardless of arrival
+  form (two paths, inline b64) — the cache can never double-store or
+  path-alias an entry;
+* the result cache's storage contract: bf16 canonical rounding, disk
+  round-trip across cache instances, model-key namespacing, corrupt
+  files read as misses, the byte-bounded LRU;
+* single-flight coalescing: leader/follower/abandon protocol at the
+  unit level, and the e2e proof — K concurrent identical /v1/match
+  requests cost EXACTLY one engine dispatch (counter-asserted) and the
+  cache-hit response is bitwise identical to the populating miss
+  (evals/agreement.py comparator);
+* /v1/localize fan-out: a 2-replica CPU fleet serves one query's
+  shortlist legs on BOTH replicas (labeled admitted-counter deltas),
+  every shortlist pano comes back as a row, ranking is by descending
+  consensus mass, and a replayed shortlist answers from cache;
+* deterministic ranking inputs: evals/inloc.dedup_matches breaks score
+  ties canonically, so two permutations of the same device output
+  produce bitwise-identical tables;
+* tool contracts: bulk_match --prewarm-results (resumable disk-tier
+  populator), bench_trend pass-through of the localize-bench fields,
+  fleet_status's resc%% column math, ci_gate's localize_smoke
+  skip-record.
+
+The chaos gate (--localize_fanout) and localize bench contracts live
+with their siblings' style here too, end-to-end and in-process.
+"""
+
+import base64
+import io
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.evals.agreement import match_table_agreement
+from ncnet_tpu.serving.feature_store import (
+    SharedFeatureStore,
+    content_digest,
+)
+from ncnet_tpu.serving.result_cache import (
+    MatchResultCache,
+    request_digests,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _jpeg_bytes(h, w, seed):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    img = Image.fromarray((rng.random((h, w, 3)) * 255).astype("uint8"))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+# -- content addressing ----------------------------------------------------
+
+
+def test_content_digest_one_image_one_digest(tmp_path):
+    """The same image bytes under two different paths AND as an inline
+    b64 body must key ONE cache entry."""
+    raw = _jpeg_bytes(32, 48, 0)
+    p1 = tmp_path / "a.jpg"
+    p2 = tmp_path / "nested" / "b.jpg"
+    p2.parent.mkdir()
+    p1.write_bytes(raw)
+    p2.write_bytes(raw)
+
+    store = SharedFeatureStore(1 << 20)
+    d_bytes = content_digest(raw)
+    assert d_bytes == content_digest(str(p1)) == content_digest(str(p2))
+    assert d_bytes == store.content_digest(str(p1))
+    assert d_bytes == store.content_digest(str(p2))
+    assert d_bytes == store.content_digest(raw)
+    # And through the request-shaped helper: path form == b64 form.
+    other = _jpeg_bytes(32, 48, 1)
+    (tmp_path / "pano.jpg").write_bytes(other)
+    dq1, dp1 = request_digests(
+        {"query_path": str(p1), "pano_path": str(tmp_path / "pano.jpg")},
+        store=store)
+    dq2, dp2 = request_digests(
+        {"query_b64": base64.b64encode(raw).decode(),
+         "pano_b64": base64.b64encode(other).decode()})
+    assert (dq1, dp1) == (dq2, dp2)
+    assert dq1 == d_bytes
+    assert dp1 != dq1  # different content, different digest
+
+
+# -- deterministic score-tie ranking inputs --------------------------------
+
+
+def test_dedup_matches_breaks_score_ties_canonically():
+    """Two score-sorted permutations of the same rows (the device sort
+    only orders by score, so tied rows arrive in any order) must dedup
+    to bitwise-identical tables — the content-addressed cache and the
+    rung-0 bitwise shadow contract both depend on it."""
+    from ncnet_tpu.evals.inloc import dedup_matches
+
+    xa = np.array([3.0, 1.0, 2.0, 1.0], np.float32)
+    ya = np.array([0.0, 5.0, 4.0, 5.0], np.float32)
+    xb = np.array([7.0, 6.0, 8.0, 6.0], np.float32)
+    yb = np.array([9.0, 2.0, 3.0, 2.0], np.float32)
+    score = np.array([0.5, 0.5, 0.5, 0.5], np.float32)  # all tied
+
+    out_fwd = dedup_matches(xa, ya, xb, yb, score)
+    perm = np.array([2, 0, 3, 1])  # still descending-score-sorted
+    out_perm = dedup_matches(xa[perm], ya[perm], xb[perm], yb[perm],
+                             score[perm])
+    for a, b in zip(out_fwd, out_perm):
+        np.testing.assert_array_equal(a, b)
+    # The duplicate row (index 1 == index 3) collapsed.
+    assert out_fwd[0].shape[0] == 3
+    # Ties ordered by the lexicographic coordinate row.
+    coords = np.stack(out_fwd[:4], axis=1)
+    assert [tuple(r) for r in coords] == sorted(tuple(r) for r in coords)
+
+
+# -- result cache unit contracts -------------------------------------------
+
+
+def _table(n, seed):
+    rng = np.random.default_rng(seed)
+    # Values dense in the mantissa so bf16 rounding is OBSERVABLE.
+    return (rng.random((n, 5)) * 7.0 + 0.1).astype(np.float32)
+
+
+def test_result_cache_bf16_disk_roundtrip(tmp_path):
+    cache = MatchResultCache(1 << 20, disk_dir=str(tmp_path),
+                             model_key="mk")
+    t = _table(16, 0)
+    key = cache.key("dq", "dp", ("mode", 8))
+    out = cache.put(key, t)
+    want = cache.canonical(t)
+    np.testing.assert_array_equal(out, want)
+    assert not np.array_equal(out, t), "bf16 rounding must be real"
+
+    # A fresh instance over the same dir (restarted server) serves the
+    # SAME canonical table from the disk tier.
+    cache2 = MatchResultCache(1 << 20, disk_dir=str(tmp_path),
+                              model_key="mk")
+    disk0 = obs.counter("serving.rescache.disk_hits").value
+    got = cache2.get(key)
+    np.testing.assert_array_equal(got, want)
+    assert obs.counter("serving.rescache.disk_hits").value == disk0 + 1
+    # ...and now from memory (promoted), not disk.
+    got2 = cache2.get(key)
+    np.testing.assert_array_equal(got2, want)
+    assert obs.counter("serving.rescache.disk_hits").value == disk0 + 1
+
+    # A different model key is a different namespace: the same digest
+    # triple keys a different entry, so no cross-serve.
+    other = MatchResultCache(1 << 20, disk_dir=str(tmp_path),
+                             model_key="other-weights")
+    assert other.get(other.key("dq", "dp", ("mode", 8))) is None
+
+    # Corrupt entry file: a miss, never a crash.
+    [path] = [os.path.join(tmp_path, f) for f in os.listdir(tmp_path)
+              if f.startswith("res1_")]
+    with open(path, "wb") as fh:
+        fh.write(b"not an npz")
+    fresh = MatchResultCache(1 << 20, disk_dir=str(tmp_path),
+                             model_key="mk")
+    assert fresh.get(key) is None
+
+
+def test_result_cache_lru_stays_byte_bounded():
+    # bf16 entries: 100 x 5 x 2 bytes = 1000 B each; budget fits two.
+    cache = MatchResultCache(2500)
+    k = [cache.key("q", f"p{i}", ("op",)) for i in range(3)]
+    cache.put(k[0], _table(100, 0))
+    cache.put(k[1], _table(100, 1))
+    assert cache.get(k[0]) is not None  # k0 is now most-recent
+    cache.put(k[2], _table(100, 2))
+    assert cache.nbytes <= 2500
+    assert len(cache) == 2
+    assert cache.get(k[1]) is None, "LRU victim was the cold entry"
+    assert cache.get(k[0]) is not None
+    assert cache.get(k[2]) is not None
+
+
+def test_result_cache_single_flight_protocol():
+    cache = MatchResultCache(1 << 20)
+    key = cache.key("a", "b", ("op",))
+    co0 = obs.counter("serving.rescache.coalesced").value
+
+    verdict, fut = cache.lookup_or_begin(key)
+    assert verdict == "leader"
+    verdict2, fut2 = cache.lookup_or_begin(key)
+    assert verdict2 == "follower" and fut2 is fut
+    assert obs.counter("serving.rescache.coalesced").value == co0 + 1
+
+    t = _table(8, 3)
+    out = cache.complete(key, t)
+    np.testing.assert_array_equal(out, cache.canonical(t))
+    np.testing.assert_array_equal(fut2.result(timeout=5), out)
+    verdict3, val3 = cache.lookup_or_begin(key)
+    assert verdict3 == "hit"
+    np.testing.assert_array_equal(val3, out)
+
+    # Abandon: followers get the leader's exception, the key stays
+    # uncached, and the NEXT requester starts a fresh flight.
+    key2 = cache.key("a", "c", ("op",))
+    assert cache.lookup_or_begin(key2)[0] == "leader"
+    _, f_follow = cache.lookup_or_begin(key2)
+    cache.abandon(key2, RuntimeError("device fell over"))
+    with pytest.raises(RuntimeError, match="fell over"):
+        f_follow.result(timeout=5)
+    assert cache.lookup_or_begin(key2)[0] == "leader"
+
+
+# -- e2e: coalescing proof --------------------------------------------------
+
+
+def test_match_coalescing_one_dispatch_bitwise(tiny_serving_model):
+    """K concurrent identical /v1/match requests = EXACTLY one engine
+    dispatch (serving.batches counter delta), one populating miss, and
+    every response's table bitwise identical to it."""
+    from ncnet_tpu.serving.client import MatchClient
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    engine.warmup([(96, 128, 96, 128)], batch_sizes=(1,))
+    cache = MatchResultCache(64 * 1024 * 1024, model_key="co-test")
+    server = MatchServer(engine, port=0, max_batch=4, max_delay_s=0.01,
+                         default_timeout_s=120.0, slo_p99_target_s=60.0,
+                         result_cache=cache).start()
+    qb, pb = _jpeg_bytes(96, 128, 12), _jpeg_bytes(96, 128, 13)
+    results, errors = [], []
+    barrier = threading.Barrier(4)
+
+    def hit_once():
+        try:
+            barrier.wait(timeout=30)
+            c = MatchClient(server.url, timeout_s=120.0, retries=0)
+            results.append(c.match(query_bytes=qb, pano_bytes=pb,
+                                   max_matches=8))
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    try:
+        batches0 = obs.counter("serving.batches").value
+        threads = [threading.Thread(target=hit_once) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert len(results) == 4
+        assert obs.counter("serving.batches").value == batches0 + 1, \
+            "K identical concurrent requests must cost ONE dispatch"
+        tags = sorted(r["rescache"] for r in results)
+        assert tags.count("miss") == 1, tags
+        assert set(tags) <= {"miss", "hit", "coalesced"}, tags
+        [miss] = [r for r in results if r["rescache"] == "miss"]
+        for r in results:
+            cmp = match_table_agreement(miss["matches"], r["matches"])
+            assert cmp["bitwise"], "coalesced/hit table diverged"
+
+        # A later identical request is a memory hit — still bitwise
+        # identical to the populating miss, still zero new dispatches.
+        late = MatchClient(server.url, timeout_s=120.0, retries=0).match(
+            query_bytes=qb, pano_bytes=pb, max_matches=8)
+        assert late["rescache"] == "hit"
+        assert match_table_agreement(miss["matches"],
+                                     late["matches"])["bitwise"]
+        assert obs.counter("serving.batches").value == batches0 + 1
+    finally:
+        server.stop()
+
+
+# -- e2e: fan-out proof -----------------------------------------------------
+
+
+def test_localize_fanout_spans_both_replicas(tiny_serving_model):
+    """One /v1/localize query's shortlist legs land on BOTH replicas of
+    a 2-replica fleet (labeled serving.admitted deltas), every pano
+    comes back as a row, ranking descends by consensus mass, and a
+    replayed shortlist answers from the result cache."""
+    from ncnet_tpu.serving.client import MatchClient
+    from ncnet_tpu.serving.fleet import MatchFleet
+    from ncnet_tpu.serving.server import MatchServer
+
+    config, params = tiny_serving_model
+    fleet = MatchFleet.build(
+        config, params, n_replicas=2, base_id="lfo", cache_mb=0,
+        engine_kwargs=dict(k_size=2, image_size=64),
+        replica_kwargs=dict(max_batch=2, max_delay_s=0.01,
+                            default_timeout_s=120.0))
+    fleet.warmup([(96, 128, 96, 128)], batch_sizes=(1, 2))
+    rids = [r.replica_id for r in fleet.replicas]
+    before = {rid: obs.counter("serving.admitted",
+                               labels={"replica": rid}).value
+              for rid in rids}
+    cache = MatchResultCache(64 * 1024 * 1024, model_key="lfo-test")
+    server = MatchServer(None, port=0, fleet=fleet, result_cache=cache,
+                         slo_p99_target_s=60.0).start()
+    qb = _jpeg_bytes(96, 128, 20)
+    panos = [_jpeg_bytes(96, 128, s) for s in range(21, 25)]
+    try:
+        client = MatchClient(server.url, timeout_s=120.0, retries=0)
+        resp = client.localize(query_bytes=qb, panos=panos)
+
+        assert resp["fanout_width"] == 4
+        assert resp["n_ok"] == 4 and resp["n_failed"] == 0
+        assert len(resp["panos"]) == 4, "every shortlist pano gets a row"
+        assert all(r["ok"] for r in resp["panos"])
+        scores = [e["score"] for e in resp["ranked"]]
+        assert scores == sorted(scores, reverse=True)
+        assert [e["rank"] for e in resp["ranked"]] == [0, 1, 2, 3]
+        assert resp["trace_id"]
+        # The fan-out proof: one query's legs were admitted on BOTH
+        # replicas (the least-loaded picker spreads parallel legs).
+        deltas = {rid: obs.counter("serving.admitted",
+                                   labels={"replica": rid}).value
+                  - before[rid] for rid in rids}
+        assert all(d >= 1 for d in deltas.values()), deltas
+        assert sum(deltas.values()) == 4
+
+        # Replay the same shortlist: every leg answers from the cache
+        # (no new admissions) with the SAME ranking.
+        resp2 = client.localize(query_bytes=qb, panos=panos)
+        assert resp2["n_ok"] == 4
+        assert all(r.get("rescache") in ("hit", "coalesced")
+                   for r in resp2["panos"])
+        assert [e["score"] for e in resp2["ranked"]] == scores
+        after2 = {rid: obs.counter("serving.admitted",
+                                   labels={"replica": rid}).value
+                  - before[rid] for rid in rids}
+        assert after2 == deltas, "cache-served legs must not dispatch"
+
+        # top_k truncates the ranking but never the per-pano rows.
+        resp3 = client.localize(query_bytes=qb, panos=panos, top_k=2)
+        assert len(resp3["ranked"]) == 2
+        assert len(resp3["panos"]) == 4
+
+        # A malformed shortlist is a 400, not a hang.
+        from ncnet_tpu.serving.client import ServingError
+        with pytest.raises(ServingError):
+            client.localize(query_bytes=qb, panos=[])
+    finally:
+        server.stop()
+
+
+# -- tool contracts ---------------------------------------------------------
+
+
+def test_bulk_prewarm_results_contract(tmp_path, capsys):
+    """tools/bulk_match.py --prewarm-results: ONE JSON line, every pair
+    stored into the disk tier, and a re-run skips them all (the disk
+    tier IS the resume ledger)."""
+    import bulk_match
+
+    rc_dir = str(tmp_path / "rescache")
+    argv = ["--out_dir", str(tmp_path / "run"), "--engine", "echo",
+            "--synthetic", "6@32x48", "--prewarm-results",
+            "--rescache_dir", rc_dir, "--max_batch", "2"]
+    rc = bulk_match.main(argv)
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "bulk_prewarm_results_pairs_per_s"
+    assert rec["stored"] == 6 and rec["already_warm"] == 0
+    assert rec["failed"] == 0
+    assert any(f.startswith("res1_") for f in os.listdir(rc_dir))
+
+    rc2 = bulk_match.main(argv)
+    assert rc2 == 0
+    rec2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec2["stored"] == 0 and rec2["already_warm"] == 6
+
+
+def test_chaos_localize_fanout_contract(tiny_serving_model, capsys):
+    """tools/chaos_serving.py --localize_fanout: a replica killed
+    mid-fan-out — zero silent pano drops, zero failed legs, at least
+    one redispatched leg that JOINS a localize query's trace, every
+    query 200, ONE stdout JSON line."""
+    import chaos_serving
+
+    rc = chaos_serving.main([
+        "--localize_fanout", "--replicas", "2", "--synthetic", "96x128",
+        "--image_size", "64", "--duration_s", "4", "--threads", "2",
+        "--panos", "4", "--max_batch", "2",
+    ], model=tiny_serving_model)
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "chaos_localize_fanout"
+    assert rc == 0, f"violations: {rec['violations']}"
+    assert rec["violations"] == []
+    assert rec["value"] == 1.0
+    assert rec["queries"]["ok"] == rec["queries"]["sent"]
+    assert rec["legs"]["legs_failed"] == 0
+    assert rec["silent_drops"] == 0 and rec["dropped"] == 0
+    assert rec["redispatched"] >= 1
+    assert rec["joined_redispatch_spans"] >= 1
+    assert rec["fanout_width"] == 4 and rec["replicas"] == 2
+
+
+def test_bench_localize_contract(tiny_serving_model, capsys):
+    """tools/bench_serving.py --localize: ONE JSON line with the
+    localize QPS headline, fan-out width, replay cache hit-rate, and
+    both replicas in the per-replica admitted breakdown."""
+    import bench_serving
+
+    rc = bench_serving.main([
+        "--localize", "--replicas", "2", "--synthetic", "96x128",
+        "--image_size", "64", "--duration_s", "1", "--threads", "2",
+        "--panos", "3", "--localize_queries", "2", "--max_batch", "2",
+    ], model=tiny_serving_model)
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "serving_localize_qps"
+    assert rec["unit"] == "qps" and rec["value"] > 0
+    assert rec["fanout_width"] == 3 and rec["replicas"] == 2
+    assert rec["queries"]["errors"] == 0
+    assert rec["legs_failed"] == 0
+    # Steady-state replay of a fixed shortlist set answers from cache.
+    assert rec["rescache_hit_rate"] == 1.0
+    assert set(rec["per_replica"]) == {"loc-d0", "loc-d1"}
+    admitted = sum(v["admitted"] for v in rec["per_replica"].values())
+    # The cold phase's legs all dispatched; both replicas took some.
+    assert admitted == rec["fanout_width"] * 2
+    assert all(v["admitted"] >= 1 for v in rec["per_replica"].values())
+    for q in ("p50", "p99"):
+        assert rec["replay_latency_ms"][q] > 0
+
+
+def test_bench_trend_passes_localize_fields_through(tmp_path, capsys):
+    """tools/bench_trend.py forwards the localize-bench context: a
+    localize QPS trend is only readable next to the fan-out width it
+    served and the cache hit-rate that paid for it."""
+    import bench_trend
+
+    rec = {"n": 1, "cmd": "bench", "rc": 0,
+           "parsed": {"metric": "serving_localize_qps", "value": 130.0,
+                      "unit": "qps", "replicas": 2, "fanout_width": 6,
+                      "rescache_hit_rate": 0.98, "legs": 240,
+                      "legs_failed": 0}}
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump(rec, fh)
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["metric"] == "serving_localize_qps"
+    assert report["fanout_width"] == 6
+    assert report["rescache_hit_rate"] == 0.98
+    assert report["legs"] == 240 and report["legs_failed"] == 0
+
+
+def test_fleet_status_rescache_column_math():
+    import fleet_status
+
+    assert fleet_status._rescache_pct({}) is None
+    assert fleet_status._rescache_pct(
+        {"serving_rescache_hits": 3.0,
+         "serving_rescache_misses": 1.0}) == 75.0
+    # Registered-but-untouched counters: 0/0 renders "-", not a crash.
+    assert fleet_status._rescache_pct(
+        {"serving_rescache_hits": 0.0,
+         "serving_rescache_misses": 0.0}) is None
+
+
+def test_ci_gate_localize_smoke_is_optional(capsys):
+    """Off by default, never silently green: a default ci_gate run
+    records localize_smoke as skipped AND optional."""
+    import ci_gate
+
+    assert "localize_smoke" in ci_gate.OPTIONAL_CHECKS
+    rc = ci_gate.main(["--skip", "tier1", "--skip", "lint",
+                       "--skip", "bench_trend"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["checks"]["localize_smoke"] == {
+        "skipped": True, "optional": True}

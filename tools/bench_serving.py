@@ -381,6 +381,177 @@ def fleet_bench(args, model=None):
     return 0 if bad == 0 else 1
 
 
+def localize_bench(args, model=None):
+    """``--localize``: the localization-as-a-service bench — one query
+    against a ``--panos``-wide shortlist, fanned out over an in-process
+    2+-replica fleet fronted by a match-result cache.
+
+    Two phases against ONE server: a COLD pass (each distinct query
+    once — every leg dispatches and populates the cache) and a
+    duration-bound REPLAY pass (the same repeated shortlists — the
+    localization traffic shape the cache exists for; steady-state legs
+    answer from cache). Prints one ``serving_localize_qps`` JSON line:
+    replay-phase queries/s, fan-out width, per-pano-leg cache hit-rate
+    on the replay, per-replica admitted deltas (the fan-out proof:
+    one query's legs land on BOTH replicas), and both phases' latency.
+    """
+    from ncnet_tpu import obs
+    from ncnet_tpu.serving.client import MatchClient
+    from ncnet_tpu.serving.fleet import MatchFleet
+    from ncnet_tpu.serving.result_cache import MatchResultCache
+    from ncnet_tpu.serving.server import MatchServer
+
+    if model is None:
+        from ncnet_tpu.cli.common import build_model
+
+        note("building tiny model (pass model= to reuse one in-process)")
+        model = build_model(
+            ncons_kernel_sizes=(3, 3),
+            ncons_channels=(16, 1),
+            relocalization_k_size=2,
+            half_precision=True,
+            backbone_bf16=True,
+        )
+    config, params = model
+    replicas = max(args.replicas, 2)
+    h, w = (int(v) for v in args.synthetic.split("x"))
+    imgs = synth_jpegs(args.synthetic, seed=31,
+                       n=args.panos + args.localize_queries)
+    shortlist, queries = imgs[:args.panos], imgs[args.panos:]
+    timeout_s = max(args.duration_s * 4, 60.0)
+    fleet = MatchFleet.build(
+        config, params,
+        n_replicas=replicas,
+        base_id="loc",
+        cache_mb=0,  # inline-b64 legs never touch the feature store
+        engine_kwargs=dict(k_size=2, image_size=args.image_size),
+        replica_kwargs=dict(
+            max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1e3,
+            default_timeout_s=timeout_s,
+        ),
+    )
+    fleet.warmup([(h, w, h, w)],
+                 batch_sizes=sorted({1, max(1, args.max_batch // 2),
+                                     args.max_batch}))
+    rids = [r.replica_id for r in fleet.replicas]
+    before = {rid: obs.counter("serving.admitted",
+                               labels={"replica": rid}).value
+              for rid in rids}
+    cache = MatchResultCache(256 * 1024 * 1024, model_key="bench")
+    server = MatchServer(None, port=0, fleet=fleet,
+                         result_cache=cache).start()
+    lock = threading.Lock()
+    stats = {"sent": 0, "ok": 0, "rejected": 0, "errors": 0,
+             "legs": 0, "legs_failed": 0, "hit_legs": 0}
+    cold_lat, replay_lat = [], []
+
+    def one(client, qb, lat_sink):
+        from ncnet_tpu.serving.client import (
+            OverCapacityError,
+            ServingError,
+        )
+
+        with lock:
+            stats["sent"] += 1
+        t_req = time.monotonic()
+        try:
+            resp = client.localize(query_bytes=qb,
+                                   panos=list(shortlist),
+                                   max_matches=args.max_matches)
+        except OverCapacityError:
+            with lock:
+                stats["rejected"] += 1
+            return
+        except (ServingError, OSError) as exc:
+            with lock:
+                stats["errors"] += 1
+            note(f"localize error: {exc}")
+            return
+        dt_ms = (time.monotonic() - t_req) * 1e3
+        rows = resp.get("panos", [])
+        with lock:
+            stats["ok"] += 1
+            lat_sink.append(dt_ms)
+            stats["legs"] += len(rows)
+            stats["legs_failed"] += sum(
+                1 for r in rows if not r.get("ok"))
+            stats["hit_legs"] += sum(
+                1 for r in rows
+                if r.get("rescache") in ("hit", "coalesced"))
+
+    try:
+        client = MatchClient(server.url, timeout_s=timeout_s,
+                             retries=0 if args.no_retry else 2)
+        note(f"phase 1/2: cold — {len(queries)} distinct queries x "
+             f"{args.panos}-pano shortlist over {replicas} replicas")
+        for qb in queries:
+            one(client, qb, cold_lat)
+        cold_legs = stats["legs"]
+        cold_hits = stats["hit_legs"]
+        note(f"phase 2/2: replay — same shortlists for "
+             f"{args.duration_s:g}s ({args.threads} drivers)")
+        t0 = time.monotonic()
+
+        def driver(k):
+            c = MatchClient(server.url, timeout_s=timeout_s,
+                            retries=0 if args.no_retry else 2)
+            i = k
+            while time.monotonic() - t0 < args.duration_s:
+                one(c, queries[i % len(queries)], replay_lat)
+                i += 1
+
+        threads = [threading.Thread(target=driver, args=(k,),
+                                    daemon=True)
+                   for k in range(args.threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        replay_elapsed = time.monotonic() - t0
+    finally:
+        server.stop()
+
+    per_replica = {
+        rid: {"admitted": obs.counter(
+            "serving.admitted", labels={"replica": rid}
+        ).value - before[rid]}
+        for rid in rids
+    }
+    replay_legs = stats["legs"] - cold_legs
+    replay_hits = stats["hit_legs"] - cold_hits
+    qps = (len(replay_lat) / replay_elapsed
+           if replay_elapsed > 0 else 0.0)
+    cold_lat.sort()
+    replay_lat.sort()
+
+    def _lat(vals):
+        return {
+            "p50": round(percentile(vals, 50), 3) if vals else None,
+            "p99": round(percentile(vals, 99), 3) if vals else None,
+        }
+
+    rec = {
+        "metric": "serving_localize_qps",
+        "value": round(qps, 4),
+        "unit": "qps",
+        "replicas": replicas,
+        "fanout_width": args.panos,
+        "queries": {k: stats[k] for k in
+                    ("sent", "ok", "rejected", "errors")},
+        "legs": stats["legs"],
+        "legs_failed": stats["legs_failed"],
+        "rescache_hit_rate": round(replay_hits / replay_legs, 4)
+        if replay_legs else None,
+        "cold_latency_ms": _lat(cold_lat),
+        "replay_latency_ms": _lat(replay_lat),
+        "per_replica": per_replica,
+        "duration_s": round(replay_elapsed, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    return 0 if stats["errors"] == 0 and not stats["legs_failed"] else 1
+
+
 def session_bench(args, model=None):
     """Streaming-session bench (``--session``): one video-style stream,
     open -> N frames -> close, against a baseline of the SAME frames as
@@ -580,6 +751,19 @@ def main(argv=None, model=None):
                         help="session mode, in-process fleet: coarse "
                         "survivors refined per frame (keeps the c2f "
                         "path non-degenerate at smoke image sizes)")
+    parser.add_argument("--localize", action="store_true",
+                        help="localize bench: repeated-shortlist "
+                        "/v1/localize queries over an in-process "
+                        "2+-replica fleet with a match-result cache "
+                        "(one serving_localize_qps line: replay qps, "
+                        "fan-out width, per-leg cache hit-rate, "
+                        "per-replica admitted deltas). Needs "
+                        "--synthetic + --replicas")
+    parser.add_argument("--panos", type=int, default=6,
+                        help="localize mode: shortlist width per query")
+    parser.add_argument("--localize_queries", type=int, default=4,
+                        help="localize mode: distinct query images "
+                        "(the replay cycles through them)")
     parser.add_argument("--slo_availability", type=float, default=0.999,
                         help="availability objective for the SLO summary")
     parser.add_argument("--slo_p99_ms", type=float, default=0.0,
@@ -600,6 +784,13 @@ def main(argv=None, model=None):
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+    if args.localize:
+        if not args.synthetic or args.replicas <= 0:
+            parser.error("--localize needs --synthetic HxW and "
+                         "--replicas >= 2 (in-process fleet; the "
+                         "fan-out proof wants two replicas)")
+        return localize_bench(args, model=model)
 
     if args.session:
         if not args.synthetic:

@@ -101,11 +101,16 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
     # And the quality-observatory fields (tools/quality_report.py /
     # obs/quality.py): a throughput trend earned by degrading rungs is
     # only honest next to the measured agreement cost and drift state.
+    # And the localize-bench fields (tools/bench_serving.py --localize):
+    # a localize-QPS trend only means something next to the fan-out
+    # width it served and the result-cache hit rate that paid for it.
     for key in ("replicas", "single_replica_pairs_per_s", "scaling_x",
                 "scaling_efficiency", "pairs_done", "pairs_s",
                 "quarantined", "resumes",
                 "c2f_pairs_s", "coarse_factor", "topk", "c2f_pck_delta",
-                "shadow_agreement", "quality_drift_psi"):
+                "shadow_agreement", "quality_drift_psi",
+                "fanout_width", "rescache_hit_rate", "legs",
+                "legs_failed"):
         if key in latest:
             report[key] = latest[key]
     return report

@@ -261,6 +261,99 @@ def chaos(args, model=None):
     return (0 if ok else 1), rec
 
 
+def prewarm_results(args, model=None):
+    """``--prewarm-results``: run the manifest's pairs and populate a
+    match-RESULT cache disk tier (serving/result_cache.py) instead of a
+    ledger — the offline half of the serving cache: a nightly sweep
+    over tomorrow's expected shortlists turns day-one localize traffic
+    into disk hits. Pairs already cached are skipped (resumable by
+    construction: the disk tier IS the ledger). Returns (rc, record).
+    """
+    import time as _time
+
+    import numpy as np
+
+    from ncnet_tpu.pipeline.bulk import iter_manifest
+    from ncnet_tpu.serving.feature_store import content_digest
+    from ncnet_tpu.serving.result_cache import MatchResultCache
+
+    model_key = args.rescache_model_key
+    if not model_key:
+        if args.engine == "echo":
+            model_key = "echo|res"
+        else:
+            from ncnet_tpu.evals.feature_cache import model_cache_key
+
+            model_key = model_cache_key("", seed=1) + "|res"
+    cache = MatchResultCache(
+        max(args.rescache_mb, 1) * 1024 * 1024,
+        disk_dir=args.rescache_dir, model_key=model_key)
+    fleet, prepare = _build_fleet(args, model)
+    fleet.start()
+    engine = fleet.replicas[0].engine
+
+    def to_table(matches):
+        t = np.asarray(matches)
+        if t.ndim == 2:
+            return t
+        # Echo engine: the digest bytes fold into a deterministic fake
+        # [4, 5] table so the prewarm plumbing drills jax-free.
+        raw = np.frombuffer(bytes(matches), np.uint8)[:20]
+        return raw.astype(np.float32).reshape(4, 5)
+
+    t0 = _time.monotonic()
+    stored = warm = failed = 0
+    pending = []
+
+    def drain_one():
+        nonlocal stored, failed
+        key0, pid, fut = pending.pop(0)
+        try:
+            br = fut.result(timeout=300.0)
+            cache.put(key0, to_table(br.result["matches"]))
+            stored += 1
+        except Exception as exc:  # noqa: BLE001 — skip, count, continue
+            note(f"prewarm: pair {pid} failed: {type(exc).__name__}: {exc}")
+            failed += 1
+
+    rows = list(iter_manifest(args.manifest))
+    for pair in rows:
+        try:
+            bucket_key, p = prepare(pair)
+            op = (engine.result_op_key(p)
+                  if hasattr(engine, "result_op_key") else ("echo",))
+            key = cache.key(content_digest(pair.query),
+                            content_digest(pair.pano), op)
+        except (OSError, ValueError) as exc:
+            note(f"prewarm: pair {pair.pair_id} unreadable: {exc}")
+            failed += 1
+            continue
+        if cache.get(key) is not None:
+            warm += 1
+            continue
+        pending.append((key, pair.pair_id, fleet.dispatcher.submit(
+            bucket_key, p)))
+        while len(pending) >= args.max_inflight:
+            drain_one()
+    while pending:
+        drain_one()
+    fleet.close()
+    dur = _time.monotonic() - t0
+    rec = {
+        "metric": "bulk_prewarm_results_pairs_per_s",
+        "value": round(stored / dur, 3) if dur > 0 else 0.0,
+        "unit": "pairs/s",
+        "engine": args.engine,
+        "pairs": len(rows),
+        "stored": stored,
+        "already_warm": warm,
+        "failed": failed,
+        "rescache_dir": args.rescache_dir,
+        "duration_s": round(dur, 3),
+    }
+    return (0 if failed == 0 else 1), rec
+
+
 def main(argv=None, model=None):
     parser = argparse.ArgumentParser(
         description="crash-safe resumable bulk matcher over a manifest")
@@ -291,6 +384,23 @@ def main(argv=None, model=None):
     parser.add_argument("--chaos", action="store_true",
                         help="crash-resume-crash gate; nonzero exit on "
                         "any lost/duplicated/unquarantined pair")
+    parser.add_argument("--prewarm-results", action="store_true",
+                        dest="prewarm_results",
+                        help="populate a match-result cache disk tier "
+                        "from the manifest's pairs instead of writing a "
+                        "ledger (serving caches answer repeat traffic "
+                        "from it; already-cached pairs are skipped)")
+    parser.add_argument("--rescache_dir", type=str, default="",
+                        help="match-result cache disk tier for "
+                        "--prewarm-results (give the server the same "
+                        "dir via --rescache_dir)")
+    parser.add_argument("--rescache_mb", type=int, default=256,
+                        help="prewarm-side memory budget (the disk "
+                        "tier is what persists)")
+    parser.add_argument("--rescache_model_key", type=str, default="",
+                        help="cache namespace; MUST match the serving "
+                        "side's (default: derived like the server's "
+                        "default for this tool's model)")
     parser.add_argument("--run_log", type=str, default="")
     args = parser.parse_args(argv)
 
@@ -312,6 +422,13 @@ def main(argv=None, model=None):
 
     if args.chaos:
         rc, rec = chaos(args, model)
+        print(json.dumps(rec), flush=True)
+        return rc
+
+    if args.prewarm_results:
+        if not args.rescache_dir:
+            parser.error("--prewarm-results needs --rescache_dir")
+        rc, rec = prewarm_results(args, model)
         print(json.dumps(rec), flush=True)
         return rc
 

@@ -88,6 +88,28 @@ stream continues. The gate FAILS (nonzero exit) if:
 Prints ONE JSON line: ``{"metric": "chaos_session_stream", "value":
 <delivered frac>, ...}`` with frame outcome counts, per-session close
 stats, re-seed counts, and the violation list.
+
+``--localize_fanout`` runs the localize fan-out chaos contract
+(docs/SERVING.md, "Localization as a service"): ``--threads`` drivers
+stream ``/v1/localize`` queries (``--panos``-wide shortlists) against
+an in-process replica fleet while a ``kill_replica`` window (default:
+the middle of the run) takes a replica down mid-fan-out. The victim's
+pano legs must REDISPATCH to survivors — the query keeps answering
+200 with every pano accounted for. The gate FAILS (nonzero exit) if:
+
+* any query gets a non-200 (a kill mid-fan-out must not fail the
+  query);
+* any response silently drops a pano (rows missing vs the shortlist,
+  or ``n_ok + n_failed`` disagreeing with the row count);
+* any pano leg FAILS (the victim's share must re-route, not error);
+* no leg was ever redispatched (the window missed all in-flight
+  fan-outs — the scenario proved nothing);
+* redispatched legs never appear as ``redispatch`` spans joined into
+  a localize query's trace (the per-query record of where legs ran).
+
+Prints ONE JSON line: ``{"metric": "chaos_localize_fanout", "value":
+<query 200 frac>, ...}`` with query/leg outcome counts, redispatch
+totals (counter + joined trace spans), and the violation list.
 """
 
 from __future__ import annotations
@@ -585,6 +607,237 @@ def run_session_stream(args, model=None):
     return 0 if not violations else 1
 
 
+def run_localize_fanout(args, model=None):
+    """The localize fan-out chaos contract (module docstring)."""
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from ncnet_tpu import obs
+    from ncnet_tpu.serving.client import (
+        MatchClient,
+        OverCapacityError,
+        ServingError,
+    )
+    from ncnet_tpu.serving.fleet import MatchFleet
+    from ncnet_tpu.serving.server import MatchServer
+
+    windows = [parse_fault_window(s) for s in args.fault]
+    for _, site, _, _ in windows:
+        if not site.startswith("kill_replica"):
+            raise SystemExit("--localize_fanout only takes kill_replica "
+                             f"fault windows (got {site!r})")
+    if args.replicas < 2:
+        raise SystemExit("--localize_fanout needs --replicas >= 2 "
+                         "(a survivor for the victim's legs)")
+    if not windows:
+        # The verb exists to kill a replica mid-fan-out; default one
+        # window across the middle of the run.
+        windows = [("kill_replica:-1", "kill_replica:-1",
+                    args.duration_s * 0.3, args.duration_s * 0.7)]
+    # The trace-join gate needs a runlog to scan; make a private one if
+    # the caller didn't ask for a copy.
+    log_path = args.run_log or os.path.join(
+        tempfile.mkdtemp(prefix="chaos_localize_"), "run.jsonl")
+    run_log = obs.init_run("chaos_serving", log_path, args=args)
+    if model is None:
+        from ncnet_tpu.cli.common import build_model
+
+        note("building tiny model (pass model= to reuse one in-process)")
+        model = build_model(
+            ncons_kernel_sizes=(3, 3),
+            ncons_channels=(16, 1),
+            relocalization_k_size=2,
+            half_precision=True,
+            backbone_bf16=True,
+        )
+    config, params = model
+    h, w = (int(v) for v in args.synthetic.split("x"))
+    fleet = MatchFleet.build(
+        config, params,
+        n_replicas=args.replicas,
+        base_id="chaos",
+        cache_mb=0,
+        engine_kwargs=dict(k_size=2, image_size=args.image_size),
+        replica_kwargs=dict(
+            max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1e3,
+            default_timeout_s=max(args.duration_s * 4, 60.0),
+            breaker_threshold=args.breaker_threshold,
+            breaker_reset_s=args.breaker_reset_s,
+            isolate_poison=not args.no_isolate_poison,
+        ),
+    )
+    fleet.warmup([(h, w, h, w)],
+                 batch_sizes=sorted({1, args.max_batch}))
+    server = MatchServer(
+        None, port=0,
+        max_batch=args.max_batch,
+        max_delay_s=args.max_delay_ms / 1e3,
+        default_timeout_s=max(args.duration_s * 4, 60.0),
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
+        isolate_poison=not args.no_isolate_poison,
+        run_log=run_log,
+        fleet=fleet,
+    ).start()
+    note(f"serving on {server.url} ({args.replicas} replicas); "
+         f"shortlist width {args.panos}; fault windows: "
+         f"{[(t, a, b) for t, _, a, b in windows]}")
+
+    imgs = synth_jpegs(args.synthetic, seed=23, n=args.panos + 4)
+    shortlist, query_pool = imgs[:args.panos], imgs[args.panos:]
+    t0 = time.monotonic()
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = {"sent": 0, "ok": 0, "rejected": 0, "errors": 0,
+             "legs": 0, "legs_ok": 0, "legs_failed": 0,
+             "silent_drops": 0, "redispatched": 0}
+    trace_ids = set()
+    deaths = []
+
+    def drive(k):
+        client = MatchClient(
+            server.url, timeout_s=max(args.duration_s * 4, 60.0),
+            retries=args.client_retries,
+            retry_deadline_s=args.duration_s)
+        i = k
+        try:
+            while time.monotonic() - t0 < args.duration_s:
+                qb = query_pool[i % len(query_pool)]
+                i += 1
+                with lock:
+                    stats["sent"] += 1
+                try:
+                    resp = client.localize(query_bytes=qb,
+                                           panos=list(shortlist))
+                except OverCapacityError:
+                    with lock:
+                        stats["rejected"] += 1
+                    continue
+                except (ServingError, OSError) as exc:
+                    with lock:
+                        stats["errors"] += 1
+                    note(f"driver {k} query error: {exc}")
+                    continue
+                # No silent drops: every shortlist pano must come back
+                # as a per-pano row, ok or structured-failed.
+                rows = resp.get("panos", [])
+                n_ok = sum(1 for r in rows if r.get("ok"))
+                with lock:
+                    stats["ok"] += 1
+                    stats["legs"] += len(shortlist)
+                    stats["legs_ok"] += n_ok
+                    stats["legs_failed"] += len(rows) - n_ok
+                    if (len(rows) != len(shortlist)
+                            or resp.get("n_ok", -1)
+                            + resp.get("n_failed", -1) != len(rows)):
+                        stats["silent_drops"] += 1
+                    stats["redispatched"] += int(
+                        resp.get("redispatched", 0))
+                    if resp.get("trace_id"):
+                        trace_ids.add(resp["trace_id"])
+        except Exception as exc:  # noqa: BLE001 — any escape IS the gate
+            with lock:
+                deaths.append(f"driver {k}: {exc!r}")
+
+    fault_log = {}
+
+    def fault_scheduler():
+        events = sorted(
+            [(s0, "arm", site) for _, site, s0, _ in windows]
+            + [(e0, "disarm", site) for _, site, _, e0 in windows]
+        )
+        for at, action, site in events:
+            delay = t0 + at - time.monotonic()
+            if delay > 0 and stop.wait(delay):
+                return
+            idx = int(site.partition(":")[2] or -1)
+            if action == "arm":
+                r = fleet.kill(idx)
+                note(f"t+{at:.1f}s killed {r.replica_id}")
+            else:
+                r = fleet.revive(idx)
+                note(f"t+{at:.1f}s revived {r.replica_id}")
+            fault_log.setdefault(site, []).append(
+                {"t_s": at, "action": action})
+
+    threads = [threading.Thread(target=drive, args=(k,), daemon=True)
+               for k in range(args.threads)]
+    aux = threading.Thread(target=fault_scheduler, daemon=True)
+    aux.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    aux.join(timeout=5)
+    elapsed = time.monotonic() - t0
+    server.stop()
+    run_log.close("ok")
+
+    # Joined-trace check: the dispatcher books a ``redispatch`` span
+    # for every bounced leg, parented into the request's trace via the
+    # context captured at submit — so a redispatched leg MUST show up
+    # in the runlog under one of the localize queries' trace ids.
+    joined_redispatch = 0
+    with open(log_path) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if (rec.get("event") == "redispatch"
+                    or (rec.get("kind") == "span"
+                        and rec.get("event") == "redispatch")):
+                if rec.get("trace_id") in trace_ids:
+                    joined_redispatch += 1
+
+    violations = list(deaths)
+    dropped = stats["sent"] - (stats["ok"] + stats["rejected"]
+                               + stats["errors"])
+    if dropped:
+        violations.append(f"{dropped} quer(ies) unaccounted for")
+    if stats["errors"]:
+        violations.append(f"{stats['errors']} non-200 quer(ies) "
+                          "(a kill mid-fan-out must still answer 200)")
+    if stats["silent_drops"]:
+        violations.append(f"{stats['silent_drops']} response(s) with "
+                          "silently dropped panos")
+    if stats["legs_failed"]:
+        violations.append(f"{stats['legs_failed']} pano leg(s) failed "
+                          "(the victim's share must redispatch, "
+                          "not fail)")
+    if windows and not stats["redispatched"]:
+        violations.append("kill window armed but no leg was ever "
+                          "redispatched (scenario proved nothing)")
+    if stats["redispatched"] and not joined_redispatch:
+        violations.append("redispatched legs never appeared in a "
+                          "localize query's joined trace")
+    rec = {
+        "metric": "chaos_localize_fanout",
+        "value": round(stats["ok"] / max(stats["sent"], 1), 4),
+        "unit": "frac",
+        "replicas": args.replicas,
+        "fanout_width": args.panos,
+        "queries": {k: stats[k] for k in
+                    ("sent", "ok", "rejected", "errors")},
+        "legs": {k: stats[k] for k in
+                 ("legs", "legs_ok", "legs_failed")},
+        "dropped": dropped,
+        "silent_drops": stats["silent_drops"],
+        "redispatched": stats["redispatched"],
+        "joined_redispatch_spans": joined_redispatch,
+        "faults": fault_log,
+        "violations": violations,
+        "duration_s": round(elapsed, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    if violations:
+        note("VIOLATIONS: " + "; ".join(violations))
+    return 0 if not violations else 1
+
+
 def main(argv=None, model=None):
     parser = argparse.ArgumentParser(
         description="chaos harness: in-process serving under load + faults"
@@ -662,11 +915,22 @@ def main(argv=None, model=None):
     parser.add_argument("--sessions", type=int, default=2,
                         help="concurrent streaming sessions for "
                         "--session_stream")
+    parser.add_argument("--localize_fanout", action="store_true",
+                        help="run the localize fan-out chaos contract "
+                        "instead of open-loop match load (module "
+                        "docstring): kill a replica mid-fan-out; every "
+                        "pano must come back (redispatched, visible in "
+                        "the joined trace) and the query must still 200")
+    parser.add_argument("--panos", type=int, default=6,
+                        help="shortlist width per localize query for "
+                        "--localize_fanout")
     args = parser.parse_args(argv)
     if args.tenant_flood:
         return run_tenant_flood(args, model)
     if args.session_stream:
         return run_session_stream(args, model)
+    if args.localize_fanout:
+        return run_localize_fanout(args, model)
     windows = [parse_fault_window(s) for s in args.fault]
     if any(site.startswith("kill_replica") for _, site, _, _ in windows) \
             and args.replicas < 2:

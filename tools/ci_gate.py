@@ -36,7 +36,11 @@ self-hosted server shadow-re-runs every response; rung-0 agreement
 must be 1.0 bitwise). ``--with-trace-join`` runs the multi-runlog
 trace-assembly self-test (``tools/trace_export.py --selftest`` —
 synthetic client + skewed server logs must join into ONE tree with
-the clock skew recovered). All are off by default because they serve
+the clock skew recovered). ``--with-localize-smoke`` runs the
+/v1/localize fan-out chaos contract (``tools/chaos_serving.py
+--localize_fanout`` — a mid-fan-out replica kill must redispatch the
+dead replica's legs, join them into the query trace, and still answer
+200 with zero silent pano drops). All are off by default because they serve
 live traffic for several seconds (or, for trace_join, are covered by
 tier-1); a default run still RECORDS them as
 ``{"skipped": true, "optional": true}`` so the JSON never reads as if
@@ -70,7 +74,7 @@ CHECKS = ("tier1", "lint", "bench_trend")
 # Opt-in checks: never run by default, never silently green — a
 # default run records them as {"skipped": true, "optional": true}.
 OPTIONAL_CHECKS = ("full_lint", "tenant_flood", "session_chaos",
-                   "quality_report", "trace_join")
+                   "quality_report", "trace_join", "localize_smoke")
 
 
 def _run(cmd, timeout_s, cpu_env=False) -> dict:
@@ -162,6 +166,17 @@ def run_quality_report(timeout_s: float) -> dict:
         timeout_s, cpu_env=True)
 
 
+def run_localize_smoke(timeout_s: float) -> dict:
+    # Short flavor of the localize fan-out chaos contract: 2 replicas,
+    # a mid-window replica kill, and the gate's violation rules (zero
+    # silent pano drops, redispatched legs joined into the query
+    # trace, every query still 200).
+    return _run(
+        [sys.executable, os.path.join("tools", "chaos_serving.py"),
+         "--localize_fanout", "--duration_s", "6", "--panos", "4"],
+        timeout_s, cpu_env=True)
+
+
 def run_trace_join(timeout_s: float) -> dict:
     # The distributed-trace assembly self-test: two synthetic runlogs
     # (client, server skewed +30s) must export as ONE joined tree with
@@ -204,6 +219,11 @@ def main(argv=None) -> int:
                     help="also run the multi-runlog trace-assembly "
                          "self-test (tools/trace_export.py --selftest); "
                          "off by default, recorded as skipped when off")
+    ap.add_argument("--with-localize-smoke", action="store_true",
+                    help="also run the /v1/localize fan-out chaos "
+                         "contract (tools/chaos_serving.py "
+                         "--localize_fanout, short duration); off by "
+                         "default, recorded as skipped when off")
     ap.add_argument("--chaos-timeout-s", type=float, default=300.0,
                     help="wall-clock fence for the optional chaos checks")
     args = ap.parse_args(argv)
@@ -218,12 +238,15 @@ def main(argv=None) -> int:
         "quality_report": lambda: run_quality_report(
             args.chaos_timeout_s),
         "trace_join": lambda: run_trace_join(args.timeout_s),
+        "localize_smoke": lambda: run_localize_smoke(
+            args.chaos_timeout_s),
     }
     enabled = {"full_lint": args.with_full_lint,
                "tenant_flood": args.with_tenant_flood,
                "session_chaos": args.with_session_chaos,
                "quality_report": args.with_quality_report,
-               "trace_join": args.with_trace_join}
+               "trace_join": args.with_trace_join,
+               "localize_smoke": args.with_localize_smoke}
     checks = {}
     for name in CHECKS + OPTIONAL_CHECKS:
         if name in args.skip or not enabled.get(name, True):

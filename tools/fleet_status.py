@@ -4,10 +4,10 @@ The dashboard half of obs/aggregate.py: scrape every replica's
 ``GET /metrics`` each poll, merge the scrapes into a fleet view, and
 render a per-replica table to STDERR —
 
-    replica      req/s   err/s   p99 ms   queue  breaker  burn  hbm GB  head%  warm  rung  sess  drift  shad%
-    r0            12.4     0.0     38.2       1   closed   0.1    21.40     33     4     0     3   0.04     99
-    r1            11.9     0.0     41.7       0   closed   0.2    21.38     33     4     0     1   0.05    100
-    FLEET         24.3     0.0     40.9       1        -   0.2    42.78     33     8     0     4   0.05     99
+    replica      req/s   err/s   p99 ms   queue  breaker  burn  hbm GB  head%  warm  rung  sess  drift  shad%  resc%
+    r0            12.4     0.0     38.2       1   closed   0.1    21.40     33     4     0     3   0.04     99     81
+    r1            11.9     0.0     41.7       0   closed   0.2    21.38     33     4     0     1   0.05    100     79
+    FLEET         24.3     0.0     40.9       1        -   0.2    42.78     33     8     0     4   0.05     99     80
       tenants: default=112  lowpri=38
 
 req/s and err/s are counter deltas between polls; p99 is exact at the
@@ -30,7 +30,10 @@ endpoints (obs/quality.py — 0.25+ means the live score distribution
 shifted); shad% is the count-weighted mean
 ``serving.quality.shadow_agreement`` across rungs (serving/shadow.py
 — "-" until the shadow sampler has compared something; the per-rung
-split lives in tools/quality_report.py). A ``tenants:`` line breaks
+split lives in tools/quality_report.py); resc% is the lifetime
+match-result-cache hit percent, ``serving.rescache.hits`` over
+hits+misses (serving/result_cache.py — "-" on replicas running
+without the result cache). A ``tenants:`` line breaks
 fleet-wide request totals out per ``serving.tenant.requests`` tenant
 label.
 
@@ -76,8 +79,22 @@ SESSIONS = "serving_session_active"
 TENANT_REQS = "serving_tenant_requests"
 DRIFT_PSI = "serving_quality_drift_psi"
 SHADOW_AGREE = "serving_quality_shadow_agreement"
+RESCACHE_HITS = "serving_rescache_hits"
+RESCACHE_MISSES = "serving_rescache_misses"
 
 _BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+
+
+def _rescache_pct(counters):
+    """Lifetime match-result-cache hit percent from the
+    ``serving_rescache_{hits,misses}`` counters ("-" on replicas that
+    run without the result cache — neither counter ever registers)."""
+    hits = counters.get(RESCACHE_HITS)
+    misses = counters.get(RESCACHE_MISSES)
+    if hits is None and misses is None:
+        return None
+    total = (hits or 0.0) + (misses or 0.0)
+    return (hits or 0.0) / total * 100.0 if total else None
 
 
 def note(msg):
@@ -202,6 +219,7 @@ def render(view, prev_counters, dt, out=None):
             rep["gauges"].get(SESSIONS),
             _label_max(rep["gauges"], DRIFT_PSI),
             _hist_family_mean(rep["histograms"], SHADOW_AGREE),
+            _rescache_pct(rep["counters"]),
         ))
     fleet_prev = (prev_counters or {}).get("FLEET")
     burn_entry = view["gauges"].get(BURN) or {}
@@ -222,23 +240,26 @@ def render(view, prev_counters, dt, out=None):
         _gauge_sum(view, SESSIONS),
         _fleet_gauge_max(view, DRIFT_PSI),
         _hist_family_mean(view["histograms"], SHADOW_AGREE),
+        _rescache_pct(view["counters"]),
     ))
     w(f"{'replica':<12} {'req/s':>8} {'err/s':>8} {'p99 ms':>8} "
       f"{'queue':>6} {'breaker':>9} {'burn':>6} {'hbm GB':>7} "
       f"{'head%':>6} {'warm':>5} {'rung':>5} {'sess':>5} "
-      f"{'drift':>6} {'shad%':>6}\n")
+      f"{'drift':>6} {'shad%':>6} {'resc%':>6}\n")
     for (ident, rps, eps, p99, q, brk, burn, hbm, head, warm,
-         rung, sess, drift, shad) in rows:
+         rung, sess, drift, shad, resc) in rows:
         qs = f"{q:.0f}".rjust(6) if q is not None else "-".rjust(6)
         ws_ = f"{warm:.0f}".rjust(5) if warm is not None else "-".rjust(5)
         rg = f"{rung:.0f}".rjust(5) if rung is not None else "-".rjust(5)
         ss = f"{sess:.0f}".rjust(5) if sess is not None else "-".rjust(5)
         sh = (f"{shad * 100:.0f}".rjust(6) if shad is not None
               else "-".rjust(6))
+        rc = (f"{resc:.0f}".rjust(6) if resc is not None
+              else "-".rjust(6))
         w(f"{ident:<12} {_fmt(rps, 8)} {_fmt(eps, 8)} {_fmt(p99, 8)} "
           f"{qs} {brk:>9} {_fmt(burn, 6)} {_fmt(hbm, 7, 2)} "
           f"{_fmt(head, 6, 0)} {ws_} {rg} {ss} "
-          f"{_fmt(drift, 6, 2)} {sh}\n")
+          f"{_fmt(drift, 6, 2)} {sh} {rc}\n")
     tenants = _tenant_totals(view["counters"])
     if tenants:
         w("  tenants: " + "  ".join(
@@ -306,6 +327,7 @@ def main(argv=None):
             "drift_psi": _label_max(rep["gauges"], DRIFT_PSI),
             "shadow_agreement": _hist_family_mean(
                 rep["histograms"], SHADOW_AGREE),
+            "rescache_hit_pct": _rescache_pct(rep["counters"]),
         }
     fleet_use = _gauge_sum(view, HBM_USE)
     fleet_lim = _gauge_sum(view, HBM_LIM)
@@ -328,6 +350,7 @@ def main(argv=None):
             "drift_psi": _fleet_gauge_max(view, DRIFT_PSI),
             "shadow_agreement": _hist_family_mean(
                 view["histograms"], SHADOW_AGREE),
+            "rescache_hit_pct": _rescache_pct(view["counters"]),
         },
         "polls": polls,
         "unreachable": sorted(view["errors"]),
