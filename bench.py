@@ -48,6 +48,23 @@ def main():
 
     setup_compile_cache()
 
+    # Closed-sweep guard (docs/NEXT.md "Consensus roofline verdict"):
+    # the dense per-layer strategy-mix sweeps are CLOSED — every
+    # explicit mix was measured HBM-infeasible at headline scale and the
+    # verdict says don't re-run them. An explicit strategy pin on the
+    # dense arm (exactly what a sweep driver materializes per line) now
+    # needs NCNET_BENCH_CLOSED_SWEEPS=1, so the autotuner's new-arm
+    # enumeration (cp/fft, ops/cp4d.py) can't silently resurrect the
+    # dense sweep lines it still carries.
+    _mix = os.environ.get("NCNET_CONSENSUS_STRATEGIES")
+    _kind = os.environ.get("NCNET_CONSENSUS_KIND") or "dense"
+    if (_mix and _kind == "dense"
+            and os.environ.get("NCNET_BENCH_CLOSED_SWEEPS") != "1"):
+        note(f"refusing dense-only strategy sweep: NCNET_CONSENSUS_"
+             f"STRATEGIES={_mix!r} pins a closed sweep (docs/NEXT.md); "
+             "set NCNET_BENCH_CLOSED_SWEEPS=1 to re-run it anyway")
+        raise SystemExit(2)
+
     # Run log is OPT-IN here (NCNET_RUN_LOG=<path or dir>): bench's stdout
     # contract is exactly one JSON line, and the default invocation inside
     # tools/tpu_session.py runs main() many times in one process — an
@@ -658,8 +675,106 @@ def main():
     # The consensus plan the measured program actually traced (recorded
     # by neigh_consensus_apply at trace time): makes BENCH_r0*.json
     # trajectories attributable to plan changes — fused? strategies?
-    # fold? autotune cache hit? — not just code drift.
+    # fold? autotune cache hit? — not just code drift. Snapshotted HERE,
+    # before the algebraic A/B below traces the cp/fft arms and
+    # overwrites the last-plan record with an arm that is not the
+    # headline program's.
     from ncnet_tpu.ops import consensus_last_plan
+
+    consensus_plan = consensus_last_plan()
+
+    # Algebraic consensus A/B (the cp/fft arms, ops/cp4d.py): time the
+    # SAME mutual->consensus->mutual stage the c2f section's one-shot
+    # anchor times, once per enumerated algebraic arm plus an explicit
+    # dense anchor, and record per-arm ms + output agreement vs dense.
+    # The winner's kind/rank/agreement land in the headline (the fields
+    # tools/bench_trend.py passes through) with a model-checked cost
+    # card. Fenced: the headline survives any arm failure.
+    # NCNET_BENCH_CONSENSUS_AB=0 skips.
+    arm_fields = {
+        "consensus_arms": None, "consensus_plan_kind": None,
+        "cp_rank": None, "cp_agreement": None,
+        "consensus_arm_card": None,
+    }
+    if os.environ.get("NCNET_BENCH_CONSENSUS_AB", "1") != "0":
+        try:
+            from ncnet_tpu.ops import autotune as _autotune
+            from ncnet_tpu.ops import cp4d as _cp4d
+            from ncnet_tpu.ops.conv4d import (
+                neigh_consensus_apply as _nca,
+            )
+            from ncnet_tpu.ops.mutual import mutual_matching as _mutual
+            from ncnet_tpu.utils.profiling import timed_steady as _timed
+
+            cons = params["neigh_consensus"]
+            # Floor 8, not 4: at 4^4 cells every arm is ~0.5 ms of
+            # dispatch overhead and the comparison is noise; 8^4 is the
+            # smallest grid where arm differences resolve (and the c2f
+            # coarse window of the default 512px smoke).
+            aph, apw = max(h_a // 16 // 2, 8), max(w_a // 16 // 2, 8)
+            corr_ab = jax.random.normal(
+                jax.random.PRNGKey(11), (1, 1, aph, apw, aph, apw),
+                jnp.float32).astype(jnp.bfloat16)
+            arms = [{"kind": "dense", "cp_rank": 0}] + [
+                p for p in _autotune.enumerate_plans(
+                    cons, symmetric=True, kl_folds=(0,), chunks=(0,))
+                if p["kind"] in ("cp", "fft")
+            ]
+            note(f"algebraic consensus A/B at [1,1,{aph},{apw},{aph},"
+                 f"{apw}]: {[p['kind'] for p in arms]}")
+            dense_out = None
+            table = {}
+            best = None
+            for plan in arms:
+                kind, rank = plan["kind"], plan["cp_rank"]
+                label = ("dense" if kind == "dense"
+                         else _autotune.plan_label(plan))
+
+                def arm_stage(c, _k=kind, _r=rank):
+                    c = _mutual(c)
+                    c = _nca(cons, c, symmetric=True, kind=_k,
+                             cp_rank=_r or None)
+                    return jnp.sum(_mutual(c).astype(jnp.float32))
+
+                try:
+                    _, dt_arm, _ = _timed(jax.jit(arm_stage), corr_ab,
+                                          iters=10)
+                    out = _nca(cons, corr_ab, symmetric=True, kind=kind,
+                               cp_rank=rank or None)
+                except Exception as exc:  # noqa: BLE001 — arm fence
+                    note(f"arm '{label}' failed ({type(exc).__name__}: "
+                         f"{exc}); skipped")
+                    continue
+                entry = {"ms": round(dt_arm * 1e3, 3)}
+                if kind == "dense":
+                    dense_out = out
+                elif dense_out is not None:
+                    entry["agreement"] = round(
+                        _cp4d.output_agreement(dense_out, out), 4)
+                table[label] = entry
+                note(f"arm {label:12s} {entry['ms']:8.2f} ms"
+                     + (f"  agreement={entry['agreement']:.4f}"
+                        if "agreement" in entry else ""))
+                if best is None or entry["ms"] < best[2]["ms"]:
+                    best = (label, plan, entry)
+            if table:
+                arm_fields["consensus_arms"] = table
+            if best is not None:
+                label, plan, entry = best
+                arm_fields["consensus_plan_kind"] = plan["kind"]
+                arm_fields["cp_rank"] = plan["cp_rank"]
+                arm_fields["cp_agreement"] = entry.get("agreement")
+                card = _autotune.winner_card(
+                    cons, corr_ab, True, plan, entry["ms"])
+                if card is not None:
+                    arm_fields["consensus_arm_card"] = {
+                        "plan_label": card.get("plan_label"),
+                        "model_ok": card.get("model_ok"),
+                        "flops": (card.get("xla") or {}).get("flops"),
+                    }
+        except Exception as exc:  # noqa: BLE001
+            note(f"algebraic consensus A/B failed ({type(exc).__name__}"
+                 f": {exc}); omitted")
 
     headline = {
         "metric": "inloc_dense_match_pairs_per_s_per_chip"
@@ -671,7 +786,8 @@ def main():
         "path": name,
         "util": util,
         **c2f_fields,
-        "consensus_plan": consensus_last_plan(),
+        **arm_fields,
+        "consensus_plan": consensus_plan,
         "costcard": costcard,
     }
     if run_log is not None:

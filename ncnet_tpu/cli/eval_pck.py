@@ -33,6 +33,7 @@ def evaluate_pck(
     alpha: float = 0.15,
     num_workers: int = 8,
     verbose: bool = True,
+    bake_params: bool = False,
 ):
     """Run keypoint-transfer PCK over a dataset; returns (mean_pck, per_pair).
 
@@ -42,11 +43,15 @@ def evaluate_pck(
     ops.matches.bilinear_point_transfer assumes). Degenerate c2f knobs
     route through the one-shot extraction on the stage-1 tensor, so the
     factor-1/top-K=all setting scores identically to mode='oneshot'.
+
+    ``bake_params`` closes the jit over ``params`` instead of passing
+    them as arguments — required for the algebraic consensus arms
+    (``config.consensus_kind`` of 'cp'/'fft'), which factorize the
+    kernels at trace time and reject tracer weights (ops/cp4d.py).
     """
     use_c2f = getattr(config, "mode", "oneshot") == "c2f"
 
-    @jax.jit
-    def step(params, source, target, batch_points):
+    def _step(params, source, target, batch_points):
         if not use_c2f:
             corr, _ = ncnet_forward(config, params, source, target)
             xa, ya, xb, yb, _ = corr_to_matches(corr, do_softmax=True)
@@ -73,6 +78,16 @@ def evaluate_pck(
                 outs = jax.lax.map(per_pair, (feat_a, feat_b))
                 xa, ya, xb, yb, _ = (o[:, 0] for o in outs)
         return pck_metric(batch_points, (xa, ya, xb, yb), alpha)
+
+    if bake_params:
+        baked = jax.jit(
+            lambda source, target, batch_points: _step(
+                params, source, target, batch_points))
+
+        def step(_params, source, target, batch_points):
+            return baked(source, target, batch_points)
+    else:
+        step = jax.jit(_step)
 
     loader = DataLoader(
         dataset, batch_size, shuffle=False, num_workers=num_workers

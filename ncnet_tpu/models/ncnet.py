@@ -76,8 +76,24 @@ class NCNetConfig:
     c2f_coarse_factor: int = 2
     c2f_topk: int = 8  # <= 0 means refine every coarse cell
     c2f_radius: int = 1
+    # Consensus plan override (ops/conv4d.py knob resolution: arg level).
+    # '' defers to env > strategy cache > auto; 'dense'/'fft' force those
+    # paths; 'cp' runs the CP-decomposed arm (ops/cp4d.py) at
+    # consensus_cp_rank — a declared approximation (the QoS cp rung).
+    consensus_kind: str = ""
+    consensus_cp_rank: int = 0
 
     def __post_init__(self):
+        if self.consensus_kind not in ("", "dense", "cp", "fft"):
+            raise ValueError(
+                f"consensus_kind must be ''/'dense'/'cp'/'fft', "
+                f"got {self.consensus_kind!r}"
+            )
+        if self.consensus_kind == "cp" and self.consensus_cp_rank < 1:
+            raise ValueError(
+                "consensus_kind='cp' needs consensus_cp_rank >= 1, "
+                f"got {self.consensus_cp_rank}"
+            )
         if self.fused_impl not in ("auto", "xla"):
             raise ValueError(
                 f"fused_impl must be 'auto' or 'xla', got {self.fused_impl!r}"
@@ -157,7 +173,9 @@ def match_pipeline(config: NCNetConfig, params: Params, corr4d,
     corr4d = corr4d.astype(config.corr_dtype)
     corr4d = mutual_matching(corr4d, maxes=mutual1_maxes)
     corr4d = neigh_consensus_apply(
-        params["neigh_consensus"], corr4d, symmetric=config.symmetric_mode
+        params["neigh_consensus"], corr4d, symmetric=config.symmetric_mode,
+        kind=config.consensus_kind or None,
+        cp_rank=config.consensus_cp_rank or None,
     )
     if not final_mutual:
         return corr4d
@@ -354,6 +372,8 @@ def c2f_raw_matches_from_features(
     kwargs = dict(
         stride=stride, radius=config.c2f_radius, topk=config.c2f_topk,
         symmetric=config.symmetric_mode, corr_dtype=config.corr_dtype,
+        kind=config.consensus_kind or None,
+        cp_rank=config.consensus_cp_rank or None,
     )
     consensus = params["neigh_consensus"]
 

@@ -136,26 +136,70 @@ def layers_from_config(config) -> List[Tuple[Tuple[int, ...], int, int]]:
     return out
 
 
+def _avg_taps(k: int, g: int) -> float:
+    """Mean in-bounds tap count per output position of a SAME-padded
+    1-D convolution, kernel ``k`` over ``g`` positions — the exact
+    valid-MAC average once border overhang is excluded."""
+    k, g = int(k), int(g)
+    if g <= 0:
+        return float(k)
+    half = (k - 1) // 2
+    total = 0
+    for i in range(g):
+        total += min(i + half, g - 1) - max(i - half, 0) + 1
+    return total / g
+
+
 def consensus_model(layers, cells: int, *, symmetric: bool,
                     dtype_bytes: int, batch: int = 1,
-                    applications: int = 1) -> dict:
+                    applications: int = 1, kind: str = "dense",
+                    cp_rank: int = 0, dims=None) -> dict:
     """Textbook cost of the consensus stack over ``cells`` 4-D positions.
 
-    Per layer: ``2 * cells * prod(kernel) * cin * cout`` FLOPs (2 per
-    MAC) and ``cells * (cin + cout) * dtype_bytes`` activation traffic
-    (weights are negligible at these channel counts). ``symmetric``
-    doubles everything (the A<->B-transposed second branch);
-    ``batch``/``applications`` scale for scanned pair stacks and
-    repeated window applies. Deliberately a lower bound: no bias/ReLU
-    FLOPs, no layout copies — see module docstring for why that is the
-    honest direction."""
+    Per dense layer: ``2 * cells * prod(kernel) * cin * cout`` FLOPs (2
+    per MAC) and ``cells * (cin + cout) * dtype_bytes`` activation
+    traffic (weights are negligible at these channel counts). When the
+    4-D grid ``dims`` is given, ``prod(kernel)`` tightens to the exact
+    valid-MAC average per dim (XLA counts no border-overhang MACs, and
+    at smoke-size grids the overhang is a >2x overcount — without the
+    correction ``model_ok`` fails honest small-shape cards). The
+    algebraic arms (ops/cp4d.py) do fundamentally less arithmetic, so
+    the lower bound must be ARM-AWARE or ``model_ok`` would correctly
+    call a CP card a lie (dense bound > measured CP FLOPs):
+
+      * ``kind='cp'``: the rank-R channel mixes alone,
+        ``2 * cells * R * cin * cout`` with R clamped to the tap count
+        — an honest floor below the separable-stage cost (XLA's HLO
+        accounting of the fused per-axis shift-add stages lands well
+        under the textbook 1-D-conv figure, same slack as fft below).
+      * ``kind='fft'``: the pointwise spectral product alone,
+        ``2 * cells * cin * cout`` — an honest floor below the
+        transform cost (FLOP-counting FFTs would over-claim vs XLA's
+        HLO accounting of fused twiddle stages).
+
+    ``symmetric`` doubles everything (the A<->B-transposed second
+    branch); ``batch``/``applications`` scale for scanned pair stacks
+    and repeated window applies. Deliberately a lower bound: no
+    bias/ReLU FLOPs, no layout copies — see module docstring for why
+    that is the honest direction."""
     flops = 0.0
     byts = 0.0
     for kernel, cin, cout in layers:
         k4 = 1
         for k in kernel:
             k4 *= int(k)
-        flops += 2.0 * cells * k4 * cin * cout
+        if kind == "cp":
+            r = min(max(int(cp_rank), 1), k4)
+            flops += 2.0 * cells * r * cin * cout
+        elif kind == "fft":
+            flops += 2.0 * cells * cin * cout
+        else:
+            taps = float(k4)
+            if dims is not None and len(dims) == len(kernel):
+                taps = 1.0
+                for k, g in zip(kernel, dims):
+                    taps *= _avg_taps(k, g)
+            flops += 2.0 * cells * taps * cin * cout
         byts += float(cells) * (cin + cout) * dtype_bytes
     mult = (2 if symmetric else 1) * max(int(batch), 1) \
         * max(int(applications), 1)
@@ -165,6 +209,8 @@ def consensus_model(layers, cells: int, *, symmetric: bool,
         "cells": int(cells),
         "layers": len(layers),
         "symmetric": bool(symmetric),
+        "kind": str(kind),
+        "cp_rank": int(cp_rank),
         "applications": int(applications) * max(int(batch), 1),
     }
 

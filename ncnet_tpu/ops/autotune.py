@@ -28,7 +28,8 @@ Cache file format (version 1)::
        "<backend kind>": {
          "<shape signature>": {
             "plan": {"strategies": [...]|null, "branch_fuse": bool,
-                     "kl_fold": int, "chunk_i": int},
+                     "kl_fold": int, "chunk_i": int,
+                     "kind": "dense"|"cp"|"fft", "cp_rank": int},
             "ms": float,            # measured steady ms per apply
             "tuned_at": str,        # ISO stamp, informational
             "candidates": int}}}}
@@ -60,7 +61,20 @@ PLAN_ENV_KEYS = (
     "NCNET_CONSENSUS_BRANCH_FUSE",
     "NCNET_CONSENSUS_KL_FOLD",
     "NCNET_CONSENSUS_CHUNK_I",
+    "NCNET_CONSENSUS_KIND",
+    "NCNET_CONSENSUS_CP_RANK",
 )
+
+# Consensus arm families. 'dense' is the exact strategy-zoo path;
+# 'cp' (CP-decomposed kernels, ops/cp4d.py — approximate below full
+# rank, sold as QoS rungs) and 'fft' (spectral pointwise products) are
+# the algebraic arms docs/NEXT.md's roofline verdict called for.
+PLAN_KINDS = ("dense", "cp", "fft")
+
+# The truncated ranks enumerate_plans offers for the cp family. Full
+# rank (= the kernel tap count) is exact but never *faster* than the
+# tuned dense arm at the 5^4 shapes, so the tuner doesn't time it.
+CP_RANKS = (4, 8, 16)
 
 # The channels-last strategies the one-shot fast path expresses; the
 # enumeration's per-layer mixes draw from these (convnd/conv3d mixes
@@ -121,13 +135,20 @@ def shape_signature(corr_shape, dtype, params, symmetric: bool) -> str:
 
 
 def normalize_plan(plan: dict) -> dict:
-    """Fill knob defaults and canonicalize types (dedupe/cache key)."""
+    """Fill knob defaults and canonicalize types (dedupe/cache key).
+
+    Pre-existing 4-knob cache entries normalize to the dense arm
+    (kind='dense', cp_rank=0) — the schema change never invalidates a
+    tuned dense plan.
+    """
     s = plan.get("strategies")
     return {
         "strategies": list(s) if s else None,
         "branch_fuse": bool(plan.get("branch_fuse", True)),
         "kl_fold": int(plan.get("kl_fold") or 0),
         "chunk_i": int(plan.get("chunk_i") or 0),
+        "kind": str(plan.get("kind") or "dense"),
+        "cp_rank": int(plan.get("cp_rank") or 0),
     }
 
 
@@ -138,6 +159,10 @@ def plan_key(plan: dict) -> str:
 def plan_label(plan: dict) -> str:
     """Short human label for bench lines / obs events."""
     p = normalize_plan(plan)
+    if p["kind"] == "cp":
+        return f"cp:rank={p['cp_rank']}"
+    if p["kind"] == "fft":
+        return "fft"
     s = ",".join(x or "auto" for x in p["strategies"]) \
         if p["strategies"] else "auto"
     bits = [s, "fused" if p["branch_fuse"] else "unfused"]
@@ -162,6 +187,8 @@ def plan_env(plan: dict) -> dict:
         "NCNET_CONSENSUS_BRANCH_FUSE": "1" if p["branch_fuse"] else "0",
         "NCNET_CONSENSUS_KL_FOLD": str(p["kl_fold"]),
         "NCNET_CONSENSUS_CHUNK_I": str(p["chunk_i"]),
+        "NCNET_CONSENSUS_KIND": p["kind"],
+        "NCNET_CONSENSUS_CP_RANK": str(p["cp_rank"]),
     }
     if p["strategies"]:
         env["NCNET_CONSENSUS_STRATEGIES"] = ",".join(
@@ -171,7 +198,8 @@ def plan_env(plan: dict) -> dict:
 
 
 def enumerate_plans(params, *, symmetric: bool = True,
-                    kl_folds=(0, 2, 4), chunks=(0,)):
+                    kl_folds=(0, 2, 4), chunks=(0,),
+                    cp_ranks=CP_RANKS, with_fft: bool = True):
     """The legal candidate space for (params, symmetric).
 
     Pruning rules (each is a hard constraint of neigh_consensus_apply,
@@ -183,24 +211,36 @@ def enumerate_plans(params, *, symmetric: bool = True,
       * branch fusion exists only for the symmetric one-shot path;
         chunked candidates are emitted unfused only (the knob is inert
         there — two labels for one program would skew a sweep's stats).
+      * the algebraic arms ('cp:rank=R', 'fft' — ops/cp4d.py) carry no
+        strategy/fold/chunk knobs and are emitted unfused: their
+        symmetric branch shares the forward factors/spectra already, so
+        a 'fused' twin would be two labels for one program. Disable
+        with cp_ranks=() / with_fft=False (the dense-only sweep the
+        closed docs/NEXT.md ledger rounds ran).
     """
     n = len(params)
     mixes = [None] + [list(c) for c in
                       itertools.product(CL_STRATEGIES, repeat=n)]
     plans, seen = [], set()
+
+    def emit(raw):
+        plan = normalize_plan(raw)
+        key = plan_key(plan)
+        if key not in seen:
+            seen.add(key)
+            plans.append(plan)
+
     for mix, fold, chunk in itertools.product(mixes, kl_folds, chunks):
         if fold > 1 and (chunk or mix is None):
             continue
         fuses = (True, False) if (symmetric and not chunk) else (False,)
         for fuse in fuses:
-            plan = normalize_plan({
-                "strategies": mix, "branch_fuse": fuse,
-                "kl_fold": fold, "chunk_i": chunk,
-            })
-            key = plan_key(plan)
-            if key not in seen:
-                seen.add(key)
-                plans.append(plan)
+            emit({"strategies": mix, "branch_fuse": fuse,
+                  "kl_fold": fold, "chunk_i": chunk})
+    for rank in cp_ranks:
+        emit({"kind": "cp", "cp_rank": int(rank), "branch_fuse": False})
+    if with_fft:
+        emit({"kind": "fft", "branch_fuse": False})
     return plans
 
 
@@ -213,10 +253,16 @@ def _valid_plan(plan, params) -> bool:
                 or any(x is not None and x not in _KNOWN_STRATEGIES
                        for x in s)):
             return False
+    kind = plan.get("kind") or "dense"
+    if kind not in PLAN_KINDS:
+        return False
     try:
         int(plan.get("kl_fold") or 0)
         int(plan.get("chunk_i") or 0)
+        rank = int(plan.get("cp_rank") or 0)
     except (TypeError, ValueError):
+        return False
+    if kind == "cp" and rank < 1:
         return False
     return True
 
@@ -389,11 +435,14 @@ def winner_card(params, corr, symmetric, plan, ms):
         cells = 1
         for d in corr.shape[2:]:
             cells *= int(d)
+        p = normalize_plan(plan)
         model = costcards.consensus_model(
             costcards.consensus_layers(params), cells,
             symmetric=symmetric,
             dtype_bytes=int(np.dtype(corr.dtype).itemsize),
             batch=int(corr.shape[0]),
+            kind=p["kind"], cp_rank=p["cp_rank"],
+            dims=tuple(int(d) for d in corr.shape[2:]),
         )
         card = costcards.make_card(
             program="consensus_plan",

@@ -120,17 +120,21 @@ def window_correlation(win_a, win_b, compute_dtype=jnp.bfloat16):
 
 
 def refine_consensus(consensus_params, win_corr, *, symmetric: bool = True,
-                     corr_dtype=jnp.float32):
+                     corr_dtype=jnp.float32, kind=None, cp_rank=None):
     """mutual -> neighborhood consensus -> mutual on the window stack.
 
     The windows ride the batch axis, and both mutual_matching and
     neigh_consensus_apply reduce/convolve per batch element, so each
     window gets its own mutual-NN normalization — the semantics the
     one-shot pipeline applies globally, restricted to the crop.
+
+    ``kind``/``cp_rank`` are the consensus plan override (arg level of
+    the ops/conv4d.py knob resolution); None defers to env/cache/auto.
     """
     c = win_corr.astype(corr_dtype)
     c = mutual_matching(c)
-    c = neigh_consensus_apply(consensus_params, c, symmetric=symmetric)
+    c = neigh_consensus_apply(consensus_params, c, symmetric=symmetric,
+                              kind=kind, cp_rank=cp_rank)
     c = mutual_matching(c)
     return c.astype(jnp.float32)
 
@@ -202,7 +206,7 @@ def splice_matches(refined, top_cells, cell_scores, matched_b, start_bi,
 def refine_from_gate(consensus_params, top_cells, cell_scores, matched_b,
                      feat_a, feat_b, *, coarse_shape, stride: int,
                      radius: int, symmetric: bool = True,
-                     corr_dtype=jnp.float32):
+                     corr_dtype=jnp.float32, kind=None, cp_rank=None):
     """Stage 2 from precomputed gate arrays: gather -> correlate ->
     consensus -> splice. Split out of :func:`c2f_refine_direction` so a
     serving engine can run the gate (stage 1) and the refinement (stage 2)
@@ -214,7 +218,8 @@ def refine_from_gate(consensus_params, top_cells, cell_scores, matched_b,
     )
     corr = window_correlation(win_a, win_b)
     refined = refine_consensus(
-        consensus_params, corr, symmetric=symmetric, corr_dtype=corr_dtype
+        consensus_params, corr, symmetric=symmetric, corr_dtype=corr_dtype,
+        kind=kind, cp_rank=cp_rank,
     )
     fine_shape = (feat_a.shape[2], feat_a.shape[3],
                   feat_b.shape[2], feat_b.shape[3])
@@ -226,7 +231,8 @@ def refine_from_gate(consensus_params, top_cells, cell_scores, matched_b,
 
 def c2f_refine_direction(consensus_params, coarse4d, feat_a, feat_b, *,
                          stride: int, radius: int, topk: int,
-                         symmetric: bool = True, corr_dtype=jnp.float32):
+                         symmetric: bool = True, corr_dtype=jnp.float32,
+                         kind=None, cp_rank=None):
     """Full stage-2 for one probe direction (one match per fine A cell).
 
     For the per-B direction, call with the coarse tensor transposed
@@ -239,7 +245,8 @@ def c2f_refine_direction(consensus_params, coarse4d, feat_a, feat_b, *,
     return refine_from_gate(
         consensus_params, top_cells, cell_scores, matched_b, feat_a, feat_b,
         coarse_shape=(ha, wa, hb, wb), stride=stride, radius=radius,
-        symmetric=symmetric, corr_dtype=corr_dtype,
+        symmetric=symmetric, corr_dtype=corr_dtype, kind=kind,
+        cp_rank=cp_rank,
     )
 
 
@@ -336,7 +343,8 @@ def gate_update_from_splice(i_m, j_m, score, *, coarse_shape, stride: int,
 def refine_from_seed(consensus_params, seed_cells, cell_scores, matched_b,
                      feat_a, feat_b, *, coarse_shape, stride: int,
                      radius: int, seed_radius: int, topk: int,
-                     symmetric: bool = True, corr_dtype=jnp.float32):
+                     symmetric: bool = True, corr_dtype=jnp.float32,
+                     kind=None, cp_rank=None):
     """Stage 2 gated by the previous frame's survivors instead of a
     coarse pass: dilate -> select -> gather -> correlate -> consensus ->
     splice, plus the updated gate the NEXT frame seeds from.
@@ -355,7 +363,8 @@ def refine_from_seed(consensus_params, seed_cells, cell_scores, matched_b,
     fields = refine_from_gate(
         consensus_params, top_cells, cell_scores, matched_b, feat_a, feat_b,
         coarse_shape=coarse_shape, stride=stride, radius=radius,
-        symmetric=symmetric, corr_dtype=corr_dtype,
+        symmetric=symmetric, corr_dtype=corr_dtype, kind=kind,
+        cp_rank=cp_rank,
     )
     _i_a, _j_a, i_b, j_b, score = fields
     new_gate = gate_update_from_splice(

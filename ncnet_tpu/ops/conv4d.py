@@ -913,7 +913,8 @@ def _consensus_oneshot_cl(params, corr, symmetric, strategies,
 
 
 def neigh_consensus_apply(
-    params, corr, *, symmetric: bool = True, chunk_i=None, strategies=None
+    params, corr, *, symmetric: bool = True, chunk_i=None,
+    strategies=None, kind=None, cp_rank=None
 ):
     """Apply the neighbourhood-consensus Conv4d+ReLU stack.
 
@@ -948,6 +949,13 @@ def neigh_consensus_apply(
         NCNET_CONSENSUS_STRATEGIES env var (comma-separated, read at
         trace time, e.g. "conv2d_stacked,conv2d_outstacked") so a
         hardware session can A/B full-pipeline mixes without code edits.
+      kind: consensus arm family — 'dense' (the strategy zoo below),
+        'cp' (CP-decomposed kernels, ops/cp4d.py — EXACT at full rank,
+        a declared approximation below it, sold as QoS rungs), or
+        'fft' (spectral pointwise products). None falls back to
+        NCNET_CONSENSUS_KIND, then the cached plan, then 'dense'.
+      cp_rank: rank for the cp arm (>= 1; >= the kernel tap count is
+        exact). None falls back to NCNET_CONSENSUS_CP_RANK / cache.
 
     Returns:
       [b, c_last, iA, jA, iB, jB].
@@ -958,6 +966,8 @@ def neigh_consensus_apply(
         "chunk_i": "arg" if chunk_i is not None else None,
         "kl_fold": None,
         "branch_fuse": None,
+        "kind": "arg" if kind is not None else None,
+        "cp_rank": "arg" if cp_rank is not None else None,
     }
     if strategies is None:
         env = os.environ.get("NCNET_CONSENSUS_STRATEGIES")
@@ -990,6 +1000,16 @@ def neigh_consensus_apply(
     branch_fuse = (env_fuse or "1") != "0"
     if env_fuse is not None:
         src["branch_fuse"] = "env"
+    if kind is None:
+        env_kind = os.environ.get("NCNET_CONSENSUS_KIND")
+        if env_kind:
+            kind = env_kind
+            src["kind"] = "env"
+    if cp_rank is None:
+        env_rank = os.environ.get("NCNET_CONSENSUS_CP_RANK")
+        if env_rank is not None:
+            cp_rank = int(env_rank)
+            src["cp_rank"] = "env"
 
     # Persistent strategy cache (ops/autotune.py, read at trace time): a
     # tuned plan recorded for this (backend kind, shape signature) fills
@@ -1020,6 +1040,45 @@ def neigh_consensus_apply(
                     and plan.get("branch_fuse") is not None):
                 branch_fuse = bool(plan["branch_fuse"])
                 src["branch_fuse"] = "cache"
+            if src["kind"] is None and plan.get("kind"):
+                kind = str(plan["kind"])
+                src["kind"] = "cache"
+            if src["cp_rank"] is None and plan.get("cp_rank") is not None:
+                cp_rank = int(plan["cp_rank"])
+                src["cp_rank"] = "cache"
+
+    # Algebraic arm dispatch (ops/cp4d.py) — the resolved kind knob
+    # routes the whole stack before any dense-path planning. The cp arm
+    # is EXACT at full rank and a declared approximation below it; the
+    # serving layer only reaches it through an explicit plan override
+    # (QoS rung / request['consensus']), never by accident.
+    kind = kind or "dense"
+    if kind not in ("dense", "cp", "fft"):
+        raise ValueError(
+            f"unknown consensus kind {kind!r} (dense|cp|fft)")
+    if kind != "dense":
+        from . import cp4d  # lazy: cp4d imports autotune, which times this fn
+
+        if kind == "cp" and not cp_rank:
+            raise ValueError("kind='cp' requires cp_rank >= 1")
+        LAST_PLAN = {
+            "path": kind,
+            "strategies": None,
+            "fused": False,
+            "kl_fold": 0,
+            "chunk_i": 0,
+            "kind": kind,
+            "cp_rank": int(cp_rank) if kind == "cp" else 0,
+            "symmetric": symmetric,
+            "cache_hit": cache_hit,
+            "cache_ms": cache_ms,
+            "source": {k: (v or "auto") for k, v in src.items()},
+        }
+        if kind == "cp":
+            return cp4d.consensus_cp_apply(
+                params, corr, rank=int(cp_rank), symmetric=symmetric)
+        return cp4d.consensus_fft_apply(
+            params, corr, symmetric=symmetric)
     b, cin, si, sj, sk, sl = corr.shape
     # The swapped symmetric branch convolves I with each kernel's K-extent
     # (swap_ab_weight), so the carried halo must cover both branch's
@@ -1133,6 +1192,8 @@ def neigh_consensus_apply(
                     "fused": fuse,
                     "kl_fold": kl_fold if kl_fold > 1 else 0,
                     "chunk_i": 0,
+                    "kind": "dense",
+                    "cp_rank": 0,
                     "symmetric": symmetric,
                     "cache_hit": cache_hit,
                     "cache_ms": cache_ms,
@@ -1149,6 +1210,8 @@ def neigh_consensus_apply(
             "fused": False,
             "kl_fold": kl_fold if kl_fold > 1 else 0,
             "chunk_i": 0,
+            "kind": "dense",
+            "cp_rank": 0,
             "symmetric": symmetric,
             "cache_hit": cache_hit,
             "cache_ms": cache_ms,
@@ -1169,6 +1232,8 @@ def neigh_consensus_apply(
         "fused": False,
         "kl_fold": 0,
         "chunk_i": int(chunk_i),
+        "kind": "dense",
+        "cp_rank": 0,
         "symmetric": symmetric,
         "cache_hit": cache_hit,
             "cache_ms": cache_ms,

@@ -1,0 +1,603 @@
+"""CP-decomposed and FFT consensus arms — conv4d by algebra, not layout.
+
+docs/NEXT.md's round-5 verdict closed the scheduling road: at the
+reference model shape the 4-D consensus stage is layout-copy bound and
+cannot be tiled faster. This module changes the *math* instead:
+
+  * **CP (canonical polyadic) decomposition** (Lebedev et al.,
+    arXiv:1412.6553): factor each [kI,kJ,kK,kL,cin,cout] consensus
+    kernel as
+
+        W[i,j,k,l,c,n] ~= sum_r A[r,i] B[r,j] C[r,k] D[r,l] M[r,c,n]
+
+    — four separable 1-D spatial stages (batched scalar-weighted
+    shifted adds the MXU/VPU like) plus one cin x cout channel mix per
+    rank. Rank R >= kI*kJ*kK*kL is EXACT via the delta basis (one rank
+    component per kernel tap, one-hot spatial factors): the apply
+    detects one-hot factor rows host-side and lowers those stages to
+    pure slices, so the full-rank path is literally `conv4d_reference`'s
+    patch-slice + einsum loop in the same tap order with the same f32
+    accumulator — bitwise identical by construction (arithmetic with
+    one-hot factors would NOT be: +-0.0 and reduction-order hazards).
+    Truncated ranks use successive-SVD initialization + ALS sweeps
+    (host-side numpy over the tiny k^4 x cin x cout tensor) and are
+    APPROXIMATE — they ship only as declared QoS rungs (serving/qos.py
+    `cp:rank=N`), never as the full-quality arm.
+
+  * **FFT convolution** (Mathieu et al., arXiv:1312.5851): rfftn over
+    the four spatial dims of the zero-padded input, pointwise product
+    with the flipped-kernel spectrum (cross-correlation == convolution
+    with the spatially flipped kernel), irfftn, crop to 'same'. The
+    kernel spectra are built from the closed-over concrete weights at
+    trace time, so XLA constant-folds them — nothing is recomputed per
+    step. f32 compute; approximate at the last-ulp level (tolerance
+    gated, not bitwise).
+
+Both arms are dispatched by `neigh_consensus_apply` (ops/conv4d.py)
+when the resolved plan's `kind` knob says so (arg > env > cache > auto,
+like every other plan knob), and enumerated by `ops/autotune.py` as
+`cp:rank=R` / `fft` candidate plans.
+
+Factorization cache: ALS output is persisted to
+`trained_models/consensus_cp.json` (next to the strategy cache), keyed
+by sha256(weight bytes) + rank, so factorization runs once per
+checkpoint — a weight change invalidates by digest, not by mtime.
+Exact (delta-basis) factorizations are cheap to rebuild and are NOT
+persisted. `NCNET_CP_FACTOR_CACHE` overrides the path ('' disables).
+
+`python -m ncnet_tpu.ops.cp4d --selftest` prints the ci_gate contract:
+one JSON line proving the rank-full bitwise identity and a
+truncated-rank agreement floor on CPU (tools/ci_gate.py
+--with-cp-parity).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+FACTOR_CACHE_BASENAME = "consensus_cp.json"
+FACTOR_CACHE_VERSION = 1
+
+# Declared per-rank agreement floors for the consensus kernels — the
+# minimum output correlation vs the dense arm a truncated rank must
+# clear to ship as a QoS rung (tests + ci_gate --with-cp-parity +
+# tools/real_parity.py --consensus report against these). Calibrated
+# against
+# the WORST case — random Gaussian init, whose flat 4-D spectrum is
+# near-incompressible (measured on the (3,3)/(8,1) stack: rank 4 ->
+# 0.23, 8 -> 0.29, 16 -> 0.59, 32 -> 0.91). Trained consensus kernels
+# are strongly low-rank (the useful signal is a near-separable
+# center-surround stencil) and sit well above these floors.
+DECLARED_AGREEMENT_FLOOR = {4: 0.10, 8: 0.20, 16: 0.40}
+
+# Declared per-rank PCK-drop budgets — how much end-to-end keypoint
+# accuracy a cp:rank=N rung is ALLOWED to give up vs the dense arm
+# before tools/real_parity.py --consensus fails its gate. Generous by
+# design: the rung exists to shed load, and the budget is the number
+# the rung promises, not the number it typically achieves (trained
+# kernels are near-separable and land far inside it).
+DECLARED_PCK_DROP = {4: 0.50, 8: 0.30, 16: 0.15}
+
+
+def declared_pck_drop(rank: int) -> float:
+    """PCK-drop budget for a cp rung at ``rank`` (nearest declared rank
+    at or below; below the smallest declared rank, its budget)."""
+    best = None
+    for r in sorted(DECLARED_PCK_DROP):
+        if r <= rank:
+            best = DECLARED_PCK_DROP[r]
+    if best is None:
+        best = DECLARED_PCK_DROP[min(DECLARED_PCK_DROP)]
+    return best
+
+# In-process factor memo keyed (weight digest, rank): serving warmup
+# re-traces per shape bucket and the autotuner traces per candidate —
+# the ALS must run once per checkpoint, not once per trace. The JSON
+# cache below persists the same result across processes.
+# guarded-by: atomic -- GIL-atomic dict ops; racing warmup threads
+_FACTOR_MEMO: dict = {}
+
+
+def factor_cache_path():
+    """Resolved factorization cache path, or None when disabled.
+
+    NCNET_CP_FACTOR_CACHE: unset -> next to the strategy cache
+    (ops/autotune.py cache_path(), so NCNET_STRATEGY_CACHE='' disables
+    both — the tuner's plan_overrides must not let candidates write
+    caches); empty string -> disabled; anything else -> that path.
+    """
+    env = os.environ.get("NCNET_CP_FACTOR_CACHE")
+    if env is not None:
+        return env or None
+    from .autotune import cache_path
+
+    base = cache_path()
+    if not base:
+        return None
+    return os.path.join(os.path.dirname(base) or ".",
+                        FACTOR_CACHE_BASENAME)
+
+
+def weight_digest(weight) -> str:
+    """Checkpoint identity of one kernel: sha256 over the f32 bytes +
+    shape — a retrained checkpoint invalidates by content."""
+    w = np.ascontiguousarray(np.asarray(weight, dtype=np.float32))
+    h = hashlib.sha256()
+    h.update(str(w.shape).encode())
+    h.update(w.tobytes())
+    return h.hexdigest()[:20]
+
+
+def _read_factor_cache(path):
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if (not isinstance(data, dict)
+                or data.get("version") != FACTOR_CACHE_VERSION
+                or not isinstance(data.get("entries"), dict)):
+            return None
+        return data
+    except (OSError, ValueError):
+        return None
+
+
+def _cache_lookup(digest: str, rank: int, shape):
+    path = factor_cache_path()
+    if not path:
+        return None
+    data = _read_factor_cache(path)
+    if not data:
+        return None
+    rec = data["entries"].get(f"{digest}|rank={rank}")
+    if not isinstance(rec, dict):
+        return None
+    try:
+        ki, kj, kk, kl, cin, cout = shape
+        f = {
+            "a": np.asarray(rec["a"], np.float32),
+            "b": np.asarray(rec["b"], np.float32),
+            "c": np.asarray(rec["c"], np.float32),
+            "d": np.asarray(rec["d"], np.float32),
+            "core": np.asarray(rec["core"], np.float32),
+            "rank": int(rec["rank"]),
+            "rel_err": float(rec["rel_err"]),
+            "exact": False,
+        }
+        r = f["rank"]
+        if (f["a"].shape != (r, ki) or f["b"].shape != (r, kj)
+                or f["c"].shape != (r, kk) or f["d"].shape != (r, kl)
+                or f["core"].shape != (r, cin, cout)):
+            return None
+        return f
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _cache_store(digest: str, rank: int, factors: dict):
+    path = factor_cache_path()
+    if not path:
+        return None
+    data = _read_factor_cache(path) or {
+        "version": FACTOR_CACHE_VERSION, "entries": {}}
+    data["entries"][f"{digest}|rank={rank}"] = {
+        "rank": int(factors["rank"]),
+        "rel_err": float(factors["rel_err"]),
+        "a": factors["a"].tolist(),
+        "b": factors["b"].tolist(),
+        "c": factors["c"].tolist(),
+        "d": factors["d"].tolist(),
+        "core": factors["core"].tolist(),
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def _delta_factors(w: np.ndarray) -> dict:
+    """Exact full-rank CP: one rank component per kernel tap, one-hot
+    spatial factors, core[r] = W[tap] verbatim (a copy, no arithmetic).
+    Rank order is the (di, dj, dk, dl) lexicographic tap order —
+    exactly `conv4d_reference`'s accumulation order."""
+    ki, kj, kk, kl, cin, cout = w.shape
+    r4 = ki * kj * kk * kl
+    a = np.zeros((r4, ki), np.float32)
+    b = np.zeros((r4, kj), np.float32)
+    c = np.zeros((r4, kk), np.float32)
+    d = np.zeros((r4, kl), np.float32)
+    core = np.zeros((r4, cin, cout), np.float32)
+    r = 0
+    for di in range(ki):
+        for dj in range(kj):
+            for dk in range(kk):
+                for dl in range(kl):
+                    a[r, di] = b[r, dj] = c[r, dk] = d[r, dl] = 1.0
+                    core[r] = w[di, dj, dk, dl]
+                    r += 1
+    return {"a": a, "b": b, "c": c, "d": d, "core": core, "rank": r4,
+            "rel_err": 0.0, "exact": True}
+
+
+def _khatri_rao(factors):
+    """Row-wise Kronecker: K[r, flat(other modes)] in axis order."""
+    k = np.ones((factors[0].shape[0], 1))
+    for f in factors:
+        k = (k[:, :, None] * f[:, None, :]).reshape(k.shape[0], -1)
+    return k
+
+
+def _als_factors(w: np.ndarray, rank: int, sweeps: int) -> dict:
+    """Truncated CP via successive-SVD init + ALS (float64 host math).
+
+    Modes are (i, j, k, l, cn) with the flat cin*cout channel matrix as
+    the fifth, norm-absorbing factor. Each ALS half-step solves the
+    Khatri-Rao normal equations with a small ridge — the tensors are
+    tiny (<= 5^4 * 16 * 16 elements), so a full sweep is microseconds.
+    """
+    ki, kj, kk, kl, cin, cout = w.shape
+    t = w.astype(np.float64).reshape(ki, kj, kk, kl, cin * cout)
+    dims = t.shape
+    norm_t = np.linalg.norm(t)
+    rng = np.random.RandomState(0)
+
+    def init(axis):
+        unf = np.moveaxis(t, axis, 0).reshape(dims[axis], -1)
+        u, _, _ = np.linalg.svd(unf, full_matrices=False)
+        f = np.empty((rank, dims[axis]))
+        for r in range(rank):
+            f[r] = u[:, r % u.shape[1]]
+            if r >= u.shape[1]:
+                # Repeated singular vectors must be perturbed or the
+                # normal equations are singular for R > mode dim.
+                f[r] += 0.05 * rng.standard_normal(dims[axis])
+        return f
+
+    factors = [init(ax) for ax in range(5)]
+    prev = None
+    for _ in range(max(1, sweeps)):
+        for mode in range(5):
+            others = [factors[o] for o in range(5) if o != mode]
+            k = _khatri_rao(others)
+            unf = np.moveaxis(t, mode, 0).reshape(dims[mode], -1)
+            g = k @ k.T
+            g[np.diag_indices_from(g)] += 1e-10 * max(1.0, g.max())
+            factors[mode] = np.linalg.solve(g, k @ unf.T)
+        approx = np.einsum(
+            "ri,rj,rk,rl,rm->ijklm", *factors, optimize=True)
+        err = np.linalg.norm(t - approx) / max(norm_t, 1e-30)
+        if prev is not None and prev - err < 1e-7:
+            break
+        prev = err
+    a, b, c, d, m = factors
+    return {
+        "a": a.astype(np.float32), "b": b.astype(np.float32),
+        "c": c.astype(np.float32), "d": d.astype(np.float32),
+        "core": m.astype(np.float32).reshape(rank, cin, cout),
+        "rank": rank, "rel_err": float(err), "exact": False,
+    }
+
+
+def cp_decompose(weight, rank: int, *, sweeps: int = 24) -> dict:
+    """Factorize one [kI,kJ,kK,kL,cin,cout] kernel at the given rank.
+
+    rank >= kI*kJ*kK*kL returns the EXACT delta-basis factorization
+    (rank clamped to the tap count, rel_err == 0.0, never persisted —
+    trivial to rebuild); smaller ranks run ALS once per (checkpoint
+    digest, rank) and are memoized in-process + persisted to the JSON
+    factor cache. Weights must be concrete (host or device) arrays —
+    the cp arm is an inference arm, not a differentiable layer.
+    """
+    if rank < 1:
+        raise ValueError(f"cp rank must be >= 1, got {rank}")
+    if isinstance(weight, jax.core.Tracer):
+        raise ValueError(
+            "cp_decompose needs concrete weights (the cp arm factorizes "
+            "per checkpoint at trace time; it is not differentiable)")
+    w = np.asarray(weight, dtype=np.float32)
+    if w.ndim != 6:
+        raise ValueError(f"expected [kI,kJ,kK,kL,cin,cout], got {w.shape}")
+    taps = int(np.prod(w.shape[:4]))
+    if rank >= taps:
+        rank = taps
+        digest = weight_digest(w)
+        memo_key = (digest, rank, "exact")
+        if memo_key not in _FACTOR_MEMO:
+            _FACTOR_MEMO[memo_key] = _delta_factors(w)
+        return _FACTOR_MEMO[memo_key]
+    digest = weight_digest(w)
+    memo_key = (digest, rank)
+    if memo_key in _FACTOR_MEMO:
+        return _FACTOR_MEMO[memo_key]
+    cached = _cache_lookup(digest, rank, w.shape)
+    if cached is not None:
+        _FACTOR_MEMO[memo_key] = cached
+        return cached
+    factors = _als_factors(w, rank, sweeps)
+    _FACTOR_MEMO[memo_key] = factors
+    _cache_store(digest, rank, factors)
+    return factors
+
+
+def reconstruct_weight(factors: dict) -> np.ndarray:
+    """The rank-R kernel the factors actually encode (tests/reporting)."""
+    return np.einsum(
+        "ri,rj,rk,rl,rcn->ijklcn", factors["a"], factors["b"],
+        factors["c"], factors["d"], factors["core"], optimize=True)
+
+
+def swap_factors(factors: dict) -> dict:
+    """CP factors of the A<->B swapped kernel (ops/conv4d.py
+    swap_ab_weight): W'[i,j,k,l] = W[k,l,i,j] just exchanges the roles
+    of (A,B) and (C,D) — the factorization is reused, never re-run.
+    For the exact delta basis the rank components are additionally
+    re-sorted into the SWAPPED kernel's lexicographic tap order, so the
+    full-rank swapped branch accumulates in `conv4d_reference`'s order
+    for the swapped weight too (bitwise, not just equal)."""
+    f = {"a": factors["c"], "b": factors["d"], "c": factors["a"],
+         "d": factors["b"], "core": factors["core"],
+         "rank": factors["rank"], "rel_err": factors["rel_err"],
+         "exact": factors["exact"]}
+    if factors["exact"]:
+        taps = np.stack([np.argmax(f[k], axis=1) for k in "abcd"], 1)
+        perm = np.lexsort(
+            (taps[:, 3], taps[:, 2], taps[:, 1], taps[:, 0]))
+        f = dict(f, **{k: f[k][perm] for k in ("a", "b", "c", "d")},
+                 core=f["core"][perm])
+    return f
+
+
+def _one_hot_taps(factors: dict):
+    """Per-rank (di,dj,dk,dl) when EVERY spatial factor row is exactly
+    one-hot (one 1.0, rest 0.0 — numpy-exact, checked host-side at
+    trace time), else None. One-hot stages are applied as pure slices:
+    a delta filter's convolution IS a shift, which keeps the full-rank
+    path bitwise (multiplying by a stored 1.0 is exact, but a sum that
+    *includes* 0.0 * x terms is not guaranteed to preserve -0.0 or the
+    reference's reduction order)."""
+    rows = [factors[k] for k in ("a", "b", "c", "d")]
+    taps = []
+    for r in range(factors["rank"]):
+        tap = []
+        for f in rows:
+            row = f[r]
+            hot = np.flatnonzero(row != 0.0)
+            if hot.size != 1 or row[hot[0]] != 1.0:
+                return None
+            tap.append(int(hot[0]))
+        taps.append(tuple(tap))
+    return taps
+
+
+def _cp_apply_one(x, factors: dict, bias=None):
+    """One CP-factored conv4d layer; returns f32 like conv4d_reference.
+
+    Exact (all-one-hot) factors reproduce conv4d_reference's loop
+    verbatim: same pads, same patch slices, same einsum, same f32
+    accumulator, same tap order. General factors batch ALL ranks into
+    the channel dimension — the cheaper of (channel-mix first | last)
+    puts ``R * min(cin, cout)`` channels through four separable
+    shifted-add stages whose per-tap weights vary only per channel, so
+    the op count is rank-INDEPENDENT (a rank loop costs ~20 tiny XLA
+    ops per rank and is dispatch-bound at exactly the small grids the
+    QoS rungs serve; batched, the same arithmetic is ~22 ops total —
+    the measured 3x that puts cp under dense on the CPU smoke). Peak
+    memory scales with R, bounded by the tap-count clamp (<= 81).
+    """
+    b, cin, si, sj, sk, sl = x.shape
+    ki = factors["a"].shape[1]
+    kj = factors["b"].shape[1]
+    kk = factors["c"].shape[1]
+    kl = factors["d"].shape[1]
+    cout = factors["core"].shape[2]
+    pads = [(k // 2, k // 2) for k in (ki, kj, kk, kl)]
+    taps = _one_hot_taps(factors)
+    if taps is not None:
+        xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple(pads))
+        core = jnp.asarray(factors["core"])
+        out = jnp.zeros((b, cout, si, sj, sk, sl), dtype=jnp.float32)
+        for r, (di, dj, dk, dl) in enumerate(taps):
+            patch = xp[:, :, di:di + si, dj:dj + sj, dk:dk + sk,
+                       dl:dl + sl]
+            out = out + jnp.einsum("bcijkl,cn->bnijkl", patch, core[r])
+    else:
+        rank = int(factors["rank"])
+        core = jnp.asarray(factors["core"])  # (R, cin, cout)
+        rows = [np.asarray(factors[k]) for k in ("a", "b", "c", "d")]
+        xp = jnp.pad(x.astype(jnp.float32),
+                     ((0, 0), (0, 0)) + tuple(pads))
+        psz = xp.shape[2:]
+        mix_first = cout < cin
+        sizes = (si, sj, sk, sl)
+        if mix_first:
+            z = jnp.einsum("bcijkl,rcn->brnijkl", xp, core)
+            z = z.reshape(b, rank * cout, *psz)
+            rep = cout
+        else:
+            z = jnp.broadcast_to(xp[:, None], (b, rank, cin) + tuple(psz))
+            z = z.reshape(b, rank * cin, *psz)
+            rep = cin
+        for axis, (row, k) in enumerate(zip(rows, (ki, kj, kk, kl))):
+            w = np.repeat(row, rep, axis=0)  # (R*rep, taps)
+            acc = None
+            for dd in range(k):
+                term = jnp.asarray(w[:, dd]).reshape(
+                    1, -1, 1, 1, 1, 1) * lax.slice_in_dim(
+                        z, dd, dd + sizes[axis], axis=axis + 2)
+                acc = term if acc is None else acc + term
+            z = acc
+        if mix_first:
+            out = z.reshape(b, rank, cout, si, sj, sk, sl).sum(axis=1)
+        else:
+            out = jnp.einsum(
+                "brcijkl,rcn->bnijkl",
+                z.reshape(b, rank, cin, si, sj, sk, sl), core)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1, 1)
+    return out
+
+
+def cp_conv4d(x, weight, bias=None, *, rank: int):
+    """CP-factored 4-D convolution (size-preserving 'same' padding).
+
+    rank >= the kernel's tap count is bitwise-identical to
+    `conv4d_reference(x, weight, bias)` in f32 (tier-1 proof in
+    tests/test_cp4d.py); smaller ranks are the declared approximation.
+    Returns f32, like the reference.
+    """
+    return _cp_apply_one(x, cp_decompose(weight, rank), bias)
+
+
+def consensus_cp_apply(params, corr, *, rank: int, symmetric=True):
+    """The Conv4d+ReLU consensus stack on CP-factored kernels.
+
+    Same stack semantics as `neigh_consensus_apply`'s dense paths
+    (per-layer bias + ReLU, symmetric branch summed via role-swapped
+    factors — no transposes materialized), dispatched by the plan
+    resolver when kind == 'cp'. Output cast to the input dtype."""
+    factor_sets = [cp_decompose(layer["weight"], rank)
+                   for layer in params]
+
+    def stack(x, swap):
+        for layer, f in zip(params, factor_sets):
+            ff = swap_factors(f) if swap else f
+            y = _cp_apply_one(x, ff, layer["bias"])
+            x = jax.nn.relu(y).astype(corr.dtype)
+        return x
+
+    out = stack(corr, False)
+    if symmetric:
+        out = out + stack(corr, True)
+    return out
+
+
+def fft_conv4d(x, weight, bias=None):
+    """4-D 'same' convolution via rfftn pointwise products.
+
+    Cross-correlation (what conv4d computes) equals convolution with
+    the spatially flipped kernel, so: zero-pad each spatial axis to
+    s + k - 1 (linear, not circular), multiply by the flipped-kernel
+    spectrum, inverse-transform, crop the center. jax's rfftn caps at
+    3-D, so the 4-D transform composes a complex FFT on the first
+    spatial axis with a 3-D rfftn on the rest (separability). f32
+    compute; the spectra come from the (concrete, closed-over) weights
+    so XLA constant-folds them per trace. Returns f32.
+    """
+    b, cin, si, sj, sk, sl = x.shape
+    ki, kj, kk, kl, _, cout = weight.shape
+    full = (si + ki - 1, sj + kj - 1, sk + kk - 1, sl + kl - 1)
+    xf = jnp.fft.rfftn(x.astype(jnp.float32), s=full[1:], axes=(3, 4, 5))
+    xf = jnp.fft.fft(xf, n=full[0], axis=2)
+    h = jnp.asarray(weight, jnp.float32)[::-1, ::-1, ::-1, ::-1]
+    hf = jnp.fft.rfftn(h, s=full[1:], axes=(1, 2, 3))
+    hf = jnp.fft.fft(hf, n=full[0], axis=0)
+    yf = jnp.einsum("bcijkl,ijklcn->bnijkl", xf, hf)
+    y = jnp.fft.ifft(yf, n=full[0], axis=2)
+    y = jnp.fft.irfftn(y, s=full[1:], axes=(3, 4, 5))
+    out = lax.slice(
+        y,
+        (0, 0, ki // 2, kj // 2, kk // 2, kl // 2),
+        (b, cout, ki // 2 + si, kj // 2 + sj, kk // 2 + sk,
+         kl // 2 + sl))
+    if bias is not None:
+        out = out + bias.astype(jnp.float32).reshape(1, -1, 1, 1, 1, 1)
+    return out
+
+
+def consensus_fft_apply(params, corr, *, symmetric=True):
+    """The Conv4d+ReLU consensus stack on the FFT arm (kind == 'fft').
+
+    The swapped symmetric branch reuses the A<->B kernel identity
+    (ops/conv4d.py swap_ab_weight) so no activation transposes are
+    materialized. Output cast to the input dtype."""
+    from .conv4d import swap_ab_weight
+
+    def stack(x, swap):
+        for layer in params:
+            w = swap_ab_weight(layer["weight"]) if swap \
+                else layer["weight"]
+            y = fft_conv4d(x, w, layer["bias"])
+            x = jax.nn.relu(y).astype(corr.dtype)
+        return x
+
+    out = stack(corr, False)
+    if symmetric:
+        out = out + stack(corr, True)
+    return out
+
+
+def output_agreement(ref, cand) -> float:
+    """Scalar agreement between two consensus outputs: centered cosine
+    similarity (Pearson r over the flattened tensors) — the offline
+    stand-in for the serving shadow sampler's per-rung match agreement."""
+    a = np.asarray(ref, np.float64).ravel()
+    b = np.asarray(cand, np.float64).ravel()
+    a = a - a.mean()
+    b = b - b.mean()
+    denom = np.linalg.norm(a) * np.linalg.norm(b)
+    if denom == 0:
+        return 1.0 if np.allclose(a, b) else 0.0
+    return float(np.dot(a, b) / denom)
+
+
+def _selftest() -> dict:
+    """The ci_gate --with-cp-parity contract, on CPU:
+
+    1. rank-full cp_conv4d is BITWISE equal to conv4d_reference (f32);
+    2. a truncated rank clears its declared agreement floor;
+    3. the fft arm matches the reference within f32 tolerance.
+    """
+    from .conv4d import (
+        conv4d_reference,
+        neigh_consensus_apply,
+        neigh_consensus_init,
+    )
+
+    key = jax.random.PRNGKey(0)
+    params = neigh_consensus_init(key, (3, 3), (8, 1))
+    corr = jax.random.normal(
+        jax.random.PRNGKey(1), (1, 1, 6, 6, 6, 6), jnp.float32)
+
+    w0, b0 = params[0]["weight"], params[0]["bias"]
+    ref = np.asarray(conv4d_reference(corr, w0, b0))
+    full = np.asarray(cp_conv4d(corr, w0, b0, rank=3 ** 4))
+    bitwise = bool(np.array_equal(ref, full))
+
+    dense = np.asarray(jax.jit(
+        lambda c: neigh_consensus_apply(params, c, symmetric=True))(corr))
+    floor = DECLARED_AGREEMENT_FLOOR[8]
+    cp8 = np.asarray(consensus_cp_apply(
+        params, corr, rank=8, symmetric=True))
+    agreement = output_agreement(dense, cp8)
+
+    fft = np.asarray(fft_conv4d(corr, w0, b0))
+    fft_err = float(np.max(np.abs(fft - ref)) /
+                    max(float(np.max(np.abs(ref))), 1e-30))
+    ok = bitwise and agreement >= floor and fft_err < 1e-4
+    return {"metric": "cp_parity", "value": 1 if ok else 0,
+            "unit": "pass", "ok": ok, "bitwise_full_rank": bitwise,
+            "cp_rank": 8, "cp_agreement": round(agreement, 4),
+            "agreement_floor": floor, "fft_rel_err": fft_err}
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--selftest" in sys.argv:
+        report = _selftest()
+        # ncnet-lint: disable=bare-print — one-JSON-line stdout contract
+        print(json.dumps(report))
+        sys.exit(0 if report["ok"] else 1)
+    # ncnet-lint: disable=bare-print — one-JSON-line stdout contract
+    print(json.dumps({"error": "usage: python -m ncnet_tpu.ops.cp4d "
+                               "--selftest"}))
+    sys.exit(2)

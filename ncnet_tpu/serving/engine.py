@@ -85,6 +85,13 @@ class Prepared:
     #: a batch is op-homogeneous; None = the engine default, whose
     #: bucket keys are identical to the pre-QoS 3-tuples.
     c2f_op: Optional[Tuple[int, int, int]] = None
+    #: Non-default consensus plan (kind, cp_rank) — set when the request
+    #: (or a ``cp:`` QoS rung) forced a consensus arm (``dense``/``cp``/
+    #: ``fft``, ops/conv4d.py). Part of the bucket key AND the result-op
+    #: key, so a rank-R approximate batch can never share a program or a
+    #: cached result with full-quality traffic; None = the engine
+    #: default resolution (env > strategy cache > auto).
+    plan: Optional[Tuple[str, int]] = None
     #: Streaming-session context (serving/session.py), set only by
     #: :meth:`MatchEngine.prepare_session_frame`. Keys: ``seed`` (the
     #: previous frame's gate arrays, or None for a full coarse frame),
@@ -179,57 +186,12 @@ class MatchEngine:
             invert_direction=invert_direction,
         )
 
-        def _match_from_feats(params, feat_a, feat_b):
-            corr, delta = ncnet_forward_from_features(
-                config, params, feat_a, feat_b
-            )
-            return inloc_device_matches(corr, delta4d=delta, **match_kwargs)
-
-        # One scanned program per (bucket shapes, batch size): the whole
-        # batch is one dispatch, outputs stack to [b, n] per match array.
-        # Queries differ per request (unlike eval's one-query fan-out),
-        # so the scan body extracts BOTH sides' features.
-        @jax.jit
-        def _batch_pairs(params, q_stack, t_stack):
-            def body(_, qt):
-                q, t = qt
-                feat_a = extract_features(config, params, q[None])
-                feat_b = extract_features(config, params, t[None])
-                return None, _match_from_feats(params, feat_a, feat_b)
-
-            _, ms = jax.lax.scan(body, None, (q_stack, t_stack))
-            return ms
-
-        # Miss program under an active cache: additionally returns the
-        # pano feature stack (bf16 — the dtype the cache stores; every
-        # correlation path casts features to bf16 as its first op, so
-        # the hit replay is bit-identical, evals/feature_cache.py).
-        @jax.jit
-        def _batch_pairs_with_feats(params, q_stack, t_stack):
-            def body(_, qt):
-                q, t = qt
-                feat_a = extract_features(config, params, q[None])
-                feat_b = extract_features(config, params, t[None])
-                return None, (_match_from_feats(params, feat_a, feat_b),
-                              feat_b.astype(jnp.bfloat16))
-
-            _, (ms, feats) = jax.lax.scan(body, None, (q_stack, t_stack))
-            return ms, feats
-
-        # Hit program: pano features come from the host cache.
-        @jax.jit
-        def _batch_pairs_cached(params, q_stack, featb_stack):
-            def body(_, qf):
-                q, feat_b = qf
-                feat_a = extract_features(config, params, q[None])
-                return None, _match_from_feats(params, feat_a, feat_b)
-
-            _, ms = jax.lax.scan(body, None, (q_stack, featb_stack))
-            return ms
-
-        self._batch_pairs = _batch_pairs
-        self._batch_pairs_with_feats = _batch_pairs_with_feats
-        self._batch_pairs_cached = _batch_pairs_cached
+        # One-shot programs compile per CONSENSUS PLAN (dense default;
+        # cp/fft when a request or QoS rung forces an arm): the default
+        # trio builds eagerly so the no-plan path is unchanged.
+        self._pair_programs: dict = {}
+        (self._batch_pairs, self._batch_pairs_with_feats,
+         self._batch_pairs_cached) = self.pair_programs_for(None)
 
         # -- coarse-to-fine programs (mode='c2f') -------------------------
         # c2f programs compile per OPERATING POINT (coarse_factor, topk,
@@ -312,16 +274,145 @@ class MatchEngine:
         self._config_for_op(op)  # knob validation
         return None if op == self._c2f_default_op else op
 
-    def c2f_programs_for(self, op: Optional[Tuple[int, int, int]]):
+    # -- consensus plans ---------------------------------------------------
+
+    def _plan_from_knobs(self, knobs: dict) -> Optional[Tuple[str, int]]:
+        """Request-level ``consensus`` knob dict -> normalized
+        (kind, cp_rank) plan tuple, or None when it matches the engine
+        config's own override (so such requests keep default bucket
+        keys). Raises ValueError on bad knobs."""
+        allowed = {"kind", "rank"}
+        unknown = set(knobs) - allowed
+        if unknown:
+            raise ValueError(f"unknown consensus knobs: {sorted(unknown)}")
+        kind = str(knobs.get("kind", "") or "")
+        if kind not in ("dense", "cp", "fft"):
+            raise ValueError(
+                f"consensus kind must be 'dense'/'cp'/'fft', got {kind!r}")
+        try:
+            rank = int(knobs.get("rank", 0) or 0)
+        except (TypeError, ValueError) as exc:
+            raise ValueError(
+                f"consensus rank must be an integer: {exc}") from exc
+        if kind != "cp":
+            rank = 0
+        plan = (kind, rank)
+        self._config_for(None, plan)  # knob validation (cp needs rank>=1)
+        default = (self.config.consensus_kind, self.config.consensus_cp_rank)
+        return None if plan == default else plan
+
+    def _config_for(self, op: Optional[Tuple[int, int, int]],
+                    plan: Optional[Tuple[str, int]]):
+        """The model config with one (c2f op, consensus plan) variant
+        applied (validation rides NCNetConfig.__post_init__)."""
+        config = self._config_for_op(op)
+        if plan is None:
+            return config
+        kind, rank = plan
+        return dataclasses.replace(
+            config, consensus_kind=str(kind), consensus_cp_rank=int(rank))
+
+    def pair_programs_for(self, plan: Optional[Tuple[str, int]]):
+        """(plain, with_feats, cached) one-shot programs for one
+        consensus plan, built on first use and cached (same lifecycle
+        as c2f_programs_for)."""
+        key = None if plan is None else tuple(plan)
+        progs = self._pair_programs.get(key)
+        if progs is None:
+            progs = self._build_pair_programs(self._config_for(None, key))
+            self._pair_programs[key] = progs
+        return progs
+
+    def _bind_params(self, config):
+        """Concrete params to close over a plan-forcing program, else
+        None (params flow in as a traced argument, the default).
+
+        The cp arm factorizes the trained consensus kernels host-side
+        (ops/cp4d.cp_decompose refuses tracers) and the fft arm
+        constant-folds the kernel spectra — both need concrete weight
+        VALUES at trace time, so plan-bearing programs bake the engine's
+        params in as compile-time constants instead of tracing them.
+        """
+        if config.consensus_kind in ("cp", "fft"):
+            return self.params
+        return None
+
+    def _build_pair_programs(self, config):
+        """Build one consensus plan's one-shot program trio.
+
+        One scanned program per (bucket shapes, batch size): the whole
+        batch is one dispatch, outputs stack to [b, n] per match array.
+        Queries differ per request (unlike eval's one-query fan-out),
+        so the scan body extracts BOTH sides' features.
+        """
+        jax, jnp = self._jax, self._jnp
+        match_kwargs = self._match_kwargs
+        bound = self._bind_params(config)
+
+        def _match_from_feats(params, feat_a, feat_b):
+            corr, delta = ncnet_forward_from_features(
+                config, params, feat_a, feat_b
+            )
+            return inloc_device_matches(corr, delta4d=delta, **match_kwargs)
+
+        @jax.jit
+        def _batch_pairs(params, q_stack, t_stack):
+            params = bound if bound is not None else params
+
+            def body(_, qt):
+                q, t = qt
+                feat_a = extract_features(config, params, q[None])
+                feat_b = extract_features(config, params, t[None])
+                return None, _match_from_feats(params, feat_a, feat_b)
+
+            _, ms = jax.lax.scan(body, None, (q_stack, t_stack))
+            return ms
+
+        # Miss program under an active cache: additionally returns the
+        # pano feature stack (bf16 — the dtype the cache stores; every
+        # correlation path casts features to bf16 as its first op, so
+        # the hit replay is bit-identical, evals/feature_cache.py).
+        @jax.jit
+        def _batch_pairs_with_feats(params, q_stack, t_stack):
+            params = bound if bound is not None else params
+
+            def body(_, qt):
+                q, t = qt
+                feat_a = extract_features(config, params, q[None])
+                feat_b = extract_features(config, params, t[None])
+                return None, (_match_from_feats(params, feat_a, feat_b),
+                              feat_b.astype(jnp.bfloat16))
+
+            _, (ms, feats) = jax.lax.scan(body, None, (q_stack, t_stack))
+            return ms, feats
+
+        # Hit program: pano features come from the host cache.
+        @jax.jit
+        def _batch_pairs_cached(params, q_stack, featb_stack):
+            params = bound if bound is not None else params
+
+            def body(_, qf):
+                q, feat_b = qf
+                feat_a = extract_features(config, params, q[None])
+                return None, _match_from_feats(params, feat_a, feat_b)
+
+            _, ms = jax.lax.scan(body, None, (q_stack, featb_stack))
+            return ms
+
+        return _batch_pairs, _batch_pairs_with_feats, _batch_pairs_cached
+
+    def c2f_programs_for(self, op: Optional[Tuple[int, int, int]],
+                         plan: Optional[Tuple[str, int]] = None):
         """(coarse, coarse_cached, refine) jitted programs for one
-        operating point, built on first use and cached. Callers are the
-        batcher worker and startup warmup — effectively single-threaded;
-        a rare duplicate build is harmless (same programs, jit cache
-        dedups the compile)."""
-        key = self._c2f_default_op if op is None else tuple(op)
+        (operating point, consensus plan) pair, built on first use and
+        cached. Callers are the batcher worker and startup warmup —
+        effectively single-threaded; a rare duplicate build is harmless
+        (same programs, jit cache dedups the compile)."""
+        op_key = self._c2f_default_op if op is None else tuple(op)
+        key = (op_key, None if plan is None else tuple(plan))
         progs = self._c2f_programs.get(key)
         if progs is None:
-            progs = self._build_c2f_programs(self._config_for_op(key))
+            progs = self._build_c2f_programs(self._config_for(op_key, plan))
             self._c2f_programs[key] = progs
         return progs
 
@@ -342,6 +433,7 @@ class MatchEngine:
         both_directions = self._both_directions
         invert_direction = self._invert_direction
         stride = c2f_stride(config)
+        bound = self._bind_params(config)
 
         def _c2f_stage1(params, feat_a, feat_b):
             coarse4d, _delta = c2f_coarse_from_features(
@@ -362,7 +454,9 @@ class MatchEngine:
                           feat_b.shape[2], feat_b.shape[3])
             kw = dict(stride=s, radius=config.c2f_radius,
                       symmetric=config.symmetric_mode,
-                      corr_dtype=config.corr_dtype)
+                      corr_dtype=config.corr_dtype,
+                      kind=config.consensus_kind or None,
+                      cp_rank=config.consensus_cp_rank or None)
 
             def per_b():  # one match per fine B cell
                 _ts, tc, cs, mb = gate_b
@@ -392,6 +486,8 @@ class MatchEngine:
 
         @jax.jit
         def _c2f_coarse(params, q_stack, t_stack):
+            params = bound if bound is not None else params
+
             def body(_, qt):
                 q, t = qt
                 fa = extract_features(config, params, q[None]).astype(
@@ -405,6 +501,8 @@ class MatchEngine:
 
         @jax.jit
         def _c2f_coarse_cached(params, q_stack, featb_stack):
+            params = bound if bound is not None else params
+
             def body(_, qf):
                 q, fb = qf
                 fa = extract_features(config, params, q[None]).astype(
@@ -417,6 +515,8 @@ class MatchEngine:
 
         @jax.jit
         def _c2f_refine(params, fa_stack, fb_stack, gates):
+            params = bound if bound is not None else params
+
             def body(_, x):
                 fa, fb, (gate_b, gate_a) = x
                 return None, _c2f_match_one(params, fa, fb, gate_b, gate_a)
@@ -428,13 +528,16 @@ class MatchEngine:
 
     # -- streaming-session seeded programs --------------------------------
 
-    def session_programs_for(self, op: Optional[Tuple[int, int, int]]):
-        """The seeded-frame program for one c2f operating point, built
-        on first use and cached (same lifecycle as c2f_programs_for)."""
-        key = self._c2f_default_op if op is None else tuple(op)
+    def session_programs_for(self, op: Optional[Tuple[int, int, int]],
+                             plan: Optional[Tuple[str, int]] = None):
+        """The seeded-frame program for one (c2f operating point,
+        consensus plan) pair, built on first use and cached (same
+        lifecycle as c2f_programs_for)."""
+        op_key = self._c2f_default_op if op is None else tuple(op)
+        key = (op_key, None if plan is None else tuple(plan))
         prog = self._session_programs.get(key)
         if prog is None:
-            prog = self._build_session_program(self._config_for_op(key))
+            prog = self._build_session_program(self._config_for(op_key, plan))
             self._session_programs[key] = prog
         return prog
 
@@ -454,6 +557,7 @@ class MatchEngine:
         invert_direction = self._invert_direction
         stride = c2f_stride(config)
         seed_radius = self.session_seed_radius
+        bound = self._bind_params(config)
 
         def _seeded_one(params, feat_a, feat_b, seed_b, seed_a):
             consensus = params["neigh_consensus"]
@@ -465,7 +569,9 @@ class MatchEngine:
             kw = dict(stride=s, radius=config.c2f_radius,
                       seed_radius=seed_radius, topk=config.c2f_topk,
                       symmetric=config.symmetric_mode,
-                      corr_dtype=config.corr_dtype)
+                      corr_dtype=config.corr_dtype,
+                      kind=config.consensus_kind or None,
+                      cp_rank=config.consensus_cp_rank or None)
 
             def passthrough(seed):
                 # Direction this engine never probes: hand the seed back
@@ -512,6 +618,8 @@ class MatchEngine:
 
         @jax.jit
         def _c2f_seeded(params, q_stack, featb_stack, seeds):
+            params = bound if bound is not None else params
+
             def body(_, x):
                 q, fb, (sb, sa) = x
                 fa = extract_features(config, params, q[None]).astype(
@@ -581,14 +689,18 @@ class MatchEngine:
         spelled out, so a request pinning the default knobs explicitly
         and one omitting them share an entry), max_matches, and the
         resize/extraction policy knobs that select the device program.
-        Model identity is NOT here — the cache's ``model_key`` carries
-        it, exactly like the feature cache.
+        A forced consensus plan (cp/fft arm) EXTENDS the key — a rank-R
+        approximate result must never be served to (or polluted by)
+        default-plan traffic; default-plan keys keep their pre-plan
+        shape so existing cache entries stay valid. Model identity is
+        NOT here — the cache's ``model_key`` carries it, exactly like
+        the feature cache.
         """
         op = prepared.c2f_op
         if prepared.mode == "c2f" and op is None:
             op = self._c2f_default_op
         mk = self._match_kwargs
-        return (
+        key = (
             prepared.mode,
             tuple(op) if op is not None else None,
             int(prepared.max_matches),
@@ -599,6 +711,9 @@ class MatchEngine:
             bool(mk["both_directions"]),
             bool(mk["invert_direction"]),
         )
+        if prepared.plan is not None:
+            key = key + (("plan",) + tuple(prepared.plan),)
+        return key
 
     def prepare(self, request: dict) -> Prepared:
         """Decode/resize a request's images, probe the feature cache.
@@ -610,8 +725,11 @@ class MatchEngine:
         (``{"coarse_factor": 4, "topk": 8, "radius": 1}``, every key
         optional) selecting a non-default operating point — the QoS
         quality ladder's rewrite target (serving/qos.py), also usable
-        directly by clients. Raises ValueError on malformed input (the
-        server maps it to 400).
+        directly by clients. Any request may carry a ``consensus`` knob
+        object (``{"kind": "cp", "rank": 8}`` / ``{"kind": "fft"}``)
+        forcing a consensus arm (ops/conv4d.py) — the ``cp:`` QoS
+        rung's rewrite target. Raises ValueError on malformed input
+        (the server maps it to 400).
         """
         if not isinstance(request, dict):
             raise ValueError("request body must be a JSON object")
@@ -634,6 +752,12 @@ class MatchEngine:
             if not isinstance(knobs, dict):
                 raise ValueError("c2f must be a JSON object of knobs")
             op = self._op_from_knobs(knobs)
+        plan = None
+        pknobs = request.get("consensus")
+        if pknobs is not None:
+            if not isinstance(pknobs, dict):
+                raise ValueError("consensus must be a JSON object of knobs")
+            plan = self._plan_from_knobs(pknobs)
         max_matches = int(request.get("max_matches", 0) or 0)
         try:
             query, _ = self._load_image(q_path, q_b64, mode, op)
@@ -669,12 +793,16 @@ class MatchEngine:
             kind = ("feat", tuple(pano_feats.shape))
         else:
             kind = ("img", tuple(pano.shape[2:]))
-        # Non-default operating points extend the key (each op is its
-        # own program family); default-op keys stay the pre-QoS 3-tuple
-        # so existing buckets, warmups and logs are unchanged.
+        # Non-default operating points / consensus plans extend the key
+        # (each is its own program family); default keys stay the
+        # pre-QoS 3-tuple so existing buckets, warmups and logs are
+        # unchanged. The plan element is tagged ("plan", kind, rank) so
+        # it can never be mistaken for a 3-int op tuple.
         bucket_key = (tuple(query.shape[2:]), kind, mode)
         if op is not None:
             bucket_key = bucket_key + (op,)
+        if plan is not None:
+            bucket_key = bucket_key + (("plan",) + plan,)
         return Prepared(
             bucket_key=bucket_key,
             query=query,
@@ -685,6 +813,7 @@ class MatchEngine:
             max_matches=max_matches,
             mode=mode,
             c2f_op=op,
+            plan=plan,
         )
 
     def prepare_session_frame(
@@ -695,6 +824,7 @@ class MatchEngine:
         ref_b64: Optional[str] = None,
         ref_feats=None,
         op: Optional[Tuple[int, int, int]] = None,
+        plan: Optional[Tuple[str, int]] = None,
         seed=None,
         seed_bucket=None,
     ) -> Prepared:
@@ -764,6 +894,8 @@ class MatchEngine:
         bucket_key = (tuple(query.shape[2:]), kind, "c2f")
         if op is not None:
             bucket_key = bucket_key + (op,)
+        if plan is not None:
+            bucket_key = bucket_key + (("plan",) + tuple(plan),)
         use_seed = (seed is not None
                     and seed_bucket == bucket_key
                     and not self._c2f_bucket_degenerate(bucket_key))
@@ -783,6 +915,7 @@ class MatchEngine:
             max_matches=max_matches,
             mode="c2f",
             c2f_op=op,
+            plan=None if plan is None else tuple(plan),
             session=session_info,
         )
 
@@ -835,9 +968,12 @@ class MatchEngine:
                 * (fb[0] // k) * (fb[1] // k)), 1
 
     def _cost_card(self, program: str, jitted, args, q_shape, p_shape,
-                   batch: int, mode: str) -> List[dict]:
+                   batch: int, mode: str,
+                   plan: Optional[Tuple[str, int]] = None) -> List[dict]:
         """AOT-capture one warmed program's cost card and emit it
-        (event + engine.costcard.* gauges). Returns [card] or [] when
+        (event + engine.costcard.* gauges). ``plan`` makes the analytic
+        cross-check rank-aware (a cp/fft program is modeled against its
+        own arm's flop floor, not dense's). Returns [card] or [] when
         the backend can't report — warmup never fails on accounting."""
         from ..obs import costcards
         from ..ops.autotune import backend_kind
@@ -859,6 +995,8 @@ class MatchEngine:
                         np.dtype(self.config.corr_dtype).itemsize),
                     batch=batch,
                     applications=applications,
+                    kind=plan[0] if plan is not None else "dense",
+                    cp_rank=plan[1] if plan is not None else 0,
                 )
         except Exception:  # noqa: BLE001 — model is best-effort
             model = None
@@ -878,11 +1016,19 @@ class MatchEngine:
         """Host-side mirror of models.ncnet.c2f_is_degenerate for one
         bucket: map the bucket's image dims to feature dims (backbone
         1/16 stride) and ask whether the bucket's c2f knobs (its op's,
-        when the 4-tuple key carries one) reduce to one-shot."""
+        when the key carries one) reduce to one-shot. Extra key
+        elements are self-describing: a 3-int tuple is an op, a
+        ("plan", ...) tuple a consensus plan (plan-irrelevant here —
+        the cp arm changes the consensus math, not the c2f geometry),
+        the "seed" string the seeded-session marker."""
         (qh, qw), kind, _mode = bucket_key[:3]
-        op = bucket_key[3] if len(bucket_key) > 3 else None
-        if op == "seed":  # seeded session buckets append a marker, not an op
-            op = None
+        op = None
+        for extra in bucket_key[3:]:
+            if extra == "seed":
+                continue
+            if isinstance(extra, tuple) and extra and extra[0] == "plan":
+                continue
+            op = extra
         q_feat = (qh // _FEAT_STRIDE_PX, qw // _FEAT_STRIDE_PX)
         if kind[0] == "feat":
             p_feat = tuple(kind[1][-2:])
@@ -945,7 +1091,8 @@ class MatchEngine:
                 raise ValueError(
                     "seeded session frames require captured reference "
                     "features")
-            seeded_prog = self.session_programs_for(batch[0].c2f_op)
+            seeded_prog = self.session_programs_for(batch[0].c2f_op,
+                                                    batch[0].plan)
             seeds = tuple(
                 tuple(self._put(jnp.stack(
                     [jnp.asarray(p.session["seed"][d][i]) for p in batch]))
@@ -984,7 +1131,7 @@ class MatchEngine:
             # still-on-device feature/gate stacks. Children of the
             # device span so a request trace shows both stages.
             coarse_prog, coarse_cached_prog, refine_prog = \
-                self.c2f_programs_for(batch[0].c2f_op)
+                self.c2f_programs_for(batch[0].c2f_op, batch[0].plan)
             with trace.span("device", batch_size=len(batch)):
                 t_c = time.monotonic()
                 if mode == "cached":
@@ -1056,16 +1203,18 @@ class MatchEngine:
                 # what it already has — dispatch one-shot instead.
                 obs.counter("engine.c2f.refine_skipped",
                             labels=self.labels).inc(len(batch))
+            pairs_prog, pairs_feats_prog, pairs_cached_prog = \
+                self.pair_programs_for(batch[0].plan)
             if mode == "cached":
-                ms = self._batch_pairs_cached(self.params, q_stack, f_stack)
+                ms = pairs_cached_prog(self.params, q_stack, f_stack)
             elif mode == "with_feats":
-                ms, feats = self._batch_pairs_with_feats(
+                ms, feats = pairs_feats_prog(
                     self.params, q_stack, t_stack
                 )
                 store = [(p, feats[k]) for k, p in enumerate(batch)
                          if p.pano_path]
             else:
-                ms = self._batch_pairs(self.params, q_stack, t_stack)
+                ms = pairs_prog(self.params, q_stack, t_stack)
             np_ms = self._jax.device_get(ms)
             for k, p in enumerate(batch):
                 if p.session is None:
@@ -1127,14 +1276,18 @@ class MatchEngine:
         the first c2f request doesn't eat a cold compile under deadline
         (the c2f entry warms BOTH stage programs; degenerate c2f knobs
         warm the one-shot program that bucket actually dispatches).
-        ``c2f_ops``: extra c2f operating points to warm per bucket —
-        knob dicts (``{"coarse_factor": 4, "topk": 8}``) or
-        (factor, topk, radius) tuples. A QoS deployment passes its
+        ``c2f_ops``: extra operating points to warm per bucket —
+        c2f knob dicts (``{"coarse_factor": 4, "topk": 8}``) or
+        (factor, topk, radius) tuples, plus kind-bearing consensus-plan
+        dicts (``{"kind": "cp", "rank": 8}`` — the ``cp:`` QoS rung's
+        knobs), which warm that plan's program family for EVERY mode in
+        ``modes`` at the default c2f point. A QoS deployment passes its
         ladder's rungs here so a degraded request under overload never
-        pays a cold compile (serving/qos.py); ignored unless "c2f" is
-        in ``modes``. Cost cards cover the default point only (the
-        card's mode label stays the plain engine mode).
-        Returns the number of (bucket, batch, mode, op) programs
+        pays a cold compile (serving/qos.py); c2f entries are ignored
+        unless "c2f" is in ``modes``. Cost cards cover the default c2f
+        point (per plan — a cp/fft card checks against its own arm's
+        analytic floor).
+        Returns the number of (bucket, batch, mode, op, plan) programs
         compiled. Compiles land in the persistent compile cache, so a
         restarted replica warms from disk.
 
@@ -1153,9 +1306,17 @@ class MatchEngine:
         cards: List[dict] = []
         with_cards = costcards.enabled()
         # Normalize the extra operating points once; None (the default
-        # point) always leads, and ops that fold into it are deduped.
+        # point/plan) always leads, and entries that fold into it are
+        # deduped. Kind-bearing dicts are consensus plans, NOT c2f ops
+        # — they must never reach _op_from_knobs (which rejects them).
         warm_ops: List[Optional[Tuple[int, int, int]]] = [None]
+        warm_plans: List[Optional[Tuple[str, int]]] = [None]
         for o in c2f_ops:
+            if isinstance(o, dict) and "kind" in o:
+                pl = self._plan_from_knobs(o)
+                if pl not in warm_plans:
+                    warm_plans.append(pl)
+                continue
             op = (self._op_from_knobs(o) if isinstance(o, dict)
                   else self._op_from_knobs(
                       dict(zip(("coarse_factor", "topk", "radius"), o))))
@@ -1169,17 +1330,26 @@ class MatchEngine:
                         f"one of {ENGINE_MODES}"
                     )
                 ops = warm_ops if engine_mode == "c2f" else [None]
-                for op in ops:
+                # Non-default c2f points warm at the default plan;
+                # non-default plans warm at the default c2f point — the
+                # QoS ladder degrades along one axis at a time.
+                variants = [(op, None) for op in ops]
+                variants += [(None, pl) for pl in warm_plans[1:]]
+                for op, wplan in variants:
                     q_shape = self._resize_shape(qh, qw, engine_mode, op)
                     p_shape = self._resize_shape(ph, pw, engine_mode, op)
                     bucket = (q_shape, ("img", p_shape), engine_mode)
                     if op is not None:
                         bucket = bucket + (op,)
+                    if wplan is not None:
+                        bucket = bucket + (("plan",) + wplan,)
                     c2f_live = engine_mode == "c2f" and \
                         not self._c2f_bucket_degenerate(bucket)
                     if c2f_live:
                         coarse_prog, _cc, refine_prog = \
-                            self.c2f_programs_for(op)
+                            self.c2f_programs_for(op, wplan)
+                    else:
+                        pairs_prog = self.pair_programs_for(wplan)[0]
                     for b in batch_sizes:
                         q = self._put(self._jnp.zeros(
                             (b, 3) + q_shape, self._jnp.float32))
@@ -1191,6 +1361,8 @@ class MatchEngine:
                                        mode=engine_mode)
                         if op is not None:
                             span_kw["c2f_op"] = list(op)
+                        if wplan is not None:
+                            span_kw["consensus_plan"] = list(wplan)
                         with obs.span("serving.warmup", **span_kw):
                             if c2f_live:
                                 coarse = coarse_prog(self.params, q, t)
@@ -1200,7 +1372,7 @@ class MatchEngine:
                                 )
                             else:
                                 self._jax.block_until_ready(
-                                    self._batch_pairs(self.params, q, t)
+                                    pairs_prog(self.params, q, t)
                                 )
                         if with_cards and op is None:
                             # AOT lower+compile hits the jit/persistent
@@ -1211,16 +1383,19 @@ class MatchEngine:
                                 cards += self._cost_card(
                                     "c2f_coarse", coarse_prog,
                                     (self.params, q, t),
-                                    q_shape, p_shape, b, engine_mode)
+                                    q_shape, p_shape, b, engine_mode,
+                                    plan=wplan)
                                 cards += self._cost_card(
                                     "c2f_refine", refine_prog,
                                     (self.params,) + tuple(coarse),
-                                    q_shape, p_shape, b, engine_mode)
+                                    q_shape, p_shape, b, engine_mode,
+                                    plan=wplan)
                             else:
                                 cards += self._cost_card(
-                                    "batch_pairs", self._batch_pairs,
+                                    "batch_pairs", pairs_prog,
                                     (self.params, q, t),
-                                    q_shape, p_shape, b, engine_mode)
+                                    q_shape, p_shape, b, engine_mode,
+                                    plan=wplan)
                         # The trace above consulted the strategy cache
                         # (ops/autotune.py) for this bucket's consensus
                         # shape; surface what it resolved — tuned plan

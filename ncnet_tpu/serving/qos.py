@@ -197,22 +197,45 @@ class TenantTable:
 
 @dataclass(frozen=True)
 class Rung:
-    """One c2f operating point on the quality ladder. ``radius=None``
-    keeps the engine config's refinement radius."""
+    """One operating point on the quality ladder.
+
+    Two rung kinds:
+
+    * ``kind='c2f'`` (default): a coarse-to-fine operating point —
+      ``coarse_factor``/``topk``/``radius`` knobs; ``radius=None``
+      keeps the engine config's refinement radius.
+    * ``kind='cp'``: a CP-decomposed consensus arm at ``rank``
+      (ops/cp4d.py) — a *declared approximation* rung that rewrites the
+      request's consensus plan, not its mode, so oneshot AND c2f
+      traffic both degrade through it. ``coarse_factor``/``topk`` are
+      unused (construct as ``Rung(1, 0, kind='cp', rank=N)``).
+
+    Field order keeps the original positional contract: ``Rung(2, 16)``
+    is the same c2f rung it always was.
+    """
 
     coarse_factor: int
     topk: int
     radius: Optional[int] = None
+    kind: str = "c2f"
+    rank: int = 0
 
     def __post_init__(self):
+        if self.kind not in ("c2f", "cp"):
+            raise ValueError(f"unknown rung kind {self.kind!r}: {self}")
         if self.coarse_factor < 1:
             raise ValueError(f"coarse_factor must be >= 1: {self}")
         if self.radius is not None and self.radius < 0:
             raise ValueError(f"radius must be >= 0: {self}")
+        if self.kind == "cp" and self.rank < 1:
+            raise ValueError(f"cp rung needs rank >= 1: {self}")
 
     def knobs(self) -> dict:
-        """The request-level ``c2f`` knob dict this rung rewrites in
-        (serving/engine.MatchEngine.prepare's schema)."""
+        """The request-level knob dict this rung rewrites in: the
+        ``c2f`` schema for c2f rungs (engine.prepare/_op_from_knobs),
+        the ``consensus`` schema for cp rungs (engine plan override)."""
+        if self.kind == "cp":
+            return {"kind": "cp", "rank": self.rank}
         d = {"coarse_factor": self.coarse_factor, "topk": self.topk}
         if self.radius is not None:
             d["radius"] = self.radius
@@ -220,18 +243,38 @@ class Rung:
 
 
 def parse_ladder(spec: str) -> Tuple[Rung, ...]:
-    """``c2f:factor=2,topk=32;c2f:factor=4,topk=8`` -> rung tuple.
+    """``c2f:factor=2,topk=32;cp:rank=8`` -> rung tuple.
 
-    Semicolon-separated rungs, best quality first; each rung is
+    Semicolon-separated rungs, best quality first. Two rung grammars:
     ``c2f:`` followed by comma-separated ``key=int`` knobs (keys:
-    ``factor``/``coarse_factor``, ``topk``, ``radius``). Empty spec =
-    empty ladder (controller sheds only, no quality degradation).
+    ``factor``/``coarse_factor``, ``topk``, ``radius``), or
+    ``cp:rank=N`` — the CP-decomposed consensus arm at rank N (no other
+    knobs). Empty spec = empty ladder (controller sheds only, no
+    quality degradation).
     """
     rungs = []
     for part in (p.strip() for p in spec.split(";") if p.strip()):
+        if part.startswith("cp:"):
+            kw = {}
+            for item in (i for i in part[len("cp:"):].split(",") if i):
+                key, _, val = item.partition("=")
+                if key.strip() != "rank":
+                    raise ValueError(
+                        f"bad ladder knob {item!r} in {part!r} "
+                        f"(cp rungs take only rank=N)")
+                try:
+                    kw["rank"] = int(val)
+                except ValueError as exc:
+                    raise ValueError(
+                        f"bad ladder knob {item!r} in {part!r}") from exc
+            if "rank" not in kw:
+                raise ValueError(f"ladder rung {part!r} needs rank=N")
+            rungs.append(Rung(1, 0, kind="cp", rank=kw["rank"]))
+            continue
         if not part.startswith("c2f:"):
             raise ValueError(
-                f"bad ladder rung {part!r}: rungs are 'c2f:key=val,...'")
+                f"bad ladder rung {part!r}: rungs are 'c2f:key=val,...'"
+                f" or 'cp:rank=N'")
         kw: Dict[str, int] = {}
         for item in (i for i in part[len("c2f:"):].split(",") if i):
             key, _, val = item.partition("=")
@@ -265,10 +308,16 @@ class QosDecision:
     def apply(self, request: dict) -> dict:
         """Rewrite a request dict to this decision's operating point
         (in place; BEFORE engine.prepare — the bucket snap depends on
-        the coarse stride). No-op at rung 0."""
+        the coarse stride). No-op at rung 0. c2f rungs rewrite the
+        mode + c2f knobs; cp rungs rewrite only the consensus plan
+        (``request['consensus']``) and leave the mode alone, so the
+        approximate arm degrades oneshot and c2f traffic alike."""
         if self.rung is not None:
-            request["mode"] = "c2f"
-            request["c2f"] = self.rung.knobs()
+            if self.rung.kind == "cp":
+                request["consensus"] = self.rung.knobs()
+            else:
+                request["mode"] = "c2f"
+                request["c2f"] = self.rung.knobs()
         return request
 
 
@@ -322,6 +371,7 @@ class QosController:
         self._last_step: Optional[float] = None
         self._cool_since: Optional[float] = None
         obs.gauge("serving.qos.rung", labels=self.labels).set(0.0)
+        obs.gauge("serving.qos.cp_rank", labels=self.labels).set(0.0)
 
     def bind(self, slo=None, depth_fn=None, max_queue=None,
              labels=None) -> "QosController":
@@ -351,6 +401,16 @@ class QosController:
         self._transitions += 1
         self._last_step = now
         obs.gauge("serving.qos.rung", labels=self.labels).set(float(new_pos))
+        # The rung-kind decode for dashboards (tools/fleet_status.py):
+        # the active rung's cp rank, 0 when the position is rung 0 or a
+        # c2f rung — /metrics carries only numbers, and a cp rung is a
+        # declared approximation a dashboard must be able to tell apart
+        # from a c2f coarsening at the same index.
+        q = min(new_pos, len(self.ladder))
+        active = self.ladder[q - 1] if q > 0 else None
+        obs.gauge("serving.qos.cp_rank", labels=self.labels).set(
+            float(active.rank) if active is not None
+            and active.kind == "cp" else 0.0)
         obs.counter("serving.qos.transitions", labels=self.labels).inc()
         obs.event("qos_transition", rung_from=old, rung_to=new_pos,
                   reason=reason, quality_rungs=len(self.ladder),

@@ -1282,12 +1282,19 @@ class MatchServer:
                         return (400, {"error": "deadline_ms must be a "
                                       "number"}, None)
                 rung_op = session.op
+                rung_plan = None
                 if decision is not None and decision.rung is not None:
                     # Quality degradation: run THIS frame at the rung's
                     # operating point instead of the session's pinned
-                    # one (the seed re-establishes at the rung).
-                    rung_op = self.engine._op_from_knobs(
-                        decision.rung.knobs())
+                    # one (the seed re-establishes at the rung). A cp
+                    # rung keeps the session's c2f point and forces the
+                    # approximate consensus arm instead — its knobs are
+                    # a consensus plan, never c2f knobs.
+                    if decision.rung.kind == "cp":
+                        rung_plan = ("cp", int(decision.rung.rank))
+                    else:
+                        rung_op = self.engine._op_from_knobs(
+                            decision.rung.knobs())
                     obs.counter("serving.qos.degraded",
                                 labels=self.labels).inc()
                     if tenant is not None:
@@ -1315,6 +1322,7 @@ class MatchServer:
                         ref_b64=session.ref_b64,
                         ref_feats=session.ref_feats,
                         op=rung_op,
+                        plan=rung_plan,
                         seed=seed.gates if seed is not None else None,
                         seed_bucket=seed.bucket if seed is not None
                         else None)
@@ -1363,7 +1371,7 @@ class MatchServer:
                                 ref_path=session.ref_path,
                                 ref_b64=session.ref_b64,
                                 ref_feats=session.ref_feats,
-                                op=rung_op, seed=None)
+                                op=rung_op, plan=rung_plan, seed=None)
                         except ValueError as exc2:
                             obs.counter("serving.bad_requests",
                                         labels=self.labels).inc()
@@ -1665,8 +1673,9 @@ def main(argv=None):
     parser.add_argument(
         "--qos_ladder", type=str, default="",
         help="quality ladder for overload degradation, best rung "
-        "first: 'c2f:factor=2,topk=32;c2f:factor=4,topk=8' "
-        "(docs/SERVING.md). Setting it enables the QoS controller.",
+        "first: 'c2f:factor=2,topk=32;c2f:factor=4,topk=8;cp:rank=8' "
+        "(cp:rank=N = the CP-decomposed approximate consensus arm, "
+        "docs/SERVING.md). Setting it enables the QoS controller.",
     )
     parser.add_argument("--qos", action="store_true",
                         help="enable the QoS controller even with no "
@@ -1836,7 +1845,8 @@ def main(argv=None):
                                  args.default_tenant_rate),
         )
     ladder_ops = [r.knobs() for r in ladder]
-    if ladder_ops and args.warmup and "c2f" not in warmup_modes:
+    if any(r.kind == "c2f" for r in ladder) and args.warmup \
+            and "c2f" not in warmup_modes:
         warmup_modes = warmup_modes + ("c2f",)
     tenant_queue_frac = args.tenant_queue_frac or None
     if args.replicas > 0:

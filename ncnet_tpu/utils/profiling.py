@@ -341,7 +341,7 @@ def setup_compile_cache(path: str = ""):
 
 
 def run_bench_matrix(runs, *, dial_timeout=300.0, fence=1500.0,
-                     knobs=(), log=print):
+                     knobs=(), log=print, on_result=None):
     """Shared driver for headline A/B matrices over trace-time env knobs.
 
     One dial, then bench.py's main() in-process per (label, env) run,
@@ -353,9 +353,19 @@ def run_bench_matrix(runs, *, dial_timeout=300.0, fence=1500.0,
     tools/tpu_session.py keeps its own loop (it additionally snapshots
     and restores operator-inherited overrides around the matrix).
 
+    `on_result(label, headline_or_None)` — when given, each run's
+    stdout is captured (bench's contract: ONE JSON line) and the parsed
+    headline dict is handed to the callback (None on timeout/failure/
+    unparseable output), so a caller can emit its OWN one-line summary
+    without bench lines interleaving on stdout. Without the callback,
+    bench lines go to stdout exactly as before.
+
     Returns 0, or 2 when the dial timed out.
     """
+    import contextlib
     import importlib.util
+    import io
+    import json
     import os
     import traceback
 
@@ -391,8 +401,20 @@ def run_bench_matrix(runs, *, dial_timeout=300.0, fence=1500.0,
         os.environ.update(env)
         log(f"=== bench[{label}] env={env} ===")
         watchdog.arm(fence + 180)
+        parsed = None
         try:
-            run_with_alarm(int(fence), _load_bench().main)
+            if on_result is None:
+                run_with_alarm(int(fence), _load_bench().main)
+            else:
+                buf = io.StringIO()
+                with contextlib.redirect_stdout(buf):
+                    run_with_alarm(int(fence), _load_bench().main)
+                for line in buf.getvalue().splitlines():
+                    if line.strip().startswith("{"):
+                        try:
+                            parsed = json.loads(line)
+                        except ValueError:
+                            pass
         except AlarmTimeout as exc:
             log(f"bench[{label}] TIMED OUT: {exc}")
         except Exception:  # noqa: BLE001
@@ -401,5 +423,7 @@ def run_bench_matrix(runs, *, dial_timeout=300.0, fence=1500.0,
             watchdog.disarm()
             for k in env:
                 os.environ.pop(k, None)
+        if on_result is not None:
+            on_result(label, parsed)
     log("A/B DONE")
     return 0

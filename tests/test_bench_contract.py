@@ -785,3 +785,27 @@ def test_bench_trend_passes_quality_fields_through(tmp_path, capsys):
     assert report["metric"] == "serving_match_throughput_rps"
     assert report["shadow_agreement"] == 0.97
     assert report["quality_drift_psi"] == 0.04
+
+
+def test_bench_trend_passes_consensus_plan_fields_through(tmp_path,
+                                                          capsys):
+    """tools/bench_trend.py forwards the algebraic-arm fields (ISSUE
+    18): a consensus trend won by a CP-truncated or spectral plan is
+    only honest next to the plan kind/rank and the measured
+    agreement-vs-dense."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_trend
+
+    rec = {"n": 1, "cmd": "bench", "rc": 0,
+           "parsed": {"metric": "match_pairs_per_s",
+                      "value": 12.5, "unit": "pairs/s",
+                      "consensus_plan_kind": "cp",
+                      "cp_rank": 8,
+                      "cp_agreement": 0.93}}
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump(rec, fh)
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["consensus_plan_kind"] == "cp"
+    assert report["cp_rank"] == 8
+    assert report["cp_agreement"] == 0.93

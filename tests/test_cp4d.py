@@ -1,0 +1,374 @@
+"""Algebraic consensus arms (ncnet_tpu/ops/cp4d.py, ISSUE 18).
+
+Coverage, per the arms' declared contracts:
+
+* rank-full CP is BITWISE identical to conv4d_reference in f32 (the
+  delta-basis lowering replays the reference loop: same pads, same
+  slices, same einsum, same accumulation order) — per conv, which is
+  the claim; the tuned dense stack is a different formulation.
+* truncated ranks clear their declared agreement floors
+  (DECLARED_AGREEMENT_FLOOR — the number quality_report gates cp QoS
+  rungs against).
+* the FFT arm matches the direct conv within f32 tolerance, and within
+  a looser tolerance from bf16 inputs.
+* the ALS factorization cache round-trips through its JSON file and
+  invalidates by checkpoint digest, never by mtime; exact (delta)
+  factorizations are never persisted.
+* the autotuner's winner selection respects measured time across the
+  dense/cp/fft kinds (injected timer — no device compiles).
+* end to end: a MatchServer with a ``cp:rank=8`` QoS rung serves the
+  cp arm under pressure and stays bitwise-identical to the plain
+  admission path at rung 0.
+"""
+
+import base64
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ncnet_tpu.ops import autotune, cp4d
+from ncnet_tpu.ops.conv4d import (
+    conv4d_reference,
+    neigh_consensus_apply,
+    neigh_consensus_init,
+)
+
+SHAPE = (1, 1, 6, 5, 7, 6)
+TAPS = 3 ** 4  # every kernel below is (3,3,3,3,...)
+
+
+@pytest.fixture
+def params():
+    return neigh_consensus_init(jax.random.PRNGKey(0), (3, 3), (8, 1))
+
+
+@pytest.fixture
+def corr():
+    r = np.random.RandomState(1)
+    return jnp.asarray(r.randn(*SHAPE).astype(np.float32))
+
+
+@pytest.fixture
+def clean_env(monkeypatch, tmp_path):
+    """Hermetic knobs: no ambient plan env, both caches at tmp paths,
+    fresh in-process factor memo."""
+    for k in autotune.PLAN_ENV_KEYS + ("NCNET_CONV4D_STRATEGY",
+                                       "NCNET_CONSENSUS_CL"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("NCNET_STRATEGY_CACHE",
+                       str(tmp_path / "consensus_autotune.json"))
+    cache = tmp_path / "consensus_cp.json"
+    monkeypatch.setenv("NCNET_CP_FACTOR_CACHE", str(cache))
+    monkeypatch.setattr(cp4d, "_FACTOR_MEMO", {})
+    return cache
+
+
+# -- exactness -------------------------------------------------------------
+
+
+def test_rank_full_cp_bitwise_vs_reference(params, clean_env):
+    """Tier-1 acceptance: at rank >= the tap count the CP arm is not
+    'close' — it is the same f32 bits as conv4d_reference, layer by
+    layer (delta factors lower to the reference's own slice/einsum/add
+    program)."""
+    r = np.random.RandomState(2)
+    cin = 1
+    for layer in params:
+        x = jnp.asarray(
+            r.randn(1, cin, 5, 4, 6, 5).astype(np.float32))
+        ref = np.asarray(conv4d_reference(x, layer["weight"],
+                                          layer["bias"]))
+        full = np.asarray(cp4d.cp_conv4d(x, layer["weight"],
+                                         layer["bias"], rank=TAPS))
+        assert full.dtype == np.float32
+        assert np.array_equal(ref, full), "full-rank CP is not bitwise"
+        # Over-asking is clamped to the tap count, same bits.
+        over = np.asarray(cp4d.cp_conv4d(x, layer["weight"],
+                                         layer["bias"], rank=TAPS * 4))
+        assert np.array_equal(ref, over)
+        cin = int(layer["weight"].shape[5])
+
+
+def test_swap_factors_full_rank_bitwise(params, clean_env):
+    """The symmetric branch's role-swapped factors accumulate in the
+    SWAPPED kernel's reference order — bitwise again, not just equal."""
+    from ncnet_tpu.ops.conv4d import swap_ab_weight
+
+    w = params[0]["weight"]
+    x = jnp.asarray(np.random.RandomState(3)
+                    .randn(1, 1, 5, 4, 6, 5).astype(np.float32))
+    ref = np.asarray(conv4d_reference(x, swap_ab_weight(w), None))
+    swapped = cp4d.swap_factors(cp4d.cp_decompose(w, TAPS))
+    got = np.asarray(cp4d._cp_apply_one(x, swapped))
+    assert np.array_equal(ref, got)
+
+
+def test_truncated_ranks_clear_declared_floors(params, corr, clean_env):
+    """Every declared (rank, floor) pair holds on the random-init stack
+    — the WORST case the floors were calibrated against."""
+    dense = np.asarray(jax.jit(
+        lambda c: neigh_consensus_apply(params, c, symmetric=True))(corr))
+    for rank, floor in sorted(cp4d.DECLARED_AGREEMENT_FLOOR.items()):
+        out = np.asarray(cp4d.consensus_cp_apply(
+            params, corr, rank=rank, symmetric=True))
+        agreement = cp4d.output_agreement(dense, out)
+        assert agreement >= floor, (
+            f"rank {rank} agreement {agreement:.4f} below declared "
+            f"floor {floor}")
+
+
+def test_fft_parity_f32_and_bf16(params, clean_env):
+    """FFT arm vs direct conv: exact-tolerance in f32; from bf16 inputs
+    both arms compute in f32 from the same rounded input, so the gap
+    stays FFT-roundoff-sized, gated looser."""
+    r = np.random.RandomState(4)
+    layer = params[0]
+    x32 = jnp.asarray(r.randn(1, 1, 5, 4, 6, 5).astype(np.float32))
+    ref = np.asarray(conv4d_reference(x32, layer["weight"],
+                                      layer["bias"]))
+    fft = np.asarray(cp4d.fft_conv4d(x32, layer["weight"],
+                                     layer["bias"]))
+    scale = float(np.max(np.abs(ref)))
+    assert float(np.max(np.abs(fft - ref))) < 1e-5 * scale
+
+    xbf = x32.astype(jnp.bfloat16)
+    ref_bf = np.asarray(conv4d_reference(xbf, layer["weight"],
+                                         layer["bias"]), np.float32)
+    fft_bf = np.asarray(cp4d.fft_conv4d(xbf, layer["weight"],
+                                        layer["bias"]))
+    scale = max(float(np.max(np.abs(ref_bf))), 1e-30)
+    assert float(np.max(np.abs(fft_bf - ref_bf))) < 1e-2 * scale
+
+
+def test_fft_stack_agreement_near_exact(params, corr, clean_env):
+    """The full symmetric fft stack tracks the dense stack at ~f32
+    precision (agreement, not bitwise — different reduction orders)."""
+    dense = np.asarray(jax.jit(
+        lambda c: neigh_consensus_apply(params, c, symmetric=True))(corr))
+    fft = np.asarray(cp4d.consensus_fft_apply(
+        params, corr, symmetric=True))
+    assert cp4d.output_agreement(dense, fft) > 0.9999
+
+
+# -- factor cache ----------------------------------------------------------
+
+
+def _boom(*a, **k):
+    raise AssertionError("ALS ran when the factor cache should serve")
+
+
+def test_factor_cache_round_trip_and_digest_invalidation(
+        clean_env, monkeypatch):
+    w = np.asarray(jax.random.normal(
+        jax.random.PRNGKey(5), (3, 3, 3, 3, 2, 2)), np.float32)
+    f1 = cp4d.cp_decompose(w, 8)
+    data = json.loads(clean_env.read_text())
+    digest = cp4d.weight_digest(w)
+    assert f"{digest}|rank=8" in data["entries"]
+
+    # Round trip: fresh memo (a new process), ALS forbidden — the JSON
+    # cache must serve the identical factors.
+    monkeypatch.setattr(cp4d, "_FACTOR_MEMO", {})
+    monkeypatch.setattr(cp4d, "_als_factors", _boom)
+    f2 = cp4d.cp_decompose(w, 8)
+    for k in ("a", "b", "c", "d", "core"):
+        np.testing.assert_array_equal(f1[k], f2[k])
+
+    # Checkpoint change invalidates by CONTENT digest: the perturbed
+    # kernel must not be served the stale factors (ALS is reached).
+    with pytest.raises(AssertionError, match="ALS ran"):
+        cp4d.cp_decompose(w + 0.5, 8)
+    # A different rank of the same weight is its own entry too.
+    with pytest.raises(AssertionError, match="ALS ran"):
+        cp4d.cp_decompose(w, 4)
+
+    # Exact full-rank factors never touch ALS or the JSON cache.
+    cp4d.cp_decompose(w, TAPS)
+    data = json.loads(clean_env.read_text())
+    assert list(data["entries"]) == [f"{digest}|rank=8"]
+
+
+def test_factor_cache_disabled_by_empty_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("NCNET_CP_FACTOR_CACHE", "")
+    monkeypatch.setattr(cp4d, "_FACTOR_MEMO", {})
+    assert cp4d.factor_cache_path() is None
+    w = np.asarray(jax.random.normal(
+        jax.random.PRNGKey(6), (3, 3, 3, 3, 1, 2)), np.float32)
+    f = cp4d.cp_decompose(w, 4)
+    assert f["rank"] == 4 and not (tmp_path / "consensus_cp.json").exists()
+
+
+# -- autotuner arm selection ----------------------------------------------
+
+
+def test_autotune_picks_dense_when_cp_loses(params, corr, clean_env):
+    """A cp/fft candidate that measures slower must not win on novelty:
+    the tuner is time-ordered across kinds."""
+
+    def timer(params_, corr_, sym_, plan, *, reps, iters):
+        kind = autotune.normalize_plan(plan)["kind"]
+        return 0.0, 1.0 if kind == "dense" else 50.0
+
+    best, ms, results = autotune.autotune(
+        params, corr, timer=timer, save=False)
+    assert autotune.normalize_plan(best)["kind"] == "dense"
+    assert ms == 1.0
+    labels = {autotune.plan_label(p) for p, _ in results}
+    assert "fft" in labels and any(
+        l.startswith("cp:rank=") for l in labels), \
+        "algebraic arms missing from the candidate space"
+
+
+def test_autotune_picks_cp_when_it_wins(params, corr, clean_env):
+    def timer(params_, corr_, sym_, plan, *, reps, iters):
+        p = autotune.normalize_plan(plan)
+        if p["kind"] == "cp" and p["cp_rank"] == 8:
+            return 0.0, 0.5
+        return 0.0, 5.0
+
+    best, ms, _ = autotune.autotune(params, corr, timer=timer,
+                                    save=False)
+    p = autotune.normalize_plan(best)
+    assert (p["kind"], p["cp_rank"], ms) == ("cp", 8, 0.5)
+
+
+# -- serving end-to-end ----------------------------------------------------
+
+
+class _QuietSlo:
+    """Never-paging SLO stub (the e2e drives the controller from queue
+    pressure alone — same posture as tests/test_qos.py)."""
+
+    def maybe_evaluate(self):
+        return {}
+
+
+def _jpeg_b64(h, w, seed):
+    import io
+
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    img = Image.fromarray(
+        rng.randint(0, 255, size=(h, w, 3), dtype="uint8"))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def _start_server(engine, **kw):
+    from ncnet_tpu.serving.server import MatchServer
+
+    kw.setdefault("port", 0)
+    kw.setdefault("max_batch", 1)
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("max_delay_s", 0.01)
+    kw.setdefault("default_timeout_s", 300.0)
+    return MatchServer(engine, **kw).start()
+
+
+def _client(url):
+    from ncnet_tpu.serving.client import MatchClient
+
+    return MatchClient(url, timeout_s=600.0, retries=0)
+
+
+def test_serving_e2e_cp_rung_degrades_and_rung0_stays_bitwise(
+        tiny_serving_model, clean_env):
+    """The QoS acceptance end to end: a ladder whose only rung is
+    ``cp:rank=8`` serves full quality at rung 0 — bitwise-identical to
+    a server with no QoS layer at all — and under queue pressure the
+    SAME request runs degraded on the cp arm (its own program, its own
+    bucket key) instead of shedding."""
+    from ncnet_tpu.serving.engine import MatchEngine
+    from ncnet_tpu.serving.qos import (
+        QosController,
+        TenantPolicy,
+        TenantTable,
+        parse_ladder,
+    )
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    kwargs = dict(
+        query_bytes=base64.b64decode(_jpeg_b64(96, 128, 0)),
+        pano_bytes=base64.b64decode(_jpeg_b64(96, 128, 1)),
+        max_matches=8)
+
+    plain = _start_server(engine)
+    try:
+        r_plain = _client(plain.url).match(**kwargs)
+    finally:
+        plain.stop()
+
+    pressure = {"on": False}
+    ladder = parse_ladder("cp:rank=8")
+    assert ladder[0].knobs() == {"kind": "cp", "rank": 8}
+    qos = QosController(
+        ladder, slo=_QuietSlo(),
+        depth_fn=lambda: 100 if pressure["on"] else 0,
+        max_queue=10,
+        step_down_interval_s=0.0,
+        step_up_hold_s=60.0,  # never climbs back during the test
+    )
+    # Degradation applies to degradable classes only (interactive runs
+    # as requested until the shed positions) — drive a best_effort
+    # tenant onto the cp rung.
+    tenants = TenantTable([TenantPolicy("lowpri", "best_effort")])
+    server = _start_server(engine, qos=qos, tenants=tenants)
+    try:
+        client = _client(server.url)
+        # Idle: rung 0 is the full-quality dense arm, same bits as the
+        # no-QoS server (the degenerate-ladder contract, now with a cp
+        # rung in the ladder).
+        r0 = client.match(tenant="lowpri", **kwargs)
+        assert r0["qos"] == {"rung": 0, "degraded": False}
+        assert r0["matches"] == r_plain["matches"]
+        assert r0["n_matches"] == r_plain["n_matches"]
+        # Pressure: the controller steps onto the cp rung and the
+        # request still serves (degraded), on the rank-8 arm.
+        pressure["on"] = True
+        r1 = client.match(tenant="lowpri", **kwargs)
+        assert r1["qos"] == {"rung": 1, "degraded": True}
+        assert r1["n_matches"] >= 1
+        # /healthz itself re-evaluates the controller (pressure is
+        # still on, so it may have stepped further by now) — assert
+        # the ladder exposure, not an exact position.
+        health = client.healthz()
+        assert health["qos"]["rung"] >= 1
+        assert health["qos"]["ladder"] == [{"kind": "cp", "rank": 8}]
+    finally:
+        server.stop()
+
+
+def test_engine_cp_plan_extends_bucket_key(tiny_serving_model,
+                                           clean_env):
+    """A forced cp plan can never share a compiled program or a result-
+    cache namespace with default traffic: the plan extends the bucket
+    key; default requests keep the pre-plan key shape."""
+    from ncnet_tpu.serving.engine import MatchEngine
+
+    config, params = tiny_serving_model
+    engine = MatchEngine(config, params, k_size=2, image_size=64,
+                         cache_mb=0)
+    req = {"query_b64": _jpeg_b64(96, 128, 0),
+           "pano_b64": _jpeg_b64(96, 128, 1)}
+    p0 = engine.prepare(dict(req))
+    assert p0.plan is None
+    p1 = engine.prepare(dict(req, consensus={"kind": "cp", "rank": 8}))
+    assert p1.plan == ("cp", 8)
+    assert p1.bucket_key == p0.bucket_key + (("plan", "cp", 8),)
+    # An explicit dense knob is still a FORCED plan (the default is ''
+    # = defer to env/cache/auto), so it gets its own key too — a pinned
+    # dense response never shares cache with auto-resolved traffic.
+    pd = engine.prepare(dict(req, consensus={"kind": "dense"}))
+    assert pd.plan == ("dense", 0)
+    assert pd.bucket_key == p0.bucket_key + (("plan", "dense", 0),)
+    with pytest.raises(ValueError, match="rank"):
+        engine.prepare(dict(req, consensus={"kind": "cp"}))
+    with pytest.raises(ValueError, match="unknown consensus"):
+        engine.prepare(dict(req, consensus={"rankk": 8}))

@@ -8,6 +8,14 @@ not floor the measurement (see tools/bench_corr_pool.py). The
 NCNET_CONV4D_STRATEGY env var is cleared for the whole run so the
 'auto'-labeled cases really measure layer-wise auto.
 
+The plan cases come from ncnet_tpu.ops.autotune.enumerate_plans — the
+single legal-candidate home — so the algebraic arms (cp:rank=R, fft;
+ops/cp4d.py) appear here automatically. For those approximate arms the
+tool also measures output agreement vs the dense reference stack, and
+the whole run ends with ONE JSON line on stdout (per-arm ms + agreement
+delta; prose stays on stderr) so a session script can record the A/B
+the same way it records bench.py.
+
 Usage:
     python tools/bench_consensus.py [--scale 1.0] [--reps 4] [--iters 3]
 """
@@ -25,7 +33,9 @@ _T0 = time.time()
 
 
 def log(msg):
-    print(f"[{time.time() - _T0:7.1f}s] {msg}", flush=True)
+    # Prose to stderr: stdout is the ONE-JSON-line machine contract.
+    print(f"[{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
 
 
 def main(argv=None):
@@ -175,9 +185,12 @@ def main(argv=None):
     if args.max_plans and len(plans) > args.max_plans:
         log(f"capping {len(plans)} enumerated plans to {args.max_plans}")
         plans = plans[: args.max_plans]
+    plan_by_label = {}
     for plan in plans:
+        label = f"plan {autotune.plan_label(plan)}"
+        plan_by_label[label] = plan
         cases.append((
-            f"plan {autotune.plan_label(plan)}", convs_plan,
+            label, convs_plan,
             dict(autotune.plan_env(plan), NCNET_STRATEGY_CACHE=""),
         ))
 
@@ -189,10 +202,18 @@ def main(argv=None):
     _knobs = autotune.PLAN_ENV_KEYS + ("NCNET_STRATEGY_CACHE",)
     _saved = {k: os.environ.get(k) for k in _knobs}
 
+    records = []
     for label, stage, env in cases:
         for k in _knobs:
             os.environ.pop(k, None)
         os.environ.update(env)
+        rec = {"label": label, "ms": None, "first_s": None,
+               "status": "ok"}
+        plan = plan_by_label.get(label)
+        if plan is not None:
+            rec["plan_kind"] = plan["kind"]
+            if plan["kind"] == "cp":
+                rec["cp_rank"] = plan["cp_rank"]
         try:
             # Per-case fence: a single pathological remote compile must
             # cost one case, not the phase (2026-07-31: the l2-only case
@@ -204,12 +225,43 @@ def main(argv=None):
                 corr,
                 iters=args.iters,
             )
+            rec["ms"] = dt * 1000 / args.reps
+            rec["first_s"] = first
             log(f"{label:34s} first={first:6.2f}s "
                 f"-> {dt * 1000 / args.reps:7.1f}ms/app (+~RTT/iter amortized)")
         except AlarmTimeout:
+            rec["status"] = "timeout"
             log(f"{label:34s} TIMED OUT (>420s compile/run)")
         except Exception as exc:  # noqa: BLE001
+            rec["status"] = f"failed: {type(exc).__name__}"
             log(f"{label:34s} FAILED: {type(exc).__name__}: "
+                f"{str(exc).splitlines()[0][:120]}")
+        records.append(rec)
+
+    # Agreement-vs-dense for the approximate algebraic arms (cp/fft):
+    # one eager apply per arm against the dense reference stack, so a
+    # "plan cp:rank=4 wins" line can never hide the quality price. Runs
+    # with the knob env still stripped (explicit args win per knob).
+    from ncnet_tpu.ops import cp4d
+
+    approx = [r for r in records
+              if r.get("plan_kind") in ("cp", "fft") and r["ms"]]
+    if approx:
+        try:
+            dense_ref = run_with_alarm(
+                420, lambda: neigh_consensus_apply(
+                    params, corr, symmetric=True))
+            for rec in approx:
+                out = run_with_alarm(
+                    420, lambda r=rec: neigh_consensus_apply(
+                        params, corr, symmetric=True,
+                        kind=r["plan_kind"], cp_rank=r.get("cp_rank")))
+                rec["agreement_vs_dense"] = round(
+                    cp4d.output_agreement(dense_ref, out), 4)
+                log(f"{rec['label']:34s} agreement vs dense = "
+                    f"{rec['agreement_vs_dense']:.4f}")
+        except Exception as exc:  # noqa: BLE001
+            log(f"agreement pass FAILED: {type(exc).__name__}: "
                 f"{str(exc).splitlines()[0][:120]}")
     for k, v in _saved.items():
         if v is None:
@@ -217,6 +269,40 @@ def main(argv=None):
         else:
             os.environ[k] = v
 
+    # The one-JSON-line contract (bench_serving.py posture): headline =
+    # fastest timed plan case, with the plan kind / rank / measured
+    # agreement tools/bench_trend.py passes through, the dense anchor
+    # for the delta, and the full per-case table.
+    import json
+
+    timed = [r for r in records if r["ms"] is not None]
+    plan_cases = [r for r in timed if r["label"] in plan_by_label]
+    dense_cases = [r for r in plan_cases
+                   if r.get("plan_kind", "dense") == "dense"]
+    dense_ms = min((r["ms"] for r in dense_cases), default=None)
+    best = min(plan_cases or timed, key=lambda r: r["ms"], default=None)
+    headline = {
+        "metric": "consensus_bench_best_ms",
+        "unit": "ms",
+        "value": None if best is None else round(best["ms"], 3),
+        "best_label": None if best is None else best["label"],
+        "consensus_plan_kind": (None if best is None
+                                else best.get("plan_kind", "dense")),
+        "cp_rank": None if best is None else best.get("cp_rank", 0),
+        "cp_agreement": (None if best is None
+                         else best.get("agreement_vs_dense")),
+        "dense_ms": None if dense_ms is None else round(dense_ms, 3),
+        "vs_dense": (None if (best is None or not dense_ms)
+                     else round(best["ms"] / dense_ms, 3)),
+        "shape": [1, 1, ii, jj, ii, jj],
+        "reps": args.reps,
+        "iters": args.iters,
+        "cases": [{k: (round(v, 3) if isinstance(v, float) else v)
+                   for k, v in r.items()} for r in records],
+    }
+    print(json.dumps(headline), flush=True)
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

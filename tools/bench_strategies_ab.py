@@ -20,8 +20,15 @@ Kept runnable for regression on future shapes/backends.
 The candidate matrix is sourced from the autotuner's enumeration
 (ncnet_tpu/ops/autotune.py — the single home shared with
 tools/bench_consensus.py and tools/autotune_consensus.py), so it now
-includes the branch-fused/unfused axis; --include_folds extends it with
-the KL-fold candidates the enumeration carries.
+includes the branch-fused/unfused axis, the algebraic arms
+(cp:rank=R / fft — ops/cp4d.py), and --include_folds extends it with
+the KL-fold candidates the enumeration carries. The dense explicit-mix
+lines are the CLOSED sweep (docs/NEXT.md verdict: HBM-infeasible at
+headline scale) — they are dropped unless NCNET_BENCH_CLOSED_SWEEPS=1,
+matching bench.py's own guard.
+
+Stdout is ONE JSON line (per-run headline value + the plan kind/rank/
+agreement fields bench_trend passes through); prose goes to stderr.
 
 Run AFTER tools/tpu_session.py finishes (one jax client at a time):
     python tools/bench_strategies_ab.py [--dial_timeout 300]
@@ -30,6 +37,7 @@ Run AFTER tools/tpu_session.py finishes (one jax client at a time):
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -40,7 +48,8 @@ _T0 = time.time()
 
 
 def log(msg):
-    print(f"[ab {time.time() - _T0:7.1f}s] {msg}", flush=True)
+    print(f"[ab {time.time() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
 
 
 def main(argv=None):
@@ -68,6 +77,16 @@ def main(argv=None):
         kl_folds=(0, 2, 4) if args.include_folds else (0,),
         chunks=(0,),
     )
+    # Closed-sweep filter (docs/NEXT.md): dense explicit-mix lines only
+    # when the operator re-opens them, mirroring bench.py's guard.
+    if os.environ.get("NCNET_BENCH_CLOSED_SWEEPS") != "1":
+        open_plans = [pl for pl in plans
+                      if pl["kind"] != "dense" or not pl["strategies"]]
+        if len(open_plans) != len(plans):
+            log(f"dropping {len(plans) - len(open_plans)} dense "
+                "explicit-mix lines (closed sweep; "
+                "NCNET_BENCH_CLOSED_SWEEPS=1 re-opens)")
+        plans = open_plans
     base_runs = [(autotune.plan_label(pl), autotune.plan_env(pl))
                  for pl in plans]
     # Anchor: the promoted default (no knobs at all — heuristic + any
@@ -95,12 +114,42 @@ def main(argv=None):
 
     from ncnet_tpu.utils.profiling import run_bench_matrix
 
-    return run_bench_matrix(
+    results = []
+
+    def on_result(label, headline):
+        rec = {"label": label, "value": None}
+        if isinstance(headline, dict):
+            for key in ("metric", "value", "unit", "consensus_plan_kind",
+                        "cp_rank", "cp_agreement", "consensus_arms"):
+                if key in headline:
+                    rec[key] = headline[key]
+        results.append(rec)
+
+    rc = run_bench_matrix(
         runs, dial_timeout=args.dial_timeout,
         knobs=autotune.PLAN_ENV_KEYS
         + ("NCNET_BENCH_KEEP_TRACE", "NCNET_STRATEGY_CACHE"),
-        log=log,
+        log=log, on_result=on_result,
     )
+
+    # ONE JSON line (the bench_serving.py posture): the best run's
+    # headline value plus the full per-arm table — per-arm ms lives in
+    # each run's consensus_arms block, agreement-vs-dense next to it.
+    ok = [r for r in results if r["value"] is not None]
+    best = max(ok, key=lambda r: r["value"], default=None)
+    print(json.dumps({
+        "metric": "consensus_ab_best_pairs_per_s",
+        "unit": best.get("unit") if best else None,
+        "value": None if best is None else best["value"],
+        "best_label": None if best is None else best["label"],
+        "consensus_plan_kind": (best or {}).get("consensus_plan_kind"),
+        "cp_rank": (best or {}).get("cp_rank"),
+        "cp_agreement": (best or {}).get("cp_agreement"),
+        "runs": results,
+        "n_runs": len(results),
+        "n_failed": len(results) - len(ok),
+    }), flush=True)
+    return rc
 
 
 if __name__ == "__main__":

@@ -104,13 +104,17 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
     # And the localize-bench fields (tools/bench_serving.py --localize):
     # a localize-QPS trend only means something next to the fan-out
     # width it served and the result-cache hit rate that paid for it.
+    # And the algebraic-consensus fields (ops/cp4d.py arms): a consensus
+    # trend won by a CP-truncated or spectral plan is only honest next
+    # to the plan kind/rank and the measured agreement-vs-dense.
     for key in ("replicas", "single_replica_pairs_per_s", "scaling_x",
                 "scaling_efficiency", "pairs_done", "pairs_s",
                 "quarantined", "resumes",
                 "c2f_pairs_s", "coarse_factor", "topk", "c2f_pck_delta",
                 "shadow_agreement", "quality_drift_psi",
                 "fanout_width", "rescache_hit_rate", "legs",
-                "legs_failed"):
+                "legs_failed",
+                "consensus_plan_kind", "cp_rank", "cp_agreement"):
         if key in latest:
             report[key] = latest[key]
     return report

@@ -40,7 +40,11 @@ the clock skew recovered). ``--with-localize-smoke`` runs the
 /v1/localize fan-out chaos contract (``tools/chaos_serving.py
 --localize_fanout`` — a mid-fan-out replica kill must redispatch the
 dead replica's legs, join them into the query trace, and still answer
-200 with zero silent pano drops). All are off by default because they serve
+200 with zero silent pano drops). ``--with-cp-parity`` runs the
+algebraic-consensus parity self-test (``python -m ncnet_tpu.ops.cp4d
+--selftest`` on CPU — rank-full CP bitwise vs conv4d_reference, the
+truncated-rank declared agreement floor, and FFT relative-error
+parity). All are off by default because they serve
 live traffic for several seconds (or, for trace_join, are covered by
 tier-1); a default run still RECORDS them as
 ``{"skipped": true, "optional": true}`` so the JSON never reads as if
@@ -74,7 +78,8 @@ CHECKS = ("tier1", "lint", "bench_trend")
 # Opt-in checks: never run by default, never silently green — a
 # default run records them as {"skipped": true, "optional": true}.
 OPTIONAL_CHECKS = ("full_lint", "tenant_flood", "session_chaos",
-                   "quality_report", "trace_join", "localize_smoke")
+                   "quality_report", "trace_join", "localize_smoke",
+                   "cp_parity")
 
 
 def _run(cmd, timeout_s, cpu_env=False) -> dict:
@@ -177,6 +182,16 @@ def run_localize_smoke(timeout_s: float) -> dict:
         timeout_s, cpu_env=True)
 
 
+def run_cp_parity(timeout_s: float) -> dict:
+    # The algebraic-consensus parity self-test (ops/cp4d.py): rank-full
+    # CP must be BITWISE equal to conv4d_reference in f32, rank-8 must
+    # hold its declared agreement floor, and the FFT arm must match
+    # direct convolution to f32 tolerance — all on CPU, no device.
+    return _run(
+        [sys.executable, "-m", "ncnet_tpu.ops.cp4d", "--selftest"],
+        timeout_s, cpu_env=True)
+
+
 def run_trace_join(timeout_s: float) -> dict:
     # The distributed-trace assembly self-test: two synthetic runlogs
     # (client, server skewed +30s) must export as ONE joined tree with
@@ -224,6 +239,11 @@ def main(argv=None) -> int:
                          "contract (tools/chaos_serving.py "
                          "--localize_fanout, short duration); off by "
                          "default, recorded as skipped when off")
+    ap.add_argument("--with-cp-parity", action="store_true",
+                    help="also run the algebraic-consensus parity "
+                         "self-test (python -m ncnet_tpu.ops.cp4d "
+                         "--selftest on CPU); off by default, recorded "
+                         "as skipped when off")
     ap.add_argument("--chaos-timeout-s", type=float, default=300.0,
                     help="wall-clock fence for the optional chaos checks")
     args = ap.parse_args(argv)
@@ -240,13 +260,15 @@ def main(argv=None) -> int:
         "trace_join": lambda: run_trace_join(args.timeout_s),
         "localize_smoke": lambda: run_localize_smoke(
             args.chaos_timeout_s),
+        "cp_parity": lambda: run_cp_parity(args.timeout_s),
     }
     enabled = {"full_lint": args.with_full_lint,
                "tenant_flood": args.with_tenant_flood,
                "session_chaos": args.with_session_chaos,
                "quality_report": args.with_quality_report,
                "trace_join": args.with_trace_join,
-               "localize_smoke": args.with_localize_smoke}
+               "localize_smoke": args.with_localize_smoke,
+               "cp_parity": args.with_cp_parity}
     checks = {}
     for name in CHECKS + OPTIONAL_CHECKS:
         if name in args.skip or not enabled.get(name, True):

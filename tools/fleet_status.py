@@ -22,7 +22,10 @@ spending error budget faster than it earns it. hbm GB / head% read the
 the ``serving.warmup_programs`` counter, how many (bucket, batch,
 mode) programs the replica precompiled; rung is the
 ``serving.qos.rung`` gauge — the QoS controller's current ladder
-position ("-" on servers without the multi-tenant QoS layer); sess is
+position ("-" on servers without the multi-tenant QoS layer),
+suffixed ``cp<R>`` when the active rung is a CP-decomposed consensus
+arm (the ``serving.qos.cp_rank`` gauge — a declared approximation,
+not a c2f coarsening); sess is
 the ``serving.session.active`` gauge — open streaming sessions on
 that front door ("-" before the first session ever opens); drift is
 the worst ``serving.quality.drift_psi`` across the replica's
@@ -75,6 +78,7 @@ HBM_USE = "device_hbm_bytes_in_use"
 HBM_LIM = "device_hbm_limit_bytes"
 WARMED = "serving_warmup_programs"
 RUNG = "serving_qos_rung"
+CP_RANK = "serving_qos_cp_rank"
 SESSIONS = "serving_session_active"
 TENANT_REQS = "serving_tenant_requests"
 DRIFT_PSI = "serving_quality_drift_psi"
@@ -83,6 +87,19 @@ RESCACHE_HITS = "serving_rescache_hits"
 RESCACHE_MISSES = "serving_rescache_misses"
 
 _BREAKER_STATES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+
+
+def _rung_cell(rung, cp_rank):
+    """Decode the rung column: the ladder position, suffixed ``cp<R>``
+    when the active rung is a CP-decomposed consensus arm
+    (``serving.qos.cp_rank`` gauge) — a declared approximation a
+    dashboard must not render identically to a c2f coarsening."""
+    if rung is None:
+        return None
+    cell = f"{rung:.0f}"
+    if cp_rank:
+        cell += f"cp{cp_rank:.0f}"
+    return cell
 
 
 def _rescache_pct(counters):
@@ -215,7 +232,8 @@ def render(view, prev_counters, dt, out=None):
             use / 1e9 if use is not None else None,
             _headroom_pct(use, lim),
             rep["counters"].get(WARMED),
-            rep["gauges"].get(RUNG),
+            _rung_cell(rep["gauges"].get(RUNG),
+                       rep["gauges"].get(CP_RANK)),
             rep["gauges"].get(SESSIONS),
             _label_max(rep["gauges"], DRIFT_PSI),
             _hist_family_mean(rep["histograms"], SHADOW_AGREE),
@@ -236,7 +254,8 @@ def render(view, prev_counters, dt, out=None):
         fleet_use / 1e9 if fleet_use is not None else None,
         _headroom_pct(fleet_use, fleet_lim),
         view["counters"].get(WARMED),
-        (view["gauges"].get(RUNG) or {}).get("max"),
+        _rung_cell((view["gauges"].get(RUNG) or {}).get("max"),
+                   (view["gauges"].get(CP_RANK) or {}).get("max")),
         _gauge_sum(view, SESSIONS),
         _fleet_gauge_max(view, DRIFT_PSI),
         _hist_family_mean(view["histograms"], SHADOW_AGREE),
@@ -250,7 +269,7 @@ def render(view, prev_counters, dt, out=None):
          rung, sess, drift, shad, resc) in rows:
         qs = f"{q:.0f}".rjust(6) if q is not None else "-".rjust(6)
         ws_ = f"{warm:.0f}".rjust(5) if warm is not None else "-".rjust(5)
-        rg = f"{rung:.0f}".rjust(5) if rung is not None else "-".rjust(5)
+        rg = (rung if rung is not None else "-").rjust(5)
         ss = f"{sess:.0f}".rjust(5) if sess is not None else "-".rjust(5)
         sh = (f"{shad * 100:.0f}".rjust(6) if shad is not None
               else "-".rjust(6))
@@ -322,6 +341,7 @@ def main(argv=None):
             "hbm_headroom_pct": _headroom_pct(use, lim),
             "warmed_programs": rep["counters"].get(WARMED),
             "qos_rung": rep["gauges"].get(RUNG),
+            "qos_cp_rank": rep["gauges"].get(CP_RANK),
             "sessions": rep["gauges"].get(SESSIONS),
             "tenants": _tenant_totals(rep["counters"]),
             "drift_psi": _label_max(rep["gauges"], DRIFT_PSI),
@@ -345,6 +365,8 @@ def main(argv=None):
             "hbm_limit_bytes": fleet_lim,
             "warmed_programs": view["counters"].get(WARMED),
             "qos_rung": (view["gauges"].get(RUNG) or {}).get("max"),
+            "qos_cp_rank": (view["gauges"].get(CP_RANK)
+                            or {}).get("max"),
             "sessions": _gauge_sum(view, SESSIONS),
             "tenants": _tenant_totals(view["counters"]),
             "drift_psi": _fleet_gauge_max(view, DRIFT_PSI),

@@ -94,6 +94,19 @@ def roofline_side(card: dict) -> Optional[str]:
     return "comp" if float(ai) >= RIDGE_FLOPS_PER_BYTE else "mem"
 
 
+def card_plan(card: dict) -> Optional[str]:
+    """The consensus arm the card was modeled for — 'cp:rank=N' / 'fft'
+    / 'dense' (obs/costcards.py consensus_model kind/cp_rank), None on
+    cards with no analytic model."""
+    model = card.get("model")
+    if not isinstance(model, dict) or "kind" not in model:
+        return None
+    kind = str(model.get("kind") or "dense")
+    if kind == "cp":
+        return f"cp:rank={int(model.get('cp_rank') or 0)}"
+    return kind
+
+
 def card_rows(cards: Dict[str, dict]) -> List[dict]:
     rows = []
     for key in sorted(cards):
@@ -106,6 +119,7 @@ def card_rows(cards: Dict[str, dict]) -> List[dict]:
             "temp_bytes": _field(card, ("memory", "temp_bytes")),
             "flops_per_byte": card.get("flops_per_byte"),
             "model_ok": card.get("model_ok"),
+            "plan": card_plan(card),
             "roofline": roofline_side(card),
             "backend": card.get("backend"),
         })
@@ -151,7 +165,8 @@ def _fmt(v, scale, nd=2) -> str:
 def render_table(rows: List[dict]) -> str:
     width = max([len(r["key"]) for r in rows] + [len("key")])
     lines = [f"{'key':<{width}}  {'GFLOP':>9}  {'MB acc':>9}  "
-             f"{'MB tmp':>9}  {'FLOP/B':>7}  {'model':>5}  side"]
+             f"{'MB tmp':>9}  {'FLOP/B':>7}  {'model':>5}  "
+             f"{'plan':>10}  side"]
     for r in rows:
         ai = r["flops_per_byte"]
         model = {True: "ok", False: "FAIL", None: "-"}[r["model_ok"]]
@@ -160,7 +175,8 @@ def render_table(rows: List[dict]) -> str:
             f"{_fmt(r['bytes_accessed'], 1e6):>9}  "
             f"{_fmt(r['temp_bytes'], 1e6):>9}  "
             f"{(f'{ai:.1f}' if ai is not None else '-'):>7}  "
-            f"{model:>5}  {r['roofline'] or '-'}")
+            f"{model:>5}  {(r['plan'] or '-'):>10}  "
+            f"{r['roofline'] or '-'}")
     lines.append(f"ridge: {RIDGE_FLOPS_PER_BYTE:.1f} FLOP/byte "
                  f"({PEAK_TFLOPS_BF16:g} TFLOP/s bf16 / "
                  f"{PEAK_HBM_GBS:g} GB/s HBM)")

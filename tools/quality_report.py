@@ -19,7 +19,12 @@ prose goes to stderr). ``--strict`` makes quality failures a nonzero
 exit so a session script (or ci_gate --with-quality-report) can gate
 on it:
 
-* any rung's mean agreement below ``--floor``;
+* any rung's mean agreement below its floor — ``--floor`` for c2f
+  rungs; for ``cp:`` rungs (a *declared* approximation,
+  ops/cp4d.py) the declared per-rank agreement floor, resolved from
+  the /healthz ``qos.ladder`` block, so a deliberately-approximate cp
+  rung doesn't fail the c2f floor while still being gated against the
+  number it promised;
 * rung 0 present but not 100% bitwise (broken comparator);
 * no shadow comparisons recorded at all — a report that measured
   nothing must never read as green.
@@ -61,21 +66,56 @@ def fetch_healthz(url: str, timeout_s: float = 5.0) -> dict:
         return json.loads(resp.read().decode("utf-8", "replace"))
 
 
-def evaluate(quality: dict, floor: float) -> dict:
+def _declared_cp_floor(rank: int, fallback: float = 0.1) -> float:
+    """The declared agreement floor for a cp:rank=N rung (the single
+    home is ops/cp4d.py DECLARED_AGREEMENT_FLOOR; nearest declared rank
+    at or below N). Falls back when ncnet_tpu isn't importable — the
+    scrape path must work on report-only hosts without jax."""
+    try:
+        from ncnet_tpu.ops.cp4d import DECLARED_AGREEMENT_FLOOR
+    except Exception:  # noqa: BLE001 — report-only host
+        return fallback
+    best = None
+    for r in sorted(DECLARED_AGREEMENT_FLOOR):
+        if r <= rank:
+            best = DECLARED_AGREEMENT_FLOOR[r]
+    if best is None:
+        best = DECLARED_AGREEMENT_FLOOR[min(DECLARED_AGREEMENT_FLOOR)]
+    return best
+
+
+def evaluate(quality: dict, floor: float, ladder=None) -> dict:
     """The report record from one /healthz ``quality`` block.
 
     ``ok`` reflects the strict gate's three rules; ``failures`` names
-    each violated one (empty = clean).
+    each violated one (empty = clean). ``ladder`` is the /healthz
+    ``qos.ladder`` knob list — it tells which rung indices are cp
+    rungs, which are gated at their declared per-rank floor instead of
+    the c2f ``floor``.
     """
     drift = quality.get("drift") or {}
     shadow = quality.get("shadow") or {}
     rungs = shadow.get("rungs") or {}
+    ladder = list(ladder or [])
     failures = []
+    rung_floors = {}
     for rung, agg in sorted(rungs.items()):
         mean = agg.get("mean_agreement")
-        if mean is not None and mean < floor:
+        try:
+            idx = int(rung)
+        except (TypeError, ValueError):
+            idx = 0
+        knobs = ladder[idx - 1] if 0 < idx <= len(ladder) else {}
+        kind = (knobs or {}).get("kind", "c2f")
+        rung_floor = floor
+        if kind == "cp":
+            rung_floor = _declared_cp_floor(
+                int((knobs or {}).get("rank") or 0))
+        rung_floors[rung] = {"kind": kind, "floor": rung_floor}
+        if mean is not None and mean < rung_floor:
             failures.append(
-                f"rung {rung} mean agreement {mean:g} below floor {floor:g}")
+                f"rung {rung} ({kind}) mean agreement {mean:g} below "
+                f"floor {rung_floor:g}")
     zero = rungs.get("0")
     if zero and zero.get("n") and (zero.get("bitwise_frac") or 0.0) < 1.0:
         failures.append(
@@ -98,6 +138,7 @@ def evaluate(quality: dict, floor: float) -> dict:
         "shadow_errors": shadow.get("errors"),
         "tau_px": shadow.get("tau_px"),
         "floor": floor,
+        "rung_floors": rung_floors,
         "ok": not failures,
         "failures": failures,
     }
@@ -220,7 +261,8 @@ def main(argv=None, fetch=None, model=None) -> int:
                           "failures": [f"unreachable: {exc}"]}))
         return 1
     quality = health.get("quality") or {}
-    rec = evaluate(quality, args.floor)
+    ladder = (health.get("qos") or {}).get("ladder")
+    rec = evaluate(quality, args.floor, ladder=ladder)
     render(rec)
     print(json.dumps(rec), flush=True)
     return 1 if (args.strict and not rec["ok"]) else 0

@@ -42,7 +42,8 @@ Usage:
     python tools/real_parity.py [--suite pfpascal,pfwillow,tss,inloc]
         [--pth trained_models/ncnet_pfpascal.pth.tar]
         [--ivd_pth trained_models/ncnet_ivd.pth.tar]
-        [--dataset_path datasets/pf-pascal] [--expected_pck 0.789] ...
+        [--dataset_path datasets/pf-pascal] [--expected_pck 0.789]
+        [--consensus cp:rank=8] ...
 """
 
 from __future__ import annotations
@@ -173,6 +174,73 @@ def run_pfpascal(args):
         rec.update(_pfpascal_c2f_delta(args, config, params, mean_pck))
     if args.session:
         rec.update(_pfpascal_session_delta(args, config, params))
+    if args.consensus:
+        rec.update(
+            _pfpascal_consensus_delta(args, config, params, mean_pck))
+    return rec
+
+
+def _parse_consensus(spec):
+    """'fft' | 'cp:rank=N' -> (kind, rank), the serving ladder grammar
+    (serving/qos.parse_ladder) restricted to one rung."""
+    s = spec.strip().lower()
+    if s == "fft":
+        return "fft", 0
+    if s.startswith("cp:rank="):
+        try:
+            return "cp", int(s.split("=", 1)[1])
+        except ValueError:
+            pass
+    raise SystemExit(
+        f"--consensus must be 'fft' or 'cp:rank=N', got {spec!r}")
+
+
+def _pfpascal_consensus_delta(args, config, params, oneshot_pck):
+    """A/B an algebraic consensus arm (cp:rank=N / fft) vs dense
+    one-shot on PF-Pascal.
+
+    Unlike --c2f this is a GATE for cp arms: a cp rung is a declared
+    approximation (ops/cp4d.py), and the PCK drop it costs end-to-end
+    must stay within the rank's declared budget
+    (cp4d.declared_pck_drop) or the run exits nonzero — the per-rung
+    PCK gate the QoS ladder's cp rungs are audited against. fft is
+    exact algebra, so it shares the ±0.01 report-only c2f gate.
+    """
+    import dataclasses
+
+    from ncnet_tpu.cli.eval_pck import evaluate_pck
+    from ncnet_tpu.data import PFPascalDataset
+    from ncnet_tpu.evals import delta_within_gate
+    from ncnet_tpu.ops import cp4d
+
+    kind, rank = _parse_consensus(args.consensus)
+    arm_config = dataclasses.replace(
+        config, consensus_kind=kind, consensus_cp_rank=rank)
+    csv = os.path.join(args.dataset_path, "image_pairs", "test_pairs.csv")
+    dataset = PFPascalDataset(
+        csv, args.dataset_path,
+        output_size=(args.image_size, args.image_size),
+        pck_procedure="scnet",
+    )
+    log(f"evaluating {args.consensus} consensus PCK@{args.alpha} at "
+        f"{args.image_size} px (params baked: the arm factorizes "
+        "weights at trace time) ...")
+    arm_pck, _ = evaluate_pck(
+        arm_config, params, dataset, args.batch_size, args.alpha,
+        num_workers=args.num_workers, bake_params=True,
+    )
+    delta = float(arm_pck) - float(oneshot_pck)
+    rec = {
+        "consensus_arm": args.consensus,
+        "consensus_pck": round(float(arm_pck), 4),
+        "consensus_pck_delta": round(delta, 4),
+    }
+    if kind == "cp":
+        budget = cp4d.declared_pck_drop(rank)
+        rec["consensus_declared_pck_drop"] = budget
+        rec["consensus_within_gate"] = delta >= -budget
+    else:
+        rec["consensus_within_gate"] = delta_within_gate(delta)
     return rec
 
 
@@ -626,6 +694,12 @@ def main(argv=None):
     ap.add_argument("--session_seed_radius", type=int, default=1,
                     help="Chebyshev seed dilation, matching the serving "
                     "engine's --session_seed_radius")
+    ap.add_argument("--consensus", type=str, default="",
+                    help="also eval PF-Pascal under an algebraic "
+                    "consensus arm ('cp:rank=N' or 'fft') and GATE the "
+                    "PCK drop against the rank's declared budget "
+                    "(ops/cp4d.py DECLARED_PCK_DROP; fft is exact and "
+                    "report-only)")
     ap.add_argument("--alpha", type=float, default=0.1)
     ap.add_argument("--batch_size", type=int, default=8)
     ap.add_argument("--num_workers", type=int, default=4)
@@ -651,6 +725,11 @@ def main(argv=None):
         records.append(rec)
         print(json.dumps(rec), flush=True)
         if rec.get("parity") is False:
+            failed_gate = True
+        # A cp arm's declared PCK budget is a hard gate (fft/c2f deltas
+        # stay report-only — they promise exactness, not a budget).
+        if (rec.get("consensus_declared_pck_drop") is not None
+                and rec.get("consensus_within_gate") is False):
             failed_gate = True
 
     if len(suites) > 1:
