@@ -38,7 +38,8 @@ DOCS_SECTION = "## Serving & SLO metric families"
 
 #: Families the docs table must cover, both ways (the fleet surface).
 SCOPED_PREFIXES = ("serving.", "slo.", "obs.heartbeat.", "breaker.",
-                   "ncnet.", "bulk.", "engine.", "device.", "trace.")
+                   "ncnet.", "bulk.", "engine.", "device.", "trace.",
+                   "train.")
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_<>]+)*$")
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import time
 
@@ -25,6 +26,7 @@ import numpy as np
 from .. import obs
 from ..data import ImagePairDataset, DataLoader
 from ..parallel import make_mesh, multihost
+from ..reliability import failpoints
 from ..training import (
     create_train_state,
     load_opt_state,
@@ -85,6 +87,22 @@ def main(argv=None):
     parser.add_argument(
         "--profile_dir", type=str, default="",
         help="capture a jax.profiler trace of the run for TensorBoard/Perfetto",
+    )
+    # Training observatory (docs/OBSERVABILITY.md "Training
+    # observatory"): the divergence sentinel resolves loss/grad-norm a
+    # few steps late (never a same-step sync) and applies this policy
+    # on NaN/inf or sustained grad-norm drift.
+    parser.add_argument(
+        "--on_divergence", type=str, default="halt",
+        choices=list(obs.train_watch.POLICIES),
+        help="divergence policy: halt raises after the train-divergence "
+        "flight dump, skip drops the offending steps from the epoch "
+        "average and continues, dump-only records and continues",
+    )
+    parser.add_argument(
+        "--step_timeout_s", type=float, default=0.0,
+        help="hard per-step watchdog: a device step hung past this many "
+        "seconds flight-dumps and exits (0 disables)",
     )
     args = parser.parse_args(argv)
 
@@ -426,9 +444,29 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
     loader.set_epoch(start_epoch - 1)
 
     def put(batch):
-        return put_batch(
+        out = put_batch(
             {k: batch[k] for k in ("source_image", "target_image")}
         )
+        # Manifest ids stay HOST-side (never device-put): the
+        # divergence sentinel's ring names offending batches by them.
+        if "_indices" in batch:
+            out["_indices"] = np.asarray(batch["_indices"])
+        return out
+
+    # Training observatory: per-step telemetry + span trees, the
+    # bounded-lag divergence sentinel, per-host step beacons, and the
+    # optional per-step watchdog (obs/train_watch.py). Dumps land next
+    # to the run log when one is active.
+    run_path = getattr(obs.get_run(), "path", None)
+    watch = obs.train_watch.TrainWatch(
+        policy=args.on_divergence,
+        lr=args.lr,
+        log_interval=args.log_interval,
+        host=multihost.host_label(),
+        step_timeout_s=args.step_timeout_s,
+        flight_dir=os.path.dirname(os.path.abspath(run_path))
+        if run_path else None,
+    )
 
     for epoch in range(start_epoch, args.num_epochs + 1):
         t0 = time.time()
@@ -460,23 +498,32 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
         # full sync every step, serializing dispatch; on a tunneled backend
         # that costs a round trip per batch. The sync happens only at log
         # points (per batch at the default --log_interval 1, matching the
-        # reference's per-batch print; raise it to unlock async dispatch).
-        t_step = time.perf_counter()
-        for i, batch in enumerate(device_prefetch(resumed(), put), start=skip):
-            trainable, opt_state, loss = train_step(
+        # reference's per-batch print; raise it to unlock async dispatch)
+        # and in the sentinel, which resolves values a few steps old.
+        watch.reset_epoch()
+        for i, batch in watch.steps(
+            device_prefetch(resumed(), put), start=skip
+        ):
+            # Chaos plant (docs/RELIABILITY.md): error/delay fire here,
+            # pre-dispatch; the corrupt mode is consumed downstream by
+            # the sentinel's loss resolve in obs/train_watch.py.
+            failpoints.fire("train.step", payload=i)
+            trainable, opt_state, loss, aux = train_step(
                 trainable, state.frozen, opt_state,
                 batch["source_image"], batch["target_image"],
             )
-            # Host wall time between dispatches — measures the steady-
-            # state step rate without adding a sync (under async dispatch
-            # individual values lag the device; the mean converges).
-            now = time.perf_counter()
-            obs.histogram("train.step_time_s").observe(now - t_step)
-            t_step = now
+            # Books step-time/data-wait histograms, the train.step span
+            # tree, the step beacon, and queues loss/grad-norm for the
+            # bounded-lag divergence check (may raise TrainDivergence
+            # under --on_divergence halt).
+            watch.book(
+                epoch=epoch, step=i, loss=loss,
+                grad_norm=aux["grad_norm"],
+                update_ratio=aux["update_ratio"],
+                batch_ids=batch.get("_indices"),
+            )
             if i % args.log_interval == 0:
                 loss = float(loss)  # the only fetch of this scalar
-                obs.gauge("train.loss").set(loss)
-                obs.event("train_step", epoch=epoch, step=i, loss=loss)
                 print(
                     f"Train epoch {epoch} [{i}/{len(loader)}]\tloss: "
                     f"{loss:.6f}",
@@ -520,9 +567,21 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                            "epoch_losses": losses},
                     tag="step",
                 )
-        train_loss = (
-            float(np.mean([float(l) for l in losses])) if losses else 0.0
-        )
+        # Resolve the sentinel's tail before averaging: the last `lag`
+        # steps' losses must still pass the divergence check.
+        watch.drain()
+        loss_vals = [float(l) for l in losses]
+        if watch.policy == "skip":
+            # skip policy: divergent steps are dropped from the curve
+            # (a NaN would otherwise poison the epoch mean and every
+            # downstream best-checkpoint comparison) — the run records
+            # the skip and keeps training.
+            n_bad = sum(1 for v in loss_vals if not math.isfinite(v))
+            if n_bad:
+                obs.event("train_divergence_skipped", epoch=epoch,
+                          n_skipped=n_bad)
+                loss_vals = [v for v in loss_vals if math.isfinite(v)]
+        train_loss = float(np.mean(loss_vals)) if loss_vals else 0.0
         train_dt = time.time() - t0
 
         val_loss, n_val = 0.0, 0
@@ -581,6 +640,7 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                 },
                 is_best=is_best,
             )
+    watch.close()
 
 
 if __name__ == "__main__":

@@ -130,7 +130,13 @@ class DataLoader:
                         samples = list(
                             pool.map(self.dataset.__getitem__, batch_idx)
                         )
-                        put(self.collate_fn(samples))
+                        batch = self.collate_fn(samples)
+                        # Manifest identity rides along host-side: the
+                        # training divergence sentinel's flight ring
+                        # names the offending batch by dataset indices
+                        # (obs/train_watch.py). Never device-put.
+                        batch["_indices"] = np.asarray(batch_idx)
+                        put(batch)
                 put(None)
             except BaseException as exc:  # propagate to the consumer
                 put(exc)

@@ -19,7 +19,16 @@ unconditionally — they no-op (or accumulate invisibly) unless an entry
 point opened a run log.
 """
 
-from . import aggregate, costcards, exemplar, flight, quality, slo, trace
+from . import (
+    aggregate,
+    costcards,
+    exemplar,
+    flight,
+    quality,
+    slo,
+    trace,
+    train_watch,
+)
 from .events import (
     NULL_RUN,
     RunLog,
@@ -71,6 +80,7 @@ __all__ = [
     "quality",
     "slo",
     "trace",
+    "train_watch",
     "SloEngine",
     "SloSpec",
     "default_serving_slos",

@@ -1,0 +1,470 @@
+"""Training observatory: per-step telemetry, divergence sentinel, beacons.
+
+Every observability layer so far (labeled metrics, tracing, SLO burn,
+PSI drift, flight recorder) points at the serving stack; the trainer
+emitted one build event and two gauges. This module gives a training
+run the same instrument panel a request gets:
+
+* :meth:`TrainWatch.steps` + :meth:`TrainWatch.book` split each step's
+  wall time into ``data_wait`` / ``forward_backward`` / ``update``
+  children under a ``train.step`` trace root and book the
+  ``train.step_time_s`` / ``train.data_wait_s`` / ``train.device_s``
+  histograms, so ``tools/trace_export.py`` / ``tools/obs_report.py``
+  render a training run exactly like a serving request. The phases are
+  host-attributed: under async dispatch the device work hides inside
+  ``forward_backward`` (the dispatch-to-dispatch window) via
+  backpressure; ``update`` is the host-side bookkeeping residue.
+
+* a **bounded-lag divergence sentinel**: loss / grad-norm leave the
+  step as device scalars and are resolved to host floats only once
+  they are ``lag`` steps old — by then the device has finished them,
+  so the fetch is never a same-step sync. A non-finite value, or a
+  sustained grad-norm PSI drift (the :class:`~.quality.DriftDetector`
+  ladder over the shared log buckets), emits ONE ``train_divergence``
+  event + ONE rate-limited ``train-divergence`` flight dump per
+  episode, carrying the last-K resolved-step ring with each step's
+  batch manifest ids — then applies the declared policy
+  (``halt`` raises :class:`TrainDivergence`, ``skip`` lets the caller
+  drop the offending step from the curve, ``dump-only`` records).
+  The resolved loss passes through the ``train.step`` failpoint's
+  ``corrupt`` mode (docs/RELIABILITY.md), so chaos runs can flip
+  exactly one loss to NaN without touching the real parameters.
+
+* **per-host step beacons**: every booked step publishes a
+  ``train.step_index`` gauge labeled with this host's replica id;
+  :func:`publish_host_lag` folds a fleet view merged by
+  ``obs/aggregate.py`` into per-host ``train.host_behind_steps``
+  gauges, so a straggling host is visible in ``tools/fleet_status.py``
+  before elastic multi-host training (ROADMAP item 4) makes it fatal.
+
+* **checkpoint health**: :func:`book_checkpoint_save` /
+  :func:`book_checkpoint_load` record save/load duration, on-disk
+  bytes and the completed-checkpoint chain depth of the run dir.
+
+Host-side only, no jax import: device scalars are resolved through
+``np.asarray`` (the ``__array__`` protocol), exactly like
+``obs/quality.py``. A :class:`~.heartbeat.Watchdog` can be armed
+around each step (``step_timeout_s``) so a hung device step hard-exits
+with a flight dump instead of wedging silently; the run-level
+:class:`~.heartbeat.Heartbeat` started by ``obs.init_run`` covers the
+softer stall case (idle runlog -> ``stall`` event + dump).
+
+All TrainWatch state is owned by the single training thread; the only
+other thread it touches is the Watchdog's, which never reads it.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from collections import deque
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Tuple,
+)
+
+import numpy as np
+
+from . import flight as _flight
+from . import trace
+from .events import event
+from .heartbeat import Watchdog
+from .metrics import MetricsRegistry, default_registry, replica_id
+from .quality import DriftDetector
+
+#: Steps a loss/grad-norm device scalar ages before the sentinel
+#: resolves it to a host float. By then the device has long finished
+#: the value, so the fetch never blocks dispatch (the "bounded lag").
+SENTINEL_LAG = 2
+
+#: Resolved-step ring carried by a ``train-divergence`` flight dump —
+#: the steps (with batch manifest ids) leading into the divergence.
+RING_SIZE = 32
+
+POLICIES = ("halt", "skip", "dump-only")
+
+
+class TrainDivergence(RuntimeError):
+    """Raised by the ``halt`` divergence policy: training observed a
+    non-finite loss/grad-norm (or sustained grad-norm drift) and was
+    told not to continue. The run log closes ``error:TrainDivergence``
+    and the ``train-divergence`` flight dump has already been written
+    by the time this propagates."""
+
+    def __init__(self, kind: str, epoch: int, step: int):
+        super().__init__(
+            f"training diverged ({kind}) at epoch {epoch} step {step}; "
+            "see the train-divergence flight dump"
+        )
+        self.kind = kind
+        self.epoch = epoch
+        self.step = step
+
+
+class TrainWatch:
+    """Per-step training telemetry + divergence sentinel + step beacon.
+
+    Single-threaded by design: one instance lives inside one training
+    loop and every method is called from that loop's thread (the race
+    lint's shared-state inventory stays empty). Typical wiring::
+
+        watch = TrainWatch(policy=args.on_divergence, lr=args.lr,
+                           log_interval=args.log_interval)
+        for i, batch in watch.steps(device_prefetch(src, put), start=s):
+            failpoints.fire("train.step", payload=i)
+            trainable, opt_state, loss, aux = train_step(...)
+            watch.book(epoch=epoch, step=i, loss=loss,
+                       grad_norm=aux["grad_norm"],
+                       update_ratio=aux["update_ratio"],
+                       batch_ids=batch.get("_indices"))
+        watch.drain()   # resolve the tail before averaging the epoch
+    """
+
+    def __init__(
+        self,
+        policy: str = "halt",
+        lag: int = SENTINEL_LAG,
+        ring_size: int = RING_SIZE,
+        log_interval: int = 1,
+        lr: Optional[float] = None,
+        host: Optional[str] = None,
+        step_timeout_s: float = 0.0,
+        registry: Optional[MetricsRegistry] = None,
+        drift: Optional[DriftDetector] = None,
+        clock: Callable[[], float] = time.monotonic,
+        flight_dir: Optional[str] = None,
+        watchdog: Optional[Watchdog] = None,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"bad divergence policy {policy!r} (want one of {POLICIES})"
+            )
+        self.policy = policy
+        self.lag = max(int(lag), 0)
+        self.log_interval = max(int(log_interval), 1)
+        self.lr = lr
+        self.step_index = -1
+        self._registry = registry if registry is not None \
+            else default_registry()
+        self._drift = drift if drift is not None else DriftDetector()
+        self._clock = clock
+        self._flight_dir = flight_dir
+        self._host = host or replica_id() or "host0"
+        self._pending: deque = deque()
+        self._ring: deque = deque(maxlen=max(int(ring_size), 1))
+        self._divergent: List[Tuple[int, int]] = []
+        self._in_divergence = False
+        self._t_boundary: Optional[float] = None
+        self._t_batch_ready: Optional[float] = None
+        self._data_wait_s = 0.0
+        self._step_timeout_s = float(step_timeout_s)
+        self._watchdog = watchdog
+        if watchdog is None and self._step_timeout_s > 0:
+            self._watchdog = Watchdog(label="train-step").start()
+
+    # -- step loop --------------------------------------------------------
+
+    def reset_epoch(self) -> None:
+        """Drop the step-boundary timestamp at an epoch edge so the
+        first step of the next epoch does not absorb validation /
+        checkpoint wall time into its ``update`` residue."""
+        self._t_boundary = None
+        self._t_batch_ready = None
+        self._data_wait_s = 0.0
+
+    def steps(self, iterable: Iterable,
+              start: int = 0) -> Iterator[Tuple[int, Any]]:
+        """Yield ``(step, batch)`` while timing each batch wait.
+
+        The wait on ``next()`` is the input pipeline's share of the
+        step (``data_wait``); the watchdog (when armed) gets a fresh
+        deadline per batch so a hung device step — not a long epoch —
+        trips it.
+        """
+        it = iter(iterable)
+        i = start
+        while True:
+            t0 = self._clock()
+            try:
+                batch = next(it)
+            except StopIteration:
+                if self._watchdog is not None:
+                    self._watchdog.disarm()
+                return
+            self._t_batch_ready = self._clock()
+            self._data_wait_s = self._t_batch_ready - t0
+            if self._t_boundary is None:
+                self._t_boundary = t0
+            if self._watchdog is not None and self._step_timeout_s > 0:
+                self._watchdog.arm(self._step_timeout_s)
+            yield i, batch
+            i += 1
+
+    def book(
+        self,
+        *,
+        epoch: int,
+        step: int,
+        loss: Any = None,
+        grad_norm: Any = None,
+        update_ratio: Any = None,
+        batch_ids: Any = None,
+    ) -> None:
+        """Book one completed step (called right after dispatch returns).
+
+        ``loss`` / ``grad_norm`` / ``update_ratio`` stay device scalars
+        here — they enter the sentinel queue and are resolved ``lag``
+        steps later. ``batch_ids`` is the batch's manifest-index array
+        (host-side), carried into the divergence ring.
+        """
+        now = self._clock()
+        if self._watchdog is not None:
+            self._watchdog.disarm()
+        ready = self._t_batch_ready if self._t_batch_ready is not None \
+            else now
+        wait_s = max(self._data_wait_s, 0.0)
+        fb_s = max(now - ready, 0.0)
+        total = wait_s + fb_s
+        if self._t_boundary is not None:
+            total = max(now - self._t_boundary, total)
+        upd_s = max(total - wait_s - fb_s, 0.0)
+        self._t_boundary = now
+        self._t_batch_ready = None
+        self._data_wait_s = 0.0
+
+        reg = self._registry
+        reg.histogram("train.step_time_s").observe(total)
+        reg.histogram("train.data_wait_s").observe(wait_s)
+        reg.histogram("train.device_s").observe(fb_s)
+        reg.counter("train.steps").inc()
+        if self.lr is not None:
+            reg.gauge("train.lr").set(float(self.lr))
+
+        # Span tree: root written after its children (readers build the
+        # tree from ids, not file order) — one request-shaped record
+        # per step for trace_export/obs_report.
+        root = trace.new_root()
+        trace.emit_span("data_wait", wait_s, parents=[root])
+        trace.emit_span("forward_backward", fb_s, parents=[root])
+        trace.emit_span("update", upd_s, parents=[root])
+        trace.emit_root(root, "train.step", total, step=step, epoch=epoch)
+
+        self.publish_beacon(step)
+
+        ids = None
+        if batch_ids is not None:
+            try:
+                ids = [int(x) for x in np.asarray(batch_ids).reshape(-1)]
+            except (TypeError, ValueError):
+                ids = None
+        self._pending.append({
+            "epoch": int(epoch), "step": int(step), "loss": loss,
+            "grad_norm": grad_norm, "update_ratio": update_ratio,
+            "batch_ids": ids,
+        })
+        while len(self._pending) > self.lag:
+            self._resolve(self._pending.popleft())
+
+    def publish_beacon(self, step: int) -> None:
+        """Publish this host's step position as a replica-labeled gauge
+        (merged fleet-side by ``obs/aggregate.py`` ->
+        :func:`publish_host_lag`)."""
+        self.step_index = int(step)
+        self._registry.gauge(
+            "train.step_index", labels={"replica": self._host}
+        ).set(float(step))
+
+    # -- sentinel ---------------------------------------------------------
+
+    def drain(self) -> None:
+        """Resolve every queued step (epoch end / shutdown): the tail
+        of the run must not escape the sentinel just because no younger
+        step aged it out."""
+        while self._pending:
+            self._resolve(self._pending.popleft())
+
+    def close(self) -> None:
+        self.drain()
+        if self._watchdog is not None:
+            self._watchdog.stop()
+
+    @property
+    def divergent_steps(self) -> List[Tuple[int, int]]:
+        """``(epoch, step)`` of every step the sentinel flagged."""
+        return list(self._divergent)
+
+    def _resolve(self, rec: Dict[str, Any]) -> None:
+        # Late import: reliability.failpoints imports the obs package;
+        # a module-level import here would cycle through obs/__init__.
+        from ..reliability import failpoints
+
+        loss_f = gn_f = ur_f = None
+        if rec["loss"] is not None:
+            arr = np.asarray(rec["loss"], dtype=np.float32).reshape(-1)
+            # The chaos plant: an armed ``train.step=corrupt`` site
+            # NaN-poisons this resolved COPY — telemetry sees the
+            # divergence, the real parameters are untouched.
+            arr = failpoints.corrupt("train.step", arr)
+            loss_f = float(arr[0]) if arr.size else None
+        if rec["grad_norm"] is not None:
+            gn_f = float(
+                np.asarray(rec["grad_norm"], dtype=np.float32).reshape(-1)[0]
+            )
+        if rec["update_ratio"] is not None:
+            ur_f = float(
+                np.asarray(
+                    rec["update_ratio"], dtype=np.float32
+                ).reshape(-1)[0]
+            )
+
+        finite = True
+        reg = self._registry
+        if loss_f is not None:
+            if math.isfinite(loss_f):
+                reg.gauge("train.loss").set(loss_f)
+            else:
+                finite = False
+        if gn_f is not None:
+            if math.isfinite(gn_f):
+                reg.gauge("train.grad_norm").set(gn_f)
+            else:
+                finite = False
+        if ur_f is not None and math.isfinite(ur_f):
+            reg.gauge("train.update_ratio").set(ur_f)
+
+        epoch, step = rec["epoch"], rec["step"]
+        entry = {
+            "epoch": epoch,
+            "step": step,
+            "loss": loss_f if loss_f is not None and math.isfinite(loss_f)
+            else None,
+            "grad_norm": gn_f if gn_f is not None and math.isfinite(gn_f)
+            else None,
+            "batch_ids": rec["batch_ids"],
+        }
+        if not finite:
+            entry["nonfinite"] = True
+        self._ring.append(entry)
+
+        if step % self.log_interval == 0 or not finite:
+            fields = {"epoch": epoch, "step": step, "loss": entry["loss"],
+                      "grad_norm": entry["grad_norm"]}
+            if ur_f is not None and math.isfinite(ur_f):
+                fields["update_ratio"] = ur_f
+            if not finite:
+                fields["nonfinite"] = True
+            event("train_step", **fields)
+
+        kind = None
+        if not finite:
+            kind = "nonfinite"
+        elif gn_f is not None:
+            edge = self._drift.offer(gn_f)
+            reg.gauge("train.grad_norm_psi").set(float(self._drift.psi))
+            if edge == "start":
+                kind = "grad_norm_drift"
+        if kind is None:
+            # A finite step re-arms the episode edge: a later relapse
+            # gets its own event + dump.
+            self._in_divergence = False
+            return
+        self._divergence(kind, entry)
+
+    def _divergence(self, kind: str, entry: Dict[str, Any]) -> None:
+        epoch, step = entry["epoch"], entry["step"]
+        self._divergent.append((epoch, step))
+        self._registry.counter("train.divergence.events").inc()
+        if not self._in_divergence:
+            self._in_divergence = True
+            # Event first: it lands in the flight ring, so the dump
+            # written next carries the verdict AND the last-K steps
+            # (with batch manifest ids) that led into it.
+            event("train_divergence", kind=kind, epoch=epoch, step=step,
+                  policy=self.policy, batch_ids=entry.get("batch_ids"),
+                  psi=round(float(self._drift.psi), 4),
+                  ring=list(self._ring))
+            try:
+                _flight.dump("train-divergence", directory=self._flight_dir)
+            except Exception:
+                pass
+        if self.policy == "halt":
+            raise TrainDivergence(kind, epoch, step)
+
+
+# -- fleet-side beacon merge ----------------------------------------------
+
+
+def publish_host_lag(view: dict,
+                     registry: Optional[MetricsRegistry] = None
+                     ) -> Dict[str, float]:
+    """Per-host behind-steps from a merged fleet view.
+
+    ``view`` is ``aggregate.merge_snapshots`` output (registry
+    snapshots or ``fleet_view`` scrapes — the scraped gauge name has
+    dots sanitized to underscores, both spellings are accepted). The
+    lead host defines the front; every host's lag is published as a
+    replica-labeled ``train.host_behind_steps`` gauge and returned.
+    """
+    gauges = view.get("gauges") or {}
+    entry = gauges.get("train.step_index") \
+        or gauges.get("train_step_index")
+    per = (entry or {}).get("per_replica") or {}
+    if not per:
+        return {}
+    lead = max(per.values())
+    behind = {rid: float(lead - v) for rid, v in sorted(per.items())}
+    reg = registry if registry is not None else default_registry()
+    for rid, lag in behind.items():
+        reg.gauge(
+            "train.host_behind_steps", labels={"replica": rid}
+        ).set(lag)
+    return behind
+
+
+# -- checkpoint health ----------------------------------------------------
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for fn in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, fn))
+            except OSError:
+                pass
+    return total
+
+
+def chain_depth(root: str) -> int:
+    """COMPLETE checkpoint dirs (meta.json present — the completeness
+    marker resolve_resume_dir keys on) under a run directory."""
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return 0
+    return sum(
+        1 for e in entries
+        if os.path.isfile(os.path.join(root, e, "meta.json"))
+    )
+
+
+def book_checkpoint_save(path: str, root: str, dur_s: float) -> None:
+    """Record one checkpoint save: duration, bytes on disk, and the
+    run dir's completed-checkpoint chain depth."""
+    reg = default_registry()
+    reg.histogram("train.ckpt.save_s").observe(float(dur_s))
+    reg.gauge("train.ckpt.bytes").set(float(_dir_bytes(path)))
+    reg.gauge("train.ckpt.chain_depth").set(float(chain_depth(root)))
+
+
+def book_checkpoint_load(path: str, dur_s: float) -> None:
+    """Record one checkpoint load's duration."""
+    del path  # symmetry with book_checkpoint_save; labels may ride later
+    default_registry().histogram("train.ckpt.load_s").observe(float(dur_s))

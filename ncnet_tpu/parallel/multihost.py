@@ -96,6 +96,16 @@ def process_index() -> int:
     return jax.process_index()
 
 
+def host_label() -> str:
+    """Stable replica label for this host's training telemetry.
+
+    Step beacons (obs/train_watch.py) publish ``train.step_index``
+    under this label; ``obs.aggregate.merge_snapshots`` then treats it
+    as the aggregation dimension, so per-host step positions survive a
+    fleet merge and straggler lag is computable."""
+    return f"host{jax.process_index()}"
+
+
 def host_local_slice(global_batch_size: int) -> Tuple[int, int]:
     """[start, stop) of this host's rows of a globally-sharded batch.
 

@@ -45,7 +45,9 @@ Planted sites (grep ``failpoints.fire`` for the live list):
 (serving/batcher worker), ``engine.device`` (serving/engine dispatch),
 ``server.handle`` (serving/server request handler), ``client.transport``
 (serving/client), ``checkpoint.save`` / ``checkpoint.save.commit`` /
-``checkpoint.load`` (training/checkpoint), ``bulk.read`` /
+``checkpoint.load`` (training/checkpoint), ``train.step``
+(cli/train step loop; ``corrupt`` NaN-poisons the divergence
+sentinel's resolved loss copy — obs/train_watch), ``bulk.read`` /
 ``bulk.dispatch`` / ``bulk.commit`` / ``bulk.checkpoint``
 (pipeline/bulk). The full site table with failure domains lives in
 docs/RELIABILITY.md and is lint-enforced

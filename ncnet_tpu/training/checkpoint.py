@@ -15,6 +15,7 @@ import dataclasses
 import json
 import os
 import shutil
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -22,6 +23,7 @@ import numpy as np
 
 from ..models.backbone import BackboneConfig
 from ..models.ncnet import NCNetConfig
+from ..obs import train_watch
 from ..reliability import failpoints
 
 
@@ -164,6 +166,7 @@ def save_checkpoint(
     dir among step / step.tmp / step.old; `resolve_resume_dir` (used by
     cli/train.py --resume) checks all three in that order."""
     failpoints.fire("checkpoint.save", payload=directory)
+    t_save = time.perf_counter()
     os.makedirs(directory, exist_ok=True)
     rolling = tag is not None
     final_tag = os.path.join(directory, tag if rolling else f"epoch_{epoch}")
@@ -206,6 +209,11 @@ def save_checkpoint(
         # best/ (or a complete sibling) resolvable, never a partial dir
         # that passes the completeness check.
         copy_checkpoint_dir(tag, os.path.join(directory, "best"))
+    # Checkpoint health telemetry (docs/OBSERVABILITY.md "Training
+    # observatory"): save duration, bytes on disk, chain depth.
+    train_watch.book_checkpoint_save(
+        tag, directory, time.perf_counter() - t_save
+    )
     return tag
 
 
@@ -242,6 +250,7 @@ def load_checkpoint(path: str, opt_state_template=None):
     the reference restore behavior (lib/model.py:217-220).
     """
     failpoints.fire("checkpoint.load", payload=path)
+    t_load = time.perf_counter()
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     config = config_from_dict(meta["config"])
@@ -251,6 +260,7 @@ def load_checkpoint(path: str, opt_state_template=None):
         opt_state = load_opt_state(path, opt_state_template)
         if opt_state is not None:
             result["opt_state"] = opt_state
+    train_watch.book_checkpoint_load(path, time.perf_counter() - t_load)
     return result
 
 

@@ -140,6 +140,10 @@ def make_train_step(
 ):
     """Build the jitted train step (loss + grads + Adam update).
 
+    ``train_step`` returns ``(trainable, opt_state, loss, aux)`` where
+    ``aux`` holds the device-scalar health signals (``grad_norm``,
+    ``update_ratio``) the training observatory resolves lazily.
+
     remat_backbone=True wraps feature extraction in jax.checkpoint so its
     activations are recomputed in the backward pass instead of stored —
     the HBM lever for fine-tuning the backbone (train_fe) at high
@@ -243,7 +247,16 @@ def make_train_step(
             )
         updates, new_opt_state = tx.update(grads, opt_state, state_trainable)
         new_trainable = optax.apply_updates(state_trainable, updates)
-        return new_trainable, new_opt_state, loss
+        # Divergence/health telemetry for obs.train_watch: the global
+        # grad norm and the update/param scale ratio come out as device
+        # scalars — free inside the jit (the norms reuse live buffers),
+        # fetched host-side only by the bounded-lag sentinel.
+        aux = {
+            "grad_norm": optax.global_norm(grads),
+            "update_ratio": optax.global_norm(updates)
+            / (optax.global_norm(state_trainable) + 1e-12),
+        }
+        return new_trainable, new_opt_state, loss, aux
 
     @jax.jit
     def eval_step(state_trainable, state_frozen, source, target):

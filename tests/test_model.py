@@ -162,7 +162,7 @@ def test_train_step_decreases_loss(rng):
     trainable, opt_state = state.trainable, state.opt_state
     losses = []
     for _ in range(8):
-        trainable, opt_state, loss = train_step(
+        trainable, opt_state, loss, _ = train_step(
             trainable, state.frozen, opt_state, src, tgt
         )
         losses.append(float(loss))
@@ -292,7 +292,7 @@ def test_finetune_mask_excludes_bn_stats(rng):
     # output (executable-dependent — flips with the persistent compile
     # cache) the "old" snapshot silently shows the new values.
     old_bb = jax.tree.map(np.array, state.trainable["backbone"])
-    new_t, _, _ = train_step(state.trainable, state.frozen, state.opt_state, src, tgt)
+    new_t, _, _, _ = train_step(state.trainable, state.frozen, state.opt_state, src, tgt)
 
     new_bb = new_t["backbone"]
     last_block_old = old_bb["layer1"][-1]
@@ -326,7 +326,7 @@ def test_finetune_blocks_n2_unfreezes_two_blocks(rng):
     tgt = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
     # np.array (copy), not np.asarray: see test_finetune_mask_excludes_bn_stats.
     old_bb = jax.tree.map(np.array, state.trainable["backbone"])
-    new_t, _, _ = train_step(state.trainable, state.frozen, state.opt_state, src, tgt)
+    new_t, _, _, _ = train_step(state.trainable, state.frozen, state.opt_state, src, tgt)
 
     new_bb = new_t["backbone"]
     assert not np.allclose(old_bb["layer1"][-1]["conv2"], new_bb["layer1"][-1]["conv2"])
@@ -403,7 +403,7 @@ def test_train_step_remat_backbone_matches(rng):
     outs = []
     for remat in (False, True):
         step, _ = make_train_step(config, tx, remat_backbone=remat)
-        t, _, loss = step(
+        t, _, loss, _ = step(
             copy(state.trainable), state.frozen, copy(state.opt_state), src, tgt
         )
         outs.append((t, float(loss)))
@@ -499,7 +499,7 @@ def test_grad_accum_matches_mean_of_microbatches(rng):
     want = optax.apply_updates(trainable, updates)
 
     step2, _ = make_train_step(TINY, tx, accum_steps=2)
-    got, _, loss = step2(trainable, frozen, tx.init(trainable), src, tgt)
+    got, _, loss, _ = step2(trainable, frozen, tx.init(trainable), src, tgt)
     # The weak loss at init is ~1e-5 (pos ≈ neg): compare with an absolute
     # tolerance — f32 summation-order differences are ~1e-7.
     np.testing.assert_allclose(

@@ -95,7 +95,7 @@ def test_dp_train_step_matches_single_device(rng):
     # single device. train_step donates params/opt-state buffers, so pass
     # fresh copies and keep `state` intact for the data-parallel run below.
     copy = lambda t: jax.tree.map(lambda x: jnp.array(x, copy=True), t)
-    t1, _, loss_single = train_step(
+    t1, _, loss_single, _ = train_step(
         copy(state.trainable), state.frozen, copy(state.opt_state), src, tgt
     )
 
@@ -106,7 +106,7 @@ def test_dp_train_step_matches_single_device(rng):
     tgt_s = jax.device_put(tgt, sharding)
     rep = NamedSharding(mesh, P())
     put_rep = lambda t: jax.tree.map(lambda x: jax.device_put(x, rep), t)
-    t2, _, loss_dp = train_step(
+    t2, _, loss_dp, _ = train_step(
         put_rep(state.trainable), put_rep(state.frozen), put_rep(state.opt_state),
         src_s, tgt_s,
     )
@@ -232,7 +232,7 @@ def test_train_step_on_2d_mesh(rng):
     train_step, _ = make_train_step(config, tx)
 
     copy = lambda t: jax.tree.map(lambda x: jnp.array(x, copy=True), t)
-    t1, _, loss_single = train_step(
+    t1, _, loss_single, _ = train_step(
         copy(state.trainable), state.frozen, copy(state.opt_state), src, tgt
     )
 
@@ -240,7 +240,7 @@ def test_train_step_on_2d_mesh(rng):
     sharding = NamedSharding(mesh, P(("dp", "sp")))
     rep = NamedSharding(mesh, P())
     put_rep = lambda t: jax.tree.map(lambda x: jax.device_put(x, rep), t)
-    t2, _, loss_2d = train_step(
+    t2, _, loss_2d, _ = train_step(
         put_rep(state.trainable), put_rep(state.frozen), put_rep(state.opt_state),
         jax.device_put(src, sharding), jax.device_put(tgt, sharding),
     )

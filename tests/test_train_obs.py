@@ -1,0 +1,431 @@
+"""Training observatory (ncnet_tpu/obs/train_watch.py): per-step
+telemetry, the bounded-lag divergence sentinel, heartbeat/watchdog
+armor, per-host beacons, and the train_report gate
+(docs/OBSERVABILITY.md "Training observatory")."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.obs import events as obs_events
+from ncnet_tpu.obs import train_watch as tw
+from ncnet_tpu.obs.metrics import MetricsRegistry
+from ncnet_tpu.obs.quality import DriftDetector
+from ncnet_tpu.reliability import failpoints
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _drive(watch, clock, n, *, wait_s=0.01, device_s=0.1, loss=0.5,
+           grad_norm=1.0, epoch=1):
+    """Run n fake steps through watch.steps/book with known timings."""
+
+    def batches():
+        for i in range(n):
+            clock.t += wait_s  # the next() wait = data_wait share
+            yield {"_indices": np.array([2 * i, 2 * i + 1])}
+
+    for i, batch in watch.steps(batches()):
+        clock.t += device_s  # dispatch-to-book = forward_backward share
+        watch.book(epoch=epoch, step=i, loss=np.float32(loss),
+                   grad_norm=np.float32(grad_norm),
+                   update_ratio=np.float32(0.01),
+                   batch_ids=batch["_indices"])
+
+
+# -- per-step telemetry ----------------------------------------------------
+
+
+def test_step_telemetry_fake_clock():
+    clock = FakeClock()
+    watch = tw.TrainWatch(policy="skip", lag=1, lr=5e-4, clock=clock,
+                          host="hA")
+    _drive(watch, clock, 5)
+    watch.drain()
+
+    snap = obs.snapshot()
+    hists, gauges = snap["histograms"], snap["gauges"]
+    assert hists["train.step_time_s"]["count"] == 5
+    # Every step is 0.01 wait + 0.1 device: the split histograms carry
+    # exactly those shares.
+    assert hists["train.data_wait_s"]["sum"] == pytest.approx(0.05)
+    assert hists["train.device_s"]["sum"] == pytest.approx(0.5)
+    assert hists["train.step_time_s"]["sum"] == pytest.approx(0.55)
+    assert snap["counters"]["train.steps"] == 5
+    assert gauges["train.lr"] == pytest.approx(5e-4)
+    assert gauges["train.loss"] == pytest.approx(0.5)
+    assert gauges["train.grad_norm"] == pytest.approx(1.0)
+    assert gauges["train.update_ratio"] == pytest.approx(0.01)
+    # The per-host beacon: last booked step, replica-labeled.
+    assert gauges['train.step_index{replica="hA"}'] == 4.0
+    assert watch.divergent_steps == []
+
+
+def test_step_spans_and_events_land_in_runlog(tmp_path):
+    path = str(tmp_path / "runlog-train-unit.jsonl")
+    run = obs.init_run("train", path, heartbeat_s=0)
+    clock = FakeClock()
+    watch = tw.TrainWatch(policy="skip", lag=0, clock=clock)
+    _drive(watch, clock, 3)
+    watch.close()
+    run.close()
+
+    with open(path) as fh:
+        records = [json.loads(l) for l in fh]
+    roots = [r for r in records
+             if r["event"] == "train.step" and r.get("kind") == "span"]
+    assert len(roots) == 3
+    assert {r["step"] for r in roots} == {0, 1, 2}
+    # Each root's trace carries the data_wait/forward_backward/update
+    # children — the request-shaped tree trace_export renders.
+    for root in roots:
+        kids = [r for r in records if r.get("kind") == "span"
+                and r.get("trace_id") == root["trace_id"]
+                and r.get("parent_id") == root["span_id"]]
+        assert {k["event"] for k in kids} == {
+            "data_wait", "forward_backward", "update"}
+    steps = [r for r in records if r["event"] == "train_step"]
+    assert len(steps) == 3
+    assert all(np.isfinite(r["loss"]) for r in steps)
+    assert all("grad_norm" in r for r in steps)
+
+
+# -- divergence sentinel ---------------------------------------------------
+
+
+def test_corrupt_failpoint_one_dump_skip_policy(tmp_path):
+    """The acceptance drill: NCNET_FAILPOINTS=train.step=corrupt:x1
+    must produce EXACTLY ONE train-divergence dump whose ring names
+    the offending step's batch manifest ids, and the run must survive
+    under the skip policy."""
+    failpoints.configure("train.step=corrupt:x1")
+    clock = FakeClock()
+    watch = tw.TrainWatch(policy="skip", lag=2, clock=clock,
+                          flight_dir=str(tmp_path))
+    _drive(watch, clock, 6)
+    watch.drain()  # the run survives: every step resolved, no raise
+
+    assert watch.divergent_steps == [(1, 0)]
+    dumps = glob.glob(str(tmp_path / "flight-train-divergence-*.jsonl"))
+    assert len(dumps) == 1, dumps
+    with open(dumps[0]) as fh:
+        dumped = [json.loads(l) for l in fh]
+    div = [r for r in dumped if r.get("event") == "train_divergence"]
+    assert len(div) == 1
+    assert div[0]["kind"] == "nonfinite"
+    assert div[0]["policy"] == "skip"
+    # Step 0's batch rode ids [0, 1] (see _drive) — the dump names it.
+    assert div[0]["batch_ids"] == [0, 1]
+    ring = div[0]["ring"]
+    assert any(e["step"] == 0 and e.get("nonfinite")
+               and e["batch_ids"] == [0, 1] for e in ring)
+
+
+def test_halt_policy_raises_dump_only_records(tmp_path):
+    failpoints.configure("train.step=corrupt:x1")
+    clock = FakeClock()
+    watch = tw.TrainWatch(policy="halt", lag=0, clock=clock,
+                          flight_dir=str(tmp_path / "halt"))
+    os.makedirs(tmp_path / "halt")
+    with pytest.raises(tw.TrainDivergence) as exc:
+        _drive(watch, clock, 2)
+    assert exc.value.kind == "nonfinite"
+    assert (exc.value.epoch, exc.value.step) == (1, 0)
+
+    failpoints.clear()
+    failpoints.configure("train.step=corrupt:x1")
+    obs.flight.recorder().clear()
+    clock2 = FakeClock()
+    quiet = tw.TrainWatch(policy="dump-only", lag=0, clock=clock2,
+                          flight_dir=str(tmp_path / "dumponly"))
+    os.makedirs(tmp_path / "dumponly")
+    _drive(quiet, clock2, 3)  # records, never raises
+    quiet.drain()
+    assert quiet.divergent_steps == [(1, 0)]
+    assert glob.glob(str(tmp_path / "dumponly" / "flight-*.jsonl"))
+
+
+def test_sustained_nan_is_one_episode_one_dump(tmp_path):
+    """Every corrupted step is counted, but a sustained NaN run is ONE
+    episode: one train_divergence event, one dump — not a dump storm."""
+    failpoints.configure("train.step=corrupt:x4")
+    clock = FakeClock()
+    watch = tw.TrainWatch(policy="dump-only", lag=0, clock=clock,
+                          flight_dir=str(tmp_path))
+    _drive(watch, clock, 6)
+    watch.drain()
+    assert len(watch.divergent_steps) == 4
+    assert len(glob.glob(str(tmp_path / "flight-train-divergence-*"))) == 1
+    reg_snap = obs.snapshot()
+    assert reg_snap["counters"]["train.divergence.events"] == 4
+
+
+def test_grad_norm_drift_triggers_divergence(tmp_path):
+    drift = DriftDetector(window=8, threshold=0.25, sustain=2,
+                          check_every=4)
+    clock = FakeClock()
+    watch = tw.TrainWatch(policy="dump-only", lag=0, clock=clock,
+                          drift=drift, flight_dir=str(tmp_path))
+
+    def batches(n):
+        for _ in range(n):
+            clock.t += 0.01
+            yield {}
+
+    step = 0
+    # Freeze the reference window at grad_norm ~0.01 ...
+    for i, _b in watch.steps(batches(8)):
+        clock.t += 0.1
+        watch.book(epoch=1, step=i, loss=np.float32(0.1),
+                   grad_norm=np.float32(0.01))
+        step = i
+    # ... then a sustained 1000x grad-norm shift: PSI crosses the
+    # ladder and the sentinel flags a grad_norm_drift divergence.
+    for i, _b in watch.steps(batches(16), start=step + 1):
+        clock.t += 0.1
+        watch.book(epoch=1, step=i, loss=np.float32(0.1),
+                   grad_norm=np.float32(10.0))
+    watch.drain()
+    assert watch.divergent_steps, "drift never flagged"
+    assert obs.snapshot()["gauges"]["train.grad_norm_psi"] > 0.25
+    dumps = glob.glob(str(tmp_path / "flight-train-divergence-*"))
+    assert len(dumps) == 1
+    with open(dumps[0]) as fh:
+        div = [json.loads(l) for l in fh
+               if "train_divergence" in l][0]
+    assert div["kind"] == "grad_norm_drift"
+
+
+# -- hang armor ------------------------------------------------------------
+
+
+class FakeWatchdog:
+    def __init__(self):
+        self.calls = []
+
+    def arm(self, timeout_s):
+        self.calls.append(("arm", timeout_s))
+
+    def disarm(self):
+        self.calls.append(("disarm", None))
+
+    def stop(self):
+        self.calls.append(("stop", None))
+
+
+def test_watchdog_armed_per_step():
+    wd = FakeWatchdog()
+    clock = FakeClock()
+    watch = tw.TrainWatch(policy="skip", lag=0, clock=clock,
+                          step_timeout_s=30.0, watchdog=wd)
+    _drive(watch, clock, 3)
+    watch.close()
+    arms = [c for c in wd.calls if c[0] == "arm"]
+    assert len(arms) == 3 and all(t == 30.0 for _, t in arms)
+    # Every armed deadline is disarmed by its book() before the next
+    # arm — a long epoch never trips the dog, only a hung step does.
+    seq = [c[0] for c in wd.calls]
+    for i, op in enumerate(seq):
+        if op == "arm":
+            assert "disarm" in seq[i + 1:], "arm without a later disarm"
+    assert seq[-1] == "stop"
+
+
+def test_heartbeat_flags_hung_step(tmp_path):
+    """A device step that stops making progress shows up as a stall
+    episode: stall event + a flight dump next to the runlog — the
+    soft armor around the step loop (the Watchdog is the hard one)."""
+    clock = FakeClock()
+    run = obs_events.RunLog(str(tmp_path / "runlog-train-hb.jsonl"),
+                            "train", clock=clock)
+    hb = obs.Heartbeat(run, interval_s=10.0, stall_after_s=25.0,
+                       clock=clock)
+    run.event("train_step", step=0, loss=0.1)  # healthy progress
+    clock.t = 10.0
+    assert hb.beat_once()["stalled"] is False
+    clock.t = 40.0  # the next step hung: no progress for 30s
+    assert hb.beat_once()["stalled"] is True
+    assert hb.stalls == 1
+    run.close()
+    with open(run.path) as fh:
+        records = [json.loads(l) for l in fh]
+    assert any(r["event"] == "stall" for r in records)
+    assert glob.glob(str(tmp_path / "flight-stall-*.jsonl"))
+
+
+# -- per-host beacons ------------------------------------------------------
+
+
+def test_two_host_beacon_merge_shows_lag():
+    """Two processes' registries, merged the way fleet_status merges
+    scrapes: the straggler's train.host_behind_steps is positive."""
+    r0, r1 = MetricsRegistry(), MetricsRegistry()
+    clock = FakeClock()
+    w0 = tw.TrainWatch(registry=r0, host="host0", clock=clock)
+    w1 = tw.TrainWatch(registry=r1, host="host1", clock=clock)
+    w0.publish_beacon(100)
+    w1.publish_beacon(92)
+
+    view = obs.aggregate.merge_snapshots([r0.snapshot(), r1.snapshot()])
+    out = MetricsRegistry()
+    behind = tw.publish_host_lag(view, registry=out)
+    assert behind == {"host0": 0.0, "host1": 8.0}
+    gauges = out.snapshot()["gauges"]
+    assert gauges['train.host_behind_steps{replica="host1"}'] == 8.0
+    assert gauges['train.host_behind_steps{replica="host0"}'] == 0.0
+    # No beacons -> no lag rows, not a crash.
+    assert tw.publish_host_lag({"gauges": {}}, registry=out) == {}
+
+
+# -- checkpoint health -----------------------------------------------------
+
+
+def test_checkpoint_health_bookkeeping(tmp_path):
+    ck = tmp_path / "run" / "epoch_1"
+    ck.mkdir(parents=True)
+    (ck / "params.npz").write_bytes(b"x" * 1000)
+    (ck / "meta.json").write_text("{}")
+    tw.book_checkpoint_save(str(ck), str(tmp_path / "run"), 0.25)
+    tw.book_checkpoint_load(str(ck), 0.5)
+    snap = obs.snapshot()
+    assert snap["histograms"]["train.ckpt.save_s"]["sum"] == \
+        pytest.approx(0.25)
+    assert snap["histograms"]["train.ckpt.load_s"]["sum"] == \
+        pytest.approx(0.5)
+    assert snap["gauges"]["train.ckpt.bytes"] >= 1000
+    assert snap["gauges"]["train.ckpt.chain_depth"] == 1.0
+
+
+# -- train_report ----------------------------------------------------------
+
+
+def _make_runlog(tmp_path, final_loss):
+    """A miniature but schema-true training runlog: step events, span
+    trees, an epoch record, and a final metrics snapshot."""
+    path = str(tmp_path / "runlog-train-rep.jsonl")
+    run = obs.init_run("train", path, heartbeat_s=0)
+    clock = FakeClock()
+    watch = tw.TrainWatch(policy="skip", lag=0, clock=clock)
+    _drive(watch, clock, 4, loss=final_loss)
+    watch.close()
+    obs.event("epoch", epoch=1, train_loss=final_loss, val_loss=0.0,
+              pairs_per_s=8.0, dur_s=0.5)
+    run.close()
+    return path
+
+
+def test_train_report_strict_green_on_reference(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import train_report
+
+    path = _make_runlog(tmp_path, final_loss=0.001)
+    rc = train_report.main([path, "--strict"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, out
+    rec = json.loads(out[0])
+    assert rc == 0 and rec["ok"] is True
+    assert rec["steps"] == 4 and rec["spans"] == 4
+    assert rec["divergence_events"] == 0
+    assert all(rec["strict"].values()), rec["strict"]
+    assert rec["step_time_hist_count"] == 4
+    assert rec["grad_norm_points"] == 4
+
+
+def test_train_report_strict_red_on_worse_curve(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import train_report
+
+    # Final loss 1.0 sits far above the committed reference's
+    # 0.0 +/- 0.05 margin: the gate must go red, and must SAY why.
+    path = _make_runlog(tmp_path, final_loss=1.0)
+    rc = train_report.main([path, "--strict"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and rec["ok"] is False
+    assert rec["strict"]["final_loss_vs_reference"] is False
+    # The rest of the evidence is intact — only the curve regressed.
+    assert rec["strict"]["train_step_spans"] is True
+    assert rec["strict"]["step_time_histogram"] is True
+
+
+def test_train_report_empty_runlog_is_an_error(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import train_report
+
+    empty = tmp_path / "runlog-train-empty.jsonl"
+    empty.write_text("")
+    rc = train_report.main([str(empty)])
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and "error" in rec
+
+
+# -- bench/gate contracts --------------------------------------------------
+
+
+def test_bench_trend_passes_train_fields_through(tmp_path, capsys):
+    """tools/bench_trend.py forwards the train-bench shape fields: a
+    train_step_pairs_per_s trend is only comparable within one device
+    count / batch / remat-accum configuration."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_trend
+
+    rec = {"n": 1, "cmd": "bench_train", "rc": 0,
+           "parsed": {"metric": "train_step_pairs_per_s",
+                      "value": 6.4, "unit": "pairs/s",
+                      "step_ms": 312.5, "devices": 4, "batch": 16,
+                      "accum": 2, "remat_policy": "dots"}}
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump(rec, fh)
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["metric"] == "train_step_pairs_per_s"
+    assert report["step_ms"] == 312.5
+    assert report["devices"] == 4 and report["batch"] == 16
+    assert report["accum"] == 2 and report["remat_policy"] == "dots"
+
+
+def test_ci_gate_train_smoke_skipped_not_green(capsys):
+    """ci_gate without --with-train-smoke records the check as
+    {"skipped": true, "optional": true} — never silently green."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import ci_gate
+
+    assert "train_smoke" in ci_gate.OPTIONAL_CHECKS
+    rc = ci_gate.main(["--skip", "tier1", "--skip", "lint",
+                       "--skip", "bench_trend"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert rec["checks"]["train_smoke"] == {
+        "skipped": True, "optional": True}
+
+
+def test_bench_train_error_path_one_json_line():
+    """bench_train.py's early-error paths keep the one-JSON-line
+    stdout contract: a bad --accum/--batch shape prints exactly one
+    parseable {"error": ...} line and exits 2."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_train.py"),
+         "--batch", "4", "--accum", "3", "--backbone", "vgg",
+         "--image-size", "48", "--iters", "1"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert res.returncode == 2, res.stderr[-1000:]
+    lines = [l for l in res.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "train_step_pairs_per_s"
+    assert "--accum" in rec["error"]

@@ -66,15 +66,22 @@ def main(argv=None):
 
     devices = dial_devices(args.dial_timeout)
     if devices is None:
+        # One-JSON-line contract even on failure: stdout carries exactly
+        # one parseable line, prose goes to stderr (same as bench.py).
         print("backend dial timed out; aborting", file=sys.stderr)
+        print(json.dumps({"metric": "train_step_pairs_per_s",
+                          "error": "backend dial timed out"}), flush=True)
         return 2
     n_dev = len(devices)
     # Same validation as cli/train.py: fail fast, not inside the jit trace.
     if args.accum > 1 and (
         args.batch % args.accum or args.batch // args.accum < 2
     ):
-        print(f"--accum {args.accum} needs --batch {args.batch} divisible "
-              "by it with a micro-batch >= 2", file=sys.stderr)
+        msg = (f"--accum {args.accum} needs --batch {args.batch} divisible "
+               "by it with a micro-batch >= 2")
+        print(msg, file=sys.stderr)
+        print(json.dumps({"metric": "train_step_pairs_per_s", "error": msg}),
+              flush=True)
         return 2
     # Largest device count dividing the MICRO-batch (same rule as
     # cli/train.py — the accumulated scan shards per micro-batch).
@@ -111,14 +118,14 @@ def main(argv=None):
         train_step, _ = make_train_step(config, tx, remat_backbone=args.remat,
                                         accum_steps=args.accum)
         trainable, opt_state = state.trainable, state.opt_state
-        trainable, opt_state, loss = train_step(  # compile + warmup
+        trainable, opt_state, loss, _ = train_step(  # compile + warmup
             trainable, state.frozen, opt_state,
             batch["source_image"], batch["target_image"],
         )
         jax.block_until_ready(loss)
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            trainable, opt_state, loss = train_step(
+            trainable, opt_state, loss, _ = train_step(
                 trainable, state.frozen, opt_state,
                 batch["source_image"], batch["target_image"],
             )

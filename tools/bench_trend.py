@@ -107,6 +107,9 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
     # And the algebraic-consensus fields (ops/cp4d.py arms): a consensus
     # trend won by a CP-truncated or spectral plan is only honest next
     # to the plan kind/rank and the measured agreement-vs-dense.
+    # And the train-bench fields (tools/bench_train.py
+    # train_step_pairs_per_s): a training-throughput trend is only
+    # comparable within one device count / batch / remat-accum shape.
     for key in ("replicas", "single_replica_pairs_per_s", "scaling_x",
                 "scaling_efficiency", "pairs_done", "pairs_s",
                 "quarantined", "resumes",
@@ -114,7 +117,8 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
                 "shadow_agreement", "quality_drift_psi",
                 "fanout_width", "rescache_hit_rate", "legs",
                 "legs_failed",
-                "consensus_plan_kind", "cp_rank", "cp_agreement"):
+                "consensus_plan_kind", "cp_rank", "cp_agreement",
+                "step_ms", "devices", "batch", "accum", "remat_policy"):
         if key in latest:
             report[key] = latest[key]
     return report

@@ -44,7 +44,10 @@ dead replica's legs, join them into the query trace, and still answer
 algebraic-consensus parity self-test (``python -m ncnet_tpu.ops.cp4d
 --selftest`` on CPU — rank-full CP bitwise vs conv4d_reference, the
 truncated-rank declared agreement floor, and FFT relative-error
-parity). All are off by default because they serve
+parity). ``--with-train-smoke`` runs a tiny CPU training-throughput
+smoke (``tools/bench_train.py --backbone vgg --image-size 48 --batch 2
+--iters 2`` — the jitted train step must complete and emit its
+one-JSON-line headline). All are off by default because they serve
 live traffic for several seconds (or, for trace_join, are covered by
 tier-1); a default run still RECORDS them as
 ``{"skipped": true, "optional": true}`` so the JSON never reads as if
@@ -79,7 +82,7 @@ CHECKS = ("tier1", "lint", "bench_trend")
 # default run records them as {"skipped": true, "optional": true}.
 OPTIONAL_CHECKS = ("full_lint", "tenant_flood", "session_chaos",
                    "quality_report", "trace_join", "localize_smoke",
-                   "cp_parity")
+                   "cp_parity", "train_smoke")
 
 
 def _run(cmd, timeout_s, cpu_env=False) -> dict:
@@ -192,6 +195,19 @@ def run_cp_parity(timeout_s: float) -> dict:
         timeout_s, cpu_env=True)
 
 
+def run_train_smoke(timeout_s: float) -> dict:
+    # The smallest real train step that still exercises the full path:
+    # VGG backbone at 48 px, batch 2, two timed iterations on CPU. A
+    # pass means the jitted two-pass correlation step + Adam update
+    # compile and run; the pairs/s headline feeds bench_trend's
+    # train_step_pairs_per_s pass-through.
+    return _run(
+        [sys.executable, os.path.join("tools", "bench_train.py"),
+         "--backbone", "vgg", "--image-size", "48", "--batch", "2",
+         "--iters", "2"],
+        timeout_s, cpu_env=True)
+
+
 def run_trace_join(timeout_s: float) -> dict:
     # The distributed-trace assembly self-test: two synthetic runlogs
     # (client, server skewed +30s) must export as ONE joined tree with
@@ -244,6 +260,10 @@ def main(argv=None) -> int:
                          "self-test (python -m ncnet_tpu.ops.cp4d "
                          "--selftest on CPU); off by default, recorded "
                          "as skipped when off")
+    ap.add_argument("--with-train-smoke", action="store_true",
+                    help="also run the CPU training-step smoke "
+                         "(tools/bench_train.py, tiny VGG config); off "
+                         "by default, recorded as skipped when off")
     ap.add_argument("--chaos-timeout-s", type=float, default=300.0,
                     help="wall-clock fence for the optional chaos checks")
     args = ap.parse_args(argv)
@@ -261,6 +281,7 @@ def main(argv=None) -> int:
         "localize_smoke": lambda: run_localize_smoke(
             args.chaos_timeout_s),
         "cp_parity": lambda: run_cp_parity(args.timeout_s),
+        "train_smoke": lambda: run_train_smoke(args.chaos_timeout_s),
     }
     enabled = {"full_lint": args.with_full_lint,
                "tenant_flood": args.with_tenant_flood,
@@ -268,7 +289,8 @@ def main(argv=None) -> int:
                "quality_report": args.with_quality_report,
                "trace_join": args.with_trace_join,
                "localize_smoke": args.with_localize_smoke,
-               "cp_parity": args.with_cp_parity}
+               "cp_parity": args.with_cp_parity,
+               "train_smoke": args.with_train_smoke}
     checks = {}
     for name in CHECKS + OPTIONAL_CHECKS:
         if name in args.skip or not enabled.get(name, True):

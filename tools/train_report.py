@@ -1,0 +1,220 @@
+"""Training-run report: runlog -> loss curve / throughput / step-time.
+
+Reads one training run's ``runlog-train-*.jsonl`` (rotated segments
+included) and prints ONE JSON line (the house tool contract) with the
+run's headline numbers; the human-readable loss-curve / throughput /
+step-time table goes to stderr::
+
+    python tools/train_report.py out/runlog-train-20260807-1.jsonl
+    {"metric": "train_report", "value": 0.412, "unit": "loss",
+     "steps": 120, "epochs": 3, "divergence_events": 0, ...}
+
+The report is assembled from the records the training observatory
+(ncnet_tpu/obs/train_watch.py) writes:
+
+- ``train_step`` events -> per-step loss / grad-norm series,
+- ``train.step`` span records -> step-time distribution (the same
+  tree tools/trace_export.py renders),
+- ``epoch`` events -> per-epoch loss + pairs/s throughput table,
+- ``train_divergence`` events -> divergence count,
+- the final ``metrics`` snapshot -> ``train.*`` histogram totals.
+
+``--strict`` turns the report into a regression gate against a
+committed reference curve (default
+``tests/data/train_reference_curve.json``): the run must have booked
+at least ``min_steps`` steps, its final train loss must not sit more
+than ``loss_margin`` above the reference's (absolute margin — losses
+from the weak-supervision objective can be negative, so a relative
+check would flip sign), no divergence events past
+``max_divergence_events``, and the observatory's evidence must be
+present (``train.step`` spans, a non-empty ``train.step_time_s``
+histogram, a grad-norm series). Exit 1 with the failed checks named
+on stderr; the JSON carries ``"strict"`` either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+DEFAULT_REFERENCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "data", "train_reference_curve.json")
+
+
+def load_run(path: str) -> List[dict]:
+    """All complete JSON records, rotated segments included (same
+    tolerance as tools/obs_report.py: a truncated final line is a
+    crash artifact, not an error)."""
+    from ncnet_tpu.obs.events import runlog_segments
+
+    records = []
+    for seg in runlog_segments(path):
+        if not os.path.exists(seg):
+            continue
+        with open(seg, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return records
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def summarize(records: List[dict]) -> dict:
+    """Fold a run's records into the report dict (no gating here)."""
+    steps = [r for r in records if r.get("event") == "train_step"]
+    epochs = [r for r in records if r.get("event") == "epoch"]
+    divergences = [r for r in records
+                   if r.get("event") == "train_divergence"]
+    step_spans = [r for r in records
+                  if r.get("event") == "train.step"
+                  and r.get("kind") == "span"]
+    losses = [r["loss"] for r in steps
+              if isinstance(r.get("loss"), (int, float))
+              and math.isfinite(r["loss"])]
+    grad_norms = [r["grad_norm"] for r in steps
+                  if isinstance(r.get("grad_norm"), (int, float))
+                  and math.isfinite(r["grad_norm"])]
+    durs = sorted(float(r.get("dur_s", 0.0)) for r in step_spans)
+
+    # The LAST metrics snapshot is the run's final state (flush_metrics
+    # runs per epoch and again at close).
+    snapshot: Dict = {}
+    for r in records:
+        if r.get("event") == "metrics" and isinstance(
+                r.get("snapshot"), dict):
+            snapshot = r["snapshot"]
+    hists = snapshot.get("histograms") or {}
+    step_hist = hists.get("train.step_time_s") or {}
+
+    report = {
+        "metric": "train_report",
+        "value": round(losses[-1], 6) if losses else None,
+        "unit": "loss",
+        "steps": len(steps),
+        "epochs": len(epochs),
+        "divergence_events": len(divergences),
+        "spans": len(step_spans),
+        "first_loss": round(losses[0], 6) if losses else None,
+        "final_loss": round(losses[-1], 6) if losses else None,
+        "grad_norm_points": len(grad_norms),
+        "final_grad_norm": round(grad_norms[-1], 6) if grad_norms
+        else None,
+        "step_time_hist_count": int(step_hist.get("count", 0)),
+        "step_p50_s": round(_percentile(durs, 0.50), 4),
+        "step_p95_s": round(_percentile(durs, 0.95), 4),
+    }
+    if epochs:
+        last = epochs[-1]
+        report["final_epoch_train_loss"] = last.get("train_loss")
+        report["pairs_per_s"] = last.get("pairs_per_s")
+    report["_epochs_table"] = epochs  # stripped before printing
+    return report
+
+
+def render_table(report: dict, out) -> None:
+    epochs = report.get("_epochs_table") or []
+    print(f"steps={report['steps']}  spans={report['spans']}  "
+          f"divergences={report['divergence_events']}  "
+          f"step p50={report['step_p50_s']}s "
+          f"p95={report['step_p95_s']}s", file=out)
+    if not epochs:
+        return
+    print(f"{'epoch':>5} {'train_loss':>12} {'val_loss':>12} "
+          f"{'pairs/s':>9} {'dur_s':>8}", file=out)
+    for e in epochs:
+        def num(key, nd=4):
+            v = e.get(key)
+            return f"{v:.{nd}f}" if isinstance(v, (int, float)) else "-"
+        print(f"{e.get('epoch', '?'):>5} {num('train_loss'):>12} "
+              f"{num('val_loss'):>12} {num('pairs_per_s', 1):>9} "
+              f"{num('dur_s', 1):>8}", file=out)
+
+
+def strict_gate(report: dict, reference: dict) -> dict:
+    """Every check named, every verdict recorded — the gate's JSON
+    must show WHAT was compared, not just pass/fail."""
+    checks = {}
+    min_steps = int(reference.get("min_steps", 1))
+    checks["min_steps"] = report["steps"] >= min_steps
+    ref_loss = reference.get("final_train_loss")
+    margin = float(reference.get("loss_margin", 0.05))
+    if ref_loss is not None and report["final_loss"] is not None:
+        checks["final_loss_vs_reference"] = (
+            report["final_loss"] <= float(ref_loss) + margin)
+    else:
+        checks["final_loss_vs_reference"] = report["final_loss"] is not None
+    max_div = int(reference.get("max_divergence_events", 0))
+    checks["divergence_events"] = report["divergence_events"] <= max_div
+    # Observatory evidence: the run must have been INSTRUMENTED, not
+    # merely finished — a green curve with no spans or histograms means
+    # the telemetry silently fell off.
+    checks["train_step_spans"] = report["spans"] > 0
+    checks["step_time_histogram"] = report["step_time_hist_count"] > 0
+    checks["grad_norm_series"] = report["grad_norm_points"] > 0
+    return checks
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("runlog", help="training runlog path (base path of "
+                    "a rotated set)")
+    ap.add_argument("--strict", action="store_true",
+                    help="gate against the committed reference curve; "
+                         "exit 1 on any failed check")
+    ap.add_argument("--reference", default=DEFAULT_REFERENCE,
+                    help="reference-curve JSON (default "
+                         "tests/data/train_reference_curve.json)")
+    args = ap.parse_args(argv)
+
+    records = load_run(args.runlog)
+    if not records:
+        print(json.dumps({"metric": "train_report",
+                          "error": f"no records in {args.runlog}"}))
+        print(f"no records in {args.runlog}", file=sys.stderr)
+        return 1
+    report = summarize(records)
+    render_table(report, sys.stderr)
+    report.pop("_epochs_table", None)
+
+    rc = 0
+    if args.strict:
+        try:
+            with open(args.reference, encoding="utf-8") as fh:
+                reference = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(json.dumps({"metric": "train_report",
+                              "error": f"bad reference: {exc}"}))
+            print(f"cannot read reference {args.reference}: {exc}",
+                  file=sys.stderr)
+            return 1
+        checks = strict_gate(report, reference)
+        report["strict"] = checks
+        report["ok"] = all(checks.values())
+        for name, ok in checks.items():
+            if not ok:
+                print(f"STRICT FAIL: {name}", file=sys.stderr)
+        rc = 0 if report["ok"] else 1
+    print(json.dumps(report))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
