@@ -51,6 +51,10 @@ SCOPE = (
     "ncnet_tpu/reliability/",
     "ncnet_tpu/pipeline/",
     "ncnet_tpu/evals/feature_cache.py",
+    # Elastic membership plane (ISSUE 20): the lease-heartbeat thread
+    # and the flock'd generation mutations.
+    "ncnet_tpu/parallel/membership.py",
+    "ncnet_tpu/training/elastic.py",
 )
 
 #: Generated-block markers in docs/ANALYSIS.md.

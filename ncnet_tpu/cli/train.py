@@ -26,9 +26,12 @@ import numpy as np
 from .. import obs
 from ..data import ImagePairDataset, DataLoader
 from ..parallel import make_mesh, multihost
+from ..parallel.membership import MembershipPlane
 from ..reliability import failpoints
 from ..training import (
     create_train_state,
+    elastic as elastic_mod,
+    load_latest_checkpoint,
     load_opt_state,
     make_train_step,
     resolve_resume_dir,
@@ -104,6 +107,27 @@ def main(argv=None):
         help="hard per-step watchdog: a device step hung past this many "
         "seconds flight-dumps and exits (0 disables)",
     )
+    # Elastic membership (docs/RELIABILITY.md "Elastic training
+    # membership"): hosts rendezvous through lease files under
+    # --elastic_dir; when a peer goes silent past the lease TTL the
+    # survivors bump the generation, reload the last committed
+    # checkpoint, re-derive their batch slices for the reduced host
+    # set, and continue.
+    parser.add_argument(
+        "--elastic_dir", type=str, default="",
+        help="filesystem membership root shared by the gang (empty "
+        "disables elastic mode)")
+    parser.add_argument(
+        "--elastic_host", type=str, default="",
+        help="this host's membership name (default: multihost.host_label())")
+    parser.add_argument(
+        "--elastic_hosts", type=str, default="",
+        help="comma-separated initial gang; the first host to form the "
+        "generation record wins, later hosts join it")
+    parser.add_argument(
+        "--lease_ttl_s", type=float, default=5.0,
+        help="membership lease TTL: a host silent this long is declared "
+        "dead and evicted by the survivors")
     args = parser.parse_args(argv)
 
     if args.grad_accum < 1:
@@ -138,6 +162,37 @@ def main(argv=None):
     # After it, jax.devices() is the GLOBAL device list and the same program
     # runs unchanged on every host.
     multihost.initialize()
+
+    # Elastic membership plane: form/join the gang BEFORE any heavy
+    # setup so the lease heartbeat covers model build and jit compile
+    # (peers must not declare this host dead while it compiles).
+    driver = None
+    if args.elastic_dir:
+        host_id = args.elastic_host or multihost.host_label()
+        gang = sorted(
+            {h.strip() for h in args.elastic_hosts.split(",") if h.strip()}
+            | {host_id}
+        )
+        plane = MembershipPlane(
+            args.elastic_dir, host_id, lease_ttl_s=args.lease_ttl_s)
+        plane.form(gang)
+        # Rejoin after eviction: a previously-dead host finding itself
+        # outside the current generation admits itself via a grow bump
+        # at the CURRENT generation; peers pick the new record up as a
+        # MembershipChange at their next step_check.
+        while True:
+            rec = plane.read_generation()
+            if rec is None or host_id in rec["hosts"]:
+                break
+            plane.bump(
+                sorted(set(rec["hosts"]) | {host_id}),
+                resume_epoch=rec.get("resume_epoch", 1),
+                resume_step=rec.get("resume_step", 0),
+                expected_generation=rec["generation"],
+            )
+        driver = elastic_mod.ElasticDriver(
+            plane, ledger_dir=args.elastic_dir)
+        driver.start()
 
     print("NCNet-TPU training")
     print(args)
@@ -209,7 +264,21 @@ def main(argv=None):
     # count to divide it.
     n_proc = multihost.process_count()
     n_dev = len(jax.devices())
-    micro = args.batch_size // max(args.grad_accum, 1)
+    # Elastic mode trains the largest batch the LIVE host count divides
+    # (round down + train_batch_adjusted event) instead of aborting.
+    global_batch = args.batch_size
+    if driver is not None:
+        global_batch = elastic_mod.adjusted_global_batch(
+            args.batch_size, driver.n_hosts)
+    # Rows that flow through THIS process's device grid per step: in
+    # elastic harness mode (one JAX process per host) that is the
+    # membership-derived slice, not the global batch.
+    local_rows = (
+        global_batch // driver.n_hosts
+        if driver is not None and n_proc == 1
+        else global_batch
+    )
+    micro = local_rows // max(args.grad_accum, 1)
     if n_proc > 1:
         if micro % n_dev:
             raise SystemExit(
@@ -230,8 +299,14 @@ def main(argv=None):
     # Each host decodes only its slice of every (deterministically
     # scheduled) global batch and contributes it to the global array.
     if n_proc > 1:
-        batch_slice = multihost.host_local_slice(args.batch_size)
+        batch_slice = multihost.host_local_slice(global_batch)
         put = lambda b: multihost.host_local_batch(b, mesh)  # noqa: E731
+    elif driver is not None and driver.n_hosts > 1:
+        # Elastic harness mode: each host trains its generation-derived
+        # slice on its own device grid (gradient exchange, if any, is
+        # the launcher's concern — see training/elastic.py docstring).
+        batch_slice = driver.slice_for(global_batch)
+        put = lambda b: shard_batch(b, mesh)  # noqa: E731
     else:
         batch_slice = None
         put = lambda b: shard_batch(b, mesh)  # noqa: E731
@@ -248,55 +323,73 @@ def main(argv=None):
         args.dataset_image_path,
         output_size=size,
     )
-    if args.batch_size > len(dataset):
+    if global_batch > len(dataset):
         raise SystemExit(
-            f"batch_size {args.batch_size} exceeds dataset size {len(dataset)}; "
+            f"batch_size {global_batch} exceeds dataset size {len(dataset)}; "
             "with drop_last this would train on zero batches"
         )
     loader = DataLoader(
-        dataset, args.batch_size, shuffle=True, num_workers=args.num_workers,
+        dataset, global_batch, shuffle=True, num_workers=args.num_workers,
         seed=args.seed, drop_last=True, batch_slice=batch_slice,
     )
-    if args.batch_size > len(dataset_val):
+    if global_batch > len(dataset_val):
         print(
-            f"WARNING: batch_size {args.batch_size} exceeds val-set size "
+            f"WARNING: batch_size {global_batch} exceeds val-set size "
             f"{len(dataset_val)}; validation will see zero batches, so the "
             "best checkpoint is selected by train loss instead",
             flush=True,
         )
     loader_val = DataLoader(
-        dataset_val, args.batch_size, shuffle=False,
+        dataset_val, global_batch, shuffle=False,
         num_workers=args.num_workers, drop_last=True, batch_slice=batch_slice,
     )
 
-    # Claim the run directory ATOMICALLY at launch (exist_ok=False):
-    # checkpoints are otherwise written lazily at end of epoch, so two runs
-    # started the same minute would silently interleave into one dir.
-    # Host 0 claims; other hosts never write (see _epoch_loop).
-    suffix = 0
-    while True:
-        name = time.strftime("%Y-%m-%d_%H%M") + "_" + args.result_model_fn
-        if suffix:
-            name += f"_{suffix + 1}"
-        ckpt_dir = os.path.join(args.result_model_dir, name)
-        if multihost.process_index() != 0:
-            break
-        try:
-            os.makedirs(ckpt_dir, exist_ok=False)
-            break
-        except FileExistsError:
-            suffix += 1
+    if driver is not None:
+        # Elastic mode: every host must agree on the checkpoint chain
+        # (survivors resume from whatever the writer last committed),
+        # so the run dir is pinned by name, not timestamp-claimed.
+        ckpt_dir = os.path.join(args.result_model_dir, args.result_model_fn)
+        os.makedirs(ckpt_dir, exist_ok=True)
+    else:
+        # Claim the run directory ATOMICALLY at launch (exist_ok=False):
+        # checkpoints are otherwise written lazily at end of epoch, so two
+        # runs started the same minute would silently interleave into one
+        # dir. Host 0 claims; other hosts never write (see _epoch_loop).
+        suffix = 0
+        while True:
+            name = time.strftime("%Y-%m-%d_%H%M") + "_" + args.result_model_fn
+            if suffix:
+                name += f"_{suffix + 1}"
+            ckpt_dir = os.path.join(args.result_model_dir, name)
+            if multihost.process_index() != 0:
+                break
+            try:
+                os.makedirs(ckpt_dir, exist_ok=False)
+                break
+            except FileExistsError:
+                suffix += 1
 
-    # Telemetry on host 0 only: params/losses are replicated, so one
-    # run log per run (same ownership rule as checkpoint writes).
+    # Checkpoint ownership: rank 0 of the live generation in elastic
+    # mode (writer takeover on a shrink is automatic), process 0
+    # otherwise. Params/losses are replicated, so exactly one host
+    # writes the chain.
+    writer = (driver.is_writer if driver is not None
+              else multihost.process_index() == 0)
+
+    # Telemetry on the writer only — except elastic mode, where every
+    # host keeps its OWN runlog (hosts share ckpt_dir; the chaos audit
+    # reads each host's beacons and the writer's curve).
     run_log = None
-    if args.run_log and multihost.process_index() == 0:
-        run_log = obs.init_run(
-            "train",
-            args.run_log if args.run_log != "auto"
-            else obs.default_log_path(ckpt_dir, "train"),
-            args=args,
-        )
+    if args.run_log and (driver is not None
+                         or multihost.process_index() == 0):
+        if args.run_log != "auto":
+            log_path = args.run_log
+        elif driver is not None:
+            log_path = os.path.join(
+                ckpt_dir, f"runlog-train-{driver.plane.host}.jsonl")
+        else:
+            log_path = obs.default_log_path(ckpt_dir, "train")
+        run_log = obs.init_run("train", log_path, args=args)
         run_log.event(
             "devices",
             n_devices=len(jax.devices()),
@@ -387,22 +480,117 @@ def main(argv=None):
 
     try:
         with trace_context(args.profile_dir):
-            _epoch_loop(args, config, state, train_step, eval_step, loader,
-                        loader_val, put, ckpt_dir, start_epoch=start_epoch,
-                        skip_steps=skip_steps, resume_meta=resume_meta)
+            while True:
+                try:
+                    _epoch_loop(args, config, state, train_step, eval_step,
+                                loader, loader_val, put, ckpt_dir,
+                                start_epoch=start_epoch,
+                                skip_steps=skip_steps,
+                                resume_meta=resume_meta, driver=driver,
+                                writer=writer)
+                    if driver is not None and driver.n_hosts > 1:
+                        # An early finisher's expiring lease must not
+                        # read as a mid-run death to peers still
+                        # training (they would bump and replay the
+                        # tail epoch for nothing).
+                        driver.finish_barrier(args.num_epochs)
+                    break
+                except elastic_mod.MembershipChange as chg:
+                    if multihost.process_count() > 1:
+                        # jax.distributed cannot reshape a live process
+                        # set: the generation bump is already durable,
+                        # so exit and let the launcher re-form the gang
+                        # (survivors resume from the same checkpoint
+                        # chain at the new generation).
+                        raise SystemExit(
+                            "membership changed (generation "
+                            f"{chg.record.get('generation')}, hosts "
+                            f"{chg.record.get('hosts')}): relaunch to "
+                            "re-form the gang"
+                        )
+                    (loader, loader_val, start_epoch, skip_steps,
+                     resume_meta, writer) = _elastic_resume(
+                        args, chg, driver, state, ckpt_dir,
+                        dataset, dataset_val, len(loader))
     except BaseException as exc:
         if run_log is not None:
             run_log.close(f"error:{type(exc).__name__}")
         raise
+    finally:
+        if driver is not None:
+            driver.stop()
     if run_log is not None:
         run_log.close("ok")
     print("Done!")
 
 
+def _elastic_resume(args, chg, driver, state, ckpt_dir, dataset, dataset_val,
+                    steps_per_epoch):
+    """Adopt a new generation in-process: reload the last committed
+    checkpoint (fallback walk), re-derive this host's batch slice for
+    the live host set, rebuild the loaders, and hand back the position
+    the epoch loop re-enters at."""
+    path, loaded = load_latest_checkpoint(
+        ckpt_dir, opt_state_template=state.opt_state)
+    meta = loaded["meta"]
+    if "step_in_epoch" in meta:
+        r_epoch, r_step = int(meta["epoch"]), int(meta["step_in_epoch"])
+    else:
+        r_epoch, r_step = int(meta["epoch"]) + 1, 0
+    det_epoch = chg.epoch if chg.epoch is not None else r_epoch
+    det_step = chg.step if chg.step is not None else r_step
+    driver.resume(chg.record, r_epoch, r_step, det_epoch, det_step,
+                  steps_per_epoch=steps_per_epoch)
+    print(
+        f"elastic: generation {driver.generation} hosts {driver.hosts}"
+        + (f" (dead: {chg.dead})" if chg.dead else "")
+        + f"; resuming from {path} at epoch {r_epoch}, step {r_step}",
+        flush=True,
+    )
+    # Restore params/opt state IN PLACE: the jitted train_step closed
+    # over the original optimizer, and the reloaded opt_state has the
+    # same tree structure (load_opt_state enforces it).
+    fresh, _tx = create_train_state(
+        loaded["params"],
+        learning_rate=args.lr,
+        train_fe=args.fe_finetune_params > 0,
+        fe_finetune_blocks=max(args.fe_finetune_params, 1),
+    )
+    state.trainable = fresh.trainable
+    state.frozen = fresh.frozen
+    state.opt_state = loaded.get("opt_state", fresh.opt_state)
+    # The shrunk host count may no longer divide the old batch: re-round
+    # and rebuild the loaders with this generation's slice. The loader
+    # schedule stays a pure function of (seed, epoch), so every survivor
+    # replays the identical batch sequence.
+    global_batch = elastic_mod.adjusted_global_batch(
+        args.batch_size, driver.n_hosts)
+    batch_slice = (driver.slice_for(global_batch)
+                   if driver.n_hosts > 1 else None)
+    loader = DataLoader(
+        dataset, global_batch, shuffle=True, num_workers=args.num_workers,
+        seed=args.seed, drop_last=True, batch_slice=batch_slice,
+    )
+    loader_val = DataLoader(
+        dataset_val, global_batch, shuffle=False,
+        num_workers=args.num_workers, drop_last=True,
+        batch_slice=batch_slice,
+    )
+    # A per-epoch checkpoint means that epoch COMPLETED.
+    start_epoch, skip_steps = (
+        (r_epoch, r_step) if "step_in_epoch" in meta else (r_epoch, 0))
+    return (loader, loader_val, start_epoch, skip_steps, meta,
+            driver.is_writer)
+
+
 def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                 put_batch, ckpt_dir, start_epoch: int = 1,
-                skip_steps: int = 0, resume_meta=None):
+                skip_steps: int = 0, resume_meta=None, driver=None,
+                writer=None):
     from ..data.loader import device_prefetch
+
+    if writer is None:
+        writer = multihost.process_index() == 0
 
     # Restore the loss history and best-checkpoint threshold from the
     # resumed checkpoint's meta so a resume does not silently reset them
@@ -462,7 +650,11 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
         policy=args.on_divergence,
         lr=args.lr,
         log_interval=args.log_interval,
-        host=multihost.host_label(),
+        # Elastic harness mode: the membership name IS the replica
+        # label (every process is JAX process 0, so host_label() would
+        # collide all hosts onto "host0" in a fleet merge).
+        host=(driver.plane.host if driver is not None
+              else multihost.host_label()),
         step_timeout_s=args.step_timeout_s,
         flight_dir=os.path.dirname(os.path.abspath(run_path))
         if run_path else None,
@@ -508,6 +700,11 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
             # pre-dispatch; the corrupt mode is consumed downstream by
             # the sentinel's loss resolve in obs/train_watch.py.
             failpoints.fire("train.step", payload=i)
+            if driver is not None:
+                # Membership probe (time-gated; a dict read most steps).
+                # Raises MembershipChange — main() reloads the last
+                # committed checkpoint and re-enters this loop.
+                driver.step_check(epoch, i)
             trainable, opt_state, loss, aux = train_step(
                 trainable, state.frozen, opt_state,
                 batch["source_image"], batch["target_image"],
@@ -530,10 +727,22 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                     flush=True,
                 )
             losses.append(loss)
+            if driver is not None:
+                # Step ledger: the zero-silent-step-loss audit replays
+                # these lines per generation (tools/chaos_train.py).
+                driver.record_step(
+                    epoch, i,
+                    loader.batch_slice or (0, loader.batch_size))
             if (
                 args.save_interval
                 and (i + 1) % args.save_interval == 0
-                and multihost.process_index() == 0
+                and writer
+                # Elastic gangs: only commit a position every live
+                # member's lease shows reached (a dead host must not
+                # leave its share of the post-commit steps untrained —
+                # see ElasticDriver.commit_barrier).
+                and (driver is None or driver.n_hosts == 1
+                     or driver.commit_barrier(epoch, i + 1))
             ):
                 # Fetch each device scalar at most once across all saves
                 # (with --log_interval > 1 most entries are still device
@@ -567,6 +776,8 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                            "epoch_losses": losses},
                     tag="step",
                 )
+                if driver is not None:
+                    driver.note_commit(epoch, i + 1)
         # Resolve the sentinel's tail before averaging: the last `lag`
         # steps' losses must still pass the divergence check.
         watch.drain()
@@ -597,7 +808,7 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
         val_loss /= max(n_val, 1)
         dt = time.time() - t0
         pairs_per_s = (
-            (len(losses) - n_preloaded) * args.batch_size
+            (len(losses) - n_preloaded) * loader.batch_size
             / max(train_dt, 1e-9)
         )
         print(
@@ -621,10 +832,12 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
         select_loss = val_loss if n_val else train_loss
         is_best = select_loss < best_val
         best_val = min(select_loss, best_val)
-        # Checkpoints are written by host 0 only: params/opt state are
+        # Checkpoints are written by the writer only (host 0, or rank 0
+        # of the live generation in elastic mode): params/opt state are
         # replicated, so other hosts would race identical writes on shared
         # storage (and per-host strftime run dirs can straddle a minute).
-        if multihost.process_index() == 0:
+        if writer and (driver is None or driver.n_hosts == 1
+                       or driver.commit_barrier(epoch, len(loader))):
             full_params = {
                 "backbone": trainable.get("backbone", state.frozen["backbone"]),
                 "neigh_consensus": trainable["neigh_consensus"],
@@ -640,6 +853,10 @@ def _epoch_loop(args, config, state, train_step, eval_step, loader, loader_val,
                 },
                 is_best=is_best,
             )
+            if driver is not None:
+                # The epoch COMPLETED: survivors of a later shrink
+                # resume at the next epoch's first step.
+                driver.note_commit(epoch + 1, 0)
     watch.close()
 
 

@@ -428,6 +428,31 @@ def publish_host_lag(view: dict,
     return behind
 
 
+# -- elastic membership ---------------------------------------------------
+
+
+def book_membership(generation: int, hosts_live: int,
+                    registry: Optional[MetricsRegistry] = None) -> None:
+    """Publish the elastic-training membership view: the current
+    generation and the live host count (training/elastic.py books this
+    at start and after every adopted generation change)."""
+    reg = registry if registry is not None else default_registry()
+    reg.gauge("train.generation").set(float(generation))
+    reg.gauge("train.hosts_live").set(float(hosts_live))
+
+
+def book_resume(generation: int, lost_steps: int,
+                registry: Optional[MetricsRegistry] = None) -> None:
+    """Record one survivor resume: the resume count and the re-trained
+    ("lost") steps between the detected position and the checkpoint
+    position training restarted from."""
+    del generation  # gauge side is book_membership's; kept for symmetry
+    reg = registry if registry is not None else default_registry()
+    reg.counter("train.resumes").inc()
+    if lost_steps > 0:
+        reg.counter("train.lost_steps").inc(float(lost_steps))
+
+
 # -- checkpoint health ----------------------------------------------------
 
 

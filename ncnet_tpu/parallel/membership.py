@@ -1,0 +1,405 @@
+"""Filesystem-rendezvous membership for elastic multi-host training.
+
+ROADMAP item 4's training half: one preempted host must not kill the
+run. There is no etcd on a TPU pod, but there IS a shared filesystem
+(the checkpoint chain already rides it), so membership is a
+filesystem-rendezvous plane with the same durability discipline as the
+bulk pipeline's ledger (pipeline/bulk.py):
+
+* **Leases** — every live host renews ``<root>/hosts/<host>.lease.json``
+  (atomic tmp + fsync + ``os.replace``; a torn lease is unreadable, not
+  wrong). A lease older than ``lease_ttl_s`` is an expired host. Each
+  lease carries an ``owner`` nonce, so a second process heartbeating
+  the same host name is detected as a steal instead of two processes
+  silently sharing one identity.
+
+* **Generation record** — ``<root>/generation.json`` is the single
+  source of truth for *who is in the run*: a monotonic ``generation``
+  counter plus the ordered live-host list and the resume marker
+  (epoch/step of the last committed checkpoint at bump time). It is
+  only ever mutated under an exclusive ``flock`` of
+  ``<root>/.membership.lock`` and only ever moves FORWARD: a bump that
+  would not raise the generation returns the newer record instead of
+  writing (two survivors racing the same eviction converge on one
+  bump). Rank within a generation is position in the sorted host list,
+  so every member derives the same ``host_local_slice`` without
+  another round of coordination.
+
+* **Rejoin** — a host that lost its lease and comes back observes a
+  generation that no longer lists it; ``join``/``renew`` raise
+  :class:`StaleGenerationError` instead of letting it write state at
+  the old generation. Re-admission is an explicit ``bump`` (grow) that
+  the survivors pick up exactly like a shrink.
+
+Failpoints (docs/RELIABILITY.md "Planted sites"): ``membership.lease``
+fires on every lease write (kill = a host dying mid-heartbeat;
+delay = a slow NFS renew), ``membership.detect`` fires on every
+dead-host scan (error = a detector crash drill).
+
+The clock is injectable (``clock=``) so lease expiry, steal and bump
+ordering are unit-testable without wall-time sleeps
+(tests/test_membership.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..reliability import failpoints
+
+
+class MembershipError(RuntimeError):
+    """Base class for membership-plane failures."""
+
+
+class StaleGenerationError(MembershipError):
+    """This host acted at a generation the plane has moved past —
+    it was evicted (lease expired, survivors bumped) and must re-enter
+    through the CURRENT generation instead of writing old state."""
+
+    def __init__(self, host: str, held: int, record: dict):
+        super().__init__(
+            f"host {host!r} holds generation {held} but the membership "
+            f"plane is at generation {record.get('generation')} with hosts "
+            f"{record.get('hosts')} — rejoin via a new bump, do not write "
+            "state at the old generation"
+        )
+        self.host = host
+        self.held = held
+        self.record = record
+
+
+class LeaseStolenError(MembershipError):
+    """Another process wrote this host's lease: two processes are
+    heartbeating the same host identity (a relaunch raced the
+    original). The loser must stop writing immediately."""
+
+    def __init__(self, host: str, owner: str, found: str):
+        super().__init__(
+            f"lease for host {host!r} is owned by {found!r}, not {owner!r} "
+            "— a second process claimed this host identity"
+        )
+        self.host = host
+
+
+def _write_json_atomic(path: str, rec: dict) -> None:
+    """tmp + fsync + rename (+ best-effort dir fsync): readers see the
+    old record or the new one, never a torn one — the bulk-ledger
+    checkpoint discipline (pipeline/bulk.py)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(rec, fh, sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+def _read_json(path: str) -> Optional[dict]:
+    """A missing or torn file reads as None (a crash mid-write leaves
+    only the previous complete record or nothing)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class MembershipPlane:
+    """One host's handle on the lease + generation files under ``root``.
+
+    Single-threaded per instance EXCEPT for lease writes: ``renew`` is
+    called from both the training thread (inline checks) and the
+    :class:`LeaseHeartbeat` thread, and is safe because each call
+    re-reads shared files and the write itself is an atomic rename.
+    """
+
+    def __init__(self, root: str, host: str, lease_ttl_s: float = 5.0,
+                 clock: Callable[[], float] = time.time):
+        if not host:
+            raise ValueError("membership host id must be non-empty")
+        self.root = root
+        self.host = host
+        self.lease_ttl_s = float(lease_ttl_s)
+        self._clock = clock
+        # Owner nonce: distinguishes "my own earlier write" from a
+        # second process claiming the same host name.
+        self._owner = f"{os.getpid()}.{os.urandom(4).hex()}"
+        os.makedirs(os.path.join(root, "hosts"), exist_ok=True)
+
+    # -- paths ------------------------------------------------------------
+
+    def _lease_path(self, host: str) -> str:
+        return os.path.join(self.root, "hosts", f"{host}.lease.json")
+
+    @property
+    def generation_path(self) -> str:
+        return os.path.join(self.root, "generation.json")
+
+    def _locked(self):
+        """Exclusive flock over the generation record (blocking: bumps
+        are rare and fast). Returns the open fh; closing drops it."""
+        fh = open(os.path.join(self.root, ".membership.lock"), "a+")
+        try:
+            import fcntl
+
+            fcntl.flock(fh, fcntl.LOCK_EX)
+        except ImportError:  # non-POSIX: single-process tests only
+            pass
+        return fh
+
+    # -- generation record ------------------------------------------------
+
+    def read_generation(self) -> Optional[dict]:
+        return _read_json(self.generation_path)
+
+    def form(self, hosts: Sequence[str],
+             resume_epoch: int = 1, resume_step: int = 0) -> dict:
+        """Create the generation-1 record from the declared host list
+        (idempotent: every host of the gang calls this at launch; the
+        first writer wins, the rest adopt the existing record)."""
+        if self.host not in hosts:
+            raise ValueError(
+                f"forming host {self.host!r} is not in the declared host "
+                f"list {list(hosts)}"
+            )
+        fh = self._locked()
+        try:
+            existing = self.read_generation()
+            if existing is not None:
+                return existing
+            rec = {
+                "generation": 1,
+                "hosts": sorted(hosts),
+                "resume_epoch": int(resume_epoch),
+                "resume_step": int(resume_step),
+                "t": self._clock(),
+            }
+            _write_json_atomic(self.generation_path, rec)
+            return rec
+        finally:
+            fh.close()
+
+    def bump(self, hosts: Sequence[str], resume_epoch: int,
+             resume_step: int, expected_generation: int) -> dict:
+        """Advance the generation to a new host list (shrink OR grow).
+
+        Monotonic and idempotent under races: if the record already
+        moved past ``expected_generation`` (another survivor bumped
+        first), the NEWER record is returned unwritten — callers treat
+        the return value, not their argument, as the outcome.
+        """
+        fh = self._locked()
+        try:
+            cur = self.read_generation()
+            if cur is None:
+                raise MembershipError(
+                    f"no generation record at {self.generation_path} "
+                    "(form() was never called)"
+                )
+            if cur["generation"] > expected_generation:
+                return cur
+            rec = {
+                "generation": int(cur["generation"]) + 1,
+                "hosts": sorted(hosts),
+                "resume_epoch": int(resume_epoch),
+                "resume_step": int(resume_step),
+                "t": self._clock(),
+            }
+            _write_json_atomic(self.generation_path, rec)
+            return rec
+        finally:
+            fh.close()
+
+    # -- leases -----------------------------------------------------------
+
+    def join(self, generation: Optional[int] = None, step: int = 0,
+             epoch: int = 0) -> dict:
+        """Write this host's first lease at the current generation.
+
+        A host not listed in the current generation (it died, the
+        survivors moved on) is REJECTED here — re-entry happens through
+        an explicit ``bump``, never by writing at the old generation.
+        """
+        rec = self.read_generation()
+        if rec is None:
+            raise MembershipError(
+                f"no generation record at {self.generation_path} "
+                "(form() was never called)"
+            )
+        if self.host not in rec["hosts"]:
+            raise StaleGenerationError(
+                self.host, generation if generation is not None
+                else rec["generation"] - 1, rec)
+        self._write_lease(rec["generation"], step, epoch)
+        return rec
+
+    def renew(self, generation: int, step: int = 0, epoch: int = 0) -> None:
+        """Renew this host's lease; the heartbeat path.
+
+        Raises :class:`StaleGenerationError` when the current record no
+        longer LISTS this host (it was evicted; survivors moved on) and
+        :class:`LeaseStolenError` when another process owns the lease.
+        A record that moved ahead while still listing this host is NOT
+        an error — that is the normal window between a peer's bump and
+        this host's next generation read (the lease stays fresh so the
+        peer does not evict a live host mid-transition).
+        """
+        failpoints.fire("membership.lease", payload=self.host)
+        rec = self.read_generation()
+        if rec is not None and self.host not in rec["hosts"]:
+            raise StaleGenerationError(self.host, generation, rec)
+        lease = _read_json(self._lease_path(self.host))
+        if lease is not None and lease.get("owner") != self._owner:
+            raise LeaseStolenError(
+                self.host, self._owner, str(lease.get("owner")))
+        self._write_lease(generation, step, epoch)
+
+    def _write_lease(self, generation: int, step: int,
+                     epoch: int = 0) -> None:
+        # (epoch, step) is this host's advertised training position:
+        # peers use it as the commit barrier (a checkpoint may only
+        # commit a position every live member has reached — the
+        # stand-in for "the collective completed this step", without
+        # which survivors could commit past a dead host's last
+        # contribution). A stale lease UNDERSTATES progress, so the
+        # barrier errs toward later commits, never unsafe ones.
+        _write_json_atomic(self._lease_path(self.host), {
+            "host": self.host,
+            "owner": self._owner,
+            "pid": os.getpid(),
+            "generation": int(generation),
+            "epoch": int(epoch),
+            "step": int(step),
+            "t": self._clock(),
+        })
+
+    def drop_lease(self) -> None:
+        """Remove this host's lease (clean shutdown: peers see an
+        orderly departure at the next scan instead of waiting a TTL)."""
+        try:
+            os.unlink(self._lease_path(self.host))
+        except OSError:
+            pass
+
+    def live_view(self) -> Dict[str, dict]:
+        """Every readable lease, keyed by host (expired ones included —
+        callers judge freshness against their own clock)."""
+        out: Dict[str, dict] = {}
+        hosts_dir = os.path.join(self.root, "hosts")
+        try:
+            entries = os.listdir(hosts_dir)
+        except OSError:
+            return out
+        for entry in sorted(entries):
+            if not entry.endswith(".lease.json"):
+                continue
+            lease = _read_json(os.path.join(hosts_dir, entry))
+            if lease and "host" in lease:
+                out[lease["host"]] = lease
+        return out
+
+    def detect_dead(self, record: Optional[dict] = None) -> List[str]:
+        """Hosts of the current generation whose lease expired (or was
+        never written after a formation grace of one TTL).
+
+        This host itself is never reported dead — a wedged local clock
+        must not let a host evict ITSELF and bump the gang under its
+        own feet.
+        """
+        failpoints.fire("membership.detect", payload=self.host)
+        rec = record if record is not None else self.read_generation()
+        if rec is None:
+            return []
+        now = self._clock()
+        leases = self.live_view()
+        dead = []
+        for host in rec["hosts"]:
+            if host == self.host:
+                continue
+            lease = leases.get(host)
+            if lease is None:
+                # Formation grace: a gang member that has not joined
+                # yet only counts dead once the record itself is older
+                # than one TTL.
+                if now - float(rec.get("t", now)) > self.lease_ttl_s:
+                    dead.append(host)
+            elif now - float(lease.get("t", 0.0)) > self.lease_ttl_s:
+                dead.append(host)
+        return dead
+
+
+class LeaseHeartbeat:
+    """Daemon thread renewing one host's lease every ``interval_s``.
+
+    The training thread reads :meth:`error` at its membership
+    checkpoints; the first renewal failure (stale generation, stolen
+    lease, unreachable filesystem) parks here and stops further
+    renewals — the trainer surfaces it, the thread never kills the
+    process on its own.
+    """
+
+    def __init__(self, plane: MembershipPlane, interval_s: float = 1.0):
+        self._plane = plane
+        self._interval_s = max(float(interval_s), 0.05)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._error: Optional[BaseException] = None
+        # guarded-by: self._lock
+        self._generation = 0
+        # guarded-by: self._lock
+        self._step = 0
+        # guarded-by: self._lock
+        self._epoch = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="membership-lease")
+
+    def start(self, generation: int, step: int = 0,
+              epoch: int = 0) -> "LeaseHeartbeat":
+        with self._lock:
+            self._generation = int(generation)
+            self._step = int(step)
+            self._epoch = int(epoch)
+        self._thread.start()
+        return self
+
+    def update(self, generation: int, step: int, epoch: int = 0) -> None:
+        """Advance the position the next renewal will advertise."""
+        with self._lock:
+            self._generation = int(generation)
+            self._step = int(step)
+            self._epoch = int(epoch)
+
+    def error(self) -> Optional[BaseException]:
+        with self._lock:
+            return self._error
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=self._interval_s * 4)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            with self._lock:
+                generation, step, epoch = (
+                    self._generation, self._step, self._epoch)
+            try:
+                self._plane.renew(generation, step=step, epoch=epoch)
+            except BaseException as exc:  # noqa: BLE001 — surfaced to
+                # the training thread at its next membership check.
+                with self._lock:
+                    self._error = exc
+                return

@@ -106,17 +106,36 @@ def host_label() -> str:
     return f"host{jax.process_index()}"
 
 
-def host_local_slice(global_batch_size: int) -> Tuple[int, int]:
+def host_local_slice(
+    global_batch_size: int,
+    rank: Optional[int] = None,
+    n_hosts: Optional[int] = None,
+) -> Tuple[int, int]:
     """[start, stop) of this host's rows of a globally-sharded batch.
 
     The data loader on each host reads only its slice; `host_local_batch`
     then assembles the global arrays without cross-host transfer (the
     standard multi-host input pattern).
+
+    `rank` / `n_hosts` default to the JAX process grid; the elastic
+    driver (training/elastic.py) passes its membership-derived values
+    instead, so the slice tracks the LIVE generation rather than the
+    process set the run was launched with.
     """
-    n, i = jax.process_count(), jax.process_index()
+    n = jax.process_count() if n_hosts is None else int(n_hosts)
+    i = jax.process_index() if rank is None else int(rank)
+    if n < 1:
+        raise ValueError(f"host count must be >= 1, got {n}")
+    if not 0 <= i < n:
+        raise ValueError(f"host rank {i} out of range for {n} hosts")
     if global_batch_size % n:
         raise ValueError(
-            f"global batch {global_batch_size} not divisible by {n} hosts"
+            f"global batch {global_batch_size} is not divisible by the "
+            f"{n} hosts sharding it (remainder {global_batch_size % n}): "
+            "every host must decode the same row count or the cross-host "
+            "array assembly wedges; pick a multiple of the host count, or "
+            "let the elastic driver round it down "
+            "(training/elastic.py adjusted_global_batch)"
         )
     per = global_batch_size // n
     return i * per, (i + 1) * per

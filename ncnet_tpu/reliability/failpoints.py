@@ -49,9 +49,12 @@ Planted sites (grep ``failpoints.fire`` for the live list):
 (cli/train step loop; ``corrupt`` NaN-poisons the divergence
 sentinel's resolved loss copy — obs/train_watch), ``bulk.read`` /
 ``bulk.dispatch`` / ``bulk.commit`` / ``bulk.checkpoint``
-(pipeline/bulk). The full site table with failure domains lives in
-docs/RELIABILITY.md and is lint-enforced
-(tests/test_failpoint_docs_lint.py).
+(pipeline/bulk), ``membership.lease`` (parallel/membership lease
+renewal; ``kill`` here SIGKILLs a host mid-heartbeat — the canonical
+host-death drill), ``membership.detect`` (dead-host detection sweep),
+``elastic.resume`` (training/elastic survivor resume entry). The full
+site table with failure domains lives in docs/RELIABILITY.md and is
+lint-enforced (tests/test_failpoint_docs_lint.py).
 
 Every injection is an obs event (``failpoint``) and a counter
 (``failpoint.<site>``) so a chaos run's run log records exactly what

@@ -11,6 +11,7 @@ from .trainer import (
 from .checkpoint import (
     save_checkpoint,
     load_checkpoint,
+    load_latest_checkpoint,
     load_opt_state,
     config_from_dict,
     resolve_resume_dir,
@@ -26,6 +27,7 @@ __all__ = [
     "replicate_state",
     "save_checkpoint",
     "load_checkpoint",
+    "load_latest_checkpoint",
     "load_opt_state",
     "config_from_dict",
     "resolve_resume_dir",

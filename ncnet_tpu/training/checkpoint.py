@@ -264,6 +264,80 @@ def load_checkpoint(path: str, opt_state_template=None):
     return result
 
 
+def checkpoint_candidates(directory: str) -> list:
+    """COMPLETE checkpoint dirs under a run dir, newest first.
+
+    Order: the rolling "step" family (``.tmp`` outranks ``step``
+    outranks ``.old`` — the resolve_resume_dir rule), then ``epoch_N``
+    descending. Completeness = meta.json AND params.npz present; a dir
+    can still be torn *inside* a file (a truncated params.npz from a
+    disk-full or a mid-write kill), which is what the fallback walk in
+    :func:`load_latest_checkpoint` exists for.
+    """
+    out = []
+    step = os.path.join(directory, "step")
+    for cand in (step + ".tmp", step, step + ".old"):
+        if os.path.isfile(os.path.join(cand, "meta.json")) and os.path.isfile(
+            os.path.join(cand, "params.npz")
+        ):
+            out.append(cand)
+    epochs = []
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        entries = []
+    for entry in entries:
+        if not entry.startswith("epoch_"):
+            continue
+        try:
+            n = int(entry.split("_", 1)[1])
+        except ValueError:
+            continue
+        cand = os.path.join(directory, entry)
+        if os.path.isfile(os.path.join(cand, "meta.json")) and os.path.isfile(
+            os.path.join(cand, "params.npz")
+        ):
+            epochs.append((n, cand))
+    out.extend(cand for _n, cand in sorted(epochs, reverse=True))
+    return out
+
+
+def load_latest_checkpoint(directory: str, opt_state_template=None):
+    """Load the newest LOADABLE checkpoint of a run dir, walking back.
+
+    The elastic resume path (training/elastic.py) must not die because
+    the newest checkpoint is torn (truncated params.npz, mangled
+    meta.json): each failed candidate logs a ``checkpoint_fallback``
+    event, bumps the ``train.checkpoint_fallbacks`` counter, and the
+    walk continues to the next-newest complete dir. Returns
+    ``(path, result)`` with ``result`` as :func:`load_checkpoint`'s
+    dict; raises ``FileNotFoundError`` only when NO candidate loads.
+    """
+    from .. import obs
+
+    errors = []
+    for cand in checkpoint_candidates(directory):
+        try:
+            return cand, load_checkpoint(cand, opt_state_template)
+        except Exception as exc:  # noqa: BLE001 — a torn file can
+            # surface as BadZipFile/JSONDecodeError/OSError/KeyError
+            # depending on which byte the truncation landed on; every
+            # flavor means "walk back one checkpoint", none is fatal.
+            errors.append((cand, exc))
+            obs.counter("train.checkpoint_fallbacks").inc()
+            obs.event(
+                "checkpoint_fallback", path=cand,
+                error=f"{type(exc).__name__}: {exc}"[:200],
+            )
+    detail = "; ".join(
+        f"{cand}: {type(exc).__name__}" for cand, exc in errors)
+    raise FileNotFoundError(
+        f"no loadable checkpoint under {directory!r}"
+        + (f" (every candidate failed: {detail})" if detail else
+           " (no complete candidate dirs)")
+    )
+
+
 def load_opt_state(path: str, template):
     """Restore just the optimizer state from a checkpoint dir, or None.
 

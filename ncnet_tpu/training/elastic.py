@@ -1,0 +1,406 @@
+"""Elastic training driver: survive a host loss, resume at step.
+
+The membership plane (parallel/membership.py) says WHO is in the run;
+this module makes the training loop ACT on it. One
+:class:`ElasticDriver` wraps a step loop (cli/train.py's, or the chaos
+harness's synthetic one):
+
+* every step calls :meth:`ElasticDriver.step_check` — a time-gated
+  membership probe (default every 0.25 s, so steady-state overhead is
+  a dict read, not a filesystem scan per step) that surfaces heartbeat
+  failures, picks up a newer generation written by a peer, and runs
+  dead-host detection. A detected death bumps the generation (shrink)
+  and raises :class:`MembershipChange`;
+
+* the trainer catches :class:`MembershipChange`, reloads the last
+  committed checkpoint (``training/checkpoint.py``'s fallback walk),
+  calls :meth:`ElasticDriver.resume`, re-derives its batch slice from
+  the NEW generation via :meth:`slice_for` /
+  :func:`adjusted_global_batch` (round down + ``train_batch_adjusted``
+  event when the shrunk host count no longer divides), fast-forwards
+  the deterministic loader to the checkpointed step, and continues;
+
+* every trained step is appended to a per-host **step ledger**
+  (``steps-<host>.jsonl``, line-buffered appends) recording
+  ``(generation, epoch, step, slice)`` — the zero-silent-step-loss
+  audit replays these and checks that every step of the final curve is
+  tiled by SOME generation's slices (tools/chaos_train.py).
+
+Caveat for real multi-process JAX pods: ``jax.distributed`` cannot
+reshape a live process set, so there the driver's job is detect →
+durable bump → exit-for-relaunch (the relaunched gang re-forms at the
+new generation and resumes from the same checkpoint chain); the
+continue-in-process path below is for the one-JAX-process-per-host
+harness mode (cli/train.py --elastic_dir, tools/chaos_train.py).
+
+Metrics (docs/OBSERVABILITY.md): ``train.generation`` /
+``train.hosts_live`` gauges, ``train.resumes`` / ``train.lost_steps``
+counters — booked through obs/train_watch.py so a fleet merge sees
+them next to the step beacons. Failpoint: ``elastic.resume`` fires at
+resume entry (error = a resume crash drill; kill = dying mid-resume,
+which must be re-survivable).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+from .. import obs
+from ..obs import train_watch
+from ..parallel import membership as _membership
+from ..parallel import multihost
+from ..reliability import failpoints
+
+
+class MembershipChange(RuntimeError):
+    """The generation moved (a host died or rejoined) and this host is
+    still a member: reload the last committed checkpoint, adopt
+    ``record`` via :meth:`ElasticDriver.resume`, and continue."""
+
+    def __init__(self, record: dict, dead: Optional[List[str]] = None,
+                 epoch: Optional[int] = None, step: Optional[int] = None):
+        super().__init__(
+            f"membership changed: generation {record.get('generation')} "
+            f"hosts {record.get('hosts')}"
+            + (f" (detected dead: {dead})" if dead else "")
+        )
+        self.record = record
+        self.dead = list(dead or [])
+        #: Where THIS host was when the change surfaced (feeds the
+        #: lost-step accounting in :meth:`ElasticDriver.resume`).
+        self.epoch = epoch
+        self.step = step
+
+
+def adjusted_global_batch(requested: int, n_hosts: int) -> int:
+    """Round the global batch DOWN to a multiple of the live host count.
+
+    A 3-host batch of 16 cannot survive a shrink to 2 hosts unchanged;
+    rather than abort, the elastic driver trains the largest divisible
+    batch and says so (``train_batch_adjusted`` event). Raises when
+    even one row per host does not fit.
+    """
+    n_hosts = int(n_hosts)
+    if n_hosts < 1:
+        raise ValueError(f"host count must be >= 1, got {n_hosts}")
+    adjusted = (int(requested) // n_hosts) * n_hosts
+    if adjusted < n_hosts:
+        raise ValueError(
+            f"global batch {requested} cannot cover {n_hosts} hosts "
+            "with at least one row each"
+        )
+    if adjusted != requested:
+        obs.event("train_batch_adjusted", requested=int(requested),
+                  adjusted=adjusted, hosts=n_hosts)
+    return adjusted
+
+
+class ElasticDriver:
+    """Membership-aware wrapper around one host's training loop.
+
+    Single-threaded by design: every method is called from the
+    training thread. The only companion thread is the
+    :class:`~..parallel.membership.LeaseHeartbeat`, which communicates
+    exclusively through its own lock (``error()``/``update()``).
+    """
+
+    def __init__(
+        self,
+        plane: _membership.MembershipPlane,
+        check_interval_s: float = 0.25,
+        heartbeat_s: Optional[float] = None,
+        ledger_dir: Optional[str] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.plane = plane
+        self.check_interval_s = float(check_interval_s)
+        # Default heartbeat: renew well inside the TTL. 0 disables the
+        # thread (unit tests renew inline from step_check instead).
+        self._heartbeat_s = (
+            heartbeat_s if heartbeat_s is not None
+            else max(plane.lease_ttl_s / 4.0, 0.05)
+        )
+        self._clock = clock
+        self._record: Optional[dict] = None
+        self._hb: Optional[_membership.LeaseHeartbeat] = None
+        self._last_check = float("-inf")
+        self._committed: Tuple[int, int] = (1, 0)
+        self.resumes = 0
+        self.lost_steps = 0
+        #: Cumulative seconds spent inside step_check's slow path —
+        #: the lease/heartbeat overhead bench_train --hosts reports
+        #: against total step time (< 2% acceptance line).
+        self.check_time_s = 0.0
+        self._ledger_fh = None
+        if ledger_dir:
+            os.makedirs(ledger_dir, exist_ok=True)
+            self._ledger_fh = open(
+                os.path.join(ledger_dir, f"steps-{plane.host}.jsonl"),
+                "a", encoding="utf-8")
+
+    # -- membership view ---------------------------------------------------
+
+    @property
+    def record(self) -> dict:
+        if self._record is None:
+            raise _membership.MembershipError("driver not started")
+        return self._record
+
+    @property
+    def generation(self) -> int:
+        return int(self.record["generation"])
+
+    @property
+    def hosts(self) -> List[str]:
+        return list(self.record["hosts"])
+
+    @property
+    def n_hosts(self) -> int:
+        return len(self.record["hosts"])
+
+    @property
+    def rank(self) -> int:
+        """This host's rank = its position in the generation's sorted
+        host list; rank 0 is the checkpoint writer (writer takeover on
+        a shrink that removes the old rank 0 is automatic)."""
+        return self.record["hosts"].index(self.plane.host)
+
+    @property
+    def is_writer(self) -> bool:
+        return self.rank == 0
+
+    def slice_for(self, global_batch_size: int) -> Tuple[int, int]:
+        """This generation's ``host_local_slice`` of the global batch."""
+        return multihost.host_local_slice(
+            global_batch_size, rank=self.rank, n_hosts=self.n_hosts)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, step: int = 0) -> "ElasticDriver":
+        """Join the current generation and start heartbeating."""
+        self._record = self.plane.join(step=step)
+        if self._heartbeat_s > 0:
+            self._hb = _membership.LeaseHeartbeat(
+                self.plane, interval_s=self._heartbeat_s
+            ).start(self.generation, step)
+        self._book_membership()
+        return self
+
+    def stop(self) -> None:
+        if self._hb is not None:
+            self._hb.stop()
+            self._hb = None
+        if self._ledger_fh is not None:
+            self._ledger_fh.close()
+            self._ledger_fh = None
+        self.plane.drop_lease()
+
+    def note_commit(self, epoch: int, step: int) -> None:
+        """Record the last COMMITTED checkpoint position; a bump's
+        resume marker advertises it so lost-step accounting and the
+        chaos audit know where survivors restarted."""
+        self._committed = (int(epoch), int(step))
+
+    def commit_barrier(self, epoch: int, step: int,
+                       wait_s: Optional[float] = None) -> bool:
+        """May the writer commit a checkpoint at ``(epoch, step)``?
+
+        Only once every live member's lease advertises a position at or
+        past it — the harness stand-in for "the collective completed
+        this step on every host". Without it a writer could commit a
+        position a since-dead host never contributed to, and the steps
+        between that host's death and its detection would be silently
+        lost (the resume marker would sit PAST them).
+
+        Advertised positions lag by up to one heartbeat, so like a real
+        collective the writer WAITS (default: three heartbeats) for
+        live peers to cross the target; a peer that never does within
+        the wait — dead, or wedged — fails the barrier and the save is
+        skipped (detection then evicts it). A stale lease understates
+        progress, so the barrier can delay a commit, never admit an
+        unsafe one.
+        """
+        if wait_s is None:
+            wait_s = 3.0 * self._heartbeat_s
+        target = (int(epoch), int(step))
+        deadline = self._clock() + max(wait_s, 0.0)
+        while True:
+            leases = self.plane.live_view()
+            behind = None
+            for host in self.hosts:
+                if host == self.plane.host:
+                    continue
+                lease = leases.get(host)
+                pos = ((int(lease.get("epoch", 0)),
+                        int(lease.get("step", 0)))
+                       if lease is not None else (-1, -1))
+                if pos < target:
+                    behind = host
+                    break
+            if behind is None:
+                return True
+            if self._clock() >= deadline:
+                return False
+            time.sleep(0.01)
+
+    def advertise(self, epoch: int, step: int) -> None:
+        """Push this host's training position toward the gang out of
+        band (the next heartbeat carries it; written immediately when
+        no heartbeat thread runs)."""
+        if self._hb is not None:
+            self._hb.update(self.generation, step, epoch=epoch)
+        else:
+            self.plane.renew(self.generation, step=step, epoch=epoch)
+
+    def finish_barrier(self, num_epochs: int,
+                       wait_s: float = 600.0) -> bool:
+        """Block a host that COMPLETED the run until its gang peers are
+        done too, so its expiring lease is not mistaken for a mid-run
+        death (peers would bump and replay the tail epoch for nothing).
+
+        Advertises ``(num_epochs + 1, 0)`` — past any trainable
+        position — then waits until every peer is *finished* (its lease
+        advertises the same), *departed* (lease dropped: a clean exit),
+        or *dead* (lease stale past the TTL: no point waiting). The
+        heartbeat keeps renewing throughout, so a straggler never
+        evicts the waiter; the wait is bounded only by ``wait_s`` as a
+        wedge backstop — a live peer either advances or goes stale
+        within one TTL.
+        """
+        target = (int(num_epochs) + 1, 0)
+        self.advertise(target[0], target[1])
+        deadline = self._clock() + max(wait_s, 0.0)
+        while True:
+            leases = self.plane.live_view()
+            now = self.plane._clock()
+            waiting = None
+            for host in self.hosts:
+                if host == self.plane.host:
+                    continue
+                lease = leases.get(host)
+                if lease is None:
+                    continue  # departed: clean drop on exit
+                if (int(lease.get("epoch", 0)),
+                        int(lease.get("step", 0))) >= target:
+                    continue  # finished
+                if now - float(lease.get("t", 0.0)) \
+                        > self.plane.lease_ttl_s:
+                    continue  # dead: nothing to wait for
+                waiting = host
+                break
+            if waiting is None:
+                return True
+            if self._clock() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    # -- the per-step probe ------------------------------------------------
+
+    def step_check(self, epoch: int, step: int, force: bool = False) -> None:
+        """Membership probe for one training step (time-gated).
+
+        Fast path: one clock read. Slow path (every
+        ``check_interval_s``): surface heartbeat errors, renew inline
+        when no heartbeat thread runs, re-read the generation record,
+        detect dead hosts and bump. Raises :class:`MembershipChange`
+        (still a member; resume and continue) or
+        :class:`~..parallel.membership.StaleGenerationError` (evicted).
+        """
+        now = self._clock()
+        if not force and now - self._last_check < self.check_interval_s:
+            return
+        self._last_check = now
+        try:
+            # Generation first: a peer's bump must surface as a
+            # MembershipChange BEFORE this host renews or detects at
+            # the generation it still holds.
+            rec = self.plane.read_generation()
+            if rec is not None and rec["generation"] > self.generation:
+                if self.plane.host not in rec["hosts"]:
+                    raise _membership.StaleGenerationError(
+                        self.plane.host, self.generation, rec)
+                raise MembershipChange(rec, epoch=epoch, step=step)
+            if self._hb is not None:
+                self._hb.update(self.generation, step, epoch=epoch)
+                err = self._hb.error()
+                if err is not None:
+                    raise err
+            else:
+                self.plane.renew(self.generation, step=step, epoch=epoch)
+            dead = self.plane.detect_dead(rec)
+            if dead:
+                survivors = [h for h in self.hosts if h not in dead]
+                new = self.plane.bump(
+                    survivors,
+                    resume_epoch=self._committed[0],
+                    resume_step=self._committed[1],
+                    expected_generation=self.generation,
+                )
+                if self.plane.host not in new["hosts"]:
+                    raise _membership.StaleGenerationError(
+                        self.plane.host, self.generation, new)
+                raise MembershipChange(new, dead=dead, epoch=epoch, step=step)
+        finally:
+            self.check_time_s += self._clock() - now
+
+    # -- resume ------------------------------------------------------------
+
+    def resume(self, record: dict, resumed_epoch: int, resumed_step: int,
+               detected_epoch: int, detected_step: int,
+               steps_per_epoch: int) -> None:
+        """Adopt a new generation after reloading the checkpoint.
+
+        ``resumed_*`` is the checkpoint position training restarts
+        from, ``detected_*`` where this host was when the change
+        surfaced; the difference is this host's re-trained ("lost")
+        steps — bounded by the save interval plus the detection window,
+        never silent.
+        """
+        failpoints.fire("elastic.resume", payload=record.get("generation"))
+        lost = max(
+            (int(detected_epoch) - int(resumed_epoch)) * int(steps_per_epoch)
+            + int(detected_step) - int(resumed_step), 0)
+        self._record = record
+        self.resumes += 1
+        self.lost_steps += lost
+        if self._hb is not None:
+            self._hb.update(self.generation, resumed_step,
+                            epoch=resumed_epoch)
+        else:
+            self.plane.renew(self.generation, step=resumed_step,
+                             epoch=resumed_epoch)
+        train_watch.book_resume(self.generation, lost)
+        self._book_membership()
+        obs.event(
+            "elastic_resume", generation=self.generation, hosts=self.hosts,
+            host=self.plane.host, rank=self.rank,
+            resumed_epoch=int(resumed_epoch), resumed_step=int(resumed_step),
+            detected_epoch=int(detected_epoch),
+            detected_step=int(detected_step), lost_steps=lost,
+        )
+
+    def _book_membership(self) -> None:
+        train_watch.book_membership(self.generation, self.n_hosts)
+
+    # -- step ledger -------------------------------------------------------
+
+    def record_step(self, epoch: int, step: int,
+                    batch_slice: Optional[Tuple[int, int]] = None) -> None:
+        """Append one trained step to this host's ledger (flushed per
+        line: after a SIGKILL the ledger is complete up to the last
+        finished step, which is exactly what the audit replays)."""
+        if self._ledger_fh is None:
+            return
+        rec = {
+            "gen": self.generation,
+            "epoch": int(epoch),
+            "step": int(step),
+            "host": self.plane.host,
+        }
+        if batch_slice is not None:
+            rec["slice"] = [int(batch_slice[0]), int(batch_slice[1])]
+        self._ledger_fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._ledger_fh.flush()

@@ -809,3 +809,84 @@ def test_bench_trend_passes_consensus_plan_fields_through(tmp_path,
     assert report["consensus_plan_kind"] == "cp"
     assert report["cp_rank"] == 8
     assert report["cp_agreement"] == 0.93
+
+
+def test_chaos_train_emits_one_json_verdict_line(tmp_path):
+    """tools/chaos_train.py stdout contract (ISSUE 20): the elastic
+    chaos gate prints ONE JSON line carrying the full verdict — every
+    acceptance check named, the ledger audit, the strict-curve gate —
+    and exits 0 iff all of them hold. Tiny deterministic config: 2
+    hosts, failpoint-armed victim death at its 3rd lease renewal."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_train.py"),
+         "--hosts", "2", "--epochs", "2", "--steps", "20",
+         "--batch", "8", "--step-s", "0.04", "--save-interval", "5",
+         "--lease-ttl-s", "0.5", "--check-interval-s", "0.08",
+         "--kill", "failpoint", "--kill-after-renewals", "2",
+         "--resume-budget-steps", "40", "--dir", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO)
+    lines = [l for l in res.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "chaos_train"
+    assert res.returncode == 0, (rec, res.stderr[-2000:])
+    assert rec["ok"] is True
+    assert rec["kill_mode"] == "failpoint"
+    assert rec["killed"] not in rec["live_hosts"]
+    assert rec["generation"] >= 2
+    assert rec["resumes"] >= 1
+    for check, passed in rec["checks"].items():
+        assert passed, (check, rec)
+    # The ledger audit is the headline: no step of the final curve may
+    # go untrained by every generation.
+    assert rec["ledger_ok"] is True
+    assert rec["strict_ok"] is True
+
+
+@pytest.mark.slow
+def test_bench_train_hosts_emits_scaling_line(tmp_path):
+    """tools/bench_train.py --hosts stdout contract (ISSUE 20): the
+    elastic scaling mode prints ONE JSON line with the efficiency
+    headline, the lease-overhead share (< 2% acceptance) and the
+    resume count, and never imports jax in the parent."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_train.py"),
+         "--hosts", "2", "--batch", "8", "--elastic-steps", "16"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = [l for l in res.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "train_elastic_scaling"
+    assert rec["unit"] == "scaling_efficiency"
+    assert rec["hosts"] == 2
+    assert rec["value"] == rec["scaling_efficiency"] > 0
+    assert rec["lease_overhead_frac"] < 0.02
+    assert rec["elastic_resumes"] == 0  # no-kill fleets must not churn
+    assert rec["synthetic"] is True
+
+
+def test_bench_trend_passes_elastic_fields_through(tmp_path, capsys):
+    """tools/bench_trend.py forwards the elastic-scaling fields (ISSUE
+    20): an efficiency trend is only comparable at one host count, and
+    a number earned mid-eviction-recovery is not steady-state."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_trend
+
+    rec = {"n": 1, "cmd": "bench", "rc": 0,
+           "parsed": {"metric": "train_elastic_scaling",
+                      "value": 0.97, "unit": "scaling_efficiency",
+                      "hosts": 3, "scaling_efficiency": 0.97,
+                      "elastic_resumes": 0}}
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump(rec, fh)
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["hosts"] == 3
+    assert report["scaling_efficiency"] == 0.97
+    assert report["elastic_resumes"] == 0

@@ -1,0 +1,326 @@
+"""Elastic training driver (training/elastic.py): batch adjustment,
+membership-change surfacing, resume accounting, commit barrier, and the
+checkpoint fallback walk it resumes through.
+
+Driver tests run with ``heartbeat_s=0`` (inline renewals from
+step_check) on fake clocks — no thread, no sleeps, fully deterministic.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import obs
+from ncnet_tpu.parallel import multihost
+from ncnet_tpu.parallel.membership import (
+    MembershipPlane,
+    StaleGenerationError,
+)
+from ncnet_tpu.training import elastic
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _events(name):
+    return [r for r in obs.flight.recorder().snapshot()
+            if r.get("event") == name]
+
+
+def _driver(root, host, clock, ttl=5.0, **kw):
+    plane = MembershipPlane(str(root), host, lease_ttl_s=ttl, clock=clock)
+    kw.setdefault("heartbeat_s", 0)
+    kw.setdefault("check_interval_s", 0.0)
+    return elastic.ElasticDriver(plane, clock=clock, **kw)
+
+
+# -- host_local_slice rank/n_hosts (satellite 1) ---------------------------
+
+
+def test_host_local_slice_explicit_rank_and_hosts():
+    assert multihost.host_local_slice(12, rank=0, n_hosts=3) == (0, 4)
+    assert multihost.host_local_slice(12, rank=2, n_hosts=3) == (8, 12)
+    # Defaults still resolve from the JAX process grid (single process
+    # on CPU: the whole batch).
+    assert multihost.host_local_slice(12) == (0, 12)
+
+
+def test_host_local_slice_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="host count must be >= 1"):
+        multihost.host_local_slice(12, rank=0, n_hosts=0)
+    with pytest.raises(ValueError, match="rank 3 out of range"):
+        multihost.host_local_slice(12, rank=3, n_hosts=3)
+    # The indivisible message must name the remainder AND the way out
+    # (the elastic round-down) — it fires mid-incident.
+    with pytest.raises(ValueError, match="remainder 1.*adjusted_global_batch"):
+        multihost.host_local_slice(13, rank=0, n_hosts=3)
+
+
+# -- adjusted_global_batch -------------------------------------------------
+
+
+def test_adjusted_global_batch_rounds_down_and_says_so():
+    before = len(_events("train_batch_adjusted"))
+    assert elastic.adjusted_global_batch(16, 3) == 15
+    evs = _events("train_batch_adjusted")
+    assert len(evs) == before + 1
+    assert evs[-1]["requested"] == 16
+    assert evs[-1]["adjusted"] == 15
+    assert evs[-1]["hosts"] == 3
+
+
+def test_adjusted_global_batch_exact_is_silent():
+    before = len(_events("train_batch_adjusted"))
+    assert elastic.adjusted_global_batch(12, 3) == 12
+    assert len(_events("train_batch_adjusted")) == before
+
+
+def test_adjusted_global_batch_rejects_impossible():
+    with pytest.raises(ValueError, match="cannot cover 5 hosts"):
+        elastic.adjusted_global_batch(3, 5)
+    with pytest.raises(ValueError, match="host count must be >= 1"):
+        elastic.adjusted_global_batch(8, 0)
+
+
+# -- driver membership view ------------------------------------------------
+
+
+def test_driver_rank_writer_and_slice(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock)
+    db = _driver(tmp_path, "b", clock)
+    da.plane.form(["a", "b"])
+    da.start()
+    db.start()
+    assert (da.rank, db.rank) == (0, 1)
+    assert da.is_writer and not db.is_writer
+    assert da.slice_for(8) == (0, 4)
+    assert db.slice_for(8) == (4, 8)
+    assert da.n_hosts == 2 and da.generation == 1
+
+
+def test_step_check_detects_death_bumps_and_raises(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock, ledger_dir=str(tmp_path))
+    db = _driver(tmp_path, "b", clock)
+    da.plane.form(["a", "b"])
+    da.start()
+    db.start()
+    da.note_commit(1, 6)  # last committed checkpoint position
+    clock.t = 6.0  # b's lease (t=0) expires; a renews inline in check
+    with pytest.raises(elastic.MembershipChange) as exc:
+        da.step_check(1, 9, force=True)
+    chg = exc.value
+    assert chg.dead == ["b"]
+    assert (chg.epoch, chg.step) == (1, 9)
+    assert chg.record["generation"] == 2
+    assert chg.record["hosts"] == ["a"]
+    # The bump advertised the commit marker as the resume point.
+    assert (chg.record["resume_epoch"], chg.record["resume_step"]) == (1, 6)
+    # Writer takeover is automatic once the driver adopts the record.
+    da.resume(chg.record, 1, 6, chg.epoch, chg.step, steps_per_epoch=24)
+    assert da.generation == 2 and da.is_writer and da.n_hosts == 1
+    assert da.resumes == 1
+    assert da.lost_steps == 3  # detected (1,9) minus resumed (1,6)
+    evs = _events("elastic_resume")
+    assert evs and evs[-1]["lost_steps"] == 3
+
+
+def test_step_check_surfaces_peer_bump_before_detection(tmp_path):
+    # A peer already bumped (grow or shrink): this host must adopt the
+    # NEWER record, not renew/detect at the generation it still holds.
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock)
+    db = _driver(tmp_path, "b", clock)
+    da.plane.form(["a", "b"])
+    da.start()
+    db.start()
+    new = db.plane.bump(["a", "b", "c"], resume_epoch=1, resume_step=0,
+                        expected_generation=1)
+    with pytest.raises(elastic.MembershipChange) as exc:
+        da.step_check(1, 3, force=True)
+    assert exc.value.record == new
+    assert exc.value.dead == []
+
+
+def test_step_check_raises_stale_when_evicted(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock)
+    db = _driver(tmp_path, "b", clock)
+    da.plane.form(["a", "b"])
+    da.start()
+    db.start()
+    db.plane.bump(["b"], resume_epoch=1, resume_step=0,
+                  expected_generation=1)
+    with pytest.raises(StaleGenerationError):
+        da.step_check(1, 3, force=True)
+
+
+def test_step_check_is_time_gated(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock, check_interval_s=0.25)
+    da.plane.form(["a"])
+    da.start()
+    da.step_check(1, 0)  # first check runs (gate starts at -inf)
+    t0 = da.check_time_s
+    da.step_check(1, 1)  # within the interval: fast path, no probe
+    assert da.check_time_s == t0
+    clock.t = 0.3
+    da.step_check(1, 2)
+    assert da.check_time_s >= t0
+
+
+def test_resume_lost_steps_across_epoch_boundary(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock)
+    da.plane.form(["a"])
+    da.start()
+    rec = dict(da.record)
+    da.resume(rec, resumed_epoch=1, resumed_step=20, detected_epoch=2,
+              detected_step=4, steps_per_epoch=24)
+    assert da.lost_steps == 8  # (2-1)*24 + 4 - 20
+
+
+# -- commit barrier --------------------------------------------------------
+
+
+def test_commit_barrier_waits_for_every_live_member(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock)
+    db = _driver(tmp_path, "b", clock)
+    da.plane.form(["a", "b"])
+    da.start()
+    db.start()
+    # b advertises (1, 5): the writer may commit positions up to it ...
+    db.plane.renew(1, step=5, epoch=1)
+    assert da.commit_barrier(1, 5, wait_s=0) is True
+    assert da.commit_barrier(1, 4, wait_s=0) is True
+    # ... but not past it — a commit the gang has not reached is the
+    # silent-step-loss window the barrier exists to close.
+    assert da.commit_barrier(1, 6, wait_s=0) is False
+    assert da.commit_barrier(2, 0, wait_s=0) is False
+    db.plane.renew(1, step=6, epoch=1)
+    assert da.commit_barrier(1, 6, wait_s=0) is True
+
+
+def test_commit_barrier_fails_on_missing_peer_lease(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock)
+    db = _driver(tmp_path, "b", clock)
+    da.plane.form(["a", "b"])
+    da.start()
+    db.start()
+    db.plane.drop_lease()  # dead peer: no advertised position at all
+    assert da.commit_barrier(1, 1, wait_s=0) is False
+
+
+def test_commit_barrier_solo_is_immediate(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock)
+    da.plane.form(["a"])
+    da.start()
+    assert da.commit_barrier(7, 100, wait_s=0) is True
+
+
+def test_finish_barrier_releases_on_peer_finish_depart_or_death(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock)
+    db = _driver(tmp_path, "b", clock)
+    da.plane.form(["a", "b"])
+    da.start()
+    db.start()
+    # b still mid-run with a fresh lease: the finisher must wait.
+    db.plane.renew(1, step=3, epoch=1)
+    assert da.finish_barrier(2, wait_s=0) is False
+    # b finished too (advertises past any trainable position): release.
+    db.plane.renew(1, step=0, epoch=3)
+    assert da.finish_barrier(2, wait_s=0) is True
+    # b departed cleanly (lease dropped): release.
+    db.plane.drop_lease()
+    assert da.finish_barrier(2, wait_s=0) is True
+    # b dead mid-run (stale lease): nothing to wait for — release.
+    db.plane.renew(1, step=3, epoch=1)
+    clock.t = 6.0
+    assert da.finish_barrier(2, wait_s=0) is True
+
+
+def test_advertise_writes_through_without_heartbeat(tmp_path):
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock)
+    da.plane.form(["a"])
+    da.start()
+    da.advertise(3, 11)
+    lease = da.plane.live_view()["a"]
+    assert (lease["epoch"], lease["step"]) == (3, 11)
+
+
+# -- step ledger -----------------------------------------------------------
+
+
+def test_record_step_ledger_lines(tmp_path):
+    import json as _json
+
+    clock = FakeClock()
+    da = _driver(tmp_path, "a", clock, ledger_dir=str(tmp_path / "led"))
+    da.plane.form(["a"])
+    da.start()
+    da.record_step(1, 0, (0, 4))
+    da.record_step(1, 1, (0, 4))
+    da.stop()
+    path = tmp_path / "led" / "steps-a.jsonl"
+    lines = [_json.loads(l) for l in path.read_text().splitlines()]
+    assert lines == [
+        {"gen": 1, "epoch": 1, "step": 0, "host": "a", "slice": [0, 4]},
+        {"gen": 1, "epoch": 1, "step": 1, "host": "a", "slice": [0, 4]},
+    ]
+
+
+# -- checkpoint fallback walk (satellite 2) --------------------------------
+
+
+def _save_tiny(directory, epoch, tag=None):
+    from ncnet_tpu.models import BackboneConfig, NCNetConfig
+    from ncnet_tpu.training.checkpoint import save_checkpoint
+
+    config = NCNetConfig(backbone=BackboneConfig(cnn="vgg"),
+                         ncons_kernel_sizes=(3,),
+                         ncons_channels=(1,))
+    return save_checkpoint(
+        directory, {"neigh_consensus": np.zeros(4, np.float32)}, config,
+        epoch=epoch, extra={"step_in_epoch": 0}, tag=tag)
+
+
+def test_load_latest_checkpoint_walks_past_truncation(tmp_path):
+    from ncnet_tpu.training.checkpoint import load_latest_checkpoint
+
+    root = str(tmp_path / "run")
+    _save_tiny(root, epoch=1)
+    _save_tiny(root, epoch=2, tag="step")
+    # Truncate the newest candidate's params mid-file (disk-full /
+    # mid-write kill): complete by the meta.json marker, torn inside.
+    with open(os.path.join(root, "step", "params.npz"), "wb") as fh:
+        fh.write(b"\x50\x4b")  # a 2-byte "zip"
+    before = obs.metrics.snapshot()["counters"].get(
+        "train.checkpoint_fallbacks", 0)
+    path, result = load_latest_checkpoint(root)
+    assert path.endswith("epoch_1")
+    assert result["meta"]["epoch"] == 1
+    after = obs.metrics.snapshot()["counters"].get(
+        "train.checkpoint_fallbacks", 0)
+    assert after == before + 1
+    evs = _events("checkpoint_fallback")
+    assert evs and evs[-1]["path"].endswith("step")
+    assert "Error" in evs[-1]["error"] or "error" in evs[-1]["error"]
+
+
+def test_load_latest_checkpoint_raises_when_nothing_loads(tmp_path):
+    from ncnet_tpu.training.checkpoint import load_latest_checkpoint
+
+    with pytest.raises(FileNotFoundError, match="no loadable checkpoint"):
+        load_latest_checkpoint(str(tmp_path / "empty"))

@@ -1,0 +1,306 @@
+"""Membership plane (parallel/membership.py): leases, generations, rejoin.
+
+Everything runs on an injected fake clock — lease expiry, steal
+detection and bump ordering are pure functions of the files on disk
+plus the clock value, so no test sleeps.
+"""
+
+import json
+import os
+
+import pytest
+
+from ncnet_tpu.obs.metrics import MetricsRegistry
+from ncnet_tpu.parallel.membership import (
+    LeaseHeartbeat,
+    LeaseStolenError,
+    MembershipError,
+    MembershipPlane,
+    StaleGenerationError,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _plane(root, host, clock, ttl=5.0):
+    return MembershipPlane(str(root), host, lease_ttl_s=ttl, clock=clock)
+
+
+# -- formation -------------------------------------------------------------
+
+
+def test_form_is_idempotent_first_writer_wins(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    b = _plane(tmp_path, "b", clock)
+    rec_a = a.form(["a", "b"])
+    clock.t = 1.0
+    rec_b = b.form(["b", "a"])  # second former adopts, does not rewrite
+    assert rec_a == rec_b
+    assert rec_a["generation"] == 1
+    assert rec_a["hosts"] == ["a", "b"]
+    assert rec_a["t"] == 0.0
+
+
+def test_form_rejects_host_not_in_gang(tmp_path):
+    with pytest.raises(ValueError, match="not in the declared host list"):
+        _plane(tmp_path, "c", FakeClock()).form(["a", "b"])
+
+
+# -- leases: renew / expire / steal ----------------------------------------
+
+
+def test_lease_renew_keeps_host_alive_expiry_kills_it(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    b = _plane(tmp_path, "b", clock)
+    a.form(["a", "b"])
+    a.join()
+    b.join()
+    clock.t = 4.0
+    b.renew(1)  # b renews inside the TTL...
+    clock.t = 8.0  # ...a does not: a's lease (t=0) is now 8s old
+    assert b.detect_dead() == ["a"]
+    assert a.detect_dead() == []  # never reports ITSELF dead
+    clock.t = 8.5
+    a.renew(1)  # a comes back before anyone bumped: alive again
+    assert b.detect_dead() == []
+
+
+def test_lease_steal_detected_by_owner_nonce(tmp_path):
+    clock = FakeClock()
+    a1 = _plane(tmp_path, "a", clock)
+    a1.form(["a"])
+    a1.join()
+    # A relaunch claims the same host name and writes its own lease.
+    a2 = _plane(tmp_path, "a", clock)
+    a2.join()
+    with pytest.raises(LeaseStolenError):
+        a1.renew(1)
+    # The thief itself keeps renewing fine — it owns the lease now.
+    a2.renew(1)
+
+
+def test_drop_lease_reads_as_departure(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    b = _plane(tmp_path, "b", clock)
+    a.form(["a", "b"])
+    a.join()
+    b.join()
+    b.drop_lease()
+    assert "b" not in a.live_view()
+    clock.t = 6.0  # past the formation grace: a missing lease is dead
+    assert a.detect_dead() == ["b"]
+
+
+def test_detect_dead_formation_grace(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    a.form(["a", "b"])
+    a.join()
+    # b never joined; within one TTL of the record that is grace ...
+    clock.t = 4.0
+    assert a.detect_dead() == []
+    # ... after it, a no-show is a death.
+    clock.t = 6.0
+    assert a.detect_dead() == ["b"]
+
+
+def test_lease_carries_training_position(tmp_path):
+    # The commit barrier (training/elastic.py) reads peers' advertised
+    # (epoch, step) off their leases; the fields must round-trip.
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    a.form(["a"])
+    a.join(step=7, epoch=2)
+    lease = a.live_view()["a"]
+    assert (lease["epoch"], lease["step"]) == (2, 7)
+    a.renew(1, step=9, epoch=3)
+    lease = a.live_view()["a"]
+    assert (lease["epoch"], lease["step"]) == (3, 9)
+
+
+# -- generation bumps ------------------------------------------------------
+
+
+def test_bump_is_monotonic_and_idempotent_under_races(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    b = _plane(tmp_path, "b", clock)
+    a.form(["a", "b", "c"])
+    # Two survivors race the same eviction of c: the first bump wins,
+    # the second (same expected_generation) returns the winner's record
+    # UNWRITTEN instead of double-bumping.
+    rec_a = a.bump(["a", "b"], resume_epoch=1, resume_step=6,
+                   expected_generation=1)
+    assert rec_a["generation"] == 2
+    rec_b = b.bump(["b"], resume_epoch=1, resume_step=6,
+                   expected_generation=1)
+    assert rec_b == rec_a  # b's shrink-to-solo never landed
+    assert b.read_generation()["hosts"] == ["a", "b"]
+
+
+def test_bump_requires_formation(tmp_path):
+    with pytest.raises(MembershipError, match="form"):
+        _plane(tmp_path, "a", FakeClock()).bump(
+            ["a"], resume_epoch=1, resume_step=0, expected_generation=1)
+
+
+def test_bump_records_resume_marker(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    a.form(["a", "b"])
+    rec = a.bump(["a"], resume_epoch=3, resume_step=12,
+                 expected_generation=1)
+    assert (rec["resume_epoch"], rec["resume_step"]) == (3, 12)
+
+
+# -- rejoin ----------------------------------------------------------------
+
+
+def test_rejoin_after_eviction_rejected_at_old_generation(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    b = _plane(tmp_path, "b", clock)
+    a.form(["a", "b"])
+    a.join()
+    b.join()
+    clock.t = 6.0
+    b.renew(1)
+    a.bump(["b"], resume_epoch=1, resume_step=0,  # a evicts itself out
+           expected_generation=1)
+    # The evicted host may not write state at the old generation ...
+    with pytest.raises(StaleGenerationError):
+        a.renew(1)
+    with pytest.raises(StaleGenerationError):
+        a.join()
+    # ... re-admission is an explicit grow bump, then join works.
+    rec = a.read_generation()
+    new = a.bump(sorted(set(rec["hosts"]) | {"a"}), resume_epoch=1,
+                 resume_step=0, expected_generation=rec["generation"])
+    assert new["generation"] == 3
+    assert new["hosts"] == ["a", "b"]
+    a.join()
+    assert "a" in b.live_view()
+
+
+def test_renew_tolerates_newer_generation_that_still_lists_host(tmp_path):
+    # The window between a peer's bump and this host's next generation
+    # read: the record moved ahead but still lists the host — renewing
+    # at the held generation must NOT raise, or the peer would evict a
+    # live host mid-transition.
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    b = _plane(tmp_path, "b", clock)
+    a.form(["a", "b", "c"])
+    a.join()
+    b.join()
+    b.bump(["a", "b"], resume_epoch=1, resume_step=0,
+           expected_generation=1)
+    a.renew(1)  # held generation is stale but a is still a member
+
+
+# -- durability ------------------------------------------------------------
+
+
+def test_torn_record_reads_as_none_not_garbage(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    a.form(["a"])
+    with open(a.generation_path, "w", encoding="utf-8") as fh:
+        fh.write('{"generation": 2, "hos')  # a crash mid-write
+    assert a.read_generation() is None
+    # A torn lease likewise drops out of the live view.
+    a.join_path = a._lease_path("a")
+    with open(a.join_path, "w", encoding="utf-8") as fh:
+        fh.write("{")
+    assert a.live_view() == {}
+
+
+def test_atomic_write_leaves_no_tmp_litter(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    a.form(["a"])
+    a.join()
+    names = {n for n in os.listdir(str(tmp_path))}
+    names |= {n for n in os.listdir(str(tmp_path / "hosts"))}
+    assert not any(n.endswith(".tmp") for n in names)
+
+
+# -- heartbeat thread ------------------------------------------------------
+
+
+def test_heartbeat_parks_first_error_and_stops(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    a.form(["a", "b"])
+    a.join()
+    hb = LeaseHeartbeat(a, interval_s=0.05).start(1)
+    try:
+        # Evict a: the next renewal must park StaleGenerationError for
+        # the training thread instead of killing the process.
+        b = _plane(tmp_path, "b", clock)
+        b.bump(["b"], resume_epoch=1, resume_step=0,
+               expected_generation=1)
+        deadline = 100
+        while hb.error() is None and deadline:
+            deadline -= 1
+            import time as _time
+
+            _time.sleep(0.02)
+        assert isinstance(hb.error(), StaleGenerationError)
+    finally:
+        hb.stop()
+
+
+# -- fleet view: a dead host's frozen beacon shows as lag ------------------
+
+
+def test_dead_host_beacon_merge_shows_it_behind(tmp_path):
+    """Two hosts' registries merged the way fleet_status merges
+    scrapes: the host whose lease expired stops advancing its step
+    beacon, and publish_host_lag pins it behind the survivor — the
+    observability echo of what detect_dead sees on disk."""
+    from ncnet_tpu import obs
+    from ncnet_tpu.obs import train_watch as tw
+
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    b = _plane(tmp_path, "b", clock)
+    a.form(["a", "b"])
+    a.join()
+    b.join()
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    wa = tw.TrainWatch(registry=ra, host="a", clock=clock)
+    wb = tw.TrainWatch(registry=rb, host="b", clock=clock)
+    wa.publish_beacon(10)
+    wb.publish_beacon(10)
+    # b dies at step 10: its beacon freezes, its lease goes stale.
+    clock.t = 8.0
+    a.renew(1)
+    wa.publish_beacon(40)
+    assert a.detect_dead() == ["b"]
+    view = obs.aggregate.merge_snapshots([ra.snapshot(), rb.snapshot()])
+    out = MetricsRegistry()
+    behind = tw.publish_host_lag(view, registry=out)
+    assert behind == {"a": 0.0, "b": 30.0}
+
+
+def test_live_view_is_json_per_lease(tmp_path):
+    clock = FakeClock()
+    a = _plane(tmp_path, "a", clock)
+    a.form(["a"])
+    a.join()
+    path = os.path.join(str(tmp_path), "hosts", "a.lease.json")
+    with open(path, encoding="utf-8") as fh:
+        lease = json.load(fh)
+    assert lease["host"] == "a"
+    assert lease["generation"] == 1
+    assert "owner" in lease and "pid" in lease

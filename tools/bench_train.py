@@ -44,7 +44,19 @@ def main(argv=None):
         "hardware). Empty = single run with the inherited env.",
     )
     p.add_argument("--dial_timeout", type=float, default=600.0)
+    # Elastic scaling line: run the chaos_train fleet (no kill) at 1
+    # host and at N hosts, report scaling efficiency and the measured
+    # lease/heartbeat overhead share of step time (< 2% acceptance).
+    p.add_argument(
+        "--hosts", type=int, default=0,
+        help="emit the train_elastic_scaling line for an N-host elastic "
+        "CPU fleet instead of the single-process step benchmark")
+    p.add_argument("--elastic-steps", type=int, default=24,
+                   help="--hosts mode: steps per epoch per fleet run")
     args = p.parse_args(argv)
+
+    if args.hosts:
+        return _measure_elastic_scaling(args)
 
     import jax
     import jax.numpy as jnp
@@ -167,6 +179,92 @@ def main(argv=None):
                               "error": str(exc)[:200]}), flush=True)
         finally:
             os.environ.pop("NCNET_TRAIN_REMAT_POLICY", None)
+
+
+def _measure_elastic_scaling(args):
+    """N-host elastic fleet throughput vs a 1-host baseline.
+
+    Both runs go through tools/chaos_train.py with ``--kill none`` (the
+    same worker loop the chaos gate audits — leases, step checks,
+    commit barriers — minus the kill). The baseline trains the per-host
+    slice, the fleet trains N slices of the same global batch, so ideal
+    scaling is exactly N× and ``scaling_efficiency`` is their ratio.
+    ``lease_overhead_frac`` is the fleet's cumulative
+    ``ElasticDriver.step_check`` time over cumulative training time —
+    the membership tax on every step, gated < 2%.
+    """
+    import glob as _glob
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    n = max(args.hosts, 1)
+    per_host = max(args.batch // n, 1)
+
+    def fleet(n_hosts, batch):
+        root = tempfile.mkdtemp(prefix=f"bench_elastic_{n_hosts}_")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "chaos_train.py"),
+             "--kill", "none", "--hosts", str(n_hosts), "--epochs", "1",
+             "--steps", str(args.elastic_steps), "--batch", str(batch),
+             # No rolling saves: the writer's commit-barrier waits would
+             # bill checkpoint sync into the throughput number; the
+             # scaling line measures the per-step membership tax only.
+             "--save-interval", "0", "--dir", root],
+            env=env, capture_output=True, text=True, timeout=600)
+        sys.stderr.write(proc.stderr[-2000:])
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{n_hosts}-host fleet exited {proc.returncode}")
+        results = []
+        for path in _glob.glob(os.path.join(root, "result-*.json")):
+            with open(path, encoding="utf-8") as fh:
+                results.append(json.load(fh))
+        if len(results) != n_hosts:
+            raise RuntimeError(
+                f"expected {n_hosts} result files, got {len(results)}")
+        wall = max(r["train_time_s"] for r in results)
+        return {
+            "pairs_per_s": sum(r["pairs"] for r in results)
+            / max(wall, 1e-9),
+            "check_frac": sum(r["check_time_s"] for r in results)
+            / max(sum(r["train_time_s"] for r in results), 1e-9),
+            "resumes": sum(r["resumes"] for r in results),
+        }
+
+    try:
+        base = fleet(1, per_host)
+        scaled = fleet(n, per_host * n)
+    except (RuntimeError, subprocess.TimeoutExpired, OSError) as exc:
+        print(str(exc), file=sys.stderr)
+        print(json.dumps({"metric": "train_elastic_scaling",
+                          "error": str(exc)[:200]}), flush=True)
+        return 2
+    efficiency = scaled["pairs_per_s"] / max(n * base["pairs_per_s"], 1e-9)
+    line = {
+        "metric": "train_elastic_scaling",
+        "value": round(efficiency, 4),
+        "unit": "scaling_efficiency",
+        "hosts": n,
+        "batch": per_host * n,
+        "scaling_efficiency": round(efficiency, 4),
+        "pairs_per_s": round(scaled["pairs_per_s"], 2),
+        "baseline_pairs_per_s": round(base["pairs_per_s"], 2),
+        "lease_overhead_frac": round(scaled["check_frac"], 5),
+        "elastic_resumes": scaled["resumes"],
+        "synthetic": True,
+    }
+    print(json.dumps(line), flush=True)
+    # The acceptance line: membership must tax step time under 2%.
+    if scaled["check_frac"] >= 0.02:
+        print(f"lease overhead {scaled['check_frac']:.4f} >= 2% of step "
+              "time", file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

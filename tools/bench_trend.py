@@ -110,6 +110,10 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
     # And the train-bench fields (tools/bench_train.py
     # train_step_pairs_per_s): a training-throughput trend is only
     # comparable within one device count / batch / remat-accum shape.
+    # And the elastic-scaling fields (tools/bench_train.py --hosts
+    # train_elastic_scaling): an efficiency trend is only comparable
+    # at one host count, and a number earned while the fleet was
+    # resuming from evictions is not a steady-state number.
     for key in ("replicas", "single_replica_pairs_per_s", "scaling_x",
                 "scaling_efficiency", "pairs_done", "pairs_s",
                 "quarantined", "resumes",
@@ -118,7 +122,8 @@ def trend(rounds: List[Tuple[int, dict]], threshold: float) -> dict:
                 "fanout_width", "rescache_hit_rate", "legs",
                 "legs_failed",
                 "consensus_plan_kind", "cp_rank", "cp_agreement",
-                "step_ms", "devices", "batch", "accum", "remat_policy"):
+                "step_ms", "devices", "batch", "accum", "remat_policy",
+                "hosts", "elastic_resumes"):
         if key in latest:
             report[key] = latest[key]
     return report

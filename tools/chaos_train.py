@@ -1,0 +1,492 @@
+"""Elastic-training chaos gate: SIGKILL a host, survivors must resume.
+
+Boots an N-host CPU training fleet (one process per host, rendezvous
+through a shared membership root — ncnet_tpu/parallel/membership.py),
+kills one host mid-epoch, and audits the recovery end to end:
+
+- the survivors detect the death (lease TTL), bump the membership
+  generation WITHOUT the victim, reload the last committed checkpoint
+  and resume within ``--resume-budget-steps`` re-trained steps;
+- the per-host step ledgers (``steps-<host>.jsonl``) prove ZERO silent
+  step loss: every ``(epoch, step)`` of the final curve is tiled by
+  some generation's batch slices;
+- every booked loss is finite;
+- the surviving writer's runlog passes ``tools/train_report.py
+  --strict`` against the committed reference curve
+  (``tests/data/elastic_train_reference.json``).
+
+Workers train a deterministic synthetic objective (loss = 1/(1+step))
+through the REAL machinery under test: MembershipPlane leases +
+generations, ElasticDriver step checks + resume, the rolling
+rename-aside checkpoint chain (training/checkpoint.py), and the
+training observatory (obs/train_watch.py) — only the model math is
+stubbed, so the gate runs anywhere in seconds.
+
+Kill modes (``--kill``):
+
+- ``poll`` (default): the parent watches the victim's step ledger and
+  SIGKILLs it once it has trained ``--kill-after-step`` steps — the
+  OOM/preemption shape;
+- ``failpoint``: arms ``NCNET_FAILPOINTS=membership.lease=kill:+N`` on
+  the victim so it dies at exactly its (N+1)-th lease renewal —
+  deterministic placement for the contract test;
+- ``none``: no kill (bench_train --hosts uses this for clean scaling
+  runs).
+
+Prints ONE JSON line (the repo bench contract)::
+
+    {"metric": "chaos_train", "value": 1.0, "ok": true, "hosts": 3,
+     "killed": "host1", "generation": 2, "resumes": 1, "lost_steps": 4,
+     "ledger_ok": true, "strict_ok": true, ...}
+
+Exit 0 iff every check passed. Prose goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_REFERENCE = os.path.join(
+    REPO, "tests", "data", "elastic_train_reference.json")
+
+
+# ---------------------------------------------------------------------------
+# worker: one "host" of the fleet
+# ---------------------------------------------------------------------------
+
+def run_worker(args) -> int:
+    import numpy as np
+
+    from ncnet_tpu import obs
+    from ncnet_tpu.models.backbone import BackboneConfig
+    from ncnet_tpu.models.ncnet import NCNetConfig
+    from ncnet_tpu.obs.train_watch import TrainWatch
+    from ncnet_tpu.parallel.membership import (
+        MembershipPlane, StaleGenerationError)
+    from ncnet_tpu.reliability import failpoints
+    from ncnet_tpu.training import elastic as elastic_mod
+    from ncnet_tpu.training import save_checkpoint, load_latest_checkpoint
+
+    root = args.membership_root
+    host = args.host
+    gang = [h for h in args.gang.split(",") if h]
+    plane = MembershipPlane(root, host, lease_ttl_s=args.lease_ttl_s)
+    plane.form(gang)
+    driver = elastic_mod.ElasticDriver(
+        plane, check_interval_s=args.check_interval_s, ledger_dir=root)
+    driver.start()
+
+    run_log = obs.init_run(
+        "train", os.path.join(root, f"runlog-train-{host}.jsonl"),
+        args=args, heartbeat_s=0)
+    watch = TrainWatch(policy="halt", host=host, log_interval=1)
+    ckpt_dir = os.path.join(root, "ckpt")
+
+    # Tiny-but-real checkpoint payload: the chain, swap, and fallback
+    # walk under test are byte-identical to a full run's.
+    config = NCNetConfig(
+        backbone=BackboneConfig(cnn="vgg"),
+        ncons_kernel_sizes=(3,), ncons_channels=(1,))
+    params = {"neigh_consensus": np.zeros(4, np.float32)}
+
+    def save(epoch, step_in_epoch=None):
+        extra = {"train_loss": [], "val_loss": []}
+        if step_in_epoch is not None:
+            extra["step_in_epoch"] = step_in_epoch
+        save_checkpoint(
+            ckpt_dir, params, config, epoch, extra=extra,
+            tag="step" if step_in_epoch is not None else None)
+
+    n_nonfinite = 0
+    n_steps_trained = 0
+    pairs = 0
+    train_time_s = 0.0
+    start_epoch, skip = 1, 0
+    rc = 0
+    try:
+        while True:
+            try:
+                for epoch in range(start_epoch, args.epochs + 1):
+                    watch.reset_epoch()
+                    skip_now = skip if epoch == start_epoch else 0
+                    gbs = elastic_mod.adjusted_global_batch(
+                        args.batch, driver.n_hosts)
+                    bslice = (driver.slice_for(gbs)
+                              if driver.n_hosts > 1 else (0, gbs))
+                    t_ep = time.monotonic()
+                    losses = []
+                    for i, _b in watch.steps(
+                            iter(range(skip_now, args.steps)),
+                            start=skip_now):
+                        failpoints.fire("train.step", payload=i)
+                        driver.step_check(epoch, i)
+                        gstep = (epoch - 1) * args.steps + i
+                        time.sleep(args.step_s)
+                        loss = 1.0 / (1.0 + gstep)
+                        watch.book(epoch=epoch, step=i, loss=loss,
+                                   grad_norm=loss, update_ratio=1e-3)
+                        if not np.isfinite(loss):
+                            n_nonfinite += 1
+                        losses.append(loss)
+                        # The live generation's slice may differ from
+                        # this epoch's opening one after a mid-epoch
+                        # resume re-entered the loop.
+                        driver.record_step(epoch, i, bslice)
+                        n_steps_trained += 1
+                        pairs += bslice[1] - bslice[0]
+                        if (args.save_interval
+                                and (i + 1) % args.save_interval == 0
+                                and driver.is_writer
+                                and driver.commit_barrier(epoch, i + 1)):
+                            save(epoch, step_in_epoch=i + 1)
+                            driver.note_commit(epoch, i + 1)
+                    watch.drain()
+                    dur = time.monotonic() - t_ep
+                    train_time_s += dur
+                    obs.event(
+                        "epoch", epoch=epoch,
+                        train_loss=float(np.mean(losses)) if losses
+                        else 0.0,
+                        val_loss=0.0, n_steps=len(losses), dur_s=dur,
+                        pairs_per_s=(len(losses) * (bslice[1] - bslice[0])
+                                     / max(dur, 1e-9)))
+                    obs.get_run().flush_metrics(phase=f"epoch{epoch}")
+                    if driver.is_writer and driver.commit_barrier(
+                            epoch, args.steps):
+                        save(epoch)
+                        driver.note_commit(epoch + 1, 0)
+                # An early finisher's expiring lease must not read as a
+                # mid-run death to peers still training.
+                driver.finish_barrier(args.epochs)
+                break
+            except elastic_mod.MembershipChange as chg:
+                try:
+                    _path, loaded = load_latest_checkpoint(ckpt_dir)
+                    meta = loaded["meta"]
+                    if "step_in_epoch" in meta:
+                        r_e = int(meta["epoch"])
+                        r_s = int(meta["step_in_epoch"])
+                    else:
+                        r_e, r_s = int(meta["epoch"]) + 1, 0
+                except FileNotFoundError:
+                    # Death before the first commit: replay from scratch.
+                    r_e, r_s = 1, 0
+                driver.resume(
+                    chg.record, r_e, r_s,
+                    chg.epoch if chg.epoch is not None else r_e,
+                    chg.step if chg.step is not None else r_s,
+                    steps_per_epoch=args.steps)
+                print(f"{host}: resumed at generation {driver.generation} "
+                      f"from epoch {r_e} step {r_s}", file=sys.stderr)
+                start_epoch, skip = r_e, r_s
+    except StaleGenerationError as exc:
+        print(f"{host}: evicted: {exc}", file=sys.stderr)
+        rc = 3
+    finally:
+        watch.close()
+        result = {
+            "host": host,
+            "generation": driver.generation,
+            "hosts": driver.hosts,
+            "resumes": driver.resumes,
+            "lost_steps": driver.lost_steps,
+            "nonfinite": n_nonfinite,
+            "steps_trained": n_steps_trained,
+            "pairs": pairs,
+            "train_time_s": train_time_s,
+            "check_time_s": driver.check_time_s,
+            "rc": rc,
+        }
+        with open(os.path.join(root, f"result-{host}.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(result, fh)
+        driver.stop()
+        run_log.close("ok" if rc == 0 else f"rc:{rc}")
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# parent: fleet boot, kill, audit
+# ---------------------------------------------------------------------------
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # A leaked pool address would send the CPU workers hunting for a
+    # remote TPU fleet.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _read_ledger_lines(path: str):
+    out = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def audit_ledgers(root: str, batch: int, epochs: int, steps: int) -> dict:
+    """Zero-silent-step-loss audit over the per-host step ledgers.
+
+    For every (epoch, step) of the final curve SOME generation's
+    recorded slices must tile the full adjusted global batch of that
+    generation — contiguous from row 0 with no gap and no missing
+    tail. Steps before the kill tile under the old generation, the
+    replayed tail under the new one; a step no generation covers is a
+    silently lost step.
+    """
+    by_gen = {}      # gen -> {(epoch, step): set[(start, stop)]}
+    gen_hosts = {}   # gen -> set[host]
+    for path in glob.glob(os.path.join(root, "steps-*.jsonl")):
+        for rec in _read_ledger_lines(path):
+            gen = int(rec.get("gen", 0))
+            key = (int(rec.get("epoch", 0)), int(rec.get("step", -1)))
+            sl = rec.get("slice") or [0, batch]
+            by_gen.setdefault(gen, {}).setdefault(key, set()).add(
+                (int(sl[0]), int(sl[1])))
+            gen_hosts.setdefault(gen, set()).add(rec.get("host"))
+
+    def tiles(intervals, want: int) -> bool:
+        pos = 0
+        for a, b in sorted(intervals):
+            if a > pos:
+                return False
+            pos = max(pos, b)
+        return pos >= want
+
+    missing = []
+    for epoch in range(1, epochs + 1):
+        for step in range(steps):
+            key = (epoch, step)
+            covered = False
+            for gen, steps_map in by_gen.items():
+                n = max(len(gen_hosts.get(gen, ())), 1)
+                want = (batch // n) * n
+                if key in steps_map and tiles(steps_map[key], want):
+                    covered = True
+                    break
+            if not covered:
+                missing.append(key)
+    return {
+        "ok": not missing,
+        "missing_steps": missing[:20],
+        "generations": sorted(by_gen),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--membership-root", default="",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--host", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--gang", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--hosts", type=int, default=3,
+                    help="fleet size (one process per host)")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=24,
+                    help="steps per epoch")
+    ap.add_argument("--batch", type=int, default=12,
+                    help="global batch the hosts slice")
+    ap.add_argument("--step-s", type=float, default=0.05,
+                    help="synthetic device time per step")
+    ap.add_argument("--save-interval", type=int, default=6,
+                    help="steps between rolling checkpoints")
+    ap.add_argument("--lease-ttl-s", type=float, default=0.75)
+    ap.add_argument("--check-interval-s", type=float, default=0.1)
+    ap.add_argument("--kill", choices=("poll", "failpoint", "none"),
+                    default="poll")
+    ap.add_argument("--kill-after-step", type=int, default=-1,
+                    help="poll mode: SIGKILL the victim once its ledger "
+                    "shows this epoch-1 step trained (default steps//3)")
+    ap.add_argument("--kill-after-renewals", type=int, default=3,
+                    help="failpoint mode: victim dies at its (N+1)-th "
+                    "lease renewal")
+    ap.add_argument("--resume-budget-steps", type=int, default=24,
+                    help="max re-trained (lost) steps per survivor: the "
+                    "save interval plus the detection window, with slack")
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    ap.add_argument("--dir", default="",
+                    help="membership/artifact root (default: a fresh "
+                    "temp dir)")
+    ap.add_argument("--reference", default=DEFAULT_REFERENCE,
+                    help="train_report --strict reference curve")
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        return run_worker(args)
+
+    import tempfile
+
+    root = args.dir or tempfile.mkdtemp(prefix="chaos_train_")
+    os.makedirs(root, exist_ok=True)
+    hosts = [f"host{i}" for i in range(args.hosts)]
+    gang = ",".join(hosts)
+    kill = args.kill if args.hosts > 1 else "none"
+    victim = hosts[1] if kill != "none" else None
+    kill_after = (args.kill_after_step if args.kill_after_step >= 0
+                  else max(args.steps // 3, 1))
+
+    procs = {}
+    for h in hosts:
+        env = _worker_env()
+        if kill == "failpoint" and h == victim:
+            env["NCNET_FAILPOINTS"] = (
+                f"membership.lease=kill:+{args.kill_after_renewals}")
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               "--membership-root", root, "--host", h, "--gang", gang,
+               "--epochs", str(args.epochs), "--steps", str(args.steps),
+               "--batch", str(args.batch), "--step-s", str(args.step_s),
+               "--save-interval", str(args.save_interval),
+               "--lease-ttl-s", str(args.lease_ttl_s),
+               "--check-interval-s", str(args.check_interval_s)]
+        procs[h] = subprocess.Popen(
+            cmd, env=env, stdout=sys.stderr, stderr=sys.stderr)
+    print(f"chaos_train: {args.hosts} hosts under {root}"
+          + (f", will kill {victim} ({kill})" if victim else ""),
+          file=sys.stderr)
+
+    deadline = time.time() + args.timeout_s
+    killed_at = None
+    if kill == "poll":
+        ledger = os.path.join(root, f"steps-{victim}.jsonl")
+        while time.time() < deadline:
+            lines = _read_ledger_lines(ledger)
+            if any(l.get("epoch") == 1 and l.get("step", -1) >= kill_after
+                   for l in lines):
+                procs[victim].send_signal(signal.SIGKILL)
+                killed_at = max(l.get("step", -1) for l in lines
+                                if l.get("epoch") == 1)
+                print(f"chaos_train: SIGKILL {victim} at epoch 1 step "
+                      f"~{killed_at}", file=sys.stderr)
+                break
+            if procs[victim].poll() is not None:
+                break  # died on its own (shouldn't)
+            time.sleep(0.02)
+
+    rcs = {}
+    for h, p in procs.items():
+        left = max(deadline - time.time(), 1.0)
+        try:
+            rcs[h] = p.wait(timeout=left)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rcs[h] = "timeout"
+
+    survivors = [h for h in hosts if h != victim]
+    results = {}
+    for h in survivors:
+        try:
+            with open(os.path.join(root, f"result-{h}.json"),
+                      encoding="utf-8") as fh:
+                results[h] = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            results[h] = None
+
+    checks = {}
+    checks["survivors_exited_clean"] = all(
+        rcs.get(h) == 0 for h in survivors)
+    checks["results_present"] = all(
+        results.get(h) is not None for h in survivors)
+    ok_results = {h: r for h, r in results.items() if r}
+
+    try:
+        with open(os.path.join(root, "generation.json"),
+                  encoding="utf-8") as fh:
+            final_gen = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        final_gen = {}
+    if victim is not None:
+        checks["victim_evicted"] = (
+            victim not in final_gen.get("hosts", [victim]))
+        checks["generation_bumped"] = final_gen.get("generation", 0) >= 2
+        checks["survivors_resumed"] = all(
+            r.get("resumes", 0) >= 1 for r in ok_results.values()
+        ) and bool(ok_results)
+        checks["resume_within_budget"] = all(
+            r.get("lost_steps", 1 << 30) <= args.resume_budget_steps
+            for r in ok_results.values()) and bool(ok_results)
+    checks["zero_nonfinite_losses"] = all(
+        r.get("nonfinite", 1) == 0 for r in ok_results.values()
+    ) and bool(ok_results)
+
+    ledger_audit = audit_ledgers(root, args.batch, args.epochs, args.steps)
+    checks["ledger_no_silent_step_loss"] = ledger_audit["ok"]
+    if not ledger_audit["ok"]:
+        print(f"chaos_train: untiled steps: "
+              f"{ledger_audit['missing_steps']}", file=sys.stderr)
+
+    # The surviving writer's curve must pass the committed-reference
+    # strict gate — recovery that wrecks the loss curve is not recovery.
+    strict_report = {}
+    if survivors and ok_results:
+        writer = sorted(ok_results)[0]
+        rp = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "train_report.py"),
+             os.path.join(root, f"runlog-train-{writer}.jsonl"),
+             "--strict", "--reference", args.reference],
+            env=_worker_env(), capture_output=True, text=True,
+            timeout=60)
+        sys.stderr.write(rp.stderr)
+        try:
+            strict_report = json.loads(rp.stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            strict_report = {"error": "unparseable train_report output"}
+        checks["strict_curve"] = rp.returncode == 0
+    else:
+        checks["strict_curve"] = False
+
+    ok = all(checks.values())
+    total_lost = sum(r.get("lost_steps", 0) for r in ok_results.values())
+    total_resumes = sum(r.get("resumes", 0) for r in ok_results.values())
+    out = {
+        "metric": "chaos_train",
+        "value": 1.0 if ok else 0.0,
+        "unit": "pass",
+        "ok": ok,
+        "hosts": args.hosts,
+        "killed": victim,
+        "kill_mode": kill,
+        "generation": final_gen.get("generation"),
+        "live_hosts": final_gen.get("hosts"),
+        "resumes": total_resumes,
+        "lost_steps": total_lost,
+        "resume_budget_steps": args.resume_budget_steps,
+        "ledger_ok": ledger_audit["ok"],
+        "ledger_generations": ledger_audit["generations"],
+        "strict_ok": checks.get("strict_curve"),
+        "strict_final_loss": strict_report.get("final_loss"),
+        "checks": checks,
+        "exit_codes": rcs,
+        "root": root,
+    }
+    print(json.dumps(out))
+    for name, passed in checks.items():
+        print(f"  {'PASS' if passed else 'FAIL'} {name}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,7 +47,13 @@ truncated-rank declared agreement floor, and FFT relative-error
 parity). ``--with-train-smoke`` runs a tiny CPU training-throughput
 smoke (``tools/bench_train.py --backbone vgg --image-size 48 --batch 2
 --iters 2`` — the jitted train step must complete and emit its
-one-JSON-line headline). All are off by default because they serve
+one-JSON-line headline). ``--with-elastic-chaos`` runs the elastic
+multi-host training chaos gate (``tools/chaos_train.py`` — a 3-host
+CPU fleet with one host SIGKILLed mid-epoch; survivors must evict it,
+bump the membership generation, resume from the last committed
+checkpoint within the step budget, lose no step silently per the
+ledger audit, and the surviving curve must pass ``train_report
+--strict``). All are off by default because they serve
 live traffic for several seconds (or, for trace_join, are covered by
 tier-1); a default run still RECORDS them as
 ``{"skipped": true, "optional": true}`` so the JSON never reads as if
@@ -82,7 +88,7 @@ CHECKS = ("tier1", "lint", "bench_trend")
 # default run records them as {"skipped": true, "optional": true}.
 OPTIONAL_CHECKS = ("full_lint", "tenant_flood", "session_chaos",
                    "quality_report", "trace_join", "localize_smoke",
-                   "cp_parity", "train_smoke")
+                   "cp_parity", "train_smoke", "elastic_chaos")
 
 
 def _run(cmd, timeout_s, cpu_env=False) -> dict:
@@ -208,6 +214,19 @@ def run_train_smoke(timeout_s: float) -> dict:
         timeout_s, cpu_env=True)
 
 
+def run_elastic_chaos(timeout_s: float) -> dict:
+    # The elastic-training chaos gate: 3 single-process CPU "hosts"
+    # under one filesystem membership plane, victim SIGKILLed once its
+    # ledger shows mid-epoch progress. Exit 0 iff every check in the
+    # tool's one-JSON-line verdict holds (eviction, generation bump,
+    # resume-within-budget, zero non-finite losses, ledger tiling,
+    # strict curve).
+    return _run(
+        [sys.executable, os.path.join("tools", "chaos_train.py"),
+         "--hosts", "3"],
+        timeout_s, cpu_env=True)
+
+
 def run_trace_join(timeout_s: float) -> dict:
     # The distributed-trace assembly self-test: two synthetic runlogs
     # (client, server skewed +30s) must export as ONE joined tree with
@@ -264,6 +283,12 @@ def main(argv=None) -> int:
                     help="also run the CPU training-step smoke "
                          "(tools/bench_train.py, tiny VGG config); off "
                          "by default, recorded as skipped when off")
+    ap.add_argument("--with-elastic-chaos", action="store_true",
+                    help="also run the elastic-training chaos gate "
+                         "(tools/chaos_train.py: 3-host CPU fleet, one "
+                         "host SIGKILLed mid-epoch, survivors must "
+                         "resume with zero silent step loss); off by "
+                         "default, recorded as skipped when off")
     ap.add_argument("--chaos-timeout-s", type=float, default=300.0,
                     help="wall-clock fence for the optional chaos checks")
     args = ap.parse_args(argv)
@@ -282,6 +307,7 @@ def main(argv=None) -> int:
             args.chaos_timeout_s),
         "cp_parity": lambda: run_cp_parity(args.timeout_s),
         "train_smoke": lambda: run_train_smoke(args.chaos_timeout_s),
+        "elastic_chaos": lambda: run_elastic_chaos(args.chaos_timeout_s),
     }
     enabled = {"full_lint": args.with_full_lint,
                "tenant_flood": args.with_tenant_flood,
@@ -290,7 +316,8 @@ def main(argv=None) -> int:
                "trace_join": args.with_trace_join,
                "localize_smoke": args.with_localize_smoke,
                "cp_parity": args.with_cp_parity,
-               "train_smoke": args.with_train_smoke}
+               "train_smoke": args.with_train_smoke,
+               "elastic_chaos": args.with_elastic_chaos}
     checks = {}
     for name in CHECKS + OPTIONAL_CHECKS:
         if name in args.skip or not enabled.get(name, True):
